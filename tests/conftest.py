@@ -1,0 +1,22 @@
+import os
+
+# Force JAX onto a virtual 8-device CPU mesh before any jax import: multi-chip
+# sharding is designed for TPU but validated on host devices (no multi-chip
+# hardware in CI).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from tpudra import featuregates  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_feature_gates():
+    featuregates.reset_for_testing()
+    yield
+    featuregates.reset_for_testing()

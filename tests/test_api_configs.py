@@ -1,0 +1,218 @@
+import pytest
+
+from tpudra import featuregates as fg
+from tpudra.api import (
+    API_VERSION_STR,
+    ComputeDomainChannelConfig,
+    DecodeError,
+    TpuConfig,
+    decode_config,
+    encode_config,
+)
+from tpudra.api.computedomain import ComputeDomainValidationError
+from tpudra.api.quantity import InvalidQuantity, parse_quantity
+from tpudra.api.sharing import (
+    MultiProcessConfig,
+    SharingValidationError,
+    TpuSharing,
+    time_slice_ordinal,
+)
+
+
+# -- quantity ---------------------------------------------------------------
+
+def test_parse_quantity():
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("512Mi") == 512 * 2**20
+    assert parse_quantity("4G") == 4 * 10**9
+    assert parse_quantity("1024") == 1024
+    assert parse_quantity("1.5Gi") == int(1.5 * 2**30)
+    with pytest.raises(InvalidQuantity):
+        parse_quantity("abc")
+    with pytest.raises(InvalidQuantity):
+        parse_quantity("1GiB")
+
+
+# -- decoder registry -------------------------------------------------------
+
+def test_decode_tpu_config_roundtrip():
+    data = {
+        "apiVersion": API_VERSION_STR,
+        "kind": "TpuConfig",
+        "sharing": {
+            "strategy": "TimeSlicing",
+            "timeSlicingConfig": {"interval": "Long"},
+        },
+    }
+    cfg = decode_config(data)
+    assert isinstance(cfg, TpuConfig)
+    assert cfg.sharing.is_time_slicing
+    assert cfg.sharing.time_slicing_config.interval == "Long"
+    assert encode_config(cfg) == data
+
+
+def test_strict_rejects_unknown_fields():
+    data = {
+        "apiVersion": API_VERSION_STR,
+        "kind": "TpuConfig",
+        "sharing": {"strategy": "TimeSlicing", "bogusField": 1},
+    }
+    with pytest.raises(DecodeError, match="bogusField"):
+        decode_config(data, strict=True)
+    cfg = decode_config(data, strict=False)  # non-strict tolerates (api.go:54-58)
+    assert cfg.sharing.is_time_slicing
+
+
+def test_decode_rejects_wrong_group_and_kind():
+    with pytest.raises(DecodeError, match="apiVersion"):
+        decode_config({"apiVersion": "other/v1", "kind": "TpuConfig"})
+    with pytest.raises(DecodeError, match="kind"):
+        decode_config({"apiVersion": API_VERSION_STR, "kind": "Nope"})
+
+
+# -- TpuConfig normalize/validate -------------------------------------------
+
+def test_default_config_no_gates():
+    cfg = TpuConfig.default()
+    assert cfg.sharing is None
+    cfg.normalize()
+    cfg.validate()
+    assert cfg.sharing is None
+
+
+def test_default_config_with_timeslicing_gate():
+    fg.feature_gates().set_from_spec("TimeSlicingSettings=true")
+    cfg = TpuConfig.default()
+    assert cfg.sharing.is_time_slicing
+    assert cfg.sharing.time_slicing_config.interval == "Default"
+
+
+def test_normalize_fills_timeslicing_interval():
+    fg.feature_gates().set_from_spec("TimeSlicingSettings=true")
+    cfg = TpuConfig(sharing=TpuSharing(strategy="TimeSlicing"))
+    cfg.normalize()
+    assert cfg.sharing.time_slicing_config.interval == "Default"
+
+
+def test_validate_bad_strategy():
+    cfg = TpuConfig(sharing=TpuSharing(strategy="Nope"))
+    with pytest.raises(SharingValidationError):
+        cfg.validate()
+
+
+def test_validate_conflicting_configs():
+    s = TpuSharing(
+        strategy="TimeSlicing",
+        time_slicing_config=None,
+        multi_process_config=MultiProcessConfig(),
+    )
+    with pytest.raises(SharingValidationError, match="multiProcessConfig"):
+        TpuConfig(sharing=s).validate()
+
+
+def test_time_slice_ordinals():
+    assert time_slice_ordinal("Default") == 0
+    assert time_slice_ordinal("Short") == 1
+    assert time_slice_ordinal("Medium") == 2
+    assert time_slice_ordinal("Long") == 3
+    assert time_slice_ordinal("Eon") == -1
+
+
+# -- MultiProcess per-device limits (reference sharing_test.go coverage) ----
+
+UUIDS = ["tpu-uuid-0", "tpu-uuid-1", "tpu-uuid-2"]
+
+
+def test_limits_default_applies_to_all():
+    cfg = MultiProcessConfig(default_pinned_hbm_limit="1Gi")
+    limits = cfg.normalized_limits(UUIDS)
+    assert limits == {u: "1024M" for u in UUIDS}
+
+
+def test_limits_per_device_overrides_default():
+    cfg = MultiProcessConfig(
+        default_pinned_hbm_limit="1Gi",
+        default_per_device_pinned_hbm_limit={"1": "2Gi", "tpu-uuid-2": "512Mi"},
+    )
+    limits = cfg.normalized_limits(UUIDS)
+    assert limits["tpu-uuid-0"] == "1024M"
+    assert limits["tpu-uuid-1"] == "2048M"
+    assert limits["tpu-uuid-2"] == "512M"
+
+
+def test_limits_bad_index():
+    cfg = MultiProcessConfig(default_per_device_pinned_hbm_limit={"9": "1Gi"})
+    with pytest.raises(SharingValidationError, match="index"):
+        cfg.normalized_limits(UUIDS)
+
+
+def test_limits_bad_key():
+    cfg = MultiProcessConfig(default_per_device_pinned_hbm_limit={"not-a-uuid": "1Gi"})
+    with pytest.raises(SharingValidationError, match="integer"):
+        cfg.normalized_limits(UUIDS)
+
+
+def test_limits_too_low():
+    cfg = MultiProcessConfig(default_per_device_pinned_hbm_limit={"0": "100k"})
+    with pytest.raises(SharingValidationError, match="too low"):
+        cfg.normalized_limits(UUIDS)
+
+
+def test_limits_default_too_low():
+    cfg = MultiProcessConfig(default_pinned_hbm_limit="1k")
+    with pytest.raises(SharingValidationError, match="too low"):
+        cfg.normalized_limits(UUIDS)
+
+
+def test_tensorcore_percentage_validation():
+    MultiProcessConfig(default_active_tensorcore_percentage=50).validate()
+    with pytest.raises(SharingValidationError):
+        MultiProcessConfig(default_active_tensorcore_percentage=0).validate()
+    with pytest.raises(SharingValidationError):
+        MultiProcessConfig(default_active_tensorcore_percentage=101).validate()
+
+
+# -- ComputeDomain configs --------------------------------------------------
+
+def test_channel_config_validate():
+    cfg = ComputeDomainChannelConfig(domain_id="abc", allocation_mode="")
+    cfg.normalize()
+    assert cfg.allocation_mode == "Single"
+    cfg.validate()
+    with pytest.raises(ComputeDomainValidationError):
+        ComputeDomainChannelConfig(domain_id="").validate()
+    with pytest.raises(ComputeDomainValidationError):
+        ComputeDomainChannelConfig(domain_id="abc", allocation_mode="Some").validate()
+
+
+# -- regression: review findings --------------------------------------------
+
+def test_partition_config_rejects_timeslicing_config_field():
+    # PartitionSharing has no timeSlicingConfig; strict decode must reject it.
+    data = {
+        "apiVersion": API_VERSION_STR,
+        "kind": "TpuPartitionConfig",
+        "sharing": {"strategy": "MultiProcess", "timeSlicingConfig": {"interval": "Short"}},
+    }
+    with pytest.raises(DecodeError, match="timeSlicingConfig"):
+        decode_config(data, strict=True)
+
+
+def test_parse_quantity_exact_large_integers():
+    big = "9007199254740993"  # 2**53 + 1: float would round this
+    assert parse_quantity(big) == 9007199254740993
+    assert parse_quantity("1500m") == 2  # milli rounds up
+
+
+def test_serde_fixed_tuple():
+    from dataclasses import dataclass, field as dfield
+    from tpudra.api import serde
+
+    @dataclass
+    class Coord:
+        xy: tuple[int, int] = dfield(default=(0, 0), metadata={"json": "xy"})
+
+    got = serde.decode(Coord, {"xy": [3, 4]})
+    assert got.xy == (3, 4)
+    with pytest.raises(DecodeError, match="elements"):
+        serde.decode(Coord, {"xy": [3, 4, 5]})

@@ -1,0 +1,126 @@
+import pytest
+
+from tpudra import featuregates as fg
+from tpudra.featuregates import (
+    COMPUTE_DOMAIN_CLIQUES,
+    CRASH_ON_ICI_FABRIC_ERRORS,
+    DOMAIN_DAEMONS_WITH_DNS_NAMES,
+    DYNAMIC_PARTITIONING,
+    MULTI_PROCESS_SHARING,
+    PASSTHROUGH_SUPPORT,
+    TIME_SLICING_SETTINGS,
+    TPU_DEVICE_HEALTH_CHECK,
+    FeatureGateError,
+    FeatureGates,
+    Stage,
+    VersionedSpec,
+)
+
+
+def test_defaults():
+    gates = fg.feature_gates()
+    assert gates.enabled(DOMAIN_DAEMONS_WITH_DNS_NAMES) is True
+    assert gates.enabled(COMPUTE_DOMAIN_CLIQUES) is True
+    assert gates.enabled(CRASH_ON_ICI_FABRIC_ERRORS) is True
+    assert gates.enabled(TIME_SLICING_SETTINGS) is False
+    assert gates.enabled(MULTI_PROCESS_SHARING) is False
+    assert gates.enabled(DYNAMIC_PARTITIONING) is False
+    assert gates.enabled(PASSTHROUGH_SUPPORT) is False
+    assert gates.enabled(TPU_DEVICE_HEALTH_CHECK) is False
+
+
+def test_set_from_spec_and_to_map():
+    gates = fg.feature_gates()
+    gates.set_from_spec("TimeSlicingSettings=true, MultiProcessSharing=true")
+    assert gates.enabled(TIME_SLICING_SETTINGS) is True
+    m = gates.to_map()
+    assert m[TIME_SLICING_SETTINGS] is True
+    assert m[MULTI_PROCESS_SHARING] is True
+    assert m[DYNAMIC_PARTITIONING] is False
+    assert set(m) == set(fg.DEFAULT_FEATURE_GATES)
+
+
+def test_unknown_gate_rejected():
+    gates = fg.feature_gates()
+    with pytest.raises(FeatureGateError):
+        gates.set_from_spec("NoSuchGate=true")
+    with pytest.raises(FeatureGateError):
+        gates.enabled("NoSuchGate")
+
+
+def test_bad_spec_strings():
+    gates = fg.feature_gates()
+    with pytest.raises(FeatureGateError):
+        gates.set_from_spec("TimeSlicingSettings")
+    with pytest.raises(FeatureGateError):
+        gates.set_from_spec("TimeSlicingSettings=maybe")
+
+
+def test_partial_failure_atomic():
+    # An unknown gate anywhere in the spec must not apply any of the values.
+    gates = fg.feature_gates()
+    with pytest.raises(FeatureGateError):
+        gates.set_from_map({TIME_SLICING_SETTINGS: True, "Bogus": True})
+    assert gates.enabled(TIME_SLICING_SETTINGS) is False
+
+
+def test_dependency_validation_cliques_require_dns():
+    gates = fg.feature_gates()
+    gates.set_from_spec("DomainDaemonsWithDNSNames=false")
+    with pytest.raises(FeatureGateError, match="requires"):
+        gates.validate()
+    gates.set_from_spec("ComputeDomainCliques=false")
+    gates.validate()  # both off: fine
+
+
+@pytest.mark.parametrize(
+    "other", [PASSTHROUGH_SUPPORT, TPU_DEVICE_HEALTH_CHECK, MULTI_PROCESS_SHARING]
+)
+def test_mutual_exclusion_with_dynamic_partitioning(other):
+    gates = fg.feature_gates()
+    gates.set_from_map({DYNAMIC_PARTITIONING: True, other: True})
+    with pytest.raises(FeatureGateError, match="mutually"):
+        gates.validate()
+
+
+def test_versioned_defaults():
+    specs = {
+        "Promoted": (
+            VersionedSpec((0, 1), False, Stage.ALPHA),
+            VersionedSpec((0, 5), True, Stage.BETA),
+        ),
+    }
+    old = FeatureGates((0, 2))
+    old.add_versioned(specs)
+    assert old.enabled("Promoted") is False
+    new = FeatureGates((0, 6))
+    new.add_versioned(specs)
+    assert new.enabled("Promoted") is True
+    # Not yet introduced at this version.
+    ancient = FeatureGates((0, 0))
+    ancient.add_versioned(specs)
+    with pytest.raises(FeatureGateError):
+        ancient.enabled("Promoted")
+
+
+def test_locked_gate():
+    gates = FeatureGates((1, 0))
+    gates.add_versioned(
+        {"Locked": (VersionedSpec((0, 1), True, Stage.GA, locked_to_default=True),)}
+    )
+    gates.set_from_map({"Locked": True})  # setting to default is allowed
+    with pytest.raises(FeatureGateError, match="locked"):
+        gates.set_from_map({"Locked": False})
+
+
+def test_set_from_map_atomic_on_locked_violation():
+    gates = FeatureGates((1, 0))
+    gates.add_versioned(
+        {
+            "A": (VersionedSpec((0, 1), False, Stage.ALPHA),),
+            "Locked": (VersionedSpec((0, 1), True, Stage.GA, locked_to_default=True),),
+        }
+    )
+    with pytest.raises(FeatureGateError, match="locked"):
+        gates.set_from_map({"A": True, "Locked": False})
+    assert gates.enabled("A") is False  # nothing applied

@@ -1,0 +1,74 @@
+import multiprocessing
+import os
+import time
+
+import pytest
+
+from tpudra.flock import Flock, FlockTimeout
+
+
+def test_basic_acquire_release(tmp_path):
+    lock = Flock(str(tmp_path / "a.lock"))
+    lock.acquire(timeout=1)
+    assert lock.held
+    lock.release()
+    assert not lock.held
+
+
+def test_reacquire_same_object_fails(tmp_path):
+    lock = Flock(str(tmp_path / "a.lock"))
+    with lock(timeout=1):
+        with pytest.raises(RuntimeError):
+            lock.acquire(timeout=0.1)
+
+
+def _hold_lock(path, hold_s, acquired_evt):
+    lock = Flock(path)
+    lock.acquire(timeout=5)
+    acquired_evt.set()
+    time.sleep(hold_s)
+    lock.release()
+
+
+def test_cross_process_contention(tmp_path):
+    path = str(tmp_path / "pu.lock")
+    evt = multiprocessing.Event()
+    p = multiprocessing.Process(target=_hold_lock, args=(path, 0.5, evt))
+    p.start()
+    try:
+        assert evt.wait(5)
+        lock = Flock(path, poll_interval=0.01)
+        with pytest.raises(FlockTimeout):
+            lock.acquire(timeout=0.1)
+        # After the holder exits, acquisition succeeds.
+        lock.acquire(timeout=5)
+        lock.release()
+    finally:
+        p.join(timeout=5)
+
+
+def _crash_holder(path, acquired_evt):
+    lock = Flock(path)
+    lock.acquire(timeout=5)
+    acquired_evt.set()
+    os._exit(1)  # simulate a crash: no release call
+
+
+def test_crash_safety(tmp_path):
+    # A crashed holder must not wedge the lock (fd close releases flock).
+    path = str(tmp_path / "cp.lock")
+    evt = multiprocessing.Event()
+    p = multiprocessing.Process(target=_crash_holder, args=(path, evt))
+    p.start()
+    assert evt.wait(5)
+    p.join(timeout=5)
+    lock = Flock(path)
+    lock.acquire(timeout=2)
+    lock.release()
+
+
+def test_context_manager(tmp_path):
+    path = str(tmp_path / "c.lock")
+    with Flock(path) as lock:
+        assert lock.held
+    assert not lock.held

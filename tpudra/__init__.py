@@ -1,0 +1,24 @@
+"""tpudra: a TPU-native Kubernetes Dynamic Resource Allocation (DRA) driver.
+
+Built from scratch with the capabilities of NVIDIA's k8s-dra-driver-gpu
+(surveyed in SURVEY.md).  Two resource families are managed:
+
+- TPUs (driver name ``tpu.google.com``): node-local allocation of full TPU
+  chips, static/dynamic TensorCore partitions, and VFIO passthrough, with
+  time-slicing and multi-process (MPS-analog) sharing.
+- ComputeDomains (driver name ``compute-domain.tpu.google.com``): a
+  cluster-level abstraction reserving ICI-connected TPU slices and exposing
+  mesh topology to claimants (the analog of the reference's IMEX/MNNVL
+  orchestration, reference cmd/compute-domain-*).
+"""
+
+__version__ = "0.1.0"
+
+# DRA driver names (reference: cmd/gpu-kubelet-plugin/main.go:41,
+# cmd/compute-domain-kubelet-plugin/main.go:42).
+TPU_DRIVER_NAME = "tpu.google.com"
+COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.google.com"
+
+# API group for our custom resources (reference: api/nvidia.com/resource/v1beta1).
+API_GROUP = "resource.tpu.google.com"
+API_VERSION = "v1beta1"

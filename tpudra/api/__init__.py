@@ -1,0 +1,88 @@
+"""Custom-resource API types for the TPU DRA driver.
+
+The analog of the reference's api/nvidia.com/resource/v1beta1 package: opaque
+per-claim configs with Normalize/Validate (api.go:41-45) and strict/non-strict
+decoders dispatching on apiVersion+kind (api.go:47-58).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from tpudra import API_GROUP, API_VERSION
+from tpudra.api import serde
+from tpudra.api.computedomain import (
+    COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND,
+    COMPUTE_DOMAIN_DAEMON_CONFIG_KIND,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+)
+from tpudra.api.serde import DecodeError
+from tpudra.api.tpuconfig import (
+    TPU_CONFIG_KIND,
+    TPU_PARTITION_CONFIG_KIND,
+    VFIO_DEVICE_CONFIG_KIND,
+    TpuConfig,
+    TpuPartitionConfig,
+    VfioDeviceConfig,
+)
+
+API_VERSION_STR = f"{API_GROUP}/{API_VERSION}"
+
+
+@runtime_checkable
+class Config(Protocol):
+    """Every opaque config implements normalize() and validate()
+    (reference api.go:41-45)."""
+
+    def normalize(self) -> None: ...
+
+    def validate(self) -> None: ...
+
+
+_KINDS = {
+    TPU_CONFIG_KIND: TpuConfig,
+    TPU_PARTITION_CONFIG_KIND: TpuPartitionConfig,
+    VFIO_DEVICE_CONFIG_KIND: VfioDeviceConfig,
+    COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND: ComputeDomainChannelConfig,
+    COMPUTE_DOMAIN_DAEMON_CONFIG_KIND: ComputeDomainDaemonConfig,
+}
+
+
+def decode_config(data: dict, *, strict: bool = True) -> Config:
+    """Decode an opaque config object by apiVersion+kind.
+
+    Strict mode rejects unknown fields (webhook/prepare path); non-strict
+    tolerates fields written by newer driver versions (checkpoint path,
+    reference api.go:54-58).
+    """
+    if not isinstance(data, dict):
+        raise DecodeError("opaque config must be a JSON object")
+    api_version = data.get("apiVersion", "")
+    kind = data.get("kind", "")
+    if api_version != API_VERSION_STR:
+        raise DecodeError(
+            f"unsupported apiVersion {api_version!r} (want {API_VERSION_STR})"
+        )
+    cls = _KINDS.get(kind)
+    if cls is None:
+        raise DecodeError(f"unsupported kind {kind!r}")
+    return serde.decode(cls, data, strict=strict)
+
+
+def encode_config(config: Config) -> dict:
+    return serde.encode(config)
+
+
+__all__ = [
+    "Config",
+    "DecodeError",
+    "decode_config",
+    "encode_config",
+    "TpuConfig",
+    "TpuPartitionConfig",
+    "VfioDeviceConfig",
+    "ComputeDomainChannelConfig",
+    "ComputeDomainDaemonConfig",
+    "API_VERSION_STR",
+]

@@ -1,0 +1,161 @@
+"""ComputeDomain / ComputeDomainClique CRD types and their opaque configs.
+
+Analogs of api/nvidia.com/resource/v1beta1/{computedomain,computedomainclique,
+computedomainconfig}.go.  Where the reference's ComputeDomain orchestrates an
+IMEX domain (Multi-Node NVLink memory sharing), ours reserves an ICI-connected
+TPU slice: the clique is the set of hosts on one ICI fabric partition, the
+channel is the per-workload grant of slice visibility, and readiness means all
+hosts in the slice have a running coordination daemon.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra import API_GROUP, API_VERSION
+
+API_VERSION_STR = f"{API_GROUP}/{API_VERSION}"
+
+COMPUTE_DOMAIN_KIND = "ComputeDomain"
+COMPUTE_DOMAIN_CLIQUE_KIND = "ComputeDomainClique"
+COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND = "ComputeDomainChannelConfig"
+COMPUTE_DOMAIN_DAEMON_CONFIG_KIND = "ComputeDomainDaemonConfig"
+
+COMPUTE_DOMAIN_STATUS_READY = "Ready"
+COMPUTE_DOMAIN_STATUS_NOT_READY = "NotReady"
+
+CHANNEL_ALLOCATION_MODE_SINGLE = "Single"
+CHANNEL_ALLOCATION_MODE_ALL = "All"
+
+# Label placed on nodes to attract the per-CD daemon DaemonSet
+# (reference: "resource.nvidia.com/computeDomain").
+COMPUTE_DOMAIN_NODE_LABEL = f"{API_GROUP}/computeDomain"
+
+
+class ComputeDomainValidationError(ValueError):
+    pass
+
+
+@dataclass
+class ComputeDomainResourceClaimTemplate:
+    name: str = field(default="", metadata={"json": "name"})
+
+
+@dataclass
+class ComputeDomainChannelSpec:
+    resource_claim_template: ComputeDomainResourceClaimTemplate = field(
+        default_factory=ComputeDomainResourceClaimTemplate,
+        metadata={"json": "resourceClaimTemplate"},
+    )
+    # "Single" grants one channel; "All" grants every channel in the domain
+    # (reference computedomain.go:103-108).
+    allocation_mode: str = field(
+        default=CHANNEL_ALLOCATION_MODE_SINGLE, metadata={"json": "allocationMode"}
+    )
+
+
+@dataclass
+class ComputeDomainSpec:
+    # Number of hosts expected in the domain.  A TPU slice is allocated as a
+    # unit, so unlike IMEX domains (join-anytime), num_nodes doubles as the
+    # slice host count; 0 means "derive from the slice topology".
+    num_nodes: int = field(default=0, metadata={"json": "numNodes"})
+    channel: Optional[ComputeDomainChannelSpec] = field(
+        default=None, metadata={"json": "channel"}
+    )
+
+
+@dataclass
+class ComputeDomainNode:
+    name: str = field(default="", metadata={"json": "name"})
+    ip_address: str = field(default="", metadata={"json": "ipAddress"})
+    clique_id: str = field(default="", metadata={"json": "cliqueID"})
+    # (clique_id, index) is unique; the index pins the node's stable DNS name
+    # (reference computedomain.go:131-147).
+    index: int = field(default=0, metadata={"json": "index"})
+    status: str = field(
+        default=COMPUTE_DOMAIN_STATUS_NOT_READY, metadata={"json": "status"}
+    )
+
+
+@dataclass
+class ComputeDomainStatus:
+    status: str = field(
+        default=COMPUTE_DOMAIN_STATUS_NOT_READY, metadata={"json": "status"}
+    )
+    nodes: list[ComputeDomainNode] = field(default_factory=list, metadata={"json": "nodes"})
+
+
+@dataclass
+class DaemonInfo:
+    """One daemon's membership entry in a clique
+    (reference cmd/compute-domain-daemon/cdclique.go DaemonInfo)."""
+
+    node_name: str = field(default="", metadata={"json": "nodeName"})
+    ip_address: str = field(default="", metadata={"json": "ipAddress"})
+    clique_id: str = field(default="", metadata={"json": "cliqueID"})
+    index: int = field(default=0, metadata={"json": "index"})
+    status: str = field(
+        default=COMPUTE_DOMAIN_STATUS_NOT_READY, metadata={"json": "status"}
+    )
+
+
+@dataclass
+class ComputeDomainCliqueSpec:
+    compute_domain_uid: str = field(default="", metadata={"json": "computeDomainUID"})
+    clique_id: str = field(default="", metadata={"json": "cliqueID"})
+
+
+@dataclass
+class ComputeDomainCliqueStatus:
+    daemons: list[DaemonInfo] = field(default_factory=list, metadata={"json": "daemons"})
+
+
+@dataclass
+class ComputeDomainChannelConfig:
+    """Opaque config on workload ResourceClaimTemplates
+    (reference computedomainconfig.go ComputeDomainChannelConfig)."""
+
+    api_version: str = field(default=API_VERSION_STR, metadata={"json": "apiVersion"})
+    kind: str = field(
+        default=COMPUTE_DOMAIN_CHANNEL_CONFIG_KIND, metadata={"json": "kind"}
+    )
+    domain_id: str = field(default="", metadata={"json": "domainID"})
+    allocation_mode: str = field(
+        default=CHANNEL_ALLOCATION_MODE_SINGLE, metadata={"json": "allocationMode"}
+    )
+
+    def normalize(self) -> None:
+        if not self.allocation_mode:
+            self.allocation_mode = CHANNEL_ALLOCATION_MODE_SINGLE
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ComputeDomainValidationError("domainID must be set")
+        if self.allocation_mode not in (
+            CHANNEL_ALLOCATION_MODE_SINGLE,
+            CHANNEL_ALLOCATION_MODE_ALL,
+        ):
+            raise ComputeDomainValidationError(
+                f"invalid allocationMode: {self.allocation_mode!r}"
+            )
+
+
+@dataclass
+class ComputeDomainDaemonConfig:
+    """Opaque config on the daemon ResourceClaimTemplate
+    (reference computedomainconfig.go ComputeDomainDaemonConfig)."""
+
+    api_version: str = field(default=API_VERSION_STR, metadata={"json": "apiVersion"})
+    kind: str = field(
+        default=COMPUTE_DOMAIN_DAEMON_CONFIG_KIND, metadata={"json": "kind"}
+    )
+    domain_id: str = field(default="", metadata={"json": "domainID"})
+
+    def normalize(self) -> None:
+        return None
+
+    def validate(self) -> None:
+        if not self.domain_id:
+            raise ComputeDomainValidationError("domainID must be set")

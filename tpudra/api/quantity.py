@@ -1,0 +1,70 @@
+"""Kubernetes resource.Quantity parsing (the subset our configs need).
+
+The reference uses k8s.io/apimachinery resource.Quantity for MPS pinned-memory
+limits (api/nvidia.com/resource/v1beta1/sharing.go:63,82-89).  We support the
+binary (Ki/Mi/Gi/Ti/Pi/Ei) and decimal (k/M/G/T/P/E, m) suffixes plus plain
+integers, which covers every quantity a device-memory limit can express.
+"""
+
+from __future__ import annotations
+
+import re
+
+_SUFFIXES = {
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+}
+
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9]+(?:\.[0-9]+)?)(m|[kMGTPE]i?|)$")
+
+
+class InvalidQuantity(ValueError):
+    pass
+
+
+def parse_quantity(s: str | int | float) -> int:
+    """Parse a quantity string to an integer number of base units (bytes).
+
+    Fractional results round up, matching k8s canonicalization for values
+    that cannot be represented exactly.
+    """
+    if isinstance(s, (int, float)):
+        return int(s)
+    m = _QUANTITY_RE.match(s.strip())
+    if not m:
+        raise InvalidQuantity(f"invalid quantity {s!r}")
+    number, suffix = m.group(1), m.group(2)
+    if "." not in number:
+        # Integer path: exact arithmetic (k8s Quantity is exact; float would
+        # lose precision above 2^53).
+        value = int(number)
+        if suffix == "m":
+            return -(-value // 1000) if value >= 0 else value // 1000
+        return value * _SUFFIXES[suffix]
+    value = float(number)
+    scaled = value / 1000.0 if suffix == "m" else value * _SUFFIXES[suffix]
+    out = int(scaled)
+    if scaled > out:
+        out += 1
+    return out
+
+
+def format_mebibytes(nbytes: int) -> tuple[str, bool]:
+    """Render a byte count as whole mebibytes ("<n>M" — the unit string the
+    MPS-analog control daemon consumes; reference sharing.go:262-265).
+
+    Returns (text, valid); valid is False when the limit truncates to zero.
+    """
+    mib = nbytes // (1024 * 1024)
+    return f"{mib}M", mib > 0

@@ -1,0 +1,132 @@
+"""Dataclass <-> JSON-object codec with strict/non-strict modes.
+
+The analog of the reference's scheme-backed decoders
+(api/nvidia.com/resource/v1beta1/api.go:47-58): the *strict* decoder rejects
+unknown fields (used by the admission webhook and the prepare path for configs
+authored against the current API), while the *non-strict* decoder ignores them
+(used when reading checkpoints written by a newer driver version).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import types
+import typing
+from typing import Any, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class DecodeError(ValueError):
+    pass
+
+
+_HINTS_CACHE: dict[type, dict[str, Any]] = {}
+
+
+def _type_hints(cls: type) -> dict[str, Any]:
+    hints = _HINTS_CACHE.get(cls)
+    if hints is None:
+        hints = typing.get_type_hints(cls)
+        _HINTS_CACHE[cls] = hints
+    return hints
+
+
+def _json_name(field: dataclasses.Field) -> str:
+    return field.metadata.get("json", field.name)
+
+
+def _unwrap_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin in (typing.Union, types.UnionType):
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _decode_value(tp, value: Any, strict: bool, path: str) -> Any:
+    tp = _unwrap_optional(tp)
+    origin = typing.get_origin(tp)
+    if dataclasses.is_dataclass(tp):
+        if not isinstance(value, dict):
+            raise DecodeError(f"{path}: expected object, got {type(value).__name__}")
+        return decode(tp, value, strict=strict, path=path)
+    if origin in (list, tuple):
+        if not isinstance(value, list):
+            raise DecodeError(f"{path}: expected array, got {type(value).__name__}")
+        args = typing.get_args(tp)
+        if origin is list:
+            item_tps = [args[0] if args else Any] * len(value)
+        elif len(args) == 2 and args[1] is Ellipsis:  # tuple[X, ...]
+            item_tps = [args[0]] * len(value)
+        else:  # fixed-shape tuple[X, Y, ...]
+            if len(args) != len(value):
+                raise DecodeError(
+                    f"{path}: expected {len(args)} elements, got {len(value)}"
+                )
+            item_tps = list(args)
+        items = [
+            _decode_value(item_tp, v, strict, f"{path}[{i}]")
+            for i, (item_tp, v) in enumerate(zip(item_tps, value))
+        ]
+        return tuple(items) if origin is tuple else items
+    if origin is dict:
+        _, val_tp = typing.get_args(tp) or (str, Any)
+        if not isinstance(value, dict):
+            raise DecodeError(f"{path}: expected object, got {type(value).__name__}")
+        return {k: _decode_value(val_tp, v, strict, f"{path}.{k}") for k, v in value.items()}
+    if tp is int and isinstance(value, bool):
+        raise DecodeError(f"{path}: expected int, got bool")
+    if tp in (int, float, str, bool) and not isinstance(value, tp):
+        # JSON numbers may arrive as int where float expected.
+        if tp is float and isinstance(value, int):
+            return float(value)
+        raise DecodeError(
+            f"{path}: expected {tp.__name__}, got {type(value).__name__}"
+        )
+    return value
+
+
+def decode(cls: Type[T], data: dict, *, strict: bool = True, path: str = "") -> T:
+    """Decode a JSON object into dataclass ``cls``.
+
+    Field JSON names come from ``metadata={"json": ...}`` (defaulting to the
+    attribute name).  Unknown keys raise DecodeError in strict mode and are
+    ignored otherwise.
+    """
+    if not isinstance(data, dict):
+        raise DecodeError(f"{path or cls.__name__}: expected object")
+    fields = {_json_name(f): f for f in dataclasses.fields(cls)}
+    hints = _type_hints(cls)
+    kwargs = {}
+    for key, value in data.items():
+        f = fields.get(key)
+        if f is None:
+            if strict:
+                raise DecodeError(f"{path or cls.__name__}: unknown field {key!r}")
+            continue
+        if value is None:
+            continue
+        kwargs[f.name] = _decode_value(hints[f.name], value, strict, f"{path}.{key}" if path else key)
+    try:
+        return cls(**kwargs)
+    except TypeError as e:
+        raise DecodeError(f"{path or cls.__name__}: {e}") from e
+
+
+def encode(obj: Any) -> Any:
+    """Encode a dataclass to a JSON-ready object, dropping None fields."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            out[_json_name(f)] = encode(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [encode(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: encode(v) for k, v in obj.items()}
+    return obj

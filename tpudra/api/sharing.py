@@ -1,0 +1,225 @@
+"""Sharing strategy types for TPU chips and partitions.
+
+The analog of api/nvidia.com/resource/v1beta1/sharing.go.  Two strategies:
+
+- ``TimeSlicing``: cooperative time-sharing of a full chip.  TPUs have no
+  hardware compute-policy knob like `nvidia-smi compute-policy`; the interval
+  is carried through to the runtime as a scheduling hint
+  (``TPU_TIMESLICE_HINT``) and recorded on the device attribute surface.
+- ``MultiProcess``: the MPS analog — several processes share one chip, each
+  restricted to a slice of HBM and a percentage of TensorCores, brokered by a
+  per-claim control daemon (reference sharing.go:123-445).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra.api.quantity import InvalidQuantity, format_mebibytes, parse_quantity
+
+TIME_SLICING_STRATEGY = "TimeSlicing"
+MULTI_PROCESS_STRATEGY = "MultiProcess"
+
+DEFAULT_TIME_SLICE = "Default"
+SHORT_TIME_SLICE = "Short"
+MEDIUM_TIME_SLICE = "Medium"
+LONG_TIME_SLICE = "Long"
+
+_TIME_SLICE_ORDINALS = {
+    DEFAULT_TIME_SLICE: 0,
+    SHORT_TIME_SLICE: 1,
+    MEDIUM_TIME_SLICE: 2,
+    LONG_TIME_SLICE: 3,
+}
+
+
+def time_slice_ordinal(interval: str) -> int:
+    """Integer encoding of a timeslice interval; -1 if invalid
+    (reference sharing.go:232-244)."""
+    return _TIME_SLICE_ORDINALS.get(interval, -1)
+
+
+class SharingValidationError(ValueError):
+    pass
+
+
+@dataclass
+class TimeSlicingConfig:
+    interval: Optional[str] = field(default=None, metadata={"json": "interval"})
+
+    def validate(self) -> None:
+        if self.interval is not None and self.interval not in _TIME_SLICE_ORDINALS:
+            raise SharingValidationError(
+                f"unknown time-slice interval: {self.interval!r}"
+            )
+
+
+@dataclass
+class MultiProcessConfig:
+    """Settings for the multi-process (MPS-analog) control daemon."""
+
+    default_active_tensorcore_percentage: Optional[int] = field(
+        default=None, metadata={"json": "defaultActiveTensorCorePercentage"}
+    )
+    # Pinned HBM limit applied to every allocated chip, overridable per chip
+    # via default_per_device_pinned_hbm_limit (keys: chip UUID or claim-local
+    # device index).
+    default_pinned_hbm_limit: Optional[str] = field(
+        default=None, metadata={"json": "defaultPinnedHbmLimit"}
+    )
+    default_per_device_pinned_hbm_limit: dict[str, str] = field(
+        default_factory=dict, metadata={"json": "defaultPerDevicePinnedHbmLimit"}
+    )
+
+    def validate(self) -> None:
+        pct = self.default_active_tensorcore_percentage
+        if pct is not None and not 0 < pct <= 100:
+            raise SharingValidationError(
+                f"defaultActiveTensorCorePercentage must be in (0, 100]: {pct}"
+            )
+        for key, value in list(self.default_per_device_pinned_hbm_limit.items()):
+            try:
+                parse_quantity(value)
+            except InvalidQuantity as e:
+                raise SharingValidationError(f"limit for {key!r}: {e}") from e
+        if self.default_pinned_hbm_limit is not None:
+            try:
+                parse_quantity(self.default_pinned_hbm_limit)
+            except InvalidQuantity as e:
+                raise SharingValidationError(f"defaultPinnedHbmLimit: {e}") from e
+
+    def normalized_limits(self, uuids: list[str]) -> dict[str, str]:
+        """Resolve per-device pinned HBM limits for the allocated ``uuids``.
+
+        The default limit (if any) applies to every device first, then
+        per-device entries override it.  Keys may be chip UUIDs or integer
+        indexes into ``uuids``.  Mirrors MpsPerDevicePinnedMemoryLimit.Normalize
+        (reference sharing.go:251-276): values are rendered as whole mebibytes
+        and must not truncate to zero.
+        """
+        limits: dict[str, str] = {}
+        if self.default_pinned_hbm_limit is not None and uuids:
+            text, ok = format_mebibytes(parse_quantity(self.default_pinned_hbm_limit))
+            if not ok:
+                raise SharingValidationError(
+                    f"invalid limit: default value set too low: "
+                    f"{self.default_pinned_hbm_limit}"
+                )
+            for uuid in uuids:
+                limits[uuid] = text
+
+        known = set(uuids)
+        for key, value in self.default_per_device_pinned_hbm_limit.items():
+            if key in known:
+                uuid = key
+            else:
+                try:
+                    index = int(key)
+                except ValueError:
+                    raise SharingValidationError(
+                        f"invalid device: unable to parse key as an integer: {key}"
+                    ) from None
+                if not 0 <= index < len(uuids):
+                    raise SharingValidationError(f"invalid device: invalid device index: {index}")
+                uuid = uuids[index]
+            text, ok = format_mebibytes(parse_quantity(value))
+            if not ok:
+                raise SharingValidationError(
+                    f"invalid limit: value set too low: {key}: {value}"
+                )
+            limits[uuid] = text
+        return limits
+
+
+@dataclass
+class TpuSharing:
+    """Sharing strategy selection for a full TPU chip
+    (reference GpuSharing, sharing.go:66-71)."""
+
+    strategy: str = field(default="", metadata={"json": "strategy"})
+    time_slicing_config: Optional[TimeSlicingConfig] = field(
+        default=None, metadata={"json": "timeSlicingConfig"}
+    )
+    multi_process_config: Optional[MultiProcessConfig] = field(
+        default=None, metadata={"json": "multiProcessConfig"}
+    )
+
+    @property
+    def is_time_slicing(self) -> bool:
+        return self.strategy == TIME_SLICING_STRATEGY
+
+    @property
+    def is_multi_process(self) -> bool:
+        return self.strategy == MULTI_PROCESS_STRATEGY
+
+    def get_time_slicing_config(self) -> Optional[TimeSlicingConfig]:
+        if not self.is_time_slicing:
+            raise SharingValidationError(
+                f"strategy is not set to {TIME_SLICING_STRATEGY!r}"
+            )
+        if self.multi_process_config is not None:
+            raise SharingValidationError(
+                f"cannot use multiProcessConfig with the {TIME_SLICING_STRATEGY!r} strategy"
+            )
+        return self.time_slicing_config
+
+    def get_multi_process_config(self) -> Optional[MultiProcessConfig]:
+        if not self.is_multi_process:
+            raise SharingValidationError(
+                f"strategy is not set to {MULTI_PROCESS_STRATEGY!r}"
+            )
+        if self.time_slicing_config is not None:
+            raise SharingValidationError(
+                f"cannot use timeSlicingConfig with the {MULTI_PROCESS_STRATEGY!r} strategy"
+            )
+        return self.multi_process_config
+
+    def validate(self) -> None:
+        if self.strategy not in (TIME_SLICING_STRATEGY, MULTI_PROCESS_STRATEGY):
+            raise SharingValidationError(f"unknown sharing strategy: {self.strategy!r}")
+        if self.is_time_slicing:
+            cfg = self.get_time_slicing_config()
+            if cfg is not None:
+                cfg.validate()
+        if self.is_multi_process:
+            cfg = self.get_multi_process_config()
+            if cfg is not None:
+                cfg.validate()
+
+
+@dataclass
+class PartitionSharing:
+    """Sharing for TPU partitions: only MultiProcess is meaningful — a
+    partition is already an isolated compute slice, so time-slicing it adds
+    nothing.  Deliberately has no timeSlicingConfig field, so the strict
+    decoder rejects it (reference MigDeviceSharing, sharing.go:73-77)."""
+
+    strategy: str = field(default="", metadata={"json": "strategy"})
+    multi_process_config: Optional[MultiProcessConfig] = field(
+        default=None, metadata={"json": "multiProcessConfig"}
+    )
+
+    @property
+    def is_time_slicing(self) -> bool:
+        return self.strategy == TIME_SLICING_STRATEGY
+
+    @property
+    def is_multi_process(self) -> bool:
+        return self.strategy == MULTI_PROCESS_STRATEGY
+
+    def get_time_slicing_config(self) -> Optional[TimeSlicingConfig]:
+        return None
+
+    def get_multi_process_config(self) -> Optional[MultiProcessConfig]:
+        if not self.is_multi_process:
+            raise SharingValidationError(
+                f"strategy is not set to {MULTI_PROCESS_STRATEGY!r}"
+            )
+        return self.multi_process_config
+
+    def validate(self) -> None:
+        if self.strategy not in (TIME_SLICING_STRATEGY, MULTI_PROCESS_STRATEGY):
+            raise SharingValidationError(f"unknown sharing strategy: {self.strategy!r}")
+        if self.is_multi_process and self.multi_process_config is not None:
+            self.multi_process_config.validate()

@@ -1,0 +1,121 @@
+"""Opaque per-claim device configs for the TPU resource family.
+
+Analogs of GpuConfig / MigDeviceConfig / VfioDeviceConfig
+(reference api/nvidia.com/resource/v1beta1/{gpuconfig,migconfig,vfiodeviceconfig}.go).
+These arrive as opaque parameters on ResourceClaims (matched by driver name)
+and are strict-decoded, normalized, and validated by the webhook at admission
+time and by the kubelet plugin at prepare time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra import API_GROUP, API_VERSION
+from tpudra import featuregates
+from tpudra.api.sharing import (
+    DEFAULT_TIME_SLICE,
+    MULTI_PROCESS_STRATEGY,
+    TIME_SLICING_STRATEGY,
+    MultiProcessConfig,
+    PartitionSharing,
+    TimeSlicingConfig,
+    TpuSharing,
+)
+
+TPU_CONFIG_KIND = "TpuConfig"
+TPU_PARTITION_CONFIG_KIND = "TpuPartitionConfig"
+VFIO_DEVICE_CONFIG_KIND = "VfioDeviceConfig"
+
+API_VERSION_STR = f"{API_GROUP}/{API_VERSION}"
+
+
+@dataclass
+class TpuConfig:
+    """Parameters for configuring a full TPU chip (reference gpuconfig.go:29-33)."""
+
+    api_version: str = field(default=API_VERSION_STR, metadata={"json": "apiVersion"})
+    kind: str = field(default=TPU_CONFIG_KIND, metadata={"json": "kind"})
+    sharing: Optional[TpuSharing] = field(default=None, metadata={"json": "sharing"})
+
+    @classmethod
+    def default(cls) -> "TpuConfig":
+        """Default config; carries a TimeSlicing stanza only when the gate is
+        on (reference gpuconfig.go:36-53)."""
+        config = cls()
+        if featuregates.enabled(featuregates.TIME_SLICING_SETTINGS):
+            config.sharing = TpuSharing(
+                strategy=TIME_SLICING_STRATEGY,
+                time_slicing_config=TimeSlicingConfig(interval=DEFAULT_TIME_SLICE),
+            )
+        return config
+
+    def normalize(self) -> None:
+        """Fill implied defaults (reference gpuconfig.go:56-80)."""
+        if self.sharing is None:
+            if not featuregates.enabled(featuregates.TIME_SLICING_SETTINGS):
+                return
+            self.sharing = TpuSharing(strategy=TIME_SLICING_STRATEGY)
+        if featuregates.enabled(featuregates.TIME_SLICING_SETTINGS):
+            if self.sharing.is_time_slicing and self.sharing.time_slicing_config is None:
+                self.sharing.time_slicing_config = TimeSlicingConfig(
+                    interval=DEFAULT_TIME_SLICE
+                )
+        if featuregates.enabled(featuregates.MULTI_PROCESS_SHARING):
+            if self.sharing.is_multi_process and self.sharing.multi_process_config is None:
+                self.sharing.multi_process_config = MultiProcessConfig()
+
+    def validate(self) -> None:
+        if self.sharing is not None:
+            self.sharing.validate()
+
+
+@dataclass
+class TpuPartitionConfig:
+    """Parameters for a TPU TensorCore partition (the MIG-device analog,
+    reference migconfig.go)."""
+
+    api_version: str = field(default=API_VERSION_STR, metadata={"json": "apiVersion"})
+    kind: str = field(default=TPU_PARTITION_CONFIG_KIND, metadata={"json": "kind"})
+    sharing: Optional[PartitionSharing] = field(default=None, metadata={"json": "sharing"})
+
+    @classmethod
+    def default(cls) -> "TpuPartitionConfig":
+        return cls()
+
+    def normalize(self) -> None:
+        if self.sharing is None:
+            return
+        if featuregates.enabled(featuregates.MULTI_PROCESS_SHARING):
+            if self.sharing.is_multi_process and self.sharing.multi_process_config is None:
+                self.sharing.multi_process_config = MultiProcessConfig()
+
+    def validate(self) -> None:
+        if self.sharing is not None:
+            if self.sharing.strategy == MULTI_PROCESS_STRATEGY and not featuregates.enabled(
+                featuregates.MULTI_PROCESS_SHARING
+            ):
+                # Tolerated at validation; rejected at prepare time when the
+                # gate is off, mirroring the reference's split of concerns.
+                pass
+            self.sharing.validate()
+
+
+@dataclass
+class VfioDeviceConfig:
+    """Parameters for a VFIO-passthrough TPU PCI function
+    (reference vfiodeviceconfig.go)."""
+
+    api_version: str = field(default=API_VERSION_STR, metadata={"json": "apiVersion"})
+    kind: str = field(default=VFIO_DEVICE_CONFIG_KIND, metadata={"json": "kind"})
+
+    @classmethod
+    def default(cls) -> "VfioDeviceConfig":
+        return cls()
+
+    def normalize(self) -> None:
+        return None
+
+    def validate(self) -> None:
+        return None

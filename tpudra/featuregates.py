@@ -1,0 +1,236 @@
+"""Versioned feature-gate registry.
+
+The analog of the reference's pkg/featuregates/featuregates.go: a k8s-style
+feature-gate system with versioned defaults (a gate's default may change as the
+project version advances through alpha/beta/GA), ``--feature-gates=A=true,B=false``
+parsing, cross-gate dependency validation, and a ``to_map()`` export used to
+propagate gate state into spawned daemon pods via template rendering
+(reference featuregates.go:33-211).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+from typing import Iterable, Mapping
+
+# ---------------------------------------------------------------------------
+# Gate names (reference featuregates.go:33-58, renamed for the TPU domain)
+# ---------------------------------------------------------------------------
+
+#: Allow time-slicing settings to be customized on full-chip claims.
+TIME_SLICING_SETTINGS = "TimeSlicingSettings"
+
+#: Allow multi-process chip sharing (the MPS analog) settings to be specified.
+MULTI_PROCESS_SHARING = "MultiProcessSharing"
+
+#: Use stable DNS names instead of raw IPs for ComputeDomain daemons.
+DOMAIN_DAEMONS_WITH_DNS_NAMES = "DomainDaemonsWithDNSNames"
+
+#: Allow TPU PCI functions to be rebound to vfio-pci for VM passthrough.
+PASSTHROUGH_SUPPORT = "PassthroughSupport"
+
+#: Device health checking through the tpuinfo library (XID-analog interrupts).
+TPU_DEVICE_HEALTH_CHECK = "TPUDeviceHealthCheck"
+
+#: Dynamic per-chip TensorCore partitioning (the dynamic-MIG analog).
+DYNAMIC_PARTITIONING = "DynamicPartitioning"
+
+#: Store daemon membership in ComputeDomainClique CRs instead of CD status.
+COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
+
+#: Crash the kubelet plugin instead of falling back to non-fabric mode when
+#: ICI fabric errors are detected during enumeration.
+CRASH_ON_ICI_FABRIC_ERRORS = "CrashOnICIFabricErrors"
+
+
+class Stage(enum.Enum):
+    ALPHA = "ALPHA"
+    BETA = "BETA"
+    GA = "GA"
+    DEPRECATED = "DEPRECATED"
+
+
+@dataclasses.dataclass(frozen=True)
+class VersionedSpec:
+    """A gate's behavior starting at ``version`` (inclusive)."""
+
+    version: tuple[int, int]
+    default: bool
+    stage: Stage
+    locked_to_default: bool = False
+
+
+# Versioned defaults (reference featuregates.go:62-119). Versions are our
+# project major.minor; a spec applies from its version onward until a newer
+# spec's version is reached.
+DEFAULT_FEATURE_GATES: dict[str, tuple[VersionedSpec, ...]] = {
+    TIME_SLICING_SETTINGS: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    MULTI_PROCESS_SHARING: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    DOMAIN_DAEMONS_WITH_DNS_NAMES: (VersionedSpec((0, 1), True, Stage.BETA),),
+    PASSTHROUGH_SUPPORT: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    DYNAMIC_PARTITIONING: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    TPU_DEVICE_HEALTH_CHECK: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    COMPUTE_DOMAIN_CLIQUES: (VersionedSpec((0, 1), True, Stage.BETA),),
+    CRASH_ON_ICI_FABRIC_ERRORS: (VersionedSpec((0, 1), True, Stage.BETA),),
+}
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+class FeatureGates:
+    """A mutable versioned feature-gate set.
+
+    Thread-safe; mirrors the semantics of k8s component-base
+    ``featuregate.MutableVersionedFeatureGate`` that the reference relies on.
+    """
+
+    def __init__(self, version: tuple[int, int] = (0, 1)):
+        self._version = version
+        self._specs: dict[str, tuple[VersionedSpec, ...]] = {}
+        self._overrides: dict[str, bool] = {}
+        self._lock = threading.Lock()
+
+    # -- registration -------------------------------------------------------
+
+    def add_versioned(self, gates: Mapping[str, Iterable[VersionedSpec]]) -> None:
+        with self._lock:
+            for name, specs in gates.items():
+                ordered = tuple(sorted(specs, key=lambda s: s.version))
+                if not ordered:
+                    raise FeatureGateError(f"feature gate {name} has no specs")
+                if name in self._specs and self._specs[name] != ordered:
+                    raise FeatureGateError(f"feature gate {name} already registered")
+                self._specs[name] = ordered
+
+    def _active_spec(self, name: str) -> VersionedSpec:
+        specs = self._specs.get(name)
+        if specs is None:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        active = None
+        for spec in specs:
+            if spec.version <= self._version:
+                active = spec
+        if active is None:
+            raise FeatureGateError(
+                f"feature gate {name!r} not available before version "
+                f"{specs[0].version} (current {self._version})"
+            )
+        return active
+
+    # -- mutation -----------------------------------------------------------
+
+    def set_from_map(self, values: Mapping[str, bool]) -> None:
+        # Validate everything first so a bad entry leaves no partial state.
+        for name, value in values.items():
+            with self._lock:
+                if name not in self._specs:
+                    raise FeatureGateError(f"unknown feature gate {name!r}")
+            spec = self._active_spec(name)
+            if spec.locked_to_default and value != spec.default:
+                raise FeatureGateError(
+                    f"cannot set feature gate {name}: locked to {spec.default}"
+                )
+        with self._lock:
+            self._overrides.update(values)
+
+    def set_from_spec(self, spec: str) -> None:
+        """Parse a ``Gate1=true,Gate2=false`` command-line value."""
+        values: dict[str, bool] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise FeatureGateError(f"missing '=' in feature-gate spec {part!r}")
+            name, _, raw = part.partition("=")
+            raw = raw.strip().lower()
+            if raw not in ("true", "false"):
+                raise FeatureGateError(
+                    f"invalid value {raw!r} for feature gate {name!r} (want true/false)"
+                )
+            values[name.strip()] = raw == "true"
+        self.set_from_map(values)
+
+    # -- queries ------------------------------------------------------------
+
+    def enabled(self, name: str) -> bool:
+        with self._lock:
+            if name in self._overrides:
+                return self._overrides[name]
+        return self._active_spec(name).default
+
+    def known_features(self) -> list[str]:
+        out = []
+        for name in sorted(self._specs):
+            spec = self._active_spec(name)
+            out.append(f"{name}={spec.default} ({spec.stage.value} - default={spec.default})")
+        return out
+
+    def to_map(self) -> dict[str, bool]:
+        """All known gates with effective values, for template propagation
+        into spawned pods (reference featuregates.go:205-211)."""
+        return {name: self.enabled(name) for name in self._specs}
+
+    def validate(self) -> None:
+        """Cross-gate dependency / mutual-exclusion validation
+        (reference featuregates.go:170-189)."""
+        if self.enabled(COMPUTE_DOMAIN_CLIQUES) and not self.enabled(
+            DOMAIN_DAEMONS_WITH_DNS_NAMES
+        ):
+            raise FeatureGateError(
+                f"feature gate {COMPUTE_DOMAIN_CLIQUES} requires "
+                f"{DOMAIN_DAEMONS_WITH_DNS_NAMES} to also be enabled"
+            )
+        for other in (PASSTHROUGH_SUPPORT, TPU_DEVICE_HEALTH_CHECK, MULTI_PROCESS_SHARING):
+            if self.enabled(DYNAMIC_PARTITIONING) and self.enabled(other):
+                raise FeatureGateError(
+                    f"feature gate {DYNAMIC_PARTITIONING} is currently mutually "
+                    f"exclusive with {other}"
+                )
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton (reference featuregates.go:121-136)
+# ---------------------------------------------------------------------------
+
+_singleton: FeatureGates | None = None
+_singleton_lock = threading.Lock()
+
+
+def _project_version() -> tuple[int, int]:
+    from tpudra import __version__
+
+    major, minor = __version__.split(".")[:2]
+    return (int(major), int(minor))
+
+
+def feature_gates() -> FeatureGates:
+    global _singleton
+    with _singleton_lock:
+        if _singleton is None:
+            fg = FeatureGates(_project_version())
+            fg.add_versioned(DEFAULT_FEATURE_GATES)
+            _singleton = fg
+        return _singleton
+
+
+def reset_for_testing() -> None:
+    global _singleton
+    with _singleton_lock:
+        _singleton = None
+
+
+def enabled(name: str) -> bool:
+    return feature_gates().enabled(name)
+
+
+def validate() -> None:
+    feature_gates().validate()
+
+
+def to_map() -> dict[str, bool]:
+    return feature_gates().to_map()

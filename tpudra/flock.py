@@ -1,0 +1,90 @@
+"""Cross-process file lock built on ``flock(2)``.
+
+The analog of the reference's pkg/flock/flock.go:70: a polling, non-blocking
+flock wrapper with a timeout.  Crash-safe by construction — the kernel releases
+the lock when the fd closes, so a crashed holder never wedges the node.  Guards
+the node-global prepare/unprepare lock (``pu.lock``) and the checkpoint
+read-mutate-write lock (``cp.lock``) across multiple driver processes on one
+node (reference gpu-kubelet-plugin/driver.go:44,341, device_state.go:555).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import fcntl
+import os
+import time
+
+
+class FlockTimeout(TimeoutError):
+    pass
+
+
+class Flock:
+    def __init__(self, path: str, poll_interval: float = 0.01):
+        self._path = path
+        self._poll_interval = poll_interval
+        self._fd: int | None = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def acquire(self, timeout: float | None = None) -> None:
+        """Acquire the exclusive lock, polling every ``poll_interval`` seconds.
+
+        Raises FlockTimeout if the lock cannot be acquired within ``timeout``
+        seconds (None = wait forever).
+        """
+        if self._fd is not None:
+            raise RuntimeError(f"lock {self._path} already held by this object")
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    self._fd = fd
+                    return
+                except OSError as e:
+                    if e.errno not in (errno.EAGAIN, errno.EACCES):
+                        raise
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise FlockTimeout(
+                        f"timeout acquiring lock {self._path} after {timeout}s"
+                    )
+                time.sleep(self._poll_interval)
+        except BaseException:
+            if self._fd is None:
+                os.close(fd)
+            raise
+
+    def release(self) -> None:
+        if self._fd is None:
+            return
+        fd, self._fd = self._fd, None
+        # Closing the fd releases the flock; explicit unlock first for clarity.
+        with contextlib.suppress(OSError):
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    @contextlib.contextmanager
+    def __call__(self, timeout: float | None = None):
+        self.acquire(timeout=timeout)
+        try:
+            yield self
+        finally:
+            self.release()
+
+    def __enter__(self) -> "Flock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

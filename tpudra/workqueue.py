@@ -1,0 +1,256 @@
+"""Retrying work queue with rate limiting.
+
+The analog of the reference's pkg/workqueue (a wrapper over client-go's
+rate-limiting workqueue, workqueue.go:152-190): closure-style work items that
+are retried with per-item exponential backoff plus a global token bucket, and
+*keyed* items with newest-wins semantics — enqueueing a newer item under the
+same key drops older queued/retrying items, and a stale retry firing after a
+newer enqueue is discarded.
+
+Limiter presets mirror the reference's (workqueue.go:49-63):
+- prepare/unprepare: per-item exponential 250ms→3s plus a global 5/s bucket
+- compute-domain daemon: exponential 5ms→6s with jitter
+- controller default: exponential 5ms→1000s plus a global 10/s bucket
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class ExponentialBackoff:
+    """Per-item exponential backoff: base * 2^failures, capped."""
+
+    def __init__(self, base: float, cap: float, jitter: float = 0.0):
+        self.base = base
+        self.cap = cap
+        self.jitter = jitter
+        self._failures: dict[object, int] = {}
+        self._lock = threading.Lock()
+
+    def when(self, item: object) -> float:
+        with self._lock:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        delay = min(self.base * (2**n), self.cap)
+        if self.jitter:
+            delay *= 1.0 + random.uniform(0, self.jitter)
+        return delay
+
+    def forget(self, item: object) -> None:
+        with self._lock:
+            self._failures.pop(item, None)
+
+    def retries(self, item: object) -> int:
+        with self._lock:
+            return self._failures.get(item, 0)
+
+
+class TokenBucket:
+    """Global qps/burst limiter; ``reserve()`` returns the wait time."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def reserve(self) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0
+            if self._tokens >= 0:
+                return 0.0
+            return -self._tokens / self.qps
+
+
+class RateLimiter:
+    """Max-of(per-item backoff, global bucket) — client-go's MaxOfRateLimiter."""
+
+    def __init__(self, backoff: ExponentialBackoff, bucket: Optional[TokenBucket] = None):
+        self.backoff = backoff
+        self.bucket = bucket
+
+    def when(self, item: object) -> float:
+        delay = self.backoff.when(item)
+        if self.bucket is not None:
+            delay = max(delay, self.bucket.reserve())
+        return delay
+
+    def forget(self, item: object) -> None:
+        self.backoff.forget(item)
+
+    def retries(self, item: object) -> int:
+        return self.backoff.retries(item)
+
+
+def prep_unprep_rate_limiter() -> RateLimiter:
+    """Preset for claim prepare/unprepare retries (reference workqueue.go:49-59)."""
+    return RateLimiter(ExponentialBackoff(0.25, 3.0), TokenBucket(5.0, 10))
+
+
+def daemon_rate_limiter() -> RateLimiter:
+    """Preset for compute-domain daemon loops (reference workqueue.go:61-63)."""
+    return RateLimiter(ExponentialBackoff(0.005, 6.0, jitter=0.5))
+
+
+def default_controller_rate_limiter() -> RateLimiter:
+    """client-go's DefaultControllerRateLimiter equivalent."""
+    return RateLimiter(ExponentialBackoff(0.005, 1000.0), TokenBucket(10.0, 100))
+
+
+@dataclass(order=True)
+class _Entry:
+    ready_at: float
+    seq: int
+    fn: Callable[[], None] = field(compare=False)
+    key: Optional[object] = field(compare=False, default=None)
+    gen: int = field(compare=False, default=0)
+
+
+class WorkQueue:
+    """A retrying queue of closures.
+
+    - ``enqueue(fn)``: run fn; on exception, retry after the limiter's delay.
+    - ``enqueue_keyed(key, fn)``: same, but a later enqueue under ``key``
+      supersedes earlier queued/retrying entries (newest wins; stale retries
+      are dropped on pop).
+    - ``run(stop)``: worker loop; call from one or more threads.
+    """
+
+    def __init__(self, rate_limiter: Optional[RateLimiter] = None, max_retries: int | None = None):
+        self._limiter = rate_limiter or default_controller_rate_limiter()
+        self._heap: list[_Entry] = []
+        self._cond = threading.Condition()
+        self._seq = itertools.count()
+        self._gens: dict[object, int] = {}
+        self._active_keys: set[object] = set()
+        self._shutdown = False
+        self._max_retries = max_retries
+        self._inflight = 0
+
+    # -- producers ----------------------------------------------------------
+
+    def enqueue(self, fn: Callable[[], None]) -> None:
+        self._push(fn, key=None, delay=0.0, gen=0)
+
+    def enqueue_keyed(self, key: object, fn: Callable[[], None]) -> None:
+        with self._cond:
+            gen = self._gens.get(key, 0) + 1
+            self._gens[key] = gen
+        # A fresh enqueue resets the key's backoff history: the newest intent
+        # is a new piece of work, not a retry of the old one.
+        self._limiter.forget(key)
+        self._push(fn, key=key, delay=0.0, gen=gen)
+
+    def _push(self, fn, key, delay, gen) -> None:
+        entry = _Entry(time.monotonic() + delay, next(self._seq), fn, key, gen)
+        with self._cond:
+            if self._shutdown:
+                return
+            heapq.heappush(self._heap, entry)
+            self._cond.notify()
+
+
+    # -- consumer -----------------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            entry = self._pop(stop)
+            if entry is None:
+                return
+            if entry.key is not None:
+                defer = False
+                with self._cond:
+                    if self._gens.get(entry.key, 0) != entry.gen:
+                        # Superseded by a newer enqueue: drop the stale item.
+                        self._inflight -= 1
+                        self._cond.notify_all()
+                        continue
+                    if entry.key in self._active_keys:
+                        # Another worker is processing this key; never run one
+                        # key concurrently (client-go dirty/processing-set
+                        # semantics). Defer briefly and re-check.
+                        entry = _Entry(
+                            time.monotonic() + 0.005, next(self._seq),
+                            entry.fn, entry.key, entry.gen,
+                        )
+                        heapq.heappush(self._heap, entry)
+                        self._inflight -= 1
+                        self._cond.notify_all()
+                        defer = True
+                    else:
+                        self._active_keys.add(entry.key)
+                if defer:
+                    continue
+            try:
+                entry.fn()
+            except Exception as e:  # noqa: BLE001 — retried work must not kill worker
+                item = entry.key if entry.key is not None else entry.fn
+                if (
+                    self._max_retries is not None
+                    and self._limiter.retries(item) >= self._max_retries
+                ):
+                    logger.error("work item %r failed permanently: %s", item, e)
+                    self._limiter.forget(item)
+                else:
+                    delay = self._limiter.when(item)
+                    logger.debug("work item %r failed (%s); retrying in %.3fs", item, e, delay)
+                    self._push(entry.fn, entry.key, delay, entry.gen)
+            else:
+                self._limiter.forget(entry.key if entry.key is not None else entry.fn)
+            finally:
+                with self._cond:
+                    if entry.key is not None:
+                        self._active_keys.discard(entry.key)
+                    self._inflight -= 1
+                    self._cond.notify_all()
+
+    def _pop(self, stop: threading.Event) -> Optional[_Entry]:
+        with self._cond:
+            while True:
+                if self._shutdown or stop.is_set():
+                    return None
+                if self._heap:
+                    now = time.monotonic()
+                    head = self._heap[0]
+                    if head.ready_at <= now:
+                        self._inflight += 1
+                        return heapq.heappop(self._heap)
+                    self._cond.wait(timeout=min(head.ready_at - now, 0.1))
+                else:
+                    self._cond.wait(timeout=0.1)
+
+    # -- lifecycle / introspection ------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until the queue is empty and no item is in flight."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._heap or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(remaining, 0.05))
+            return True
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._heap)
