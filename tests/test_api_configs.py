@@ -101,6 +101,7 @@ def test_validate_bad_strategy():
 
 
 def test_validate_conflicting_configs():
+    fg.feature_gates().set_from_spec("TimeSlicingSettings=true")
     s = TpuSharing(
         strategy="TimeSlicing",
         time_slicing_config=None,
@@ -216,3 +217,25 @@ def test_serde_fixed_tuple():
     assert got.xy == (3, 4)
     with pytest.raises(DecodeError, match="elements"):
         serde.decode(Coord, {"xy": [3, 4, 5]})
+
+
+def test_validate_rejects_gated_off_strategy():
+    # Admission must reject strategies whose feature gate is disabled
+    # (reference validate.go:26-45).
+    cfg = TpuConfig(sharing=TpuSharing(strategy="TimeSlicing"))
+    with pytest.raises(SharingValidationError, match="disabled"):
+        cfg.validate()
+    fg.feature_gates().set_from_spec("TimeSlicingSettings=true")
+    cfg.validate()
+    cfg2 = TpuConfig(sharing=TpuSharing(strategy="MultiProcess"))
+    with pytest.raises(SharingValidationError, match="disabled"):
+        cfg2.validate()
+
+
+def test_parse_quantity_suffix_strictness():
+    assert parse_quantity("1Ki") == 1024
+    with pytest.raises(InvalidQuantity):
+        parse_quantity("1ki")  # lowercase binary: invalid
+    with pytest.raises(InvalidQuantity):
+        parse_quantity("1K")  # uppercase decimal: invalid
+    assert parse_quantity("2k") == 2000

@@ -1,0 +1,179 @@
+import threading
+
+import pytest
+
+from tpudra.kube import errors, gvr
+from tpudra.kube.fake import FakeKube, match_label_selector
+
+
+@pytest.fixture
+def api():
+    return FakeKube()
+
+
+def mk_cd(name="cd1", ns="default", labels=None, finalizers=None):
+    obj = {
+        "apiVersion": gvr.COMPUTE_DOMAINS.api_version,
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"numNodes": 2},
+    }
+    if labels:
+        obj["metadata"]["labels"] = labels
+    if finalizers:
+        obj["metadata"]["finalizers"] = finalizers
+    return obj
+
+
+def test_create_get_roundtrip(api):
+    created = api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"] == "1"
+    got = api.get(gvr.COMPUTE_DOMAINS, "cd1", "default")
+    assert got["spec"]["numNodes"] == 2
+
+
+def test_create_duplicate_and_get_missing(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    with pytest.raises(errors.AlreadyExists):
+        api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    with pytest.raises(errors.NotFound):
+        api.get(gvr.COMPUTE_DOMAINS, "nope", "default")
+
+
+def test_generate_name(api):
+    obj = mk_cd()
+    del obj["metadata"]["name"]
+    obj["metadata"]["generateName"] = "cd-"
+    created = api.create(gvr.COMPUTE_DOMAINS, obj)
+    assert created["metadata"]["name"].startswith("cd-")
+
+
+def test_update_conflict_on_stale_rv(api):
+    created = api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    first = dict(created)
+    first["spec"] = {"numNodes": 3}
+    api.update(gvr.COMPUTE_DOMAINS, first)
+    stale = dict(created)  # still has rv=1
+    stale["spec"] = {"numNodes": 9}
+    with pytest.raises(errors.Conflict):
+        api.update(gvr.COMPUTE_DOMAINS, stale)
+
+
+def test_update_status_only_touches_status(api):
+    created = api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    created["status"] = {"status": "Ready"}
+    created["spec"] = {"numNodes": 99}  # must be ignored by status update
+    api.update_status(gvr.COMPUTE_DOMAINS, created)
+    got = api.get(gvr.COMPUTE_DOMAINS, "cd1", "default")
+    assert got["status"]["status"] == "Ready"
+    assert got["spec"]["numNodes"] == 2
+
+
+def test_finalizer_lifecycle(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd(finalizers=["tpu.google.com/cd"]))
+    api.delete(gvr.COMPUTE_DOMAINS, "cd1", "default")
+    # Object still present, marked terminating.
+    got = api.get(gvr.COMPUTE_DOMAINS, "cd1", "default")
+    assert got["metadata"]["deletionTimestamp"]
+    # Removing the finalizer completes deletion.
+    got["metadata"]["finalizers"] = []
+    api.update(gvr.COMPUTE_DOMAINS, got)
+    with pytest.raises(errors.NotFound):
+        api.get(gvr.COMPUTE_DOMAINS, "cd1", "default")
+
+
+def test_owner_reference_cascade(api):
+    owner = api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    dep = {
+        "metadata": {
+            "name": "clique1",
+            "namespace": "default",
+            "ownerReferences": [
+                {"uid": owner["metadata"]["uid"], "kind": "ComputeDomain", "name": "cd1"}
+            ],
+        }
+    }
+    api.create(gvr.COMPUTE_DOMAIN_CLIQUES, dep)
+    api.delete(gvr.COMPUTE_DOMAINS, "cd1", "default")
+    with pytest.raises(errors.NotFound):
+        api.get(gvr.COMPUTE_DOMAIN_CLIQUES, "clique1", "default")
+
+
+def test_list_with_selectors(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("a", labels={"team": "x"}))
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("b", labels={"team": "y"}))
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("c", ns="other", labels={"team": "x"}))
+    out = api.list(gvr.COMPUTE_DOMAINS, namespace="default", label_selector="team=x")
+    assert [o["metadata"]["name"] for o in out["items"]] == ["a"]
+    out = api.list(gvr.COMPUTE_DOMAINS, label_selector="team=x")
+    assert len(out["items"]) == 2
+    out = api.list(gvr.COMPUTE_DOMAINS, field_selector="metadata.name=b")
+    assert [o["metadata"]["name"] for o in out["items"]] == ["b"]
+
+
+def test_label_selector_forms():
+    assert match_label_selector("a=1,b!=2", {"a": "1", "b": "3"})
+    assert not match_label_selector("a=1,b!=2", {"a": "1", "b": "2"})
+    assert match_label_selector("a", {"a": "anything"})
+    assert not match_label_selector("a", {})
+    assert match_label_selector("!a", {})
+    assert not match_label_selector("!a", {"a": "x"})
+    assert match_label_selector(None, {})
+
+
+def test_patch_merge(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd(labels={"keep": "1", "drop": "2"}))
+    api.patch(
+        gvr.COMPUTE_DOMAINS,
+        "cd1",
+        {"metadata": {"labels": {"drop": None, "new": "3"}}},
+        "default",
+    )
+    got = api.get(gvr.COMPUTE_DOMAINS, "cd1", "default")
+    assert got["metadata"]["labels"] == {"keep": "1", "new": "3"}
+
+
+def test_watch_live_and_resume(api):
+    stop = threading.Event()
+    events = []
+
+    def consume():
+        for ev in api.watch(gvr.COMPUTE_DOMAINS, "default", resource_version="0", stop=stop):
+            events.append((ev["type"], ev["object"]["metadata"]["name"]))
+            if len(events) >= 3:
+                return
+
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("early"))  # before watch: replayed via rv=0
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("live"))
+    api.delete(gvr.COMPUTE_DOMAINS, "live", "default")
+    t.join(5)
+    stop.set()
+    assert ("ADDED", "early") in events
+    assert ("ADDED", "live") in events
+    assert ("DELETED", "live") in events
+
+
+def test_reactor_injects_failure(api):
+    def boom(verb, g, obj):
+        raise errors.Forbidden("nope")
+
+    api.react("create", gvr.COMPUTE_DOMAINS, boom)
+    with pytest.raises(errors.Forbidden):
+        api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+
+
+def test_generation_bumps_only_on_spec_change(api):
+    created = api.create(gvr.COMPUTE_DOMAINS, mk_cd())
+    assert created["metadata"]["generation"] == 1
+    created["metadata"]["labels"] = {"x": "1"}
+    updated = api.update(gvr.COMPUTE_DOMAINS, created)
+    assert updated["metadata"]["generation"] == 1
+    updated["spec"] = {"numNodes": 5}
+    updated = api.update(gvr.COMPUTE_DOMAINS, updated)
+    assert updated["metadata"]["generation"] == 2

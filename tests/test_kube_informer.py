@@ -1,0 +1,103 @@
+import threading
+import time
+
+import pytest
+
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.kube.informer import Informer, MutationCache
+
+
+@pytest.fixture
+def api():
+    return FakeKube()
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def mk(name, ns="default", labels=None):
+    return {
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"numNodes": 1},
+    }
+
+
+def test_informer_sync_and_events(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk("pre"))
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    seen = []
+    inf.add_handler(lambda t, o: seen.append((t, o["metadata"]["name"])))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    assert inf.get("pre", "default") is not None
+    assert ("ADDED", "pre") in seen
+
+    api.create(gvr.COMPUTE_DOMAINS, mk("live"))
+    assert wait_for(lambda: ("ADDED", "live") in seen)
+    obj = api.get(gvr.COMPUTE_DOMAINS, "live", "default")
+    obj["spec"]["numNodes"] = 7
+    api.update(gvr.COMPUTE_DOMAINS, obj)
+    assert wait_for(lambda: ("MODIFIED", "live") in seen)
+    assert wait_for(lambda: inf.get("live", "default")["spec"]["numNodes"] == 7)
+    api.delete(gvr.COMPUTE_DOMAINS, "live", "default")
+    assert wait_for(lambda: ("DELETED", "live") in seen)
+    assert wait_for(lambda: inf.get("live", "default") is None)
+    stop.set()
+
+
+def test_informer_label_filter(api):
+    inf = Informer(api, gvr.COMPUTE_DOMAINS, label_selector="want=yes")
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    api.create(gvr.COMPUTE_DOMAINS, mk("yes", labels={"want": "yes"}))
+    api.create(gvr.COMPUTE_DOMAINS, mk("no", labels={"want": "no"}))
+    assert wait_for(lambda: inf.get("yes", "default") is not None)
+    time.sleep(0.1)
+    assert inf.get("no", "default") is None
+    stop.set()
+
+
+def test_informer_index(api):
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    inf.add_index("uid", lambda o: o["metadata"].get("uid"))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    created = api.create(gvr.COMPUTE_DOMAINS, mk("x"))
+    uid = created["metadata"]["uid"]
+    assert wait_for(lambda: len(inf.by_index("uid", uid)) == 1)
+    stop.set()
+
+
+def test_mutation_cache_defeats_staleness(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk("cd"))
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    cache = MutationCache(inf)
+
+    # Controller writes; informer hasn't seen the event yet (simulate by
+    # reading immediately after the write).
+    obj = api.get(gvr.COMPUTE_DOMAINS, "cd", "default")
+    obj["spec"]["numNodes"] = 42
+    written = api.update(gvr.COMPUTE_DOMAINS, obj)
+    cache.mutated(written)
+    got = cache.get("cd", "default")
+    assert got["spec"]["numNodes"] == 42
+    # Once the informer catches up past that rv, the informer copy wins.
+    assert wait_for(
+        lambda: int(inf.get("cd", "default")["metadata"]["resourceVersion"])
+        >= int(written["metadata"]["resourceVersion"])
+    )
+    assert cache.get("cd", "default")["spec"]["numNodes"] == 42
+    stop.set()

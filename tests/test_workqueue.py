@@ -158,3 +158,15 @@ def test_keyed_items_never_run_concurrently():
     stop.set()
     for t in threads:
         t.join(2)
+
+
+def test_gens_bookkeeping_is_bounded():
+    q = WorkQueue()
+    stop, t = run_queue(q)
+    done = threading.Event()
+    for i in range(20):
+        q.enqueue_keyed(f"claim-{i}", (lambda: None) if i < 19 else done.set)
+    assert q.drain(5)
+    assert wait_for(lambda: len(q._gens) == 0)
+    stop.set()
+    t.join(2)

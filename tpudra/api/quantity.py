@@ -26,7 +26,7 @@ _SUFFIXES = {
     "Ei": 2**60,
 }
 
-_QUANTITY_RE = re.compile(r"^([+-]?[0-9]+(?:\.[0-9]+)?)(m|[kMGTPE]i?|)$")
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9]+(?:\.[0-9]+)?)([a-zA-Z]{0,2})$")
 
 
 class InvalidQuantity(ValueError):
@@ -45,6 +45,8 @@ def parse_quantity(s: str | int | float) -> int:
     if not m:
         raise InvalidQuantity(f"invalid quantity {s!r}")
     number, suffix = m.group(1), m.group(2)
+    if suffix != "m" and suffix not in _SUFFIXES:
+        raise InvalidQuantity(f"invalid quantity suffix {suffix!r} in {s!r}")
     if "." not in number:
         # Integer path: exact arithmetic (k8s Quantity is exact; float would
         # lose precision above 2^53).

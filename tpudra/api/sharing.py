@@ -44,6 +44,28 @@ class SharingValidationError(ValueError):
     pass
 
 
+def _validate_strategy_gate(strategy: str) -> None:
+    """A strategy is only valid when its feature gate is enabled — admission
+    must reject configuration of disabled features (reference validate.go:26-45,
+    'unknown GPU sharing strategy' whenever the gate is off)."""
+    from tpudra import featuregates
+
+    if strategy == TIME_SLICING_STRATEGY:
+        if not featuregates.enabled(featuregates.TIME_SLICING_SETTINGS):
+            raise SharingValidationError(
+                f"unknown sharing strategy: {strategy!r} "
+                f"(feature gate {featuregates.TIME_SLICING_SETTINGS} is disabled)"
+            )
+    elif strategy == MULTI_PROCESS_STRATEGY:
+        if not featuregates.enabled(featuregates.MULTI_PROCESS_SHARING):
+            raise SharingValidationError(
+                f"unknown sharing strategy: {strategy!r} "
+                f"(feature gate {featuregates.MULTI_PROCESS_SHARING} is disabled)"
+            )
+    else:
+        raise SharingValidationError(f"unknown sharing strategy: {strategy!r}")
+
+
 @dataclass
 class TimeSlicingConfig:
     interval: Optional[str] = field(default=None, metadata={"json": "interval"})
@@ -176,8 +198,7 @@ class TpuSharing:
         return self.multi_process_config
 
     def validate(self) -> None:
-        if self.strategy not in (TIME_SLICING_STRATEGY, MULTI_PROCESS_STRATEGY):
-            raise SharingValidationError(f"unknown sharing strategy: {self.strategy!r}")
+        _validate_strategy_gate(self.strategy)
         if self.is_time_slicing:
             cfg = self.get_time_slicing_config()
             if cfg is not None:
@@ -219,7 +240,6 @@ class PartitionSharing:
         return self.multi_process_config
 
     def validate(self) -> None:
-        if self.strategy not in (TIME_SLICING_STRATEGY, MULTI_PROCESS_STRATEGY):
-            raise SharingValidationError(f"unknown sharing strategy: {self.strategy!r}")
+        _validate_strategy_gate(self.strategy)
         if self.is_multi_process and self.multi_process_config is not None:
             self.multi_process_config.validate()

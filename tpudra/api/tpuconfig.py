@@ -16,7 +16,6 @@ from tpudra import API_GROUP, API_VERSION
 from tpudra import featuregates
 from tpudra.api.sharing import (
     DEFAULT_TIME_SLICE,
-    MULTI_PROCESS_STRATEGY,
     TIME_SLICING_STRATEGY,
     MultiProcessConfig,
     PartitionSharing,
@@ -93,12 +92,6 @@ class TpuPartitionConfig:
 
     def validate(self) -> None:
         if self.sharing is not None:
-            if self.sharing.strategy == MULTI_PROCESS_STRATEGY and not featuregates.enabled(
-                featuregates.MULTI_PROCESS_SHARING
-            ):
-                # Tolerated at validation; rejected at prepare time when the
-                # gate is off, mirroring the reference's split of concerns.
-                pass
             self.sharing.validate()
 
 

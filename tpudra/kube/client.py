@@ -1,0 +1,230 @@
+"""Minimal Kubernetes REST client.
+
+Replaces the reference's generated clientsets + client-go (pkg/flags/kubeclient.go:33-118)
+with a thin dynamic client: every driver component talks to the apiserver
+through the ``KubeAPI`` protocol, implemented here over HTTP(S) and by
+kube/fake.py in memory.  Auth: in-cluster service account, kubeconfig bearer
+token/client cert, or anonymous (test server).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import urllib.parse
+import urllib.request
+from typing import Iterator, Optional, Protocol
+
+import yaml
+
+from tpudra.kube import errors
+from tpudra.kube.gvr import GVR
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeAPI(Protocol):
+    """The API surface shared by KubeClient and FakeKube."""
+
+    def get(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> dict: ...
+
+    def list(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> dict: ...
+
+    def create(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict: ...
+
+    def update(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict: ...
+
+    def update_status(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict: ...
+
+    def patch(
+        self, gvr: GVR, name: str, patch: dict, namespace: Optional[str] = None
+    ) -> dict: ...
+
+    def delete(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> None: ...
+
+    def watch(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> Iterator[dict]: ...
+
+
+class KubeClient:
+    def __init__(
+        self,
+        server: str,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+        timeout: float = 30.0,
+    ):
+        self._server = server.rstrip("/")
+        self._token = token
+        self._timeout = timeout
+        self._ssl_ctx: Optional[ssl.SSLContext] = None
+        if server.startswith("https"):
+            if insecure:
+                self._ssl_ctx = ssl._create_unverified_context()
+            else:
+                self._ssl_ctx = ssl.create_default_context(cafile=ca_file)
+
+    # -- construction helpers ----------------------------------------------
+
+    @classmethod
+    def in_cluster(cls) -> "KubeClient":
+        host = os.environ["KUBERNETES_SERVICE_HOST"]
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        return cls(
+            f"https://{host}:{port}",
+            token=token,
+            ca_file=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt"),
+        )
+
+    @classmethod
+    def from_kubeconfig(cls, path: Optional[str] = None, context: Optional[str] = None) -> "KubeClient":
+        path = path or os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        ctx_name = context or cfg.get("current-context")
+        ctx = next(c["context"] for c in cfg["contexts"] if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in cfg["clusters"] if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in cfg["users"] if u["name"] == ctx["user"])
+        token = user.get("token")
+        return cls(
+            cluster["server"],
+            token=token,
+            ca_file=cluster.get("certificate-authority"),
+            insecure=cluster.get("insecure-skip-tls-verify", False),
+        )
+
+    @classmethod
+    def auto(cls) -> "KubeClient":
+        """In-cluster when available, else kubeconfig; KUBE_API_SERVER
+        overrides both (test harness)."""
+        override = os.environ.get("KUBE_API_SERVER")
+        if override:
+            return cls(override)
+        if os.path.exists(os.path.join(SERVICE_ACCOUNT_DIR, "token")):
+            return cls.in_cluster()
+        return cls.from_kubeconfig()
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        query: Optional[dict] = None,
+        body: Optional[dict] = None,
+        stream: bool = False,
+        timeout: Optional[float] = None,
+    ):
+        url = self._server + path
+        if query:
+            url += "?" + urllib.parse.urlencode({k: v for k, v in query.items() if v})
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            content_type = (
+                "application/merge-patch+json" if method == "PATCH" else "application/json"
+            )
+            req.add_header("Content-Type", content_type)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=timeout or self._timeout, context=self._ssl_ctx
+            )
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            try:
+                status = json.loads(payload)
+            except (ValueError, TypeError):
+                status = {"message": payload.decode(errors="replace")}
+            raise errors.from_status(status, e.code) from None
+        if stream:
+            return resp
+        with resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else None
+
+    # -- KubeAPI ------------------------------------------------------------
+
+    def get(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> dict:
+        return self._request("GET", gvr.path(namespace, name))
+
+    def list(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> dict:
+        return self._request(
+            "GET",
+            gvr.path(namespace),
+            query={"labelSelector": label_selector, "fieldSelector": field_selector},
+        )
+
+    def create(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        ns = obj.get("metadata", {}).get("namespace") or namespace
+        return self._request("POST", gvr.path(ns), body=obj)
+
+    def update(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        meta = obj["metadata"]
+        ns = meta.get("namespace") or namespace
+        return self._request("PUT", gvr.path(ns, meta["name"]), body=obj)
+
+    def update_status(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        meta = obj["metadata"]
+        ns = meta.get("namespace") or namespace
+        return self._request("PUT", gvr.path(ns, meta["name"]) + "/status", body=obj)
+
+    def patch(
+        self, gvr: GVR, name: str, patch: dict, namespace: Optional[str] = None
+    ) -> dict:
+        return self._request("PATCH", gvr.path(namespace, name), body=patch)
+
+    def delete(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> None:
+        self._request("DELETE", gvr.path(namespace, name))
+
+    def watch(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> Iterator[dict]:
+        resp = self._request(
+            "GET",
+            gvr.path(namespace),
+            query={
+                "watch": "true",
+                "resourceVersion": resource_version,
+                "labelSelector": label_selector,
+            },
+            stream=True,
+            timeout=3600.0,
+        )
+        with resp:
+            for line in resp:
+                if stop is not None and stop.is_set():
+                    return
+                line = line.strip()
+                if line:
+                    yield json.loads(line)

@@ -1,0 +1,68 @@
+"""API error model mirroring k8s apimachinery's StatusError semantics."""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+    def to_status(self) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Status",
+            "status": "Failure",
+            "message": self.message,
+            "reason": self.reason,
+            "code": self.code,
+        }
+
+
+class NotFound(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExists(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class Conflict(ApiError):
+    code = 409
+    reason = "Conflict"
+
+
+class Invalid(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class BadRequest(ApiError):
+    code = 400
+    reason = "BadRequest"
+
+
+class Forbidden(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+_BY_REASON = {
+    cls.reason: cls for cls in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden)
+}
+
+
+def from_status(status: dict, http_code: int) -> ApiError:
+    reason = status.get("reason", "")
+    message = status.get("message", "")
+    cls = _BY_REASON.get(reason)
+    if cls is None:
+        cls = {404: NotFound, 409: Conflict, 422: Invalid, 400: BadRequest, 403: Forbidden}.get(
+            http_code, ApiError
+        )
+    return cls(message)

@@ -1,0 +1,383 @@
+"""In-memory fake Kubernetes API.
+
+The reference has no fake device or API backend — its e2e suite needs a real
+cluster (tests/bats/README.md:1) — while our CI target is a hermetic harness
+(BASELINE.json: "kind cluster, CPU-only mock NVML").  This fake implements the
+apiserver semantics the driver's controllers actually rely on:
+
+- resourceVersion bumping and optimistic-concurrency Conflict on stale updates
+- create/AlreadyExists, get/NotFound, generateName
+- finalizers: delete sets deletionTimestamp; removal happens when the last
+  finalizer is cleared by an update
+- ownerReferences cascade GC (the apiserver's GC controller, simplified)
+- status subresource updates
+- list with label/field selectors
+- watch with resourceVersion resume (event history replay + live queues)
+
+It implements the same ``KubeAPI`` protocol as the real REST client, and can be
+served over HTTP (kube/httpserver.py) so the real client can be tested against
+it end-to-end.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+import uuid as uuidlib
+from typing import Callable, Iterator, Optional
+
+from tpudra.kube import errors
+from tpudra.kube.gvr import GVR
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def match_label_selector(selector: str | None, labels: dict) -> bool:
+    """Equality-based selector matching: "k=v", "k==v", "k!=v", "k", "!k"."""
+    if not selector:
+        return True
+    labels = labels or {}
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if term.startswith("!"):
+            if term[1:].strip() in labels:
+                return False
+        elif "!=" in term:
+            k, _, v = term.partition("!=")
+            if labels.get(k.strip()) == v.strip():
+                return False
+        elif "=" in term:
+            k, _, v = term.partition("==") if "==" in term else term.partition("=")
+            if labels.get(k.strip()) != v.strip():
+                return False
+        else:
+            if term not in labels:
+                return False
+    return True
+
+
+def match_field_selector(selector: str | None, obj: dict) -> bool:
+    """Supports metadata.name / metadata.namespace / spec.nodeName equality."""
+    if not selector:
+        return True
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        k, _, v = term.partition("=")
+        k = k.strip().lstrip("=")
+        parts = k.split(".")
+        cur = obj
+        for p in parts:
+            cur = cur.get(p, {}) if isinstance(cur, dict) else {}
+        if (cur or "") != v.strip():
+            return False
+    return True
+
+
+class _Watcher:
+    def __init__(self, gvr_key: str, namespace: Optional[str], label_selector: Optional[str]):
+        self.gvr_key = gvr_key
+        self.namespace = namespace
+        self.label_selector = label_selector
+        self.queue: queue.Queue = queue.Queue()
+        self.stopped = threading.Event()
+
+    def stop(self) -> None:
+        self.stopped.set()
+        self.queue.put(None)
+
+
+class FakeKube:
+    """An in-memory KubeAPI implementation."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._objects: dict[str, dict[tuple, dict]] = {}  # gvr_key -> {(ns, name): obj}
+        self._rv = 0
+        self._history: list[tuple[int, str, dict]] = []  # (rv, gvr_key, event)
+        self._watchers: list[_Watcher] = []
+        self._reactors: list[tuple[str, str, Callable]] = []  # (verb, gvr_key, fn)
+
+    # -- test hooks ---------------------------------------------------------
+
+    def react(self, verb: str, gvr: GVR, fn: Callable[[str, GVR, dict | None], None]) -> None:
+        """Install a reactor called before ``verb`` ("create", "update",
+        "delete", "get", "list") executes; raise from it to inject failures."""
+        self._reactors.append((verb, self._key(gvr), fn))
+
+    def _run_reactors(self, verb: str, gvr: GVR, obj: dict | None) -> None:
+        for v, key, fn in self._reactors:
+            if v in (verb, "*") and key == self._key(gvr):
+                fn(verb, gvr, obj)
+
+    # -- internals ----------------------------------------------------------
+
+    @staticmethod
+    def _key(gvr: GVR) -> str:
+        return f"{gvr.group}/{gvr.version}/{gvr.resource}"
+
+    def _bucket(self, gvr: GVR) -> dict[tuple, dict]:
+        return self._objects.setdefault(self._key(gvr), {})
+
+    def _obj_key(self, gvr: GVR, namespace: Optional[str], name: str) -> tuple:
+        return (namespace if gvr.namespaced else None, name)
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _emit(self, gvr: GVR, event_type: str, obj: dict) -> None:
+        event = {"type": event_type, "object": copy.deepcopy(obj)}
+        self._history.append((int(obj["metadata"]["resourceVersion"]), self._key(gvr), event))
+        for w in list(self._watchers):
+            if w.gvr_key != self._key(gvr):
+                continue
+            meta = obj.get("metadata", {})
+            if w.namespace and meta.get("namespace") != w.namespace:
+                continue
+            if not match_label_selector(w.label_selector, meta.get("labels", {})):
+                continue
+            w.queue.put(copy.deepcopy(event))
+
+    # -- KubeAPI protocol ---------------------------------------------------
+
+    def get(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> dict:
+        with self._lock:
+            self._run_reactors("get", gvr, None)
+            obj = self._bucket(gvr).get(self._obj_key(gvr, namespace, name))
+            if obj is None:
+                raise errors.NotFound(f"{gvr.resource} {namespace or ''}/{name} not found")
+            return copy.deepcopy(obj)
+
+    def list(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> dict:
+        with self._lock:
+            self._run_reactors("list", gvr, None)
+            items = []
+            for (ns, _), obj in self._bucket(gvr).items():
+                if gvr.namespaced and namespace and ns != namespace:
+                    continue
+                if not match_label_selector(label_selector, obj["metadata"].get("labels", {})):
+                    continue
+                if not match_field_selector(field_selector, obj):
+                    continue
+                items.append(copy.deepcopy(obj))
+            items.sort(key=lambda o: (o["metadata"].get("namespace") or "", o["metadata"]["name"]))
+            return {
+                "apiVersion": gvr.api_version,
+                "kind": gvr.kind + "List",
+                "metadata": {"resourceVersion": str(self._rv)},
+                "items": items,
+            }
+
+    def create(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        with self._lock:
+            self._run_reactors("create", gvr, obj)
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            if gvr.namespaced:
+                meta.setdefault("namespace", namespace or "default")
+                namespace = meta["namespace"]
+            name = meta.get("name")
+            if not name:
+                gen = meta.get("generateName")
+                if not gen:
+                    raise errors.Invalid("name or generateName required")
+                name = gen + uuidlib.uuid4().hex[:5]
+                meta["name"] = name
+            key = self._obj_key(gvr, namespace, name)
+            if key in self._bucket(gvr):
+                raise errors.AlreadyExists(
+                    f"{gvr.resource} {namespace or ''}/{name} already exists"
+                )
+            meta["uid"] = str(uuidlib.uuid4())
+            meta["resourceVersion"] = self._next_rv()
+            meta["creationTimestamp"] = _now()
+            meta.setdefault("generation", 1)
+            obj.setdefault("apiVersion", gvr.api_version)
+            obj.setdefault("kind", gvr.kind)
+            self._bucket(gvr)[key] = obj
+            self._emit(gvr, "ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def _update(
+        self, gvr: GVR, obj: dict, namespace: Optional[str], status_only: bool
+    ) -> dict:
+        with self._lock:
+            self._run_reactors("update", gvr, obj)
+            obj = copy.deepcopy(obj)
+            meta = obj.get("metadata", {})
+            name = meta.get("name")
+            if not name:
+                raise errors.Invalid("name required")
+            if gvr.namespaced:
+                namespace = meta.get("namespace") or namespace or "default"
+            key = self._obj_key(gvr, namespace, name)
+            current = self._bucket(gvr).get(key)
+            if current is None:
+                raise errors.NotFound(f"{gvr.resource} {namespace or ''}/{name} not found")
+            rv = meta.get("resourceVersion")
+            if rv and rv != current["metadata"]["resourceVersion"]:
+                raise errors.Conflict(
+                    f"operation cannot be fulfilled on {gvr.resource} {name}: "
+                    f"object has been modified"
+                )
+            if status_only:
+                updated = copy.deepcopy(current)
+                updated["status"] = obj.get("status", {})
+            else:
+                updated = obj
+                # Immutable/system-owned fields are preserved.
+                updated["metadata"]["uid"] = current["metadata"]["uid"]
+                updated["metadata"]["creationTimestamp"] = current["metadata"][
+                    "creationTimestamp"
+                ]
+                if "deletionTimestamp" in current["metadata"]:
+                    updated["metadata"]["deletionTimestamp"] = current["metadata"][
+                        "deletionTimestamp"
+                    ]
+                if current.get("spec") != updated.get("spec"):
+                    updated["metadata"]["generation"] = (
+                        current["metadata"].get("generation", 1) + 1
+                    )
+                updated.setdefault("status", current.get("status", {}))
+            updated["metadata"]["resourceVersion"] = self._next_rv()
+            updated.setdefault("apiVersion", gvr.api_version)
+            updated.setdefault("kind", gvr.kind)
+
+            # Finalizer semantics: a terminating object whose finalizers have
+            # all been removed is actually deleted by this update.
+            if (
+                updated["metadata"].get("deletionTimestamp")
+                and not updated["metadata"].get("finalizers")
+            ):
+                del self._bucket(gvr)[key]
+                self._emit(gvr, "DELETED", updated)
+                self._cascade_delete(updated["metadata"]["uid"])
+                return copy.deepcopy(updated)
+
+            self._bucket(gvr)[key] = updated
+            self._emit(gvr, "MODIFIED", updated)
+            return copy.deepcopy(updated)
+
+    def update(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        return self._update(gvr, obj, namespace, status_only=False)
+
+    def update_status(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        return self._update(gvr, obj, namespace, status_only=True)
+
+    def patch(
+        self, gvr: GVR, name: str, patch: dict, namespace: Optional[str] = None
+    ) -> dict:
+        """RFC 7386 JSON merge patch."""
+        with self._lock:
+            current = self.get(gvr, name, namespace)
+
+            def merge(dst, src):
+                for k, v in src.items():
+                    if v is None:
+                        dst.pop(k, None)
+                    elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+                        merge(dst[k], v)
+                    else:
+                        dst[k] = v
+
+            merge(current, patch)
+            current["metadata"]["resourceVersion"] = ""  # skip conflict check
+            return self._update(gvr, current, namespace, status_only=False)
+
+    def delete(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> None:
+        with self._lock:
+            self._run_reactors("delete", gvr, None)
+            key = self._obj_key(gvr, namespace, name)
+            obj = self._bucket(gvr).get(key)
+            if obj is None:
+                raise errors.NotFound(f"{gvr.resource} {namespace or ''}/{name} not found")
+            if obj["metadata"].get("finalizers"):
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = _now()
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
+                    self._emit(gvr, "MODIFIED", obj)
+                return
+            del self._bucket(gvr)[key]
+            obj["metadata"]["resourceVersion"] = self._next_rv()
+            self._emit(gvr, "DELETED", obj)
+            self._cascade_delete(obj["metadata"]["uid"])
+
+    def _cascade_delete(self, owner_uid: str) -> None:
+        """Owner-reference GC: delete dependents of a removed owner."""
+        from tpudra.kube.gvr import ALL_GVRS
+
+        for gvr in ALL_GVRS:
+            bucket = self._objects.get(self._key(gvr), {})
+            doomed = []
+            for (ns, name), obj in bucket.items():
+                for ref in obj["metadata"].get("ownerReferences", []):
+                    if ref.get("uid") == owner_uid:
+                        doomed.append((ns, name))
+                        break
+            for ns, name in doomed:
+                try:
+                    self.delete(gvr, name, ns)
+                except errors.NotFound:
+                    pass
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> Iterator[dict]:
+        """Yield {"type": ..., "object": ...} events.
+
+        With resource_version, replays history events newer than it first
+        (k8s watch resume), then streams live events.  Terminates when
+        ``stop`` is set.
+        """
+        watcher = _Watcher(self._key(gvr), namespace if gvr.namespaced else None, label_selector)
+        with self._lock:
+            backlog = []
+            if resource_version is not None:
+                rv = int(resource_version)
+                for ev_rv, key, event in self._history:
+                    if key != watcher.gvr_key or ev_rv <= rv:
+                        continue
+                    meta = event["object"].get("metadata", {})
+                    if watcher.namespace and meta.get("namespace") != watcher.namespace:
+                        continue
+                    if not match_label_selector(label_selector, meta.get("labels", {})):
+                        continue
+                    backlog.append(copy.deepcopy(event))
+            self._watchers.append(watcher)
+        try:
+            yield from backlog
+            while True:
+                if stop is not None and stop.is_set():
+                    return
+                try:
+                    event = watcher.queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if event is None:
+                    return
+                yield event
+        finally:
+            with self._lock:
+                if watcher in self._watchers:
+                    self._watchers.remove(watcher)

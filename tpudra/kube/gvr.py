@@ -1,0 +1,97 @@
+"""Group/Version/Resource identifiers for every API type the driver touches.
+
+The analog of the typed clientsets the reference generates under
+pkg/nvidia.com/ (client-gen/informer-gen, Makefile:117-165) — but since our
+client is a generic REST layer, a GVR constant plus the dynamic client replaces
+each generated typed client.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from tpudra import API_GROUP, API_VERSION
+
+
+@dataclass(frozen=True)
+class GVR:
+    group: str  # "" for core
+    version: str
+    resource: str  # plural, lowercase
+    kind: str
+    namespaced: bool = True
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    @property
+    def prefix(self) -> str:
+        """URL path prefix: /api/v1 or /apis/<group>/<version>."""
+        if self.group:
+            return f"/apis/{self.group}/{self.version}"
+        return f"/api/{self.version}"
+
+    def path(self, namespace: str | None = None, name: str | None = None) -> str:
+        parts = [self.prefix]
+        if self.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self.resource)
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+
+# -- core/v1 ----------------------------------------------------------------
+
+PODS = GVR("", "v1", "pods", "Pod")
+NODES = GVR("", "v1", "nodes", "Node", namespaced=False)
+CONFIGMAPS = GVR("", "v1", "configmaps", "ConfigMap")
+SERVICES = GVR("", "v1", "services", "Service")
+
+# -- apps/v1 ----------------------------------------------------------------
+
+DAEMONSETS = GVR("apps", "v1", "daemonsets", "DaemonSet")
+DEPLOYMENTS = GVR("apps", "v1", "deployments", "Deployment")
+
+# -- resource.k8s.io (DRA) --------------------------------------------------
+
+RESOURCE_CLAIMS = GVR("resource.k8s.io", "v1", "resourceclaims", "ResourceClaim")
+RESOURCE_CLAIM_TEMPLATES = GVR(
+    "resource.k8s.io", "v1", "resourceclaimtemplates", "ResourceClaimTemplate"
+)
+RESOURCE_SLICES = GVR(
+    "resource.k8s.io", "v1", "resourceslices", "ResourceSlice", namespaced=False
+)
+DEVICE_CLASSES = GVR(
+    "resource.k8s.io", "v1", "deviceclasses", "DeviceClass", namespaced=False
+)
+
+# -- our CRDs (resource.tpu.google.com) -------------------------------------
+
+COMPUTE_DOMAINS = GVR(API_GROUP, API_VERSION, "computedomains", "ComputeDomain")
+COMPUTE_DOMAIN_CLIQUES = GVR(
+    API_GROUP, API_VERSION, "computedomaincliques", "ComputeDomainClique"
+)
+
+ALL_GVRS = [
+    PODS,
+    NODES,
+    CONFIGMAPS,
+    SERVICES,
+    DAEMONSETS,
+    DEPLOYMENTS,
+    RESOURCE_CLAIMS,
+    RESOURCE_CLAIM_TEMPLATES,
+    RESOURCE_SLICES,
+    DEVICE_CLASSES,
+    COMPUTE_DOMAINS,
+    COMPUTE_DOMAIN_CLIQUES,
+]
+
+
+def by_path(group: str, version: str, resource: str) -> GVR | None:
+    for gvr in ALL_GVRS:
+        if (gvr.group, gvr.version, gvr.resource) == (group, version, resource):
+            return gvr
+    return None

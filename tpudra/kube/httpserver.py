@@ -1,0 +1,186 @@
+"""HTTP frontend serving a FakeKube over the Kubernetes REST wire format.
+
+This is the in-process replacement for the reference's kind-cluster test
+harness (demo/clusters/kind): the real KubeClient talks real HTTP to this
+server, so client, controllers, and plugins are all exercised over the same
+wire protocol they use in production — without a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpudra.kube import errors
+from tpudra.kube.fake import FakeKube
+from tpudra.kube.gvr import by_path
+
+
+def _parse_path(path: str):
+    """Return (gvr, namespace, name, subresource) or raise BadRequest."""
+    parts = [p for p in path.split("/") if p]
+    # /api/v1/... (core) or /apis/<group>/<version>/...
+    if not parts:
+        raise errors.BadRequest("empty path")
+    if parts[0] == "api" and len(parts) >= 2:
+        group, version = "", parts[1]
+        rest = parts[2:]
+    elif parts[0] == "apis" and len(parts) >= 3:
+        group, version = parts[1], parts[2]
+        rest = parts[3:]
+    else:
+        raise errors.BadRequest(f"unrecognized path {path!r}")
+    namespace = None
+    if len(rest) >= 2 and rest[0] == "namespaces":
+        namespace = rest[1]
+        rest = rest[2:]
+    if not rest:
+        raise errors.BadRequest(f"no resource in path {path!r}")
+    resource, rest = rest[0], rest[1:]
+    name = rest[0] if rest else None
+    subresource = rest[1] if len(rest) > 1 else None
+    gvr = by_path(group, version, resource)
+    if gvr is None:
+        raise errors.NotFound(f"the server could not find resource {resource!r} in {group}/{version}")
+    return gvr, namespace, name, subresource
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    fake: FakeKube = None  # set by serve()
+
+    def log_message(self, *args):  # silence request logging
+        pass
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _send_error(self, e: errors.ApiError) -> None:
+        self._send_json(e.code, e.to_status())
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            raise errors.BadRequest(f"invalid JSON body: {e}") from None
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urllib.parse.urlparse(self.path)
+        query = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        try:
+            gvr, namespace, name, subresource = _parse_path(parsed.path)
+            if method == "GET" and name is None and query.get("watch") == "true":
+                self._serve_watch(gvr, namespace, query)
+                return
+            if method == "GET" and name is None:
+                out = self.fake.list(
+                    gvr,
+                    namespace,
+                    label_selector=query.get("labelSelector"),
+                    field_selector=query.get("fieldSelector"),
+                )
+            elif method == "GET":
+                out = self.fake.get(gvr, name, namespace)
+            elif method == "POST":
+                out = self.fake.create(gvr, self._body(), namespace)
+            elif method == "PUT" and subresource == "status":
+                out = self.fake.update_status(gvr, self._body(), namespace)
+            elif method == "PUT":
+                out = self.fake.update(gvr, self._body(), namespace)
+            elif method == "PATCH":
+                out = self.fake.patch(gvr, name, self._body(), namespace)
+            elif method == "DELETE":
+                self.fake.delete(gvr, name, namespace)
+                out = {"apiVersion": "v1", "kind": "Status", "status": "Success"}
+            else:
+                raise errors.BadRequest(f"unsupported method {method}")
+            self._send_json(200, out)
+        except errors.ApiError as e:
+            self._send_error(e)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _serve_watch(self, gvr, namespace, query) -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def write_chunk(data: bytes) -> None:
+            self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            self.wfile.flush()
+
+        stop = threading.Event()
+        try:
+            for event in self.fake.watch(
+                gvr,
+                namespace,
+                resource_version=query.get("resourceVersion"),
+                label_selector=query.get("labelSelector"),
+                stop=stop,
+            ):
+                write_chunk(json.dumps(event).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            stop.set()
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_PUT(self):
+        self._dispatch("PUT")
+
+    def do_PATCH(self):
+        self._dispatch("PATCH")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+
+class FakeKubeServer:
+    """Serve a FakeKube over HTTP on localhost; use as a context manager."""
+
+    def __init__(self, fake: FakeKube | None = None, port: int = 0):
+        self.fake = fake or FakeKube()
+        handler = type("BoundHandler", (_Handler,), {"fake": self.fake})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FakeKubeServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self) -> "FakeKubeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,181 @@
+"""List+watch informer with a local cache and event handlers.
+
+The analog of the generated informers the reference gets from informer-gen
+plus client-go's shared informer machinery: list, then watch from the list's
+resourceVersion, re-listing on watch failure; handlers fire on add/update/
+delete; ``wait_for_sync`` gates controller startup.
+
+Also provides MutationCache: after a controller writes an object, the freshly
+written version is layered over the informer cache so the controller doesn't
+act on its own stale read (reference compute-domain-controller/
+computedomain.go:117-125).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.gvr import GVR
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[[str, dict], None]  # (event_type, object)
+
+
+def obj_key(obj: dict) -> tuple:
+    meta = obj.get("metadata", {})
+    return (meta.get("namespace"), meta.get("name"))
+
+
+class Informer:
+    def __init__(
+        self,
+        api: KubeAPI,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        resync_period: float = 0.0,
+    ):
+        self._api = api
+        self._gvr = gvr
+        self._namespace = namespace
+        self._label_selector = label_selector
+        self._resync_period = resync_period
+        self._store: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+        self._handlers: list[Handler] = []
+        self._synced = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._indices: dict[str, Callable[[dict], str | None]] = {}
+
+    # -- configuration ------------------------------------------------------
+
+    def add_handler(self, handler: Handler) -> None:
+        self._handlers.append(handler)
+
+    def add_index(self, name: str, fn: Callable[[dict], str | None]) -> None:
+        """Register a secondary index (e.g. by uid, by label value)."""
+        self._indices[name] = fn
+
+    # -- store access -------------------------------------------------------
+
+    def get(self, name: str, namespace: Optional[str] = None) -> Optional[dict]:
+        with self._lock:
+            return self._store.get((namespace, name))
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return list(self._store.values())
+
+    def by_index(self, index: str, value: str) -> list[dict]:
+        fn = self._indices[index]
+        with self._lock:
+            return [o for o in self._store.values() if fn(o) == value]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, stop: threading.Event) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(stop,), daemon=True, name=f"informer-{self._gvr.resource}"
+        )
+        self._thread.start()
+
+    def wait_for_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                self._list_and_watch(stop)
+            except Exception as e:  # noqa: BLE001 — informer must survive apiserver blips
+                logger.warning(
+                    "informer %s: list/watch failed: %s; re-listing", self._gvr.resource, e
+                )
+                time.sleep(0.2)
+
+    def _list_and_watch(self, stop: threading.Event) -> None:
+        listing = self._api.list(
+            self._gvr, self._namespace, label_selector=self._label_selector
+        )
+        rv = listing.get("metadata", {}).get("resourceVersion")
+        fresh = {obj_key(o): o for o in listing.get("items", [])}
+        with self._lock:
+            old = self._store
+            self._store = fresh
+        for key, obj in fresh.items():
+            if key not in old:
+                self._dispatch("ADDED", obj)
+            elif old[key].get("metadata", {}).get("resourceVersion") != obj.get(
+                "metadata", {}
+            ).get("resourceVersion"):
+                self._dispatch("MODIFIED", obj)
+        for key, obj in old.items():
+            if key not in fresh:
+                self._dispatch("DELETED", obj)
+        self._synced.set()
+
+        for event in self._api.watch(
+            self._gvr,
+            self._namespace,
+            resource_version=rv,
+            label_selector=self._label_selector,
+            stop=stop,
+        ):
+            if stop.is_set():
+                return
+            etype, obj = event["type"], event["object"]
+            key = obj_key(obj)
+            with self._lock:
+                if etype == "DELETED":
+                    self._store.pop(key, None)
+                else:
+                    self._store[key] = obj
+            self._dispatch(etype, obj)
+
+    def _dispatch(self, etype: str, obj: dict) -> None:
+        for handler in self._handlers:
+            try:
+                handler(etype, obj)
+            except Exception:  # noqa: BLE001
+                logger.exception("informer %s handler failed", self._gvr.resource)
+
+
+class MutationCache:
+    """Layer controller-written objects over an informer cache so a controller
+    never acts on its own stale read.  Entries expire after ttl (the informer
+    catches up well before that)."""
+
+    def __init__(self, informer: Informer, ttl: float = 10.0):
+        self._informer = informer
+        self._ttl = ttl
+        self._mutated: dict[tuple, tuple[float, dict]] = {}
+        self._lock = threading.Lock()
+
+    def mutated(self, obj: dict) -> None:
+        with self._lock:
+            self._mutated[obj_key(obj)] = (time.monotonic() + self._ttl, obj)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> Optional[dict]:
+        key = (namespace, name)
+        cached = self._informer.get(name, namespace)
+        with self._lock:
+            entry = self._mutated.get(key)
+            if entry is None:
+                return cached
+            expires, obj = entry
+            if time.monotonic() > expires:
+                del self._mutated[key]
+                return cached
+        if cached is not None:
+            try:
+                if int(cached["metadata"]["resourceVersion"]) >= int(
+                    obj["metadata"]["resourceVersion"]
+                ):
+                    return cached  # informer caught up
+            except (KeyError, ValueError):
+                return cached
+        return obj

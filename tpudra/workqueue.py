@@ -215,8 +215,21 @@ class WorkQueue:
                 with self._cond:
                     if entry.key is not None:
                         self._active_keys.discard(entry.key)
+                        # Done with the newest generation of this key (no
+                        # retry queued): drop the bookkeeping so long-lived
+                        # daemons don't accumulate an entry per claim ever
+                        # seen.
+                        if (
+                            self._gens.get(entry.key) == entry.gen
+                            and not self._has_queued_key(entry.key)
+                        ):
+                            del self._gens[entry.key]
                     self._inflight -= 1
                     self._cond.notify_all()
+
+    def _has_queued_key(self, key: object) -> bool:
+        """Caller must hold self._cond."""
+        return any(e.key == key for e in self._heap)
 
     def _pop(self, stop: threading.Event) -> Optional[_Entry]:
         with self._cond:
