@@ -1,0 +1,188 @@
+import json
+
+import pytest
+
+from tpudra.devicelib import MockTopologyConfig, make_device_lib
+from tpudra.plugin.cdi import CDIHandler, ContainerEdits, chip_edits
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    ChecksumMismatch,
+    PreparedClaim,
+    PreparedDevice,
+    PreparedDeviceGroup,
+)
+
+
+# -- CDI --------------------------------------------------------------------
+
+@pytest.fixture
+def cdi(tmp_path):
+    return CDIHandler(str(tmp_path / "cdi"))
+
+
+def test_claim_spec_roundtrip(cdi):
+    edits = ContainerEdits(env=["TPU_VISIBLE_DEVICES=0"], device_nodes=["/dev/accel0"])
+    ids = cdi.create_claim_spec_file("uid-1", {"tpu-0": edits})
+    assert ids == ["k8s.tpu.google.com/claim=uid-1-tpu-0"]
+    spec = cdi.read_claim_spec("uid-1")
+    assert spec["cdiVersion"] == "0.6.0"
+    assert spec["kind"] == "k8s.tpu.google.com/claim"
+    dev = spec["devices"][0]
+    assert dev["name"] == "uid-1-tpu-0"
+    assert dev["containerEdits"]["env"] == ["TPU_VISIBLE_DEVICES=0"]
+    assert dev["containerEdits"]["deviceNodes"] == [{"path": "/dev/accel0"}]
+    assert cdi.list_claim_uids() == ["uid-1"]
+    cdi.delete_claim_spec_file("uid-1")
+    assert cdi.read_claim_spec("uid-1") is None
+    cdi.delete_claim_spec_file("uid-1")  # idempotent
+
+
+def test_common_edits_and_mounts(cdi):
+    common = ContainerEdits(env=["TPUDRA_CLIQUE_ID=s.0"], mounts=[("/h", "/c")])
+    cdi.create_claim_spec_file("uid-2", {"d": ContainerEdits()}, common_edits=common)
+    spec = cdi.read_claim_spec("uid-2")
+    assert spec["containerEdits"]["env"] == ["TPUDRA_CLIQUE_ID=s.0"]
+    m = spec["containerEdits"]["mounts"][0]
+    assert (m["hostPath"], m["containerPath"]) == ("/h", "/c")
+
+
+def test_chip_edits_env():
+    lib = make_device_lib("mock", config=MockTopologyConfig(generation="v5p"))
+    chips = lib.enumerate_chips()[1:3]
+    edits = chip_edits(chips)
+    env = dict(e.split("=", 1) for e in edits.env)
+    assert env["TPU_VISIBLE_DEVICES"] == "1,2"
+    assert env["TPUDRA_CLIQUE_ID"] == "mock-slice-0000.0"
+    assert env["TPUDRA_GENERATION"] == "v5p"
+    assert len(env["TPUDRA_CHIP_COORDS"].split(";")) == 2
+    assert edits.device_nodes == ["/dev/accel1", "/dev/accel2"]
+
+
+def test_driver_root_transform(tmp_path):
+    cdi = CDIHandler(str(tmp_path / "cdi"), driver_root="/driver-root")
+    assert cdi.host_path("/dev/accel0") == "/driver-root/dev/accel0"
+
+
+# -- checkpoint -------------------------------------------------------------
+
+def mk_claim(uid="u1", status=PREPARE_COMPLETED):
+    return PreparedClaim(
+        uid=uid,
+        namespace="ns",
+        name="claim-a",
+        status=status,
+        groups=[
+            PreparedDeviceGroup(
+                devices=[
+                    PreparedDevice(
+                        canonical_name="tpu-0",
+                        type="chip",
+                        pool_name="node-a",
+                        request_names=["r0"],
+                        cdi_device_ids=["k8s.tpu.google.com/claim=u1-tpu-0"],
+                        attributes={"uuid": "tpu-x-0"},
+                    )
+                ],
+                config_state={"timeslice": "Default"},
+            )
+        ],
+    )
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.read().prepared_claims == {}
+    cp = Checkpoint(prepared_claims={"u1": mk_claim()})
+    mgr.write(cp)
+    got = mgr.read()
+    claim = got.prepared_claims["u1"]
+    assert claim.status == PREPARE_COMPLETED
+    assert claim.namespace == "ns"
+    assert claim.all_devices()[0].canonical_name == "tpu-0"
+    assert claim.groups[0].config_state == {"timeslice": "Default"}
+
+
+def test_checkpoint_mutate_is_atomic(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+
+    def add(cp):
+        cp.prepared_claims["u2"] = mk_claim("u2", PREPARE_STARTED)
+
+    mgr.mutate(add)
+    assert mgr.read().prepared_claims["u2"].status == PREPARE_STARTED
+
+    def fail(cp):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        mgr.mutate(fail)
+    assert "u2" in mgr.read().prepared_claims  # unchanged
+
+
+def test_downgrade_reads_v1(tmp_path):
+    # A V2-writing driver's file must be readable by a V1-only reader
+    # (downgrade) — simulate by parsing only the v1 entry.
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
+    envelope = json.load(open(mgr.path))
+    v1 = json.loads(envelope["v1"]["data"])
+    assert "u1" in v1["preparedClaims"]
+    assert v1["preparedClaims"]["u1"]["devices"][0]["canonicalName"] == "tpu-0"
+
+
+def test_upgrade_reads_v1_only_file(tmp_path):
+    # A file written by an old (V1-only) driver: no v2 entry.
+    mgr = CheckpointManager(str(tmp_path))
+    v1_data = json.dumps(
+        {
+            "preparedClaims": {
+                "old-uid": {"devices": [{"canonicalName": "tpu-1", "type": "chip"}]}
+            }
+        }
+    )
+    import zlib
+
+    envelope = {"v1": {"data": v1_data, "checksum": zlib.crc32(v1_data.encode())}}
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
+    got = mgr.read()
+    claim = got.prepared_claims["old-uid"]
+    assert claim.status == PREPARE_COMPLETED  # V1 claims were complete
+    assert claim.all_devices()[0].canonical_name == "tpu-1"
+
+
+def test_checksum_mismatch_detected(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
+    envelope = json.load(open(mgr.path))
+    envelope["v2"]["data"] = envelope["v2"]["data"].replace("tpu-0", "tpu-9")
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
+    with pytest.raises(ChecksumMismatch):
+        mgr.read()
+
+
+def test_forward_compat_unknown_fields(tmp_path):
+    # A newer driver added fields; non-strict decode must tolerate them.
+    mgr = CheckpointManager(str(tmp_path))
+    cp_data = json.dumps(
+        {
+            "preparedClaims": {
+                "u9": {
+                    "uid": "u9",
+                    "status": "PrepareCompleted",
+                    "futureField": {"x": 1},
+                    "groups": [],
+                }
+            }
+        }
+    )
+    import zlib
+
+    envelope = {"v2": {"data": cp_data, "checksum": zlib.crc32(cp_data.encode())}}
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
+    assert mgr.read().prepared_claims["u9"].status == "PrepareCompleted"

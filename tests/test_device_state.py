@@ -1,0 +1,475 @@
+import os
+import threading
+
+import pytest
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra import featuregates as fg
+from tpudra.devicelib import MockTopologyConfig
+from tpudra.devicelib.mock import MockDeviceLib
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin.cdi import CDIHandler
+from tpudra.plugin.checkpoint import CheckpointManager, PREPARE_STARTED
+from tpudra.plugin.cleanup import CheckpointCleanupManager
+from tpudra.plugin.device_state import DeviceState, PermanentError, PrepareError
+from tpudra.plugin.sharing import MultiProcessManager
+from tpudra.plugin.vfio import VfioManager
+
+
+# -- harness ----------------------------------------------------------------
+
+def mk_claim(uid, devices, configs=None, ns="default", name="claim-x"):
+    results = [
+        {"request": f"r{i}", "driver": TPU_DRIVER_NAME, "pool": "node-a", "device": d}
+        for i, d in enumerate(devices)
+    ]
+    return {
+        "metadata": {"uid": uid, "namespace": ns, "name": name},
+        "status": {
+            "allocation": {"devices": {"results": results, "config": configs or []}}
+        },
+    }
+
+
+def opaque(params, source="FromClaim", requests=None):
+    return {
+        "source": source,
+        "requests": requests or [],
+        "opaque": {"driver": TPU_DRIVER_NAME, "parameters": params},
+    }
+
+
+API_V = "resource.tpu.google.com/v1beta1"
+
+
+class Harness:
+    def __init__(self, tmp_path, config=None, kube=None, with_mp=False, with_vfio=False):
+        self.lib = MockDeviceLib(
+            config=config or MockTopologyConfig(generation="v5p"),
+            state_file=str(tmp_path / "hw-state.json"),
+        )
+        self.cdi = CDIHandler(str(tmp_path / "cdi"))
+        self.cp = CheckpointManager(str(tmp_path / "plugin"))
+        self.kube = kube or FakeKube()
+        mp = None
+        if with_mp:
+            mp = MultiProcessManager(
+                self.kube, self.lib, "node-a", pipe_root=str(tmp_path / "mp")
+            )
+        vfio = None
+        if with_vfio:
+            vfio = VfioManager(sysfs_root=str(tmp_path / "sys"))
+        self.state = DeviceState(
+            self.lib, self.cdi, self.cp, "node-a", mp_manager=mp, vfio_manager=vfio
+        )
+
+
+# -- basic prepare/unprepare ------------------------------------------------
+
+def test_prepare_full_chip_default(tmp_path):
+    h = Harness(tmp_path)
+    out = h.state.prepare(mk_claim("u1", ["tpu-0"]))
+    assert len(out) == 1
+    assert out[0].device_name == "tpu-0"
+    assert out[0].pool_name == "node-a"
+    assert out[0].cdi_device_ids == ["k8s.tpu.google.com/claim=u1-tpu-0"]
+    spec = h.cdi.read_claim_spec("u1")
+    env = spec["containerEdits"]["env"]  # claim-wide env, not per-device
+    assert "TPU_VISIBLE_DEVICES=0" in env
+    assert any(e.startswith("TPUDRA_CLIQUE_ID=") for e in env)
+    assert {"path": "/dev/accel0"} in spec["devices"][0]["containerEdits"]["deviceNodes"]
+
+
+def test_prepare_is_idempotent(tmp_path):
+    h = Harness(tmp_path)
+    first = h.state.prepare(mk_claim("u1", ["tpu-0", "tpu-1"]))
+    second = h.state.prepare(mk_claim("u1", ["tpu-0", "tpu-1"]))
+    assert [d.device_name for d in first] == [d.device_name for d in second]
+
+
+def test_unprepare_removes_everything(tmp_path):
+    h = Harness(tmp_path)
+    h.state.prepare(mk_claim("u1", ["tpu-0"]))
+    h.state.unprepare("u1")
+    assert h.cdi.read_claim_spec("u1") is None
+    assert h.state.prepared_claim_uids() == {}
+    h.state.unprepare("u1")  # idempotent
+
+
+def test_overlap_rejected(tmp_path):
+    h = Harness(tmp_path)
+    h.state.prepare(mk_claim("u1", ["tpu-0"]))
+    with pytest.raises(PermanentError, match="already prepared"):
+        h.state.prepare(mk_claim("u2", ["tpu-0"], name="claim-y"))
+    # Disjoint devices fine.
+    h.state.prepare(mk_claim("u3", ["tpu-1"]))
+
+
+def test_unknown_device_rejected(tmp_path):
+    h = Harness(tmp_path)
+    with pytest.raises(PermanentError, match="not allocatable"):
+        h.state.prepare(mk_claim("u1", ["tpu-99"]))
+
+
+def test_claim_without_allocation_rejected(tmp_path):
+    h = Harness(tmp_path)
+    with pytest.raises(PermanentError, match="no allocation"):
+        h.state.prepare({"metadata": {"uid": "u", "namespace": "d", "name": "n"}, "status": {}})
+
+
+def test_bad_opaque_config_rejected(tmp_path):
+    h = Harness(tmp_path)
+    cfg = opaque({"apiVersion": API_V, "kind": "TpuConfig", "bogus": 1})
+    with pytest.raises(PermanentError, match="invalid opaque config"):
+        h.state.prepare(mk_claim("u1", ["tpu-0"], configs=[cfg]))
+
+
+# -- sharing ----------------------------------------------------------------
+
+def test_timeslicing_applied_and_reset(tmp_path):
+    fg.feature_gates().set_from_spec("TimeSlicingSettings=true")
+    h = Harness(tmp_path)
+    cfg = opaque(
+        {
+            "apiVersion": API_V,
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing", "timeSlicingConfig": {"interval": "Long"}},
+        }
+    )
+    h.state.prepare(mk_claim("u1", ["tpu-0"], configs=[cfg]))
+    chip = h.lib.enumerate_chips()[0]
+    assert h.lib.get_timeslice(chip.uuid) == "Long"
+    spec = h.cdi.read_claim_spec("u1")
+    assert "TPU_TIMESLICE_HINT=Long" in spec["containerEdits"]["env"]
+    h.state.unprepare("u1")
+    assert h.lib.get_timeslice(chip.uuid) == "Default"
+
+
+def test_config_precedence_claim_over_class(tmp_path):
+    fg.feature_gates().set_from_spec("TimeSlicingSettings=true")
+    h = Harness(tmp_path)
+    class_cfg = opaque(
+        {
+            "apiVersion": API_V,
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing", "timeSlicingConfig": {"interval": "Short"}},
+        },
+        source="FromClass",
+    )
+    claim_cfg = opaque(
+        {
+            "apiVersion": API_V,
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "TimeSlicing", "timeSlicingConfig": {"interval": "Medium"}},
+        }
+    )
+    # Claim config listed before class config in the array: class-first
+    # ordering must still let the claim config win.
+    h.state.prepare(mk_claim("u1", ["tpu-0"], configs=[claim_cfg, class_cfg]))
+    chip = h.lib.enumerate_chips()[0]
+    assert h.lib.get_timeslice(chip.uuid) == "Medium"
+
+
+def test_multiprocess_daemon_lifecycle(tmp_path):
+    fg.feature_gates().set_from_spec("MultiProcessSharing=true")
+    kube = FakeKube()
+
+    def make_ready(verb, g, obj):
+        if obj is not None and obj.get("kind") == "Deployment":
+            obj["status"] = {"readyReplicas": 1}
+
+    kube.react("create", gvr.DEPLOYMENTS, make_ready)
+    h = Harness(tmp_path, kube=kube, with_mp=True)
+    cfg = opaque(
+        {
+            "apiVersion": API_V,
+            "kind": "TpuConfig",
+            "sharing": {
+                "strategy": "MultiProcess",
+                "multiProcessConfig": {
+                    "defaultActiveTensorCorePercentage": 50,
+                    "defaultPinnedHbmLimit": "8Gi",
+                },
+            },
+        }
+    )
+    h.state.prepare(mk_claim("u1", ["tpu-0", "tpu-1"], configs=[cfg]))
+    deps = kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"]
+    assert len(deps) == 1
+    assert deps[0]["metadata"]["name"] == "tpu-mp-control-daemon-u1"
+    assert deps[0]["spec"]["template"]["spec"]["nodeName"] == "node-a"
+    chips = h.lib.enumerate_chips()
+    assert h.lib.get_exclusive(chips[0].uuid) is True
+    spec = h.cdi.read_claim_spec("u1")
+    env = spec["containerEdits"]["env"]
+    assert "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE=50" in env
+    assert any("TPUDRA_MP_PIPE_DIRECTORY=" in e for e in env)
+
+    h.state.unprepare("u1")
+    assert kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"] == []
+    assert h.lib.get_exclusive(chips[0].uuid) is False
+
+
+# -- dynamic partitions -----------------------------------------------------
+
+def dyn_harness(tmp_path, **kw):
+    fg.feature_gates().set_from_spec("DynamicPartitioning=true")
+    return Harness(tmp_path, **kw)
+
+
+def test_dynamic_partition_prepare_unprepare(tmp_path):
+    h = dyn_harness(tmp_path)
+    name = "tpu-0-part-1c.4hbm-0-0"
+    assert name in h.state.allocatable
+    out = h.state.prepare(mk_claim("u1", [name]))
+    assert out[0].device_name == name
+    assert len(h.lib.list_partitions()) == 1
+    spec = h.cdi.read_claim_spec("u1")
+    env = spec["containerEdits"]["env"]
+    assert "TPUDRA_PARTITIONS=tpu-0-part-1c.4hbm-0-0=1c.4hbm@0,0" in env
+    h.state.unprepare("u1")
+    assert h.lib.list_partitions() == []
+
+
+def inject_create_failure(lib, fail_on_placement):
+    """Make create_partition fail once for the given (core_start, hbm_start)
+    — simulating a hardware fault halfway through a multi-device prepare."""
+    from tpudra.devicelib import DeviceLibError
+
+    real = lib.create_partition
+    state = {"armed": True}
+
+    def flaky(spec):
+        if state["armed"] and (spec.core_start, spec.hbm_start) == fail_on_placement:
+            state["armed"] = False
+            raise DeviceLibError("injected hardware fault")
+        return real(spec)
+
+    lib.create_partition = flaky
+    return state
+
+
+def test_partial_prepare_rollback_on_retry(tmp_path):
+    h = dyn_harness(tmp_path)
+    # Also prepare an unrelated claim whose partition must survive rollback.
+    h.state.prepare(mk_claim("uother", ["tpu-1-part-1c.4hbm-0-0"]))
+    inject_create_failure(h.lib, (1, 4))
+    with pytest.raises(PrepareError, match="injected"):
+        h.state.prepare(
+            mk_claim("u1", ["tpu-0-part-1c.4hbm-0-0", "tpu-0-part-1c.4hbm-1-4"])
+        )
+    # The immediate undo destroyed the half-created partition; only the
+    # unrelated claim's partition remains, and u1 is stuck in Started.
+    assert len(h.lib.list_partitions()) == 1
+    assert h.state.prepared_claim_uids()["u1"][2] == PREPARE_STARTED
+    # Kubelet retries: rollback the orphan, then succeed.
+    out = h.state.prepare(
+        mk_claim("u1", ["tpu-0-part-1c.4hbm-0-0", "tpu-0-part-1c.4hbm-1-4"])
+    )
+    assert len(out) == 2
+    assert len(h.lib.list_partitions()) == 3
+    # Every live partition is now owned by a completed claim.
+    owned = {
+        d.attributes["partitionUUID"]
+        for c in h.cp.read().prepared_claims.values()
+        for d in c.all_devices()
+    }
+    assert owned == {p.uuid for p in h.lib.list_partitions()}
+
+
+def test_unprepare_of_partial_claim_rolls_back(tmp_path):
+    h = dyn_harness(tmp_path)
+    h.state.prepare(mk_claim("uother", ["tpu-1-part-1c.4hbm-0-0"]))
+    inject_create_failure(h.lib, (1, 4))
+    with pytest.raises(PrepareError):
+        h.state.prepare(
+            mk_claim("u1", ["tpu-0-part-1c.4hbm-0-0", "tpu-0-part-1c.4hbm-1-4"])
+        )
+    h.state.unprepare("u1")
+    # Orphan gone; the unrelated claim's partition intact.
+    assert len(h.lib.list_partitions()) == 1
+    assert "u1" not in h.state.prepared_claim_uids()
+    assert "uother" in h.state.prepared_claim_uids()
+
+
+def test_destroy_unknown_partitions_at_startup(tmp_path):
+    h = dyn_harness(tmp_path)
+    h.state.prepare(mk_claim("u1", ["tpu-0-part-1c.4hbm-0-0"]))
+    # Simulate an out-of-band partition (crashed driver, manual op).
+    from tpudra.devicelib import PartitionSpec
+
+    h.lib.create_partition(PartitionSpec(1, "1c.4hbm", 0, 0))
+    assert len(h.lib.list_partitions()) == 2
+    # "Restart": new DeviceState over the same checkpoint + hardware state.
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    destroyed = state2.destroy_unknown_partitions()
+    assert destroyed == 1
+    live = h.lib.list_partitions()
+    assert len(live) == 1  # the checkpointed one survived
+
+
+# -- static partitions ------------------------------------------------------
+
+def test_static_partitions_advertised(tmp_path):
+    cfg = MockTopologyConfig(
+        generation="v5p", static_partitions=[(0, "1c.4hbm", 0, 0), (0, "1c.4hbm", 1, 4)]
+    )
+    h = Harness(tmp_path, config=cfg)
+    names = set(h.state.allocatable)
+    # Chip 0 is statically partitioned: partitions advertised, chip hidden.
+    assert "tpu-0-part-1c.4hbm-0-0" in names
+    assert "tpu-0-part-1c.4hbm-1-4" in names
+    assert "tpu-0" not in names
+    assert "tpu-1" in names
+    out = h.state.prepare(mk_claim("u1", ["tpu-0-part-1c.4hbm-0-0"]))
+    assert out[0].device_name == "tpu-0-part-1c.4hbm-0-0"
+    # Unprepare of a static partition must NOT destroy it.
+    h.state.unprepare("u1")
+    assert len(h.lib.list_partitions()) == 2
+
+
+# -- vfio -------------------------------------------------------------------
+
+def mk_sysfs(tmp_path, chips):
+    sys = tmp_path / "sys"
+    (sys / "kernel/iommu_groups/7").mkdir(parents=True)
+    for chip in chips:
+        d = sys / "bus/pci/devices" / chip.pci_address
+        d.mkdir(parents=True)
+        (d / "iommu_group").write_text(str(7 + chip.index))
+    for drv in ("tpu", "vfio-pci"):
+        (sys / "bus/pci/drivers" / drv).mkdir(parents=True)
+    return str(sys)
+
+
+def test_vfio_prepare_unprepare(tmp_path):
+    fg.feature_gates().set_from_spec("PassthroughSupport=true")
+    lib = MockDeviceLib(config=MockTopologyConfig(generation="v5p"))
+    mk_sysfs(tmp_path, lib.enumerate_chips())
+    h = Harness(tmp_path, with_vfio=True)
+    assert "tpu-vfio-0" in h.state.allocatable
+    cfg = opaque({"apiVersion": API_V, "kind": "VfioDeviceConfig"})
+    out = h.state.prepare(mk_claim("u1", ["tpu-vfio-0"], configs=[cfg]))
+    assert out[0].device_name == "tpu-vfio-0"
+    chip = h.lib.enumerate_chips()[0]
+    override = (
+        tmp_path / "sys/bus/pci/devices" / chip.pci_address / "driver_override"
+    ).read_text()
+    assert override == "vfio-pci"
+    spec = h.cdi.read_claim_spec("u1")
+    nodes = [n["path"] for n in spec["devices"][0]["containerEdits"]["deviceNodes"]]
+    assert "/dev/vfio/7" in nodes
+    assert "/dev/vfio/vfio" in nodes
+    h.state.unprepare("u1")
+    override = (
+        tmp_path / "sys/bus/pci/devices" / chip.pci_address / "driver_override"
+    ).read_text()
+    assert override.strip() == ""
+
+
+def test_config_type_mismatch(tmp_path):
+    fg.feature_gates().set_from_spec("PassthroughSupport=true")
+    h = Harness(tmp_path, with_vfio=True)
+    cfg = opaque({"apiVersion": API_V, "kind": "VfioDeviceConfig"})
+    with pytest.raises(PermanentError, match="non-vfio"):
+        h.state.prepare(mk_claim("u1", ["tpu-0"], configs=[cfg]))
+
+
+# -- stale-claim GC ---------------------------------------------------------
+
+def test_cleanup_unprepares_stale_claims(tmp_path):
+    h = Harness(tmp_path)
+    h.state.prepare(mk_claim("u-dead", ["tpu-0"], ns="default", name="gone"))
+    h.state.prepare(mk_claim("u-mismatch", ["tpu-1"], ns="default", name="replaced"))
+    h.state.prepare(mk_claim("u-live", ["tpu-2"], ns="default", name="alive"))
+
+    # "replaced" exists but with a different uid; "alive" matches; "gone" 404s.
+    h.kube.create(
+        gvr.RESOURCE_CLAIMS,
+        {"metadata": {"name": "replaced", "namespace": "default"}, "status": {"allocation": {}}},
+    )
+    live = h.kube.create(
+        gvr.RESOURCE_CLAIMS,
+        {"metadata": {"name": "alive", "namespace": "default"}, "status": {"allocation": {}}},
+    )
+    # Force the live claim's uid to match the checkpointed one.
+    h.kube._bucket(gvr.RESOURCE_CLAIMS)[("default", "alive")]["metadata"]["uid"] = "u-live"
+
+    mgr = CheckpointCleanupManager(h.kube, h.state, period=3600)
+    stale = mgr.cleanup_once()
+    assert stale == 2
+    assert set(h.state.prepared_claim_uids()) == {"u-live"}
+
+
+def test_failed_mp_prepare_cleans_up(tmp_path):
+    # assert_ready timeout must not leak the Deployment or exclusive mode
+    # (review finding: sharing side effects leaked on failed prepare).
+    fg.feature_gates().set_from_spec("MultiProcessSharing=true")
+    h = Harness(tmp_path, with_mp=True)  # no readiness reactor: stays unready
+    cfg = opaque(
+        {
+            "apiVersion": API_V,
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "MultiProcess", "multiProcessConfig": {}},
+        }
+    )
+    import tpudra.plugin.sharing as sharing_mod
+
+    orig = sharing_mod.MultiProcessControlDaemon.assert_ready
+    sharing_mod.MultiProcessControlDaemon.assert_ready = (
+        lambda self, timeout=0.1, poll=0.02: orig(self, timeout=0.1, poll=0.02)
+    )
+    try:
+        with pytest.raises(sharing_mod.SharingError):
+            h.state.prepare(mk_claim("u1", ["tpu-0"], configs=[cfg]))
+    finally:
+        sharing_mod.MultiProcessControlDaemon.assert_ready = orig
+    assert h.kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"] == []
+    chip = h.lib.enumerate_chips()[0]
+    assert h.lib.get_exclusive(chip.uuid) is False
+
+
+def test_mp_cleanup_stale_daemons(tmp_path):
+    fg.feature_gates().set_from_spec("MultiProcessSharing=true")
+    kube = FakeKube()
+
+    def make_ready(verb, g, obj):
+        if obj is not None and obj.get("kind") == "Deployment":
+            obj["status"] = {"readyReplicas": 1}
+
+    kube.react("create", gvr.DEPLOYMENTS, make_ready)
+    h = Harness(tmp_path, kube=kube, with_mp=True)
+    cfg = opaque(
+        {
+            "apiVersion": API_V,
+            "kind": "TpuConfig",
+            "sharing": {"strategy": "MultiProcess", "multiProcessConfig": {}},
+        }
+    )
+    h.state.prepare(mk_claim("u1", ["tpu-0"], configs=[cfg]))
+    # Simulate a leaked daemon from a crashed prepare (claim never recorded).
+    mp = h.state._mp
+    leaked = mp.new_daemon("u-leaked", [h.lib.enumerate_chips()[1].uuid],
+                           __import__("tpudra.api.sharing", fromlist=["MultiProcessConfig"]).MultiProcessConfig())
+    leaked.start()
+    assert len(kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"]) == 2
+    removed = mp.cleanup_stale(set(h.state.prepared_claim_uids()))
+    assert removed == 1
+    names = [d["metadata"]["name"] for d in kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"]]
+    assert names == ["tpu-mp-control-daemon-u1"]
+    assert h.lib.get_exclusive(h.lib.enumerate_chips()[1].uuid) is False
+
+
+def test_overlap_chip_vs_partition_and_vfio(tmp_path):
+    # Same-silicon overlap under different names must be refused
+    # (review finding: chip vs its partitions vs its vfio alias).
+    fg.feature_gates().set_from_spec("DynamicPartitioning=true")
+    h = Harness(tmp_path)
+    h.state.prepare(mk_claim("u1", ["tpu-0"]))
+    with pytest.raises(PermanentError, match="overlaps"):
+        h.state.prepare(mk_claim("u2", ["tpu-0-part-1c.4hbm-0-0"], name="y"))
+    # And partition-first, chip-second:
+    h.state.prepare(mk_claim("u3", ["tpu-1-part-1c.4hbm-0-0"]))
+    with pytest.raises(PermanentError, match="overlaps"):
+        h.state.prepare(mk_claim("u4", ["tpu-1"], name="z"))

@@ -1,0 +1,188 @@
+import threading
+
+import pytest
+
+from tpudra.devicelib import (
+    GENERATIONS,
+    DeviceLibError,
+    HealthEvent,
+    HealthEventKind,
+    MockTopologyConfig,
+    PartitionSpec,
+    make_device_lib,
+    partition_profiles,
+)
+from tpudra.devicelib.mock import MockDeviceLib
+
+
+@pytest.fixture
+def lib():
+    return make_device_lib("mock", config=MockTopologyConfig(generation="v5p"))
+
+
+# -- enumeration ------------------------------------------------------------
+
+def test_default_v5p_host(lib):
+    chips = lib.enumerate_chips()
+    assert len(chips) == 4  # v5p: 4 chips/host
+    assert {c.index for c in chips} == {0, 1, 2, 3}
+    assert len({c.uuid for c in chips}) == 4
+    assert all(c.generation == "v5p" for c in chips)
+    assert all(c.hbm_bytes == 95 * 2**30 for c in chips)
+    assert all(c.tensorcores == 2 for c in chips)
+    # Unique coords within the host block.
+    assert len({c.coords for c in chips}) == 4
+    topo = lib.slice_topology()
+    assert topo.clique_id == "mock-slice-0000.0"
+    assert topo.mesh_shape == (2, 2, 1)
+
+
+def test_multi_host_topology():
+    lib = make_device_lib(
+        "mock",
+        config=MockTopologyConfig(generation="v5p", num_hosts=4, host_index=2),
+    )
+    topo = lib.slice_topology()
+    assert topo.mesh_shape == (2, 2, 4)  # v5p-16: 4 hosts stack along z
+    # Host 2's chips sit at z=2.
+    assert all(c.coords[2] == 2 for c in lib.enumerate_chips())
+
+
+def test_v5e_host():
+    lib = make_device_lib("mock", config=MockTopologyConfig(generation="v5e"))
+    chips = lib.enumerate_chips()
+    assert len(chips) == 8
+    assert all(c.tensorcores == 1 for c in chips)
+
+
+def test_config_from_json_env(monkeypatch):
+    monkeypatch.setenv(
+        "TPUDRA_MOCK_TOPOLOGY",
+        '{"generation": "v4", "num_chips": 2, "slice_uuid": "s1", "partition_id": 7}',
+    )
+    lib = make_device_lib("mock")
+    assert len(lib.enumerate_chips()) == 2
+    assert lib.slice_topology().clique_id == "s1.7"
+
+
+# -- partition profiles -----------------------------------------------------
+
+def test_v5p_profiles():
+    profiles = partition_profiles(GENERATIONS["v5p"])
+    names = {p.name for p in profiles}
+    # 1 core with half-or-more HBM; 2 cores (full chip) with all HBM.
+    assert "1c.4hbm" in names
+    assert "1c.8hbm" in names
+    assert "2c.8hbm" in names
+
+
+def test_non_partitionable_generation_has_no_profiles():
+    assert partition_profiles(GENERATIONS["v5e"]) == []
+    lib = make_device_lib("mock", config=MockTopologyConfig(generation="v5e"))
+    with pytest.raises(DeviceLibError, match="not partitionable"):
+        lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 0))
+
+
+def test_placements_for_half_chip_profile(lib):
+    chip = lib.enumerate_chips()[0]
+    placements = lib.possible_placements(chip)
+    half = [p for p in placements if p.profile.name == "1c.4hbm"]
+    # Two placements: core 0 + HBM 0-3, core 1 + HBM 4-7 (NUMA-aligned).
+    assert {(p.core_start, p.hbm_start) for p in half} == {(0, 0), (1, 4)}
+
+
+# -- partition lifecycle ----------------------------------------------------
+
+def test_create_list_delete_partition(lib):
+    live = lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 0))
+    assert live.uuid.startswith("tpupart-")
+    assert live.parent_uuid == lib.enumerate_chips()[0].uuid
+    assert [p.uuid for p in lib.list_partitions()] == [live.uuid]
+    lib.delete_partition(live.uuid)
+    assert lib.list_partitions() == []
+    with pytest.raises(DeviceLibError):
+        lib.delete_partition(live.uuid)
+
+
+def test_partition_overlap_rejected(lib):
+    lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 0))
+    with pytest.raises(DeviceLibError, match="collides"):
+        lib.create_partition(PartitionSpec(0, "2c.8hbm", 0, 0))
+    with pytest.raises(DeviceLibError, match="collides"):
+        lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 0))
+    # Disjoint core+HBM on same chip is fine; other chip always fine.
+    lib.create_partition(PartitionSpec(0, "1c.4hbm", 1, 4))
+    lib.create_partition(PartitionSpec(1, "1c.4hbm", 0, 0))
+    assert len(lib.list_partitions()) == 3
+
+
+def test_partition_bad_placement(lib):
+    with pytest.raises(DeviceLibError, match="cores"):
+        lib.create_partition(PartitionSpec(0, "1c.4hbm", 5, 0))
+    with pytest.raises(DeviceLibError, match="HBM"):
+        lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 7))
+    with pytest.raises(DeviceLibError, match="invalid partition profile"):
+        lib.create_partition(PartitionSpec(0, "garbage", 0, 0))
+
+
+def test_partition_state_survives_restart(tmp_path):
+    state = str(tmp_path / "mock-state.json")
+    cfg = MockTopologyConfig(generation="v5p")
+    lib1 = MockDeviceLib(config=cfg, state_file=state)
+    live = lib1.create_partition(PartitionSpec(2, "1c.4hbm", 0, 0))
+    # "Restart": a new instance sees the persisted partition — this is what
+    # startup reconciliation (DestroyUnknownPartitions) runs against.
+    lib2 = MockDeviceLib(config=cfg, state_file=state)
+    found = lib2.list_partitions()
+    assert [p.uuid for p in found] == [live.uuid]
+    lib2.delete_partition(live.uuid)
+    lib3 = MockDeviceLib(config=cfg, state_file=state)
+    assert lib3.list_partitions() == []
+
+
+def test_static_partitions_created_at_startup():
+    cfg = MockTopologyConfig(
+        generation="v5p", static_partitions=[(0, "1c.4hbm", 0, 0), (0, "1c.4hbm", 1, 4)]
+    )
+    lib = MockDeviceLib(config=cfg)
+    assert len(lib.list_partitions()) == 2
+
+
+# -- sharing knobs ----------------------------------------------------------
+
+def test_timeslice_and_exclusive(lib):
+    chips = lib.enumerate_chips()
+    uuids = [c.uuid for c in chips[:2]]
+    lib.set_timeslice(uuids, "Long")
+    assert lib.get_timeslice(uuids[0]) == "Long"
+    assert lib.get_timeslice(chips[2].uuid) is None
+    lib.set_exclusive(uuids, True)
+    assert lib.get_exclusive(uuids[0]) is True
+    with pytest.raises(DeviceLibError):
+        lib.set_timeslice(["nonexistent"], "Short")
+
+
+# -- health events ----------------------------------------------------------
+
+def test_health_event_stream(lib):
+    stop = threading.Event()
+    got = []
+
+    def consume():
+        for ev in lib.health_events(stop):
+            got.append(ev)
+            return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    chip = lib.enumerate_chips()[0]
+    lib.inject_health_event(
+        HealthEvent(HealthEventKind.HBM_ECC_ERROR, chip.uuid, detail="double-bit")
+    )
+    t.join(5)
+    stop.set()
+    assert got and got[0].kind == "HbmEccError"
+    assert got[0].chip_uuid == chip.uuid

@@ -1,0 +1,35 @@
+from tpudra.devicelib.base import (
+    DeviceLib,
+    DeviceLibError,
+    HealthEvent,
+    HealthEventKind,
+    LivePartition,
+    PartitionSpec,
+    make_device_lib,
+)
+from tpudra.devicelib.topology import (
+    GENERATIONS,
+    HBM_SLICES_PER_CHIP,
+    MockTopologyConfig,
+    PartitionProfile,
+    SliceTopology,
+    TpuChip,
+    partition_profiles,
+)
+
+__all__ = [
+    "DeviceLib",
+    "DeviceLibError",
+    "HealthEvent",
+    "HealthEventKind",
+    "LivePartition",
+    "PartitionSpec",
+    "make_device_lib",
+    "GENERATIONS",
+    "HBM_SLICES_PER_CHIP",
+    "MockTopologyConfig",
+    "PartitionProfile",
+    "SliceTopology",
+    "TpuChip",
+    "partition_profiles",
+]

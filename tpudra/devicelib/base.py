@@ -1,0 +1,147 @@
+"""Hardware-abstraction interface for TPU enumeration and control.
+
+The analog of the reference's ``deviceLib`` (gpu-kubelet-plugin/nvlib.go:41):
+everything the kubelet plugins need from the hardware lives behind this
+interface so business logic runs identically on the mock backend (hermetic CI)
+and the native backend (C++ libtpuinfo via ctypes, native/tpuinfo/).
+
+Mapping to the reference:
+- enumerate_chips / slice_topology  ↔ VisitDevices+getGpuInfo / fabric info
+- partition create/delete/list     ↔ createMigDevice/deleteMigDevice (nvlib.go:860-1128)
+- set_timeslice / set_exclusive    ↔ nvidia-smi timeslice/compute-mode shellouts
+- health event stream              ↔ NVML XID/ECC event set (device_health.go)
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from tpudra.devicelib.topology import (
+    PartitionPlacement,
+    SliceTopology,
+    TpuChip,
+)
+
+
+@dataclass(frozen=True)
+class PartitionSpec:
+    """Identity of a possible partition: (parent chip index, profile name,
+    core_start, hbm_start) — the analog of the reference's MigSpecTuple
+    (parentMinor, profileID, placementStart), mig.go:35."""
+
+    parent_index: int
+    profile: str  # PartitionProfile.name, e.g. "1c.4hbm"
+    core_start: int
+    hbm_start: int
+
+
+@dataclass
+class LivePartition:
+    """A partition that exists on the hardware right now (MigLiveTuple analog,
+    mig.go:65)."""
+
+    spec: PartitionSpec
+    uuid: str
+    parent_uuid: str
+    dev_paths: list[str]
+
+
+class HealthEventKind:
+    # The XID-analog taxonomy for TPUs: hardware interrupt classes surfaced
+    # by the driver (reference device_health.go:38-351 maps NVML XID/ECC).
+    HBM_ECC_ERROR = "HbmEccError"
+    ICI_LINK_DOWN = "IciLinkDown"
+    CHIP_LOCKUP = "ChipLockup"
+    THERMAL_TRIP = "ThermalTrip"
+    FIRMWARE_FAULT = "FirmwareFault"
+
+    ALL = (HBM_ECC_ERROR, ICI_LINK_DOWN, CHIP_LOCKUP, THERMAL_TRIP, FIRMWARE_FAULT)
+
+    # Events that do not indicate the chip itself is unusable — the analog of
+    # the reference's default-ignored XIDs (device_health.go:329: app-caused
+    # XIDs 13,31,43,45,...).  ICI link flaps degrade the fabric but the chip
+    # still computes; the ComputeDomain layer owns fabric health.
+    DEFAULT_IGNORED = (ICI_LINK_DOWN,)
+
+
+@dataclass(frozen=True)
+class HealthEvent:
+    kind: str
+    chip_uuid: str
+    partition_uuid: Optional[str] = None  # set when scoped to a partition
+    detail: str = ""
+
+
+class DeviceLibError(Exception):
+    pass
+
+
+class DeviceLib(abc.ABC):
+    """Abstract TPU device library."""
+
+    # -- enumeration --------------------------------------------------------
+
+    @abc.abstractmethod
+    def enumerate_chips(self) -> list[TpuChip]:
+        """All chips on this host, stable order by index."""
+
+    @abc.abstractmethod
+    def slice_topology(self) -> SliceTopology:
+        """This host's slice membership / fabric identity."""
+
+    # -- partitions (MIG analog) -------------------------------------------
+
+    @abc.abstractmethod
+    def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
+        """All (profile, placement) pairs the chip supports."""
+
+    @abc.abstractmethod
+    def create_partition(self, spec: PartitionSpec) -> LivePartition:
+        """Carve a TensorCore partition out of a chip.  Idempotence is the
+        caller's job (checkpoint state machine); colliding placements raise."""
+
+    @abc.abstractmethod
+    def delete_partition(self, uuid: str) -> None:
+        """Destroy a live partition by uuid; unknown uuid raises."""
+
+    @abc.abstractmethod
+    def list_partitions(self) -> list[LivePartition]:
+        """Partitions that exist right now (startup reconciliation input for
+        DestroyUnknownPartitions, reference device_state.go:337)."""
+
+    # -- sharing knobs ------------------------------------------------------
+
+    @abc.abstractmethod
+    def set_timeslice(self, chip_uuids: list[str], interval: str) -> None:
+        """Record the cooperative time-slice hint for the chips."""
+
+    @abc.abstractmethod
+    def set_exclusive(self, chip_uuids: list[str], exclusive: bool) -> None:
+        """Single-client vs multi-client chip access (compute-mode analog)."""
+
+    # -- health -------------------------------------------------------------
+
+    @abc.abstractmethod
+    def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
+        """Blocking stream of health events until ``stop`` is set."""
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        return None
+
+
+def make_device_lib(backend: str = "mock", **kwargs) -> DeviceLib:
+    """Factory: backend is "mock" (hermetic) or "native" (C++ libtpuinfo)."""
+    if backend == "mock":
+        from tpudra.devicelib.mock import MockDeviceLib
+
+        return MockDeviceLib(**kwargs)
+    if backend == "native":
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        return NativeDeviceLib(**kwargs)
+    raise DeviceLibError(f"unknown device-lib backend {backend!r}")

@@ -1,0 +1,268 @@
+"""Mock TPU device backend.
+
+The hermetic-CI device backend the reference never had (its e2e suite requires
+real GPU runners; SURVEY.md §4.3).  Topology comes from MockTopologyConfig
+(inline, or JSON via the TPUDRA_MOCK_TOPOLOGY env var); partition state can be
+persisted to a JSON file so driver restarts see pre-existing partitions — that
+is what exercises the startup-reconciliation/rollback machinery
+(DestroyUnknownPartitions) the same way real hardware would.
+
+Health events are injected by tests through ``inject_health_event``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import uuid as uuidlib
+from typing import Iterator, Optional
+
+from tpudra.devicelib.base import (
+    DeviceLib,
+    DeviceLibError,
+    HealthEvent,
+    LivePartition,
+    PartitionSpec,
+)
+from tpudra.devicelib.topology import (
+    GENERATIONS,
+    HBM_SLICES_PER_CHIP,
+    MockTopologyConfig,
+    PartitionPlacement,
+    PartitionProfile,
+    SliceTopology,
+    TpuChip,
+    chip_coords_for_host,
+    partition_profiles,
+)
+
+MOCK_TOPOLOGY_ENV = "TPUDRA_MOCK_TOPOLOGY"
+
+
+class MockDeviceLib(DeviceLib):
+    def __init__(
+        self,
+        config: Optional[MockTopologyConfig] = None,
+        state_file: Optional[str] = None,
+    ):
+        if config is None:
+            env = os.environ.get(MOCK_TOPOLOGY_ENV)
+            if env:
+                if env.strip().startswith("{"):
+                    config = MockTopologyConfig.from_json(env)
+                else:
+                    with open(env) as f:
+                        config = MockTopologyConfig.from_json(f.read())
+            else:
+                config = MockTopologyConfig()
+        self._config = config
+        self._state_file = state_file
+        self._lock = threading.Lock()
+        self._partitions: dict[str, LivePartition] = {}
+        self._timeslice: dict[str, str] = {}
+        self._exclusive: dict[str, bool] = {}
+        self._health_queues: list[queue.Queue] = []
+
+        spec, num_chips, mesh = config.resolve()
+        coords = chip_coords_for_host(spec, config.host_index, num_chips)
+        clique = f"{config.slice_uuid}.{config.partition_id}"
+        self._chips = [
+            TpuChip(
+                index=i,
+                uuid=f"tpu-{config.slice_uuid}-{config.host_index}-{i}",
+                generation=spec.name,
+                coords=coords[i],
+                pci_address=f"0000:{0x10 + i:02x}:00.0",
+                clique_id=clique,
+                hbm_bytes=spec.hbm_bytes,
+                tensorcores=spec.tensorcores_per_chip,
+            )
+            for i in range(num_chips)
+        ]
+        self._topology = SliceTopology(
+            slice_uuid=config.slice_uuid,
+            partition_id=config.partition_id,
+            mesh_shape=mesh,
+            host_index=config.host_index,
+            num_hosts=config.num_hosts,
+        )
+        self._load_state()
+        for part in config.static_partitions:
+            chip_idx, profile, core_start, hbm_start = part
+            spec_ = PartitionSpec(chip_idx, profile, core_start, hbm_start)
+            if not any(p.spec == spec_ for p in self._partitions.values()):
+                self._create_unlocked(spec_, static=True)
+
+    # -- state persistence --------------------------------------------------
+
+    def _load_state(self) -> None:
+        if not self._state_file or not os.path.exists(self._state_file):
+            return
+        with open(self._state_file) as f:
+            data = json.load(f)
+        for p in data.get("partitions", []):
+            lp = LivePartition(
+                spec=PartitionSpec(**p["spec"]),
+                uuid=p["uuid"],
+                parent_uuid=p["parent_uuid"],
+                dev_paths=p["dev_paths"],
+            )
+            self._partitions[lp.uuid] = lp
+
+    def _save_state(self) -> None:
+        if not self._state_file:
+            return
+        data = {
+            "partitions": [
+                {
+                    "spec": vars(p.spec),
+                    "uuid": p.uuid,
+                    "parent_uuid": p.parent_uuid,
+                    "dev_paths": p.dev_paths,
+                }
+                for p in self._partitions.values()
+            ]
+        }
+        tmp = self._state_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._state_file)
+
+    # -- enumeration --------------------------------------------------------
+
+    def enumerate_chips(self) -> list[TpuChip]:
+        return list(self._chips)
+
+    def slice_topology(self) -> SliceTopology:
+        return self._topology
+
+    def chip_by_index(self, index: int) -> TpuChip:
+        for chip in self._chips:
+            if chip.index == index:
+                return chip
+        raise DeviceLibError(f"no chip with index {index}")
+
+    def chip_by_uuid(self, uuid: str) -> TpuChip:
+        for chip in self._chips:
+            if chip.uuid == uuid:
+                return chip
+        raise DeviceLibError(f"no chip with uuid {uuid}")
+
+    # -- partitions ---------------------------------------------------------
+
+    def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
+        out = []
+        for profile in partition_profiles(chip.spec):
+            out.extend(profile.placements(chip.spec))
+        return out
+
+    def _overlaps(self, a: PartitionSpec, b: PartitionSpec) -> bool:
+        if a.parent_index != b.parent_index:
+            return False
+
+        def ranges(s: PartitionSpec):
+            prof = _parse_profile(s.profile)
+            return (
+                (s.core_start, s.core_start + prof.tensorcores),
+                (s.hbm_start, s.hbm_start + prof.hbm_slices),
+            )
+
+        (ac, ah), (bc, bh) = ranges(a), ranges(b)
+        cores_overlap = ac[0] < bc[1] and bc[0] < ac[1]
+        hbm_overlap = ah[0] < bh[1] and bh[0] < ah[1]
+        return cores_overlap or hbm_overlap
+
+    def _create_unlocked(self, spec: PartitionSpec, static: bool = False) -> LivePartition:
+        chip = self.chip_by_index(spec.parent_index)
+        prof = _parse_profile(spec.profile)
+        gen = GENERATIONS[chip.generation]
+        if prof.tensorcores + spec.core_start > gen.tensorcores_per_chip:
+            raise DeviceLibError(f"placement {spec} exceeds chip cores")
+        if prof.hbm_slices + spec.hbm_start > HBM_SLICES_PER_CHIP:
+            raise DeviceLibError(f"placement {spec} exceeds chip HBM")
+        if not gen.partitionable:
+            raise DeviceLibError(f"generation {gen.name} is not partitionable")
+        for live in self._partitions.values():
+            if self._overlaps(live.spec, spec):
+                raise DeviceLibError(
+                    f"placement {spec} collides with existing partition {live.uuid}"
+                )
+        uuid = f"tpupart-{uuidlib.uuid4().hex[:12]}"
+        live = LivePartition(
+            spec=spec,
+            uuid=uuid,
+            parent_uuid=chip.uuid,
+            dev_paths=[f"/dev/accel{chip.index}"],
+        )
+        self._partitions[uuid] = live
+        self._save_state()
+        return live
+
+    def create_partition(self, spec: PartitionSpec) -> LivePartition:
+        with self._lock:
+            return self._create_unlocked(spec)
+
+    def delete_partition(self, uuid: str) -> None:
+        with self._lock:
+            if uuid not in self._partitions:
+                raise DeviceLibError(f"no partition with uuid {uuid}")
+            del self._partitions[uuid]
+            self._save_state()
+
+    def list_partitions(self) -> list[LivePartition]:
+        with self._lock:
+            return list(self._partitions.values())
+
+    # -- sharing knobs ------------------------------------------------------
+
+    def set_timeslice(self, chip_uuids: list[str], interval: str) -> None:
+        with self._lock:
+            for u in chip_uuids:
+                self.chip_by_uuid(u)  # existence check
+                self._timeslice[u] = interval
+
+    def set_exclusive(self, chip_uuids: list[str], exclusive: bool) -> None:
+        with self._lock:
+            for u in chip_uuids:
+                self.chip_by_uuid(u)
+                self._exclusive[u] = exclusive
+
+    def get_timeslice(self, chip_uuid: str) -> Optional[str]:
+        with self._lock:
+            return self._timeslice.get(chip_uuid)
+
+    def get_exclusive(self, chip_uuid: str) -> bool:
+        with self._lock:
+            return self._exclusive.get(chip_uuid, False)
+
+    # -- health -------------------------------------------------------------
+
+    def inject_health_event(self, event: HealthEvent) -> None:
+        with self._lock:
+            for q in self._health_queues:
+                q.put(event)
+
+    def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._health_queues.append(q)
+        try:
+            while not stop.is_set():
+                try:
+                    yield q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                if q in self._health_queues:
+                    self._health_queues.remove(q)
+
+
+def _parse_profile(name: str) -> PartitionProfile:
+    try:
+        cores_s, hbm_s = name.split(".")
+        return PartitionProfile(int(cores_s.rstrip("c")), int(hbm_s.rstrip("hbm")))
+    except (ValueError, AttributeError):
+        raise DeviceLibError(f"invalid partition profile {name!r}") from None

@@ -1,0 +1,214 @@
+"""TPU chip, host, and slice topology model.
+
+The TPU-native replacement for the reference's NVML device model
+(cmd/gpu-kubelet-plugin/nvlib.go:428-746).  Where a GPU is identified by UUID +
+PCI bus ID, a TPU chip is additionally a *point in an ICI mesh*: its (x, y, z)
+coordinates inside the slice determine which collectives ride ICI versus DCN,
+so they are first-class device attributes (the analog of NVML fabric info's
+clusterUUID/cliqueID, reference compute-domain-kubelet-plugin/nvlib.go:201-356).
+
+Generations follow public Cloud TPU system architecture: chips per host, cores
+per chip, HBM, and whether a chip's TensorCores can be partitioned and used as
+independent accelerators (the MIG analog; v4/v5p have 2 TensorCores per chip,
+v5e/v6e have 1 fused core).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class TpuGenerationSpec:
+    name: str  # "v4", "v5e", "v5p", "v6e"
+    tensorcores_per_chip: int
+    hbm_bytes: int
+    chips_per_host: int
+    # Default host footprint within the ICI mesh, x,y,z (v5p host owns a
+    # 2x2x1 block; v5e host owns 2x4 of a 2D mesh).
+    host_bounds: tuple[int, int, int]
+    peak_bf16_tflops: float
+    partitionable: bool  # can TensorCores be split into separate partitions
+
+
+GENERATIONS: dict[str, TpuGenerationSpec] = {
+    "v4": TpuGenerationSpec("v4", 2, 32 * 2**30, 4, (2, 2, 1), 275.0, True),
+    "v5e": TpuGenerationSpec("v5e", 1, 16 * 2**30, 8, (2, 4, 1), 197.0, False),
+    "v5p": TpuGenerationSpec("v5p", 2, 95 * 2**30, 4, (2, 2, 1), 459.0, True),
+    "v6e": TpuGenerationSpec("v6e", 1, 32 * 2**30, 8, (2, 4, 1), 918.0, False),
+}
+
+# HBM is modeled in fixed slices for partition accounting (the analog of MIG
+# memory slices): each chip's HBM divides into this many equal slices.
+HBM_SLICES_PER_CHIP = 8
+
+
+@dataclass(frozen=True)
+class PartitionProfile:
+    """A supported TensorCore partition shape (the MIG-profile analog).
+
+    name examples (v5p): "1c.4hbm" = 1 TensorCore + 4/8 of HBM,
+    "1c.8hbm" = 1 core with all HBM, "2c.8hbm" = whole chip as a partition.
+    """
+
+    tensorcores: int
+    hbm_slices: int
+
+    @property
+    def name(self) -> str:
+        return f"{self.tensorcores}c.{self.hbm_slices}hbm"
+
+    def placements(self, spec: TpuGenerationSpec) -> list["PartitionPlacement"]:
+        """All placements of this profile on one chip: core_start advances by
+        the core count, hbm_start by the HBM-slice count (MIG placement
+        analog, reference nvlib.go:1129-1209)."""
+        out = []
+        if self.tensorcores > spec.tensorcores_per_chip:
+            return out
+        if self.hbm_slices > HBM_SLICES_PER_CHIP:
+            return out
+        core_starts = range(0, spec.tensorcores_per_chip - self.tensorcores + 1, self.tensorcores)
+        hbm_starts = range(0, HBM_SLICES_PER_CHIP - self.hbm_slices + 1, self.hbm_slices)
+        # Placement = aligned (core block, hbm block) pairs; we pair the i-th
+        # core block with the proportionally aligned HBM block to keep the
+        # partition NUMA-local to its core's HBM stacks.
+        for ci, cs in enumerate(core_starts):
+            for hi, hs in enumerate(hbm_starts):
+                if len(core_starts) > 1 and len(hbm_starts) > 1:
+                    # Align: core block i owns HBM region i's slices only.
+                    per_core = HBM_SLICES_PER_CHIP // spec.tensorcores_per_chip
+                    lo = cs * per_core
+                    hi_end = (cs + self.tensorcores) * per_core
+                    if not (lo <= hs and hs + self.hbm_slices <= hi_end):
+                        continue
+                out.append(PartitionPlacement(self, cs, hs))
+        return out
+
+
+@dataclass(frozen=True)
+class PartitionPlacement:
+    profile: PartitionProfile
+    core_start: int
+    hbm_start: int
+
+
+def partition_profiles(spec: TpuGenerationSpec) -> list[PartitionProfile]:
+    """Supported profiles for a generation.  Non-partitionable generations
+    (single fused core) support none."""
+    if not spec.partitionable:
+        return []
+    profiles = []
+    cores = spec.tensorcores_per_chip
+    c = 1
+    while c <= cores:
+        h = HBM_SLICES_PER_CHIP // (cores // c)
+        # Each core count supports its proportional HBM share and every
+        # larger power-of-two share up to the full chip.
+        while h <= HBM_SLICES_PER_CHIP:
+            profiles.append(PartitionProfile(c, h))
+            h *= 2
+        c *= 2
+    return profiles
+
+
+@dataclass
+class TpuChip:
+    """One physical TPU chip on this host."""
+
+    index: int  # host-local index; device node /dev/accel<index>
+    uuid: str
+    generation: str
+    coords: tuple[int, int, int]  # ICI mesh coordinates within the slice
+    pci_address: str
+    # Fabric identity: "<slice_uuid>.<partition_id>" — chips that share it are
+    # ICI-connected (the clusterUUID.cliqueID analog).
+    clique_id: str
+    hbm_bytes: int = 0
+    tensorcores: int = 0
+
+    @property
+    def spec(self) -> TpuGenerationSpec:
+        return GENERATIONS[self.generation]
+
+    def dev_paths(self) -> list[str]:
+        # Cloud TPU VMs expose both the accel and vfio-style nodes; the accel
+        # node is the canonical one for libtpu.
+        return [f"/dev/accel{self.index}"]
+
+
+@dataclass
+class SliceTopology:
+    """The slice this host belongs to, as visible from the host."""
+
+    slice_uuid: str
+    partition_id: int
+    mesh_shape: tuple[int, int, int]  # full slice mesh, e.g. v5p-16 = (2,2,2)
+    host_index: int  # this host's index within the slice
+    num_hosts: int
+
+    @property
+    def clique_id(self) -> str:
+        return f"{self.slice_uuid}.{self.partition_id}"
+
+
+@dataclass
+class MockTopologyConfig:
+    """Config for the mock backend (our hermetic-CI replacement for the fake
+    NVML backend the reference never had; see SURVEY.md §4.3)."""
+
+    generation: str = "v5p"
+    num_chips: Optional[int] = None  # default: chips_per_host for generation
+    slice_uuid: str = "mock-slice-0000"
+    partition_id: int = 0
+    mesh_shape: Optional[tuple[int, int, int]] = None
+    host_index: int = 0
+    num_hosts: int = 1
+    # Pre-existing (static) partitions: list of (chip_index, profile_name,
+    # core_start, hbm_start).
+    static_partitions: list = field(default_factory=list)
+
+    @classmethod
+    def from_json(cls, text: str) -> "MockTopologyConfig":
+        data = json.loads(text)
+        if "mesh_shape" in data and data["mesh_shape"] is not None:
+            data["mesh_shape"] = tuple(data["mesh_shape"])
+        data["static_partitions"] = [tuple(p) for p in data.get("static_partitions", [])]
+        return cls(**data)
+
+    def resolve(self) -> tuple[TpuGenerationSpec, int, tuple[int, int, int]]:
+        spec = GENERATIONS[self.generation]
+        num = self.num_chips if self.num_chips is not None else spec.chips_per_host
+        if self.mesh_shape is not None:
+            mesh = self.mesh_shape
+        else:
+            hb = spec.host_bounds
+            mesh = (hb[0], hb[1], hb[2] * self.num_hosts)
+        return spec, num, mesh
+
+
+def chip_coords_for_host(
+    spec: TpuGenerationSpec, host_index: int, num_chips: int
+) -> list[tuple[int, int, int]]:
+    """Lay this host's chips out in its block of the slice mesh.  Hosts stack
+    along z (v5p) or y (2D generations)."""
+    hb = spec.host_bounds
+    if num_chips > hb[0] * hb[1] * hb[2]:
+        # Overflowing the host's mesh block would collide with the next
+        # host's coordinates; real hosts never exceed their block.
+        raise ValueError(
+            f"num_chips={num_chips} exceeds the {spec.name} host block "
+            f"{hb[0]}x{hb[1]}x{hb[2]}"
+        )
+    coords = []
+    base_z = host_index * hb[2]
+    i = 0
+    for z in range(hb[2]):
+        for y in range(hb[1]):
+            for x in range(hb[0]):
+                if i >= num_chips:
+                    return coords
+                coords.append((x, y, base_z + z))
+                i += 1
+    return coords

@@ -1,0 +1,204 @@
+"""Allocatable-device model for the TPU kubelet plugin.
+
+The analog of gpu-kubelet-plugin/{allocatable,deviceinfo,mig}.go: a tagged
+union of everything this node can advertise —
+
+- full TPU chips                      canonical name ``tpu-<index>``
+- static TensorCore partitions        ``tpu-<index>-part-<profile>-<core>-<hbm>``
+- dynamic (abstract) partitions       same name; created during Prepare
+- VFIO passthrough functions          ``tpu-vfio-<index>``
+
+plus conversion to resource.k8s.io Device entries with TPU-native attributes:
+uuid, productName, tpuGeneration, ICI mesh coordinates (coordX/Y/Z), cliqueID,
+and capacities (hbm, tensorcores, hbm-slice-* counters for partitioning).
+The ICI coordinates are what let a workload (or scheduler CEL expression)
+reason about fabric locality — the TPU analog of the reference's
+pciBusID/architecture attributes (deviceinfo.go:159-269).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra.devicelib import (
+    HBM_SLICES_PER_CHIP,
+    LivePartition,
+    PartitionSpec,
+    TpuChip,
+)
+from tpudra.devicelib.topology import PartitionPlacement
+
+TYPE_CHIP = "chip"
+TYPE_PARTITION_STATIC = "partition-static"
+TYPE_PARTITION_DYNAMIC = "partition-dynamic"
+TYPE_VFIO = "vfio"
+
+_PART_NAME_RE = re.compile(
+    r"^tpu-(?P<index>\d+)-part-(?P<cores>\d+)c\.(?P<hbm>\d+)hbm-(?P<core_start>\d+)-(?P<hbm_start>\d+)$"
+)
+
+
+def chip_name(index: int) -> str:
+    return f"tpu-{index}"
+
+
+def partition_name(spec: PartitionSpec) -> str:
+    return (
+        f"tpu-{spec.parent_index}-part-{spec.profile}-{spec.core_start}-{spec.hbm_start}"
+    )
+
+
+def vfio_name(index: int) -> str:
+    return f"tpu-vfio-{index}"
+
+
+def parse_partition_name(name: str) -> Optional[PartitionSpec]:
+    m = _PART_NAME_RE.match(name)
+    if not m:
+        return None
+    return PartitionSpec(
+        parent_index=int(m.group("index")),
+        profile=f"{m.group('cores')}c.{m.group('hbm')}hbm",
+        core_start=int(m.group("core_start")),
+        hbm_start=int(m.group("hbm_start")),
+    )
+
+
+@dataclass
+class AllocatableDevice:
+    """One advertisable device (allocatable.go:39 tagged-union analog)."""
+
+    type: str
+    name: str
+    chip: TpuChip  # the chip itself, or the parent chip for partitions/vfio
+    partition_spec: Optional[PartitionSpec] = None
+    live_partition: Optional[LivePartition] = None  # static partitions only
+    vfio_index: Optional[int] = None
+
+    @property
+    def is_partition(self) -> bool:
+        return self.type in (TYPE_PARTITION_STATIC, TYPE_PARTITION_DYNAMIC)
+
+    # -- ResourceSlice conversion (deviceinfo.go GetDevice analog) ----------
+
+    def attributes(self) -> dict[str, dict]:
+        chip = self.chip
+        attrs = {
+            "type": {"string": self.type},
+            "uuid": {"string": chip.uuid},
+            "productName": {"string": f"tpu-{chip.generation}"},
+            "tpuGeneration": {"string": chip.generation},
+            "index": {"int": chip.index},
+            "pcieAddress": {"string": chip.pci_address},
+            "coordX": {"int": chip.coords[0]},
+            "coordY": {"int": chip.coords[1]},
+            "coordZ": {"int": chip.coords[2]},
+            "cliqueID": {"string": chip.clique_id},
+        }
+        if self.partition_spec is not None:
+            attrs["profile"] = {"string": self.partition_spec.profile}
+            attrs["coreStart"] = {"int": self.partition_spec.core_start}
+            attrs["hbmStart"] = {"int": self.partition_spec.hbm_start}
+            if self.live_partition is not None:
+                attrs["uuid"] = {"string": self.live_partition.uuid}
+                attrs["parentUUID"] = {"string": chip.uuid}
+        if self.type == TYPE_VFIO:
+            attrs["addressingMode"] = {"string": "vfio-pci"}
+        return attrs
+
+    def capacity(self) -> dict[str, dict]:
+        chip = self.chip
+        if self.is_partition:
+            spec = self.partition_spec
+            cores, hbm_slices = _profile_counts(spec.profile)
+            hbm = chip.hbm_bytes * hbm_slices // HBM_SLICES_PER_CHIP
+            return {
+                "tensorcores": {"value": str(cores)},
+                "hbm": {"value": str(hbm)},
+            }
+        return {
+            "tensorcores": {"value": str(chip.tensorcores)},
+            "hbm": {"value": str(chip.hbm_bytes)},
+        }
+
+    def to_resource_device(self) -> dict:
+        """resource.k8s.io/v1 Device (flat, non-partitionable form)."""
+        return {
+            "name": self.name,
+            "attributes": self.attributes(),
+            "capacity": self.capacity(),
+        }
+
+
+def _profile_counts(profile: str) -> tuple[int, int]:
+    cores_s, hbm_s = profile.split(".")
+    return int(cores_s.rstrip("c")), int(hbm_s.rstrip("hbm"))
+
+
+def build_allocatable(
+    chips: list[TpuChip],
+    static_partitions: list[LivePartition],
+    dynamic_placements: dict[int, list[PartitionPlacement]] | None = None,
+    with_vfio: bool = False,
+) -> dict[str, AllocatableDevice]:
+    """Assemble the full allocatable map (enumerateAllPossibleDevices analog,
+    nvlib.go:170).
+
+    Chips with *static* partitions advertise the partitions instead of the
+    whole chip; with dynamic partitioning, abstract partitions are advertised
+    alongside the full chip and the KEP-4815 counters arbitrate.  VFIO aliases
+    advertise the same silicon for passthrough (siblings; only one of the
+    alias pair is ever prepared, allocatable.go:238).
+    """
+    out: dict[str, AllocatableDevice] = {}
+    chips_by_index = {c.index: c for c in chips}
+    statically_partitioned = set()
+    for live in static_partitions:
+        chip = chips_by_index[live.spec.parent_index]
+        statically_partitioned.add(chip.index)
+        dev = AllocatableDevice(
+            type=TYPE_PARTITION_STATIC,
+            name=partition_name(live.spec),
+            chip=chip,
+            partition_spec=live.spec,
+            live_partition=live,
+        )
+        out[dev.name] = dev
+    for chip in chips:
+        if chip.index in statically_partitioned:
+            continue
+        dev = AllocatableDevice(type=TYPE_CHIP, name=chip_name(chip.index), chip=chip)
+        out[dev.name] = dev
+        for placement in (dynamic_placements or {}).get(chip.index, []):
+            spec = PartitionSpec(
+                parent_index=chip.index,
+                profile=placement.profile.name,
+                core_start=placement.core_start,
+                hbm_start=placement.hbm_start,
+            )
+            pdev = AllocatableDevice(
+                type=TYPE_PARTITION_DYNAMIC,
+                name=partition_name(spec),
+                chip=chip,
+                partition_spec=spec,
+            )
+            out[pdev.name] = pdev
+        if with_vfio:
+            vdev = AllocatableDevice(
+                type=TYPE_VFIO,
+                name=vfio_name(chip.index),
+                chip=chip,
+                vfio_index=chip.index,
+            )
+            out[vdev.name] = vdev
+    return out
+
+
+def pool_name(node_name: str) -> str:
+    return node_name
+
+
+DRIVER_NAME = TPU_DRIVER_NAME

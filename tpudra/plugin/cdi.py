@@ -1,0 +1,182 @@
+"""CDI (Container Device Interface) spec management for TPU claims.
+
+The analog of gpu-kubelet-plugin/cdi.go: for every prepared claim we write a
+transient CDI spec file into the CDI root (/var/run/cdi), which the container
+runtime (containerd with enable_cdi) resolves into device nodes, env vars, and
+mounts inside the workload container (reference cdi.go:194-304).
+
+TPU container wiring is env-first: libtpu discovers chips from /dev/accel* and
+is *restricted* via env (no nvidia-cdi-hook binary needed, SURVEY.md §2 native
+boundary table):
+
+- TPU_VISIBLE_DEVICES=<host-local chip indices>   restrict to granted chips
+- TPU_CHIPS_PER_HOST_BOUNDS / TPU_HOST_BOUNDS     host/slice footprint
+- TPUDRA_CHIP_COORDS=<x,y,z;...>                  ICI coords of granted chips
+- TPUDRA_CLIQUE_ID=<sliceUuid.partition>          fabric identity
+- TPU_WORKER_ID / TPU_WORKER_HOSTNAMES            multi-host rendezvous
+  (written by the ComputeDomain path)
+
+So a JAX process in the container sees exactly the granted chips in
+jax.devices(), with topology attributes for mesh construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+CDI_VERSION = "0.6.0"
+
+# Vendor/class for transient per-claim specs (reference cdi.go:
+# "k8s.gpu.nvidia.com/claim").
+CDI_VENDOR = "k8s.tpu.google.com"
+CDI_CLASS = "claim"
+CDI_KIND = f"{CDI_VENDOR}/{CDI_CLASS}"
+
+
+@dataclass
+class ContainerEdits:
+    """A subset of CDI containerEdits that our devices need."""
+
+    env: list[str] = field(default_factory=list)
+    device_nodes: list[str] = field(default_factory=list)
+    mounts: list[tuple[str, str]] = field(default_factory=list)  # (host, container)
+    hooks: list[dict] = field(default_factory=list)
+
+    def merge(self, other: "ContainerEdits") -> "ContainerEdits":
+        return ContainerEdits(
+            env=self.env + other.env,
+            device_nodes=self.device_nodes + other.device_nodes,
+            mounts=self.mounts + other.mounts,
+            hooks=self.hooks + other.hooks,
+        )
+
+    def to_cdi(self) -> dict:
+        out: dict = {}
+        if self.env:
+            out["env"] = list(self.env)
+        if self.device_nodes:
+            out["deviceNodes"] = [{"path": p} for p in self.device_nodes]
+        if self.mounts:
+            out["mounts"] = [
+                {
+                    "hostPath": h,
+                    "containerPath": c,
+                    "options": ["rw", "nosuid", "nodev", "bind"],
+                }
+                for h, c in self.mounts
+            ]
+        if self.hooks:
+            out["hooks"] = list(self.hooks)
+        return out
+
+
+class CDIHandler:
+    """Writes/removes per-claim transient CDI spec files
+    (reference CDIHandler, cdi.go:50)."""
+
+    def __init__(self, cdi_root: str, driver_root: str = "/"):
+        self._cdi_root = cdi_root
+        self._driver_root = driver_root.rstrip("/") or "/"
+        os.makedirs(cdi_root, exist_ok=True)
+
+    # -- naming -------------------------------------------------------------
+
+    @staticmethod
+    def claim_device_name(claim_uid: str, device_name: str) -> str:
+        return f"{claim_uid}-{device_name}"
+
+    @staticmethod
+    def qualified_device_id(claim_uid: str, device_name: str) -> str:
+        """The CDI device ID returned to kubelet (reference cdi.go:321)."""
+        return f"{CDI_KIND}={CDIHandler.claim_device_name(claim_uid, device_name)}"
+
+    def spec_path(self, claim_uid: str) -> str:
+        return os.path.join(self._cdi_root, f"{CDI_VENDOR}-claim_{claim_uid}.json")
+
+    def host_path(self, path: str) -> str:
+        """Translate a device path for a containerized driver root
+        (reference driver-root transform, cdi.go/cdioptions.go)."""
+        if self._driver_root == "/":
+            return path
+        return self._driver_root + path
+
+    # -- spec files ---------------------------------------------------------
+
+    def create_claim_spec_file(
+        self,
+        claim_uid: str,
+        device_edits: dict[str, ContainerEdits],
+        common_edits: Optional[ContainerEdits] = None,
+    ) -> list[str]:
+        """Write the transient spec for a claim; returns qualified CDI IDs.
+
+        ``device_edits`` maps device name → its edits; ``common_edits`` apply
+        to every container consuming any device of the claim (claim-wide env
+        like the clique ID; reference cdi.go:194-304).
+        """
+        devices = []
+        ids = []
+        for device_name, edits in device_edits.items():
+            devices.append(
+                {
+                    "name": self.claim_device_name(claim_uid, device_name),
+                    "containerEdits": edits.to_cdi(),
+                }
+            )
+            ids.append(self.qualified_device_id(claim_uid, device_name))
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": CDI_KIND,
+            "devices": devices,
+        }
+        if common_edits is not None:
+            spec["containerEdits"] = common_edits.to_cdi()
+        tmp = self.spec_path(claim_uid) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(spec, f, indent=2)
+        os.replace(tmp, self.spec_path(claim_uid))
+        return ids
+
+    def delete_claim_spec_file(self, claim_uid: str) -> None:
+        try:
+            os.unlink(self.spec_path(claim_uid))
+        except FileNotFoundError:
+            pass
+
+    def read_claim_spec(self, claim_uid: str) -> Optional[dict]:
+        try:
+            with open(self.spec_path(claim_uid)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def list_claim_uids(self) -> list[str]:
+        """Claim UIDs that currently have spec files (startup GC input)."""
+        prefix = f"{CDI_VENDOR}-claim_"
+        out = []
+        for name in os.listdir(self._cdi_root):
+            if name.startswith(prefix) and name.endswith(".json"):
+                out.append(name[len(prefix) : -len(".json")])
+        return out
+
+
+def chip_edits(chips: list, driver_root_transform=None) -> ContainerEdits:
+    """Container edits granting a set of TpuChip objects: device nodes plus
+    the env that restricts libtpu/JAX to exactly those chips."""
+    transform = driver_root_transform or (lambda p: p)
+    indices = sorted(c.index for c in chips)
+    coords = [c.coords for c in sorted(chips, key=lambda c: c.index)]
+    edits = ContainerEdits(
+        env=[
+            "TPU_VISIBLE_DEVICES=" + ",".join(str(i) for i in indices),
+            "TPUDRA_CHIP_COORDS=" + ";".join(",".join(map(str, xyz)) for xyz in coords),
+        ],
+        device_nodes=[transform(p) for c in chips for p in c.dev_paths()],
+    )
+    if chips:
+        edits.env.append(f"TPUDRA_CLIQUE_ID={chips[0].clique_id}")
+        edits.env.append(f"TPUDRA_GENERATION={chips[0].generation}")
+    return edits

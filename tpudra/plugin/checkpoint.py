@@ -1,0 +1,185 @@
+"""Versioned, checksummed node-local checkpoint.
+
+The analog of gpu-kubelet-plugin/{checkpoint,checkpointv}.go: a JSON file that
+is the node-local source of truth for idempotent prepare, partition teardown,
+channel-conflict detection, and stale-claim GC.  Both V1 and V2 payloads are
+written on every mutation, each with its own checksum, so that *both* driver
+upgrade and downgrade find a checkpoint they can read (reference
+checkpoint.go:10-47, checkpointv.go:24-82).
+
+- V1 (legacy shape): claim UID → prepared device list only.
+- V2: adds per-claim prepare status (PrepareStarted/PrepareCompleted) and the
+  claim's namespace/name (needed by the stale-claim GC to validate claims
+  against the API server by name+UID, reference cleanup.go:150).
+
+Reads prefer V2 and fall back to V1; unknown fields are tolerated (non-strict)
+so checkpoints written by newer drivers parse (reference api.go:54-58).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from tpudra.api import serde
+from tpudra.flock import Flock
+
+PREPARE_STARTED = "PrepareStarted"
+PREPARE_COMPLETED = "PrepareCompleted"
+
+CHECKPOINT_FILE = "checkpoint.json"
+CHECKPOINT_LOCK = "cp.lock"
+
+
+class CheckpointError(Exception):
+    pass
+
+
+class ChecksumMismatch(CheckpointError):
+    pass
+
+
+@dataclass
+class PreparedDevice:
+    """One granted device as recorded in the checkpoint (prepared.go:31)."""
+
+    canonical_name: str = field(default="", metadata={"json": "canonicalName"})
+    type: str = field(default="", metadata={"json": "type"})  # chip|partition|vfio|channel|daemon
+    pool_name: str = field(default="", metadata={"json": "poolName"})
+    request_names: list[str] = field(default_factory=list, metadata={"json": "requestNames"})
+    cdi_device_ids: list[str] = field(default_factory=list, metadata={"json": "cdiDeviceIDs"})
+    # Hardware identity needed for unprepare/rollback: chip uuid, live
+    # partition uuid + spec, vfio PCI address, channel id.
+    attributes: dict[str, str] = field(default_factory=dict, metadata={"json": "attributes"})
+
+
+@dataclass
+class PreparedDeviceGroup:
+    """Devices sharing one resolved config (prepared.go:44), plus the config
+    state needed to undo it (MPS daemon id, timeslice reset, CDI ids)."""
+
+    devices: list[PreparedDevice] = field(default_factory=list, metadata={"json": "devices"})
+    config_state: dict[str, str] = field(default_factory=dict, metadata={"json": "configState"})
+
+
+@dataclass
+class PreparedClaim:
+    uid: str = field(default="", metadata={"json": "uid"})
+    namespace: str = field(default="", metadata={"json": "namespace"})
+    name: str = field(default="", metadata={"json": "name"})
+    status: str = field(default=PREPARE_STARTED, metadata={"json": "status"})
+    groups: list[PreparedDeviceGroup] = field(default_factory=list, metadata={"json": "groups"})
+
+    def all_devices(self) -> list[PreparedDevice]:
+        return [d for g in self.groups for d in g.devices]
+
+
+@dataclass
+class Checkpoint:
+    prepared_claims: dict[str, PreparedClaim] = field(
+        default_factory=dict, metadata={"json": "preparedClaims"}
+    )
+
+
+def _checksum(data: str) -> int:
+    return zlib.crc32(data.encode())
+
+
+def _encode_v2(cp: Checkpoint) -> str:
+    return json.dumps(serde.encode(cp), sort_keys=True)
+
+
+def _decode_v2(data: str) -> Checkpoint:
+    return serde.decode(Checkpoint, json.loads(data), strict=False)
+
+
+def _encode_v1(cp: Checkpoint) -> str:
+    """Legacy shape: uid → flat device list (no status, no claim identity)."""
+    out = {
+        "preparedClaims": {
+            uid: {"devices": [serde.encode(d) for d in claim.all_devices()]}
+            for uid, claim in cp.prepared_claims.items()
+        }
+    }
+    return json.dumps(out, sort_keys=True)
+
+
+def _decode_v1(data: str) -> Checkpoint:
+    raw = json.loads(data)
+    cp = Checkpoint()
+    for uid, entry in raw.get("preparedClaims", {}).items():
+        devices = [
+            serde.decode(PreparedDevice, d, strict=False) for d in entry.get("devices", [])
+        ]
+        # V1 had no explicit status: a claim present in a V1 checkpoint was
+        # fully prepared (started-but-unfinished claims were not persisted).
+        cp.prepared_claims[uid] = PreparedClaim(
+            uid=uid,
+            status=PREPARE_COMPLETED,
+            groups=[PreparedDeviceGroup(devices=devices)],
+        )
+    return cp
+
+
+class CheckpointManager:
+    """Atomic read/write of the dual-version checkpoint file, with a
+    flock-guarded read-mutate-write helper (reference device_state.go:555-582)."""
+
+    def __init__(self, plugin_dir: str):
+        self._path = os.path.join(plugin_dir, CHECKPOINT_FILE)
+        self._lock = Flock(os.path.join(plugin_dir, CHECKPOINT_LOCK))
+        os.makedirs(plugin_dir, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def read(self) -> Checkpoint:
+        """Read the newest readable version; fresh checkpoint if absent."""
+        try:
+            with open(self._path) as f:
+                envelope = json.load(f)
+        except FileNotFoundError:
+            return Checkpoint()
+        except ValueError as e:
+            raise CheckpointError(f"corrupt checkpoint envelope: {e}") from e
+        for version, decode in (("v2", _decode_v2), ("v1", _decode_v1)):
+            entry = envelope.get(version)
+            if not entry:
+                continue
+            data, checksum = entry.get("data", ""), entry.get("checksum")
+            if _checksum(data) != checksum:
+                raise ChecksumMismatch(
+                    f"checkpoint {version} checksum mismatch "
+                    f"(got {checksum}, want {_checksum(data)})"
+                )
+            return decode(data)
+        raise CheckpointError("checkpoint has no readable version")
+
+    def write(self, cp: Checkpoint) -> None:
+        v1, v2 = _encode_v1(cp), _encode_v2(cp)
+        envelope = {
+            "v1": {"data": v1, "checksum": _checksum(v1)},
+            "v2": {"data": v2, "checksum": _checksum(v2)},
+        }
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(envelope, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+
+    def mutate(
+        self, fn: Callable[[Checkpoint], Optional[Checkpoint]], timeout: float = 10.0
+    ) -> Checkpoint:
+        """flock-guarded read-mutate-write: fn may mutate in place (return
+        None) or return a replacement."""
+        with self._lock(timeout=timeout):
+            cp = self.read()
+            out = fn(cp)
+            cp = out if out is not None else cp
+            self.write(cp)
+            return cp

@@ -1,0 +1,78 @@
+"""Stale-claim garbage collection.
+
+The analog of gpu-kubelet-plugin/cleanup.go: kubelet can die between our
+Prepare and its own bookkeeping, leaving claims checkpointed here that no
+longer exist (or were re-created with a new UID) in the API server.  On
+startup and every ``period`` seconds, every checkpointed claim is validated by
+name+UID against the API server; stale ones are unprepared
+(reference cleanup.go:41-213, 10-minute period).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import NotFound
+from tpudra.plugin.device_state import DeviceState
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_PERIOD = 600.0
+
+
+class CheckpointCleanupManager:
+    def __init__(self, kube: KubeAPI, state: DeviceState, period: float = DEFAULT_PERIOD):
+        self._kube = kube
+        self._state = state
+        self._period = period
+        self._thread: threading.Thread | None = None
+
+    def start(self, stop: threading.Event) -> None:
+        self._thread = threading.Thread(
+            target=self._run, args=(stop,), daemon=True, name="checkpoint-cleanup"
+        )
+        self._thread.start()
+
+    def _run(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            try:
+                self.cleanup_once()
+            except Exception:  # noqa: BLE001 — periodic GC must survive
+                logger.exception("checkpoint cleanup pass failed")
+            stop.wait(self._period)
+
+    def cleanup_once(self) -> int:
+        """One validation pass; returns number of stale claims unprepared."""
+        stale = 0
+        for uid, (namespace, name, status) in self._state.prepared_claim_uids().items():
+            if self._is_stale(uid, namespace, name):
+                logger.info(
+                    "unpreparing stale claim %s/%s:%s (status=%s)",
+                    namespace, name, uid, status,
+                )
+                self._state.unprepare(uid)
+                stale += 1
+        return stale
+
+    def _is_stale(self, uid: str, namespace: str, name: str) -> bool:
+        if not namespace or not name:
+            # Pre-V2 checkpoint entries lack identity; leave them for manual
+            # cleanup rather than guessing (reference skips those too).
+            return False
+        try:
+            claim = self._kube.get(gvr.RESOURCE_CLAIMS, name, namespace)
+        except NotFound:
+            return True
+        except Exception as e:  # noqa: BLE001 — apiserver blip: do not GC
+            logger.warning("cannot validate claim %s/%s: %s", namespace, name, e)
+            return False
+        if claim.get("metadata", {}).get("uid") != uid:
+            return True  # same name, different object
+        if claim.get("metadata", {}).get("deletionTimestamp") and not claim.get(
+            "status", {}
+        ).get("allocation"):
+            return True  # deallocated and terminating
+        return False

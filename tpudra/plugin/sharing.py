@@ -1,0 +1,247 @@
+"""Chip-sharing strategy managers.
+
+The analog of gpu-kubelet-plugin/sharing.go:
+
+- TimeSlicingManager: TPUs have no `nvidia-smi compute-policy` knob; the
+  interval is applied as a scheduling hint through the device library (carried
+  to the runtime via env) and recorded for reset on unprepare
+  (reference sharing.go:107-121 sets DEFAULT compute mode + timeslice).
+
+- MultiProcessManager: the MPS analog.  Several processes share one chip,
+  each pinned to an HBM budget and a TensorCore percentage.  Like the
+  reference (sharing.go:123-445), a per-claim *control daemon* Deployment is
+  stamped onto this node; it owns the chip in exclusive mode and brokers
+  client processes through a pipe directory that is CDI-mounted into workload
+  containers together with TPUDRA_MP_* env.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional
+
+import yaml
+
+from tpudra.api.sharing import DEFAULT_TIME_SLICE, MultiProcessConfig, TimeSlicingConfig
+from tpudra.devicelib import DeviceLib
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.plugin.cdi import ContainerEdits
+
+logger = logging.getLogger(__name__)
+
+MP_DAEMON_NAME_PREFIX = "tpu-mp-control-daemon-"
+DEFAULT_TEMPLATE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "templates",
+    "multi-process-daemon.tmpl.yaml",
+)
+
+
+class SharingError(Exception):
+    pass
+
+
+class TimeSlicingManager:
+    """Applies/resets cooperative time-slice intervals on full chips."""
+
+    def __init__(self, devicelib: DeviceLib):
+        self._lib = devicelib
+
+    def set_timeslice(self, chip_uuids: list[str], config: Optional[TimeSlicingConfig]) -> str:
+        interval = DEFAULT_TIME_SLICE
+        if config is not None and config.interval is not None:
+            interval = config.interval
+        self._lib.set_timeslice(chip_uuids, interval)
+        return interval
+
+    def reset(self, chip_uuids: list[str]) -> None:
+        self._lib.set_timeslice(chip_uuids, DEFAULT_TIME_SLICE)
+
+
+class MultiProcessControlDaemon:
+    """One per-claim control daemon (reference MpsControlDaemon, sharing.go:72)."""
+
+    def __init__(
+        self,
+        manager: "MultiProcessManager",
+        claim_uid: str,
+        chip_uuids: list[str],
+        config: MultiProcessConfig,
+    ):
+        self._m = manager
+        self.claim_uid = claim_uid
+        self.chip_uuids = chip_uuids
+        self.config = config
+        self.name = MP_DAEMON_NAME_PREFIX + claim_uid
+
+    @property
+    def pipe_dir(self) -> str:
+        return os.path.join(self._m.pipe_root, self.claim_uid)
+
+    @property
+    def shm_dir(self) -> str:
+        return os.path.join(self._m.pipe_root, self.claim_uid, "shm")
+
+    def start(self) -> None:
+        """Pin chips exclusive and stamp the daemon Deployment onto this node
+        (reference sharing.go:186-291)."""
+        self._m.devicelib.set_exclusive(self.chip_uuids, True)
+        os.makedirs(self.shm_dir, exist_ok=True)
+        limits = self.config.normalized_limits(self.chip_uuids)
+        deployment = self._m.render_template(
+            name=self.name,
+            claim_uid=self.claim_uid,
+            chip_uuids=self.chip_uuids,
+            tensorcore_pct=self.config.default_active_tensorcore_percentage or 100,
+            hbm_limits=limits,
+            pipe_dir=self.pipe_dir,
+        )
+        try:
+            self._m.kube.create(gvr.DEPLOYMENTS, deployment, self._m.namespace)
+        except Exception as e:  # AlreadyExists on retry is fine
+            from tpudra.kube.errors import AlreadyExists
+
+            if not isinstance(e, AlreadyExists):
+                raise
+
+    def assert_ready(self, timeout: float = 30.0, poll: float = 1.0) -> None:
+        """Block until the daemon Deployment reports a ready replica
+        (reference AssertReady, sharing.go:293-349).  Check-first, then a
+        gentle poll — this runs inside NodePrepareResources, and tens of
+        concurrent prepares hammering the apiserver at high frequency would
+        be self-inflicted load."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                dep = self._m.kube.get(gvr.DEPLOYMENTS, self.name, self._m.namespace)
+            except Exception:
+                dep = None
+            if dep and dep.get("status", {}).get("readyReplicas", 0) >= 1:
+                return
+            if time.monotonic() >= deadline:
+                raise SharingError(
+                    f"multi-process control daemon {self.name} not ready after {timeout}s"
+                )
+            time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
+
+    def get_cdi_edits(self) -> ContainerEdits:
+        """Edits injected into every consumer of the claim
+        (reference GetCDIContainerEdits, sharing.go:350-370)."""
+        return ContainerEdits(
+            env=[
+                f"TPUDRA_MP_PIPE_DIRECTORY=/var/run/tpudra/mp/{self.claim_uid}",
+                f"TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE="
+                f"{self.config.default_active_tensorcore_percentage or 100}",
+            ],
+            mounts=[
+                (self.pipe_dir, f"/var/run/tpudra/mp/{self.claim_uid}"),
+                (self.shm_dir, "/dev/shm/tpudra-mp"),
+            ],
+        )
+
+    def stop(self) -> None:
+        from tpudra.kube.errors import NotFound
+
+        try:
+            self._m.kube.delete(gvr.DEPLOYMENTS, self.name, self._m.namespace)
+        except NotFound:
+            pass
+        self._m.devicelib.set_exclusive(self.chip_uuids, False)
+
+
+class MultiProcessManager:
+    def __init__(
+        self,
+        kube: KubeAPI,
+        devicelib: DeviceLib,
+        node_name: str,
+        namespace: str = "tpudra-system",
+        pipe_root: str = "/var/run/tpudra/mp",
+        template_path: str = DEFAULT_TEMPLATE_PATH,
+        image: str = "tpudra/mp-control-daemon:latest",
+    ):
+        self.kube = kube
+        self.devicelib = devicelib
+        self.node_name = node_name
+        self.namespace = namespace
+        self.pipe_root = pipe_root
+        self.template_path = template_path
+        self.image = image
+
+    def new_daemon(
+        self, claim_uid: str, chip_uuids: list[str], config: MultiProcessConfig
+    ) -> MultiProcessControlDaemon:
+        return MultiProcessControlDaemon(self, claim_uid, chip_uuids, config)
+
+    def daemon_for(self, claim_uid: str, chip_uuids: list[str]) -> MultiProcessControlDaemon:
+        """Reconstruct a handle for stop() from checkpoint state."""
+        return MultiProcessControlDaemon(self, claim_uid, chip_uuids, MultiProcessConfig())
+
+    def cleanup_stale(self, valid_claim_uids: set[str]) -> int:
+        """Startup GC: delete control-daemon Deployments on this node whose
+        claim is no longer checkpointed (crash between daemon.start() and
+        checkpoint completion leaks one), and release their chips from
+        exclusive mode."""
+        from tpudra.kube.errors import NotFound
+
+        listing = self.kube.list(
+            gvr.DEPLOYMENTS,
+            namespace=self.namespace,
+            label_selector=(
+                "app.kubernetes.io/name=tpu-mp-control-daemon,"
+                f"tpu.google.com/node={self.node_name}"
+            ),
+        )
+        removed = 0
+        for dep in listing.get("items", []):
+            uid = dep["metadata"].get("labels", {}).get("tpu.google.com/claim-uid", "")
+            if uid in valid_claim_uids:
+                continue
+            chip_uuids = []
+            for c in dep.get("spec", {}).get("template", {}).get("spec", {}).get(
+                "containers", []
+            ):
+                for env in c.get("env", []):
+                    if env.get("name") == "TPUDRA_MP_CHIP_UUIDS" and env.get("value"):
+                        chip_uuids = env["value"].split(",")
+            logger.info("removing stale mp control daemon %s", dep["metadata"]["name"])
+            try:
+                self.kube.delete(gvr.DEPLOYMENTS, dep["metadata"]["name"], self.namespace)
+            except NotFound:
+                pass
+            if chip_uuids:
+                try:
+                    self.devicelib.set_exclusive(chip_uuids, False)
+                except Exception:  # noqa: BLE001 — chips may be gone
+                    logger.warning("could not release chips %s", chip_uuids)
+            removed += 1
+        return removed
+
+    def render_template(
+        self,
+        name: str,
+        claim_uid: str,
+        chip_uuids: list[str],
+        tensorcore_pct: int,
+        hbm_limits: dict[str, str],
+        pipe_dir: str,
+    ) -> dict:
+        """Render templates/multi-process-daemon.tmpl.yaml
+        (reference templates/mps-control-daemon.tmpl.yaml)."""
+        with open(self.template_path) as f:
+            text = f.read()
+        rendered = text.format(
+            name=name,
+            namespace=self.namespace,
+            node_name=self.node_name,
+            claim_uid=claim_uid,
+            image=self.image,
+            chip_uuids=",".join(chip_uuids),
+            tensorcore_pct=tensorcore_pct,
+            hbm_limits=";".join(f"{k}={v}" for k, v in sorted(hbm_limits.items())),
+            pipe_dir=pipe_dir,
+        )
+        return yaml.safe_load(rendered)
