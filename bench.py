@@ -1,0 +1,91 @@
+"""Benchmark: ResourceClaim bind p50 latency through the full driver path.
+
+The BASELINE.json headline metric.  The reference instruments this path
+(t_prep/t_prep_lock_acq log lines, gpu-kubelet-plugin/driver.go:340-386) but
+publishes no numbers; its only hard bound is the e2e suite's 8 s
+pod-time-to-READY ceiling for a single-GPU claim
+(tests/bats/test_gpu_basic.bats:33).  We therefore report
+``vs_baseline = 8000 ms / p50_ms`` — how many times faster than the
+reference's accepted worst case one full bind is.
+
+What one iteration measures (the gpu-test1 single-chip claim analog, end to
+end through every real layer of this driver):
+
+  DRA unix-socket RPC → node-global flock → checkpoint RMW (flock + dual
+  version write) → overlap validation → device prepare → transient CDI spec
+  write → checkpoint complete → RPC response … then the matching unprepare.
+
+Run: ``python bench.py`` — prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import tempfile
+import time
+
+ITERS = 200
+WARMUP = 10
+BASELINE_BIND_MS = 8000.0  # reference e2e bound, test_gpu_basic.bats:33
+
+
+def main() -> None:
+    from tests.test_device_state import mk_claim
+    from tpudra.devicelib import MockTopologyConfig
+    from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.kube.fake import FakeKube
+    from tpudra.plugin.draserver import UnixRPCClient
+    from tpudra.plugin.driver import Driver, DriverConfig
+
+    with tempfile.TemporaryDirectory() as tmp:
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5p"),
+            state_file=f"{tmp}/hw.json",
+        )
+        driver = Driver(
+            DriverConfig(
+                node_name="bench-node",
+                plugin_dir=f"{tmp}/plugin",
+                registry_dir=f"{tmp}/registry",
+                cdi_root=f"{tmp}/cdi",
+            ),
+            FakeKube(),
+            lib,
+        )
+        driver.start()
+        client = UnixRPCClient(driver.sockets.dra_socket_path)
+        try:
+            samples_ms: list[float] = []
+            for i in range(ITERS + WARMUP):
+                uid = f"bench-{i}"
+                claim = mk_claim(uid, [f"tpu-{i % 4}"])
+                t0 = time.perf_counter()
+                resp = client.call("NodePrepareResources", {"claims": [claim]})
+                dt = (time.perf_counter() - t0) * 1000.0
+                result = resp["claims"][uid]
+                if "error" in result:
+                    raise RuntimeError(f"prepare failed: {result['error']}")
+                client.call("NodeUnprepareResources", {"claims": [{"uid": uid}]})
+                if i >= WARMUP:
+                    samples_ms.append(dt)
+            p50 = statistics.median(samples_ms)
+        finally:
+            client.close()
+            driver.stop()
+
+    print(
+        json.dumps(
+            {
+                "metric": "resourceclaim_bind_p50_latency",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_BIND_MS / p50, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,6 +12,13 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import pytest  # noqa: E402
 
+import jax  # noqa: E402
+
+# The axon sitecustomize force-registers a TPU platform through jax.config
+# (which outranks the env var) — pin the config back so tests get the
+# virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
 from tpudra import featuregates  # noqa: E402
 
 

@@ -1,0 +1,282 @@
+"""Driver layer: plugin sockets, claim fan-in, ResourceSlice publication,
+health-driven republication (reference gpu-kubelet-plugin/driver.go)."""
+
+import threading
+import time
+
+import pytest
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra import featuregates as fg
+from tpudra.devicelib import HealthEvent, HealthEventKind, MockTopologyConfig
+from tpudra.devicelib.mock import MockDeviceLib
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin.draserver import UnixRPCClient
+from tpudra.plugin.driver import Driver, DriverConfig
+from tpudra.plugin.resourceslice import (
+    build_resource_slices,
+    generate_driver_resources,
+)
+
+from tests.test_device_state import mk_claim
+
+
+def mk_driver(tmp_path, kube=None, generation="v5p", k8s_minor=35):
+    lib = MockDeviceLib(
+        config=MockTopologyConfig(generation=generation),
+        state_file=str(tmp_path / "hw.json"),
+    )
+    cfg = DriverConfig(
+        node_name="node-a",
+        plugin_dir=str(tmp_path / "plugin"),
+        registry_dir=str(tmp_path / "registry"),
+        cdi_root=str(tmp_path / "cdi"),
+        k8s_minor=k8s_minor,
+    )
+    return Driver(cfg, kube or FakeKube(), lib)
+
+
+# -- ResourceSlice generation ------------------------------------------------
+
+
+class TestResourceSliceGeneration:
+    def test_flat_pool_devices(self, tmp_path):
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(d.state.allocatable, node_name="node-a")
+        assert not res.partitionable
+        names = [dev["name"] for dev in res.devices]
+        assert "tpu-0" in names and "tpu-3" in names
+        chip = next(dev for dev in res.devices if dev["name"] == "tpu-0")
+        assert chip["attributes"]["tpuGeneration"]["string"] == "v5p"
+        assert "coordX" in chip["attributes"]
+        assert "consumesCounters" not in chip
+
+    def test_partitionable_counters(self, tmp_path):
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable, partitionable=True, node_name="node-a"
+        )
+        # One CounterSet per chip (v5p host: 4 chips).
+        assert len(res.shared_counters) == 4
+        cs = next(c for c in res.shared_counters if c["name"] == "tpu-0-counters")
+        assert cs["counters"]["tensorcores"]["value"] == "2"
+        assert cs["counters"]["hbm-slice-7"]["value"] == "1"
+        by_name = {dev["name"]: dev for dev in res.devices}
+        # Full chip consumes everything.
+        full = by_name["tpu-0"]["consumesCounters"][0]
+        assert full["counterSet"] == "tpu-0-counters"
+        assert full["counters"]["tensorcores"]["value"] == "2"
+        assert sum(1 for k in full["counters"] if k.startswith("hbm-slice-")) == 8
+        # A half-chip partition consumes its share only.
+        part = by_name["tpu-0-part-1c.4hbm-1-4"]["consumesCounters"][0]
+        assert part["counters"]["tensorcores"]["value"] == "1"
+        assert set(k for k in part["counters"] if k.startswith("hbm-slice-")) == {
+            "hbm-slice-4", "hbm-slice-5", "hbm-slice-6", "hbm-slice-7",
+        }
+
+    def test_unhealthy_chip_withholds_partitions(self, tmp_path):
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable,
+            unhealthy={"tpu-0"},
+            partitionable=True,
+            node_name="node-a",
+        )
+        names = {dev["name"] for dev in res.devices}
+        assert not any(n.startswith("tpu-0") for n in names)
+        assert "tpu-1" in names
+
+    def test_unhealthy_partition_keeps_siblings(self, tmp_path):
+        """Partition-scoped health events withhold only that partition;
+        healthy sibling partitions and other chips stay schedulable."""
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable,
+            unhealthy={"tpu-0-part-1c.4hbm-0-0"},
+            partitionable=True,
+            node_name="node-a",
+        )
+        names = {dev["name"] for dev in res.devices}
+        assert "tpu-0-part-1c.4hbm-0-0" not in names
+        assert "tpu-0-part-1c.4hbm-1-4" in names and "tpu-0" in names
+
+    def test_device_chunking_in_combined_form(self, tmp_path):
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable, partitionable=True, node_name="node-a"
+        )
+        import tpudra.plugin.resourceslice as rs
+
+        old = rs.MAX_DEVICES_PER_SLICE
+        rs.MAX_DEVICES_PER_SLICE = 4
+        try:
+            combined = build_resource_slices(res, "node-a", k8s_minor=34)
+        finally:
+            rs.MAX_DEVICES_PER_SLICE = old
+        assert len(combined) > 1
+        assert all(len(s["spec"]["devices"]) <= 4 for s in combined)
+        assert "sharedCounters" in combined[0]["spec"]
+        assert "sharedCounters" not in combined[1]["spec"]
+
+    def test_split_vs_combined_slices(self, tmp_path):
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable, partitionable=True, node_name="node-a"
+        )
+        split = build_resource_slices(res, "node-a", k8s_minor=35)
+        assert len(split) >= 2
+        assert split[0]["spec"]["sharedCounters"] and not split[0]["spec"]["devices"]
+        assert all(s["spec"]["pool"]["resourceSliceCount"] == len(split) for s in split)
+        combined = build_resource_slices(res, "node-a", k8s_minor=34)
+        assert len(combined) == 1
+        assert combined[0]["spec"]["sharedCounters"] and combined[0]["spec"]["devices"]
+
+
+# -- Driver lifecycle --------------------------------------------------------
+
+
+class TestDriver:
+    def test_publish_creates_and_replaces_slices(self, tmp_path):
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.publish_resources()
+        items = kube.list(gvr.RESOURCE_SLICES)["items"]
+        assert len(items) == 1
+        assert items[0]["spec"]["nodeName"] == "node-a"
+        gen0 = items[0]["spec"]["pool"]["generation"]
+        d.publish_resources()  # idempotent update, generation bumps
+        items = kube.list(gvr.RESOURCE_SLICES)["items"]
+        assert len(items) == 1
+        assert items[0]["spec"]["pool"]["generation"] == gen0 + 1
+
+    def test_prepare_unprepare_roundtrip(self, tmp_path):
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        claim = mk_claim("uid-1", ["tpu-0"])
+        resp = d.prepare_resource_claims([claim])
+        devs = resp["claims"]["uid-1"]["devices"]
+        assert devs[0]["deviceName"] == "tpu-0"
+        assert devs[0]["cdiDeviceIDs"]
+        resp = d.unprepare_resource_claims([{"uid": "uid-1"}])
+        assert resp["claims"]["uid-1"] == {}
+
+    def test_prepare_error_marked_permanent(self, tmp_path):
+        d = mk_driver(tmp_path)
+        claim = mk_claim("uid-1", ["tpu-99"])  # not allocatable
+        resp = d.prepare_resource_claims([claim])
+        assert resp["claims"]["uid-1"]["permanent"] is True
+
+    def test_sockets_serve_dra_protocol(self, tmp_path):
+        d = mk_driver(tmp_path)
+        d.start()
+        try:
+            reg = UnixRPCClient(d.sockets.registration_socket_path)
+            info = reg.call("GetInfo")
+            assert info["name"] == TPU_DRIVER_NAME
+            assert info["endpoint"] == d.sockets.dra_socket_path
+            reg.call("NotifyRegistrationStatus", {"pluginRegistered": True})
+            assert d.sockets.registered
+            reg.close()
+
+            dra = UnixRPCClient(d.sockets.dra_socket_path)
+            resp = dra.call(
+                "NodePrepareResources", {"claims": [mk_claim("uid-s", ["tpu-1"])]}
+            )
+            assert resp["claims"]["uid-s"]["devices"][0]["deviceName"] == "tpu-1"
+            resp = dra.call("NodeUnprepareResources", {"claims": [{"uid": "uid-s"}]})
+            assert resp["claims"]["uid-s"] == {}
+            dra.close()
+        finally:
+            d.stop()
+
+    def test_health_event_republishes_without_device(self, tmp_path):
+        fg.feature_gates().set_from_map({fg.TPU_DEVICE_HEALTH_CHECK: True})
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            chip0 = d.state._chips_by_index[0]
+            d._lib.inject_health_event(
+                HealthEvent(kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid)
+            )
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if "tpu-0" in d.unhealthy_devices():
+                    break
+                time.sleep(0.01)
+            assert "tpu-0" in d.unhealthy_devices()
+            items = kube.list(gvr.RESOURCE_SLICES)["items"]
+            names = {dev["name"] for s in items for dev in s["spec"]["devices"]}
+            assert "tpu-0" not in names and "tpu-1" in names
+        finally:
+            d.stop()
+
+    def test_vfio_prepare_withholds_sibling_chip(self, tmp_path):
+        from tpudra.plugin.vfio import VfioManager
+
+        from tests.test_device_state import mk_sysfs
+
+        fg.feature_gates().set_from_map({fg.PASSTHROUGH_SUPPORT: True})
+        kube = FakeKube()
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5p"),
+            state_file=str(tmp_path / "hw.json"),
+        )
+        mk_sysfs(tmp_path, lib.enumerate_chips())
+        cfg = DriverConfig(
+            node_name="node-a",
+            plugin_dir=str(tmp_path / "plugin"),
+            registry_dir=str(tmp_path / "registry"),
+            cdi_root=str(tmp_path / "cdi"),
+        )
+        d = Driver(
+            cfg, kube, lib,
+            vfio_manager=VfioManager(sysfs_root=str(tmp_path / "sys")),
+        )
+        d.publish_resources()
+
+        def advertised():
+            items = kube.list(gvr.RESOURCE_SLICES)["items"]
+            return {dev["name"] for s in items for dev in s["spec"]["devices"]}
+
+        assert {"tpu-0", "tpu-vfio-0"} <= advertised()
+        claim = mk_claim("uid-v", ["tpu-vfio-0"], configs=[
+            {
+                "source": "FromClaim",
+                "requests": [],
+                "opaque": {
+                    "driver": TPU_DRIVER_NAME,
+                    "parameters": {
+                        "apiVersion": "resource.tpu.google.com/v1beta1",
+                        "kind": "VfioDeviceConfig",
+                    },
+                },
+            }
+        ])
+        resp = d.prepare_resource_claims([claim])
+        assert "error" not in resp["claims"]["uid-v"], resp
+        names = advertised()
+        assert "tpu-0" not in names, "bound sibling chip must be withheld"
+        assert "tpu-vfio-0" in names and "tpu-1" in names
+        d.unprepare_resource_claims([{"uid": "uid-v"}])
+        assert "tpu-0" in advertised(), "sibling visible again after unprepare"
+
+    def test_ignored_health_kind_keeps_device(self, tmp_path):
+        fg.feature_gates().set_from_map({fg.TPU_DEVICE_HEALTH_CHECK: True})
+        d = mk_driver(tmp_path)
+        d.start()
+        try:
+            chip0 = d.state._chips_by_index[0]
+            d._lib.inject_health_event(
+                HealthEvent(kind=HealthEventKind.ICI_LINK_DOWN, chip_uuid=chip0.uuid)
+            )
+            time.sleep(0.2)
+            assert d.unhealthy_devices() == set()
+        finally:
+            d.stop()

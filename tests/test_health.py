@@ -1,0 +1,59 @@
+"""Healthcheck self-probe service (reference gpu-kubelet-plugin/health.go)."""
+
+import json
+import urllib.request
+
+from tpudra.plugin.health import Healthcheck
+
+from tests.test_driver import mk_driver
+
+
+def fetch(port: int, path: str = "/healthz"):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHealthcheck:
+    def test_healthy_when_sockets_serving(self, tmp_path):
+        d = mk_driver(tmp_path)
+        d.start()
+        hc = Healthcheck(d.sockets)
+        hc.start()
+        try:
+            status, body = fetch(hc.port)
+            assert status == 200 and body["healthy"]
+        finally:
+            hc.stop()
+            d.stop()
+
+    def test_unhealthy_when_dra_socket_gone(self, tmp_path):
+        d = mk_driver(tmp_path)
+        d.start()
+        hc = Healthcheck(d.sockets)
+        hc.start()
+        try:
+            d.sockets._dra.stop()  # simulate a wedged/dead DRA server
+            status, body = fetch(hc.port)
+            assert status == 503 and not body["healthy"]
+            assert "DRA socket" in body["detail"]
+        finally:
+            hc.stop()
+            d.stop()
+
+    def test_404_off_path(self, tmp_path):
+        d = mk_driver(tmp_path)
+        d.start()
+        hc = Healthcheck(d.sockets)
+        hc.start()
+        try:
+            status, _ = fetch(hc.port, "/nope")
+        except Exception:
+            status = 404
+        finally:
+            hc.stop()
+            d.stop()
+        assert status == 404

@@ -235,6 +235,36 @@ class DeviceState:
             uid: (c.namespace, c.name, c.status) for uid, c in cp.prepared_claims.items()
         }
 
+    def bound_sibling_devices(self) -> set[str]:
+        """Device names sharing silicon with a prepared passthrough grant —
+        withheld from publication so the scheduler cannot double-book the
+        chip under its other alias (reference allocatable.go:238,
+        device_state.go:252-262,409-421).
+
+        A prepared vfio alias withholds the chip device and its partitions;
+        a prepared chip/partition withholds the chip's vfio alias.
+        """
+        if not self._passthrough:
+            return set()
+        cp = self._cp.read()
+        withheld: set[str] = set()
+        for claim in cp.prepared_claims.values():
+            for dev in claim.all_devices():
+                adev = self.allocatable.get(dev.canonical_name)
+                if adev is None:
+                    continue
+                if adev.type == alloc.TYPE_VFIO:
+                    idx = adev.chip.index
+                    withheld.add(alloc.chip_name(idx))
+                    withheld.update(
+                        n
+                        for n, d in self.allocatable.items()
+                        if d.is_partition and d.chip.index == idx
+                    )
+                else:
+                    withheld.add(alloc.vfio_name(adev.chip.index))
+        return withheld
+
     def destroy_unknown_partitions(self) -> int:
         """Startup reconciliation: with dynamic partitioning, every live
         partition must be explained by the checkpoint; others are destroyed

@@ -1,0 +1,313 @@
+"""The TPU kubelet-plugin driver: claim fan-in, slice publication, health.
+
+The analog of gpu-kubelet-plugin/driver.go:52-554:
+
+- ``prepare_resource_claims``/``unprepare_resource_claims`` fan a kubelet
+  batch into per-claim operations under the node-global ``pu.lock`` flock
+  (driver.go:298-400), with per-stage wall-time instrumentation
+  (t_prep_lock_acq / t_prep — the BASELINE bind-latency hooks).
+- ``publish_resources`` pushes this node's pool as ResourceSlice objects,
+  flat or KEP-4815 partitionable (driver.go:402-554).
+- a health monitor consumes device-lib events and republishes the pool
+  without unhealthy silicon; there is deliberately no auto-reheal — a chip
+  comes back only on plugin restart (driver.go:462-502).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra import TPU_DRIVER_NAME, featuregates
+from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
+from tpudra.flock import Flock, FlockTimeout
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import Conflict, NotFound
+from tpudra.plugin import allocatable as alloc
+from tpudra.plugin.cdi import CDIHandler
+from tpudra.plugin.checkpoint import CheckpointManager
+from tpudra.plugin.cleanup import CheckpointCleanupManager
+from tpudra.plugin.device_state import DeviceState, PermanentError
+from tpudra.plugin.draserver import PluginSockets
+from tpudra.plugin.resourceslice import build_resource_slices, generate_driver_resources
+from tpudra.plugin.sharing import MultiProcessManager
+from tpudra.plugin.vfio import VfioManager
+
+logger = logging.getLogger(__name__)
+
+PU_LOCK = "pu.lock"
+PU_LOCK_TIMEOUT = 10.0  # reference driver.go:341
+
+
+@dataclass
+class DriverConfig:
+    node_name: str
+    plugin_dir: str  # /var/lib/kubelet/plugins/tpu.google.com
+    registry_dir: str  # /var/lib/kubelet/plugins_registry
+    cdi_root: str  # /var/run/cdi
+    driver_root: str = "/"
+    k8s_minor: int = 35
+    device_backend: str = "mock"
+    device_backend_options: dict = field(default_factory=dict)
+    health_ignored_kinds: tuple = HealthEventKind.DEFAULT_IGNORED
+
+
+class Driver:
+    def __init__(
+        self,
+        config: DriverConfig,
+        kube: KubeAPI,
+        devicelib: DeviceLib,
+        mp_manager: Optional[MultiProcessManager] = None,
+        vfio_manager: Optional[VfioManager] = None,
+    ):
+        self._config = config
+        self._kube = kube
+        self._lib = devicelib
+        os.makedirs(config.plugin_dir, exist_ok=True)
+        self._pu_lock = Flock(os.path.join(config.plugin_dir, PU_LOCK))
+        self.state = DeviceState(
+            devicelib,
+            CDIHandler(config.cdi_root, config.driver_root),
+            CheckpointManager(config.plugin_dir),
+            config.node_name,
+            mp_manager=mp_manager,
+            vfio_manager=vfio_manager,
+        )
+        self._unhealthy: set[str] = set()
+        self._unhealthy_lock = threading.Lock()
+        # Serializes the whole snapshot→build→apply publication path: the
+        # health thread and prepare RPC threads both publish, and an
+        # interleaving could re-advertise silicon just marked unhealthy.
+        self._publish_lock = threading.Lock()
+        self._pool_generation = 1
+        self._stop = threading.Event()
+        self._sockets = PluginSockets(
+            TPU_DRIVER_NAME,
+            config.plugin_dir,
+            config.registry_dir,
+            prepare=self.prepare_resource_claims,
+            unprepare=self.unprepare_resource_claims,
+        )
+        self.cleanup = CheckpointCleanupManager(kube, self.state)
+        self._health_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Startup order mirrors the reference (driver.go:66-170): destroy
+        unknown partitions, serve sockets, start health + GC, publish."""
+        if featuregates.enabled(featuregates.DYNAMIC_PARTITIONING):
+            n = self.state.destroy_unknown_partitions()
+            if n:
+                logger.warning("startup reconciliation destroyed %d unknown partitions", n)
+        self._sockets.start()
+        if featuregates.enabled(featuregates.TPU_DEVICE_HEALTH_CHECK):
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True, name="device-health"
+            )
+            self._health_thread.start()
+        self.cleanup.start(self._stop)
+        self.publish_resources()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._sockets.stop()
+        self._lib.close()
+
+    @property
+    def sockets(self) -> PluginSockets:
+        return self._sockets
+
+    # ------------------------------------------------------ prepare/unprepare
+
+    def prepare_resource_claims(self, claims: list[dict]) -> dict:
+        out: dict[str, dict] = {}
+        republish = False
+        for claim in claims:
+            uid = claim.get("metadata", {}).get("uid", "")
+            try:
+                result, vfio = self._prepare_one(claim)
+                out[uid] = result
+                republish = republish or vfio
+            except Exception as e:  # noqa: BLE001 — per-claim fault barrier
+                logger.exception("prepare failed for claim %s", uid)
+                out[uid] = {"error": str(e), "permanent": isinstance(e, PermanentError)}
+        if republish:
+            # Passthrough prepares flip sibling visibility; republish once
+            # per batch so the scheduler stops seeing the bound full-chip
+            # alias (driver.go:361).
+            self.publish_resources()
+        return {"claims": out}
+
+    def unprepare_resource_claims(self, claims: list[dict]) -> dict:
+        out: dict[str, dict] = {}
+        withheld_before = self.state.bound_sibling_devices()
+        for ref in claims:
+            uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
+            try:
+                self._unprepare_one(uid)
+                out[uid] = {}
+            except Exception as e:  # noqa: BLE001
+                logger.exception("unprepare failed for claim %s", uid)
+                out[uid] = {"error": str(e)}
+        if withheld_before and self.state.bound_sibling_devices() != withheld_before:
+            self.publish_resources()  # siblings became visible again
+        return {"claims": out}
+
+    def _prepare_one(self, claim: dict) -> tuple[dict, bool]:
+        t0 = time.monotonic()
+        try:
+            with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+                t_lock = time.monotonic() - t0
+                devices = self.state.prepare(claim)
+        except FlockTimeout as e:
+            raise RuntimeError(f"node prepare lock: {e}") from e
+        logger.info(
+            "t_prep_lock_acq=%.4fs t_prep=%.4fs claim=%s",
+            t_lock, time.monotonic() - t0, claim.get("metadata", {}).get("uid"),
+        )
+        vfio = any(
+            self.state.allocatable.get(d.device_name) is not None
+            and self.state.allocatable[d.device_name].type == alloc.TYPE_VFIO
+            for d in devices
+        )
+        return {
+            "devices": [
+                {
+                    "requestNames": d.request_names,
+                    "poolName": d.pool_name,
+                    "deviceName": d.device_name,
+                    "cdiDeviceIDs": d.cdi_device_ids,
+                }
+                for d in devices
+            ]
+        }, vfio
+
+    def _unprepare_one(self, uid: str) -> None:
+        if not uid:
+            raise PermanentError("claim reference has no uid")
+        t0 = time.monotonic()
+        try:
+            with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+                self.state.unprepare(uid)
+        except FlockTimeout as e:
+            raise RuntimeError(f"node unprepare lock: {e}") from e
+        logger.info("t_unprep=%.4fs claim=%s", time.monotonic() - t0, uid)
+
+    # ---------------------------------------------------------- publication
+
+    def publish_resources(self) -> list[dict]:
+        with self._publish_lock:
+            partitionable = featuregates.enabled(featuregates.DYNAMIC_PARTITIONING)
+            with self._unhealthy_lock:
+                unhealthy = set(self._unhealthy)
+            res = generate_driver_resources(
+                self.state.allocatable,
+                unhealthy=unhealthy,
+                withheld=self.state.bound_sibling_devices(),
+                partitionable=partitionable,
+                node_name=self._config.node_name,
+            )
+            slices = build_resource_slices(
+                res,
+                self._config.node_name,
+                k8s_minor=self._config.k8s_minor,
+                generation=self._pool_generation,
+            )
+            self._pool_generation += 1
+            published_names = {s["metadata"]["name"] for s in slices}
+            for s in slices:
+                self._apply_slice(s)
+            self._delete_stale_slices(published_names)
+            logger.info(
+                "published %d ResourceSlice(s), %d devices, %d unhealthy",
+                len(slices), len(res.devices), len(unhealthy),
+            )
+            return slices
+
+    def _apply_slice(self, obj: dict) -> None:
+        name = obj["metadata"]["name"]
+        for _attempt in range(3):
+            try:
+                existing = self._kube.get(gvr.RESOURCE_SLICES, name)
+            except NotFound:
+                self._kube.create(gvr.RESOURCE_SLICES, obj)
+                return
+            obj["metadata"]["resourceVersion"] = existing["metadata"].get("resourceVersion")
+            try:
+                self._kube.update(gvr.RESOURCE_SLICES, obj)
+                return
+            except Conflict:
+                continue  # re-read the resourceVersion and retry
+        logger.warning("giving up on ResourceSlice %s after repeated conflicts", name)
+
+    def _delete_stale_slices(self, keep: set[str]) -> None:
+        """Remove slices this node published in a previous shape (e.g. the
+        combined form after an upgrade to the split form)."""
+        prefix = f"{self._config.node_name}-{TPU_DRIVER_NAME}-"
+        try:
+            existing = self._kube.list(
+                gvr.RESOURCE_SLICES,
+                field_selector=f"spec.nodeName={self._config.node_name}",
+            )
+        except Exception:  # noqa: BLE001 — publication must not die on list
+            return
+        for item in existing.get("items", []):
+            name = item.get("metadata", {}).get("name", "")
+            if name.startswith(prefix) and name not in keep:
+                try:
+                    self._kube.delete(gvr.RESOURCE_SLICES, name)
+                except NotFound:
+                    pass
+
+    # --------------------------------------------------------------- health
+
+    def _health_loop(self) -> None:
+        for event in self._lib.health_events(self._stop):
+            try:
+                self._handle_health_event(event)
+            except Exception:  # noqa: BLE001
+                logger.exception("handling health event %s", event)
+
+    def _handle_health_event(self, event: HealthEvent) -> None:
+        if event.kind in self._config.health_ignored_kinds:
+            logger.info("ignoring health event %s on %s", event.kind, event.chip_uuid)
+            return
+        names = self._devices_for_event(event)
+        if not names:
+            logger.warning("health event %s for unknown silicon %s", event.kind, event.chip_uuid)
+            return
+        with self._unhealthy_lock:
+            before = set(self._unhealthy)
+            self._unhealthy.update(names)
+            changed = self._unhealthy != before
+        if changed:
+            logger.error(
+                "marking unhealthy after %s (%s): %s — republishing without them",
+                event.kind, event.detail, sorted(names),
+            )
+            self.publish_resources()
+
+    def _devices_for_event(self, event: HealthEvent) -> set[str]:
+        if event.partition_uuid:
+            for name, dev in self.state.allocatable.items():
+                if (
+                    dev.live_partition is not None
+                    and dev.live_partition.uuid == event.partition_uuid
+                ):
+                    return {name}
+        return {
+            name
+            for name, dev in self.state.allocatable.items()
+            if dev.chip.uuid == event.chip_uuid
+        }
+
+    def unhealthy_devices(self) -> set[str]:
+        with self._unhealthy_lock:
+            return set(self._unhealthy)

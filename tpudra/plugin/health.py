@@ -1,0 +1,98 @@
+"""Liveness healthcheck service.
+
+The analog of gpu-kubelet-plugin/health.go:52-150: an HTTP endpoint (the
+reference uses gRPC health v1; the contract — a kubelet liveness probe target —
+is the same) that reports healthy only when the plugin's own sockets actually
+answer:
+
+- the registration socket responds to GetInfo with the right driver name, and
+- the DRA service socket completes a no-op NodePrepareResources.
+
+Probing our own sockets rather than returning a static 200 catches wedged
+RPC threads, a deleted socket file, or a plugin that silently stopped serving.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+from typing import Optional
+
+from tpudra.plugin.draserver import PluginSockets, UnixRPCClient
+
+logger = logging.getLogger(__name__)
+
+
+class Healthcheck:
+    def __init__(self, sockets: PluginSockets, port: int = 0, probe_timeout: float = 5.0):
+        """port 0 picks an ephemeral port (reference: healthcheck disabled
+        with port < 0, main.go flag healthcheck-port)."""
+        self._sockets = sockets
+        self._probe_timeout = probe_timeout
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._port = port
+
+    # -- probe logic --------------------------------------------------------
+
+    def check(self) -> tuple[bool, str]:
+        try:
+            reg = UnixRPCClient(
+                self._sockets.registration_socket_path, timeout=self._probe_timeout
+            )
+            try:
+                info = reg.call("GetInfo")
+            finally:
+                reg.close()
+            if info.get("name") != self._sockets.driver_name:
+                return False, f"registration socket serves {info.get('name')!r}"
+        except Exception as e:  # noqa: BLE001 — any probe failure is unhealthy
+            return False, f"registration socket: {e}"
+        try:
+            dra = UnixRPCClient(self._sockets.dra_socket_path, timeout=self._probe_timeout)
+            try:
+                dra.call("NodePrepareResources", {"claims": []})
+            finally:
+                dra.close()
+        except Exception as e:  # noqa: BLE001
+            return False, f"DRA socket: {e}"
+        return True, "ok"
+
+    # -- HTTP surface -------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> None:
+        check = self.check
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path not in ("/healthz", "/readyz"):
+                    self.send_error(404)
+                    return
+                healthy, detail = check()
+                body = json.dumps({"healthy": healthy, "detail": detail}).encode()
+                self.send_response(200 if healthy else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # noqa: D102
+                logger.debug("healthcheck: " + fmt, *args)
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="healthcheck"
+        ).start()
+        logger.info("healthcheck serving on 127.0.0.1:%d", self._port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

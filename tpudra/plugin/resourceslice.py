@@ -1,0 +1,180 @@
+"""ResourceSlice generation: flat and KEP-4815 partitionable forms.
+
+The analog of gpu-kubelet-plugin/driver.go:402-554 + partitions.go:
+
+- Flat form (pre-1.33 clusters, or DynamicPartitioning off): one pool per
+  node carrying every allocatable device as an independent entry.
+- Partitionable form (KEP-4815): each chip contributes a CounterSet with a
+  ``tensorcores`` counter and one counter per HBM slice; the full-chip device
+  consumes all of them and every abstract dynamic partition consumes its
+  proportional share, giving the scheduler the arithmetic to co-allocate
+  disjoint partitions of one chip and to refuse a partition once the full
+  chip is taken (reference partitions.go:85-307).
+- Split vs combined publication by k8s version: ≥1.35 servers accept devices
+  and counter sets in separate slices of one pool; older servers need the
+  combined single-slice form (reference driver.go:507-540).
+
+Unhealthy devices are filtered out before publication — the republish path
+for health events (reference driver.go:462-502).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra.devicelib import HBM_SLICES_PER_CHIP
+from tpudra.plugin import allocatable as alloc
+from tpudra.plugin.allocatable import AllocatableDevice
+
+
+def counter_set_name(chip_index: int) -> str:
+    return f"tpu-{chip_index}-counters"
+
+
+def _hbm_slice_counter(i: int) -> str:
+    return f"hbm-slice-{i}"
+
+
+def chip_counters(chip) -> dict[str, dict]:
+    """Full capacity of one chip as a counter map: all cores, every slice."""
+    counters = {"tensorcores": {"value": str(chip.tensorcores)}}
+    for i in range(HBM_SLICES_PER_CHIP):
+        counters[_hbm_slice_counter(i)] = {"value": "1"}
+    return counters
+
+
+def device_consumed_counters(dev: AllocatableDevice) -> list[dict]:
+    """What this device drains from its chip's CounterSet
+    (PartConsumesCounters analog, partitions.go:96,263)."""
+    chip = dev.chip
+    if dev.is_partition:
+        spec = dev.partition_spec
+        cores, hbm_slices = alloc._profile_counts(spec.profile)
+        counters = {"tensorcores": {"value": str(cores)}}
+        for i in range(spec.hbm_start, spec.hbm_start + hbm_slices):
+            counters[_hbm_slice_counter(i)] = {"value": "1"}
+    else:
+        counters = chip_counters(chip)
+    return [{"counterSet": counter_set_name(chip.index), "counters": counters}]
+
+
+@dataclass
+class DriverResources:
+    """One pool's worth of publication data for this node."""
+
+    pool_name: str
+    devices: list[dict] = field(default_factory=list)
+    shared_counters: list[dict] = field(default_factory=list)
+    partitionable: bool = False
+
+
+def generate_driver_resources(
+    allocatable: dict[str, AllocatableDevice],
+    unhealthy: set[str] | None = None,
+    withheld: set[str] | None = None,
+    partitionable: bool = False,
+    node_name: str = "",
+) -> DriverResources:
+    """Build the node pool (GenerateDriverResources analog, driver.go:507).
+
+    ``unhealthy`` holds canonical device names to withhold for health.  An
+    unhealthy *chip* (or its vfio alias) also withholds every device sharing
+    that silicon; an unhealthy *partition* withholds only itself, so healthy
+    sibling partitions stay schedulable.  ``withheld`` names are dropped
+    as-is (the bound-sibling set from passthrough prepares).
+    """
+    unhealthy = unhealthy or set()
+    withheld = withheld or set()
+    bad_chips = {
+        allocatable[n].chip.index
+        for n in unhealthy
+        if n in allocatable and not allocatable[n].is_partition
+    }
+    res = DriverResources(
+        pool_name=alloc.pool_name(node_name), partitionable=partitionable
+    )
+    seen_counter_chips: set[int] = set()
+    for name in sorted(allocatable):
+        dev = allocatable[name]
+        if name in unhealthy or name in withheld or dev.chip.index in bad_chips:
+            continue
+        entry = dev.to_resource_device()
+        if partitionable:
+            if dev.chip.index not in seen_counter_chips:
+                seen_counter_chips.add(dev.chip.index)
+                res.shared_counters.append(
+                    {
+                        "name": counter_set_name(dev.chip.index),
+                        "counters": chip_counters(dev.chip),
+                    }
+                )
+            entry["consumesCounters"] = device_consumed_counters(dev)
+        res.devices.append(entry)
+    return res
+
+
+# -- ResourceSlice object assembly ------------------------------------------
+
+MAX_DEVICES_PER_SLICE = 128
+
+
+def build_resource_slices(
+    res: DriverResources,
+    node_name: str,
+    k8s_minor: int = 35,
+    generation: int = 1,
+) -> list[dict]:
+    """Render pool data into resource.k8s.io/v1 ResourceSlice objects.
+
+    ≥1.35: counter sets ride in their own slice, devices chunked across
+    further slices (the reference's "split" form, driver.go:513-527); older
+    servers get one combined slice (driver.go:529-539).
+    """
+    pool = {
+        "name": res.pool_name,
+        "generation": generation,
+        "resourceSliceCount": 1,
+    }
+    common_spec = {
+        "driver": TPU_DRIVER_NAME,
+        "nodeName": node_name,
+        "pool": dict(pool),
+    }
+
+    slices: list[dict] = []
+
+    def add(name_suffix: str, spec_extra: dict) -> None:
+        spec = {k: (dict(v) if isinstance(v, dict) else v) for k, v in common_spec.items()}
+        spec.update(spec_extra)
+        slices.append(
+            {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": f"{node_name}-{TPU_DRIVER_NAME}-{name_suffix}"},
+                "spec": spec,
+            }
+        )
+
+    chunks = [
+        res.devices[i : i + MAX_DEVICES_PER_SLICE]
+        for i in range(0, len(res.devices), MAX_DEVICES_PER_SLICE)
+    ] or [[]]
+    split = res.partitionable and k8s_minor >= 35
+    if split:
+        add("counters", {"sharedCounters": res.shared_counters, "devices": []})
+        for i, chunk in enumerate(chunks):
+            add(f"devices-{i}", {"devices": chunk})
+    else:
+        # Combined form: counters (if any) ride the first device chunk; the
+        # device list is still chunked to respect the 128-devices-per-slice
+        # API cap (resource.k8s.io validation).
+        for i, chunk in enumerate(chunks):
+            spec_extra: dict = {"devices": chunk}
+            if res.partitionable and i == 0:
+                spec_extra["sharedCounters"] = res.shared_counters
+            add(f"devices-{i}", spec_extra)
+
+    for s in slices:
+        s["spec"]["pool"]["resourceSliceCount"] = len(slices)
+    return slices

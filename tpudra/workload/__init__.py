@@ -1,0 +1,19 @@
+"""Workload-side library: what runs *inside* a container that consumed a claim.
+
+The reference has no workload library — its demo pods run raw CUDA/NCCL
+binaries (demo/specs/, tests/bats/test_cd_mnnvl_workload.bats).  The TPU build
+ships one because the contract is richer: the driver injects env
+(TPU_VISIBLE_DEVICES, TPUDRA_CHIP_COORDS, TPUDRA_CLIQUE_ID, ...) describing
+exactly the silicon granted, and this package turns that into a
+``jax.sharding.Mesh`` plus ready-made SPMD workloads:
+
+- envspec:     claim env → device set / mesh construction
+- collectives: ICI bandwidth benchmarks (psum / all-gather / ppermute ring) —
+  the analog of the reference's nickelpie/nvbandwidth e2e assertions
+- model:       a flagship SPMD transformer train step (dp/tp/sp sharded)
+  proving a claimed slice is usable end-to-end
+"""
+
+from tpudra.workload.envspec import ClaimEnv, mesh_from_devices
+
+__all__ = ["ClaimEnv", "mesh_from_devices"]

@@ -1,0 +1,158 @@
+"""ICI collective bandwidth benchmarks.
+
+The TPU-native analog of the reference's ComputeDomain e2e workloads — the
+"nickelpie" NCCL send/recv/broadcast test asserting ``RESULT bandwidth: X
+GB/s`` and the nvbandwidth multinode memcpy assertion
+(tests/bats/test_cd_mnnvl_workload.bats:18-52).  Instead of NCCL binaries,
+these are jitted XLA collectives over a ``Mesh``:
+
+- psum:       all-reduce — the BASELINE.json "JAX psum GB/s" metric
+- all_gather: payload replication along an axis
+- ppermute:   neighbor ring shift — raw single-link ICI bandwidth
+
+Each benchmark is written with ``shard_map`` so the collective is explicit
+(not left to sharding propagation) and compiled once; timing loops run the
+compiled executable with donated buffers to avoid realloc noise.
+
+Bus bandwidth convention matches nccl-tests: all-reduce moves
+``2*(n-1)/n * bytes`` per device, all-gather/permute ``(n-1)/n * bytes`` and
+``bytes`` respectively.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+
+@dataclass
+class BenchResult:
+    op: str
+    payload_bytes: int
+    n_devices: int
+    seconds_per_op: float
+    algo_gbps: float  # payload / time
+    bus_gbps: float  # nccl-tests bus-bandwidth convention
+
+    def line(self) -> str:
+        # The e2e suite greps this (the RESULT-bandwidth assertion analog).
+        return f"RESULT bandwidth: {self.bus_gbps:.2f} GB/s op={self.op} n={self.n_devices}"
+
+
+def _time_compiled(fn, args, iters: int, warmup: int = 2) -> float:
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _mk_operand(mesh, axis: str, elems_per_device: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    x = jnp.arange(n * elems_per_device, dtype=jnp.bfloat16).reshape(n, elems_per_device)
+    return jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+
+
+def bench_psum(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    elems = mib_per_device * 2**20 // 2  # bfloat16
+    x = _mk_operand(mesh, axis, elems)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None)
+    )
+    def allreduce(block):
+        return jax.lax.psum(block, axis_name=axis) * jnp.bfloat16(1.0 / n)
+
+    fn = jax.jit(allreduce)
+    dt = _time_compiled(fn, (x,), iters)
+    payload = elems * 2
+    return BenchResult(
+        op="psum",
+        payload_bytes=payload,
+        n_devices=n,
+        seconds_per_op=dt,
+        algo_gbps=payload / dt / 1e9,
+        bus_gbps=(2 * (n - 1) / n) * payload / dt / 1e9,
+    )
+
+
+def bench_all_gather(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    elems = mib_per_device * 2**20 // 2
+    x = _mk_operand(mesh, axis, elems)
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(None, None))
+    def gather(block):
+        return jax.lax.all_gather(block, axis_name=axis, axis=0).reshape(n, -1)
+
+    fn = jax.jit(gather)
+    dt = _time_compiled(fn, (x,), iters)
+    payload = elems * 2 * n  # each device materializes the full array
+    return BenchResult(
+        op="all_gather",
+        payload_bytes=payload,
+        n_devices=n,
+        seconds_per_op=dt,
+        algo_gbps=payload / dt / 1e9,
+        bus_gbps=((n - 1) / n) * payload / dt / 1e9,
+    )
+
+
+def bench_ppermute_ring(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
+    """Every device sends its whole block to the next ring neighbor — the
+    closest analog to a raw point-to-point ICI link measurement."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    elems = mib_per_device * 2**20 // 2
+    x = _mk_operand(mesh, axis, elems)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
+    def shift(block):
+        return jax.lax.ppermute(block, axis_name=axis, perm=perm)
+
+    fn = jax.jit(shift)
+    dt = _time_compiled(fn, (x,), iters)
+    payload = elems * 2
+    return BenchResult(
+        op="ppermute_ring",
+        payload_bytes=payload,
+        n_devices=n,
+        seconds_per_op=dt,
+        algo_gbps=payload / dt / 1e9,
+        bus_gbps=payload / dt / 1e9,
+    )
+
+
+ALL_BENCHES = {
+    "psum": bench_psum,
+    "all_gather": bench_all_gather,
+    "ppermute_ring": bench_ppermute_ring,
+}
+
+
+def run_all(mesh, axis: str = "data", mib_per_device: int = 8, iters: int = 5):
+    return [fn(mesh, axis=axis, mib_per_device=mib_per_device, iters=iters) for fn in ALL_BENCHES.values()]

@@ -1,0 +1,136 @@
+"""Claim environment → JAX mesh.
+
+Parses the env the TPU plugin's CDI spec injects (plugin/cdi.py chip_edits +
+device_state._write_cdi_spec) and the slice-level env the ComputeDomain
+daemon config injects, and builds the ``jax.sharding.Mesh`` a workload should
+run under.  This is the TPU answer to "the pod sees exactly the granted
+devices": on GPUs the runtime hides device nodes; on TPU the visibility env
+(TPU_VISIBLE_DEVICES) plus ICI coordinates do the same job, and the mesh
+shape follows the granted topology rather than a hardcoded world size.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ClaimEnv:
+    """Everything the driver told this container about its grant."""
+
+    visible_devices: list[int] = field(default_factory=list)
+    coords: list[tuple[int, int, int]] = field(default_factory=list)
+    clique_id: str = ""
+    generation: str = ""
+    # "name=profile@core_start,hbm_start" per granted partition.
+    partitions: dict[str, str] = field(default_factory=dict)
+    # ComputeDomain slice env (set by the CD daemon config, not per-chip).
+    domain_uid: str = ""
+    channel_ids: list[int] = field(default_factory=list)
+    num_hosts: int = 1
+    host_index: int = 0
+    coordinator: str = ""  # host:port for jax.distributed DCN rendezvous
+
+    @classmethod
+    def from_environ(cls, env: Optional[dict] = None) -> "ClaimEnv":
+        env = dict(os.environ if env is None else env)
+        out = cls()
+        vis = env.get("TPU_VISIBLE_DEVICES", "")
+        if vis:
+            out.visible_devices = [int(x) for x in vis.split(",") if x != ""]
+        for xyz in env.get("TPUDRA_CHIP_COORDS", "").split(";"):
+            if xyz:
+                x, y, z = (int(v) for v in xyz.split(","))
+                out.coords.append((x, y, z))
+        out.clique_id = env.get("TPUDRA_CLIQUE_ID", "")
+        out.generation = env.get("TPUDRA_GENERATION", "")
+        for desc in env.get("TPUDRA_PARTITIONS", "").split(";"):
+            if desc and "=" in desc:
+                name, spec = desc.split("=", 1)
+                out.partitions[name] = spec
+        out.domain_uid = env.get("TPUDRA_DOMAIN_UID", "")
+        chans = env.get("TPUDRA_DOMAIN_CHANNELS", "")
+        if chans:
+            out.channel_ids = [int(x) for x in chans.split(",") if x != ""]
+        out.num_hosts = int(env.get("TPUDRA_NUM_HOSTS", "1") or "1")
+        out.host_index = int(env.get("TPUDRA_HOST_INDEX", "0") or "0")
+        out.coordinator = env.get("TPUDRA_COORDINATOR", "")
+        return out
+
+    @property
+    def mesh_bounds(self) -> tuple[int, int, int]:
+        """Bounding box of the granted chips in ICI coordinates — the natural
+        physical mesh shape when the grant is a contiguous block."""
+        if not self.coords:
+            return (0, 0, 0)
+        xs, ys, zs = zip(*self.coords)
+        return (
+            max(xs) - min(xs) + 1,
+            max(ys) - min(ys) + 1,
+            max(zs) - min(zs) + 1,
+        )
+
+    def initialize_distributed(self) -> None:
+        """Join the slice-wide runtime across hosts of a ComputeDomain.
+
+        Multi-host grants carry coordinator/host-count env (written by the CD
+        daemon settings); jax.distributed rides DCN for rendezvous while the
+        compiled collectives ride ICI."""
+        if self.num_hosts <= 1 or not self.coordinator:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator,
+            num_processes=self.num_hosts,
+            process_id=self.host_index,
+        )
+
+
+def mesh_from_devices(
+    axis_names: tuple[str, ...] = ("data",),
+    axis_shape: Optional[tuple[int, ...]] = None,
+    devices=None,
+):
+    """Build a Mesh over the claim's devices.
+
+    Default: one flat axis over everything granted.  ``axis_shape`` factors
+    the device count into named axes (dp/tp/sp/...); the order follows
+    jax.devices() order, which libtpu guarantees matches ICI adjacency for
+    the innermost axis — so put the bandwidth-hungry axis (tp) last.
+    """
+    import jax
+    import numpy as np
+
+    devices = list(jax.devices() if devices is None else devices)
+    if axis_shape is None:
+        axis_shape = (len(devices),)
+        if len(axis_names) != 1:
+            raise ValueError("axis_shape required for multi-axis meshes")
+    n = int(np.prod(axis_shape))
+    if n != len(devices):
+        raise ValueError(f"axis_shape {axis_shape} != {len(devices)} devices")
+    arr = np.asarray(devices).reshape(axis_shape)
+    return jax.sharding.Mesh(arr, axis_names)
+
+
+def factor_devices(n: int, axes: int = 3) -> tuple[int, ...]:
+    """Factor a device count into a balanced shape, largest factor last
+    (innermost = ICI-nearest).  8 → (2, 2, 2); 4 → (1, 2, 2); 1 → (1, 1, 1)."""
+    shape = [1] * axes
+    i = axes - 1
+    remaining = n
+    while remaining > 1:
+        for f in (2, 3, 5, 7):
+            if remaining % f == 0:
+                shape[i] = shape[i] * f
+                remaining //= f
+                break
+        else:
+            shape[i] *= remaining
+            remaining = 1
+        i = (i - 1) if i > 0 else axes - 1
+    shape.sort()
+    return tuple(shape)

@@ -1,0 +1,200 @@
+"""Flagship SPMD workload: a causal-transformer train step over a claim mesh.
+
+The reference validates a multi-node grant by running NCCL benchmarks; the
+TPU build validates it by *training*, because the real acceptance test for a
+claimed slice is "does SPMD compile and step across the granted topology".
+This module is a deliberately small, pure-JAX (no framework) decoder:
+
+- bfloat16 matmuls (MXU-shaped, dims multiples of 128 at real sizes) with
+  float32 accumulation and float32 master params
+- layers stacked and iterated with ``lax.scan`` — one trace regardless of
+  depth, no Python-loop unrolling
+- GSPMD sharding: params tp-sharded (Megatron layout: column-parallel in,
+  row-parallel out), batch dp-sharded, activations seq-sharded (sp) outside
+  the attention core — XLA inserts the all-gathers/reduce-scatters on ICI
+- remat on the layer body trades FLOPs for HBM
+
+Used by __graft_entry__ (single-chip forward + multi-chip dryrun) and by the
+ComputeDomain e2e workload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 256
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 256
+    max_seq: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng, cfg: ModelConfig):
+    import jax
+    import jax.numpy as jnp
+
+    k_emb, k_layers, k_out = jax.random.split(rng, 3)
+
+    def dense(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
+
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    ks = jax.random.split(k_layers, 6)
+    s = D ** -0.5
+    return {
+        "embed": dense(k_emb, (cfg.vocab, D), 0.02),
+        "pos": dense(k_out, (cfg.max_seq, D), 0.02),
+        # Stacked per-layer params, leading axis = layer (scan carries it).
+        "layers": {
+            "wqkv": dense(ks[0], (L, D, 3 * D), s),
+            "wo": dense(ks[1], (L, D, D), s),
+            "w1": dense(ks[2], (L, D, F), s),
+            "w2": dense(ks[3], (L, F, D), F ** -0.5),
+            "ln1": jnp.ones((L, D), jnp.float32),
+            "ln2": jnp.ones((L, D), jnp.float32),
+        },
+        "ln_f": jnp.ones((D,), jnp.float32),
+    }
+
+
+def _rmsnorm(x, scale):
+    import jax
+    import jax.numpy as jnp
+
+    x32 = x.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + 1e-6)
+    return (x32 * inv * scale).astype(x.dtype)
+
+
+def _layer(cfg: ModelConfig, x, layer_params):
+    """One decoder block in bfloat16; x: [B, S, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    p = layer_params
+
+    h = _rmsnorm(x, p["ln1"])
+    qkv = jnp.einsum("bsd,de->bse", h, p["wqkv"].astype(jnp.bfloat16))
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (hd ** -0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
+    x = x + jnp.einsum("bsd,de->bse", attn, p["wo"].astype(jnp.bfloat16))
+
+    h = _rmsnorm(x, p["ln2"])
+    h = jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16))
+    h = jax.nn.gelu(h)
+    h = jnp.einsum("bsf,fd->bsd", h, p["w2"].astype(jnp.bfloat16))
+    return x + h
+
+
+def forward(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → logits [B, S, V] float32."""
+    import jax
+    import jax.numpy as jnp
+
+    B, S = tokens.shape
+    x = params["embed"][tokens].astype(jnp.bfloat16)
+    x = x + params["pos"][:S].astype(jnp.bfloat16)[None]
+
+    layer_body = partial(_layer, cfg)
+    layer_body = jax.checkpoint(layer_body)  # remat: recompute in backward
+
+    def step(x, layer_params):
+        return layer_body(x, layer_params), None
+
+    x, _ = jax.lax.scan(step, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.float32), params["embed"]
+    )
+    return logits
+
+
+def loss_fn(params, tokens, cfg: ModelConfig):
+    import jax
+    import jax.numpy as jnp
+
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: ModelConfig, learning_rate: float = 1e-3):
+    """Returns (init_opt_state, train_step) using optax adamw."""
+    import jax
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return tx.init, train_step
+
+
+# -- sharding layout ---------------------------------------------------------
+
+
+def param_specs(cfg: ModelConfig):
+    """Megatron-style tensor-parallel layout as PartitionSpecs.
+
+    Column-parallel (output dim on tp): wqkv, w1, embed's model dim.
+    Row-parallel (input dim on tp): wo, w2.  Norms replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P(None, "tp"),
+        "pos": P(None, "tp"),
+        "layers": {
+            "wqkv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w1": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+        },
+        "ln_f": P(None),
+    }
+
+
+def batch_spec():
+    """Batch dp-sharded, sequence sp-sharded: long-context inputs split
+    across the sp axis so no single device holds the whole sequence."""
+    from jax.sharding import PartitionSpec as P
+
+    return P("dp", "sp")
+
+
+def shard_params(params, mesh, cfg: ModelConfig):
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: not isinstance(x, dict),
+    )
