@@ -1,0 +1,530 @@
+"""ComputeDomain stack: controller reconcile/teardown, daemon clique
+membership + DNS identity + process supervision, CD plugin prepare gating,
+and the full multi-node lifecycle of SURVEY.md §3.3 — hermetic on FakeKube."""
+
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra.api.computedomain import COMPUTE_DOMAIN_NODE_LABEL
+from tpudra.cddaemon.app import DaemonApp, DaemonConfig
+from tpudra.cddaemon.cdclique import CliqueManager
+from tpudra.cddaemon.dnsnames import DNSNameManager, dns_name
+from tpudra.cddaemon.process import ProcessManager
+from tpudra.cdplugin.driver import CDDriver, CDDriverConfig
+from tpudra.controller import Controller, ManagerConfig
+from tpudra.devicelib import MockTopologyConfig
+from tpudra.devicelib.mock import MockDeviceLib
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+
+NS = "tpudra-system"
+API_V = "resource.tpu.google.com/v1beta1"
+
+
+def wait_for(fn, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def mk_cd(kube, name="cd1", ns="user-ns", num_nodes=2, rct_name="my-channel"):
+    return kube.create(
+        gvr.COMPUTE_DOMAINS,
+        {
+            "apiVersion": API_V,
+            "kind": "ComputeDomain",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "numNodes": num_nodes,
+                "channel": {
+                    "resourceClaimTemplate": {"name": rct_name},
+                    "allocationMode": "Single",
+                },
+            },
+        },
+        ns,
+    )
+
+
+def mk_node(kube, name):
+    return kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
+
+
+# -- controller units --------------------------------------------------------
+
+
+class TestController:
+    def test_reconcile_creates_children(self, tmp_path):
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+
+        uid = cd["metadata"]["uid"]
+        ds = kube.get(gvr.DAEMONSETS, f"computedomain-daemon-{uid}", NS)
+        assert ds["spec"]["template"]["spec"]["nodeSelector"][
+            "resource.tpu.google.com/computeDomain"
+        ] == uid
+        daemon_rct = kube.get(gvr.RESOURCE_CLAIM_TEMPLATES, f"compute-domain-daemon-{uid}", NS)
+        params = daemon_rct["spec"]["spec"]["devices"]["config"][0]["opaque"]["parameters"]
+        assert params["kind"] == "ComputeDomainDaemonConfig"
+        assert params["domainID"] == uid
+        workload_rct = kube.get(gvr.RESOURCE_CLAIM_TEMPLATES, "my-channel", "user-ns")
+        wparams = workload_rct["spec"]["spec"]["devices"]["config"][0]["opaque"]["parameters"]
+        assert wparams["kind"] == "ComputeDomainChannelConfig"
+        assert wparams["allocationMode"] == "Single"
+        # finalizer added
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert "resource.tpu.google.com/computeDomain" in cd["metadata"]["finalizers"]
+
+    def test_reconcile_is_idempotent(self, tmp_path):
+        kube = FakeKube()
+        mk_cd(kube)
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+        c.manager.reconcile("user-ns", "cd1")
+        assert len(kube.list(gvr.DAEMONSETS, NS)["items"]) == 1
+
+    def test_max_nodes_guard(self, tmp_path):
+        kube = FakeKube()
+        mk_cd(kube, num_nodes=64)
+        c = Controller(kube, ManagerConfig(driver_namespace=NS, max_nodes_per_domain=8))
+        c.manager.reconcile("user-ns", "cd1")
+        assert kube.list(gvr.DAEMONSETS, NS)["items"] == []
+
+    def test_teardown_chain_and_finalizer(self, tmp_path):
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        node = mk_node(kube, "node-a")
+        kube.patch(gvr.NODES, "node-a", {"metadata": {"labels": {COMPUTE_DOMAIN_NODE_LABEL: uid}}})
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+        kube.delete(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")  # finalizer → terminating
+        # teardown requires several passes (assert-removed ordering)
+        for _ in range(5):
+            try:
+                c.manager.reconcile("user-ns", "cd1")
+            except Exception:
+                pass
+        assert kube.list(gvr.DAEMONSETS, NS)["items"] == []
+        assert kube.list(gvr.RESOURCE_CLAIM_TEMPLATES, NS)["items"] == []
+        assert kube.list(gvr.RESOURCE_CLAIM_TEMPLATES, "user-ns")["items"] == []
+        node = kube.get(gvr.NODES, "node-a")
+        assert COMPUTE_DOMAIN_NODE_LABEL not in node["metadata"].get("labels", {})
+        with pytest.raises(Exception):
+            kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+
+    def test_status_aggregation_from_cliques(self, tmp_path):
+        kube = FakeKube()
+        cd = mk_cd(kube, num_nodes=2)
+        uid = cd["metadata"]["uid"]
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+        kube.create(
+            gvr.COMPUTE_DOMAIN_CLIQUES,
+            {
+                "metadata": {"name": f"{uid}.s1-0", "namespace": NS},
+                "spec": {"computeDomainUID": uid, "cliqueID": "s1-0"},
+                "status": {"daemons": [
+                    {"nodeName": "node-a", "ipAddress": "10.0.0.1", "cliqueID": "s1-0", "index": 0, "status": "Ready"},
+                    {"nodeName": "node-b", "ipAddress": "10.0.0.2", "cliqueID": "s1-0", "index": 1, "status": "NotReady"},
+                ]},
+            },
+            NS,
+        )
+        c.manager.reconcile("user-ns", "cd1")
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert cd["status"]["status"] == "NotReady"
+        assert len(cd["status"]["nodes"]) == 2
+
+        clique = kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, f"{uid}.s1-0", NS)
+        clique["status"]["daemons"][1]["status"] = "Ready"
+        kube.update_status(gvr.COMPUTE_DOMAIN_CLIQUES, clique, NS)
+        c.manager.reconcile("user-ns", "cd1")
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert cd["status"]["status"] == "Ready"
+
+    def test_cleanup_manager_removes_orphans(self, tmp_path):
+        from tpudra.controller.cleanup import CleanupManager
+
+        kube = FakeKube()
+        kube.create(
+            gvr.DAEMONSETS,
+            {
+                "metadata": {
+                    "name": "computedomain-daemon-deadbeef",
+                    "namespace": NS,
+                    "labels": {"resource.tpu.google.com/computeDomain": "deadbeef"},
+                },
+                "spec": {},
+            },
+            NS,
+        )
+        gc = CleanupManager(kube, gvr.DAEMONSETS, NS, cd_exists=lambda uid: False)
+        assert gc.cleanup_once() == 1
+        assert kube.list(gvr.DAEMONSETS, NS)["items"] == []
+
+
+# -- daemon units ------------------------------------------------------------
+
+
+class TestCliqueManager:
+    def test_join_assigns_sequential_indices(self):
+        kube = FakeKube()
+        a = CliqueManager(kube, NS, "uid1", "s1-0", "node-a", "10.0.0.1")
+        b = CliqueManager(kube, NS, "uid1", "s1-0", "node-b", "10.0.0.2")
+        assert a.join() == 0
+        assert b.join() == 1
+        assert a.join() == 0  # idempotent rejoin keeps the index
+
+    def test_index_reuse_after_leave(self):
+        kube = FakeKube()
+        a = CliqueManager(kube, NS, "uid1", "s1-0", "node-a", "10.0.0.1")
+        b = CliqueManager(kube, NS, "uid1", "s1-0", "node-b", "10.0.0.2")
+        a.join(); b.join()
+        a.leave()
+        c = CliqueManager(kube, NS, "uid1", "s1-0", "node-c", "10.0.0.3")
+        assert c.join() == 0  # lowest free index
+
+    def test_status_flip(self):
+        kube = FakeKube()
+        a = CliqueManager(kube, NS, "uid1", "s1-0", "node-a", "10.0.0.1")
+        a.join()
+        a.update_daemon_status(ready=True)
+        clique = kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, "uid1.s1-0", NS)
+        assert clique["status"]["daemons"][0]["status"] == "Ready"
+
+
+class TestDNSNames:
+    def test_nodes_config_and_hosts(self, tmp_path):
+        mgr = DNSNameManager(
+            max_nodes=4,
+            hosts_path=str(tmp_path / "hosts"),
+            nodes_config_path=str(tmp_path / "nodes.cfg"),
+        )
+        mgr.write_nodes_config()
+        names = (tmp_path / "nodes.cfg").read_text().split()
+        assert names == [dns_name(i) for i in range(4)]
+        assert mgr.update_hosts_file({0: "10.0.0.1", 2: "10.0.0.3"})
+        hosts = (tmp_path / "hosts").read_text()
+        assert "10.0.0.1\tcompute-domain-daemon-0000" in hosts
+        assert "0.0.0.0\tcompute-domain-daemon-0001" in hosts
+        assert "10.0.0.3\tcompute-domain-daemon-0002" in hosts
+        # unchanged content → no rewrite
+        assert not mgr.update_hosts_file({0: "10.0.0.1", 2: "10.0.0.3"})
+        # preserves unmanaged content
+        (tmp_path / "hosts").write_text("127.0.0.1 localhost\n" + hosts)
+        assert mgr.update_hosts_file({0: "10.9.9.9"})
+        out = (tmp_path / "hosts").read_text()
+        assert out.startswith("127.0.0.1 localhost")
+        assert "10.9.9.9\tcompute-domain-daemon-0000" in out
+
+
+class TestProcessManager:
+    def test_watchdog_restarts_on_death(self):
+        pm = ProcessManager([sys.executable, "-c", "import time; time.sleep(60)"])
+        stop = threading.Event()
+        pm.ensure_started()
+        pm.start_watchdog(stop, tick=0.05)
+        try:
+            pid1 = pm.pid
+            os.kill(pid1, signal.SIGKILL)
+            wait_for(lambda: pm.running and pm.pid != pid1, msg="watchdog restart")
+            assert pm.restarts == 1
+        finally:
+            stop.set()
+            pm.stop()
+
+    def test_expected_stop_not_restarted(self):
+        pm = ProcessManager([sys.executable, "-c", "import time; time.sleep(60)"])
+        stop = threading.Event()
+        pm.ensure_started()
+        pm.start_watchdog(stop, tick=0.05)
+        try:
+            pm.stop()
+            time.sleep(0.2)
+            assert not pm.running
+            assert pm.restarts == 0
+        finally:
+            stop.set()
+
+
+# -- status-socket stub (stands in for tpu-slicewatchd) ----------------------
+
+
+class ReadyServer:
+    """Answers the native daemon's status protocol with a settable state."""
+
+    def __init__(self):
+        self.state = b"NOT_READY"
+        outer = self
+
+        class H(socketserver.StreamRequestHandler):
+            def handle(self):
+                if self.rfile.readline().strip() == b"Q":
+                    self.wfile.write(outer.state + b"\n")
+
+        self._srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0), H)
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def set_ready(self):
+        self.state = b"READY"
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+SIGHUP_TOLERANT = [
+    sys.executable,
+    "-c",
+    "import signal, time\n"
+    "signal.signal(signal.SIGHUP, lambda *a: None)\n"
+    "while True: time.sleep(1)",
+]
+
+
+# -- full lifecycle (§3.3) ---------------------------------------------------
+
+
+class TestFullLifecycle:
+    def test_multi_node_domain_forms_and_gates_workload(self, tmp_path):
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        mk_node(kube, "node-b")
+        cd = mk_cd(kube, num_nodes=2)
+        uid = cd["metadata"]["uid"]
+
+        stop = threading.Event()
+        controller = Controller(kube, ManagerConfig(driver_namespace=NS, resync_period=0.2))
+        controller.start(stop)
+
+        try:
+            # Controller stamps out the children.
+            wait_for(
+                lambda: kube.list(gvr.DAEMONSETS, NS)["items"], msg="DaemonSet creation"
+            )
+            wait_for(
+                lambda: kube.list(gvr.RESOURCE_CLAIM_TEMPLATES, "user-ns")["items"],
+                msg="workload RCT",
+            )
+
+            # Workload channel claim lands on node-a: CD plugin prepares.
+            lib_a = MockDeviceLib(
+                config=MockTopologyConfig(generation="v5p", host_index=0, num_hosts=2),
+                state_file=str(tmp_path / "hw-a.json"),
+            )
+            cddrv = CDDriver(
+                CDDriverConfig(
+                    node_name="node-a",
+                    plugin_dir=str(tmp_path / "cdplug-a"),
+                    registry_dir=str(tmp_path / "reg-a"),
+                    cdi_root=str(tmp_path / "cdi-a"),
+                ),
+                kube,
+                lib_a,
+            )
+            claim = {
+                "metadata": {"uid": "wl-1", "namespace": "user-ns", "name": "wl"},
+                "status": {"allocation": {"devices": {
+                    "results": [{
+                        "request": "channel",
+                        "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                        "pool": "node-a",
+                        "device": "channel-5",
+                    }],
+                    "config": [{
+                        "source": "FromClaim",
+                        "requests": [],
+                        "opaque": {
+                            "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                            "parameters": {
+                                "apiVersion": API_V,
+                                "kind": "ComputeDomainChannelConfig",
+                                "domainID": uid,
+                                "allocationMode": "Single",
+                            },
+                        },
+                    }],
+                }}},
+            }
+            resp = cddrv.prepare_resource_claims([claim])
+            assert "error" in resp["claims"]["wl-1"], "must gate until domain Ready"
+            assert not resp["claims"]["wl-1"].get("permanent")
+            node = kube.get(gvr.NODES, "node-a")
+            assert node["metadata"]["labels"][COMPUTE_DOMAIN_NODE_LABEL] == uid
+
+            # Daemon pods come up on both nodes (the DS would place them on
+            # labeled nodes); each joins the clique and reports READY.
+            apps, stubs = [], []
+            for i, node_name in enumerate(["node-a", "node-b"]):
+                stub = ReadyServer()
+                stubs.append(stub)
+                cfg = DaemonConfig(
+                    cd_uid=uid,
+                    node_name=node_name,
+                    pod_name=f"daemon-{node_name}",
+                    pod_ip=f"10.0.0.{i + 1}",
+                    namespace=NS,
+                    clique_id="slice1.0",
+                    num_hosts=2,
+                    host_index=i,
+                    status_port=stub.port,
+                    work_dir=str(tmp_path / f"cd-work-{i}"),
+                    hosts_path=str(tmp_path / f"hosts-{i}"),
+                    daemon_argv=SIGHUP_TOLERANT,
+                )
+                app = DaemonApp(kube, cfg)
+                threading.Thread(target=app.run, args=(stop,), daemon=True).start()
+                apps.append(app)
+            for app in apps:
+                assert app.wait_started()
+            for stub in stubs:
+                stub.set_ready()
+
+            # Daemons flip Ready in the clique; controller aggregates to CD.
+            wait_for(
+                lambda: kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+                .get("status", {})
+                .get("status")
+                == "Ready",
+                timeout=20,
+                msg="CD global Ready",
+            )
+
+            # Peer exchange reached both daemons' /etc/hosts.
+            for i in range(2):
+                hosts = (tmp_path / f"hosts-{i}").read_text()
+                assert "10.0.0.1\tcompute-domain-daemon-0000" in hosts
+                assert "10.0.0.2\tcompute-domain-daemon-0001" in hosts
+
+            # The workload prepare retry now passes and injects the channel.
+            resp = cddrv.prepare_resource_claims([claim])
+            result = resp["claims"]["wl-1"]
+            assert result.get("devices"), result
+            assert result["devices"][0]["deviceName"] == "channel-5"
+            spec = cddrv.state._cdi.read_claim_spec("wl-1")
+            env = spec["containerEdits"]["env"]
+            assert f"TPUDRA_DOMAIN_UID={uid}" in env
+            assert "TPUDRA_DOMAIN_CHANNELS=5" in env
+            assert "TPUDRA_NUM_HOSTS=2" in env
+
+            # Unprepare releases the channel and (last claim) the node label.
+            cddrv.unprepare_resource_claims([{"uid": "wl-1"}])
+            node = kube.get(gvr.NODES, "node-a")
+            assert COMPUTE_DOMAIN_NODE_LABEL not in node["metadata"].get("labels", {})
+
+            # Delete the CD: controller runs the teardown chain.
+            kube.delete(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+            wait_for(
+                lambda: not kube.list(gvr.DAEMONSETS, NS)["items"],
+                timeout=20,
+                msg="DaemonSet teardown",
+            )
+            wait_for(
+                lambda: not kube.list(gvr.RESOURCE_CLAIM_TEMPLATES, "user-ns")["items"],
+                timeout=20,
+                msg="workload RCT teardown",
+            )
+        finally:
+            stop.set()
+            for app in apps:
+                if app.process is not None:
+                    app.process.stop()
+            for stub in stubs:
+                stub.close()
+
+    def test_daemon_claim_prepare(self, tmp_path):
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube, ns="user-ns")
+        uid = cd["metadata"]["uid"]
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5p", num_hosts=2),
+            state_file=str(tmp_path / "hw.json"),
+        )
+        cddrv = CDDriver(
+            CDDriverConfig(
+                node_name="node-a",
+                plugin_dir=str(tmp_path / "cdplug"),
+                registry_dir=str(tmp_path / "reg"),
+                cdi_root=str(tmp_path / "cdi"),
+            ),
+            kube,
+            lib,
+        )
+        claim = {
+            "metadata": {"uid": "dm-1", "namespace": NS, "name": "daemon-claim"},
+            "status": {"allocation": {"devices": {
+                "results": [{
+                    "request": "daemon",
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "pool": "node-a",
+                    "device": "daemon-0",
+                }],
+                "config": [{
+                    "source": "FromClass",
+                    "requests": [],
+                    "opaque": {
+                        "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": API_V,
+                            "kind": "ComputeDomainDaemonConfig",
+                            "domainID": uid,
+                        },
+                    },
+                }],
+            }}},
+        }
+        resp = cddrv.prepare_resource_claims([claim])
+        result = resp["claims"]["dm-1"]
+        assert result.get("devices"), result
+        spec = cddrv.state._cdi.read_claim_spec("dm-1")
+        env = spec["containerEdits"]["env"]
+        assert f"CD_UID={uid}" in env
+        assert any(e.startswith("TPUDRA_COORDINATOR=") for e in env)
+        assert any(e.startswith("CLIQUE_ID=") for e in env)
+        mounts = spec["containerEdits"]["mounts"]
+        assert mounts[0]["containerPath"] == "/etc/tpudra-cd"
+        env_file = os.path.join(cddrv.cd_manager.domain_dir(uid), "daemon.env")
+        assert os.path.exists(env_file)
+        cddrv.unprepare_resource_claims([{"uid": "dm-1"}])
+        assert not os.path.exists(env_file)
+
+    def test_channel_publication_chunked(self, tmp_path):
+        kube = FakeKube()
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5e"),
+            state_file=str(tmp_path / "hw.json"),
+        )
+        cddrv = CDDriver(
+            CDDriverConfig(
+                node_name="node-a",
+                plugin_dir=str(tmp_path / "p"),
+                registry_dir=str(tmp_path / "r"),
+                cdi_root=str(tmp_path / "c"),
+            ),
+            kube,
+            lib,
+        )
+        slices = cddrv.publish_resources()
+        total = sum(len(s["spec"]["devices"]) for s in slices)
+        assert total == 2049  # 2048 channels + 1 daemon device
+        assert all(len(s["spec"]["devices"]) <= 128 for s in slices)
+        assert slices[0]["spec"]["pool"]["resourceSliceCount"] == len(slices)

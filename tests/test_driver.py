@@ -267,6 +267,14 @@ class TestDriver:
         d.unprepare_resource_claims([{"uid": "uid-v"}])
         assert "tpu-0" in advertised(), "sibling visible again after unprepare"
 
+        # Reverse direction: a plain chip grant withholds its vfio alias.
+        resp = d.prepare_resource_claims([mk_claim("uid-c", ["tpu-1"])])
+        assert "error" not in resp["claims"]["uid-c"], resp
+        names = advertised()
+        assert "tpu-vfio-1" not in names and "tpu-1" in names
+        d.unprepare_resource_claims([{"uid": "uid-c"}])
+        assert "tpu-vfio-1" in advertised()
+
     def test_ignored_health_kind_keeps_device(self, tmp_path):
         fg.feature_gates().set_from_map({fg.TPU_DEVICE_HEALTH_CHECK: True})
         d = mk_driver(tmp_path)

@@ -1,0 +1,15 @@
+"""Per-node ComputeDomain daemon.
+
+The analog of cmd/compute-domain-daemon/: runs in the DaemonSet pod the
+controller stamps out per CD, on every node the CD's workloads landed on.
+Responsibilities (reference main.go:206-415):
+
+- join the CD's clique: ensure the ``ComputeDomainClique`` CR exists and
+  insert this node's DaemonInfo under a stable free index (cdclique.go)
+- maintain the native slice-coordination daemon (``tpu-slicewatchd``, the
+  nvidia-imex analog): peer config rendering, /etc/hosts indirection so a
+  membership change is a SIGHUP re-resolve instead of a restart, watchdog
+  restart on unexpected death (process.go, dnsnames.go)
+- readiness: the ``check`` subcommand queries the native daemon's status
+  socket expecting READY (the ``nvidia-imex-ctl -q`` probe analog)
+"""

@@ -1,0 +1,198 @@
+"""ComputeDomain daemon application: run + check.
+
+The analog of compute-domain-daemon/main.go:206-443.
+
+``run`` labels the pod with its cliqueID, joins the clique CR, renders the
+native daemon's peer config, then runs three loops until stopped:
+
+- peer updates: clique membership change → /etc/hosts rewrite → ensure the
+  native daemon is started → reload signal (main.go:368-415)
+- watchdog: restart the native daemon on unexpected death
+- readiness: poll the native daemon's status socket and mirror READY /
+  NOT_READY into this daemon's clique entry
+
+``check`` is the kubelet startup/readiness/liveness probe: query the native
+daemon's status socket and exit 0 iff READY (the ``nvidia-imex-ctl -q``
+analog, main.go:419-443).  A node with an empty cliqueID runs no native
+daemon and reports READY unconditionally (main.go:230-236).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from tpudra import featuregates
+from tpudra.cddaemon.cdclique import CliqueManager
+from tpudra.cddaemon.dnsnames import DNSNameManager, dns_name
+from tpudra.cddaemon.process import ProcessManager
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_STATUS_PORT = 7173
+DEFAULT_PEER_PORT = 7174
+
+
+@dataclass
+class DaemonConfig:
+    cd_uid: str
+    node_name: str
+    pod_name: str
+    pod_ip: str
+    namespace: str = "tpudra-system"
+    clique_id: str = ""  # empty → no ICI fabric on this node, idle daemon
+    num_hosts: int = 1
+    host_index: int = 0
+    status_port: int = DEFAULT_STATUS_PORT
+    peer_port: int = DEFAULT_PEER_PORT
+    work_dir: str = "/var/run/tpudra-cd"
+    hosts_path: str = "/etc/hosts"
+    daemon_argv: Optional[Sequence[str]] = None  # default: tpu-slicewatchd
+
+    @classmethod
+    def from_environ(cls, env: Optional[dict] = None) -> "DaemonConfig":
+        env = dict(os.environ if env is None else env)
+        return cls(
+            cd_uid=env.get("CD_UID", ""),
+            node_name=env.get("NODE_NAME", ""),
+            pod_name=env.get("POD_NAME", ""),
+            pod_ip=env.get("POD_IP", ""),
+            namespace=env.get("NAMESPACE", "tpudra-system"),
+            clique_id=env.get("CLIQUE_ID", ""),
+            num_hosts=int(env.get("TPUDRA_NUM_HOSTS", "1")),
+            host_index=int(env.get("TPUDRA_HOST_INDEX", "0")),
+            status_port=int(env.get("STATUS_PORT", str(DEFAULT_STATUS_PORT))),
+            peer_port=int(env.get("PEER_PORT", str(DEFAULT_PEER_PORT))),
+            work_dir=env.get("WORK_DIR", "/var/run/tpudra-cd"),
+            hosts_path=env.get("HOSTS_PATH", "/etc/hosts"),
+        )
+
+
+def query_status(port: int, host: str = "127.0.0.1", timeout: float = 2.0) -> str:
+    """Ask the native daemon for its state; returns e.g. "READY"."""
+    try:
+        with socket.create_connection((host, port), timeout=timeout) as s:
+            s.sendall(b"Q\n")
+            data = s.makefile().readline()
+        return data.strip()
+    except OSError as e:
+        return f"UNREACHABLE: {e}"
+
+
+class DaemonApp:
+    def __init__(self, kube: KubeAPI, config: DaemonConfig):
+        self._kube = kube
+        self.config = config
+        self.clique: Optional[CliqueManager] = None
+        self.process: Optional[ProcessManager] = None
+        self._dns: Optional[DNSNameManager] = None
+        self._started = threading.Event()
+
+    # ------------------------------------------------------------------ run
+
+    def run(self, stop: threading.Event) -> None:
+        cfg = self.config
+        self._label_own_pod()
+        if not cfg.clique_id:
+            logger.info("no cliqueID on this node: idling without a native daemon")
+            self._started.set()
+            stop.wait()
+            return
+
+        self.clique = CliqueManager(
+            self._kube, cfg.namespace, cfg.cd_uid, cfg.clique_id, cfg.node_name, cfg.pod_ip
+        )
+        index = self.clique.join()
+
+        os.makedirs(cfg.work_dir, exist_ok=True)
+        self._dns = DNSNameManager(
+            max_nodes=max(cfg.num_hosts, 1),
+            hosts_path=cfg.hosts_path,
+            nodes_config_path=os.path.join(cfg.work_dir, "nodes.cfg"),
+        )
+        nodes_cfg = self._dns.write_nodes_config()
+
+        argv = list(cfg.daemon_argv or [])
+        if not argv:
+            argv = [
+                "tpu-slicewatchd",
+                "--nodes-config", nodes_cfg,
+                "--index", str(index),
+                "--status-port", str(cfg.status_port),
+                "--peer-port", str(cfg.peer_port),
+            ]
+        self.process = ProcessManager(argv)
+        self.process.start_watchdog(stop)
+
+        self.clique.watch_peers(self._on_peers_update, stop)
+        self._started.set()
+
+        # Readiness loop: mirror the native daemon's state into the clique.
+        last_ready: Optional[bool] = None
+        while not stop.is_set():
+            ready = self.is_ready()
+            if ready != last_ready:
+                self.clique.update_daemon_status(ready)
+                last_ready = ready
+            stop.wait(2.0)
+        self.process.stop()
+
+    def wait_started(self, timeout: float = 30.0) -> bool:
+        return self._started.wait(timeout)
+
+    def _on_peers_update(self, peers: dict[int, str]) -> None:
+        """Membership changed (main.go:368-415): with DNS names, rewrite
+        /etc/hosts and send a reload; otherwise restart with fresh IPs."""
+        if self.process is None:
+            return
+        use_dns = featuregates.enabled(featuregates.DOMAIN_DAEMONS_WITH_DNS_NAMES)
+        if use_dns:
+            changed = self._dns.update_hosts_file(peers)
+            self.process.ensure_started()
+            if changed:
+                self.process.reload()
+        else:
+            with open(os.path.join(self.config.work_dir, "peers.cfg"), "w") as f:
+                for index in sorted(peers):
+                    f.write(f"{peers[index]}\n")
+            self.process.restart()
+        logger.info("applied peer update: %d peers", len(peers))
+
+    def _label_own_pod(self) -> None:
+        """Label the pod with its cliqueID for debuggability
+        (main.go:222)."""
+        if not self.config.pod_name:
+            return
+        try:
+            self._kube.patch(
+                gvr.PODS,
+                self.config.pod_name,
+                {"metadata": {"labels": {"tpudra/cliqueID": self.config.clique_id or "none"}}},
+                self.config.namespace,
+            )
+        except Exception as e:  # noqa: BLE001 — cosmetic label only
+            logger.warning("could not label own pod: %s", e)
+
+    # ---------------------------------------------------------------- check
+
+    def is_ready(self) -> bool:
+        if not self.config.clique_id:
+            return True
+        return query_status(self.config.status_port) == "READY"
+
+
+def check(config: Optional[DaemonConfig] = None) -> int:
+    """Probe entry point: 0 iff READY (main.go:419-443)."""
+    cfg = config or DaemonConfig.from_environ()
+    if not cfg.clique_id:
+        print("READY (no clique)")
+        return 0
+    state = query_status(cfg.status_port)
+    print(state)
+    return 0 if state == "READY" else 1

@@ -1,0 +1,194 @@
+"""Clique membership through ComputeDomainClique CRs.
+
+The analog of compute-domain-daemon/cdclique.go:39-500.  The k8s API server
+is the rendezvous medium: each daemon upserts its DaemonInfo {nodeName, ip,
+cliqueID, index} into the clique CR named ``<cdUID>.<cliqueID>``, claiming the
+lowest free index (stable identity for the DNS-name scheme), watches the CR
+to learn peers, and flips its own entry Ready/NotReady from local daemon
+state.  Conflicts are expected (every daemon in the clique writes the same
+object) and handled by re-read-and-retry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from tpudra.api.computedomain import (
+    COMPUTE_DOMAIN_STATUS_NOT_READY,
+    COMPUTE_DOMAIN_STATUS_READY,
+)
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import AlreadyExists, Conflict, NotFound
+from tpudra.kube.informer import Informer
+
+logger = logging.getLogger(__name__)
+
+MAX_UPSERT_RETRIES = 20
+
+# Callback receiving {index: ip} for the clique's current membership.
+PeersCallback = Callable[[dict[int, str]], None]
+
+
+def clique_name(cd_uid: str, clique_id: str) -> str:
+    return f"{cd_uid}.{clique_id}"
+
+
+class CliqueManager:
+    def __init__(
+        self,
+        kube: KubeAPI,
+        namespace: str,
+        cd_uid: str,
+        clique_id: str,
+        node_name: str,
+        ip_address: str,
+    ):
+        self._kube = kube
+        self._ns = namespace
+        self._cd_uid = cd_uid
+        self._clique_id = clique_id
+        self._node = node_name
+        self._ip = ip_address
+        self._informer: Optional[Informer] = None
+        self._peers_cb: Optional[PeersCallback] = None
+        self._last_peers: Optional[dict[int, str]] = None
+        self._lock = threading.Lock()
+        self.index: Optional[int] = None
+
+    @property
+    def name(self) -> str:
+        return clique_name(self._cd_uid, self._clique_id)
+
+    # -- membership ---------------------------------------------------------
+
+    def join(self) -> int:
+        """Ensure the clique CR exists and this daemon has an entry; returns
+        the claimed index (syncDaemonInfoToClique + getNextAvailableIndex,
+        cdclique.go:277,350)."""
+        for _ in range(MAX_UPSERT_RETRIES):
+            clique = self._get_or_create()
+            daemons = clique.setdefault("status", {}).setdefault("daemons", [])
+            mine = next((d for d in daemons if d.get("nodeName") == self._node), None)
+            if mine is not None:
+                if mine.get("ipAddress") == self._ip:
+                    self.index = mine["index"]
+                    return self.index
+                mine["ipAddress"] = self._ip
+            else:
+                used = {d.get("index") for d in daemons}
+                index = next(i for i in range(len(daemons) + 1) if i not in used)
+                daemons.append(
+                    {
+                        "nodeName": self._node,
+                        "ipAddress": self._ip,
+                        "cliqueID": self._clique_id,
+                        "index": index,
+                        "status": COMPUTE_DOMAIN_STATUS_NOT_READY,
+                    }
+                )
+            try:
+                updated = self._kube.update_status(
+                    gvr.COMPUTE_DOMAIN_CLIQUES, clique, self._ns
+                )
+            except Conflict:
+                continue
+            mine = next(
+                d for d in updated["status"]["daemons"] if d["nodeName"] == self._node
+            )
+            self.index = mine["index"]
+            logger.info("joined clique %s as index %d", self.name, self.index)
+            return self.index
+        raise RuntimeError(f"could not join clique {self.name}: persistent conflicts")
+
+    def _get_or_create(self) -> dict:
+        try:
+            return self._kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, self.name, self._ns)
+        except NotFound:
+            pass
+        obj = {
+            "apiVersion": gvr.COMPUTE_DOMAIN_CLIQUES.api_version,
+            "kind": gvr.COMPUTE_DOMAIN_CLIQUES.kind,
+            "metadata": {"name": self.name, "namespace": self._ns},
+            "spec": {"computeDomainUID": self._cd_uid, "cliqueID": self._clique_id},
+            "status": {"daemons": []},
+        }
+        try:
+            return self._kube.create(gvr.COMPUTE_DOMAIN_CLIQUES, obj, self._ns)
+        except AlreadyExists:
+            return self._kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, self.name, self._ns)
+
+    def update_daemon_status(self, ready: bool) -> None:
+        """Flip this daemon's entry (updateDaemonStatus, cdclique.go:429)."""
+        target = COMPUTE_DOMAIN_STATUS_READY if ready else COMPUTE_DOMAIN_STATUS_NOT_READY
+        for _ in range(MAX_UPSERT_RETRIES):
+            try:
+                clique = self._kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, self.name, self._ns)
+            except NotFound:
+                return
+            mine = next(
+                (
+                    d
+                    for d in clique.get("status", {}).get("daemons", [])
+                    if d.get("nodeName") == self._node
+                ),
+                None,
+            )
+            if mine is None or mine.get("status") == target:
+                return
+            mine["status"] = target
+            try:
+                self._kube.update_status(gvr.COMPUTE_DOMAIN_CLIQUES, clique, self._ns)
+                return
+            except Conflict:
+                continue
+        logger.warning("could not update daemon status in clique %s", self.name)
+
+    def leave(self) -> None:
+        """Remove this daemon's entry on clean shutdown."""
+        for _ in range(MAX_UPSERT_RETRIES):
+            try:
+                clique = self._kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, self.name, self._ns)
+            except NotFound:
+                return
+            daemons = clique.get("status", {}).get("daemons", [])
+            remaining = [d for d in daemons if d.get("nodeName") != self._node]
+            if len(remaining) == len(daemons):
+                return
+            clique["status"]["daemons"] = remaining
+            try:
+                self._kube.update_status(gvr.COMPUTE_DOMAIN_CLIQUES, clique, self._ns)
+                return
+            except Conflict:
+                continue
+
+    # -- peer watching ------------------------------------------------------
+
+    def watch_peers(self, callback: PeersCallback, stop: threading.Event) -> None:
+        """Invoke callback with {index: ip} whenever membership changes
+        (maybePushDaemonsUpdate, cdclique.go:408)."""
+        self._peers_cb = callback
+        self._informer = Informer(self._kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._ns)
+        self._informer.add_handler(self._on_event)
+        self._informer.start(stop)
+        self._informer.wait_for_sync()
+
+    def _on_event(self, etype: str, obj: dict) -> None:
+        if obj.get("metadata", {}).get("name") != self.name:
+            return
+        if etype == "DELETED":
+            peers: dict[int, str] = {}
+        else:
+            peers = {
+                d["index"]: d.get("ipAddress", "")
+                for d in obj.get("status", {}).get("daemons", [])
+                if d.get("ipAddress")
+            }
+        with self._lock:
+            if peers == self._last_peers:
+                return
+            self._last_peers = peers
+        if self._peers_cb is not None:
+            self._peers_cb(dict(peers))

@@ -1,0 +1,76 @@
+"""Stable daemon identity via DNS names.
+
+The analog of compute-domain-daemon/dnsnames.go:44-216.  The native slice
+daemon wants a *static* peer list at startup; clique membership is dynamic.
+The trick (reference IMEXDaemonsWithDNSNames, default on): the peer config
+names ``compute-domain-daemon-0000 … -NNNN`` — the maximum domain size — and
+``/etc/hosts`` maps the currently-known names to IPs.  A membership change is
+then an /etc/hosts rewrite plus a reload signal instead of a daemon restart.
+
+TPU twist: a slice's host set is fixed at slice creation, so the index space
+is exactly ``num_hosts`` rather than an arbitrary ceiling — the clique index
+*is* the host's position in the slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+DNS_NAME_FORMAT = "compute-domain-daemon-%04d"
+HOSTS_BEGIN = "# BEGIN tpudra compute-domain daemons"
+HOSTS_END = "# END tpudra compute-domain daemons"
+
+
+def dns_name(index: int) -> str:
+    return DNS_NAME_FORMAT % index
+
+
+class DNSNameManager:
+    def __init__(self, max_nodes: int, hosts_path: str = "/etc/hosts", nodes_config_path: str = ""):
+        self._max_nodes = max_nodes
+        self._hosts_path = hosts_path
+        self._nodes_config_path = nodes_config_path
+
+    def write_nodes_config(self) -> str:
+        """Static peer list of max-size DNS names (WriteNodesConfig,
+        dnsnames.go:191)."""
+        content = "\n".join(dns_name(i) for i in range(self._max_nodes)) + "\n"
+        os.makedirs(os.path.dirname(self._nodes_config_path) or ".", exist_ok=True)
+        with open(self._nodes_config_path, "w") as f:
+            f.write(content)
+        return self._nodes_config_path
+
+    def update_hosts_file(self, ips_by_index: dict[int, str]) -> bool:
+        """Rewrite the managed /etc/hosts block; returns True if changed
+        (updateHostsFile, dnsnames.go:145).  Unknown indices resolve to
+        0.0.0.0 so lookups fail fast instead of hanging in DNS."""
+        lines = [HOSTS_BEGIN]
+        for i in range(self._max_nodes):
+            ip = ips_by_index.get(i, "0.0.0.0")
+            lines.append(f"{ip}\t{dns_name(i)}")
+        lines.append(HOSTS_END)
+        block = "\n".join(lines)
+
+        try:
+            with open(self._hosts_path) as f:
+                current = f.read()
+        except FileNotFoundError:
+            current = ""
+        begin = current.find(HOSTS_BEGIN)
+        end = current.find(HOSTS_END)
+        if begin != -1 and end != -1:
+            new = current[:begin] + block + current[end + len(HOSTS_END):]
+        else:
+            new = current.rstrip("\n") + ("\n" if current.strip() else "") + block + "\n"
+        if new == current:
+            return False
+        # In-place write, NOT an atomic rename: kubelet bind-mounts /etc/hosts
+        # as a single file, and rename(2) onto a bind-mount target fails with
+        # EBUSY (the reference writes in place too, dnsnames.go:183).
+        with open(self._hosts_path, "w") as f:
+            f.write(new)
+        logger.info("updated %s with %d peer mappings", self._hosts_path, len(ips_by_index))
+        return True

@@ -1,0 +1,111 @@
+"""Child-process supervision for the native slice daemon.
+
+The analog of compute-domain-daemon/process.go:33-223: start/stop/signal a
+child process (``tpu-slicewatchd``; nvidia-imex in the reference) plus a
+watchdog that restarts it on unexpected death.  Stop is graceful (SIGTERM,
+then SIGKILL after a grace period).
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import subprocess
+import threading
+import time
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class ProcessManager:
+    def __init__(self, argv: Sequence[str], term_grace: float = 5.0):
+        self._argv = list(argv)
+        self._term_grace = term_grace
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.RLock()
+        self._expected_stop = False
+        self.restarts = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def ensure_started(self) -> None:
+        with self._lock:
+            if self.running:
+                return
+            self._expected_stop = False
+            self._proc = subprocess.Popen(self._argv)
+            logger.info("started %s (pid %d)", self._argv[0], self._proc.pid)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._expected_stop = True
+            proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        proc.terminate()
+        try:
+            proc.wait(timeout=self._term_grace)
+        except subprocess.TimeoutExpired:
+            logger.warning("%s ignored SIGTERM; killing", self._argv[0])
+            proc.kill()
+            proc.wait()
+
+    def restart(self) -> None:
+        self.stop()
+        self.ensure_started()
+
+    def reload(self) -> None:
+        """Ask the daemon to re-resolve peers without restarting (the
+        SIGUSR1-to-nvidia-imex analog, reference main.go:405)."""
+        self.send_signal(signal.SIGHUP)
+
+    def send_signal(self, sig: int) -> None:
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.send_signal(sig)
+
+    @property
+    def running(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self.running else None
+
+    # -- watchdog -----------------------------------------------------------
+
+    def watchdog(self, stop: threading.Event, tick: float = 1.0) -> None:
+        """Restart the child if it died unexpectedly (process.go:170-202)."""
+        while not stop.is_set():
+            with self._lock:
+                died = (
+                    self._proc is not None
+                    and self._proc.poll() is not None
+                    and not self._expected_stop
+                )
+            if died:
+                logger.error(
+                    "%s exited unexpectedly (rc=%s); restarting",
+                    self._argv[0], self._proc.returncode,
+                )
+                self.restarts += 1
+                self.ensure_started()
+            stop.wait(tick)
+
+    def start_watchdog(self, stop: threading.Event, tick: float = 1.0) -> threading.Thread:
+        t = threading.Thread(
+            target=self.watchdog, args=(stop, tick), daemon=True, name="slice-daemon-watchdog"
+        )
+        t.start()
+        return t
+
+    def wait(self, timeout: float | None = None) -> Optional[int]:
+        with self._lock:
+            proc = self._proc
+        if proc is None:
+            return None
+        try:
+            return proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None

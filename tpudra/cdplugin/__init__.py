@@ -1,0 +1,16 @@
+"""ComputeDomain kubelet plugin (driver name ``compute-domain.tpu.google.com``).
+
+The analog of cmd/compute-domain-kubelet-plugin/: advertises 2048 abstract
+channel devices plus one daemon device per node, and prepares claims against
+them:
+
+- **channel** claims (user workloads): label the node to attract the CD's
+  DaemonSet ("CD follows workload"), gate on domain readiness — the claim
+  retries, holding the pod in ContainerCreating, until every host in the
+  slice has a Ready daemon — then inject the channel device + slice
+  topology env.
+- **daemon** claims (the DaemonSet pod itself): create the per-CD config
+  dir, inject the clique identity and coordination env.
+"""
+
+CHANNEL_COUNT = 2048  # reference nvlib.go:358-361

@@ -1,0 +1,78 @@
+"""Allocatable devices for the ComputeDomain plugin.
+
+The analog of compute-domain-kubelet-plugin/{nvlib,deviceinfo,allocatable}.go:
+2048 abstract channel devices (``channel-0..2047``) plus one daemon device
+(``daemon-0``) per node.  Channels are not hardware — they are the per-
+workload security boundary of a domain (reference computedomain.go:29-30):
+pods holding the same channel in the same domain may establish slice-wide
+collectives; the scheduler's job is only to pick a free channel number.
+
+The cliqueID attribute carries this host's ICI fabric identity
+(``<slice_uuid>.<partition_id>``) so DeviceClass CEL selectors can constrain
+co-scheduling to one fabric (the clusterUUID.cliqueID analog,
+nvlib.go:201-356).
+"""
+
+from __future__ import annotations
+
+from tpudra.cdplugin import CHANNEL_COUNT
+from tpudra.devicelib import DeviceLib
+
+TYPE_CHANNEL = "channel"
+TYPE_DAEMON = "daemon"
+
+CHANNEL_DEV_DIR = "/dev/tpudra-channels"
+
+
+def channel_name(i: int) -> str:
+    return f"channel-{i}"
+
+
+def daemon_name() -> str:
+    return "daemon-0"
+
+
+def channel_dev_path(i: int) -> str:
+    return f"{CHANNEL_DEV_DIR}/channel{i}"
+
+
+def parse_device_name(name: str) -> tuple[str, int]:
+    """→ (type, id); raises ValueError on unknown names."""
+    if name == daemon_name():
+        return TYPE_DAEMON, 0
+    if name.startswith("channel-"):
+        return TYPE_CHANNEL, int(name[len("channel-"):])
+    raise ValueError(f"unknown compute-domain device {name!r}")
+
+
+def build_devices(lib: DeviceLib) -> list[dict]:
+    """resource.k8s.io Device entries for this node's pool."""
+    chips = lib.enumerate_chips()
+    clique_id = chips[0].clique_id if chips else ""
+    topo = lib.slice_topology()
+    devices = [
+        {
+            "name": daemon_name(),
+            "attributes": {
+                "type": {"string": TYPE_DAEMON},
+                "id": {"int": 0},
+                "cliqueID": {"string": clique_id},
+                "numHosts": {"int": topo.num_hosts},
+                "hostIndex": {"int": topo.host_index},
+            },
+            "capacity": {},
+        }
+    ]
+    for i in range(CHANNEL_COUNT):
+        devices.append(
+            {
+                "name": channel_name(i),
+                "attributes": {
+                    "type": {"string": TYPE_CHANNEL},
+                    "id": {"int": i},
+                    "cliqueID": {"string": clique_id},
+                },
+                "capacity": {},
+            }
+        )
+    return devices

@@ -1,0 +1,132 @@
+"""Plugin-side ComputeDomain manager.
+
+The analog of compute-domain-kubelet-plugin/computedomain.go:50-439: finds
+CDs by UID, adds/removes this node's attraction label (the pull model that
+summons the controller's DaemonSet, §3.3), checks readiness against the CD
+status, and manages per-domain daemon settings (the config dir + env the
+daemon claim injects).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from tpudra.api.computedomain import (
+    COMPUTE_DOMAIN_NODE_LABEL,
+    COMPUTE_DOMAIN_STATUS_READY,
+)
+from tpudra.cddaemon.dnsnames import dns_name
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR_PORT = 7175
+
+
+class ComputeDomainManager:
+    def __init__(self, kube: KubeAPI, node_name: str, plugin_dir: str):
+        self._kube = kube
+        self._node = node_name
+        self._domains_dir = os.path.join(plugin_dir, "domains")
+
+    # -- lookup -------------------------------------------------------------
+
+    def get_by_uid(self, uid: str) -> Optional[dict]:
+        for cd in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", []):
+            if cd["metadata"]["uid"] == uid:
+                return cd
+        return None
+
+    def assert_in_namespace(self, uid: str, namespace: str) -> dict:
+        """A channel claim may only consume a CD from its own namespace —
+        the cross-namespace guard (device_state.go:466-475)."""
+        cd = self.get_by_uid(uid)
+        if cd is None:
+            raise LookupError(f"ComputeDomain {uid} not found")
+        if cd["metadata"]["namespace"] != namespace:
+            raise PermissionError(
+                f"ComputeDomain {uid} is in namespace "
+                f"{cd['metadata']['namespace']!r}, claim is in {namespace!r}"
+            )
+        return cd
+
+    # -- node label (the DaemonSet attractor) -------------------------------
+
+    def add_node_label(self, uid: str) -> None:
+        node = self._kube.get(gvr.NODES, self._node)
+        labels = node["metadata"].get("labels", {})
+        if labels.get(COMPUTE_DOMAIN_NODE_LABEL) == uid:
+            return
+        if COMPUTE_DOMAIN_NODE_LABEL in labels:
+            # One domain per node at a time (a TPU host belongs to one slice).
+            raise RuntimeError(
+                f"node {self._node} already labeled for domain "
+                f"{labels[COMPUTE_DOMAIN_NODE_LABEL]}"
+            )
+        self._kube.patch(
+            gvr.NODES, self._node, {"metadata": {"labels": {COMPUTE_DOMAIN_NODE_LABEL: uid}}}
+        )
+        logger.info("labeled node %s for ComputeDomain %s", self._node, uid)
+
+    def remove_node_label(self, uid: str) -> None:
+        node = self._kube.get(gvr.NODES, self._node)
+        if node["metadata"].get("labels", {}).get(COMPUTE_DOMAIN_NODE_LABEL) != uid:
+            return
+        self._kube.patch(
+            gvr.NODES, self._node, {"metadata": {"labels": {COMPUTE_DOMAIN_NODE_LABEL: None}}}
+        )
+
+    # -- readiness gate -----------------------------------------------------
+
+    def node_ready_in_domain(self, uid: str) -> bool:
+        """This node's entry in cd.status.nodes is Ready
+        (AssertComputeDomainReady, computedomain.go:238-294)."""
+        cd = self.get_by_uid(uid)
+        if cd is None:
+            return False
+        for node in cd.get("status", {}).get("nodes", []):
+            if node.get("name") == self._node:
+                return node.get("status") == COMPUTE_DOMAIN_STATUS_READY
+        return False
+
+    def domain_ready(self, uid: str) -> bool:
+        cd = self.get_by_uid(uid)
+        return (
+            cd is not None
+            and cd.get("status", {}).get("status") == COMPUTE_DOMAIN_STATUS_READY
+        )
+
+    # -- per-domain daemon settings ----------------------------------------
+
+    def domain_dir(self, uid: str) -> str:
+        return os.path.join(self._domains_dir, uid)
+
+    def prepare_daemon_settings(self, uid: str, clique_id: str, num_hosts: int, host_index: int) -> dict:
+        """Create the config dir + env for the daemon claim
+        (ComputeDomainDaemonSettings, computedomain.go:62)."""
+        d = self.domain_dir(uid)
+        os.makedirs(d, exist_ok=True)
+        env = {
+            "CD_UID": uid,
+            "CLIQUE_ID": clique_id,
+            "TPUDRA_NUM_HOSTS": str(num_hosts),
+            "TPUDRA_HOST_INDEX": str(host_index),
+            # Stable rendezvous: the index-0 daemon's DNS name.
+            "TPUDRA_COORDINATOR": f"{dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
+        }
+        with open(os.path.join(d, "daemon.env"), "w") as f:
+            for k, v in sorted(env.items()):
+                f.write(f"{k}={v}\n")
+        return env
+
+    def cleanup_daemon_settings(self, uid: str) -> None:
+        d = self.domain_dir(uid)
+        try:
+            for name in os.listdir(d):
+                os.unlink(os.path.join(d, name))
+            os.rmdir(d)
+        except FileNotFoundError:
+            pass

@@ -1,0 +1,161 @@
+"""ComputeDomain kubelet-plugin driver.
+
+The analog of compute-domain-kubelet-plugin/driver.go: the same two-socket
+kubelet contract as the TPU plugin (tpudra/plugin/draserver.py) serving the
+compute-domain driver name, ResourceSlice publication of the 2048 channels +
+1 daemon device (chunked to the per-slice device cap), and claim fan-in to
+the checkpointed CD device state.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra.cdplugin.allocatable import build_devices
+from tpudra.cdplugin.computedomain import ComputeDomainManager
+from tpudra.cdplugin.state import ComputeDomainDeviceState
+from tpudra.devicelib import DeviceLib
+from tpudra.flock import Flock, FlockTimeout
+from tpudra.kube.apply import apply_resource_slice
+from tpudra.kube.client import KubeAPI
+from tpudra.plugin.cdi import CDIHandler
+from tpudra.plugin.checkpoint import CheckpointManager
+from tpudra.plugin.cleanup import CheckpointCleanupManager
+from tpudra.plugin.device_state import PermanentError
+from tpudra.plugin.draserver import PluginSockets
+from tpudra.plugin.resourceslice import MAX_DEVICES_PER_SLICE
+
+logger = logging.getLogger(__name__)
+
+PU_LOCK_TIMEOUT = 10.0
+
+
+@dataclass
+class CDDriverConfig:
+    node_name: str
+    plugin_dir: str
+    registry_dir: str
+    cdi_root: str
+    driver_root: str = "/"
+
+
+class CDDriver:
+    def __init__(self, config: CDDriverConfig, kube: KubeAPI, devicelib: DeviceLib):
+        self._config = config
+        self._kube = kube
+        self._lib = devicelib
+        os.makedirs(config.plugin_dir, exist_ok=True)
+        self._pu_lock = Flock(os.path.join(config.plugin_dir, "pu.lock"))
+        self.cd_manager = ComputeDomainManager(kube, config.node_name, config.plugin_dir)
+        self.state = ComputeDomainDeviceState(
+            devicelib,
+            CDIHandler(config.cdi_root, config.driver_root),
+            CheckpointManager(config.plugin_dir),
+            self.cd_manager,
+            config.node_name,
+        )
+        self._stop = threading.Event()
+        self._sockets = PluginSockets(
+            COMPUTE_DOMAIN_DRIVER_NAME,
+            config.plugin_dir,
+            config.registry_dir,
+            prepare=self.prepare_resource_claims,
+            unprepare=self.unprepare_resource_claims,
+        )
+        self.cleanup = CheckpointCleanupManager(kube, self.state)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._sockets.start()
+        self.cleanup.start(self._stop)
+        self.publish_resources()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._sockets.stop()
+
+    @property
+    def sockets(self) -> PluginSockets:
+        return self._sockets
+
+    # ------------------------------------------------------ prepare/unprepare
+
+    def prepare_resource_claims(self, claims: list[dict]) -> dict:
+        out: dict[str, dict] = {}
+        for claim in claims:
+            uid = claim.get("metadata", {}).get("uid", "")
+            t0 = time.monotonic()
+            try:
+                with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+                    devices = self.state.prepare(claim)
+                out[uid] = {
+                    "devices": [
+                        {
+                            "requestNames": d.request_names,
+                            "poolName": d.pool_name,
+                            "deviceName": d.device_name,
+                            "cdiDeviceIDs": d.cdi_device_ids,
+                        }
+                        for d in devices
+                    ]
+                }
+                logger.info("t_prep=%.4fs cd-claim=%s", time.monotonic() - t0, uid)
+            except FlockTimeout as e:
+                out[uid] = {"error": f"node prepare lock: {e}", "permanent": False}
+            except Exception as e:  # noqa: BLE001 — per-claim fault barrier
+                logger.info("CD prepare %s: %s", uid, e)
+                out[uid] = {"error": str(e), "permanent": isinstance(e, PermanentError)}
+        return {"claims": out}
+
+    def unprepare_resource_claims(self, claims: list[dict]) -> dict:
+        out: dict[str, dict] = {}
+        for ref in claims:
+            uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
+            try:
+                with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+                    self.state.unprepare(uid)
+                out[uid] = {}
+            except Exception as e:  # noqa: BLE001
+                logger.exception("CD unprepare failed for claim %s", uid)
+                out[uid] = {"error": str(e)}
+        return {"claims": out}
+
+    # ---------------------------------------------------------- publication
+
+    def publish_resources(self) -> list[dict]:
+        devices = build_devices(self._lib)
+        chunks = [
+            devices[i : i + MAX_DEVICES_PER_SLICE]
+            for i in range(0, len(devices), MAX_DEVICES_PER_SLICE)
+        ]
+        slices = []
+        for i, chunk in enumerate(chunks):
+            slices.append(
+                {
+                    "apiVersion": "resource.k8s.io/v1",
+                    "kind": "ResourceSlice",
+                    "metadata": {
+                        "name": f"{self._config.node_name}-{COMPUTE_DOMAIN_DRIVER_NAME}-{i}"
+                    },
+                    "spec": {
+                        "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                        "nodeName": self._config.node_name,
+                        "pool": {
+                            "name": self._config.node_name,
+                            "generation": 1,
+                            "resourceSliceCount": len(chunks),
+                        },
+                        "devices": chunk,
+                    },
+                }
+            )
+        for s in slices:
+            apply_resource_slice(self._kube, s)
+        logger.info("published %d CD ResourceSlice(s)", len(slices))
+        return slices

@@ -1,0 +1,355 @@
+"""Checkpointed prepare/unprepare for ComputeDomain claims.
+
+The analog of compute-domain-kubelet-plugin/device_state.go:147-673 — the
+same idempotent checkpoint skeleton as the TPU plugin, with CD-specific
+config application:
+
+- **channel** (applyComputeDomainChannelConfig, :466): assert the CD lives in
+  the claim's namespace, label the node (summoning the DaemonSet), then gate
+  on this node being Ready in the CD status — raising a *retryable* error
+  until it is, which holds the workload pod in ContainerCreating while the
+  domain forms — and finally inject the channel device node(s) and slice
+  topology env.  Channel conflicts across claims are refused from the
+  checkpoint (assertImexChannelNotAllocated analog, :646).
+- **daemon** (applyComputeDomainDaemonConfig, :516): create the per-domain
+  settings dir, inject clique identity + rendezvous env and the config-dir
+  mount.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra.api import DecodeError, decode_config
+from tpudra.api.computedomain import (
+    CHANNEL_ALLOCATION_MODE_ALL,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+)
+from tpudra.cdplugin import CHANNEL_COUNT, allocatable as alloc
+from tpudra.cdplugin.computedomain import ComputeDomainManager
+from tpudra.devicelib import DeviceLib
+from tpudra.plugin.cdi import CDIHandler, ContainerEdits
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    PreparedClaim,
+    PreparedDevice,
+    PreparedDeviceGroup,
+)
+from tpudra.plugin.device_state import (
+    PermanentError,
+    PrepareError,
+    PreparedDeviceResult,
+    _claim_identity,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def _allocation_results(claim: dict) -> list[dict]:
+    results = (
+        claim.get("status", {})
+        .get("allocation", {})
+        .get("devices", {})
+        .get("results", [])
+    )
+    return [r for r in results if r.get("driver") == COMPUTE_DOMAIN_DRIVER_NAME]
+
+
+def _opaque_config(claim: dict):
+    """CD claims carry exactly one opaque config (channel or daemon)."""
+    entries = (
+        claim.get("status", {})
+        .get("allocation", {})
+        .get("devices", {})
+        .get("config", [])
+    )
+    decoded = []
+    for entry in entries:
+        opaque = entry.get("opaque")
+        if not opaque or opaque.get("driver") != COMPUTE_DOMAIN_DRIVER_NAME:
+            continue
+        try:
+            config = decode_config(opaque.get("parameters", {}), strict=True)
+            config.normalize()
+            config.validate()
+        except (DecodeError, ValueError) as e:
+            raise PermanentError(f"invalid opaque config: {e}") from e
+        decoded.append(config)
+    if not decoded:
+        raise PermanentError("compute-domain claim has no opaque config")
+    if len(decoded) > 1:
+        raise PermanentError("compute-domain claim has multiple opaque configs")
+    return decoded[0]
+
+
+class ComputeDomainDeviceState:
+    def __init__(
+        self,
+        devicelib: DeviceLib,
+        cdi: CDIHandler,
+        checkpoints: CheckpointManager,
+        cd_manager: ComputeDomainManager,
+        node_name: str,
+    ):
+        self._lib = devicelib
+        self._cdi = cdi
+        self._cp = checkpoints
+        self._cdm = cd_manager
+        self._node_name = node_name
+
+    # ------------------------------------------------------------------ API
+
+    def prepare(self, claim: dict) -> list[PreparedDeviceResult]:
+        t0 = time.monotonic()
+        uid, namespace, name = _claim_identity(claim)
+        results = _allocation_results(claim)
+        if not results:
+            raise PermanentError(
+                f"claim {namespace}/{name}:{uid} has no allocation for "
+                f"{COMPUTE_DOMAIN_DRIVER_NAME}"
+            )
+        config = _opaque_config(claim)
+
+        cached: list[PreparedDeviceResult] = []
+
+        def start(cp: Checkpoint) -> None:
+            existing = cp.prepared_claims.get(uid)
+            if existing is not None and existing.status == PREPARE_COMPLETED:
+                cached.extend(self._results_from(existing))
+                return
+            if isinstance(config, ComputeDomainChannelConfig):
+                self._assert_channels_free(cp, uid, results, config)
+            cp.prepared_claims[uid] = PreparedClaim(
+                uid=uid,
+                namespace=namespace,
+                name=name,
+                status=PREPARE_STARTED,
+                groups=[],
+            )
+
+        self._cp.mutate(start)
+        if cached:
+            return cached
+
+        try:
+            if isinstance(config, ComputeDomainChannelConfig):
+                group = self._apply_channel_config(uid, namespace, config, results)
+            elif isinstance(config, ComputeDomainDaemonConfig):
+                group = self._apply_daemon_config(uid, config, results)
+            else:
+                raise PermanentError(
+                    f"{type(config).__name__} belongs to the TPU plugin"
+                )
+        except Exception:
+            # Leave the claim in PrepareStarted: kubelet retries (the
+            # readiness-gating path relies on this, §3.3).
+            raise
+
+        devices, edits = group
+        self._cdi.create_claim_spec_file(
+            uid, {d.canonical_name: ContainerEdits() for d in devices}, edits
+        )
+
+        def complete(cp: Checkpoint) -> None:
+            cp.prepared_claims[uid] = PreparedClaim(
+                uid=uid,
+                namespace=namespace,
+                name=name,
+                status=PREPARE_COMPLETED,
+                groups=[PreparedDeviceGroup(devices=devices, config_state={})],
+            )
+
+        self._cp.mutate(complete)
+        logger.info(
+            "prepared CD claim %s/%s:%s t_prep=%.4fs",
+            namespace, name, uid, time.monotonic() - t0,
+        )
+        return [
+            PreparedDeviceResult(
+                request_names=d.request_names,
+                pool_name=d.pool_name,
+                device_name=d.canonical_name,
+                cdi_device_ids=d.cdi_device_ids,
+            )
+            for d in devices
+        ]
+
+    def unprepare(self, claim_uid: str) -> None:
+        def go(cp: Checkpoint) -> None:
+            claim = cp.prepared_claims.pop(claim_uid, None)
+            self._cdi.delete_claim_spec_file(claim_uid)
+            if claim is None:
+                return
+            domain_uid = ""
+            kinds = set()
+            for dev in claim.all_devices():
+                domain_uid = dev.attributes.get("domainUID", domain_uid)
+                kinds.add(dev.type)
+            if not domain_uid:
+                return
+            if alloc.TYPE_DAEMON in kinds:
+                self._cdm.cleanup_daemon_settings(domain_uid)
+            if alloc.TYPE_CHANNEL in kinds:
+                still_used = any(
+                    d.attributes.get("domainUID") == domain_uid
+                    for other in cp.prepared_claims.values()
+                    for d in other.all_devices()
+                )
+                if not still_used:
+                    try:
+                        self._cdm.remove_node_label(domain_uid)
+                    except Exception as e:  # noqa: BLE001 — label GC is best-effort
+                        logger.warning("removing CD node label: %s", e)
+
+        self._cp.mutate(go)
+
+    def prepared_claim_uids(self) -> dict[str, tuple[str, str, str]]:
+        cp = self._cp.read()
+        return {
+            uid: (c.namespace, c.name, c.status)
+            for uid, c in cp.prepared_claims.items()
+        }
+
+    # ----------------------------------------------------------- internals
+
+    def _results_from(self, claim: PreparedClaim) -> list[PreparedDeviceResult]:
+        return [
+            PreparedDeviceResult(
+                request_names=d.request_names,
+                pool_name=d.pool_name,
+                device_name=d.canonical_name,
+                cdi_device_ids=d.cdi_device_ids,
+            )
+            for g in claim.groups
+            for d in g.devices
+        ]
+
+    def _assert_channels_free(
+        self,
+        cp: Checkpoint,
+        uid: str,
+        results: list[dict],
+        config: ComputeDomainChannelConfig,
+    ) -> None:
+        """A channel granted to one claim may not be re-granted to another on
+        this node (reference :646).  In All mode the claim takes the whole
+        channel space of its domain."""
+        wanted: set[tuple[str, int]] = set()
+        for r in results:
+            kind, cid = alloc.parse_device_name(r.get("device", ""))
+            if kind == alloc.TYPE_CHANNEL:
+                wanted.add((config.domain_id, cid))
+        for other_uid, other in cp.prepared_claims.items():
+            if other_uid == uid:
+                continue
+            for dev in other.all_devices():
+                if dev.type != alloc.TYPE_CHANNEL:
+                    continue
+                key = (dev.attributes.get("domainUID", ""), int(dev.attributes.get("channelID", -1)))
+                if key in wanted:
+                    raise PermanentError(
+                        f"channel {key[1]} of domain {key[0]} already prepared "
+                        f"for claim {other.namespace}/{other.name}:{other_uid}"
+                    )
+
+    def _apply_channel_config(
+        self,
+        uid: str,
+        namespace: str,
+        config: ComputeDomainChannelConfig,
+        results: list[dict],
+    ) -> tuple[list[PreparedDevice], ContainerEdits]:
+        try:
+            self._cdm.assert_in_namespace(config.domain_id, namespace)
+        except LookupError as e:
+            raise PrepareError(str(e)) from e  # CD may not have synced yet
+        except PermissionError as e:
+            raise PermanentError(str(e)) from e
+        self._cdm.add_node_label(config.domain_id)
+        if not self._cdm.node_ready_in_domain(config.domain_id):
+            raise PrepareError(
+                f"ComputeDomain {config.domain_id} is not ready on node "
+                f"{self._node_name} yet"
+            )
+
+        channel_ids: list[int] = []
+        devices: list[PreparedDevice] = []
+        for r in results:
+            kind, cid = alloc.parse_device_name(r.get("device", ""))
+            if kind != alloc.TYPE_CHANNEL:
+                raise PermanentError(
+                    f"channel config applied to non-channel device {r.get('device')}"
+                )
+            channel_ids.append(cid)
+            devices.append(
+                PreparedDevice(
+                    canonical_name=r["device"],
+                    type=alloc.TYPE_CHANNEL,
+                    pool_name=self._node_name,
+                    request_names=[r["request"]] if r.get("request") else [],
+                    cdi_device_ids=[self._cdi.qualified_device_id(uid, r["device"])],
+                    attributes={
+                        "domainUID": config.domain_id,
+                        "channelID": str(cid),
+                    },
+                )
+            )
+        granted = (
+            list(range(CHANNEL_COUNT))
+            if config.allocation_mode == CHANNEL_ALLOCATION_MODE_ALL
+            else sorted(channel_ids)
+        )
+        topo = self._lib.slice_topology()
+        chips = self._lib.enumerate_chips()
+        edits = ContainerEdits(
+            env=[
+                f"TPUDRA_DOMAIN_UID={config.domain_id}",
+                "TPUDRA_DOMAIN_CHANNELS=" + ",".join(str(i) for i in granted),
+                f"TPUDRA_NUM_HOSTS={topo.num_hosts}",
+                f"TPUDRA_HOST_INDEX={topo.host_index}",
+                f"TPUDRA_CLIQUE_ID={chips[0].clique_id if chips else ''}",
+            ],
+            device_nodes=[
+                self._cdi.host_path(alloc.channel_dev_path(i)) for i in granted
+            ],
+        )
+        return devices, edits
+
+    def _apply_daemon_config(
+        self, uid: str, config: ComputeDomainDaemonConfig, results: list[dict]
+    ) -> tuple[list[PreparedDevice], ContainerEdits]:
+        for r in results:
+            kind, _ = alloc.parse_device_name(r.get("device", ""))
+            if kind != alloc.TYPE_DAEMON:
+                raise PermanentError(
+                    f"daemon config applied to non-daemon device {r.get('device')}"
+                )
+        chips = self._lib.enumerate_chips()
+        topo = self._lib.slice_topology()
+        clique_id = chips[0].clique_id if chips else ""
+        env = self._cdm.prepare_daemon_settings(
+            config.domain_id, clique_id, topo.num_hosts, topo.host_index
+        )
+        devices = [
+            PreparedDevice(
+                canonical_name=r["device"],
+                type=alloc.TYPE_DAEMON,
+                pool_name=self._node_name,
+                request_names=[r["request"]] if r.get("request") else [],
+                cdi_device_ids=[self._cdi.qualified_device_id(uid, r["device"])],
+                attributes={"domainUID": config.domain_id},
+            )
+            for r in results
+        ]
+        edits = ContainerEdits(
+            env=[f"{k}={v}" for k, v in sorted(env.items())],
+            mounts=[(self._cdm.domain_dir(config.domain_id), "/etc/tpudra-cd")],
+        )
+        return devices, edits

@@ -1,0 +1,11 @@
+"""ComputeDomain cluster controller.
+
+The analog of cmd/compute-domain-controller/: watches ComputeDomain CRs and
+stamps out, per CD, a node daemon DaemonSet plus two ResourceClaimTemplates
+(daemon + workload channel), maintains the CD's aggregated status from
+ComputeDomainClique CRs, and runs the deletion/finalizer choreography.
+"""
+
+from tpudra.controller.controller import Controller, ManagerConfig
+
+__all__ = ["Controller", "ManagerConfig"]

@@ -1,0 +1,72 @@
+"""Generic orphan garbage collection.
+
+The analog of compute-domain-controller/cleanup.go:46-147
+(``CleanupManager[T]``): every CD-owned object the controller stamps out in
+the *driver's* namespace (DaemonSets, daemon RCTs) cannot carry a
+cross-namespace owner reference, so a periodic pass deletes any such object
+whose labeled ComputeDomain no longer exists — covering controller crashes
+mid-teardown.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+from tpudra.controller.resourceclaimtemplate import CD_UID_LABEL
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import NotFound
+from tpudra.kube.gvr import GVR
+
+logger = logging.getLogger(__name__)
+
+
+class CleanupManager:
+    def __init__(
+        self,
+        kube: KubeAPI,
+        target: GVR,
+        namespace: str | None,
+        cd_exists: Callable[[str], bool],
+        period: float = 600.0,
+    ):
+        self._kube = kube
+        self._target = target
+        self._ns = namespace
+        self._cd_exists = cd_exists
+        self._period = period
+
+    def cleanup_once(self) -> int:
+        removed = 0
+        items = self._kube.list(
+            self._target, self._ns, label_selector=CD_UID_LABEL
+        ).get("items", [])
+        for obj in items:
+            uid = obj["metadata"].get("labels", {}).get(CD_UID_LABEL, "")
+            if uid and not self._cd_exists(uid):
+                name = obj["metadata"]["name"]
+                ns = obj["metadata"].get("namespace")
+                logger.info(
+                    "GC: deleting orphaned %s %s/%s (CD %s gone)",
+                    self._target.kind, ns or "", name, uid,
+                )
+                try:
+                    self._kube.delete(self._target, name, ns)
+                    removed += 1
+                except NotFound:
+                    pass
+        return removed
+
+    def start(self, stop: threading.Event) -> None:
+        def run() -> None:
+            while not stop.is_set():
+                try:
+                    self.cleanup_once()
+                except Exception:  # noqa: BLE001 — periodic GC must survive
+                    logger.exception("%s cleanup pass failed", self._target.kind)
+                stop.wait(self._period)
+
+        threading.Thread(
+            target=run, daemon=True, name=f"cleanup-{self._target.resource}"
+        ).start()

@@ -1,0 +1,189 @@
+"""ComputeDomain reconciliation.
+
+The analog of compute-domain-controller/computedomain.go + cdstatus.go:
+
+- add/update: add finalizer → ensure daemon RCT → ensure DaemonSet → ensure
+  workload RCT (in the CD's namespace) → aggregate status from
+  ComputeDomainClique CRs (computedomain.go:298-374, cdstatus.go:135-265)
+- delete: teardown chain with assert-removed ordering — workload RCT →
+  DaemonSet → daemon RCT → node labels → cliques → drop finalizer
+  (computedomain.go:314-348); each step must be observed gone before the
+  next, so partial teardowns converge across controller restarts
+- global status: Ready iff the CD has at least spec.numNodes nodes and every
+  node reports Ready (computedomain.go:251-265)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from tpudra.api.computedomain import (
+    COMPUTE_DOMAIN_STATUS_NOT_READY,
+    COMPUTE_DOMAIN_STATUS_READY,
+)
+from tpudra.controller.daemonset import DaemonSetManager
+from tpudra.controller.node import NodeManager
+from tpudra.controller.resourceclaimtemplate import (
+    DaemonResourceClaimTemplateManager,
+    WorkloadResourceClaimTemplateManager,
+)
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import Conflict, NotFound
+
+logger = logging.getLogger(__name__)
+
+CD_FINALIZER = "resource.tpu.google.com/computeDomain"
+
+
+class RetryLater(Exception):
+    """Reconcile step not yet satisfied; requeue the key."""
+
+
+class ComputeDomainManager:
+    def __init__(
+        self,
+        kube: KubeAPI,
+        driver_namespace: str,
+        image: str = "tpudra:latest",
+        max_nodes_per_domain: int = 0,
+    ):
+        self._kube = kube
+        self._ns = driver_namespace
+        self._max_nodes = max_nodes_per_domain
+        self.daemonsets = DaemonSetManager(kube, driver_namespace, image=image)
+        self.daemon_rcts = DaemonResourceClaimTemplateManager(kube, driver_namespace)
+        self.workload_rcts = WorkloadResourceClaimTemplateManager(kube)
+        self.nodes = NodeManager(kube, self.cd_exists)
+
+    # ------------------------------------------------------------- helpers
+
+    def cd_exists(self, uid: str) -> bool:
+        for item in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", []):
+            if item["metadata"]["uid"] == uid:
+                return True
+        return False
+
+    def get(self, namespace: str, name: str) -> Optional[dict]:
+        try:
+            return self._kube.get(gvr.COMPUTE_DOMAINS, name, namespace)
+        except NotFound:
+            return None
+
+    # ----------------------------------------------------------- reconcile
+
+    def reconcile(self, namespace: str, name: str) -> None:
+        cd = self.get(namespace, name)
+        if cd is None:
+            return
+        if cd["metadata"].get("deletionTimestamp"):
+            self._teardown(cd)
+            return
+        if self._max_nodes and cd.get("spec", {}).get("numNodes", 0) > self._max_nodes:
+            logger.error(
+                "CD %s/%s requests %d nodes > max %d; not deploying",
+                namespace, name, cd["spec"]["numNodes"], self._max_nodes,
+            )
+            return
+        cd = self._ensure_finalizer(cd)
+        rct = self.daemon_rcts.ensure(cd)
+        self.daemonsets.ensure(cd, rct["metadata"]["name"])
+        self.workload_rcts.ensure(cd)
+        self.sync_status(cd)
+
+    def _ensure_finalizer(self, cd: dict) -> dict:
+        finalizers = cd["metadata"].setdefault("finalizers", [])
+        if CD_FINALIZER in finalizers:
+            return cd
+        finalizers.append(CD_FINALIZER)
+        try:
+            return self._kube.update(gvr.COMPUTE_DOMAINS, cd, cd["metadata"]["namespace"])
+        except Conflict as e:
+            raise RetryLater(f"finalizer conflict: {e}") from e
+
+    def _teardown(self, cd: dict) -> None:
+        """Deletion choreography (computedomain.go:314-348).  Each phase
+        issues deletes, then *verifies absence* before continuing; raises
+        RetryLater until the chain completes, then drops the finalizer."""
+        uid = cd["metadata"]["uid"]
+        self.workload_rcts.remove(cd)
+        if not self.workload_rcts.assert_removed(cd):
+            raise RetryLater("workload RCT still present")
+        self.daemonsets.remove(uid)
+        if not self.daemonsets.assert_removed(uid):
+            raise RetryLater("DaemonSet still present")
+        self.daemon_rcts.remove(uid)
+        if not self.daemon_rcts.assert_removed(uid):
+            raise RetryLater("daemon RCT still present")
+        self.nodes.remove_labels_for(uid)
+        self._delete_cliques(uid)
+        finalizers = [f for f in cd["metadata"].get("finalizers", []) if f != CD_FINALIZER]
+        cd["metadata"]["finalizers"] = finalizers
+        try:
+            self._kube.update(gvr.COMPUTE_DOMAINS, cd, cd["metadata"]["namespace"])
+        except (Conflict, NotFound):
+            pass
+        logger.info("ComputeDomain %s torn down", uid)
+
+    def _delete_cliques(self, cd_uid: str) -> None:
+        for clique in self._kube.list(gvr.COMPUTE_DOMAIN_CLIQUES, self._ns).get("items", []):
+            if clique.get("spec", {}).get("computeDomainUID") == cd_uid:
+                try:
+                    self._kube.delete(
+                        gvr.COMPUTE_DOMAIN_CLIQUES,
+                        clique["metadata"]["name"],
+                        self._ns,
+                    )
+                except NotFound:
+                    pass
+
+    # -------------------------------------------------------------- status
+
+    def build_nodes_from_cliques(self, cd_uid: str) -> list[dict]:
+        """Aggregate clique daemon entries into cd.status.nodes
+        (buildNodesFromCliques, cdstatus.go:242)."""
+        nodes: list[dict] = []
+        for clique in self._kube.list(gvr.COMPUTE_DOMAIN_CLIQUES, self._ns).get("items", []):
+            if clique.get("spec", {}).get("computeDomainUID") != cd_uid:
+                continue
+            for daemon in clique.get("status", {}).get("daemons", []):
+                nodes.append(
+                    {
+                        "name": daemon.get("nodeName", ""),
+                        "ipAddress": daemon.get("ipAddress", ""),
+                        "cliqueID": daemon.get("cliqueID", ""),
+                        "index": daemon.get("index", 0),
+                        "status": daemon.get("status", COMPUTE_DOMAIN_STATUS_NOT_READY),
+                    }
+                )
+        nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
+        return nodes
+
+    def calculate_global_status(self, cd: dict, nodes: list[dict]) -> str:
+        """Ready iff enough nodes and all Ready (computedomain.go:251-265)."""
+        num_nodes = cd.get("spec", {}).get("numNodes", 0)
+        if num_nodes <= 0 or len(nodes) < num_nodes:
+            return COMPUTE_DOMAIN_STATUS_NOT_READY
+        if any(n["status"] != COMPUTE_DOMAIN_STATUS_READY for n in nodes):
+            return COMPUTE_DOMAIN_STATUS_NOT_READY
+        return COMPUTE_DOMAIN_STATUS_READY
+
+    def sync_status(self, cd: dict) -> None:
+        nodes = self.build_nodes_from_cliques(cd["metadata"]["uid"])
+        status = {
+            "status": self.calculate_global_status(cd, nodes),
+            "nodes": nodes,
+        }
+        if cd.get("status") == status:
+            return
+        cd = dict(cd)
+        cd["status"] = status
+        try:
+            self._kube.update_status(
+                gvr.COMPUTE_DOMAINS, cd, cd["metadata"]["namespace"]
+            )
+        except Conflict as e:
+            raise RetryLater(f"status conflict: {e}") from e
+        except NotFound:
+            pass

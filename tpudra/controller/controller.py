@@ -1,0 +1,122 @@
+"""Controller shell: informers feeding one shared work queue.
+
+The analog of compute-domain-controller/controller.go:75-105.  Events from
+the ComputeDomain and ComputeDomainClique informers collapse into keyed work
+items (newest wins — pkg/workqueue semantics) handled by
+``ComputeDomainManager.reconcile``; clique events re-enqueue their owning CD
+so status aggregation is event-driven, with a periodic full resync as the
+safety net.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+
+from tpudra.controller.cleanup import CleanupManager
+from tpudra.controller.computedomain import ComputeDomainManager, RetryLater
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.informer import Informer
+from tpudra.workqueue import WorkQueue, default_controller_rate_limiter
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ManagerConfig:
+    driver_namespace: str = "tpudra-system"
+    image: str = "tpudra:latest"
+    max_nodes_per_domain: int = 0
+    resync_period: float = 600.0
+
+
+class Controller:
+    def __init__(self, kube: KubeAPI, config: ManagerConfig | None = None):
+        self._kube = kube
+        self._config = config or ManagerConfig()
+        self.manager = ComputeDomainManager(
+            kube,
+            self._config.driver_namespace,
+            image=self._config.image,
+            max_nodes_per_domain=self._config.max_nodes_per_domain,
+        )
+        self.queue = WorkQueue(rate_limiter=default_controller_rate_limiter())
+        self._cd_informer = Informer(kube, gvr.COMPUTE_DOMAINS)
+        self._clique_informer = Informer(
+            kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._config.driver_namespace
+        )
+        self._cleanups = [
+            CleanupManager(
+                kube, gvr.DAEMONSETS, self._config.driver_namespace, self.manager.cd_exists
+            ),
+            CleanupManager(
+                kube,
+                gvr.RESOURCE_CLAIM_TEMPLATES,
+                self._config.driver_namespace,
+                self.manager.cd_exists,
+            ),
+        ]
+
+    # -- event plumbing -----------------------------------------------------
+
+    def _enqueue_cd(self, namespace: str, name: str) -> None:
+        key = ("cd", namespace, name)
+        self.queue.enqueue_keyed(
+            key, lambda: self._reconcile_with_retry(namespace, name, key)
+        )
+
+    def _reconcile_with_retry(self, namespace: str, name: str, key) -> None:
+        try:
+            self.manager.reconcile(namespace, name)
+        except RetryLater as e:
+            logger.info("requeue %s/%s: %s", namespace, name, e)
+            raise  # the work queue's rate limiter schedules the retry
+        except Exception:
+            logger.exception("reconcile %s/%s failed", namespace, name)
+            raise
+
+    def _on_cd_event(self, _etype: str, obj: dict) -> None:
+        meta = obj.get("metadata", {})
+        self._enqueue_cd(meta.get("namespace", ""), meta.get("name", ""))
+
+    def _on_clique_event(self, _etype: str, obj: dict) -> None:
+        cd_uid = obj.get("spec", {}).get("computeDomainUID", "")
+        if not cd_uid:
+            return
+        for cd in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", []):
+            if cd["metadata"]["uid"] == cd_uid:
+                self._enqueue_cd(cd["metadata"]["namespace"], cd["metadata"]["name"])
+                return
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        self._cd_informer.add_handler(self._on_cd_event)
+        self._clique_informer.add_handler(self._on_clique_event)
+        self._cd_informer.start(stop)
+        self._clique_informer.start(stop)
+        self._cd_informer.wait_for_sync()
+        self._clique_informer.wait_for_sync()
+        for c in self._cleanups:
+            c.start(stop)
+        self.manager.nodes.start(stop)
+        threading.Thread(
+            target=self._resync_loop, args=(stop,), daemon=True, name="cd-resync"
+        ).start()
+        self.queue.run(stop)  # blocks until stop
+
+    def start(self, stop: threading.Event) -> threading.Thread:
+        t = threading.Thread(target=self.run, args=(stop,), daemon=True, name="controller")
+        t.start()
+        return t
+
+    def _resync_loop(self, stop: threading.Event) -> None:
+        while not stop.is_set():
+            stop.wait(self._config.resync_period)
+            if stop.is_set():
+                return
+            for cd in self._cd_informer.list():
+                meta = cd.get("metadata", {})
+                self._enqueue_cd(meta.get("namespace", ""), meta.get("name", ""))

@@ -1,0 +1,94 @@
+"""Per-ComputeDomain DaemonSet management.
+
+The analog of compute-domain-controller/daemonset.go:58-189: renders
+``templates/compute-domain-daemon.tmpl.yaml`` per CD (name
+``computedomain-daemon-<uid>``, nodeSelector on the CD label so it lands only
+on nodes the CD kubelet plugin has labeled — the "CD follows workload" pull
+model), creating the daemon RCT first so the pod's resource claim resolves.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import string
+
+import yaml
+
+from tpudra import featuregates
+from tpudra.controller.resourceclaimtemplate import CD_UID_LABEL
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import NotFound
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_TEMPLATE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "templates",
+    "compute-domain-daemon.tmpl.yaml",
+)
+
+
+class DaemonSetManager:
+    def __init__(
+        self,
+        kube: KubeAPI,
+        driver_namespace: str,
+        image: str = "tpudra:latest",
+        template_path: str = DEFAULT_TEMPLATE_PATH,
+        log_verbosity: int = 0,
+    ):
+        self._kube = kube
+        self._ns = driver_namespace
+        self._image = image
+        self._template_path = template_path
+        self._log_verbosity = log_verbosity
+
+    def name(self, cd_uid: str) -> str:
+        return f"computedomain-daemon-{cd_uid}"
+
+    def render(self, cd: dict, daemon_rct_name: str) -> dict:
+        with open(self._template_path) as f:
+            template = string.Template(f.read())
+        gates = ",".join(
+            f"{k}={'true' if v else 'false'}" for k, v in sorted(featuregates.to_map().items())
+        )
+        rendered = template.substitute(
+            name=self.name(cd["metadata"]["uid"]),
+            namespace=self._ns,
+            cd_uid=cd["metadata"]["uid"],
+            image=self._image,
+            daemon_rct_name=daemon_rct_name,
+            feature_gates=gates,
+            log_verbosity=str(self._log_verbosity),
+        )
+        return yaml.safe_load(rendered)
+
+    def ensure(self, cd: dict, daemon_rct_name: str) -> dict:
+        name = self.name(cd["metadata"]["uid"])
+        try:
+            return self._kube.get(gvr.DAEMONSETS, name, self._ns)
+        except NotFound:
+            pass
+        obj = self.render(cd, daemon_rct_name)
+        logger.info("creating DaemonSet %s/%s", self._ns, name)
+        return self._kube.create(gvr.DAEMONSETS, obj, self._ns)
+
+    def remove(self, cd_uid: str) -> None:
+        try:
+            self._kube.delete(gvr.DAEMONSETS, self.name(cd_uid), self._ns)
+        except NotFound:
+            pass
+
+    def assert_removed(self, cd_uid: str) -> bool:
+        try:
+            self._kube.get(gvr.DAEMONSETS, self.name(cd_uid), self._ns)
+            return False
+        except NotFound:
+            return True
+
+    def list_all(self) -> list[dict]:
+        return self._kube.list(
+            gvr.DAEMONSETS, self._ns, label_selector=CD_UID_LABEL
+        ).get("items", [])

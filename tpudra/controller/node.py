@@ -1,0 +1,79 @@
+"""Node label hygiene for ComputeDomains.
+
+The analog of compute-domain-controller/node.go:42-168: the CD kubelet plugin
+labels nodes ``resource.tpu.google.com/computeDomain=<uid>`` to attract the
+daemon DaemonSet; the controller removes those labels when a CD is deleted and
+periodically sweeps labels whose CD no longer exists (a node can miss the
+deletion if its plugin was down).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable
+
+from tpudra.api.computedomain import COMPUTE_DOMAIN_NODE_LABEL
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import ApiError
+
+logger = logging.getLogger(__name__)
+
+
+class NodeManager:
+    def __init__(self, kube: KubeAPI, cd_exists: Callable[[str], bool], period: float = 600.0):
+        self._kube = kube
+        self._cd_exists = cd_exists
+        self._period = period
+
+    def remove_labels_for(self, cd_uid: str) -> int:
+        """Strip the CD label from every node carrying it
+        (RemoveComputeDomainLabels, node.go:114)."""
+        removed = 0
+        nodes = self._kube.list(
+            gvr.NODES, label_selector=f"{COMPUTE_DOMAIN_NODE_LABEL}={cd_uid}"
+        ).get("items", [])
+        for node in nodes:
+            name = node["metadata"]["name"]
+            try:
+                self._kube.patch(
+                    gvr.NODES, name, {"metadata": {"labels": {COMPUTE_DOMAIN_NODE_LABEL: None}}}
+                )
+                removed += 1
+            except ApiError as e:
+                logger.warning("removing CD label from node %s: %s", name, e)
+        return removed
+
+    def sweep_stale_labels(self) -> int:
+        """Remove labels referencing CDs that no longer exist."""
+        removed = 0
+        nodes = self._kube.list(
+            gvr.NODES, label_selector=COMPUTE_DOMAIN_NODE_LABEL
+        ).get("items", [])
+        for node in nodes:
+            uid = node["metadata"].get("labels", {}).get(COMPUTE_DOMAIN_NODE_LABEL, "")
+            if uid and not self._cd_exists(uid):
+                name = node["metadata"]["name"]
+                logger.info("sweeping stale CD label %s from node %s", uid, name)
+                try:
+                    self._kube.patch(
+                        gvr.NODES,
+                        name,
+                        {"metadata": {"labels": {COMPUTE_DOMAIN_NODE_LABEL: None}}},
+                    )
+                    removed += 1
+                except ApiError as e:
+                    logger.warning("sweeping node %s: %s", name, e)
+        return removed
+
+    def start(self, stop: threading.Event) -> None:
+        def run() -> None:
+            while not stop.is_set():
+                try:
+                    self.sweep_stale_labels()
+                except Exception:  # noqa: BLE001 — periodic GC must survive
+                    logger.exception("node label sweep failed")
+                stop.wait(self._period)
+
+        threading.Thread(target=run, daemon=True, name="node-label-sweep").start()

@@ -1,0 +1,187 @@
+"""ResourceClaimTemplate management for ComputeDomains.
+
+The analog of compute-domain-controller/resourceclaimtemplate.go:79-399. Two
+specializations of one base:
+
+- the **daemon RCT**, in the driver's namespace, consumed by the DaemonSet
+  pod; deviceClass ``compute-domain-daemon.tpu.google.com``, opaque
+  ``ComputeDomainDaemonConfig{domainID}``.
+- the **workload RCT**, created in the *CD's own namespace* under the
+  user-chosen name from ``spec.channel.resourceClaimTemplate.name``;
+  deviceClass ``compute-domain-default-channel.tpu.google.com``, opaque
+  ``ComputeDomainChannelConfig{domainID, allocationMode}`` — this is the
+  template user pods reference to receive a slice channel.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra.api.computedomain import (
+    CHANNEL_ALLOCATION_MODE_SINGLE,
+    ComputeDomainChannelConfig,
+    ComputeDomainDaemonConfig,
+)
+from tpudra.api.serde import encode
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import NotFound
+
+logger = logging.getLogger(__name__)
+
+CD_UID_LABEL = "resource.tpu.google.com/computeDomain"
+DAEMON_DEVICE_CLASS = "compute-domain-daemon.tpu.google.com"
+CHANNEL_DEVICE_CLASS = "compute-domain-default-channel.tpu.google.com"
+
+
+def _rct(
+    name: str,
+    namespace: str,
+    cd: dict,
+    device_class: str,
+    opaque_config: dict,
+    owner_ref: bool,
+) -> dict:
+    meta: dict = {
+        "name": name,
+        "namespace": namespace,
+        "labels": {CD_UID_LABEL: cd["metadata"]["uid"]},
+    }
+    if owner_ref:
+        meta["ownerReferences"] = [
+            {
+                "apiVersion": cd.get("apiVersion", ""),
+                "kind": cd.get("kind", "ComputeDomain"),
+                "name": cd["metadata"]["name"],
+                "uid": cd["metadata"]["uid"],
+                "controller": True,
+            }
+        ]
+    return {
+        "apiVersion": "resource.k8s.io/v1",
+        "kind": "ResourceClaimTemplate",
+        "metadata": meta,
+        "spec": {
+            "spec": {
+                "devices": {
+                    "requests": [
+                        {
+                            "name": "channel" if device_class == CHANNEL_DEVICE_CLASS else "daemon",
+                            "exactly": {
+                                "deviceClassName": device_class,
+                                "allocationMode": "ExactCount",
+                                "count": 1,
+                            },
+                        }
+                    ],
+                    "config": [
+                        {
+                            "opaque": {
+                                "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                                "parameters": opaque_config,
+                            }
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+class DaemonResourceClaimTemplateManager:
+    """Daemon RCT in the driver namespace (resourceclaimtemplate.go:304)."""
+
+    def __init__(self, kube: KubeAPI, driver_namespace: str):
+        self._kube = kube
+        self._ns = driver_namespace
+
+    def name(self, cd: dict) -> str:
+        return f"compute-domain-daemon-{cd['metadata']['uid']}"
+
+    def ensure(self, cd: dict) -> dict:
+        name = self.name(cd)
+        try:
+            return self._kube.get(gvr.RESOURCE_CLAIM_TEMPLATES, name, self._ns)
+        except NotFound:
+            pass
+        config = ComputeDomainDaemonConfig(domain_id=cd["metadata"]["uid"])
+        obj = _rct(
+            name,
+            self._ns,
+            cd,
+            DAEMON_DEVICE_CLASS,
+            encode(config),
+            owner_ref=False,  # cross-namespace owners are not allowed
+        )
+        logger.info("creating daemon RCT %s/%s", self._ns, name)
+        return self._kube.create(gvr.RESOURCE_CLAIM_TEMPLATES, obj, self._ns)
+
+    def remove(self, cd_uid: str) -> None:
+        name = f"compute-domain-daemon-{cd_uid}"
+        try:
+            self._kube.delete(gvr.RESOURCE_CLAIM_TEMPLATES, name, self._ns)
+        except NotFound:
+            pass
+
+    def assert_removed(self, cd_uid: str) -> bool:
+        try:
+            self._kube.get(
+                gvr.RESOURCE_CLAIM_TEMPLATES, f"compute-domain-daemon-{cd_uid}", self._ns
+            )
+            return False
+        except NotFound:
+            return True
+
+
+class WorkloadResourceClaimTemplateManager:
+    """Workload channel RCT in the CD's namespace
+    (resourceclaimtemplate.go:364)."""
+
+    def __init__(self, kube: KubeAPI):
+        self._kube = kube
+
+    @staticmethod
+    def requested_name(cd: dict) -> str | None:
+        channel = cd.get("spec", {}).get("channel") or {}
+        name = (channel.get("resourceClaimTemplate") or {}).get("name", "")
+        return name or None
+
+    def ensure(self, cd: dict) -> dict | None:
+        name = self.requested_name(cd)
+        if name is None:
+            return None
+        ns = cd["metadata"]["namespace"]
+        try:
+            return self._kube.get(gvr.RESOURCE_CLAIM_TEMPLATES, name, ns)
+        except NotFound:
+            pass
+        channel = cd.get("spec", {}).get("channel") or {}
+        config = ComputeDomainChannelConfig(
+            domain_id=cd["metadata"]["uid"],
+            allocation_mode=channel.get("allocationMode", CHANNEL_ALLOCATION_MODE_SINGLE),
+        )
+        obj = _rct(name, ns, cd, CHANNEL_DEVICE_CLASS, encode(config), owner_ref=True)
+        logger.info("creating workload RCT %s/%s", ns, name)
+        return self._kube.create(gvr.RESOURCE_CLAIM_TEMPLATES, obj, ns)
+
+    def remove(self, cd: dict) -> None:
+        name = self.requested_name(cd)
+        if name is None:
+            return
+        try:
+            self._kube.delete(
+                gvr.RESOURCE_CLAIM_TEMPLATES, name, cd["metadata"]["namespace"]
+            )
+        except NotFound:
+            pass
+
+    def assert_removed(self, cd: dict) -> bool:
+        name = self.requested_name(cd)
+        if name is None:
+            return True
+        try:
+            self._kube.get(gvr.RESOURCE_CLAIM_TEMPLATES, name, cd["metadata"]["namespace"])
+            return False
+        except NotFound:
+            return True

@@ -1,0 +1,32 @@
+"""Create-or-update helpers shared by the node plugins."""
+
+from __future__ import annotations
+
+import logging
+
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import Conflict, NotFound
+
+logger = logging.getLogger(__name__)
+
+
+def apply_resource_slice(kube: KubeAPI, obj: dict, attempts: int = 3) -> bool:
+    """Create the slice, or update it carrying the live resourceVersion;
+    retries conflicts by re-reading.  Returns False if conflicts persist
+    (the caller's next publish supersedes the stale slice anyway)."""
+    name = obj["metadata"]["name"]
+    for _ in range(attempts):
+        try:
+            existing = kube.get(gvr.RESOURCE_SLICES, name)
+        except NotFound:
+            kube.create(gvr.RESOURCE_SLICES, obj)
+            return True
+        obj["metadata"]["resourceVersion"] = existing["metadata"].get("resourceVersion")
+        try:
+            kube.update(gvr.RESOURCE_SLICES, obj)
+            return True
+        except Conflict:
+            continue
+    logger.warning("giving up on ResourceSlice %s after repeated conflicts", name)
+    return False

@@ -26,8 +26,9 @@ from tpudra import TPU_DRIVER_NAME, featuregates
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
 from tpudra.flock import Flock, FlockTimeout
 from tpudra.kube import gvr
+from tpudra.kube.apply import apply_resource_slice
 from tpudra.kube.client import KubeAPI
-from tpudra.kube.errors import Conflict, NotFound
+from tpudra.kube.errors import NotFound
 from tpudra.plugin import allocatable as alloc
 from tpudra.plugin.cdi import CDIHandler
 from tpudra.plugin.checkpoint import CheckpointManager
@@ -128,20 +129,20 @@ class Driver:
 
     def prepare_resource_claims(self, claims: list[dict]) -> dict:
         out: dict[str, dict] = {}
-        republish = False
+        # Any prepare can flip sibling visibility in either direction (a vfio
+        # grant withholds the chip; a chip grant withholds the vfio alias) —
+        # republish once per batch when the withheld set changed
+        # (driver.go:361).  bound_sibling_devices is empty-and-free with
+        # passthrough disabled.
+        withheld_before = self.state.bound_sibling_devices()
         for claim in claims:
             uid = claim.get("metadata", {}).get("uid", "")
             try:
-                result, vfio = self._prepare_one(claim)
-                out[uid] = result
-                republish = republish or vfio
+                out[uid] = self._prepare_one(claim)
             except Exception as e:  # noqa: BLE001 — per-claim fault barrier
                 logger.exception("prepare failed for claim %s", uid)
                 out[uid] = {"error": str(e), "permanent": isinstance(e, PermanentError)}
-        if republish:
-            # Passthrough prepares flip sibling visibility; republish once
-            # per batch so the scheduler stops seeing the bound full-chip
-            # alias (driver.go:361).
+        if self.state.bound_sibling_devices() != withheld_before:
             self.publish_resources()
         return {"claims": out}
 
@@ -156,11 +157,11 @@ class Driver:
             except Exception as e:  # noqa: BLE001
                 logger.exception("unprepare failed for claim %s", uid)
                 out[uid] = {"error": str(e)}
-        if withheld_before and self.state.bound_sibling_devices() != withheld_before:
+        if self.state.bound_sibling_devices() != withheld_before:
             self.publish_resources()  # siblings became visible again
         return {"claims": out}
 
-    def _prepare_one(self, claim: dict) -> tuple[dict, bool]:
+    def _prepare_one(self, claim: dict) -> dict:
         t0 = time.monotonic()
         try:
             with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
@@ -172,11 +173,6 @@ class Driver:
             "t_prep_lock_acq=%.4fs t_prep=%.4fs claim=%s",
             t_lock, time.monotonic() - t0, claim.get("metadata", {}).get("uid"),
         )
-        vfio = any(
-            self.state.allocatable.get(d.device_name) is not None
-            and self.state.allocatable[d.device_name].type == alloc.TYPE_VFIO
-            for d in devices
-        )
         return {
             "devices": [
                 {
@@ -187,7 +183,7 @@ class Driver:
                 }
                 for d in devices
             ]
-        }, vfio
+        }
 
     def _unprepare_one(self, uid: str) -> None:
         if not uid:
@@ -223,29 +219,13 @@ class Driver:
             self._pool_generation += 1
             published_names = {s["metadata"]["name"] for s in slices}
             for s in slices:
-                self._apply_slice(s)
+                apply_resource_slice(self._kube, s)
             self._delete_stale_slices(published_names)
             logger.info(
                 "published %d ResourceSlice(s), %d devices, %d unhealthy",
                 len(slices), len(res.devices), len(unhealthy),
             )
             return slices
-
-    def _apply_slice(self, obj: dict) -> None:
-        name = obj["metadata"]["name"]
-        for _attempt in range(3):
-            try:
-                existing = self._kube.get(gvr.RESOURCE_SLICES, name)
-            except NotFound:
-                self._kube.create(gvr.RESOURCE_SLICES, obj)
-                return
-            obj["metadata"]["resourceVersion"] = existing["metadata"].get("resourceVersion")
-            try:
-                self._kube.update(gvr.RESOURCE_SLICES, obj)
-                return
-            except Conflict:
-                continue  # re-read the resourceVersion and retry
-        logger.warning("giving up on ResourceSlice %s after repeated conflicts", name)
 
     def _delete_stale_slices(self, keep: set[str]) -> None:
         """Remove slices this node published in a previous shape (e.g. the
