@@ -1,0 +1,322 @@
+// tpu-slicewatchd — per-node slice coordination daemon.
+//
+// The TPU-native replacement for the closed-source nvidia-imex daemon the
+// reference supervises (cmd/compute-domain-daemon/process.go, main.go:49-50):
+// where IMEX brokers cross-node GPU memory export over NVLink, a TPU slice's
+// ICI fabric needs no runtime broker — what the ComputeDomain machinery needs
+// from this daemon is exactly the part it *did* use IMEX for:
+//
+//   1. peer liveness over DCN: every daemon heartbeats every other host in
+//      the slice (UDP), so "the domain is formed" is an observable state;
+//   2. a READY probe: a TCP status socket answering "Q\n" with "READY\n"
+//      once all expected peers are alive (the nvidia-imex-ctl -q analog,
+//      reference main.go:429-438);
+//   3. config-by-files + reload-by-signal: peers come from a static
+//      nodes.cfg of DNS names indirected through /etc/hosts; SIGHUP
+//      re-resolves (the reference's SIGUSR1-to-imex dance, main.go:405).
+//
+// Single-threaded poll(2) event loop; no dependencies beyond POSIX.
+//
+// Usage:
+//   tpu-slicewatchd --nodes-config nodes.cfg [--hosts /etc/hosts]
+//                   --index N --expected M
+//                   [--status-port 7173] [--peer-port 7174]
+//                   [--heartbeat-ms 500] [--stale-ms 3000]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+volatile sig_atomic_t g_reload = 0;
+volatile sig_atomic_t g_stop = 0;
+
+void on_sighup(int) { g_reload = 1; }
+void on_term(int) { g_stop = 1; }
+
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+struct Config {
+  std::string nodes_config;
+  std::string hosts_path = "/etc/hosts";
+  int index = 0;
+  int expected = 1;
+  int status_port = 7173;
+  int peer_port = 7174;
+  int heartbeat_ms = 500;
+  int stale_ms = 3000;
+};
+
+struct Peer {
+  std::string name;
+  std::string ip;  // empty or "0.0.0.0" = not yet known
+  int port = 0;    // 0 = the shared --peer-port
+  int64_t last_seen_ms = 0;
+};
+
+// Parse the hosts file ourselves: the whole point of the /etc/hosts
+// indirection is that membership changes land as file rewrites, and libc
+// resolvers cache — reading the file on SIGHUP gives deterministic reload
+// semantics (the reason the reference signals its daemon, dnsnames.go:145).
+std::map<std::string, std::string> parse_hosts(const std::string& path) {
+  std::map<std::string, std::string> out;
+  std::ifstream f(path);
+  std::string line;
+  while (std::getline(f, line)) {
+    auto hash = line.find('#');
+    if (hash != std::string::npos) line = line.substr(0, hash);
+    std::istringstream ss(line);
+    std::string ip, name;
+    if (!(ss >> ip)) continue;
+    while (ss >> name) out[name] = ip;
+  }
+  return out;
+}
+
+// nodes.cfg lines are DNS names, optionally "name:port" — the port override
+// exists for single-host testing, where every peer is 127.0.0.1 and only the
+// port distinguishes daemons; production files carry bare names.
+std::vector<std::pair<std::string, int>> parse_nodes_config(
+    const std::string& path) {
+  std::vector<std::pair<std::string, int>> names;
+  std::ifstream f(path);
+  std::string line;
+  while (std::getline(f, line)) {
+    while (!line.empty() && (line.back() == '\r' || line.back() == ' ')) line.pop_back();
+    if (line.empty() || line[0] == '#') continue;
+    auto colon = line.rfind(':');
+    int port = 0;
+    if (colon != std::string::npos) {
+      port = atoi(line.substr(colon + 1).c_str());
+      line = line.substr(0, colon);
+    }
+    names.emplace_back(line, port);
+  }
+  return names;
+}
+
+class SliceWatch {
+ public:
+  explicit SliceWatch(const Config& cfg) : cfg_(cfg) {}
+
+  bool init() {
+    peer_fd_ = ::socket(AF_INET, SOCK_DGRAM, 0);
+    if (peer_fd_ < 0) return perr("peer socket");
+    int one = 1;
+    setsockopt(peer_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(cfg_.peer_port);
+    if (bind(peer_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return perr("bind peer port");
+
+    status_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (status_fd_ < 0) return perr("status socket");
+    setsockopt(status_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in saddr{};
+    saddr.sin_family = AF_INET;
+    saddr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    saddr.sin_port = htons(cfg_.status_port);
+    if (bind(status_fd_, reinterpret_cast<sockaddr*>(&saddr), sizeof(saddr)) < 0)
+      return perr("bind status port");
+    if (listen(status_fd_, 8) < 0) return perr("listen status");
+    reload();
+    return true;
+  }
+
+  void reload() {
+    auto names = parse_nodes_config(cfg_.nodes_config);
+    auto hosts = parse_hosts(cfg_.hosts_path);
+    std::vector<Peer> next;
+    for (size_t i = 0; i < names.size(); i++) {
+      Peer p;
+      p.name = names[i].first;
+      p.port = names[i].second;
+      auto it = hosts.find(p.name);
+      if (it != hosts.end() && it->second != "0.0.0.0") p.ip = it->second;
+      // Preserve liveness across reloads for unchanged IPs.
+      if (i < peers_.size() && peers_[i].ip == p.ip)
+        p.last_seen_ms = peers_[i].last_seen_ms;
+      next.push_back(p);
+    }
+    peers_ = std::move(next);
+    fprintf(stderr, "[slicewatchd] reloaded: %zu names, %d resolved\n",
+            peers_.size(), resolved_count());
+  }
+
+  int resolved_count() const {
+    int n = 0;
+    for (const auto& p : peers_)
+      if (!p.ip.empty()) n++;
+    return n;
+  }
+
+  bool ready() const {
+    // READY = the whole slice is formed: every one of the expected hosts is
+    // resolved and recently alive.  A 1-host slice is trivially READY.
+    if (cfg_.expected <= 1) return true;
+    if (resolved_count() < cfg_.expected) return false;
+    int64_t now = now_ms();
+    int alive = 0;
+    for (size_t i = 0; i < peers_.size(); i++) {
+      if (peers_[i].ip.empty()) continue;
+      if (static_cast<int>(i) == cfg_.index ||
+          now - peers_[i].last_seen_ms <= cfg_.stale_ms)
+        alive++;
+    }
+    return alive >= cfg_.expected;
+  }
+
+  void send_heartbeats() {
+    char msg[32];
+    int len = snprintf(msg, sizeof(msg), "HB %d", cfg_.index);
+    for (const auto& p : peers_) {
+      if (p.ip.empty()) continue;
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(p.port > 0 ? p.port : cfg_.peer_port);
+      if (inet_pton(AF_INET, p.ip.c_str(), &addr.sin_addr) != 1) continue;
+      sendto(peer_fd_, msg, len, 0, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    }
+  }
+
+  void receive_heartbeats() {
+    char buf[64];
+    for (;;) {
+      ssize_t n = recv(peer_fd_, buf, sizeof(buf) - 1, MSG_DONTWAIT);
+      if (n <= 0) return;
+      buf[n] = '\0';
+      int idx = -1;
+      if (sscanf(buf, "HB %d", &idx) == 1 && idx >= 0 &&
+          idx < static_cast<int>(peers_.size()))
+        peers_[idx].last_seen_ms = now_ms();
+    }
+  }
+
+  void answer_status() {
+    int fd = accept(status_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    // Bound the read: a client that connects and stalls must not freeze the
+    // single-threaded loop (heartbeats stop → peers mark us stale).
+    struct timeval tv = {0, 200000};  // 200 ms
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    char buf[16];
+    ssize_t n = read(fd, buf, sizeof(buf));
+    (void)n;
+    std::string reply;
+    if (ready()) {
+      reply = "READY\n";
+    } else {
+      char detail[96];
+      snprintf(detail, sizeof(detail), "NOT_READY resolved=%d/%d\n",
+               resolved_count(), cfg_.expected);
+      reply = detail;
+    }
+    ssize_t w = write(fd, reply.data(), reply.size());
+    (void)w;
+    close(fd);
+  }
+
+  int run() {
+    int64_t next_hb = 0;
+    while (!g_stop) {
+      if (g_reload) {
+        g_reload = 0;
+        reload();
+      }
+      int64_t now = now_ms();
+      if (now >= next_hb) {
+        send_heartbeats();
+        next_hb = now + cfg_.heartbeat_ms;
+      }
+      struct pollfd fds[2] = {
+          {peer_fd_, POLLIN, 0},
+          {status_fd_, POLLIN, 0},
+      };
+      int timeout = static_cast<int>(next_hb - now);
+      if (timeout < 0) timeout = 0;
+      int rc = poll(fds, 2, timeout);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        return perr("poll") ? 1 : 1;
+      }
+      if (fds[0].revents & POLLIN) receive_heartbeats();
+      if (fds[1].revents & POLLIN) answer_status();
+    }
+    return 0;
+  }
+
+ private:
+  bool perr(const char* what) {
+    fprintf(stderr, "[slicewatchd] %s: %s\n", what, strerror(errno));
+    return false;
+  }
+
+  Config cfg_;
+  std::vector<Peer> peers_;
+  int peer_fd_ = -1;
+  int status_fd_ = -1;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Config cfg;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "missing value for %s\n", a.c_str());
+        exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--nodes-config") cfg.nodes_config = next();
+    else if (a == "--hosts") cfg.hosts_path = next();
+    else if (a == "--index") cfg.index = atoi(next());
+    else if (a == "--expected") cfg.expected = atoi(next());
+    else if (a == "--status-port") cfg.status_port = atoi(next());
+    else if (a == "--peer-port") cfg.peer_port = atoi(next());
+    else if (a == "--heartbeat-ms") cfg.heartbeat_ms = atoi(next());
+    else if (a == "--stale-ms") cfg.stale_ms = atoi(next());
+    else {
+      fprintf(stderr, "unknown flag %s\n", a.c_str());
+      return 2;
+    }
+  }
+  if (cfg.nodes_config.empty()) {
+    fprintf(stderr, "--nodes-config is required\n");
+    return 2;
+  }
+  signal(SIGHUP, on_sighup);
+  signal(SIGTERM, on_term);
+  signal(SIGINT, on_term);
+
+  SliceWatch sw(cfg);
+  if (!sw.init()) return 1;
+  fprintf(stderr,
+          "[slicewatchd] up: index=%d expected=%d peer-port=%d status-port=%d\n",
+          cfg.index, cfg.expected, cfg.peer_port, cfg.status_port);
+  return sw.run();
+}

@@ -1,0 +1,358 @@
+// libtpuinfo implementation.  See tpuinfo.h for the contract.
+
+#include "tpuinfo.h"
+
+#include <dirent.h>
+#include <fcntl.h>
+#include <sys/file.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <random>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct GenSpec {
+  const char* name;
+  int tensorcores;
+  long long hbm_bytes;
+  int chips_per_host;
+  int host_bounds[3];  // the host's block of the slice mesh (x, y, z)
+};
+
+// Public Cloud TPU system-architecture numbers (mirrors
+// tpudra/devicelib/topology.py GENERATIONS).
+const GenSpec kGenerations[] = {
+    {"v4", 2, 32LL << 30, 4, {2, 2, 1}},
+    {"v5e", 1, 16LL << 30, 8, {2, 4, 1}},
+    {"v5p", 2, 95LL << 30, 4, {2, 2, 1}},
+    {"v6e", 1, 32LL << 30, 8, {2, 4, 1}},
+};
+const int kHbmSlices = 8;
+
+const GenSpec* find_gen(const std::string& name) {
+  for (const auto& g : kGenerations)
+    if (name == g.name) return &g;
+  return nullptr;
+}
+
+struct Partition {
+  int parent_index;
+  std::string profile;
+  int core_start;
+  int hbm_start;
+  std::string uuid;
+};
+
+}  // namespace
+
+struct tpuinfo_handle {
+  std::vector<tpuinfo_chip> chips;
+  tpuinfo_topology topo{};
+  std::string state_file;  // partition registry; empty = partitions disabled
+  std::string error;
+
+  int fail(const std::string& msg) {
+    error = msg;
+    return -1;
+  }
+};
+
+namespace {
+
+std::map<std::string, std::string> parse_config(const std::string& path,
+                                                std::string* err) {
+  std::map<std::string, std::string> kv;
+  std::ifstream f(path);
+  if (!f) {
+    *err = "cannot open config " + path;
+    return kv;
+  }
+  std::string line;
+  while (std::getline(f, line)) {
+    if (line.empty() || line[0] == '#') continue;
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    kv[line.substr(0, eq)] = line.substr(eq + 1);
+  }
+  return kv;
+}
+
+int count_accel_devices(const std::string& dev_root) {
+  int n = 0;
+  DIR* d = opendir(dev_root.c_str());
+  if (d == nullptr) return 0;
+  while (dirent* e = readdir(d)) {
+    if (strncmp(e->d_name, "accel", 5) == 0 && isdigit(e->d_name[5])) n++;
+  }
+  closedir(d);
+  return n;
+}
+
+std::string getenv_or(const char* name, const std::string& fallback) {
+  const char* v = getenv(name);
+  return v != nullptr ? std::string(v) : fallback;
+}
+
+void fill_chips(tpuinfo_handle* h, const GenSpec& gen, int num_chips,
+                const std::string& slice_uuid, const std::string& partition_id,
+                int host_index) {
+  // Host-local chips occupy a contiguous block of the slice mesh; hosts
+  // stack their blocks along z (exactly chip_coords_for_host in
+  // tpudra/devicelib/topology.py:191-214, so mock and native agree).
+  const int* hb = gen.host_bounds;
+  for (int i = 0; i < num_chips; i++) {
+    tpuinfo_chip c{};
+    c.index = i;
+    snprintf(c.uuid, sizeof(c.uuid), "tpu-%s-%d-%d", slice_uuid.c_str(),
+             host_index, i);
+    snprintf(c.generation, sizeof(c.generation), "%s", gen.name);
+    c.coords[0] = i % hb[0];
+    c.coords[1] = (i / hb[0]) % hb[1];
+    c.coords[2] = host_index * hb[2] + i / (hb[0] * hb[1]);
+    snprintf(c.pci_address, sizeof(c.pci_address), "0000:%02x:00.0", 0x10 + i);
+    snprintf(c.clique_id, sizeof(c.clique_id), "%s.%s", slice_uuid.c_str(),
+             partition_id.c_str());
+    c.hbm_bytes = gen.hbm_bytes;
+    c.tensorcores = gen.tensorcores;
+    h->chips.push_back(c);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Partition registry: flock-guarded line format
+//   uuid parent profile core_start hbm_start
+// ---------------------------------------------------------------------------
+
+class LockedStateFile {
+ public:
+  // The lock lives on a sibling ".lock" file that is never renamed: locking
+  // the state file itself would break mutual exclusion the moment write()
+  // replaces it (the flock stays with the orphaned inode).  Mirrors the
+  // separate cp.lock convention in tpudra/plugin/checkpoint.py.
+  explicit LockedStateFile(const std::string& path) : path_(path) {
+    fd_ = open((path + ".lock").c_str(), O_CREAT | O_RDWR, 0644);
+    if (fd_ >= 0) flock(fd_, LOCK_EX);
+  }
+  ~LockedStateFile() {
+    if (fd_ >= 0) {
+      flock(fd_, LOCK_UN);
+      close(fd_);
+    }
+  }
+  bool ok() const { return fd_ >= 0; }
+
+  std::vector<Partition> read() {
+    std::vector<Partition> out;
+    std::ifstream f(path_);
+    std::string line;
+    while (std::getline(f, line)) {
+      Partition p;
+      char uuid[64], profile[16];
+      if (sscanf(line.c_str(), "%63s %d %15s %d %d", uuid, &p.parent_index,
+                 profile, &p.core_start, &p.hbm_start) == 5) {
+        p.uuid = uuid;
+        p.profile = profile;
+        out.push_back(p);
+      }
+    }
+    return out;
+  }
+
+  void write(const std::vector<Partition>& parts) {
+    std::string tmp = path_ + ".tmp";
+    {
+      std::ofstream f(tmp, std::ios::trunc);
+      for (const auto& p : parts)
+        f << p.uuid << ' ' << p.parent_index << ' ' << p.profile << ' '
+          << p.core_start << ' ' << p.hbm_start << '\n';
+    }
+    rename(tmp.c_str(), path_.c_str());
+  }
+
+ private:
+  std::string path_;
+  int fd_ = -1;
+};
+
+bool parse_profile(const std::string& profile, int* cores, int* hbm) {
+  return sscanf(profile.c_str(), "%dc.%dhbm", cores, hbm) == 2;
+}
+
+bool ranges_overlap(int a0, int a1, int b0, int b1) {
+  return a0 < b1 && b0 < a1;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
+  auto* h = new tpuinfo_handle();
+  std::string gen_name, slice_uuid, partition_id;
+  int num_chips = 0, host_index = 0, num_hosts = 1;
+
+  if (config_path != nullptr && config_path[0] != '\0') {
+    std::string err;
+    auto kv = parse_config(config_path, &err);
+    if (!err.empty()) {
+      h->error = err;
+      *out = h;
+      return -1;
+    }
+    gen_name = kv.count("generation") ? kv["generation"] : "v5p";
+    num_chips = kv.count("num_chips") ? atoi(kv["num_chips"].c_str()) : 0;
+    host_index = kv.count("host_index") ? atoi(kv["host_index"].c_str()) : 0;
+    num_hosts = kv.count("num_hosts") ? atoi(kv["num_hosts"].c_str()) : 1;
+    slice_uuid = kv.count("slice_uuid") ? kv["slice_uuid"] : "slice-local";
+    partition_id = kv.count("partition_id") ? kv["partition_id"] : "0";
+    h->state_file = kv.count("state_file") ? kv["state_file"] : "";
+  } else {
+    // Cloud TPU VM contract: device nodes + TPU_* env.
+    gen_name = getenv_or("TPU_ACCELERATOR_TYPE", "v5p");
+    auto dash = gen_name.find('-');  // "v5p-16" → "v5p"
+    if (dash != std::string::npos) gen_name = gen_name.substr(0, dash);
+    num_chips = count_accel_devices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
+    host_index = atoi(getenv_or("TPU_WORKER_ID", "0").c_str());
+    num_hosts = atoi(getenv_or("TPU_WORKER_COUNT", "1").c_str());
+    slice_uuid = getenv_or("TPU_SLICE_UUID", "slice-local");
+    partition_id = "0";
+    h->state_file = getenv_or("TPUINFO_STATE_FILE", "/var/run/tpuinfo-state");
+  }
+
+  const GenSpec* gen = find_gen(gen_name);
+  if (gen == nullptr) {
+    h->error = "unknown TPU generation " + gen_name;
+    *out = h;
+    return -1;
+  }
+  if (num_chips <= 0) num_chips = gen->chips_per_host;
+
+  fill_chips(h, *gen, num_chips, slice_uuid, partition_id, host_index);
+  snprintf(h->topo.slice_uuid, sizeof(h->topo.slice_uuid), "%s",
+           slice_uuid.c_str());
+  // Mesh = host block stacked along z (topology.py resolve():186-187).
+  h->topo.mesh[0] = gen->host_bounds[0];
+  h->topo.mesh[1] = gen->host_bounds[1];
+  h->topo.mesh[2] = gen->host_bounds[2] * num_hosts;
+  h->topo.host_index = host_index;
+  h->topo.num_hosts = num_hosts;
+  *out = h;
+  return 0;
+}
+
+void tpuinfo_close(tpuinfo_handle* h) { delete h; }
+
+int tpuinfo_chip_count(tpuinfo_handle* h) {
+  return static_cast<int>(h->chips.size());
+}
+
+int tpuinfo_get_chip(tpuinfo_handle* h, int i, tpuinfo_chip* out) {
+  if (i < 0 || i >= static_cast<int>(h->chips.size()))
+    return h->fail("chip index out of range");
+  *out = h->chips[i];
+  return 0;
+}
+
+int tpuinfo_get_topology(tpuinfo_handle* h, tpuinfo_topology* out) {
+  *out = h->topo;
+  return 0;
+}
+
+int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,
+                             const char* profile, int core_start,
+                             int hbm_start, tpuinfo_partition* out) {
+  if (h->state_file.empty()) return h->fail("partitioning disabled (no state_file)");
+  if (parent_index < 0 || parent_index >= static_cast<int>(h->chips.size()))
+    return h->fail("parent chip out of range");
+  const tpuinfo_chip& chip = h->chips[parent_index];
+  int cores = 0, hbm = 0;
+  if (!parse_profile(profile, &cores, &hbm))
+    return h->fail(std::string("malformed profile ") + profile);
+  if (cores < 1 || core_start < 0 || core_start + cores > chip.tensorcores)
+    return h->fail("core placement out of range");
+  if (hbm < 1 || hbm_start < 0 || hbm_start + hbm > kHbmSlices)
+    return h->fail("hbm placement out of range");
+
+  LockedStateFile sf(h->state_file);
+  if (!sf.ok()) return h->fail("cannot open state file " + h->state_file);
+  auto parts = sf.read();
+  for (const auto& p : parts) {
+    if (p.parent_index != parent_index) continue;
+    int pc = 0, ph = 0;
+    parse_profile(p.profile, &pc, &ph);
+    if (ranges_overlap(core_start, core_start + cores, p.core_start,
+                       p.core_start + pc) ||
+        ranges_overlap(hbm_start, hbm_start + hbm, p.hbm_start,
+                       p.hbm_start + ph))
+      return h->fail("placement overlaps live partition " + p.uuid);
+  }
+  Partition p;
+  p.parent_index = parent_index;
+  p.profile = profile;
+  p.core_start = core_start;
+  p.hbm_start = hbm_start;
+  static std::mt19937_64 rng{std::random_device{}()};
+  char uuid[64];
+  snprintf(uuid, sizeof(uuid), "part-%d-%s-%d-%d-%08llx", parent_index, profile,
+           core_start, hbm_start,
+           static_cast<unsigned long long>(rng() & 0xffffffffULL));
+  p.uuid = uuid;
+  parts.push_back(p);
+  sf.write(parts);
+
+  if (out != nullptr) {
+    out->parent_index = p.parent_index;
+    snprintf(out->profile, sizeof(out->profile), "%s", p.profile.c_str());
+    out->core_start = p.core_start;
+    out->hbm_start = p.hbm_start;
+    snprintf(out->uuid, sizeof(out->uuid), "%s", p.uuid.c_str());
+  }
+  return 0;
+}
+
+int tpuinfo_delete_partition(tpuinfo_handle* h, const char* uuid) {
+  if (h->state_file.empty()) return h->fail("partitioning disabled (no state_file)");
+  LockedStateFile sf(h->state_file);
+  if (!sf.ok()) return h->fail("cannot open state file " + h->state_file);
+  auto parts = sf.read();
+  size_t before = parts.size();
+  parts.erase(std::remove_if(parts.begin(), parts.end(),
+                             [&](const Partition& p) { return p.uuid == uuid; }),
+              parts.end());
+  if (parts.size() == before)
+    return h->fail(std::string("no such partition ") + uuid);
+  sf.write(parts);
+  return 0;
+}
+
+int tpuinfo_list_partitions(tpuinfo_handle* h, tpuinfo_partition* out, int cap) {
+  if (h->state_file.empty()) return 0;
+  LockedStateFile sf(h->state_file);
+  if (!sf.ok()) return h->fail("cannot open state file " + h->state_file);
+  auto parts = sf.read();
+  int n = static_cast<int>(parts.size());
+  for (int i = 0; i < n && i < cap; i++) {
+    out[i].parent_index = parts[i].parent_index;
+    snprintf(out[i].profile, sizeof(out[i].profile), "%s",
+             parts[i].profile.c_str());
+    out[i].core_start = parts[i].core_start;
+    out[i].hbm_start = parts[i].hbm_start;
+    snprintf(out[i].uuid, sizeof(out[i].uuid), "%s", parts[i].uuid.c_str());
+  }
+  return n;
+}
+
+const char* tpuinfo_last_error(tpuinfo_handle* h) { return h->error.c_str(); }
+
+}  // extern "C"

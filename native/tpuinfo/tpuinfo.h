@@ -1,0 +1,75 @@
+/* libtpuinfo — TPU chip enumeration, topology, and partition control.
+ *
+ * The TPU-native equivalent of the reference's NVML boundary (the cgo
+ * go-nvml/go-nvlib layer, gpu-kubelet-plugin/nvlib.go:56-71): a C ABI the
+ * Python device library binds with ctypes (tpudra/devicelib/native.py).
+ *
+ * Discovery sources, in order:
+ *   1. an explicit config file (key=value; see tpuinfo.cc) — used by CI and
+ *      by hosts where the platform metadata is pre-rendered to disk;
+ *   2. /dev/accel* device nodes plus TPU_* environment (the Cloud TPU VM
+ *      contract: TPU_ACCELERATOR_TYPE, TPU_WORKER_ID, ...).
+ *
+ * Partition state (the MIG-analog TensorCore sub-allocation registry) is a
+ * flock(2)-guarded state file so concurrent plugin processes and crash
+ * recovery see one truth — mirroring how MIG state lives in the driver, not
+ * the client.
+ */
+#ifndef TPUDRA_NATIVE_TPUINFO_H_
+#define TPUDRA_NATIVE_TPUINFO_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct tpuinfo_handle tpuinfo_handle;
+
+typedef struct {
+  int index;
+  char uuid[64];
+  char generation[8];
+  int coords[3];
+  char pci_address[24];
+  char clique_id[96];
+  long long hbm_bytes;
+  int tensorcores;
+} tpuinfo_chip;
+
+typedef struct {
+  int parent_index;
+  char profile[16]; /* e.g. "1c.4hbm" */
+  int core_start;
+  int hbm_start;
+  char uuid[64];
+} tpuinfo_partition;
+
+typedef struct {
+  char slice_uuid[64];
+  int mesh[3];
+  int host_index;
+  int num_hosts;
+} tpuinfo_topology;
+
+/* All functions return 0 on success, negative on error (see
+ * tpuinfo_last_error for a message). */
+int tpuinfo_open(const char* config_path, tpuinfo_handle** out);
+void tpuinfo_close(tpuinfo_handle* h);
+
+int tpuinfo_chip_count(tpuinfo_handle* h);
+int tpuinfo_get_chip(tpuinfo_handle* h, int i, tpuinfo_chip* out);
+int tpuinfo_get_topology(tpuinfo_handle* h, tpuinfo_topology* out);
+
+int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,
+                             const char* profile, int core_start,
+                             int hbm_start, tpuinfo_partition* out);
+int tpuinfo_delete_partition(tpuinfo_handle* h, const char* uuid);
+/* Fills up to cap entries; returns the total count (may exceed cap). */
+int tpuinfo_list_partitions(tpuinfo_handle* h, tpuinfo_partition* out, int cap);
+
+const char* tpuinfo_last_error(tpuinfo_handle* h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUDRA_NATIVE_TPUINFO_H_ */

@@ -1,0 +1,385 @@
+"""Native C++ boundary: libtpuinfo via ctypes and tpu-slicewatchd.
+
+Gated on the artifacts being built (``make -C native``); CI builds them
+before running the suite, and the mock backend keeps everything else green
+without them.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import time
+
+import pytest
+
+from tpudra.devicelib import PartitionSpec
+
+NATIVE_BUILD = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "build")
+LIB = os.path.join(NATIVE_BUILD, "libtpuinfo.so")
+SLICEWATCHD = os.path.join(NATIVE_BUILD, "tpu-slicewatchd")
+
+pytestmark = pytest.mark.skipif(
+    not (os.path.exists(LIB) and os.path.exists(SLICEWATCHD)),
+    reason="native components not built (make -C native)",
+)
+
+
+def mk_config(tmp_path, **overrides):
+    values = {
+        "generation": "v5p",
+        "num_chips": 4,
+        "host_index": 0,
+        "num_hosts": 2,
+        "slice_uuid": "slice-t",
+        "partition_id": "0",
+        "state_file": str(tmp_path / "tpuinfo-state"),
+    }
+    values.update(overrides)
+    path = tmp_path / "tpuinfo.cfg"
+    path.write_text("".join(f"{k}={v}\n" for k, v in values.items()))
+    return str(path)
+
+
+def mk_native(tmp_path, **overrides):
+    from tpudra.devicelib.native import NativeDeviceLib
+
+    return NativeDeviceLib(config_path=mk_config(tmp_path, **overrides))
+
+
+class TestLibTpuInfo:
+    def test_enumeration_and_topology(self, tmp_path):
+        lib = mk_native(tmp_path)
+        chips = lib.enumerate_chips()
+        assert len(chips) == 4
+        assert chips[0].generation == "v5p"
+        assert chips[0].tensorcores == 2
+        assert chips[0].hbm_bytes == 95 * 2**30
+        assert chips[0].clique_id == "slice-t.0"
+        assert chips[0].uuid != chips[1].uuid
+        assert chips[0].coords != chips[1].coords
+        topo = lib.slice_topology()
+        assert topo.num_hosts == 2
+        assert topo.slice_uuid == "slice-t"
+        lib.close()
+
+    def test_partition_lifecycle_and_overlap(self, tmp_path):
+        lib = mk_native(tmp_path)
+        spec = PartitionSpec(0, "1c.4hbm", 0, 0)
+        live = lib.create_partition(spec)
+        assert live.uuid.startswith("part-0-1c.4hbm-0-0-")
+        assert live.spec == spec
+        with pytest.raises(Exception, match="overlap"):
+            lib.create_partition(PartitionSpec(0, "2c.8hbm", 0, 0))
+        # Disjoint placement on the same chip is fine.
+        other = lib.create_partition(PartitionSpec(0, "1c.4hbm", 1, 4))
+        assert {p.uuid for p in lib.list_partitions()} == {live.uuid, other.uuid}
+        lib.delete_partition(live.uuid)
+        assert [p.uuid for p in lib.list_partitions()] == [other.uuid]
+        with pytest.raises(Exception, match="no such partition"):
+            lib.delete_partition(live.uuid)
+        lib.close()
+
+    def test_state_survives_reopen(self, tmp_path):
+        lib = mk_native(tmp_path)
+        lib.create_partition(PartitionSpec(1, "1c.4hbm", 0, 0))
+        lib.close()
+        lib2 = mk_native(tmp_path)
+        parts = lib2.list_partitions()
+        assert len(parts) == 1 and parts[0].spec.parent_index == 1
+        lib2.close()
+
+    def test_invalid_placement_rejected(self, tmp_path):
+        lib = mk_native(tmp_path)
+        with pytest.raises(Exception, match="core placement"):
+            lib.create_partition(PartitionSpec(0, "1c.4hbm", 5, 0))
+        with pytest.raises(Exception, match="hbm placement"):
+            lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 6))
+        lib.close()
+
+    def test_driver_runs_on_native_backend(self, tmp_path):
+        """Cross-backend parity: the full prepare path over libtpuinfo."""
+        from tests.test_device_state import mk_claim
+        from tpudra.kube.fake import FakeKube
+        from tpudra.plugin.driver import Driver, DriverConfig
+
+        lib = mk_native(tmp_path)
+        d = Driver(
+            DriverConfig(
+                node_name="node-n",
+                plugin_dir=str(tmp_path / "p"),
+                registry_dir=str(tmp_path / "r"),
+                cdi_root=str(tmp_path / "c"),
+            ),
+            FakeKube(),
+            lib,
+        )
+        resp = d.prepare_resource_claims([mk_claim("u-n", ["tpu-2"])])
+        assert resp["claims"]["u-n"]["devices"][0]["deviceName"] == "tpu-2"
+        d.unprepare_resource_claims([{"uid": "u-n"}])
+
+    def test_topology_parity_with_mock(self, tmp_path):
+        """Native and mock backends must agree on coordinates and mesh for
+        identical hardware — consumers (slice attributes, workload meshes)
+        must not see backend-dependent answers."""
+        from tpudra.devicelib import MockTopologyConfig
+        from tpudra.devicelib.mock import MockDeviceLib
+
+        native = mk_native(tmp_path, host_index=1)
+        mock = MockDeviceLib(
+            config=MockTopologyConfig(
+                generation="v5p", host_index=1, num_hosts=2, slice_uuid="slice-t"
+            )
+        )
+        n_chips = native.enumerate_chips()
+        m_chips = mock.enumerate_chips()
+        assert [c.coords for c in n_chips] == [c.coords for c in m_chips]
+        assert [c.uuid for c in n_chips] == [c.uuid for c in m_chips]
+        nt, mt = native.slice_topology(), mock.slice_topology()
+        assert nt.mesh_shape == mt.mesh_shape
+        assert (nt.host_index, nt.num_hosts) == (mt.host_index, mt.num_hosts)
+        native.close()
+
+    def test_health_event_fifo(self, tmp_path):
+        """Real hosts feed events through a fifo: open must not block the
+        monitor thread and reads must not seek."""
+        import threading
+
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        fifo = str(tmp_path / "health-fifo")
+        os.mkfifo(fifo)
+        lib = NativeDeviceLib(
+            config_path=mk_config(tmp_path), health_events_path=fifo
+        )
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for ev in lib.health_events(stop):
+                got.append(ev)
+                stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive(), "fifo with no writer must not wedge the monitor"
+        fd = os.open(fifo, os.O_WRONLY | os.O_NONBLOCK)
+        os.write(fd, b"ChipLockup tpu-slice-t-0-2 - wedged\n")
+        os.close(fd)
+        t.join(timeout=5)
+        assert got and got[0].kind == "ChipLockup"
+        assert got[0].chip_uuid == "tpu-slice-t-0-2"
+        lib.close()
+
+    def test_health_event_tail(self, tmp_path):
+        import threading
+
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        events_file = tmp_path / "health-events"
+        events_file.write_text("")
+        lib = NativeDeviceLib(
+            config_path=mk_config(tmp_path),
+            health_events_path=str(events_file),
+        )
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for ev in lib.health_events(stop):
+                got.append(ev)
+                stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        with open(events_file, "a") as f:
+            f.write("HbmEccError tpu-slice-t-0-0 - double-bit\n")
+        t.join(timeout=5)
+        assert got and got[0].kind == "HbmEccError"
+        assert got[0].chip_uuid == "tpu-slice-t-0-0"
+        assert got[0].detail == "double-bit"
+        lib.close()
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def query(port, timeout=2.0):
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.sendall(b"Q\n")
+        return s.makefile().readline().strip()
+
+
+def wait_status(port, want_prefix, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    last = ""
+    while time.monotonic() < deadline:
+        try:
+            last = query(port)
+            if last.startswith(want_prefix):
+                return last
+        except OSError:
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"status never reached {want_prefix!r}; last={last!r}")
+
+
+class TestDaemonAppWithNativeSlicewatchd:
+    def test_domain_forms_through_real_daemons(self, tmp_path):
+        """The full native path: two DaemonApps join the clique CR, exchange
+        IPs through it, rewrite hosts files, supervise real tpu-slicewatchd
+        processes, and mirror READY into the clique once the slice forms."""
+        import threading
+
+        from tpudra.cddaemon.app import DaemonApp, DaemonConfig
+        from tpudra.kube import gvr
+        from tpudra.kube.fake import FakeKube
+
+        kube = FakeKube()
+        pa, pb = free_port(), free_port()
+        sa, sb = free_port(), free_port()
+        # Port-annotated peer list (both daemons share 127.0.0.1 in tests).
+        nodes_ports = tmp_path / "nodes-ports.cfg"
+        nodes_ports.write_text(
+            f"compute-domain-daemon-0000:{pa}\ncompute-domain-daemon-0001:{pb}\n"
+        )
+        stop = threading.Event()
+        apps = []
+        try:
+            for i, (peer_port, status_port) in enumerate([(pa, sa), (pb, sb)]):
+                hosts = tmp_path / f"hosts-{i}"
+                hosts.write_text("")
+                cfg = DaemonConfig(
+                    cd_uid="cd-native",
+                    node_name=f"node-{i}",
+                    pod_name=f"pod-{i}",
+                    pod_ip="127.0.0.1",
+                    namespace="tpudra-system",
+                    clique_id="slice-n.0",
+                    num_hosts=2,
+                    host_index=i,
+                    status_port=status_port,
+                    peer_port=peer_port,
+                    work_dir=str(tmp_path / f"work-{i}"),
+                    hosts_path=str(hosts),
+                    daemon_argv=[
+                        SLICEWATCHD,
+                        "--nodes-config", str(nodes_ports),
+                        "--hosts", str(hosts),
+                        "--index", str(i), "--expected", "2",
+                        "--status-port", str(status_port),
+                        "--peer-port", str(peer_port),
+                        "--heartbeat-ms", "50", "--stale-ms", "500",
+                    ],
+                )
+                app = DaemonApp(kube, cfg)
+                threading.Thread(target=app.run, args=(stop,), daemon=True).start()
+                apps.append(app)
+            for app in apps:
+                assert app.wait_started()
+            assert wait_status(sa, "READY") == "READY"
+            assert wait_status(sb, "READY") == "READY"
+
+            def clique_all_ready():
+                clique = kube.get(
+                    gvr.COMPUTE_DOMAIN_CLIQUES, "cd-native.slice-n.0", "tpudra-system"
+                )
+                daemons = clique.get("status", {}).get("daemons", [])
+                return len(daemons) == 2 and all(
+                    d["status"] == "Ready" for d in daemons
+                )
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and not clique_all_ready():
+                time.sleep(0.1)
+            assert clique_all_ready(), "daemon readiness must reach the clique CR"
+        finally:
+            stop.set()
+            time.sleep(0.1)
+            for app in apps:
+                if app.process is not None:
+                    app.process.stop()
+
+
+class TestSliceWatchd:
+    def test_single_host_ready(self, tmp_path):
+        (tmp_path / "nodes.cfg").write_text("compute-domain-daemon-0000\n")
+        (tmp_path / "hosts").write_text("127.0.0.1\tcompute-domain-daemon-0000\n")
+        sp = free_port()
+        proc = subprocess.Popen(
+            [
+                SLICEWATCHD,
+                "--nodes-config", str(tmp_path / "nodes.cfg"),
+                "--hosts", str(tmp_path / "hosts"),
+                "--index", "0", "--expected", "1",
+                "--status-port", str(sp), "--peer-port", str(free_port()),
+            ]
+        )
+        try:
+            assert wait_status(sp, "READY") == "READY"
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_two_daemons_form_and_degrade(self, tmp_path):
+        """Two daemons on localhost: NOT_READY alone, READY once both
+        heartbeat, NOT_READY again after one dies (failure detection)."""
+        pa, pb = free_port(), free_port()
+        sa, sb = free_port(), free_port()
+        nodes = tmp_path / "nodes.cfg"
+        nodes.write_text(
+            f"compute-domain-daemon-0000:{pa}\ncompute-domain-daemon-0001:{pb}\n"
+        )
+        hosts = tmp_path / "hosts"
+        # Initially only daemon 0 is known (daemon 1 hasn't joined).
+        hosts.write_text("127.0.0.1\tcompute-domain-daemon-0000\n")
+
+        def spawn(index, status_port, peer_port):
+            return subprocess.Popen(
+                [
+                    SLICEWATCHD,
+                    "--nodes-config", str(nodes),
+                    "--hosts", str(hosts),
+                    "--index", str(index), "--expected", "2",
+                    "--status-port", str(status_port),
+                    "--peer-port", str(peer_port),
+                    "--heartbeat-ms", "50", "--stale-ms", "400",
+                ]
+            )
+
+        a = spawn(0, sa, pa)
+        b = None
+        try:
+            assert wait_status(sa, "NOT_READY").startswith("NOT_READY")
+            # Daemon 1 joins: membership lands in the hosts file, daemons get
+            # the reload signal (the DNSNameManager + SIGHUP dance).  Wait for
+            # its status socket before signaling — SIGHUP before the handler
+            # is installed would kill the fresh process.
+            b = spawn(1, sb, pb)
+            wait_status(sb, "NOT_READY")
+            hosts.write_text(
+                "127.0.0.1\tcompute-domain-daemon-0000\n"
+                "127.0.0.1\tcompute-domain-daemon-0001\n"
+            )
+            a.send_signal(signal.SIGHUP)
+            b.send_signal(signal.SIGHUP)
+            assert wait_status(sa, "READY") == "READY"
+            assert wait_status(sb, "READY") == "READY"
+            # Kill daemon 1: daemon 0 must notice within the stale window.
+            b.kill()
+            b.wait(timeout=5)
+            b = None
+            assert wait_status(sa, "NOT_READY").startswith("NOT_READY")
+        finally:
+            a.terminate()
+            a.wait(timeout=5)
+            if b is not None:
+                b.terminate()
+                b.wait(timeout=5)

@@ -1,0 +1,200 @@
+"""Admission webhook over good/bad opaque configs across object kinds —
+mirroring the reference's cmd/webhook/main_test.go coverage."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, TPU_DRIVER_NAME
+from tpudra import featuregates as fg
+from tpudra.webhook import WebhookServer, admit_review
+from tpudra.webhook.app import validate_claim_object
+
+API_V = "resource.tpu.google.com/v1beta1"
+
+
+def claim(configs, kind="ResourceClaim"):
+    spec = {"devices": {"requests": [{"name": "r0"}], "config": configs}}
+    if kind == "ResourceClaimTemplate":
+        return {"kind": kind, "apiVersion": "resource.k8s.io/v1", "spec": {"spec": spec}}
+    return {"kind": kind, "apiVersion": "resource.k8s.io/v1", "spec": spec}
+
+
+def opaque(params, driver=TPU_DRIVER_NAME):
+    return {"opaque": {"driver": driver, "parameters": params}}
+
+
+def review(obj, uid="req-1"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "object": obj},
+    }
+
+
+GOOD_TPU = {"apiVersion": API_V, "kind": "TpuConfig"}
+GOOD_CHANNEL = {
+    "apiVersion": API_V,
+    "kind": "ComputeDomainChannelConfig",
+    "domainID": "uid-1",
+    "allocationMode": "All",
+}
+
+
+class TestValidation:
+    def test_valid_configs_admit(self):
+        assert validate_claim_object(claim([opaque(GOOD_TPU)])) == []
+        assert (
+            validate_claim_object(
+                claim([opaque(GOOD_CHANNEL, COMPUTE_DOMAIN_DRIVER_NAME)])
+            )
+            == []
+        )
+
+    def test_template_kind_supported(self):
+        obj = claim([opaque(GOOD_TPU)], kind="ResourceClaimTemplate")
+        assert validate_claim_object(obj) == []
+
+    def test_unknown_kind_rejected(self):
+        errs = validate_claim_object(
+            claim([opaque({"apiVersion": API_V, "kind": "NopeConfig"})])
+        )
+        assert errs and "NopeConfig" in errs[0]
+
+    def test_unknown_field_rejected_strict(self):
+        errs = validate_claim_object(
+            claim([opaque({"apiVersion": API_V, "kind": "TpuConfig", "bogus": 1})])
+        )
+        assert errs and "bogus" in errs[0]
+
+    def test_semantic_validation_runs(self):
+        errs = validate_claim_object(
+            claim(
+                [
+                    opaque(
+                        {
+                            "apiVersion": API_V,
+                            "kind": "ComputeDomainChannelConfig",
+                            "domainID": "",
+                        },
+                        COMPUTE_DOMAIN_DRIVER_NAME,
+                    )
+                ]
+            )
+        )
+        assert errs and "domainID" in errs[0]
+
+    def test_gated_strategy_rejected_when_gate_off(self):
+        errs = validate_claim_object(
+            claim(
+                [
+                    opaque(
+                        {
+                            "apiVersion": API_V,
+                            "kind": "TpuConfig",
+                            "sharing": {"strategy": "TimeSlicing"},
+                        }
+                    )
+                ]
+            )
+        )
+        assert errs and "TimeSlicing" in errs[0]
+        fg.feature_gates().set_from_map({fg.TIME_SLICING_SETTINGS: True})
+        assert (
+            validate_claim_object(
+                claim(
+                    [
+                        opaque(
+                            {
+                                "apiVersion": API_V,
+                                "kind": "TpuConfig",
+                                "sharing": {"strategy": "TimeSlicing"},
+                            }
+                        )
+                    ]
+                )
+            )
+            == []
+        )
+
+    def test_non_dict_parameters_denied_not_crashed(self):
+        for bad in ("a string", [1, 2], 42):
+            errs = validate_claim_object(claim([opaque(bad)]))
+            assert errs and "must be an object" in errs[0], bad
+
+    def test_other_drivers_ignored(self):
+        obj = claim([opaque({"kind": "Whatever"}, driver="gpu.example.com")])
+        assert validate_claim_object(obj) == []
+
+    def test_unsupported_object_kind(self):
+        errs = validate_claim_object({"kind": "Pod"})
+        assert errs and "Pod" in errs[0]
+
+    def test_multiple_errors_accumulate(self):
+        obj = claim(
+            [
+                opaque({"apiVersion": API_V, "kind": "NopeConfig"}),
+                opaque(
+                    {"apiVersion": API_V, "kind": "ComputeDomainChannelConfig", "domainID": ""},
+                    COMPUTE_DOMAIN_DRIVER_NAME,
+                ),
+            ]
+        )
+        errs = validate_claim_object(obj)
+        assert len(errs) == 2
+        assert "config[0]" in errs[0] and "config[1]" in errs[1]
+
+
+class TestAdmissionReview:
+    def test_allowed_response(self):
+        resp = admit_review(review(claim([opaque(GOOD_TPU)])))
+        assert resp["response"]["allowed"] is True
+        assert resp["response"]["uid"] == "req-1"
+
+    def test_denied_response_carries_message(self):
+        resp = admit_review(
+            review(claim([opaque({"apiVersion": API_V, "kind": "NopeConfig"})]))
+        )
+        assert resp["response"]["allowed"] is False
+        assert "NopeConfig" in resp["response"]["status"]["message"]
+        assert resp["response"]["status"]["code"] == 422
+
+    def test_empty_review_allowed(self):
+        resp = admit_review({"request": {"uid": "x", "object": claim([])}})
+        assert resp["response"]["allowed"] is True
+
+
+class TestServer:
+    def test_http_roundtrip(self):
+        srv = WebhookServer(host="127.0.0.1")
+        srv.start()
+        try:
+            body = json.dumps(review(claim([opaque(GOOD_TPU)]))).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/validate-resource-claim-parameters",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                out = json.loads(r.read())
+            assert out["response"]["allowed"] is True
+
+            bad = json.dumps(
+                review(claim([opaque({"apiVersion": API_V, "kind": "Nope"})]))
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/validate-resource-claim-parameters",
+                data=bad,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as r:
+                out = json.loads(r.read())
+            assert out["response"]["allowed"] is False
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+            ) as r:
+                assert r.status == 200
+        finally:
+            srv.stop()

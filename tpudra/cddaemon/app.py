@@ -123,7 +123,9 @@ class DaemonApp:
             argv = [
                 "tpu-slicewatchd",
                 "--nodes-config", nodes_cfg,
+                "--hosts", cfg.hosts_path,
                 "--index", str(index),
+                "--expected", str(max(cfg.num_hosts, 1)),
                 "--status-port", str(cfg.status_port),
                 "--peer-port", str(cfg.peer_port),
             ]
@@ -154,8 +156,10 @@ class DaemonApp:
         use_dns = featuregates.enabled(featuregates.DOMAIN_DAEMONS_WITH_DNS_NAMES)
         if use_dns:
             changed = self._dns.update_hosts_file(peers)
-            self.process.ensure_started()
-            if changed:
+            started = self.process.ensure_started()
+            if changed and not started:
+                # A just-spawned daemon reads the fresh hosts file itself;
+                # signaling before its handler is installed would kill it.
                 self.process.reload()
         else:
             with open(os.path.join(self.config.work_dir, "peers.cfg"), "w") as f:

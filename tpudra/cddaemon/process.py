@@ -19,23 +19,31 @@ logger = logging.getLogger(__name__)
 
 
 class ProcessManager:
+    # Window after spawn during which signals are unsafe: the child may not
+    # have installed its handlers yet, and the default SIGHUP action kills it.
+    SIGNAL_SAFE_AGE = 0.5
+
     def __init__(self, argv: Sequence[str], term_grace: float = 5.0):
         self._argv = list(argv)
         self._term_grace = term_grace
         self._proc: Optional[subprocess.Popen] = None
         self._lock = threading.RLock()
         self._expected_stop = False
+        self._started_at = 0.0
         self.restarts = 0
 
     # -- lifecycle ----------------------------------------------------------
 
-    def ensure_started(self) -> None:
+    def ensure_started(self) -> bool:
+        """Returns True if this call actually spawned the process."""
         with self._lock:
             if self.running:
-                return
+                return False
             self._expected_stop = False
             self._proc = subprocess.Popen(self._argv)
+            self._started_at = time.monotonic()
             logger.info("started %s (pid %d)", self._argv[0], self._proc.pid)
+            return True
 
     def stop(self) -> None:
         with self._lock:
@@ -57,7 +65,18 @@ class ProcessManager:
 
     def reload(self) -> None:
         """Ask the daemon to re-resolve peers without restarting (the
-        SIGUSR1-to-nvidia-imex analog, reference main.go:405)."""
+        SIGUSR1-to-nvidia-imex analog, reference main.go:405).
+
+        If the process was spawned moments ago — by us or by the watchdog —
+        wait out the handler-install window first; a fresh process read the
+        fresh config at startup, but a SIGHUP landing before its handler is
+        installed would kill it."""
+        while True:
+            with self._lock:
+                age = time.monotonic() - self._started_at
+                if not self.running or age >= self.SIGNAL_SAFE_AGE:
+                    break
+            time.sleep(self.SIGNAL_SAFE_AGE - age)
         self.send_signal(signal.SIGHUP)
 
     def send_signal(self, sig: int) -> None:

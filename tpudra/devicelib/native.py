@@ -1,0 +1,310 @@
+"""Native device-library backend: ctypes over C++ libtpuinfo.
+
+The analog of the reference's cgo→NVML boundary (nvlib.go:56-71 loading
+libnvidia-ml.so.1 by explicit path).  All enumeration, topology and the
+partition registry live in native/tpuinfo (built to
+native/build/libtpuinfo.so); this binding adapts the C ABI to the DeviceLib
+interface so the plugins run identically on mock and native backends.
+
+Health events: the native library exposes hardware interrupts by appending
+lines ``<kind> <chip_uuid> [partition_uuid] [detail...]`` to an event file
+(on real hosts, a fifo fed by the platform's interrupt handler; in tests, a
+plain file) which this backend tails.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+import time
+from typing import Iterator, Optional
+
+from tpudra.devicelib.base import (
+    DeviceLib,
+    DeviceLibError,
+    HealthEvent,
+    LivePartition,
+    PartitionSpec,
+)
+from tpudra.devicelib.topology import (
+    GENERATIONS,
+    PartitionPlacement,
+    SliceTopology,
+    TpuChip,
+    partition_profiles,
+)
+
+DEFAULT_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "build",
+    "libtpuinfo.so",
+)
+
+
+class _Chip(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int),
+        ("uuid", ctypes.c_char * 64),
+        ("generation", ctypes.c_char * 8),
+        ("coords", ctypes.c_int * 3),
+        ("pci_address", ctypes.c_char * 24),
+        ("clique_id", ctypes.c_char * 96),
+        ("hbm_bytes", ctypes.c_longlong),
+        ("tensorcores", ctypes.c_int),
+    ]
+
+
+class _Partition(ctypes.Structure):
+    _fields_ = [
+        ("parent_index", ctypes.c_int),
+        ("profile", ctypes.c_char * 16),
+        ("core_start", ctypes.c_int),
+        ("hbm_start", ctypes.c_int),
+        ("uuid", ctypes.c_char * 64),
+    ]
+
+
+class _Topology(ctypes.Structure):
+    _fields_ = [
+        ("slice_uuid", ctypes.c_char * 64),
+        ("mesh", ctypes.c_int * 3),
+        ("host_index", ctypes.c_int),
+        ("num_hosts", ctypes.c_int),
+    ]
+
+
+def _load(lib_path: str):
+    lib = ctypes.CDLL(lib_path)
+    lib.tpuinfo_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.tpuinfo_open.restype = ctypes.c_int
+    lib.tpuinfo_close.argtypes = [ctypes.c_void_p]
+    lib.tpuinfo_chip_count.argtypes = [ctypes.c_void_p]
+    lib.tpuinfo_chip_count.restype = ctypes.c_int
+    lib.tpuinfo_get_chip.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(_Chip)]
+    lib.tpuinfo_get_chip.restype = ctypes.c_int
+    lib.tpuinfo_get_topology.argtypes = [ctypes.c_void_p, ctypes.POINTER(_Topology)]
+    lib.tpuinfo_get_topology.restype = ctypes.c_int
+    lib.tpuinfo_create_partition.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, ctypes.POINTER(_Partition),
+    ]
+    lib.tpuinfo_create_partition.restype = ctypes.c_int
+    lib.tpuinfo_delete_partition.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpuinfo_delete_partition.restype = ctypes.c_int
+    lib.tpuinfo_list_partitions.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_Partition), ctypes.c_int,
+    ]
+    lib.tpuinfo_list_partitions.restype = ctypes.c_int
+    lib.tpuinfo_last_error.argtypes = [ctypes.c_void_p]
+    lib.tpuinfo_last_error.restype = ctypes.c_char_p
+    return lib
+
+
+class NativeDeviceLib(DeviceLib):
+    def __init__(
+        self,
+        config_path: str = "",
+        lib_path: str = DEFAULT_LIB_PATH,
+        health_events_path: str = "",
+    ):
+        if not os.path.exists(lib_path):
+            raise DeviceLibError(
+                f"libtpuinfo not found at {lib_path} (build with `make -C native`)"
+            )
+        self._lib = _load(lib_path)
+        self._handle = ctypes.c_void_p()
+        rc = self._lib.tpuinfo_open(
+            config_path.encode() or None, ctypes.byref(self._handle)
+        )
+        if rc != 0:
+            err = self._error()
+            self._lib.tpuinfo_close(self._handle)
+            self._handle = None
+            raise DeviceLibError(f"tpuinfo_open: {err}")
+        self._health_events_path = health_events_path or os.environ.get(
+            "TPUINFO_HEALTH_EVENTS", ""
+        )
+        self._sharing_lock = threading.Lock()
+        self._timeslice: dict[str, str] = {}
+        self._exclusive: dict[str, bool] = {}
+
+    def _error(self) -> str:
+        return (self._lib.tpuinfo_last_error(self._handle) or b"").decode()
+
+    # -- enumeration --------------------------------------------------------
+
+    def enumerate_chips(self) -> list[TpuChip]:
+        n = self._lib.tpuinfo_chip_count(self._handle)
+        out = []
+        for i in range(n):
+            c = _Chip()
+            if self._lib.tpuinfo_get_chip(self._handle, i, ctypes.byref(c)) != 0:
+                raise DeviceLibError(self._error())
+            out.append(
+                TpuChip(
+                    index=c.index,
+                    uuid=c.uuid.decode(),
+                    generation=c.generation.decode(),
+                    coords=tuple(c.coords),
+                    pci_address=c.pci_address.decode(),
+                    clique_id=c.clique_id.decode(),
+                    hbm_bytes=c.hbm_bytes,
+                    tensorcores=c.tensorcores,
+                )
+            )
+        return out
+
+    def slice_topology(self) -> SliceTopology:
+        t = _Topology()
+        if self._lib.tpuinfo_get_topology(self._handle, ctypes.byref(t)) != 0:
+            raise DeviceLibError(self._error())
+        slice_uuid = t.slice_uuid.decode()
+        chips = self.enumerate_chips()
+        partition_id = (
+            chips[0].clique_id.split(".", 1)[1] if chips and "." in chips[0].clique_id else "0"
+        )
+        return SliceTopology(
+            slice_uuid=slice_uuid,
+            partition_id=partition_id,
+            mesh_shape=tuple(t.mesh),
+            host_index=t.host_index,
+            num_hosts=t.num_hosts,
+        )
+
+    # -- partitions ---------------------------------------------------------
+
+    def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
+        spec = GENERATIONS[chip.generation]
+        out = []
+        for profile in partition_profiles(spec):
+            out.extend(profile.placements(spec))
+        return out
+
+    def create_partition(self, spec: PartitionSpec) -> LivePartition:
+        p = _Partition()
+        rc = self._lib.tpuinfo_create_partition(
+            self._handle,
+            spec.parent_index,
+            spec.profile.encode(),
+            spec.core_start,
+            spec.hbm_start,
+            ctypes.byref(p),
+        )
+        if rc != 0:
+            raise DeviceLibError(f"create_partition: {self._error()}")
+        chips = {c.index: c for c in self.enumerate_chips()}
+        parent = chips[spec.parent_index]
+        return LivePartition(
+            spec=spec,
+            uuid=p.uuid.decode(),
+            parent_uuid=parent.uuid,
+            dev_paths=parent.dev_paths(),
+        )
+
+    def delete_partition(self, uuid: str) -> None:
+        if self._lib.tpuinfo_delete_partition(self._handle, uuid.encode()) != 0:
+            raise DeviceLibError(f"delete_partition: {self._error()}")
+
+    def list_partitions(self) -> list[LivePartition]:
+        cap = 256
+        while True:
+            arr = (_Partition * cap)()
+            n = self._lib.tpuinfo_list_partitions(self._handle, arr, cap)
+            if n < 0:
+                raise DeviceLibError(f"list_partitions: {self._error()}")
+            if n <= cap:
+                break
+            cap = n
+        chips = {c.index: c for c in self.enumerate_chips()}
+        out = []
+        for i in range(n):
+            p = arr[i]
+            parent = chips[p.parent_index]
+            out.append(
+                LivePartition(
+                    spec=PartitionSpec(
+                        parent_index=p.parent_index,
+                        profile=p.profile.decode(),
+                        core_start=p.core_start,
+                        hbm_start=p.hbm_start,
+                    ),
+                    uuid=p.uuid.decode(),
+                    parent_uuid=parent.uuid,
+                    dev_paths=parent.dev_paths(),
+                )
+            )
+        return out
+
+    # -- sharing knobs ------------------------------------------------------
+
+    def set_timeslice(self, chip_uuids: list[str], interval: str) -> None:
+        with self._sharing_lock:
+            for u in chip_uuids:
+                self._timeslice[u] = interval
+
+    def set_exclusive(self, chip_uuids: list[str], exclusive: bool) -> None:
+        with self._sharing_lock:
+            for u in chip_uuids:
+                self._exclusive[u] = exclusive
+
+    # -- health -------------------------------------------------------------
+
+    def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
+        path = self._health_events_path
+        if not path:
+            stop.wait()
+            return
+        # Works for both a plain file (tests: tail by byte offset) and a fifo
+        # (real hosts: non-blocking open so a missing writer never wedges the
+        # monitor thread, and no seek — fifos are unseekable).
+        pos = 0
+        buf = b""
+        while not stop.is_set():
+            try:
+                fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK)
+                try:
+                    is_fifo = os.fstat(fd).st_mode & 0o170000 == 0o010000
+                    if not is_fifo:
+                        os.lseek(fd, pos, os.SEEK_SET)
+                    while not stop.is_set():
+                        try:
+                            chunk = os.read(fd, 4096)
+                        except BlockingIOError:
+                            chunk = b""
+                        if not chunk:
+                            if not is_fifo:
+                                break  # plain file: EOF; reopen to tail
+                            if stop.wait(0.2):
+                                return
+                            continue
+                        if not is_fifo:
+                            pos += len(chunk)
+                        buf += chunk
+                        while b"\n" in buf:
+                            line, buf = buf.split(b"\n", 1)
+                            parts = line.decode(errors="replace").split(None, 3)
+                            if len(parts) < 2:
+                                continue
+                            yield HealthEvent(
+                                kind=parts[0],
+                                chip_uuid=parts[1],
+                                partition_uuid=parts[2]
+                                if len(parts) > 2 and parts[2] != "-"
+                                else None,
+                                detail=parts[3].strip() if len(parts) > 3 else "",
+                            )
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass
+            if stop.wait(0.2):
+                return
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.tpuinfo_close(self._handle)
+            self._handle = None

@@ -1,0 +1,10 @@
+"""Validating admission webhook.
+
+The analog of cmd/webhook/: catches malformed opaque device configs at
+``kubectl apply`` time instead of at NodePrepareResources time (where the
+only signal is a pod stuck in ContainerCreating).
+"""
+
+from tpudra.webhook.app import WebhookServer, admit_review
+
+__all__ = ["WebhookServer", "admit_review"]

@@ -1,0 +1,171 @@
+"""Admission logic + HTTPS server.
+
+The analog of cmd/webhook/main.go:115-292 and resource.go:34-152:
+
+- ``/validate-resource-claim-parameters`` receives an AdmissionReview for a
+  ResourceClaim or ResourceClaimTemplate (resource.k8s.io v1 / v1beta1 /
+  v1beta2 — older versions are shape-compatible for the fields we touch, the
+  conversion the reference does explicitly)
+- every opaque config entry addressed to one of our two drivers is
+  strict-decoded, normalized, and validated; unknown fields, wrong kinds and
+  semantic errors all become a deny with a precise message
+- configs for other drivers are ignored (not our webhook's business)
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import ssl
+import threading
+from typing import Optional
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, TPU_DRIVER_NAME
+from tpudra.api import DecodeError, decode_config
+
+logger = logging.getLogger(__name__)
+
+OUR_DRIVERS = (TPU_DRIVER_NAME, COMPUTE_DOMAIN_DRIVER_NAME)
+WEBHOOK_PATH = "/validate-resource-claim-parameters"
+
+
+def _claim_spec_from_object(obj: dict) -> tuple[Optional[dict], str]:
+    """Extract the ResourceClaimSpec from a claim or template
+    (resource.go:84-152); returns (spec, kind)."""
+    kind = obj.get("kind", "")
+    if kind == "ResourceClaim":
+        return obj.get("spec", {}), kind
+    if kind == "ResourceClaimTemplate":
+        return obj.get("spec", {}).get("spec", {}), kind
+    return None, kind
+
+
+def validate_claim_object(obj: dict) -> list[str]:
+    """All validation errors for one claim/template object (empty = admit)."""
+    spec, kind = _claim_spec_from_object(obj)
+    if spec is None:
+        return [f"unsupported object kind {kind!r}"]
+    errors: list[str] = []
+    entries = spec.get("devices", {}).get("config", [])
+    for i, entry in enumerate(entries):
+        opaque = entry.get("opaque")
+        if not opaque:
+            continue
+        if opaque.get("driver") not in OUR_DRIVERS:
+            continue
+        path = f"spec.devices.config[{i}].opaque.parameters"
+        params = opaque.get("parameters") or {}
+        if not isinstance(params, dict):
+            errors.append(f"{path}: must be an object, got {type(params).__name__}")
+            continue
+        try:
+            config = decode_config(params, strict=True)
+            config.normalize()
+            config.validate()
+        except (DecodeError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+        except Exception as e:  # noqa: BLE001 — a deny beats a dropped review
+            errors.append(f"{path}: internal validation error: {e}")
+    return errors
+
+
+def admit_review(review: dict) -> dict:
+    """AdmissionReview request → AdmissionReview response
+    (admitResourceClaimParameters, main.go:201-292)."""
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+    obj = request.get("object") or {}
+    errors = validate_claim_object(obj)
+    response: dict = {"uid": uid, "allowed": not errors}
+    if errors:
+        response["status"] = {
+            "code": 422,
+            "message": "; ".join(errors),
+        }
+    return {
+        "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+class WebhookServer:
+    """HTTPS (or plain-HTTP for tests) admission endpoint."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        cert_file: Optional[str] = None,
+        key_file: Optional[str] = None,
+        host: str = "0.0.0.0",
+    ):
+        self._host = host
+        self._port = port
+        self._cert = cert_file
+        self._key = key_file
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> None:
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802 — http.server API
+                if self.path != WEBHOOK_PATH:
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    review = json.loads(self.rfile.read(length))
+                except json.JSONDecodeError:
+                    self.send_error(400, "malformed AdmissionReview")
+                    return
+                try:
+                    body = json.dumps(admit_review(review)).encode()
+                except Exception as e:  # noqa: BLE001 — always answer the review
+                    logger.exception("admission review failed")
+                    body = json.dumps(
+                        {
+                            "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+                            "kind": "AdmissionReview",
+                            "response": {
+                                "uid": (review.get("request") or {}).get("uid", ""),
+                                "allowed": False,
+                                "status": {"code": 500, "message": f"webhook error: {e}"},
+                            },
+                        }
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:  # noqa: N802
+                if self.path == "/healthz":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):  # noqa: D102
+                logger.debug("webhook: " + fmt, *args)
+
+        self._server = http.server.ThreadingHTTPServer((self._host, self._port), Handler)
+        if self._cert and self._key:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(self._cert, self._key)
+            self._server.socket = ctx.wrap_socket(self._server.socket, server_side=True)
+        self._port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True, name="webhook").start()
+        logger.info("webhook serving on %s:%d", self._host, self._port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
