@@ -1,0 +1,112 @@
+"""CLI entry points parse their flags; deployment/demo manifests are valid
+YAML with the expected shapes."""
+
+import glob
+import os
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestEntryPoints:
+    def test_all_mains_importable_and_parse(self, monkeypatch):
+        monkeypatch.setenv("NODE_NAME", "n1")
+        from tpudra.cddaemon.main import build_parser as daemon_parser
+        from tpudra.cdplugin.main import build_parser as cdplugin_parser
+        from tpudra.controller.main import build_parser as controller_parser
+        from tpudra.plugin.main import build_parser as plugin_parser
+        from tpudra.webhook.main import build_parser as webhook_parser
+
+        args = plugin_parser().parse_args([])
+        assert args.node_name == "n1"
+        assert args.plugin_dir.endswith("tpu.google.com")
+        assert args.device_backend == "native"
+
+        args = cdplugin_parser().parse_args(["--device-backend", "mock"])
+        assert args.device_backend == "mock"
+
+        args = controller_parser().parse_args(["--max-nodes-per-domain", "8"])
+        assert args.max_nodes_per_domain == 8
+        assert args.namespace == "tpudra-system"
+
+        args = daemon_parser().parse_args(["run"])
+        assert args.command == "run"
+        args = daemon_parser().parse_args(["check"])
+        assert args.command == "check"
+
+        args = webhook_parser().parse_args([])
+        assert args.port == 8443
+
+    def test_env_mirrors_win_over_defaults(self, monkeypatch):
+        monkeypatch.setenv("NODE_NAME", "n2")
+        monkeypatch.setenv("CDI_ROOT", "/custom/cdi")
+        monkeypatch.setenv("HEALTHCHECK_PORT", "9999")
+        from tpudra.plugin.main import build_parser
+
+        args = build_parser().parse_args([])
+        assert args.node_name == "n2"
+        assert args.cdi_root == "/custom/cdi"
+        assert args.healthcheck_port == 9999
+
+    def test_pyproject_scripts_resolve(self):
+        import importlib
+
+        import tomllib
+
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            scripts = tomllib.load(f)["project"]["scripts"]
+        assert len(scripts) == 5
+        for target in scripts.values():
+            module, _, attr = target.partition(":")
+            mod = importlib.import_module(module)
+            assert callable(getattr(mod, attr))
+
+
+class TestManifests:
+    def manifests(self):
+        files = glob.glob(os.path.join(REPO, "deployments", "*.yaml"))
+        files += glob.glob(os.path.join(REPO, "demo", "specs", "*.yaml"))
+        assert files
+        return files
+
+    def test_all_yaml_parses(self):
+        for path in self.manifests():
+            with open(path) as f:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+            assert docs, path
+            for doc in docs:
+                assert "apiVersion" in doc and "kind" in doc, path
+
+    def test_deviceclasses_cover_both_drivers(self):
+        with open(os.path.join(REPO, "deployments", "deviceclasses.yaml")) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        names = {d["metadata"]["name"] for d in docs}
+        assert "tpu.google.com" in names
+        assert "compute-domain-daemon.tpu.google.com" in names
+        assert "compute-domain-default-channel.tpu.google.com" in names
+
+    def test_crds_match_gvr_registry(self):
+        from tpudra.kube import gvr
+
+        with open(os.path.join(REPO, "deployments", "crds.yaml")) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        plurals = {d["spec"]["names"]["plural"] for d in docs}
+        assert gvr.COMPUTE_DOMAINS.resource in plurals
+        assert gvr.COMPUTE_DOMAIN_CLIQUES.resource in plurals
+        for d in docs:
+            assert d["spec"]["group"] == gvr.COMPUTE_DOMAINS.group
+
+    def test_daemon_template_renders(self):
+        from tpudra.controller.daemonset import DaemonSetManager
+        from tpudra.kube.fake import FakeKube
+
+        mgr = DaemonSetManager(FakeKube(), "tpudra-system", image="img:1")
+        cd = {"metadata": {"name": "cd1", "namespace": "u", "uid": "uid-x"}}
+        obj = mgr.render(cd, "rct-x")
+        assert obj["kind"] == "DaemonSet"
+        tpl = obj["spec"]["template"]["spec"]
+        assert tpl["nodeSelector"]["resource.tpu.google.com/computeDomain"] == "uid-x"
+        assert tpl["resourceClaims"][0]["resourceClaimTemplateName"] == "rct-x"
+        envs = {e["name"] for e in tpl["containers"][0]["env"]}
+        assert {"CD_UID", "NAMESPACE", "NODE_NAME", "POD_IP"} <= envs

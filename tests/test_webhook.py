@@ -165,6 +165,52 @@ class TestAdmissionReview:
         assert resp["response"]["allowed"] is True
 
 
+class TestTLSServer:
+    def test_stalled_plaintext_client_does_not_block_tls_clients(self, tmp_path):
+        import socket
+        import ssl
+        import subprocess
+
+        cert, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", cert, "-days", "1", "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        srv = WebhookServer(host="127.0.0.1", cert_file=cert, key_file=key)
+        srv.start()
+        stall = None
+        try:
+            # A client that connects and never speaks TLS must not wedge the
+            # accept loop (handshake happens per connection, with a timeout).
+            stall = socket.create_connection(("127.0.0.1", srv.port))
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            body = json.dumps(review(claim([opaque(GOOD_TPU)]))).encode()
+            with socket.create_connection(("127.0.0.1", srv.port), timeout=5) as raw:
+                with ctx.wrap_socket(raw, server_hostname="localhost") as tls:
+                    tls.sendall(
+                        b"POST /validate-resource-claim-parameters HTTP/1.1\r\n"
+                        b"Host: localhost\r\nContent-Type: application/json\r\n"
+                        + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                        + body
+                    )
+                    chunks = b""
+                    while b'"allowed"' not in chunks:
+                        data = tls.recv(65536)
+                        if not data:
+                            break
+                        chunks += data
+                    resp = chunks.decode()
+            assert "200" in resp.splitlines()[0]
+            assert '"allowed": true' in resp
+        finally:
+            if stall is not None:
+                stall.close()
+            srv.stop()
+
+
 class TestServer:
     def test_http_roundtrip(self):
         srv = WebhookServer(host="127.0.0.1")

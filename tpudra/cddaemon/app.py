@@ -111,19 +111,31 @@ class DaemonApp:
         index = self.clique.join()
 
         os.makedirs(cfg.work_dir, exist_ok=True)
+        # With the DNS-names gate (default): peers resolve through the real
+        # /etc/hosts, updated in place, and membership changes are a reload.
+        # Gate off: the daemon reads a private hosts-format peer file that
+        # _on_peers_update rewrites before a full restart (the reference's
+        # restart-with-fresh-IPs mode, main.go:335-366).
+        self._use_dns = featuregates.enabled(featuregates.DOMAIN_DAEMONS_WITH_DNS_NAMES)
+        hosts_for_daemon = (
+            cfg.hosts_path if self._use_dns else os.path.join(cfg.work_dir, "peers-hosts")
+        )
         self._dns = DNSNameManager(
             max_nodes=max(cfg.num_hosts, 1),
-            hosts_path=cfg.hosts_path,
+            hosts_path=hosts_for_daemon,
             nodes_config_path=os.path.join(cfg.work_dir, "nodes.cfg"),
         )
         nodes_cfg = self._dns.write_nodes_config()
+        if not self._use_dns:
+            with open(hosts_for_daemon, "w"):
+                pass  # daemon must find the file before the first update
 
         argv = list(cfg.daemon_argv or [])
         if not argv:
             argv = [
                 "tpu-slicewatchd",
                 "--nodes-config", nodes_cfg,
-                "--hosts", cfg.hosts_path,
+                "--hosts", hosts_for_daemon,
                 "--index", str(index),
                 "--expected", str(max(cfg.num_hosts, 1)),
                 "--status-port", str(cfg.status_port),
@@ -150,21 +162,18 @@ class DaemonApp:
 
     def _on_peers_update(self, peers: dict[int, str]) -> None:
         """Membership changed (main.go:368-415): with DNS names, rewrite
-        /etc/hosts and send a reload; otherwise restart with fresh IPs."""
+        /etc/hosts and send a reload; otherwise rewrite the private peer
+        file and restart with fresh IPs."""
         if self.process is None:
             return
-        use_dns = featuregates.enabled(featuregates.DOMAIN_DAEMONS_WITH_DNS_NAMES)
-        if use_dns:
-            changed = self._dns.update_hosts_file(peers)
+        changed = self._dns.update_hosts_file(peers)
+        if self._use_dns:
             started = self.process.ensure_started()
             if changed and not started:
                 # A just-spawned daemon reads the fresh hosts file itself;
-                # signaling before its handler is installed would kill it.
+                # reload() holds its own handler-install-window guard.
                 self.process.reload()
         else:
-            with open(os.path.join(self.config.work_dir, "peers.cfg"), "w") as f:
-                for index in sorted(peers):
-                    f.write(f"{peers[index]}\n")
             self.process.restart()
         logger.info("applied peer update: %d peers", len(peers))
 

@@ -1,0 +1,63 @@
+"""ComputeDomain daemon binary (the cmd/compute-domain-daemon analog).
+
+Subcommands: ``run`` (the daemon) and ``check`` (the kubelet probe expecting
+READY from the native daemon's status socket)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpudra.flags import add_common_flags, make_kube_client, setup_common
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("compute-domain-daemon")
+    sub = p.add_subparsers(dest="command", required=True)
+    run_p = sub.add_parser("run", help="run the per-node domain daemon")
+    add_common_flags(run_p)
+    sub.add_parser("check", help="probe: exit 0 iff the slice daemon is READY")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from tpudra.cddaemon.app import DaemonApp, DaemonConfig, check
+
+    if args.command == "check":
+        return check()
+
+    setup_common(args)
+    config = DaemonConfig.from_environ()
+    # Derive this node's fabric identity from the device library: the clique
+    # id is what the chips report, not a deploy-time constant.
+    try:
+        from tpudra.flags import make_device_lib
+
+        lib = make_device_lib("native", "")
+        chips = lib.enumerate_chips()
+        topo = lib.slice_topology()
+        if chips and not config.clique_id:
+            config.clique_id = chips[0].clique_id
+        config.num_hosts = topo.num_hosts
+        config.host_index = topo.host_index
+        lib.close()
+    except Exception as e:  # noqa: BLE001 — no TPU = idle daemon, still valid
+        logger.warning("no local TPU fabric identity (%s); daemon will idle", e)
+
+    kube = make_kube_client(args.kubeconfig)
+    app = DaemonApp(kube, config)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    app.run(stop)  # blocks until stop
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -68,16 +68,20 @@ class ProcessManager:
         SIGUSR1-to-nvidia-imex analog, reference main.go:405).
 
         If the process was spawned moments ago — by us or by the watchdog —
-        wait out the handler-install window first; a fresh process read the
-        fresh config at startup, but a SIGHUP landing before its handler is
-        installed would kill it."""
+        wait out the handler-install window first: a SIGHUP landing before
+        the child's handler is installed would kill it.  The age check and
+        the signal happen under one lock acquisition so a watchdog respawn
+        cannot slip between them; a non-running process is simply not
+        signaled (any fresh spawn reads the fresh config at startup)."""
         while True:
             with self._lock:
+                if not self.running:
+                    return
                 age = time.monotonic() - self._started_at
-                if not self.running or age >= self.SIGNAL_SAFE_AGE:
-                    break
+                if age >= self.SIGNAL_SAFE_AGE:
+                    self._proc.send_signal(signal.SIGHUP)
+                    return
             time.sleep(self.SIGNAL_SAFE_AGE - age)
-        self.send_signal(signal.SIGHUP)
 
     def send_signal(self, sig: int) -> None:
         with self._lock:

@@ -1,0 +1,86 @@
+"""ComputeDomain kubelet plugin binary
+(the cmd/compute-domain-kubelet-plugin analog)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpudra.flags import (
+    add_common_flags,
+    env_default,
+    make_device_lib,
+    make_kube_client,
+    setup_common,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("compute-domain-kubelet-plugin")
+    add_common_flags(p)
+    p.add_argument("--node-name", default=env_default("NODE_NAME"), required=not env_default("NODE_NAME"))
+    p.add_argument(
+        "--plugin-dir",
+        default=env_default("PLUGIN_DIR", "/var/lib/kubelet/plugins/compute-domain.tpu.google.com"),
+    )
+    p.add_argument(
+        "--registry-dir",
+        default=env_default("REGISTRY_DIR", "/var/lib/kubelet/plugins_registry"),
+    )
+    p.add_argument("--cdi-root", default=env_default("CDI_ROOT", "/var/run/cdi"))
+    p.add_argument("--driver-root", default=env_default("DRIVER_ROOT", "/"))
+    p.add_argument(
+        "--device-backend", default=env_default("DEVICE_BACKEND", "native"),
+        choices=["mock", "native"],
+    )
+    p.add_argument("--tpuinfo-config", default=env_default("TPUINFO_CONFIG"))
+    p.add_argument(
+        "--healthcheck-port", type=int,
+        default=int(env_default("HEALTHCHECK_PORT", "-1")),
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_common(args)
+
+    from tpudra.cdplugin.driver import CDDriver, CDDriverConfig
+    from tpudra.plugin.health import Healthcheck
+
+    kube = make_kube_client(args.kubeconfig)
+    lib = make_device_lib(args.device_backend, args.tpuinfo_config)
+    driver = CDDriver(
+        CDDriverConfig(
+            node_name=args.node_name,
+            plugin_dir=args.plugin_dir,
+            registry_dir=args.registry_dir,
+            cdi_root=args.cdi_root,
+            driver_root=args.driver_root,
+        ),
+        kube,
+        lib,
+    )
+    driver.start()
+    hc = None
+    if args.healthcheck_port >= 0:
+        hc = Healthcheck(driver.sockets, port=args.healthcheck_port)
+        hc.start()
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    logger.info("compute-domain-kubelet-plugin up on node %s", args.node_name)
+    stop.wait()
+    if hc is not None:
+        hc.stop()
+    driver.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,52 @@
+"""ComputeDomain controller binary (the cmd/compute-domain-controller analog)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpudra.flags import add_common_flags, env_default, make_kube_client, setup_common
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("compute-domain-controller")
+    add_common_flags(p)
+    p.add_argument("--namespace", default=env_default("NAMESPACE", "tpudra-system"))
+    p.add_argument("--image", default=env_default("DAEMON_IMAGE", "tpudra:latest"))
+    p.add_argument(
+        "--max-nodes-per-domain", type=int,
+        default=int(env_default("MAX_NODES_PER_DOMAIN", "0")),
+        help="refuse CDs larger than this (0 = unlimited) [MAX_NODES_PER_DOMAIN]",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_common(args)
+
+    from tpudra.controller import Controller, ManagerConfig
+
+    kube = make_kube_client(args.kubeconfig)
+    controller = Controller(
+        kube,
+        ManagerConfig(
+            driver_namespace=args.namespace,
+            image=args.image,
+            max_nodes_per_domain=args.max_nodes_per_domain,
+        ),
+    )
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    logger.info("compute-domain-controller up in namespace %s", args.namespace)
+    controller.run(stop)  # blocks until stop
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

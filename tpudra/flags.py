@@ -1,0 +1,80 @@
+"""CLI flag plumbing shared by all five binaries.
+
+The analog of pkg/flags (reference kubeclient.go:33-118, featuregates.go,
+LogStartupConfig): every flag has an environment-variable mirror (urfave/cli
+convention — flags win over env, env over defaults), plus common groups for
+logging, feature gates, and the kube client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+from typing import Optional
+
+from tpudra import featuregates
+
+logger = logging.getLogger(__name__)
+
+
+def env_default(env: str, fallback: str = "") -> str:
+    return os.environ.get(env, fallback)
+
+
+def add_common_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--kubeconfig",
+        default=env_default("KUBECONFIG"),
+        help="kubeconfig path (empty: in-cluster service account) [KUBECONFIG]",
+    )
+    parser.add_argument(
+        "--feature-gates",
+        default=env_default("FEATURE_GATES"),
+        help="comma-separated gate=bool pairs [FEATURE_GATES]",
+    )
+    parser.add_argument(
+        "--log-level",
+        default=env_default("LOG_LEVEL", "INFO"),
+        help="python logging level name [LOG_LEVEL]",
+    )
+
+
+def setup_common(args: argparse.Namespace) -> None:
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    if args.feature_gates:
+        featuregates.feature_gates().set_from_spec(args.feature_gates)
+    featuregates.validate()
+    log_startup_config(args)
+
+
+def log_startup_config(args: argparse.Namespace) -> None:
+    """Structured startup-config dump (pkg/flags LogStartupConfig analog)."""
+    logger.info(
+        "startup config: %s",
+        " ".join(f"{k}={v!r}" for k, v in sorted(vars(args).items()) if k != "func"),
+    )
+    logger.info(
+        "feature gates: %s",
+        " ".join(f"{k}={v}" for k, v in sorted(featuregates.to_map().items())),
+    )
+
+
+def make_kube_client(kubeconfig: str):
+    from tpudra.kube.client import KubeClient
+
+    if kubeconfig:
+        return KubeClient.from_kubeconfig(kubeconfig)
+    return KubeClient.auto()
+
+
+def make_device_lib(backend: str, config: str):
+    from tpudra.devicelib import make_device_lib as factory
+
+    kwargs = {}
+    if backend == "native" and config:
+        kwargs["config_path"] = config
+    return factory(backend, **kwargs)

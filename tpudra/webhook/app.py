@@ -155,11 +155,25 @@ class WebhookServer:
             def log_message(self, fmt, *args):  # noqa: D102
                 logger.debug("webhook: " + fmt, *args)
 
-        self._server = http.server.ThreadingHTTPServer((self._host, self._port), Handler)
+        class Server(http.server.ThreadingHTTPServer):
+            """TLS is wrapped per connection on the handler thread: wrapping
+            the listening socket would run the handshake inside accept() on
+            the single serve_forever thread, letting one stalled client
+            block every admission request."""
+
+            ssl_context: Optional[ssl.SSLContext] = None
+
+            def finish_request(self, request, client_address):
+                if self.ssl_context is not None:
+                    request.settimeout(10.0)
+                    request = self.ssl_context.wrap_socket(request, server_side=True)
+                self.RequestHandlerClass(request, client_address, self)
+
+        self._server = Server((self._host, self._port), Handler)
         if self._cert and self._key:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             ctx.load_cert_chain(self._cert, self._key)
-            self._server.socket = ctx.wrap_socket(self._server.socket, server_side=True)
+            self._server.ssl_context = ctx
         self._port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever, daemon=True, name="webhook").start()
         logger.info("webhook serving on %s:%d", self._host, self._port)

@@ -1,0 +1,44 @@
+"""Admission webhook binary (the cmd/webhook analog)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from tpudra.flags import add_common_flags, env_default, setup_common
+
+logger = logging.getLogger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("tpudra-webhook")
+    add_common_flags(p)
+    p.add_argument("--port", type=int, default=int(env_default("PORT", "8443")))
+    p.add_argument("--tls-cert", default=env_default("TLS_CERT"))
+    p.add_argument("--tls-key", default=env_default("TLS_KEY"))
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    setup_common(args)
+
+    from tpudra.webhook import WebhookServer
+
+    srv = WebhookServer(
+        port=args.port, cert_file=args.tls_cert or None, key_file=args.tls_key or None
+    )
+    srv.start()
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    logger.info("webhook up on :%d (tls=%s)", srv.port, bool(args.tls_cert))
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
