@@ -1,0 +1,366 @@
+"""End-to-end lifecycle tests — the hermetic analog of the reference's bats
+suite (tests/bats/, SURVEY.md §4): driven from the demo manifests, through a
+simulated scheduler allocating against published ResourceSlices, the kubelet
+socket protocol, and the real checkpoint/CDI state on disk.  What the
+reference could only run on hardware CI runners runs here on the mock
+backend.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import yaml
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra import featuregates as fg
+from tpudra.devicelib import MockTopologyConfig
+from tpudra.devicelib.mock import MockDeviceLib
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin.draserver import UnixRPCClient
+from tpudra.plugin.driver import Driver, DriverConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_spec(name):
+    with open(os.path.join(REPO, "demo", "specs", name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def find(docs, kind):
+    return [d for d in docs if d["kind"] == kind]
+
+
+class Scheduler:
+    """A micro-scheduler: allocates RCT device requests against the
+    ResourceSlices in the fake apiserver (first-fit, counter-blind for full
+    devices; enough to drive the node plugin the way kube-scheduler would)."""
+
+    def __init__(self, kube):
+        self._kube = kube
+        self._allocated: set[tuple[str, str]] = set()  # (pool, device)
+
+    def _published(self):
+        for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
+            pool = s["spec"]["pool"]["name"]
+            for dev in s["spec"]["devices"]:
+                yield pool, s["spec"]["driver"], dev
+
+    def allocate(self, rct, uid, namespace="default", name="claim"):
+        spec = rct["spec"]["spec"]["devices"]
+        results = []
+        for req in spec.get("requests", []):
+            count = req.get("exactly", {}).get("count", 1)
+            matched = 0
+            for pool, driver, dev in self._published():
+                if (pool, dev["name"]) in self._allocated:
+                    continue
+                if not self._matches(req, dev):
+                    continue
+                self._allocated.add((pool, dev["name"]))
+                results.append(
+                    {"request": req["name"], "driver": driver,
+                     "pool": pool, "device": dev["name"]}
+                )
+                matched += 1
+                if matched == count:
+                    break
+            assert matched == count, f"cannot satisfy request {req['name']}"
+        config = []
+        for entry in spec.get("config", []):
+            config.append({"source": "FromClaim", "requests": [], **entry})
+        claim = {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {"uid": uid, "namespace": namespace, "name": name},
+            "status": {"allocation": {"devices": {"results": results, "config": config}}},
+        }
+        return claim
+
+    def _matches(self, req, dev) -> bool:
+        cls = req.get("exactly", {}).get("deviceClassName", "")
+        dtype = dev["attributes"].get("type", {}).get("string", "")
+        if cls == "tpu.google.com":
+            return dtype == "chip"
+        if cls == "tpu-partition.google.com":
+            if not dtype.startswith("partition"):
+                return False
+            for sel in req.get("exactly", {}).get("selectors", []):
+                expr = sel.get("cel", {}).get("expression", "")
+                if "1c.4hbm" in expr:
+                    return dev["attributes"].get("profile", {}).get("string") == "1c.4hbm"
+            return True
+        return False
+
+    def release(self, claim):
+        for r in claim["status"]["allocation"]["devices"]["results"]:
+            self._allocated.discard((r["pool"], r["device"]))
+
+
+def mk_driver(tmp_path, kube, **fg_map):
+    if fg_map:
+        fg.feature_gates().set_from_map(fg_map)
+    lib = MockDeviceLib(
+        config=MockTopologyConfig(generation="v5p"),
+        state_file=str(tmp_path / "hw.json"),
+    )
+    return Driver(
+        DriverConfig(
+            node_name="node-a",
+            plugin_dir=str(tmp_path / "plugin"),
+            registry_dir=str(tmp_path / "registry"),
+            cdi_root=str(tmp_path / "cdi"),
+        ),
+        kube,
+        lib,
+    )
+
+
+class TestSpecDrivenLifecycle:
+    def test_tpu_test1_single_chip_pod(self, tmp_path):
+        """demo/specs/tpu-test1.yaml end to end (test_gpu_basic.bats analog):
+        the pod's container must see exactly one chip."""
+        kube = FakeKube()
+        driver = mk_driver(tmp_path, kube)
+        driver.start()
+        try:
+            docs = load_spec("tpu-test1.yaml")
+            rct = find(docs, "ResourceClaimTemplate")[0]
+            sched = Scheduler(kube)
+            claim = sched.allocate(rct, "e2e-t1", "tpu-test1", "pod1-tpu")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "tpu-test1")
+
+            client = UnixRPCClient(driver.sockets.dra_socket_path)
+            resp = client.call("NodePrepareResources", {"claims": [claim]})
+            devices = resp["claims"]["e2e-t1"]["devices"]
+            assert len(devices) == 1
+
+            spec = driver.state._cdi.read_claim_spec("e2e-t1")
+            env = {e.split("=", 1)[0]: e.split("=", 1)[1] for e in spec["containerEdits"]["env"]}
+            visible = env["TPU_VISIBLE_DEVICES"].split(",")
+            assert len(visible) == 1  # the pod's python asserts len(jax.devices()) == 1
+            node_paths = [
+                n["path"] for d in spec["devices"] for n in d["containerEdits"]["deviceNodes"]
+            ]
+            assert node_paths == [f"/dev/accel{visible[0]}"]
+
+            client.call("NodeUnprepareResources", {"claims": [{"uid": "e2e-t1"}]})
+            client.close()
+        finally:
+            driver.stop()
+
+    def test_tpu_test2_shared_claim_two_containers(self, tmp_path):
+        """demo/specs/tpu-test2.yaml: one time-sliced claim shared by two
+        containers — both consume the same CDI device ids."""
+        kube = FakeKube()
+        driver = mk_driver(tmp_path, kube, **{fg.TIME_SLICING_SETTINGS: True})
+        driver.start()
+        try:
+            docs = load_spec("tpu-test2.yaml")
+            rct = find(docs, "ResourceClaimTemplate")[0]
+            claim = Scheduler(kube).allocate(rct, "e2e-t2", "tpu-test2", "shared")
+            client = UnixRPCClient(driver.sockets.dra_socket_path)
+            resp = client.call("NodePrepareResources", {"claims": [claim]})
+            result = resp["claims"]["e2e-t2"]
+            assert "error" not in result, result
+            # One claim → one CDI id set; both containers reference it.
+            cdi_ids = result["devices"][0]["cdiDeviceIDs"]
+            assert cdi_ids
+            chip_uuid = driver.state._chips_by_index[
+                int(result["devices"][0]["deviceName"].split("-")[1])
+            ].uuid
+            assert driver.state._lib.get_timeslice(chip_uuid) == "Short"
+            client.call("NodeUnprepareResources", {"claims": [{"uid": "e2e-t2"}]})
+            assert driver.state._lib.get_timeslice(chip_uuid) == "Default"  # reset
+            client.close()
+        finally:
+            driver.stop()
+
+    def test_tpu_partition_spec_two_pods_one_chip(self, tmp_path):
+        """demo/specs/tpu-test-partition.yaml (test_gpu_dynmig.bats analog):
+        two pods take disjoint halves of the same silicon."""
+        kube = FakeKube()
+        driver = mk_driver(tmp_path, kube, **{fg.DYNAMIC_PARTITIONING: True})
+        driver.start()
+        try:
+            docs = load_spec("tpu-test-partition.yaml")
+            rct = find(docs, "ResourceClaimTemplate")[0]
+            sched = Scheduler(kube)
+            c1 = sched.allocate(rct, "e2e-p1", "tpu-test-partition", "pod1-part")
+            c2 = sched.allocate(rct, "e2e-p2", "tpu-test-partition", "pod2-part")
+            client = UnixRPCClient(driver.sockets.dra_socket_path)
+            r1 = client.call("NodePrepareResources", {"claims": [c1]})["claims"]["e2e-p1"]
+            r2 = client.call("NodePrepareResources", {"claims": [c2]})["claims"]["e2e-p2"]
+            assert "error" not in r1 and "error" not in r2, (r1, r2)
+            assert r1["devices"][0]["deviceName"] != r2["devices"][0]["deviceName"]
+            # Two live partitions exist on the hardware now.
+            assert len(driver.state._lib.list_partitions()) == 2
+            client.call(
+                "NodeUnprepareResources",
+                {"claims": [{"uid": "e2e-p1"}, {"uid": "e2e-p2"}]},
+            )
+            assert driver.state._lib.list_partitions() == []
+            client.close()
+        finally:
+            driver.stop()
+
+
+class TestRestartRecovery:
+    def test_prepared_claims_survive_plugin_restart(self, tmp_path):
+        """Plugin restart (upgrade analog, test_gpu_updowngrade.bats): a new
+        driver over the same plugin dir must return the same grant
+        idempotently and GC nothing that is still live."""
+        kube = FakeKube()
+        d1 = mk_driver(tmp_path, kube)
+        d1.publish_resources()
+        docs = load_spec("tpu-test1.yaml")
+        rct = find(docs, "ResourceClaimTemplate")[0]
+        claim = Scheduler(kube).allocate(rct, "e2e-r1", "default", "c")
+        created = kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        # The apiserver owns uid assignment; the allocation must carry it or
+        # the GC correctly treats the claim as a stale re-creation.
+        uid = created["metadata"]["uid"]
+        claim["metadata"]["uid"] = uid
+        first = d1.prepare_resource_claims([claim])["claims"][uid]
+        d1.stop()
+
+        d2 = mk_driver(tmp_path, kube)
+        second = d2.prepare_resource_claims([claim])["claims"][uid]
+        assert first["devices"] == second["devices"]
+        assert d2.cleanup.cleanup_once() == 0  # claim still exists → no GC
+        d2.unprepare_resource_claims([{"uid": uid}])
+        d2.stop()
+
+    def test_stale_claim_gc_after_restart(self, tmp_path):
+        """Claim deleted from the apiserver while the plugin was down: the
+        GC pass unprepares it and frees the silicon."""
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        kube = FakeKube()
+        d1 = mk_driver(tmp_path, kube)
+        d1.publish_resources()
+        docs = load_spec("tpu-test-partition.yaml")
+        rct = find(docs, "ResourceClaimTemplate")[0]
+        claim = Scheduler(kube).allocate(rct, "e2e-r2", "default", "gone")
+        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        d1.prepare_resource_claims([claim])
+        assert len(d1.state._lib.list_partitions()) == 1
+        d1.stop()
+        kube.delete(gvr.RESOURCE_CLAIMS, "gone", "default")
+
+        d2 = mk_driver(tmp_path, kube)
+        assert d2.cleanup.cleanup_once() == 1
+        assert d2.state._lib.list_partitions() == []
+        assert d2.state.prepared_claim_uids() == {}
+        d2.stop()
+
+
+class TestStress:
+    def test_concurrent_claim_churn(self, tmp_path):
+        """test_gpu_stress.bats analog: many workers prepare/unprepare
+        through the socket concurrently; every claim gets a device, overlaps
+        are refused consistently, and the node ends clean."""
+        kube = FakeKube()
+        driver = mk_driver(tmp_path, kube)
+        driver.start()
+        errors: list[str] = []
+        ok = [0]
+        lock = threading.Lock()
+
+        def worker(wid):
+            client = UnixRPCClient(driver.sockets.dra_socket_path)
+            try:
+                for i in range(6):
+                    uid = f"stress-{wid}-{i}"
+                    chip = (wid + i) % 4
+                    claim = {
+                        "metadata": {"uid": uid, "namespace": "d", "name": uid},
+                        "status": {"allocation": {"devices": {"results": [
+                            {"request": "r0", "driver": TPU_DRIVER_NAME,
+                             "pool": "node-a", "device": f"tpu-{chip}"}], "config": []}}},
+                    }
+                    resp = client.call("NodePrepareResources", {"claims": [claim]})
+                    result = resp["claims"][uid]
+                    if "error" in result:
+                        # Overlap with another worker on the same chip is the
+                        # only acceptable refusal.
+                        if "overlaps" not in result["error"]:
+                            with lock:
+                                errors.append(result["error"])
+                        continue
+                    with lock:
+                        ok[0] += 1
+                    client.call("NodeUnprepareResources", {"claims": [{"uid": uid}]})
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        driver.stop()
+        assert not errors, errors[:3]
+        assert ok[0] > 0
+        assert driver.state.prepared_claim_uids() == {}
+        assert driver.state._cdi.list_claim_uids() == []
+
+
+class TestCDFailover:
+    def test_daemon_unready_degrades_domain(self, tmp_path):
+        """test_cd_failover.bats analog: a daemon losing its native process
+        flips its clique entry NotReady and the controller degrades the CD."""
+        from tests.test_computedomain import ReadyServer, mk_cd, mk_node, wait_for
+        from tpudra.cddaemon.app import DaemonApp, DaemonConfig
+        from tpudra.controller import Controller, ManagerConfig
+
+        NS = "tpudra-system"
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        mk_node(kube, "node-b")
+        cd = mk_cd(kube, num_nodes=2)
+        uid = cd["metadata"]["uid"]
+        stop = threading.Event()
+        Controller(kube, ManagerConfig(driver_namespace=NS, resync_period=0.2)).start(stop)
+
+        apps, stubs = [], []
+        try:
+            for i, node in enumerate(["node-a", "node-b"]):
+                stub = ReadyServer()
+                stub.set_ready()
+                stubs.append(stub)
+                cfg = DaemonConfig(
+                    cd_uid=uid, node_name=node, pod_name=f"d-{node}",
+                    pod_ip=f"10.0.0.{i + 1}", namespace=NS, clique_id="s1.0",
+                    num_hosts=2, host_index=i, status_port=stub.port,
+                    work_dir=str(tmp_path / f"w{i}"),
+                    hosts_path=str(tmp_path / f"h{i}"),
+                    daemon_argv=["sleep", "600"],
+                )
+                app = DaemonApp(kube, cfg)
+                threading.Thread(target=app.run, args=(stop,), daemon=True).start()
+                apps.append(app)
+
+            def cd_status():
+                return (
+                    kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+                    .get("status", {})
+                    .get("status")
+                )
+
+            wait_for(lambda: cd_status() == "Ready", timeout=20, msg="CD Ready")
+            # Failure injection: node-b's native daemon stops answering.
+            stubs[1].state = b"NOT_READY lost-peer"
+            wait_for(lambda: cd_status() == "NotReady", timeout=20, msg="CD degraded")
+            # Recovery: it comes back.
+            stubs[1].set_ready()
+            wait_for(lambda: cd_status() == "Ready", timeout=20, msg="CD recovered")
+        finally:
+            stop.set()
+            for app in apps:
+                if app.process is not None:
+                    app.process.stop()
+            for stub in stubs:
+                stub.close()

@@ -50,7 +50,7 @@ class CDDriver:
         self._kube = kube
         self._lib = devicelib
         os.makedirs(config.plugin_dir, exist_ok=True)
-        self._pu_lock = Flock(os.path.join(config.plugin_dir, "pu.lock"))
+        self._pu_lock_path = os.path.join(config.plugin_dir, "pu.lock")
         self.cd_manager = ComputeDomainManager(kube, config.node_name, config.plugin_dir)
         self.state = ComputeDomainDeviceState(
             devicelib,
@@ -86,13 +86,18 @@ class CDDriver:
 
     # ------------------------------------------------------ prepare/unprepare
 
+    def _pu_lock(self):
+        """Fresh Flock per operation — see tpudra/plugin/driver.py: one
+        shared instance cannot serve concurrent kubelet RPC threads."""
+        return Flock(self._pu_lock_path)
+
     def prepare_resource_claims(self, claims: list[dict]) -> dict:
         out: dict[str, dict] = {}
         for claim in claims:
             uid = claim.get("metadata", {}).get("uid", "")
             t0 = time.monotonic()
             try:
-                with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+                with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                     devices = self.state.prepare(claim)
                 out[uid] = {
                     "devices": [
@@ -118,7 +123,7 @@ class CDDriver:
         for ref in claims:
             uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
             try:
-                with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+                with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                     self.state.unprepare(uid)
                 out[uid] = {}
             except Exception as e:  # noqa: BLE001

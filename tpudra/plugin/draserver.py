@@ -88,7 +88,10 @@ class UnixRPCServer(socketserver.ThreadingUnixStreamServer):
         self._thread.start()
 
     def stop(self) -> None:
-        self.shutdown()
+        if self._thread is not None:
+            # shutdown() blocks on serve_forever's ack — calling it on a
+            # server that was never started would wait forever.
+            self.shutdown()
         self.server_close()
         if os.path.exists(self.path):
             os.unlink(self.path)

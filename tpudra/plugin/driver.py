@@ -71,7 +71,7 @@ class Driver:
         self._kube = kube
         self._lib = devicelib
         os.makedirs(config.plugin_dir, exist_ok=True)
-        self._pu_lock = Flock(os.path.join(config.plugin_dir, PU_LOCK))
+        self._pu_lock_path = os.path.join(config.plugin_dir, PU_LOCK)
         self.state = DeviceState(
             devicelib,
             CDIHandler(config.cdi_root, config.driver_root),
@@ -161,10 +161,17 @@ class Driver:
             self.publish_resources()  # siblings became visible again
         return {"claims": out}
 
+    def _pu_lock(self):
+        """A fresh Flock per operation: one shared instance cannot be
+        acquired twice, but kubelet issues concurrent prepare RPCs — each
+        call gets its own fd and the kernel serializes across both threads
+        and processes."""
+        return Flock(self._pu_lock_path)
+
     def _prepare_one(self, claim: dict) -> dict:
         t0 = time.monotonic()
         try:
-            with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+            with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                 t_lock = time.monotonic() - t0
                 devices = self.state.prepare(claim)
         except FlockTimeout as e:
@@ -190,7 +197,7 @@ class Driver:
             raise PermanentError("claim reference has no uid")
         t0 = time.monotonic()
         try:
-            with self._pu_lock(timeout=PU_LOCK_TIMEOUT):
+            with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                 self.state.unprepare(uid)
         except FlockTimeout as e:
             raise RuntimeError(f"node unprepare lock: {e}") from e
