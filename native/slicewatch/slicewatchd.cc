@@ -31,6 +31,7 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -64,6 +65,11 @@ struct Config {
   int peer_port = 7174;
   int heartbeat_ms = 500;
   int stale_ms = 3000;
+  // Source-address verification rejects spoofed liveness, but drops real
+  // heartbeats where the CNI SNATs pod traffic or a multi-homed sender's
+  // routing picks a different egress address than the one in /etc/hosts —
+  // such clusters opt out with --no-hb-source-check.
+  bool hb_source_check = true;
 };
 
 struct Peer {
@@ -203,13 +209,40 @@ class SliceWatch {
   void receive_heartbeats() {
     char buf[64];
     for (;;) {
-      ssize_t n = recv(peer_fd_, buf, sizeof(buf) - 1, MSG_DONTWAIT);
+      sockaddr_in src{};
+      socklen_t srclen = sizeof(src);
+      ssize_t n = recvfrom(peer_fd_, buf, sizeof(buf) - 1, MSG_DONTWAIT,
+                           reinterpret_cast<sockaddr*>(&src), &srclen);
       if (n <= 0) return;
       buf[n] = '\0';
       int idx = -1;
-      if (sscanf(buf, "HB %d", &idx) == 1 && idx >= 0 &&
-          idx < static_cast<int>(peers_.size()))
+      if (sscanf(buf, "HB %d", &idx) != 1 || idx < 0 ||
+          idx >= static_cast<int>(peers_.size()))
+        continue;
+      // The socket is INADDR_ANY: only count a heartbeat as liveness for
+      // index N when the datagram actually came from the address we resolved
+      // for N — otherwise any pod on the cluster network could spoof peer
+      // liveness and flip the domain READY before the slice is formed.
+      if (!cfg_.hb_source_check) {
         peers_[idx].last_seen_ms = now_ms();
+        continue;
+      }
+      const Peer& p = peers_[idx];
+      char src_ip[INET_ADDRSTRLEN] = {0};
+      inet_ntop(AF_INET, &src.sin_addr, src_ip, sizeof(src_ip));
+      if (p.ip.empty() || p.ip != src_ip) {
+        fprintf(stderr, "[slicewatchd] dropping HB %d from %s (expect %s)\n",
+                idx, src_ip, p.ip.empty() ? "<unresolved>" : p.ip.c_str());
+        continue;
+      }
+      // Single-host test mode distinguishes daemons by port override; the
+      // sender's source port is its bound --peer-port, so verify it too.
+      if (p.port > 0 && ntohs(src.sin_port) != p.port) {
+        fprintf(stderr, "[slicewatchd] dropping HB %d from port %d (expect %d)\n",
+                idx, ntohs(src.sin_port), p.port);
+        continue;
+      }
+      peers_[idx].last_seen_ms = now_ms();
     }
   }
 
@@ -300,6 +333,7 @@ int main(int argc, char** argv) {
     else if (a == "--peer-port") cfg.peer_port = atoi(next());
     else if (a == "--heartbeat-ms") cfg.heartbeat_ms = atoi(next());
     else if (a == "--stale-ms") cfg.stale_ms = atoi(next());
+    else if (a == "--no-hb-source-check") cfg.hb_source_check = false;
     else {
       fprintf(stderr, "unknown flag %s\n", a.c_str());
       return 2;

@@ -98,6 +98,22 @@ class TestController:
         c.manager.reconcile("user-ns", "cd1")
         assert len(kube.list(gvr.DAEMONSETS, NS)["items"]) == 1
 
+    def test_daemonset_drift_reconciled(self, tmp_path):
+        # Image/template changes after a controller upgrade must propagate
+        # to already-deployed per-CD daemons (ref daemonset.go:346).
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+        old = kube.get(gvr.DAEMONSETS, f"computedomain-daemon-{uid}", NS)
+        assert old["spec"]["template"]["spec"]["containers"][0]["image"] != "tpudra:v2"
+
+        c2 = Controller(kube, ManagerConfig(driver_namespace=NS, image="tpudra:v2"))
+        c2.manager.reconcile("user-ns", "cd1")
+        live = kube.get(gvr.DAEMONSETS, f"computedomain-daemon-{uid}", NS)
+        assert live["spec"]["template"]["spec"]["containers"][0]["image"] == "tpudra:v2"
+
     def test_max_nodes_guard(self, tmp_path):
         kube = FakeKube()
         mk_cd(kube, num_nodes=64)
@@ -528,3 +544,67 @@ class TestFullLifecycle:
         assert total == 2049  # 2048 channels + 1 daemon device
         assert all(len(s["spec"]["devices"]) <= 128 for s in slices)
         assert slices[0]["spec"]["pool"]["resourceSliceCount"] == len(slices)
+
+    def test_republish_bumps_generation_and_deletes_stale(self, tmp_path):
+        # If chunking/naming changes across an upgrade, orphaned slices at
+        # equal generation would advertise duplicate channel devices.
+        kube = FakeKube()
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5e"),
+            state_file=str(tmp_path / "hw.json"),
+        )
+        cddrv = CDDriver(
+            CDDriverConfig(
+                node_name="node-a",
+                plugin_dir=str(tmp_path / "p"),
+                registry_dir=str(tmp_path / "r"),
+                cdi_root=str(tmp_path / "c"),
+            ),
+            kube,
+            lib,
+        )
+        first = cddrv.publish_resources()
+        # A slice published under an older naming scheme for the same node.
+        kube.create(
+            gvr.RESOURCE_SLICES,
+            {
+                "apiVersion": "resource.k8s.io/v1",
+                "kind": "ResourceSlice",
+                "metadata": {"name": f"node-a-{COMPUTE_DOMAIN_DRIVER_NAME}-stale-99"},
+                "spec": {
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "nodeName": "node-a",
+                    "pool": {"name": "node-a", "generation": 1, "resourceSliceCount": 1},
+                    "devices": [],
+                },
+            },
+        )
+        second = cddrv.publish_resources()
+        assert (
+            second[0]["spec"]["pool"]["generation"]
+            == first[0]["spec"]["pool"]["generation"] + 1
+        )
+        names = {
+            i["metadata"]["name"]
+            for i in kube.list(gvr.RESOURCE_SLICES)["items"]
+            if i["spec"]["nodeName"] == "node-a"
+        }
+        assert f"node-a-{COMPUTE_DOMAIN_DRIVER_NAME}-stale-99" not in names
+        assert names == {s["metadata"]["name"] for s in second}
+        # A restarted driver must outrank the previous process's slices, not
+        # start back at generation 1 (scheduler trusts the highest seen).
+        restarted = CDDriver(
+            CDDriverConfig(
+                node_name="node-a",
+                plugin_dir=str(tmp_path / "p2"),
+                registry_dir=str(tmp_path / "r2"),
+                cdi_root=str(tmp_path / "c2"),
+            ),
+            kube,
+            lib,
+        )
+        third = restarted.publish_resources()
+        assert (
+            third[0]["spec"]["pool"]["generation"]
+            > second[0]["spec"]["pool"]["generation"]
+        )

@@ -21,7 +21,7 @@ from tpudra.cdplugin.computedomain import ComputeDomainManager
 from tpudra.cdplugin.state import ComputeDomainDeviceState
 from tpudra.devicelib import DeviceLib
 from tpudra.flock import Flock, FlockTimeout
-from tpudra.kube.apply import apply_resource_slice
+from tpudra.kube.apply import next_pool_generation, publish_slices
 from tpudra.kube.client import KubeAPI
 from tpudra.plugin.cdi import CDIHandler
 from tpudra.plugin.checkpoint import CheckpointManager
@@ -68,6 +68,10 @@ class CDDriver:
             unprepare=self.unprepare_resource_claims,
         )
         self.cleanup = CheckpointCleanupManager(kube, self.state)
+        # Seeded from live slices so a restart outranks previous publishes.
+        self._pool_generation = next_pool_generation(
+            kube, config.node_name, config.node_name
+        )
 
     # ------------------------------------------------------------- lifecycle
 
@@ -153,14 +157,19 @@ class CDDriver:
                         "nodeName": self._config.node_name,
                         "pool": {
                             "name": self._config.node_name,
-                            "generation": 1,
+                            "generation": self._pool_generation,
                             "resourceSliceCount": len(chunks),
                         },
                         "devices": chunk,
                     },
                 }
             )
-        for s in slices:
-            apply_resource_slice(self._kube, s)
+        self._pool_generation += 1
+        publish_slices(
+            self._kube,
+            slices,
+            self._config.node_name,
+            f"{self._config.node_name}-{COMPUTE_DOMAIN_DRIVER_NAME}-",
+        )
         logger.info("published %d CD ResourceSlice(s)", len(slices))
         return slices

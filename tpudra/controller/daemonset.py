@@ -9,6 +9,8 @@ model), creating the daemon RCT first so the pod's resource claim resolves.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
 import string
@@ -30,6 +32,19 @@ DEFAULT_TEMPLATE_PATH = os.path.join(
 )
 
 
+# Annotation recording the hash of the spec this controller last rendered.
+# Drift detection compares rendered-vs-rendered (never rendered-vs-live), so
+# it is immune to server-side defaulting AND catches fields a newer template
+# *removed* — both directions a live-spec comparison gets wrong.
+TEMPLATE_HASH_ANNOTATION = "resource.tpu.google.com/template-hash"
+
+
+def _spec_hash(spec: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:32]
+
+
 class DaemonSetManager:
     def __init__(
         self,
@@ -42,19 +57,20 @@ class DaemonSetManager:
         self._kube = kube
         self._ns = driver_namespace
         self._image = image
-        self._template_path = template_path
         self._log_verbosity = log_verbosity
+        # The template never changes within a controller process — read it
+        # once, not on every reconcile.
+        with open(template_path) as f:
+            self._template = string.Template(f.read())
 
     def name(self, cd_uid: str) -> str:
         return f"computedomain-daemon-{cd_uid}"
 
     def render(self, cd: dict, daemon_rct_name: str) -> dict:
-        with open(self._template_path) as f:
-            template = string.Template(f.read())
         gates = ",".join(
             f"{k}={'true' if v else 'false'}" for k, v in sorted(featuregates.to_map().items())
         )
-        rendered = template.substitute(
+        rendered = self._template.substitute(
             name=self.name(cd["metadata"]["uid"]),
             namespace=self._ns,
             cd_uid=cd["metadata"]["uid"],
@@ -63,17 +79,35 @@ class DaemonSetManager:
             feature_gates=gates,
             log_verbosity=str(self._log_verbosity),
         )
-        return yaml.safe_load(rendered)
+        obj = yaml.safe_load(rendered)
+        obj.setdefault("metadata", {}).setdefault("annotations", {})[
+            TEMPLATE_HASH_ANNOTATION
+        ] = _spec_hash(obj["spec"])
+        return obj
 
     def ensure(self, cd: dict, daemon_rct_name: str) -> dict:
         name = self.name(cd["metadata"]["uid"])
-        try:
-            return self._kube.get(gvr.DAEMONSETS, name, self._ns)
-        except NotFound:
-            pass
         obj = self.render(cd, daemon_rct_name)
-        logger.info("creating DaemonSet %s/%s", self._ns, name)
-        return self._kube.create(gvr.DAEMONSETS, obj, self._ns)
+        try:
+            live = self._kube.get(gvr.DAEMONSETS, name, self._ns)
+        except NotFound:
+            logger.info("creating DaemonSet %s/%s", self._ns, name)
+            return self._kube.create(gvr.DAEMONSETS, obj, self._ns)
+        # Reconcile drift: image/feature-gate/template changes after a
+        # controller upgrade must propagate to already-deployed per-CD
+        # daemons (reference updates existing DaemonSets, daemonset.go:346).
+        live_hash = (
+            live.get("metadata", {}).get("annotations", {}).get(TEMPLATE_HASH_ANNOTATION)
+        )
+        want_hash = obj["metadata"]["annotations"][TEMPLATE_HASH_ANNOTATION]
+        if live_hash != want_hash:
+            logger.info("updating drifted DaemonSet %s/%s", self._ns, name)
+            live["spec"] = obj["spec"]
+            meta = live.setdefault("metadata", {})
+            meta.setdefault("labels", {}).update(obj["metadata"].get("labels", {}))
+            meta.setdefault("annotations", {})[TEMPLATE_HASH_ANNOTATION] = want_hash
+            return self._kube.update(gvr.DAEMONSETS, live, self._ns)
+        return live
 
     def remove(self, cd_uid: str) -> None:
         try:

@@ -11,6 +11,65 @@ from tpudra.kube.errors import Conflict, NotFound
 logger = logging.getLogger(__name__)
 
 
+def next_pool_generation(kube: KubeAPI, node_name: str, pool_name: str) -> int:
+    """Seed a publisher's pool generation from the highest generation already
+    live for this pool, so a restarted driver's fresh slices outrank any
+    leftovers from the previous process (DRA consumers trust the highest
+    generation seen for a pool; starting back at 1 would let a stale slice
+    shadow the real device set)."""
+    highest = 0
+    try:
+        existing = kube.list(
+            gvr.RESOURCE_SLICES, field_selector=f"spec.nodeName={node_name}"
+        )
+    except Exception:  # noqa: BLE001 — publication must not die on list
+        logger.warning(
+            "could not list live slices to seed pool %s generation; "
+            "starting at 1 — stale higher-generation slices may shadow "
+            "fresh publishes until overtaken",
+            pool_name,
+            exc_info=True,
+        )
+        return 1
+    for item in existing.get("items", []):
+        pool = item.get("spec", {}).get("pool", {})
+        if pool.get("name") == pool_name:
+            highest = max(highest, int(pool.get("generation", 0)))
+    return highest + 1
+
+
+def delete_stale_slices(
+    kube: KubeAPI, node_name: str, name_prefix: str, keep: set[str]
+) -> None:
+    """Remove slices this node published in a previous shape (naming or
+    chunking changes across an upgrade) — orphans would keep advertising
+    duplicate devices.  Shared by both node plugins."""
+    try:
+        existing = kube.list(
+            gvr.RESOURCE_SLICES, field_selector=f"spec.nodeName={node_name}"
+        )
+    except Exception:  # noqa: BLE001 — publication must not die on list
+        return
+    for item in existing.get("items", []):
+        name = item.get("metadata", {}).get("name", "")
+        if name.startswith(name_prefix) and name not in keep:
+            try:
+                kube.delete(gvr.RESOURCE_SLICES, name)
+            except NotFound:
+                pass
+
+
+def publish_slices(
+    kube: KubeAPI, slices: list[dict], node_name: str, name_prefix: str
+) -> None:
+    """Apply a freshly built slice set, then GC slices from a previous shape.
+    The shared tail of both node plugins' publish paths."""
+    keep = {s["metadata"]["name"] for s in slices}
+    for s in slices:
+        apply_resource_slice(kube, s)
+    delete_stale_slices(kube, node_name, name_prefix, keep)
+
+
 def apply_resource_slice(kube: KubeAPI, obj: dict, attempts: int = 3) -> bool:
     """Create the slice, or update it carrying the live resourceVersion;
     retries conflicts by re-reading.  Returns False if conflicts persist

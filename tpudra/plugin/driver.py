@@ -25,10 +25,8 @@ from typing import Optional
 from tpudra import TPU_DRIVER_NAME, featuregates
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
 from tpudra.flock import Flock, FlockTimeout
-from tpudra.kube import gvr
-from tpudra.kube.apply import apply_resource_slice
+from tpudra.kube.apply import next_pool_generation, publish_slices
 from tpudra.kube.client import KubeAPI
-from tpudra.kube.errors import NotFound
 from tpudra.plugin import allocatable as alloc
 from tpudra.plugin.cdi import CDIHandler
 from tpudra.plugin.checkpoint import CheckpointManager
@@ -86,7 +84,10 @@ class Driver:
         # health thread and prepare RPC threads both publish, and an
         # interleaving could re-advertise silicon just marked unhealthy.
         self._publish_lock = threading.Lock()
-        self._pool_generation = 1
+        # Seeded from live slices so a restart outranks previous publishes.
+        self._pool_generation = next_pool_generation(
+            kube, config.node_name, alloc.pool_name(config.node_name)
+        )
         self._stop = threading.Event()
         self._sockets = PluginSockets(
             TPU_DRIVER_NAME,
@@ -224,34 +225,17 @@ class Driver:
                 generation=self._pool_generation,
             )
             self._pool_generation += 1
-            published_names = {s["metadata"]["name"] for s in slices}
-            for s in slices:
-                apply_resource_slice(self._kube, s)
-            self._delete_stale_slices(published_names)
+            publish_slices(
+                self._kube,
+                slices,
+                self._config.node_name,
+                f"{self._config.node_name}-{TPU_DRIVER_NAME}-",
+            )
             logger.info(
                 "published %d ResourceSlice(s), %d devices, %d unhealthy",
                 len(slices), len(res.devices), len(unhealthy),
             )
             return slices
-
-    def _delete_stale_slices(self, keep: set[str]) -> None:
-        """Remove slices this node published in a previous shape (e.g. the
-        combined form after an upgrade to the split form)."""
-        prefix = f"{self._config.node_name}-{TPU_DRIVER_NAME}-"
-        try:
-            existing = self._kube.list(
-                gvr.RESOURCE_SLICES,
-                field_selector=f"spec.nodeName={self._config.node_name}",
-            )
-        except Exception:  # noqa: BLE001 — publication must not die on list
-            return
-        for item in existing.get("items", []):
-            name = item.get("metadata", {}).get("name", "")
-            if name.startswith(prefix) and name not in keep:
-                try:
-                    self._kube.delete(gvr.RESOURCE_SLICES, name)
-                except NotFound:
-                    pass
 
     # --------------------------------------------------------------- health
 
