@@ -11,9 +11,11 @@ reference's accepted worst case one full bind is.
 What one iteration measures (the gpu-test1 single-chip claim analog, end to
 end through every real layer of this driver):
 
-  DRA unix-socket RPC → node-global flock → checkpoint RMW (flock + dual
-  version write) → overlap validation → device prepare → transient CDI spec
-  write → checkpoint complete → RPC response … then the matching unprepare.
+  DRA gRPC over the unix socket (the real kubelet wire protocol) → claim
+  reference resolution against the apiserver → node-global flock →
+  checkpoint RMW (flock + dual version write) → overlap validation → device
+  prepare → transient CDI spec write → checkpoint complete → RPC response
+  … then the matching unprepare.
 
 Run: ``python bench.py`` — prints exactly one JSON line.
 """
@@ -35,15 +37,17 @@ def main() -> None:
     from tests.test_device_state import mk_claim
     from tpudra.devicelib import MockTopologyConfig
     from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.kube import gvr
     from tpudra.kube.fake import FakeKube
-    from tpudra.plugin.draserver import UnixRPCClient
     from tpudra.plugin.driver import Driver, DriverConfig
+    from tpudra.plugin.grpcserver import DRAClient
 
     with tempfile.TemporaryDirectory() as tmp:
         lib = MockDeviceLib(
             config=MockTopologyConfig(generation="v5p"),
             state_file=f"{tmp}/hw.json",
         )
+        kube = FakeKube()
         driver = Driver(
             DriverConfig(
                 node_name="bench-node",
@@ -51,23 +55,26 @@ def main() -> None:
                 registry_dir=f"{tmp}/registry",
                 cdi_root=f"{tmp}/cdi",
             ),
-            FakeKube(),
+            kube,
             lib,
         )
         driver.start()
-        client = UnixRPCClient(driver.sockets.dra_socket_path)
+        client = DRAClient(driver.sockets.dra_socket_path)
         try:
             samples_ms: list[float] = []
             for i in range(ITERS + WARMUP):
                 uid = f"bench-{i}"
-                claim = mk_claim(uid, [f"tpu-{i % 4}"])
+                claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                # Timed span = what kubelet experiences: the DRA gRPC call,
+                # including the plugin's claim-reference resolution.
                 t0 = time.perf_counter()
-                resp = client.call("NodePrepareResources", {"claims": [claim]})
+                resp = client.prepare([claim])
                 dt = (time.perf_counter() - t0) * 1000.0
                 result = resp["claims"][uid]
                 if "error" in result:
                     raise RuntimeError(f"prepare failed: {result['error']}")
-                client.call("NodeUnprepareResources", {"claims": [{"uid": uid}]})
+                client.unprepare([claim])
                 if i >= WARMUP:
                     samples_ms.append(dt)
             p50 = statistics.median(samples_ms)

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Regenerate the protobuf message modules under tpudra/drapb/.
+#
+# Only messages are generated (protoc --python_out); the gRPC service
+# wiring is hand-written in tpudra/plugin/grpcserver.py with
+# grpc.method_handlers_generic_handler, so grpc_tools is not needed.
+set -euo pipefail
+cd "$(dirname "$0")"
+OUT=../tpudra/drapb
+protoc --python_out="$OUT" \
+  pluginregistration_v1.proto dra_v1.proto dra_v1beta1.proto
+echo "generated into $OUT:"
+ls "$OUT"

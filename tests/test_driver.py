@@ -12,8 +12,13 @@ from tpudra.devicelib import HealthEvent, HealthEventKind, MockTopologyConfig
 from tpudra.devicelib.mock import MockDeviceLib
 from tpudra.kube import gvr
 from tpudra.kube.fake import FakeKube
-from tpudra.plugin.draserver import UnixRPCClient
 from tpudra.plugin.driver import Driver, DriverConfig
+from tpudra.plugin.grpcserver import (
+    DRA_PLUGIN_TYPE,
+    SUPPORTED_SERVICES,
+    DRAClient,
+    RegistrationClient,
+)
 from tpudra.plugin.resourceslice import (
     build_resource_slices,
     generate_driver_resources,
@@ -173,24 +178,62 @@ class TestDriver:
         assert resp["claims"]["uid-1"]["permanent"] is True
 
     def test_sockets_serve_dra_protocol(self, tmp_path):
-        d = mk_driver(tmp_path)
+        """Conformance: the two sockets speak the real kubelet wire contract —
+        pluginregistration.Registration on the registry socket and both
+        dra.v1/dra.v1beta1 DRAPlugin services on the DRA socket, with claim
+        references resolved against the apiserver (the way kubeletplugin.Start
+        serves the reference, driver.go:123-132)."""
+        import os
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
         d.start()
         try:
-            reg = UnixRPCClient(d.sockets.registration_socket_path)
-            info = reg.call("GetInfo")
+            # --- registration handshake (pluginwatcher side) ---
+            reg = RegistrationClient(d.sockets.registration_socket_path)
+            info = reg.get_info()
+            assert info["type"] == DRA_PLUGIN_TYPE
             assert info["name"] == TPU_DRIVER_NAME
-            assert info["endpoint"] == d.sockets.dra_socket_path
-            reg.call("NotifyRegistrationStatus", {"pluginRegistered": True})
+            assert info["endpoint"] == os.path.abspath(d.sockets.dra_socket_path)
+            assert info["supportedVersions"] == SUPPORTED_SERVICES
+            reg.notify(True)
             assert d.sockets.registered
             reg.close()
 
-            dra = UnixRPCClient(d.sockets.dra_socket_path)
-            resp = dra.call(
-                "NodePrepareResources", {"claims": [mk_claim("uid-s", ["tpu-1"])]}
-            )
-            assert resp["claims"]["uid-s"]["devices"][0]["deviceName"] == "tpu-1"
-            resp = dra.call("NodeUnprepareResources", {"claims": [{"uid": "uid-s"}]})
-            assert resp["claims"]["uid-s"] == {}
+            # --- DRA service, both API versions kubelet may pick ---
+            for service in ("v1", "v1beta1"):
+                uid = f"uid-{service}"
+                claim = mk_claim(uid, ["tpu-1"], name=f"claim-{service}")
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                dra = DRAClient(d.sockets.dra_socket_path, service=service)
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid]["devices"][0]["deviceName"] == "tpu-1"
+                resp = dra.unprepare([claim])
+                assert resp["claims"][uid] == {}
+                dra.close()
+        finally:
+            d.stop()
+
+    def test_dra_claim_resolution_failures(self, tmp_path):
+        """Kubelet sends only claim references; an unknown claim or a uid
+        mismatch (stale re-creation) must yield a per-claim error, never a
+        prepared device."""
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            dra = DRAClient(d.sockets.dra_socket_path)
+            # Never created in the apiserver.
+            ghost = {"metadata": {"uid": "u-ghost", "namespace": "default", "name": "nope"}}
+            resp = dra.prepare([ghost])
+            assert "resolve claim" in resp["claims"]["u-ghost"]["error"]
+
+            # Same name, different uid: the claim was deleted and re-created.
+            claim = mk_claim("u-old", ["tpu-0"], name="flappy")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            stale = {"metadata": {"uid": "u-new", "namespace": "default", "name": "flappy"}}
+            resp = dra.prepare([stale])
+            assert "UID mismatch" in resp["claims"]["u-new"]["error"]
             dra.close()
         finally:
             d.stop()

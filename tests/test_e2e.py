@@ -19,8 +19,8 @@ from tpudra.devicelib import MockTopologyConfig
 from tpudra.devicelib.mock import MockDeviceLib
 from tpudra.kube import gvr
 from tpudra.kube.fake import FakeKube
-from tpudra.plugin.draserver import UnixRPCClient
 from tpudra.plugin.driver import Driver, DriverConfig
+from tpudra.plugin.grpcserver import DRAClient
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -49,7 +49,7 @@ class Scheduler:
             for dev in s["spec"]["devices"]:
                 yield pool, s["spec"]["driver"], dev
 
-    def allocate(self, rct, uid, namespace="default", name="claim"):
+    def allocate(self, rct, uid, namespace="default", name="claim", create=True):
         spec = rct["spec"]["spec"]["devices"]
         results = []
         for req in spec.get("requests", []):
@@ -78,6 +78,10 @@ class Scheduler:
             "metadata": {"uid": uid, "namespace": namespace, "name": name},
             "status": {"allocation": {"devices": {"results": results, "config": config}}},
         }
+        if create:
+            # Allocation lives in the apiserver: the plugin resolves claim
+            # references kubelet sends over the DRA gRPC wire.
+            claim = self._kube.create(gvr.RESOURCE_CLAIMS, claim, namespace)
         return claim
 
     def _matches(self, req, dev) -> bool:
@@ -131,10 +135,9 @@ class TestSpecDrivenLifecycle:
             rct = find(docs, "ResourceClaimTemplate")[0]
             sched = Scheduler(kube)
             claim = sched.allocate(rct, "e2e-t1", "tpu-test1", "pod1-tpu")
-            kube.create(gvr.RESOURCE_CLAIMS, claim, "tpu-test1")
 
-            client = UnixRPCClient(driver.sockets.dra_socket_path)
-            resp = client.call("NodePrepareResources", {"claims": [claim]})
+            client = DRAClient(driver.sockets.dra_socket_path)
+            resp = client.prepare([claim])
             devices = resp["claims"]["e2e-t1"]["devices"]
             assert len(devices) == 1
 
@@ -147,7 +150,7 @@ class TestSpecDrivenLifecycle:
             ]
             assert node_paths == [f"/dev/accel{visible[0]}"]
 
-            client.call("NodeUnprepareResources", {"claims": [{"uid": "e2e-t1"}]})
+            client.unprepare([claim])
             client.close()
         finally:
             driver.stop()
@@ -162,8 +165,8 @@ class TestSpecDrivenLifecycle:
             docs = load_spec("tpu-test2.yaml")
             rct = find(docs, "ResourceClaimTemplate")[0]
             claim = Scheduler(kube).allocate(rct, "e2e-t2", "tpu-test2", "shared")
-            client = UnixRPCClient(driver.sockets.dra_socket_path)
-            resp = client.call("NodePrepareResources", {"claims": [claim]})
+            client = DRAClient(driver.sockets.dra_socket_path)
+            resp = client.prepare([claim])
             result = resp["claims"]["e2e-t2"]
             assert "error" not in result, result
             # One claim → one CDI id set; both containers reference it.
@@ -173,7 +176,7 @@ class TestSpecDrivenLifecycle:
                 int(result["devices"][0]["deviceName"].split("-")[1])
             ].uuid
             assert driver.state._lib.get_timeslice(chip_uuid) == "Short"
-            client.call("NodeUnprepareResources", {"claims": [{"uid": "e2e-t2"}]})
+            client.unprepare([claim])
             assert driver.state._lib.get_timeslice(chip_uuid) == "Default"  # reset
             client.close()
         finally:
@@ -191,17 +194,14 @@ class TestSpecDrivenLifecycle:
             sched = Scheduler(kube)
             c1 = sched.allocate(rct, "e2e-p1", "tpu-test-partition", "pod1-part")
             c2 = sched.allocate(rct, "e2e-p2", "tpu-test-partition", "pod2-part")
-            client = UnixRPCClient(driver.sockets.dra_socket_path)
-            r1 = client.call("NodePrepareResources", {"claims": [c1]})["claims"]["e2e-p1"]
-            r2 = client.call("NodePrepareResources", {"claims": [c2]})["claims"]["e2e-p2"]
+            client = DRAClient(driver.sockets.dra_socket_path)
+            r1 = client.prepare([c1])["claims"]["e2e-p1"]
+            r2 = client.prepare([c2])["claims"]["e2e-p2"]
             assert "error" not in r1 and "error" not in r2, (r1, r2)
             assert r1["devices"][0]["deviceName"] != r2["devices"][0]["deviceName"]
             # Two live partitions exist on the hardware now.
             assert len(driver.state._lib.list_partitions()) == 2
-            client.call(
-                "NodeUnprepareResources",
-                {"claims": [{"uid": "e2e-p1"}, {"uid": "e2e-p2"}]},
-            )
+            client.unprepare([c1, c2])
             assert driver.state._lib.list_partitions() == []
             client.close()
         finally:
@@ -219,11 +219,7 @@ class TestRestartRecovery:
         docs = load_spec("tpu-test1.yaml")
         rct = find(docs, "ResourceClaimTemplate")[0]
         claim = Scheduler(kube).allocate(rct, "e2e-r1", "default", "c")
-        created = kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
-        # The apiserver owns uid assignment; the allocation must carry it or
-        # the GC correctly treats the claim as a stale re-creation.
-        uid = created["metadata"]["uid"]
-        claim["metadata"]["uid"] = uid
+        uid = claim["metadata"]["uid"]
         first = d1.prepare_resource_claims([claim])["claims"][uid]
         d1.stop()
 
@@ -244,7 +240,6 @@ class TestRestartRecovery:
         docs = load_spec("tpu-test-partition.yaml")
         rct = find(docs, "ResourceClaimTemplate")[0]
         claim = Scheduler(kube).allocate(rct, "e2e-r2", "default", "gone")
-        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
         d1.prepare_resource_claims([claim])
         assert len(d1.state._lib.list_partitions()) == 1
         d1.stop()
@@ -270,7 +265,7 @@ class TestStress:
         lock = threading.Lock()
 
         def worker(wid):
-            client = UnixRPCClient(driver.sockets.dra_socket_path)
+            client = DRAClient(driver.sockets.dra_socket_path)
             try:
                 for i in range(6):
                     uid = f"stress-{wid}-{i}"
@@ -281,7 +276,8 @@ class TestStress:
                             {"request": "r0", "driver": TPU_DRIVER_NAME,
                              "pool": "node-a", "device": f"tpu-{chip}"}], "config": []}}},
                     }
-                    resp = client.call("NodePrepareResources", {"claims": [claim]})
+                    kube.create(gvr.RESOURCE_CLAIMS, claim, "d")
+                    resp = client.prepare([claim])
                     result = resp["claims"][uid]
                     if "error" in result:
                         # Overlap with another worker on the same chip is the
@@ -289,10 +285,12 @@ class TestStress:
                         if "overlaps" not in result["error"]:
                             with lock:
                                 errors.append(result["error"])
+                        kube.delete(gvr.RESOURCE_CLAIMS, uid, "d")
                         continue
                     with lock:
                         ok[0] += 1
-                    client.call("NodeUnprepareResources", {"claims": [{"uid": uid}]})
+                    client.unprepare([claim])
+                    kube.delete(gvr.RESOURCE_CLAIMS, uid, "d")
             finally:
                 client.close()
 

@@ -36,7 +36,13 @@ class TestHealthcheck:
         hc = Healthcheck(d.sockets)
         hc.start()
         try:
-            d.sockets._dra.stop()  # simulate a wedged/dead DRA server
+            # Simulate a wedged/dead DRA server: stop the gRPC server and
+            # remove its socket file.
+            import os
+
+            d.sockets._dra_server.stop(grace=0).wait()
+            if os.path.exists(d.sockets.dra_socket_path):
+                os.unlink(d.sockets.dra_socket_path)
             status, body = fetch(hc.port)
             assert status == 503 and not body["healthy"]
             assert "DRA socket" in body["detail"]

@@ -1,7 +1,7 @@
 """ComputeDomain kubelet-plugin driver.
 
 The analog of compute-domain-kubelet-plugin/driver.go: the same two-socket
-kubelet contract as the TPU plugin (tpudra/plugin/draserver.py) serving the
+kubelet gRPC contract as the TPU plugin (tpudra/plugin/grpcserver.py) serving the
 compute-domain driver name, ResourceSlice publication of the 2048 channels +
 1 daemon device (chunked to the per-slice device cap), and claim fan-in to
 the checkpointed CD device state.
@@ -27,7 +27,7 @@ from tpudra.plugin.cdi import CDIHandler
 from tpudra.plugin.checkpoint import CheckpointManager
 from tpudra.plugin.cleanup import CheckpointCleanupManager
 from tpudra.plugin.device_state import PermanentError
-from tpudra.plugin.draserver import PluginSockets
+from tpudra.plugin.grpcserver import PluginSockets, kube_claim_resolver
 from tpudra.plugin.resourceslice import MAX_DEVICES_PER_SLICE
 
 logger = logging.getLogger(__name__)
@@ -66,6 +66,7 @@ class CDDriver:
             config.registry_dir,
             prepare=self.prepare_resource_claims,
             unprepare=self.unprepare_resource_claims,
+            resolve_claim=kube_claim_resolver(kube),
         )
         self.cleanup = CheckpointCleanupManager(kube, self.state)
         # Seeded from live slices so a restart outranks previous publishes.

@@ -202,7 +202,10 @@ class FakeKube:
                 raise errors.AlreadyExists(
                     f"{gvr.resource} {namespace or ''}/{name} already exists"
                 )
-            meta["uid"] = str(uuidlib.uuid4())
+            # A real apiserver owns uid assignment; the fake honors a
+            # pre-set uid so tests can use deterministic claim uids while
+            # still getting server-assigned ones when omitted.
+            meta["uid"] = meta.get("uid") or str(uuidlib.uuid4())
             meta["resourceVersion"] = self._next_rv()
             meta["creationTimestamp"] = _now()
             meta.setdefault("generation", 1)

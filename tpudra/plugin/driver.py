@@ -32,7 +32,7 @@ from tpudra.plugin.cdi import CDIHandler
 from tpudra.plugin.checkpoint import CheckpointManager
 from tpudra.plugin.cleanup import CheckpointCleanupManager
 from tpudra.plugin.device_state import DeviceState, PermanentError
-from tpudra.plugin.draserver import PluginSockets
+from tpudra.plugin.grpcserver import PluginSockets, kube_claim_resolver
 from tpudra.plugin.resourceslice import build_resource_slices, generate_driver_resources
 from tpudra.plugin.sharing import MultiProcessManager
 from tpudra.plugin.vfio import VfioManager
@@ -95,6 +95,7 @@ class Driver:
             config.registry_dir,
             prepare=self.prepare_resource_claims,
             unprepare=self.unprepare_resource_claims,
+            resolve_claim=kube_claim_resolver(kube),
         )
         self.cleanup = CheckpointCleanupManager(kube, self.state)
         self._health_thread: Optional[threading.Thread] = None

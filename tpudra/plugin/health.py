@@ -20,7 +20,7 @@ import logging
 import threading
 from typing import Optional
 
-from tpudra.plugin.draserver import PluginSockets, UnixRPCClient
+from tpudra.plugin.grpcserver import DRAClient, PluginSockets, RegistrationClient
 
 logger = logging.getLogger(__name__)
 
@@ -38,11 +38,11 @@ class Healthcheck:
 
     def check(self) -> tuple[bool, str]:
         try:
-            reg = UnixRPCClient(
+            reg = RegistrationClient(
                 self._sockets.registration_socket_path, timeout=self._probe_timeout
             )
             try:
-                info = reg.call("GetInfo")
+                info = reg.get_info()
             finally:
                 reg.close()
             if info.get("name") != self._sockets.driver_name:
@@ -50,9 +50,9 @@ class Healthcheck:
         except Exception as e:  # noqa: BLE001 — any probe failure is unhealthy
             return False, f"registration socket: {e}"
         try:
-            dra = UnixRPCClient(self._sockets.dra_socket_path, timeout=self._probe_timeout)
+            dra = DRAClient(self._sockets.dra_socket_path, timeout=self._probe_timeout)
             try:
-                dra.call("NodePrepareResources", {"claims": []})
+                dra.prepare([])  # no-op batch, same as reference health.go:122
             finally:
                 dra.close()
         except Exception as e:  # noqa: BLE001
