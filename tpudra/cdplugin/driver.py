@@ -15,7 +15,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, metrics
 from tpudra.cdplugin.allocatable import build_devices
 from tpudra.cdplugin.computedomain import ComputeDomainManager
 from tpudra.cdplugin.state import ComputeDomainDeviceState
@@ -117,16 +117,23 @@ class CDDriver:
                 }
                 logger.info("t_prep=%.4fs cd-claim=%s", time.monotonic() - t0, uid)
             except FlockTimeout as e:
+                metrics.PREPARE_ERRORS.labels(COMPUTE_DOMAIN_DRIVER_NAME).inc()
                 out[uid] = {"error": f"node prepare lock: {e}", "permanent": False}
             except Exception as e:  # noqa: BLE001 — per-claim fault barrier
                 logger.info("CD prepare %s: %s", uid, e)
+                metrics.PREPARE_ERRORS.labels(COMPUTE_DOMAIN_DRIVER_NAME).inc()
                 out[uid] = {"error": str(e), "permanent": isinstance(e, PermanentError)}
+            finally:
+                metrics.PREPARE_SECONDS.labels(COMPUTE_DOMAIN_DRIVER_NAME).observe(
+                    time.monotonic() - t0
+                )
         return {"claims": out}
 
     def unprepare_resource_claims(self, claims: list[dict]) -> dict:
         out: dict[str, dict] = {}
         for ref in claims:
             uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
+            t0 = time.monotonic()
             try:
                 with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                     self.state.unprepare(uid)
@@ -134,6 +141,10 @@ class CDDriver:
             except Exception as e:  # noqa: BLE001
                 logger.exception("CD unprepare failed for claim %s", uid)
                 out[uid] = {"error": str(e)}
+            finally:
+                metrics.UNPREPARE_SECONDS.labels(COMPUTE_DOMAIN_DRIVER_NAME).observe(
+                    time.monotonic() - t0
+                )
         return {"claims": out}
 
     # ---------------------------------------------------------- publication
@@ -172,5 +183,6 @@ class CDDriver:
             self._config.node_name,
             f"{self._config.node_name}-{COMPUTE_DOMAIN_DRIVER_NAME}-",
         )
+        metrics.SLICE_PUBLISH_TOTAL.labels(COMPUTE_DOMAIN_DRIVER_NAME).inc()
         logger.info("published %d CD ResourceSlice(s)", len(slices))
         return slices

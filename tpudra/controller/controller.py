@@ -19,9 +19,14 @@ from tpudra.controller.computedomain import ComputeDomainManager, RetryLater
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.informer import Informer
+from tpudra import metrics
 from tpudra.workqueue import WorkQueue, default_controller_rate_limiter
 
 logger = logging.getLogger(__name__)
+
+_RECONCILE_OK = metrics.RECONCILES_TOTAL.labels("computedomain", "ok")
+_RECONCILE_REQUEUE = metrics.RECONCILES_TOTAL.labels("computedomain", "requeue")
+_RECONCILE_ERROR = metrics.RECONCILES_TOTAL.labels("computedomain", "error")
 
 
 @dataclass
@@ -42,7 +47,9 @@ class Controller:
             image=self._config.image,
             max_nodes_per_domain=self._config.max_nodes_per_domain,
         )
-        self.queue = WorkQueue(rate_limiter=default_controller_rate_limiter())
+        self.queue = WorkQueue(
+            rate_limiter=default_controller_rate_limiter(), name="controller"
+        )
         self._cd_informer = Informer(kube, gvr.COMPUTE_DOMAINS)
         self._clique_informer = Informer(
             kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._config.driver_namespace
@@ -70,11 +77,14 @@ class Controller:
     def _reconcile_with_retry(self, namespace: str, name: str, key) -> None:
         try:
             self.manager.reconcile(namespace, name)
+            _RECONCILE_OK.inc()
         except RetryLater as e:
             logger.info("requeue %s/%s: %s", namespace, name, e)
+            _RECONCILE_REQUEUE.inc()
             raise  # the work queue's rate limiter schedules the retry
         except Exception:
             logger.exception("reconcile %s/%s failed", namespace, name)
+            _RECONCILE_ERROR.inc()
             raise
 
     def _on_cd_event(self, _etype: str, obj: dict) -> None:

@@ -22,6 +22,12 @@ def build_parser() -> argparse.ArgumentParser:
         default=int(env_default("MAX_NODES_PER_DOMAIN", "0")),
         help="refuse CDs larger than this (0 = unlimited) [MAX_NODES_PER_DOMAIN]",
     )
+    p.add_argument(
+        "--http-endpoint",
+        default=env_default("HTTP_ENDPOINT", ""),
+        help="opt-in host:port serving /metrics, /debug/stacks and /healthz "
+        "(reference SetupHTTPEndpoint, main.go:256) [HTTP_ENDPOINT]",
+    )
     return p
 
 
@@ -40,11 +46,26 @@ def main(argv=None) -> int:
             max_nodes_per_domain=args.max_nodes_per_domain,
         ),
     )
+    debug = None
+    if args.http_endpoint:
+        from tpudra.metrics import DebugEndpoint, parse_http_endpoint
+
+        try:
+            host, port = parse_http_endpoint(args.http_endpoint)
+        except ValueError as e:
+            build_parser().error(str(e))
+        debug = DebugEndpoint(host, port)
+        debug.start()
+
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     logger.info("compute-domain-controller up in namespace %s", args.namespace)
-    controller.run(stop)  # blocks until stop
+    try:
+        controller.run(stop)  # blocks until stop
+    finally:
+        if debug is not None:
+            debug.stop()
     return 0
 
 

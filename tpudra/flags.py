@@ -48,6 +48,11 @@ def setup_common(args: argparse.Namespace) -> None:
     if args.feature_gates:
         featuregates.feature_gates().set_from_spec(args.feature_gates)
     featuregates.validate()
+    # Every binary: kill -USR1 dumps all thread stacks to stderr
+    # (internal/common/util.go:35 analog).
+    from tpudra import metrics
+
+    metrics.install_debug_handlers()
     log_startup_config(args)
 
 

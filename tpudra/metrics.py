@@ -1,0 +1,193 @@
+"""Prometheus metrics + debug observability shared by all five binaries.
+
+The analog of the reference's opt-in controller HTTP endpoint serving
+Prometheus metrics and pprof (compute-domain-controller/main.go:256-303)
+and the SIGUSR1/SIGUSR2 goroutine-dump handlers every binary installs
+(internal/common/util.go:35).  Python translation:
+
+- metric families below cover the same signals the reference's
+  legacyregistry carried (workqueue depth, client latencies) plus the
+  prepare-path histogram that the reference only ever logged as
+  ``t_prep`` lines (gpu-kubelet-plugin/driver.go:340-386);
+- ``DebugEndpoint`` serves ``/metrics`` and ``/debug/stacks`` (the
+  goroutine-profile analog: a dump of every Python thread's stack);
+- ``install_debug_handlers`` registers SIGUSR1/SIGUSR2 via faulthandler —
+  ``kill -USR1 <pid>`` writes all thread stacks to stderr without
+  disturbing the process.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import logging
+import signal
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from prometheus_client import (
+    CONTENT_TYPE_LATEST,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+logger = logging.getLogger(__name__)
+
+# Buckets sized for the bind path: sub-ms (mock/cached) through the
+# reference's 8 s worst case and the O(seconds) partition-create hot op.
+_PREPARE_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0)
+
+PREPARE_SECONDS = Histogram(
+    "tpudra_prepare_seconds",
+    "Per-claim NodePrepareResources wall time (the t_prep path)",
+    ["driver"],
+    buckets=_PREPARE_BUCKETS,
+)
+UNPREPARE_SECONDS = Histogram(
+    "tpudra_unprepare_seconds",
+    "Per-claim NodeUnprepareResources wall time",
+    ["driver"],
+    buckets=_PREPARE_BUCKETS,
+)
+PREPARE_ERRORS = Counter(
+    "tpudra_prepare_errors_total",
+    "Per-claim prepare failures returned to kubelet",
+    ["driver"],
+)
+UNHEALTHY_DEVICES = Gauge(
+    "tpudra_unhealthy_devices",
+    "Devices currently withheld from the ResourceSlice due to health events",
+    ["driver"],
+)
+SLICE_PUBLISH_TOTAL = Counter(
+    "tpudra_resourceslice_publish_total",
+    "ResourceSlice publication passes",
+    ["driver"],
+)
+WORKQUEUE_DEPTH = Gauge(
+    "tpudra_workqueue_depth",
+    "Items waiting or in flight in a work queue",
+    ["queue"],
+)
+WORKQUEUE_RETRIES = Counter(
+    "tpudra_workqueue_retries_total",
+    "Work items re-enqueued after a failure",
+    ["queue"],
+)
+RECONCILES_TOTAL = Counter(
+    "tpudra_reconciles_total",
+    "Controller reconcile passes by outcome",
+    ["manager", "outcome"],
+)
+
+
+def render_latest() -> tuple[bytes, str]:
+    return generate_latest(), CONTENT_TYPE_LATEST
+
+
+def format_thread_stacks() -> str:
+    """All Python thread stacks — the goroutine-dump analog."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def install_debug_handlers() -> None:
+    """SIGUSR1/SIGUSR2 → all-thread stack dump to stderr
+    (internal/common/util.go:35 analog).  Safe to call more than once;
+    no-ops where signals are unavailable (non-main thread, Windows)."""
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True, chain=False)
+        faulthandler.register(signal.SIGUSR2, all_threads=True, chain=False)
+        logger.info("debug handlers installed: SIGUSR1/SIGUSR2 dump thread stacks")
+    except (AttributeError, ValueError, RuntimeError) as e:
+        logger.debug("debug handlers not installed: %s", e)
+
+
+def parse_http_endpoint(value: str) -> tuple[str, int]:
+    """Parse a ``host:port`` / ``:port`` / ``[v6]:port`` endpoint flag.
+    Raises ValueError with a readable message on malformed input."""
+    host, sep, port = value.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"--http-endpoint must be host:port or :port, got {value!r}"
+        )
+    host = host.strip("[]")  # IPv6 literal brackets
+    return host or "0.0.0.0", int(port)
+
+
+class DebugEndpoint:
+    """Opt-in HTTP endpoint serving /metrics, /debug/stacks and /healthz.
+
+    The controller binary binds it from ``--http-endpoint`` (reference
+    SetupHTTPEndpoint, main.go:256); the node plugins mount the same routes
+    on their healthcheck server instead of running a second listener.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> None:
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if not handle_debug_request(self):
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):  # noqa: D102
+                logger.debug("debug-endpoint: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="debug-endpoint"
+        ).start()
+        logger.info("debug endpoint serving on %s:%d", self._host, self._port)
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def handle_debug_request(handler: BaseHTTPRequestHandler) -> bool:
+    """Serve /metrics, /debug/stacks and /healthz on any
+    BaseHTTPRequestHandler.  Returns False — with nothing written to the
+    connection — when the path is not a debug route, so the caller decides
+    what a miss means (404 or its own routing)."""
+    if handler.path == "/metrics":
+        body, ctype = render_latest()
+        handler.send_response(200)
+        handler.send_header("Content-Type", ctype)
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return True
+    if handler.path == "/debug/stacks":
+        body = format_thread_stacks().encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/plain; charset=utf-8")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return True
+    if handler.path == "/healthz":
+        handler.send_response(200)
+        handler.send_header("Content-Length", "2")
+        handler.end_headers()
+        handler.wfile.write(b"ok")
+        return True
+    return False

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpudra import TPU_DRIVER_NAME, featuregates
+from tpudra import TPU_DRIVER_NAME, featuregates, metrics
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
 from tpudra.flock import Flock, FlockTimeout
 from tpudra.kube.apply import next_pool_generation, publish_slices
@@ -139,11 +139,17 @@ class Driver:
         withheld_before = self.state.bound_sibling_devices()
         for claim in claims:
             uid = claim.get("metadata", {}).get("uid", "")
+            t0 = time.monotonic()
             try:
                 out[uid] = self._prepare_one(claim)
             except Exception as e:  # noqa: BLE001 — per-claim fault barrier
                 logger.exception("prepare failed for claim %s", uid)
+                metrics.PREPARE_ERRORS.labels(TPU_DRIVER_NAME).inc()
                 out[uid] = {"error": str(e), "permanent": isinstance(e, PermanentError)}
+            finally:
+                metrics.PREPARE_SECONDS.labels(TPU_DRIVER_NAME).observe(
+                    time.monotonic() - t0
+                )
         if self.state.bound_sibling_devices() != withheld_before:
             self.publish_resources()
         return {"claims": out}
@@ -153,12 +159,17 @@ class Driver:
         withheld_before = self.state.bound_sibling_devices()
         for ref in claims:
             uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
+            t0 = time.monotonic()
             try:
                 self._unprepare_one(uid)
                 out[uid] = {}
             except Exception as e:  # noqa: BLE001
                 logger.exception("unprepare failed for claim %s", uid)
                 out[uid] = {"error": str(e)}
+            finally:
+                metrics.UNPREPARE_SECONDS.labels(TPU_DRIVER_NAME).observe(
+                    time.monotonic() - t0
+                )
         if self.state.bound_sibling_devices() != withheld_before:
             self.publish_resources()  # siblings became visible again
         return {"claims": out}
@@ -232,6 +243,8 @@ class Driver:
                 self._config.node_name,
                 f"{self._config.node_name}-{TPU_DRIVER_NAME}-",
             )
+            metrics.SLICE_PUBLISH_TOTAL.labels(TPU_DRIVER_NAME).inc()
+            metrics.UNHEALTHY_DEVICES.labels(TPU_DRIVER_NAME).set(len(unhealthy))
             logger.info(
                 "published %d ResourceSlice(s), %d devices, %d unhealthy",
                 len(slices), len(res.devices), len(unhealthy),

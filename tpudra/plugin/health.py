@@ -26,13 +26,22 @@ logger = logging.getLogger(__name__)
 
 
 class Healthcheck:
-    def __init__(self, sockets: PluginSockets, port: int = 0, probe_timeout: float = 5.0):
+    def __init__(
+        self,
+        sockets: PluginSockets,
+        port: int = 0,
+        probe_timeout: float = 5.0,
+        host: str = "0.0.0.0",
+    ):
         """port 0 picks an ephemeral port (reference: healthcheck disabled
-        with port < 0, main.go flag healthcheck-port)."""
+        with port < 0, main.go flag healthcheck-port).  Binds all
+        interfaces by default: kubelet probes and Prometheus both hit the
+        pod IP, not loopback."""
         self._sockets = sockets
         self._probe_timeout = probe_timeout
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._port = port
+        self._host = host
 
     # -- probe logic --------------------------------------------------------
 
@@ -70,6 +79,14 @@ class Healthcheck:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 — http.server API
+                if self.path in ("/metrics", "/debug/stacks"):
+                    # The plugins mount the observability routes on this
+                    # listener instead of running a second HTTP server
+                    # (controller equivalent: --http-endpoint).
+                    from tpudra.metrics import handle_debug_request
+
+                    handle_debug_request(self)
+                    return
                 if self.path not in ("/healthz", "/readyz"):
                     self.send_error(404)
                     return
@@ -84,12 +101,12 @@ class Healthcheck:
             def log_message(self, fmt, *args):  # noqa: D102
                 logger.debug("healthcheck: " + fmt, *args)
 
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", self._port), Handler)
+        self._server = http.server.ThreadingHTTPServer((self._host, self._port), Handler)
         self._port = self._server.server_address[1]
         threading.Thread(
             target=self._server.serve_forever, daemon=True, name="healthcheck"
         ).start()
-        logger.info("healthcheck serving on 127.0.0.1:%d", self._port)
+        logger.info("healthcheck serving on %s:%d", self._host, self._port)
 
     def stop(self) -> None:
         if self._server is not None:

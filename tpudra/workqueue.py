@@ -130,7 +130,12 @@ class WorkQueue:
     - ``run(stop)``: worker loop; call from one or more threads.
     """
 
-    def __init__(self, rate_limiter: Optional[RateLimiter] = None, max_retries: int | None = None):
+    def __init__(
+        self,
+        rate_limiter: Optional[RateLimiter] = None,
+        max_retries: int | None = None,
+        name: str = "default",
+    ):
         self._limiter = rate_limiter or default_controller_rate_limiter()
         self._heap: list[_Entry] = []
         self._cond = threading.Condition()
@@ -140,6 +145,17 @@ class WorkQueue:
         self._shutdown = False
         self._max_retries = max_retries
         self._inflight = 0
+        self._name = name
+        # Resolve the labelled children once — .labels() takes an internal
+        # lock and these are updated inside self._cond's critical section.
+        from tpudra import metrics
+
+        self._depth_gauge = metrics.WORKQUEUE_DEPTH.labels(name)
+        self._retries_counter = metrics.WORKQUEUE_RETRIES.labels(name)
+
+    def _update_depth(self) -> None:
+        """Caller must hold self._cond."""
+        self._depth_gauge.set(len(self._heap) + self._inflight)
 
     # -- producers ----------------------------------------------------------
 
@@ -161,6 +177,7 @@ class WorkQueue:
             if self._shutdown:
                 return
             heapq.heappush(self._heap, entry)
+            self._update_depth()
             self._cond.notify()
 
 
@@ -177,6 +194,7 @@ class WorkQueue:
                     if self._gens.get(entry.key, 0) != entry.gen:
                         # Superseded by a newer enqueue: drop the stale item.
                         self._inflight -= 1
+                        self._update_depth()
                         self._cond.notify_all()
                         continue
                     if entry.key in self._active_keys:
@@ -189,6 +207,7 @@ class WorkQueue:
                         )
                         heapq.heappush(self._heap, entry)
                         self._inflight -= 1
+                        self._update_depth()
                         self._cond.notify_all()
                         defer = True
                     else:
@@ -208,6 +227,7 @@ class WorkQueue:
                 else:
                     delay = self._limiter.when(item)
                     logger.debug("work item %r failed (%s); retrying in %.3fs", item, e, delay)
+                    self._retries_counter.inc()
                     self._push(entry.fn, entry.key, delay, entry.gen)
             else:
                 self._limiter.forget(entry.key if entry.key is not None else entry.fn)
@@ -225,6 +245,7 @@ class WorkQueue:
                         ):
                             del self._gens[entry.key]
                     self._inflight -= 1
+                    self._update_depth()
                     self._cond.notify_all()
 
     def _has_queued_key(self, key: object) -> bool:
