@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Bring up a DRA-enabled kind cluster, build + load the driver image, and
+# install the chart with the mock device backend
+# (reference demo/clusters/kind/create-cluster.sh).
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "$HERE/../../.." && pwd)"
+CLUSTER_NAME="${CLUSTER_NAME:-tpudra}"
+IMAGE="${IMAGE:-tpudra:dev}"
+
+command -v kind >/dev/null || { echo "kind not found (https://kind.sigs.k8s.io)"; exit 1; }
+command -v kubectl >/dev/null || { echo "kubectl not found"; exit 1; }
+command -v helm >/dev/null || { echo "helm not found"; exit 1; }
+command -v docker >/dev/null || { echo "docker not found"; exit 1; }
+
+echo "==> creating kind cluster ${CLUSTER_NAME}"
+kind create cluster --name "${CLUSTER_NAME}" \
+  --config "${HERE}/kind-cluster-config.yaml" --wait 120s
+
+echo "==> building driver image ${IMAGE}"
+docker build -f "${REPO}/deployments/container/Dockerfile" -t "${IMAGE}" "${REPO}"
+
+echo "==> loading image into kind"
+kind load docker-image --name "${CLUSTER_NAME}" "${IMAGE}"
+
+echo "==> installing chart (mock device backend)"
+"${HERE}/install-driver.sh"
+
+echo "==> done; try: kubectl apply -f ${REPO}/demo/specs/tpu-test1.yaml"

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Tear the demo cluster down (reference demo/clusters/kind/delete-cluster.sh).
+set -euo pipefail
+kind delete cluster --name "${CLUSTER_NAME:-tpudra}"

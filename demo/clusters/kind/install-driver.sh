@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Install (or upgrade) the chart into the current kubectl context with the
+# mock device backend — suitable for kind/CI clusters without TPUs
+# (reference demo/clusters/kind/install-dra-driver-gpu.sh).
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "$HERE/../../.." && pwd)"
+IMAGE="${IMAGE:-tpudra:dev}"
+NAMESPACE="${NAMESPACE:-tpudra-system}"
+
+helm upgrade --install tpudra "${REPO}/deployments/helm/tpu-dra-driver" \
+  --namespace "${NAMESPACE}" --create-namespace \
+  --set image.repository="${IMAGE%:*}" \
+  --set image.tag="${IMAGE##*:}" \
+  --set kubeletPlugin.deviceBackend=mock \
+  --wait --timeout 5m
+
+kubectl -n "${NAMESPACE}" get pods

@@ -1,0 +1,50 @@
+{{/* Chart name, honoring nameOverride. */}}
+{{ define "tpu-dra-driver.name" }}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{ end }}
+
+{{/* Fully qualified app name. */}}
+{{ define "tpu-dra-driver.fullname" }}
+{{- if .Values.fullnameOverride -}}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- printf "%s-%s" .Release.Name (include "tpu-dra-driver.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+{{ end }}
+
+{{/* Install namespace, honoring namespaceOverride. */}}
+{{ define "tpu-dra-driver.namespace" }}
+{{- default .Release.Namespace .Values.namespaceOverride -}}
+{{ end }}
+
+{{/* Image reference; empty tag = appVersion. */}}
+{{ define "tpu-dra-driver.image" }}
+{{- printf "%s:%s" .Values.image.repository (default .Chart.AppVersion .Values.image.tag) -}}
+{{ end }}
+
+{{/* Common labels. */}}
+{{ define "tpu-dra-driver.labels" }}
+app.kubernetes.io/name: {{ include "tpu-dra-driver.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{ end }}
+
+{{/* ServiceAccount name. */}}
+{{ define "tpu-dra-driver.serviceAccountName" }}
+{{- if .Values.serviceAccount.create -}}
+{{- default (include "tpu-dra-driver.fullname" .) .Values.serviceAccount.name -}}
+{{- else -}}
+{{- default "default" .Values.serviceAccount.name -}}
+{{- end -}}
+{{ end }}
+
+{{/* Comma-separated gate=bool pairs for the FEATURE_GATES env. */}}
+{{ define "tpu-dra-driver.featureGates" }}
+{{- range $k, $v := .Values.featureGates -}}{{ $k }}={{ $v }},{{- end -}}
+{{ end }}
+
+{{/* Webhook service DNS name (what the cert must cover). */}}
+{{ define "tpu-dra-driver.webhookHost" }}
+{{- printf "%s-webhook.%s.svc" (include "tpu-dra-driver.fullname" .) (include "tpu-dra-driver.namespace" .) -}}
+{{ end }}

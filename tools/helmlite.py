@@ -1,0 +1,581 @@
+"""helmlite: a minimal Helm-template renderer for chart validation in CI.
+
+The build environment has no ``helm`` binary, but the chart under
+``deployments/helm/tpu-dra-driver`` must be render-verified (the reference's
+e2e suite installs per-file via ``helm upgrade -i``, tests/bats/helpers.sh:42-60).
+This implements exactly the template subset the chart uses, so
+``tests/test_helm.py`` can assert every manifest renders and parses:
+
+- actions: ``{{ pipeline }}`` with ``-`` whitespace trimming
+- data: ``.Values...``, ``.Release.Name/Namespace``, ``.Chart.Name/Version/AppVersion``
+- control flow: ``if``/``else if``/``else``/``end``, ``range $k, $v := ...``
+- ``define``/``include`` (loaded from ``_*.tpl`` files)
+- functions: ``quote squote default not and or eq ne empty fail printf
+  toYaml nindent indent trunc trimSuffix lower contains replace required``
+- pipelines: ``a | b | c``
+
+It is intentionally NOT a general Go-template engine: unsupported syntax
+raises, which is the desired behavior for a chart linter — if a template
+uses a construct helmlite doesn't know, the test should fail loudly and
+either the template gets simplified or helmlite grows the verb.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import yaml
+
+
+class TemplateError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Values plumbing
+# ---------------------------------------------------------------------------
+
+
+def deep_merge(base: dict, override: dict) -> dict:
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+@dataclass
+class Context:
+    values: dict
+    release_name: str = "tpudra"
+    release_namespace: str = "tpudra-system"
+    chart: dict = field(default_factory=dict)
+    locals: dict = field(default_factory=dict)
+
+    def root(self) -> dict:
+        return {
+            "Values": self.values,
+            "Release": {
+                "Name": self.release_name,
+                "Namespace": self.release_namespace,
+                "Service": "Helm",
+            },
+            "Chart": self.chart,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+# ---------------------------------------------------------------------------
+
+_TOKEN = re.compile(
+    r"""
+    \s*(
+        "(?:[^"\\]|\\.)*"     # double-quoted string
+      | `[^`]*`               # raw string
+      | \(|\)                 # parens
+      | \|                    # pipe
+      | \$[A-Za-z0-9_]*       # variable
+      | \.[A-Za-z0-9_.]*      # field path
+      | -?\d+(?:\.\d+)?       # number
+      | [A-Za-z_][A-Za-z0-9_]*  # ident (function or true/false)
+    )""",
+    re.VERBOSE,
+)
+
+
+def tokenize(expr: str) -> list[str]:
+    out, pos = [], 0
+    while pos < len(expr):
+        m = _TOKEN.match(expr, pos)
+        if not m:
+            if expr[pos:].strip() == "":
+                break
+            raise TemplateError(f"cannot tokenize {expr[pos:]!r} in {expr!r}")
+        out.append(m.group(1))
+        pos = m.end()
+    return out
+
+
+def truthy(v: Any) -> bool:
+    return bool(v) and v is not None
+
+
+class Evaluator:
+    def __init__(self, ctx: Context, defines: dict[str, str]):
+        self.ctx = ctx
+        self.defines = defines
+
+    # -- field / literal resolution -----------------------------------------
+
+    def resolve_path(self, path: str, base: Any) -> Any:
+        cur = base
+        for part in [p for p in path.split(".") if p]:
+            if isinstance(cur, dict):
+                cur = cur.get(part)
+            else:
+                cur = getattr(cur, part, None)
+            if cur is None:
+                return None
+        return cur
+
+    def atom(self, tok: str) -> Any:
+        if tok.startswith('"'):
+            return tok[1:-1].encode().decode("unicode_escape")
+        if tok.startswith("`"):
+            return tok[1:-1]
+        if tok == ".":
+            return self.ctx.root()
+        if tok.startswith("."):
+            return self.resolve_path(tok[1:], self.ctx.root())
+        if tok.startswith("$"):
+            name = tok[1:]
+            if not name:
+                return self.ctx.root()
+            if name in self.ctx.locals:
+                return self.ctx.locals[name]
+            raise TemplateError(f"unknown variable ${name}")
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if re.fullmatch(r"-?\d+\.\d+", tok):
+            return float(tok)
+        if tok == "true":
+            return True
+        if tok == "false":
+            return False
+        if tok in ("nil", "null"):
+            return None
+        raise TemplateError(f"unresolvable atom {tok!r}")
+
+    # -- function dispatch ---------------------------------------------------
+
+    def call(self, fn: str, args: list[Any]) -> Any:
+        if fn == "quote":
+            return '"' + str("" if args[0] is None else args[0]).replace('"', '\\"') + '"'
+        if fn == "squote":
+            return "'" + str("" if args[0] is None else args[0]) + "'"
+        if fn == "default":
+            return args[1] if truthy(args[1]) or args[1] == 0 and args[1] is not False else args[0]
+        if fn == "not":
+            return not truthy(args[0])
+        if fn == "and":
+            cur = True
+            for a in args:
+                cur = a
+                if not truthy(a):
+                    return a
+            return cur
+        if fn == "or":
+            for a in args:
+                if truthy(a):
+                    return a
+            return args[-1] if args else None
+        if fn == "eq":
+            return all(a == args[0] for a in args[1:])
+        if fn == "ne":
+            return args[0] != args[1]
+        if fn == "empty":
+            return not truthy(args[0])
+        if fn == "fail":
+            raise TemplateError(f"fail: {args[0]}")
+        if fn == "required":
+            if not truthy(args[1]):
+                raise TemplateError(f"required: {args[0]}")
+            return args[1]
+        if fn == "printf":
+            return _go_printf(args[0], args[1:])
+        if fn == "toYaml":
+            return yaml.safe_dump(args[0], default_flow_style=False).rstrip("\n")
+        if fn == "nindent":
+            n = int(args[0])
+            text = str(args[1])
+            pad = " " * n
+            return "\n" + "\n".join(
+                pad + line if line else line for line in text.splitlines()
+            )
+        if fn == "indent":
+            n = int(args[0])
+            pad = " " * n
+            return "\n".join(
+                pad + line if line else line for line in str(args[1]).splitlines()
+            )
+        if fn == "trunc":
+            n = int(args[0])
+            s = str(args[1])
+            return s[:n] if n >= 0 else s[n:]
+        if fn == "trimSuffix":
+            s = str(args[1])
+            return s[: -len(args[0])] if args[0] and s.endswith(args[0]) else s
+        if fn == "lower":
+            return str(args[0]).lower()
+        if fn == "contains":
+            return str(args[0]) in str(args[1])
+        if fn == "replace":
+            return str(args[2]).replace(str(args[0]), str(args[1]))
+        if fn == "include":
+            name, dot = args[0], args[1]
+            body = self.defines.get(name)
+            if body is None:
+                raise TemplateError(f"include of undefined template {name!r}")
+            if dot != self.ctx.root():
+                # The chart only ever includes with the root context; a
+                # non-root dot would render differently under real helm,
+                # so fail loudly per this module's linter contract.
+                raise TemplateError(
+                    f"include {name!r} with non-root context is unsupported"
+                )
+            sub = Renderer(self.ctx, self.defines)
+            return sub.render(body).strip("\n")
+        if fn == "list":
+            return list(args)
+        raise TemplateError(f"unsupported function {fn!r}")
+
+    # -- pipeline ------------------------------------------------------------
+
+    def eval(self, expr: str) -> Any:
+        return self.eval_tokens(tokenize(expr))
+
+    def eval_tokens(self, toks: list[str]) -> Any:
+        # Split on top-level pipes.
+        stages: list[list[str]] = [[]]
+        depth = 0
+        for t in toks:
+            if t == "(":
+                depth += 1
+            elif t == ")":
+                depth -= 1
+            if t == "|" and depth == 0:
+                stages.append([])
+            else:
+                stages[-1].append(t)
+        value, first = None, True
+        for stage in stages:
+            if not stage:
+                raise TemplateError(f"empty pipeline stage in {toks!r}")
+            if first:
+                value = self.eval_command(stage)
+                first = False
+            else:
+                fn, args = stage[0], self.eval_args(stage[1:])
+                value = self.call(fn, args + [value])
+        return value
+
+    def eval_command(self, toks: list[str]) -> Any:
+        head = toks[0]
+        if head == "(":
+            # Entire command may be a parenthesized pipeline (possibly with
+            # trailing args — not supported; keep it simple).
+            inner, rest = self._match_paren(toks)
+            if rest:
+                raise TemplateError(f"unexpected tokens after parens: {rest!r}")
+            return self.eval_tokens(inner)
+        if re.fullmatch(r"[A-Za-z_][A-Za-z0-9_]*", head) and head not in (
+            "true",
+            "false",
+            "nil",
+            "null",
+        ):
+            return self.call(head, self.eval_args(toks[1:]))
+        if len(toks) != 1:
+            raise TemplateError(f"unexpected argument list after {head!r}: {toks!r}")
+        return self.atom(head)
+
+    def eval_args(self, toks: list[str]) -> list[Any]:
+        args: list[Any] = []
+        i = 0
+        while i < len(toks):
+            if toks[i] == "(":
+                inner, _rest = self._match_paren(toks[i:])
+                args.append(self.eval_tokens(inner))
+                i += len(inner) + 2
+            else:
+                args.append(self.atom(toks[i]))
+                i += 1
+        return args
+
+    @staticmethod
+    def _match_paren(toks: list[str]) -> tuple[list[str], list[str]]:
+        assert toks[0] == "("
+        depth = 0
+        for i, t in enumerate(toks):
+            if t == "(":
+                depth += 1
+            elif t == ")":
+                depth -= 1
+                if depth == 0:
+                    return toks[1:i], toks[i + 1 :]
+        raise TemplateError(f"unbalanced parens in {toks!r}")
+
+
+def _gostr(v: Any) -> str:
+    """Render a value the way Go templates do (true/false, no None)."""
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v)
+
+
+def _go_printf(fmt: str, args: list[Any]) -> str:
+    # %s/%d/%v are all the chart needs.
+    out, ai = [], 0
+    i = 0
+    while i < len(fmt):
+        c = fmt[i]
+        if c == "%" and i + 1 < len(fmt):
+            spec = fmt[i + 1]
+            if spec == "%":
+                out.append("%")
+            else:
+                out.append(_gostr(args[ai]))
+                ai += 1
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Template parsing/rendering
+# ---------------------------------------------------------------------------
+
+_ACTION = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.DOTALL)
+
+
+def _split_actions(src: str) -> list[tuple[str, str]]:
+    """Returns [(kind, payload)]: kind 'text' or 'action'.  Handles the
+    ``{{-``/``-}}`` whitespace-trim markers the way Go templates do."""
+    parts: list[tuple[str, str]] = []
+    pos = 0
+    for m in _ACTION.finditer(src):
+        text = src[pos : m.start()]
+        if m.group(0).startswith("{{-"):
+            # Go trims ALL trailing whitespace incl. newlines.
+            text = text.rstrip()
+        parts.append(("text", text))
+        parts.append(("action", m.group(1)))
+        pos = m.end()
+        if m.group(0).endswith("-}}"):
+            while pos < len(src) and src[pos] in " \t\n\r":
+                pos += 1
+    parts.append(("text", src[pos:]))
+    return parts
+
+
+@dataclass
+class _Node:
+    kind: str  # text | action | if | range | define
+    payload: str = ""
+    branches: list = field(default_factory=list)  # for if: [(cond, nodes)...], else: (None, nodes)
+    body: list = field(default_factory=list)
+
+
+def _parse(parts: list[tuple[str, str]]) -> list[_Node]:
+    nodes, stack = [], []
+
+    def sink() -> list:
+        if stack:
+            top = stack[-1]
+            if top.kind == "if":
+                return top.branches[-1][1]
+            return top.body
+        return nodes
+
+    for kind, payload in parts:
+        if kind == "text":
+            if payload:
+                sink().append(_Node("text", payload))
+            continue
+        stripped = payload.strip()
+        if stripped.startswith("/*"):
+            continue  # comment
+        if stripped.startswith("if "):
+            n = _Node("if")
+            n.branches = [(stripped[3:].strip(), [])]
+            sink().append(n)
+            stack.append(n)
+        elif stripped.startswith("else if "):
+            if not stack or stack[-1].kind != "if":
+                raise TemplateError("else if outside if")
+            stack[-1].branches.append((stripped[len("else if ") :].strip(), []))
+        elif stripped == "else":
+            if not stack or stack[-1].kind != "if":
+                raise TemplateError("else outside if")
+            stack[-1].branches.append((None, []))
+        elif stripped.startswith("range "):
+            n = _Node("range", stripped[len("range ") :].strip())
+            sink().append(n)
+            stack.append(n)
+        elif stripped.startswith("define "):
+            name = stripped[len("define ") :].strip().strip('"')
+            n = _Node("define", name)
+            sink().append(n)
+            stack.append(n)
+        elif stripped == "end":
+            if not stack:
+                raise TemplateError("end without open block")
+            stack.pop()
+        else:
+            sink().append(_Node("action", stripped))
+    if stack:
+        raise TemplateError(f"unclosed block {stack[-1].kind}")
+    return nodes
+
+
+class Renderer:
+    def __init__(self, ctx: Context, defines: dict[str, str]):
+        self.ctx = ctx
+        self.defines = defines
+        self.ev = Evaluator(ctx, defines)
+
+    def render(self, src: str) -> str:
+        return self._render_nodes(_parse(_split_actions(src)))
+
+    def _render_nodes(self, nodes: list[_Node]) -> str:
+        out: list[str] = []
+        for n in nodes:
+            if n.kind == "text":
+                out.append(n.payload)
+            elif n.kind == "action":
+                out.append(_gostr(self.ev.eval(n.payload)))
+            elif n.kind == "define":
+                # Re-serialize the body so include can re-render it with the
+                # caller's context.  (Bodies are stored raw at load time via
+                # load_defines; a define encountered mid-file is ignored.)
+                continue
+            elif n.kind == "if":
+                for cond, body in n.branches:
+                    if cond is None or truthy(self.ev.eval(cond)):
+                        out.append(self._render_nodes(body))
+                        break
+            elif n.kind == "range":
+                out.append(self._render_range(n))
+        return "".join(out)
+
+    def _render_range(self, n: _Node) -> str:
+        spec = n.payload
+        m = re.match(r"^\$(\w+),\s*\$(\w+)\s*:=\s*(.+)$", spec)
+        out = []
+        if m:
+            kvar, vvar, expr = m.groups()
+            coll = self.ev.eval(expr) or {}
+            items = coll.items() if isinstance(coll, dict) else enumerate(coll)
+            for k, v in items:
+                self.ctx.locals[kvar] = k
+                self.ctx.locals[vvar] = v
+                out.append(self._render_nodes(n.body))
+            self.ctx.locals.pop(kvar, None)
+            self.ctx.locals.pop(vvar, None)
+            return "".join(out)
+        m = re.match(r"^\$(\w+)\s*:=\s*(.+)$", spec)
+        if m:
+            vvar, expr = m.groups()
+            for v in self.ev.eval(expr) or []:
+                self.ctx.locals[vvar] = v
+                out.append(self._render_nodes(n.body))
+            self.ctx.locals.pop(vvar, None)
+            return "".join(out)
+        raise TemplateError(f"unsupported range spec {spec!r}")
+
+
+# ---------------------------------------------------------------------------
+# Chart-level driver
+# ---------------------------------------------------------------------------
+
+
+def load_defines(src: str) -> dict[str, str]:
+    """Extract {{ define "name" }}...{{ end }} bodies textually, tracking
+    block nesting so defines containing if/range blocks keep their inner
+    {{ end }}s."""
+    defines: dict[str, str] = {}
+    open_name: Optional[str] = None
+    depth = 0
+    body_start = 0
+    for m in _ACTION.finditer(src):
+        payload = m.group(1).strip()
+        if open_name is None:
+            dm = re.match(r'define\s+"([^"]+)"', payload)
+            if dm:
+                open_name = dm.group(1)
+                depth = 0
+                body_start = m.end()
+            continue
+        if payload.startswith(("if ", "range ", "with ")) or re.match(
+            r'define\s+"', payload
+        ):
+            depth += 1
+        elif payload == "end":
+            if depth == 0:
+                defines[open_name] = src[body_start : m.start()]
+                open_name = None
+            else:
+                depth -= 1
+    if open_name is not None:
+        raise TemplateError(f"unterminated define {open_name!r}")
+    return defines
+
+
+class Chart:
+    def __init__(self, chart_dir: str):
+        self.dir = chart_dir
+        with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+            self.meta = yaml.safe_load(f)
+        with open(os.path.join(chart_dir, "values.yaml")) as f:
+            self.default_values = yaml.safe_load(f) or {}
+        self.defines: dict[str, str] = {}
+        tdir = os.path.join(chart_dir, "templates")
+        self.templates: dict[str, str] = {}
+        for name in sorted(os.listdir(tdir)):
+            path = os.path.join(tdir, name)
+            with open(path) as f:
+                src = f.read()
+            if name.startswith("_"):
+                self.defines.update(load_defines(src))
+            elif name.endswith((".yaml", ".yml", ".tpl")):
+                self.templates[name] = src
+
+    def render(
+        self,
+        values: Optional[dict] = None,
+        release_name: str = "tpudra",
+        namespace: str = "tpudra-system",
+    ) -> dict[str, list[dict]]:
+        """Render every template; returns {template_name: [parsed docs]}."""
+        merged = deep_merge(self.default_values, values or {})
+        chart_meta = {
+            "Name": self.meta.get("name", ""),
+            "Version": self.meta.get("version", ""),
+            "AppVersion": self.meta.get("appVersion", ""),
+        }
+        out: dict[str, list[dict]] = {}
+        for name, src in self.templates.items():
+            ctx = Context(
+                values=merged,
+                release_name=release_name,
+                release_namespace=namespace,
+                chart=chart_meta,
+            )
+            text = Renderer(ctx, self.defines).render(src)
+            try:
+                docs = [d for d in yaml.safe_load_all(text) if d]
+            except yaml.YAMLError as e:
+                raise TemplateError(f"{name}: rendered YAML invalid: {e}\n{text}") from e
+            out[name] = docs
+        return out
+
+    def crds(self) -> list[dict]:
+        crd_dir = os.path.join(self.dir, "crds")
+        docs = []
+        if os.path.isdir(crd_dir):
+            for name in sorted(os.listdir(crd_dir)):
+                with open(os.path.join(crd_dir, name)) as f:
+                    docs.extend(d for d in yaml.safe_load_all(f) if d)
+        return docs
