@@ -9,10 +9,21 @@ REPO="$(cd "$HERE/../../.." && pwd)"
 IMAGE="${IMAGE:-tpudra:dev}"
 NAMESPACE="${NAMESPACE:-tpudra-system}"
 
+# Split "<repo>[:tag]" on the LAST colon only when that colon belongs to a
+# tag (i.e. appears after the final slash) — registries carry ports
+# (localhost:5001/tpudra) and tags are optional.
+if [[ "${IMAGE##*/}" == *:* ]]; then
+  IMAGE_REPO="${IMAGE%:*}"
+  IMAGE_TAG="${IMAGE##*:}"
+else
+  IMAGE_REPO="${IMAGE}"
+  IMAGE_TAG="latest"
+fi
+
 helm upgrade --install tpudra "${REPO}/deployments/helm/tpu-dra-driver" \
   --namespace "${NAMESPACE}" --create-namespace \
-  --set image.repository="${IMAGE%:*}" \
-  --set image.tag="${IMAGE##*:}" \
+  --set image.repository="${IMAGE_REPO}" \
+  --set image.tag="${IMAGE_TAG}" \
   --set kubeletPlugin.deviceBackend=mock \
   --wait --timeout 5m
 

@@ -6,11 +6,13 @@ reference could only run on hardware CI runners runs here on the mock
 backend.
 """
 
+import re
 import glob
 import os
 import threading
 import time
 
+import pytest
 import yaml
 
 from tpudra import TPU_DRIVER_NAME
@@ -36,12 +38,17 @@ def find(docs, kind):
 
 class Scheduler:
     """A micro-scheduler: allocates RCT device requests against the
-    ResourceSlices in the fake apiserver (first-fit, counter-blind for full
-    devices; enough to drive the node plugin the way kube-scheduler would)."""
+    ResourceSlices in the fake apiserver, first-fit, with KEP-4815
+    SharedCounters arithmetic — a full device blocks its partitions,
+    disjoint partitions co-allocate, and counter exhaustion refuses
+    (the scheduler-side contract of reference partitions.go:85-307)."""
 
     def __init__(self, kube):
         self._kube = kube
         self._allocated: set[tuple[str, str]] = set()  # (pool, device)
+        # KEP-4815 ledger: units consumed per (pool, counterSet, counter).
+        self._consumed: dict[tuple[str, str, str], int] = {}
+        self._claim_demand: dict[str, dict[tuple[str, str, str], int]] = {}
 
     def _published(self):
         for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
@@ -49,9 +56,36 @@ class Scheduler:
             for dev in s["spec"]["devices"]:
                 yield pool, s["spec"]["driver"], dev
 
+    def _capacity(self) -> dict[tuple[str, str, str], int]:
+        """Published SharedCounters across all slices of every pool (the
+        split form carries them in a devices-free slice)."""
+        caps: dict[tuple[str, str, str], int] = {}
+        for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
+            pool = s["spec"]["pool"]["name"]
+            for cs in s["spec"].get("sharedCounters", []):
+                for cname, v in cs.get("counters", {}).items():
+                    caps[(pool, cs["name"], cname)] = int(v["value"])
+        return caps
+
+    @staticmethod
+    def _demand(pool: str, dev: dict) -> dict[tuple[str, str, str], int]:
+        out: dict[tuple[str, str, str], int] = {}
+        for cc in dev.get("consumesCounters", []):
+            for cname, v in cc.get("counters", {}).items():
+                out[(pool, cc["counterSet"], cname)] = int(v["value"])
+        return out
+
+    def _counters_fit(self, caps, demand) -> bool:
+        return all(
+            self._consumed.get(key, 0) + want <= caps.get(key, 0)
+            for key, want in demand.items()
+        )
+
     def allocate(self, rct, uid, namespace="default", name="claim", create=True):
         spec = rct["spec"]["spec"]["devices"]
         results = []
+        caps = self._capacity()
+        claim_demand: dict[tuple[str, str, str], int] = {}
         for req in spec.get("requests", []):
             count = req.get("exactly", {}).get("count", 1)
             matched = 0
@@ -60,7 +94,13 @@ class Scheduler:
                     continue
                 if not self._matches(req, dev):
                     continue
+                demand = self._demand(pool, dev)
+                if not self._counters_fit(caps, demand):
+                    continue
                 self._allocated.add((pool, dev["name"]))
+                for key, want in demand.items():
+                    self._consumed[key] = self._consumed.get(key, 0) + want
+                    claim_demand[key] = claim_demand.get(key, 0) + want
                 results.append(
                     {"request": req["name"], "driver": driver,
                      "pool": pool, "device": dev["name"]}
@@ -68,7 +108,18 @@ class Scheduler:
                 matched += 1
                 if matched == count:
                     break
-            assert matched == count, f"cannot satisfy request {req['name']}"
+            if matched != count:
+                # Roll back everything this allocate reserved — a refused
+                # claim must not leak devices or counters.
+                for r in results:
+                    self._allocated.discard((r["pool"], r["device"]))
+                for key, want in claim_demand.items():
+                    left = self._consumed.get(key, 0) - want
+                    if left > 0:
+                        self._consumed[key] = left
+                    else:
+                        self._consumed.pop(key, None)
+                raise AssertionError(f"cannot satisfy request {req['name']}")
         config = []
         for entry in spec.get("config", []):
             config.append({"source": "FromClaim", "requests": [], **entry})
@@ -82,6 +133,7 @@ class Scheduler:
             # Allocation lives in the apiserver: the plugin resolves claim
             # references kubelet sends over the DRA gRPC wire.
             claim = self._kube.create(gvr.RESOURCE_CLAIMS, claim, namespace)
+        self._claim_demand[claim["metadata"]["uid"]] = claim_demand
         return claim
 
     def _matches(self, req, dev) -> bool:
@@ -94,14 +146,25 @@ class Scheduler:
                 return False
             for sel in req.get("exactly", {}).get("selectors", []):
                 expr = sel.get("cel", {}).get("expression", "")
-                if "1c.4hbm" in expr:
-                    return dev["attributes"].get("profile", {}).get("string") == "1c.4hbm"
+                m = re.search(r"\d+c\.\d+hbm", expr)
+                if m:
+                    return (
+                        dev["attributes"].get("profile", {}).get("string")
+                        == m.group(0)
+                    )
             return True
         return False
 
     def release(self, claim):
         for r in claim["status"]["allocation"]["devices"]["results"]:
             self._allocated.discard((r["pool"], r["device"]))
+        demand = self._claim_demand.pop(claim["metadata"]["uid"], {})
+        for key, want in demand.items():
+            left = self._consumed.get(key, 0) - want
+            if left > 0:
+                self._consumed[key] = left
+            else:
+                self._consumed.pop(key, None)
 
 
 def mk_driver(tmp_path, kube, **fg_map):
@@ -206,6 +269,109 @@ class TestSpecDrivenLifecycle:
             client.close()
         finally:
             driver.stop()
+
+
+def mk_rct(device_class, count=1, profile=None, name="rct"):
+    req = {"name": "r0", "exactly": {"deviceClassName": device_class, "count": count}}
+    if profile:
+        req["exactly"]["selectors"] = [
+            {"cel": {"expression": f'device.attributes["tpu.google.com"].profile == "{profile}"'}}
+        ]
+    return {
+        "metadata": {"name": name},
+        "spec": {"spec": {"devices": {"requests": [req], "config": []}}},
+    }
+
+
+class TestCounterAwareAllocation:
+    """KEP-4815 SharedCounters arithmetic, scheduler side (the contract the
+    reference encodes in partitions.go:85-307): published counters are the
+    only thing preventing a full chip and its partitions from being handed
+    out twice."""
+
+    def one_chip_driver(self, tmp_path, kube):
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5p", num_chips=1),
+            state_file=str(tmp_path / "hw.json"),
+        )
+        driver = Driver(
+            DriverConfig(
+                node_name="node-a",
+                plugin_dir=str(tmp_path / "plugin"),
+                registry_dir=str(tmp_path / "registry"),
+                cdi_root=str(tmp_path / "cdi"),
+            ),
+            kube,
+            lib,
+        )
+        driver.publish_resources()
+        return driver
+
+    def test_full_chip_blocks_partitions(self, tmp_path):
+        kube = FakeKube()
+        self.one_chip_driver(tmp_path, kube)
+        sched = Scheduler(kube)
+        sched.allocate(mk_rct("tpu.google.com"), "c-full", name="full")
+        with pytest.raises(AssertionError, match="cannot satisfy"):
+            sched.allocate(
+                mk_rct("tpu-partition.google.com", profile="1c.4hbm"),
+                "c-part", name="part", create=False,
+            )
+
+    def test_partition_blocks_full_chip(self, tmp_path):
+        kube = FakeKube()
+        self.one_chip_driver(tmp_path, kube)
+        sched = Scheduler(kube)
+        sched.allocate(
+            mk_rct("tpu-partition.google.com", profile="1c.4hbm"), "c-p1", name="p1"
+        )
+        with pytest.raises(AssertionError, match="cannot satisfy"):
+            sched.allocate(
+                mk_rct("tpu.google.com"), "c-full", name="full", create=False
+            )
+
+    def test_disjoint_partitions_coallocate_on_one_chip(self, tmp_path):
+        kube = FakeKube()
+        self.one_chip_driver(tmp_path, kube)
+        sched = Scheduler(kube)
+        c1 = sched.allocate(
+            mk_rct("tpu-partition.google.com", profile="1c.4hbm"), "c-p1", name="p1"
+        )
+        c2 = sched.allocate(
+            mk_rct("tpu-partition.google.com", profile="1c.4hbm"), "c-p2", name="p2"
+        )
+        d1 = c1["status"]["allocation"]["devices"]["results"][0]["device"]
+        d2 = c2["status"]["allocation"]["devices"]["results"][0]["device"]
+        assert d1 != d2  # the two disjoint halves of the single chip
+
+    def test_counter_exhaustion_refuses_free_device_name(self, tmp_path):
+        """An unallocated *device entry* must still be refused when its
+        counters are drained: after a 1c.8hbm partition takes core 0 plus
+        every HBM slice, the 1c.4hbm placement at core 1 is name-free but
+        its HBM counters are gone."""
+        kube = FakeKube()
+        self.one_chip_driver(tmp_path, kube)
+        sched = Scheduler(kube)
+        sched.allocate(
+            mk_rct("tpu-partition.google.com", profile="1c.8hbm"), "c-big", name="big"
+        )
+        with pytest.raises(AssertionError, match="cannot satisfy"):
+            sched.allocate(
+                mk_rct("tpu-partition.google.com", profile="1c.4hbm"),
+                "c-small", name="small", create=False,
+            )
+
+    def test_release_restores_counters(self, tmp_path):
+        kube = FakeKube()
+        self.one_chip_driver(tmp_path, kube)
+        sched = Scheduler(kube)
+        full = sched.allocate(mk_rct("tpu.google.com"), "c-full", name="full")
+        sched.release(full)
+        part = sched.allocate(
+            mk_rct("tpu-partition.google.com", profile="1c.4hbm"), "c-p1", name="p1"
+        )
+        assert part["status"]["allocation"]["devices"]["results"]
 
 
 class TestRestartRecovery:

@@ -160,7 +160,9 @@ class Evaluator:
         if fn == "squote":
             return "'" + str("" if args[0] is None else args[0]) + "'"
         if fn == "default":
-            return args[1] if truthy(args[1]) or args[1] == 0 and args[1] is not False else args[0]
+            # sprig's empty(): 0, "", nil, false, empty collections all take
+            # the default — matching real helm exactly.
+            return args[1] if truthy(args[1]) else args[0]
         if fn == "not":
             return not truthy(args[0])
         if fn == "and":

@@ -25,11 +25,9 @@ from tpudra.kube.errors import NotFound
 
 logger = logging.getLogger(__name__)
 
-DEFAULT_TEMPLATE_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "templates",
-    "compute-domain-daemon.tmpl.yaml",
-)
+from tpudra.paths import template_path
+
+DEFAULT_TEMPLATE_PATH = template_path("compute-domain-daemon.tmpl.yaml")
 
 
 # Annotation recording the hash of the spec this controller last rendered.

@@ -35,12 +35,25 @@ from tpudra.devicelib.topology import (
     partition_profiles,
 )
 
-DEFAULT_LIB_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-    "build",
-    "libtpuinfo.so",
-)
+# Resolution order: explicit env (the container image sets it), then the
+# in-repo build product (dev checkouts), then the system install location
+# (the dlopen-by-known-path pattern of reference nvlib.go:69-71).
+def _default_lib_path() -> str:
+    env = os.environ.get("TPUINFO_LIBRARY_PATH")
+    if env:
+        return env
+    repo_build = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "native",
+        "build",
+        "libtpuinfo.so",
+    )
+    if os.path.exists(repo_build):
+        return repo_build
+    return "/usr/local/lib/libtpuinfo.so"
+
+
+DEFAULT_LIB_PATH = _default_lib_path()
 
 
 class _Chip(ctypes.Structure):

@@ -1,0 +1,23 @@
+"""Runtime file-resolution shared by components that read non-package data
+(daemon templates, native library).
+
+Resolution order everywhere: explicit environment override → in-repo path
+(dev checkout) → system install location (what the container image ships).
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def template_path(name: str) -> str:
+    """Locate a runtime-rendered template (templates/*.tmpl.yaml)."""
+    env_dir = os.environ.get("TPUDRA_TEMPLATES_DIR")
+    if env_dir:
+        return os.path.join(env_dir, name)
+    repo = os.path.join(_REPO_ROOT, "templates", name)
+    if os.path.exists(repo):
+        return repo
+    return os.path.join("/templates", name)

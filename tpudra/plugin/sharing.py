@@ -33,11 +33,9 @@ from tpudra.plugin.cdi import ContainerEdits
 logger = logging.getLogger(__name__)
 
 MP_DAEMON_NAME_PREFIX = "tpu-mp-control-daemon-"
-DEFAULT_TEMPLATE_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "templates",
-    "multi-process-daemon.tmpl.yaml",
-)
+from tpudra.paths import template_path
+
+DEFAULT_TEMPLATE_PATH = template_path("multi-process-daemon.tmpl.yaml")
 
 
 class SharingError(Exception):
