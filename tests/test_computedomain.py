@@ -63,6 +63,174 @@ def mk_node(kube, name):
     return kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
 
 
+# -- non-fabric nodes + feature-gated membership paths -----------------------
+
+
+class TestNonFabricAndGates:
+    def mk_ds_pod(self, kube, uid, node, ready=True, ip="10.1.0.9"):
+        return kube.create(
+            gvr.PODS,
+            {
+                "metadata": {
+                    "name": f"cd-daemon-{node}",
+                    "labels": {COMPUTE_DOMAIN_NODE_LABEL: uid},
+                },
+                "spec": {"nodeName": node},
+                "status": {
+                    "podIP": ip,
+                    "conditions": [
+                        {"type": "Ready", "status": "True" if ready else "False"}
+                    ],
+                },
+            },
+            NS,
+        )
+
+    def test_non_fabric_node_counts_via_ds_pod(self, tmp_path):
+        """A node without an ICI clique never appears in any clique CR; the
+        controller must still count it through its Ready DS pod
+        (daemonsetpods.go analog) or the CD can never reach Ready."""
+        from tpudra.api.computedomain import COMPUTE_DOMAIN_STATUS_READY
+
+        kube = FakeKube()
+        cd = mk_cd(kube, num_nodes=2)
+        uid = cd["metadata"]["uid"]
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+
+        # One fabric node via the clique CR...
+        clique = CliqueManager(kube, NS, uid, "s1.0", "node-a", "10.0.0.1")
+        clique.join()
+        clique.update_daemon_status(True)
+        # ...and one non-fabric node via a Ready DS pod only.
+        self.mk_ds_pod(kube, uid, "node-b", ready=True)
+
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        c.manager.sync_status(cd)
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert {n["name"] for n in cd["status"]["nodes"]} == {"node-a", "node-b"}
+        assert cd["status"]["status"] == COMPUTE_DOMAIN_STATUS_READY
+
+        # The pod losing readiness degrades the domain.
+        pod = kube.get(gvr.PODS, "cd-daemon-node-b", NS)
+        pod["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+        kube.update(gvr.PODS, pod, NS)
+        c.manager.sync_status(cd)
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert cd["status"]["status"] != COMPUTE_DOMAIN_STATUS_READY
+
+    def test_legacy_direct_status_path(self, tmp_path):
+        """ComputeDomainCliques gate OFF: daemons write cd.status.nodes
+        directly (cdstatus.go:55) and the controller only aggregates."""
+        from tpudra import featuregates as fg
+        from tpudra.api.computedomain import COMPUTE_DOMAIN_STATUS_READY
+        from tpudra.cddaemon.cdstatus import DirectStatusManager
+
+        fg.feature_gates().set_from_map({fg.COMPUTE_DOMAIN_CLIQUES: False})
+        kube = FakeKube()
+        cd = mk_cd(kube, num_nodes=2)
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+
+        managers = []
+        for i, node in enumerate(["node-a", "node-b"]):
+            m = DirectStatusManager(
+                kube, "user-ns", "cd1", "s1.0", node, f"10.0.0.{i + 1}"
+            )
+            managers.append(m)
+            assert m.join() == i
+        # Peers visible through the direct path, same-clique only.
+        seen: list[dict] = []
+        import threading
+
+        stop = threading.Event()
+        managers[0].watch_peers(lambda peers: seen.append(peers), stop)
+        for m in managers:
+            m.update_daemon_status(True)
+        wait_for(lambda: seen and len(seen[-1]) == 2, msg="peer update")
+        stop.set()
+
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        c.manager.sync_status(cd)
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert cd["status"]["status"] == COMPUTE_DOMAIN_STATUS_READY
+        assert {n["name"] for n in cd["status"]["nodes"]} == {"node-a", "node-b"}
+
+        # Clean leave removes the entry.
+        managers[1].leave()
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert {n["name"] for n in cd["status"]["nodes"]} == {"node-a"}
+
+    def test_non_fabric_daemon_joins_direct_status(self, tmp_path):
+        """Gate off + no clique: the daemon itself must maintain a Ready
+        cd.status.nodes entry — there is no clique CR and the legacy
+        controller branch reads only status.nodes."""
+        from tpudra import featuregates as fg
+        from tpudra.api.computedomain import COMPUTE_DOMAIN_STATUS_READY
+
+        fg.feature_gates().set_from_map({fg.COMPUTE_DOMAIN_CLIQUES: False})
+        kube = FakeKube()
+        cd = mk_cd(kube, num_nodes=1)
+        stop = threading.Event()
+        app = DaemonApp(
+            kube,
+            DaemonConfig(
+                cd_uid=cd["metadata"]["uid"], node_name="node-nf",
+                pod_name="", pod_ip="10.9.0.1", namespace=NS,
+                cd_namespace="user-ns", cd_name="cd1", clique_id="",
+            ),
+        )
+        t = threading.Thread(target=app.run, args=(stop,), daemon=True)
+        t.start()
+        try:
+            assert app.wait_started(10)
+            wait_for(
+                lambda: kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+                .get("status", {})
+                .get("nodes"),
+                msg="direct-status node entry",
+            )
+            node = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")["status"]["nodes"][0]
+            assert node["name"] == "node-nf"
+            assert node["cliqueID"] == ""
+            wait_for(
+                lambda: kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")["status"][
+                    "nodes"
+                ][0]["status"]
+                == COMPUTE_DOMAIN_STATUS_READY,
+                msg="Ready direct-status entry",
+            )
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_crash_on_fabric_errors_gate(self, tmp_path):
+        """CrashOnICIFabricErrors: strict (default) raises on inconsistent
+        fabric state; legacy mode degrades to non-fabric membership."""
+        import pytest
+
+        from tpudra import featuregates as fg
+        from tpudra.cdplugin.allocatable import FabricError, resolve_clique_id
+
+        class Chip:
+            def __init__(self, clique_id):
+                self.clique_id = clique_id
+
+        # Consistent fabric: fine either way.
+        assert resolve_clique_id([Chip("s1.0"), Chip("s1.0")]) == "s1.0"
+
+        # Inconsistent fabric: strict raises...
+        with pytest.raises(FabricError):
+            resolve_clique_id([Chip("s1.0"), Chip("s2.0")])
+        with pytest.raises(FabricError):
+            resolve_clique_id([Chip("")])
+
+        # ...legacy degrades to non-fabric.
+        fg.feature_gates().set_from_map({fg.CRASH_ON_ICI_FABRIC_ERRORS: False})
+        assert resolve_clique_id([Chip("s1.0"), Chip("s2.0")]) == ""
+        assert resolve_clique_id([Chip("")]) == ""
+
+
 # -- controller units --------------------------------------------------------
 
 

@@ -46,6 +46,10 @@ class DaemonConfig:
     pod_name: str
     pod_ip: str
     namespace: str = "tpudra-system"
+    # CD object coordinates, used by the legacy direct-status membership
+    # path (ComputeDomainCliques gate off).
+    cd_namespace: str = ""
+    cd_name: str = ""
     clique_id: str = ""  # empty → no ICI fabric on this node, idle daemon
     num_hosts: int = 1
     host_index: int = 0
@@ -64,6 +68,8 @@ class DaemonConfig:
             pod_name=env.get("POD_NAME", ""),
             pod_ip=env.get("POD_IP", ""),
             namespace=env.get("NAMESPACE", "tpudra-system"),
+            cd_namespace=env.get("CD_NAMESPACE", ""),
+            cd_name=env.get("CD_NAME", ""),
             clique_id=env.get("CLIQUE_ID", ""),
             num_hosts=int(env.get("TPUDRA_NUM_HOSTS", "1")),
             host_index=int(env.get("TPUDRA_HOST_INDEX", "0")),
@@ -99,15 +105,40 @@ class DaemonApp:
     def run(self, stop: threading.Event) -> None:
         cfg = self.config
         self._label_own_pod()
+        use_cliques = featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES)
         if not cfg.clique_id:
+            # Non-fabric node: no native daemon.  With cliques (default) the
+            # controller tracks this node through the DS pod's readiness
+            # (build_non_fabric_nodes); in legacy direct-status mode there is
+            # no pod path, so the daemon must still write its own
+            # cd.status.nodes entry (reference cdstatus.go handles both).
             logger.info("no cliqueID on this node: idling without a native daemon")
+            if not use_cliques:
+                self._run_non_fabric_direct_status(stop)
+                return
             self._started.set()
             stop.wait()
             return
 
-        self.clique = CliqueManager(
-            self._kube, cfg.namespace, cfg.cd_uid, cfg.clique_id, cfg.node_name, cfg.pod_ip
-        )
+        if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
+            self.clique = CliqueManager(
+                self._kube, cfg.namespace, cfg.cd_uid, cfg.clique_id,
+                cfg.node_name, cfg.pod_ip,
+            )
+        else:
+            # Legacy direct-status membership: daemons write cd.status.nodes
+            # themselves (reference cdstatus.go:55; gate off).
+            from tpudra.cddaemon.cdstatus import DirectStatusManager
+
+            if not (cfg.cd_namespace and cfg.cd_name):
+                raise RuntimeError(
+                    "ComputeDomainCliques gate is off but CD_NAMESPACE/CD_NAME "
+                    "are not set — the direct-status path needs the CD object"
+                )
+            self.clique = DirectStatusManager(
+                self._kube, cfg.cd_namespace, cfg.cd_name, cfg.clique_id,
+                cfg.node_name, cfg.pod_ip,
+            )
         index = self.clique.join()
 
         os.makedirs(cfg.work_dir, exist_ok=True)
@@ -156,6 +187,32 @@ class DaemonApp:
                 last_ready = ready
             stop.wait(2.0)
         self.process.stop()
+
+    def _run_non_fabric_direct_status(self, stop: threading.Event) -> None:
+        """Legacy mode, non-fabric node: maintain a cd.status.nodes entry
+        with empty cliqueID so the controller can count this node (there is
+        no clique CR and the legacy controller branch reads only
+        status.nodes)."""
+        from tpudra.cddaemon.cdstatus import DirectStatusManager
+
+        cfg = self.config
+        if not (cfg.cd_namespace and cfg.cd_name):
+            raise RuntimeError(
+                "ComputeDomainCliques gate is off but CD_NAMESPACE/CD_NAME "
+                "are not set — the direct-status path needs the CD object"
+            )
+        self.clique = DirectStatusManager(
+            self._kube, cfg.cd_namespace, cfg.cd_name, "", cfg.node_name, cfg.pod_ip
+        )
+        self.clique.join()
+        self._started.set()
+        last_ready: Optional[bool] = None
+        while not stop.is_set():
+            ready = self.is_ready()  # no clique → unconditionally True
+            if ready != last_ready:
+                self.clique.update_daemon_status(ready)
+                last_ready = ready
+            stop.wait(2.0)
 
     def wait_started(self, timeout: float = 30.0) -> bool:
         return self._started.wait(timeout)

@@ -1,0 +1,180 @@
+"""Legacy direct-status membership (ComputeDomainCliques gate OFF).
+
+The analog of compute-domain-daemon/cdstatus.go:55-477: instead of
+rendezvousing through ComputeDomainClique CRs, each daemon upserts its node
+entry straight into ``cd.status.nodes`` and learns peers by watching the
+ComputeDomain itself.  Same interface as CliqueManager so DaemonApp can pick
+one by feature gate.
+
+Kept for one-release migration compatibility: a cluster downgrading the
+gate must not strand daemons mid-domain.  The clique path is the default
+(and scales better — one small CR per clique instead of every daemon
+rewriting the CD object).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from tpudra.api.computedomain import (
+    COMPUTE_DOMAIN_STATUS_NOT_READY,
+    COMPUTE_DOMAIN_STATUS_READY,
+)
+from tpudra.cddaemon.cdclique import MAX_UPSERT_RETRIES, PeersCallback
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.errors import Conflict, NotFound
+from tpudra.kube.informer import Informer
+
+logger = logging.getLogger(__name__)
+
+
+class DirectStatusManager:
+    """CliqueManager-shaped membership written directly to cd.status.nodes."""
+
+    def __init__(
+        self,
+        kube: KubeAPI,
+        cd_namespace: str,
+        cd_name: str,
+        clique_id: str,
+        node_name: str,
+        ip_address: str,
+    ):
+        self._kube = kube
+        self._cd_ns = cd_namespace
+        self._cd_name = cd_name
+        self._clique_id = clique_id
+        self._node = node_name
+        self._ip = ip_address
+        self._informer: Optional[Informer] = None
+        self._peers_cb: Optional[PeersCallback] = None
+        self._last_peers: Optional[dict[int, str]] = None
+        self._lock = threading.Lock()
+        self.index: Optional[int] = None
+
+    @property
+    def name(self) -> str:
+        return f"{self._cd_ns}/{self._cd_name}"
+
+    def _get_cd(self) -> dict:
+        return self._kube.get(gvr.COMPUTE_DOMAINS, self._cd_name, self._cd_ns)
+
+    # -- membership ---------------------------------------------------------
+
+    def join(self) -> int:
+        """Upsert this node into cd.status.nodes, claiming the lowest free
+        index (the cdstatus.go analog of getNextAvailableIndex)."""
+        for _ in range(MAX_UPSERT_RETRIES):
+            cd = self._get_cd()
+            nodes = cd.setdefault("status", {}).setdefault("nodes", [])
+            mine = next((n for n in nodes if n.get("name") == self._node), None)
+            if mine is not None:
+                if (
+                    mine.get("ipAddress") == self._ip
+                    and mine.get("cliqueID") == self._clique_id
+                ):
+                    self.index = mine["index"]
+                    return self.index
+                # Restarted with a new IP or a rebuilt slice (new cliqueID):
+                # refresh both, or peers' same-clique filters would exclude
+                # this entry forever.
+                mine["ipAddress"] = self._ip
+                mine["cliqueID"] = self._clique_id
+            else:
+                used = {n.get("index") for n in nodes}
+                index = next(i for i in range(len(nodes) + 1) if i not in used)
+                nodes.append(
+                    {
+                        "name": self._node,
+                        "ipAddress": self._ip,
+                        "cliqueID": self._clique_id,
+                        "index": index,
+                        "status": COMPUTE_DOMAIN_STATUS_NOT_READY,
+                    }
+                )
+            try:
+                updated = self._kube.update_status(gvr.COMPUTE_DOMAINS, cd, self._cd_ns)
+            except Conflict:
+                continue
+            mine = next(
+                n for n in updated["status"]["nodes"] if n["name"] == self._node
+            )
+            self.index = mine["index"]
+            logger.info(
+                "joined %s via direct status as index %d", self.name, self.index
+            )
+            return self.index
+        raise RuntimeError(f"could not join {self.name}: persistent conflicts")
+
+    def update_daemon_status(self, ready: bool) -> None:
+        target = COMPUTE_DOMAIN_STATUS_READY if ready else COMPUTE_DOMAIN_STATUS_NOT_READY
+        for _ in range(MAX_UPSERT_RETRIES):
+            try:
+                cd = self._get_cd()
+            except NotFound:
+                return
+            mine = next(
+                (
+                    n
+                    for n in cd.get("status", {}).get("nodes", [])
+                    if n.get("name") == self._node
+                ),
+                None,
+            )
+            if mine is None or mine.get("status") == target:
+                return
+            mine["status"] = target
+            try:
+                self._kube.update_status(gvr.COMPUTE_DOMAINS, cd, self._cd_ns)
+                return
+            except Conflict:
+                continue
+        logger.warning("could not update node status in %s", self.name)
+
+    def leave(self) -> None:
+        for _ in range(MAX_UPSERT_RETRIES):
+            try:
+                cd = self._get_cd()
+            except NotFound:
+                return
+            nodes = cd.get("status", {}).get("nodes", [])
+            remaining = [n for n in nodes if n.get("name") != self._node]
+            if len(remaining) == len(nodes):
+                return
+            cd["status"]["nodes"] = remaining
+            try:
+                self._kube.update_status(gvr.COMPUTE_DOMAINS, cd, self._cd_ns)
+                return
+            except Conflict:
+                continue
+
+    # -- peer watching ------------------------------------------------------
+
+    def watch_peers(self, callback: PeersCallback, stop: threading.Event) -> None:
+        self._peers_cb = callback
+        self._informer = Informer(self._kube, gvr.COMPUTE_DOMAINS, namespace=self._cd_ns)
+        self._informer.add_handler(self._on_event)
+        self._informer.start(stop)
+        self._informer.wait_for_sync()
+
+    def _on_event(self, etype: str, obj: dict) -> None:
+        if obj.get("metadata", {}).get("name") != self._cd_name:
+            return
+        if etype == "DELETED":
+            peers: dict[int, str] = {}
+        else:
+            peers = {
+                n["index"]: n.get("ipAddress", "")
+                for n in obj.get("status", {}).get("nodes", [])
+                # Only same-clique peers are slice neighbors.
+                if n.get("ipAddress") and n.get("cliqueID") == self._clique_id
+            }
+        with self._lock:
+            if peers == self._last_peers:
+                return
+            self._last_peers = peers
+        if self._peers_cb is not None:
+            self._peers_cb(dict(peers))

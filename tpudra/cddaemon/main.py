@@ -39,11 +39,13 @@ def main(argv=None) -> int:
     try:
         from tpudra.flags import make_device_lib
 
+        from tpudra.cdplugin.allocatable import resolve_clique_id
+
         lib = make_device_lib("native", "")
         chips = lib.enumerate_chips()
         topo = lib.slice_topology()
         if chips and not config.clique_id:
-            config.clique_id = chips[0].clique_id
+            config.clique_id = resolve_clique_id(chips)
         config.num_hosts = topo.num_hosts
         config.host_index = topo.host_index
         lib.close()

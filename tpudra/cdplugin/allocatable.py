@@ -15,11 +15,20 @@ nvlib.go:201-356).
 
 from __future__ import annotations
 
+import logging
+
+from tpudra import featuregates
 from tpudra.cdplugin import CHANNEL_COUNT
 from tpudra.devicelib import DeviceLib
 
+logger = logging.getLogger(__name__)
+
 TYPE_CHANNEL = "channel"
 TYPE_DAEMON = "daemon"
+
+
+class FabricError(RuntimeError):
+    """ICI fabric state is inconsistent on this host."""
 
 CHANNEL_DEV_DIR = "/dev/tpudra-channels"
 
@@ -45,10 +54,34 @@ def parse_device_name(name: str) -> tuple[str, int]:
     raise ValueError(f"unknown compute-domain device {name!r}")
 
 
+def resolve_clique_id(chips) -> str:
+    """This host's fabric identity, with the strict/legacy split of
+    reference nvlib.go:201-356 keyed on the CrashOnICIFabricErrors gate
+    (featuregates.go:33-59): strict mode (default) raises on inconsistent
+    or missing fabric state so the plugin restarts visibly; legacy mode
+    degrades the host to non-fabric membership (empty cliqueID — the
+    daemon idles and the controller tracks the node through its DS pod)."""
+    ids = {c.clique_id for c in chips}
+    strict = featuregates.enabled(featuregates.CRASH_ON_ICI_FABRIC_ERRORS)
+    if len(ids) > 1:
+        msg = f"chips disagree on ICI clique: {sorted(ids)}"
+        if strict:
+            raise FabricError(msg)
+        logger.warning("%s — degrading to non-fabric membership", msg)
+        return ""
+    if chips and not chips[0].clique_id:
+        msg = "chips report no ICI clique membership"
+        if strict:
+            raise FabricError(msg)
+        logger.warning("%s — degrading to non-fabric membership", msg)
+        return ""
+    return chips[0].clique_id if chips else ""
+
+
 def build_devices(lib: DeviceLib) -> list[dict]:
     """resource.k8s.io Device entries for this node's pool."""
     chips = lib.enumerate_chips()
-    clique_id = chips[0].clique_id if chips else ""
+    clique_id = resolve_clique_id(chips)
     topo = lib.slice_topology()
     devices = [
         {

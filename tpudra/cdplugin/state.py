@@ -314,7 +314,7 @@ class ComputeDomainDeviceState:
                 "TPUDRA_DOMAIN_CHANNELS=" + ",".join(str(i) for i in granted),
                 f"TPUDRA_NUM_HOSTS={topo.num_hosts}",
                 f"TPUDRA_HOST_INDEX={topo.host_index}",
-                f"TPUDRA_CLIQUE_ID={chips[0].clique_id if chips else ''}",
+                f"TPUDRA_CLIQUE_ID={alloc.resolve_clique_id(chips)}",
             ],
             device_nodes=[
                 self._cdi.host_path(alloc.channel_dev_path(i)) for i in granted
@@ -333,7 +333,10 @@ class ComputeDomainDeviceState:
                 )
         chips = self._lib.enumerate_chips()
         topo = self._lib.slice_topology()
-        clique_id = chips[0].clique_id if chips else ""
+        # Same strict/legacy fabric-error semantics as enumeration: the
+        # CLIQUE_ID handed to the daemon must agree with what the published
+        # devices advertised (a degraded node must not join a clique).
+        clique_id = alloc.resolve_clique_id(chips)
         env = self._cdm.prepare_daemon_settings(
             config.domain_id, clique_id, topo.num_hosts, topo.host_index
         )

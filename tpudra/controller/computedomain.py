@@ -22,9 +22,11 @@ from tpudra.api.computedomain import (
     COMPUTE_DOMAIN_STATUS_NOT_READY,
     COMPUTE_DOMAIN_STATUS_READY,
 )
+from tpudra import featuregates
 from tpudra.controller.daemonset import DaemonSetManager
 from tpudra.controller.node import NodeManager
 from tpudra.controller.resourceclaimtemplate import (
+    CD_UID_LABEL,
     DaemonResourceClaimTemplateManager,
     WorkloadResourceClaimTemplateManager,
 )
@@ -160,6 +162,45 @@ class ComputeDomainManager:
         nodes.sort(key=lambda n: (n["cliqueID"], n["index"]))
         return nodes
 
+    def build_non_fabric_nodes(self, cd_uid: str, fabric_nodes: set[str]) -> list[dict]:
+        """Nodes whose daemon has no ICI clique — they never appear in any
+        ComputeDomainClique CR, so membership comes from the per-CD
+        DaemonSet pod itself: present + Ready pod = Ready node (the
+        daemonsetpods.go informer path of the reference controller).
+        Without this, a CD containing a non-fabric node could never reach
+        Ready."""
+        out: list[dict] = []
+        try:
+            pods = self._kube.list(
+                gvr.PODS, self._ns, label_selector=f"{CD_UID_LABEL}={cd_uid}"
+            ).get("items", [])
+        except Exception as e:  # noqa: BLE001
+            # Publishing a shrunken node list on a transient list error
+            # would flip the CD NOT_READY with no diagnostic; retry instead.
+            raise RetryLater(f"listing CD daemon pods: {e}") from e
+        for pod in pods:
+            node = pod.get("spec", {}).get("nodeName", "")
+            if not node or node in fabric_nodes:
+                continue
+            conditions = pod.get("status", {}).get("conditions", [])
+            pod_ready = any(
+                c.get("type") == "Ready" and c.get("status") == "True"
+                for c in conditions
+            )
+            out.append(
+                {
+                    "name": node,
+                    "ipAddress": pod.get("status", {}).get("podIP", ""),
+                    "cliqueID": "",
+                    "index": 0,
+                    "status": COMPUTE_DOMAIN_STATUS_READY
+                    if pod_ready
+                    else COMPUTE_DOMAIN_STATUS_NOT_READY,
+                }
+            )
+        out.sort(key=lambda n: n["name"])
+        return out
+
     def calculate_global_status(self, cd: dict, nodes: list[dict]) -> str:
         """Ready iff enough nodes and all Ready (computedomain.go:251-265)."""
         num_nodes = cd.get("spec", {}).get("numNodes", 0)
@@ -170,7 +211,15 @@ class ComputeDomainManager:
         return COMPUTE_DOMAIN_STATUS_READY
 
     def sync_status(self, cd: dict) -> None:
-        nodes = self.build_nodes_from_cliques(cd["metadata"]["uid"])
+        if featuregates.enabled(featuregates.COMPUTE_DOMAIN_CLIQUES):
+            nodes = self.build_nodes_from_cliques(cd["metadata"]["uid"])
+            seen = {n["name"] for n in nodes}
+            nodes += self.build_non_fabric_nodes(cd["metadata"]["uid"], seen)
+        else:
+            # Legacy direct-status mode: the daemons own status.nodes
+            # (cdstatus.go:55); the controller only recomputes the
+            # aggregate without touching their entries.
+            nodes = cd.get("status", {}).get("nodes", [])
         status = {
             "status": self.calculate_global_status(cd, nodes),
             "nodes": nodes,

@@ -72,6 +72,8 @@ class DaemonSetManager:
             name=self.name(cd["metadata"]["uid"]),
             namespace=self._ns,
             cd_uid=cd["metadata"]["uid"],
+            cd_namespace=cd["metadata"].get("namespace", ""),
+            cd_name=cd["metadata"].get("name", ""),
             image=self._image,
             daemon_rct_name=daemon_rct_name,
             feature_gates=gates,
