@@ -63,6 +63,39 @@ def mk_node(kube, name):
     return kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
 
 
+class TestInformerReadThrough:
+    def test_cd_exists_pre_and_post_sync(self):
+        """cd_exists must answer correctly from the direct API before the
+        informer syncs (an empty pre-sync cache looks like 'nothing
+        exists' — wrongly triggering orphan GC) and from the cache after."""
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        # Informers wired but not started: fallback path.
+        assert c.manager.cd_exists(uid)
+        assert not c.manager.cd_exists("no-such-uid")
+
+        stop = threading.Event()
+        try:
+            c._cd_informer.start(stop)
+            c._clique_informer.start(stop)
+            assert c._cd_informer.wait_for_sync()
+            assert c._clique_informer.wait_for_sync()
+            # Cache path now answers.
+            assert c.manager.cd_exists(uid)
+            assert not c.manager.cd_exists("no-such-uid")
+            # Clique aggregation reads through the cdUID index.
+            clique = CliqueManager(kube, NS, uid, "s1.0", "node-a", "10.0.0.1")
+            clique.join()
+            wait_for(
+                lambda: c.manager.build_nodes_from_cliques(uid),
+                msg="clique visible through informer index",
+            )
+        finally:
+            stop.set()
+
+
 # -- non-fabric nodes + feature-gated membership paths -----------------------
 
 

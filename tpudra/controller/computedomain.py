@@ -58,14 +58,43 @@ class ComputeDomainManager:
         self.daemon_rcts = DaemonResourceClaimTemplateManager(kube, driver_namespace)
         self.workload_rcts = WorkloadResourceClaimTemplateManager(kube)
         self.nodes = NodeManager(kube, self.cd_exists)
+        self._cd_informer = None
+        self._clique_informer = None
+
+    def use_informers(self, cd_informer, clique_informer) -> None:
+        """Route existence checks and clique aggregation through informer
+        caches instead of per-call full LISTs (the reference's
+        uid-indexed informer + mutation cache, computedomain.go:117-125).
+        Reads fall back to the API until each informer has synced."""
+        cd_informer.add_index("uid", lambda o: o.get("metadata", {}).get("uid"))
+        clique_informer.add_index(
+            "cdUID", lambda o: o.get("spec", {}).get("computeDomainUID")
+        )
+        self._cd_informer = cd_informer
+        self._clique_informer = clique_informer
 
     # ------------------------------------------------------------- helpers
 
     def cd_exists(self, uid: str) -> bool:
+        inf = self._cd_informer
+        if inf is not None and inf.has_synced:
+            return bool(inf.by_index("uid", uid))
         for item in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", []):
             if item["metadata"]["uid"] == uid:
                 return True
         return False
+
+    def _cliques_for(self, cd_uid: str) -> list[dict]:
+        inf = self._clique_informer
+        if inf is not None and inf.has_synced:
+            return inf.by_index("cdUID", cd_uid)
+        return [
+            c
+            for c in self._kube.list(gvr.COMPUTE_DOMAIN_CLIQUES, self._ns).get(
+                "items", []
+            )
+            if c.get("spec", {}).get("computeDomainUID") == cd_uid
+        ]
 
     def get(self, namespace: str, name: str) -> Optional[dict]:
         try:
@@ -146,9 +175,7 @@ class ComputeDomainManager:
         """Aggregate clique daemon entries into cd.status.nodes
         (buildNodesFromCliques, cdstatus.go:242)."""
         nodes: list[dict] = []
-        for clique in self._kube.list(gvr.COMPUTE_DOMAIN_CLIQUES, self._ns).get("items", []):
-            if clique.get("spec", {}).get("computeDomainUID") != cd_uid:
-                continue
+        for clique in self._cliques_for(cd_uid):
             for daemon in clique.get("status", {}).get("daemons", []):
                 nodes.append(
                     {

@@ -54,6 +54,9 @@ class Controller:
         self._clique_informer = Informer(
             kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._config.driver_namespace
         )
+        # Existence checks + clique aggregation read through these caches
+        # once synced (kills the per-reconcile full LISTs).
+        self.manager.use_informers(self._cd_informer, self._clique_informer)
         self._cleanups = [
             CleanupManager(
                 kube, gvr.DAEMONSETS, self._config.driver_namespace, self.manager.cd_exists
@@ -95,10 +98,20 @@ class Controller:
         cd_uid = obj.get("spec", {}).get("computeDomainUID", "")
         if not cd_uid:
             return
-        for cd in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", []):
-            if cd["metadata"]["uid"] == cd_uid:
-                self._enqueue_cd(cd["metadata"]["namespace"], cd["metadata"]["name"])
-                return
+        # Both informer threads start concurrently; a clique event can land
+        # before the CD informer's initial LIST completes, so fall back to
+        # the API until it has synced (same pre-sync hazard as cd_exists).
+        if self._cd_informer.has_synced:
+            cds = self._cd_informer.by_index("uid", cd_uid)
+        else:
+            cds = [
+                cd
+                for cd in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", [])
+                if cd["metadata"]["uid"] == cd_uid
+            ]
+        for cd in cds:
+            self._enqueue_cd(cd["metadata"]["namespace"], cd["metadata"]["name"])
+            return
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -87,6 +87,13 @@ class Informer:
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
         return self._synced.wait(timeout)
 
+    @property
+    def has_synced(self) -> bool:
+        """Non-blocking: True once the initial LIST has populated the
+        store.  Read-through consumers must fall back to a direct API call
+        until then — an empty pre-sync cache looks like 'nothing exists'."""
+        return self._synced.is_set()
+
     def _run(self, stop: threading.Event) -> None:
         while not stop.is_set():
             try:
