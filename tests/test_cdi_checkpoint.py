@@ -68,6 +68,32 @@ def test_driver_root_transform(tmp_path):
 
 # -- checkpoint -------------------------------------------------------------
 
+def test_device_edits_cache_ttl_and_warmup():
+    """The 5-min per-device edits cache (reference cdi.go:65,151): warmup
+    precomputes, hits are copies, expiry rebuilds."""
+    from tpudra.plugin.cdi import ContainerEdits, DeviceEditsCache
+
+    now = [1000.0]
+    builds = {"tpu-0": 0}
+
+    def build():
+        builds["tpu-0"] += 1
+        return ContainerEdits(device_nodes=["/dev/accel0"])
+
+    cache = DeviceEditsCache(ttl=300.0, clock=lambda: now[0])
+    cache.warmup({"tpu-0": build})
+    assert builds["tpu-0"] == 1
+
+    hit = cache.get("tpu-0", build)
+    assert builds["tpu-0"] == 1  # warm hit, no rebuild
+    hit.device_nodes.append("/dev/mutated")
+    assert cache.get("tpu-0", build).device_nodes == ["/dev/accel0"]  # copy-out
+
+    now[0] += 301.0
+    assert cache.get("tpu-0", build).device_nodes == ["/dev/accel0"]
+    assert builds["tpu-0"] == 2  # expired → rebuilt
+
+
 def mk_claim(uid="u1", status=PREPARE_COMPLETED):
     return PreparedClaim(
         uid=uid,

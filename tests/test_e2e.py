@@ -155,6 +155,34 @@ class Scheduler:
             return True
         return False
 
+    def allocate_extended(
+        self, limits: dict[str, int], uid: str, namespace="default", pod_name="pod"
+    ):
+        """The extendedResourceName translation a DRA-aware scheduler does
+        (reference test_gpu_extres.bats): a pod requesting
+        ``resources.limits: {"tpu.google.com/chip": N}`` gets a
+        scheduler-authored ResourceClaim against the DeviceClass that
+        advertises that extendedResourceName; the node plugin then sees a
+        perfectly ordinary claim."""
+        class_by_extres = {
+            "tpu.google.com/chip": "tpu.google.com",
+        }
+        requests = []
+        for res_name, count in limits.items():
+            device_class = class_by_extres.get(res_name)
+            assert device_class, f"no DeviceClass advertises {res_name}"
+            requests.append(
+                {
+                    "name": f"extres-{len(requests)}",
+                    "exactly": {"deviceClassName": device_class, "count": count},
+                }
+            )
+        rct = {
+            "metadata": {"name": f"{pod_name}-extended-resources"},
+            "spec": {"spec": {"devices": {"requests": requests, "config": []}}},
+        }
+        return self.allocate(rct, uid, namespace, f"{pod_name}-extended-resources")
+
     def release(self, claim):
         for r in claim["status"]["allocation"]["devices"]["results"]:
             self._allocated.discard((r["pool"], r["device"]))
@@ -281,6 +309,43 @@ def mk_rct(device_class, count=1, profile=None, name="rct"):
         "metadata": {"name": name},
         "spec": {"spec": {"devices": {"requests": [req], "config": []}}},
     }
+
+
+class TestExtendedResourceName:
+    def test_pod_limits_translate_to_claim_and_prepare(self, tmp_path):
+        """test_gpu_extres.bats analog: a pod asking for 2 chips via classic
+        resources.limits ends in a prepared claim whose container sees
+        exactly those 2 chips."""
+        kube = FakeKube()
+        driver = mk_driver(tmp_path, kube)
+        driver.start()
+        try:
+            claim = Scheduler(kube).allocate_extended(
+                {"tpu.google.com/chip": 2}, "extres-1", "default", "mypod"
+            )
+            assert claim["metadata"]["name"] == "mypod-extended-resources"
+            client = DRAClient(driver.sockets.dra_socket_path)
+            resp = client.prepare([claim])
+            result = resp["claims"]["extres-1"]
+            assert "error" not in result, result
+            assert len(result["devices"]) == 2
+            spec = driver.state._cdi.read_claim_spec("extres-1")
+            env = {
+                e.split("=", 1)[0]: e.split("=", 1)[1]
+                for e in spec["containerEdits"]["env"]
+            }
+            assert len(env["TPU_VISIBLE_DEVICES"].split(",")) == 2
+            client.unprepare([claim])
+            client.close()
+        finally:
+            driver.stop()
+
+    def test_unknown_extended_resource_refused(self):
+        # Refusal happens at DeviceClass lookup, before any published state.
+        with pytest.raises(AssertionError, match="no DeviceClass"):
+            Scheduler(FakeKube()).allocate_extended(
+                {"other.vendor/thing": 1}, "extres-2"
+            )
 
 
 class TestCounterAwareAllocation:

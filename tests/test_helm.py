@@ -163,6 +163,24 @@ class TestToggles:
         assert "TpuPartitionConfig" in exprs
         assert by_kind(rendered, "ValidatingAdmissionPolicyBinding")
 
+    def test_extended_resource_name_toggle(self, chart):
+        # Omitted by default (needs the cluster's DRAExtendedResource gate).
+        dc = [
+            d
+            for d in by_kind(chart.render(), "DeviceClass")
+            if d["metadata"]["name"] == "tpu.google.com"
+        ][0]
+        assert "extendedResourceName" not in dc["spec"]
+        rendered = chart.render(
+            {"resources": {"tpus": {"extendedResourceName": "tpu.google.com/chip"}}}
+        )
+        dc = [
+            d
+            for d in by_kind(rendered, "DeviceClass")
+            if d["metadata"]["name"] == "tpu.google.com"
+        ][0]
+        assert dc["spec"]["extendedResourceName"] == "tpu.google.com/chip"
+
     def test_resource_api_version_override(self, chart):
         rendered = chart.render({"resourceApiVersion": "resource.k8s.io/v1beta1"})
         for dc in by_kind(rendered, "DeviceClass"):

@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 CDI_VERSION = "0.6.0"
 
@@ -53,6 +55,14 @@ class ContainerEdits:
             hooks=self.hooks + other.hooks,
         )
 
+    def copy(self) -> "ContainerEdits":
+        return ContainerEdits(
+            env=list(self.env),
+            device_nodes=list(self.device_nodes),
+            mounts=list(self.mounts),
+            hooks=list(self.hooks),
+        )
+
     def to_cdi(self) -> dict:
         out: dict = {}
         if self.env:
@@ -71,6 +81,47 @@ class ContainerEdits:
         if self.hooks:
             out["hooks"] = list(self.hooks)
         return out
+
+
+class DeviceEditsCache:
+    """Expiring per-device container-edits cache with startup warmup
+    (the reference's 5-minute dev-spec cache, cdi.go:65,151).
+
+    Today's builders are cheap string formatting, so this is a parity
+    feature, not a measured win: it exists so that a future native backend
+    whose ``dev_paths`` actually probes sysfs/devfs inherits the
+    reference's cost model (bounded to once per device per TTL, first
+    prepare pre-warmed) without a redesign.  Entries are copied in and out
+    so callers can mutate freely.
+    """
+
+    DEFAULT_TTL = 300.0  # reference cdi.go:65
+
+    def __init__(self, ttl: float = DEFAULT_TTL, clock: Callable[[], float] = time.monotonic):
+        self._ttl = ttl
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: dict[str, tuple[float, ContainerEdits]] = {}
+
+    def get(self, device_name: str, build: Callable[[], ContainerEdits]) -> ContainerEdits:
+        now = self._clock()
+        with self._lock:
+            hit = self._entries.get(device_name)
+            if hit is not None and now - hit[0] <= self._ttl:
+                return hit[1].copy()
+        edits = build()
+        with self._lock:
+            self._entries[device_name] = (now, edits.copy())
+        return edits
+
+    def warmup(self, builders: dict[str, Callable[[], ContainerEdits]]) -> None:
+        """Precompute edits for every known device (reference WarmupDevSpecCache,
+        cdi.go:151)."""
+        now = self._clock()
+        built = {name: (now, build().copy()) for name, build in builders.items()}
+        with self._lock:
+            self._entries.update(built)
+
 
 
 class CDIHandler:

@@ -38,7 +38,12 @@ from tpudra.api import (
 from tpudra.devicelib import DeviceLib, DeviceLibError, PartitionSpec
 from tpudra.plugin import allocatable as alloc
 from tpudra.plugin.allocatable import AllocatableDevice
-from tpudra.plugin.cdi import CDIHandler, ContainerEdits, chip_edits
+from tpudra.plugin.cdi import (
+    CDIHandler,
+    ContainerEdits,
+    DeviceEditsCache,
+    chip_edits,
+)
 from tpudra.plugin.checkpoint import (
     PREPARE_COMPLETED,
     PREPARE_STARTED,
@@ -114,6 +119,22 @@ class DeviceState:
             static_parts,
             dynamic_placements,
             with_vfio=self._passthrough,
+        )
+        # Per-device edits cache with startup warmup (reference
+        # cdi.go:65,151).  Builders are currently trivial — see the
+        # DeviceEditsCache docstring for why the cache exists anyway.
+        self._edits_cache = DeviceEditsCache()
+        self._edits_cache.warmup(
+            {
+                name: (lambda d=dev: self._build_device_edits(d))
+                for name, dev in self.allocatable.items()
+                if dev.type != alloc.TYPE_VFIO  # vfio edits depend on bind state
+            }
+        )
+
+    def _build_device_edits(self, adev) -> ContainerEdits:
+        return ContainerEdits(
+            device_nodes=[self._cdi.host_path(p) for p in adev.chip.dev_paths()]
         )
 
     # ------------------------------------------------------------------ API
@@ -529,8 +550,9 @@ class DeviceState:
                     )
                 else:
                     tpu_chips[adev.chip.index] = adev.chip
-                    edits = ContainerEdits(
-                        device_nodes=[self._cdi.host_path(p) for p in adev.chip.dev_paths()]
+                    edits = self._edits_cache.get(
+                        dev.canonical_name,
+                        lambda a=adev: self._build_device_edits(a),
                     )
                     if adev.is_partition:
                         spec = adev.partition_spec
