@@ -62,6 +62,9 @@ struct tpuinfo_handle {
   tpuinfo_topology topo{};
   std::string state_file;  // partition registry; empty = partitions disabled
   std::string error;
+  // Real PCI addresses from sysfs probing, index-aligned with chips
+  // (empty in config/env modes).
+  std::vector<std::string> pci_addresses;
 
   int fail(const std::string& msg) {
     error = msg;
@@ -98,6 +101,69 @@ int count_accel_devices(const std::string& dev_root) {
   }
   closedir(d);
   return n;
+}
+
+// ---------------------------------------------------------------------------
+// sysfs PCI probing — the real-hardware path.  Google TPU PCI functions
+// carry vendor id 0x1ae0; the device id names the generation (ids as
+// published by google/cloud-accelerator-diagnostics' tpu-info tool).
+// ---------------------------------------------------------------------------
+
+const unsigned kGoogleVendorId = 0x1ae0;
+
+struct PciIdGen {
+  unsigned device_id;
+  const char* generation;
+};
+
+const PciIdGen kPciIdTable[] = {
+    {0x005e, "v4"},
+    {0x0062, "v5p"},
+    {0x0063, "v5e"},
+    {0x006f, "v6e"},
+};
+
+struct PciTpu {
+  std::string address;   // "0000:af:00.0"
+  std::string generation;
+};
+
+std::string read_trimmed(const std::string& path) {
+  std::ifstream f(path);
+  std::string s;
+  std::getline(f, s);
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r' || s.back() == ' '))
+    s.pop_back();
+  return s;
+}
+
+// Scan <sysfs_root>/bus/pci/devices for TPU functions.  Returns them sorted
+// by PCI address, which is the stable host-local index order (the same
+// order the accel device nodes are minor-numbered in).
+std::vector<PciTpu> probe_sysfs_pci(const std::string& sysfs_root) {
+  std::vector<PciTpu> out;
+  std::string base = sysfs_root + "/bus/pci/devices";
+  DIR* d = opendir(base.c_str());
+  if (d == nullptr) return out;
+  while (dirent* e = readdir(d)) {
+    if (e->d_name[0] == '.') continue;
+    std::string dev_dir = base + "/" + e->d_name;
+    unsigned vendor = strtoul(read_trimmed(dev_dir + "/vendor").c_str(), nullptr, 16);
+    if (vendor != kGoogleVendorId) continue;
+    // Vendor 0x1ae0 also covers non-TPU Google functions (e.g. gVNIC);
+    // only a known TPU device id counts, like the upstream tpu-info tool.
+    unsigned device = strtoul(read_trimmed(dev_dir + "/device").c_str(), nullptr, 16);
+    PciTpu t;
+    t.address = e->d_name;
+    for (const auto& id : kPciIdTable)
+      if (id.device_id == device) t.generation = id.generation;
+    if (t.generation.empty()) continue;
+    out.push_back(t);
+  }
+  closedir(d);
+  std::sort(out.begin(), out.end(),
+            [](const PciTpu& a, const PciTpu& b) { return a.address < b.address; });
+  return out;
 }
 
 std::string getenv_or(const char* name, const std::string& fallback) {
@@ -219,16 +285,37 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
     partition_id = kv.count("partition_id") ? kv["partition_id"] : "0";
     h->state_file = kv.count("state_file") ? kv["state_file"] : "";
   } else {
-    // Cloud TPU VM contract: device nodes + TPU_* env.
-    gen_name = getenv_or("TPU_ACCELERATOR_TYPE", "v5p");
+    // Hardware path.  Primary source: sysfs PCI probing (vendor 0x1ae0);
+    // the device id names the generation and the function addresses are
+    // real.  Env/devfs fill in what PCI config space cannot carry (slice
+    // membership, worker index — Cloud TPU VM metadata contract).
+    auto pci = probe_sysfs_pci(getenv_or("TPUINFO_SYSFS_ROOT", "/sys"));
+    gen_name = getenv_or("TPU_ACCELERATOR_TYPE", "");
     auto dash = gen_name.find('-');  // "v5p-16" → "v5p"
     if (dash != std::string::npos) gen_name = gen_name.substr(0, dash);
-    num_chips = count_accel_devices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
+    int dev_count = count_accel_devices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
+    if (!pci.empty()) {
+      // A container may see the host's full /sys but be granted only a
+      // subset of accel device nodes via cgroups — the usable set is the
+      // smaller of the two views.
+      num_chips = static_cast<int>(pci.size());
+      if (dev_count > 0 && dev_count < num_chips) {
+        num_chips = dev_count;
+        pci.resize(dev_count);
+      }
+      gen_name = pci[0].generation;
+    } else {
+      // No PCI visibility (VM without sysfs passthrough): fall back to
+      // counting accel device nodes.
+      num_chips = dev_count;
+    }
+    if (gen_name.empty()) gen_name = "v5p";
     host_index = atoi(getenv_or("TPU_WORKER_ID", "0").c_str());
     num_hosts = atoi(getenv_or("TPU_WORKER_COUNT", "1").c_str());
     slice_uuid = getenv_or("TPU_SLICE_UUID", "slice-local");
     partition_id = "0";
     h->state_file = getenv_or("TPUINFO_STATE_FILE", "/var/run/tpuinfo-state");
+    for (const auto& t : pci) h->pci_addresses.push_back(t.address);
   }
 
   const GenSpec* gen = find_gen(gen_name);
@@ -240,6 +327,10 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
   if (num_chips <= 0) num_chips = gen->chips_per_host;
 
   fill_chips(h, *gen, num_chips, slice_uuid, partition_id, host_index);
+  // sysfs mode: replace the synthetic addresses with the probed ones.
+  for (size_t i = 0; i < h->chips.size() && i < h->pci_addresses.size(); i++)
+    snprintf(h->chips[i].pci_address, sizeof(h->chips[i].pci_address), "%s",
+             h->pci_addresses[i].c_str());
   snprintf(h->topo.slice_uuid, sizeof(h->topo.slice_uuid), "%s",
            slice_uuid.c_str());
   // Mesh = host block stacked along z (topology.py resolve():186-187).

@@ -63,6 +63,50 @@ class TestLibTpuInfo:
         assert topo.slice_uuid == "slice-t"
         lib.close()
 
+    def test_sysfs_pci_probing(self, tmp_path, monkeypatch):
+        """The hardware path: chips enumerated from sysfs PCI devices with
+        Google's vendor id — generation from the device id, real function
+        addresses on the chips (no config file involved)."""
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        pci_root = tmp_path / "sys" / "bus" / "pci" / "devices"
+        # Two v5e functions, one foreign NIC, and a gVNIC — Google vendor id
+        # but not a TPU device id — all non-TPUs must be ignored.
+        for addr, vendor, device in [
+            ("0000:af:00.0", "0x1ae0", "0x0063"),
+            ("0000:b0:00.0", "0x1ae0", "0x0063"),
+            ("0000:04:00.0", "0x8086", "0x1572"),
+            ("0000:03:00.0", "0x1ae0", "0x0042"),
+        ]:
+            d = pci_root / addr
+            d.mkdir(parents=True)
+            (d / "vendor").write_text(vendor + "\n")
+            (d / "device").write_text(device + "\n")
+
+        (tmp_path / "dev").mkdir()  # hermetic devfs: no accel nodes here
+        monkeypatch.setenv("TPUINFO_DEV_ROOT", str(tmp_path / "dev"))
+        monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path / "sys"))
+        monkeypatch.setenv("TPUINFO_STATE_FILE", str(tmp_path / "state"))
+        monkeypatch.setenv("TPU_SLICE_UUID", "hw-slice")
+        monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+        lib = NativeDeviceLib(config_path="")
+        chips = lib.enumerate_chips()
+        assert len(chips) == 2  # the NIC is not a TPU
+        assert {c.generation for c in chips} == {"v5e"}
+        assert sorted(c.pci_address for c in chips) == [
+            "0000:af:00.0",
+            "0000:b0:00.0",
+        ]
+        assert chips[0].clique_id.startswith("hw-slice.")
+        lib.close()
+
+        # Containment: granted only 1 accel node via cgroups while the full
+        # host /sys is visible → usable set is the devfs view.
+        (tmp_path / "dev" / "accel0").write_text("")
+        lib = NativeDeviceLib(config_path="")
+        assert len(lib.enumerate_chips()) == 1
+        lib.close()
+
     def test_partition_lifecycle_and_overlap(self, tmp_path):
         lib = mk_native(tmp_path)
         spec = PartitionSpec(0, "1c.4hbm", 0, 0)
