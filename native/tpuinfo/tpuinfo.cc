@@ -92,15 +92,20 @@ std::map<std::string, std::string> parse_config(const std::string& path,
   return kv;
 }
 
-int count_accel_devices(const std::string& dev_root) {
-  int n = 0;
+// Minor numbers of the /dev/accelN nodes visible to this process, sorted.
+// The kernel assigns accel minors in PCI enumeration (address) order, so
+// index i here corresponds to the i-th sysfs TPU function sorted by address.
+std::vector<int> accel_device_indices(const std::string& dev_root) {
+  std::vector<int> out;
   DIR* d = opendir(dev_root.c_str());
-  if (d == nullptr) return 0;
+  if (d == nullptr) return out;
   while (dirent* e = readdir(d)) {
-    if (strncmp(e->d_name, "accel", 5) == 0 && isdigit(e->d_name[5])) n++;
+    if (strncmp(e->d_name, "accel", 5) == 0 && isdigit(e->d_name[5]))
+      out.push_back(atoi(e->d_name + 5));
   }
   closedir(d);
-  return n;
+  std::sort(out.begin(), out.end());
+  return out;
 }
 
 // ---------------------------------------------------------------------------
@@ -293,16 +298,22 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
     gen_name = getenv_or("TPU_ACCELERATOR_TYPE", "");
     auto dash = gen_name.find('-');  // "v5p-16" → "v5p"
     if (dash != std::string::npos) gen_name = gen_name.substr(0, dash);
-    int dev_count = count_accel_devices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
+    auto accel = accel_device_indices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
+    int dev_count = static_cast<int>(accel.size());
     if (!pci.empty()) {
       // A container may see the host's full /sys but be granted only a
       // subset of accel device nodes via cgroups — the usable set is the
-      // smaller of the two views.
-      num_chips = static_cast<int>(pci.size());
-      if (dev_count > 0 && dev_count < num_chips) {
-        num_chips = dev_count;
-        pci.resize(dev_count);
+      // smaller of the two views, matched by minor number (accelN is the
+      // N-th function in PCI address order), NOT by truncation: a pod
+      // granted /dev/accel{2,3} must report chips 2 and 3's addresses.
+      if (dev_count > 0 && dev_count < static_cast<int>(pci.size())) {
+        std::vector<PciTpu> granted;
+        for (int idx : accel)
+          if (idx >= 0 && idx < static_cast<int>(pci.size()))
+            granted.push_back(pci[idx]);
+        if (!granted.empty()) pci = granted;
       }
+      num_chips = static_cast<int>(pci.size());
       gen_name = pci[0].generation;
     } else {
       // No PCI visibility (VM without sysfs passthrough): fall back to

@@ -38,6 +38,24 @@ class TestEntryPoints:
         args = webhook_parser().parse_args([])
         assert args.port == 8443
 
+    def test_version_flag_and_buildinfo(self, monkeypatch, capsys):
+        """internal/info analog: every binary answers --version with the
+        stamped build identity; env overrides beat the package default."""
+        import pytest
+
+        from tpudra import buildinfo
+        from tpudra.plugin.main import build_parser
+
+        with pytest.raises(SystemExit) as exc:
+            build_parser().parse_args(["--version"])
+        assert exc.value.code == 0
+        out = capsys.readouterr().out
+        assert "tpudra" in out and "commit" in out
+
+        monkeypatch.setenv("TPUDRA_VERSION", "9.9.9")
+        monkeypatch.setenv("TPUDRA_GIT_COMMIT", "abc1234")
+        assert buildinfo.version_string() == "tpudra 9.9.9 (commit abc1234)"
+
     def test_env_mirrors_win_over_defaults(self, monkeypatch):
         monkeypatch.setenv("NODE_NAME", "n2")
         monkeypatch.setenv("CDI_ROOT", "/custom/cdi")

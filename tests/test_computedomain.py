@@ -517,7 +517,337 @@ SIGHUP_TOLERANT = [
 ]
 
 
+class TestPodManagerReadiness:
+    """Own-pod informer path (podmanager.go analog): kubelet-probe
+    transitions on the pod object drive clique daemon status via the watch,
+    not the status-socket poll."""
+
+    def _pod(self, kube, name, ready):
+        return kube.create(
+            gvr.PODS,
+            {
+                "metadata": {"name": name},
+                "spec": {"nodeName": "node-a"},
+                "status": {
+                    "conditions": [
+                        {"type": "Ready", "status": "True" if ready else "False"}
+                    ]
+                },
+            },
+            NS,
+        )
+
+    def test_pod_transition_drives_clique_status(self, tmp_path):
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        stub = ReadyServer()
+        stub.set_ready()
+        pod = self._pod(kube, "cd-daemon-a", ready=True)
+        cfg = DaemonConfig(
+            cd_uid=uid,
+            node_name="node-a",
+            pod_name="cd-daemon-a",
+            pod_ip="10.0.0.1",
+            namespace=NS,
+            clique_id="s1.0",
+            num_hosts=1,
+            host_index=0,
+            status_port=stub.port,
+            work_dir=str(tmp_path / "wd"),
+            hosts_path=str(tmp_path / "hosts"),
+            daemon_argv=SIGHUP_TOLERANT,
+        )
+        app = DaemonApp(kube, cfg)
+        stop = threading.Event()
+        threading.Thread(target=app.run, args=(stop,), daemon=True).start()
+        try:
+            assert app.wait_started()
+
+            from tpudra.api.computedomain import COMPUTE_DOMAIN_STATUS_READY
+
+            def daemon_ready():
+                cliques = kube.list(gvr.COMPUTE_DOMAIN_CLIQUES, NS)["items"]
+                for cl in cliques:
+                    for d in cl.get("status", {}).get("daemons", []):
+                        if d.get("nodeName") == "node-a":
+                            return d.get("status") == COMPUTE_DOMAIN_STATUS_READY
+                return None
+
+            wait_for(lambda: daemon_ready() is True, msg="initial Ready")
+            wait_for(lambda: app.pods is not None and app.pods.seen_pod,
+                     msg="pod seen by informer")
+
+            # Kubelet marks the pod NotReady: the socket still answers READY,
+            # so only the pod-watch path can propagate this transition fast.
+            pod = kube.get(gvr.PODS, "cd-daemon-a", NS)
+            pod["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+            kube.update(gvr.PODS, pod, NS)
+            wait_for(lambda: daemon_ready() is False, timeout=5,
+                     msg="NotReady propagated via pod watch")
+
+            # And back — but with the apiserver briefly down for clique
+            # writes: the transition must stay pending and land once the
+            # outage clears (retried by the poll loop), not be lost.
+            from tpudra.kube.errors import ApiError
+
+            outage = {"on": True}
+
+            def flaky(verb, g, obj):
+                if outage["on"]:
+                    raise ApiError("apiserver unavailable")
+
+            kube.react("update", gvr.COMPUTE_DOMAIN_CLIQUES, flaky)
+            pod = kube.get(gvr.PODS, "cd-daemon-a", NS)
+            pod["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+            kube.update(gvr.PODS, pod, NS)
+            time.sleep(0.5)
+            assert daemon_ready() is False  # write could not land yet
+            outage["on"] = False
+            wait_for(lambda: daemon_ready() is True, timeout=10,
+                     msg="pending transition retried after outage")
+        finally:
+            stop.set()
+            stub.close()
+
+
 # -- full lifecycle (§3.3) ---------------------------------------------------
+
+
+class TestMultiNamespaceDaemonSets:
+    """mnsdaemonset.go analog: DaemonSets found in --additional-namespaces
+    are reconciled in place; new ones land in the driver namespace; teardown
+    sweeps every managed namespace."""
+
+    def _manager(self, kube, extra=("legacy-ns",)):
+        from tpudra.controller.daemonset import MultiNamespaceDaemonSetManager
+
+        return MultiNamespaceDaemonSetManager(
+            kube, NS, additional_namespaces=extra
+        )
+
+    def test_new_daemonset_lands_in_driver_namespace(self):
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        mns = self._manager(kube)
+        ds = mns.ensure(cd, "daemon-rct")
+        assert ds["metadata"]["namespace"] == NS
+        assert kube.list(gvr.DAEMONSETS, "legacy-ns")["items"] == []
+
+    def test_existing_daemonset_reconciled_where_it_lives(self):
+        from tpudra.controller.daemonset import DaemonSetManager
+
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        # A previous driver release deployed the DS into legacy-ns.
+        legacy = DaemonSetManager(kube, "legacy-ns", image="old:1")
+        legacy.ensure(cd, "daemon-rct")
+
+        mns = self._manager(kube)
+        ds = mns.ensure(cd, "daemon-rct")
+        assert ds["metadata"]["namespace"] == "legacy-ns"
+        # No duplicate in the driver namespace.
+        assert kube.list(gvr.DAEMONSETS, NS)["items"] == []
+
+    def test_remove_and_assert_removed_span_namespaces(self):
+        from tpudra.controller.daemonset import DaemonSetManager
+
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        DaemonSetManager(kube, "legacy-ns").ensure(cd, "rct")
+        mns = self._manager(kube)
+        assert not mns.assert_removed(uid)
+        mns.remove(uid)
+        assert mns.assert_removed(uid)
+        assert kube.list(gvr.DAEMONSETS, "legacy-ns")["items"] == []
+
+    def test_list_all_unions_namespaces(self):
+        from tpudra.controller.daemonset import DaemonSetManager
+
+        kube = FakeKube()
+        cd1, cd2 = mk_cd(kube, name="cd1"), mk_cd(kube, name="cd2")
+        DaemonSetManager(kube, NS).ensure(cd1, "rct")
+        DaemonSetManager(kube, "legacy-ns").ensure(cd2, "rct")
+        assert len(self._manager(kube).list_all()) == 2
+
+    def test_duplicate_namespaces_deduped(self):
+        kube = FakeKube()
+        mns = self._manager(kube, extra=(NS, "legacy-ns", "legacy-ns"))
+        assert mns.namespaces == [NS, "legacy-ns"]
+
+
+def _mk_cddriver(kube, tmp_path, node="node-a", tag=""):
+    lib = MockDeviceLib(
+        config=MockTopologyConfig(generation="v5p", host_index=0, num_hosts=2),
+        state_file=str(tmp_path / f"hw{tag}.json"),
+    )
+    return CDDriver(
+        CDDriverConfig(
+            node_name=node,
+            plugin_dir=str(tmp_path / f"cdplug{tag}"),
+            registry_dir=str(tmp_path / f"reg{tag}"),
+            cdi_root=str(tmp_path / f"cdi{tag}"),
+        ),
+        kube,
+        lib,
+    )
+
+
+def _channel_claim(uid, cd_uid, device="channel-5"):
+    return {
+        "metadata": {"uid": uid, "namespace": "user-ns", "name": uid},
+        "status": {"allocation": {"devices": {
+            "results": [{
+                "request": "channel",
+                "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                "pool": "node-a",
+                "device": device,
+            }],
+            "config": [{
+                "source": "FromClaim",
+                "requests": [],
+                "opaque": {
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "parameters": {
+                        "apiVersion": API_V,
+                        "kind": "ComputeDomainChannelConfig",
+                        "domainID": cd_uid,
+                        "allocationMode": "Single",
+                    },
+                },
+            }],
+        }}},
+    }
+
+
+class TestStartedClaimRollback:
+    """Unprepare of a PrepareStarted claim rolls back partial side effects
+    (the TPU plugin's partial-claim discipline, device_state.go:482, applied
+    to the CD plugin)."""
+
+    def test_gated_channel_claim_unprepare_removes_node_label(self, tmp_path):
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+
+        resp = drv.prepare_resource_claims([_channel_claim("wl-roll", uid)])
+        assert "error" in resp["claims"]["wl-roll"]  # gated: domain not Ready
+        node = kube.get(gvr.NODES, "node-a")
+        assert node["metadata"]["labels"][COMPUTE_DOMAIN_NODE_LABEL] == uid
+        claims = drv.state.prepared_claim_uids()
+        assert claims["wl-roll"][2] == "PrepareStarted"
+
+        # Scheduler gives up; kubelet unprepares the never-completed claim.
+        drv.unprepare_resource_claims([{"uid": "wl-roll"}])
+        node = kube.get(gvr.NODES, "node-a")
+        assert COMPUTE_DOMAIN_NODE_LABEL not in node["metadata"].get("labels", {})
+        assert "wl-roll" not in drv.state.prepared_claim_uids()
+
+    def test_rollback_keeps_label_while_sibling_claim_in_flight(self, tmp_path):
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+
+        drv.prepare_resource_claims([_channel_claim("wl-1", uid, "channel-1")])
+        drv.prepare_resource_claims([_channel_claim("wl-2", uid, "channel-2")])
+        drv.unprepare_resource_claims([{"uid": "wl-1"}])
+        # wl-2 still holds the domain on this node.
+        node = kube.get(gvr.NODES, "node-a")
+        assert node["metadata"]["labels"][COMPUTE_DOMAIN_NODE_LABEL] == uid
+        drv.unprepare_resource_claims([{"uid": "wl-2"}])
+        node = kube.get(gvr.NODES, "node-a")
+        assert COMPUTE_DOMAIN_NODE_LABEL not in node["metadata"].get("labels", {})
+
+    def test_failed_daemon_claim_does_not_pin_channel_label(self, tmp_path):
+        """A daemon claim's intent stamp must not count toward keeping the
+        channel node label alive: the daemon unprepare path never removes
+        the label, so counting it would leak the label after all claims are
+        gone — permanently blocking the node for other domains."""
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+
+        # Channel claim gates (PrepareStarted, label set).
+        drv.prepare_resource_claims([_channel_claim("wl-1", uid)])
+        # Daemon claim fails mid-prepare, leaving a daemon intent stamp.
+        daemon_claim = {
+            "metadata": {"uid": "dm-1", "namespace": NS, "name": "dm"},
+            "status": {"allocation": {"devices": {
+                "results": [{"request": "daemon",
+                             "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                             "pool": "node-a", "device": "daemon-0"}],
+                "config": [{"source": "FromClaim", "requests": [], "opaque": {
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "parameters": {"apiVersion": API_V,
+                                   "kind": "ComputeDomainDaemonConfig",
+                                   "domainID": uid}}}],
+            }}},
+        }
+        drv.state._cdi.create_claim_spec_file = lambda *a, **kw: (_ for _ in ()).throw(
+            OSError("disk full")
+        )
+        resp = drv.prepare_resource_claims([daemon_claim])
+        assert "error" in resp["claims"]["dm-1"]
+
+        drv.unprepare_resource_claims([{"uid": "wl-1"}])
+        drv.unprepare_resource_claims([{"uid": "dm-1"}])
+        node = kube.get(gvr.NODES, "node-a")
+        assert COMPUTE_DOMAIN_NODE_LABEL not in node["metadata"].get("labels", {})
+        assert drv.state.prepared_claim_uids() == {}
+
+    def test_failed_daemon_claim_unprepare_cleans_settings_dir(self, tmp_path):
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+
+        claim = {
+            "metadata": {"uid": "dm-1", "namespace": NS, "name": "dm"},
+            "status": {"allocation": {"devices": {
+                "results": [{
+                    "request": "daemon",
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "pool": "node-a",
+                    "device": "daemon-0",
+                }],
+                "config": [{
+                    "source": "FromClaim",
+                    "requests": [],
+                    "opaque": {
+                        "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                        "parameters": {
+                            "apiVersion": API_V,
+                            "kind": "ComputeDomainDaemonConfig",
+                            "domainID": uid,
+                        },
+                    },
+                }],
+            }}},
+        }
+        # Fail after the settings dir is created (CDI write blows up).
+        orig = drv.state._cdi.create_claim_spec_file
+
+        def boom(*a, **kw):
+            raise OSError("disk full")
+
+        drv.state._cdi.create_claim_spec_file = boom
+        resp = drv.prepare_resource_claims([claim])
+        assert "error" in resp["claims"]["dm-1"]
+        domain_dir = drv.state._cdm.domain_dir(uid)
+        assert os.path.isdir(domain_dir)
+
+        drv.state._cdi.create_claim_spec_file = orig
+        drv.unprepare_resource_claims([{"uid": "dm-1"}])
+        assert not os.path.exists(domain_dir)
+        assert "dm-1" not in drv.state.prepared_claim_uids()
 
 
 class TestFullLifecycle:

@@ -101,10 +101,14 @@ class TestLibTpuInfo:
         lib.close()
 
         # Containment: granted only 1 accel node via cgroups while the full
-        # host /sys is visible → usable set is the devfs view.
-        (tmp_path / "dev" / "accel0").write_text("")
+        # host /sys is visible → usable set is the devfs view, matched by
+        # minor number: /dev/accel1 is the *second* function in PCI address
+        # order, so the chip must carry that address, not the first one.
+        (tmp_path / "dev" / "accel1").write_text("")
         lib = NativeDeviceLib(config_path="")
-        assert len(lib.enumerate_chips()) == 1
+        chips = lib.enumerate_chips()
+        assert len(chips) == 1
+        assert chips[0].pci_address == "0000:b0:00.0"
         lib.close()
 
     def test_partition_lifecycle_and_overlap(self, tmp_path):

@@ -9,7 +9,7 @@ import pytest
 from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, TPU_DRIVER_NAME
 from tpudra import featuregates as fg
 from tpudra.webhook import WebhookServer, admit_review
-from tpudra.webhook.app import validate_claim_object
+from tpudra.webhook.app import convert_claim_spec_to_v1, validate_claim_object
 
 API_V = "resource.tpu.google.com/v1beta1"
 
@@ -144,6 +144,119 @@ class TestValidation:
         errs = validate_claim_object(obj)
         assert len(errs) == 2
         assert "config[0]" in errs[0] and "config[1]" in errs[1]
+
+
+class TestVersionConversion:
+    """Explicit v1beta1/v1beta2 → v1 conversion (resource.go:84-152)."""
+
+    def _v1beta1_claim(self, configs):
+        return {
+            "kind": "ResourceClaim",
+            "apiVersion": "resource.k8s.io/v1beta1",
+            "spec": {
+                "devices": {
+                    "requests": [
+                        {
+                            "name": "tpu",
+                            "deviceClassName": "tpu.google.com",
+                            "allocationMode": "ExactCount",
+                            "count": 2,
+                        }
+                    ],
+                    "config": configs,
+                }
+            },
+        }
+
+    def test_v1beta1_flat_request_folds_into_exactly(self):
+        spec = self._v1beta1_claim([])["spec"]
+        out = convert_claim_spec_to_v1(spec, "v1beta1")
+        req = out["devices"]["requests"][0]
+        assert "deviceClassName" not in req
+        assert req["exactly"] == {
+            "deviceClassName": "tpu.google.com",
+            "allocationMode": "ExactCount",
+            "count": 2,
+        }
+        assert req["name"] == "tpu"
+        # The input spec is not mutated.
+        assert "exactly" not in spec["devices"]["requests"][0]
+
+    def test_v1beta1_first_available_passes_through(self):
+        spec = {
+            "devices": {
+                "requests": [
+                    {"name": "a", "firstAvailable": [{"name": "s", "deviceClassName": "x"}]}
+                ]
+            }
+        }
+        out = convert_claim_spec_to_v1(spec, "v1beta1")
+        assert out["devices"]["requests"][0] == spec["devices"]["requests"][0]
+
+    def test_v1_and_v1beta2_identity(self):
+        spec = {"devices": {"requests": [{"name": "a", "exactly": {"deviceClassName": "x"}}]}}
+        assert convert_claim_spec_to_v1(spec, "v1") is spec
+        assert convert_claim_spec_to_v1(spec, "v1beta2") is spec
+
+    def test_unsupported_version_rejected(self):
+        with pytest.raises(ValueError):
+            convert_claim_spec_to_v1({}, "v1alpha3")
+
+    def test_v1beta1_opaque_config_still_validated(self):
+        obj = self._v1beta1_claim(
+            [opaque({"apiVersion": API_V, "kind": "NopeConfig"})]
+        )
+        errs = validate_claim_object(obj)
+        assert errs and "NopeConfig" in errs[0]
+
+    def test_request_resource_version_wins_over_api_version(self):
+        # The API server tells us what version it sent via request.resource
+        # (the reference switches on ar.Request.Resource).
+        obj = self._v1beta1_claim([opaque(GOOD_TPU)])
+        obj["apiVersion"] = "resource.k8s.io/v1"  # lying object
+        errs = validate_claim_object(
+            obj,
+            {"group": "resource.k8s.io", "version": "v1alpha3", "resource": "resourceclaims"},
+        )
+        assert errs and "unsupported resource.k8s.io version" in errs[0]
+
+    def test_config_request_reference_validated_against_converted_spec(self):
+        obj = self._v1beta1_claim([])
+        obj["spec"]["devices"]["requests"][0]["firstAvailable"] = None
+        obj["spec"]["devices"]["config"] = [
+            {"requests": ["tpu"], "opaque": {"driver": TPU_DRIVER_NAME,
+                                            "parameters": GOOD_TPU}},
+        ]
+        assert validate_claim_object(obj) == []
+        obj["spec"]["devices"]["config"][0]["requests"] = ["typo"]
+        errs = validate_claim_object(obj)
+        assert errs and "no request named 'typo'" in errs[0]
+
+    def test_config_subrequest_reference_accepted(self):
+        obj = {
+            "kind": "ResourceClaim",
+            "apiVersion": "resource.k8s.io/v1",
+            "spec": {"devices": {
+                "requests": [{"name": "a", "firstAvailable": [
+                    {"name": "big", "deviceClassName": "tpu.google.com"},
+                    {"name": "small", "deviceClassName": "tpu.google.com"},
+                ]}],
+                "config": [{"requests": ["a/small"], "opaque": {
+                    "driver": TPU_DRIVER_NAME, "parameters": GOOD_TPU}}],
+            }},
+        }
+        assert validate_claim_object(obj) == []
+        obj["spec"]["devices"]["config"][0]["requests"] = ["a/huge"]
+        assert validate_claim_object(obj)
+
+    def test_admission_review_carries_resource_version(self):
+        rev = review(claim([opaque(GOOD_TPU)]))
+        rev["request"]["resource"] = {
+            "group": "resource.k8s.io",
+            "version": "v1beta2",
+            "resource": "resourceclaims",
+        }
+        assert admit_review(rev)["response"]["allowed"] is True
 
 
 class TestAdmissionReview:

@@ -1,0 +1,45 @@
+"""Build-time version stamping (internal/info/version.go analog).
+
+The reference stamps version/commit via -ldflags at `go build` time.  The
+Python analog has three sources, in precedence order:
+
+1. ``TPUDRA_VERSION`` / ``TPUDRA_GIT_COMMIT`` environment variables —
+   dev overrides beat everything;
+2. ``tpudra/_buildstamp.py`` — generated at image build time (see
+   ``deployments/container/Dockerfile``), the ldflags equivalent;
+3. package ``__version__`` with commit "unknown".
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpudra import __version__
+
+
+def _stamped() -> tuple[str, str]:
+    try:
+        from tpudra import _buildstamp  # type: ignore[attr-defined]
+
+        return (
+            getattr(_buildstamp, "VERSION", __version__),
+            getattr(_buildstamp, "GIT_COMMIT", "unknown"),
+        )
+    except ImportError:
+        return __version__, "unknown"
+
+
+def version() -> str:
+    stamped_version, _ = _stamped()
+    return os.environ.get("TPUDRA_VERSION", stamped_version)
+
+
+def git_commit() -> str:
+    _, stamped_commit = _stamped()
+    return os.environ.get("TPUDRA_GIT_COMMIT", stamped_commit)
+
+
+def version_string() -> str:
+    """One-line build identity, logged by every binary at startup
+    (the reference's version metric / -version output)."""
+    return f"tpudra {version()} (commit {git_commit()})"

@@ -8,8 +8,11 @@ native daemon's peer config, then runs three loops until stopped:
 - peer updates: clique membership change → /etc/hosts rewrite → ensure the
   native daemon is started → reload signal (main.go:368-415)
 - watchdog: restart the native daemon on unexpected death
-- readiness: poll the native daemon's status socket and mirror READY /
-  NOT_READY into this daemon's clique entry
+- readiness: an informer on the daemon's own pod mirrors kubelet-probe
+  Ready/NotReady transitions into this daemon's clique entry on the watch
+  event (podmanager.go analog); a status-socket poll bootstraps readiness
+  until the watch has surfaced the pod, then kubelet's verdict is
+  authoritative
 
 ``check`` is the kubelet startup/readiness/liveness probe: query the native
 daemon's status socket and exit 0 iff READY (the ``nvidia-imex-ctl -q``
@@ -29,6 +32,7 @@ from typing import Optional, Sequence
 from tpudra import featuregates
 from tpudra.cddaemon.cdclique import CliqueManager
 from tpudra.cddaemon.dnsnames import DNSNameManager, dns_name
+from tpudra.cddaemon.podmanager import PodManager
 from tpudra.cddaemon.process import ProcessManager
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
@@ -97,6 +101,7 @@ class DaemonApp:
         self.config = config
         self.clique: Optional[CliqueManager] = None
         self.process: Optional[ProcessManager] = None
+        self.pods: Optional[PodManager] = None
         self._dns: Optional[DNSNameManager] = None
         self._started = threading.Event()
 
@@ -176,15 +181,45 @@ class DaemonApp:
         self.process.start_watchdog(stop)
 
         self.clique.watch_peers(self._on_peers_update, stop)
+
+        # Readiness: kubelet's probes (the `check` subcommand) flip the pod
+        # Ready condition; the own-pod informer mirrors those transitions
+        # into the clique entry on the watch event (podmanager.go analog).
+        # Until the watch has surfaced our pod (or without a pod name at
+        # all), a 2 s socket poll carries readiness; after that kubelet's
+        # verdict is authoritative and the poll only retries writes that
+        # could not land (a transient apiserver error must not strand the
+        # clique entry on a stale state until the *next* transition).
+        status_lock = threading.Lock()
+        desired: list[Optional[bool]] = [None]
+        written: list[Optional[bool]] = [None]
+
+        def flush() -> None:
+            with status_lock:
+                want = desired[0]
+                if want is None or want == written[0]:
+                    return
+                try:
+                    ok = self.clique.update_daemon_status(want)
+                except Exception:  # noqa: BLE001 — keep the transition pending
+                    logger.exception("daemon status write failed; will retry")
+                    ok = False
+                if ok:
+                    written[0] = want
+
+        def on_pod_ready(ready: bool) -> None:
+            desired[0] = ready
+            flush()
+
+        if cfg.pod_name:
+            self.pods = PodManager(self._kube, cfg.namespace, cfg.pod_name, on_pod_ready)
+            self.pods.start(stop)
         self._started.set()
 
-        # Readiness loop: mirror the native daemon's state into the clique.
-        last_ready: Optional[bool] = None
         while not stop.is_set():
-            ready = self.is_ready()
-            if ready != last_ready:
-                self.clique.update_daemon_status(ready)
-                last_ready = ready
+            if self.pods is None or not self.pods.seen_pod:
+                desired[0] = self.is_ready()
+            flush()
             stop.wait(2.0)
         self.process.stop()
 
@@ -209,8 +244,7 @@ class DaemonApp:
         last_ready: Optional[bool] = None
         while not stop.is_set():
             ready = self.is_ready()  # no clique → unconditionally True
-            if ready != last_ready:
-                self.clique.update_daemon_status(ready)
+            if ready != last_ready and self.clique.update_daemon_status(ready):
                 last_ready = ready
             stop.wait(2.0)
 

@@ -120,14 +120,17 @@ class CliqueManager:
         except AlreadyExists:
             return self._kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, self.name, self._ns)
 
-    def update_daemon_status(self, ready: bool) -> None:
-        """Flip this daemon's entry (updateDaemonStatus, cdclique.go:429)."""
+    def update_daemon_status(self, ready: bool) -> bool:
+        """Flip this daemon's entry (updateDaemonStatus, cdclique.go:429).
+        Returns True when the target state is in place (or there is nothing
+        to write), False when the write could not land — callers keep the
+        transition pending and retry."""
         target = COMPUTE_DOMAIN_STATUS_READY if ready else COMPUTE_DOMAIN_STATUS_NOT_READY
         for _ in range(MAX_UPSERT_RETRIES):
             try:
                 clique = self._kube.get(gvr.COMPUTE_DOMAIN_CLIQUES, self.name, self._ns)
             except NotFound:
-                return
+                return True
             mine = next(
                 (
                     d
@@ -137,14 +140,15 @@ class CliqueManager:
                 None,
             )
             if mine is None or mine.get("status") == target:
-                return
+                return True
             mine["status"] = target
             try:
                 self._kube.update_status(gvr.COMPUTE_DOMAIN_CLIQUES, clique, self._ns)
-                return
+                return True
             except Conflict:
                 continue
         logger.warning("could not update daemon status in clique %s", self.name)
+        return False
 
     def leave(self) -> None:
         """Remove this daemon's entry on clean shutdown."""

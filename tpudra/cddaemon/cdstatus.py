@@ -109,13 +109,15 @@ class DirectStatusManager:
             return self.index
         raise RuntimeError(f"could not join {self.name}: persistent conflicts")
 
-    def update_daemon_status(self, ready: bool) -> None:
+    def update_daemon_status(self, ready: bool) -> bool:
+        """Same success contract as CliqueManager.update_daemon_status:
+        True = converged / nothing to write, False = write pending."""
         target = COMPUTE_DOMAIN_STATUS_READY if ready else COMPUTE_DOMAIN_STATUS_NOT_READY
         for _ in range(MAX_UPSERT_RETRIES):
             try:
                 cd = self._get_cd()
             except NotFound:
-                return
+                return True
             mine = next(
                 (
                     n
@@ -125,14 +127,15 @@ class DirectStatusManager:
                 None,
             )
             if mine is None or mine.get("status") == target:
-                return
+                return True
             mine["status"] = target
             try:
                 self._kube.update_status(gvr.COMPUTE_DOMAINS, cd, self._cd_ns)
-                return
+                return True
             except Conflict:
                 continue
         logger.warning("could not update node status in %s", self.name)
+        return False
 
     def leave(self) -> None:
         for _ in range(MAX_UPSERT_RETRIES):

@@ -1,0 +1,81 @@
+"""Own-pod readiness informer.
+
+The analog of compute-domain-daemon/podmanager.go:45-149: an informer on the
+daemon's own pod pushes kubelet-probe readiness transitions into the clique
+status, so a Ready/NotReady flip propagates on the watch event instead of a
+poll tick.  The kubelet's probes (the ``check`` subcommand querying the
+native daemon's status socket) are what flip the pod condition; this mirrors
+kubelet's verdict back into the ComputeDomainClique daemon entry
+(cdclique.go:429).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
+from tpudra.kube.informer import Informer
+
+logger = logging.getLogger(__name__)
+
+
+def pod_is_ready(pod: dict) -> bool:
+    for cond in pod.get("status", {}).get("conditions", []):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+class PodManager:
+    """Watches this daemon's own pod and reports Ready transitions."""
+
+    def __init__(
+        self,
+        kube: KubeAPI,
+        namespace: str,
+        pod_name: str,
+        on_ready_change: Callable[[bool], None],
+    ):
+        self._pod_name = pod_name
+        self._on_ready_change = on_ready_change
+        # Field-selected to our own pod (the reference podmanager.go does
+        # the same): N daemons in a shared namespace must not each cache and
+        # process every pod event in it.
+        self._informer = Informer(
+            kube,
+            gvr.PODS,
+            namespace=namespace,
+            field_selector=f"metadata.name={pod_name}",
+        )
+        self._informer.add_handler(self._on_event)
+        self._last_ready: Optional[bool] = None
+        self._seen = threading.Event()
+
+    def start(self, stop: threading.Event) -> None:
+        self._informer.start(stop)
+
+    @property
+    def seen_pod(self) -> bool:
+        """Whether the watch has ever surfaced our pod.  Until it does (e.g.
+        the pod object is not visible yet), the caller keeps the socket-poll
+        fallback fast; after that, events drive readiness."""
+        return self._seen.is_set()
+
+    def _on_event(self, etype: str, obj: dict) -> None:
+        if obj.get("metadata", {}).get("name") != self._pod_name:
+            return
+        self._seen.set()
+        if etype == "DELETED":
+            return
+        ready = pod_is_ready(obj)
+        if ready == self._last_ready:
+            return
+        self._last_ready = ready
+        logger.info("own pod %s readiness -> %s", self._pod_name, ready)
+        try:
+            self._on_ready_change(ready)
+        except Exception:  # noqa: BLE001 — a failed status write must not kill the watch
+            logger.exception("pod readiness callback failed")

@@ -126,12 +126,25 @@ class ComputeDomainDeviceState:
                 return
             if isinstance(config, ComputeDomainChannelConfig):
                 self._assert_channels_free(cp, uid, results, config)
+            # Record the claim's intent (domain + config kind) before any
+            # side effect, so a crash mid-prepare leaves enough in the
+            # checkpoint for the PrepareStarted rollback branch in
+            # unprepare (the TPU plugin's unpreparePartiallyPrepared
+            # discipline, device_state.go:482).
+            intent = {
+                "domainUID": getattr(config, "domain_id", ""),
+                "configType": (
+                    "channel"
+                    if isinstance(config, ComputeDomainChannelConfig)
+                    else "daemon"
+                ),
+            }
             cp.prepared_claims[uid] = PreparedClaim(
                 uid=uid,
                 namespace=namespace,
                 name=name,
                 status=PREPARE_STARTED,
-                groups=[],
+                groups=[PreparedDeviceGroup(devices=[], config_state=intent)],
             )
 
         self._cp.mutate(start)
@@ -189,6 +202,23 @@ class ComputeDomainDeviceState:
                 return
             domain_uid = ""
             kinds = set()
+            if claim.status == PREPARE_STARTED:
+                # Rollback branch for a partially prepared claim: the side
+                # effects that can exist before PrepareCompleted are the node
+                # label (channel path) and the per-domain settings dir
+                # (daemon path); devices were never recorded, so read the
+                # intent stamped at PrepareStarted.
+                for g in claim.groups:
+                    domain_uid = g.config_state.get("domainUID", domain_uid)
+                    ctype = g.config_state.get("configType", "")
+                    if ctype == "channel":
+                        kinds.add(alloc.TYPE_CHANNEL)
+                    elif ctype == "daemon":
+                        kinds.add(alloc.TYPE_DAEMON)
+                logger.info(
+                    "rolling back partially prepared CD claim %s (domain %s)",
+                    claim_uid, domain_uid or "<unknown>",
+                )
             for dev in claim.all_devices():
                 domain_uid = dev.attributes.get("domainUID", domain_uid)
                 kinds.add(dev.type)
@@ -197,10 +227,22 @@ class ComputeDomainDeviceState:
             if alloc.TYPE_DAEMON in kinds:
                 self._cdm.cleanup_daemon_settings(domain_uid)
             if alloc.TYPE_CHANNEL in kinds:
+                # The node label is owned by the *channel* path
+                # (_apply_channel_config is the only place that sets it), so
+                # only channel claims — completed ones via their devices,
+                # in-flight ones via their intent stamp — keep it alive.
+                # Counting daemon claims here would leak the label: the
+                # daemon unprepare path never removes it.
                 still_used = any(
-                    d.attributes.get("domainUID") == domain_uid
+                    d.type == alloc.TYPE_CHANNEL
+                    and d.attributes.get("domainUID") == domain_uid
                     for other in cp.prepared_claims.values()
                     for d in other.all_devices()
+                ) or any(
+                    g.config_state.get("configType") == "channel"
+                    and g.config_state.get("domainUID") == domain_uid
+                    for other in cp.prepared_claims.values()
+                    for g in other.groups
                 )
                 if not still_used:
                     try:

@@ -23,7 +23,7 @@ from tpudra.api.computedomain import (
     COMPUTE_DOMAIN_STATUS_READY,
 )
 from tpudra import featuregates
-from tpudra.controller.daemonset import DaemonSetManager
+from tpudra.controller.daemonset import MultiNamespaceDaemonSetManager
 from tpudra.controller.node import NodeManager
 from tpudra.controller.resourceclaimtemplate import (
     CD_UID_LABEL,
@@ -50,11 +50,17 @@ class ComputeDomainManager:
         driver_namespace: str,
         image: str = "tpudra:latest",
         max_nodes_per_domain: int = 0,
+        additional_namespaces: tuple[str, ...] = (),
     ):
         self._kube = kube
         self._ns = driver_namespace
         self._max_nodes = max_nodes_per_domain
-        self.daemonsets = DaemonSetManager(kube, driver_namespace, image=image)
+        self.daemonsets = MultiNamespaceDaemonSetManager(
+            kube,
+            driver_namespace,
+            additional_namespaces=additional_namespaces,
+            image=image,
+        )
         self.daemon_rcts = DaemonResourceClaimTemplateManager(kube, driver_namespace)
         self.workload_rcts = WorkloadResourceClaimTemplateManager(kube)
         self.nodes = NodeManager(kube, self.cd_exists)

@@ -35,6 +35,7 @@ class ManagerConfig:
     image: str = "tpudra:latest"
     max_nodes_per_domain: int = 0
     resync_period: float = 600.0
+    additional_namespaces: tuple[str, ...] = ()
 
 
 class Controller:
@@ -46,6 +47,7 @@ class Controller:
             self._config.driver_namespace,
             image=self._config.image,
             max_nodes_per_domain=self._config.max_nodes_per_domain,
+            additional_namespaces=self._config.additional_namespaces,
         )
         self.queue = WorkQueue(
             rate_limiter=default_controller_rate_limiter(), name="controller"
@@ -57,10 +59,12 @@ class Controller:
         # Existence checks + clique aggregation read through these caches
         # once synced (kills the per-reconcile full LISTs).
         self.manager.use_informers(self._cd_informer, self._clique_informer)
+        # Orphan GC sweeps every managed namespace (the driver namespace
+        # plus --additional-namespaces, mnsdaemonset.go semantics).
         self._cleanups = [
-            CleanupManager(
-                kube, gvr.DAEMONSETS, self._config.driver_namespace, self.manager.cd_exists
-            ),
+            CleanupManager(kube, gvr.DAEMONSETS, ns, self.manager.cd_exists)
+            for ns in self.manager.daemonsets.namespaces
+        ] + [
             CleanupManager(
                 kube,
                 gvr.RESOURCE_CLAIM_TEMPLATES,

@@ -109,6 +109,12 @@ class DaemonSetManager:
             return self._kube.update(gvr.DAEMONSETS, live, self._ns)
         return live
 
+    def get(self, cd_uid: str) -> dict | None:
+        try:
+            return self._kube.get(gvr.DAEMONSETS, self.name(cd_uid), self._ns)
+        except NotFound:
+            return None
+
     def remove(self, cd_uid: str) -> None:
         try:
             self._kube.delete(gvr.DAEMONSETS, self.name(cd_uid), self._ns)
@@ -126,3 +132,70 @@ class DaemonSetManager:
         return self._kube.list(
             gvr.DAEMONSETS, self._ns, label_selector=CD_UID_LABEL
         ).get("items", [])
+
+
+class MultiNamespaceDaemonSetManager:
+    """DaemonSet management across the driver namespace plus any
+    ``--additional-namespaces`` (mnsdaemonset.go analog).
+
+    Why this exists: after a driver upgrade that moved the deployment
+    namespace, per-CD DaemonSets may still live in the old namespace.  New
+    DaemonSets always go to the driver namespace, but an existing one found
+    in any managed namespace is reconciled where it is, and teardown/GC
+    sweep every managed namespace.
+    """
+
+    def __init__(
+        self,
+        kube: KubeAPI,
+        driver_namespace: str,
+        additional_namespaces: tuple[str, ...] = (),
+        image: str = "tpudra:latest",
+        template_path: str = DEFAULT_TEMPLATE_PATH,
+        log_verbosity: int = 0,
+    ):
+        self._driver_ns = driver_namespace
+        # Dedup while keeping the driver namespace first (create target).
+        namespaces = dict.fromkeys((driver_namespace, *additional_namespaces))
+        self._managers = {
+            ns: DaemonSetManager(
+                kube,
+                ns,
+                image=image,
+                template_path=template_path,
+                log_verbosity=log_verbosity,
+            )
+            for ns in namespaces
+        }
+        # Home-namespace cache: a legacy DaemonSet only *pre*-exists (this
+        # controller always creates in the driver namespace), so once a CD's
+        # home is resolved it never changes until teardown — the additional-
+        # namespace probes are paid once per CD, not once per reconcile.
+        self._home_ns: dict[str, str] = {}
+
+    @property
+    def namespaces(self) -> list[str]:
+        return list(self._managers)
+
+    def ensure(self, cd: dict, daemon_rct_name: str) -> dict:
+        uid = cd["metadata"]["uid"]
+        home = self._home_ns.get(uid)
+        if home is None:
+            home = self._driver_ns
+            for ns, mgr in self._managers.items():
+                if ns != self._driver_ns and mgr.get(uid) is not None:
+                    home = ns
+                    break
+            self._home_ns[uid] = home
+        return self._managers[home].ensure(cd, daemon_rct_name)
+
+    def remove(self, cd_uid: str) -> None:
+        self._home_ns.pop(cd_uid, None)
+        for mgr in self._managers.values():
+            mgr.remove(cd_uid)
+
+    def assert_removed(self, cd_uid: str) -> bool:
+        return all(mgr.assert_removed(cd_uid) for mgr in self._managers.values())
+
+    def list_all(self) -> list[dict]:
+        return [ds for mgr in self._managers.values() for ds in mgr.list_all()]

@@ -23,6 +23,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="refuse CDs larger than this (0 = unlimited) [MAX_NODES_PER_DOMAIN]",
     )
     p.add_argument(
+        "--additional-namespaces",
+        default=env_default("ADDITIONAL_NAMESPACES", ""),
+        help="comma-separated extra namespaces where per-CD DaemonSets may "
+        "live and are swept (reference --additional-namespaces) "
+        "[ADDITIONAL_NAMESPACES]",
+    )
+    p.add_argument(
         "--http-endpoint",
         default=env_default("HTTP_ENDPOINT", ""),
         help="opt-in host:port serving /metrics, /debug/stacks and /healthz "
@@ -44,6 +51,9 @@ def main(argv=None) -> int:
             driver_namespace=args.namespace,
             image=args.image,
             max_nodes_per_domain=args.max_nodes_per_domain,
+            additional_namespaces=tuple(
+                ns.strip() for ns in args.additional_namespaces.split(",") if ns.strip()
+            ),
         ),
     )
     debug = None

@@ -38,6 +38,11 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         default=env_default("LOG_LEVEL", "INFO"),
         help="python logging level name [LOG_LEVEL]",
     )
+    from tpudra import buildinfo
+
+    parser.add_argument(
+        "--version", action="version", version=buildinfo.version_string()
+    )
 
 
 def setup_common(args: argparse.Namespace) -> None:
@@ -58,6 +63,9 @@ def setup_common(args: argparse.Namespace) -> None:
 
 def log_startup_config(args: argparse.Namespace) -> None:
     """Structured startup-config dump (pkg/flags LogStartupConfig analog)."""
+    from tpudra import buildinfo
+
+    logger.info("%s", buildinfo.version_string())
     logger.info(
         "startup config: %s",
         " ".join(f"{k}={v!r}" for k, v in sorted(vars(args).items()) if k != "func"),

@@ -56,6 +56,7 @@ class KubeAPI(Protocol):
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
         label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
         stop: Optional[threading.Event] = None,
     ) -> Iterator[dict]: ...
 
@@ -208,6 +209,7 @@ class KubeClient:
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
         label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
         stop: Optional[threading.Event] = None,
     ) -> Iterator[dict]:
         resp = self._request(
@@ -217,6 +219,7 @@ class KubeClient:
                 "watch": "true",
                 "resourceVersion": resource_version,
                 "labelSelector": label_selector,
+                "fieldSelector": field_selector,
             },
             stream=True,
             timeout=3600.0,

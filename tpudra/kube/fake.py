@@ -82,10 +82,17 @@ def match_field_selector(selector: str | None, obj: dict) -> bool:
 
 
 class _Watcher:
-    def __init__(self, gvr_key: str, namespace: Optional[str], label_selector: Optional[str]):
+    def __init__(
+        self,
+        gvr_key: str,
+        namespace: Optional[str],
+        label_selector: Optional[str],
+        field_selector: Optional[str] = None,
+    ):
         self.gvr_key = gvr_key
         self.namespace = namespace
         self.label_selector = label_selector
+        self.field_selector = field_selector
         self.queue: queue.Queue = queue.Queue()
         self.stopped = threading.Event()
 
@@ -143,6 +150,8 @@ class FakeKube:
             if w.namespace and meta.get("namespace") != w.namespace:
                 continue
             if not match_label_selector(w.label_selector, meta.get("labels", {})):
+                continue
+            if not match_field_selector(w.field_selector, obj):
                 continue
             w.queue.put(copy.deepcopy(event))
 
@@ -345,6 +354,7 @@ class FakeKube:
         namespace: Optional[str] = None,
         resource_version: Optional[str] = None,
         label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
         stop: Optional[threading.Event] = None,
     ) -> Iterator[dict]:
         """Yield {"type": ..., "object": ...} events.
@@ -353,7 +363,12 @@ class FakeKube:
         (k8s watch resume), then streams live events.  Terminates when
         ``stop`` is set.
         """
-        watcher = _Watcher(self._key(gvr), namespace if gvr.namespaced else None, label_selector)
+        watcher = _Watcher(
+            self._key(gvr),
+            namespace if gvr.namespaced else None,
+            label_selector,
+            field_selector,
+        )
         with self._lock:
             backlog = []
             if resource_version is not None:
@@ -365,6 +380,8 @@ class FakeKube:
                     if watcher.namespace and meta.get("namespace") != watcher.namespace:
                         continue
                     if not match_label_selector(label_selector, meta.get("labels", {})):
+                        continue
+                    if not match_field_selector(field_selector, event["object"]):
                         continue
                     backlog.append(copy.deepcopy(event))
             self._watchers.append(watcher)

@@ -128,6 +128,7 @@ class _Handler(BaseHTTPRequestHandler):
                 namespace,
                 resource_version=query.get("resourceVersion"),
                 label_selector=query.get("labelSelector"),
+                field_selector=query.get("fieldSelector"),
                 stop=stop,
             ):
                 write_chunk(json.dumps(event).encode() + b"\n")

@@ -38,12 +38,14 @@ class Informer:
         gvr: GVR,
         namespace: Optional[str] = None,
         label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
         resync_period: float = 0.0,
     ):
         self._api = api
         self._gvr = gvr
         self._namespace = namespace
         self._label_selector = label_selector
+        self._field_selector = field_selector
         self._resync_period = resync_period
         self._store: dict[tuple, dict] = {}
         self._lock = threading.Lock()
@@ -106,7 +108,10 @@ class Informer:
 
     def _list_and_watch(self, stop: threading.Event) -> None:
         listing = self._api.list(
-            self._gvr, self._namespace, label_selector=self._label_selector
+            self._gvr,
+            self._namespace,
+            label_selector=self._label_selector,
+            field_selector=self._field_selector,
         )
         rv = listing.get("metadata", {}).get("resourceVersion")
         fresh = {obj_key(o): o for o in listing.get("items", [])}
@@ -130,6 +135,7 @@ class Informer:
             self._namespace,
             resource_version=rv,
             label_selector=self._label_selector,
+            field_selector=self._field_selector,
             stop=stop,
         ):
             if stop.is_set():

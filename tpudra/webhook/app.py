@@ -4,8 +4,11 @@ The analog of cmd/webhook/main.go:115-292 and resource.go:34-152:
 
 - ``/validate-resource-claim-parameters`` receives an AdmissionReview for a
   ResourceClaim or ResourceClaimTemplate (resource.k8s.io v1 / v1beta1 /
-  v1beta2 — older versions are shape-compatible for the fields we touch, the
-  conversion the reference does explicitly)
+  v1beta2). Like the reference (resource.go:84-152, via the k8s conversion
+  scheme) the object is explicitly converted to the v1 shape before
+  validation: v1beta1's flat DeviceRequest fields are folded into the
+  ``exactly`` nesting v1beta2/v1 use; unknown versions are denied rather
+  than validated on a guessed shape.
 - every opaque config entry addressed to one of our two drivers is
   strict-decoded, normalized, and validated; unknown fields, wrong kinds and
   semantic errors all become a deny with a precise message
@@ -30,30 +33,117 @@ OUR_DRIVERS = (TPU_DRIVER_NAME, COMPUTE_DOMAIN_DRIVER_NAME)
 WEBHOOK_PATH = "/validate-resource-claim-parameters"
 
 
-def _claim_spec_from_object(obj: dict) -> tuple[Optional[dict], str]:
-    """Extract the ResourceClaimSpec from a claim or template
+SUPPORTED_VERSIONS = ("v1", "v1beta1", "v1beta2")
+
+# ExactDeviceRequest fields that v1beta1 carried flat on DeviceRequest
+# (k8s.io/api/resource/v1beta1/types.go DeviceRequest vs v1 ExactDeviceRequest).
+_EXACT_REQUEST_FIELDS = (
+    "deviceClassName",
+    "selectors",
+    "allocationMode",
+    "count",
+    "adminAccess",
+    "tolerations",
+    "capacity",
+)
+
+
+def convert_claim_spec_to_v1(spec: dict, version: str) -> dict:
+    """Convert a ResourceClaimSpec from the given resource.k8s.io version to
+    the v1 shape (the reference does this through the k8s conversion scheme,
+    resource.go:108-115).
+
+    v1 and v1beta2 share the DeviceRequest shape (name + exactly |
+    firstAvailable). v1beta1 carried the exact-request fields flat on the
+    request; fold them under ``exactly``. Raises ValueError on an
+    unsupported version.
+    """
+    if version in ("v1", "v1beta2"):
+        return spec
+    if version != "v1beta1":
+        raise ValueError(f"unsupported resource.k8s.io version {version!r}")
+    out = dict(spec)
+    devices = dict(spec.get("devices") or {})
+    requests = []
+    for req in devices.get("requests") or []:
+        if not isinstance(req, dict) or "firstAvailable" in req or "exactly" in req:
+            # Prioritized-list requests are already v1-shaped; a request that
+            # somehow carries "exactly" is already converted.
+            requests.append(req)
+            continue
+        exact = {k: req[k] for k in _EXACT_REQUEST_FIELDS if k in req}
+        converted = {k: v for k, v in req.items() if k not in _EXACT_REQUEST_FIELDS}
+        converted["exactly"] = exact
+        requests.append(converted)
+    if requests:
+        devices["requests"] = requests
+    if devices:
+        out["devices"] = devices
+    return out
+
+
+def _claim_spec_from_object(obj: dict, version: str) -> tuple[Optional[dict], str]:
+    """Extract the v1-converted ResourceClaimSpec from a claim or template
     (resource.go:84-152); returns (spec, kind)."""
     kind = obj.get("kind", "")
     if kind == "ResourceClaim":
-        return obj.get("spec", {}), kind
-    if kind == "ResourceClaimTemplate":
-        return obj.get("spec", {}).get("spec", {}), kind
-    return None, kind
+        spec = obj.get("spec", {})
+    elif kind == "ResourceClaimTemplate":
+        spec = obj.get("spec", {}).get("spec", {})
+    else:
+        return None, kind
+    return convert_claim_spec_to_v1(spec, version), kind
 
 
-def validate_claim_object(obj: dict) -> list[str]:
+def _version_for_object(obj: dict, resource: Optional[dict]) -> str:
+    """The resource.k8s.io version to convert from: the AdmissionReview's
+    request.resource wins (what the API server actually sent, the
+    reference's switch on ar.Request.Resource), falling back to the
+    object's own apiVersion."""
+    if resource and resource.get("group") == "resource.k8s.io":
+        return resource.get("version", "")
+    api_version = obj.get("apiVersion", "")
+    if "/" in api_version:
+        group, _, version = api_version.partition("/")
+        if group == "resource.k8s.io":
+            return version
+    return "v1"
+
+
+def validate_claim_object(obj: dict, resource: Optional[dict] = None) -> list[str]:
     """All validation errors for one claim/template object (empty = admit)."""
-    spec, kind = _claim_spec_from_object(obj)
+    version = _version_for_object(obj, resource)
+    if version not in SUPPORTED_VERSIONS:
+        return [f"unsupported resource.k8s.io version {version!r}"]
+    spec, kind = _claim_spec_from_object(obj, version)
     if spec is None:
         return [f"unsupported object kind {kind!r}"]
     errors: list[str] = []
     entries = spec.get("devices", {}).get("config", [])
+    # Request names addressable from config entries, read from the
+    # *converted* v1 shape (this is why conversion runs first: the checks
+    # below are written against one spec shape only).  A prioritized-list
+    # subrequest is addressed as "request/subrequest"; naming the parent
+    # request alone also matches.
+    known_requests: set[str] = set()
+    for req in spec.get("devices", {}).get("requests") or []:
+        rname = req.get("name", "")
+        known_requests.add(rname)
+        for sub in req.get("firstAvailable") or []:
+            known_requests.add(f"{rname}/{sub.get('name', '')}")
     for i, entry in enumerate(entries):
         opaque = entry.get("opaque")
         if not opaque:
             continue
         if opaque.get("driver") not in OUR_DRIVERS:
             continue
+        for rname in entry.get("requests") or []:
+            if rname not in known_requests:
+                errors.append(
+                    f"spec.devices.config[{i}].requests: no request named "
+                    f"{rname!r} in this claim (have: "
+                    f"{sorted(known_requests) or 'none'})"
+                )
         path = f"spec.devices.config[{i}].opaque.parameters"
         params = opaque.get("parameters") or {}
         if not isinstance(params, dict):
@@ -76,7 +166,7 @@ def admit_review(review: dict) -> dict:
     request = review.get("request") or {}
     uid = request.get("uid", "")
     obj = request.get("object") or {}
-    errors = validate_claim_object(obj)
+    errors = validate_claim_object(obj, request.get("resource"))
     response: dict = {"uid": uid, "allowed": not errors}
     if errors:
         response["status"] = {
