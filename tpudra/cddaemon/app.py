@@ -190,7 +190,7 @@ class DaemonApp:
         # verdict is authoritative and the poll only retries writes that
         # could not land (a transient apiserver error must not strand the
         # clique entry on a stale state until the *next* transition).
-        status_lock = threading.Lock()
+        status_lock = threading.RLock()
         desired: list[Optional[bool]] = [None]
         written: list[Optional[bool]] = [None]
 
@@ -208,7 +208,8 @@ class DaemonApp:
                     written[0] = want
 
         def on_pod_ready(ready: bool) -> None:
-            desired[0] = ready
+            with status_lock:
+                desired[0] = ready
             flush()
 
         if cfg.pod_name:
@@ -218,7 +219,14 @@ class DaemonApp:
 
         while not stop.is_set():
             if self.pods is None or not self.pods.seen_pod:
-                desired[0] = self.is_ready()
+                ready = self.is_ready()  # socket I/O outside the lock
+                with status_lock:
+                    # Re-check under the lock: the informer may have surfaced
+                    # the pod while we were blocked on the socket, and its
+                    # (kubelet-authoritative) verdict must not be overwritten
+                    # by a stale poll result.
+                    if self.pods is None or not self.pods.seen_pod:
+                        desired[0] = ready
             flush()
             stop.wait(2.0)
         self.process.stop()
@@ -244,8 +252,12 @@ class DaemonApp:
         last_ready: Optional[bool] = None
         while not stop.is_set():
             ready = self.is_ready()  # no clique → unconditionally True
-            if ready != last_ready and self.clique.update_daemon_status(ready):
-                last_ready = ready
+            if ready != last_ready:
+                try:
+                    if self.clique.update_daemon_status(ready):
+                        last_ready = ready
+                except Exception:  # noqa: BLE001 — transient API error: retry next tick
+                    logger.exception("direct status write failed; will retry")
             stop.wait(2.0)
 
     def wait_started(self, timeout: float = 30.0) -> bool:

