@@ -611,6 +611,140 @@ class TestPodManagerReadiness:
             stub.close()
 
 
+class TestBatsParityCD:
+    """Hermetic analogs of the reference's CD bats behaviors the suite did
+    not yet mirror (test_cd_misc.bats, test_cd_imex_chan_inject.bats,
+    test_cd_logging.bats)."""
+
+    def _ready_cd(self, kube, tmp_path):
+        """CD + driver with node-a Ready in cd.status (prepare passes)."""
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube, num_nodes=1)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+        clique = CliqueManager(kube, NS, uid, "s1.0", "node-a", "10.0.0.1")
+        clique.join()
+        clique.update_daemon_status(True)
+        # Controller aggregation: cliques → cd.status.nodes (the readiness
+        # gate reads the aggregated status, not the clique CR).
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.sync_status(kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns"))
+        return cd, uid, drv
+
+    def test_channel_injection_single_mode(self, tmp_path):
+        """test_cd_imex_chan_inject.bats:17 — Single grants exactly the
+        allocated channel's device node."""
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        resp = drv.prepare_resource_claims([_channel_claim("wl-s", uid, "channel-5")])
+        assert resp["claims"]["wl-s"].get("devices"), resp
+        spec = drv.state._cdi.read_claim_spec("wl-s")
+        nodes = spec["containerEdits"]["deviceNodes"]
+        assert len(nodes) == 1 and nodes[0]["path"].endswith("channel5")
+        env = spec["containerEdits"]["env"]
+        assert "TPUDRA_DOMAIN_CHANNELS=5" in env
+
+    def test_channel_injection_all_mode(self, tmp_path):
+        """test_cd_imex_chan_inject.bats:24 — All grants the domain's whole
+        channel space (2048 device nodes)."""
+        from tpudra.cdplugin import CHANNEL_COUNT
+
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        claim = _channel_claim("wl-a", uid, "channel-0")
+        claim["status"]["allocation"]["devices"]["config"][0]["opaque"][
+            "parameters"
+        ]["allocationMode"] = "All"
+        resp = drv.prepare_resource_claims([claim])
+        assert resp["claims"]["wl-a"].get("devices"), resp
+        spec = drv.state._cdi.read_claim_spec("wl-a")
+        assert len(spec["containerEdits"]["deviceNodes"]) == CHANNEL_COUNT
+
+    def test_bad_opaque_config_is_permanent_error(self, tmp_path):
+        """test_cd_misc.bats:99 — an unknown field in the opaque config is a
+        strict-decode failure, surfaced as a *permanent* (non-retryable)
+        prepare error."""
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        claim = _channel_claim("wl-bad", uid)
+        claim["status"]["allocation"]["devices"]["config"][0]["opaque"][
+            "parameters"
+        ]["unexpectedField"] = 1
+        resp = drv.prepare_resource_claims([claim])
+        result = resp["claims"]["wl-bad"]
+        assert "error" in result and result["permanent"] is True
+        assert "unexpectedField" in result["error"]
+
+    def test_stale_started_claim_gc(self, tmp_path):
+        """test_cd_misc.bats:144 — a PrepareStarted claim is left alone while
+        its ResourceClaim exists, unprepared (with rollback) once the RC is
+        gone, and a later kubelet unprepare is a no-op."""
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+
+        claim = _channel_claim("wl-stale", uid)
+        rc = {
+            "metadata": {"uid": "wl-stale", "name": "wl-stale", "namespace": "user-ns"},
+            "spec": {},
+        }
+        kube.create(gvr.RESOURCE_CLAIMS, rc, "user-ns")
+        resp = drv.prepare_resource_claims([claim])
+        assert "error" in resp["claims"]["wl-stale"]  # gated → PrepareStarted
+        node = kube.get(gvr.NODES, "node-a")
+        assert node["metadata"]["labels"][COMPUTE_DOMAIN_NODE_LABEL] == uid
+
+        # RC still exists: not stale, claim stays checkpointed.
+        assert drv.cleanup.cleanup_once() == 0
+        assert "wl-stale" in drv.state.prepared_claim_uids()
+
+        # RC deleted: the GC unprepares and rolls back the node label.
+        kube.delete(gvr.RESOURCE_CLAIMS, "wl-stale", "user-ns")
+        assert drv.cleanup.cleanup_once() == 1
+        assert "wl-stale" not in drv.state.prepared_claim_uids()
+        node = kube.get(gvr.NODES, "node-a")
+        assert COMPUTE_DOMAIN_NODE_LABEL not in node["metadata"].get("labels", {})
+
+        # The late kubelet unprepare is a harmless no-op.
+        resp = drv.unprepare_resource_claims([{"uid": "wl-stale"}])
+        assert resp["claims"]["wl-stale"] == {}
+
+    def test_daemon_leave_cleans_cd_status(self, tmp_path):
+        """test_cd_misc.bats:47 — after the daemon leaves the clique, the
+        controller's status sync drops the node from cd.status."""
+        kube = FakeKube()
+        cd = mk_cd(kube, num_nodes=1)
+        uid = cd["metadata"]["uid"]
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.reconcile("user-ns", "cd1")
+
+        clique = CliqueManager(kube, NS, uid, "s1.0", "node-a", "10.0.0.1")
+        clique.join()
+        clique.update_daemon_status(True)
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        c.manager.sync_status(cd)
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert [n["name"] for n in cd["status"]["nodes"]] == ["node-a"]
+
+        clique.leave()
+        c.manager.sync_status(cd)
+        cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+        assert cd["status"].get("nodes", []) == []
+
+    def test_log_verbosity_propagates_into_daemonset(self):
+        """test_cd_logging.bats:107 — the controller's verbosity flows into
+        the rendered per-CD DaemonSet env (daemonset.go:45-56 analog)."""
+        from tpudra.controller.daemonset import DaemonSetManager
+
+        kube = FakeKube()
+        cd = mk_cd(kube)
+        ds = DaemonSetManager(kube, NS, log_verbosity=5).render(cd, "rct")
+        env = ds["spec"]["template"]["spec"]["containers"][0]["env"]
+        assert {"name": "LOG_VERBOSITY", "value": "5"} in env
+
+
 # -- full lifecycle (§3.3) ---------------------------------------------------
 
 

@@ -190,7 +190,7 @@ class DaemonApp:
         # verdict is authoritative and the poll only retries writes that
         # could not land (a transient apiserver error must not strand the
         # clique entry on a stale state until the *next* transition).
-        status_lock = threading.RLock()
+        status_lock = threading.Lock()
         desired: list[Optional[bool]] = [None]
         written: list[Optional[bool]] = [None]
 

@@ -73,15 +73,19 @@ def init_params(rng, cfg: ModelConfig):
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
 
     L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    H, hd = cfg.n_heads, cfg.head_dim
     ks = jax.random.split(k_layers, 6)
     s = D ** -0.5
     return {
         "embed": dense(k_emb, (cfg.vocab, D), 0.02),
         "pos": dense(k_out, (cfg.max_seq, D), 0.02),
         # Stacked per-layer params, leading axis = layer (scan carries it).
+        # Attention weights keep an explicit head axis — the tp sharding
+        # lives on H, so the in-layer reshapes only ever split *unsharded*
+        # axes and GSPMD propagation never has to reshard a weight.
         "layers": {
-            "wqkv": dense(ks[0], (L, D, 3 * D), s),
-            "wo": dense(ks[1], (L, D, D), s),
+            "wqkv": dense(ks[0], (L, D, H, 3 * hd), s),
+            "wo": dense(ks[1], (L, H, hd, D), s),
             "w1": dense(ks[2], (L, D, F), s),
             "w2": dense(ks[3], (L, F, D), F ** -0.5),
             "ln1": jnp.ones((L, D), jnp.float32),
@@ -115,8 +119,10 @@ def _layer(cfg: ModelConfig, x, layer_params):
     p = layer_params
 
     h = _rmsnorm(x, p["ln1"])
-    wqkv = p["wqkv"].astype(jnp.bfloat16).reshape(D, 3, H, hd)
-    qkv = jnp.einsum("bsd,dthe->tbhse", h, wqkv)
+    # [D, H, 3hd] → [D, H, 3, hd]: splits only the unsharded minor axis
+    # (tp shards H), so the reshape is GSPMD-transparent.
+    wqkv = p["wqkv"].astype(jnp.bfloat16).reshape(D, H, 3, hd)
+    qkv = jnp.einsum("bsd,dhte->tbhse", h, wqkv)
     q, k, v = qkv[0], qkv[1], qkv[2]
     # bf16 matmul + cast: the MXU's native bf16 output plus a vector cast
     # measures ~5% MFU faster than preferred_element_type=f32 here; softmax
@@ -126,8 +132,7 @@ def _layer(cfg: ModelConfig, x, layer_params):
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    wo = p["wo"].astype(jnp.bfloat16).reshape(H, hd, D)
-    x = x + jnp.einsum("bhqd,hde->bqe", attn, wo)
+    x = x + jnp.einsum("bhqd,hde->bqe", attn, p["wo"].astype(jnp.bfloat16))
 
     h = _rmsnorm(x, p["ln2"])
     h = jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16))
@@ -292,8 +297,10 @@ def param_specs(cfg: ModelConfig):
         "embed": P(None, "tp"),
         "pos": P(None, "tp"),
         "layers": {
-            "wqkv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
+            # Attention weights shard the head axis (tp must divide H);
+            # the per-head [3hd] / [hd] minors stay whole on each device.
+            "wqkv": P(None, None, "tp", None),
+            "wo": P(None, "tp", None, None),
             "w1": P(None, None, "tp"),
             "w2": P(None, "tp", None),
             "ln1": P(None, None),
