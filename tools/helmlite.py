@@ -581,3 +581,64 @@ class Chart:
                 with open(os.path.join(crd_dir, name)) as f:
                     docs.extend(d for d in yaml.safe_load_all(f) if d)
         return docs
+
+
+def main(argv=None) -> int:
+    """CLI: render a chart to YAML on stdout (a `helm template` stand-in
+    for environments without the helm binary):
+
+        python tools/helmlite.py deployments/helm/tpu-dra-driver \
+            --set image.tag=v0.1.0 | kubectl apply -f -
+    """
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        "helmlite", description="minimal `helm template` for chart rendering"
+    )
+    p.add_argument("chart_dir")
+    p.add_argument(
+        "--set", action="append", default=[], dest="sets",
+        help="dotted.key=value override (repeatable)",
+    )
+    p.add_argument("--release", default="tpudra")
+    p.add_argument("--namespace", default="tpudra-system")
+    p.add_argument(
+        "--no-crds", action="store_true", help="omit the chart's crds/ directory"
+    )
+    args = p.parse_args(argv)
+
+    overrides: dict = {}
+    for spec in args.sets:
+        key, sep, value = spec.partition("=")
+        if not sep:
+            p.error(f"--set {spec!r}: expected key=value")
+        node = overrides
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        # Light coercion, mirroring helm: bools and ints stay typed.
+        if value in ("true", "false"):
+            typed: object = value == "true"
+        else:
+            try:
+                typed = int(value)
+            except ValueError:
+                typed = value
+        node[parts[-1]] = typed
+
+    chart = Chart(args.chart_dir)
+    docs: list[dict] = []
+    if not args.no_crds:
+        docs.extend(chart.crds())
+    rendered = chart.render(
+        overrides, release_name=args.release, namespace=args.namespace
+    )
+    for name in sorted(rendered):
+        docs.extend(rendered[name])
+    sys.stdout.write(yaml.safe_dump_all(docs, sort_keys=False))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
