@@ -1,0 +1,61 @@
+#!/usr/bin/env bash
+# Bring up a GKE cluster with a TPU slice node pool and the DRA APIs enabled
+# (reference demo/clusters/gke/create-cluster.sh, retargeted from A100 VMs
+# to a Cloud TPU node pool — GKE is where multi-host TPU slices live).
+#
+# Environment knobs (all optional):
+#   CLUSTER_NAME   cluster name                    (default: tpudra-cluster)
+#   REGION / ZONE  location                        (default: us-central2-b,
+#                  a zone with v5e capacity)
+#   CLUSTER_VERSION GKE minor with DRA beta        (default: 1.34)
+#   TPU_MACHINE    TPU VM machine type             (default: ct5lp-hightpu-4t,
+#                  one v5e host with 4 chips)
+#   TPU_TOPOLOGY   slice topology                  (default: 2x4 — a 2-host
+#                  slice, the smallest multi-host ComputeDomain)
+#   NUM_HOSTS      hosts in the slice node pool    (default: 2, must match
+#                  the topology's host count)
+set -euo pipefail
+
+: "${PROJECT_NAME:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+if [[ -z "${PROJECT_NAME}" ]]; then
+  echo "Project name could not be determined; run 'gcloud config set project'"
+  exit 1
+fi
+
+CLUSTER_NAME="${CLUSTER_NAME:-tpudra-cluster}"
+ZONE="${ZONE:-us-central2-b}"
+CLUSTER_VERSION="${CLUSTER_VERSION:-1.34}"
+TPU_MACHINE="${TPU_MACHINE:-ct5lp-hightpu-4t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
+NUM_HOSTS="${NUM_HOSTS:-2}"
+
+echo "==> creating GKE cluster ${CLUSTER_NAME} (${ZONE}, ${CLUSTER_VERSION})"
+# DRA needs the resource.k8s.io API group; on GKE that is gated behind
+# --enable-kubernetes-unstable-apis until it reaches GA in the channel.
+gcloud container clusters create "${CLUSTER_NAME}" \
+  --quiet \
+  --project="${PROJECT_NAME}" \
+  --zone="${ZONE}" \
+  --cluster-version="${CLUSTER_VERSION}" \
+  --num-nodes=1 \
+  --enable-kubernetes-unstable-apis=resource.k8s.io/v1beta1/deviceclasses,resource.k8s.io/v1beta1/resourceclaims,resource.k8s.io/v1beta1/resourceclaimtemplates,resource.k8s.io/v1beta1/resourceslices
+
+echo "==> adding TPU slice node pool (${TPU_MACHINE}, topology ${TPU_TOPOLOGY})"
+# A multi-host slice node pool: GKE provisions NUM_HOSTS TPU VMs forming one
+# ICI-connected slice. The driver's ComputeDomain machinery maps 1:1 onto
+# it (clique = slice, host index = TPU_WORKER_ID).
+gcloud container node-pools create tpu-slice \
+  --quiet \
+  --project="${PROJECT_NAME}" \
+  --zone="${ZONE}" \
+  --cluster="${CLUSTER_NAME}" \
+  --machine-type="${TPU_MACHINE}" \
+  --tpu-topology="${TPU_TOPOLOGY}" \
+  --num-nodes="${NUM_HOSTS}" \
+  --node-labels=tpudra.google.com/enabled=true
+
+gcloud container clusters get-credentials "${CLUSTER_NAME}" \
+  --project="${PROJECT_NAME}" --zone="${ZONE}"
+
+echo "==> done; install the driver with:"
+echo "    IMAGE=<your-registry>/tpudra:TAG demo/clusters/gke/install-driver.sh"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Tear down the GKE demo cluster (reference demo/clusters/gke/delete-cluster.sh).
+set -euo pipefail
+
+: "${PROJECT_NAME:=$(gcloud config list --format 'value(core.project)' 2>/dev/null)}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpudra-cluster}"
+ZONE="${ZONE:-us-central2-b}"
+
+gcloud container clusters delete "${CLUSTER_NAME}" \
+  --quiet --project="${PROJECT_NAME}" --zone="${ZONE}"

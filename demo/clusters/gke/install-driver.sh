@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Install the driver chart on a GKE TPU cluster with the *native* device
+# backend (reference demo/clusters/gke/install-dra-driver-gpu.sh).  Unlike
+# the kind path this expects real /dev/accel* devices on the TPU node pool,
+# so the kubelet plugin runs with --device-backend=native (libtpuinfo reads
+# sysfs PCI + the Cloud TPU VM metadata env).
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(cd "$HERE/../../.." && pwd)"
+IMAGE="${IMAGE:?set IMAGE=<registry>/tpudra:<tag> (pushed where GKE can pull)}"
+NAMESPACE="${NAMESPACE:-tpudra-system}"
+
+if [[ "${IMAGE##*/}" == *:* ]]; then
+  IMAGE_REPO="${IMAGE%:*}"; IMAGE_TAG="${IMAGE##*:}"
+else
+  IMAGE_REPO="${IMAGE}"; IMAGE_TAG="latest"
+fi
+
+helm upgrade --install tpudra "${REPO}/deployments/helm/tpu-dra-driver" \
+  --namespace "${NAMESPACE}" --create-namespace \
+  --set image.repository="${IMAGE_REPO}" \
+  --set image.tag="${IMAGE_TAG}" \
+  --set kubeletPlugin.deviceBackend=native \
+  --set kubeletPlugin.nodeSelector."tpudra\.google\.com/enabled"=\"true\" \
+  --wait --timeout 10m
+
+kubectl -n "${NAMESPACE}" get pods -o wide
+echo "==> try: kubectl apply -f ${REPO}/demo/specs/tpu-test1.yaml"
+echo "==> multi-host slice: kubectl apply -f ${REPO}/demo/specs/tpu-test-cd.yaml"

@@ -3,6 +3,7 @@ YAML with the expected shapes."""
 
 import glob
 import os
+import sys
 
 import yaml
 
@@ -85,6 +86,7 @@ class TestManifests:
     def manifests(self):
         files = glob.glob(os.path.join(REPO, "deployments", "*.yaml"))
         files += glob.glob(os.path.join(REPO, "demo", "specs", "*.yaml"))
+        files += glob.glob(os.path.join(REPO, "demo", "specs", "*", "*.yaml"))
         assert files
         return files
 
@@ -114,6 +116,80 @@ class TestManifests:
         assert gvr.COMPUTE_DOMAIN_CLIQUES.resource in plurals
         for d in docs:
             assert d["spec"]["group"] == gvr.COMPUTE_DOMAINS.group
+
+    def test_demo_opaque_configs_decode(self):
+        """Every opaque config in the demo specs must strict-decode through
+        the real api types — a stale field name in a demo would otherwise
+        only fail at prepare time on a cluster."""
+        from tpudra import featuregates as fg
+        from tpudra.api import decode_config
+
+        # The sharing demos exercise gated strategies; gates reset via the
+        # autouse conftest fixture.
+        fg.feature_gates().set_from_map(
+            {fg.TIME_SLICING_SETTINGS: True, fg.MULTI_PROCESS_SHARING: True}
+        )
+        checked = 0
+        for path in self.manifests():
+            with open(path) as f:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+            for doc in docs:
+                specs = []
+                if doc.get("kind") == "ResourceClaimTemplate":
+                    specs.append(doc.get("spec", {}).get("spec", {}))
+                elif doc.get("kind") == "ResourceClaim":
+                    specs.append(doc.get("spec", {}))
+                for spec in specs:
+                    for entry in spec.get("devices", {}).get("config", []):
+                        opaque = entry.get("opaque") or {}
+                        if not opaque.get("driver", "").endswith("google.com"):
+                            continue
+                        config = decode_config(opaque["parameters"], strict=True)
+                        config.normalize()
+                        config.validate()
+                        checked += 1
+        assert checked >= 3  # timeslice, multiprocess, partition demos
+
+    def test_demo_device_classes_exist_in_chart(self):
+        """Each deviceClassName referenced by a demo spec is one the chart
+        actually installs."""
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from helmlite import Chart
+
+        rendered = Chart(
+            os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+        ).render()
+        chart_classes = {
+            d["metadata"]["name"]
+            for docs in rendered.values()
+            for d in docs
+            if d.get("kind") == "DeviceClass"
+        }
+        for path in self.manifests():
+            with open(path) as f:
+                docs = [d for d in yaml.safe_load_all(f) if d]
+            for doc in docs:
+                text = yaml.safe_dump(doc)
+                for line in text.splitlines():
+                    if "deviceClassName:" in line:
+                        name = line.split("deviceClassName:")[1].strip()
+                        assert name in chart_classes, (path, name)
+
+    def test_demo_feature_gate_names_are_real(self):
+        """Demo READMEs/specs that name a feature gate must use a gate that
+        exists (a typo'd gate silently never activates)."""
+        from tpudra import featuregates as fg
+
+        known = set(fg.feature_gates().to_map())
+        import re
+
+        for path in glob.glob(os.path.join(REPO, "demo", "specs", "*", "*")):
+            if not path.endswith((".yaml", ".md")):
+                continue
+            with open(path) as f:
+                content = f.read()
+            for match in re.findall(r"featureGates\.(\w+)", content):
+                assert match in known, (path, match)
 
     def test_daemon_template_renders(self):
         from tpudra.controller.daemonset import DaemonSetManager
