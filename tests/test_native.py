@@ -251,6 +251,61 @@ class TestLibTpuInfo:
         lib.close()
 
 
+class TestKmsgHealthEvents:
+    """Without an explicit events file, the native lib tails the kernel log
+    (the channel real TPU-driver faults — and NVIDIA XIDs — surface on) and
+    translates accel lines into the HealthEvent taxonomy."""
+
+    def test_kmsg_lines_become_health_events(self, tmp_path, monkeypatch):
+        import threading
+
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        kmsg = tmp_path / "kmsg"
+        # Pre-start history must be skipped (SEEK_END): a fault from last
+        # boot must not mark silicon unhealthy now.
+        kmsg.write_text("6,1,100,-;accel accel0: uncorrectable ECC error (stale)\n")
+        monkeypatch.setenv("TPUINFO_KMSG_PATH", str(kmsg))
+        lib = NativeDeviceLib(config_path=mk_config(tmp_path), health_events_path="")
+        uuids = {c.index: c.uuid for c in lib.enumerate_chips()}
+        stop = threading.Event()
+        got = []
+
+        def real(ev):
+            return "sentinel" not in ev.detail
+
+        def consume():
+            for ev in lib.health_events(stop):
+                got.append(ev)
+                if sum(1 for e in got if real(e)) >= 2:
+                    stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        # The scanner seeks to SEEK_END at open; feed sentinel faults until
+        # one comes back, proving the tail is live (a bare sleep races a
+        # slow-starting consumer past the real fault lines).
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            with open(kmsg, "a") as f:
+                f.write("3,9,90,-;accel accel0: thermal sentinel\n")
+            time.sleep(0.05)
+        assert got, "kmsg tail never came up"
+        with open(kmsg, "a") as f:
+            # Non-accel noise, an unmatched accel info line, then two faults.
+            f.write("4,2,200,-;usb 1-1: device descriptor read error\n")
+            f.write("6,3,210,-;accel accel1: firmware loaded ok\n")
+            f.write("3,4,220,-;accel accel1: HBM uncorrectable ECC error at 0xdead\n")
+            f.write("3,5,230,-;accel accel2: TensorCore watchdog timeout, chip wedged\n")
+        t.join(timeout=10)
+        events = [e for e in got if real(e)]
+        assert len(events) == 2, got
+        assert events[0].kind == "HbmEccError" and events[0].chip_uuid == uuids[1]
+        assert events[1].kind == "ChipLockup" and events[1].chip_uuid == uuids[2]
+        assert "0xdead" in events[0].detail
+        lib.close()
+
+
 def free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

@@ -270,10 +270,18 @@ print(f"OK host={env.host_index} sum={val}")
                     text=True,
                 )
             )
-        outs = []
-        for p in procs:
-            out, _ = p.communicate(timeout=120)
-            outs.append(out)
+        try:
+            outs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=120)
+                outs.append(out)
+        finally:
+            # A hung or crashed worker must not orphan its peer (which would
+            # sit in jax.distributed.initialize holding the port).
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
         for idx, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {idx} failed:\n{out}"
             assert f"OK host={idx}" in out, out

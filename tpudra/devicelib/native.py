@@ -264,56 +264,125 @@ class NativeDeviceLib(DeviceLib):
 
     # -- health -------------------------------------------------------------
 
-    def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
-        path = self._health_events_path
-        if not path:
-            stop.wait()
-            return
-        # Works for both a plain file (tests: tail by byte offset) and a fifo
-        # (real hosts: non-blocking open so a missing writer never wedges the
-        # monitor thread, and no seek — fifos are unseekable).
-        pos = 0
-        buf = b""
+    # Kernel-log patterns → HealthEventKind: on TPU hosts, hardware faults
+    # surface as accel-driver lines in the kernel ring buffer — the same
+    # channel NVIDIA XIDs use ("NVRM: Xid" in dmesg; the reference reads
+    # them via NVML events instead, device_health.go:38).  Matched against
+    # the record's message, case-insensitively, FIRST MATCH WINS — keep the
+    # specific fabric/thermal/firmware patterns ahead of the broad ECC one,
+    # or an "uncorrectable ICI link" fault would classify as HbmEccError and
+    # escape DEFAULT_IGNORED (IciLinkDown degrades the fabric but the chip
+    # still computes, base.py:63-67).
+    KMSG_PATTERNS: list[tuple[str, str]] = [
+        (r"ici.*link|link.*down", "IciLinkDown"),
+        (r"thermal|overtemp", "ThermalTrip"),
+        (r"firmware (fault|crash|error)", "FirmwareFault"),
+        (r"lockup|wedged|watchdog timeout", "ChipLockup"),
+        (r"uncorrectable|ecc error", "HbmEccError"),
+    ]
+
+    @staticmethod
+    def _tail_lines(path: str, stop: threading.Event, from_end: bool) -> Iterator[str]:
+        """Yield decoded lines appended to *path* until *stop*.
+
+        One loop for all three shapes the health sources take:
+
+        - plain file: byte tail (``from_end=False`` starts at offset 0);
+        - fifo: non-blocking open so a missing writer never wedges the
+          monitor thread; EOF just means the writer went away — keep
+          polling the same fd;
+        - /dev/kmsg: record-oriented non-blocking reads (EAGAIN when
+          drained); ``from_end=True`` seeks past boot history so stale
+          faults from before this process don't poison the allocatable
+          set; EPIPE signals a ring-buffer overrun and reading again on
+          the SAME fd continues from the oldest surviving record —
+          reopening would seek to the end and silently drop buffered
+          faults.
+        """
         while not stop.is_set():
             try:
                 fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK)
-                try:
-                    is_fifo = os.fstat(fd).st_mode & 0o170000 == 0o010000
-                    if not is_fifo:
-                        os.lseek(fd, pos, os.SEEK_SET)
-                    while not stop.is_set():
-                        try:
-                            chunk = os.read(fd, 4096)
-                        except BlockingIOError:
-                            chunk = b""
-                        if not chunk:
-                            if not is_fifo:
-                                break  # plain file: EOF; reopen to tail
-                            if stop.wait(0.2):
-                                return
-                            continue
-                        if not is_fifo:
-                            pos += len(chunk)
-                        buf += chunk
-                        while b"\n" in buf:
-                            line, buf = buf.split(b"\n", 1)
-                            parts = line.decode(errors="replace").split(None, 3)
-                            if len(parts) < 2:
-                                continue
-                            yield HealthEvent(
-                                kind=parts[0],
-                                chip_uuid=parts[1],
-                                partition_uuid=parts[2]
-                                if len(parts) > 2 and parts[2] != "-"
-                                else None,
-                                detail=parts[3].strip() if len(parts) > 3 else "",
-                            )
-                finally:
-                    os.close(fd)
             except OSError:
-                pass
-            if stop.wait(0.2):
+                if stop.wait(1.0):
+                    return
+                continue
+            try:
+                if from_end:
+                    try:
+                        os.lseek(fd, 0, os.SEEK_END)
+                    except OSError:
+                        pass  # unseekable (fifo): tail from here anyway
+                buf = b""
+                while not stop.is_set():
+                    try:
+                        chunk = os.read(fd, 8192)
+                    except BlockingIOError:
+                        chunk = b""
+                    except BrokenPipeError:
+                        continue  # kmsg overrun: next read resumes at oldest record
+                    except OSError:
+                        break  # fd went bad; reopen
+                    if not chunk:
+                        # EOF on a plain file / writerless fifo: new appends
+                        # (or a new writer) show up on the same fd.
+                        if stop.wait(0.2):
+                            return
+                        continue
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        yield line.decode(errors="replace")
+            finally:
+                os.close(fd)
+            if stop.wait(0.5):
                 return
+
+    def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
+        path = self._health_events_path
+        if path:
+            # Explicit event file/fifo: "<kind> <chipUUID> <partUUID|-> <detail>".
+            for line in self._tail_lines(path, stop, from_end=False):
+                parts = line.split(None, 3)
+                if len(parts) < 2:
+                    continue
+                yield HealthEvent(
+                    kind=parts[0],
+                    chip_uuid=parts[1],
+                    partition_uuid=parts[2]
+                    if len(parts) > 2 and parts[2] != "-"
+                    else None,
+                    detail=parts[3].strip() if len(parts) > 3 else "",
+                )
+            return
+        # No explicit source: scan the kernel log for accel driver faults
+        # (the real interrupt surface on TPU VM hosts).
+        kmsg = os.environ.get("TPUINFO_KMSG_PATH", "/dev/kmsg")
+        if not os.path.exists(kmsg):
+            stop.wait()
+            return
+        import re
+
+        patterns = [(re.compile(rx, re.I), kind) for rx, kind in self.KMSG_PATTERNS]
+        accel_rx = re.compile(r"accel\s*(?:accel)?(\d+)")
+        uuid_by_index = {c.index: c.uuid for c in self.enumerate_chips()}
+        for line in self._tail_lines(kmsg, stop, from_end=True):
+            # Strip the "prio,seq,ts,flags;" record prefix if present.
+            message = line.split(";", 1)[1] if ";" in line else line
+            m = accel_rx.search(message)
+            if m is None:
+                continue
+            uuid = uuid_by_index.get(int(m.group(1)))
+            if uuid is None:
+                continue
+            for rx, kind in patterns:
+                if rx.search(message):
+                    yield HealthEvent(
+                        kind=kind,
+                        chip_uuid=uuid,
+                        partition_uuid=None,
+                        detail=message.strip(),
+                    )
+                    break
 
     # -- lifecycle ----------------------------------------------------------
 
