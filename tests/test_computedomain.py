@@ -152,6 +152,45 @@ class TestNonFabricAndGates:
         cd = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
         assert cd["status"]["status"] != COMPUTE_DOMAIN_STATUS_READY
 
+    def test_pod_events_drive_status_through_informer(self, tmp_path):
+        """With the controller running, a non-fabric DS pod's readiness flip
+        must propagate to cd.status via the pod informer event — no resync
+        wait, no per-sync pod LISTs (daemonsetpods.go informer analog)."""
+        from tpudra.api.computedomain import COMPUTE_DOMAIN_STATUS_READY
+
+        kube = FakeKube()
+        cd = mk_cd(kube, num_nodes=1)
+        uid = cd["metadata"]["uid"]
+        stop = threading.Event()
+        # Long resync: only events can explain a fast status change.
+        c = Controller(kube, ManagerConfig(driver_namespace=NS, resync_period=600))
+        c.start(stop)
+        try:
+            wait_for(lambda: kube.list(gvr.DAEMONSETS, NS)["items"], msg="DS")
+            pod = self.mk_ds_pod(kube, uid, "node-nf", ready=False)
+            wait_for(
+                lambda: kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+                .get("status", {})
+                .get("nodes"),
+                msg="non-fabric node counted",
+            )
+            cd_now = kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+            assert cd_now["status"]["status"] != COMPUTE_DOMAIN_STATUS_READY
+
+            pod = kube.get(gvr.PODS, pod["metadata"]["name"], NS)
+            pod["status"]["conditions"] = [{"type": "Ready", "status": "True"}]
+            kube.update(gvr.PODS, pod, NS)
+            wait_for(
+                lambda: kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns")
+                .get("status", {})
+                .get("status")
+                == COMPUTE_DOMAIN_STATUS_READY,
+                timeout=5,
+                msg="Ready via pod event",
+            )
+        finally:
+            stop.set()
+
     def test_legacy_direct_status_path(self, tmp_path):
         """ComputeDomainCliques gate OFF: daemons write cd.status.nodes
         directly (cdstatus.go:55) and the controller only aggregates."""

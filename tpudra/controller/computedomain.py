@@ -66,11 +66,13 @@ class ComputeDomainManager:
         self.nodes = NodeManager(kube, self.cd_exists)
         self._cd_informer = None
         self._clique_informer = None
+        self._pod_informer = None
 
-    def use_informers(self, cd_informer, clique_informer) -> None:
-        """Route existence checks and clique aggregation through informer
-        caches instead of per-call full LISTs (the reference's
-        uid-indexed informer + mutation cache, computedomain.go:117-125).
+    def use_informers(self, cd_informer, clique_informer, pod_informer=None) -> None:
+        """Route existence checks, clique aggregation, and non-fabric pod
+        membership through informer caches instead of per-call LISTs (the
+        reference's uid-indexed informer + mutation cache,
+        computedomain.go:117-125, and the daemonsetpods.go pod informer).
         Reads fall back to the API until each informer has synced."""
         cd_informer.add_index("uid", lambda o: o.get("metadata", {}).get("uid"))
         clique_informer.add_index(
@@ -78,6 +80,12 @@ class ComputeDomainManager:
         )
         self._cd_informer = cd_informer
         self._clique_informer = clique_informer
+        if pod_informer is not None:
+            pod_informer.add_index(
+                "cdUID",
+                lambda o: o.get("metadata", {}).get("labels", {}).get(CD_UID_LABEL),
+            )
+            self._pod_informer = pod_informer
 
     # ------------------------------------------------------------- helpers
 
@@ -203,14 +211,18 @@ class ComputeDomainManager:
         Without this, a CD containing a non-fabric node could never reach
         Ready."""
         out: list[dict] = []
-        try:
-            pods = self._kube.list(
-                gvr.PODS, self._ns, label_selector=f"{CD_UID_LABEL}={cd_uid}"
-            ).get("items", [])
-        except Exception as e:  # noqa: BLE001
-            # Publishing a shrunken node list on a transient list error
-            # would flip the CD NOT_READY with no diagnostic; retry instead.
-            raise RetryLater(f"listing CD daemon pods: {e}") from e
+        inf = self._pod_informer
+        if inf is not None and inf.has_synced:
+            pods = inf.by_index("cdUID", cd_uid)
+        else:
+            try:
+                pods = self._kube.list(
+                    gvr.PODS, self._ns, label_selector=f"{CD_UID_LABEL}={cd_uid}"
+                ).get("items", [])
+            except Exception as e:  # noqa: BLE001
+                # Publishing a shrunken node list on a transient list error
+                # would flip the CD NOT_READY with no diagnostic; retry.
+                raise RetryLater(f"listing CD daemon pods: {e}") from e
         for pod in pods:
             node = pod.get("spec", {}).get("nodeName", "")
             if not node or node in fabric_nodes:

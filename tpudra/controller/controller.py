@@ -16,6 +16,7 @@ from dataclasses import dataclass
 
 from tpudra.controller.cleanup import CleanupManager
 from tpudra.controller.computedomain import ComputeDomainManager, RetryLater
+from tpudra.controller.resourceclaimtemplate import CD_UID_LABEL
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.informer import Informer
@@ -56,9 +57,20 @@ class Controller:
         self._clique_informer = Informer(
             kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._config.driver_namespace
         )
-        # Existence checks + clique aggregation read through these caches
-        # once synced (kills the per-reconcile full LISTs).
-        self.manager.use_informers(self._cd_informer, self._clique_informer)
+        # Per-CD daemon pods (daemonsetpods.go analog): non-fabric node
+        # membership reads through this cache, and pod readiness flips
+        # drive status syncs as events instead of waiting for a resync.
+        self._pod_informer = Informer(
+            kube,
+            gvr.PODS,
+            namespace=self._config.driver_namespace,
+            label_selector=CD_UID_LABEL,
+        )
+        # Existence checks + clique aggregation + pod membership read
+        # through these caches once synced (kills the per-reconcile LISTs).
+        self.manager.use_informers(
+            self._cd_informer, self._clique_informer, self._pod_informer
+        )
         # Orphan GC sweeps every managed namespace (the driver namespace
         # plus --additional-namespaces, mnsdaemonset.go semantics).
         self._cleanups = [
@@ -117,15 +129,27 @@ class Controller:
             self._enqueue_cd(cd["metadata"]["namespace"], cd["metadata"]["name"])
             return
 
+    def _on_pod_event(self, _etype: str, obj: dict) -> None:
+        """A per-CD daemon pod changed (created / readiness flip / gone):
+        resync its ComputeDomain — for non-fabric nodes the pod IS the
+        membership signal (daemonsetpods.go analog)."""
+        cd_uid = obj.get("metadata", {}).get("labels", {}).get(CD_UID_LABEL, "")
+        if not cd_uid:
+            return
+        self._on_clique_event("", {"spec": {"computeDomainUID": cd_uid}})
+
     # -- lifecycle ----------------------------------------------------------
 
     def run(self, stop: threading.Event) -> None:
         self._cd_informer.add_handler(self._on_cd_event)
         self._clique_informer.add_handler(self._on_clique_event)
+        self._pod_informer.add_handler(self._on_pod_event)
         self._cd_informer.start(stop)
         self._clique_informer.start(stop)
+        self._pod_informer.start(stop)
         self._cd_informer.wait_for_sync()
         self._clique_informer.wait_for_sync()
+        self._pod_informer.wait_for_sync()
         for c in self._cleanups:
             c.start(stop)
         self.manager.nodes.start(stop)
