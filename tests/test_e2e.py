@@ -483,6 +483,50 @@ class TestRestartRecovery:
         d2.stop()
 
 
+class TestUpDowngradeE2E:
+    def test_downgrade_then_upgrade_roundtrip(self, tmp_path):
+        """test_gpu_updowngrade.bats analog, hermetic: a claim prepared by
+        the current (dual-V1/V2-writing) driver survives a downgrade to a
+        V1-only driver — simulated by stripping the v2 envelope entry, which
+        is exactly what an old driver's read-mutate-write leaves behind —
+        and the subsequent upgrade back: the new driver returns the
+        identical grant idempotently and unprepares cleanly."""
+        import json as jsonlib
+
+        kube = FakeKube()
+        d1 = mk_driver(tmp_path, kube)
+        d1.publish_resources()
+        rct = find(load_spec("tpu-test1.yaml"), "ResourceClaimTemplate")[0]
+        claim = Scheduler(kube).allocate(rct, "e2e-ud", "default", "ud")
+        uid = claim["metadata"]["uid"]
+        first = d1.prepare_resource_claims([claim])["claims"][uid]
+        assert first.get("devices"), first
+        cp_path = d1.state._cp.path
+        d1.stop()
+
+        # "Downgrade": an old driver only understands (and rewrites) the v1
+        # payload; the v2 entry disappears from the envelope.
+        with open(cp_path) as f:
+            envelope = jsonlib.load(f)
+        assert "v1" in envelope and "v2" in envelope
+        del envelope["v2"]
+        with open(cp_path, "w") as f:
+            jsonlib.dump(envelope, f)
+
+        # "Upgrade": the current driver reads the V1-only file.
+        d2 = mk_driver(tmp_path, kube)
+        second = d2.prepare_resource_claims([claim])["claims"][uid]
+        assert second.get("devices") == first["devices"]
+        assert d2.cleanup.cleanup_once() == 0  # not stale — claim exists
+        d2.unprepare_resource_claims([{"uid": uid}])
+        assert d2.state.prepared_claim_uids() == {}
+        # And the rewritten checkpoint is dual-version again.
+        with open(cp_path) as f:
+            envelope = jsonlib.load(f)
+        assert "v1" in envelope and "v2" in envelope
+        d2.stop()
+
+
 class TestStress:
     def test_concurrent_claim_churn(self, tmp_path):
         """test_gpu_stress.bats analog: many workers prepare/unprepare
