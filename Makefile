@@ -1,0 +1,36 @@
+# Developer entry points (the reference's Makefile/versions.mk analog).
+
+IMAGE ?= tpudra:dev
+VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
+GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
+
+.PHONY: all native test test-fast bench image helm-render clean
+
+all: native test
+
+native:
+	$(MAKE) -C native
+
+test: native
+	python -m pytest tests/ -q
+
+# The quick loop: skip the slower e2e/stress/native suites.
+test-fast:
+	python -m pytest tests/ -q \
+	  --ignore=tests/test_e2e.py \
+	  --ignore=tests/test_computedomain.py \
+	  --ignore=tests/test_native.py
+
+bench: native
+	python bench.py
+
+image:
+	docker build -f deployments/container/Dockerfile \
+	  --build-arg VERSION=$(VERSION) --build-arg GIT_COMMIT=$(GIT_COMMIT) \
+	  -t $(IMAGE) .
+
+helm-render:
+	python tools/helmlite.py deployments/helm/tpu-dra-driver
+
+clean:
+	rm -rf native/build
