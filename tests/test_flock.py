@@ -1,10 +1,38 @@
-import multiprocessing
 import os
+import subprocess
+import sys
 import time
 
 import pytest
 
 from tpudra.flock import Flock, FlockTimeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_holder(path, sentinel, body):
+    """Run a lock-holding child as a fresh interpreter: the test session
+    imports JAX (multithreaded), so fork-based children are deadlock-prone
+    and spawn cannot re-import a pytest-loaded module."""
+    code = (
+        "import sys, time, pathlib\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from tpudra.flock import Flock\n"
+        f"lock = Flock({path!r})\n"
+        "lock.acquire(timeout=5)\n"
+        f"pathlib.Path({sentinel!r}).touch()\n"
+        + body
+    )
+    return subprocess.Popen([sys.executable, "-c", code])
+
+
+def _wait_file(path, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.01)
+    return False
 
 
 def test_basic_acquire_release(tmp_path):
@@ -22,21 +50,12 @@ def test_reacquire_same_object_fails(tmp_path):
             lock.acquire(timeout=0.1)
 
 
-def _hold_lock(path, hold_s, acquired_evt):
-    lock = Flock(path)
-    lock.acquire(timeout=5)
-    acquired_evt.set()
-    time.sleep(hold_s)
-    lock.release()
-
-
 def test_cross_process_contention(tmp_path):
     path = str(tmp_path / "pu.lock")
-    evt = multiprocessing.Event()
-    p = multiprocessing.Process(target=_hold_lock, args=(path, 0.5, evt))
-    p.start()
+    sentinel = str(tmp_path / "held")
+    p = _spawn_holder(path, sentinel, "time.sleep(0.5)\nlock.release()\n")
     try:
-        assert evt.wait(5)
+        assert _wait_file(sentinel)
         lock = Flock(path, poll_interval=0.01)
         with pytest.raises(FlockTimeout):
             lock.acquire(timeout=0.1)
@@ -44,24 +63,16 @@ def test_cross_process_contention(tmp_path):
         lock.acquire(timeout=5)
         lock.release()
     finally:
-        p.join(timeout=5)
-
-
-def _crash_holder(path, acquired_evt):
-    lock = Flock(path)
-    lock.acquire(timeout=5)
-    acquired_evt.set()
-    os._exit(1)  # simulate a crash: no release call
+        p.wait(timeout=10)
 
 
 def test_crash_safety(tmp_path):
     # A crashed holder must not wedge the lock (fd close releases flock).
     path = str(tmp_path / "cp.lock")
-    evt = multiprocessing.Event()
-    p = multiprocessing.Process(target=_crash_holder, args=(path, evt))
-    p.start()
-    assert evt.wait(5)
-    p.join(timeout=5)
+    sentinel = str(tmp_path / "held")
+    p = _spawn_holder(path, sentinel, "import os\nos._exit(1)\n")
+    assert _wait_file(sentinel)
+    p.wait(timeout=10)
     lock = Flock(path)
     lock.acquire(timeout=2)
     lock.release()
