@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Preflight for the kubelet-plugin pod (reference
+# hack/kubelet-plugin-prestart.sh analog): when the TPU stack is not set up
+# on the node, fail with an actionable message in the init container's log
+# instead of letting the plugin crash-loop opaquely.  Kubernetes provides
+# the retry-with-backoff; this provides the diagnosis.
+set -u
+
+BACKEND="${DEVICE_BACKEND:-native}"
+
+if [ "${BACKEND}" = "mock" ]; then
+    echo "preflight: mock device backend — no hardware expected, OK"
+    exit 0
+fi
+
+fail() {
+    printf '%b\n' "preflight FAILED: $*" \
+        "Is this a TPU node? The native backend needs the accel devices" \
+        "the Cloud TPU VM image provides. If TPUs live elsewhere, set the" \
+        "chart's kubeletPlugin.nodeSelector to target TPU nodes only, or" \
+        "switch kubeletPlugin.deviceBackend to 'mock' for CI clusters." >&2
+    exit 1
+}
+
+# 1. Device nodes: /dev/accel* (or vfio groups for passthrough nodes).
+if ! ls /dev/accel* >/dev/null 2>&1 && ! ls /dev/vfio/* >/dev/null 2>&1; then
+    fail "no /dev/accel* or /dev/vfio/* device nodes visible"
+fi
+
+# 2. sysfs PCI: at least one Google TPU function (vendor 0x1ae0), unless the
+# VM hides sysfs (then enumeration falls back to counting device nodes).
+if [ -d /sys/bus/pci/devices ]; then
+    found=0
+    for dev in /sys/bus/pci/devices/*; do
+        [ -r "${dev}/vendor" ] || continue
+        if [ "$(cat "${dev}/vendor")" = "0x1ae0" ]; then
+            found=1
+            break
+        fi
+    done
+    if [ "${found}" = 0 ] && ! ls /dev/accel* >/dev/null 2>&1; then
+        fail "no PCI function with Google vendor id 0x1ae0 in sysfs"
+    fi
+fi
+
+echo "preflight: TPU device surface present, OK"

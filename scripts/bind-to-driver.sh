@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Usage: ./bind-to-driver.sh <ssss:bb:dd.f> <driver>
+# Bind the TPU PCI function to the given driver via sysfs driver_override —
+# the manual form of what the plugin's VFIO passthrough path does during
+# Prepare (reference scripts/bind_to_driver.sh; tpudra/plugin/vfio.py).
+set -u
+
+dev="${1:?usage: $0 <ssss:bb:dd.f> <driver>}"
+driver="${2:?usage: $0 <ssss:bb:dd.f> <driver>}"
+override="/sys/bus/pci/devices/${dev}/driver_override"
+bind="/sys/bus/pci/drivers/${driver}/bind"
+
+[ -e "${override}" ] || { echo "${override} does not exist" >&2; exit 1; }
+echo "${driver}" > "${override}" || { echo "writing ${override} failed" >&2; exit 1; }
+
+# Unbind from the current driver first, if any.
+current="/sys/bus/pci/devices/${dev}/driver"
+if [ -e "${current}" ]; then
+    echo "${dev}" > "${current}/unbind" || { echo "unbind failed" >&2; exit 1; }
+fi
+
+[ -e "${bind}" ] || { echo "${bind} does not exist (driver loaded?)" >&2; exit 1; }
+if ! echo "${dev}" > "${bind}"; then
+    echo "" > "${override}"
+    echo "binding ${dev} to ${driver} failed" >&2
+    exit 1
+fi
+echo "bound ${dev} to ${driver}"

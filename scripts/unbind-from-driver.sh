@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Usage: ./unbind-from-driver.sh <ssss:bb:dd.f>
+# Release the TPU PCI function from its current driver and clear the
+# driver_override, returning it to default matching (reference
+# scripts/unbind_from_driver.sh).
+set -u
+
+dev="${1:?usage: $0 <ssss:bb:dd.f>}"
+current="/sys/bus/pci/devices/${dev}/driver"
+override="/sys/bus/pci/devices/${dev}/driver_override"
+
+if [ -e "${current}" ]; then
+    echo "${dev}" > "${current}/unbind" || { echo "unbind failed" >&2; exit 1; }
+fi
+[ -e "${override}" ] && echo "" > "${override}"
+echo "unbound ${dev}"

@@ -77,6 +77,19 @@ class TestDefaultRender:
             "/var/run/cdi",
         } <= mounts
 
+    def test_preflight_init_container(self, chart):
+        ds = by_kind(chart.render(), "DaemonSet")[0]
+        inits = ds["spec"]["template"]["spec"].get("initContainers", [])
+        assert [c["name"] for c in inits] == ["preflight"]
+        assert inits[0]["command"] == ["kubelet-plugin-prestart.sh"]
+        env = {e["name"]: e["value"] for e in inits[0]["env"]}
+        assert env["DEVICE_BACKEND"] == "native"
+        # Opt-out drops it.
+        ds = by_kind(
+            chart.render({"kubeletPlugin": {"preflight": False}}), "DaemonSet"
+        )[0]
+        assert "initContainers" not in ds["spec"]["template"]["spec"]
+
     def test_image_tag_defaults_to_appversion(self, chart):
         ds = by_kind(chart.render(), "DaemonSet")[0]
         image = ds["spec"]["template"]["spec"]["containers"][0]["image"]
