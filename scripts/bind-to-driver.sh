@@ -11,17 +11,26 @@ override="/sys/bus/pci/devices/${dev}/driver_override"
 bind="/sys/bus/pci/drivers/${driver}/bind"
 
 [ -e "${override}" ] || { echo "${override} does not exist" >&2; exit 1; }
+# Verify the target driver exists BEFORE touching anything: discovering it
+# after the unbind would leave the device driverless with a stale override.
+[ -e "${bind}" ] || { echo "${bind} does not exist (driver loaded?)" >&2; exit 1; }
 echo "${driver}" > "${override}" || { echo "writing ${override} failed" >&2; exit 1; }
 
-# Unbind from the current driver first, if any.
+# Unbind from the current driver, if any.
 current="/sys/bus/pci/devices/${dev}/driver"
 if [ -e "${current}" ]; then
-    echo "${dev}" > "${current}/unbind" || { echo "unbind failed" >&2; exit 1; }
+    if ! echo "${dev}" > "${current}/unbind"; then
+        echo "" > "${override}"
+        echo "unbind failed" >&2
+        exit 1
+    fi
 fi
 
-[ -e "${bind}" ] || { echo "${bind} does not exist (driver loaded?)" >&2; exit 1; }
 if ! echo "${dev}" > "${bind}"; then
     echo "" > "${override}"
+    # Best effort back to default matching so the device is not left
+    # driverless (the kernel re-matches only on a probe event).
+    echo "${dev}" > /sys/bus/pci/drivers_probe 2>/dev/null
     echo "binding ${dev} to ${driver} failed" >&2
     exit 1
 fi

@@ -13,4 +13,8 @@ if [ -e "${current}" ]; then
     echo "${dev}" > "${current}/unbind" || { echo "unbind failed" >&2; exit 1; }
 fi
 [ -e "${override}" ] && echo "" > "${override}"
-echo "unbound ${dev}"
+# The kernel re-matches drivers only on a probe event; without this the
+# device would stay driverless (tpudra/plugin/vfio.py rebinds explicitly
+# for the same reason).
+echo "${dev}" > /sys/bus/pci/drivers_probe 2>/dev/null
+echo "unbound ${dev}; reprobed for default driver matching"
