@@ -784,6 +784,65 @@ class TestBatsParityCD:
         assert {"name": "LOG_VERBOSITY", "value": "5"} in env
 
 
+class TestControllerChurn:
+    def test_cd_create_delete_churn_leaves_nothing(self, tmp_path):
+        """Soak: rapid ComputeDomain create/delete cycles with the
+        controller live; when the dust settles no DaemonSet, RCT, clique,
+        or finalizer survives — the teardown choreography + orphan GC must
+        hold under churn, not just single-shot."""
+        kube = FakeKube()
+        for n in ("node-a", "node-b"):
+            mk_node(kube, n)
+        stop = threading.Event()
+        c = Controller(
+            kube,
+            ManagerConfig(
+                driver_namespace=NS,
+                resync_period=0.2,
+                additional_namespaces=("legacy-ns",),
+            ),
+        )
+        c.start(stop)
+        try:
+            for round_ in range(4):
+                cds = []
+                for i in range(5):
+                    cds.append(
+                        mk_cd(kube, name=f"cd-{round_}-{i}", rct_name=f"rct-{round_}-{i}")
+                    )
+                # Let the controller stamp children for at least some of
+                # them before (and while) deleting — interleaved teardown.
+                wait_for(
+                    lambda: kube.list(gvr.DAEMONSETS, NS)["items"],
+                    msg="some DS exists",
+                )
+                for cd in cds:
+                    kube.delete(
+                        gvr.COMPUTE_DOMAINS,
+                        cd["metadata"]["name"],
+                        cd["metadata"]["namespace"],
+                    )
+
+            def settled():
+                if kube.list(gvr.COMPUTE_DOMAINS).get("items"):
+                    return False
+                if kube.list(gvr.DAEMONSETS, NS)["items"]:
+                    return False
+                if kube.list(gvr.DAEMONSETS, "legacy-ns")["items"]:
+                    return False
+                if kube.list(gvr.RESOURCE_CLAIM_TEMPLATES, NS)["items"]:
+                    return False
+                if kube.list(gvr.RESOURCE_CLAIM_TEMPLATES, "user-ns")["items"]:
+                    return False
+                if kube.list(gvr.COMPUTE_DOMAIN_CLIQUES, NS)["items"]:
+                    return False
+                return True
+
+            wait_for(settled, timeout=30, msg="all CD children torn down")
+        finally:
+            stop.set()
+
+
 # -- full lifecycle (§3.3) ---------------------------------------------------
 
 
