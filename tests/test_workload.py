@@ -202,6 +202,36 @@ class TestRingAttention:
         assert bool(jnp.isfinite(out.astype(jnp.float32)).all())
 
 
+class TestAttentionSelection:
+    def test_auto_is_naive_on_cpu_and_short_seq(self):
+        from tpudra.workload.model import ModelConfig
+
+        cfg = ModelConfig(max_seq=1024)
+        assert not cfg.use_flash_attention(1024)  # short seq
+        # On CPU the pallas TPU kernel is unavailable; auto must never
+        # select it regardless of length (conftest pins jax to cpu).
+        assert not cfg.use_flash_attention(8192)
+
+    def test_explicit_modes_override(self):
+        from tpudra.workload.model import ModelConfig
+
+        assert ModelConfig(attention="flash").use_flash_attention(128)
+        assert not ModelConfig(attention="naive").use_flash_attention(1 << 20)
+
+    def test_naive_path_still_trains(self):
+        # The branch refactor must not disturb the default path.
+        import jax
+
+        from tpudra.workload import model as m
+
+        cfg = m.ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1,
+                            d_ff=64, max_seq=32)
+        params = m.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+        loss = jax.jit(m.loss_fn, static_argnums=2)(params, toks, cfg)
+        assert bool(jax.numpy.isfinite(loss))
+
+
 class TestDistributedRendezvous:
     """The DCN rendezvous path end to end: two worker processes receive the
     env a ComputeDomain daemon grant injects (TPUDRA_COORDINATOR /
