@@ -66,6 +66,25 @@ def test_informer_label_filter(api):
     stop.set()
 
 
+def test_informer_field_selector(api):
+    """Field-selected informers (the own-pod watch) must filter both the
+    initial LIST and live events by metadata.name."""
+    inf = Informer(
+        api, gvr.COMPUTE_DOMAINS, field_selector="metadata.name=target"
+    )
+    api.create(gvr.COMPUTE_DOMAINS, mk("other"))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    assert inf.list() == []  # pre-existing non-match excluded from LIST
+    api.create(gvr.COMPUTE_DOMAINS, mk("target"))
+    api.create(gvr.COMPUTE_DOMAINS, mk("another"))
+    assert wait_for(lambda: inf.get("target", "default") is not None)
+    time.sleep(0.1)
+    assert {o["metadata"]["name"] for o in inf.list()} == {"target"}
+    stop.set()
+
+
 def test_informer_index(api):
     inf = Informer(api, gvr.COMPUTE_DOMAINS)
     inf.add_index("uid", lambda o: o["metadata"].get("uid"))
