@@ -77,10 +77,11 @@ def test_informer_field_selector(api):
     inf.start(stop)
     assert inf.wait_for_sync(5)
     assert inf.list() == []  # pre-existing non-match excluded from LIST
-    api.create(gvr.COMPUTE_DOMAINS, mk("target"))
+    # Non-match first: once "target" (created after) is visible, the FIFO
+    # event stream guarantees "another" was already drained — no sleep race.
     api.create(gvr.COMPUTE_DOMAINS, mk("another"))
+    api.create(gvr.COMPUTE_DOMAINS, mk("target"))
     assert wait_for(lambda: inf.get("target", "default") is not None)
-    time.sleep(0.1)
     assert {o["metadata"]["name"] for o in inf.list()} == {"target"}
     stop.set()
 

@@ -70,8 +70,11 @@ def match_field_selector(selector: str | None, obj: dict) -> bool:
         term = term.strip()
         if not term:
             continue
+        # Both k8s forms: "k=v" and "k==v" (partition leaves the extra "="
+        # on the value side).
         k, _, v = term.partition("=")
-        k = k.strip().lstrip("=")
+        k = k.strip()
+        v = v.lstrip("=")
         parts = k.split(".")
         cur = obj
         for p in parts:
