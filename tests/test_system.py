@@ -1,0 +1,228 @@
+"""Process-level system smoke: the real console binaries as OS processes
+against the fake apiserver over HTTP — the hermetic analog of the kind
+demo.  Everything in between is real: argv parsing, env mirrors, the kube
+REST client over TCP, the DRA gRPC unix sockets, signal handling, and a
+clean SIGTERM shutdown."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeClient
+from tpudra.kube.httpserver import FakeKubeServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_for(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(module, *argv, server, **env_extra):
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        KUBE_API_SERVER=server.url,
+        **{k: str(v) for k, v in env_extra.items()},
+    )
+    env.pop("KUBECONFIG", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", module, *map(str, argv)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def terminate(proc, what):
+    """SIGTERM and require a clean, prompt exit."""
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        out, _ = proc.communicate(timeout=20)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise AssertionError(f"{what} did not exit on SIGTERM:\n{out[-3000:]}")
+    assert proc.returncode == 0, f"{what} rc={proc.returncode}:\n{out[-3000:]}"
+    return out
+
+
+class TestKubeletPluginProcess:
+    def test_boot_publish_prepare_shutdown(self, tmp_path):
+        from tpudra.plugin.grpcserver import DRAClient
+
+        hc_port = free_port()
+        with FakeKubeServer() as server:
+            client = KubeClient(server.url)
+            proc = spawn(
+                "tpudra.plugin.main",
+                "--node-name", "sys-node",
+                "--plugin-dir", tmp_path / "plugin",
+                "--registry-dir", tmp_path / "registry",
+                "--cdi-root", tmp_path / "cdi",
+                "--device-backend", "mock",
+                "--healthcheck-port", hc_port,
+                server=server,
+            )
+            try:
+                # Boot → ResourceSlices land in the apiserver over HTTP.
+                slices = wait_for(
+                    lambda: client.list(gvr.RESOURCE_SLICES).get("items"),
+                    msg="ResourceSlice publication",
+                )
+                devices = [
+                    d["name"] for s in slices for d in s["spec"].get("devices", [])
+                ]
+                assert "tpu-0" in devices
+
+                # Liveness endpoint self-probes both live sockets.
+                resp = urllib.request.urlopen(
+                    f"http://127.0.0.1:{hc_port}/healthz", timeout=5
+                )
+                assert resp.status == 200
+
+                # Act as kubelet: DRA gRPC over the unix socket.
+                claim = {
+                    "metadata": {"uid": "sys-1", "namespace": "default", "name": "c1"},
+                    "status": {"allocation": {"devices": {
+                        "results": [{
+                            "request": "r0", "driver": TPU_DRIVER_NAME,
+                            "pool": "sys-node", "device": "tpu-0",
+                        }],
+                        "config": [],
+                    }}},
+                }
+                client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                dra = DRAClient(str(tmp_path / "plugin" / "dra.sock"))
+                try:
+                    resp = dra.prepare([claim])
+                    result = resp["claims"]["sys-1"]
+                    assert result.get("devices"), result
+                    spec_files = os.listdir(tmp_path / "cdi")
+                    assert any("sys-1" in f for f in spec_files), spec_files
+                    dra.unprepare([claim])
+                    assert not any(
+                        "sys-1" in f for f in os.listdir(tmp_path / "cdi")
+                    )
+                finally:
+                    dra.close()
+            finally:
+                terminate(proc, "tpu-kubelet-plugin")
+
+
+class TestControllerProcess:
+    def test_cd_reconcile_and_teardown(self, tmp_path):
+        with FakeKubeServer() as server:
+            client = KubeClient(server.url)
+            proc = spawn(
+                "tpudra.controller.main",
+                "--namespace", "tpudra-system",
+                server=server,
+            )
+            try:
+                cd = client.create(
+                    gvr.COMPUTE_DOMAINS,
+                    {
+                        "apiVersion": "resource.tpu.google.com/v1beta1",
+                        "kind": "ComputeDomain",
+                        "metadata": {"name": "sys-cd", "namespace": "user-ns"},
+                        "spec": {
+                            "numNodes": 1,
+                            "channel": {
+                                "resourceClaimTemplate": {"name": "sys-rct"},
+                                "allocationMode": "Single",
+                            },
+                        },
+                    },
+                    "user-ns",
+                )
+                wait_for(
+                    lambda: client.list(gvr.DAEMONSETS, "tpudra-system")["items"],
+                    msg="per-CD DaemonSet",
+                )
+                wait_for(
+                    lambda: client.list(gvr.RESOURCE_CLAIM_TEMPLATES, "user-ns")["items"],
+                    msg="workload RCT",
+                )
+                client.delete(gvr.COMPUTE_DOMAINS, "sys-cd", "user-ns")
+
+                def torn_down():
+                    return (
+                        not client.list(gvr.COMPUTE_DOMAINS).get("items")
+                        and not client.list(gvr.DAEMONSETS, "tpudra-system")["items"]
+                        and not client.list(
+                            gvr.RESOURCE_CLAIM_TEMPLATES, "user-ns"
+                        )["items"]
+                    )
+
+                wait_for(torn_down, msg="finalizer teardown chain")
+            finally:
+                terminate(proc, "compute-domain-controller")
+
+
+class TestWebhookProcess:
+    def test_admission_over_http(self):
+        import json
+
+        port = free_port()
+        with FakeKubeServer() as server:
+            proc = spawn("tpudra.webhook.main", "--port", port, server=server)
+            try:
+                review = {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "request": {
+                        "uid": "sys-rev",
+                        "object": {
+                            "kind": "ResourceClaim",
+                            "apiVersion": "resource.k8s.io/v1",
+                            "spec": {"devices": {"config": [{"opaque": {
+                                "driver": TPU_DRIVER_NAME,
+                                "parameters": {
+                                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                                    "kind": "NopeConfig",
+                                },
+                            }}]}},
+                        },
+                    },
+                }
+
+                def post():
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{port}/validate-resource-claim-parameters",
+                        data=json.dumps(review).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    try:
+                        return json.loads(urllib.request.urlopen(req, timeout=2).read())
+                    except OSError:
+                        return None
+
+                resp = wait_for(post, msg="webhook answering")
+                assert resp["response"]["allowed"] is False
+                assert "NopeConfig" in resp["response"]["status"]["message"]
+            finally:
+                terminate(proc, "tpudra-webhook")
