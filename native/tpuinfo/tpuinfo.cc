@@ -298,6 +298,10 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
     gen_name = getenv_or("TPU_ACCELERATOR_TYPE", "");
     auto dash = gen_name.find('-');  // "v5p-16" → "v5p"
     if (dash != std::string::npos) gen_name = gen_name.substr(0, dash);
+    // Cloud TPU accelerator-type aliases → generation table names.
+    if (gen_name == "v5litepod") gen_name = "v5e";
+    else if (gen_name == "v5pod" || gen_name == "v5") gen_name = "v5p";
+    else if (gen_name == "v6litepod") gen_name = "v6e";
     auto accel = accel_device_indices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
     int dev_count = static_cast<int>(accel.size());
     if (!pci.empty()) {
@@ -319,6 +323,19 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
       // No PCI visibility (VM without sysfs passthrough): fall back to
       // counting accel device nodes.
       num_chips = dev_count;
+    }
+    if (num_chips <= 0 && getenv("TPU_ACCELERATOR_TYPE") == nullptr) {
+      // Nothing probed and no Cloud TPU VM metadata attesting this is a
+      // TPU host: refuse rather than synthesize chips_per_host phantom
+      // devices — a non-TPU node must never advertise allocatable silicon
+      // to the scheduler.  (With TPU_ACCELERATOR_TYPE set, the VM contract
+      // is trusted: some environments hide sysfs and devfs from the
+      // container while libtpu still reaches the chips.)
+      h->error =
+          "no TPU devices found (no sysfs PCI functions with vendor 0x1ae0, "
+          "no /dev/accel* nodes, and TPU_ACCELERATOR_TYPE is unset)";
+      *out = h;
+      return -1;
     }
     if (gen_name.empty()) gen_name = "v5p";
     host_index = atoi(getenv_or("TPU_WORKER_ID", "0").c_str());

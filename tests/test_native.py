@@ -251,6 +251,32 @@ class TestLibTpuInfo:
         lib.close()
 
 
+class TestNonTpuNodeRefusal:
+    def test_no_devices_and_no_attestation_refuses(self, tmp_path, monkeypatch):
+        """A non-TPU node must never synthesize allocatable silicon: with
+        empty sysfs, empty devfs, and no Cloud TPU VM metadata the hardware
+        path errors instead of inventing chips_per_host devices."""
+        from tpudra.devicelib.base import DeviceLibError
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        (tmp_path / "sys").mkdir()
+        (tmp_path / "dev").mkdir()
+        monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path / "sys"))
+        monkeypatch.setenv("TPUINFO_DEV_ROOT", str(tmp_path / "dev"))
+        monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+        with pytest.raises(DeviceLibError, match="no TPU devices found"):
+            NativeDeviceLib(config_path="")
+
+        # The Cloud TPU VM metadata contract is trusted: with the env set,
+        # enumeration proceeds from the generation's host shape even when
+        # the container hides sysfs/devfs.
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+        monkeypatch.setenv("TPUINFO_STATE_FILE", str(tmp_path / "state"))
+        lib = NativeDeviceLib(config_path="")
+        assert len(lib.enumerate_chips()) > 0
+        lib.close()
+
+
 class TestKmsgHealthEvents:
     """Without an explicit events file, the native lib tails the kernel log
     (the channel real TPU-driver faults — and NVIDIA XIDs — surface on) and
