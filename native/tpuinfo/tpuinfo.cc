@@ -324,7 +324,7 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
       // counting accel device nodes.
       num_chips = dev_count;
     }
-    if (num_chips <= 0 && getenv("TPU_ACCELERATOR_TYPE") == nullptr) {
+    if (num_chips <= 0 && getenv_or("TPU_ACCELERATOR_TYPE", "").empty()) {
       // Nothing probed and no Cloud TPU VM metadata attesting this is a
       // TPU host: refuse rather than synthesize chips_per_host phantom
       // devices — a non-TPU node must never advertise allocatable silicon

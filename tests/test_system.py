@@ -39,6 +39,19 @@ def free_port():
         return s.getsockname()[1]
 
 
+@pytest.fixture
+def short_tmp():
+    """AF_UNIX socket paths are capped at ~107 bytes; pytest's tmp_path is
+    long enough to overflow them with the CD driver's socket names, so the
+    socket-bearing dirs live under a short mkdtemp."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="tpusys-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
 def spawn(module, *argv, server, **env_extra):
     env = dict(
         os.environ,
@@ -71,7 +84,7 @@ def terminate(proc, what):
 
 
 class TestKubeletPluginProcess:
-    def test_boot_publish_prepare_shutdown(self, tmp_path):
+    def test_boot_publish_prepare_shutdown(self, short_tmp):
         from tpudra.plugin.grpcserver import DRAClient
 
         hc_port = free_port()
@@ -80,17 +93,20 @@ class TestKubeletPluginProcess:
             proc = spawn(
                 "tpudra.plugin.main",
                 "--node-name", "sys-node",
-                "--plugin-dir", tmp_path / "plugin",
-                "--registry-dir", tmp_path / "registry",
-                "--cdi-root", tmp_path / "cdi",
+                "--plugin-dir", os.path.join(short_tmp, "plugin"),
+                "--registry-dir", os.path.join(short_tmp, "registry"),
+                "--cdi-root", os.path.join(short_tmp, "cdi"),
                 "--device-backend", "mock",
                 "--healthcheck-port", hc_port,
                 server=server,
             )
             try:
                 # Boot → ResourceSlices land in the apiserver over HTTP.
+                # Generous timeout: interpreter start + imports alone take
+                # seconds on a loaded machine.
                 slices = wait_for(
                     lambda: client.list(gvr.RESOURCE_SLICES).get("items"),
+                    timeout=60,
                     msg="ResourceSlice publication",
                 )
                 devices = [
@@ -116,16 +132,16 @@ class TestKubeletPluginProcess:
                     }}},
                 }
                 client.create(gvr.RESOURCE_CLAIMS, claim, "default")
-                dra = DRAClient(str(tmp_path / "plugin" / "dra.sock"))
+                dra = DRAClient(os.path.join(short_tmp, "plugin", "dra.sock"))
                 try:
                     resp = dra.prepare([claim])
                     result = resp["claims"]["sys-1"]
                     assert result.get("devices"), result
-                    spec_files = os.listdir(tmp_path / "cdi")
+                    spec_files = os.listdir(os.path.join(short_tmp, "cdi"))
                     assert any("sys-1" in f for f in spec_files), spec_files
                     dra.unprepare([claim])
                     assert not any(
-                        "sys-1" in f for f in os.listdir(tmp_path / "cdi")
+                        "sys-1" in f for f in os.listdir(os.path.join(short_tmp, "cdi"))
                     )
                 finally:
                     dra.close()
@@ -133,8 +149,173 @@ class TestKubeletPluginProcess:
                 terminate(proc, "tpu-kubelet-plugin")
 
 
+class TestCDKubeletPluginProcess:
+    def test_boot_publishes_channels_and_daemon(self, short_tmp):
+        from tpudra.cdplugin import CHANNEL_COUNT
+
+        with FakeKubeServer() as server:
+            client = KubeClient(server.url)
+            proc = spawn(
+                "tpudra.cdplugin.main",
+                "--node-name", "sys-node",
+                "--plugin-dir", os.path.join(short_tmp, "cdplugin"),
+                "--registry-dir", os.path.join(short_tmp, "registry"),
+                "--cdi-root", os.path.join(short_tmp, "cdi"),
+                "--device-backend", "mock",
+                server=server,
+            )
+            try:
+                def published():
+                    slices = client.list(gvr.RESOURCE_SLICES).get("items", [])
+                    n = sum(len(s["spec"].get("devices", [])) for s in slices)
+                    return n if n >= CHANNEL_COUNT + 1 else 0
+
+                total = wait_for(published, timeout=60, msg="chunked CD slices")
+                assert total == CHANNEL_COUNT + 1  # 2048 channels + daemon-0
+            finally:
+                terminate(proc, "compute-domain-kubelet-plugin")
+
+
+class TestCDDaemonProcess:
+    def test_check_probe_and_idle_run(self, short_tmp):
+        # `check` with no clique: READY unconditionally (exit 0).
+        with FakeKubeServer() as server:
+            env_probe = dict(
+                os.environ,
+                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            )
+            env_probe.pop("CLIQUE_ID", None)
+            out = subprocess.run(
+                [sys.executable, "-m", "tpudra.cddaemon.main", "check"],
+                env=env_probe, capture_output=True, text=True,
+            )
+            assert out.returncode == 0, out.stdout + out.stderr
+
+            # `check` with a clique but no live status socket: probe fails.
+            env_probe["CLIQUE_ID"] = "s1.0"
+            env_probe["STATUS_PORT"] = str(free_port())
+            out = subprocess.run(
+                [sys.executable, "-m", "tpudra.cddaemon.main", "check"],
+                env=env_probe, capture_output=True, text=True,
+            )
+            assert out.returncode == 1
+
+            # `run` with no derivable TPU identity (library unloadable —
+            # deterministic regardless of what the host attests about
+            # TPUs): the daemon idles and exits clean on SIGTERM.  SIGTERM
+            # only after the idle log line: python+imports take seconds
+            # and the handler is installed late in startup.
+            log = os.path.join(short_tmp, "daemon.log")
+            env = dict(
+                os.environ,
+                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                KUBE_API_SERVER=server.url,
+                CD_UID="sys-cd-uid",
+                NODE_NAME="sys-node",
+                POD_NAME="",
+                POD_IP="10.0.0.9",
+                NAMESPACE="tpudra-system",
+                WORK_DIR=str(os.path.join(short_tmp, "wd")),
+                HOSTS_PATH=str(os.path.join(short_tmp, "hosts")),
+                TPUINFO_LIBRARY_PATH=os.path.join(short_tmp, "no-such-lib.so"),
+            )
+            env.pop("KUBECONFIG", None)
+            with open(log, "w") as logf:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "tpudra.cddaemon.main", "run"],
+                    env=env, stdout=logf, stderr=subprocess.STDOUT, text=True,
+                )
+
+            def log_text():
+                with open(log) as f:
+                    return f.read()
+
+            wait_for(
+                lambda: "idling" in log_text(), timeout=30,
+                msg="daemon idle log line",
+            )
+            assert proc.poll() is None, "daemon should idle, not exit"
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=20) == 0, log_text()[-2000:]
+
+
+    def test_fabric_run_forms_clique_with_native_daemon(self, short_tmp):
+        """The full fabric path as processes: the daemon derives its slice
+        identity from the Cloud TPU VM metadata contract, joins the clique
+        CR in the apiserver, supervises a REAL tpu-slicewatchd, and the
+        `check` probe reports READY."""
+        slicewatchd = os.path.join(REPO, "native", "build", "tpu-slicewatchd")
+        if not os.path.exists(slicewatchd):
+            pytest.skip("tpu-slicewatchd not built (make -C native)")
+        status_port, peer_port = free_port(), free_port()
+        with FakeKubeServer() as server:
+            client = KubeClient(server.url)
+            log = os.path.join(short_tmp, "daemon.log")
+            env = dict(
+                os.environ,
+                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                PATH=os.path.join(REPO, "native", "build") + os.pathsep
+                + os.environ.get("PATH", ""),
+                KUBE_API_SERVER=server.url,
+                CD_UID="sys-cd-uid",
+                NODE_NAME="sys-node",
+                POD_NAME="",
+                POD_IP="127.0.0.1",
+                NAMESPACE="tpudra-system",
+                WORK_DIR=str(os.path.join(short_tmp, "wd")),
+                HOSTS_PATH=str(os.path.join(short_tmp, "hosts")),
+                STATUS_PORT=str(status_port),
+                PEER_PORT=str(peer_port),
+                # Deterministic single-host slice identity (the Cloud TPU VM
+                # metadata contract), independent of the host environment.
+                TPU_ACCELERATOR_TYPE="v5litepod-4",
+                TPU_WORKER_ID="0",
+                TPU_WORKER_COUNT="1",
+                TPU_SLICE_UUID="sys-slice",
+                TPUINFO_STATE_FILE=os.path.join(short_tmp, "tpuinfo-state"),
+            )
+            env.pop("KUBECONFIG", None)
+            open(os.path.join(short_tmp, "hosts"), "w").close()
+            with open(log, "w") as logf:
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "tpudra.cddaemon.main", "run"],
+                    env=env, stdout=logf, stderr=subprocess.STDOUT, text=True,
+                )
+            try:
+                def clique_ready():
+                    cliques = client.list(
+                        gvr.COMPUTE_DOMAIN_CLIQUES, "tpudra-system"
+                    ).get("items", [])
+                    for cl in cliques:
+                        for d in cl.get("status", {}).get("daemons", []):
+                            if d.get("nodeName") == "sys-node":
+                                return d.get("status") == "Ready"
+                    return False
+
+                wait_for(clique_ready, timeout=60, msg="clique daemon Ready")
+
+                # The kubelet probe agrees: check == READY (exit 0).
+                out = subprocess.run(
+                    [sys.executable, "-m", "tpudra.cddaemon.main", "check"],
+                    env=dict(env, CLIQUE_ID="sys.0"),
+                    capture_output=True, text=True,
+                )
+                assert out.returncode == 0, out.stdout + out.stderr
+            finally:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+                try:
+                    rc = proc.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    with open(log) as f:
+                        raise AssertionError("daemon hung:\n" + f.read()[-3000:])
+                with open(log) as f:
+                    assert rc == 0, f.read()[-3000:]
+
+
 class TestControllerProcess:
-    def test_cd_reconcile_and_teardown(self, tmp_path):
+    def test_cd_reconcile_and_teardown(self, short_tmp):
         with FakeKubeServer() as server:
             client = KubeClient(server.url)
             proc = spawn(
