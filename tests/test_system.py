@@ -52,7 +52,12 @@ def short_tmp():
     shutil.rmtree(d, ignore_errors=True)
 
 
-def spawn(module, *argv, server, **env_extra):
+def spawn(module, *argv, server, log_path=None, **env_extra):
+    """Launch a binary as `python -m module` against the fake apiserver.
+
+    Output goes to a PIPE by default, or to ``log_path`` when the test
+    needs to poll it while the process runs (communicate() would block).
+    """
     env = dict(
         os.environ,
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -60,13 +65,28 @@ def spawn(module, *argv, server, **env_extra):
         **{k: str(v) for k, v in env_extra.items()},
     )
     env.pop("KUBECONFIG", None)
-    return subprocess.Popen(
-        [sys.executable, "-m", module, *map(str, argv)],
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-    )
+    out = open(log_path, "w") if log_path else subprocess.PIPE
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", module, *map(str, argv)],
+            env=env,
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+    finally:
+        if log_path:
+            out.close()
+    proc.log_path = log_path
+    proc.spawn_env = env
+    return proc
+
+
+def proc_output(proc):
+    if proc.log_path:
+        with open(proc.log_path) as f:
+            return f.read()
+    return proc.communicate()[0]
 
 
 def terminate(proc, what):
@@ -74,10 +94,15 @@ def terminate(proc, what):
     if proc.poll() is None:
         proc.send_signal(signal.SIGTERM)
     try:
-        out, _ = proc.communicate(timeout=20)
+        if proc.log_path:
+            proc.wait(timeout=20)
+            out = proc_output(proc)
+        else:
+            out, _ = proc.communicate(timeout=20)
     except subprocess.TimeoutExpired:
         proc.kill()
-        out, _ = proc.communicate()
+        proc.wait()
+        out = proc_output(proc)
         raise AssertionError(f"{what} did not exit on SIGTERM:\n{out[-3000:]}")
     assert proc.returncode == 0, f"{what} rc={proc.returncode}:\n{out[-3000:]}"
     return out
@@ -205,38 +230,25 @@ class TestCDDaemonProcess:
             # TPUs): the daemon idles and exits clean on SIGTERM.  SIGTERM
             # only after the idle log line: python+imports take seconds
             # and the handler is installed late in startup.
-            log = os.path.join(short_tmp, "daemon.log")
-            env = dict(
-                os.environ,
-                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
-                KUBE_API_SERVER=server.url,
+            proc = spawn(
+                "tpudra.cddaemon.main", "run",
+                server=server,
+                log_path=os.path.join(short_tmp, "daemon.log"),
                 CD_UID="sys-cd-uid",
                 NODE_NAME="sys-node",
                 POD_NAME="",
                 POD_IP="10.0.0.9",
                 NAMESPACE="tpudra-system",
-                WORK_DIR=str(os.path.join(short_tmp, "wd")),
-                HOSTS_PATH=str(os.path.join(short_tmp, "hosts")),
+                WORK_DIR=os.path.join(short_tmp, "wd"),
+                HOSTS_PATH=os.path.join(short_tmp, "hosts"),
                 TPUINFO_LIBRARY_PATH=os.path.join(short_tmp, "no-such-lib.so"),
             )
-            env.pop("KUBECONFIG", None)
-            with open(log, "w") as logf:
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "tpudra.cddaemon.main", "run"],
-                    env=env, stdout=logf, stderr=subprocess.STDOUT, text=True,
-                )
-
-            def log_text():
-                with open(log) as f:
-                    return f.read()
-
             wait_for(
-                lambda: "idling" in log_text(), timeout=30,
+                lambda: "idling" in proc_output(proc), timeout=30,
                 msg="daemon idle log line",
             )
             assert proc.poll() is None, "daemon should idle, not exit"
-            proc.send_signal(signal.SIGTERM)
-            assert proc.wait(timeout=20) == 0, log_text()[-2000:]
+            terminate(proc, "compute-domain-daemon (idle)")
 
 
     def test_fabric_run_forms_clique_with_native_daemon(self, short_tmp):
@@ -250,22 +262,22 @@ class TestCDDaemonProcess:
         status_port, peer_port = free_port(), free_port()
         with FakeKubeServer() as server:
             client = KubeClient(server.url)
-            log = os.path.join(short_tmp, "daemon.log")
-            env = dict(
-                os.environ,
-                PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            open(os.path.join(short_tmp, "hosts"), "w").close()
+            proc = spawn(
+                "tpudra.cddaemon.main", "run",
+                server=server,
+                log_path=os.path.join(short_tmp, "daemon.log"),
                 PATH=os.path.join(REPO, "native", "build") + os.pathsep
                 + os.environ.get("PATH", ""),
-                KUBE_API_SERVER=server.url,
                 CD_UID="sys-cd-uid",
                 NODE_NAME="sys-node",
                 POD_NAME="",
                 POD_IP="127.0.0.1",
                 NAMESPACE="tpudra-system",
-                WORK_DIR=str(os.path.join(short_tmp, "wd")),
-                HOSTS_PATH=str(os.path.join(short_tmp, "hosts")),
-                STATUS_PORT=str(status_port),
-                PEER_PORT=str(peer_port),
+                WORK_DIR=os.path.join(short_tmp, "wd"),
+                HOSTS_PATH=os.path.join(short_tmp, "hosts"),
+                STATUS_PORT=status_port,
+                PEER_PORT=peer_port,
                 # Deterministic single-host slice identity (the Cloud TPU VM
                 # metadata contract), independent of the host environment.
                 TPU_ACCELERATOR_TYPE="v5litepod-4",
@@ -274,13 +286,6 @@ class TestCDDaemonProcess:
                 TPU_SLICE_UUID="sys-slice",
                 TPUINFO_STATE_FILE=os.path.join(short_tmp, "tpuinfo-state"),
             )
-            env.pop("KUBECONFIG", None)
-            open(os.path.join(short_tmp, "hosts"), "w").close()
-            with open(log, "w") as logf:
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "tpudra.cddaemon.main", "run"],
-                    env=env, stdout=logf, stderr=subprocess.STDOUT, text=True,
-                )
             try:
                 def clique_ready():
                     cliques = client.list(
@@ -297,21 +302,12 @@ class TestCDDaemonProcess:
                 # The kubelet probe agrees: check == READY (exit 0).
                 out = subprocess.run(
                     [sys.executable, "-m", "tpudra.cddaemon.main", "check"],
-                    env=dict(env, CLIQUE_ID="sys.0"),
+                    env=dict(proc.spawn_env, CLIQUE_ID="sys.0"),
                     capture_output=True, text=True,
                 )
                 assert out.returncode == 0, out.stdout + out.stderr
             finally:
-                if proc.poll() is None:
-                    proc.send_signal(signal.SIGTERM)
-                try:
-                    rc = proc.wait(timeout=20)
-                except subprocess.TimeoutExpired:
-                    proc.kill()
-                    with open(log) as f:
-                        raise AssertionError("daemon hung:\n" + f.read()[-3000:])
-                with open(log) as f:
-                    assert rc == 0, f.read()[-3000:]
+                terminate(proc, "compute-domain-daemon (fabric)")
 
 
 class TestControllerProcess:
