@@ -185,7 +185,7 @@ class TestNonFabricAndGates:
                 .get("status", {})
                 .get("status")
                 == COMPUTE_DOMAIN_STATUS_READY,
-                timeout=5,
+                timeout=15,
                 msg="Ready via pod event",
             )
         finally:
@@ -622,7 +622,7 @@ class TestPodManagerReadiness:
             pod = kube.get(gvr.PODS, "cd-daemon-a", NS)
             pod["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
             kube.update(gvr.PODS, pod, NS)
-            wait_for(lambda: daemon_ready() is False, timeout=5,
+            wait_for(lambda: daemon_ready() is False, timeout=15,
                      msg="NotReady propagated via pod watch")
 
             # And back — but with the apiserver briefly down for clique
