@@ -31,12 +31,23 @@ def wait_for(fn, timeout=30.0, msg="condition"):
     raise AssertionError(f"timed out waiting for {msg}")
 
 
-def free_port():
+def free_ports(n=1):
+    """Distinct ephemeral ports: all sockets stay bound until every port is
+    read, so back-to-back calls cannot hand out the same port twice."""
     import socket
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for sk in socks:
+            sk.bind(("127.0.0.1", 0))
+        return [sk.getsockname()[1] for sk in socks]
+    finally:
+        for sk in socks:
+            sk.close()
+
+
+def free_port():
+    return free_ports(1)[0]
 
 
 @pytest.fixture
@@ -259,7 +270,7 @@ class TestCDDaemonProcess:
         slicewatchd = os.path.join(REPO, "native", "build", "tpu-slicewatchd")
         if not os.path.exists(slicewatchd):
             pytest.skip("tpu-slicewatchd not built (make -C native)")
-        status_port, peer_port = free_port(), free_port()
+        status_port, peer_port = free_ports(2)
         with FakeKubeServer() as server:
             client = KubeClient(server.url)
             open(os.path.join(short_tmp, "hosts"), "w").close()
