@@ -26,12 +26,12 @@ import logging
 import os
 import socket
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 from tpudra import featuregates
 from tpudra.cddaemon.cdclique import CliqueManager
-from tpudra.cddaemon.dnsnames import DNSNameManager, dns_name
+from tpudra.cddaemon.dnsnames import DNSNameManager
 from tpudra.cddaemon.podmanager import PodManager
 from tpudra.cddaemon.process import ProcessManager
 from tpudra.kube import gvr

@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Optional
 
 from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
 from tpudra.api import DecodeError, decode_config

@@ -18,7 +18,7 @@ import ctypes
 import os
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Iterator
 
 from tpudra.devicelib.base import (
     DeviceLib,

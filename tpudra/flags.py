@@ -11,7 +11,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-from typing import Optional
 
 from tpudra import featuregates
 
