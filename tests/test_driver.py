@@ -331,3 +331,97 @@ class TestDriver:
             assert d.unhealthy_devices() == set()
         finally:
             d.stop()
+
+
+class TestKubeletRestartResilience:
+    def test_reregistration_and_concurrent_clients(self, tmp_path):
+        """Kubelet restarts re-dial both sockets: a fresh registration
+        handshake must succeed after the previous client went away, and
+        concurrent DRA clients (kubelet's parallel pod syncs) must each get
+        correct per-claim answers."""
+        import threading
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            # Two registration "kubelets" in sequence (restart analog).
+            for _ in range(2):
+                reg = RegistrationClient(d.sockets.registration_socket_path)
+                assert reg.get_info()["name"] == TPU_DRIVER_NAME
+                reg.notify(True)
+                reg.close()
+
+            claims = []
+            for i in range(4):
+                uid = f"conc-{i}"
+                claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                claims.append(claim)
+
+            errors: list[str] = []
+
+            def worker(claim):
+                uid = claim["metadata"]["uid"]
+                dra = DRAClient(d.sockets.dra_socket_path)
+                try:
+                    resp = dra.prepare([claim])
+                    result = resp["claims"][uid]
+                    if "error" in result:
+                        errors.append(f"{uid}: {result['error']}")
+                        return
+                    expect = claim["status"]["allocation"]["devices"]["results"][0]["device"]
+                    if result["devices"][0]["deviceName"] != expect:
+                        errors.append(f"{uid}: wrong device {result}")
+                    dra.unprepare([claim])
+                finally:
+                    dra.close()
+
+            threads = [threading.Thread(target=worker, args=(c,)) for c in claims]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors, errors
+            assert d.state.prepared_claim_uids() == {}
+        finally:
+            d.stop()
+
+
+class TestCDISpecContract:
+    def test_spec_file_shape_matches_cdi_contract(self, tmp_path):
+        """The transient spec file must be a valid CDI document: version,
+        vendor/class kind, per-device entries whose names match the ids the
+        DRA response hands kubelet (containerd resolves exactly those)."""
+        from tpudra.plugin.cdi import CDI_KIND, CDI_VERSION
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            claim = mk_claim("cdi-1", ["tpu-0", "tpu-1"], name="cdi-claim")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            resp = d.prepare_resource_claims([claim])
+            result = resp["claims"]["cdi-1"]
+            assert "error" not in result, result
+
+            spec = d.state._cdi.read_claim_spec("cdi-1")
+            assert spec["cdiVersion"] == CDI_VERSION
+            assert spec["kind"] == CDI_KIND
+            vendor_kind, _, cls = CDI_KIND.partition("/")
+            assert vendor_kind and cls
+            spec_names = {dev["name"] for dev in spec["devices"]}
+            # Every CDI id in the DRA answer is "<kind>=<name>" and resolves
+            # to a device entry in the spec file.
+            for dev in result["devices"]:
+                for cdi_id in dev["cdiDeviceIDs"]:
+                    kind, _, name = cdi_id.partition("=")
+                    assert kind == CDI_KIND, cdi_id
+                    assert name in spec_names, (cdi_id, spec_names)
+            # Edits must use CDI's containerEdits schema keys.
+            for dev in spec["devices"]:
+                edits = dev["containerEdits"]
+                assert set(edits) <= {"env", "deviceNodes", "mounts", "hooks"}
+            d.unprepare_resource_claims([{"uid": "cdi-1"}])
+        finally:
+            d.stop()
