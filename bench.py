@@ -58,7 +58,7 @@ PEAK_BF16_TFLOPS = [
 ]
 
 # Largest config that fits a single 16 GB v5e chip with selective remat;
-# ~472M params, measured ~62% MFU with the tuned flash-attention path
+# ~472M params, measured ~67% MFU with the tuned splash-attention path
 # (see extras.tpu for the live number).
 BENCH_MODEL = dict(
     vocab=32768, d_model=2048, n_heads=16, n_layers=8, d_ff=8192, max_seq=1024
@@ -307,7 +307,7 @@ def bench_long_context() -> dict:
         return {
             "seq": cfg.max_seq,
             "batch": batch,
-            "attention": "pallas flash (naive cannot compile at this length)",
+            "attention": "pallas splash, fused bwd (naive cannot compile at this length)",
             "step_ms": round(dt * 1000.0, 1),
             "tokens_per_s": round(tokens_per_step / dt),
             "model_tflops_per_s": round(flops / dt / 1e12, 1),
