@@ -77,7 +77,7 @@ class ModelConfig:
         return self.d_model // self.n_heads
 
     def use_flash_attention(self, seq_len: int) -> bool:
-        if self.attention == "flash":
+        if self.attention in ("flash", "splash"):  # both name the pallas path
             return True
         if self.attention == "naive":
             return False
