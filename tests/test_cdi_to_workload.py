@@ -1,0 +1,153 @@
+"""Closing the loop between the driver and the workload layer: the CDI spec
+the plugin writes, merged the way containerd applies CDI (env + device nodes
++ mounts into the OCI config), must produce exactly the environment
+``tpudra.workload.envspec.ClaimEnv`` expects — the contract the two layers
+share but no single test exercised end to end."""
+
+import pytest
+
+from tests.test_device_state import mk_claim, opaque
+from tpudra import featuregates as fg
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.workload.envspec import ClaimEnv
+
+API_V = "resource.tpu.google.com/v1beta1"
+
+
+def apply_cdi(spec, requested_ids):
+    """containerd's CDI application, simplified: for each requested
+    "<kind>=<name>" id, merge that device's containerEdits (and the spec's
+    common containerEdits) into an OCI-ish container config."""
+    kind = spec["kind"]
+    by_name = {d["name"]: d for d in spec["devices"]}
+    env: dict = {}
+    device_nodes: list = []
+    mounts: list = []
+
+    def merge(edits):
+        for kv in edits.get("env", []):
+            k, _, v = kv.partition("=")
+            env[k] = v
+        device_nodes.extend(n["path"] for n in edits.get("deviceNodes", []))
+        mounts.extend(
+            (m["hostPath"], m["containerPath"]) for m in edits.get("mounts", [])
+        )
+
+    merge(spec.get("containerEdits", {}))
+    for cdi_id in requested_ids:
+        req_kind, _, name = cdi_id.partition("=")
+        assert req_kind == kind, f"foreign CDI kind {cdi_id}"
+        assert name in by_name, f"unresolvable CDI device {cdi_id}"
+        merge(by_name[name]["containerEdits"])
+    return env, device_nodes, mounts
+
+
+@pytest.fixture
+def driver(tmp_path):
+    from tests.test_e2e import mk_driver
+
+    d = mk_driver(tmp_path, FakeKube())
+    d.start()
+    yield d
+    d.stop()
+
+
+class TestChipClaimContract:
+    def test_container_env_parses_into_claim_env(self, driver):
+        kube = driver._kube if hasattr(driver, "_kube") else None
+        claim = mk_claim("wl-env", ["tpu-1", "tpu-2"], name="wl")
+        resp = driver.prepare_resource_claims([claim])
+        result = resp["claims"]["wl-env"]
+        assert "error" not in result, result
+
+        spec = driver.state._cdi.read_claim_spec("wl-env")
+        ids = [i for dev in result["devices"] for i in dev["cdiDeviceIDs"]]
+        env, nodes, _ = apply_cdi(spec, ids)
+
+        # What the container would see, parsed by the workload layer.
+        claim_env = ClaimEnv.from_environ(env)
+        assert claim_env.visible_devices == [1, 2]
+        assert len(claim_env.coords) == 2
+        assert claim_env.generation
+        assert claim_env.clique_id
+        # Granted chips are adjacent on the host mesh: bounding box covers 2.
+        bx, by, bz = claim_env.mesh_bounds
+        assert bx * by * bz >= 2
+        # Device nodes for exactly the granted chips.
+        assert any("accel1" in n for n in nodes)
+        assert any("accel2" in n for n in nodes)
+        assert not any("accel0" in n for n in nodes)
+        driver.unprepare_resource_claims([{"uid": "wl-env"}])
+
+
+class TestPartitionClaimContract:
+    def test_partition_grant_round_trips(self, tmp_path):
+        from tests.test_e2e import mk_driver
+
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        d = mk_driver(tmp_path, FakeKube())
+        d.start()
+        try:
+            claim = mk_claim(
+                "wl-part",
+                ["tpu-0-part-1c.4hbm-0-0"],
+                configs=[opaque({
+                    "apiVersion": API_V,
+                    "kind": "TpuPartitionConfig",
+                })],
+                name="wlp",
+            )
+            resp = d.prepare_resource_claims([claim])
+            result = resp["claims"]["wl-part"]
+            assert "error" not in result, result
+            spec = d.state._cdi.read_claim_spec("wl-part")
+            ids = [i for dev in result["devices"] for i in dev["cdiDeviceIDs"]]
+            env, _, _ = apply_cdi(spec, ids)
+            claim_env = ClaimEnv.from_environ(env)
+            assert claim_env.partitions, env
+            (name, desc), = claim_env.partitions.items()
+            assert "1c.4hbm@" in desc
+            d.unprepare_resource_claims([{"uid": "wl-part"}])
+        finally:
+            d.stop()
+
+
+class TestChannelClaimContract:
+    def test_channel_grant_env_reaches_distributed_init_contract(self, tmp_path):
+        """A ComputeDomain channel grant's env must satisfy what
+        ClaimEnv.initialize_distributed needs (host count/rank parsing) —
+        coordinator comes from the daemon settings side."""
+        from tests.test_computedomain import (
+            Controller,
+            ManagerConfig,
+            _channel_claim,
+            _mk_cddriver,
+            mk_cd,
+            mk_node,
+        )
+        from tpudra.cddaemon.cdclique import CliqueManager
+
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube, num_nodes=1)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+        clique = CliqueManager(kube, "tpudra-system", uid, "s1.0", "node-a", "10.0.0.1")
+        clique.join()
+        clique.update_daemon_status(True)
+        c = Controller(kube, ManagerConfig(driver_namespace="tpudra-system"))
+        c.manager.sync_status(kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns"))
+
+        claim = _channel_claim("wl-ch", uid, "channel-3")
+        resp = drv.prepare_resource_claims([claim])
+        result = resp["claims"]["wl-ch"]
+        assert result.get("devices"), result
+        spec = drv.state._cdi.read_claim_spec("wl-ch")
+        ids = [i for dev in result["devices"] for i in dev["cdiDeviceIDs"]]
+        env, nodes, _ = apply_cdi(spec, ids)
+        claim_env = ClaimEnv.from_environ(env)
+        assert claim_env.domain_uid == uid
+        assert claim_env.channel_ids == [3]
+        assert claim_env.num_hosts == 2 and claim_env.host_index == 0
+        assert any("channel3" in n for n in nodes)
