@@ -55,7 +55,6 @@ def driver(tmp_path):
 
 class TestChipClaimContract:
     def test_container_env_parses_into_claim_env(self, driver):
-        kube = driver._kube if hasattr(driver, "_kube") else None
         claim = mk_claim("wl-env", ["tpu-1", "tpu-2"], name="wl")
         resp = driver.prepare_resource_claims([claim])
         result = resp["claims"]["wl-env"]

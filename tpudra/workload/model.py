@@ -85,6 +85,14 @@ class ModelConfig:
 
         if jax.default_backend() != "tpu":
             return False
+        # Multi-device GSPMD cannot partition a pallas call — XLA would
+        # replicate it and gather the activations around the kernel.  On
+        # meshes, naive attention (whose einsums XLA partitions natively)
+        # and ring attention own the problem; the pallas path is for
+        # single-device programs (or per-shard code under shard_map, where
+        # the explicit "flash"/"splash" override applies).
+        if jax.device_count() != 1:
+            return False
         if self.head_dim % 128 != 0:
             return False
         # Block shapes must divide the sequence: either the tuned 512/1024
