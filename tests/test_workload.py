@@ -216,7 +216,16 @@ class TestAttentionSelection:
         from tpudra.workload.model import ModelConfig
 
         assert ModelConfig(attention="flash").use_flash_attention(128)
+        assert ModelConfig(attention="splash").use_flash_attention(128)
         assert not ModelConfig(attention="naive").use_flash_attention(1 << 20)
+
+    def test_config_validation(self):
+        from tpudra.workload.model import ModelConfig
+
+        with pytest.raises(ValueError, match="attention"):
+            ModelConfig(attention="flsh")
+        with pytest.raises(ValueError, match="divisible"):
+            ModelConfig(d_model=100, n_heads=3)
 
     def test_naive_path_still_trains(self):
         # The branch refactor must not disturb the default path.

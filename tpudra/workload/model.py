@@ -60,17 +60,27 @@ class ModelConfig:
     # v5e (128 and full-width are both slower).  Short sequences fall into
     # the tail path automatically.
     ce_chunk: int = 512
-    # Attention core: "auto" | "naive" | "flash".  Measured on v5e (472M
-    # params): the pallas flash kernel with tuned q512/k1024 blocks beats
-    # XLA's fused naive chain at every length it can run — 61.6% vs 51.9%
-    # MFU at seq 1024, 64.7% at seq 8192 where naive cannot even compile
-    # (the f32 score tensor exceeds HBM).  The kernel's DEFAULT block sizes
-    # are 3.2x slower than tuned ones at seq 8192 — never use it unturned.
-    # "auto" picks tuned flash on TPU whenever the block shapes divide the
-    # sequence (seq % 1024 == 0, or seq itself a smaller 128-multiple) and
-    # head_dim is MXU-aligned; everything else (CPU, odd lengths) takes the
-    # naive path.
+    # Attention core: "auto" | "naive" | "flash"/"splash".  Measured on
+    # v5e (472M params): the pallas splash kernel with 1024-wide blocks
+    # and its fused backward beats XLA's fused naive chain at every length
+    # it can run — 66-67% vs 52% MFU at seq 1024, and past the HBM cliff
+    # (seq > ~2048) it is the only path that compiles at all (72% MFU at
+    # 8192, 78% at 16384).  Both pallas kernels LOSE to naive at their
+    # default block sizes — the tuning is the feature.  "auto" picks the
+    # kernel for single-device TPU programs whose block shapes divide the
+    # sequence and whose head_dim is MXU-aligned; meshes, CPU, and odd
+    # lengths take the naive path.
     attention: str = "auto"
+
+    def __post_init__(self):
+        if self.attention not in ("auto", "naive", "flash", "splash"):
+            raise ValueError(
+                f"attention must be auto|naive|flash|splash, got {self.attention!r}"
+            )
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by n_heads {self.n_heads}"
+            )
 
     @property
     def head_dim(self) -> int:
