@@ -212,7 +212,10 @@ def bench_tpu_step() -> dict:
             # A ~472M-param train step on a host CPU takes minutes-to-hours;
             # this section only means anything on an accelerator.
             return {"skipped": "no accelerator (jax platform is cpu)"}
-        cfg = m.ModelConfig(**BENCH_MODEL)
+        # Explicit splash: this is a deliberately single-device program,
+        # and "auto" conservatively declines the pallas path when the host
+        # exposes multiple chips (model.py use_flash_attention).
+        cfg = m.ModelConfig(**BENCH_MODEL, attention="splash")
         params = m.init_params(jax.random.PRNGKey(0), cfg)
         n_params = sum(x.size for x in jax.tree.leaves(params))
         init_opt, train_step = m.make_train_step(cfg)
@@ -266,8 +269,7 @@ def bench_long_context() -> dict:
     """Long-context train step (seq 8192) on the real chip.
 
     At this length the naive attention's f32 score tensor cannot fit HBM —
-    the model's flash path (ModelConfig.attention="auto" → pallas flash
-    kernel on TPU) is what makes the step exist at all.  The reference has
+    the model's pallas splash path is what makes the step exist at all.  The reference has
     no analog; the closest is its MNNVL claim that the fabric extends the
     memory domain — this is the single-chip version of "long context
     actually trains".
@@ -281,7 +283,7 @@ def bench_long_context() -> dict:
             return {"skipped": "no accelerator"}
         cfg = m.ModelConfig(
             vocab=32768, d_model=2048, n_heads=16, n_layers=8, d_ff=8192,
-            max_seq=8192,
+            max_seq=8192, attention="splash",
         )
         batch = 2
         params = m.init_params(jax.random.PRNGKey(0), cfg)

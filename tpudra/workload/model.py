@@ -98,16 +98,19 @@ class ModelConfig:
         # Multi-device GSPMD cannot partition a pallas call — XLA would
         # replicate it and gather the activations around the kernel.  On
         # meshes, naive attention (whose einsums XLA partitions natively)
-        # and ring attention own the problem; the pallas path is for
-        # single-device programs (or per-shard code under shard_map, where
-        # the explicit "flash"/"splash" override applies).
+        # and ring attention own the problem.  The config cannot see the
+        # program's sharding, so auto is conservative: any process with
+        # multiple visible devices takes naive.  A deliberately
+        # single-device program on a multi-chip host (bench.py does this)
+        # or per-shard code under shard_map should pass
+        # attention="splash" explicitly.
         if jax.device_count() != 1:
             return False
         if self.head_dim % 128 != 0:
             return False
-        # Block shapes must divide the sequence: either the tuned 512/1024
-        # blocks fit, or the sequence itself is a small 128-multiple that
-        # becomes the block.
+        # Block shapes must divide the sequence: either the tuned
+        # 1024-wide blocks fit, or the sequence itself is a small
+        # 128-multiple that becomes the block.
         return seq_len % 1024 == 0 or (seq_len <= 512 and seq_len % 128 == 0)
 
 
