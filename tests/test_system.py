@@ -150,11 +150,22 @@ class TestKubeletPluginProcess:
                 ]
                 assert "tpu-0" in devices
 
-                # Liveness endpoint self-probes both live sockets.
-                resp = urllib.request.urlopen(
-                    f"http://127.0.0.1:{hc_port}/healthz", timeout=5
-                )
-                assert resp.status == 200
+                # Liveness endpoint self-probes both live sockets.  Poll:
+                # the binary starts the healthcheck server *after* the
+                # driver, so slices can be visible a beat before the HTTP
+                # socket listens.
+                def healthz_ok():
+                    try:
+                        return (
+                            urllib.request.urlopen(
+                                f"http://127.0.0.1:{hc_port}/healthz", timeout=5
+                            ).status
+                            == 200
+                        )
+                    except OSError:
+                        return False
+
+                wait_for(healthz_ok, msg="healthcheck endpoint")
 
                 # Act as kubelet: DRA gRPC over the unix socket.
                 claim = {
