@@ -193,7 +193,12 @@ class TestKubeletPluginProcess:
                 finally:
                     dra.close()
             finally:
-                terminate(proc, "tpu-kubelet-plugin")
+                out = terminate(proc, "tpu-kubelet-plugin")
+                # Level-0 logging contract (test_cd_logging.bats analog):
+                # build identity + full startup-config + feature-gate dump.
+                assert "tpudra 0." in out
+                assert "startup config:" in out and "node_name='sys-node'" in out
+                assert "feature gates:" in out
 
 
 class TestCDKubeletPluginProcess:
