@@ -11,7 +11,7 @@ This implements exactly the template subset the chart uses, so
 - control flow: ``if``/``else if``/``else``/``end``, ``range $k, $v := ...``
 - ``define``/``include`` (loaded from ``_*.tpl`` files)
 - functions: ``quote squote default not and or eq ne empty fail printf
-  toYaml nindent indent trunc trimSuffix lower contains replace required``
+  toYaml nindent indent trunc trimSuffix lower contains replace required join``
 - pipelines: ``a | b | c``
 
 It is intentionally NOT a general Go-template engine: unsupported syntax
@@ -219,6 +219,8 @@ class Evaluator:
             return str(args[0]) in str(args[1])
         if fn == "replace":
             return str(args[2]).replace(str(args[0]), str(args[1]))
+        if fn == "join":
+            return str(args[0]).join(str(x) for x in (args[1] or []))
         if fn == "include":
             name, dot = args[0], args[1]
             body = self.defines.get(name)
