@@ -162,6 +162,23 @@ class TestToggles:
             for d in by_kind(rendered, "Deployment")
         )
 
+    def test_additional_namespaces_env(self, chart):
+        def controller_env(rendered):
+            dep = [
+                d for docs in rendered.values() for d in docs
+                if d.get("kind") == "Deployment" and "controller" in d["metadata"]["name"]
+            ][0]
+            return {
+                e["name"]: e.get("value")
+                for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+            }
+
+        env = controller_env(
+            chart.render({"controller": {"additionalNamespaces": ["old-ns", "older-ns"]}})
+        )
+        assert env["ADDITIONAL_NAMESPACES"] == "old-ns,older-ns"
+        assert "ADDITIONAL_NAMESPACES" not in controller_env(chart.render())
+
     def test_network_policy_toggle(self, chart):
         assert by_kind(chart.render(), "NetworkPolicy") == []
         rendered = chart.render({"networkPolicy": {"enabled": True}})
