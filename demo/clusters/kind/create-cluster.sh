@@ -21,8 +21,11 @@ kind create cluster --name "${CLUSTER_NAME}" \
 echo "==> building driver image ${IMAGE}"
 docker build -f "${REPO}/deployments/container/Dockerfile" -t "${IMAGE}" "${REPO}"
 
-echo "==> loading image into kind"
-kind load docker-image --name "${CLUSTER_NAME}" "${IMAGE}"
+echo "==> building workload image (driver runtime + jax, for demo pods)"
+docker build -f "${REPO}/deployments/container/Dockerfile" --target workload   -t tpudra-workload:latest "${REPO}"
+
+echo "==> loading images into kind"
+kind load docker-image --name "${CLUSTER_NAME}" "${IMAGE}" tpudra-workload:latest
 
 echo "==> installing chart (mock device backend)"
 "${HERE}/install-driver.sh"
