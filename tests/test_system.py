@@ -430,3 +430,65 @@ class TestWebhookProcess:
                 assert "NopeConfig" in resp["response"]["status"]["message"]
             finally:
                 terminate(proc, "tpudra-webhook")
+
+
+class TestMPControlDaemonProcess:
+    def test_broker_protocol_and_probe(self, short_tmp):
+        """The per-claim MP control daemon as a process: limits
+        materialized from env, ATTACH/DETACH brokered over the control
+        socket, the `status` probe (the Deployment's readinessProbe)
+        agreeing, and clean SIGTERM shutdown."""
+        import json
+
+        pipe_dir = os.path.join(short_tmp, "mp")
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            TPUDRA_MP_PIPE_DIRECTORY=pipe_dir,
+            TPUDRA_MP_CHIP_UUIDS="chip-a,chip-b",
+            TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE="50",
+            TPUDRA_MP_PINNED_HBM_LIMITS="chip-a=6144Mi;chip-b=6144Mi",
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpudra.mpdaemon", "run"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            from tpudra.mpdaemon import LIMITS_FILE, query
+
+            wait_for(
+                lambda: os.path.exists(os.path.join(pipe_dir, "control.sock")),
+                msg="control socket",
+            )
+            with open(os.path.join(pipe_dir, LIMITS_FILE)) as f:
+                limits = json.load(f)
+            assert limits["chipUUIDs"] == ["chip-a", "chip-b"]
+            assert limits["activeTensorCorePercentage"] == 50
+            assert limits["pinnedHbmLimits"]["chip-b"] == "6144Mi"
+
+            assert query(pipe_dir, "STATUS") == "READY 0"
+            resp = query(pipe_dir, "ATTACH 1234")
+            assert resp.startswith("OK ")
+            assert json.loads(resp[3:])["activeTensorCorePercentage"] == 50
+            assert query(pipe_dir, "STATUS") == "READY 1"
+            assert query(pipe_dir, "DETACH 1234") == "OK"
+            assert query(pipe_dir, "STATUS") == "READY 0"
+
+            # The readiness probe the Deployment template runs.
+            probe = subprocess.run(
+                [sys.executable, "-m", "tpudra.mpdaemon", "status"],
+                env=env, capture_output=True, text=True,
+            )
+            assert probe.returncode == 0, probe.stdout + probe.stderr
+        finally:
+            terminate_simple = proc.poll() is None
+            if terminate_simple:
+                proc.send_signal(signal.SIGTERM)
+            out, _ = proc.communicate(timeout=20)
+            assert proc.returncode == 0, out[-2000:]
+        # Probe against the stopped daemon fails (socket gone).
+        probe = subprocess.run(
+            [sys.executable, "-m", "tpudra.mpdaemon", "status"],
+            env=env, capture_output=True, text=True,
+        )
+        assert probe.returncode == 1

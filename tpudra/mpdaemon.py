@@ -1,0 +1,166 @@
+"""The multi-process control daemon (the MPS control daemon analog).
+
+The TPU kubelet plugin stamps a per-claim Deployment running this binary
+(``templates/multi-process-daemon.tmpl.yaml``, reference
+mps-control-daemon.tmpl.yaml); consumer containers of the claim get
+``TPUDRA_MP_PIPE_DIRECTORY`` pointing at the shared hostPath this daemon
+owns.  The broker contract:
+
+- on startup the daemon materializes the claim's sharing policy into
+  ``limits.json`` in the pipe directory (chip UUIDs, active-TensorCore
+  percentage, per-chip pinned-HBM limits — resolved by the plugin from the
+  opaque MultiProcessConfig, tpudra/api/sharing.py normalized_limits);
+- it serves a unix socket ``control.sock`` there: clients ATTACH/DETACH
+  (the admission point a hardware broker would enforce limits at) and
+  anyone may ask STATUS;
+- the readiness probe is ``tpu-mp-control-daemon status`` — exit 0 iff the
+  socket answers READY, which is what lets the plugin's AssertReady (and
+  the pod's readinessProbe) gate workload prepare on the broker being up.
+
+Subcommands: ``run`` (default) and ``status``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import socketserver
+import sys
+import threading
+
+logger = logging.getLogger(__name__)
+
+CONTROL_SOCK = "control.sock"
+LIMITS_FILE = "limits.json"
+
+
+def _pipe_dir(env=None) -> str:
+    env = os.environ if env is None else env
+    d = env.get("TPUDRA_MP_PIPE_DIRECTORY", "")
+    if not d:
+        raise SystemExit("TPUDRA_MP_PIPE_DIRECTORY is not set")
+    return d
+
+
+class ControlDaemon:
+    def __init__(self, pipe_dir: str, env=None):
+        env = os.environ if env is None else env
+        self.pipe_dir = pipe_dir
+        self.limits = {
+            "chipUUIDs": [
+                u for u in env.get("TPUDRA_MP_CHIP_UUIDS", "").split(",") if u
+            ],
+            "activeTensorCorePercentage": int(
+                env.get("TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE", "100") or "100"
+            ),
+            # "uuid=limitMi;..." as rendered by the plugin.
+            "pinnedHbmLimits": dict(
+                kv.split("=", 1)
+                for kv in env.get("TPUDRA_MP_PINNED_HBM_LIMITS", "").split(";")
+                if "=" in kv
+            ),
+        }
+        self._clients: set[str] = set()
+        self._lock = threading.Lock()
+        self._server: socketserver.ThreadingUnixStreamServer | None = None
+
+    @property
+    def sock_path(self) -> str:
+        return os.path.join(self.pipe_dir, CONTROL_SOCK)
+
+    def start(self) -> None:
+        os.makedirs(self.pipe_dir, exist_ok=True)
+        with open(os.path.join(self.pipe_dir, LIMITS_FILE), "w") as f:
+            json.dump(self.limits, f, indent=2)
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+        daemon = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                line = self.rfile.readline().decode(errors="replace").strip()
+                verb, _, arg = line.partition(" ")
+                with daemon._lock:
+                    if verb == "ATTACH" and arg:
+                        daemon._clients.add(arg)
+                        resp = "OK " + json.dumps(daemon.limits)
+                    elif verb == "DETACH" and arg:
+                        daemon._clients.discard(arg)
+                        resp = "OK"
+                    elif verb == "STATUS":
+                        resp = f"READY {len(daemon._clients)}"
+                    else:
+                        resp = f"ERR unknown verb {verb!r}"
+                self.wfile.write((resp + "\n").encode())
+
+        self._server = socketserver.ThreadingUnixStreamServer(self.sock_path, Handler)
+        self._server.daemon_threads = True
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="mp-control"
+        ).start()
+        logger.info(
+            "mp control daemon up: %d chip(s), %d%% TensorCore, socket %s",
+            len(self.limits["chipUUIDs"]),
+            self.limits["activeTensorCorePercentage"],
+            self.sock_path,
+        )
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        try:
+            os.unlink(self.sock_path)
+        except FileNotFoundError:
+            pass
+
+
+def query(pipe_dir: str, line: str, timeout: float = 2.0) -> str:
+    with socket.socket(socket.AF_UNIX) as s:
+        s.settimeout(timeout)
+        s.connect(os.path.join(pipe_dir, CONTROL_SOCK))
+        s.sendall((line + "\n").encode())
+        return s.makefile().readline().strip()
+
+
+def status(pipe_dir: str | None = None) -> int:
+    """Probe entry: exit 0 iff the broker answers READY."""
+    try:
+        resp = query(pipe_dir or _pipe_dir(), "STATUS")
+    except OSError as e:
+        print(f"NOT_READY: {e}")
+        return 1
+    print(resp)
+    return 0 if resp.startswith("READY") else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("tpu-mp-control-daemon")
+    sub = p.add_subparsers(dest="command")
+    sub.add_parser("run", help="run the per-claim control daemon (default)")
+    sub.add_parser("status", help="probe: exit 0 iff the broker is READY")
+    args = p.parse_args(argv)
+
+    if args.command == "status":
+        return status()
+
+    logging.basicConfig(
+        level=logging.INFO, format="%(asctime)s %(levelname).1s %(name)s: %(message)s"
+    )
+    daemon = ControlDaemon(_pipe_dir())
+    daemon.start()
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
