@@ -204,3 +204,35 @@ class TestManifests:
         assert tpl["resourceClaims"][0]["resourceClaimTemplateName"] == "rct-x"
         envs = {e["name"] for e in tpl["containers"][0]["env"]}
         assert {"CD_UID", "NAMESPACE", "NODE_NAME", "POD_IP"} <= envs
+
+    def test_all_template_commands_resolve(self):
+        """Every command a template or chart container runs must be a real
+        console script (pyproject) or a script the image ships — a typo'd
+        binary name crash-loops only on a real cluster."""
+        import re
+        import tomllib
+
+        with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
+            known = set(tomllib.load(f)["project"]["scripts"])
+        # Scripts COPY'd into the image by the Dockerfile.
+        with open(
+            os.path.join(REPO, "deployments", "container", "Dockerfile")
+        ) as f:
+            for m in re.findall(r"COPY\s+\S+\s+/usr/local/bin/(\S+)", f.read()):
+                known.add(m)
+        known |= {"python"}  # base-image interpreter
+
+        files = glob.glob(os.path.join(REPO, "templates", "*.yaml"))
+        files += glob.glob(
+            os.path.join(REPO, "deployments", "helm", "tpu-dra-driver",
+                         "templates", "*.yaml")
+        )
+        checked = 0
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    m = re.search(r'command:\s*\[\s*"([^"]+)"', line)
+                    if m:
+                        assert m.group(1) in known, (path, m.group(1))
+                        checked += 1
+        assert checked >= 8
