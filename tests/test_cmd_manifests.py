@@ -57,6 +57,27 @@ class TestEntryPoints:
         monkeypatch.setenv("TPUDRA_GIT_COMMIT", "abc1234")
         assert buildinfo.version_string() == "tpudra 9.9.9 (commit abc1234)"
 
+    def test_log_verbosity_propagation(self, monkeypatch):
+        """LOG_VERBOSITY >= 4 (rendered into daemon pods by the controller)
+        turns on debug logging unless LOG_LEVEL was set explicitly —
+        completing the verbosity-propagation chain the DS template starts."""
+        import argparse
+        import logging
+
+        from tpudra.flags import setup_common
+
+        monkeypatch.delenv("LOG_LEVEL", raising=False)
+        monkeypatch.setenv("LOG_VERBOSITY", "5")
+        monkeypatch.setattr(logging.root, "handlers", [])
+        setup_common(argparse.Namespace(log_level="INFO", feature_gates=""))
+        assert logging.root.level == logging.DEBUG
+
+        # Explicit LOG_LEVEL wins over the verbosity hint.
+        monkeypatch.setenv("LOG_LEVEL", "WARNING")
+        monkeypatch.setattr(logging.root, "handlers", [])
+        setup_common(argparse.Namespace(log_level="WARNING", feature_gates=""))
+        assert logging.root.level == logging.WARNING
+
     def test_env_mirrors_win_over_defaults(self, monkeypatch):
         monkeypatch.setenv("NODE_NAME", "n2")
         monkeypatch.setenv("CDI_ROOT", "/custom/cdi")

@@ -45,8 +45,19 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
 
 
 def setup_common(args: argparse.Namespace) -> None:
+    level_name = args.log_level.upper()
+    # Verbosity propagation: the controller renders its numeric verbosity
+    # into spawned daemon pods as LOG_VERBOSITY (the reference's klog -v
+    # template propagation, daemonset.go:45-56).  A klog-style v>=4 means
+    # debug; an explicit LOG_LEVEL/--log-level still wins.
+    if "LOG_LEVEL" not in os.environ and level_name == "INFO":
+        try:
+            if int(os.environ.get("LOG_VERBOSITY", "0") or "0") >= 4:
+                level_name = "DEBUG"
+        except ValueError:
+            pass
     logging.basicConfig(
-        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        level=getattr(logging, level_name, logging.INFO),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
     if args.feature_gates:
