@@ -140,18 +140,19 @@ def status(pipe_dir: str | None = None) -> int:
 
 
 def main(argv=None) -> int:
+    from tpudra.flags import add_common_flags, setup_common
+
     p = argparse.ArgumentParser("tpu-mp-control-daemon")
     sub = p.add_subparsers(dest="command")
-    sub.add_parser("run", help="run the per-claim control daemon (default)")
+    run_p = sub.add_parser("run", help="run the per-claim control daemon (default)")
+    add_common_flags(run_p)
     sub.add_parser("status", help="probe: exit 0 iff the broker is READY")
     args = p.parse_args(argv)
 
     if args.command == "status":
         return status()
 
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(levelname).1s %(name)s: %(message)s"
-    )
+    setup_common(args)  # shared logging/gates, honors LOG_LEVEL/LOG_VERBOSITY
     daemon = ControlDaemon(_pipe_dir())
     daemon.start()
     stop = threading.Event()

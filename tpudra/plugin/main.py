@@ -43,6 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="liveness HTTP port; < 0 disables [HEALTHCHECK_PORT]",
     )
     p.add_argument("--k8s-minor", type=int, default=int(env_default("K8S_MINOR", "35")))
+    p.add_argument(
+        "--mp-daemon-image",
+        default=env_default("MP_DAEMON_IMAGE", "tpudra:latest"),
+        help="image for per-claim multi-process control daemons; the binary "
+        "ships in the driver image [MP_DAEMON_IMAGE]",
+    )
     return p
 
 
@@ -69,7 +75,9 @@ def main(argv=None) -> int:
         ),
         kube,
         lib,
-        mp_manager=MultiProcessManager(kube, lib, args.node_name),
+        mp_manager=MultiProcessManager(
+            kube, lib, args.node_name, image=args.mp_daemon_image
+        ),
         vfio_manager=VfioManager(),
     )
     driver.start()

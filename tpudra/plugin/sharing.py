@@ -159,7 +159,10 @@ class MultiProcessManager:
         namespace: str = "tpudra-system",
         pipe_root: str = "/var/run/tpudra/mp",
         template_path: str = DEFAULT_TEMPLATE_PATH,
-        image: str = "tpudra/mp-control-daemon:latest",
+        # The control daemon ships IN the driver image (console script
+        # tpu-mp-control-daemon); the chart passes the deployed driver
+        # image via --mp-daemon-image / MP_DAEMON_IMAGE.
+        image: str = "tpudra:latest",
     ):
         self.kube = kube
         self.devicelib = devicelib
