@@ -324,3 +324,36 @@ print(f"OK host={env.host_index} sum={val}")
         for idx, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {idx} failed:\n{out}"
             assert f"OK host={idx}" in out, out
+
+
+class TestMultiProcessClient:
+    def test_attach_detach_against_live_broker(self, tmp_path):
+        """Workload side of the MPS-analog: ClaimEnv.attach_multiprocess
+        registers with the per-claim control daemon, receives the limits,
+        and releases its slot on exit."""
+        from tpudra.mpdaemon import ControlDaemon, query
+
+        pipe_dir = str(tmp_path / "mp")
+        daemon = ControlDaemon(
+            pipe_dir,
+            env={
+                "TPUDRA_MP_CHIP_UUIDS": "chip-x",
+                "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE": "25",
+                "TPUDRA_MP_PINNED_HBM_LIMITS": "chip-x=2048Mi",
+            },
+        )
+        daemon.start()
+        try:
+            env = ClaimEnv.from_environ({"TPUDRA_MP_PIPE_DIRECTORY": pipe_dir})
+            with env.attach_multiprocess() as limits:
+                assert limits["activeTensorCorePercentage"] == 25
+                assert limits["pinnedHbmLimits"] == {"chip-x": "2048Mi"}
+                assert query(pipe_dir, "STATUS") == "READY 1"
+            assert query(pipe_dir, "STATUS") == "READY 0"
+        finally:
+            daemon.stop()
+
+    def test_attach_is_noop_without_sharing(self):
+        env = ClaimEnv.from_environ({})
+        with env.attach_multiprocess() as limits:
+            assert limits is None

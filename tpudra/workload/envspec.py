@@ -32,6 +32,9 @@ class ClaimEnv:
     num_hosts: int = 1
     host_index: int = 0
     coordinator: str = ""  # host:port for jax.distributed DCN rendezvous
+    # Multi-process sharing (MPS analog): the per-claim control daemon's
+    # pipe directory, injected by the plugin's CDI edits.
+    mp_pipe_dir: str = ""
 
     @classmethod
     def from_environ(cls, env: Optional[dict] = None) -> "ClaimEnv":
@@ -57,6 +60,7 @@ class ClaimEnv:
         out.num_hosts = int(env.get("TPUDRA_NUM_HOSTS", "1") or "1")
         out.host_index = int(env.get("TPUDRA_HOST_INDEX", "0") or "0")
         out.coordinator = env.get("TPUDRA_COORDINATOR", "")
+        out.mp_pipe_dir = env.get("TPUDRA_MP_PIPE_DIRECTORY", "")
         return out
 
     @property
@@ -87,6 +91,42 @@ class ClaimEnv:
             num_processes=self.num_hosts,
             process_id=self.host_index,
         )
+
+    def attach_multiprocess(self):
+        """Register with the claim's multi-process control daemon and return
+        the granted limits (the CUDA-MPS-client analog: chip UUIDs,
+        active-TensorCore percentage, pinned-HBM budgets).
+
+        Returns a context manager; DETACH happens on exit.  No-op (yields
+        None) when the grant carries no multi-process sharing.
+        """
+        import contextlib
+
+        env = self
+
+        @contextlib.contextmanager
+        def session():
+            if not env.mp_pipe_dir:
+                yield None
+                return
+            import json
+            import os as _os
+
+            from tpudra.mpdaemon import query
+
+            me = str(_os.getpid())
+            resp = query(env.mp_pipe_dir, f"ATTACH {me}")
+            if not resp.startswith("OK "):
+                raise RuntimeError(f"mp control daemon refused attach: {resp}")
+            try:
+                yield json.loads(resp[3:])
+            finally:
+                try:
+                    query(env.mp_pipe_dir, f"DETACH {me}")
+                except OSError:
+                    pass  # daemon went away; nothing to release
+
+        return session()
 
 
 def mesh_from_devices(
