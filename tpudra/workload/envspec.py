@@ -12,6 +12,7 @@ shape follows the granted topology rather than a hardcoded world size.
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -92,41 +93,38 @@ class ClaimEnv:
             process_id=self.host_index,
         )
 
+    @_contextmanager
     def attach_multiprocess(self):
-        """Register with the claim's multi-process control daemon and return
+        """Register with the claim's multi-process control daemon and yield
         the granted limits (the CUDA-MPS-client analog: chip UUIDs,
         active-TensorCore percentage, pinned-HBM budgets).
 
-        Returns a context manager; DETACH happens on exit.  No-op (yields
-        None) when the grant carries no multi-process sharing.
+        DETACH happens on exit.  No-op (yields None) when the grant carries
+        no multi-process sharing.
         """
-        import contextlib
+        if not self.mp_pipe_dir:
+            yield None
+            return
+        import json
+        import socket as _socket
+        import uuid as _uuid
 
-        env = self
+        from tpudra.mpdaemon import query
 
-        @contextlib.contextmanager
-        def session():
-            if not env.mp_pipe_dir:
-                yield None
-                return
-            import json
-            import os as _os
-
-            from tpudra.mpdaemon import query
-
-            me = str(_os.getpid())
-            resp = query(env.mp_pipe_dir, f"ATTACH {me}")
-            if not resp.startswith("OK "):
-                raise RuntimeError(f"mp control daemon refused attach: {resp}")
+        # Unique per client: consumer containers of one claim live in
+        # separate PID namespaces, so a bare pid would collide in the
+        # broker's client set (two containers can both be pid 7).
+        me = f"{_socket.gethostname()}-{os.getpid()}-{_uuid.uuid4().hex[:8]}"
+        resp = query(self.mp_pipe_dir, f"ATTACH {me}")
+        if not resp.startswith("OK "):
+            raise RuntimeError(f"mp control daemon refused attach: {resp}")
+        try:
+            yield json.loads(resp[3:])
+        finally:
             try:
-                yield json.loads(resp[3:])
-            finally:
-                try:
-                    query(env.mp_pipe_dir, f"DETACH {me}")
-                except OSError:
-                    pass  # daemon went away; nothing to release
-
-        return session()
+                query(self.mp_pipe_dir, f"DETACH {me}")
+            except OSError:
+                pass  # daemon went away; nothing to release
 
 
 def mesh_from_devices(
