@@ -257,3 +257,21 @@ class TestManifests:
                         assert m.group(1) in known, (path, m.group(1))
                         checked += 1
         assert checked >= 8
+
+    def test_dockerfile_default_target_is_driver(self):
+        """Docker builds the LAST stage by default; a plain `docker build .`
+        must yield the driver image, not the jax-bloated workload stage
+        (regression guard for the stage ordering)."""
+        with open(
+            os.path.join(REPO, "deployments", "container", "Dockerfile")
+        ) as f:
+            froms = [
+                line.strip() for line in f if line.strip().upper().startswith("FROM ")
+            ]
+        assert froms, "no FROM lines?"
+        last = froms[-1].split()
+        # Final stage must be (an alias of) the runtime stage with no
+        # additions after it — i.e. exactly "FROM runtime".
+        assert [w.lower() for w in last] == ["from", "runtime"], froms[-1]
+        # And the workload stage must exist for the demo image build.
+        assert any("as workload" in f.lower() for f in froms), froms
