@@ -150,3 +150,85 @@ class TestChannelClaimContract:
         assert claim_env.channel_ids == [3]
         assert claim_env.num_hosts == 2 and claim_env.host_index == 0
         assert any("channel3" in n for n in nodes)
+
+
+class TestMultiProcessContract:
+    def test_mp_grant_to_broker_attach_round_trip(self, tmp_path):
+        """The whole MPS-analog chain in one test: MultiProcess claim →
+        plugin stamps the broker Deployment + CDI env/mounts → container
+        env parses into ClaimEnv → a broker started from the Deployment's
+        own env accepts the workload's ATTACH and hands back the limits."""
+        from tests.test_e2e import mk_driver
+        from tpudra.mpdaemon import ControlDaemon
+        from tpudra.plugin.sharing import MultiProcessManager
+
+        fg.feature_gates().set_from_map({fg.MULTI_PROCESS_SHARING: True})
+        kube = FakeKube()
+
+        def make_ready(verb, g, obj):
+            if obj is not None and obj.get("kind") == "Deployment":
+                obj["status"] = {"readyReplicas": 1}
+
+        kube.react("create", gvr.DEPLOYMENTS, make_ready)
+        d = mk_driver(tmp_path, kube)
+        d.state._mp = MultiProcessManager(
+            kube, d.state._lib, "node-a", pipe_root=str(tmp_path / "mp")
+        )
+        d.start()
+        try:
+            claim = mk_claim(
+                "mp-1",
+                ["tpu-0"],
+                configs=[opaque({
+                    "apiVersion": API_V,
+                    "kind": "TpuConfig",
+                    "sharing": {
+                        "strategy": "MultiProcess",
+                        "multiProcessConfig": {
+                            "defaultActiveTensorCorePercentage": 40,
+                            "defaultPinnedHbmLimit": "4Gi",
+                        },
+                    },
+                })],
+                name="mp",
+            )
+            resp = d.prepare_resource_claims([claim])
+            result = resp["claims"]["mp-1"]
+            assert "error" not in result, result
+
+            spec = d.state._cdi.read_claim_spec("mp-1")
+            ids = [i for dev in result["devices"] for i in dev["cdiDeviceIDs"]]
+            env, _, mounts = apply_cdi(spec, ids)
+            claim_env = ClaimEnv.from_environ(env)
+            assert claim_env.mp_pipe_dir  # container-side path
+
+            # containerd would bind-mount hostPath → containerPath; resolve
+            # the broker's host-side pipe dir through that mapping.
+            host_pipe = {c: h for h, c in mounts}[claim_env.mp_pipe_dir]
+
+            # The broker runs from the Deployment's own rendered env.
+            dep = kube.list(gvr.DEPLOYMENTS, "tpudra-system")["items"][0]
+            dep_env = {
+                e["name"]: e.get("value", "")
+                for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+            }
+            broker = ControlDaemon(host_pipe, env=dep_env)
+            broker.start()
+            try:
+                # The workload's view: attach via the container path,
+                # remapped the way the mount would.
+                claim_env.mp_pipe_dir = host_pipe
+                with claim_env.attach_multiprocess() as limits:
+                    assert limits["activeTensorCorePercentage"] == 40
+                    assert limits["chipUUIDs"], limits
+                    # "M" means MiB here — the unit string the control
+                    # daemon consumes (reference sharing.go:236, the CUDA
+                    # MPS convention).
+                    assert any(
+                        v == "4096M" for v in limits["pinnedHbmLimits"].values()
+                    ), limits
+            finally:
+                broker.stop()
+            d.unprepare_resource_claims([{"uid": "mp-1"}])
+        finally:
+            d.stop()
