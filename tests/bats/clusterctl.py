@@ -1,0 +1,329 @@
+#!/usr/bin/env python3
+"""clusterctl — bring the hermetic cluster up/down for the bats e2e suite.
+
+The analog of the reference's ``tests/bats/helpers.sh`` install step (helm
+install into a kubectl-pointed cluster, helpers.sh:42-60), except nothing
+external is needed: `up` starts
+
+- the fake apiserver over HTTP (tpudra/kube/httpserver.py),
+- per-node TPU kubelet plugins (and, with --cd, ComputeDomain kubelet
+  plugins, the controller, and per-node fabric identity),
+- the scheduler/kubelet simulator (tpu-cluster-sim),
+
+registers Node objects, applies the chart's DeviceClasses (the "helm
+install" of the hermetic world), waits for ResourceSlice publication, and
+writes ``env.sh`` with the environment the bats files source.  `down`
+SIGTERMs everything it started, newest first.
+
+State-dir layout (keep the dir SHORT — AF_UNIX socket paths live in it):
+
+    <state>/apiserver.url      <state>/pids
+    <state>/env.sh             <state>/sim.json
+    <state>/<node>/{plugin,cdplugin,registry,cdi,cdwork,hosts,logs}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+NATIVE_BUILD = os.path.join(REPO, "native", "build")
+NAMESPACE = "tpudra-system"
+
+
+def free_ports(n: int) -> list[int]:
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for sk in socks:
+            sk.bind(("127.0.0.1", 0))
+        return [sk.getsockname()[1] for sk in socks]
+    finally:
+        for sk in socks:
+            sk.close()
+
+
+def wait_for(fn, timeout: float, msg: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            v = fn()
+        except Exception:  # noqa: BLE001 — the cluster is still booting
+            v = None
+        if v:
+            return v
+        time.sleep(0.1)
+    raise SystemExit(f"clusterctl: timed out waiting for {msg}")
+
+
+def record_pid(state: str, pid: int, what: str) -> None:
+    with open(os.path.join(state, "pids"), "a") as f:
+        f.write(f"{pid}\t{what}\n")
+
+
+def spawn(state: str, what: str, argv: list[str], env: dict) -> subprocess.Popen:
+    log_dir = os.path.join(state, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, f"{what}.log"), "w")
+    proc = subprocess.Popen(
+        argv, env=env, stdout=log, stderr=subprocess.STDOUT, start_new_session=True
+    )
+    log.close()
+    record_pid(state, proc.pid, what)
+    return proc
+
+
+def base_env(server_url: str) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["KUBE_API_SERVER"] = server_url
+    env["PYTHONUNBUFFERED"] = "1"
+    env.pop("KUBECONFIG", None)
+    return env
+
+
+# ----------------------------------------------------------------- serve
+
+
+def cmd_serve(args) -> int:
+    from tpudra.kube.httpserver import FakeKubeServer
+
+    server = FakeKubeServer()
+    server.start()
+    with open(args.url_file + ".tmp", "w") as f:
+        f.write(server.url)
+    os.replace(args.url_file + ".tmp", args.url_file)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    while not stop:
+        time.sleep(0.2)
+    server.stop()
+    return 0
+
+
+# -------------------------------------------------------------------- up
+
+
+def cmd_up(args) -> int:
+    from tpudra.kube import gvr
+    from tpudra.kube.client import KubeClient
+    from helmlite import Chart
+
+    state = args.state
+    os.makedirs(state, exist_ok=True)
+    open(os.path.join(state, "pids"), "w").close()
+
+    url_file = os.path.join(state, "apiserver.url")
+    spawn(state, "apiserver", [sys.executable, HERE + "/clusterctl.py", "serve",
+                               "--url-file", url_file], dict(os.environ))
+    wait_for(lambda: os.path.exists(url_file), 30, "apiserver URL")
+    server_url = open(url_file).read().strip()
+    kube = KubeClient(server_url)
+    wait_for(lambda: kube.list(gvr.PODS) is not None, 30, "apiserver answering")
+    env = base_env(server_url)
+
+    nodes = [f"node-{i}" for i in range(args.nodes)]
+    for n in nodes:
+        kube.create(gvr.NODES, {
+            "apiVersion": "v1", "kind": "Node",
+            "metadata": {"name": n, "labels": {"kubernetes.io/hostname": n}},
+        })
+
+    # "helm install": the chart's DeviceClasses are the scheduler-facing
+    # surface; the driver binaries below are the chart's DaemonSet payload.
+    rendered = Chart(CHART).render(namespace=NAMESPACE)
+    for docs in rendered.values():
+        for doc in docs:
+            if doc and doc.get("kind") == "DeviceClass":
+                kube.create(gvr.DEVICE_CLASSES, doc)
+
+    # Fabric identity for --cd: one slice spanning all nodes.
+    peer_ports = free_ports(args.nodes)
+    status_ports = free_ports(args.nodes)
+    port_map = ",".join(f"{i}={p}" for i, p in enumerate(peer_ports))
+
+    sim_nodes = []
+    for i, n in enumerate(nodes):
+        nd = os.path.join(state, n)
+        for sub in ("plugin", "cdplugin", "registry", "cdi", "cdwork"):
+            os.makedirs(os.path.join(nd, sub), exist_ok=True)
+        hosts = os.path.join(nd, "hosts")
+        open(hosts, "a").close()
+        topo = {
+            "generation": args.generation,
+            "num_chips": args.chips_per_node,
+            "slice_uuid": "bats-slice",
+            "host_index": i,
+            "num_hosts": args.nodes,
+        }
+        plug_env = dict(
+            env,
+            NODE_NAME=n,
+            TPUDRA_MOCK_TOPOLOGY=json.dumps(topo),
+        )
+        if args.feature_gates:
+            plug_env["FEATURE_GATES"] = args.feature_gates
+        spawn(state, f"plugin-{n}", [
+            sys.executable, "-m", "tpudra.plugin.main",
+            "--node-name", n,
+            "--plugin-dir", os.path.join(nd, "plugin"),
+            "--registry-dir", os.path.join(nd, "registry"),
+            "--cdi-root", os.path.join(nd, "cdi"),
+            "--device-backend", "mock",
+        ], plug_env)
+        drivers = {"tpu.google.com": os.path.join(nd, "plugin", "dra.sock")}
+        if args.cd:
+            spawn(state, f"cdplugin-{n}", [
+                sys.executable, "-m", "tpudra.cdplugin.main",
+                "--node-name", n,
+                "--plugin-dir", os.path.join(nd, "cdplugin"),
+                "--registry-dir", os.path.join(nd, "registry"),
+                "--cdi-root", os.path.join(nd, "cdi"),
+                "--device-backend", "mock",
+            ], plug_env)
+            drivers["compute-domain.tpu.google.com"] = os.path.join(
+                nd, "cdplugin", "dra.sock"
+            )
+        sim_nodes.append({
+            "name": n,
+            "drivers": drivers,
+            "cdi_roots": [os.path.join(nd, "cdi")],
+            "env": {
+                "PATH": NATIVE_BUILD + os.pathsep + os.environ.get("PATH", ""),
+                "TPUDRA_SIM_JAX_CPU": "1",
+                "STATUS_PORT": str(status_ports[i]),
+                "TPUDRA_PEER_PORT_MAP": port_map,
+                "HOSTS_PATH": hosts,
+                "WORK_DIR": os.path.join(nd, "cdwork"),
+            },
+        })
+
+    if args.cd:
+        spawn(state, "controller", [
+            sys.executable, "-m", "tpudra.controller.main",
+            "--namespace", NAMESPACE,
+        ], env)
+
+    sim_cfg = {
+        "server": server_url,
+        "nodes": sim_nodes,
+        "env": {
+            "KUBE_API_SERVER": server_url,
+            "PYTHONPATH": env["PYTHONPATH"],
+        },
+    }
+    sim_path = os.path.join(state, "sim.json")
+    with open(sim_path, "w") as f:
+        json.dump(sim_cfg, f, indent=2)
+    spawn(state, "cluster-sim", [
+        sys.executable, "-m", "tpudra.sim.main", "--config", sim_path,
+    ], env)
+
+    # Readiness: every node's TPU pool published; with --cd, every node's
+    # channel pool too (2048 channels + daemon arrive chunked).
+    def slices_ready():
+        items = kube.list(gvr.RESOURCE_SLICES).get("items", [])
+        tpu_nodes = {s["spec"].get("nodeName") for s in items
+                     if s["spec"]["driver"] == "tpu.google.com"}
+        if set(nodes) - tpu_nodes:
+            return False
+        if args.cd:
+            cd_nodes = {s["spec"].get("nodeName") for s in items
+                        if s["spec"]["driver"] == "compute-domain.tpu.google.com"}
+            if set(nodes) - cd_nodes:
+                return False
+        return True
+
+    wait_for(slices_ready, 90, "ResourceSlice publication")
+
+    with open(os.path.join(state, "env.sh"), "w") as f:
+        f.write(
+            f'export KUBE_API_SERVER="{server_url}"\n'
+            f'export TPUDRA_STATE="{state}"\n'
+            f'export TPUDRA_NAMESPACE="{NAMESPACE}"\n'
+            f'export TPUDRA_NODES="{" ".join(nodes)}"\n'
+            f'export PYTHONPATH="{env["PYTHONPATH"]}"\n'
+            f'export PATH="{os.path.join(REPO, "tests", "bats", "bin")}:'
+            f'{os.environ.get("PATH", "")}"\n'
+        )
+    print(state)
+    return 0
+
+
+# ------------------------------------------------------------------ down
+
+
+def cmd_down(args) -> int:
+    pids_file = os.path.join(args.state, "pids")
+    try:
+        entries = [line.split("\t") for line in open(pids_file).read().splitlines()]
+    except FileNotFoundError:
+        return 0
+    for pid_s, _what in reversed(entries):
+        try:
+            os.killpg(int(pid_s), signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            try:
+                os.kill(int(pid_s), signal.SIGTERM)
+            except (OSError, ProcessLookupError):
+                pass
+    deadline = time.monotonic() + 15
+    for pid_s, what in reversed(entries):
+        pid = int(pid_s)
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            print(f"clusterctl: {what} ({pid}) did not exit; SIGKILL", file=sys.stderr)
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+    os.unlink(pids_file)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="clusterctl", description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    sp = sub.add_parser("serve")
+    sp.add_argument("--url-file", required=True)
+    sp.set_defaults(fn=cmd_serve)
+
+    up = sub.add_parser("up")
+    up.add_argument("--state", required=True)
+    up.add_argument("--nodes", type=int, default=1)
+    up.add_argument("--cd", action="store_true",
+                    help="also start CD plugins + controller + fabric identity")
+    up.add_argument("--generation", default="v5p")
+    up.add_argument("--chips-per-node", type=int, default=4)
+    up.add_argument("--feature-gates", default="",
+                    help="FEATURE_GATES for the driver binaries")
+    up.set_defaults(fn=cmd_up)
+
+    dn = sub.add_parser("down")
+    dn.add_argument("--state", required=True)
+    dn.set_defaults(fn=cmd_down)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Shared helpers for the bats e2e suite (the analog of the reference's
+# tests/bats/helpers.sh).  Each test file calls `cluster_up [flags]` from
+# setup_file and `cluster_down` from teardown_file; the hermetic cluster is
+# per-file, like the reference's per-file helm install (helpers.sh:42-60).
+#
+# TPUDRA_BATS_KEEP=1 keeps the state dir on teardown for debugging.
+
+BATS_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO="$(cd "$BATS_DIR/../.." && pwd)"
+export PATH="$BATS_DIR/bin:$PATH"
+
+cluster_up() {
+  TPUDRA_STATE="$(mktemp -d /tmp/tpubats-XXXXXX)"
+  export TPUDRA_STATE
+  python3 "$BATS_DIR/clusterctl.py" up --state "$TPUDRA_STATE" "$@" >/dev/null
+  # shellcheck disable=SC1091
+  source "$TPUDRA_STATE/env.sh"
+}
+
+cluster_down() {
+  [ -n "${TPUDRA_STATE:-}" ] || return 0
+  python3 "$BATS_DIR/clusterctl.py" down --state "$TPUDRA_STATE" || true
+  if [ -z "${TPUDRA_BATS_KEEP:-}" ]; then
+    rm -rf "$TPUDRA_STATE"
+  else
+    echo "# state kept at $TPUDRA_STATE" >&2
+  fi
+}
+
+# wait_until <timeout-s> <cmd...> — poll until the command succeeds.
+wait_until() {
+  local timeout="$1"; shift
+  local deadline=$((SECONDS + timeout))
+  while [ "$SECONDS" -lt "$deadline" ]; do
+    if "$@" >/dev/null 2>&1; then return 0; fi
+    sleep 0.3
+  done
+  echo "wait_until: timed out: $*" >&2
+  return 1
+}
+
+# pod_phase <name> [ns]
+pod_phase() {
+  kubectl get pod "$1" -n "${2:-default}" -o 'jsonpath={.status.phase}' 2>/dev/null
+}
+
+# pod_succeeded <name> [ns] — true when phase is Succeeded.
+pod_succeeded() {
+  [ "$(pod_phase "$1" "${2:-default}")" = "Succeeded" ]
+}
+
+# pod_log_has <pod> <pattern> [ns]
+pod_log_has() {
+  kubectl logs "$1" -n "${3:-default}" | grep -q "$2"
+}
+
+# apply_spec <file relative to demo/specs or absolute>
+apply_spec() {
+  local f="$1"
+  [ -f "$f" ] || f="$REPO/demo/specs/$1"
+  kubectl apply -f "$f"
+}
+
+# plugin_log <what> — driver process logs from the state dir (the analog of
+# the reference's failure hooks dumping plugin logs, test_gpu_basic.bats:18).
+plugin_log() {
+  cat "$TPUDRA_STATE/logs/$1.log" 2>/dev/null || true
+}
+
+dump_cluster_state() {
+  echo "--- pods:"; kubectl get pods -A || true
+  echo "--- claims:"; kubectl get resourceclaims -A -o name || true
+  echo "--- slices:"; kubectl get resourceslices -o name || true
+  for f in "$TPUDRA_STATE"/logs/*.log; do
+    echo "--- ${f##*/} (tail):"; tail -20 "$f"
+  done
+}

@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# minibats — a minimal bats-core-compatible runner (this environment ships
+# no bats).  Supports the subset the suite uses: @test blocks, setup_file/
+# teardown_file (run once, in the runner shell so exported variables
+# persist), setup/teardown (per test, inside the test subshell), `run`
+# (captures $status/$output/$lines), and `skip`.  Real bats-core runs these
+# same files unmodified against a real cluster.
+#
+# Usage: minibats.sh FILE.bats [test-number ...]
+set -u
+
+FILE="${1:?usage: minibats.sh FILE.bats [n ...]}"
+shift || true
+ONLY=("$@")
+
+TMP="$(mktemp -d /tmp/minibats-XXXXXX)"
+trap 'rm -rf "$TMP"' EXIT
+
+# Transform "@test \"name\" {" into numbered functions, collecting names.
+awk -v namesfile="$TMP/names" '
+  /^[ \t]*@test[ \t]/ {
+    n++
+    line=$0
+    sub(/^[ \t]*@test[ \t]+"/, "", line)
+    sub(/"[ \t]*\{[ \t]*$/, "", line)
+    print n "\t" line >> namesfile
+    print "__minibats_test_" n "() {"
+    next
+  }
+  { print }
+' "$FILE" > "$TMP/suite.sh"
+
+COUNT=0
+[ -f "$TMP/names" ] && COUNT=$(wc -l < "$TMP/names")
+
+run() {
+  local _rc=0
+  set +e
+  output="$("$@" 2>&1)"
+  _rc=$?
+  set -e
+  status=$_rc
+  # shellcheck disable=SC2034
+  mapfile -t lines <<<"$output"
+  return 0
+}
+
+skip() {
+  echo "minibats-skip: ${1:-}" >&2
+  exit 200
+}
+
+# bats' `load` builtin: source relative to the test file's directory.
+BATS_TEST_DIRNAME="$(cd "$(dirname "$FILE")" && pwd)"
+export BATS_TEST_DIRNAME
+load() {
+  local f="$1"
+  [[ "$f" == /* ]] || f="$BATS_TEST_DIRNAME/$f"
+  [ -f "$f" ] || f="$f.bash"
+  # shellcheck disable=SC1090
+  source "$f"
+}
+
+export MINIBATS=1
+# shellcheck disable=SC1090
+source "$TMP/suite.sh"
+
+echo "1..$COUNT"
+declare -F setup_file >/dev/null && { setup_file || { echo "not ok 0 setup_file"; exit 1; }; }
+
+FAILED=0
+while IFS=$'\t' read -r idx name; do
+  if [ "${#ONLY[@]}" -gt 0 ]; then
+    keep=""
+    for o in "${ONLY[@]}"; do [ "$o" = "$idx" ] && keep=1; done
+    [ -z "$keep" ] && continue
+  fi
+  out_file="$TMP/out-$idx"
+  (
+    # errexit must stay live inside the test body: never invoke the test
+    # function from a condition/|| context (bash suppresses set -e there).
+    set -eE
+    trap 'declare -F teardown >/dev/null && teardown' EXIT
+    if declare -F setup >/dev/null; then setup; fi
+    "__minibats_test_$idx"
+  ) >"$out_file" 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    echo "ok $idx $name"
+  elif [ "$rc" -eq 200 ]; then
+    echo "ok $idx $name # SKIP"
+  else
+    echo "not ok $idx $name"
+    sed 's/^/#   /' "$out_file"
+    FAILED=$((FAILED + 1))
+  fi
+done < "$TMP/names"
+
+declare -F teardown_file >/dev/null && { teardown_file || true; }
+
+exit $((FAILED > 0))

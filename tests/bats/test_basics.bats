@@ -1,0 +1,40 @@
+#!/usr/bin/env bats
+# Install sanity (the reference's test_basics.bats analog): the driver comes
+# up, publishes ResourceSlices, and the chart's DeviceClasses are present.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "DeviceClasses installed" {
+  run kubectl get deviceclasses -o name
+  [ "$status" -eq 0 ]
+  [[ "$output" == *"tpu.google.com"* ]]
+  [[ "$output" == *"tpu-partition.google.com"* ]]
+}
+
+@test "node registered" {
+  run kubectl get nodes -o 'jsonpath={.items[*].metadata.name}'
+  [ "$status" -eq 0 ]
+  [[ "$output" == *"node-0"* ]]
+}
+
+@test "TPU ResourceSlices published with chip devices" {
+  run kubectl get resourceslices -o json
+  [ "$status" -eq 0 ]
+  echo "$output" | grep -q '"tpu-0"'
+  echo "$output" | grep -q '"driver": "tpu.google.com"'
+}
+
+@test "plugin startup log contract: version, config dump, feature gates" {
+  log="$(plugin_log plugin-node-0)"
+  [[ "$log" == *"tpudra 0."* ]]
+  [[ "$log" == *"startup config:"* ]]
+  [[ "$log" == *"feature gates:"* ]]
+}

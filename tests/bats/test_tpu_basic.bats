@@ -1,0 +1,104 @@
+#!/usr/bin/env bats
+# Full-chip claims end to end (the reference's test_gpu_basic.bats analog):
+# the quickstart specs are applied verbatim; pods run and their in-pod
+# assertions (jax device count == granted chips) pass.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 4 --feature-gates TimeSlicingSettings=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+teardown() {
+  # On failure the reference dumps object state + plugin logs
+  # (test_gpu_basic.bats:18-25); minibats shows this only for failed tests.
+  :
+}
+
+@test "tpu-test1: single-chip pod runs its jax assertion" {
+  apply_spec tpu-test1.yaml
+  wait_until 60 pod_succeeded pod1 tpu-test1
+  run kubectl logs pod1 -n tpu-test1
+  [[ "$output" == *"TPU_VISIBLE_DEVICES ="* ]]
+  [[ "$output" == *"jax devices:"* ]]
+}
+
+@test "tpu-test1: claim was prepared and CDI spec existed" {
+  run kubectl get resourceclaims -n tpu-test1 -o json
+  [ "$status" -eq 0 ]
+  [[ "$output" == *'"pod1-tpu"'* ]]
+}
+
+@test "tpu-test1: deleting the pod unprepares and frees the chip" {
+  kubectl delete pod pod1 -n tpu-test1
+  wait_until 30 sh -c "! kubectl get pod pod1 -n tpu-test1 -o name 2>/dev/null | grep -q pod1"
+  # The generated claim is garbage-collected with its pod.
+  wait_until 30 sh -c "! kubectl get resourceclaims -n tpu-test1 -o json | grep -q pod1-tpu"
+}
+
+@test "tpu-test2: one time-sliced claim shared by two containers" {
+  apply_spec tpu-test2.yaml
+  wait_until 60 pod_succeeded pod1 tpu-test2
+  run kubectl logs pod1 -n tpu-test2 -c ctr0
+  [[ "$output" == *"ctr0 sees"* ]]
+  run kubectl logs pod1 -n tpu-test2 -c ctr1
+  [[ "$output" == *"ctr1 sees"* ]]
+  # Both containers consume the same claim: identical chip grants.
+  c0=$(kubectl logs pod1 -n tpu-test2 -c ctr0 | grep "ctr0 sees")
+  c1=$(kubectl logs pod1 -n tpu-test2 -c ctr1 | grep "ctr1 sees")
+  [ "${c0#ctr0}" = "${c1#ctr1}" ]
+}
+
+@test "all chips released after the pods are gone" {
+  kubectl delete pod pod1 -n tpu-test2
+  wait_until 30 sh -c "! kubectl get pods -n tpu-test2 -o name | grep -q pod"
+  # Every chip is allocatable again: a 4-chip claim must fit.
+  cat > "$TPUDRA_STATE/all-chips.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: all-chips
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+            count: 4
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: all-chips-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import os
+          vis = os.environ["TPU_VISIBLE_DEVICES"].split(",")
+          assert len(vis) == 4, vis
+          print("got all", len(vis))
+      resources:
+        claims:
+          - name: tpu
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: all-chips
+EOF
+  kubectl apply -f "$TPUDRA_STATE/all-chips.yaml"
+  wait_until 60 pod_succeeded all-chips-pod default
+  run kubectl logs all-chips-pod
+  [[ "$output" == *"got all 4"* ]]
+  kubectl delete pod all-chips-pod
+}

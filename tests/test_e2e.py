@@ -6,7 +6,6 @@ reference could only run on hardware CI runners runs here on the mock
 backend.
 """
 
-import re
 import glob
 import os
 import threading
@@ -22,6 +21,7 @@ from tpudra.devicelib.mock import MockDeviceLib
 from tpudra.kube import gvr
 from tpudra.kube.fake import FakeKube
 from tpudra.plugin.driver import Driver, DriverConfig
+from tpudra.sim.sched import Scheduler
 from tpudra.plugin.grpcserver import DRAClient
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -34,165 +34,6 @@ def load_spec(name):
 
 def find(docs, kind):
     return [d for d in docs if d["kind"] == kind]
-
-
-class Scheduler:
-    """A micro-scheduler: allocates RCT device requests against the
-    ResourceSlices in the fake apiserver, first-fit, with KEP-4815
-    SharedCounters arithmetic — a full device blocks its partitions,
-    disjoint partitions co-allocate, and counter exhaustion refuses
-    (the scheduler-side contract of reference partitions.go:85-307)."""
-
-    def __init__(self, kube):
-        self._kube = kube
-        self._allocated: set[tuple[str, str]] = set()  # (pool, device)
-        # KEP-4815 ledger: units consumed per (pool, counterSet, counter).
-        self._consumed: dict[tuple[str, str, str], int] = {}
-        self._claim_demand: dict[str, dict[tuple[str, str, str], int]] = {}
-
-    def _published(self):
-        for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
-            pool = s["spec"]["pool"]["name"]
-            for dev in s["spec"]["devices"]:
-                yield pool, s["spec"]["driver"], dev
-
-    def _capacity(self) -> dict[tuple[str, str, str], int]:
-        """Published SharedCounters across all slices of every pool (the
-        split form carries them in a devices-free slice)."""
-        caps: dict[tuple[str, str, str], int] = {}
-        for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
-            pool = s["spec"]["pool"]["name"]
-            for cs in s["spec"].get("sharedCounters", []):
-                for cname, v in cs.get("counters", {}).items():
-                    caps[(pool, cs["name"], cname)] = int(v["value"])
-        return caps
-
-    @staticmethod
-    def _demand(pool: str, dev: dict) -> dict[tuple[str, str, str], int]:
-        out: dict[tuple[str, str, str], int] = {}
-        for cc in dev.get("consumesCounters", []):
-            for cname, v in cc.get("counters", {}).items():
-                out[(pool, cc["counterSet"], cname)] = int(v["value"])
-        return out
-
-    def _counters_fit(self, caps, demand) -> bool:
-        return all(
-            self._consumed.get(key, 0) + want <= caps.get(key, 0)
-            for key, want in demand.items()
-        )
-
-    def allocate(self, rct, uid, namespace="default", name="claim", create=True):
-        spec = rct["spec"]["spec"]["devices"]
-        results = []
-        caps = self._capacity()
-        claim_demand: dict[tuple[str, str, str], int] = {}
-        for req in spec.get("requests", []):
-            count = req.get("exactly", {}).get("count", 1)
-            matched = 0
-            for pool, driver, dev in self._published():
-                if (pool, dev["name"]) in self._allocated:
-                    continue
-                if not self._matches(req, dev):
-                    continue
-                demand = self._demand(pool, dev)
-                if not self._counters_fit(caps, demand):
-                    continue
-                self._allocated.add((pool, dev["name"]))
-                for key, want in demand.items():
-                    self._consumed[key] = self._consumed.get(key, 0) + want
-                    claim_demand[key] = claim_demand.get(key, 0) + want
-                results.append(
-                    {"request": req["name"], "driver": driver,
-                     "pool": pool, "device": dev["name"]}
-                )
-                matched += 1
-                if matched == count:
-                    break
-            if matched != count:
-                # Roll back everything this allocate reserved — a refused
-                # claim must not leak devices or counters.
-                for r in results:
-                    self._allocated.discard((r["pool"], r["device"]))
-                for key, want in claim_demand.items():
-                    left = self._consumed.get(key, 0) - want
-                    if left > 0:
-                        self._consumed[key] = left
-                    else:
-                        self._consumed.pop(key, None)
-                raise AssertionError(f"cannot satisfy request {req['name']}")
-        config = []
-        for entry in spec.get("config", []):
-            config.append({"source": "FromClaim", "requests": [], **entry})
-        claim = {
-            "apiVersion": "resource.k8s.io/v1",
-            "kind": "ResourceClaim",
-            "metadata": {"uid": uid, "namespace": namespace, "name": name},
-            "status": {"allocation": {"devices": {"results": results, "config": config}}},
-        }
-        if create:
-            # Allocation lives in the apiserver: the plugin resolves claim
-            # references kubelet sends over the DRA gRPC wire.
-            claim = self._kube.create(gvr.RESOURCE_CLAIMS, claim, namespace)
-        self._claim_demand[claim["metadata"]["uid"]] = claim_demand
-        return claim
-
-    def _matches(self, req, dev) -> bool:
-        cls = req.get("exactly", {}).get("deviceClassName", "")
-        dtype = dev["attributes"].get("type", {}).get("string", "")
-        if cls == "tpu.google.com":
-            return dtype == "chip"
-        if cls == "tpu-partition.google.com":
-            if not dtype.startswith("partition"):
-                return False
-            for sel in req.get("exactly", {}).get("selectors", []):
-                expr = sel.get("cel", {}).get("expression", "")
-                m = re.search(r"\d+c\.\d+hbm", expr)
-                if m:
-                    return (
-                        dev["attributes"].get("profile", {}).get("string")
-                        == m.group(0)
-                    )
-            return True
-        return False
-
-    def allocate_extended(
-        self, limits: dict[str, int], uid: str, namespace="default", pod_name="pod"
-    ):
-        """The extendedResourceName translation a DRA-aware scheduler does
-        (reference test_gpu_extres.bats): a pod requesting
-        ``resources.limits: {"tpu.google.com/chip": N}`` gets a
-        scheduler-authored ResourceClaim against the DeviceClass that
-        advertises that extendedResourceName; the node plugin then sees a
-        perfectly ordinary claim."""
-        class_by_extres = {
-            "tpu.google.com/chip": "tpu.google.com",
-        }
-        requests = []
-        for res_name, count in limits.items():
-            device_class = class_by_extres.get(res_name)
-            assert device_class, f"no DeviceClass advertises {res_name}"
-            requests.append(
-                {
-                    "name": f"extres-{len(requests)}",
-                    "exactly": {"deviceClassName": device_class, "count": count},
-                }
-            )
-        rct = {
-            "metadata": {"name": f"{pod_name}-extended-resources"},
-            "spec": {"spec": {"devices": {"requests": requests, "config": []}}},
-        }
-        return self.allocate(rct, uid, namespace, f"{pod_name}-extended-resources")
-
-    def release(self, claim):
-        for r in claim["status"]["allocation"]["devices"]["results"]:
-            self._allocated.discard((r["pool"], r["device"]))
-        demand = self._claim_demand.pop(claim["metadata"]["uid"], {})
-        for key, want in demand.items():
-            left = self._consumed.get(key, 0) - want
-            if left > 0:
-                self._consumed[key] = left
-            else:
-                self._consumed.pop(key, None)
 
 
 def mk_driver(tmp_path, kube, **fg_map):

@@ -1,0 +1,390 @@
+#!/usr/bin/env python3
+"""kubectlite — the kubectl subset the bats e2e suite needs.
+
+Talks to the apiserver named by ``KUBE_API_SERVER`` (the hermetic fake) or,
+failing that, a kubeconfig — so the same test scripts drive either the
+simulator or a real cluster (where plain kubectl also works, since the wire
+format is identical).
+
+Supported verbs: apply -f, get (-o json|yaml|name|jsonpath=...), delete,
+wait (--for=condition=X / --for=jsonpath=.../ --for=delete), logs (reads the
+simulator's log annotations), label, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import yaml  # noqa: E402
+
+from tpudra.kube import gvr as gvrmod  # noqa: E402
+from tpudra.kube.client import KubeClient  # noqa: E402
+from tpudra.kube.errors import ApiError, Conflict, NotFound  # noqa: E402
+from tpudra.sim.kubelet import LOG_ANNOTATION_PREFIX  # noqa: E402
+
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "cm": "configmaps", "configmap": "configmaps",
+    "svc": "services", "service": "services",
+    "ds": "daemonsets", "daemonset": "daemonsets",
+    "deploy": "deployments", "deployment": "deployments",
+    "resourceclaim": "resourceclaims",
+    "rct": "resourceclaimtemplates",
+    "resourceclaimtemplate": "resourceclaimtemplates",
+    "resourceslice": "resourceslices",
+    "deviceclass": "deviceclasses",
+    "cd": "computedomains", "computedomain": "computedomains",
+    "cdclique": "computedomaincliques",
+    "computedomainclique": "computedomaincliques",
+}
+
+
+def resolve_type(name: str) -> gvrmod.GVR:
+    plural = ALIASES.get(name.lower(), name.lower())
+    for g in gvrmod.ALL_GVRS:
+        if g.resource == plural or g.kind.lower() == name.lower():
+            return g
+    sys.exit(f"error: unknown resource type {name!r}")
+
+
+def resolve_kind(kind: str, api_version: str) -> gvrmod.GVR:
+    group = api_version.split("/")[0] if "/" in api_version else ""
+    for g in gvrmod.ALL_GVRS:
+        if g.kind == kind and g.group == group:
+            return g
+    sys.exit(f"error: no resource registered for kind {kind!r} ({api_version})")
+
+
+def client() -> KubeClient:
+    server = os.environ.get("KUBE_API_SERVER")
+    if server:
+        return KubeClient(server)
+    if os.environ.get("KUBECONFIG") or os.path.exists(
+        os.path.expanduser("~/.kube/config")
+    ):
+        return KubeClient.from_kubeconfig()
+    sys.exit("error: KUBE_API_SERVER is not set and no kubeconfig found")
+
+
+def load_docs(path: str) -> list[dict]:
+    data = sys.stdin.read() if path == "-" else open(path).read()
+    return [d for d in yaml.safe_load_all(data) if d]
+
+
+# ------------------------------------------------------------------ jsonpath
+
+def jsonpath(obj, expr: str):
+    """Minimal jsonpath: {.a.b[0].c} and [*] wildcards."""
+    expr = expr.strip()
+    if expr.startswith("{") and expr.endswith("}"):
+        expr = expr[1:-1]
+    expr = expr.lstrip(".")
+    values = [obj]
+    token_re = re.compile(r"([^.\[\]]+)|\[(\*|\d+)\]")
+    for m in token_re.finditer(expr):
+        key, idx = m.group(1), m.group(2)
+        next_values = []
+        for v in values:
+            if key is not None:
+                if isinstance(v, dict) and key in v:
+                    next_values.append(v[key])
+            elif idx == "*":
+                if isinstance(v, list):
+                    next_values.extend(v)
+            else:
+                i = int(idx)
+                if isinstance(v, list) and i < len(v):
+                    next_values.append(v[i])
+        values = next_values
+    return values
+
+
+def fmt_value(v) -> str:
+    if isinstance(v, (dict, list)):
+        return json.dumps(v)
+    return str(v)
+
+
+# --------------------------------------------------------------------- verbs
+
+def cmd_apply(args) -> int:
+    kube = client()
+    for doc in load_docs(args.filename):
+        g = resolve_kind(doc.get("kind", ""), doc.get("apiVersion", ""))
+        ns = doc.get("metadata", {}).get("namespace") or args.namespace
+        name = doc.get("metadata", {}).get("name", "")
+        try:
+            kube.create(g, doc, ns if g.namespaced else None)
+            verb = "created"
+        except (Conflict, ApiError) as e:
+            if "exists" not in str(e).lower():
+                raise
+            live = kube.get(g, name, ns if g.namespaced else None)
+            doc.setdefault("metadata", {})["resourceVersion"] = live["metadata"].get(
+                "resourceVersion", ""
+            )
+            doc["metadata"].setdefault("uid", live["metadata"].get("uid"))
+            kube.update(g, doc, ns if g.namespaced else None)
+            verb = "configured"
+        print(f"{g.resource}/{name} {verb}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    kube = client()
+    targets: list[tuple[gvrmod.GVR, str, str]] = []
+    if args.filename:
+        for doc in load_docs(args.filename):
+            g = resolve_kind(doc.get("kind", ""), doc.get("apiVersion", ""))
+            ns = doc.get("metadata", {}).get("namespace") or args.namespace
+            targets.append((g, doc["metadata"]["name"], ns))
+    else:
+        g = resolve_type(args.type)
+        for name in args.names:
+            targets.append((g, name, args.namespace))
+    rc = 0
+    for g, name, ns in targets:
+        try:
+            kube.delete(g, name, ns if g.namespaced else None)
+            print(f"{g.resource}/{name} deleted")
+        except NotFound:
+            if not args.ignore_not_found:
+                print(f"error: {g.resource}/{name} not found", file=sys.stderr)
+                rc = 1
+    return rc
+
+
+def _get_objects(kube, args):
+    g = resolve_type(args.type)
+    ns = None if args.all_namespaces else (args.namespace if g.namespaced else None)
+    if args.names:
+        return g, [kube.get(g, n, ns) for n in args.names]
+    out = kube.list(
+        g, ns,
+        label_selector=args.selector or None,
+        field_selector=args.field_selector or None,
+    )
+    return g, out.get("items", [])
+
+
+def cmd_get(args) -> int:
+    kube = client()
+    try:
+        g, objs = _get_objects(kube, args)
+    except NotFound as e:
+        if args.ignore_not_found:
+            return 0
+        sys.exit(f"error: {e}")
+    o = args.output
+    if o == "json":
+        payload = objs[0] if (args.names and len(objs) == 1) else {
+            "apiVersion": "v1", "kind": "List", "items": objs,
+        }
+        print(json.dumps(payload, indent=2))
+    elif o == "yaml":
+        payload = objs[0] if (args.names and len(objs) == 1) else {
+            "apiVersion": "v1", "kind": "List", "items": objs,
+        }
+        print(yaml.safe_dump(payload, sort_keys=False))
+    elif o == "name":
+        for obj in objs:
+            print(f"{g.resource}/{obj['metadata']['name']}")
+    elif o and o.startswith("jsonpath="):
+        expr = o[len("jsonpath="):]
+        scope = objs[0] if (args.names and len(objs) == 1) else {"items": objs}
+        print(" ".join(fmt_value(v) for v in jsonpath(scope, expr)))
+    else:
+        rows = []
+        for obj in objs:
+            phase = obj.get("status", {}).get("phase", "")
+            ready = ""
+            for c in obj.get("status", {}).get("conditions", []):
+                if c.get("type") == "Ready":
+                    ready = c.get("status", "")
+            rows.append((obj["metadata"]["name"], phase, ready))
+        if not rows:
+            # kubectl exits 0 on an empty table list.
+            print("No resources found", file=sys.stderr)
+            return 0
+        print(f"{'NAME':40} {'PHASE':12} READY")
+        for name, phase, ready in rows:
+            print(f"{name:40} {phase:12} {ready}")
+    return 0
+
+
+def _condition_met(obj: dict, cond: str) -> bool:
+    want_type, _, want_status = cond.partition("=")
+    want_status = want_status or "True"
+    for c in obj.get("status", {}).get("conditions", []):
+        if c.get("type", "").lower() == want_type.lower():
+            return str(c.get("status", "")).lower() == want_status.lower()
+    return False
+
+
+def cmd_wait(args) -> int:
+    kube = client()
+    g, name = None, None
+    if "/" in args.target:
+        tname, name = args.target.split("/", 1)
+        g = resolve_type(tname)
+    else:
+        g = resolve_type(args.target)
+    timeout = parse_duration(args.timeout)
+    deadline = time.monotonic() + timeout
+    mode = args.wait_for
+    last_err = ""
+    while time.monotonic() < deadline:
+        try:
+            if name:
+                objs = [kube.get(g, name, args.namespace if g.namespaced else None)]
+            else:
+                objs = kube.list(
+                    g,
+                    args.namespace if g.namespaced else None,
+                    label_selector=args.selector or None,
+                ).get("items", [])
+            if mode == "delete":
+                if not objs:
+                    return 0
+            elif mode.startswith("condition="):
+                if objs and all(_condition_met(o, mode[len("condition="):]) for o in objs):
+                    return 0
+            elif mode.startswith("jsonpath="):
+                expr, _, want = mode[len("jsonpath="):].partition("=")
+                ok = bool(objs)
+                for o in objs:
+                    got = jsonpath(o, expr)
+                    if want:
+                        ok = ok and got and fmt_value(got[0]) == want
+                    else:
+                        ok = ok and bool(got)
+                if ok:
+                    return 0
+            else:
+                sys.exit(f"error: unsupported --for {mode!r}")
+            last_err = "condition not met"
+        except NotFound as e:
+            if mode == "delete":
+                return 0
+            last_err = str(e)
+        time.sleep(0.2)
+    print(f"error: timed out waiting for {args.target}: {last_err}", file=sys.stderr)
+    return 1
+
+
+def cmd_logs(args) -> int:
+    kube = client()
+    pod = kube.get(gvrmod.PODS, args.pod, args.namespace)
+    ann = pod["metadata"].get("annotations", {})
+    if args.container:
+        keys = [LOG_ANNOTATION_PREFIX + args.container]
+    else:
+        keys = sorted(k for k in ann if k.startswith(LOG_ANNOTATION_PREFIX))
+    if not keys or not any(k in ann for k in keys):
+        # Logs land in annotations when a container exits or on demand; a
+        # running container's output may not be synced yet.
+        print("", end="")
+        return 0
+    for k in keys:
+        if k in ann:
+            sys.stdout.write(ann[k])
+    return 0
+
+
+def cmd_label(args) -> int:
+    kube = client()
+    g = resolve_type(args.type)
+    labels = {}
+    for kv in args.labels:
+        if kv.endswith("-"):
+            labels[kv[:-1]] = None
+        else:
+            k, _, v = kv.partition("=")
+            labels[k] = v
+    kube.patch(
+        g, args.name, {"metadata": {"labels": labels}},
+        args.namespace if g.namespaced else None,
+    )
+    print(f"{g.resource}/{args.name} labeled")
+    return 0
+
+
+def parse_duration(s: str) -> float:
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(s|m|h)?", s)
+    if not m:
+        sys.exit(f"error: bad duration {s!r}")
+    mult = {"s": 1, "m": 60, "h": 3600}[m.group(2) or "s"]
+    return float(m.group(1)) * mult
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="kubectlite", description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    ap = sub.add_parser("apply")
+    ap.add_argument("-f", "--filename", required=True)
+    ap.add_argument("-n", "--namespace", default="default")
+    ap.set_defaults(fn=cmd_apply)
+
+    dp = sub.add_parser("delete")
+    dp.add_argument("type", nargs="?")
+    dp.add_argument("names", nargs="*")
+    dp.add_argument("-f", "--filename")
+    dp.add_argument("-n", "--namespace", default="default")
+    dp.add_argument("--ignore-not-found", action="store_true")
+    dp.set_defaults(fn=cmd_delete)
+
+    gp = sub.add_parser("get")
+    gp.add_argument("type")
+    gp.add_argument("names", nargs="*")
+    gp.add_argument("-n", "--namespace", default="default")
+    gp.add_argument("-A", "--all-namespaces", action="store_true")
+    gp.add_argument("-o", "--output", default="")
+    gp.add_argument("-l", "--selector", default="")
+    gp.add_argument("--field-selector", default="")
+    gp.add_argument("--ignore-not-found", action="store_true")
+    gp.set_defaults(fn=cmd_get)
+
+    wp = sub.add_parser("wait")
+    wp.add_argument("target", help="type/name or type with -l")
+    wp.add_argument("--for", dest="wait_for", required=True)
+    wp.add_argument("-n", "--namespace", default="default")
+    wp.add_argument("-l", "--selector", default="")
+    wp.add_argument("--timeout", default="30s")
+    wp.set_defaults(fn=cmd_wait)
+
+    lp = sub.add_parser("logs")
+    lp.add_argument("pod")
+    lp.add_argument("-c", "--container", default="")
+    lp.add_argument("-n", "--namespace", default="default")
+    lp.set_defaults(fn=cmd_logs)
+
+    lb = sub.add_parser("label")
+    lb.add_argument("type")
+    lb.add_argument("name")
+    lb.add_argument("labels", nargs="+")
+    lb.add_argument("-n", "--namespace", default="default")
+    lb.set_defaults(fn=cmd_label)
+
+    vp = sub.add_parser("version")
+    vp.set_defaults(fn=lambda a: (print("kubectlite (tpudra hermetic harness)"), 0)[1])
+
+    args = p.parse_args(argv)
+    try:
+        return args.fn(args)
+    except ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

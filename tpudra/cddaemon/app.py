@@ -62,6 +62,12 @@ class DaemonConfig:
     work_dir: str = "/var/run/tpudra-cd"
     hosts_path: str = "/etc/hosts"
     daemon_argv: Optional[Sequence[str]] = None  # default: tpu-slicewatchd
+    # Single-host test mode: clique index -> UDP peer port.  When set, the
+    # daemon binds the port for its own index and writes the port-annotated
+    # nodes.cfg form ("name:port") that tpu-slicewatchd documents for
+    # same-host peers (slicewatchd.cc:101-103).  Production leaves this
+    # empty: every host binds the same --peer-port.
+    peer_port_map: Optional[dict[int, int]] = None
 
     @classmethod
     def from_environ(cls, env: Optional[dict] = None) -> "DaemonConfig":
@@ -81,7 +87,19 @@ class DaemonConfig:
             peer_port=int(env.get("PEER_PORT", str(DEFAULT_PEER_PORT))),
             work_dir=env.get("WORK_DIR", "/var/run/tpudra-cd"),
             hosts_path=env.get("HOSTS_PATH", "/etc/hosts"),
+            peer_port_map=_parse_port_map(env.get("TPUDRA_PEER_PORT_MAP", "")),
         )
+
+
+def _parse_port_map(spec: str) -> Optional[dict[int, int]]:
+    """Parse "0=5001,1=5002" (TPUDRA_PEER_PORT_MAP) into {index: port}."""
+    if not spec:
+        return None
+    out: dict[int, int] = {}
+    for part in spec.split(","):
+        idx, _, port = part.partition("=")
+        out[int(idx)] = int(port)
+    return out
 
 
 def query_status(port: int, host: str = "127.0.0.1", timeout: float = 2.0) -> str:
@@ -161,7 +179,12 @@ class DaemonApp:
             hosts_path=hosts_for_daemon,
             nodes_config_path=os.path.join(cfg.work_dir, "nodes.cfg"),
         )
-        nodes_cfg = self._dns.write_nodes_config()
+        nodes_cfg = self._dns.write_nodes_config(port_map=cfg.peer_port_map)
+        peer_port = (
+            cfg.peer_port_map.get(index, cfg.peer_port)
+            if cfg.peer_port_map
+            else cfg.peer_port
+        )
         if not self._use_dns:
             with open(hosts_for_daemon, "w"):
                 pass  # daemon must find the file before the first update
@@ -175,7 +198,7 @@ class DaemonApp:
                 "--index", str(index),
                 "--expected", str(max(cfg.num_hosts, 1)),
                 "--status-port", str(cfg.status_port),
-                "--peer-port", str(cfg.peer_port),
+                "--peer-port", str(peer_port),
             ]
         self.process = ProcessManager(argv)
         self.process.start_watchdog(stop)

@@ -34,10 +34,17 @@ class DNSNameManager:
         self._hosts_path = hosts_path
         self._nodes_config_path = nodes_config_path
 
-    def write_nodes_config(self) -> str:
+    def write_nodes_config(self, port_map=None) -> str:
         """Static peer list of max-size DNS names (WriteNodesConfig,
-        dnsnames.go:191)."""
-        content = "\n".join(dns_name(i) for i in range(self._max_nodes)) + "\n"
+        dnsnames.go:191).  ``port_map`` ({index: port}) emits the
+        port-annotated "name:port" form tpu-slicewatchd accepts for
+        same-host peers (single-host test mode)."""
+        def line(i: int) -> str:
+            if port_map and i in port_map:
+                return f"{dns_name(i)}:{port_map[i]}"
+            return dns_name(i)
+
+        content = "\n".join(line(i) for i in range(self._max_nodes)) + "\n"
         os.makedirs(os.path.dirname(self._nodes_config_path) or ".", exist_ok=True)
         with open(self._nodes_config_path, "w") as f:
             f.write(content)

@@ -46,6 +46,7 @@ class GVR:
 
 PODS = GVR("", "v1", "pods", "Pod")
 NODES = GVR("", "v1", "nodes", "Node", namespaced=False)
+NAMESPACES = GVR("", "v1", "namespaces", "Namespace", namespaced=False)
 CONFIGMAPS = GVR("", "v1", "configmaps", "ConfigMap")
 SERVICES = GVR("", "v1", "services", "Service")
 
@@ -77,6 +78,7 @@ COMPUTE_DOMAIN_CLIQUES = GVR(
 ALL_GVRS = [
     PODS,
     NODES,
+    NAMESPACES,
     CONFIGMAPS,
     SERVICES,
     DAEMONSETS,

@@ -1,0 +1,22 @@
+"""Hermetic cluster simulator — the harness the reference never had.
+
+The reference's e2e suite (tests/bats/, SURVEY.md §4) can only run on
+hardware CI runners because it needs a real cluster: a scheduler that
+understands DRA, a kubelet that calls the driver's gRPC sockets, and a
+container runtime that applies CDI specs.  This package simulates exactly
+those three actors against the fake apiserver (tpudra/kube/httpserver.py),
+so the same bats suite runs on a laptop:
+
+- ``sched``: a DRA-aware micro-scheduler with KEP-4815 SharedCounters
+  arithmetic (the scheduler-side contract of reference partitions.go:85-307).
+- ``kubelet``: per-node claim prepare/unprepare over the real DRA gRPC
+  sockets, container processes launched with the CDI-injected environment,
+  readiness probes, and pod status/log reporting — plus minimal DaemonSet
+  and Deployment controllers so the ComputeDomain stack's spawned pods run.
+- ``main``: the ``tpu-cluster-sim`` entry point used by tests/bats.
+
+Everything the simulator does to the driver is indistinguishable from a
+real kubelet: it speaks the same protobuf DRA service over the same unix
+sockets and applies the same transient CDI spec files the container
+runtime would.
+"""

@@ -1,0 +1,804 @@
+"""Scheduler + kubelet + pod-runtime simulation for the hermetic cluster.
+
+One ClusterSim process stands in for everything between the apiserver and
+the driver that a real cluster provides (SURVEY.md §4.3's "kind + mock"
+target):
+
+- a DRA-aware scheduler (tpudra/sim/sched.py) that instantiates
+  ResourceClaims from ResourceClaimTemplates, performs the
+  extendedResourceName translation, and picks a node where every claim fits;
+- per-node kubelet behavior: NodePrepareResources/NodeUnprepareResources
+  over the driver's real gRPC unix socket (retrying retryable errors the way
+  kubelet holds a pod in ContainerCreating — reference device_state.go:499);
+- a container runtime: containers run as local processes with the CDI
+  spec's environment applied (what containerd's CDI support does with the
+  transient spec files, reference cdi.go:194-304), logs captured to pod
+  annotations, exec readiness probes honored;
+- minimal DaemonSet/Deployment controllers so the pods the ComputeDomain
+  controller and the sharing managers stamp out actually run.
+
+Known binary names map to ``python -m`` module invocations, so the pods the
+controller renders ("compute-domain-daemon run") execute the real binaries.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, TPU_DRIVER_NAME
+from tpudra.kube import gvr
+from tpudra.kube.errors import ApiError, Conflict, NotFound
+from tpudra.sim.sched import (
+    EXTENDED_RESOURCE_CLASSES,
+    InsufficientResources,
+    Scheduler,
+)
+
+logger = logging.getLogger(__name__)
+
+LOG_ANNOTATION_PREFIX = "sim.tpu.google.com/log-"
+EVENT_ANNOTATION = "sim.tpu.google.com/event"
+DEVICE_NODES_ENV = "SIM_CDI_DEVICE_NODES"
+
+# Console-script name -> python module (the image's entry points).
+BINARY_MODULES = {
+    "tpu-kubelet-plugin": "tpudra.plugin.main",
+    "compute-domain-kubelet-plugin": "tpudra.cdplugin.main",
+    "compute-domain-controller": "tpudra.controller.main",
+    "compute-domain-daemon": "tpudra.cddaemon.main",
+    "tpudra-webhook": "tpudra.webhook.main",
+    "tpu-mp-control-daemon": "tpudra.mpdaemon",
+}
+
+LOG_CAP = 8192
+
+
+@dataclass
+class NodeConfig:
+    """One simulated node: where its driver sockets and CDI roots live, and
+    the node-level environment injected into every container it runs (the
+    analog of node-scoped config like /etc/hosts and the TPU metadata
+    server)."""
+
+    name: str
+    drivers: dict[str, str] = field(default_factory=dict)  # driver -> socket
+    cdi_roots: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "NodeConfig":
+        return cls(
+            name=d["name"],
+            drivers=dict(d.get("drivers", {})),
+            cdi_roots=list(d.get("cdi_roots", [])),
+            env={k: str(v) for k, v in d.get("env", {}).items()},
+        )
+
+
+class _Container:
+    def __init__(self, spec: dict, env: dict, workdir: str):
+        self.spec = spec
+        self.name = spec["name"]
+        self.env = env
+        self.workdir = workdir
+        self.proc: Optional[subprocess.Popen] = None
+        self.log_path = os.path.join(workdir, f"{self.name}.log")
+        self.ready = False
+        self.restarts = 0
+        self.last_exit: Optional[int] = None
+        self.next_start = 0.0  # restart backoff deadline
+        self.next_probe = 0.0
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def log_tail(self) -> str:
+        try:
+            with open(self.log_path) as f:
+                data = f.read()
+            return data[-LOG_CAP:]
+        except OSError:
+            return ""
+
+
+class _PodRun:
+    def __init__(self, pod: dict, node: NodeConfig):
+        self.uid = pod["metadata"]["uid"]
+        self.namespace = pod["metadata"]["namespace"]
+        self.name = pod["metadata"]["name"]
+        self.pod = pod
+        self.node = node
+        self.claims: list[dict] = []  # resolved ResourceClaim objects
+        self.generated_claims: list[tuple[str, str]] = []  # (ns, name) we created
+        self.prepared = False
+        self.containers: list[_Container] = []
+        self.workdir = tempfile.mkdtemp(prefix=f"pod-{self.name}-")
+        self.next_prepare = 0.0
+        self.last_status: Optional[tuple] = None
+
+
+def _resolve_field_ref(path: str, pod: dict) -> str:
+    md = pod["metadata"]
+    return {
+        "metadata.name": md["name"],
+        "metadata.namespace": md["namespace"],
+        "metadata.uid": md.get("uid", ""),
+        "spec.nodeName": pod["spec"].get("nodeName", ""),
+        "status.podIP": "127.0.0.1",
+    }.get(path, "")
+
+
+def _container_env(container: dict, pod: dict) -> dict:
+    env = {}
+    for e in container.get("env", []):
+        if "value" in e:
+            env[e["name"]] = str(e["value"])
+        elif "valueFrom" in e and "fieldRef" in e["valueFrom"]:
+            env[e["name"]] = _resolve_field_ref(
+                e["valueFrom"]["fieldRef"].get("fieldPath", ""), pod
+            )
+    return env
+
+
+def rewrite_command(argv: list[str]) -> list[str]:
+    """Map console-script names to `python -m` (the hermetic image)."""
+    if not argv:
+        return argv
+    head, rest = argv[0], argv[1:]
+    if head in BINARY_MODULES:
+        return [sys.executable, "-m", BINARY_MODULES[head], *rest]
+    if os.path.basename(head) in ("python", "python3"):
+        return [sys.executable, *rest]
+    return argv
+
+
+class ClusterSim:
+    """The reconcile loop tying scheduler, kubelet, and pod runtime together."""
+
+    def __init__(self, kube, nodes: list[NodeConfig], base_env: Optional[dict] = None):
+        self._kube = kube
+        self._nodes = {n.name: n for n in nodes}
+        self._base_env = dict(base_env or {})
+        self._sched = Scheduler(kube)
+        self._pods: dict[str, _PodRun] = {}
+        # claim uid -> set of pod uids that required it (shared-claim refcount)
+        self._claim_users: dict[str, set[str]] = {}
+        self._prepared_claims: set[str] = set()
+        self._dra_clients: dict[tuple[str, str], object] = {}
+        self._stop = threading.Event()
+
+    # ----------------------------------------------------------- plumbing
+
+    def _dra(self, node: NodeConfig, driver: str):
+        from tpudra.plugin.grpcserver import DRAClient
+
+        key = (node.name, driver)
+        cli = self._dra_clients.get(key)
+        if cli is None:
+            sock = node.drivers.get(driver)
+            if not sock:
+                raise RuntimeError(f"node {node.name} has no driver {driver}")
+            cli = DRAClient(sock)
+            self._dra_clients[key] = cli
+        return cli
+
+    def _annotate(self, pod_run: _PodRun, annotations: dict) -> None:
+        try:
+            self._kube.patch(
+                gvr.PODS,
+                pod_run.name,
+                {"metadata": {"annotations": annotations}},
+                pod_run.namespace,
+            )
+        except (NotFound, ApiError):
+            pass
+
+    # --------------------------------------------------------------- run
+
+    def run(self, stop: Optional[threading.Event] = None, tick: float = 0.15) -> None:
+        stop = stop or self._stop
+        self._adopt_existing()
+        while not stop.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("sim tick failed")
+            stop.wait(tick)
+        self._teardown()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def step(self) -> None:
+        self._sync_daemonsets()
+        self._sync_deployments()
+        pods = self._kube.list(gvr.PODS).get("items", [])
+        by_uid = {p["metadata"]["uid"]: p for p in pods}
+        self._schedule(pods)
+        self._kubelet(pods)
+        self._reap(by_uid)
+
+    def _adopt_existing(self) -> None:
+        """Rebuild the allocation ledger from claims already in the
+        apiserver (sim restart; the analog of scheduler cache rebuild)."""
+        for claim in self._kube.list(gvr.RESOURCE_CLAIMS).get("items", []):
+            results = (
+                claim.get("status", {})
+                .get("allocation", {})
+                .get("devices", {})
+                .get("results", [])
+            )
+            if results:
+                self._sched.adopt(claim)
+
+    # -------------------------------------------- DaemonSet / Deployment
+
+    def _node_labels(self) -> dict[str, dict]:
+        labels = {}
+        for n in self._kube.list(gvr.NODES).get("items", []):
+            labels[n["metadata"]["name"]] = n["metadata"].get("labels", {})
+        return labels
+
+    def _ensure_pod(self, name: str, namespace: str, template: dict,
+                    node_name: str, owner: dict) -> None:
+        spec = json.loads(json.dumps(template.get("spec", {})))
+        spec["nodeName"] = node_name
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "labels": dict(template.get("metadata", {}).get("labels", {})),
+                "ownerReferences": [owner],
+            },
+            "spec": spec,
+        }
+        try:
+            self._kube.create(gvr.PODS, pod, namespace)
+        except (Conflict, ApiError) as e:
+            if "exists" not in str(e).lower():
+                raise
+
+    def _owned_pods(self, owner_uid: str) -> list[dict]:
+        return [
+            p
+            for p in self._kube.list(gvr.PODS).get("items", [])
+            if any(
+                o.get("uid") == owner_uid
+                for o in p["metadata"].get("ownerReferences", [])
+            )
+        ]
+
+    def _sync_daemonsets(self) -> None:
+        node_labels = self._node_labels()
+        seen_owner_uids = set()
+        for ds in self._kube.list(gvr.DAEMONSETS).get("items", []):
+            md, tmpl = ds["metadata"], ds["spec"]["template"]
+            seen_owner_uids.add(md["uid"])
+            selector = tmpl["spec"].get("nodeSelector", {})
+            want_nodes = {
+                n
+                for n in self._nodes
+                if all(node_labels.get(n, {}).get(k) == v for k, v in selector.items())
+            }
+            owner = {
+                "apiVersion": "apps/v1", "kind": "DaemonSet",
+                "name": md["name"], "uid": md["uid"],
+            }
+            have = {p["spec"].get("nodeName"): p for p in self._owned_pods(md["uid"])}
+            for n in want_nodes - set(have):
+                self._ensure_pod(
+                    f"{md['name']}-{n}", md["namespace"], tmpl, n, owner
+                )
+            for n, pod in have.items():
+                if n not in want_nodes:
+                    self._delete_pod(pod)
+            # numberReady lets kubectl-level waits observe rollout state.
+            ready = sum(
+                1
+                for p in have.values()
+                if any(
+                    c.get("type") == "Ready" and c.get("status") == "True"
+                    for c in p.get("status", {}).get("conditions", [])
+                )
+            )
+            status = {
+                "desiredNumberScheduled": len(want_nodes),
+                "numberReady": ready,
+            }
+            if ds.get("status", {}) != status:
+                ds = dict(ds, status=status)
+                try:
+                    self._kube.update_status(gvr.DAEMONSETS, ds, md["namespace"])
+                except (Conflict, NotFound):
+                    pass
+
+    def _sync_deployments(self) -> None:
+        for dep in self._kube.list(gvr.DEPLOYMENTS).get("items", []):
+            md, tmpl = dep["metadata"], dep["spec"]["template"]
+            node_name = tmpl["spec"].get("nodeName", "")
+            if node_name not in self._nodes:
+                continue
+            owner = {
+                "apiVersion": "apps/v1", "kind": "Deployment",
+                "name": md["name"], "uid": md["uid"],
+            }
+            have = self._owned_pods(md["uid"])
+            replicas = int(dep["spec"].get("replicas", 1))
+            have_names = {p["metadata"]["name"] for p in have}
+            want_names = {f"{md['name']}-{i}" for i in range(replicas)}
+            for name in sorted(want_names - have_names):
+                self._ensure_pod(name, md["namespace"], tmpl, node_name, owner)
+            for p in have:
+                if p["metadata"]["name"] not in want_names:  # scale-down
+                    self._delete_pod(p)
+            ready = sum(
+                1
+                for p in have
+                if any(
+                    c.get("type") == "Ready" and c.get("status") == "True"
+                    for c in p.get("status", {}).get("conditions", [])
+                )
+            )
+            status = {"replicas": len(have), "readyReplicas": ready}
+            if dep.get("status", {}) != status:
+                dep = dict(dep, status=status)
+                try:
+                    self._kube.update_status(gvr.DEPLOYMENTS, dep, md["namespace"])
+                except (Conflict, NotFound):
+                    pass
+
+    # ---------------------------------------------------------- scheduler
+
+    def _claim_entries(self, pod: dict) -> list[dict]:
+        return pod["spec"].get("resourceClaims", [])
+
+    def _extended_limits(self, pod: dict) -> dict[str, int]:
+        limits: dict[str, int] = {}
+        for c in pod["spec"].get("containers", []):
+            for k, v in c.get("resources", {}).get("limits", {}).items():
+                if k in EXTENDED_RESOURCE_CLASSES:
+                    limits[k] = limits.get(k, 0) + int(v)
+        return limits
+
+    def _resolve_claims(self, pod: dict, node: str) -> Optional[list[dict]]:
+        """Ensure every claim the pod references exists and is allocated on
+        ``node``.  Returns the claim objects, or None when allocation cannot
+        be satisfied (caller tries another node / retries).  Rolls back
+        claims allocated in this call on failure."""
+        md = pod["metadata"]
+        ns, owner = md["namespace"], {
+            "apiVersion": "v1", "kind": "Pod", "name": md["name"], "uid": md["uid"],
+        }
+        resolved: list[dict] = []
+        fresh: list[dict] = []  # claims this attempt created (safe to delete)
+        fresh_status: list[dict] = []  # user claims this attempt allocated
+        try:
+            for entry in self._claim_entries(pod):
+                if entry.get("resourceClaimName"):
+                    claim = self._kube.get(
+                        gvr.RESOURCE_CLAIMS, entry["resourceClaimName"], ns
+                    )
+                    results = (
+                        claim.get("status", {})
+                        .get("allocation", {})
+                        .get("devices", {})
+                        .get("results", [])
+                    )
+                    if not results:
+                        # Allocate a user-authored standalone claim in place.
+                        rct_shape = {"spec": {"spec": claim["spec"]}}
+                        alloc = self._sched.allocate(
+                            rct_shape, claim["metadata"]["uid"], ns,
+                            claim["metadata"]["name"], create=False, node=node,
+                        )
+                        claim["status"] = alloc["status"]
+                        claim = self._kube.update_status(gvr.RESOURCE_CLAIMS, claim, ns)
+                        fresh_status.append(claim)
+                    resolved.append(claim)
+                elif entry.get("resourceClaimTemplateName"):
+                    cname = f"{md['name']}-{entry['name']}"
+                    try:
+                        claim = self._kube.get(gvr.RESOURCE_CLAIMS, cname, ns)
+                    except NotFound:
+                        rct = self._kube.get(
+                            gvr.RESOURCE_CLAIM_TEMPLATES,
+                            entry["resourceClaimTemplateName"],
+                            ns,
+                        )
+                        claim = self._sched.allocate(
+                            rct, f"{md['uid']}-{entry['name']}", ns, cname,
+                            node=node, owner=owner,
+                        )
+                        fresh.append(claim)
+                    resolved.append(claim)
+            limits = self._extended_limits(pod)
+            if limits:
+                cname = f"{md['name']}-extended-resources"
+                try:
+                    claim = self._kube.get(gvr.RESOURCE_CLAIMS, cname, ns)
+                except NotFound:
+                    claim = self._sched.allocate_extended(
+                        limits, f"{md['uid']}-extres", ns, md["name"],
+                        node=node, owner=owner,
+                    )
+                    fresh.append(claim)
+                resolved.append(claim)
+        except (InsufficientResources, NotFound) as e:
+            # Claims this attempt created are deleted; a user-authored
+            # standalone claim only has the status this attempt wrote
+            # cleared — the object is the user's, not ours.
+            for claim in fresh:
+                self._sched.release(claim)
+                try:
+                    self._kube.delete(
+                        gvr.RESOURCE_CLAIMS, claim["metadata"]["name"], ns
+                    )
+                except NotFound:
+                    pass
+            for claim in fresh_status:
+                self._sched.release(claim)
+                claim["status"] = {}
+                try:
+                    self._kube.update_status(gvr.RESOURCE_CLAIMS, claim, ns)
+                except (Conflict, NotFound):
+                    pass
+            logger.debug("pod %s/%s does not fit on %s: %s", ns, md["name"], node, e)
+            return None
+        return resolved
+
+    def _schedule(self, pods: list[dict]) -> None:
+        for pod in pods:
+            md = pod["metadata"]
+            if md.get("deletionTimestamp") or pod["spec"].get("nodeName"):
+                continue
+            if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            for node in self._nodes:
+                claims = self._resolve_claims(pod, node)
+                if claims is None:
+                    continue
+                pod["spec"]["nodeName"] = node
+                try:
+                    self._kube.update(gvr.PODS, pod, md["namespace"])
+                except (Conflict, NotFound):
+                    # Racing update: the claims persist in the apiserver and
+                    # stay in the ledger; the next tick re-resolves them by
+                    # name, so nothing is released here.
+                    pass
+                break
+
+    # ------------------------------------------------------------ kubelet
+
+    def _kubelet(self, pods: list[dict]) -> None:
+        for pod in pods:
+            md = pod["metadata"]
+            node = self._nodes.get(pod["spec"].get("nodeName", ""))
+            if node is None:
+                continue
+            run = self._pods.get(md["uid"])
+            if run is None:
+                if md.get("deletionTimestamp"):
+                    continue
+                run = _PodRun(pod, node)
+                self._pods[md["uid"]] = run
+            run.pod = pod
+            if md.get("deletionTimestamp"):
+                self._shutdown_pod(run)
+                continue
+            if not run.prepared:
+                self._prepare_pod(run)
+            if run.prepared:
+                self._run_containers(run)
+            self._report_status(run)
+
+    def _prepare_pod(self, run: _PodRun) -> None:
+        now = time.monotonic()
+        if now < run.next_prepare:
+            return
+        run.next_prepare = now + 1.0
+        if not run.claims:
+            claims = self._resolve_claims(run.pod, run.node.name)
+            if claims is None:
+                return
+            run.claims = claims
+            run.generated_claims = [
+                (c["metadata"]["namespace"], c["metadata"]["name"])
+                for c in claims
+                if any(
+                    o.get("uid") == run.uid
+                    for o in c["metadata"].get("ownerReferences", [])
+                )
+            ]
+        for claim in run.claims:
+            uid = claim["metadata"]["uid"]
+            self._claim_users.setdefault(uid, set()).add(run.uid)
+        # Group claims per driver and prepare; any retryable failure keeps
+        # the pod unprepared (kubelet's ContainerCreating retry loop).
+        try:
+            for claim in run.claims:
+                uid = claim["metadata"]["uid"]
+                if uid in self._prepared_claims:
+                    continue
+                drivers = {
+                    r["driver"]
+                    for r in claim["status"]["allocation"]["devices"]["results"]
+                }
+                for driver in drivers:
+                    resp = self._dra(run.node, driver).prepare([claim])
+                    result = resp["claims"].get(uid, {})
+                    if result.get("error"):
+                        raise RuntimeError(result["error"])
+                self._prepared_claims.add(uid)
+        except Exception as e:  # noqa: BLE001 — retried next tick
+            msg = str(e)
+            logger.info("prepare pending for pod %s: %s", run.name, msg[:200])
+            self._annotate(run, {EVENT_ANNOTATION: f"prepare: {msg[:500]}"})
+            return
+        run.prepared = True
+        self._annotate(run, {EVENT_ANNOTATION: "prepared"})
+
+    def _cdi_env(self, run: _PodRun) -> dict:
+        """Apply the transient CDI specs of the pod's claims: merge every
+        env edit and surface injected device nodes for assertions."""
+        env: dict[str, str] = {}
+        dev_nodes: list[str] = []
+        uids = {c["metadata"]["uid"] for c in run.claims}
+        for root in run.node.cdi_roots:
+            try:
+                files = os.listdir(root)
+            except OSError:
+                continue
+            for fn in files:
+                if not any(uid in fn for uid in uids):
+                    continue
+                try:
+                    with open(os.path.join(root, fn)) as f:
+                        spec = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                for e in spec.get("containerEdits", {}).get("env", []):
+                    k, _, v = e.partition("=")
+                    env[k] = v
+                for dev in spec.get("devices", []):
+                    edits = dev.get("containerEdits", {})
+                    for e in edits.get("env", []):
+                        k, _, v = e.partition("=")
+                        env[k] = v
+                    for n in edits.get("deviceNodes", []):
+                        dev_nodes.append(n["path"])
+        if dev_nodes:
+            env[DEVICE_NODES_ENV] = ",".join(sorted(dev_nodes))
+        return env
+
+    @staticmethod
+    def _mock_jax_env(env: dict) -> dict:
+        """With TPUDRA_SIM_JAX_CPU=1 (node env), a claimed pod's jax sees
+        exactly its granted chips as CPU devices — the in-pod observable
+        the reference asserts with nvidia-smi, minus the silicon.  The
+        device count flows from the CDI-injected TPU_VISIBLE_DEVICES, so a
+        wrong grant fails the pod's own assertion."""
+        if env.get("TPUDRA_SIM_JAX_CPU") != "1":
+            return {}
+        visible = env.get("TPU_VISIBLE_DEVICES", "")
+        if not visible:
+            return {}
+        n = len(visible.split(","))
+        return {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count={n}",
+        }
+
+    def _start_container(self, run: _PodRun, c: _Container) -> None:
+        argv = rewrite_command(
+            list(c.spec.get("command", [])) + list(c.spec.get("args", []))
+        )
+        if not argv:
+            argv = [sys.executable, "-c", "pass"]
+        with open(c.log_path, "a") as out:
+            c.proc = subprocess.Popen(
+                argv, env=c.env, cwd=run.workdir,
+                stdout=out, stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+
+    def _run_containers(self, run: _PodRun) -> None:
+        if not run.containers:
+            cdi_env = self._cdi_env(run)
+            for cspec in run.pod["spec"].get("containers", []):
+                env = {
+                    "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+                    "HOME": run.workdir,
+                    "PYTHONPATH": os.environ.get("PYTHONPATH", ""),
+                    "PYTHONUNBUFFERED": "1",
+                }
+                env.update(self._base_env)
+                env.update(run.node.env)
+                env.update(cdi_env)
+                env.update(self._mock_jax_env(env))
+                env.update(_container_env(cspec, run.pod))
+                c = _Container(cspec, env, run.workdir)
+                run.containers.append(c)
+                self._start_container(run, c)
+        restart_always = run.pod["spec"].get("restartPolicy", "Always") == "Always"
+        now = time.monotonic()
+        for c in run.containers:
+            if not c.running() and c.proc is not None:
+                rc = c.proc.poll()
+                if c.last_exit is None or c.last_exit != rc:
+                    c.last_exit = rc
+                    self._annotate(
+                        run,
+                        {LOG_ANNOTATION_PREFIX + c.name: c.log_tail() or "(empty)"},
+                    )
+                if restart_always and rc is not None:
+                    if c.next_start == 0.0:
+                        c.next_start = now + 1.0
+                    elif now >= c.next_start:
+                        c.restarts += 1
+                        c.next_start = 0.0
+                        c.last_exit = None
+                        self._start_container(run, c)
+            self._probe(c, now)
+
+    def _probe(self, c: _Container, now: float) -> None:
+        probe = c.spec.get("readinessProbe", {})
+        exec_cmd = probe.get("exec", {}).get("command")
+        if not c.running():
+            # A completed (rc 0) container counts ready for Succeeded pods.
+            c.ready = c.proc is not None and c.proc.poll() == 0
+            return
+        if not exec_cmd:
+            c.ready = True
+            return
+        if now < c.next_probe:
+            return
+        c.next_probe = now + max(1.0, float(probe.get("periodSeconds", 5)))
+        try:
+            rc = subprocess.run(
+                rewrite_command(list(exec_cmd)),
+                env=c.env, capture_output=True, timeout=10,
+            ).returncode
+        except (OSError, subprocess.TimeoutExpired):
+            rc = 1
+        c.ready = rc == 0
+
+    def _report_status(self, run: _PodRun) -> None:
+        if not run.prepared:
+            phase, ready = "Pending", False
+        else:
+            states = [(c.running(), c.proc.poll() if c.proc else None)
+                      for c in run.containers]
+            if not states:
+                phase, ready = "Pending", False
+            elif any(r for r, _ in states):
+                phase, ready = "Running", all(c.ready for c in run.containers)
+            elif all(rc == 0 for _, rc in states):
+                phase, ready = "Succeeded", True
+            elif run.pod["spec"].get("restartPolicy", "Always") == "Always":
+                phase, ready = "Running", False  # crash-looping
+            else:
+                phase, ready = "Failed", False
+        statuses = [
+            {
+                "name": c.name,
+                "ready": c.ready,
+                "restartCount": c.restarts,
+                "state": (
+                    {"running": {}}
+                    if c.running()
+                    else {"terminated": {"exitCode": c.proc.poll() if c.proc else -1}}
+                ),
+            }
+            for c in run.containers
+        ]
+        key = (phase, ready, json.dumps(statuses, sort_keys=True))
+        if key == run.last_status:
+            return
+        run.last_status = key
+        pod = dict(run.pod)
+        pod["status"] = {
+            "phase": phase,
+            "podIP": "127.0.0.1",
+            "conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ],
+            "containerStatuses": statuses,
+        }
+        try:
+            self._kube.update_status(gvr.PODS, pod, run.namespace)
+        except (Conflict, NotFound):
+            run.last_status = None
+
+    # ------------------------------------------------------------ teardown
+
+    def _delete_pod(self, pod: dict) -> None:
+        try:
+            self._kube.delete(
+                gvr.PODS, pod["metadata"]["name"], pod["metadata"]["namespace"]
+            )
+        except NotFound:
+            pass
+
+    def _shutdown_pod(self, run: _PodRun) -> None:
+        """Kill containers, unprepare claims whose last user left, release
+        allocations, and delete generated claims — then drop the pod."""
+        for c in run.containers:
+            if c.running():
+                try:
+                    os.killpg(os.getpgid(c.proc.pid), signal.SIGTERM)
+                except (OSError, ProcessLookupError):
+                    pass
+        deadline = time.monotonic() + 5
+        for c in run.containers:
+            if c.proc is None:
+                continue
+            while c.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if c.proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(c.proc.pid), signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+                c.proc.wait()
+        for claim in run.claims:
+            uid = claim["metadata"]["uid"]
+            users = self._claim_users.get(uid, set())
+            users.discard(run.uid)
+            if users:
+                continue
+            self._claim_users.pop(uid, None)
+            if uid in self._prepared_claims:
+                drivers = {
+                    r["driver"]
+                    for r in claim["status"]["allocation"]["devices"]["results"]
+                }
+                for driver in drivers:
+                    try:
+                        self._dra(run.node, driver).unprepare([claim])
+                    except Exception:  # noqa: BLE001
+                        logger.exception(
+                            "unprepare failed for claim %s", claim["metadata"]["name"]
+                        )
+                self._prepared_claims.discard(uid)
+            self._sched.release(claim)
+        for ns, name in run.generated_claims:
+            try:
+                self._kube.delete(gvr.RESOURCE_CLAIMS, name, ns)
+            except NotFound:
+                pass
+        self._pods.pop(run.uid, None)
+
+    def _reap(self, live_by_uid: dict[str, dict]) -> None:
+        for uid in list(self._pods):
+            if uid not in live_by_uid:
+                self._shutdown_pod(self._pods[uid])
+
+    def _teardown(self) -> None:
+        for run in list(self._pods.values()):
+            self._shutdown_pod(run)
+        for cli in self._dra_clients.values():
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def parse_config(path: str) -> tuple[str, list[NodeConfig], dict]:
+    with open(path) as f:
+        cfg = json.load(f)
+    nodes = [NodeConfig.from_dict(d) for d in cfg.get("nodes", [])]
+    return cfg.get("server", ""), nodes, {
+        k: str(v) for k, v in cfg.get("env", {}).items()
+    }

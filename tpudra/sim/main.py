@@ -1,0 +1,61 @@
+"""``tpu-cluster-sim`` — run the hermetic scheduler/kubelet simulator.
+
+Consumes a JSON config describing the simulated nodes (driver sockets, CDI
+roots, node-level env) and reconciles against the apiserver named by
+``--kube-api-server`` / ``KUBE_API_SERVER`` until SIGTERM.  The bats e2e
+harness (tests/bats/clusterctl.py) generates the config and supervises this
+process alongside the real driver binaries.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+
+from tpudra.kube.client import KubeClient
+from tpudra.sim.kubelet import ClusterSim, parse_config
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-cluster-sim", description=__doc__)
+    p.add_argument("--config", required=True, help="sim config JSON path")
+    p.add_argument(
+        "--kube-api-server",
+        default=os.environ.get("KUBE_API_SERVER", ""),
+        help="apiserver URL (overrides the config's `server`)",
+    )
+    p.add_argument("--tick", type=float, default=0.15)
+    p.add_argument("-v", "--verbosity", type=int,
+                   default=int(os.environ.get("LOG_VERBOSITY", "0")))
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbosity >= 4 else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+        stream=sys.stdout,
+    )
+    server, nodes, base_env = parse_config(args.config)
+    server = args.kube_api_server or server
+    if not server:
+        p.error("no apiserver: set --kube-api-server or the config's `server`")
+    if not nodes:
+        p.error("config has no nodes")
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+
+    sim = ClusterSim(KubeClient(server), nodes, base_env)
+    logging.getLogger(__name__).info(
+        "cluster-sim: %d node(s) against %s", len(nodes), server
+    )
+    sim.run(stop, tick=args.tick)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

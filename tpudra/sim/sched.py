@@ -1,0 +1,270 @@
+"""DRA-aware micro-scheduler for the hermetic cluster simulator.
+
+Allocates ResourceClaim(Template) device requests against the ResourceSlices
+published in the apiserver, first-fit, with KEP-4815 SharedCounters
+arithmetic — a full device blocks its partitions, disjoint partitions
+co-allocate, and counter exhaustion refuses (the scheduler-side contract of
+reference cmd/gpu-kubelet-plugin/partitions.go:85-307).
+
+DeviceClass matching mirrors the CEL selectors the chart's DeviceClasses
+carry (deployments/helm/tpu-dra-driver/templates/deviceclasses.yaml) without
+a CEL evaluator: each class name maps to the device ``type`` attribute its
+selector tests.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator, Optional
+
+from tpudra.kube import gvr
+
+
+class InsufficientResources(AssertionError):
+    """A device request cannot be satisfied by the published slices.
+
+    Subclasses AssertionError so test suites can assert on refusal the same
+    way the reference bats tests assert a pod stays Pending.
+    """
+
+
+# DeviceClass name -> predicate over the device `type` attribute, standing in
+# for the CEL expression of the corresponding DeviceClass object.
+_CLASS_TYPE = {
+    "tpu.google.com": lambda t: t == "chip",
+    "tpu-partition.google.com": lambda t: t.startswith("partition"),
+    "tpu-vfio.google.com": lambda t: t == "vfio",
+    "compute-domain-daemon.tpu.google.com": lambda t: t == "daemon",
+    "compute-domain-default-channel.tpu.google.com": lambda t: t == "channel",
+}
+
+# extendedResourceName -> DeviceClass advertising it (chart values.yaml).
+EXTENDED_RESOURCE_CLASSES = {
+    "tpu.google.com/chip": "tpu.google.com",
+}
+
+
+class Scheduler:
+    """First-fit DRA allocator with KEP-4815 counter arithmetic."""
+
+    def __init__(self, kube):
+        self._kube = kube
+        self._allocated: set[tuple[str, str]] = set()  # (pool, device)
+        # KEP-4815 ledger: units consumed per (pool, counterSet, counter).
+        self._consumed: dict[tuple[str, str, str], int] = {}
+        self._claim_demand: dict[str, dict[tuple[str, str, str], int]] = {}
+        # (pool, device) pairs each claim holds, for release-by-uid.
+        self._claim_devices: dict[str, list[tuple[str, str]]] = {}
+
+    def _published(self, node: Optional[str] = None) -> Iterator[tuple[str, str, dict]]:
+        for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
+            spec = s["spec"]
+            if node and spec.get("nodeName") not in (None, node):
+                continue
+            pool = spec["pool"]["name"]
+            for dev in spec.get("devices", []):
+                yield pool, spec["driver"], dev
+
+    def _capacity(self) -> dict[tuple[str, str, str], int]:
+        """Published SharedCounters across all slices of every pool (the
+        split form carries them in a devices-free slice)."""
+        caps: dict[tuple[str, str, str], int] = {}
+        for s in self._kube.list(gvr.RESOURCE_SLICES)["items"]:
+            pool = s["spec"]["pool"]["name"]
+            for cs in s["spec"].get("sharedCounters", []):
+                for cname, v in cs.get("counters", {}).items():
+                    caps[(pool, cs["name"], cname)] = int(v["value"])
+        return caps
+
+    @staticmethod
+    def _demand(pool: str, dev: dict) -> dict[tuple[str, str, str], int]:
+        out: dict[tuple[str, str, str], int] = {}
+        for cc in dev.get("consumesCounters", []):
+            for cname, v in cc.get("counters", {}).items():
+                out[(pool, cc["counterSet"], cname)] = int(v["value"])
+        return out
+
+    def _counters_fit(self, caps, demand) -> bool:
+        return all(
+            self._consumed.get(key, 0) + want <= caps.get(key, 0)
+            for key, want in demand.items()
+        )
+
+    def allocate(
+        self,
+        rct,
+        uid,
+        namespace="default",
+        name="claim",
+        create=True,
+        node: Optional[str] = None,
+        owner: Optional[dict] = None,
+    ):
+        """Allocate every request of an RCT-shaped spec; returns the
+        ResourceClaim (created in the apiserver unless ``create=False``).
+
+        ``node`` restricts candidate devices to slices advertising that
+        nodeName — the node-fit half of real scheduling.  Raises
+        InsufficientResources (leaking nothing) when any request cannot be
+        satisfied.
+        """
+        spec = rct["spec"]["spec"]["devices"]
+        results = []
+        caps = self._capacity()
+        claim_demand: dict[tuple[str, str, str], int] = {}
+        for req in spec.get("requests", []):
+            count = req.get("exactly", {}).get("count", 1)
+            matched = 0
+            for pool, driver, dev in self._published(node):
+                if (pool, dev["name"]) in self._allocated:
+                    continue
+                if not self._matches(req, dev):
+                    continue
+                demand = self._demand(pool, dev)
+                if not self._counters_fit(caps, demand):
+                    continue
+                self._allocated.add((pool, dev["name"]))
+                for key, want in demand.items():
+                    self._consumed[key] = self._consumed.get(key, 0) + want
+                    claim_demand[key] = claim_demand.get(key, 0) + want
+                results.append(
+                    {"request": req["name"], "driver": driver,
+                     "pool": pool, "device": dev["name"]}
+                )
+                matched += 1
+                if matched == count:
+                    break
+            if matched != count:
+                # Roll back everything this allocate reserved — a refused
+                # claim must not leak devices or counters.
+                for r in results:
+                    self._allocated.discard((r["pool"], r["device"]))
+                self._release_counters(claim_demand)
+                raise InsufficientResources(f"cannot satisfy request {req['name']}")
+        config = []
+        for entry in spec.get("config", []):
+            config.append({"source": "FromClaim", "requests": [], **entry})
+        claim = {
+            "apiVersion": "resource.k8s.io/v1",
+            "kind": "ResourceClaim",
+            "metadata": {"uid": uid, "namespace": namespace, "name": name},
+            "status": {"allocation": {"devices": {"results": results, "config": config}}},
+        }
+        if owner:
+            claim["metadata"]["ownerReferences"] = [owner]
+        if create:
+            # Allocation lives in the apiserver: the plugin resolves claim
+            # references kubelet sends over the DRA gRPC wire.
+            claim = self._kube.create(gvr.RESOURCE_CLAIMS, claim, namespace)
+        real_uid = claim["metadata"]["uid"]
+        self._claim_demand[real_uid] = claim_demand
+        self._claim_devices[real_uid] = [(r["pool"], r["device"]) for r in results]
+        return claim
+
+    def _matches(self, req, dev) -> bool:
+        cls = req.get("exactly", {}).get("deviceClassName", "")
+        dtype = dev["attributes"].get("type", {}).get("string", "")
+        pred = _CLASS_TYPE.get(cls)
+        if pred is None or not pred(dtype):
+            return False
+        if cls == "tpu-partition.google.com":
+            # DRA ANDs all selectors: every profile-bearing expression must
+            # match, not just the first one encountered.
+            for sel in req.get("exactly", {}).get("selectors", []):
+                expr = sel.get("cel", {}).get("expression", "")
+                m = re.search(r"\d+c\.\d+hbm", expr)
+                if m and (
+                    dev["attributes"].get("profile", {}).get("string") != m.group(0)
+                ):
+                    return False
+        return True
+
+    def allocate_extended(
+        self,
+        limits: dict[str, int],
+        uid: str,
+        namespace="default",
+        pod_name="pod",
+        node: Optional[str] = None,
+        owner: Optional[dict] = None,
+    ):
+        """The extendedResourceName translation a DRA-aware scheduler does
+        (reference test_gpu_extres.bats): a pod requesting
+        ``resources.limits: {"tpu.google.com/chip": N}`` gets a
+        scheduler-authored ResourceClaim against the DeviceClass that
+        advertises that extendedResourceName; the node plugin then sees a
+        perfectly ordinary claim."""
+        requests = []
+        for res_name, count in limits.items():
+            device_class = EXTENDED_RESOURCE_CLASSES.get(res_name)
+            assert device_class, f"no DeviceClass advertises {res_name}"
+            requests.append(
+                {
+                    "name": f"extres-{len(requests)}",
+                    "exactly": {"deviceClassName": device_class, "count": count},
+                }
+            )
+        rct = {
+            "metadata": {"name": f"{pod_name}-extended-resources"},
+            "spec": {"spec": {"devices": {"requests": requests, "config": []}}},
+        }
+        return self.allocate(
+            rct, uid, namespace, f"{pod_name}-extended-resources",
+            node=node, owner=owner,
+        )
+
+    def adopt(self, claim) -> None:
+        """Absorb an already-allocated claim into the ledger (sim restart:
+        the scheduler-cache rebuild a real scheduler does from the API)."""
+        uid = claim["metadata"]["uid"]
+        if uid in self._claim_devices:
+            return
+        results = (
+            claim.get("status", {})
+            .get("allocation", {})
+            .get("devices", {})
+            .get("results", [])
+        )
+        by_pool_dev = {}
+        for pool, _, dev in self._published():
+            by_pool_dev[(pool, dev["name"])] = dev
+        demand: dict[tuple[str, str, str], int] = {}
+        devices = []
+        for r in results:
+            key = (r["pool"], r["device"])
+            devices.append(key)
+            self._allocated.add(key)
+            dev = by_pool_dev.get(key)
+            if dev:
+                for k, want in self._demand(r["pool"], dev).items():
+                    demand[k] = demand.get(k, 0) + want
+                    self._consumed[k] = self._consumed.get(k, 0) + want
+        self._claim_devices[uid] = devices
+        self._claim_demand[uid] = demand
+
+    def release(self, claim) -> None:
+        """Release a claim's devices and counters (by object)."""
+        self.release_uid(
+            claim["metadata"]["uid"],
+            [
+                (r["pool"], r["device"])
+                for r in claim.get("status", {})
+                .get("allocation", {})
+                .get("devices", {})
+                .get("results", [])
+            ],
+        )
+
+    def release_uid(self, uid: str, devices=None) -> None:
+        for pool_dev in devices or self._claim_devices.get(uid, []):
+            self._allocated.discard(pool_dev)
+        self._claim_devices.pop(uid, None)
+        self._release_counters(self._claim_demand.pop(uid, {}))
+
+    def _release_counters(self, demand: dict[tuple[str, str, str], int]) -> None:
+        for key, want in demand.items():
+            left = self._consumed.get(key, 0) - want
+            if left > 0:
+                self._consumed[key] = left
+            else:
+                self._consumed.pop(key, None)
