@@ -75,13 +75,40 @@ def record_pid(state: str, pid: int, what: str) -> None:
 def spawn(state: str, what: str, argv: list[str], env: dict) -> subprocess.Popen:
     log_dir = os.path.join(state, "logs")
     os.makedirs(log_dir, exist_ok=True)
-    log = open(os.path.join(log_dir, f"{what}.log"), "w")
+    log = open(os.path.join(log_dir, f"{what}.log"), "a")
     proc = subprocess.Popen(
         argv, env=env, stdout=log, stderr=subprocess.STDOUT, start_new_session=True
     )
     log.close()
     record_pid(state, proc.pid, what)
+    # Record how to respawn, for `clusterctl restart` (failover tests).
+    procs_path = os.path.join(state, "procs.json")
+    try:
+        procs = json.load(open(procs_path))
+    except FileNotFoundError:
+        procs = {}
+    procs[what] = {"argv": argv, "env": env, "pid": proc.pid}
+    with open(procs_path + ".tmp", "w") as f:
+        json.dump(procs, f)
+    os.replace(procs_path + ".tmp", procs_path)
     return proc
+
+
+def wait_for_exit(pid: int, timeout: float, what: str = "") -> None:
+    """Wait for a process to die; escalate to SIGKILL past the deadline."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return
+        time.sleep(0.1)
+    if what:
+        print(f"clusterctl: {what} ({pid}) did not exit; SIGKILL", file=sys.stderr)
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        pass
 
 
 def base_env(server_url: str) -> dict:
@@ -168,6 +195,13 @@ def cmd_up(args) -> int:
             "host_index": i,
             "num_hosts": args.nodes,
         }
+        if args.static_partitions:
+            topo["static_partitions"] = [
+                [int(c), prof, int(cs), int(hs)]
+                for c, prof, cs, hs in (
+                    p.split(":") for p in args.static_partitions.split(",")
+                )
+            ]
         plug_env = dict(
             env,
             NODE_NAME=n,
@@ -265,6 +299,34 @@ def cmd_up(args) -> int:
 # ------------------------------------------------------------------ down
 
 
+def cmd_kill(args) -> int:
+    """SIGKILL one recorded process (failover tests kill daemons mid-run,
+    the reference's test_cd_failover.bats / lib/test_cd_nvb_failover.sh)."""
+    procs = json.load(open(os.path.join(args.state, "procs.json")))
+    pid = procs[args.what]["pid"]
+    try:
+        os.killpg(pid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            return 1
+    return 0
+
+
+def cmd_restart(args) -> int:
+    """Respawn a recorded process by name (driver restart tests)."""
+    procs = json.load(open(os.path.join(args.state, "procs.json")))
+    entry = procs[args.what]
+    try:
+        os.killpg(entry["pid"], signal.SIGTERM)
+    except (OSError, ProcessLookupError):
+        pass
+    wait_for_exit(entry["pid"], 10, args.what)
+    spawn(args.state, args.what, entry["argv"], entry["env"])
+    return 0
+
+
 def cmd_down(args) -> int:
     pids_file = os.path.join(args.state, "pids")
     try:
@@ -281,19 +343,7 @@ def cmd_down(args) -> int:
                 pass
     deadline = time.monotonic() + 15
     for pid_s, what in reversed(entries):
-        pid = int(pid_s)
-        while time.monotonic() < deadline:
-            try:
-                os.kill(pid, 0)
-            except ProcessLookupError:
-                break
-            time.sleep(0.1)
-        else:
-            print(f"clusterctl: {what} ({pid}) did not exit; SIGKILL", file=sys.stderr)
-            try:
-                os.killpg(pid, signal.SIGKILL)
-            except (OSError, ProcessLookupError):
-                pass
+        wait_for_exit(int(pid_s), max(0.0, deadline - time.monotonic()), what)
     os.unlink(pids_file)
     return 0
 
@@ -315,11 +365,23 @@ def main(argv=None) -> int:
     up.add_argument("--chips-per-node", type=int, default=4)
     up.add_argument("--feature-gates", default="",
                     help="FEATURE_GATES for the driver binaries")
+    up.add_argument("--static-partitions", default="",
+                    help="chip:profile:core_start:hbm_start[,...] per node")
     up.set_defaults(fn=cmd_up)
 
     dn = sub.add_parser("down")
     dn.add_argument("--state", required=True)
     dn.set_defaults(fn=cmd_down)
+
+    kp = sub.add_parser("kill")
+    kp.add_argument("--state", required=True)
+    kp.add_argument("--what", required=True)
+    kp.set_defaults(fn=cmd_kill)
+
+    rp = sub.add_parser("restart")
+    rp.add_argument("--state", required=True)
+    rp.add_argument("--what", required=True)
+    rp.set_defaults(fn=cmd_restart)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -1,0 +1,72 @@
+#!/usr/bin/env bats
+# Dynamic TensorCore partitions (the reference's test_gpu_dynmig.bats
+# analog): two pods carve disjoint partitions out of one chip, KEP-4815
+# counters block the full chip while partitions are live, and teardown
+# frees everything.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 1 \
+    --feature-gates DynamicPartitioning=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "two half-chip partition pods co-allocate on one chip" {
+  apply_spec tpu-test-partition.yaml
+  wait_until 90 pod_succeeded pod1 tpu-test-partition
+  wait_until 90 pod_succeeded pod2 tpu-test-partition
+  run kubectl logs pod1 -n tpu-test-partition
+  [[ "$output" != *"None"* ]]
+  run kubectl logs pod2 -n tpu-test-partition
+  [[ "$output" != *"None"* ]]
+}
+
+@test "full chip is counter-blocked while partitions are live" {
+  cat > "$TPUDRA_STATE/full-chip.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: full-chip
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: full-chip-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "print('ran')"]
+      resources:
+        claims: [{name: tpu}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: full-chip
+EOF
+  kubectl apply -f "$TPUDRA_STATE/full-chip.yaml"
+  sleep 3
+  # Still unscheduled: the chip's counters are consumed by the partitions.
+  [ "$(pod_phase full-chip-pod default)" != "Succeeded" ]
+  run kubectl get pod full-chip-pod -o 'jsonpath={.spec.nodeName}'
+  [ -z "$output" ]
+}
+
+@test "deleting the partition pods unblocks the full chip" {
+  kubectl delete pod pod1 pod2 -n tpu-test-partition
+  wait_until 90 pod_succeeded full-chip-pod default
+  kubectl delete pod full-chip-pod
+}

@@ -1,0 +1,54 @@
+#!/usr/bin/env bats
+# extendedResourceName path (the reference's test_gpu_extres.bats analog):
+# a pod requests plain `resources.limits: {tpu.google.com/chip: N}` with no
+# resourceClaims stanza; the DRA-aware scheduler authors the claim.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 4
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "pod with extended-resource limits gets chips via an authored claim" {
+  cat > "$TPUDRA_STATE/extres.yaml" <<'EOF'
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: extres-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import os
+          vis = os.environ["TPU_VISIBLE_DEVICES"].split(",")
+          assert len(vis) == 2, vis
+          print("extres got", len(vis))
+      resources:
+        limits:
+          tpu.google.com/chip: 2
+EOF
+  kubectl apply -f "$TPUDRA_STATE/extres.yaml"
+  wait_until 60 pod_succeeded extres-pod default
+  run kubectl logs extres-pod
+  [[ "$output" == *"extres got 2"* ]]
+}
+
+@test "the scheduler-authored claim exists and is owned by the pod" {
+  run kubectl get resourceclaims extres-pod-extended-resources -o json
+  [ "$status" -eq 0 ]
+  [[ "$output" == *'"kind": "Pod"'* ]]
+}
+
+@test "deleting the pod garbage-collects the authored claim" {
+  kubectl delete pod extres-pod
+  wait_until 30 sh -c "! kubectl get resourceclaims extres-pod-extended-resources -o name 2>/dev/null | grep -q extres"
+}

@@ -1,0 +1,78 @@
+#!/usr/bin/env bats
+# Static partitions (the reference's test_gpu_mig.bats analog): chips
+# pre-partitioned at install time advertise their partitions instead of the
+# whole chip; claims select by profile.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 2 \
+    --static-partitions "0:1c.4hbm:0:0,0:1c.4hbm:1:4"
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "partitioned chip advertises partitions, not itself" {
+  run kubectl get resourceslices -o json
+  [ "$status" -eq 0 ]
+  [[ "$output" == *"tpu-0-part-1c.4hbm-0-0"* ]]
+  [[ "$output" == *"tpu-0-part-1c.4hbm-1-4"* ]]
+  [[ "$output" == *'"tpu-1"'* ]]
+  # The parent of a statically-partitioned chip must not be allocatable.
+  ! echo "$output" | grep -q '"name": "tpu-0"'
+}
+
+@test "a profile-selected claim lands on a static partition" {
+  cat > "$TPUDRA_STATE/static-part.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: static-part
+spec:
+  spec:
+    devices:
+      requests:
+        - name: part
+          exactly:
+            deviceClassName: tpu-partition.google.com
+            selectors:
+              - cel:
+                  expression: |-
+                    device.attributes["tpu.google.com"].profile == "1c.4hbm"
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: static-part-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import os
+          parts = os.environ.get("TPUDRA_PARTITIONS")
+          assert parts, "no partition env injected"
+          print("partition env:", parts)
+      resources:
+        claims: [{name: part}]
+  resourceClaims:
+    - name: part
+      resourceClaimTemplateName: static-part
+EOF
+  kubectl apply -f "$TPUDRA_STATE/static-part.yaml"
+  wait_until 60 pod_succeeded static-part-pod default
+  run kubectl logs static-part-pod
+  [[ "$output" == *"partition env: "* ]]
+}
+
+@test "cleanup releases the partition" {
+  kubectl delete pod static-part-pod
+  wait_until 30 sh -c "! kubectl get pods -o name | grep -q static-part-pod"
+}

@@ -1,0 +1,87 @@
+#!/usr/bin/env bats
+# Claim churn under parallelism (the reference's test_gpu_stress.bats
+# analog): waves of pods racing for every chip on two nodes; everything
+# binds, runs, and frees.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 2 --chips-per-node 4
+}
+
+teardown_file() {
+  cluster_down
+}
+
+make_wave() {
+  local wave="$1" count="$2"
+  : > "$TPUDRA_STATE/wave.yaml"
+  for i in $(seq 1 "$count"); do
+    cat >> "$TPUDRA_STATE/wave.yaml" <<EOF
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: stress-$wave-$i
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: stress-$wave-$i
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "import os; print('chip', os.environ['TPU_VISIBLE_DEVICES'])"]
+      resources:
+        claims: [{name: tpu}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: stress-$wave-$i
+---
+EOF
+  done
+}
+
+@test "wave 1: 8 single-chip pods saturate both nodes and all succeed" {
+  make_wave 1 8
+  kubectl apply -f "$TPUDRA_STATE/wave.yaml"
+  for i in $(seq 1 8); do
+    wait_until 120 pod_succeeded "stress-1-$i" default
+  done
+  # Every chip was used exactly once: 8 distinct (node, chip) grants.
+  grants=$(for i in $(seq 1 8); do
+    node=$(kubectl get pod "stress-1-$i" -o 'jsonpath={.spec.nodeName}')
+    chip=$(kubectl logs "stress-1-$i" | grep '^chip ')
+    echo "$node/$chip"
+  done | sort -u | wc -l)
+  [ "$grants" -eq 8 ]
+}
+
+@test "a 9th pod stays pending until the wave is deleted" {
+  make_wave 2 1
+  kubectl apply -f "$TPUDRA_STATE/wave.yaml"
+  sleep 2
+  [ "$(pod_phase stress-2-1 default)" != "Succeeded" ]
+  for i in $(seq 1 8); do kubectl delete pod "stress-1-$i" >/dev/null; done
+  wait_until 120 pod_succeeded stress-2-1 default
+}
+
+@test "wave 3 reuses every freed chip" {
+  kubectl delete pod stress-2-1 >/dev/null
+  make_wave 3 8
+  kubectl apply -f "$TPUDRA_STATE/wave.yaml"
+  for i in $(seq 1 8); do
+    wait_until 120 pod_succeeded "stress-3-$i" default
+  done
+  for i in $(seq 1 8); do kubectl delete pod "stress-3-$i" >/dev/null; done
+}

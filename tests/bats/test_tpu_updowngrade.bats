@@ -1,0 +1,103 @@
+#!/usr/bin/env bats
+# Driver restart with live claims (the reference's test_gpu_updowngrade.bats
+# analog): the checkpoint is the node-local source of truth, so a plugin
+# restart mid-claim must preserve prepared state — new claims bind after the
+# restart and the surviving claim unprepares cleanly.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 2
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "a pod holds a chip across a plugin restart" {
+  cat > "$TPUDRA_STATE/holder.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: holder
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: holder-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "import time; time.sleep(600)"]
+      resources:
+        claims: [{name: tpu}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: holder
+EOF
+  kubectl apply -f "$TPUDRA_STATE/holder.yaml"
+  wait_until 60 sh -c "[ \"\$(kubectl get pod holder-pod -o 'jsonpath={.status.phase}')\" = Running ]"
+
+  python3 "$BATS_DIR/clusterctl.py" restart --state "$TPUDRA_STATE" --what plugin-node-0
+
+  # The restarted plugin republishes its slices (fresh pool generation).
+  wait_until 60 sh -c "kubectl get resourceslices -o json | grep -q '\"tpu-1\"'"
+  # The held claim is still prepared: its transient CDI spec survives.
+  uid=$(kubectl get resourceclaims holder-pod-tpu -o 'jsonpath={.metadata.uid}')
+  ls "$TPUDRA_STATE"/node-0/cdi/ | grep -q "$uid"
+}
+
+@test "new claims bind against the restarted plugin" {
+  cat > "$TPUDRA_STATE/after.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: after-restart
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: after-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "import os; print('post-restart', os.environ['TPU_VISIBLE_DEVICES'])"]
+      resources:
+        claims: [{name: tpu}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: after-restart
+EOF
+  kubectl apply -f "$TPUDRA_STATE/after.yaml"
+  wait_until 60 pod_succeeded after-pod default
+  run kubectl logs after-pod
+  [[ "$output" == *"post-restart"* ]]
+}
+
+@test "the surviving claim unprepares cleanly after the restart" {
+  uid=$(kubectl get resourceclaims holder-pod-tpu -o 'jsonpath={.metadata.uid}')
+  kubectl delete pod holder-pod after-pod
+  wait_until 60 sh -c "! ls '$TPUDRA_STATE'/node-0/cdi/ | grep -q '$uid'"
+}
