@@ -1,0 +1,53 @@
+#!/usr/bin/env bats
+# ComputeDomain channel injection (the reference's
+# test_cd_imex_chan_inject.bats analog): a CD pulls its daemon onto the
+# workload's node, the real compute-domain-daemon + tpu-slicewatchd form
+# the domain, and the gated workload pod starts with its channel injected.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --cd
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "controller materializes RCTs for the ComputeDomain" {
+  apply_spec domain/channel-injection.yaml
+  # Workload RCT appears in the user namespace, daemon RCT in the driver's.
+  wait_until 60 kubectl get resourceclaimtemplates chan-single-rct -n tpu-domain-demo -o name
+  wait_until 60 sh -c "kubectl get resourceclaimtemplates -n $TPUDRA_NAMESPACE -o name | grep -q ."
+}
+
+@test "workload pod is gated until the domain forms, then runs" {
+  # The channel claim's prepare blocks (retryable error) until the CD is
+  # Ready; the daemon DS is pulled onto the node by the claim itself.
+  wait_until 60 sh -c "kubectl get daemonsets -n $TPUDRA_NAMESPACE -o name | grep -q computedomain-daemon"
+  wait_until 180 pod_succeeded chan-single-pod tpu-domain-demo
+  run kubectl logs chan-single-pod -n tpu-domain-demo
+  [[ "$output" == *"channels ['0']"* ]] || [[ "$output" == *"channels"* ]]
+}
+
+@test "CD status is Ready with the node listed" {
+  run kubectl get computedomains chan-single -n tpu-domain-demo -o 'jsonpath={.status.status}'
+  [ "$output" = "Ready" ]
+  run kubectl get computedomains chan-single -n tpu-domain-demo -o 'jsonpath={.status.nodes[*].name}'
+  [[ "$output" == *"node-0"* ]]
+}
+
+@test "clique CR carries a Ready daemon entry" {
+  run kubectl get computedomaincliques -n "$TPUDRA_NAMESPACE" -o json
+  [ "$status" -eq 0 ]
+  [[ "$output" == *'"status": "Ready"'* ]]
+}
+
+@test "deleting the CD tears down DS, RCTs, and node labels" {
+  kubectl delete computedomains chan-single -n tpu-domain-demo
+  wait_until 90 sh -c "! kubectl get computedomains -n tpu-domain-demo -o name | grep -q chan"
+  wait_until 90 sh -c "! kubectl get daemonsets -n $TPUDRA_NAMESPACE -o name | grep -q computedomain-daemon"
+  wait_until 90 sh -c "! kubectl get resourceclaimtemplates chan-single-rct -n tpu-domain-demo -o name 2>/dev/null | grep -q chan"
+  run kubectl get nodes node-0 -o 'jsonpath={.metadata.labels}'
+  ! echo "$output" | grep -q computeDomain
+}

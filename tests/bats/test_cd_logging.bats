@@ -1,0 +1,47 @@
+#!/usr/bin/env bats
+# Logging contract (the reference's test_cd_logging.bats analog): verbosity
+# set on the controller propagates into the per-CD DaemonSet it renders,
+# and every binary emits the level-0 startup identity.
+
+load helpers.sh
+
+setup_file() {
+  LOG_VERBOSITY=5 cluster_up --nodes 1 --cd
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "controller and plugins log build identity and startup config" {
+  for what in controller plugin-node-0 cdplugin-node-0; do
+    log="$(plugin_log $what)"
+    [[ "$log" == *"tpudra 0."* ]]
+    [[ "$log" == *"startup config:"* ]]
+  done
+}
+
+@test "controller verbosity lands in the rendered DaemonSet env" {
+  apply_spec domain/channel-injection.yaml
+  wait_until 90 sh -c "kubectl get daemonsets -n $TPUDRA_NAMESPACE -o name | grep -q computedomain-daemon"
+  run kubectl get daemonsets -n "$TPUDRA_NAMESPACE" -o json
+  [[ "$output" == *'"LOG_VERBOSITY"'* ]]
+  echo "$output" | python3 -c '
+import json, sys
+for ds in json.load(sys.stdin)["items"]:
+    env = {e["name"]: e.get("value") for c in ds["spec"]["template"]["spec"]["containers"] for e in c.get("env", [])}
+    assert env.get("LOG_VERBOSITY") == "5", env
+print("verbosity propagated")
+'
+}
+
+@test "daemon pod startup dump appears in kubectl logs while running" {
+  wait_until 180 pod_succeeded chan-single-pod tpu-domain-demo
+  uid=$(kubectl get computedomains chan-single -n tpu-domain-demo -o 'jsonpath={.metadata.uid}')
+  wait_until 30 pod_log_has "computedomain-daemon-$uid-node-0" "startup config:" "$TPUDRA_NAMESPACE"
+}
+
+@test "controller startup dump records the effective verbosity" {
+  log="$(plugin_log controller)"
+  [[ "$log" == *"log_verbosity=5"* ]]
+}

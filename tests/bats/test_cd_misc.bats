@@ -1,0 +1,44 @@
+#!/usr/bin/env bats
+# ComputeDomain odds and ends (the reference's test_cd_misc.bats analog):
+# allocationMode All injects the full 2048-channel set, and a node's
+# fabric resources are reusable by a successor domain after teardown.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --cd
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "allocationMode All injects all 2048 channels" {
+  apply_spec domain/channel-injection-all.yaml
+  wait_until 240 pod_succeeded chan-all-pod tpu-domain-demo
+  run kubectl logs chan-all-pod -n tpu-domain-demo
+  [[ "$output" == *"2048 channels"* ]]
+}
+
+@test "teardown of the first domain completes" {
+  kubectl delete pod chan-all-pod -n tpu-domain-demo
+  kubectl delete computedomains chan-all -n tpu-domain-demo
+  wait_until 120 sh -c "! kubectl get computedomains -n tpu-domain-demo -o name | grep -q chan-all"
+  wait_until 120 sh -c "! kubectl get daemonsets -n $TPUDRA_NAMESPACE -o name | grep -q computedomain-daemon"
+}
+
+@test "a successor domain forms on the same node" {
+  apply_spec domain/channel-injection.yaml
+  wait_until 240 pod_succeeded chan-single-pod tpu-domain-demo
+  run kubectl logs chan-single-pod -n tpu-domain-demo
+  [[ "$output" == *"channels ['0']"* ]]
+  kubectl delete pod chan-single-pod -n tpu-domain-demo
+  kubectl delete computedomains chan-single -n tpu-domain-demo
+  wait_until 120 sh -c "! kubectl get computedomains -n tpu-domain-demo -o name | grep -q chan-single"
+}
+
+@test "no cliques or claims leak after both domains are gone" {
+  wait_until 60 sh -c "! kubectl get computedomaincliques -n $TPUDRA_NAMESPACE -o name | grep -q ."
+  run kubectl get resourceclaims -n tpu-domain-demo -o name
+  [ -z "$output" ]
+}

@@ -1,0 +1,94 @@
+#!/usr/bin/env bats
+# Two-node ComputeDomain workload (the reference's
+# test_cd_mnnvl_workload.bats analog): pods pinned to both nodes of the
+# slice are gated until the full domain forms — real daemons on both nodes,
+# real slicewatchd heartbeats between them — then start with channels and
+# the slice topology env JAX's SPMD init consumes.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 2 --cd
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "two pinned pods form and consume a 2-node domain" {
+  cat > "$TPUDRA_STATE/cd2.yaml" <<'EOF'
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: cd2
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata:
+  namespace: cd2
+  name: two-node
+spec:
+  numNodes: 2
+  channel:
+    resourceClaimTemplate:
+      name: two-node-rct
+    allocationMode: Single
+EOF
+  for n in 0 1; do
+    cat >> "$TPUDRA_STATE/cd2.yaml" <<EOF
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: cd2
+  name: worker-$n
+spec:
+  restartPolicy: Never
+  nodeSelector:
+    kubernetes.io/hostname: node-$n
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import os
+          assert os.environ["TPUDRA_DOMAIN_CHANNELS"], "no channel injected"
+          assert os.environ["TPUDRA_NUM_HOSTS"] == "2", os.environ.get("TPUDRA_NUM_HOSTS")
+          print("worker on", os.environ.get("TPUDRA_HOST_INDEX"),
+                "domain", os.environ["TPUDRA_DOMAIN_UID"])
+      resources:
+        claims:
+          - name: channel
+  resourceClaims:
+    - name: channel
+      resourceClaimTemplateName: two-node-rct
+EOF
+  done
+  kubectl apply -f "$TPUDRA_STATE/cd2.yaml"
+  wait_until 240 pod_succeeded worker-0 cd2
+  wait_until 240 pod_succeeded worker-1 cd2
+}
+
+@test "workers saw distinct host indexes of the same domain" {
+  d0=$(kubectl logs worker-0 -n cd2 | grep -o 'domain .*')
+  d1=$(kubectl logs worker-1 -n cd2 | grep -o 'domain .*')
+  [ "$d0" = "$d1" ]
+  h0=$(kubectl logs worker-0 -n cd2 | grep -o 'worker on [0-9]*')
+  h1=$(kubectl logs worker-1 -n cd2 | grep -o 'worker on [0-9]*')
+  [ "$h0" != "$h1" ]
+}
+
+@test "CD reports both nodes Ready" {
+  run kubectl get computedomains two-node -n cd2 -o 'jsonpath={.status.status}'
+  [ "$output" = "Ready" ]
+  run kubectl get computedomains two-node -n cd2 -o 'jsonpath={.status.nodes[*].name}'
+  [[ "$output" == *"node-0"* ]]
+  [[ "$output" == *"node-1"* ]]
+}
+
+@test "teardown" {
+  kubectl delete pod worker-0 worker-1 -n cd2
+  kubectl delete computedomains two-node -n cd2
+  wait_until 90 sh -c "! kubectl get computedomains -n cd2 -o name | grep -q two-node"
+}

@@ -96,7 +96,7 @@ class TestEntryPoints:
 
         with open(os.path.join(REPO, "pyproject.toml"), "rb") as f:
             scripts = tomllib.load(f)["project"]["scripts"]
-        assert len(scripts) == 6
+        assert len(scripts) == 7
         for target in scripts.values():
             module, _, attr = target.partition(":")
             mod = importlib.import_module(module)

@@ -51,6 +51,7 @@ class ComputeDomainManager:
         image: str = "tpudra:latest",
         max_nodes_per_domain: int = 0,
         additional_namespaces: tuple[str, ...] = (),
+        log_verbosity: int = 0,
     ):
         self._kube = kube
         self._ns = driver_namespace
@@ -60,6 +61,7 @@ class ComputeDomainManager:
             driver_namespace,
             additional_namespaces=additional_namespaces,
             image=image,
+            log_verbosity=log_verbosity,
         )
         self.daemon_rcts = DaemonResourceClaimTemplateManager(kube, driver_namespace)
         self.workload_rcts = WorkloadResourceClaimTemplateManager(kube)

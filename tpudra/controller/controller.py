@@ -37,6 +37,9 @@ class ManagerConfig:
     max_nodes_per_domain: int = 0
     resync_period: float = 600.0
     additional_namespaces: tuple[str, ...] = ()
+    # Rendered into spawned daemon pods as LOG_VERBOSITY (the reference's
+    # klog -v template propagation, daemonset.go:45-56).
+    log_verbosity: int = 0
 
 
 class Controller:
@@ -49,6 +52,7 @@ class Controller:
             image=self._config.image,
             max_nodes_per_domain=self._config.max_nodes_per_domain,
             additional_namespaces=self._config.additional_namespaces,
+            log_verbosity=self._config.log_verbosity,
         )
         self.queue = WorkQueue(
             rate_limiter=default_controller_rate_limiter(), name="controller"

@@ -54,6 +54,7 @@ def main(argv=None) -> int:
             additional_namespaces=tuple(
                 ns.strip() for ns in args.additional_namespaces.split(",") if ns.strip()
             ),
+            log_verbosity=args.log_verbosity,
         ),
     )
     debug = None

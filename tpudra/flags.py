@@ -21,6 +21,15 @@ def env_default(env: str, fallback: str = "") -> str:
     return os.environ.get(env, fallback)
 
 
+def _env_int(env: str, fallback: int) -> int:
+    """Env mirror for an integer flag; malformed values fall back instead
+    of crashing the binary before arg parsing."""
+    try:
+        return int(os.environ.get(env, "") or fallback)
+    except ValueError:
+        return fallback
+
+
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--kubeconfig",
@@ -37,6 +46,13 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         default=env_default("LOG_LEVEL", "INFO"),
         help="python logging level name [LOG_LEVEL]",
     )
+    parser.add_argument(
+        "--log-verbosity",
+        type=int,
+        default=_env_int("LOG_VERBOSITY", 0),
+        help="klog-style numeric verbosity; >=4 implies DEBUG and is "
+        "propagated into spawned daemon pods [LOG_VERBOSITY]",
+    )
     from tpudra import buildinfo
 
     parser.add_argument(
@@ -50,12 +66,11 @@ def setup_common(args: argparse.Namespace) -> None:
     # into spawned daemon pods as LOG_VERBOSITY (the reference's klog -v
     # template propagation, daemonset.go:45-56).  A klog-style v>=4 means
     # debug; an explicit LOG_LEVEL/--log-level still wins.
-    if "LOG_LEVEL" not in os.environ and level_name == "INFO":
-        try:
-            if int(os.environ.get("LOG_VERBOSITY", "0") or "0") >= 4:
-                level_name = "DEBUG"
-        except ValueError:
-            pass
+    verbosity = getattr(args, "log_verbosity", None)
+    if verbosity is None:  # caller without common flags: the env mirror
+        verbosity = _env_int("LOG_VERBOSITY", 0)
+    if "LOG_LEVEL" not in os.environ and level_name == "INFO" and verbosity >= 4:
+        level_name = "DEBUG"
     logging.basicConfig(
         level=getattr(logging, level_name, logging.INFO),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",

@@ -33,7 +33,9 @@ def _parse_path(path: str):
     else:
         raise errors.BadRequest(f"unrecognized path {path!r}")
     namespace = None
-    if len(rest) >= 2 and rest[0] == "namespaces":
+    # "/namespaces/<ns>/<resource>..." is a namespace prefix; a bare
+    # "/namespaces[/<name>]" is the cluster-scoped Namespace resource.
+    if len(rest) >= 3 and rest[0] == "namespaces":
         namespace = rest[1]
         rest = rest[2:]
     if not rest:

@@ -98,6 +98,8 @@ class _Container:
         self.last_exit: Optional[int] = None
         self.next_start = 0.0  # restart backoff deadline
         self.next_probe = 0.0
+        self.next_log_sync = 0.0
+        self.synced_len = -1
 
     def running(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
@@ -220,11 +222,12 @@ class ClusterSim:
         self._stop.set()
 
     def step(self) -> None:
-        self._sync_daemonsets()
+        node_labels = self._node_labels()
+        self._sync_daemonsets(node_labels)
         self._sync_deployments()
         pods = self._kube.list(gvr.PODS).get("items", [])
         by_uid = {p["metadata"]["uid"]: p for p in pods}
-        self._schedule(pods)
+        self._schedule(pods, node_labels)
         self._kubelet(pods)
         self._reap(by_uid)
 
@@ -280,17 +283,20 @@ class ClusterSim:
             )
         ]
 
-    def _sync_daemonsets(self) -> None:
-        node_labels = self._node_labels()
-        seen_owner_uids = set()
+    @staticmethod
+    def _node_matches(node_labels: dict, node: str, selector: dict) -> bool:
+        return all(
+            node_labels.get(node, {}).get(k) == v for k, v in selector.items()
+        )
+
+    def _sync_daemonsets(self, node_labels: dict) -> None:
         for ds in self._kube.list(gvr.DAEMONSETS).get("items", []):
             md, tmpl = ds["metadata"], ds["spec"]["template"]
-            seen_owner_uids.add(md["uid"])
             selector = tmpl["spec"].get("nodeSelector", {})
             want_nodes = {
                 n
                 for n in self._nodes
-                if all(node_labels.get(n, {}).get(k) == v for k, v in selector.items())
+                if self._node_matches(node_labels, n, selector)
             }
             owner = {
                 "apiVersion": "apps/v1", "kind": "DaemonSet",
@@ -458,14 +464,17 @@ class ClusterSim:
             return None
         return resolved
 
-    def _schedule(self, pods: list[dict]) -> None:
+    def _schedule(self, pods: list[dict], node_labels: dict) -> None:
         for pod in pods:
             md = pod["metadata"]
             if md.get("deletionTimestamp") or pod["spec"].get("nodeName"):
                 continue
             if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
                 continue
+            selector = pod["spec"].get("nodeSelector", {})
             for node in self._nodes:
+                if not self._node_matches(node_labels, node, selector):
+                    continue
                 claims = self._resolve_claims(pod, node)
                 if claims is None:
                     continue
@@ -634,6 +643,23 @@ class ClusterSim:
         restart_always = run.pod["spec"].get("restartPolicy", "Always") == "Always"
         now = time.monotonic()
         for c in run.containers:
+            if c.running() and now >= c.next_log_sync:
+                # Running containers sync logs periodically so `kubectl
+                # logs` works mid-run (exited ones sync below).  Track the
+                # uncapped file size: the capped tail's length pins at
+                # LOG_CAP and would freeze the sync.
+                c.next_log_sync = now + 2.0
+                try:
+                    size = os.path.getsize(c.log_path)
+                except OSError:
+                    size = 0
+                if size != c.synced_len:
+                    c.synced_len = size
+                    tail = c.log_tail()
+                    if tail:
+                        self._annotate(
+                            run, {LOG_ANNOTATION_PREFIX + c.name: tail}
+                        )
             if not c.running() and c.proc is not None:
                 rc = c.proc.poll()
                 if c.last_exit is None or c.last_exit != rc:
