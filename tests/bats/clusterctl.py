@@ -123,10 +123,56 @@ def base_env(server_url: str) -> dict:
 # ----------------------------------------------------------------- serve
 
 
+def _install_admission(fake, webhook_url: str) -> None:
+    """Route ResourceClaim(Template) writes through the validating webhook
+    (what the real apiserver's ValidatingWebhookConfiguration does): a
+    denial rejects the write.  failurePolicy=Ignore while the webhook is
+    still booting."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from tpudra.kube import errors, gvr
+
+    def admission_reactor(verb, g, obj):
+        if obj is None:
+            return
+        review = {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "sim-admission",
+                "object": obj,
+            },
+        }
+        req = urllib.request.Request(
+            webhook_url,
+            data=_json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            resp = _json.loads(urllib.request.urlopen(req, timeout=5).read())
+        except (OSError, ValueError):
+            return  # failurePolicy: Ignore
+        response = resp.get("response", {})
+        if not response.get("allowed", True):
+            msg = response.get("status", {}).get("message", "denied")
+            raise errors.BadRequest(f"admission webhook denied the request: {msg}")
+
+    # Create only: a claim's spec is immutable after creation, and FakeKube
+    # routes every status/patch write through the "update" verb — reacting
+    # there would hold the apiserver's global lock for a webhook round-trip
+    # on each of the driver's frequent status writes.
+    for g in (gvr.RESOURCE_CLAIMS, gvr.RESOURCE_CLAIM_TEMPLATES):
+        fake.react("create", g, admission_reactor)
+
+
 def cmd_serve(args) -> int:
     from tpudra.kube.httpserver import FakeKubeServer
 
     server = FakeKubeServer()
+    if args.webhook_url:
+        _install_admission(server.fake, args.webhook_url)
     server.start()
     with open(args.url_file + ".tmp", "w") as f:
         f.write(server.url)
@@ -153,8 +199,17 @@ def cmd_up(args) -> int:
     open(os.path.join(state, "pids"), "w").close()
 
     url_file = os.path.join(state, "apiserver.url")
-    spawn(state, "apiserver", [sys.executable, HERE + "/clusterctl.py", "serve",
-                               "--url-file", url_file], dict(os.environ))
+    serve_argv = [sys.executable, HERE + "/clusterctl.py", "serve",
+                  "--url-file", url_file]
+    webhook_port = 0
+    if args.webhook:
+        webhook_port = free_ports(1)[0]
+        serve_argv += ["--webhook-url",
+                       f"http://127.0.0.1:{webhook_port}"
+                       "/validate-resource-claim-parameters"]
+    serve_env = dict(os.environ)
+    serve_env["PYTHONPATH"] = REPO + os.pathsep + serve_env.get("PYTHONPATH", "")
+    spawn(state, "apiserver", serve_argv, serve_env)
     wait_for(lambda: os.path.exists(url_file), 30, "apiserver URL")
     server_url = open(url_file).read().strip()
     kube = KubeClient(server_url)
@@ -249,6 +304,32 @@ def cmd_up(args) -> int:
             sys.executable, "-m", "tpudra.controller.main",
             "--namespace", NAMESPACE,
         ], env)
+
+    if args.webhook:
+        webhook_env = dict(env)
+        if args.feature_gates:
+            webhook_env["FEATURE_GATES"] = args.feature_gates
+        spawn(state, "webhook", [
+            sys.executable, "-m", "tpudra.webhook.main",
+            "--port", str(webhook_port),
+        ], webhook_env)
+
+        def webhook_answering():
+            import json as _json
+            import urllib.request
+
+            review = {"apiVersion": "admission.k8s.io/v1",
+                      "kind": "AdmissionReview",
+                      "request": {"uid": "probe", "object": {}}}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{webhook_port}"
+                "/validate-resource-claim-parameters",
+                data=_json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return _json.loads(urllib.request.urlopen(req, timeout=2).read())
+
+        wait_for(webhook_answering, 30, "webhook answering")
 
     sim_cfg = {
         "server": server_url,
@@ -354,6 +435,7 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("serve")
     sp.add_argument("--url-file", required=True)
+    sp.add_argument("--webhook-url", default="")
     sp.set_defaults(fn=cmd_serve)
 
     up = sub.add_parser("up")
@@ -361,6 +443,9 @@ def main(argv=None) -> int:
     up.add_argument("--nodes", type=int, default=1)
     up.add_argument("--cd", action="store_true",
                     help="also start CD plugins + controller + fabric identity")
+    up.add_argument("--webhook", action="store_true",
+                    help="start the admission webhook and route claim writes "
+                    "through it")
     up.add_argument("--generation", default="v5p")
     up.add_argument("--chips-per-node", type=int, default=4)
     up.add_argument("--feature-gates", default="",

@@ -1,0 +1,97 @@
+#!/usr/bin/env bats
+# CD plugin restart with a live domain (the reference's
+# test_cd_updowngrade.bats analog): the CD kubelet plugin's checkpoint
+# preserves prepared channel state across a restart — the domain stays up,
+# the held channel survives, and new channel claims bind afterwards.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --cd
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "form a domain with a long-running channel holder" {
+  cat > "$TPUDRA_STATE/cdu.yaml" <<'EOF'
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata:
+  namespace: cdu
+  name: upgrade
+spec:
+  numNodes: 1
+  channel:
+    resourceClaimTemplate:
+      name: upgrade-rct
+    allocationMode: Single
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: cdu
+  name: holder
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "import time; time.sleep(600)"]
+      resources:
+        claims: [{name: channel}]
+  resourceClaims:
+    - name: channel
+      resourceClaimTemplateName: upgrade-rct
+EOF
+  kubectl apply -f "$TPUDRA_STATE/cdu.yaml"
+  wait_until 240 sh -c "kubectl get pod holder -n cdu -o 'jsonpath={.status.phase}' | grep -q Running"
+}
+
+@test "restarting the CD plugin preserves the domain and the held channel" {
+  uid=$(kubectl get resourceclaims holder-channel -n cdu -o 'jsonpath={.metadata.uid}')
+  python3 "$BATS_DIR/clusterctl.py" restart --state "$TPUDRA_STATE" --what cdplugin-node-0
+  # Slices republished by the restarted plugin.
+  wait_until 90 sh -c "kubectl get resourceslices -o json | grep -q compute-domain.tpu.google.com"
+  # Checkpointed channel claim still prepared: its CDI spec survives.
+  ls "$TPUDRA_STATE"/node-0/cdi/ | grep -q "$uid"
+  # Domain still Ready.
+  run kubectl get computedomains upgrade -n cdu -o 'jsonpath={.status.status}'
+  [ "$output" = "Ready" ]
+}
+
+@test "a new channel claim binds against the restarted plugin" {
+  cat > "$TPUDRA_STATE/cdu2.yaml" <<'EOF'
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: cdu
+  name: second
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import os
+          print("second channels", os.environ["TPUDRA_DOMAIN_CHANNELS"])
+      resources:
+        claims: [{name: channel}]
+  resourceClaims:
+    - name: channel
+      resourceClaimTemplateName: upgrade-rct
+EOF
+  kubectl apply -f "$TPUDRA_STATE/cdu2.yaml"
+  wait_until 120 sh -c "[ \"\$(kubectl get pod second -n cdu -o 'jsonpath={.status.phase}')\" = Succeeded ]"
+  run kubectl logs second -n cdu
+  [[ "$output" == *"second channels"* ]]
+}
+
+@test "teardown" {
+  kubectl delete pod holder second -n cdu
+  kubectl delete computedomains upgrade -n cdu
+  wait_until 120 sh -c "! kubectl get computedomains -n cdu -o name | grep -q upgrade"
+}

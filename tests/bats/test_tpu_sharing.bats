@@ -1,0 +1,43 @@
+#!/usr/bin/env bats
+# Multi-process sharing (the MPS-analog half of the reference's
+# test_gpu_basic.bats sharing coverage): the plugin stamps a per-claim
+# control-daemon Deployment, the sim runs the real tpu-mp-control-daemon
+# as its pod, prepare gates on its readiness, and the workload containers
+# get the TPUDRA_MP_* env through CDI.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 2 \
+    --feature-gates MultiProcessSharing=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "MP-shared claim: control daemon deployed, workers see broker env" {
+  apply_spec sharing/multiprocess-demo.yaml
+  # The control-daemon Deployment is stamped by the plugin and becomes
+  # ready before the workload can start.
+  wait_until 120 sh -c "kubectl get deployments -n $TPUDRA_NAMESPACE -o name | grep -q tpu-mp"
+  wait_until 180 pod_succeeded mp-pod tpu-sharing
+  run kubectl logs mp-pod -n tpu-sharing -c worker-0
+  [[ "$output" == *"pipe: /var/run/tpudra/mp/"* ]]
+  [[ "$output" == *"pct: 50"* ]]
+  run kubectl logs mp-pod -n tpu-sharing -c worker-1
+  [[ "$output" == *"pipe: /var/run/tpudra/mp/"* ]]
+}
+
+@test "control-daemon pod runs the real broker with materialized limits" {
+  pod=$(kubectl get pods -n "$TPUDRA_NAMESPACE" -o name | grep tpu-mp | head -1)
+  [ -n "$pod" ]
+  run kubectl get pod "${pod#*/}" -n "$TPUDRA_NAMESPACE" -o 'jsonpath={.status.conditions[0].status}'
+  [ "$output" = "True" ]
+}
+
+@test "unprepare tears the control daemon down" {
+  kubectl delete pod mp-pod -n tpu-sharing
+  wait_until 120 sh -c "! kubectl get deployments -n $TPUDRA_NAMESPACE -o name | grep -q tpu-mp"
+  wait_until 60 sh -c "! kubectl get pods -n $TPUDRA_NAMESPACE -o name | grep -q tpu-mp"
+}

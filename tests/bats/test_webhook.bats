@@ -1,0 +1,100 @@
+#!/usr/bin/env bats
+# Admission webhook in the apply path (SURVEY §2.5): config typos are
+# caught at kubectl-apply time instead of at NodePrepareResources time.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --webhook --feature-gates TimeSlicingSettings=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "a valid opaque config is admitted" {
+  apply_spec tpu-test2.yaml
+  run kubectl get resourceclaimtemplates shared-tpu -n tpu-test2 -o name
+  [ "$status" -eq 0 ]
+}
+
+@test "an unknown config kind is rejected at apply time" {
+  cat > "$TPUDRA_STATE/bad-kind.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: bad-kind
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+      config:
+        - opaque:
+            driver: tpu.google.com
+            parameters:
+              apiVersion: resource.tpu.google.com/v1beta1
+              kind: NopeConfig
+EOF
+  run kubectl apply -f "$TPUDRA_STATE/bad-kind.yaml"
+  [ "$status" -ne 0 ]
+  [[ "$output" == *"admission webhook denied"* ]]
+  [[ "$output" == *"NopeConfig"* ]]
+  run kubectl get resourceclaimtemplates bad-kind -o name
+  [ "$status" -ne 0 ] || [ -z "$output" ]
+}
+
+@test "an invalid field value is rejected with the validator's message" {
+  cat > "$TPUDRA_STATE/bad-value.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: bad-value
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+      config:
+        - opaque:
+            driver: tpu.google.com
+            parameters:
+              apiVersion: resource.tpu.google.com/v1beta1
+              kind: TpuConfig
+              sharing:
+                strategy: NotAStrategy
+EOF
+  run kubectl apply -f "$TPUDRA_STATE/bad-value.yaml"
+  [ "$status" -ne 0 ]
+  [[ "$output" == *"admission webhook denied"* ]]
+}
+
+@test "configs for other drivers pass through untouched" {
+  cat > "$TPUDRA_STATE/other-driver.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: other-driver
+spec:
+  spec:
+    devices:
+      requests:
+        - name: dev
+          exactly:
+            deviceClassName: gpu.example.com
+      config:
+        - opaque:
+            driver: gpu.example.com
+            parameters:
+              whatever: true
+EOF
+  run kubectl apply -f "$TPUDRA_STATE/other-driver.yaml"
+  [ "$status" -eq 0 ]
+}

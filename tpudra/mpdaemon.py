@@ -151,6 +151,10 @@ def main(argv=None) -> int:
 
     if args.command == "status":
         return status()
+    if args.command is None:
+        # Bare invocation (the Deployment template's command) means `run`;
+        # re-parse so the run subparser's common flags are populated.
+        args = p.parse_args(["run"] if argv is None else ["run", *argv])
 
     setup_common(args)  # shared logging/gates, honors LOG_LEVEL/LOG_VERBOSITY
     daemon = ControlDaemon(_pipe_dir())

@@ -127,6 +127,14 @@ class _PodRun:
         self.workdir = tempfile.mkdtemp(prefix=f"pod-{self.name}-")
         self.next_prepare = 0.0
         self.last_status: Optional[tuple] = None
+        # Async prepare: the gRPC call can legitimately block on work only
+        # this sim performs (e.g. the MP control-daemon Deployment the
+        # plugin stamps and then waits on), so it must not run on the
+        # reconcile loop's thread.
+        self.prepare_thread: Optional[threading.Thread] = None
+        # ("ok", "", prepared_uids) | ("err", message, prepared_uids) —
+        # prepared_uids always lists the claims the attempt did prepare.
+        self.prepare_result: Optional[tuple] = None
 
 
 def _resolve_field_ref(path: str, pod: dict) -> str:
@@ -533,30 +541,58 @@ class ClusterSim:
         for claim in run.claims:
             uid = claim["metadata"]["uid"]
             self._claim_users.setdefault(uid, set()).add(run.uid)
-        # Group claims per driver and prepare; any retryable failure keeps
-        # the pod unprepared (kubelet's ContainerCreating retry loop).
-        try:
-            for claim in run.claims:
-                uid = claim["metadata"]["uid"]
-                if uid in self._prepared_claims:
-                    continue
-                drivers = {
-                    r["driver"]
-                    for r in claim["status"]["allocation"]["devices"]["results"]
-                }
-                for driver in drivers:
-                    resp = self._dra(run.node, driver).prepare([claim])
-                    result = resp["claims"].get(uid, {})
-                    if result.get("error"):
-                        raise RuntimeError(result["error"])
-                self._prepared_claims.add(uid)
-        except Exception as e:  # noqa: BLE001 — retried next tick
-            msg = str(e)
-            logger.info("prepare pending for pod %s: %s", run.name, msg[:200])
-            self._annotate(run, {EVENT_ANNOTATION: f"prepare: {msg[:500]}"})
+
+        # Harvest a finished async prepare.
+        if run.prepare_thread is not None and not run.prepare_thread.is_alive():
+            run.prepare_thread = None
+            kind, msg, done = run.prepare_result
+            run.prepare_result = None
+            # Claims prepared before any failure stay prepared (the driver
+            # is idempotent); only the pod-level gate retries.
+            self._prepared_claims.update(done)
+            if kind == "ok":
+                run.prepared = True
+                self._annotate(run, {EVENT_ANNOTATION: "prepared"})
+            else:
+                logger.info("prepare pending for pod %s: %s", run.name, msg[:200])
+                self._annotate(run, {EVENT_ANNOTATION: f"prepare: {msg[:500]}"})
             return
-        run.prepared = True
-        self._annotate(run, {EVENT_ANNOTATION: "prepared"})
+        if run.prepare_thread is not None:
+            return
+
+        pending = [
+            c for c in run.claims
+            if c["metadata"]["uid"] not in self._prepared_claims
+        ]
+        if not pending:
+            run.prepared = True
+            self._annotate(run, {EVENT_ANNOTATION: "prepared"})
+            return
+
+        def do_prepare() -> None:
+            # Any retryable failure keeps the pod unprepared (kubelet's
+            # ContainerCreating retry loop).
+            done: list[str] = []
+            try:
+                for claim in pending:
+                    uid = claim["metadata"]["uid"]
+                    drivers = {
+                        r["driver"]
+                        for r in claim["status"]["allocation"]["devices"]["results"]
+                    }
+                    for driver in drivers:
+                        resp = self._dra(run.node, driver).prepare([claim])
+                        result = resp["claims"].get(uid, {})
+                        if result.get("error"):
+                            raise RuntimeError(result["error"])
+                    done.append(uid)
+            except Exception as e:  # noqa: BLE001 — retried next tick
+                run.prepare_result = ("err", str(e), done)
+                return
+            run.prepare_result = ("ok", "", done)
+
+        run.prepare_thread = threading.Thread(target=do_prepare, daemon=True)
+        run.prepare_thread.start()
 
     def _cdi_env(self, run: _PodRun) -> dict:
         """Apply the transient CDI specs of the pod's claims: merge every
@@ -757,9 +793,19 @@ class ClusterSim:
         except NotFound:
             pass
 
-    def _shutdown_pod(self, run: _PodRun) -> None:
+    def _shutdown_pod(self, run: _PodRun) -> bool:
         """Kill containers, unprepare claims whose last user left, release
-        allocations, and delete generated claims — then drop the pod."""
+        allocations, and delete generated claims — then drop the pod.
+        Returns False when shutdown must be deferred because a prepare is
+        still in flight: joining here would stall the reconcile loop (and
+        deadlock an MP prepare that waits on this loop's Deployment sync),
+        so _reap retries next tick until the RPCs self-bound."""
+        if run.prepare_thread is not None and run.prepare_thread.is_alive():
+            return False
+        run.prepare_thread = None
+        if run.prepare_result:
+            self._prepared_claims.update(run.prepare_result[2])
+            run.prepare_result = None
         for c in run.containers:
             if c.running():
                 try:
@@ -805,6 +851,7 @@ class ClusterSim:
             except NotFound:
                 pass
         self._pods.pop(run.uid, None)
+        return True
 
     def _reap(self, live_by_uid: dict[str, dict]) -> None:
         for uid in list(self._pods):
@@ -812,8 +859,14 @@ class ClusterSim:
                 self._shutdown_pod(self._pods[uid])
 
     def _teardown(self) -> None:
-        for run in list(self._pods.values()):
-            self._shutdown_pod(run)
+        # Bounded wait for in-flight prepares (each RPC self-bounds at the
+        # client timeout); anything still live after that is abandoned.
+        deadline = time.monotonic() + 40
+        while self._pods and time.monotonic() < deadline:
+            for run in list(self._pods.values()):
+                self._shutdown_pod(run)
+            if self._pods:
+                time.sleep(0.2)
         for cli in self._dra_clients.values():
             try:
                 cli.close()
