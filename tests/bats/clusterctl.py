@@ -186,6 +186,16 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _mk_fake_sysfs(node_dir: str, topo: dict) -> str:
+    """Fake sysfs for the node's mock chips (shared layout:
+    devicelib.mock.fake_sysfs_tree)."""
+    from tpudra.devicelib import MockTopologyConfig
+    from tpudra.devicelib.mock import MockDeviceLib, fake_sysfs_tree
+
+    lib = MockDeviceLib(config=MockTopologyConfig.from_json(json.dumps(topo)))
+    return fake_sysfs_tree(node_dir, lib.enumerate_chips())
+
+
 # -------------------------------------------------------------------- up
 
 
@@ -264,6 +274,11 @@ def cmd_up(args) -> int:
         )
         if args.feature_gates:
             plug_env["FEATURE_GATES"] = args.feature_gates
+        plugin_extra_argv = []
+        if args.vfio:
+            plugin_extra_argv += [
+                "--sysfs-root", _mk_fake_sysfs(nd, topo),
+            ]
         spawn(state, f"plugin-{n}", [
             sys.executable, "-m", "tpudra.plugin.main",
             "--node-name", n,
@@ -271,6 +286,7 @@ def cmd_up(args) -> int:
             "--registry-dir", os.path.join(nd, "registry"),
             "--cdi-root", os.path.join(nd, "cdi"),
             "--device-backend", "mock",
+            *plugin_extra_argv,
         ], plug_env)
         drivers = {"tpu.google.com": os.path.join(nd, "plugin", "dra.sock")}
         if args.cd:
@@ -452,6 +468,9 @@ def main(argv=None) -> int:
                     help="FEATURE_GATES for the driver binaries")
     up.add_argument("--static-partitions", default="",
                     help="chip:profile:core_start:hbm_start[,...] per node")
+    up.add_argument("--vfio", action="store_true",
+                    help="fabricate a per-node sysfs tree and point the "
+                    "plugin's vfio rebind path at it")
     up.set_defaults(fn=cmd_up)
 
     dn = sub.add_parser("down")

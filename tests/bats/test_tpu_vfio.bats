@@ -1,0 +1,125 @@
+#!/usr/bin/env bats
+# VFIO passthrough (SURVEY §2.1 / reference vfio-device.go): a claim on the
+# vfio alias rebinds the chip's PCI function to vfio-pci (sysfs
+# driver_override), injects the /dev/vfio group nodes, withholds the full
+# chip while the alias is held, and reverts on unprepare.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 2 --vfio \
+    --feature-gates PassthroughSupport=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "vfio aliases advertised alongside full chips" {
+  run kubectl get resourceslices -o json
+  [[ "$output" == *'"tpu-vfio-0"'* ]]
+  [[ "$output" == *'"tpu-0"'* ]]
+}
+
+@test "a vfio claim rebinds the device and injects the group nodes" {
+  cat > "$TPUDRA_STATE/vfio.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: vfio-chip
+spec:
+  spec:
+    devices:
+      requests:
+        - name: dev
+          exactly:
+            deviceClassName: tpu-vfio.google.com
+      config:
+        - opaque:
+            driver: tpu.google.com
+            parameters:
+              apiVersion: resource.tpu.google.com/v1beta1
+              kind: VfioDeviceConfig
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: vfio-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import os, time
+          nodes = os.environ.get("SIM_CDI_DEVICE_NODES", "")
+          assert "/dev/vfio/" in nodes, nodes
+          print("vfio nodes:", nodes)
+          time.sleep(600)
+      resources:
+        claims: [{name: dev}]
+  resourceClaims:
+    - name: dev
+      resourceClaimTemplateName: vfio-chip
+EOF
+  kubectl apply -f "$TPUDRA_STATE/vfio.yaml"
+  wait_until 90 sh -c "kubectl get pod vfio-pod -o 'jsonpath={.status.phase}' | grep -q Running"
+  # The sysfs rebind actually happened.
+  chip_dir=$(ls -d "$TPUDRA_STATE"/node-0/sys/bus/pci/devices/* | head -1)
+  grep -q vfio-pci "$chip_dir/driver_override"
+}
+
+@test "the sibling full chip is withheld while the vfio alias is held" {
+  # tpu-0's silicon is claimed through its vfio alias: only tpu-1 remains.
+  cat > "$TPUDRA_STATE/two-chips.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: two-chips
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+            count: 2
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: two-chips-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "print('ran')"]
+      resources:
+        claims: [{name: tpu}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: two-chips
+EOF
+  kubectl apply -f "$TPUDRA_STATE/two-chips.yaml"
+  sleep 3
+  run kubectl get pod two-chips-pod -o 'jsonpath={.spec.nodeName}'
+  [ -z "$output" ]
+}
+
+@test "unprepare reverts the driver_override and frees the silicon" {
+  kubectl delete pod vfio-pod
+  # The pod object vanishes synchronously; the unprepare that reverts the
+  # override runs on the sim kubelet's next reconcile tick — poll for it.
+  chip_dir=$(ls -d "$TPUDRA_STATE"/node-0/sys/bus/pci/devices/* | head -1)
+  wait_until 60 sh -c "! grep -q vfio-pci '$chip_dir/driver_override'"
+  # With the alias released, the 2-chip claim can now bind.
+  wait_until 90 sh -c "kubectl get pod two-chips-pod -o 'jsonpath={.status.phase}' | grep -q Succeeded"
+  kubectl delete pod two-chips-pod
+}

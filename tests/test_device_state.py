@@ -332,15 +332,9 @@ def test_static_partitions_advertised(tmp_path):
 # -- vfio -------------------------------------------------------------------
 
 def mk_sysfs(tmp_path, chips):
-    sys = tmp_path / "sys"
-    (sys / "kernel/iommu_groups/7").mkdir(parents=True)
-    for chip in chips:
-        d = sys / "bus/pci/devices" / chip.pci_address
-        d.mkdir(parents=True)
-        (d / "iommu_group").write_text(str(7 + chip.index))
-    for drv in ("tpu", "vfio-pci"):
-        (sys / "bus/pci/drivers" / drv).mkdir(parents=True)
-    return str(sys)
+    from tpudra.devicelib.mock import fake_sysfs_tree
+
+    return fake_sysfs_tree(str(tmp_path), chips)
 
 
 def test_vfio_prepare_unprepare(tmp_path):

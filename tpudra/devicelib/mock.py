@@ -266,3 +266,23 @@ def _parse_profile(name: str) -> PartitionProfile:
         return PartitionProfile(int(cores_s.rstrip("c")), int(hbm_s.rstrip("hbm")))
     except (ValueError, AttributeError):
         raise DeviceLibError(f"invalid partition profile {name!r}") from None
+
+
+def fake_sysfs_tree(root: str, chips) -> str:
+    """Fabricate the PCI/IOMMU sysfs surface the vfio rebind path touches
+    (tpudra/plugin/vfio.py), for the mock backend's chips: per-device dirs
+    with an ``iommu_group`` file (group 7+index) and the two driver dirs.
+    Shared by the unit tests and the bats harness so the layout cannot
+    diverge from what VfioManager reads."""
+    import os
+
+    sysfs = os.path.join(root, "sys")
+    os.makedirs(os.path.join(sysfs, "kernel", "iommu_groups", "7"), exist_ok=True)
+    for chip in chips:
+        d = os.path.join(sysfs, "bus", "pci", "devices", chip.pci_address)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "iommu_group"), "w") as f:
+            f.write(str(7 + chip.index))
+    for drv in ("tpu", "vfio-pci"):
+        os.makedirs(os.path.join(sysfs, "bus", "pci", "drivers", drv), exist_ok=True)
+    return sysfs

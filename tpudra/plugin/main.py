@@ -33,6 +33,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cdi-root", default=env_default("CDI_ROOT", "/var/run/cdi"))
     p.add_argument("--driver-root", default=env_default("DRIVER_ROOT", "/"))
     p.add_argument(
+        "--sysfs-root", default=env_default("SYSFS_ROOT", "/sys"),
+        help="sysfs mount the vfio rebind path manipulates; a containerized "
+        "driver mounts the host's at a prefix [SYSFS_ROOT]",
+    )
+    p.add_argument(
+        "--dev-root", default=env_default("DEV_ROOT", "/dev"),
+        help="device-node root for vfio group nodes [DEV_ROOT]",
+    )
+    p.add_argument(
         "--device-backend", default=env_default("DEVICE_BACKEND", "native"),
         choices=["mock", "native"],
     )
@@ -78,7 +87,9 @@ def main(argv=None) -> int:
         mp_manager=MultiProcessManager(
             kube, lib, args.node_name, image=args.mp_daemon_image
         ),
-        vfio_manager=VfioManager(),
+        vfio_manager=VfioManager(
+            sysfs_root=args.sysfs_root, dev_root=args.dev_root
+        ),
     )
     driver.start()
     hc = None
