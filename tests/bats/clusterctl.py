@@ -279,13 +279,35 @@ def cmd_up(args) -> int:
             plugin_extra_argv += [
                 "--sysfs-root", _mk_fake_sysfs(nd, topo),
             ]
+        backend = "mock"
+        if args.native_backend:
+            # The real C++ enumeration library in config-file mode, with the
+            # file-driven health event channel (TPUINFO_HEALTH_EVENTS).
+            backend = "native"
+            cfg_path = os.path.join(nd, "tpuinfo.cfg")
+            with open(cfg_path, "w") as f:
+                for k, v in {
+                    # Same per-node topology as the mock path (one source
+                    # of truth); static_partitions has no tpuinfo.cfg key.
+                    **{k: v for k, v in topo.items() if k != "static_partitions"},
+                    "partition_id": "0",
+                    "state_file": os.path.join(nd, "tpuinfo-state"),
+                }.items():
+                    f.write(f"{k}={v}\n")
+            health_events = os.path.join(nd, "health-events")
+            open(health_events, "a").close()
+            plug_env["TPUINFO_LIBRARY_PATH"] = os.path.join(
+                NATIVE_BUILD, "libtpuinfo.so"
+            )
+            plug_env["TPUINFO_HEALTH_EVENTS"] = health_events
+            plugin_extra_argv += ["--tpuinfo-config", cfg_path]
         spawn(state, f"plugin-{n}", [
             sys.executable, "-m", "tpudra.plugin.main",
             "--node-name", n,
             "--plugin-dir", os.path.join(nd, "plugin"),
             "--registry-dir", os.path.join(nd, "registry"),
             "--cdi-root", os.path.join(nd, "cdi"),
-            "--device-backend", "mock",
+            "--device-backend", backend,
             *plugin_extra_argv,
         ], plug_env)
         drivers = {"tpu.google.com": os.path.join(nd, "plugin", "dra.sock")}
@@ -471,6 +493,9 @@ def main(argv=None) -> int:
     up.add_argument("--vfio", action="store_true",
                     help="fabricate a per-node sysfs tree and point the "
                     "plugin's vfio rebind path at it")
+    up.add_argument("--native-backend", action="store_true",
+                    help="TPU plugins use the C++ libtpuinfo backend in "
+                    "config-file mode (health events via file)")
     up.set_defaults(fn=cmd_up)
 
     dn = sub.add_parser("down")
@@ -488,6 +513,11 @@ def main(argv=None) -> int:
     rp.set_defaults(fn=cmd_restart)
 
     args = p.parse_args(argv)
+    if getattr(args, "native_backend", False) and getattr(
+        args, "static_partitions", ""
+    ):
+        p.error("--static-partitions is mock-only; the native config file "
+                "has no static-partitions key")
     return args.fn(args)
 
 

@@ -1,0 +1,102 @@
+#!/usr/bin/env bats
+# Device health end to end on the NATIVE backend (reference
+# device_health.go → driver.go:441-505): a fault event on the file-driven
+# interrupt channel makes the plugin republish its ResourceSlices without
+# the unhealthy chip, with no auto-reheal.
+
+load helpers.sh
+
+setup_file() {
+  if [ ! -f "$REPO/native/build/libtpuinfo.so" ]; then
+    echo "libtpuinfo.so not built (make -C native)" >&2
+    return 1
+  fi
+  cluster_up --nodes 1 --chips-per-node 2 --native-backend \
+    --feature-gates TPUDeviceHealthCheck=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "the C++ backend enumerates and publishes both chips" {
+  run kubectl get resourceslices -o json
+  [[ "$output" == *'"tpu-0"'* ]]
+  [[ "$output" == *'"tpu-1"'* ]]
+}
+
+@test "a fault event removes the chip from the published slices" {
+  uuid=$(kubectl get resourceslices -o json | python3 -c '
+import json, sys
+for s in json.load(sys.stdin)["items"]:
+    for d in s["spec"].get("devices", []):
+        if d["name"] == "tpu-0":
+            print(d["attributes"]["uuid"]["string"]); break
+')
+  [ -n "$uuid" ]
+  echo "ChipLockup $uuid - bats-injected" >> "$TPUDRA_STATE/node-0/health-events"
+  wait_until 60 sh -c "! kubectl get resourceslices -o json | grep -q '\"tpu-0\"'"
+  run kubectl get resourceslices -o json
+  [[ "$output" == *'"tpu-1"'* ]]
+}
+
+@test "no auto-reheal: the chip stays withheld" {
+  sleep 3
+  run kubectl get resourceslices -o json
+  ! echo "$output" | grep -q '"tpu-0"'
+}
+
+@test "new claims avoid the unhealthy chip" {
+  cat > "$TPUDRA_STATE/healthy.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: healthy
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: healthy-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "import os; print('got', os.environ['TPU_VISIBLE_DEVICES'])"]
+      resources:
+        claims: [{name: tpu}]
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: healthy
+EOF
+  kubectl apply -f "$TPUDRA_STATE/healthy.yaml"
+  wait_until 60 pod_succeeded healthy-pod default
+  run kubectl logs healthy-pod
+  [[ "$output" == *"got 1"* ]]
+  kubectl delete pod healthy-pod
+}
+
+@test "an ignored event kind does not withhold silicon" {
+  uuid=$(kubectl get resourceslices -o json | python3 -c '
+import json, sys
+for s in json.load(sys.stdin)["items"]:
+    for d in s["spec"].get("devices", []):
+        if d["name"] == "tpu-1":
+            print(d["attributes"]["uuid"]["string"]); break
+')
+  # IciLinkDown is on the default ignore list (XID-skip analog): a link
+  # flap does not mean the chip itself is unusable.
+  echo "IciLinkDown $uuid - flap" >> "$TPUDRA_STATE/node-0/health-events"
+  sleep 3
+  run kubectl get resourceslices -o json
+  [[ "$output" == *'"tpu-1"'* ]]
+}
