@@ -1,0 +1,89 @@
+#!/usr/bin/env bats
+# CEL attribute selection (reference DeviceClass/selector semantics): the
+# demo selector claims bind by generation, mesh coordinates, and partition
+# profile; a selector no device satisfies holds the pod Pending.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 1 --chips-per-node 4 \
+    --feature-gates DynamicPartitioning=true
+}
+
+teardown_file() {
+  cluster_down
+}
+
+mk_pod() {
+  local name="$1" rct="$2"
+  cat <<EOF
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: tpu-selectors
+  name: $name
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c", "import os; print('sel', os.environ.get('TPU_VISIBLE_DEVICES'), os.environ.get('TPUDRA_PARTITIONS'))"]
+      resources:
+        claims: [{name: dev}]
+  resourceClaims:
+    - name: dev
+      resourceClaimTemplateName: $rct
+EOF
+}
+
+@test "generation, coordinate, and profile selectors all bind" {
+  apply_spec selectors/claims.yaml
+  # The x-neighbor pair binds first: only two chips sit at y=0,z=0, and a
+  # first-fit generation claim could otherwise take one of them.
+  mk_pod sel-pair x-neighbors > "$TPUDRA_STATE/sel-pair.yaml"
+  kubectl apply -f "$TPUDRA_STATE/sel-pair.yaml"
+  wait_until 90 pod_succeeded sel-pair tpu-selectors
+  { mk_pod sel-gen v5p-only; mk_pod sel-part two-core-partition; } \
+    > "$TPUDRA_STATE/sel-pods.yaml"
+  kubectl apply -f "$TPUDRA_STATE/sel-pods.yaml"
+  for p in sel-gen sel-part; do
+    wait_until 90 pod_succeeded "$p" tpu-selectors
+  done
+  # The coordinate pair got two distinct chips.
+  run kubectl logs sel-pair -n tpu-selectors
+  chips=$(echo "$output" | grep -o 'sel [0-9,]*' | cut -d' ' -f2)
+  [ "$(echo "$chips" | tr ',' '\n' | sort -u | wc -l)" -eq 2 ]
+}
+
+@test "a selector no device satisfies holds the pod Pending" {
+  cat > "$TPUDRA_STATE/never.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: tpu-selectors
+  name: never
+spec:
+  spec:
+    devices:
+      requests:
+        - name: dev
+          exactly:
+            deviceClassName: tpu.google.com
+            selectors:
+              - cel:
+                  expression: >-
+                    device.attributes["tpu.google.com"].tpuGeneration == "v9x"
+EOF
+  kubectl apply -f "$TPUDRA_STATE/never.yaml"
+  mk_pod sel-never never > "$TPUDRA_STATE/never-pod.yaml"
+  kubectl apply -f "$TPUDRA_STATE/never-pod.yaml"
+  sleep 3
+  run kubectl get pod sel-never -n tpu-selectors -o 'jsonpath={.spec.nodeName}'
+  [ -z "$output" ]
+}
+
+@test "cleanup" {
+  kubectl delete pod sel-gen sel-pair sel-part sel-never -n tpu-selectors
+  wait_until 60 sh -c "! kubectl get pods -n tpu-selectors -o name | grep -q sel-"
+}

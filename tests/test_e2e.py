@@ -152,6 +152,65 @@ def mk_rct(device_class, count=1, profile=None, name="rct"):
     }
 
 
+class TestCelSubset:
+    """The sim scheduler's CEL evaluator: equality conjunctions match, and
+    anything outside the subset fails CLOSED — the simulator must never
+    grant a device a real CEL evaluator might refuse."""
+
+    def test_equality_conjunctions(self):
+        from tpudra.sim.sched import cel_matches
+
+        attrs = {
+            "tpuGeneration": {"string": "v5p"},
+            "coordY": {"int": 0},
+            "healthy": {"bool": True},
+        }
+        dom = 'device.attributes["tpu.google.com"]'
+        assert cel_matches(f'{dom}.tpuGeneration == "v5p"', attrs)
+        assert cel_matches(f"{dom}.coordY == 0", attrs)
+        assert cel_matches(f"{dom}.healthy == true", attrs)
+        assert cel_matches(
+            f'{dom}.tpuGeneration == "v5p" && {dom}.coordY == 0', attrs
+        )
+        assert not cel_matches(f'{dom}.tpuGeneration == "v5e"', attrs)
+        assert not cel_matches(f"{dom}.coordY == 1", attrs)
+        assert not cel_matches(f"{dom}.missing == 1", attrs)
+        assert cel_matches("", attrs)  # no selector: match
+
+    def test_unsupported_constructs_fail_closed(self):
+        from tpudra.sim.sched import cel_matches
+
+        attrs = {"coordY": {"int": 3}}
+        dom = 'device.attributes["tpu.google.com"]'
+        for expr in (
+            f"{dom}.coordY >= 1",
+            f"{dom}.coordY == 3 || {dom}.coordY == 4",
+            f"!({dom}.coordY == 4)",
+            "true",
+        ):
+            assert not cel_matches(expr, attrs), expr
+
+    def test_domain_and_type_mismatches_fail_closed(self):
+        from tpudra.sim.sched import cel_matches
+
+        attrs = {"coordY": {"int": 0}, "healthy": {"bool": True}}
+        # Wrong domain: real CEL errors on the missing key -> non-matching.
+        assert not cel_matches(
+            'device.attributes["gpu.nvidia.com"].coordY == 0',
+            attrs,
+            domain="tpu.google.com",
+        )
+        assert cel_matches(
+            'device.attributes["tpu.google.com"].coordY == 0',
+            attrs,
+            domain="tpu.google.com",
+        )
+        # Type mismatch: bool==int / int==bool are CEL errors, not matches.
+        dom = 'device.attributes["tpu.google.com"]'
+        assert not cel_matches(f"{dom}.healthy == 1", attrs, "tpu.google.com")
+        assert not cel_matches(f"{dom}.coordY == true", attrs, "tpu.google.com")
+
+
 class TestExtendedResourceName:
     def test_pod_limits_translate_to_claim_and_prepare(self, tmp_path):
         """test_gpu_extres.bats analog: a pod asking for 2 chips via classic

@@ -44,6 +44,44 @@ EXTENDED_RESOURCE_CLASSES = {
 }
 
 
+# One clause of the CEL subset: device.attributes["<domain>"].<attr> ==
+# <"string" | int | bool> — the shape every chart DeviceClass and demo
+# selector uses.
+_CEL_CLAUSE = re.compile(
+    r'^device\.attributes\["([^"]*)"\]\.(\w+)\s*==\s*("(?:[^"]*)"|\d+|true|false)$'
+)
+
+
+def cel_matches(expr: str, attributes: dict, domain: str = "") -> bool:
+    """Evaluate the CEL subset the suite's selectors use: conjunctions
+    (&&) of attribute equality tests against the device driver's attribute
+    domain.  Anything outside the subset — including a wrong domain or a
+    type-mismatched comparison, both CEL errors — fails CLOSED (no match):
+    a simulator must never grant a device a real scheduler's CEL evaluator
+    might refuse."""
+    expr = " ".join(expr.split())
+    if not expr:
+        return True
+    for clause in expr.split("&&"):
+        m = _CEL_CLAUSE.fullmatch(clause.strip())
+        if not m:
+            return False
+        clause_domain, attr, literal = m.group(1), m.group(2), m.group(3)
+        if domain and clause_domain != domain:
+            return False
+        # Typed comparison: the literal's CEL type must match the boxed
+        # attribute type exactly (bool==int is a CEL error, not a match).
+        if literal.startswith('"'):
+            want = {"string": literal[1:-1]}
+        elif literal in ("true", "false"):
+            want = {"bool": literal == "true"}
+        else:
+            want = {"int": int(literal)}
+        if attributes.get(attr) != want:
+            return False
+    return True
+
+
 class Scheduler:
     """First-fit DRA allocator with KEP-4815 counter arithmetic."""
 
@@ -118,7 +156,7 @@ class Scheduler:
             for pool, driver, dev in self._published(node):
                 if (pool, dev["name"]) in self._allocated:
                     continue
-                if not self._matches(req, dev):
+                if not self._matches(req, dev, driver):
                     continue
                 demand = self._demand(pool, dev)
                 if not self._counters_fit(caps, demand):
@@ -161,23 +199,20 @@ class Scheduler:
         self._claim_devices[real_uid] = [(r["pool"], r["device"]) for r in results]
         return claim
 
-    def _matches(self, req, dev) -> bool:
+    def _matches(self, req, dev, driver: str = "") -> bool:
         cls = req.get("exactly", {}).get("deviceClassName", "")
         dtype = dev["attributes"].get("type", {}).get("string", "")
         pred = _CLASS_TYPE.get(cls)
         if pred is None or not pred(dtype):
             return False
-        if cls == "tpu-partition.google.com":
-            # DRA ANDs all selectors: every profile-bearing expression must
-            # match, not just the first one encountered.
-            for sel in req.get("exactly", {}).get("selectors", []):
-                expr = sel.get("cel", {}).get("expression", "")
-                m = re.search(r"\d+c\.\d+hbm", expr)
-                if m and (
-                    dev["attributes"].get("profile", {}).get("string") != m.group(0)
-                ):
-                    return False
-        return True
+        # DRA ANDs all selectors; each must hold against the device.  The
+        # attribute domain in a selector is the publishing driver's name.
+        return all(
+            cel_matches(
+                sel.get("cel", {}).get("expression", ""), dev["attributes"], driver
+            )
+            for sel in req.get("exactly", {}).get("selectors", [])
+        )
 
     def allocate_extended(
         self,
