@@ -244,6 +244,7 @@ def cmd_up(args) -> int:
     # Fabric identity for --cd: one slice spanning all nodes.
     peer_ports = free_ports(args.nodes)
     status_ports = free_ports(args.nodes)
+    health_ports = free_ports(args.nodes)
     port_map = ",".join(f"{i}={p}" for i, p in enumerate(peer_ports))
 
     sim_nodes = []
@@ -308,6 +309,7 @@ def cmd_up(args) -> int:
             "--registry-dir", os.path.join(nd, "registry"),
             "--cdi-root", os.path.join(nd, "cdi"),
             "--device-backend", backend,
+            "--healthcheck-port", str(health_ports[i]),
             *plugin_extra_argv,
         ], plug_env)
         drivers = {"tpu.google.com": os.path.join(nd, "plugin", "dra.sock")}
@@ -407,6 +409,8 @@ def cmd_up(args) -> int:
             f'export TPUDRA_STATE="{state}"\n'
             f'export TPUDRA_NAMESPACE="{NAMESPACE}"\n'
             f'export TPUDRA_NODES="{" ".join(nodes)}"\n'
+            f'export TPUDRA_HEALTH_PORTS="'
+            f'{" ".join(f"{n}={p}" for n, p in zip(nodes, health_ports))}"\n'
             f'export PYTHONPATH="{env["PYTHONPATH"]}"\n'
             f'export PATH="{os.path.join(REPO, "tests", "bats", "bin")}:'
             f'{os.environ.get("PATH", "")}"\n'

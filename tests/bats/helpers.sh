@@ -40,6 +40,18 @@ wait_until() {
   return 1
 }
 
+# health_port <node> — the node's plugin healthcheck port from env.sh.
+health_port() {
+  local v="${TPUDRA_HEALTH_PORTS#*$1=}"
+  echo "${v%% *}"
+}
+
+# prepare_count <node> — current value of the prepare histogram counter.
+prepare_count() {
+  curl -fsS "http://127.0.0.1:$(health_port "$1")/metrics" \
+    | grep 'tpudra_prepare_seconds_count' | grep -o '[0-9.]*$' | head -1
+}
+
 # pod_phase <name> [ns]
 pod_phase() {
   kubectl get pod "$1" -n "${2:-default}" -o 'jsonpath={.status.phase}' 2>/dev/null

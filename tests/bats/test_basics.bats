@@ -38,3 +38,12 @@ teardown_file() {
   [[ "$log" == *"startup config:"* ]]
   [[ "$log" == *"feature gates:"* ]]
 }
+
+@test "healthz answers and /metrics carries the prepare histogram" {
+  port="$(health_port node-0)"
+  run curl -fsS "http://127.0.0.1:$port/healthz"
+  [ "$status" -eq 0 ]
+  run curl -fsS "http://127.0.0.1:$port/metrics"
+  [ "$status" -eq 0 ]
+  [[ "$output" == *"tpudra_prepare_seconds"* ]]
+}

@@ -20,11 +20,18 @@ teardown() {
 }
 
 @test "tpu-test1: single-chip pod runs its jax assertion" {
+  run curl -fsS "http://127.0.0.1:$(health_port node-0)/metrics"
+  [ "$status" -eq 0 ]
+  before=$(prepare_count node-0)
   apply_spec tpu-test1.yaml
   wait_until 60 pod_succeeded pod1 tpu-test1
   run kubectl logs pod1 -n tpu-test1
   [[ "$output" == *"TPU_VISIBLE_DEVICES ="* ]]
   [[ "$output" == *"jax devices:"* ]]
+  # The prepare moved the plugin's metrics histogram (VERDICT §5 criterion).
+  after=$(prepare_count node-0)
+  [ -n "$after" ]
+  awk -v a="${before:-0}" -v b="$after" 'BEGIN { exit !(b > a) }'
 }
 
 @test "tpu-test1: claim was prepared and CDI spec existed" {
