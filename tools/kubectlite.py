@@ -19,6 +19,7 @@ import os
 import re
 import sys
 import time
+import urllib.error
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -379,10 +380,15 @@ def main(argv=None) -> int:
     vp.set_defaults(fn=lambda a: (print("kubectlite (tpudra hermetic harness)"), 0)[1])
 
     args = p.parse_args(argv)
+    if args.verb == "delete" and not args.filename and not (args.type and args.names):
+        p.error("delete needs a resource type plus name(s), or -f FILE")
     try:
         return args.fn(args)
     except ApiError as e:
         print(f"error: {e}", file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"error: cannot reach the apiserver: {e}", file=sys.stderr)
         return 1
 
 
