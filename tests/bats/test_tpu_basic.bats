@@ -108,4 +108,61 @@ EOF
   run kubectl logs all-chips-pod
   [[ "$output" == *"got all 4"* ]]
   kubectl delete pod all-chips-pod
+  wait_until 30 sh -c "! kubectl get pods -o name | grep -q all-chips-pod"
+}
+
+@test "a claimed pod builds its jax mesh from the grant and psums across it" {
+  cat > "$TPUDRA_STATE/mesh-pod.yaml" <<'EOF'
+apiVersion: resource.k8s.io/v1
+kind: ResourceClaimTemplate
+metadata:
+  namespace: default
+  name: mesh-chips
+spec:
+  spec:
+    devices:
+      requests:
+        - name: tpu
+          exactly:
+            deviceClassName: tpu.google.com
+            count: 4
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: default
+  name: mesh-pod
+spec:
+  restartPolicy: Never
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args:
+        - |
+          import jax, jax.numpy as jnp
+          from jax.sharding import NamedSharding, PartitionSpec as P
+          from tpudra.workload.envspec import ClaimEnv, mesh_from_devices, factor_devices
+          ce = ClaimEnv.from_environ()
+          assert len(ce.visible_devices) == 4, ce.visible_devices
+          assert len(ce.coords) == 4, ce.coords
+          assert len(jax.devices()) == 4  # the grant IS the jax world
+          mesh = mesh_from_devices(("dp", "tp"), factor_devices(4, 2))
+          x = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("dp")))
+          # A GSPMD all-reduce over the claimed mesh.
+          s = float(jax.jit(jnp.sum, in_shardings=NamedSharding(mesh, P("dp")))(x))
+          assert s == 28.0, s
+          print("mesh", dict(mesh.shape), "sum", s)
+      resources:
+        claims:
+          - name: tpu
+  resourceClaims:
+    - name: tpu
+      resourceClaimTemplateName: mesh-chips
+EOF
+  kubectl apply -f "$TPUDRA_STATE/mesh-pod.yaml"
+  wait_until 90 pod_succeeded mesh-pod default
+  run kubectl logs mesh-pod
+  [[ "$output" == *"mesh"*"sum 28.0"* ]]
+  kubectl delete pod mesh-pod
 }
