@@ -224,26 +224,45 @@ def _layer(cfg: ModelConfig, x, layer_params):
     return x + h
 
 
-def backbone(params, tokens, cfg: ModelConfig):
-    """tokens [B, S] int32 → final hidden states [B, S, D] bf16."""
-    import jax
+def embed_tokens(params, tokens):
+    """tokens [B, S] int32 → embedded inputs [B, S, D] bf16 (shared by the
+    dense and pipelined backbones)."""
     import jax.numpy as jnp
 
-    B, S = tokens.shape
+    S = tokens.shape[1]
     x = params["embed"][tokens].astype(jnp.bfloat16)
-    x = x + params["pos"][:S].astype(jnp.bfloat16)[None]
+    return x + params["pos"][:S].astype(jnp.bfloat16)[None]
+
+
+def remat_layer_body(cfg: ModelConfig):
+    """The per-layer body with cfg.remat applied — the single place both
+    the dense scan and the pipeline stages get their (possibly
+    checkpointed) layer function.
+
+    Selective remat ("dots"): keep matmul outputs (MXU work is the
+    expensive part to recompute), rematerialize the cheap elementwise/
+    softmax ops — measured ~1.2x step-time win over full remat on v5e at
+    equal memory headroom.
+    """
+    import jax
 
     layer_body = partial(_layer, cfg)
-    # Selective remat: keep matmul outputs (MXU work is the expensive part to
-    # recompute), rematerialize the cheap elementwise/softmax ops — measured
-    # ~1.2x step-time win over full remat on v5e at equal memory headroom.
     if cfg.remat == "dots":
-        layer_body = jax.checkpoint(
+        return jax.checkpoint(
             layer_body,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         )
-    elif cfg.remat == "full":
-        layer_body = jax.checkpoint(layer_body)
+    if cfg.remat == "full":
+        return jax.checkpoint(layer_body)
+    return layer_body
+
+
+def backbone(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → final hidden states [B, S, D] bf16."""
+    import jax
+
+    x = embed_tokens(params, tokens)
+    layer_body = remat_layer_body(cfg)
 
     def step(x, layer_params):
         return layer_body(x, layer_params), None
@@ -278,10 +297,16 @@ def loss_fn(params, tokens, cfg: ModelConfig):
     residuals (a ``jax.checkpoint`` here would bound that to one chunk,
     measured 2% MFU slower — deliberately not taken).
     """
+    x = backbone(params, tokens, cfg)
+    return ce_head(params, x, tokens, cfg)
+
+
+def ce_head(params, x, tokens, cfg: ModelConfig):
+    """The chunked cross-entropy head over hidden states [B, S, D] — shared
+    by the dense and pipelined (workload/pipeline.py) loss paths."""
     import jax
     import jax.numpy as jnp
 
-    x = backbone(params, tokens, cfg)
     emb = params["embed"].astype(jnp.bfloat16)
     xs, targets = x[:, :-1], tokens[:, 1:]
     B, Sm1, D = xs.shape

@@ -1,0 +1,116 @@
+"""Mixture-of-experts FFN with expert parallelism, the XLA way.
+
+A Switch-style top-1 MoE layer in the Mesh-TensorFlow dispatch/combine
+formulation: routing builds a [tokens, experts, capacity] dispatch tensor,
+expert FFNs run batched over the expert axis, and a combine einsum gathers
+outputs back to token order.
+
+Expert parallelism is NOT hand-written communication: the math is dense
+einsums, and sharding the expert axis of the weights over an ``ep`` mesh
+axis makes GSPMD partition the expert FFN FLOPs and insert the dispatch/
+combine collectives over ICI (its cost model picks all-to-all or
+gather/reduce combinations by shape) — the TPU-native equivalent of the
+reference ecosystem's NCCL all-to-all expert dispatch.  ``expert_specs``
+gives the PartitionSpecs; tests/test_workload.py verifies the ep-sharded
+program matches the single-device result bit-for-bit, that the per-shard
+expert computation really is E/ep-sized, and that cross-device
+collectives are present in the compiled HLO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class MoEConfig:
+    d_model: int = 128
+    d_ff: int = 256
+    num_experts: int = 4
+    # capacity = capacity_factor * tokens / num_experts, rounded up to a
+    # multiple of 8 (TPU lane alignment); overflowing tokens are dropped
+    # (their residual passes through), the standard Switch behavior.
+    capacity_factor: float = 1.25
+
+    def capacity(self, num_tokens: int) -> int:
+        import math
+
+        cap = math.ceil(self.capacity_factor * num_tokens / self.num_experts)
+        return max(8, -(-cap // 8) * 8)
+
+
+def init_moe_params(rng, cfg: MoEConfig):
+    import jax
+    import jax.numpy as jnp
+
+    kr, k1, k2 = jax.random.split(rng, 3)
+    E, D, F = cfg.num_experts, cfg.d_model, cfg.d_ff
+    return {
+        "router": jax.random.normal(kr, (D, E), jnp.float32) * (D ** -0.5),
+        "w1": jax.random.normal(k1, (E, D, F), jnp.float32) * (D ** -0.5),
+        "w2": jax.random.normal(k2, (E, F, D), jnp.float32) * (F ** -0.5),
+    }
+
+
+def expert_specs(ep_axis: str = "ep"):
+    """PartitionSpecs sharding the expert axis (router replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    return {"router": P(), "w1": P(ep_axis), "w2": P(ep_axis)}
+
+
+def moe_ffn(params, x, cfg: MoEConfig):
+    """x [B, S, D] -> [B, S, D]; top-1 routed expert FFN + aux load loss.
+
+    Returns (y, aux) where aux is the Switch load-balancing loss
+    (mean fraction * mean router prob per expert, scaled by E).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, S, D = x.shape
+    E = cfg.num_experts
+    T = B * S
+    C = cfg.capacity(T)
+    tokens = x.reshape(T, D)
+
+    logits = tokens.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [T, E]
+    # Position of each token within its expert's queue; >= C drops.
+    position = (jnp.cumsum(onehot, axis=0) - onehot) * onehot  # [T, E]
+    keep = (position < C) * onehot  # [T, E]
+    pos_onehot = jax.nn.one_hot(
+        position.sum(axis=-1).astype(jnp.int32), C, dtype=jnp.float32
+    )  # [T, C]
+    dispatch = keep[:, :, None] * pos_onehot[:, None, :]  # [T, E, C]
+    combine = dispatch * gate[:, None, None]  # [T, E, C]
+
+    # Dispatch → per-expert FFN → combine.  With w1/w2 (and therefore the
+    # [E, C, D] intermediates) sharded over ep, these einsums are where
+    # GSPMD places the all-to-alls.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # Switch aux loss: encourages uniform routing.
+    frac_tokens = onehot.mean(axis=0)  # fraction routed per expert
+    frac_probs = probs.mean(axis=0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+
+    return y.reshape(B, S, D).astype(x.dtype), aux
+
+
+def shard_moe_params(params, mesh, ep_axis: str = "ep"):
+    import jax
+    from jax.sharding import NamedSharding
+
+    specs = expert_specs(ep_axis)
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
