@@ -77,6 +77,13 @@ class ModelConfig:
     #   "full"  — save nothing, recompute everything (lowest memory)
     #   "none"  — no remat: save all residuals (fastest when memory allows)
     remat: str = "dots"
+    # Sparse (Switch-MoE) FFN: 0 = dense.  With E experts each layer's FFN
+    # becomes top-1-routed (workload/moe.py math); the expert axis shards
+    # over the tp mesh axis in param_specs, and loss_fn adds
+    # moe_aux_weight * the mean load-balancing loss.
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if self.attention not in ("auto", "naive", "flash", "splash"):
@@ -85,6 +92,8 @@ class ModelConfig:
             )
         if self.remat not in ("dots", "full", "none"):
             raise ValueError(f"remat must be dots|full|none, got {self.remat!r}")
+        if self.num_experts < 0:
+            raise ValueError(f"num_experts must be >= 0, got {self.num_experts}")
         if self.d_model % self.n_heads:
             raise ValueError(
                 f"d_model {self.d_model} not divisible by n_heads {self.n_heads}"
@@ -145,8 +154,18 @@ def init_params(rng, cfg: ModelConfig):
         "layers": {
             "wqkv": dense(ks[0], (L, D, H, 3 * hd), s),
             "wo": dense(ks[1], (L, H, hd, D), s),
-            "w1": dense(ks[2], (L, D, F), s),
-            "w2": dense(ks[3], (L, F, D), F ** -0.5),
+            **(
+                {
+                    "router": dense(ks[4], (L, D, cfg.num_experts), s),
+                    "w1": dense(ks[2], (L, cfg.num_experts, D, F), s),
+                    "w2": dense(ks[3], (L, cfg.num_experts, F, D), F ** -0.5),
+                }
+                if cfg.num_experts
+                else {
+                    "w1": dense(ks[2], (L, D, F), s),
+                    "w2": dense(ks[3], (L, F, D), F ** -0.5),
+                }
+            ),
             "ln1": jnp.ones((L, D), jnp.float32),
             "ln2": jnp.ones((L, D), jnp.float32),
         },
@@ -218,10 +237,23 @@ def _layer(cfg: ModelConfig, x, layer_params):
     x = x + jnp.einsum("bhqd,hde->bqe", attn, p["wo"].astype(jnp.bfloat16))
 
     h = _rmsnorm(x, p["ln2"])
+    if cfg.num_experts:
+        from tpudra.workload.moe import MoEConfig, moe_ffn
+
+        mcfg = MoEConfig(
+            d_model=D,
+            d_ff=cfg.d_ff,
+            num_experts=cfg.num_experts,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
+        ffn, aux = moe_ffn(
+            {"router": p["router"], "w1": p["w1"], "w2": p["w2"]}, h, mcfg
+        )
+        return x + ffn, aux
     h = jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16))
     h = jax.nn.gelu(h)
     h = jnp.einsum("bsf,fd->bsd", h, p["w2"].astype(jnp.bfloat16))
-    return x + h
+    return x + h, jnp.zeros((), jnp.float32)
 
 
 def embed_tokens(params, tokens):
@@ -257,18 +289,21 @@ def remat_layer_body(cfg: ModelConfig):
     return layer_body
 
 
-def backbone(params, tokens, cfg: ModelConfig):
-    """tokens [B, S] int32 → final hidden states [B, S, D] bf16."""
+def backbone_and_aux(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → (hidden states [B, S, D] bf16, mean per-layer
+    MoE aux loss — zero for dense models)."""
     import jax
+    import jax.numpy as jnp
 
     x = embed_tokens(params, tokens)
-    layer_body = remat_layer_body(cfg)
+    # The layer body's (carry, aux) return is exactly scan's contract.
+    x, auxs = jax.lax.scan(remat_layer_body(cfg), x, params["layers"])
+    return _rmsnorm(x, params["ln_f"]), jnp.mean(auxs)
 
-    def step(x, layer_params):
-        return layer_body(x, layer_params), None
 
-    x, _ = jax.lax.scan(step, x, params["layers"])
-    return _rmsnorm(x, params["ln_f"])
+def backbone(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → final hidden states [B, S, D] bf16."""
+    return backbone_and_aux(params, tokens, cfg)[0]
 
 
 def forward(params, tokens, cfg: ModelConfig):
@@ -297,8 +332,11 @@ def loss_fn(params, tokens, cfg: ModelConfig):
     residuals (a ``jax.checkpoint`` here would bound that to one chunk,
     measured 2% MFU slower — deliberately not taken).
     """
-    x = backbone(params, tokens, cfg)
-    return ce_head(params, x, tokens, cfg)
+    x, aux = backbone_and_aux(params, tokens, cfg)
+    loss = ce_head(params, x, tokens, cfg)
+    if cfg.num_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 def ce_head(params, x, tokens, cfg: ModelConfig):
@@ -401,10 +439,20 @@ def param_specs(cfg: ModelConfig):
     """Megatron-style tensor-parallel layout as PartitionSpecs.
 
     Column-parallel (output dim on tp): wqkv, w1, embed's model dim.
-    Row-parallel (input dim on tp): wo, w2.  Norms replicated.
+    Row-parallel (input dim on tp): wo, w2.  Norms replicated.  MoE models
+    shard the expert axis over tp instead (expert parallelism; tp must
+    divide num_experts), router replicated.
     """
     from jax.sharding import PartitionSpec as P
 
+    if cfg.num_experts:
+        ffn = {
+            "router": P(None, None, None),
+            "w1": P(None, "tp", None, None),
+            "w2": P(None, "tp", None, None),
+        }
+    else:
+        ffn = {"w1": P(None, None, "tp"), "w2": P(None, "tp", None)}
     return {
         "embed": P(None, "tp"),
         "pos": P(None, "tp"),
@@ -413,8 +461,7 @@ def param_specs(cfg: ModelConfig):
             # the per-head [3hd] / [hd] minors stay whole on each device.
             "wqkv": P(None, None, "tp", None),
             "wo": P(None, "tp", None, None),
-            "w1": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
+            **ffn,
             "ln1": P(None, None),
             "ln2": P(None, None),
         },

@@ -91,11 +91,18 @@ def moe_ffn(params, x, cfg: MoEConfig):
 
     # Dispatch → per-expert FFN → combine.  With w1/w2 (and therefore the
     # [E, C, D] intermediates) sharded over ep, these einsums are where
-    # GSPMD places the all-to-alls.
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens.astype(jnp.float32))
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
-    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    # GSPMD places the all-to-alls.  The expert compute path runs in
+    # bfloat16 like the dense FFN (router/softmax/aux stay f32): the
+    # dispatch/combine tensors are 0/1 masks and gates, exactly
+    # representable / tolerably rounded in bf16.
+    bf16 = jnp.bfloat16
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(bf16), tokens.astype(bf16))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"].astype(bf16)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(bf16))
+    y = jnp.einsum(
+        "tec,ecd->td", combine.astype(bf16), expert_out,
+        preferred_element_type=jnp.float32,
+    )
 
     # Switch aux loss: encourages uniform routing.
     frac_tokens = onehot.mean(axis=0)  # fraction routed per expert

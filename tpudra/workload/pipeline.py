@@ -71,6 +71,11 @@ def pipelined_backbone(
     M = num_microbatches
     if B % M:
         raise ValueError(f"batch {B} does not split into {M} microbatches")
+    if cfg.num_experts:
+        raise ValueError(
+            "MoE layers are not pipelined yet: the aux loss would need "
+            "accumulation across stages"
+        )
     num_stages = mesh.shape[pp_axis]
 
     x = embed_tokens(params, tokens)
@@ -99,7 +104,8 @@ def pipelined_backbone(
 
         def stage_fn(x):
             def step(x, lp):
-                return layer_body(x, lp), None
+                x, _aux = layer_body(x, lp)  # aux is zero: dense-only here
+                return x, None
 
             x, _ = jax.lax.scan(step, x, layers)
             return x
