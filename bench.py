@@ -199,6 +199,36 @@ def bench_bind_partition_p50() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+
+def _time_train_step(cfg, batch: int, iters: int):
+    """Shared timing harness for the train-step benches: init, one
+    compile+sync step, then ``iters`` queued dispatches synced once
+    (a per-step sync costs ~80 ms through the remote-execution tunnel).
+    Returns (n_params, seconds_per_step, compile_seconds)."""
+    import jax
+
+    from tpudra.workload import model as m
+
+    params = m.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    init_opt, train_step = m.make_train_step(cfg)
+    opt_state = init_opt(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.max_seq), 0, cfg.vocab
+    )
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)  # forces device sync (block_until_ready is not enough
+    # through the axon remote-execution tunnel)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    return n_params, (time.perf_counter() - t0) / iters, compile_s
+
+
 def bench_tpu_step() -> dict:
     """Flagship train step on whatever accelerator jax provides."""
     try:
@@ -216,33 +246,8 @@ def bench_tpu_step() -> dict:
         # and "auto" conservatively declines the pallas path when the host
         # exposes multiple chips (model.py use_flash_attention).
         cfg = m.ModelConfig(**BENCH_MODEL, attention="splash")
-        params = m.init_params(jax.random.PRNGKey(0), cfg)
-        n_params = sum(x.size for x in jax.tree.leaves(params))
-        init_opt, train_step = m.make_train_step(cfg)
-        opt_state = init_opt(params)
-        tokens = jax.random.randint(
-            jax.random.PRNGKey(1), (BENCH_BATCH, cfg.max_seq), 0, cfg.vocab
-        )
-        step = jax.jit(train_step, donate_argnums=(0, 1))
-
-        t0 = time.perf_counter()
-        params, opt_state, loss = step(params, opt_state, tokens)
-        float(loss)  # forces device sync (block_until_ready is not enough
-        # through the axon remote-execution tunnel)
-        compile_s = time.perf_counter() - t0
-
-        # Amortized timing: queue STEP_ITERS async dispatches, sync once.
-        t0 = time.perf_counter()
-        for _ in range(STEP_ITERS):
-            params, opt_state, loss = step(params, opt_state, tokens)
-        float(loss)
-        dt = (time.perf_counter() - t0) / STEP_ITERS
-
+        n_params, dt, compile_s = _time_train_step(cfg, BENCH_BATCH, STEP_ITERS)
         tokens_per_step = BENCH_BATCH * (cfg.max_seq - 1)
-        # Model FLOPs (PaLM appendix accounting): 6N per token + the
-        # attention term 12·L·S·D per token.  Remat recompute is excluded —
-        # MFU is model-FLOPs utilization, so selective remat shows up as
-        # higher MFU rather than inflated FLOPs.
         flops = tokens_per_step * (
             6 * n_params + 12 * cfg.n_layers * cfg.max_seq * cfg.d_model
         )
@@ -286,22 +291,7 @@ def bench_long_context() -> dict:
             max_seq=8192, attention="splash",
         )
         batch = 2
-        params = m.init_params(jax.random.PRNGKey(0), cfg)
-        n_params = sum(x.size for x in jax.tree.leaves(params))
-        init_opt, train_step = m.make_train_step(cfg)
-        opt_state = init_opt(params)
-        tokens = jax.random.randint(
-            jax.random.PRNGKey(1), (batch, cfg.max_seq), 0, cfg.vocab
-        )
-        step = jax.jit(train_step, donate_argnums=(0, 1))
-        params, opt_state, loss = step(params, opt_state, tokens)
-        float(loss)
-        iters = 5
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            params, opt_state, loss = step(params, opt_state, tokens)
-        float(loss)
-        dt = (time.perf_counter() - t0) / iters
+        n_params, dt, _ = _time_train_step(cfg, batch, iters=5)
         tokens_per_step = batch * (cfg.max_seq - 1)
         flops = tokens_per_step * (
             6 * n_params + 12 * cfg.n_layers * cfg.max_seq * cfg.d_model
@@ -313,6 +303,38 @@ def bench_long_context() -> dict:
             "step_ms": round(dt * 1000.0, 1),
             "tokens_per_s": round(tokens_per_step / dt),
             "model_tflops_per_s": round(flops / dt / 1e12, 1),
+        }
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def bench_moe() -> dict:
+    """Sparse (Switch-MoE) flagship variant on the real chip: same layer
+    count as the dense bench at half width with 8 experts — more params at
+    a fraction of the per-token FLOPs (top-1 routing).  Single chip, so no
+    expert parallelism here; the ep-sharded path is exercised on the
+    virtual mesh by dryrun_multichip and the workload tests."""
+    try:
+        import jax
+
+        from tpudra.workload import model as m
+
+        if jax.devices()[0].platform == "cpu":
+            return {"skipped": "no accelerator"}
+        cfg = m.ModelConfig(
+            vocab=32768, d_model=1024, n_heads=8, n_layers=8, d_ff=4096,
+            max_seq=1024, attention="splash", num_experts=8,
+        )
+        batch = 8
+        n_params, dt, _ = _time_train_step(cfg, batch, iters=5)
+        tokens_per_step = batch * (cfg.max_seq - 1)
+        return {
+            "num_experts": cfg.num_experts,
+            "params_m": round(n_params / 1e6, 1),
+            "batch": batch,
+            "seq": cfg.max_seq,
+            "step_ms": round(dt * 1000.0, 1),
+            "tokens_per_s": round(tokens_per_step / dt),
         }
     except Exception as e:  # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:300]}
@@ -384,6 +406,7 @@ def main() -> None:
     partition = bench_bind_partition_p50()
     tpu = bench_tpu_step()
     long_context = bench_long_context()
+    moe = bench_moe()
     collectives = bench_collectives()
     print(
         json.dumps(
@@ -395,6 +418,7 @@ def main() -> None:
                 "extras": {
                     "tpu": tpu,
                     "long_context": long_context,
+                    "moe": moe,
                     "collectives": collectives,
                     "dynamic_partition": partition,
                 },

@@ -280,10 +280,13 @@ class TestMoEExpertParallel:
 
         hlo = f.lower(sp, xs).compile().as_text()
         # The per-shard program computes on ONE expert's bf16-cast weights
-        # (w1 shard [E/ep=1, D=16, F=32]): the FLOPs are genuinely
-        # expert-parallel and run on the bf16 MXU path, with GSPMD-placed
-        # cross-device collectives for dispatch/combine.
+        # (w1 shard [E/ep=1, D=16, F=32]) and never materializes a
+        # full-expert-count bf16 tensor — i.e. the expert FLOPs are
+        # genuinely partitioned, not all-gathered and replicated — with
+        # GSPMD-placed cross-device collectives for dispatch/combine.
         assert "bf16[1,16,32]" in hlo
+        for full in ("bf16[4,16,32]", "bf16[4,32,16]", "bf16[4,8,32]", "bf16[4,8,16]"):
+            assert full not in hlo, f"replicated expert compute: {full}"
         assert ("all-to-all" in hlo) or ("all-gather" in hlo)
 
     def test_capacity_drops_overflow_and_grads_flow(self):
