@@ -208,11 +208,10 @@ class TestPipelineParallel:
 
         m, cfg, params, tokens, mesh = self._setup()
         dense = m.backbone(params, tokens, cfg).astype(jnp.float32)
-        pipe = pipelined_backbone(
-            params, tokens, cfg, mesh, num_microbatches=4
-        ).astype(jnp.float32)
+        pipe, aux = pipelined_backbone(params, tokens, cfg, mesh, num_microbatches=4)
         # bf16 layers; the dense path also remats (different rounding order).
-        assert float(jnp.max(jnp.abs(dense - pipe))) < 0.06
+        assert float(jnp.max(jnp.abs(dense - pipe.astype(jnp.float32)))) < 0.06
+        assert float(aux) == 0.0  # dense layers contribute no aux
 
     def test_loss_and_grads_match_dense(self):
         import jax
@@ -346,25 +345,48 @@ class TestMoEExpertParallel:
         _, _, loss2 = jax.jit(train_step)(sp, init_opt(sp), t2)
         assert jnp.isfinite(float(loss2))
 
-    def test_moe_not_pipelined_yet(self):
+    def test_moe_pipelines_with_per_microbatch_aux(self):
+        """MoE layers pipeline too: with ample capacity (so the per-group
+        capacity semantics drop no tokens in either path) hidden states
+        match the dense MoE backbone per token, and the aux is the
+        per-microbatch average — nonzero and close to the full-batch aux."""
         import numpy as np
 
         import jax
-        import pytest
+        import jax.numpy as jnp
         from jax.sharding import Mesh
 
         from tpudra.workload import model as m
-        from tpudra.workload.pipeline import pipelined_backbone
+        from tpudra.workload.pipeline import pipelined_backbone, pipelined_loss_fn
 
         cfg = m.ModelConfig(
             vocab=32, d_model=32, n_heads=2, n_layers=2, d_ff=64,
-            max_seq=16, num_experts=2,
+            max_seq=16, num_experts=2, moe_capacity_factor=4.0,
         )
         params = m.init_params(jax.random.PRNGKey(0), cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 32)
         mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1), ("pp", "dp"))
-        with pytest.raises(ValueError, match="not pipelined"):
-            pipelined_backbone(params, tokens, cfg, mesh, 2)
+
+        dense_x, dense_aux = m.backbone_and_aux(params, tokens, cfg)
+        pipe_x, pipe_aux = pipelined_backbone(params, tokens, cfg, mesh, 2)
+        assert (
+            float(
+                jnp.max(
+                    jnp.abs(
+                        dense_x.astype(jnp.float32) - pipe_x.astype(jnp.float32)
+                    )
+                )
+            )
+            < 0.06
+        )
+        assert float(pipe_aux) > 0.0
+        # Per-microbatch averaging differs from the full-batch aux only by
+        # routing variance across microbatches.
+        assert abs(float(pipe_aux) - float(dense_aux)) < 0.5
+
+        l_pipe = float(pipelined_loss_fn(params, tokens, cfg, mesh, 2))
+        l_dense = float(m.loss_fn(params, tokens, cfg))
+        assert abs(l_pipe - l_dense) < 0.02, (l_pipe, l_dense)
 
     def test_capacity_rounding(self):
         from tpudra.workload.moe import MoEConfig

@@ -56,11 +56,13 @@ def pipelined_backbone(
     pp_axis: str = "pp",
     dp_axis: str | None = "dp",
 ):
-    """tokens [B, S] → hidden states [B, S, D], layer stack pipelined.
+    """tokens [B, S] → (hidden states [B, S, D], mean MoE aux loss),
+    layer stack pipelined.
 
     ``params`` is the ordinary model param tree; the layer chunk each stage
     holds is carved out inside shard_map.  Embedding and the final norm run
-    replicated (they are a sliver of the FLOPs).
+    replicated (they are a sliver of the FLOPs).  The aux scalar is zero
+    for dense models.
     """
     import jax
     import jax.numpy as jnp
@@ -71,11 +73,6 @@ def pipelined_backbone(
     M = num_microbatches
     if B % M:
         raise ValueError(f"batch {B} does not split into {M} microbatches")
-    if cfg.num_experts:
-        raise ValueError(
-            "MoE layers are not pipelined yet: the aux loss would need "
-            "accumulation across stages"
-        )
     num_stages = mesh.shape[pp_axis]
 
     x = embed_tokens(params, tokens)
@@ -93,7 +90,7 @@ def pipelined_backbone(
         shard_map,
         mesh=mesh,
         in_specs=(layers_spec, micro_spec),
-        out_specs=micro_spec,
+        out_specs=(micro_spec, P()),
         check_vma=False,
     )
     def run(layers, xs):
@@ -104,25 +101,29 @@ def pipelined_backbone(
 
         def stage_fn(x):
             def step(x, lp):
-                x, _aux = layer_body(x, lp)  # aux is zero: dense-only here
-                return x, None
+                return layer_body(x, lp)
 
-            x, _ = jax.lax.scan(step, x, layers)
-            return x
+            x, auxs = jax.lax.scan(step, x, layers)
+            return x, jnp.mean(auxs)
 
         perm = [(i, (i + 1) % npp) for i in range(npp)]
         buf = jnp.zeros_like(xs[0])
         ys = jnp.zeros_like(xs)
+        aux_acc = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            buf, ys = carry
+            buf, ys, aux_acc = carry
             # Stage 0 feeds microbatch t (while in range); later stages
             # consume what the previous stage pushed last tick.
             feed = jax.lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             inp = jnp.where(stage == 0, feed, buf)
-            out = stage_fn(inp)
+            out, aux = stage_fn(inp)
+            # Stage s computes real microbatches only for s <= t < s+M;
+            # warmup/drain ticks run on garbage and must not pollute aux.
+            valid = (t >= stage) & (t < stage + M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             # The last stage finishes microbatch t-(npp-1) this tick.
             widx = t - (npp - 1)
             updated = jax.lax.dynamic_update_index_in_dim(
@@ -131,17 +132,26 @@ def pipelined_backbone(
             write = (stage == npp - 1) & (widx >= 0) & (widx < M)
             ys = jnp.where(write, updated, ys)
             buf = jax.lax.ppermute(out, pp_axis, perm)
-            return (buf, ys), None
+            return (buf, ys, aux_acc), None
 
-        (buf, ys), _ = jax.lax.scan(tick, (buf, ys), jnp.arange(M + npp - 1))
+        (buf, ys, aux_acc), _ = jax.lax.scan(
+            tick, (buf, ys, aux_acc), jnp.arange(M + npp - 1)
+        )
         # Only the last stage holds real outputs; masked psum replicates
         # them across the pp axis (and anchors the transpose rule).
         ys = jax.lax.psum(jnp.where(stage == npp - 1, ys, 0), pp_axis)
-        return ys
+        # Every stage contributed M per-microbatch means of its own layer
+        # chunk: the psum over stages followed by / (npp * M) is the mean
+        # over all (layer, microbatch) pairs — matching the dense path's
+        # jnp.mean over layers of full-batch means (equal-size microbatches).
+        aux = jax.lax.psum(aux_acc, pp_axis) / (npp * M)
+        if dp_axis:
+            aux = jax.lax.pmean(aux, dp_axis)
+        return ys, aux
 
-    ys = run(stage_layers, xs)
+    ys, aux = run(stage_layers, xs)
     x = ys.reshape(B, S, -1)
-    return _rmsnorm(x, params["ln_f"])
+    return _rmsnorm(x, params["ln_f"]), aux
 
 
 def pipelined_loss_fn(
@@ -149,10 +159,19 @@ def pipelined_loss_fn(
     pp_axis: str = "pp", dp_axis: str | None = "dp",
 ):
     """Next-token cross-entropy through the pipelined backbone — the
-    pipelined twin of model.loss_fn (same math, same head)."""
+    pipelined twin of model.loss_fn (same math, same head).
+
+    For MoE configs the load-balancing aux is computed per microbatch and
+    averaged (the standard data-parallel MoE behavior); it differs from
+    the dense full-batch aux by the routing variance across microbatches,
+    while the routed token computation itself is identical per token.
+    """
     from tpudra.workload.model import ce_head
 
-    x = pipelined_backbone(
+    x, aux = pipelined_backbone(
         params, tokens, cfg, mesh, num_microbatches, pp_axis, dp_axis
     )
-    return ce_head(params, x, tokens, cfg)
+    loss = ce_head(params, x, tokens, cfg)
+    if cfg.num_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
