@@ -1,0 +1,109 @@
+#!/usr/bin/env bats
+# Cross-host collective through a claimed ComputeDomain (the reference's
+# NCCL send/recv/broadcast assertion, test_cd_mnnvl_workload.bats:18-35):
+# two worker pods on the domain's two nodes join jax.distributed via the
+# grant env (TPUDRA_NUM_HOSTS / HOST_INDEX, coordinator) and run a real
+# cross-process XLA reduction.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 2 --cd
+  # TOCTOU note: the port is released here and rebound by worker-0's jax
+  # coordinator once the domain forms; bats files run serially, so the
+  # window is effectively private to this file.
+  COORD_PORT=$(python3 -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1]); s.close()")
+  export COORD_PORT
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "two pods psum across the domain via DCN rendezvous" {
+  cat > "$TPUDRA_STATE/coll.yaml" <<EOF
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: coll
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata:
+  namespace: coll
+  name: coll
+spec:
+  numNodes: 2
+  channel:
+    resourceClaimTemplate:
+      name: coll-rct
+    allocationMode: Single
+EOF
+  for n in 0 1; do
+    cat >> "$TPUDRA_STATE/coll.yaml" <<EOF
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: coll
+  name: worker-$n
+spec:
+  restartPolicy: Never
+  nodeSelector:
+    kubernetes.io/hostname: node-$n
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      env:
+        # Sim-only override: both "hosts" are one machine here, so the
+        # grant's stable-DNS coordinator is swapped for loopback.  On a
+        # real cluster this var is absent and the grant's own
+        # TPUDRA_COORDINATOR (injected by the channel claim) is used.
+        - name: TPUDRA_SIM_COORDINATOR
+          value: "127.0.0.1:$COORD_PORT"
+      command: ["python", "-c"]
+      args:
+        - |
+          import os
+          import jax
+          jax.config.update("jax_platforms", "cpu")
+          from tpudra.workload.envspec import ClaimEnv
+          env = ClaimEnv.from_environ()
+          assert env.num_hosts == 2, env.num_hosts
+          assert env.coordinator, "grant injected no coordinator"
+          env.coordinator = os.environ.get("TPUDRA_SIM_COORDINATOR") or env.coordinator
+          env.initialize_distributed()
+          assert jax.process_count() == 2
+          import numpy as np
+          import jax.numpy as jnp
+          from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+          from jax.experimental import multihost_utils
+          mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+          local = jnp.ones((1, 4), jnp.float32) * (env.host_index + 1)
+          garr = multihost_utils.host_local_array_to_global_array(local, mesh, P("dp", None))
+          total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(garr)
+          val = float(total.addressable_data(0))
+          assert val == 12.0, val  # (1 + 2) * 4 across both hosts
+          print("RESULT psum:", val, "host", env.host_index)
+      resources:
+        claims:
+          - name: channel
+  resourceClaims:
+    - name: channel
+      resourceClaimTemplateName: coll-rct
+EOF
+  done
+  kubectl apply -f "$TPUDRA_STATE/coll.yaml"
+  wait_until 300 pod_succeeded worker-0 coll
+  wait_until 300 pod_succeeded worker-1 coll
+  run kubectl logs worker-0 -n coll
+  [[ "$output" == *"RESULT psum: 12.0 host 0"* ]]
+  run kubectl logs worker-1 -n coll
+  [[ "$output" == *"RESULT psum: 12.0 host 1"* ]]
+}
+
+@test "teardown" {
+  kubectl delete pod worker-0 worker-1 -n coll
+  kubectl delete computedomains coll -n coll
+  wait_until 120 sh -c "! kubectl get computedomains -n coll -o name | grep -q coll"
+}

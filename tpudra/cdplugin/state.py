@@ -349,6 +349,9 @@ class ComputeDomainDeviceState:
         )
         topo = self._lib.slice_topology()
         chips = self._lib.enumerate_chips()
+        from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
+        from tpudra.cddaemon.dnsnames import dns_name
+
         edits = ContainerEdits(
             env=[
                 f"TPUDRA_DOMAIN_UID={config.domain_id}",
@@ -356,6 +359,11 @@ class ComputeDomainDeviceState:
                 f"TPUDRA_NUM_HOSTS={topo.num_hosts}",
                 f"TPUDRA_HOST_INDEX={topo.host_index}",
                 f"TPUDRA_CLIQUE_ID={alloc.resolve_clique_id(chips)}",
+                # DCN rendezvous from the grant alone: workloads join
+                # jax.distributed at the index-0 daemon's stable DNS name
+                # (ClaimEnv.initialize_distributed).  Daemon claims get the
+                # same value via their settings env (computedomain.py:118).
+                f"TPUDRA_COORDINATOR={dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
             ],
             device_nodes=[
                 self._cdi.host_path(alloc.channel_dev_path(i)) for i in granted
