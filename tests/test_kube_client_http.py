@@ -94,3 +94,48 @@ def test_watch_over_http(server, client):
     stop.set()
     assert ("ADDED", "w1") in events
     assert ("DELETED", "w1") in events
+
+
+class TestRateLimiting:
+    """Client-side QPS/burst (reference kubeclient.go:33-118): a hot loop
+    is clamped to the configured rate; the default client is unthrottled."""
+
+    def test_hot_loop_clamped_to_qps(self, server):
+        client = KubeClient(server.url, qps=50.0, burst=1)
+        client.create(gvr.NODES, mk_node("rl"))
+        t0 = time.monotonic()
+        for _ in range(11):
+            client.get(gvr.NODES, "rl")
+        elapsed = time.monotonic() - t0
+        # burst=1: after the first token, 10 more requests need >= 10/50 s.
+        assert elapsed >= 0.18, f"hot loop not clamped: {elapsed:.3f}s"
+
+    def test_burst_absorbs_spike(self, server):
+        client = KubeClient(server.url, qps=1.0, burst=20)
+        client.create(gvr.NODES, mk_node("rb"))
+        t0 = time.monotonic()
+        for _ in range(10):
+            client.get(gvr.NODES, "rb")
+        # 10 requests fit entirely in the burst bucket: no throttling.
+        assert time.monotonic() - t0 < 1.0
+
+    def test_default_unthrottled(self, server):
+        client = KubeClient(server.url)
+        assert client._limiter is None
+        t0 = time.monotonic()
+        for _ in range(30):
+            client.list(gvr.NODES)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_flag_plumbing(self):
+        import argparse
+
+        from tpudra.flags import add_common_flags
+
+        p = argparse.ArgumentParser()
+        add_common_flags(p)
+        args = p.parse_args(["--kube-api-qps", "7.5", "--kube-api-burst", "3"])
+        assert args.kube_api_qps == 7.5 and args.kube_api_burst == 3
+        # Defaults mirror the reference (kubeclient.go:54-69).
+        args = p.parse_args([])
+        assert args.kube_api_qps == 5.0 and args.kube_api_burst == 10

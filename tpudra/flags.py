@@ -30,11 +30,32 @@ def _env_int(env: str, fallback: int) -> int:
         return fallback
 
 
+def _env_float(env: str, fallback: float) -> float:
+    try:
+        return float(os.environ.get(env, "") or fallback)
+    except ValueError:
+        return fallback
+
+
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--kubeconfig",
         default=env_default("KUBECONFIG"),
         help="kubeconfig path (empty: in-cluster service account) [KUBECONFIG]",
+    )
+    parser.add_argument(
+        "--kube-api-qps",
+        type=float,
+        default=_env_float("KUBE_API_QPS", 5.0),
+        help="client-side QPS toward the apiserver; <= 0 disables "
+        "(reference kube-api-qps, kubeclient.go:54-61) [KUBE_API_QPS]",
+    )
+    parser.add_argument(
+        "--kube-api-burst",
+        type=int,
+        default=_env_int("KUBE_API_BURST", 10),
+        help="client-side burst toward the apiserver "
+        "(reference kube-api-burst, kubeclient.go:62-69) [KUBE_API_BURST]",
     )
     parser.add_argument(
         "--feature-gates",
@@ -101,12 +122,40 @@ def log_startup_config(args: argparse.Namespace) -> None:
     )
 
 
-def make_kube_client(kubeconfig: str):
+def install_stop_handlers() -> "threading.Event":
+    """Install SIGTERM/SIGINT handlers that set (and return) a stop event.
+
+    Must be called BEFORE any server/socket startup: the reference's helper
+    wires signal handling ahead of kubeletplugin.Start (clean shutdown in
+    cmd/gpu-kubelet-plugin/driver.go:170-200); installing afterwards leaves a
+    window where a kubelet drain that observes the freshly published
+    ResourceSlices can SIGTERM the process while the signal still has default
+    disposition — death rc=-15 with no socket unlink or slice retraction.
+    """
+    import signal
+    import threading
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    return stop
+
+
+def make_kube_client(kubeconfig: str, qps: float = 0.0, burst: int = 0):
     from tpudra.kube.client import KubeClient
 
     if kubeconfig:
-        return KubeClient.from_kubeconfig(kubeconfig)
-    return KubeClient.auto()
+        return KubeClient.from_kubeconfig(kubeconfig, qps=qps, burst=burst)
+    return KubeClient.auto(qps=qps, burst=burst)
+
+
+def make_kube_client_from_args(args: argparse.Namespace):
+    """The binaries' entry: kubeconfig + QPS/burst from the common flags."""
+    return make_kube_client(
+        args.kubeconfig,
+        qps=getattr(args, "kube_api_qps", 0.0),
+        burst=getattr(args, "kube_api_burst", 0),
+    )
 
 
 def make_device_lib(backend: str, config: str):

@@ -69,10 +69,22 @@ class KubeClient:
         ca_file: Optional[str] = None,
         insecure: bool = False,
         timeout: float = 30.0,
+        qps: float = 0.0,
+        burst: int = 0,
     ):
         self._server = server.rstrip("/")
         self._token = token
         self._timeout = timeout
+        # Client-side QPS/burst (kubeclient.go:33-118 analog), reusing the
+        # workqueue's reservation bucket (FIFO-fair: each caller sleeps
+        # out its own reservation).  Default unlimited: tests and the fake
+        # server need no throttle; the binaries pass the KUBE_API_QPS/
+        # KUBE_API_BURST flag values (tpudra/flags.py make_kube_client).
+        self._limiter = None
+        if qps > 0:
+            from tpudra.workqueue import TokenBucket
+
+            self._limiter = TokenBucket(qps, max(burst, 1))
         self._ssl_ctx: Optional[ssl.SSLContext] = None
         if server.startswith("https"):
             if insecure:
@@ -83,7 +95,7 @@ class KubeClient:
     # -- construction helpers ----------------------------------------------
 
     @classmethod
-    def in_cluster(cls) -> "KubeClient":
+    def in_cluster(cls, qps: float = 0.0, burst: int = 0) -> "KubeClient":
         host = os.environ["KUBERNETES_SERVICE_HOST"]
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as f:
@@ -92,10 +104,18 @@ class KubeClient:
             f"https://{host}:{port}",
             token=token,
             ca_file=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt"),
+            qps=qps,
+            burst=burst,
         )
 
     @classmethod
-    def from_kubeconfig(cls, path: Optional[str] = None, context: Optional[str] = None) -> "KubeClient":
+    def from_kubeconfig(
+        cls,
+        path: Optional[str] = None,
+        context: Optional[str] = None,
+        qps: float = 0.0,
+        burst: int = 0,
+    ) -> "KubeClient":
         path = path or os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
         with open(path) as f:
             cfg = yaml.safe_load(f)
@@ -109,18 +129,20 @@ class KubeClient:
             token=token,
             ca_file=cluster.get("certificate-authority"),
             insecure=cluster.get("insecure-skip-tls-verify", False),
+            qps=qps,
+            burst=burst,
         )
 
     @classmethod
-    def auto(cls) -> "KubeClient":
+    def auto(cls, qps: float = 0.0, burst: int = 0) -> "KubeClient":
         """In-cluster when available, else kubeconfig; KUBE_API_SERVER
         overrides both (test harness)."""
         override = os.environ.get("KUBE_API_SERVER")
         if override:
-            return cls(override)
+            return cls(override, qps=qps, burst=burst)
         if os.path.exists(os.path.join(SERVICE_ACCOUNT_DIR, "token")):
-            return cls.in_cluster()
-        return cls.from_kubeconfig()
+            return cls.in_cluster(qps=qps, burst=burst)
+        return cls.from_kubeconfig(qps=qps, burst=burst)
 
     # -- HTTP ---------------------------------------------------------------
 
@@ -133,6 +155,14 @@ class KubeClient:
         stream: bool = False,
         timeout: Optional[float] = None,
     ):
+        # One token per request (streamed watch events are free — the
+        # token paid for the watch's establishment, matching client-go).
+        if self._limiter is not None:
+            wait = self._limiter.reserve()
+            if wait > 0:
+                import time
+
+                time.sleep(wait)
         url = self._server + path
         if query:
             url += "?" + urllib.parse.urlencode({k: v for k, v in query.items() if v})

@@ -53,6 +53,7 @@ class Informer:
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._indices: dict[str, Callable[[dict], str | None]] = {}
+        self._backoff = 0.2  # relist backoff, reset by each successful list
 
     # -- configuration ------------------------------------------------------
 
@@ -97,14 +98,25 @@ class Informer:
         return self._synced.is_set()
 
     def _run(self, stop: threading.Event) -> None:
+        # Jittered exponential relist backoff: when the apiserver is down,
+        # every informer in every binary hits this loop at once — fixed
+        # short sleeps synchronize them into a relist storm at recovery
+        # (client-go's reflector backs off the same way).
+        import random
+
+        self._backoff = 0.2
         while not stop.is_set():
             try:
                 self._list_and_watch(stop)
+                self._backoff = 0.2
             except Exception as e:  # noqa: BLE001 — informer must survive apiserver blips
+                delay = self._backoff * (0.5 + random.random())
                 logger.warning(
-                    "informer %s: list/watch failed: %s; re-listing", self._gvr.resource, e
+                    "informer %s: list/watch failed: %s; re-listing in %.1fs",
+                    self._gvr.resource, e, delay,
                 )
-                time.sleep(0.2)
+                self._backoff = min(self._backoff * 2, 30.0)
+                stop.wait(delay)
 
     def _list_and_watch(self, stop: threading.Event) -> None:
         listing = self._api.list(
@@ -113,6 +125,11 @@ class Informer:
             label_selector=self._label_selector,
             field_selector=self._field_selector,
         )
+        # A healthy LIST resets the relist backoff even if the WATCH below
+        # dies every cycle (an LB idle-timeout resetting watches must not
+        # escalate us to 30 s event-delivery gaps — client-go's reflector
+        # resets on successful list the same way).
+        self._backoff = 0.2
         rv = listing.get("metadata", {}).get("resourceVersion")
         fresh = {obj_key(o): o for o in listing.get("items", [])}
         with self._lock:
