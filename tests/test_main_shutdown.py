@@ -1,0 +1,94 @@
+"""Shutdown-ordering regression tests for the binary entrypoints.
+
+The reference wires signal handling before kubeletplugin.Start so a drain
+arriving the instant ResourceSlices are visible still tears down cleanly
+(cmd/gpu-kubelet-plugin/driver.go:170-200).  Round 2 shipped the opposite
+order in both plugin mains — handlers installed *after* driver.start() — and
+the process-level system test hit the default-disposition window (death
+rc=-15, no socket unlink) about one run in three.  These tests pin the fix
+deterministically: by the time start() runs, SIGTERM must already be
+handled, and a signal delivered *during* start() must still produce a clean
+rc=0 exit through the teardown path.
+"""
+
+import signal
+import os
+
+import pytest
+
+
+class _RecordingDriver:
+    """Stands in for the real Driver/CDDriver: records the SIGTERM
+    disposition observed at start() time and self-delivers the signal,
+    simulating a drain racing the publication."""
+
+    instances: list = []
+
+    def __init__(self, *a, **kw):
+        self.sigterm_at_start = None
+        self.started = False
+        self.stopped = False
+        type(self).instances.append(self)
+
+    def start(self):
+        self.sigterm_at_start = signal.getsignal(signal.SIGTERM)
+        self.started = True
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def stop(self):
+        self.stopped = True
+
+    @property
+    def sockets(self):
+        raise AssertionError("healthcheck must be disabled in this test")
+
+
+@pytest.fixture(autouse=True)
+def _restore_dispositions():
+    before = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+    yield
+    for s, h in before.items():
+        signal.signal(s, h)
+
+
+@pytest.fixture(autouse=True)
+def _reset_instances():
+    _RecordingDriver.instances = []
+    yield
+    _RecordingDriver.instances = []
+
+
+def _assert_clean(rc):
+    (drv,) = _RecordingDriver.instances
+    assert drv.started
+    assert drv.sigterm_at_start not in (
+        signal.SIG_DFL,
+        signal.SIG_IGN,
+        None,
+    ), "SIGTERM still had default disposition when driver.start() ran"
+    assert drv.stopped, "teardown path did not run after mid-start SIGTERM"
+    assert rc == 0
+
+
+def test_plugin_main_handles_sigterm_before_start(monkeypatch):
+    import tpudra.plugin.main as mod
+
+    monkeypatch.setattr("tpudra.plugin.driver.Driver", _RecordingDriver)
+    monkeypatch.setattr(mod, "make_kube_client_from_args", lambda *_: object())
+    monkeypatch.setattr(mod, "make_device_lib", lambda *_: object())
+    monkeypatch.setattr(
+        "tpudra.plugin.sharing.MultiProcessManager", lambda *a, **k: object()
+    )
+    monkeypatch.setattr("tpudra.plugin.vfio.VfioManager", lambda *a, **k: object())
+    rc = mod.main(["--node-name", "t", "--healthcheck-port", "-1"])
+    _assert_clean(rc)
+
+
+def test_cdplugin_main_handles_sigterm_before_start(monkeypatch):
+    import tpudra.cdplugin.main as mod
+
+    monkeypatch.setattr("tpudra.cdplugin.driver.CDDriver", _RecordingDriver)
+    monkeypatch.setattr(mod, "make_kube_client_from_args", lambda *_: object())
+    monkeypatch.setattr(mod, "make_device_lib", lambda *_: object())
+    rc = mod.main(["--node-name", "t", "--healthcheck-port", "-1"])
+    _assert_clean(rc)

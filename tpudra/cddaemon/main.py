@@ -7,10 +7,13 @@ from __future__ import annotations
 
 import argparse
 import logging
-import signal
-import threading
 
-from tpudra.flags import add_common_flags, make_kube_client, setup_common
+from tpudra.flags import (
+    add_common_flags,
+    install_stop_handlers,
+    make_kube_client_from_args,
+    setup_common,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -33,6 +36,7 @@ def main(argv=None) -> int:
         return check()
 
     setup_common(args)
+    stop = install_stop_handlers()
     config = DaemonConfig.from_environ()
     # Derive this node's fabric identity from the device library: the clique
     # id is what the chips report, not a deploy-time constant.
@@ -52,11 +56,8 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 — no TPU = idle daemon, still valid
         logger.warning("no local TPU fabric identity (%s); daemon will idle", e)
 
-    kube = make_kube_client(args.kubeconfig)
+    kube = make_kube_client_from_args(args)
     app = DaemonApp(kube, config)
-    stop = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
     app.run(stop)  # blocks until stop
     return 0
 

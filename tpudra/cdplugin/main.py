@@ -5,14 +5,13 @@ from __future__ import annotations
 
 import argparse
 import logging
-import signal
-import threading
 
 from tpudra.flags import (
     add_common_flags,
     env_default,
+    install_stop_handlers,
     make_device_lib,
-    make_kube_client,
+    make_kube_client_from_args,
     setup_common,
 )
 
@@ -52,7 +51,7 @@ def main(argv=None) -> int:
     from tpudra.cdplugin.driver import CDDriver, CDDriverConfig
     from tpudra.plugin.health import Healthcheck
 
-    kube = make_kube_client(args.kubeconfig)
+    kube = make_kube_client_from_args(args)
     lib = make_device_lib(args.device_backend, args.tpuinfo_config)
     driver = CDDriver(
         CDDriverConfig(
@@ -65,20 +64,22 @@ def main(argv=None) -> int:
         kube,
         lib,
     )
-    driver.start()
+    # Handlers go in before driver.start() publishes sockets/slices — see
+    # plugin/main.py; this main had the same SIGTERM default-disposition
+    # window and the system test hit it about one run in three.
+    stop = install_stop_handlers()
     hc = None
-    if args.healthcheck_port >= 0:
-        hc = Healthcheck(driver.sockets, port=args.healthcheck_port)
-        hc.start()
-
-    stop = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
-    logger.info("compute-domain-kubelet-plugin up on node %s", args.node_name)
-    stop.wait()
-    if hc is not None:
-        hc.stop()
-    driver.stop()
+    try:
+        driver.start()
+        if args.healthcheck_port >= 0:
+            hc = Healthcheck(driver.sockets, port=args.healthcheck_port)
+            hc.start()
+        logger.info("compute-domain-kubelet-plugin up on node %s", args.node_name)
+        stop.wait()
+    finally:
+        if hc is not None:
+            hc.stop()
+        driver.stop()
     return 0
 
 

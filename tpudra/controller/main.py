@@ -4,10 +4,14 @@ from __future__ import annotations
 
 import argparse
 import logging
-import signal
-import threading
 
-from tpudra.flags import add_common_flags, env_default, make_kube_client, setup_common
+from tpudra.flags import (
+    add_common_flags,
+    env_default,
+    install_stop_handlers,
+    make_kube_client_from_args,
+    setup_common,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -44,7 +48,7 @@ def main(argv=None) -> int:
 
     from tpudra.controller import Controller, ManagerConfig
 
-    kube = make_kube_client(args.kubeconfig)
+    kube = make_kube_client_from_args(args)
     controller = Controller(
         kube,
         ManagerConfig(
@@ -57,6 +61,7 @@ def main(argv=None) -> int:
             log_verbosity=args.log_verbosity,
         ),
     )
+    stop = install_stop_handlers()
     debug = None
     if args.http_endpoint:
         from tpudra.metrics import DebugEndpoint, parse_http_endpoint
@@ -68,9 +73,6 @@ def main(argv=None) -> int:
         debug = DebugEndpoint(host, port)
         debug.start()
 
-    stop = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
     logger.info("compute-domain-controller up in namespace %s", args.namespace)
     try:
         controller.run(stop)  # blocks until stop

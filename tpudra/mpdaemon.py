@@ -26,7 +26,6 @@ import argparse
 import json
 import logging
 import os
-import signal
 import socket
 import socketserver
 import sys
@@ -157,13 +156,15 @@ def main(argv=None) -> int:
         args = p.parse_args(["run"] if argv is None else ["run", *argv])
 
     setup_common(args)  # shared logging/gates, honors LOG_LEVEL/LOG_VERBOSITY
+    from tpudra.flags import install_stop_handlers
+
+    stop = install_stop_handlers()
     daemon = ControlDaemon(_pipe_dir())
-    daemon.start()
-    stop = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
-    stop.wait()
-    daemon.stop()
+    try:
+        daemon.start()
+        stop.wait()
+    finally:
+        daemon.stop()
     return 0
 
 

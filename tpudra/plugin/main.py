@@ -4,14 +4,13 @@ from __future__ import annotations
 
 import argparse
 import logging
-import signal
-import threading
 
 from tpudra.flags import (
     add_common_flags,
     env_default,
+    install_stop_handlers,
     make_device_lib,
-    make_kube_client,
+    make_kube_client_from_args,
     setup_common,
 )
 
@@ -70,7 +69,7 @@ def main(argv=None) -> int:
     from tpudra.plugin.sharing import MultiProcessManager
     from tpudra.plugin.vfio import VfioManager
 
-    kube = make_kube_client(args.kubeconfig)
+    kube = make_kube_client_from_args(args)
     lib = make_device_lib(args.device_backend, args.tpuinfo_config)
     driver = Driver(
         DriverConfig(
@@ -91,20 +90,23 @@ def main(argv=None) -> int:
             sysfs_root=args.sysfs_root, dev_root=args.dev_root
         ),
     )
-    driver.start()
+    # Handlers go in before driver.start() publishes sockets/slices: anything
+    # observing the published state may signal immediately (kubelet drain,
+    # the system test), and the default disposition would kill us with no
+    # teardown (reference orders this the same way, driver.go:170-200).
+    stop = install_stop_handlers()
     hc = None
-    if args.healthcheck_port >= 0:
-        hc = Healthcheck(driver.sockets, port=args.healthcheck_port)
-        hc.start()
-
-    stop = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
-    logger.info("tpu-kubelet-plugin up on node %s", args.node_name)
-    stop.wait()
-    if hc is not None:
-        hc.stop()
-    driver.stop()
+    try:
+        driver.start()
+        if args.healthcheck_port >= 0:
+            hc = Healthcheck(driver.sockets, port=args.healthcheck_port)
+            hc.start()
+        logger.info("tpu-kubelet-plugin up on node %s", args.node_name)
+        stop.wait()
+    finally:
+        if hc is not None:
+            hc.stop()
+        driver.stop()
     return 0
 
 

@@ -4,10 +4,13 @@ from __future__ import annotations
 
 import argparse
 import logging
-import signal
-import threading
 
-from tpudra.flags import add_common_flags, env_default, setup_common
+from tpudra.flags import (
+    add_common_flags,
+    env_default,
+    install_stop_handlers,
+    setup_common,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -30,13 +33,13 @@ def main(argv=None) -> int:
     srv = WebhookServer(
         port=args.port, cert_file=args.tls_cert or None, key_file=args.tls_key or None
     )
-    srv.start()
-    stop = threading.Event()
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        signal.signal(sig, lambda *_: stop.set())
-    logger.info("webhook up on :%d (tls=%s)", srv.port, bool(args.tls_cert))
-    stop.wait()
-    srv.stop()
+    stop = install_stop_handlers()
+    try:
+        srv.start()
+        logger.info("webhook up on :%d (tls=%s)", srv.port, bool(args.tls_cert))
+        stop.wait()
+    finally:
+        srv.stop()
     return 0
 
 
