@@ -55,10 +55,13 @@ spec:
     - name: ctr
       image: tpudra-workload:latest
       env:
-        # Sim-only override: both "hosts" are one machine here, so the
-        # grant's stable-DNS coordinator is swapped for loopback.  On a
-        # real cluster this var is absent and the grant's own
-        # TPUDRA_COORDINATOR (injected by the channel claim) is used.
+        # Sim-only override: both "hosts" are one machine here, so host 0
+        # and the daemon's coordinator proxy would contend for one port —
+        # the grant's stable-DNS coordinator is swapped for loopback.  On
+        # a real cluster this var is absent: host 0 binds its own pod IP
+        # and registers it in TPUDRA_CD_DIR, and the index-0 daemon's
+        # proxy forwards the stable name to it (the full path is covered
+        # hermetically by tests/test_coordproxy.py).
         - name: TPUDRA_SIM_COORDINATOR
           value: "127.0.0.1:$COORD_PORT"
       command: ["python", "-c"]

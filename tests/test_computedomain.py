@@ -438,6 +438,36 @@ class TestController:
 # -- daemon units ------------------------------------------------------------
 
 
+class TestDaemonConfigParsing:
+    def test_port_map_tolerates_malformed_entries(self):
+        """A trailing comma or missing '=' must not crash from_environ
+        before logging is even configured (advisor round 2)."""
+        from tpudra.cddaemon.app import _parse_port_map
+
+        assert _parse_port_map("") is None
+        assert _parse_port_map("0=5001,1=5002") == {0: 5001, 1: 5002}
+        assert _parse_port_map("0=5001,") == {0: 5001}
+        assert _parse_port_map("0=5001,bogus,1=x") == {0: 5001}
+        assert _parse_port_map("nonsense") is None
+
+    def test_from_environ_with_malformed_port_map(self):
+        cfg = DaemonConfig.from_environ(
+            {"CD_UID": "u", "TPUDRA_PEER_PORT_MAP": "0=5001,,=,junk"}
+        )
+        assert cfg.peer_port_map == {0: 5001}
+
+    def test_coordinator_defaults(self):
+        from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
+
+        cfg = DaemonConfig.from_environ({"CD_UID": "u"})
+        assert cfg.coordinator_port == DEFAULT_COORDINATOR_PORT
+        assert cfg.coordinator_dir == "/etc/tpudra-cd"
+        cfg = DaemonConfig.from_environ(
+            {"CD_UID": "u", "COORDINATOR_PORT": "bogus"}
+        )
+        assert cfg.coordinator_port == DEFAULT_COORDINATOR_PORT
+
+
 class TestCliqueManager:
     def test_join_assigns_sequential_indices(self):
         kube = FakeKube()
@@ -682,6 +712,27 @@ class TestBatsParityCD:
         assert len(nodes) == 1 and nodes[0]["path"].endswith("channel5")
         env = spec["containerEdits"]["env"]
         assert "TPUDRA_DOMAIN_CHANNELS=5" in env
+
+    def test_channel_grant_carries_rendezvous_dir(self, tmp_path):
+        """Channel grants mount the per-domain host dir and point
+        TPUDRA_CD_DIR at it, so host 0 can register its live coordinator
+        endpoint for the daemon's proxy (cddaemon/coordproxy.py)."""
+        import os as _os
+
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        resp = drv.prepare_resource_claims([_channel_claim("wl-r", uid, "channel-3")])
+        assert resp["claims"]["wl-r"].get("devices"), resp
+        spec = drv.state._cdi.read_claim_spec("wl-r")
+        env = spec["containerEdits"]["env"]
+        assert "TPUDRA_CD_DIR=/var/run/tpudra-cd" in env
+        assert any(e.startswith("TPUDRA_COORDINATOR=") for e in env)
+        mounts = spec["containerEdits"]["mounts"]
+        assert mounts and mounts[0]["containerPath"] == "/var/run/tpudra-cd"
+        # The host side is the domain settings dir the daemon pod also
+        # mounts — and it must exist by grant time.
+        assert mounts[0]["hostPath"] == drv.state._cdm.domain_dir(uid)
+        assert _os.path.isdir(mounts[0]["hostPath"])
 
     def test_channel_injection_all_mode(self, tmp_path):
         """test_cd_imex_chan_inject.bats:24 — All grants the domain's whole

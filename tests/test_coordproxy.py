@@ -1,0 +1,227 @@
+"""Coordinator-proxy rendezvous: the daemon-side bridge between the stable
+TPUDRA_COORDINATOR DNS name and the host-0 workload's actually-bound
+jax.distributed coordinator (cddaemon/coordproxy.py; no reference analog —
+IMEX daemons gossip their own peer IPs, dnsnames.go)."""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tpudra.cddaemon.coordproxy import (
+    CoordinatorProxy,
+    read_registration,
+    write_registration,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRegistration:
+    def test_roundtrip(self, tmp_path):
+        write_registration(str(tmp_path), "10.1.2.3", 7175)
+        assert read_registration(str(tmp_path)) == ("10.1.2.3", 7175)
+
+    def test_missing_and_malformed(self, tmp_path):
+        assert read_registration(str(tmp_path)) is None
+        (tmp_path / "coordinator").write_text("garbage\n")
+        assert read_registration(str(tmp_path)) is None
+        (tmp_path / "coordinator").write_text(":7175\n")
+        assert read_registration(str(tmp_path)) is None
+
+    def test_write_is_atomic_replace(self, tmp_path):
+        write_registration(str(tmp_path), "10.0.0.1", 1)
+        write_registration(str(tmp_path), "10.0.0.2", 2)
+        assert read_registration(str(tmp_path)) == ("10.0.0.2", 2)
+        assert not (tmp_path / "coordinator.tmp").exists()
+
+
+class TestProxy:
+    def test_refuses_before_registration_then_splices(self, tmp_path):
+        # Upstream: a trivial echo server standing in for the coordinator.
+        upstream = socket.socket()
+        upstream.bind(("127.0.0.1", 0))
+        upstream.listen(1)
+        up_port = upstream.getsockname()[1]
+
+        def echo_once():
+            conn, _ = upstream.accept()
+            data = conn.recv(1024)
+            conn.sendall(b"echo:" + data)
+            conn.close()
+
+        proxy = CoordinatorProxy(0, str(tmp_path), host="127.0.0.1")
+        proxy.start()
+        try:
+            # Unregistered: connection is accepted then closed with no data
+            # (jax.distributed's client treats this as retryable).
+            with socket.create_connection(("127.0.0.1", proxy.bound_port), 5) as s:
+                assert s.recv(64) == b""
+
+            write_registration(str(tmp_path), "127.0.0.1", up_port)
+            t = threading.Thread(target=echo_once, daemon=True)
+            t.start()
+            with socket.create_connection(("127.0.0.1", proxy.bound_port), 5) as s:
+                s.sendall(b"hello")
+                assert s.recv(64) == b"echo:hello"
+            t.join(timeout=5)
+        finally:
+            proxy.stop()
+            upstream.close()
+
+    def test_unreachable_registration_closes_connection(self, tmp_path):
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()  # nothing listens here now
+        write_registration(str(tmp_path), "127.0.0.1", dead_port)
+        proxy = CoordinatorProxy(0, str(tmp_path), host="127.0.0.1")
+        proxy.start()
+        try:
+            with socket.create_connection(("127.0.0.1", proxy.bound_port), 5) as s:
+                assert s.recv(64) == b""
+        finally:
+            proxy.stop()
+
+
+class TestHostZeroRegistration:
+    def test_initialize_writes_registration_and_binds_locally(
+        self, tmp_path, monkeypatch
+    ):
+        """Host 0 must NOT try to bind the daemon's DNS name — it binds its
+        own address and publishes it for the proxy."""
+        from tpudra.workload.envspec import ClaimEnv
+
+        captured = {}
+
+        class FakeDistributed:
+            def initialize(self, coordinator_address, num_processes, process_id):
+                captured["address"] = coordinator_address
+                captured["n"] = num_processes
+                captured["id"] = process_id
+
+        import jax
+
+        monkeypatch.setattr(jax, "distributed", FakeDistributed())
+        env = ClaimEnv.from_environ(
+            {
+                "TPUDRA_NUM_HOSTS": "2",
+                "TPUDRA_HOST_INDEX": "0",
+                "TPUDRA_COORDINATOR": "compute-domain-daemon-0000:7175",
+                "TPUDRA_CD_DIR": str(tmp_path),
+            }
+        )
+        env.initialize_distributed()
+        reg = read_registration(str(tmp_path))
+        assert reg is not None and reg[1] == 7175
+        assert captured["address"] == f"{reg[0]}:7175"
+        assert "compute-domain-daemon" not in captured["address"]
+        assert captured["n"] == 2 and captured["id"] == 0
+
+    def test_nonzero_host_uses_grant_coordinator(self, tmp_path, monkeypatch):
+        from tpudra.workload.envspec import ClaimEnv
+
+        captured = {}
+
+        class FakeDistributed:
+            def initialize(self, coordinator_address, num_processes, process_id):
+                captured["address"] = coordinator_address
+
+        import jax
+
+        monkeypatch.setattr(jax, "distributed", FakeDistributed())
+        env = ClaimEnv.from_environ(
+            {
+                "TPUDRA_NUM_HOSTS": "2",
+                "TPUDRA_HOST_INDEX": "1",
+                "TPUDRA_COORDINATOR": "compute-domain-daemon-0000:7175",
+                "TPUDRA_CD_DIR": str(tmp_path),
+            }
+        )
+        env.initialize_distributed()
+        assert captured["address"] == "compute-domain-daemon-0000:7175"
+        assert read_registration(str(tmp_path)) is None
+
+
+WORKER = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+from tpudra.workload.envspec import ClaimEnv
+
+env = ClaimEnv.from_environ()
+env.initialize_distributed()
+assert jax.process_count() == 2, jax.process_count()
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental import multihost_utils
+
+mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+local = jnp.ones((1, 4), jnp.float32) * (env.host_index + 1)
+garr = multihost_utils.host_local_array_to_global_array(local, mesh, P("dp", None))
+total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(garr)
+val = float(total.addressable_data(0))
+assert val == 12.0, val
+print(f"OK host={env.host_index} sum={val}")
+"""
+
+
+class TestRendezvousThroughProxy:
+    def test_two_workers_rendezvous_via_proxy(self, tmp_path):
+        """The full production path, hermetically: host 0 binds its own
+        coordinator and registers it; host 1 dials the *proxy* (standing in
+        for the index-0 daemon's DNS name) and is spliced through.  Both
+        then run a cross-process XLA reduction."""
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            coord_port = s.getsockname()[1]
+
+        proxy = CoordinatorProxy(0, str(tmp_path), host="127.0.0.1")
+        proxy.start()
+        worker_py = tmp_path / "worker.py"
+        worker_py.write_text(WORKER)
+        procs = []
+        try:
+            for idx in range(2):
+                env = dict(
+                    os.environ,
+                    PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                    # Host 0 parses the port and binds locally; host 1 dials
+                    # the proxy (the "daemon DNS name" of this test).
+                    TPUDRA_COORDINATOR=(
+                        f"127.0.0.1:{coord_port}"
+                        if idx == 0
+                        else f"127.0.0.1:{proxy.bound_port}"
+                    ),
+                    TPUDRA_CD_DIR=str(tmp_path),
+                    TPUDRA_NUM_HOSTS="2",
+                    TPUDRA_HOST_INDEX=str(idx),
+                    JAX_PLATFORMS="cpu",
+                )
+                env.pop("XLA_FLAGS", None)  # one device per process
+                if idx:
+                    env.pop("TPUDRA_CD_DIR")  # only host 0 registers
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, str(worker_py)],
+                        env=env, stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT, text=True,
+                    )
+                )
+            outs = []
+            for p in procs:
+                out, _ = p.communicate(timeout=120)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+            proxy.stop()
+        for idx, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"worker {idx} failed:\n{out}"
+            assert f"OK host={idx}" in out, out

@@ -61,6 +61,13 @@ class DaemonConfig:
     peer_port: int = DEFAULT_PEER_PORT
     work_dir: str = "/var/run/tpudra-cd"
     hosts_path: str = "/etc/hosts"
+    # DCN rendezvous proxy: listen port (the TPUDRA_COORDINATOR port peers
+    # dial at this daemon's DNS name) and the per-domain host dir where the
+    # host-0 workload registers its live coordinator endpoint (the same dir
+    # the plugin mounts into this pod at /etc/tpudra-cd).  Port <= 0
+    # disables the proxy.
+    coordinator_port: int = 0
+    coordinator_dir: str = "/etc/tpudra-cd"
     daemon_argv: Optional[Sequence[str]] = None  # default: tpu-slicewatchd
     # Single-host test mode: clique index -> UDP peer port.  When set, the
     # daemon binds the port for its own index and writes the port-annotated
@@ -87,19 +94,40 @@ class DaemonConfig:
             peer_port=int(env.get("PEER_PORT", str(DEFAULT_PEER_PORT))),
             work_dir=env.get("WORK_DIR", "/var/run/tpudra-cd"),
             hosts_path=env.get("HOSTS_PATH", "/etc/hosts"),
+            coordinator_port=_env_port(env, "COORDINATOR_PORT"),
+            coordinator_dir=env.get("COORDINATOR_DIR", "/etc/tpudra-cd"),
             peer_port_map=_parse_port_map(env.get("TPUDRA_PEER_PORT_MAP", "")),
         )
 
 
+def _env_port(env: dict, key: str) -> int:
+    from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
+
+    try:
+        return int(env.get(key, "") or DEFAULT_COORDINATOR_PORT)
+    except ValueError:
+        return DEFAULT_COORDINATOR_PORT
+
+
 def _parse_port_map(spec: str) -> Optional[dict[int, int]]:
-    """Parse "0=5001,1=5002" (TPUDRA_PEER_PORT_MAP) into {index: port}."""
+    """Parse "0=5001,1=5002" (TPUDRA_PEER_PORT_MAP) into {index: port}.
+
+    Malformed entries are reported and skipped, mirroring _env_int's
+    tolerant fallback — a trailing comma in a test harness's env must not
+    crash the daemon before logging is even configured."""
     if not spec:
         return None
     out: dict[int, int] = {}
     for part in spec.split(","):
         idx, _, port = part.partition("=")
+        if not (idx.strip().isdigit() and port.strip().isdigit()):
+            if part.strip():
+                logger.warning(
+                    "ignoring malformed TPUDRA_PEER_PORT_MAP entry %r", part
+                )
+            continue
         out[int(idx)] = int(port)
-    return out
+    return out or None
 
 
 def query_status(port: int, host: str = "127.0.0.1", timeout: float = 2.0) -> str:
@@ -120,6 +148,7 @@ class DaemonApp:
         self.clique: Optional[CliqueManager] = None
         self.process: Optional[ProcessManager] = None
         self.pods: Optional[PodManager] = None
+        self.coordproxy = None
         self._dns: Optional[DNSNameManager] = None
         self._started = threading.Event()
 
@@ -163,6 +192,28 @@ class DaemonApp:
                 cfg.node_name, cfg.pod_ip,
             )
         index = self.clique.join()
+
+        # DCN rendezvous proxy: peers dial TPUDRA_COORDINATOR =
+        # dns_name(0):7175, which resolves to the index-0 daemon's pod IP —
+        # this pod.  The host-0 workload binds jax.distributed's coordinator
+        # in its *own* pod and registers the live endpoint in the shared
+        # per-domain dir; the proxy splices the two.  Every daemon runs it
+        # (cheap, and index assignment can change across restarts); only
+        # index 0's ever receives traffic.
+        self.coordproxy = None
+        if cfg.coordinator_port > 0:
+            from tpudra.cddaemon.coordproxy import CoordinatorProxy
+
+            try:
+                self.coordproxy = CoordinatorProxy(
+                    cfg.coordinator_port, cfg.coordinator_dir
+                )
+                self.coordproxy.start()
+            except OSError as e:
+                # A daemon without the proxy still watches the slice; the
+                # rendezvous just needs cluster routing to the workload.
+                logger.warning("coordinator proxy failed to bind: %s", e)
+                self.coordproxy = None
 
         os.makedirs(cfg.work_dir, exist_ok=True)
         # With the DNS-names gate (default): peers resolve through the real
@@ -252,6 +303,8 @@ class DaemonApp:
                         desired[0] = ready
             flush()
             stop.wait(2.0)
+        if self.coordproxy is not None:
+            self.coordproxy.stop()
         self.process.stop()
 
     def _run_non_fabric_direct_status(self, stop: threading.Event) -> None:

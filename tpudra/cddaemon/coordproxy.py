@@ -1,0 +1,172 @@
+"""Coordinator proxy: the daemon-side half of the DCN rendezvous.
+
+Channel grants point every worker at the index-0 daemon's stable DNS name
+(``compute-domain-daemon-0000:7175``, cdplugin/state.py) — but
+``jax.distributed``'s coordinator service is *bound by the host-0 workload
+process inside its own pod*, on a different IP.  The daemon bridges that
+gap: the host-0 workload registers its actual ``ip:port`` in the per-domain
+host directory (the same dir the plugin mounts into both the daemon and the
+workload pods), and this proxy accepts connections on the coordinator port
+and splices them through to the registered endpoint.
+
+The reference has no analog — its IMEX daemons gossip peer IPs themselves
+(dnsnames.go) and NCCL carries its own bootstrap — but the *shape* is its
+DNS-stability trick (main.go:368-415): peers dial a stable name; the thing
+behind the name forwards to wherever the live endpoint currently is.
+
+Connections arriving before the workload has registered are closed
+immediately; ``jax.distributed.initialize`` retries its coordinator
+connection for ``initialization_timeout`` (default 300 s), so early workers
+simply spin until host 0 comes up.
+
+Staleness window: nothing unregisters on workload death — between a host-0
+pod dying and its replacement re-registering (every host-0 start
+overwrites the file), the proxy forwards to the dead address and peers see
+refused connections, which jax retries.  If the dead IP were recycled by
+an unrelated listener, the spliced peers still fail at the jax coordinator
+handshake (process count/id checks) rather than silently joining a wrong
+domain.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+REGISTRATION_FILE = "coordinator"
+
+
+def read_registration(dir_path: str) -> Optional[tuple[str, int]]:
+    """Read the workload-written ``ip:port`` registration, or None."""
+    try:
+        with open(os.path.join(dir_path, REGISTRATION_FILE)) as f:
+            text = f.read().strip()
+    except OSError:
+        return None
+    host, _, port = text.rpartition(":")
+    if not host or not port.isdigit():
+        return None
+    return host, int(port)
+
+
+def write_registration(dir_path: str, host: str, port: int) -> str:
+    """Atomically publish the live coordinator endpoint (workload side)."""
+    path = os.path.join(dir_path, REGISTRATION_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(f"{host}:{port}\n")
+    os.replace(tmp, path)
+    return path
+
+
+class CoordinatorProxy:
+    """TCP proxy from the daemon's coordinator port to the registered
+    workload endpoint.  One thread per direction per connection — the
+    coordinator carries a handful of small rendezvous/heartbeat streams,
+    not bulk traffic (collectives ride ICI, not this socket)."""
+
+    def __init__(self, port: int, registration_dir: str, host: str = ""):
+        self.port = port
+        self._dir = registration_dir
+        self._host = host  # "" = all interfaces
+        self._server: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def bound_port(self) -> int:
+        """The actual listen port (useful when constructed with port 0)."""
+        return self._server.getsockname()[1] if self._server else self.port
+
+    def start(self) -> None:
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self._host, self.port))
+        self._server.listen(16)
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="coord-proxy"
+        )
+        self._thread.start()
+        logger.info(
+            "coordinator proxy on :%d -> %s/%s",
+            self.bound_port, self._dir, REGISTRATION_FILE,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- internals
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._server.accept()
+            except OSError as e:
+                if self._stop.is_set() or self._server.fileno() < 0:
+                    return  # stop() closed us
+                # Transient accept failure (EMFILE under an fd squeeze,
+                # ECONNABORTED): the proxy must survive it — a silently
+                # dead accept thread strands every later worker in
+                # jax.distributed's 300 s connect timeout.
+                logger.warning("coordinator proxy accept failed: %s", e)
+                if self._stop.wait(0.1):
+                    return
+                continue
+            target = read_registration(self._dir)
+            if target is None:
+                # No workload registered yet: refuse; jax.distributed's
+                # client retries until initialization_timeout.
+                conn.close()
+                continue
+            threading.Thread(
+                target=self._splice, args=(conn, target, addr),
+                daemon=True, name="coord-proxy-conn",
+            ).start()
+
+    def _splice(self, conn: socket.socket, target: tuple[str, int], addr) -> None:
+        try:
+            upstream = socket.create_connection(target, timeout=10)
+        except OSError as e:
+            logger.warning("coordinator %s:%d unreachable: %s", *target, e)
+            conn.close()
+            return
+
+        def pump(src: socket.socket, dst: socket.socket) -> None:
+            # On src EOF propagate only a write-shutdown to dst: a legal
+            # TCP half-close (client sends, then SHUT_WR, then reads the
+            # reply) must not tear down the opposite direction.
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=pump, args=(upstream, conn), daemon=True)
+        t.start()
+        pump(conn, upstream)
+        t.join()
+        for s in (conn, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -19,6 +19,7 @@ config application:
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
@@ -352,6 +353,18 @@ class ComputeDomainDeviceState:
         from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
         from tpudra.cddaemon.dnsnames import dns_name
 
+        # The per-domain host dir is shared three ways: the daemon pod
+        # mounts it (daemon settings), and every workload pod gets it too so
+        # host 0 can register its live jax.distributed coordinator endpoint
+        # for the daemon's proxy to forward to (cddaemon/coordproxy.py).
+        domain_dir = self._cdm.domain_dir(config.domain_id)
+        os.makedirs(domain_dir, exist_ok=True)
+        # The host-0 workload writes its registration here and commonly
+        # runs as non-root (securityContext runAsUser); the dir is created
+        # by the root plugin, so open it up — it carries one rendezvous
+        # address, not secrets.
+        os.chmod(domain_dir, 0o777)
+        cd_dir_mount = "/var/run/tpudra-cd"
         edits = ContainerEdits(
             env=[
                 f"TPUDRA_DOMAIN_UID={config.domain_id}",
@@ -363,11 +376,15 @@ class ComputeDomainDeviceState:
                 # jax.distributed at the index-0 daemon's stable DNS name
                 # (ClaimEnv.initialize_distributed).  Daemon claims get the
                 # same value via their settings env (computedomain.py:118).
+                # Host 0 binds locally instead and registers through
+                # TPUDRA_CD_DIR; the daemon proxies the stable name to it.
                 f"TPUDRA_COORDINATOR={dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
+                f"TPUDRA_CD_DIR={cd_dir_mount}",
             ],
             device_nodes=[
                 self._cdi.host_path(alloc.channel_dev_path(i)) for i in granted
             ],
+            mounts=[(domain_dir, cd_dir_mount)],
         )
         return devices, edits
 

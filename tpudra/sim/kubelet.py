@@ -596,9 +596,16 @@ class ClusterSim:
 
     def _cdi_env(self, run: _PodRun) -> dict:
         """Apply the transient CDI specs of the pod's claims: merge every
-        env edit and surface injected device nodes for assertions."""
+        env edit and surface injected device nodes for assertions.
+
+        Mount translation: pod "containers" here are host processes, so a
+        bind mount is an identity map — any env value naming a mounted
+        containerPath is rewritten to its hostPath (e.g. TPUDRA_CD_DIR →
+        the per-domain dir the plugin created), exactly what the runtime's
+        real bind mount would make true inside the container."""
         env: dict[str, str] = {}
         dev_nodes: list[str] = []
+        mounts: dict[str, str] = {}  # containerPath -> hostPath
         uids = {c["metadata"]["uid"] for c in run.claims}
         for root in run.node.cdi_roots:
             try:
@@ -613,16 +620,22 @@ class ClusterSim:
                         spec = json.load(f)
                 except (OSError, ValueError):
                     continue
-                for e in spec.get("containerEdits", {}).get("env", []):
-                    k, _, v = e.partition("=")
-                    env[k] = v
-                for dev in spec.get("devices", []):
-                    edits = dev.get("containerEdits", {})
+                all_edits = [spec.get("containerEdits", {})] + [
+                    dev.get("containerEdits", {}) for dev in spec.get("devices", [])
+                ]
+                for edits in all_edits:
                     for e in edits.get("env", []):
                         k, _, v = e.partition("=")
                         env[k] = v
                     for n in edits.get("deviceNodes", []):
                         dev_nodes.append(n["path"])
+                    for mt in edits.get("mounts", []):
+                        mounts[mt["containerPath"]] = mt["hostPath"]
+        for k, v in env.items():
+            for cpath, hpath in mounts.items():
+                if v == cpath or v.startswith(cpath + "/"):
+                    env[k] = hpath + v[len(cpath):]
+                    break
         if dev_nodes:
             env[DEVICE_NODES_ENV] = ",".join(sorted(dev_nodes))
         return env

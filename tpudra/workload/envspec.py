@@ -33,6 +33,10 @@ class ClaimEnv:
     num_hosts: int = 1
     host_index: int = 0
     coordinator: str = ""  # host:port for jax.distributed DCN rendezvous
+    # Per-domain shared dir (host path mounted into both the workload and
+    # the daemon pods): host 0 registers its live coordinator endpoint here
+    # for the daemon's proxy to forward to.
+    cd_dir: str = ""
     # Multi-process sharing (MPS analog): the per-claim control daemon's
     # pipe directory, injected by the plugin's CDI edits.
     mp_pipe_dir: str = ""
@@ -61,6 +65,7 @@ class ClaimEnv:
         out.num_hosts = int(env.get("TPUDRA_NUM_HOSTS", "1") or "1")
         out.host_index = int(env.get("TPUDRA_HOST_INDEX", "0") or "0")
         out.coordinator = env.get("TPUDRA_COORDINATOR", "")
+        out.cd_dir = env.get("TPUDRA_CD_DIR", "")
         out.mp_pipe_dir = env.get("TPUDRA_MP_PIPE_DIRECTORY", "")
         return out
 
@@ -82,13 +87,51 @@ class ClaimEnv:
 
         Multi-host grants carry coordinator/host-count env (written by the CD
         daemon settings); jax.distributed rides DCN for rendezvous while the
-        compiled collectives ride ICI."""
+        compiled collectives ride ICI.
+
+        TPUDRA_COORDINATOR names the index-0 *daemon* (a stable DNS name) —
+        but jax.distributed's coordinator service is bound by *this* process
+        when it is host 0, inside its own pod.  So host 0 binds locally,
+        publishes its real ``ip:port`` into the shared per-domain dir
+        (TPUDRA_CD_DIR), and the daemon's CoordinatorProxy forwards peers
+        dialing the stable name to the registered endpoint
+        (cddaemon/coordproxy.py)."""
         if self.num_hosts <= 1 or not self.coordinator:
             return
         import jax
 
+        address = self.coordinator
+        _, _, port = self.coordinator.rpartition(":")
+        if self.host_index == 0 and port.isdigit():
+            # A portless coordinator value passes through verbatim (jax
+            # reports the malformed address clearly); only a well-formed
+            # grant triggers the local-bind + registration path.
+            ip = _local_ip()
+            if not ip:
+                raise RuntimeError(
+                    "host 0 has no routable IPv4 address to bind the "
+                    "coordinator on — cannot register a loopback address "
+                    "(the daemon proxy would forward to itself); IPv6-only "
+                    "pod networks need hostNetwork or an explicit "
+                    "coordinator service"
+                )
+            address = f"{ip}:{port}"
+            if self.cd_dir:
+                from tpudra.cddaemon.coordproxy import write_registration
+
+                try:
+                    write_registration(self.cd_dir, ip, int(port))
+                except OSError as e:
+                    # Crash loudly WITH the diagnosis: a silent skip here
+                    # strands every peer in a 300 s connect timeout.
+                    raise RuntimeError(
+                        f"host 0 could not register its coordinator in "
+                        f"{self.cd_dir}: {e} — peers dialing "
+                        f"{self.coordinator} will hang; check the domain "
+                        f"dir mount and its permissions"
+                    ) from e
         jax.distributed.initialize(
-            coordinator_address=self.coordinator,
+            coordinator_address=address,
             num_processes=self.num_hosts,
             process_id=self.host_index,
         )
@@ -125,6 +168,25 @@ class ClaimEnv:
                 query(self.mp_pipe_dir, f"DETACH {me}")
             except OSError:
                 pass  # daemon went away; nothing to release
+
+
+def _local_ip() -> str:
+    """This pod's routable IP: a connected UDP socket's local address
+    (no packet is sent; works without DNS for the pod's own hostname).
+    Returns "" when no IPv4 route exists — callers must treat that as an
+    error, NOT fall back to loopback: registering 127.0.0.1 would point
+    the daemon's coordinator proxy at itself (its own netns), and each
+    forwarded connection would re-enter the proxy in a self-connect loop."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return ""
+    finally:
+        s.close()
 
 
 def mesh_from_devices(
