@@ -512,3 +512,57 @@ class TestSliceWatchd:
             if b is not None:
                 b.terminate()
                 b.wait(timeout=5)
+
+
+class TestRuntimeProbeOverlay:
+    """NativeDeviceLib + runtimeprobe: the runtime's attested coords
+    replace the spec-table guess, while corroborate_runtime diffs the RAW
+    table view (comparing the overlay against the probe that produced it
+    would make the check circular)."""
+
+    def test_overlay_applies_but_corroboration_sees_raw_table(self, tmp_path):
+        from tpudra.devicelib.native import NativeDeviceLib
+        from tpudra.devicelib.runtimeprobe import RuntimeProbe
+
+        cfg = mk_config(tmp_path, generation="v5e", num_chips=4, num_hosts=1)
+        plain = NativeDeviceLib(config_path=cfg)
+        table_coords = [list(c.coords) for c in plain.enumerate_chips()]
+        plain.close()
+
+        scrambled = [[9, c[1], c[2]] for c in table_coords]
+        probe = RuntimeProbe(
+            platform="tpu", device_kind="TPU v5 lite", num_devices=4,
+            coords=scrambled,
+        )
+        lib = NativeDeviceLib(config_path=cfg, runtime_probe=probe)
+        try:
+            # Enumeration: runtime coords win over the table.
+            assert [list(c.coords) for c in lib.enumerate_chips()] == scrambled
+            # Corroboration: the table's disagreement is REPORTED, not
+            # masked by the overlay.
+            out = lib.corroborate_runtime()
+            assert out["available"]
+            assert out["match"]["coords"] is False
+            assert not out["consistent"]
+            assert out["lib"]["coords"] == table_coords
+        finally:
+            lib.close()
+
+    def test_agreeing_probe_is_consistent(self, tmp_path):
+        from tpudra.devicelib.native import NativeDeviceLib
+        from tpudra.devicelib.runtimeprobe import RuntimeProbe
+
+        cfg = mk_config(tmp_path, generation="v5e", num_chips=4, num_hosts=1)
+        plain = NativeDeviceLib(config_path=cfg)
+        coords = [list(c.coords) for c in plain.enumerate_chips()]
+        plain.close()
+        probe = RuntimeProbe(
+            platform="tpu", device_kind="TPU v5 lite", num_devices=4,
+            coords=coords,
+        )
+        lib = NativeDeviceLib(config_path=cfg, runtime_probe=probe)
+        try:
+            out = lib.corroborate_runtime()
+            assert out["consistent"], out
+        finally:
+            lib.close()

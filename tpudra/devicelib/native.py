@@ -121,6 +121,7 @@ class NativeDeviceLib(DeviceLib):
         config_path: str = "",
         lib_path: str = DEFAULT_LIB_PATH,
         health_events_path: str = "",
+        runtime_probe=None,
     ):
         if not os.path.exists(lib_path):
             raise DeviceLibError(
@@ -139,6 +140,19 @@ class NativeDeviceLib(DeviceLib):
         self._health_events_path = health_events_path or os.environ.get(
             "TPUINFO_HEALTH_EVENTS", ""
         )
+        # Live-runtime corroboration (runtimeprobe.py): when a probe is
+        # provided — or TPUINFO_RUNTIME_PROBE=1 asks for one at open — the
+        # runtime's attested chip coordinates replace the spec-table guess
+        # and corroborate_runtime() can diff the two views.  Opt-in: the
+        # probe subprocess briefly touches the TPU runtime, which a
+        # production kubelet plugin must not do unasked.
+        self._runtime_probe = runtime_probe
+        if self._runtime_probe is None and os.environ.get(
+            "TPUINFO_RUNTIME_PROBE"
+        ) == "1":
+            from tpudra.devicelib.runtimeprobe import probe_runtime
+
+            self._runtime_probe = probe_runtime()
         self._sharing_lock = threading.Lock()
         self._timeslice: dict[str, str] = {}
         self._exclusive: dict[str, bool] = {}
@@ -167,7 +181,31 @@ class NativeDeviceLib(DeviceLib):
                     tensorcores=c.tensorcores,
                 )
             )
+        if self._runtime_probe is not None:
+            from tpudra.devicelib.runtimeprobe import apply_to_chips
+
+            out = apply_to_chips(out, self._runtime_probe)
         return out
+
+    def corroborate_runtime(self) -> dict:
+        """Diff this library's enumeration against the live TPU runtime
+        (the NVML-is-truth gap of reference nvlib.go closed from the other
+        side).  {"available": False} when no runtime is reachable.
+
+        Compares the library's RAW view — the overlay a held probe applies
+        in enumerate_chips is suppressed for the diff, otherwise the check
+        would compare the probe against itself and a wrong spec-table
+        placement could never surface."""
+        from tpudra.devicelib.runtimeprobe import corroborate, probe_runtime
+
+        probe = self._runtime_probe or probe_runtime()
+        saved, self._runtime_probe = self._runtime_probe, None
+        try:
+            chips = self.enumerate_chips()
+            topo = self.slice_topology()
+        finally:
+            self._runtime_probe = saved
+        return corroborate(chips, topo, probe)
 
     def slice_topology(self) -> SliceTopology:
         t = _Topology()
