@@ -200,6 +200,84 @@ class TestPipelineParallel:
         mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "dp"))
         return m, cfg, params, tokens, mesh
 
+    def test_combined_3d_ep_single_program(self):
+        """dp×pp×tp in ONE program: the pipeline schedule is manual over
+        pp/dp while tp stays a GSPMD-auto axis inside the stage body — the
+        Megatron layout and the ep-sharded (experts-on-tp) Switch FFN are
+        partitioned by XLA within each pipeline stage.  Loss parity against
+        the unpipelined run of the same sparse model (capacity high enough
+        that no tokens drop, so per-microbatch routing matches)."""
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from tpudra.workload import model as m
+        from tpudra.workload.pipeline import pipelined_loss_fn
+
+        # f32 compute: XLA's CPU AllReducePromotion aborts on the bf16
+        # all-reduces a partial-manual backward emits (the knob exists for
+        # exactly this validation path); also makes parity tight.
+        cfg = m.ModelConfig(
+            vocab=64, d_model=32, n_heads=2, n_layers=4, d_ff=64, max_seq=16,
+            num_experts=2, moe_capacity_factor=8.0, moe_aux_weight=0.0,
+            compute_dtype="f32",
+        )
+        mesh = Mesh(
+            np.array(jax.devices()[:8]).reshape(2, 2, 2), ("pp", "dp", "tp")
+        )
+        params = m.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, cfg.vocab)
+
+        dense = float(jax.jit(lambda p, t: m.loss_fn(p, t, cfg))(params, tokens))
+
+        # Same model through the combined program: params tp-sharded
+        # (experts on tp), batch dp-sharded, layers pipelined over pp.
+        sharded = m.shard_params(params, mesh, cfg)
+        tok_sharded = jax.device_put(
+            tokens, NamedSharding(mesh, P("dp", None))
+        )
+        loss, grads = jax.jit(
+            jax.value_and_grad(
+                lambda p, t: pipelined_loss_fn(
+                    p, t, cfg, mesh, num_microbatches=4
+                )
+            )
+        )(sharded, tok_sharded)
+        assert abs(float(loss) - dense) < 1e-3, (float(loss), dense)
+        for leaf in jax.tree.leaves(grads):
+            assert bool(jnp.isfinite(leaf).all())
+
+    def test_mesh_validation_up_front(self):
+        """Missing pp/dp axes and non-dividing microbatches raise ValueError
+        in the caller's frame, not an opaque shard_map error (advisor
+        round 2)."""
+        import numpy as np
+
+        import jax
+        import pytest as _pytest
+        from jax.sharding import Mesh
+
+        from tpudra.workload.pipeline import pipelined_backbone
+
+        m, cfg, params, tokens, mesh = self._setup()
+        no_dp = Mesh(np.array(jax.devices()[:2]), ("pp",))
+        with _pytest.raises(ValueError, match="no 'dp' axis"):
+            pipelined_backbone(params, tokens, cfg, no_dp, num_microbatches=4)
+        with _pytest.raises(ValueError, match="no 'nope' axis"):
+            pipelined_backbone(
+                params, tokens, cfg, mesh, num_microbatches=4, pp_axis="nope"
+            )
+        # dp=2 but microbatch size 8/8=1: does not split over dp.
+        with _pytest.raises(ValueError, match="does not split over"):
+            pipelined_backbone(params, tokens, cfg, mesh, num_microbatches=8)
+        # dp_axis=None opts out of the dp checks entirely.
+        out, _ = pipelined_backbone(
+            params, tokens, cfg, no_dp, num_microbatches=4, dp_axis=None
+        )
+        assert out.shape == tokens.shape + (cfg.d_model,)
+
     def test_backbone_matches_dense(self):
         import jax
         import jax.numpy as jnp

@@ -15,7 +15,8 @@ This module is a deliberately small, pure-JAX (no framework) decoder:
 - remat on the layer body trades FLOPs for HBM
 
 Perf decisions, each A/B-measured on a real v5e chip (472M params, batch 16,
-seq 1024; cumulatively 41% → 67% MFU):
+seq 1024; cumulatively 41% → ~66% MFU — the headline and the A/B legs are
+re-measured into every round's BENCH_r{N}.json by bench.py, extras.tpu/.ab):
 
 - **transpose-free projections**: qkv is one einsum straight into
   ``[3, B, H, S, hd]`` and the output projection contracts ``[H, hd]``
@@ -33,10 +34,13 @@ seq 1024; cumulatively 41% → 67% MFU):
   output (-5% if done the other way); softmax runs in f32 for stability
 - **tuned pallas splash attention on TPU** (``attention="auto"``): the
   splash kernel with 1024-wide blocks and the fused backward beats the
-  fused naive chain at every runnable length — 66-67% vs 52% MFU at seq
-  1024 — and is the only path past the HBM cliff (seq 8192 at 72%, 16384
-  at 78% MFU, where naive cannot compile).  Both pallas kernels lose to
-  naive at their DEFAULT block sizes; the tuning is the feature
+  fused naive chain at every runnable length — ~66% vs ~52% MFU at seq
+  1024 (bench.py extras.ab.attention_naive) — and is the only path past
+  the HBM cliff (seq 8192 at ~72% batch 2, 16384 at ~79% MFU batch 1,
+  extras.long_context/.long_context_16k; naive cannot compile there).
+  Both pallas kernels lose to naive at their DEFAULT block sizes; the
+  tuning is the feature.  A block sweep at seq 1024 (512/1024 q×kv
+  combinations) is within noise of 1024×1024 — the default stands
 
 Used by __graft_entry__ (single-chip forward + multi-chip dryrun) and by the
 ComputeDomain e2e workload.
@@ -61,16 +65,22 @@ class ModelConfig:
     # the tail path automatically.
     ce_chunk: int = 512
     # Attention core: "auto" | "naive" | "flash"/"splash".  Measured on
-    # v5e (472M params): the pallas splash kernel with 1024-wide blocks
-    # and its fused backward beats XLA's fused naive chain at every length
-    # it can run — 66-67% vs 52% MFU at seq 1024, and past the HBM cliff
-    # (seq > ~2048) it is the only path that compiles at all (72% MFU at
-    # 8192, 78% at 16384).  Both pallas kernels LOSE to naive at their
-    # default block sizes — the tuning is the feature.  "auto" picks the
-    # kernel for single-device TPU programs whose block shapes divide the
-    # sequence and whose head_dim is MXU-aligned; meshes, CPU, and odd
-    # lengths take the naive path.
+    # v5e (472M params; artifacts in BENCH_r{N}.json extras.ab): the
+    # pallas splash kernel with 1024-wide blocks and its fused backward
+    # beats XLA's fused naive chain at every length it can run — ~66% vs
+    # ~52% MFU at seq 1024 — and past the HBM cliff (seq > ~2048) it is
+    # the only path that compiles at all (~72% MFU at 8192, ~79% at
+    # 16384).  Both pallas kernels LOSE to naive at their default block
+    # sizes — the tuning is the feature.  "auto" picks the kernel for
+    # single-device TPU programs whose block shapes divide the sequence
+    # and whose head_dim is MXU-aligned; meshes, CPU, and odd lengths
+    # take the naive path.
     attention: str = "auto"
+    # Splash-attention block sizes (0 = the tuned default, min(1024, S)).
+    # Exposed so bench.py can sweep them on real hardware; both must divide
+    # the sequence length.
+    attn_block_q: int = 0
+    attn_block_kv: int = 0
     # Rematerialization policy for the layer scan body:
     #   "dots"  — keep matmul outputs, recompute elementwise/softmax
     #             (checkpoint_dots_with_no_batch_dims; the measured default)
@@ -84,6 +94,12 @@ class ModelConfig:
     num_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Activation/matmul compute dtype: "bf16" (the MXU path, default) or
+    # "f32".  f32 exists for numerics debugging and for virtual-CPU-mesh
+    # validation of partial-manual (pipeline × GSPMD-auto tp) programs —
+    # XLA's CPU AllReducePromotion pass aborts on the bf16 all-reduces
+    # those emit in the backward; real TPU meshes keep bf16.
+    compute_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.attention not in ("auto", "naive", "flash", "splash"):
@@ -94,6 +110,19 @@ class ModelConfig:
             raise ValueError(f"remat must be dots|full|none, got {self.remat!r}")
         if self.num_experts < 0:
             raise ValueError(f"num_experts must be >= 0, got {self.num_experts}")
+        if self.compute_dtype not in ("bf16", "f32"):
+            raise ValueError(
+                f"compute_dtype must be bf16|f32, got {self.compute_dtype!r}"
+            )
+        for name in ("attn_block_q", "attn_block_kv"):
+            blk = getattr(self, name)
+            if blk and (blk % 128 or self.max_seq % blk):
+                # Fail here, not as an opaque Mosaic block-shape error mid
+                # sweep: splash blocks must be lane-aligned and divide S.
+                raise ValueError(
+                    f"{name}={blk} must be a multiple of 128 dividing "
+                    f"max_seq={self.max_seq}"
+                )
         if self.d_model % self.n_heads:
             raise ValueError(
                 f"d_model {self.d_model} not divisible by n_heads {self.n_heads}"
@@ -102,6 +131,12 @@ class ModelConfig:
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def act_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.compute_dtype == "bf16" else jnp.float32
 
     def use_flash_attention(self, seq_len: int) -> bool:
         if self.attention in ("flash", "splash"):  # both name the pallas path
@@ -199,7 +234,7 @@ def _layer(cfg: ModelConfig, x, layer_params):
     h = _rmsnorm(x, p["ln1"])
     # [D, H, 3hd] → [D, H, 3, hd]: splits only the unsharded minor axis
     # (tp shards H), so the reshape is GSPMD-transparent.
-    wqkv = p["wqkv"].astype(jnp.bfloat16).reshape(D, H, 3, hd)
+    wqkv = p["wqkv"].astype(cfg.act_dtype).reshape(D, H, 3, hd)
     qkv = jnp.einsum("bsd,dhte->tbhse", h, wqkv)
     q, k, v = qkv[0], qkv[1], qkv[2]
     if cfg.use_flash_attention(S):
@@ -215,16 +250,17 @@ def _layer(cfg: ModelConfig, x, layer_params):
         )
 
         mask = _sm.MultiHeadMask([_sm.CausalMask((S, S)) for _ in range(H)])
-        blk = min(1024, S)
+        blk_q = cfg.attn_block_q or min(1024, S)
+        blk_kv = cfg.attn_block_kv or min(1024, S)
         blocks = _sk.BlockSizes(
-            block_q=blk, block_kv=blk,
-            block_q_dkv=blk, block_kv_dkv=blk,
+            block_q=blk_q, block_kv=blk_kv,
+            block_q_dkv=blk_q, block_kv_dkv=blk_kv,
             use_fused_bwd_kernel=True,
         )
         kernel = _sk.make_splash_mha(
             mask=mask, head_shards=1, q_seq_shards=1, block_sizes=blocks
         )
-        attn = jax.vmap(kernel)(q * (hd ** -0.5), k, v).astype(jnp.bfloat16)
+        attn = jax.vmap(kernel)(q * (hd ** -0.5), k, v).astype(cfg.act_dtype)
     else:
         # bf16 matmul + cast: the MXU's native bf16 output plus a vector
         # cast measures ~5% MFU faster than preferred_element_type=f32
@@ -232,9 +268,9 @@ def _layer(cfg: ModelConfig, x, layer_params):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (hd ** -0.5)
         mask = jnp.tril(jnp.ones((S, S), bool))
         scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(jnp.bfloat16)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.act_dtype)
         attn = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    x = x + jnp.einsum("bhqd,hde->bqe", attn, p["wo"].astype(jnp.bfloat16))
+    x = x + jnp.einsum("bhqd,hde->bqe", attn, p["wo"].astype(cfg.act_dtype))
 
     h = _rmsnorm(x, p["ln2"])
     if cfg.num_experts:
@@ -245,25 +281,24 @@ def _layer(cfg: ModelConfig, x, layer_params):
             d_ff=cfg.d_ff,
             num_experts=cfg.num_experts,
             capacity_factor=cfg.moe_capacity_factor,
+            compute_dtype=cfg.compute_dtype,
         )
         ffn, aux = moe_ffn(
             {"router": p["router"], "w1": p["w1"], "w2": p["w2"]}, h, mcfg
         )
         return x + ffn, aux
-    h = jnp.einsum("bsd,df->bsf", h, p["w1"].astype(jnp.bfloat16))
+    h = jnp.einsum("bsd,df->bsf", h, p["w1"].astype(cfg.act_dtype))
     h = jax.nn.gelu(h)
-    h = jnp.einsum("bsf,fd->bsd", h, p["w2"].astype(jnp.bfloat16))
+    h = jnp.einsum("bsf,fd->bsd", h, p["w2"].astype(cfg.act_dtype))
     return x + h, jnp.zeros((), jnp.float32)
 
 
-def embed_tokens(params, tokens):
-    """tokens [B, S] int32 → embedded inputs [B, S, D] bf16 (shared by the
-    dense and pipelined backbones)."""
-    import jax.numpy as jnp
-
+def embed_tokens(params, tokens, cfg: ModelConfig):
+    """tokens [B, S] int32 → embedded inputs [B, S, D] in cfg.act_dtype
+    (shared by the dense and pipelined backbones)."""
     S = tokens.shape[1]
-    x = params["embed"][tokens].astype(jnp.bfloat16)
-    return x + params["pos"][:S].astype(jnp.bfloat16)[None]
+    x = params["embed"][tokens].astype(cfg.act_dtype)
+    return x + params["pos"][:S].astype(cfg.act_dtype)[None]
 
 
 def remat_layer_body(cfg: ModelConfig):
@@ -273,8 +308,9 @@ def remat_layer_body(cfg: ModelConfig):
 
     Selective remat ("dots"): keep matmul outputs (MXU work is the
     expensive part to recompute), rematerialize the cheap elementwise/
-    softmax ops — measured ~1.2x step-time win over full remat on v5e at
-    equal memory headroom.
+    softmax ops — ~66% vs ~61% MFU against full remat on v5e at the
+    flagship config (bench.py extras.ab.remat_full re-measures this every
+    round).  "none" does not compile at the flagship batch (HBM OOM).
     """
     import jax
 
@@ -295,7 +331,7 @@ def backbone_and_aux(params, tokens, cfg: ModelConfig):
     import jax
     import jax.numpy as jnp
 
-    x = embed_tokens(params, tokens)
+    x = embed_tokens(params, tokens, cfg)
     # The layer body's (carry, aux) return is exactly scan's contract.
     x, auxs = jax.lax.scan(remat_layer_body(cfg), x, params["layers"])
     return _rmsnorm(x, params["ln_f"]), jnp.mean(auxs)
@@ -316,7 +352,7 @@ def forward(params, tokens, cfg: ModelConfig):
     return jnp.einsum(
         "bsd,vd->bsv",
         x,
-        params["embed"].astype(jnp.bfloat16),
+        params["embed"].astype(cfg.act_dtype),
         preferred_element_type=jnp.float32,
     )
 
@@ -345,7 +381,7 @@ def ce_head(params, x, tokens, cfg: ModelConfig):
     import jax
     import jax.numpy as jnp
 
-    emb = params["embed"].astype(jnp.bfloat16)
+    emb = params["embed"].astype(cfg.act_dtype)
     xs, targets = x[:, :-1], tokens[:, 1:]
     B, Sm1, D = xs.shape
 

@@ -31,6 +31,15 @@ class MoEConfig:
     # multiple of 8 (TPU lane alignment); overflowing tokens are dropped
     # (their residual passes through), the standard Switch behavior.
     capacity_factor: float = 1.25
+    # Expert-compute dtype: "bf16" (default) or "f32" — see
+    # model.ModelConfig.compute_dtype for when f32 is the right call.
+    compute_dtype: str = "bf16"
+
+    @property
+    def act_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.compute_dtype == "bf16" else jnp.float32
 
     def capacity(self, num_tokens: int) -> int:
         import math
@@ -95,12 +104,12 @@ def moe_ffn(params, x, cfg: MoEConfig):
     # bfloat16 like the dense FFN (router/softmax/aux stay f32): the
     # dispatch/combine tensors are 0/1 masks and gates, exactly
     # representable / tolerably rounded in bf16.
-    bf16 = jnp.bfloat16
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(bf16), tokens.astype(bf16))
-    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"].astype(bf16)))
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(bf16))
+    act = cfg.act_dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(act), tokens.astype(act))
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"].astype(act)))
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(act))
     y = jnp.einsum(
-        "tec,ecd->td", combine.astype(bf16), expert_out,
+        "tec,ecd->td", combine.astype(act), expert_out,
         preferred_element_type=jnp.float32,
     )
 

@@ -73,9 +73,27 @@ def pipelined_backbone(
     M = num_microbatches
     if B % M:
         raise ValueError(f"batch {B} does not split into {M} microbatches")
+    if pp_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no {pp_axis!r} axis for the pipeline"
+        )
+    if dp_axis:
+        # Validate up front in the same style as the shape checks above —
+        # a violation otherwise surfaces as an opaque shard_map/GSPMD
+        # sharding error deep inside XLA.
+        if dp_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh {dict(mesh.shape)} has no {dp_axis!r} axis; pass "
+                f"dp_axis=None to run without data parallelism"
+            )
+        if (B // M) % mesh.shape[dp_axis]:
+            raise ValueError(
+                f"microbatch size {B // M} does not split over the "
+                f"{dp_axis!r} axis of size {mesh.shape[dp_axis]}"
+            )
     num_stages = mesh.shape[pp_axis]
 
-    x = embed_tokens(params, tokens)
+    x = embed_tokens(params, tokens, cfg)
     xs = x.reshape(M, B // M, S, -1)
 
     stage_layers = split_layers(params["layers"], num_stages)
@@ -86,11 +104,19 @@ def pipelined_backbone(
     micro_spec = P(None, dp_axis) if dp_axis else P()
     layers_spec = jax.tree.map(lambda _: P(pp_axis), stage_layers)
 
+    # Manual axes: only the pipeline schedule (pp) and the microbatch
+    # split (dp) are hand-scheduled.  Every OTHER mesh axis (tp carrying
+    # the Megatron/expert sharding) stays GSPMD-automatic INSIDE the stage
+    # body — XLA partitions the per-stage einsums over tp and inserts the
+    # ICI collectives, composing 3D dp×pp×tp (+ep on tp) in one program.
+    manual = frozenset({pp_axis} | ({dp_axis} if dp_axis else set()))
+
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(layers_spec, micro_spec),
         out_specs=(micro_spec, P()),
+        axis_names=manual,
         check_vma=False,
     )
     def run(layers, xs):
@@ -138,8 +164,17 @@ def pipelined_backbone(
             tick, (buf, ys, aux_acc), jnp.arange(M + npp - 1)
         )
         # Only the last stage holds real outputs; masked psum replicates
-        # them across the pp axis (and anchors the transpose rule).
-        ys = jax.lax.psum(jnp.where(stage == npp - 1, ys, 0), pp_axis)
+        # them across the pp axis (and anchors the transpose rule).  The
+        # psum runs in f32 when the mesh has GSPMD-auto axes: XLA's CPU
+        # AllReducePromotion pass aborts on the bf16 all-reduce it emits
+        # for partial-manual collectives (crash in CloneAllReduce), and on
+        # TPU the one-per-step f32 gather is noise.
+        if len(manual) < len(mesh.shape):
+            ys = jax.lax.psum(
+                jnp.where(stage == npp - 1, ys, 0).astype(jnp.float32), pp_axis
+            ).astype(ys.dtype)
+        else:
+            ys = jax.lax.psum(jnp.where(stage == npp - 1, ys, 0), pp_axis)
         # Every stage contributed M per-microbatch means of its own layer
         # chunk: the psum over stages followed by / (npp * M) is the mean
         # over all (layer, microbatch) pairs — matching the dense path's
