@@ -1,0 +1,187 @@
+"""Round-3 perf sweep on the real chip.  Each experiment runs in its OWN
+subprocess: a failed remote compile (HTTP 500 = compile-time HBM OOM) leaks
+device memory in the owning process, poisoning every later experiment, so
+isolation is correctness here, not hygiene.
+
+Run: python hack/sweep_r3.py [tag ...]       (default: all)
+     python hack/sweep_r3.py --one <tag>     (internal: run one experiment)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import BENCH_BATCH, BENCH_MODEL, PEAK_BF16_TFLOPS, _time_train_step  # noqa: E402
+
+
+def model_flops(cfg, n_params, tokens):
+    return tokens * (6 * n_params + 12 * cfg.n_layers * cfg.max_seq * cfg.d_model)
+
+
+def measure(cfg, batch, iters=10):
+    import jax
+
+    n_params, dt, compile_s = _time_train_step(cfg, batch, iters)
+    kind = jax.devices()[0].device_kind.lower()
+    peak = next(p for k, p in PEAK_BF16_TFLOPS if k in kind)
+    tokens = batch * (cfg.max_seq - 1)
+    flops = model_flops(cfg, n_params, tokens)
+    return {
+        "batch": batch,
+        "step_ms": round(dt * 1000, 1),
+        "mfu_pct": round(flops / dt / (peak * 1e12) * 100.0, 2),
+        "tokens_per_s": round(tokens / dt),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def _decomp(which):
+    """One decomposition leg per process (a shared process OOMs: three
+    resident compiled programs + undonated states exceed HBM)."""
+    import jax
+
+    from tpudra.workload import model as m
+
+    cfg = m.ModelConfig(**{**BENCH_MODEL, "attention": "splash"})
+    params = m.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (BENCH_BATCH, cfg.max_seq), 0, cfg.vocab
+    )
+    if which == "fwd":
+        fn = jax.jit(lambda p, t: m.loss_fn(p, t, cfg))
+        scalar = lambda r: r  # noqa: E731
+        args = (params, tokens)
+    elif which == "fwdbwd":
+        # Grads must be OUTPUTS or XLA DCEs the whole backward (observed:
+        # [0]-indexing made fwdbwd time == fwd time exactly).
+        fn = jax.jit(lambda p, t: jax.value_and_grad(m.loss_fn)(p, t, cfg))
+        scalar = lambda r: r[0]  # noqa: E731
+        args = (params, tokens)
+    else:
+        init_opt, train_step = m.make_train_step(cfg)
+        opt_state = init_opt(params)
+        fn = jax.jit(train_step, donate_argnums=(0, 1))
+        scalar = lambda r: r[2]  # noqa: E731
+        args = (params, opt_state, tokens)
+
+    r = fn(*args)
+    if which == "full":
+        # donated: thread the state
+        params, opt_state, _ = r
+        float(scalar(r))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, opt_state, loss = fn(params, opt_state, args[2])
+        float(loss)
+    else:
+        float(scalar(r))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            r = fn(*args)
+        float(scalar(r))
+    return {"ms": round((time.perf_counter() - t0) / 10 * 1000, 1)}
+
+
+def _cfg_exp(tag, batch=BENCH_BATCH, iters=10, **kw):
+    def run():
+        from tpudra.workload import model as m
+
+        cfg = m.ModelConfig(**{**BENCH_MODEL, "attention": "splash", **kw})
+        return measure(cfg, batch, iters)
+
+    return run
+
+
+def _remat_policy_exp(policy_name, batch=BENCH_BATCH):
+    """Flagship step with an alternative jax.checkpoint policy grafted in."""
+    import jax
+    from functools import partial as _partial
+
+    from tpudra.workload import model as m
+
+    policy = getattr(jax.checkpoint_policies, policy_name)
+    orig = m.remat_layer_body
+
+    def patched(cfg):
+        return jax.checkpoint(_partial(m._layer, cfg), policy=policy)
+
+    m.remat_layer_body = patched
+    try:
+        cfg = m.ModelConfig(**{**BENCH_MODEL, "attention": "splash"})
+        return measure(cfg, batch, iters=10)
+    finally:
+        m.remat_layer_body = orig
+
+
+def exp_cache():
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/tpudra-jaxcache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from tpudra.workload import model as m
+
+    cfg = m.ModelConfig(**dict(BENCH_MODEL, attention="splash"))
+    cold = measure(cfg, BENCH_BATCH, iters=3)
+    jax.clear_caches()
+    warm = measure(cfg, BENCH_BATCH, iters=3)
+    return {"cold_compile_s": cold["compile_s"], "warm_compile_s": warm["compile_s"]}
+
+
+EXPERIMENTS = {
+    "decomp-fwd": lambda: _decomp("fwd"),
+    "decomp-fwdbwd": lambda: _decomp("fwdbwd"),
+    "decomp-full": lambda: _decomp("full"),
+    "remat-none-b16": _cfg_exp("remat-none-b16", remat="none"),
+    "remat-none-b8": _cfg_exp("remat-none-b8", batch=8, remat="none"),
+    "remat-full-b16": _cfg_exp("remat-full-b16", remat="full"),
+    "attention-naive": _cfg_exp("attention-naive", attention="naive"),
+    "remat-dotsbatch-b16": lambda: _remat_policy_exp("checkpoint_dots"),
+    "remat-dotsbatch-b12": lambda: _remat_policy_exp("checkpoint_dots", batch=12),
+    "cache": exp_cache,
+    "base": _cfg_exp("base"),
+}
+
+
+def main():
+    args = sys.argv[1:]
+    if args and args[0] == "--one":
+        tag = args[1]
+        try:
+            print(json.dumps({"tag": tag, **EXPERIMENTS[tag]()}), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(
+                json.dumps({"tag": tag, "error": f"{type(e).__name__}: {e}"[:250]}),
+                flush=True,
+            )
+        return
+
+    tags = args or list(EXPERIMENTS)
+    for tag in tags:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", tag],
+            capture_output=True, text=True, timeout=1200,
+        )
+        lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+        if lines:
+            print(lines[-1], flush=True)
+        else:
+            tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+            print(
+                json.dumps({"tag": tag, "error": " | ".join(tail)[:250]}),
+                flush=True,
+            )
+        print(
+            json.dumps({"tag": f"{tag}-wall", "s": round(time.time() - t0, 1)}),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
