@@ -1,0 +1,57 @@
+"""Regenerate the committed golden chart renders (tests/helm_goldens/).
+
+Run after any intentional chart change:  python hack/regen_helm_goldens.py
+tests/test_helm.py::TestGoldens asserts the live render matches these
+byte-for-byte.  On a machine with real helm, cross-check helmlite itself:
+
+    helm template tpudra deployments/helm/tpu-dra-driver [-f values-custom.yaml]
+
+and diff against the same goldens (object-level: the goldens are canonical
+sorted-key YAML of every rendered document, one file per template).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from helmlite import Chart  # noqa: E402
+
+GOLDEN_DIR = os.path.join(REPO, "tests", "helm_goldens")
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-dra-driver")
+
+
+def canonical(docs: list[dict]) -> str:
+    return "\n---\n".join(
+        yaml.safe_dump(d, sort_keys=True, default_flow_style=False) for d in docs
+    )
+
+
+def write_set(name: str, values: dict | None) -> None:
+    outdir = os.path.join(GOLDEN_DIR, name)
+    os.makedirs(outdir, exist_ok=True)
+    for f in os.listdir(outdir):
+        if f.endswith(".yaml"):
+            os.unlink(os.path.join(outdir, f))
+    rendered = Chart(CHART).render(values)
+    for template, docs in sorted(rendered.items()):
+        if not docs:
+            continue
+        with open(os.path.join(outdir, template), "w") as fh:
+            fh.write(canonical(docs) + "\n")
+    print(f"{name}: {sum(len(d) for d in rendered.values())} docs")
+
+
+def custom_values() -> dict:
+    with open(os.path.join(GOLDEN_DIR, "values-custom.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+if __name__ == "__main__":
+    write_set("default", None)
+    write_set("custom", custom_values())

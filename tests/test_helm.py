@@ -261,3 +261,95 @@ class TestRendererStrictness:
         r = Renderer(Context(values={}), {})
         with pytest.raises(TemplateError):
             r.render("{{ mystery .Values }}")
+
+
+class TestOperationalKnobs:
+    """updateStrategy / priorityClassName / podAnnotations / per-component
+    scheduling (reference kubeletplugin.yaml:28-44 analog)."""
+
+    def test_defaults(self, chart):
+        rendered = chart.render()
+        ds = by_kind(rendered, "DaemonSet")[0]
+        assert ds["spec"]["updateStrategy"] == {"type": "RollingUpdate"}
+        pod = ds["spec"]["template"]["spec"]
+        assert pod["priorityClassName"] == "system-node-critical"
+        ctrl = [
+            d for d in by_kind(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-controller")
+        ][0]
+        assert (
+            ctrl["spec"]["template"]["spec"]["priorityClassName"]
+            == "system-cluster-critical"
+        )
+
+    def test_custom_values_flow_through(self, chart):
+        import yaml as _yaml
+
+        with open(os.path.join(GOLDEN_DIR, "values-custom.yaml")) as f:
+            values = _yaml.safe_load(f)
+        rendered = chart.render(values)
+        ds = by_kind(rendered, "DaemonSet")[0]
+        assert ds["spec"]["updateStrategy"]["rollingUpdate"] == {"maxUnavailable": 2}
+        tpl = ds["spec"]["template"]
+        assert tpl["metadata"]["annotations"] == {"example.com/scrape": "true"}
+        assert tpl["spec"]["priorityClassName"] == "my-node-critical"
+        # helm deep-merges map values: the default TPU selector stays.
+        assert tpl["spec"]["nodeSelector"] == {
+            "google.com/tpu": "true", "pool": "tpu",
+        }
+        ctrl = [
+            d for d in by_kind(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-controller")
+        ][0]
+        cspec = ctrl["spec"]["template"]["spec"]
+        assert cspec["nodeSelector"] == {"node-role.kubernetes.io/control-plane": ""}
+        assert cspec["tolerations"][0]["key"] == "node-role.kubernetes.io/control-plane"
+        wh = [
+            d for d in by_kind(rendered, "Deployment")
+            if d["metadata"]["name"].endswith("-webhook")
+        ][0]
+        assert wh["spec"]["template"]["spec"]["priorityClassName"] == "my-cluster-critical"
+
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "helm_goldens"
+)
+
+
+class TestGoldens:
+    """Golden cross-validation (VERDICT r2 #6): the committed renders pin
+    helmlite's output for the default and a knob-exercising values set.
+    Regenerate after intentional chart changes with
+    `python hack/regen_helm_goldens.py`; on a machine with real helm,
+    `helm template` against the same values cross-checks helmlite itself
+    (the goldens are canonical sorted-key YAML, object-comparable)."""
+
+    @pytest.mark.parametrize("name", ["default", "custom"])
+    def test_render_matches_goldens(self, chart, name):
+        import yaml as _yaml
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "hack"
+        ))
+        from regen_helm_goldens import canonical
+
+        values = None
+        if name == "custom":
+            with open(os.path.join(GOLDEN_DIR, "values-custom.yaml")) as f:
+                values = _yaml.safe_load(f)
+        rendered = chart.render(values)
+        golden_dir = os.path.join(GOLDEN_DIR, name)
+        golden_files = {f for f in os.listdir(golden_dir) if f.endswith(".yaml")}
+        rendered_files = {t for t, docs in rendered.items() if docs}
+        assert rendered_files == golden_files, (
+            "template set changed; regenerate goldens "
+            "(python hack/regen_helm_goldens.py)"
+        )
+        for template in sorted(rendered_files):
+            with open(os.path.join(golden_dir, template)) as f:
+                want = f.read()
+            got = canonical(rendered[template]) + "\n"
+            assert got == want, (
+                f"{name}/{template} drifted from its golden — if the chart "
+                "change is intentional, run python hack/regen_helm_goldens.py"
+            )
