@@ -467,3 +467,40 @@ def test_overlap_chip_vs_partition_and_vfio(tmp_path):
     h.state.prepare(mk_claim("u3", ["tpu-1-part-1c.4hbm-0-0"]))
     with pytest.raises(PermanentError, match="overlaps"):
         h.state.prepare(mk_claim("u4", ["tpu-1"], name="z"))
+
+
+def test_vfio_per_device_mutex_registry(tmp_path):
+    """Reference mutex.go:23 analog: one lazily-created lock per PCI
+    address — same device serializes, different devices don't contend."""
+    import threading
+    import time as _time
+
+    from tpudra.plugin.vfio import PerDeviceMutex, VfioManager, per_device_lock
+
+    reg = PerDeviceMutex()
+    a1, a2, b = reg.get("0000:00:01.0"), reg.get("0000:00:01.0"), reg.get("0000:00:02.0")
+    assert a1 is a2 and a1 is not b
+
+    # Concurrent configure of the SAME function serializes: the second
+    # thread must observe the first one's completed rebind (idempotent
+    # early-return), never interleave the sysfs writes.
+    fg.feature_gates().set_from_spec("PassthroughSupport=true")
+    lib = MockDeviceLib(config=MockTopologyConfig(generation="v5p"))
+    chips = lib.enumerate_chips()
+    mk_sysfs(tmp_path, chips)
+    mgr = VfioManager(sysfs_root=str(tmp_path / "sys"), dev_root=str(tmp_path / "dev"))
+    chip = chips[0]
+
+    held = per_device_lock.get(chip.pci_address)
+    held.acquire()
+    done = threading.Event()
+    t = threading.Thread(target=lambda: (mgr.configure(chip), done.set()), daemon=True)
+    t.start()
+    _time.sleep(0.1)
+    assert not done.is_set(), "configure proceeded while device mutex held"
+    held.release()
+    assert done.wait(5)
+    # The rebind sequence ran to completion once unblocked.
+    with open(tmp_path / "sys/bus/pci/devices" / chip.pci_address / "driver_override") as f:
+        assert f.read().strip() == "vfio-pci"
+    mgr.unconfigure(chip)

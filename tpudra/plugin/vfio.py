@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
+
 from tpudra.devicelib import TpuChip
 from tpudra.plugin.cdi import ContainerEdits
 
@@ -22,6 +24,29 @@ TPU_DRIVER = "tpu"  # the in-kernel accel driver name
 
 class VfioError(Exception):
     pass
+
+
+class PerDeviceMutex:
+    """Lazily-created mutex per PCI address (reference mutex.go:23
+    PerGPUMutex): the sysfs unbind/override/bind dance below is a
+    multi-write sequence with no kernel-side atomicity, so two in-process
+    paths touching the SAME function (a prepare racing the health
+    monitor's enumeration refresh, or unprepare racing a retried prepare)
+    must serialize — while operations on different devices proceed
+    concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._submutex: dict[str, threading.Lock] = {}
+
+    def get(self, device: str) -> threading.Lock:
+        with self._lock:
+            if device not in self._submutex:
+                self._submutex[device] = threading.Lock()
+            return self._submutex[device]
+
+
+per_device_lock = PerDeviceMutex()
 
 
 class VfioManager:
@@ -76,35 +101,38 @@ class VfioManager:
 
     def configure(self, chip: TpuChip) -> str:
         """Rebind to vfio-pci; returns the iommu group
-        (reference Configure, vfio-device.go:176)."""
-        dev_dir = self._device_dir(chip.pci_address)
-        if not os.path.isdir(dev_dir):
-            raise VfioError(f"PCI device {chip.pci_address} not found")
-        current = self.current_driver(chip)
-        if current == VFIO_PCI:
-            return self.iommu_group(chip)  # idempotent
-        self._write(os.path.join(dev_dir, "driver_override"), VFIO_PCI)
-        if current is not None:
-            self._write(
-                os.path.join(self._driver_dir(current), "unbind"), chip.pci_address
-            )
-        self._write(os.path.join(self._driver_dir(VFIO_PCI), "bind"), chip.pci_address)
-        logger.info("bound %s to vfio-pci", chip.pci_address)
-        return self.iommu_group(chip)
+        (reference Configure, vfio-device.go:176-178 — incl. taking the
+        device's mutex around the rebind sequence)."""
+        with per_device_lock.get(chip.pci_address):
+            dev_dir = self._device_dir(chip.pci_address)
+            if not os.path.isdir(dev_dir):
+                raise VfioError(f"PCI device {chip.pci_address} not found")
+            current = self.current_driver(chip)
+            if current == VFIO_PCI:
+                return self.iommu_group(chip)  # idempotent
+            self._write(os.path.join(dev_dir, "driver_override"), VFIO_PCI)
+            if current is not None:
+                self._write(
+                    os.path.join(self._driver_dir(current), "unbind"), chip.pci_address
+                )
+            self._write(os.path.join(self._driver_dir(VFIO_PCI), "bind"), chip.pci_address)
+            logger.info("bound %s to vfio-pci", chip.pci_address)
+            return self.iommu_group(chip)
 
     def unconfigure(self, chip: TpuChip) -> None:
         """Return the function to the TPU driver
-        (reference Unconfigure, vfio-device.go:207)."""
-        dev_dir = self._device_dir(chip.pci_address)
-        if not os.path.isdir(dev_dir):
-            return
-        current = self.current_driver(chip)
-        self._write(os.path.join(dev_dir, "driver_override"), "\n")
-        if current == VFIO_PCI:
-            self._write(os.path.join(self._driver_dir(VFIO_PCI), "unbind"), chip.pci_address)
-        if os.path.isdir(self._driver_dir(TPU_DRIVER)):
-            self._write(os.path.join(self._driver_dir(TPU_DRIVER), "bind"), chip.pci_address)
-        logger.info("returned %s to the %s driver", chip.pci_address, TPU_DRIVER)
+        (reference Unconfigure, vfio-device.go:207-209)."""
+        with per_device_lock.get(chip.pci_address):
+            dev_dir = self._device_dir(chip.pci_address)
+            if not os.path.isdir(dev_dir):
+                return
+            current = self.current_driver(chip)
+            self._write(os.path.join(dev_dir, "driver_override"), "\n")
+            if current == VFIO_PCI:
+                self._write(os.path.join(self._driver_dir(VFIO_PCI), "unbind"), chip.pci_address)
+            if os.path.isdir(self._driver_dir(TPU_DRIVER)):
+                self._write(os.path.join(self._driver_dir(TPU_DRIVER), "bind"), chip.pci_address)
+            logger.info("returned %s to the %s driver", chip.pci_address, TPU_DRIVER)
 
     def get_cdi_edits(self, chip: TpuChip, iommu_group: str) -> ContainerEdits:
         """Inject the VFIO group + control nodes
