@@ -326,7 +326,7 @@ def bench_long_context(seq: int, batch: int) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
-def bench_ab(remat: str = None, attention: str = None) -> dict:
+def bench_ab(remat: str = None, attention: str = None, ce_impl: str = None) -> dict:
     """A/B leg at the flagship config: one knob changed from the tuned
     default, so every tuning claim in model.py's docstring is backed by a
     driver-captured artifact (remat=dots / splash attention are the
@@ -342,11 +342,14 @@ def bench_ab(remat: str = None, attention: str = None) -> dict:
         kw = dict(BENCH_MODEL, attention=attention or "splash")
         if remat:
             kw["remat"] = remat
+        if ce_impl:
+            kw["ce_impl"] = ce_impl
         cfg = m.ModelConfig(**kw)
         n_params, dt, _ = _time_train_step(cfg, BENCH_BATCH, iters=5)
         return {
             "remat": cfg.remat,
             "attention": cfg.attention,
+            "ce_impl": cfg.ce_impl,
             **_model_metrics(
                 cfg, BENCH_BATCH, n_params, dt, jax.devices()[0].device_kind
             ),
@@ -521,6 +524,7 @@ SECTIONS = {
     "moe": bench_moe,
     "ab_remat_full": lambda: bench_ab(remat="full"),
     "ab_naive": lambda: bench_ab(attention="naive"),
+    "ab_ce_fused": lambda: bench_ab(ce_impl="fused"),
     "native": bench_native_corroboration,
 }
 
@@ -573,6 +577,7 @@ def main(argv=None) -> None:
         "ab": {
             "remat_full": _run_section("ab_remat_full"),
             "attention_naive": _run_section("ab_naive"),
+            "ce_fused": _run_section("ab_ce_fused"),
         },
         "collectives": bench_collectives(),
         "dynamic_partition": partition,

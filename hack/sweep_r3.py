@@ -143,6 +143,19 @@ EXPERIMENTS = {
     "attention-naive": _cfg_exp("attention-naive", attention="naive"),
     "remat-dotsbatch-b16": lambda: _remat_policy_exp("checkpoint_dots"),
     "remat-dotsbatch-b12": lambda: _remat_policy_exp("checkpoint_dots", batch=12),
+    "ce-fused-b16": _cfg_exp("ce-fused-b16", ce_impl="fused"),
+    "ce-fused-b24": _cfg_exp("ce-fused-b24", batch=24, ce_impl="fused"),
+    "ce-fused-b32": _cfg_exp("ce-fused-b32", batch=32, ce_impl="fused"),
+    "ce-fused-none-b16": _cfg_exp("ce-fused-none-b16", ce_impl="fused", remat="none"),
+    "long16k-fused-b2": _cfg_exp(
+        "long16k-fused-b2", batch=2, iters=5, max_seq=16384, ce_impl="fused"
+    ),
+    "long16k-fused-b1": _cfg_exp(
+        "long16k-fused-b1", batch=1, iters=5, max_seq=16384, ce_impl="fused"
+    ),
+    "long16k-chunked-b2": _cfg_exp(
+        "long16k-chunked-b2", batch=2, iters=5, max_seq=16384
+    ),
     "cache": exp_cache,
     "base": _cfg_exp("base"),
 }

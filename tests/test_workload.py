@@ -708,3 +708,89 @@ class TestMultiProcessClient:
         env = ClaimEnv.from_environ({})
         with env.attach_multiprocess() as limits:
             assert limits is None
+
+
+class TestFusedCEHead:
+    """ce_kernel.py: the pallas online-softmax CE head must match the
+    chunked head (same math, no logits in HBM) in loss AND grads."""
+
+    def _cfgs(self):
+        from tpudra.workload import model as m
+
+        kw = dict(vocab=128, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_seq=32)
+        return (
+            m.ModelConfig(**kw, ce_impl="chunked"),
+            m.ModelConfig(**kw, ce_impl="fused"),
+        )
+
+    def test_loss_and_grads_match_chunked(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpudra.workload import model as m
+
+        chunked, fused = self._cfgs()
+        params = m.init_params(jax.random.PRNGKey(0), chunked)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (3, 32), 0, chunked.vocab)
+        l_c, g_c = jax.value_and_grad(m.loss_fn)(params, tokens, chunked)
+        l_f, g_f = jax.value_and_grad(m.loss_fn)(params, tokens, fused)
+        assert abs(float(l_c) - float(l_f)) < 2e-3, (float(l_c), float(l_f))
+        diffs = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), g_c, g_f
+        )
+        assert max(jax.tree.leaves(diffs)) < 5e-3, diffs
+
+    def test_nondividing_token_count_pads(self):
+        """N = B*(S-1) is rarely block-aligned; pad rows must not leak
+        into the mean."""
+        import jax
+
+        from tpudra.workload.ce_kernel import fused_ce_mean
+        import jax.numpy as jnp
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (13, 32), jnp.float32)
+        emb = jax.random.normal(jax.random.PRNGKey(1), (64, 32), jnp.float32)
+        tgt = jax.random.randint(jax.random.PRNGKey(2), (13,), 0, 64)
+        logits = x @ emb.T
+        want = float(jnp.mean(
+            jax.scipy.special.logsumexp(logits, axis=-1)
+            - logits[jnp.arange(13), tgt]
+        ))
+        got = float(fused_ce_mean(x, emb, tgt.astype(jnp.int32), interpret=True))
+        assert abs(want - got) < 1e-4
+
+    def test_bad_impl_rejected(self):
+        import pytest as _pytest
+
+        from tpudra.workload import model as m
+
+        with _pytest.raises(ValueError, match="ce_impl"):
+            m.ModelConfig(ce_impl="magic")
+
+    def test_no_silent_truncation_on_odd_sizes(self):
+        """Vocab sizes that are 128-aligned but not block-aligned, and row
+        counts past one block, must compute the FULL softmax (a flooring
+        grid would silently skip the tail)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpudra.workload.ce_kernel import fused_ce_mean
+
+        for N, V in [(600, 1664), (13, 64), (520, 384)]:
+            x = jax.random.normal(jax.random.PRNGKey(0), (N, 32), jnp.float32)
+            emb = jax.random.normal(jax.random.PRNGKey(1), (V, 32), jnp.float32)
+            tgt = jax.random.randint(jax.random.PRNGKey(2), (N,), 0, V).astype(jnp.int32)
+            logits = x @ emb.T
+            want = float(jnp.mean(
+                jax.scipy.special.logsumexp(logits, axis=-1)
+                - logits[jnp.arange(N), tgt]
+            ))
+            got = float(fused_ce_mean(x, emb, tgt, interpret=True))
+            assert abs(want - got) < 1e-3, (N, V, want, got)
+            # Grads too: the backward's chunk picker must cover every row.
+            gw = jax.grad(lambda a: jnp.mean(
+                jax.scipy.special.logsumexp(a @ emb.T, axis=-1)
+                - (a @ emb.T)[jnp.arange(N), tgt]
+            ))(x)
+            gg = jax.grad(lambda a: fused_ce_mean(a, emb, tgt, interpret=True))(x)
+            assert float(jnp.max(jnp.abs(gw - gg))) < 1e-3, (N, V)

@@ -1,0 +1,227 @@
+"""Fused cross-entropy head: a pallas online-softmax kernel.
+
+The chunked CE head (model.ce_head) never materializes the full [N, V]
+log-softmax, but its backward keeps the stacked per-chunk f32 logits as
+residuals — ~2 GB at the flagship config — and the logsumexp runs as
+separate HBM passes over them.  This kernel computes, in one pass over
+vocab blocks on the MXU, each token's ``logsumexp(x @ E^T)`` and its
+target logit WITHOUT ever writing logits to HBM (the classic
+flash-attention-style online max/sum recurrence, applied to the LM head).
+
+Backward recomputes block logits from (x, E, lse) — the custom_vjp costs
+one extra logits matmul (8·N·D·V total FLOPs vs the chunked path's 6) in
+exchange for dropping the 2 GB residual and its traffic; whether that
+trades profitably is measured, not assumed (bench.py extras.ab.ce_fused —
+adopted as default only if it wins on hardware).
+
+Shapes: x [N, D] (activation dtype), emb [V, D], targets [N] int32.
+N is padded to the row-block size internally; V and D must already be
+multiples of 128 (true for every config in this repo: vocab 32768,
+d_model ≥ 1024).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax  # module-level: custom_vjp decorates at import time
+
+BLOCK_N = 512
+BLOCK_V = 512  # bv=1024 with double-buffered [bv, D] blocks exceeds the
+# 16 MB scoped-VMEM budget at D=2048 (compiles to a catastrophic spill)
+
+
+def _fwd_kernel(x_ref, emb_ref, tgt_ref, lse_ref, tlog_ref, m_scr, s_scr, t_scr):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    bv = emb_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        s_scr[:] = jnp.zeros(s_scr.shape, jnp.float32)
+        t_scr[:] = jnp.zeros(t_scr.shape, jnp.float32)
+
+    # [bn, D] x [bv, D]^T on the MXU, f32 accumulation.
+    logits = jax.lax.dot_general(
+        x_ref[:], emb_ref[:],
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_prev = m_scr[:]
+    m_new = jnp.maximum(m_prev, jnp.max(logits, axis=1, keepdims=True))
+    s_scr[:] = s_scr[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(logits - m_new), axis=1, keepdims=True
+    )
+    m_scr[:] = m_new
+
+    # Target logit: pick it out of this block when the target falls here.
+    local = tgt_ref[:] - j * bv  # [bn, 1] int32
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    picked = jnp.sum(
+        jnp.where(col == local, logits, 0.0), axis=1, keepdims=True
+    )
+    t_scr[:] = t_scr[:] + jnp.where(
+        (local >= 0) & (local < bv), picked, 0.0
+    )
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _emit():
+        lse_ref[:] = m_scr[:] + jnp.log(s_scr[:])
+        tlog_ref[:] = t_scr[:]
+
+
+def _pick_block(total: int, pref: int, align: int) -> int:
+    """Largest align-multiple block <= pref that divides total — a grid of
+    total // block floors, so a non-dividing block would silently SKIP the
+    tail (wrong loss, wrong grads, no error)."""
+    for b in range(min(pref, total), 0, -align):
+        if total % b == 0:
+            return b
+    raise ValueError(
+        f"no {align}-aligned block divides {total} (pad the dimension to a "
+        f"multiple of {align} first)"
+    )
+
+
+def _fwd_pallas(x, emb, targets2d, interpret=False):
+    """x [Np, D], emb [V, D], targets2d [Np, 1] → (lse [Np,1], tgt [Np,1]).
+    Np must be 8-aligned (fused_ce_mean pads); V must be 128-aligned."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Np, D = x.shape
+    V = emb.shape[0]
+    bn = _pick_block(Np, BLOCK_N, 8)
+    bv = _pick_block(V, BLOCK_V, 128) if V >= 128 else V
+    grid = (Np // bn, V // bv)
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bn, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((bv, D), lambda i, j: (j, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Np, 1), jnp.float32),
+            jax.ShapeDtypeStruct((Np, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bn, 1), jnp.float32),
+            pltpu.VMEM((bn, 1), jnp.float32),
+            pltpu.VMEM((bn, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, emb, targets2d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_ce_sum(x, emb, targets, n_valid: int, interpret: bool = False):
+    """Sum over the first ``n_valid`` rows of ``logsumexp - target_logit``.
+
+    x [Np, D] activation-dtype, emb [V, D], targets [Np] int32 (pad rows'
+    targets are ignored).  Callers divide by token count for the mean and
+    must pass an 8-aligned Np (fused_ce_mean pads; a direct caller with an
+    odd row count gets a ValueError from the block picker, never a
+    silently truncated sum).
+    """
+    loss, _ = _fused_fwd(x, emb, targets, n_valid, interpret)
+    return loss
+
+
+def _fused_fwd(x, emb, targets, n_valid, interpret):
+    import jax.numpy as jnp
+
+    lse, tlog = _fwd_pallas(x, emb.astype(x.dtype), targets[:, None], interpret)
+    valid = (jnp.arange(x.shape[0]) < n_valid)[:, None]
+    loss = jnp.sum(jnp.where(valid, lse - tlog, 0.0))
+    return loss, (x, emb, targets, lse)
+
+
+BWD_CHUNK = 4096
+
+
+def _fused_bwd(n_valid, interpret, res, g):
+    """Recompute block logits; d_logits = g·(softmax − onehot) on valid
+    rows.  Chunked over row blocks inside a scan: the softmax
+    intermediate exists only at [chunk, V] (0.5 GB f32 at the flagship
+    config vs 2.1 GB unchunked — the unchunked form OOMs the whole train
+    step at compile time), with dEmb accumulated across chunks in f32."""
+    import jax.numpy as jnp
+
+    x, emb, targets, lse = res
+    e_act = emb.astype(x.dtype)
+    Np, D = x.shape
+    V = emb.shape[0]
+    # Largest 8-aligned chunk dividing Np (Np arrives 8-aligned from the
+    # forward): a naive "fall back to unchunked on odd sizes" would build
+    # the very multi-GB softmax this chunking exists to avoid.
+    bn = _pick_block(Np, BWD_CHUNK, 8) if Np % 8 == 0 else Np
+    n_chunks = Np // bn
+    vocab_iota = jnp.arange(V, dtype=targets.dtype)[None, :]
+    row_iota = jnp.arange(Np)
+
+    def chunk_grads(xc, tc, lsec, validc):
+        logits = jnp.einsum(
+            "nd,vd->nv", xc, e_act, preferred_element_type=jnp.float32
+        )
+        p = jnp.exp(logits - lsec)
+        # onehot via a fused iota-compare (an explicit one_hot would
+        # materialize the whole [chunk, V] f32 mask separately).
+        d = (
+            jnp.where(validc, p - (vocab_iota == tc[:, None]), 0.0) * g
+        ).astype(xc.dtype)
+        dxc = jnp.einsum("nv,vd->nd", d, e_act).astype(xc.dtype)
+        dembc = jnp.einsum("nv,nd->vd", d, xc, preferred_element_type=jnp.float32)
+        return dxc, dembc
+
+    if n_chunks == 1:
+        valid = (row_iota < n_valid)[:, None]
+        dx, demb = chunk_grads(x, targets, lse, valid)
+        return dx, demb.astype(emb.dtype), None
+
+    xs = x.reshape(n_chunks, bn, D)
+    ts = targets.reshape(n_chunks, bn)
+    ls = lse.reshape(n_chunks, bn, 1)
+    vs = (row_iota < n_valid).reshape(n_chunks, bn)[..., None]
+
+    def step(demb_acc, inp):
+        xc, tc, lsec, validc = inp
+        dxc, dembc = chunk_grads(xc, tc, lsec, validc)
+        return demb_acc + dembc, dxc
+
+    demb, dxs = jax.lax.scan(
+        step, jnp.zeros((V, D), jnp.float32), (xs, ts, ls, vs)
+    )
+    return dxs.reshape(Np, D), demb.astype(emb.dtype), None
+
+
+fused_ce_sum.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_ce_mean(x2d, emb, targets1d, interpret: bool = False):
+    """Mean next-token CE over x2d [N, D] / targets1d [N] — pads N up to
+    the row block and masks the pad rows out of the sum."""
+    import jax.numpy as jnp
+
+    N, D = x2d.shape
+    # Row block: the tuned size for real workloads; small (test) inputs
+    # round up to a sublane-aligned single block.
+    bn = BLOCK_N if N >= BLOCK_N else -(-N // 8) * 8
+    Np = -(-N // bn) * bn
+    if Np != N:
+        x2d = jnp.pad(x2d, ((0, Np - N), (0, 0)))
+        targets1d = jnp.pad(targets1d, (0, Np - N))
+    return fused_ce_sum(x2d, emb, targets1d, N, interpret) / N

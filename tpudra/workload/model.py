@@ -64,6 +64,14 @@ class ModelConfig:
     # v5e (128 and full-width are both slower).  Short sequences fall into
     # the tail path automatically.
     ce_chunk: int = 512
+    # CE head implementation: "chunked" (scan over sequence chunks, logits
+    # kept as bwd residuals) or "fused" (pallas online-softmax over vocab
+    # blocks, no logits in HBM, recompute backward — ce_kernel.py).
+    # Measured on v5e (bench.py extras.ab.ce_fused): fused loses ~2 MFU
+    # pts at the flagship config and is par at batch 24 / seq 16384 — the
+    # recompute FLOPs outweigh the freed residual on this chip, so
+    # chunked stays the default; fused is for memory-constrained configs.
+    ce_impl: str = "chunked"
     # Attention core: "auto" | "naive" | "flash"/"splash".  Measured on
     # v5e (472M params; artifacts in BENCH_r{N}.json extras.ab): the
     # pallas splash kernel with 1024-wide blocks and its fused backward
@@ -114,6 +122,8 @@ class ModelConfig:
             raise ValueError(
                 f"compute_dtype must be bf16|f32, got {self.compute_dtype!r}"
             )
+        if self.ce_impl not in ("chunked", "fused"):
+            raise ValueError(f"ce_impl must be chunked|fused, got {self.ce_impl!r}")
         for name in ("attn_block_q", "attn_block_kv"):
             blk = getattr(self, name)
             if blk and (blk % 128 or self.max_seq % blk):
@@ -384,6 +394,16 @@ def ce_head(params, x, tokens, cfg: ModelConfig):
     emb = params["embed"].astype(cfg.act_dtype)
     xs, targets = x[:, :-1], tokens[:, 1:]
     B, Sm1, D = xs.shape
+
+    if cfg.ce_impl == "fused":
+        from tpudra.workload.ce_kernel import fused_ce_mean
+
+        return fused_ce_mean(
+            xs.reshape(B * Sm1, D),
+            params["embed"],
+            targets.reshape(-1).astype(jnp.int32),
+            interpret=jax.default_backend() != "tpu",
+        )
 
     def ce_sum(xc, tc):
         logits = jnp.einsum(
