@@ -108,8 +108,10 @@ def _remat_policy_exp(policy_name, batch=BENCH_BATCH):
     policy = getattr(jax.checkpoint_policies, policy_name)
     orig = m.remat_layer_body
 
-    def patched(cfg):
-        return jax.checkpoint(_partial(m._layer, cfg), policy=policy)
+    def patched(cfg, attn_fn=None):
+        return jax.checkpoint(
+            _partial(m._layer, cfg, attn_fn=attn_fn), policy=policy
+        )
 
     m.remat_layer_body = patched
     try:

@@ -794,3 +794,59 @@ class TestFusedCEHead:
             ))(x)
             gg = jax.grad(lambda a: fused_ce_mean(a, emb, tgt, interpret=True))(x)
             assert float(jnp.max(jnp.abs(gw - gg))) < 1e-3, (N, V)
+
+
+class TestRingModelComposition:
+    """ringattention.ring_loss_fn: the flagship loss with a
+    sequence-parallel ring attention core (sp manual, everything else
+    GSPMD) must match the dense model."""
+
+    def test_loss_and_grads_match_dense(self):
+        import numpy as np
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from tpudra.workload import model as m
+        from tpudra.workload.ringattention import ring_loss_fn
+
+        cfg = m.ModelConfig(
+            vocab=64, d_model=32, n_heads=2, n_layers=2, d_ff=64, max_seq=16,
+            attention="naive", compute_dtype="f32",
+        )
+        params = m.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab)
+        dense, dense_grads = jax.value_and_grad(m.loss_fn)(params, tokens, cfg)
+
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4, 1), ("dp", "sp", "tp"))
+        sharded = m.shard_params(params, mesh, cfg)
+        tok = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+        ring, ring_grads = jax.jit(
+            jax.value_and_grad(lambda p, t: ring_loss_fn(p, t, cfg, mesh))
+        )(sharded, tok)
+        assert abs(float(dense) - float(ring)) < 1e-3, (float(dense), float(ring))
+        diffs = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), dense_grads, ring_grads
+        )
+        assert max(jax.tree.leaves(diffs)) < 5e-3, diffs
+
+    def test_mesh_validation(self):
+        import numpy as np
+
+        import jax
+        import pytest as _pytest
+        from jax.sharding import Mesh
+
+        from tpudra.workload import model as m
+        from tpudra.workload.ringattention import ring_loss_fn
+
+        cfg = m.ModelConfig(vocab=64, d_model=32, n_heads=2, n_layers=1, d_ff=64, max_seq=16)
+        params = m.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        no_sp = Mesh(np.array(jax.devices()[:2]), ("dp",))
+        with _pytest.raises(ValueError, match="no 'sp' axis"):
+            ring_loss_fn(params, tokens, cfg, no_sp)
+        mesh = Mesh(np.array(jax.devices()[:3]), ("sp",))
+        with _pytest.raises(ValueError, match="does not shard"):
+            ring_loss_fn(params, tokens, cfg, mesh)

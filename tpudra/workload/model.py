@@ -227,12 +227,17 @@ def _rmsnorm(x, scale):
     return (x32 * inv * scale).astype(x.dtype)
 
 
-def _layer(cfg: ModelConfig, x, layer_params):
+def _layer(cfg: ModelConfig, x, layer_params, attn_fn=None):
     """One decoder block in bfloat16; x: [B, S, D].
 
     Projections are transpose-free: qkv lands directly in [3, B, H, S, hd]
     and the output projection contracts the [H, hd] pair, so the layer
     never pays HBM traffic for head-axis transposes (+3% MFU on v5e).
+
+    ``attn_fn`` overrides the attention core: (q, k, v) each [B, H, S, hd]
+    → [B, H, S, hd].  Ring attention plugs in here
+    (ringattention.ring_loss_fn) — sequence-parallel attention composed
+    with the otherwise-GSPMD model.
     """
     import jax
     import jax.numpy as jnp
@@ -247,7 +252,9 @@ def _layer(cfg: ModelConfig, x, layer_params):
     wqkv = p["wqkv"].astype(cfg.act_dtype).reshape(D, H, 3, hd)
     qkv = jnp.einsum("bsd,dhte->tbhse", h, wqkv)
     q, k, v = qkv[0], qkv[1], qkv[2]
-    if cfg.use_flash_attention(S):
+    if attn_fn is not None:
+        attn = attn_fn(q, k, v).astype(cfg.act_dtype)
+    elif cfg.use_flash_attention(S):
         # Pallas splash kernel (flash-attention family, fused backward):
         # never materializes the [B,H,S,S] scores — faster than the fused
         # naive chain at every runnable length and the only path past the
@@ -311,7 +318,7 @@ def embed_tokens(params, tokens, cfg: ModelConfig):
     return x + params["pos"][:S].astype(cfg.act_dtype)[None]
 
 
-def remat_layer_body(cfg: ModelConfig):
+def remat_layer_body(cfg: ModelConfig, attn_fn=None):
     """The per-layer body with cfg.remat applied — the single place both
     the dense scan and the pipeline stages get their (possibly
     checkpointed) layer function.
@@ -324,7 +331,7 @@ def remat_layer_body(cfg: ModelConfig):
     """
     import jax
 
-    layer_body = partial(_layer, cfg)
+    layer_body = partial(_layer, cfg, attn_fn=attn_fn)
     if cfg.remat == "dots":
         return jax.checkpoint(
             layer_body,
@@ -335,7 +342,7 @@ def remat_layer_body(cfg: ModelConfig):
     return layer_body
 
 
-def backbone_and_aux(params, tokens, cfg: ModelConfig):
+def backbone_and_aux(params, tokens, cfg: ModelConfig, attn_fn=None):
     """tokens [B, S] int32 → (hidden states [B, S, D] bf16, mean per-layer
     MoE aux loss — zero for dense models)."""
     import jax
@@ -343,7 +350,7 @@ def backbone_and_aux(params, tokens, cfg: ModelConfig):
 
     x = embed_tokens(params, tokens, cfg)
     # The layer body's (carry, aux) return is exactly scan's contract.
-    x, auxs = jax.lax.scan(remat_layer_body(cfg), x, params["layers"])
+    x, auxs = jax.lax.scan(remat_layer_body(cfg, attn_fn), x, params["layers"])
     return _rmsnorm(x, params["ln_f"]), jnp.mean(auxs)
 
 
@@ -367,7 +374,7 @@ def forward(params, tokens, cfg: ModelConfig):
     )
 
 
-def loss_fn(params, tokens, cfg: ModelConfig):
+def loss_fn(params, tokens, cfg: ModelConfig, attn_fn=None):
     """Next-token NLL over tokens [B, S].
 
     The whole sequence goes through the backbone (power-of-two S keeps every
@@ -378,7 +385,7 @@ def loss_fn(params, tokens, cfg: ModelConfig):
     residuals (a ``jax.checkpoint`` here would bound that to one chunk,
     measured 2% MFU slower — deliberately not taken).
     """
-    x, aux = backbone_and_aux(params, tokens, cfg)
+    x, aux = backbone_and_aux(params, tokens, cfg, attn_fn)
     loss = ce_head(params, x, tokens, cfg)
     if cfg.num_experts:
         loss = loss + cfg.moe_aux_weight * aux

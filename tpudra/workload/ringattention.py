@@ -28,15 +28,26 @@ from __future__ import annotations
 from functools import partial
 
 
-def ring_self_attention(q, k, v, axis_name: str, causal: bool = True):
+def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
+                        layout: str = "bshd"):
     """Blockwise-ring causal attention; call INSIDE shard_map with q/k/v
-    holding this device's sequence block [B, s_block, H, D]."""
+    holding this device's sequence block — ``layout`` "bshd"
+    ([B, s_block, H, D], the standalone-kernel convention) or "bhsd"
+    ([B, H, s_block, D], the model layer's native head-major layout, which
+    avoids any transpose at the shard_map boundary)."""
     import jax.numpy as jnp
     from jax import lax
 
+    if layout == "bshd":
+        B, s, H, D = q.shape
+        qk_eq, pv_eq = "bqhd,bkhd->bhqk", "bhqk,bkhd->bhqd"
+    elif layout == "bhsd":
+        B, H, s, D = q.shape
+        qk_eq, pv_eq = "bhqd,bhkd->bhqk", "bhqk,bhkd->bhqd"
+    else:
+        raise ValueError(f"layout must be bshd|bhsd, got {layout!r}")
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
-    B, s, H, D = q.shape
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, step_idx):
@@ -47,7 +58,7 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True):
         k_off = j * s
 
         scale = D ** -0.5
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        scores = jnp.einsum(qk_eq, q, k_blk).astype(jnp.float32) * scale
         if causal:
             q_pos = q_off + jnp.arange(s)[:, None]
             k_pos = k_off + jnp.arange(s)[None, :]
@@ -61,7 +72,7 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True):
         blk_shift = jnp.where(jnp.isneginf(m_new)[..., None], -jnp.inf, scores - m_new[..., None])
         p = jnp.exp(blk_shift)  # [B,H,sq,sk]
         acc = acc * jnp.exp(shift)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+            pv_eq, p, v_blk.astype(jnp.float32)
         )
         l = l * jnp.exp(shift) + jnp.sum(p, axis=-1)
         m = m_new
@@ -81,24 +92,49 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True):
     )
     del k_f, v_f
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B,H,sq,D]
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,sq,H,D]
+    if layout == "bshd":
+        out = out.transpose(0, 2, 1, 3)  # [B,sq,H,D]
+    return out.astype(q.dtype)
 
 
-def make_sharded_ring_attention(mesh, axis_name: str = "sp", causal: bool = True):
-    """Jitted [B, S, H, D] ring attention with S sharded over ``axis_name``;
+def make_sharded_ring_attention(
+    mesh,
+    axis_name: str = "sp",
+    causal: bool = True,
+    layout: str = "bshd",
+    manual_only: bool = False,
+    jit: bool = True,
+):
+    """Ring attention with the sequence dim sharded over ``axis_name``;
     batch stays replicated across the other axes (compose with dp by
-    sharding B in the caller's specs)."""
+    sharding B in the caller's specs).  ``manual_only`` leaves every mesh
+    axis except ``axis_name`` GSPMD-automatic (the model-composition mode:
+    dp/tp partitioning continues through the manual region); ``jit=False``
+    returns the bare shard_map for embedding inside a larger program."""
     import jax
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, axis_name, None, None)
+    seq_dim = 1 if layout == "bshd" else 2
+    spec = P(*(axis_name if d == seq_dim else None for d in range(4)))
 
-    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **(
+            {"axis_names": frozenset({axis_name}), "check_vma": False}
+            if manual_only
+            else {}
+        ),
+    )
     def fn(q, k, v):
-        return ring_self_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ring_self_attention(
+            q, k, v, axis_name=axis_name, causal=causal, layout=layout
+        )
 
-    return jax.jit(fn)
+    return jax.jit(fn) if jit else fn
 
 
 def dense_reference(q, k, v, causal: bool = True):
@@ -115,3 +151,32 @@ def dense_reference(q, k, v, causal: bool = True):
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bhqd", probs, v.astype(jnp.float32))
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ring_loss_fn(params, tokens, cfg, mesh, sp_axis: str = "sp"):
+    """The flagship loss with the attention core replaced by ring
+    attention over ``sp_axis`` — the sequence-parallel composition: every
+    projection/FFN/CE einsum stays GSPMD-partitioned over the mesh's
+    other axes, while inside each layer the attention runs the manual
+    ring schedule (only ``sp_axis`` is a manual shard_map axis; dp/tp
+    remain automatic, mirroring pipeline.py's partial-manual pattern).
+
+    Per-device sequence memory is S/n for k/v — the model-level form of
+    this module's standalone kernel, so a grant whose sequence outgrows
+    one chip's HBM still trains (SURVEY §5 long-context).
+    """
+    if sp_axis not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no {sp_axis!r} axis")
+    if tokens.shape[1] % mesh.shape[sp_axis]:
+        raise ValueError(
+            f"sequence {tokens.shape[1]} does not shard over {sp_axis!r} "
+            f"of size {mesh.shape[sp_axis]}"
+        )
+    from tpudra.workload import model as m
+
+    # _layer hands attention in its native head-major [B, H, S, hd]; the
+    # bhsd kernel layout keeps the shard_map boundary transpose-free.
+    attn_fn = make_sharded_ring_attention(
+        mesh, axis_name=sp_axis, layout="bhsd", manual_only=True, jit=False
+    )
+    return m.loss_fn(params, tokens, cfg, attn_fn=attn_fn)
