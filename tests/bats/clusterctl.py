@@ -241,11 +241,20 @@ def cmd_up(args) -> int:
             if doc and doc.get("kind") == "DeviceClass":
                 kube.create(gvr.DEVICE_CLASSES, doc)
 
-    # Fabric identity for --cd: one slice spanning all nodes.
-    peer_ports = free_ports(args.nodes)
-    status_ports = free_ports(args.nodes)
-    health_ports = free_ports(args.nodes)
+    # Fabric identity for --cd: one slice spanning all nodes.  ONE batch:
+    # free_ports holds every socket until all are read, so ports within a
+    # batch cannot collide — separate batches could hand out duplicates.
+    batch = free_ports(args.nodes * 3 + 2)
+    peer_ports = batch[: args.nodes]
+    status_ports = batch[args.nodes : args.nodes * 2]
+    health_ports = batch[args.nodes * 2 : args.nodes * 3]
     port_map = ",".join(f"{i}={p}" for i, p in enumerate(peer_ports))
+    # Coordinator proxy: all "hosts" share this machine, so only node 0's
+    # daemon binds a proxy port (the others would EADDRINUSE each other);
+    # exported as TPUDRA_COORD_PROXY_PORT for tests that dial it, plus a
+    # scratch port from the same batch for tests that need a second
+    # guaranteed-distinct endpoint (the collective test's host-0 bind).
+    coord_proxy_port, scratch_port = batch[args.nodes * 3 :]
 
     sim_nodes = []
     for i, n in enumerate(nodes):
@@ -336,6 +345,7 @@ def cmd_up(args) -> int:
                 "TPUDRA_PEER_PORT_MAP": port_map,
                 "HOSTS_PATH": hosts,
                 "WORK_DIR": os.path.join(nd, "cdwork"),
+                "COORDINATOR_PORT": str(coord_proxy_port if i == 0 else 0),
             },
         })
 
@@ -409,6 +419,8 @@ def cmd_up(args) -> int:
             f'export TPUDRA_STATE="{state}"\n'
             f'export TPUDRA_NAMESPACE="{NAMESPACE}"\n'
             f'export TPUDRA_NODES="{" ".join(nodes)}"\n'
+            f'export TPUDRA_COORD_PROXY_PORT="{coord_proxy_port}"\n'
+            f'export TPUDRA_SCRATCH_PORT="{scratch_port}"\n'
             f'export TPUDRA_HEALTH_PORTS="'
             f'{" ".join(f"{n}={p}" for n, p in zip(nodes, health_ports))}"\n'
             f'export PYTHONPATH="{env["PYTHONPATH"]}"\n'

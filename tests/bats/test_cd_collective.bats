@@ -9,10 +9,10 @@ load helpers.sh
 
 setup_file() {
   cluster_up --nodes 2 --cd
-  # TOCTOU note: the port is released here and rebound by worker-0's jax
-  # coordinator once the domain forms; bats files run serially, so the
-  # window is effectively private to this file.
-  COORD_PORT=$(python3 -c "import socket; s=socket.socket(); s.bind(('127.0.0.1',0)); print(s.getsockname()[1]); s.close()")
+  # Host-0's coordinator bind port: from clusterctl's single free-port
+  # batch, so it cannot collide with the daemon's proxy port (a separate
+  # ephemeral pick here could land on the same number).
+  COORD_PORT="$TPUDRA_SCRATCH_PORT"
   export COORD_PORT
 }
 
@@ -21,6 +21,11 @@ teardown_file() {
 }
 
 @test "two pods psum across the domain via DCN rendezvous" {
+  # Worker 0 (host 0) binds + registers COORD_PORT; worker 1 dials the
+  # node-0 daemon's REAL coordinator proxy (TPUDRA_COORD_PROXY_PORT from
+  # clusterctl), which forwards to the registered endpoint — the whole
+  # production relay, minus only the DNS name (both "hosts" are this
+  # machine, so the stable name is swapped for loopback).
   cat > "$TPUDRA_STATE/coll.yaml" <<EOF
 apiVersion: v1
 kind: Namespace
@@ -40,6 +45,14 @@ spec:
     allocationMode: Single
 EOF
   for n in 0 1; do
+    if [ "$n" = 0 ]; then
+      # Host 0 parses this port, binds it locally, and registers it in
+      # the mounted domain dir (TPUDRA_CD_DIR).
+      SIM_COORD="127.0.0.1:$COORD_PORT"
+    else
+      # Peers go THROUGH the daemon's proxy.
+      SIM_COORD="127.0.0.1:$TPUDRA_COORD_PROXY_PORT"
+    fi
     cat >> "$TPUDRA_STATE/coll.yaml" <<EOF
 ---
 apiVersion: v1
@@ -55,15 +68,11 @@ spec:
     - name: ctr
       image: tpudra-workload:latest
       env:
-        # Sim-only override: both "hosts" are one machine here, so host 0
-        # and the daemon's coordinator proxy would contend for one port —
-        # the grant's stable-DNS coordinator is swapped for loopback.  On
-        # a real cluster this var is absent: host 0 binds its own pod IP
-        # and registers it in TPUDRA_CD_DIR, and the index-0 daemon's
-        # proxy forwards the stable name to it (the full path is covered
-        # hermetically by tests/test_coordproxy.py).
+        # Sim-only override of the ADDRESS only (the stable DNS name does
+        # not resolve on one machine); the relay itself is real — worker 1
+        # reaches worker 0 through the node-0 daemon's coordinator proxy.
         - name: TPUDRA_SIM_COORDINATOR
-          value: "127.0.0.1:$COORD_PORT"
+          value: "$SIM_COORD"
       command: ["python", "-c"]
       args:
         - |
@@ -103,6 +112,13 @@ EOF
   [[ "$output" == *"RESULT psum: 12.0 host 0"* ]]
   run kubectl logs worker-1 -n coll
   [[ "$output" == *"RESULT psum: 12.0 host 1"* ]]
+  # The relay was real: node-0's daemon served its coordinator proxy on
+  # the port worker 1 dialed, and host 0 registered its live endpoint in
+  # the shared domain dir.
+  daemon0=$(kubectl get pods -n "$TPUDRA_NAMESPACE" -o name | grep -- computedomain-daemon | grep -- -node-0 | head -1)
+  run kubectl logs "${daemon0#pods/}" -n "$TPUDRA_NAMESPACE"
+  [[ "$output" == *"coordinator proxy on :$TPUDRA_COORD_PROXY_PORT"* ]]
+  ls "$TPUDRA_STATE"/node-0/cdplugin/domains/*/coordinator
 }
 
 @test "teardown" {

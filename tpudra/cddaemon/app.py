@@ -95,9 +95,15 @@ class DaemonConfig:
             work_dir=env.get("WORK_DIR", "/var/run/tpudra-cd"),
             hosts_path=env.get("HOSTS_PATH", "/etc/hosts"),
             coordinator_port=_env_port(env, "COORDINATOR_PORT"),
-            coordinator_dir=env.get("COORDINATOR_DIR", "/etc/tpudra-cd"),
+            coordinator_dir=env.get("COORDINATOR_DIR", _default_cd_mount()),
             peer_port_map=_parse_port_map(env.get("TPUDRA_PEER_PORT_MAP", "")),
         )
+
+
+def _default_cd_mount() -> str:
+    from tpudra.cdplugin.computedomain import DAEMON_CD_MOUNT
+
+    return DAEMON_CD_MOUNT
 
 
 def _env_port(env: dict, key: str) -> int:

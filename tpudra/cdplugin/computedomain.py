@@ -24,6 +24,11 @@ from tpudra.kube.client import KubeAPI
 logger = logging.getLogger(__name__)
 
 DEFAULT_COORDINATOR_PORT = 7175
+# In-pod mount point of the per-domain host dir (daemon pods and the
+# coordinator-registration env both name it — one constant, because the
+# sim's env→host-path translation only works when the env value exactly
+# matches the mount's containerPath).
+DAEMON_CD_MOUNT = "/etc/tpudra-cd"
 
 
 class ComputeDomainManager:
@@ -116,6 +121,12 @@ class ComputeDomainManager:
             "TPUDRA_HOST_INDEX": str(host_index),
             # Stable rendezvous: the index-0 daemon's DNS name.
             "TPUDRA_COORDINATOR": f"{dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
+            # Where the coordinator proxy finds the host-0 workload's
+            # registration — the same dir this grant mounts.  Explicit
+            # (it equals the in-pod default) so environments that apply
+            # CDI mounts by env translation (the cluster sim) resolve it
+            # to the real host path.
+            "COORDINATOR_DIR": DAEMON_CD_MOUNT,
         }
         with open(os.path.join(d, "daemon.env"), "w") as f:
             for k, v in sorted(env.items()):

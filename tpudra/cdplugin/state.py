@@ -417,8 +417,10 @@ class ComputeDomainDeviceState:
             )
             for r in results
         ]
+        from tpudra.cdplugin.computedomain import DAEMON_CD_MOUNT
+
         edits = ContainerEdits(
             env=[f"{k}={v}" for k, v in sorted(env.items())],
-            mounts=[(self._cdm.domain_dir(config.domain_id), "/etc/tpudra-cd")],
+            mounts=[(self._cdm.domain_dir(config.domain_id), DAEMON_CD_MOUNT)],
         )
         return devices, edits
