@@ -121,8 +121,55 @@ EOF
   ls "$TPUDRA_STATE"/node-0/cdplugin/domains/*/coordinator
 }
 
+@test "rendezvous survives a daemon restart" {
+  # Kill the node-0 daemon pod (the one serving the coordinator proxy).
+  # The DaemonSet recreates it; the replacement rejoins the clique,
+  # rebinds the proxy, and a FRESH worker pair must still rendezvous
+  # through it — the elastic-recovery path for the relay (the analog of
+  # the reference's daemon-failover assertions, test_cd_failover.bats).
+  old0=$(kubectl get pods -n "$TPUDRA_NAMESPACE" -o name | grep -- computedomain-daemon | grep -- -node-0 | head -1)
+  old0="${old0#pods/}"
+  old_uid=$(kubectl get pod "$old0" -n "$TPUDRA_NAMESPACE" -o jsonpath='{.metadata.uid}')
+  kubectl delete pod "$old0" -n "$TPUDRA_NAMESPACE"
+  # The replacement reuses the deterministic pod name — key on the UID.
+  daemon_replaced() {
+    local uid
+    uid=$(kubectl get pod "$old0" -n "$TPUDRA_NAMESPACE" -o jsonpath='{.metadata.uid}' 2>/dev/null)
+    [ -n "$uid" ] && [ "$uid" != "$old_uid" ]
+  }
+  wait_until 120 daemon_replaced
+  cd_ready() {
+    kubectl get computedomain coll -n coll -o jsonpath='{.status.status}' | grep -q Ready
+  }
+  wait_until 180 cd_ready
+
+  # Second worker pair, same ports: the old worker-0 is dead so its bind
+  # port is free, and its stale registration is overwritten on start.
+  # Only the POD docs are re-applied — re-PUTting the ComputeDomain doc
+  # would transiently strip the controller's finalizer (full-object
+  # update semantics) and race the teardown choreography.
+  python3 - "$TPUDRA_STATE/coll.yaml" > "$TPUDRA_STATE/coll2.yaml" <<'PYEOF'
+import sys, yaml
+docs = [d for d in yaml.safe_load_all(open(sys.argv[1])) if d and d["kind"] == "Pod"]
+for d in docs:
+    d["metadata"]["name"] = d["metadata"]["name"].replace("worker-", "worker2-")
+print(yaml.safe_dump_all(docs))
+PYEOF
+  kubectl apply -f "$TPUDRA_STATE/coll2.yaml"
+  wait_until 300 pod_succeeded worker2-0 coll
+  wait_until 300 pod_succeeded worker2-1 coll
+  run kubectl logs worker2-1 -n coll
+  [[ "$output" == *"RESULT psum: 12.0 host 1"* ]]
+  # The replacement daemon served the proxy: same deterministic pod name,
+  # but logs are per pod INSTANCE, so this reads the new pod's log only.
+  run kubectl logs "$old0" -n "$TPUDRA_NAMESPACE"
+  [[ "$output" == *"coordinator proxy on :$TPUDRA_COORD_PROXY_PORT"* ]]
+}
+
 @test "teardown" {
-  kubectl delete pod worker-0 worker-1 -n coll
+  # --ignore-not-found: a failure in the restart test before coll2.yaml
+  # applies must not cascade into a second (misattributed) failure here.
+  kubectl delete pod worker-0 worker-1 worker2-0 worker2-1 -n coll --ignore-not-found
   kubectl delete computedomains coll -n coll
   wait_until 120 sh -c "! kubectl get computedomains -n coll -o name | grep -q coll"
 }
