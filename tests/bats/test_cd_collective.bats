@@ -77,12 +77,21 @@ spec:
       args:
         - |
           import os
+          # The libtpu worker-bootstrap contract must be in the CONTAINER
+          # env (not just parseable): libtpu reads the real process env, so
+          # the CDI grant has to have injected every var before jax loads.
+          assert os.environ["TPU_WORKER_ID"] == os.environ["TPUDRA_HOST_INDEX"]
+          assert len(os.environ["TPU_WORKER_HOSTNAMES"].split(",")) == 2
+          assert os.environ["TPU_SKIP_MDS_QUERY"] == "true"
+          assert os.environ["TPU_HOST_BOUNDS"], "no host bounds injected"
+          assert os.environ["TPU_CHIPS_PER_HOST_BOUNDS"], "no chip bounds"
           import jax
           jax.config.update("jax_platforms", "cpu")
           from tpudra.workload.envspec import ClaimEnv
           env = ClaimEnv.from_environ()
           assert env.num_hosts == 2, env.num_hosts
           assert env.coordinator, "grant injected no coordinator"
+          assert env.apply_libtpu_env()["TPU_WORKER_ID"] == str(env.worker_id)
           env.coordinator = os.environ.get("TPUDRA_SIM_COORDINATOR") or env.coordinator
           env.initialize_distributed()
           assert jax.process_count() == 2

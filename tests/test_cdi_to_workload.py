@@ -150,6 +150,21 @@ class TestChannelClaimContract:
         assert claim_env.channel_ids == [3]
         assert claim_env.num_hosts == 2 and claim_env.host_index == 0
         assert any("channel3" in n for n in nodes)
+        # The libtpu worker-bootstrap contract rides the same grant: the
+        # vars libtpu itself reads to form the multi-host ICI mesh
+        # (cdplugin/libtpuenv.py) — jax.distributed rendezvous alone is
+        # not enough.  Mock slice: v5p, 2 hosts → mesh (2,2,2), host
+        # block (2,2,1), host grid (1,1,2).
+        assert env["TPU_WORKER_ID"] == "0"
+        assert env["TPU_WORKER_HOSTNAMES"] == (
+            "compute-domain-daemon-0000,compute-domain-daemon-0001"
+        )
+        assert env["TPU_SKIP_MDS_QUERY"] == "true"
+        assert env["TPU_HOST_BOUNDS"] == "1,1,2"
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        assert claim_env.libtpu_env() == {
+            k: v for k, v in env.items() if k.startswith("TPU_") and k != "TPU_VISIBLE_DEVICES"
+        }
 
 
 class TestMultiProcessContract:

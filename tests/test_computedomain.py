@@ -712,6 +712,12 @@ class TestBatsParityCD:
         assert len(nodes) == 1 and nodes[0]["path"].endswith("channel5")
         env = spec["containerEdits"]["env"]
         assert "TPUDRA_DOMAIN_CHANNELS=5" in env
+        # Channel grants carry the libtpu worker-bootstrap contract
+        # (cdplugin/libtpuenv.py) alongside the rendezvous env.
+        assert "TPU_WORKER_ID=0" in env
+        assert "TPU_SKIP_MDS_QUERY=true" in env
+        assert "TPU_HOST_BOUNDS=1,1,2" in env
+        assert "TPU_CHIPS_PER_HOST_BOUNDS=2,2,1" in env
 
     def test_channel_grant_carries_rendezvous_dir(self, tmp_path):
         """Channel grants mount the per-domain host dir and point
@@ -1330,10 +1336,16 @@ class TestFullLifecycle:
         assert f"CD_UID={uid}" in env
         assert any(e.startswith("TPUDRA_COORDINATOR=") for e in env)
         assert any(e.startswith("CLIQUE_ID=") for e in env)
+        # The daemon settings record the libtpu worker contract too, so
+        # operators can read the slice's mesh-formation env off the daemon.
+        assert "TPU_SKIP_MDS_QUERY=true" in env
+        assert any(e.startswith("TPU_WORKER_HOSTNAMES=") for e in env)
         mounts = spec["containerEdits"]["mounts"]
         assert mounts[0]["containerPath"] == "/etc/tpudra-cd"
         env_file = os.path.join(cddrv.cd_manager.domain_dir(uid), "daemon.env")
         assert os.path.exists(env_file)
+        with open(env_file) as f:
+            assert "TPU_WORKER_ID=" in f.read()
         cddrv.unprepare_resource_claims([{"uid": "dm-1"}])
         assert not os.path.exists(env_file)
 

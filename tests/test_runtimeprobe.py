@@ -87,6 +87,24 @@ class TestCorroborate:
         assert not out["consistent"]
         assert out["match"]["chip_count"] is False
 
+    def test_vacuous_probe_is_not_corroboration(self):
+        """A probe with nothing comparable (no kind, no devices, no coords,
+        no HBM) must read as unverified — consistent None with a zero
+        checked_count — never as a pass."""
+        out = corroborate(mk_chips(), mk_topo(), RuntimeProbe(platform="tpu"))
+        assert out["available"]
+        assert out["consistent"] is None
+        assert out["checked_count"] == 0
+
+    def test_checked_count_reflects_evidence(self):
+        chips = mk_chips()
+        probe = RuntimeProbe(
+            platform="tpu", device_kind="TPU v5 lite", num_devices=4,
+            coords=[list(c.coords) for c in chips],
+        )
+        out = corroborate(chips, mk_topo(), probe)
+        assert out["checked_count"] == 3  # generation, chip_count, coords
+
     def test_generation_mismatch(self):
         out = corroborate(
             mk_chips(generation="v5p"),

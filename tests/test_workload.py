@@ -47,6 +47,88 @@ class TestClaimEnv:
         assert env.visible_devices == []
         assert env.mesh_bounds == (0, 0, 0)
         assert env.num_hosts == 1
+        assert env.worker_id == -1
+        assert env.libtpu_env() == {}
+
+    def test_parse_and_apply_libtpu_contract(self, monkeypatch):
+        """The worker-bootstrap contract (cdplugin/libtpuenv.py) round-trips
+        through ClaimEnv, and apply_libtpu_env exports it for the libtpu
+        load that happens at first jax import."""
+        contract = {
+            "TPU_WORKER_ID": "1",
+            "TPU_WORKER_HOSTNAMES": (
+                "compute-domain-daemon-0000,compute-domain-daemon-0001"
+            ),
+            "TPU_SKIP_MDS_QUERY": "true",
+            "TPU_HOST_BOUNDS": "1,1,2",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+        }
+        env = ClaimEnv.from_environ(contract)
+        assert env.worker_id == 1
+        assert env.worker_hostnames == [
+            "compute-domain-daemon-0000",
+            "compute-domain-daemon-0001",
+        ]
+        assert env.skip_mds_query
+        assert env.host_bounds == "1,1,2"
+        assert env.chips_per_host_bounds == "2,2,1"
+        assert env.libtpu_env() == contract
+        for k in contract:
+            # setenv-then-delenv (not bare delenv): delenv on an absent key
+            # records nothing, so the apply below would LEAK real TPU_*
+            # vars into the process env and skew any later live-TPU probe.
+            monkeypatch.setenv(k, "placeholder")
+            monkeypatch.delenv(k)
+        applied = env.apply_libtpu_env()
+        assert applied == contract
+        for k, v in contract.items():
+            assert os.environ[k] == v
+
+    def test_garbled_worker_id_is_not_granted(self):
+        assert ClaimEnv.from_environ({"TPU_WORKER_ID": "--1"}).worker_id == -1
+        assert ClaimEnv.from_environ({"TPU_WORKER_ID": "abc"}).worker_id == -1
+        assert ClaimEnv.from_environ({"TPU_WORKER_ID": "-1"}).worker_id == -1
+
+    def test_host0_daemon_coordinator_without_cd_dir_raises(self):
+        """A daemon-proxied grant with the domain-dir env stripped must
+        fail loudly on host 0 (the silent alternative strands every peer
+        in jax's 300 s timeout); a direct-address coordinator needs no
+        registration and is exercised live by TestDistributedRendezvous."""
+        env = ClaimEnv.from_environ({
+            "TPUDRA_NUM_HOSTS": "2",
+            "TPUDRA_HOST_INDEX": "0",
+            "TPUDRA_COORDINATOR": "compute-domain-daemon-0000:7175",
+        })
+        with pytest.raises(RuntimeError, match="TPUDRA_CD_DIR"):
+            env.initialize_distributed()
+
+    def test_libtpu_worker_env_derivation(self):
+        """cdplugin/libtpuenv derives the host grid from the slice mesh and
+        the generation's per-host chip block."""
+        from tpudra.cdplugin import libtpuenv
+        from tpudra.devicelib.mock import MockDeviceLib
+        from tpudra.devicelib.topology import MockTopologyConfig
+
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(
+                generation="v5p", host_index=1, num_hosts=2
+            )
+        )
+        env = libtpuenv.worker_env(lib.slice_topology(), lib.enumerate_chips())
+        assert env == {
+            "TPU_WORKER_ID": "1",
+            "TPU_WORKER_HOSTNAMES": (
+                "compute-domain-daemon-0000,compute-domain-daemon-0001"
+            ),
+            "TPU_SKIP_MDS_QUERY": "true",
+            "TPU_HOST_BOUNDS": "1,1,2",
+            "TPU_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+        }
+        # Degraded node (no chips): worker identity survives, footprint
+        # vars are withheld rather than invented.
+        env = libtpuenv.worker_env(lib.slice_topology(), [])
+        assert env["TPU_WORKER_ID"] == "1"
+        assert "TPU_HOST_BOUNDS" not in env
 
     def test_factor_devices(self):
         assert factor_devices(8) == (2, 2, 2)

@@ -65,7 +65,11 @@ class DaemonConfig:
     # dial at this daemon's DNS name) and the per-domain host dir where the
     # host-0 workload registers its live coordinator endpoint (the same dir
     # the plugin mounts into this pod at /etc/tpudra-cd).  Port <= 0
-    # disables the proxy.
+    # disables the proxy.  NOTE the two construction paths differ on
+    # purpose: direct construction (tests, embedders) is opt-in (default
+    # 0), while ``from_environ`` — the production path, driven by the
+    # daemon-settings env — defaults an unset COORDINATOR_PORT to
+    # DEFAULT_COORDINATOR_PORT so deployed daemons always serve the proxy.
     coordinator_port: int = 0
     coordinator_dir: str = "/etc/tpudra-cd"
     daemon_argv: Optional[Sequence[str]] = None  # default: tpu-slicewatchd
@@ -109,9 +113,17 @@ def _default_cd_mount() -> str:
 def _env_port(env: dict, key: str) -> int:
     from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
 
+    raw = env.get(key, "")
     try:
-        return int(env.get(key, "") or DEFAULT_COORDINATOR_PORT)
+        return int(raw or DEFAULT_COORDINATOR_PORT)
     except ValueError:
+        # An explicitly-set-but-garbled port is an operator error; keep the
+        # proxy up on the default (a disabled proxy strands every worker in
+        # jax's 300 s timeout) but say so instead of silently substituting.
+        logger.warning(
+            "unparseable %s=%r; falling back to %d",
+            key, raw, DEFAULT_COORDINATOR_PORT,
+        )
         return DEFAULT_COORDINATOR_PORT
 
 

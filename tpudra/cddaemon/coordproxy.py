@@ -55,9 +55,13 @@ def read_registration(dir_path: str) -> Optional[tuple[str, int]]:
 
 
 def write_registration(dir_path: str, host: str, port: int) -> str:
-    """Atomically publish the live coordinator endpoint (workload side)."""
+    """Atomically publish the live coordinator endpoint (workload side).
+
+    The temp name is unique per writer: the domain dir is sticky-bit
+    shared (cdplugin/state.py), so a crashed previous workload's leftover
+    ``.tmp`` owned by another uid must not block this one's open."""
     path = os.path.join(dir_path, REGISTRATION_FILE)
-    tmp = path + ".tmp"
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         f.write(f"{host}:{port}\n")
     os.replace(tmp, path)

@@ -109,9 +109,19 @@ class ComputeDomainManager:
     def domain_dir(self, uid: str) -> str:
         return os.path.join(self._domains_dir, uid)
 
-    def prepare_daemon_settings(self, uid: str, clique_id: str, num_hosts: int, host_index: int) -> dict:
+    def prepare_daemon_settings(
+        self,
+        uid: str,
+        clique_id: str,
+        num_hosts: int,
+        host_index: int,
+        libtpu_env: Optional[dict] = None,
+    ) -> dict:
         """Create the config dir + env for the daemon claim
-        (ComputeDomainDaemonSettings, computedomain.go:62)."""
+        (ComputeDomainDaemonSettings, computedomain.go:62).  ``libtpu_env``
+        is the worker-bootstrap contract (cdplugin/libtpuenv.py) recorded in
+        the settings so operators can read the slice's mesh-formation env
+        off the daemon."""
         d = self.domain_dir(uid)
         os.makedirs(d, exist_ok=True)
         env = {
@@ -128,6 +138,7 @@ class ComputeDomainManager:
             # to the real host path.
             "COORDINATOR_DIR": DAEMON_CD_MOUNT,
         }
+        env.update(libtpu_env or {})
         with open(os.path.join(d, "daemon.env"), "w") as f:
             for k, v in sorted(env.items()):
                 f.write(f"{k}={v}\n")

@@ -1,0 +1,86 @@
+"""The libtpu worker-bootstrap env contract for multi-host slices.
+
+On a GKE-style TPU node (no TPU metadata server env), libtpu forms its ICI
+mesh from a small env contract — the same one the GKE TPU device plugin
+emits for multi-host podslices:
+
+- ``TPU_WORKER_ID``                this host's index within the slice
+- ``TPU_WORKER_HOSTNAMES``         all workers' hostnames, worker-id order
+- ``TPU_SKIP_MDS_QUERY=true``      don't ask the metadata server for topology
+- ``TPU_HOST_BOUNDS``              the host grid of the slice, "x,y,z"
+- ``TPU_CHIPS_PER_HOST_BOUNDS``    each host's chip block, "x,y,z"
+
+``jax.distributed.initialize`` (DCN rendezvous) is orthogonal: without this
+contract a multi-host claim would rendezvous at the JAX level and then fail
+to form the libtpu mesh.  This driver replaces the GKE TPU device plugin,
+so emitting the contract is its job — the analog of the reference injecting
+the IMEX channel device NCCL needs (compute-domain-kubelet-plugin/
+device_state.go:466-514): inject what the comm layer needs, with the grant.
+
+The worker hostnames are the per-domain daemon's stable DNS names
+(cddaemon/dnsnames.py): one daemon per slice host, host-networked, kept
+resolvable by the daemon's /etc/hosts machinery — so they name exactly the
+TPU hosts libtpu must reach, in clique-index order, and survive daemon pod
+churn the same way the slice-watch peer list does.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpudra.cddaemon.dnsnames import dns_name
+from tpudra.devicelib.base import TpuChip
+from tpudra.devicelib.topology import GENERATIONS, SliceTopology
+
+logger = logging.getLogger(__name__)
+
+
+def host_bounds(
+    topo: SliceTopology, chips: list[TpuChip]
+) -> tuple[str, str] | None:
+    """(TPU_HOST_BOUNDS, TPU_CHIPS_PER_HOST_BOUNDS) for this slice, or None
+    when the node exposes no chips to read a generation from (a degraded
+    node still gets worker identity, just no footprint).
+
+    The host grid is the slice mesh divided elementwise by the generation's
+    per-host chip block: v5p-16 = mesh (2,2,2) / host block (2,2,1) → hosts
+    (1,1,2).  A non-divisible mesh (never true of real slices) degrades to
+    stacking all hosts along z, with a warning.
+    """
+    if not chips:
+        return None
+    spec = GENERATIONS.get(chips[0].generation)
+    if spec is None:
+        return None
+    hb = spec.host_bounds
+    mesh = topo.mesh_shape
+    if all(m % b == 0 for m, b in zip(mesh, hb)) and (
+        (mesh[0] // hb[0]) * (mesh[1] // hb[1]) * (mesh[2] // hb[2])
+        == topo.num_hosts
+    ):
+        grid = (mesh[0] // hb[0], mesh[1] // hb[1], mesh[2] // hb[2])
+    else:
+        logger.warning(
+            "slice mesh %s is not a whole number of %s host blocks %s; "
+            "falling back to a 1x1x%d host grid",
+            mesh, spec.name, hb, topo.num_hosts,
+        )
+        grid = (1, 1, topo.num_hosts)
+    fmt = lambda t: ",".join(str(v) for v in t)  # noqa: E731
+    return fmt(grid), fmt(hb)
+
+
+def worker_env(topo: SliceTopology, chips: list[TpuChip]) -> dict[str, str]:
+    """The full contract for one host of the granted slice."""
+    env = {
+        "TPU_WORKER_ID": str(topo.host_index),
+        "TPU_WORKER_HOSTNAMES": ",".join(
+            dns_name(i) for i in range(topo.num_hosts)
+        ),
+        "TPU_SKIP_MDS_QUERY": "true",
+    }
+    bounds = host_bounds(topo, chips)
+    if bounds is not None:
+        env["TPU_HOST_BOUNDS"] = bounds[0]
+        env["TPU_CHIPS_PER_HOST_BOUNDS"] = bounds[1]
+    return env

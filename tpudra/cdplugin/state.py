@@ -350,6 +350,7 @@ class ComputeDomainDeviceState:
         )
         topo = self._lib.slice_topology()
         chips = self._lib.enumerate_chips()
+        from tpudra.cdplugin import libtpuenv
         from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
         from tpudra.cddaemon.dnsnames import dns_name
 
@@ -361,9 +362,12 @@ class ComputeDomainDeviceState:
         os.makedirs(domain_dir, exist_ok=True)
         # The host-0 workload writes its registration here and commonly
         # runs as non-root (securityContext runAsUser); the dir is created
-        # by the root plugin, so open it up — it carries one rendezvous
-        # address, not secrets.
-        os.chmod(domain_dir, 0o777)
+        # by the root plugin, so non-owners must be able to create files.
+        # Sticky bit: only the file's owner (or root) may replace/unlink a
+        # registration — without it any local pod could silently redirect
+        # the daemon proxy (and thus every worker's rendezvous) to an
+        # arbitrary endpoint by overwriting the host-0 registration.
+        os.chmod(domain_dir, 0o1777)
         cd_dir_mount = "/var/run/tpudra-cd"
         edits = ContainerEdits(
             env=[
@@ -380,6 +384,15 @@ class ComputeDomainDeviceState:
                 # TPUDRA_CD_DIR; the daemon proxies the stable name to it.
                 f"TPUDRA_COORDINATOR={dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
                 f"TPUDRA_CD_DIR={cd_dir_mount}",
+            ]
+            # The libtpu worker-bootstrap contract (TPU_WORKER_ID /
+            # TPU_WORKER_HOSTNAMES / TPU_SKIP_MDS_QUERY / host+chip bounds):
+            # jax.distributed rendezvous above is necessary but not
+            # sufficient — libtpu itself forms the ICI mesh from these
+            # (cdplugin/libtpuenv.py; GKE TPU device-plugin contract).
+            + [
+                f"{k}={v}"
+                for k, v in sorted(libtpuenv.worker_env(topo, chips).items())
             ],
             device_nodes=[
                 self._cdi.host_path(alloc.channel_dev_path(i)) for i in granted
@@ -403,8 +416,11 @@ class ComputeDomainDeviceState:
         # CLIQUE_ID handed to the daemon must agree with what the published
         # devices advertised (a degraded node must not join a clique).
         clique_id = alloc.resolve_clique_id(chips)
+        from tpudra.cdplugin import libtpuenv
+
         env = self._cdm.prepare_daemon_settings(
-            config.domain_id, clique_id, topo.num_hosts, topo.host_index
+            config.domain_id, clique_id, topo.num_hosts, topo.host_index,
+            libtpu_env=libtpuenv.worker_env(topo, chips),
         )
         devices = [
             PreparedDevice(

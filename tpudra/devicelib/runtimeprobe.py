@@ -162,7 +162,10 @@ def corroborate(chips: list, topo, probe: Optional[RuntimeProbe]) -> dict:
         not probe_coords
         or all(c in lib_coords for c in probe_coords)
     )
-    count_match = True if subset else len(chips) == probe.num_devices
+    if probe.num_devices <= 0:
+        count_match = None  # a probe that saw no devices compares nothing
+    else:
+        count_match = True if subset else len(chips) == probe.num_devices
     if probe_coords:
         coords_match = (
             all(c in lib_coords for c in probe_coords)
@@ -185,9 +188,20 @@ def corroborate(chips: list, topo, probe: Optional[RuntimeProbe]) -> dict:
         "coords": coords_match,
         "hbm": hbm_match,
     }
+    checked = sum(1 for v in comparisons.values() if v is not None)
     return {
         "available": True,
-        "consistent": all(v for v in comparisons.values() if v is not None),
+        # A probe with nothing comparable (no generation, no coords, no
+        # HBM figure) must read as "unverified", not "corroborated":
+        # consistent is None when zero comparisons actually ran, and
+        # checked_count lets artifact readers see how much evidence backs
+        # a True.
+        "consistent": (
+            all(v for v in comparisons.values() if v is not None)
+            if checked
+            else None
+        ),
+        "checked_count": checked,
         "runtime_sees_subset": subset,
         "match": comparisons,
         "lib": {

@@ -9,12 +9,18 @@ TPU container wiring is env-first: libtpu discovers chips from /dev/accel* and
 is *restricted* via env (no nvidia-cdi-hook binary needed, SURVEY.md §2 native
 boundary table):
 
+Chip claims (this plugin's chip_edits, :218) emit:
+
 - TPU_VISIBLE_DEVICES=<host-local chip indices>   restrict to granted chips
-- TPU_CHIPS_PER_HOST_BOUNDS / TPU_HOST_BOUNDS     host/slice footprint
 - TPUDRA_CHIP_COORDS=<x,y,z;...>                  ICI coords of granted chips
 - TPUDRA_CLIQUE_ID=<sliceUuid.partition>          fabric identity
-- TPU_WORKER_ID / TPU_WORKER_HOSTNAMES            multi-host rendezvous
-  (written by the ComputeDomain path)
+- TPUDRA_GENERATION=<v4|v5e|v5p|v6e>              generation for the workload
+
+ComputeDomain channel claims (cdplugin/state.py:_apply_channel_config) emit,
+on top of the rendezvous env, the libtpu worker-bootstrap contract —
+TPU_WORKER_ID, TPU_WORKER_HOSTNAMES, TPU_SKIP_MDS_QUERY, TPU_HOST_BOUNDS,
+TPU_CHIPS_PER_HOST_BOUNDS (cdplugin/libtpuenv.py) — which libtpu itself
+reads to form the multi-host ICI mesh.
 
 So a JAX process in the container sees exactly the granted chips in
 jax.devices(), with topology attributes for mesh construction.

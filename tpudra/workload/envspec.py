@@ -40,6 +40,14 @@ class ClaimEnv:
     # Multi-process sharing (MPS analog): the per-claim control daemon's
     # pipe directory, injected by the plugin's CDI edits.
     mp_pipe_dir: str = ""
+    # The libtpu worker-bootstrap contract (cdplugin/libtpuenv.py): the env
+    # libtpu itself reads to form the ICI mesh on a multi-host slice —
+    # orthogonal to the JAX-level rendezvous above.
+    worker_id: int = -1  # -1 = not granted (single-host / no CD)
+    worker_hostnames: list[str] = field(default_factory=list)
+    skip_mds_query: bool = False
+    host_bounds: str = ""  # "x,y,z" host grid of the slice
+    chips_per_host_bounds: str = ""  # "x,y,z" chip block per host
 
     @classmethod
     def from_environ(cls, env: Optional[dict] = None) -> "ClaimEnv":
@@ -67,6 +75,17 @@ class ClaimEnv:
         out.coordinator = env.get("TPUDRA_COORDINATOR", "")
         out.cd_dir = env.get("TPUDRA_CD_DIR", "")
         out.mp_pipe_dir = env.get("TPUDRA_MP_PIPE_DIRECTORY", "")
+        try:
+            out.worker_id = int(env.get("TPU_WORKER_ID", ""))
+        except ValueError:
+            out.worker_id = -1  # absent or garbled → "not granted"
+        hostnames = env.get("TPU_WORKER_HOSTNAMES", "")
+        out.worker_hostnames = [h for h in hostnames.split(",") if h]
+        out.skip_mds_query = env.get("TPU_SKIP_MDS_QUERY", "").lower() in (
+            "true", "1",
+        )
+        out.host_bounds = env.get("TPU_HOST_BOUNDS", "")
+        out.chips_per_host_bounds = env.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
         return out
 
     @property
@@ -81,6 +100,38 @@ class ClaimEnv:
             max(ys) - min(ys) + 1,
             max(zs) - min(zs) + 1,
         )
+
+    def libtpu_env(self) -> dict[str, str]:
+        """The worker-bootstrap env libtpu reads to form the ICI mesh
+        (cdplugin/libtpuenv.py docstring has the full contract).  Empty for
+        grants that never carried it (single-host chip claims)."""
+        out: dict[str, str] = {}
+        if self.worker_id >= 0:
+            out["TPU_WORKER_ID"] = str(self.worker_id)
+        if self.worker_hostnames:
+            out["TPU_WORKER_HOSTNAMES"] = ",".join(self.worker_hostnames)
+        if self.skip_mds_query:
+            out["TPU_SKIP_MDS_QUERY"] = "true"
+        if self.host_bounds:
+            out["TPU_HOST_BOUNDS"] = self.host_bounds
+        if self.chips_per_host_bounds:
+            out["TPU_CHIPS_PER_HOST_BOUNDS"] = self.chips_per_host_bounds
+        return out
+
+    def apply_libtpu_env(self) -> dict[str, str]:
+        """Materialize the contract into ``os.environ`` and return it.
+
+        Call BEFORE importing jax: libtpu is a C library that reads the
+        real process env at load time, so values parsed from anywhere else
+        (a constructed env dict, a settings file) must be exported before
+        the first jax import loads it.  In a CDI-wired container this is a
+        no-op re-export of what the runtime already injected; it exists for
+        processes that assemble their env by hand (launchers, tests, the
+        cluster sim's pod runtime).
+        """
+        env = self.libtpu_env()
+        os.environ.update(env)
+        return env
 
     def initialize_distributed(self) -> None:
         """Join the slice-wide runtime across hosts of a ComputeDomain.
@@ -130,6 +181,22 @@ class ClaimEnv:
                         f"{self.coordinator} will hang; check the domain "
                         f"dir mount and its permissions"
                     ) from e
+            elif _is_daemon_dns_name(self.coordinator):
+                # Peers will dial the daemon's proxy, which forwards to the
+                # registration this process has nowhere to write — the same
+                # outcome as a failed registration (every peer hangs for
+                # jax's full 300 s timeout), so fail the same way: loudly,
+                # with the diagnosis.  A direct-address coordinator (an IP
+                # or reachable hostname, e.g. hand-built launcher env)
+                # needs no registration and passes through.
+                raise RuntimeError(
+                    "host 0 has a daemon-proxied coordinator grant "
+                    f"({self.coordinator}) but no TPUDRA_CD_DIR to "
+                    "register its endpoint in — peers dialing the proxy "
+                    "would hang; this grant predates the domain-dir mount "
+                    "(re-prepare the claim with a current driver) or the "
+                    "env was stripped"
+                )
         jax.distributed.initialize(
             coordinator_address=address,
             num_processes=self.num_hosts,
@@ -168,6 +235,16 @@ class ClaimEnv:
                 query(self.mp_pipe_dir, f"DETACH {me}")
             except OSError:
                 pass  # daemon went away; nothing to release
+
+
+def _is_daemon_dns_name(coordinator: str) -> bool:
+    """True when the coordinator address names a compute-domain daemon's
+    stable DNS name (the proxy-relayed rendezvous path) rather than a
+    directly reachable host."""
+    from tpudra.cddaemon.dnsnames import DNS_NAME_FORMAT
+
+    prefix = DNS_NAME_FORMAT.split("%")[0]
+    return coordinator.partition(":")[0].startswith(prefix)
 
 
 def _local_ip() -> str:
