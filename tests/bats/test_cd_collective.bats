@@ -175,10 +175,57 @@ PYEOF
   [[ "$output" == *"coordinator proxy on :$TPUDRA_COORD_PROXY_PORT"* ]]
 }
 
+@test "stale host-0 registration is probed, dropped, and recovered from" {
+  # The worst staleness case: the host-0 WORKLOAD (not the daemon) died
+  # after registering — the registration points at a dead address and
+  # nothing will ever overwrite it if the replacement runs under another
+  # uid (the domain dir is sticky-bit shared).  The daemon's coordinator
+  # proxy must probe-and-drop it (coordproxy.py drop_after), turning the
+  # peer's connect timeouts into fast retries, then relay the replacement
+  # pair's rendezvous — all in well under jax's 300 s timeout.
+  reg=$(ls "$TPUDRA_STATE"/node-0/cdplugin/domains/*/coordinator)
+  echo "127.0.0.1:1" > "$reg"   # dead endpoint: connect refused instantly
+
+  # Peer first: its jax client dials the proxy, which burns 3 failed
+  # forwards to the dead endpoint and drops the registration.
+  python3 - "$TPUDRA_STATE/coll.yaml" worker3-1 > "$TPUDRA_STATE/coll3-peer.yaml" <<'PYEOF'
+import sys, yaml
+docs = [d for d in yaml.safe_load_all(open(sys.argv[1])) if d and d["kind"] == "Pod"]
+docs = [d for d in docs if d["metadata"]["name"] == sys.argv[2].replace("worker3-", "worker-")]
+for d in docs:
+    d["metadata"]["name"] = sys.argv[2]
+print(yaml.safe_dump_all(docs))
+PYEOF
+  kubectl apply -f "$TPUDRA_STATE/coll3-peer.yaml"
+  daemon_dropped_stale() {
+    local d
+    d=$(kubectl get pods -n "$TPUDRA_NAMESPACE" -o name | grep -- computedomain-daemon | grep -- -node-0 | head -1)
+    kubectl logs "${d#pods/}" -n "$TPUDRA_NAMESPACE" | grep -q "dropped stale coordinator registration"
+  }
+  wait_until 120 daemon_dropped_stale
+  [ ! -e "$reg" ]
+
+  # Replacement host 0: registers its live endpoint; the already-running
+  # peer's next retry is spliced through and both finish the psum.
+  python3 - "$TPUDRA_STATE/coll.yaml" worker3-0 > "$TPUDRA_STATE/coll3-h0.yaml" <<'PYEOF'
+import sys, yaml
+docs = [d for d in yaml.safe_load_all(open(sys.argv[1])) if d and d["kind"] == "Pod"]
+docs = [d for d in docs if d["metadata"]["name"] == sys.argv[2].replace("worker3-", "worker-")]
+for d in docs:
+    d["metadata"]["name"] = sys.argv[2]
+print(yaml.safe_dump_all(docs))
+PYEOF
+  kubectl apply -f "$TPUDRA_STATE/coll3-h0.yaml"
+  wait_until 300 pod_succeeded worker3-0 coll
+  wait_until 300 pod_succeeded worker3-1 coll
+  run kubectl logs worker3-1 -n coll
+  [[ "$output" == *"RESULT psum: 12.0 host 1"* ]]
+}
+
 @test "teardown" {
   # --ignore-not-found: a failure in the restart test before coll2.yaml
   # applies must not cascade into a second (misattributed) failure here.
-  kubectl delete pod worker-0 worker-1 worker2-0 worker2-1 -n coll --ignore-not-found
+  kubectl delete pod worker-0 worker-1 worker2-0 worker2-1 worker3-0 worker3-1 -n coll --ignore-not-found
   kubectl delete computedomains coll -n coll
   wait_until 120 sh -c "! kubectl get computedomains -n coll -o name | grep -q coll"
 }

@@ -36,7 +36,8 @@ class TestRegistration:
         write_registration(str(tmp_path), "10.0.0.1", 1)
         write_registration(str(tmp_path), "10.0.0.2", 2)
         assert read_registration(str(tmp_path)) == ("10.0.0.2", 2)
-        assert not (tmp_path / "coordinator.tmp").exists()
+        # No temp droppings (the per-writer unique .tmp.* names included).
+        assert os.listdir(tmp_path) == ["coordinator"]
 
 
 class TestProxy:
@@ -83,8 +84,198 @@ class TestProxy:
         try:
             with socket.create_connection(("127.0.0.1", proxy.bound_port), 5) as s:
                 assert s.recv(64) == b""
+            # One failure is NOT staleness — the registration survives.
+            assert read_registration(str(tmp_path)) is not None
         finally:
             proxy.stop()
+
+    def test_probe_and_drop_stale_registration_then_recover(self, tmp_path):
+        """Staleness recovery: after drop_after consecutive failed
+        upstream connects the proxy unlinks the registration (so a
+        replacement host-0 workload of any uid can take over and peers
+        stop burning connect attempts on a dead address); a fresh
+        registration then splices normally."""
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        write_registration(str(tmp_path), "127.0.0.1", dead_port)
+        # Grace/window zeroed: this test is about the drop mechanics, not
+        # the timing guards (covered by the grace tests below).
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=3,
+            min_fail_window=0, registration_grace=0,
+        )
+        proxy.start()
+        upstream = socket.socket()
+        try:
+            for _ in range(3):
+                with socket.create_connection(
+                    ("127.0.0.1", proxy.bound_port), 5
+                ) as s:
+                    assert s.recv(64) == b""
+            # The splice threads run async; wait for the drop.
+            deadline = 50
+            while read_registration(str(tmp_path)) and deadline:
+                threading.Event().wait(0.1)
+                deadline -= 1
+            assert read_registration(str(tmp_path)) is None
+            assert not (tmp_path / "coordinator").exists()
+
+            # Recovery: the replacement registers and is spliced through.
+            upstream.bind(("127.0.0.1", 0))
+            upstream.listen(1)
+            write_registration(
+                str(tmp_path), "127.0.0.1", upstream.getsockname()[1]
+            )
+
+            def echo_once():
+                conn, _ = upstream.accept()
+                conn.sendall(b"echo:" + conn.recv(1024))
+                conn.close()
+
+            t = threading.Thread(target=echo_once, daemon=True)
+            t.start()
+            with socket.create_connection(("127.0.0.1", proxy.bound_port), 5) as s:
+                s.sendall(b"hi")
+                assert s.recv(64) == b"echo:hi"
+            t.join(timeout=5)
+        finally:
+            proxy.stop()
+            upstream.close()
+
+    def test_success_resets_failure_streak(self, tmp_path):
+        """Two failures, a success, two more failures: never drops (the
+        counter is *consecutive* per endpoint)."""
+        upstream = socket.socket()
+        upstream.bind(("127.0.0.1", 0))
+        upstream.listen(4)
+        up_port = upstream.getsockname()[1]
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=3,
+            min_fail_window=0, registration_grace=0,
+        )
+        target = ("127.0.0.1", up_port)
+        proxy._note_connect_failure(target)
+        proxy._note_connect_failure(target)
+        proxy._note_connect_success(target)
+        proxy._note_connect_failure(target)
+        proxy._note_connect_failure(target)
+        assert proxy._fail_count == 2
+        # And a registration re-written between probes is never dropped:
+        # the drop inspects the renamed-aside file and restores anything
+        # that is not the probed endpoint's own.
+        write_registration(str(tmp_path), "127.0.0.1", up_port + 1)
+        proxy._note_connect_failure(target)  # third consecutive → drop path
+        assert read_registration(str(tmp_path)) == ("127.0.0.1", up_port + 1)
+        assert os.listdir(tmp_path) == ["coordinator"]  # no probe droppings
+        upstream.close()
+
+    def test_young_registration_is_never_dropped(self, tmp_path):
+        """A registration younger than registration_grace must survive any
+        number of failed probes: host 0 registers BEFORE
+        jax.distributed.initialize binds the listener, and it registers
+        exactly once — a drop in that startup window would kill the job."""
+        write_registration(str(tmp_path), "127.0.0.1", 1)
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=2,
+            min_fail_window=0, registration_grace=60,
+        )
+        for _ in range(5):
+            proxy._note_connect_failure(("127.0.0.1", 1))
+        assert read_registration(str(tmp_path)) == ("127.0.0.1", 1)
+        # Backdate the file past the grace: now the same probes drop it.
+        reg = tmp_path / "coordinator"
+        os.utime(reg, (os.stat(reg).st_atime, os.stat(reg).st_mtime - 120))
+        for _ in range(2):
+            proxy._note_connect_failure(("127.0.0.1", 1))
+        assert read_registration(str(tmp_path)) is None
+
+    def test_failure_streak_must_span_min_window(self, tmp_path):
+        """drop_after failures landing inside min_fail_window (one network
+        blip hitting N concurrent connects) are one observation — no drop
+        until the streak has AGED past the window."""
+        write_registration(str(tmp_path), "127.0.0.1", 1)
+        reg = tmp_path / "coordinator"
+        os.utime(reg, (os.stat(reg).st_atime, os.stat(reg).st_mtime - 120))
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=2,
+            min_fail_window=30, registration_grace=0,
+        )
+        for _ in range(5):
+            proxy._note_connect_failure(("127.0.0.1", 1))
+        assert read_registration(str(tmp_path)) == ("127.0.0.1", 1)
+        # Age the streak (simulate failures spread over > window).
+        proxy._fail_first_ts -= 60
+        proxy._note_connect_failure(("127.0.0.1", 1))
+        assert read_registration(str(tmp_path)) is None
+
+    def test_timeout_class_failures_need_the_long_window(self, tmp_path):
+        """Timeout/unreachable failures look identical to a transient
+        daemon↔workload partition against a LIVE coordinator, so they may
+        only drop after unreachable_window — refusals (RST) keep the short
+        window."""
+        write_registration(str(tmp_path), "127.0.0.1", 1)
+        reg = tmp_path / "coordinator"
+        os.utime(reg, (os.stat(reg).st_atime, os.stat(reg).st_mtime - 120))
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=2,
+            min_fail_window=0, registration_grace=0, unreachable_window=300,
+        )
+        for _ in range(5):
+            proxy._note_connect_failure(("127.0.0.1", 1), refused=False)
+        assert read_registration(str(tmp_path)) == ("127.0.0.1", 1)
+        # One refusal in the streak re-arms the short window.
+        proxy._note_connect_failure(("127.0.0.1", 1), refused=True)
+        assert read_registration(str(tmp_path)) is None
+
+    def test_connection_cap_drops_excess_then_recovers(self, tmp_path):
+        """The splice pool is bounded: with every slot held, new peers are
+        dropped immediately (jax retries); slots free on splice exit."""
+        upstream = socket.socket()
+        upstream.bind(("127.0.0.1", 0))
+        upstream.listen(4)
+        up_port = upstream.getsockname()[1]
+        write_registration(str(tmp_path), "127.0.0.1", up_port)
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", max_connections=1
+        )
+        proxy.start()
+        held = None
+        try:
+            # First peer occupies the only slot (upstream holds it open).
+            held = socket.create_connection(("127.0.0.1", proxy.bound_port), 5)
+            up_conn, _ = upstream.accept()
+            # Second peer: dropped at accept, before any splice.
+            with socket.create_connection(("127.0.0.1", proxy.bound_port), 5) as s:
+                assert s.recv(64) == b""
+            # Free the slot; a later peer splices again.
+            held.close()
+            up_conn.close()
+            upstream.settimeout(1)  # a dropped probe must not hang accept
+            deadline = 50
+            while deadline:
+                s = socket.create_connection(("127.0.0.1", proxy.bound_port), 5)
+                s.settimeout(5)
+                try:
+                    s.sendall(b"x")
+                    conn, _ = upstream.accept()
+                    conn.sendall(b"y")
+                    conn.close()
+                    if s.recv(64) == b"y":
+                        break
+                except OSError:
+                    pass
+                finally:
+                    s.close()
+                threading.Event().wait(0.1)
+                deadline -= 1
+            assert deadline, "slot never freed"
+        finally:
+            if held is not None:
+                held.close()
+            proxy.stop()
+            upstream.close()
 
 
 class TestHostZeroRegistration:

@@ -19,13 +19,35 @@ immediately; ``jax.distributed.initialize`` retries its coordinator
 connection for ``initialization_timeout`` (default 300 s), so early workers
 simply spin until host 0 comes up.
 
-Staleness window: nothing unregisters on workload death — between a host-0
-pod dying and its replacement re-registering (every host-0 start
-overwrites the file), the proxy forwards to the dead address and peers see
-refused connections, which jax retries.  If the dead IP were recycled by
-an unrelated listener, the spliced peers still fail at the jax coordinator
-handshake (process count/id checks) rather than silently joining a wrong
-domain.
+Staleness recovery (probe-and-drop): nothing unregisters on workload death,
+so after a host-0 pod dies the proxy would forward to a dead address until
+a replacement re-registers.  The proxy counts consecutive failed upstream
+connects to the *same* registered endpoint and, once ``drop_after``
+failures (default 3) have accumulated over at least ``min_fail_window``
+seconds, unlinks the registration: peers then get the fast
+not-yet-registered close instead of connect timeouts, and — the domain dir
+being sticky-bit shared (cdplugin/state.py) — a replacement workload
+running under a *different* uid, which could not have replaced the dead
+owner's file, can now register.  The daemon runs as root in its pod, so
+the unlink bypasses the sticky bit.
+
+Guard rails against dropping a LIVE coordinator (registrations are written
+once per workload, just before ``jax.distributed.initialize`` binds the
+listener, and never rewritten — a false drop is fatal to the job):
+
+- a registration younger than ``registration_grace`` seconds is never
+  dropped (host 0's bind follows its registration within the same process;
+  refusals in that window are startup, not death);
+- the failure streak must *span* ``min_fail_window`` seconds, so N
+  simultaneous in-flight connects failing on one network blip don't count
+  as N probes;
+- the drop itself renames the file aside and inspects it (atomic with
+  respect to a replacement's ``os.replace``): only the probed endpoint's
+  own file is removed, a fresh registration landing mid-drop is restored.
+
+If a dead IP were recycled by an unrelated listener, the spliced peers
+still fail at the jax coordinator handshake (process count/id checks)
+rather than silently joining a wrong domain.
 """
 
 from __future__ import annotations
@@ -34,6 +56,7 @@ import logging
 import os
 import socket
 import threading
+import time
 from typing import Optional
 
 logger = logging.getLogger(__name__)
@@ -74,13 +97,40 @@ class CoordinatorProxy:
     coordinator carries a handful of small rendezvous/heartbeat streams,
     not bulk traffic (collectives ride ICI, not this socket)."""
 
-    def __init__(self, port: int, registration_dir: str, host: str = ""):
+    def __init__(
+        self,
+        port: int,
+        registration_dir: str,
+        host: str = "",
+        max_connections: int = 64,
+        drop_after: int = 3,
+        min_fail_window: float = 5.0,
+        registration_grace: float = 10.0,
+        unreachable_window: float = 120.0,
+    ):
         self.port = port
         self._dir = registration_dir
         self._host = host  # "" = all interfaces
         self._server: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Bound concurrent splices: this proxy coexists with the node-
+        # critical slice-watch loop, so a connection flood (or a stuck
+        # upstream holding the 10 s connect timeout) must not exhaust
+        # threads/fds.  Excess connections are dropped early — jax clients
+        # retry refused connections anyway.
+        self._conn_slots = threading.BoundedSemaphore(max_connections)
+        # Probe-and-drop state: consecutive connect failures to the same
+        # registered endpoint (see module docstring).
+        self._drop_after = drop_after
+        self._min_fail_window = min_fail_window
+        self._registration_grace = registration_grace
+        self._unreachable_window = unreachable_window
+        self._fail_lock = threading.Lock()
+        self._fail_target: Optional[tuple[str, int]] = None
+        self._fail_count = 0  # all consecutive failures
+        self._fail_refused = 0  # the refused-class subset
+        self._fail_first_ts = 0.0
 
     @property
     def bound_port(self) -> int:
@@ -134,18 +184,52 @@ class CoordinatorProxy:
                 # client retries until initialization_timeout.
                 conn.close()
                 continue
-            threading.Thread(
-                target=self._splice, args=(conn, target, addr),
-                daemon=True, name="coord-proxy-conn",
-            ).start()
+            if not self._conn_slots.acquire(blocking=False):
+                logger.warning(
+                    "coordinator proxy at max concurrent connections; "
+                    "dropping %s", addr,
+                )
+                conn.close()
+                continue
+            try:
+                threading.Thread(
+                    target=self._splice, args=(conn, target, addr),
+                    daemon=True, name="coord-proxy-conn",
+                ).start()
+            except Exception as e:  # noqa: BLE001 — thread exhaustion
+                # Thread.start raises RuntimeError (not OSError) under
+                # process-wide thread exhaustion; the accept loop must
+                # survive it (its own comment above) and the slot/socket
+                # must not leak.
+                self._conn_slots.release()
+                conn.close()
+                logger.warning("coordinator proxy could not spawn splice: %s", e)
 
     def _splice(self, conn: socket.socket, target: tuple[str, int], addr) -> None:
+        try:
+            self._splice_inner(conn, target)
+        finally:
+            self._conn_slots.release()
+
+    def _splice_inner(self, conn: socket.socket, target: tuple[str, int]) -> None:
         try:
             upstream = socket.create_connection(target, timeout=10)
         except OSError as e:
             logger.warning("coordinator %s:%d unreachable: %s", *target, e)
             conn.close()
+            # RST-class errors are strong evidence the ENDPOINT is dead
+            # (a host answered and said nobody listens); timeouts and
+            # unreachables are ambiguous — they look identical during a
+            # transient network partition between the daemon and a LIVE
+            # workload, and a false drop is unrecoverable (registrations
+            # are write-once).  The ambiguous class needs a much longer
+            # streak before it may drop.
+            refused = isinstance(
+                e, (ConnectionRefusedError, ConnectionResetError)
+            )
+            self._note_connect_failure(target, refused=refused)
             return
+        self._note_connect_success(target)
 
         def pump(src: socket.socket, dst: socket.socket) -> None:
             # On src EOF propagate only a write-shutdown to dst: a legal
@@ -174,3 +258,114 @@ class CoordinatorProxy:
                 s.close()
             except OSError:
                 pass
+
+    # --------------------------------------------------- probe-and-drop
+
+    def _note_connect_success(self, target: tuple[str, int]) -> None:
+        with self._fail_lock:
+            if self._fail_target == target:
+                self._fail_target = None
+                self._fail_count = 0
+                self._fail_refused = 0
+
+    def _note_connect_failure(
+        self, target: tuple[str, int], refused: bool = True
+    ) -> None:
+        """Count consecutive failures per endpoint; past the threshold AND
+        the class's window, drop the registration (module docstring: this
+        is what lets a replacement workload under a different uid take
+        over, and turns peers' connect timeouts into fast retries).
+
+        Refused-class failures (RST: something answered, nobody listens)
+        may drop after ``min_fail_window``; a streak with no refusal at
+        all — timeouts/unreachables, which a transient daemon↔workload
+        partition produces against a perfectly live coordinator — must
+        span ``unreachable_window`` first.  A partition that heals resets
+        the streak on the next successful forward, so only an endpoint
+        that stays dark for the whole long window is dropped."""
+        now = time.monotonic()
+        with self._fail_lock:
+            if self._fail_target != target:
+                self._fail_target = target
+                self._fail_count = 0
+                self._fail_refused = 0
+                self._fail_first_ts = now
+            self._fail_count += 1
+            if refused:
+                self._fail_refused += 1
+            if self._fail_count < self._drop_after:
+                return
+            span = now - self._fail_first_ts
+            window = (
+                self._min_fail_window
+                if self._fail_refused
+                else self._unreachable_window
+            )
+            if span < window:
+                # N simultaneous in-flight connects failing on one blip
+                # are one observation, not N probes of a dead endpoint.
+                return
+            self._fail_target = None
+            self._fail_count = 0
+            self._fail_refused = 0
+        self._drop_registration(target)
+
+    def _drop_registration(self, target: tuple[str, int]) -> None:
+        """Remove the registration iff it is the probed endpoint's own,
+        aged-out file.  Rename-aside first: a replacement's ``os.replace``
+        landing mid-drop creates a fresh file at the canonical path that
+        this never touches — no unlink-the-new-registration race."""
+        path = os.path.join(self._dir, REGISTRATION_FILE)
+        try:
+            if time.time() - os.stat(path).st_mtime < self._registration_grace:
+                return  # young registration: startup window, never drop
+        except OSError:
+            return  # already gone
+        probe = f"{path}.probe.{os.getpid()}"
+        try:
+            os.rename(path, probe)
+        except OSError:
+            return  # raced with another drop or a fresh replace
+        try:
+            st = os.stat(probe)
+            with open(probe) as f:
+                content = f.read().strip()
+            stale = (
+                content == f"{target[0]}:{target[1]}"
+                and time.time() - st.st_mtime >= self._registration_grace
+            )
+        except OSError:
+            stale = False
+        if stale:
+            try:
+                os.unlink(probe)
+            except OSError:
+                pass
+            logger.info(
+                "dropped stale coordinator registration %s:%d after %d "
+                "consecutive failed connects", target[0], target[1],
+                self._drop_after,
+            )
+            return
+        # Not the file we probed (or unreadable): put it back — unless an
+        # even newer registration has already taken the canonical path.
+        try:
+            os.link(probe, path)  # fails if path exists: never clobbers
+        except FileExistsError:
+            pass  # newer registration won; discard the probe copy below
+        except OSError:
+            # No hard-link support (NFS root_squash, FUSE volumes): restore
+            # by rename.  This can clobber a registration that landed in
+            # the microseconds since — but keeping SOME live registration
+            # beats silently deleting the only copy.
+            try:
+                os.replace(probe, path)
+            except OSError:
+                logger.warning(
+                    "could not restore coordinator registration %s", probe
+                )
+            return
+        try:
+            os.unlink(probe)
+        except OSError:
+            pass
