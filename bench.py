@@ -212,10 +212,16 @@ def _enable_compile_cache() -> str:
     return cache_dir
 
 
-def _time_train_step(cfg, batch: int, iters: int):
+def _time_train_step(cfg, batch: int, iters: int, chains: int = 2):
     """Shared timing harness for the train-step benches: init, one
-    compile+sync step, then ``iters`` queued dispatches synced once
-    (a per-step sync costs ~80 ms through the remote-execution tunnel).
+    compile+sync step, then ``chains`` independent chains of ``iters``
+    queued dispatches, each synced once (a per-step sync costs ~80 ms of
+    round-trip through the remote-execution tunnel), keeping the BEST
+    chain.  Best-of-N exists because these numbers become the round
+    artifact: a host-side stall (another process on the bench box, tunnel
+    hiccup) inflates a single chain and then reads as a model regression —
+    exactly what happened to the r3 seq-8192 figure, measured during a
+    concurrent full-suite soak (BASELINE.md "measurement noise").
     Returns (n_params, seconds_per_step, compile_seconds)."""
     import jax
 
@@ -234,11 +240,14 @@ def _time_train_step(cfg, batch: int, iters: int):
     float(loss)  # forces device sync (block_until_ready is not enough
     # through the axon remote-execution tunnel)
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, tokens)
-    float(loss)
-    return n_params, (time.perf_counter() - t0) / iters, compile_s
+    best = float("inf")
+    for _ in range(max(1, chains)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return n_params, best, compile_s
 
 
 def _model_metrics(cfg, batch: int, n_params: int, dt: float, kind: str) -> dict:
@@ -551,6 +560,62 @@ def _run_section(name: str, timeout: float = 1200.0) -> dict:
     return {"error": f"section {name} rc={proc.returncode}: " + " | ".join(tail)[:250]}
 
 
+# ---------------------------------------------------------------------------
+# Artifact shape.  The driver captures only a bounded tail of stdout
+# (BENCH_r03.json arrived truncated mid-object, parsed=null — the headline
+# numbers existed only in prose that round).  So the printed line carries a
+# COMPACT summary (scalar per section), and the full per-section detail is
+# written to BENCH_DETAILS_r{N}.json in the repo, committed alongside.
+# ---------------------------------------------------------------------------
+
+# Scalars worth carrying on the one-line summary, wherever they appear.
+SUMMARY_KEYS = (
+    "device_kind", "seq", "batch", "step_ms", "tokens_per_s",
+    "model_tflops_per_s", "mfu_pct", "compile_s", "warm_compile_s",
+    "bind_p50_ms", "bind_p99_ms", "available", "consistent",
+    "checked_count", "psum_bus_gbps", "hook_exercised", "num_experts",
+    "matched",
+)
+
+
+def _summarize(section) -> dict:
+    """Compact view of one section: error/skip markers, whitelisted
+    scalars, and recursively-summarized sub-dicts."""
+    if not isinstance(section, dict):
+        return section
+    out = {}
+    for k in ("error", "skipped"):
+        if k in section:
+            out[k] = str(section[k])[:80]
+    for k in SUMMARY_KEYS:
+        if k in section:
+            out[k] = section[k]
+    if isinstance(section.get("model"), dict) and "params_m" in section["model"]:
+        out["params_m"] = section["model"]["params_m"]
+    for k, v in section.items():
+        if isinstance(v, dict) and k not in ("model",):
+            s = _summarize(v)
+            if s:
+                out[k] = s
+    return out
+
+
+def _round_number() -> int:
+    """Next round index: one past the newest BENCH_r{N}.json the driver has
+    recorded (round 4 runs with BENCH_r03.json in the tree)."""
+    import glob
+    import re
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ns = [
+        int(m.group(1))
+        for f in glob.glob(os.path.join(repo, "BENCH_r*.json"))
+        for m in [re.search(r"BENCH_r(\d+)\.json$", f)]
+        if m
+    ]
+    return (max(ns) + 1) if ns else 1
+
+
 def main(argv=None) -> None:
     argv = sys.argv[1:] if argv is None else argv
     if len(argv) == 2 and argv[0] == "--section":
@@ -583,17 +648,43 @@ def main(argv=None) -> None:
         "dynamic_partition": partition,
         "native_corroboration": _run_section("native"),
     }
-    print(
-        json.dumps(
-            {
-                "metric": "resourceclaim_bind_p50_latency",
-                "value": round(p50, 3),
-                "unit": "ms",
-                "vs_baseline": round(BASELINE_BIND_MS / p50, 1),
-                "extras": extras,
-            }
-        )
+
+    headline = {
+        "metric": "resourceclaim_bind_p50_latency",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_BIND_MS / p50, 1),
+    }
+    details_name = f"BENCH_DETAILS_r{_round_number():02d}.json"
+    details_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), details_name
     )
+    try:
+        with open(details_path, "w") as f:
+            json.dump({**headline, "extras": extras}, f, indent=1)
+    except OSError as e:
+        extras["details_write_error"] = str(e)[:120]
+    line = {
+        **headline,
+        "extras": {k: _summarize(v) for k, v in extras.items()},
+        "details_file": details_name,
+    }
+    text = json.dumps(line)
+    if len(text) > 1900:
+        # Defensive: the driver's capture truncates around 2000 chars.
+        # Shed the heaviest nested summaries before the headline is at
+        # risk (the full detail is in the committed details file).
+        for victim in ("ab", "native_corroboration", "collectives"):
+            line["extras"].pop(victim, None)
+            text = json.dumps(line)
+            if len(text) <= 1900:
+                break
+    if len(text) > 1900:
+        # Last resort: the headline + details pointer ALWAYS fits — a
+        # truncated-mid-object line (r3's parsed:null artifact) is the one
+        # outcome this pipeline exists to prevent.
+        text = json.dumps({**headline, "details_file": details_name})
+    print(text)
 
 
 if __name__ == "__main__":

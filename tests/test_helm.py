@@ -262,6 +262,38 @@ class TestRendererStrictness:
         with pytest.raises(TemplateError):
             r.render("{{ mystery .Values }}")
 
+    def test_range_over_string_raises(self):
+        """Go templates reject ranging a string; silently iterating its
+        characters would lint-pass a template that fails at install."""
+        from helmlite import Context, Renderer
+
+        r = Renderer(Context(values={"ns": "a,b"}), {})
+        with pytest.raises(TemplateError, match="string"):
+            r.render("{{ range .Values.ns }}x{{ end }}")
+
+    def test_dollar_reaches_root_through_range_and_args(self):
+        """$.Values folds correctly in argument position inside a
+        dot-rebinding range (the shape that silently mis-rendered before
+        the _fold_atom fix)."""
+        from helmlite import Context, Renderer
+
+        r = Renderer(Context(values={"lst": [1], "a": True, "b": True}), {})
+        out = r.render(
+            "{{ range .Values.lst }}"
+            "{{ if (and $.Values.a $.Values.b) }}YES{{ end }}"
+            "{{ end }}"
+        )
+        assert out == "YES"
+
+    def test_dollar_binds_to_include_dot(self):
+        """Go binds $ to the data an execution STARTED with: inside an
+        include that is the caller-supplied dot, not the chart root."""
+        from helmlite import Context, Renderer
+
+        defines = {"x": "{{ $.name }}"}
+        r = Renderer(Context(values={}), defines)
+        assert r.render('{{ include "x" (dict "name" "ARG") }}') == "ARG"
+
 
 class TestOperationalKnobs:
     """updateStrategy / priorityClassName / podAnnotations / per-component
@@ -353,3 +385,155 @@ class TestGoldens:
                 f"{name}/{template} drifted from its golden — if the chart "
                 "change is intentional, run python hack/regen_helm_goldens.py"
             )
+
+
+REFERENCE_CHART = "/root/reference/deployments/helm/nvidia-dra-driver-gpu"
+
+
+@pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_CHART), reason="reference checkout not present"
+)
+class TestReferenceChart:
+    """Non-circular helmlite validation: render the REFERENCE driver's
+    chart — a 1.4k-line template corpus helmlite was never written
+    against — and assert known-good objects per the reference's own
+    values.yaml defaults.  The in-repo goldens (TestGoldens) catch
+    regressions but are helmlite-rendered themselves; this corpus is the
+    fidelity check against independently-authored helm usage (with/dict/
+    hasKey/index/splitList/Capabilities/variables/method calls)."""
+
+    # The reference deliberately fails its default render until KEP 5004
+    # GA; this override is the escape hatch its own error message names.
+    OVERRIDE = {"gpuResourcesEnabledOverride": True}
+
+    @pytest.fixture(scope="class")
+    def ref_chart(self):
+        return Chart(REFERENCE_CHART)
+
+    @pytest.fixture(scope="class")
+    def rendered(self, ref_chart):
+        return ref_chart.render(
+            values=self.OVERRIDE,
+            release_name="nvidia-dra-driver-gpu",
+            namespace="nvidia",
+            api_versions=("resource.k8s.io/v1beta1",),
+        )
+
+    def test_default_render_reproduces_the_kep5004_guard(self, ref_chart):
+        """With stock values the reference chart REFUSES to render (its
+        validation.yaml calls fail) — reproducing that exact behavior is
+        itself a fidelity check of if/printf/variables/fail."""
+        with pytest.raises(TemplateError, match="gpuResourcesEnabledOverride"):
+            ref_chart.render(api_versions=("resource.k8s.io/v1beta1",))
+
+    def test_all_device_classes(self, rendered):
+        got = names(by_kind(rendered, "DeviceClass"))
+        assert got == {
+            "gpu.nvidia.com",
+            "mig.nvidia.com",
+            "vfio.gpu.nvidia.com",
+            "compute-domain-daemon.nvidia.com",
+            "compute-domain-default-channel.nvidia.com",
+        }
+        for dc in by_kind(rendered, "DeviceClass"):
+            assert dc["apiVersion"] == "resource.k8s.io/v1beta1"
+
+    def test_resource_api_version_follows_capabilities(self, ref_chart):
+        """The resourceApiVersion helper walks Capabilities tiers — v1
+        wins when present and unlocks extendedResourceName (KEP 5004)."""
+        rendered = ref_chart.render(
+            values=self.OVERRIDE,
+            api_versions=("resource.k8s.io/v1", "resource.k8s.io/v1beta1"),
+        )
+        gpu = [
+            d for d in by_kind(rendered, "DeviceClass")
+            if d["metadata"]["name"] == "gpu.nvidia.com"
+        ][0]
+        assert gpu["apiVersion"] == "resource.k8s.io/v1"
+        assert gpu["spec"]["extendedResourceName"] == "nvidia.com/gpu"
+
+    def test_kubelet_plugin_daemonset_structure(self, rendered):
+        ds = by_kind(rendered, "DaemonSet")[0]
+        assert ds["metadata"]["name"] == "nvidia-dra-driver-gpu-kubelet-plugin"
+        spec = ds["spec"]["template"]["spec"]
+        assert spec["priorityClassName"] == "system-node-critical"
+        containers = {c["name"] for c in spec["containers"]}
+        assert containers == {"compute-domains", "gpus"}
+        # The component selector label the _helpers.tpl dict/include
+        # pattern produces.
+        sel = ds["spec"]["selector"]["matchLabels"]
+        assert sel == {"nvidia-dra-driver-gpu-component": "kubelet-plugin"}
+
+    def test_controller_deployment(self, rendered):
+        dep = by_kind(rendered, "Deployment")[0]
+        assert dep["metadata"]["name"] == "nvidia-dra-driver-gpu-controller"
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        assert labels["nvidia-dra-driver-gpu-component"] == "controller"
+
+    def test_rbac_chains_are_complete(self, rendered):
+        for kind in ("ClusterRole", "ClusterRoleBinding", "ServiceAccount"):
+            assert by_kind(rendered, kind), f"no {kind} rendered"
+        # splitList/join over the namespaces helper: the daemon SA lands in
+        # the release namespace.
+        sa = [
+            d for d in by_kind(rendered, "ServiceAccount")
+            if d["metadata"]["name"] == "compute-domain-daemon-service-account"
+        ]
+        assert sa and sa[0]["metadata"]["namespace"] == "nvidia"
+
+    def test_openshift_scc_binding_follows_capabilities(self, ref_chart):
+        """Capabilities.APIVersions.Has gates the OpenShift anyuid SCC
+        bindings — absent by default, present when the cluster advertises
+        SecurityContextConstraints."""
+        base = ref_chart.render(
+            values=self.OVERRIDE, api_versions=("resource.k8s.io/v1beta1",)
+        )
+        assert "compute-domain-daemon-openshift-anyuid-role-binding" not in names(
+            by_kind(base, "ClusterRoleBinding")
+        )
+        ocp = ref_chart.render(
+            values=self.OVERRIDE,
+            api_versions=(
+                "resource.k8s.io/v1beta1",
+                "security.openshift.io/v1/SecurityContextConstraints",
+            ),
+        )
+        assert "compute-domain-daemon-openshift-anyuid-role-binding" in names(
+            by_kind(ocp, "ClusterRoleBinding")
+        )
+
+    def test_dollar_root_inside_range(self, ref_chart):
+        """``$.Values.x`` inside a dot-rebinding range (the MPS-gated RBAC
+        rules, rbac-kubeletplugin.yaml) must reach the chart root — a
+        silent miss here renders the Role without its Deployment rules."""
+        rendered = ref_chart.render(
+            values={**self.OVERRIDE, "featureGates": {"MPSSupport": True}},
+            namespace="nvidia",
+            api_versions=("resource.k8s.io/v1beta1",),
+        )
+        roles = [
+            d for d in by_kind(rendered, "Role")
+            if d["metadata"]["name"].endswith("role-kubeletplugin")
+        ]
+        assert roles
+        rules = roles[0]["rules"]
+        assert any(
+            "deployments" in r.get("resources", []) for r in rules
+        ), rules
+        # And with the gate off, the rule must be absent.
+        base = ref_chart.render(
+            values=self.OVERRIDE, api_versions=("resource.k8s.io/v1beta1",)
+        )
+        base_role = [
+            d for d in by_kind(base, "Role")
+            if d["metadata"]["name"].endswith("role-kubeletplugin")
+        ][0]
+        assert not any(
+            "deployments" in r.get("resources", []) for r in base_role["rules"]
+        )
+
+    def test_crds_parse(self, ref_chart):
+        kinds = {
+            d["spec"]["names"]["kind"] for d in ref_chart.crds()
+        }
+        assert kinds == {"ComputeDomain", "ComputeDomainClique"}

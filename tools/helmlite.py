@@ -7,17 +7,27 @@ This implements exactly the template subset the chart uses, so
 ``tests/test_helm.py`` can assert every manifest renders and parses:
 
 - actions: ``{{ pipeline }}`` with ``-`` whitespace trimming
-- data: ``.Values...``, ``.Release.Name/Namespace``, ``.Chart.Name/Version/AppVersion``
-- control flow: ``if``/``else if``/``else``/``end``, ``range $k, $v := ...``
-- ``define``/``include`` (loaded from ``_*.tpl`` files)
-- functions: ``quote squote default not and or eq ne empty fail printf
-  toYaml nindent indent trunc trimSuffix lower contains replace required join``
+- data: ``.Values...``, ``.Release.Name/Namespace``,
+  ``.Chart.Name/Version/AppVersion``, ``.Capabilities.APIVersions.Has``
+- control flow: ``if``/``else if``/``else``/``end``,
+  ``range [$k, [$v] :=] ...`` and ``with ...`` (both rebind dot, as in Go),
+  ``$var := expr`` declaration and ``$var = expr`` assignment
+- ``define``/``include`` (loaded from ``_*.tpl`` files; include renders
+  with the caller-supplied dot, so helper patterns like
+  ``include "x" (dict "context" . ...)`` work)
+- functions: ``quote squote default not and or eq ne gt lt empty fail
+  printf toYaml nindent indent trunc trimSuffix lower contains replace
+  required join list dict hasKey index splitList concat append int trim
+  dir``
 - pipelines: ``a | b | c``
 
 It is intentionally NOT a general Go-template engine: unsupported syntax
 raises, which is the desired behavior for a chart linter — if a template
 uses a construct helmlite doesn't know, the test should fail loudly and
-either the template gets simplified or helmlite grows the verb.
+either the template gets simplified or helmlite grows the verb.  The
+non-circular fidelity check is ``tests/test_helm.py::TestReferenceChart``:
+helmlite renders the REFERENCE driver's chart — a template corpus helmlite
+was never written against — and asserts known-good objects come out.
 """
 
 from __future__ import annotations
@@ -49,6 +59,20 @@ def deep_merge(base: dict, override: dict) -> dict:
     return out
 
 
+class _APIVersions:
+    """``.Capabilities.APIVersions`` with the ``Has`` method charts probe
+    for cluster API availability (OpenShift SCCs, resource.k8s.io tiers)."""
+
+    def __init__(self, versions):
+        self._versions = set(versions or ())
+
+    def Has(self, version: str) -> bool:  # noqa: N802 — Go method name
+        return version in self._versions
+
+
+_UNSET = object()
+
+
 @dataclass
 class Context:
     values: dict
@@ -56,6 +80,16 @@ class Context:
     release_namespace: str = "tpudra-system"
     chart: dict = field(default_factory=dict)
     locals: dict = field(default_factory=dict)
+    # The current dot (None = the root context).  ``with`` and ``range``
+    # rebind it, Go-style.
+    dot: Any = None
+    # What ``$`` resolves to: Go binds it to the data the template
+    # EXECUTION started with — the chart root for top-level templates,
+    # but the caller-supplied dot inside an include.
+    dollar: Any = _UNSET
+    # API versions ``.Capabilities.APIVersions.Has`` answers for (helm
+    # fills this from the live cluster; callers pass a fixed set).
+    api_versions: tuple = ()
 
     def root(self) -> dict:
         return {
@@ -66,7 +100,11 @@ class Context:
                 "Service": "Helm",
             },
             "Chart": self.chart,
+            "Capabilities": {"APIVersions": _APIVersions(self.api_versions)},
         }
+
+    def current_dot(self) -> Any:
+        return self.root() if self.dot is None else self.dot
 
 
 # ---------------------------------------------------------------------------
@@ -80,7 +118,9 @@ _TOKEN = re.compile(
       | `[^`]*`               # raw string
       | \(|\)                 # parens
       | \|                    # pipe
-      | \$[A-Za-z0-9_]*       # variable
+      | \$[A-Za-z0-9_]*(?:\.[A-Za-z0-9_.]+)?  # variable, opt. field path
+                              # ($x.f / $.f are ONE token — whitespace
+                              # separates them from a path argument)
       | \.[A-Za-z0-9_.]*      # field path
       | -?\d+(?:\.\d+)?       # number
       | [A-Za-z_][A-Za-z0-9_]*  # ident (function or true/false)
@@ -130,16 +170,24 @@ class Evaluator:
         if tok.startswith("`"):
             return tok[1:-1]
         if tok == ".":
-            return self.ctx.root()
+            return self.ctx.current_dot()
         if tok.startswith("."):
-            return self.resolve_path(tok[1:], self.ctx.root())
+            # Field paths resolve against the CURRENT dot (with/range
+            # rebind it); ``$`` below reaches the root regardless.
+            return self.resolve_path(tok[1:], self.ctx.current_dot())
         if tok.startswith("$"):
-            name = tok[1:]
+            name, _, path = tok[1:].partition(".")
             if not name:
-                return self.ctx.root()
-            if name in self.ctx.locals:
-                return self.ctx.locals[name]
-            raise TemplateError(f"unknown variable ${name}")
+                base = (
+                    self.ctx.root()
+                    if self.ctx.dollar is _UNSET
+                    else self.ctx.dollar
+                )
+            elif name in self.ctx.locals:
+                base = self.ctx.locals[name]
+            else:
+                raise TemplateError(f"unknown variable ${name}")
+            return self.resolve_path(path, base) if path else base
         if re.fullmatch(r"-?\d+", tok):
             return int(tok)
         if re.fullmatch(r"-?\d+\.\d+", tok):
@@ -226,17 +274,58 @@ class Evaluator:
             body = self.defines.get(name)
             if body is None:
                 raise TemplateError(f"include of undefined template {name!r}")
-            if dot != self.ctx.root():
-                # The chart only ever includes with the root context; a
-                # non-root dot would render differently under real helm,
-                # so fail loudly per this module's linter contract.
-                raise TemplateError(
-                    f"include {name!r} with non-root context is unsupported"
-                )
-            sub = Renderer(self.ctx, self.defines)
+            # Included templates run with the caller-supplied dot and a
+            # fresh variable scope (Go semantics) — this is what makes
+            # helper patterns like ``include "x" (dict "context" . ...)``
+            # and ``include "y" (list $a $b)`` render correctly.
+            sub_ctx = Context(
+                values=self.ctx.values,
+                release_name=self.ctx.release_name,
+                release_namespace=self.ctx.release_namespace,
+                chart=self.ctx.chart,
+                dot=dot,
+                dollar=dot,  # Go: $ binds to the execution's start data
+                api_versions=self.ctx.api_versions,
+            )
+            sub = Renderer(sub_ctx, self.defines)
             return sub.render(body).strip("\n")
         if fn == "list":
             return list(args)
+        if fn == "dict":
+            if len(args) % 2:
+                raise TemplateError("dict requires an even argument count")
+            return {args[i]: args[i + 1] for i in range(0, len(args), 2)}
+        if fn == "hasKey":
+            return isinstance(args[0], dict) and args[1] in args[0]
+        if fn == "index":
+            cur = args[0]
+            for key in args[1:]:
+                if cur is None:
+                    return None
+                cur = cur[key] if not isinstance(cur, dict) else cur.get(key)
+            return cur
+        if fn == "splitList":
+            return [p for p in str(args[1]).split(str(args[0]))]
+        if fn == "concat":
+            out: list = []
+            for a in args:
+                out.extend(a or [])
+            return out
+        if fn == "append":
+            return list(args[0] or []) + [args[1]]
+        if fn == "int":
+            try:
+                return int(args[0] or 0)
+            except (TypeError, ValueError):
+                return 0
+        if fn == "gt":
+            return args[0] > args[1]
+        if fn == "lt":
+            return args[0] < args[1]
+        if fn == "trim":
+            return str(args[0]).strip()
+        if fn == "dir":
+            return os.path.dirname(str(args[0]))
         raise TemplateError(f"unsupported function {fn!r}")
 
     # -- pipeline ------------------------------------------------------------
@@ -285,9 +374,16 @@ class Evaluator:
             "null",
         ):
             return self.call(head, self.eval_args(toks[1:]))
-        if len(toks) != 1:
-            raise TemplateError(f"unexpected argument list after {head!r}: {toks!r}")
-        return self.atom(head)
+        value, rest = self.atom(head), toks[1:]
+        if rest:
+            # A field path with arguments is a Go method call —
+            # ``.Capabilities.APIVersions.Has "resource.k8s.io/v1"``.
+            if callable(value):
+                return value(*self.eval_args(rest))
+            raise TemplateError(
+                f"unexpected argument list after {head!r}: {toks!r}"
+            )
+        return value
 
     def eval_args(self, toks: list[str]) -> list[Any]:
         args: list[Any] = []
@@ -388,7 +484,7 @@ def _parse(parts: list[tuple[str, str]]) -> list[_Node]:
     def sink() -> list:
         if stack:
             top = stack[-1]
-            if top.kind == "if":
+            if top.kind in ("if", "with"):
                 return top.branches[-1][1]
             return top.body
         return nodes
@@ -406,14 +502,24 @@ def _parse(parts: list[tuple[str, str]]) -> list[_Node]:
             n.branches = [(stripped[3:].strip(), [])]
             sink().append(n)
             stack.append(n)
+        elif stripped.startswith("with "):
+            # Same branch structure as if, but the truthy value becomes dot.
+            n = _Node("with")
+            n.branches = [(stripped[5:].strip(), [])]
+            sink().append(n)
+            stack.append(n)
         elif stripped.startswith("else if "):
-            if not stack or stack[-1].kind != "if":
+            if not stack or stack[-1].kind not in ("if", "with"):
                 raise TemplateError("else if outside if")
             stack[-1].branches.append((stripped[len("else if ") :].strip(), []))
         elif stripped == "else":
-            if not stack or stack[-1].kind != "if":
+            if not stack or stack[-1].kind not in ("if", "with"):
                 raise TemplateError("else outside if")
             stack[-1].branches.append((None, []))
+        elif re.match(r"^\$\w+\s*:?=\s*", stripped):
+            # Variable declaration ($x := expr) or assignment ($x = expr);
+            # one flat per-render scope, which matches how charts use them.
+            sink().append(_Node("assign", stripped))
         elif stripped.startswith("range "):
             n = _Node("range", stripped[len("range ") :].strip())
             sink().append(n)
@@ -460,34 +566,74 @@ class Renderer:
                     if cond is None or truthy(self.ev.eval(cond)):
                         out.append(self._render_nodes(body))
                         break
+            elif n.kind == "with":
+                out.append(self._render_with(n))
+            elif n.kind == "assign":
+                var, _, expr = re.match(
+                    r"^\$(\w+)\s*(:?=)\s*(.+)$", n.payload
+                ).groups()
+                self.ctx.locals[var] = self.ev.eval(expr)
             elif n.kind == "range":
                 out.append(self._render_range(n))
         return "".join(out)
 
+    def _render_with(self, n: _Node) -> str:
+        """``with expr``: render the body with dot rebound to the value
+        when truthy; else branches render with dot unchanged (Go)."""
+        cond, body = n.branches[0]
+        value = self.ev.eval(cond)
+        if truthy(value):
+            saved = self.ctx.dot
+            self.ctx.dot = value
+            try:
+                return self._render_nodes(body)
+            finally:
+                self.ctx.dot = saved
+        for cond2, body2 in n.branches[1:]:
+            if cond2 is None or truthy(self.ev.eval(cond2)):
+                return self._render_nodes(body2)
+        return ""
+
     def _render_range(self, n: _Node) -> str:
+        """All three Go range forms; dot is rebound to each element (with
+        or without loop variables — Go does both)."""
         spec = n.payload
+        kvar = vvar = None
         m = re.match(r"^\$(\w+),\s*\$(\w+)\s*:=\s*(.+)$", spec)
-        out = []
         if m:
             kvar, vvar, expr = m.groups()
-            coll = self.ev.eval(expr) or {}
-            items = coll.items() if isinstance(coll, dict) else enumerate(coll)
+        else:
+            m = re.match(r"^\$(\w+)\s*:=\s*(.+)$", spec)
+            if m:
+                vvar, expr = m.groups()
+            else:
+                expr = spec
+        coll = self.ev.eval(expr)
+        if isinstance(coll, str):
+            # Go templates cannot range over a string; silently iterating
+            # characters would render N wrong copies instead of failing
+            # the lint (this module's contract).
+            raise TemplateError(f"range can't iterate over string {coll!r}")
+        if isinstance(coll, dict):
+            items = list(coll.items())
+        else:
+            items = list(enumerate(coll or []))
+        out = []
+        saved = self.ctx.dot
+        try:
             for k, v in items:
-                self.ctx.locals[kvar] = k
-                self.ctx.locals[vvar] = v
+                if kvar:
+                    self.ctx.locals[kvar] = k
+                if vvar:
+                    self.ctx.locals[vvar] = v
+                self.ctx.dot = v
                 out.append(self._render_nodes(n.body))
-            self.ctx.locals.pop(kvar, None)
-            self.ctx.locals.pop(vvar, None)
-            return "".join(out)
-        m = re.match(r"^\$(\w+)\s*:=\s*(.+)$", spec)
-        if m:
-            vvar, expr = m.groups()
-            for v in self.ev.eval(expr) or []:
-                self.ctx.locals[vvar] = v
-                out.append(self._render_nodes(n.body))
-            self.ctx.locals.pop(vvar, None)
-            return "".join(out)
-        raise TemplateError(f"unsupported range spec {spec!r}")
+        finally:
+            self.ctx.dot = saved
+            for var in (kvar, vvar):
+                if var:
+                    self.ctx.locals.pop(var, None)
+        return "".join(out)
 
 
 # ---------------------------------------------------------------------------
@@ -551,8 +697,11 @@ class Chart:
         values: Optional[dict] = None,
         release_name: str = "tpudra",
         namespace: str = "tpudra-system",
+        api_versions: tuple = (),
     ) -> dict[str, list[dict]]:
-        """Render every template; returns {template_name: [parsed docs]}."""
+        """Render every template; returns {template_name: [parsed docs]}.
+        ``api_versions`` answers ``.Capabilities.APIVersions.Has`` (helm
+        reads these off the live cluster; here the caller fixes them)."""
         merged = deep_merge(self.default_values, values or {})
         chart_meta = {
             "Name": self.meta.get("name", ""),
@@ -566,6 +715,7 @@ class Chart:
                 release_name=release_name,
                 release_namespace=namespace,
                 chart=chart_meta,
+                api_versions=api_versions,
             )
             text = Renderer(ctx, self.defines).render(src)
             try:
