@@ -27,3 +27,17 @@ def _reset_feature_gates():
     featuregates.reset_for_testing()
     yield
     featuregates.reset_for_testing()
+
+
+@pytest.fixture
+def short_tmp():
+    """AF_UNIX socket paths are capped at ~107 bytes; pytest's tmp_path is
+    long enough to overflow them with the CD driver's socket names, so
+    socket-bearing dirs live under a short mkdtemp (shared by the
+    process-level suites: test_system, test_crash_sweep)."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="tpush-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)

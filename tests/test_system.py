@@ -50,19 +50,6 @@ def free_port():
     return free_ports(1)[0]
 
 
-@pytest.fixture
-def short_tmp():
-    """AF_UNIX socket paths are capped at ~107 bytes; pytest's tmp_path is
-    long enough to overflow them with the CD driver's socket names, so the
-    socket-bearing dirs live under a short mkdtemp."""
-    import shutil
-    import tempfile
-
-    d = tempfile.mkdtemp(prefix="tpusys-")
-    yield d
-    shutil.rmtree(d, ignore_errors=True)
-
-
 def spawn(module, *argv, server, log_path=None, **env_extra):
     """Launch a binary as `python -m module` against the fake apiserver.
 

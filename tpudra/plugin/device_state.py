@@ -59,6 +59,27 @@ from tpudra.plugin.vfio import VfioManager
 logger = logging.getLogger(__name__)
 
 
+def _crashpoint(point: str) -> None:
+    """Injectable SIGKILL for the process-level crash-consistency sweep
+    (tests/test_crash_sweep.py): when TPUDRA_CRASHPOINT names this
+    checkpoint boundary, die with no cleanup — the restarted plugin must
+    converge from the checkpoint alone (SURVEY §3.4's three GC layers;
+    reference device_state.go:223-242,337).  Two-key arming: the kill also
+    requires TPUDRA_TEST_HOOKS=1, so a single leaked env var in a copied
+    manifest cannot turn every production prepare into a crash loop.
+    Unarmed cost: one env read and string compare per boundary."""
+    import os
+
+    if (
+        os.environ.get("TPUDRA_CRASHPOINT") == point
+        and os.environ.get("TPUDRA_TEST_HOOKS") == "1"
+    ):
+        import signal
+
+        logger.warning("crashpoint %s armed: SIGKILL self", point)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 class PermanentError(Exception):
     """Non-retryable failure: kubelet retries won't fix bad user input
     (reference compute-domain plugin's permanentError type)."""
@@ -186,6 +207,7 @@ class DeviceState:
         if cached:
             logger.info("claim %s already prepared (idempotent return)", uid)
             return cached
+        _crashpoint("post-prepare-started")
 
         undos: list = []
         try:
@@ -202,7 +224,9 @@ class DeviceState:
                     logger.exception("prepare-failure cleanup step failed")
             raise
 
+        _crashpoint("post-mutate")
         self._write_cdi_spec(uid, groups)
+        _crashpoint("post-cdi")
         t_cdi = time.monotonic()
         plain_groups = [g for g, _ in groups]
 
@@ -216,6 +240,7 @@ class DeviceState:
             )
 
         self._cp.mutate(complete)
+        _crashpoint("post-completed")
         logger.info(
             "prepared claim %s/%s:%s t_prep=%.4fs t_cdi_to_done=%.4fs",
             namespace, name, uid, time.monotonic() - t0, time.monotonic() - t_cdi,
