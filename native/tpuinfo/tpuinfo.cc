@@ -342,7 +342,25 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
     num_hosts = atoi(getenv_or("TPU_WORKER_COUNT", "1").c_str());
     slice_uuid = getenv_or("TPU_SLICE_UUID", "slice-local");
     partition_id = "0";
-    h->state_file = getenv_or("TPUINFO_STATE_FILE", "/var/run/tpuinfo-state");
+    // No public TPU runtime API exposes sub-chip partition mutation: on
+    // real hardware the registry would be a file-backed SIMULATION the
+    // silicon never enforces, so it stays off unless explicitly opted in.
+    // tpuinfo_partitions_supported() is how callers learn which one they
+    // got (the MIG-capability probe analog, nvlib.go:269-301).
+    // Legacy adoption: versions before the attestation defaulted the
+    // registry on — a node upgrading with a NON-EMPTY registry keeps it
+    // (orphaning previously simulated partitions would leak them forever:
+    // list/delete would stop seeing entries the checkpoint still names).
+    // Fresh nodes (no file) get the new attest-false default.
+    {
+      std::string reg = getenv_or("TPUINFO_STATE_FILE", "/var/run/tpuinfo-state");
+      struct stat st {};
+      bool legacy = ::stat(reg.c_str(), &st) == 0 && st.st_size > 0;
+      if (getenv_or("TPUINFO_SIMULATE_PARTITIONS", "") == "1" || legacy)
+        h->state_file = reg;
+      else
+        h->state_file = "";
+    }
     for (const auto& t : pci) h->pci_addresses.push_back(t.address);
   }
 
@@ -389,10 +407,22 @@ int tpuinfo_get_topology(tpuinfo_handle* h, tpuinfo_topology* out) {
   return 0;
 }
 
+int tpuinfo_partitions_supported(tpuinfo_handle* h) {
+  /* Supported == this handle has a partition registry to mutate: a
+   * config-file handle with state_file (the sim/e2e path), or a hardware
+   * handle whose operator opted into simulation (open() above).  Real
+   * silicon without the opt-in reports 0 — sub-chip partitioning awaits a
+   * runtime API. */
+  return h->state_file.empty() ? 0 : 1;
+}
+
 int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,
                              const char* profile, int core_start,
                              int hbm_start, tpuinfo_partition* out) {
-  if (h->state_file.empty()) return h->fail("partitioning disabled (no state_file)");
+  if (h->state_file.empty())
+    return h->fail(
+        "partition mutation not supported by this backend (no TPU runtime "
+        "API; tpuinfo_partitions_supported() == 0)");
   if (parent_index < 0 || parent_index >= static_cast<int>(h->chips.size()))
     return h->fail("parent chip out of range");
   const tpuinfo_chip& chip = h->chips[parent_index];
@@ -442,7 +472,10 @@ int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,
 }
 
 int tpuinfo_delete_partition(tpuinfo_handle* h, const char* uuid) {
-  if (h->state_file.empty()) return h->fail("partitioning disabled (no state_file)");
+  if (h->state_file.empty())
+    return h->fail(
+        "partition mutation not supported by this backend (no TPU runtime "
+        "API; tpuinfo_partitions_supported() == 0)");
   LockedStateFile sf(h->state_file);
   if (!sf.ok()) return h->fail("cannot open state file " + h->state_file);
   auto parts = sf.read();

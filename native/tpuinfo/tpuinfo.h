@@ -59,6 +59,16 @@ int tpuinfo_chip_count(tpuinfo_handle* h);
 int tpuinfo_get_chip(tpuinfo_handle* h, int i, tpuinfo_chip* out);
 int tpuinfo_get_topology(tpuinfo_handle* h, tpuinfo_topology* out);
 
+/* Capability attestation: 1 iff this handle can actually mutate sub-chip
+ * partitions.  No public TPU runtime API exposes partition create/delete,
+ * so the hardware (sysfs/metadata) path reports 0 unless the operator
+ * explicitly opts into file-backed simulation (TPUINFO_SIMULATE_PARTITIONS=1);
+ * config-file handles — the hermetic sim/e2e path — report 1 when the
+ * config carries a state_file.  Callers must not advertise dynamic
+ * partitions the backend cannot enforce (the MIG-capability-gating analog,
+ * reference nvlib.go:269-301). */
+int tpuinfo_partitions_supported(tpuinfo_handle* h);
+
 int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,
                              const char* profile, int core_start,
                              int hbm_start, tpuinfo_partition* out);

@@ -15,6 +15,26 @@ teardown_file() {
   cluster_down
 }
 
+@test "partitions are advertised only because the backend attests support" {
+  # Capability gating (the MIG-capability probe analog): the published
+  # chip carries the backend's partitionsSupported attestation, and the
+  # dynamic-partition devices exist because it is true here (the sim
+  # backend).  A real-silicon node attests false and advertises chips
+  # only — no TPU runtime API mutates sub-chip partitions.
+  kubectl get resourceslices -o json > "$TPUDRA_STATE/slices.json"
+  python3 - "$TPUDRA_STATE/slices.json" <<'PYEOF'
+import json, sys
+slices = json.load(open(sys.argv[1]))
+devices = [d for s in slices.get("items", []) for d in s["spec"].get("devices", [])]
+chips = [d for d in devices if d["name"].startswith("tpu-") and "part" not in d["name"]]
+assert chips, devices
+for c in chips:
+    attrs = c.get("basic", c).get("attributes", {})
+    assert attrs["partitionsSupported"] == {"bool": True}, (c["name"], attrs)
+assert any("part-1c" in d["name"] for d in devices), [d["name"] for d in devices]
+PYEOF
+}
+
 @test "two half-chip partition pods co-allocate on one chip" {
   apply_spec tpu-test-partition.yaml
   wait_until 90 pod_succeeded pod1 tpu-test-partition

@@ -111,6 +111,86 @@ class TestLibTpuInfo:
         assert chips[0].pci_address == "0000:b0:00.0"
         lib.close()
 
+    def test_partitions_supported_attestation(self, tmp_path, monkeypatch):
+        """Capability probe (VERDICT r3 #5, the MIG-capability gating
+        analog): config-file handles with a state_file attest support (the
+        hermetic sim); a hardware handle attests False — no TPU runtime
+        API mutates sub-chip partitions — unless the operator explicitly
+        opts into file-backed simulation."""
+        # Config mode with state_file: the sim path, supported.
+        lib = mk_native(tmp_path)
+        assert lib.partitions_supported() is True
+        lib.close()
+        # Config mode without a state_file: nothing to mutate.
+        lib = mk_native(tmp_path, state_file="")
+        assert lib.partitions_supported() is False
+        with pytest.raises(Exception, match="not supported"):
+            from tpudra.devicelib.base import PartitionSpec
+
+            lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 0))
+        lib.close()
+
+        # Hardware path (sysfs): attests False by default...
+        from tpudra.devicelib.native import NativeDeviceLib
+
+        pci_root = tmp_path / "sys" / "bus" / "pci" / "devices"
+        d = pci_root / "0000:af:00.0"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text("0x1ae0\n")
+        (d / "device").write_text("0x0063\n")
+        (tmp_path / "dev").mkdir()
+        monkeypatch.setenv("TPUINFO_DEV_ROOT", str(tmp_path / "dev"))
+        monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path / "sys"))
+        monkeypatch.setenv("TPUINFO_STATE_FILE", str(tmp_path / "hw-state"))
+        monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+        monkeypatch.delenv("TPUINFO_SIMULATE_PARTITIONS", raising=False)
+        lib = NativeDeviceLib(config_path="")
+        assert lib.partitions_supported() is False
+        lib.close()
+        # ...and True only under the explicit simulation opt-in.
+        monkeypatch.setenv("TPUINFO_SIMULATE_PARTITIONS", "1")
+        lib = NativeDeviceLib(config_path="")
+        assert lib.partitions_supported() is True
+        lib.close()
+
+        # Legacy adoption: an upgrading node with a NON-EMPTY registry
+        # keeps managing it even without the opt-in — orphaning
+        # previously simulated partitions would leak them forever.
+        monkeypatch.delenv("TPUINFO_SIMULATE_PARTITIONS", raising=False)
+        (tmp_path / "hw-state").write_text(
+            "p0\tuuid-legacy\t0\t1c.4hbm\t0\t0\n"
+        )
+        lib = NativeDeviceLib(config_path="")
+        assert lib.partitions_supported() is True
+        lib.close()
+
+    def test_simulated_partitions_probe_fails_fast_without_registry(
+        self, tmp_path, monkeypatch
+    ):
+        """SimulatedPartitions on a native handle with no registry must
+        refuse at startup (probe roundtrip) rather than advertise
+        partitions every prepare would fail on."""
+        from tpudra import featuregates as fg
+        from tpudra.devicelib.base import DeviceLibError
+        from tpudra.plugin.cdi import CDIHandler
+        from tpudra.plugin.checkpoint import CheckpointManager
+        from tpudra.plugin.device_state import DeviceState
+
+        fg.feature_gates().set_from_map(
+            {fg.DYNAMIC_PARTITIONING: True, fg.SIMULATED_PARTITIONS: True}
+        )
+        lib = mk_native(tmp_path, state_file="")
+        try:
+            with pytest.raises(DeviceLibError, match="cannot simulate"):
+                DeviceState(
+                    lib,
+                    CDIHandler(str(tmp_path / "cdi")),
+                    CheckpointManager(str(tmp_path / "plugin")),
+                    "node-a",
+                )
+        finally:
+            lib.close()
+
     def test_partition_lifecycle_and_overlap(self, tmp_path):
         lib = mk_native(tmp_path)
         spec = PartitionSpec(0, "1c.4hbm", 0, 0)

@@ -94,6 +94,17 @@ class DeviceLib(abc.ABC):
 
     # -- partitions (MIG analog) -------------------------------------------
 
+    def partitions_supported(self) -> bool:
+        """Capability attestation: can this backend actually mutate
+        sub-chip partitions?  The plugin only advertises dynamic-partition
+        devices when this is True (the MIG-capability gating analog,
+        reference nvlib.go:269-301) — advertising partitions the hardware
+        cannot enforce would hand the scheduler phantom devices.  Default
+        True for simulation backends; the native library attests per
+        handle (no public TPU runtime API exposes partition mutation, so
+        real silicon reports False unless simulation is opted in)."""
+        return True
+
     @abc.abstractmethod
     def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
         """All (profile, placement) pairs the chip supports."""

@@ -152,6 +152,9 @@ class MockDeviceLib(DeviceLib):
 
     # -- partitions ---------------------------------------------------------
 
+    def partitions_supported(self) -> bool:
+        return self._config.partitions_supported
+
     def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
         out = []
         for profile in partition_profiles(chip.spec):

@@ -112,6 +112,8 @@ def _load(lib_path: str):
     lib.tpuinfo_list_partitions.restype = ctypes.c_int
     lib.tpuinfo_last_error.argtypes = [ctypes.c_void_p]
     lib.tpuinfo_last_error.restype = ctypes.c_char_p
+    lib.tpuinfo_partitions_supported.argtypes = [ctypes.c_void_p]
+    lib.tpuinfo_partitions_supported.restype = ctypes.c_int
     return lib
 
 
@@ -225,6 +227,14 @@ class NativeDeviceLib(DeviceLib):
         )
 
     # -- partitions ---------------------------------------------------------
+
+    def partitions_supported(self) -> bool:
+        """The library's per-handle attestation (tpuinfo.h): config-file
+        handles with a state_file say yes (hermetic sim); hardware handles
+        say no unless TPUINFO_SIMULATE_PARTITIONS=1 opted into file-backed
+        simulation — no public TPU runtime API mutates sub-chip
+        partitions."""
+        return bool(self._lib.tpuinfo_partitions_supported(self._handle))
 
     def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
         spec = GENERATIONS[chip.generation]

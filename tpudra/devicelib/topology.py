@@ -168,6 +168,10 @@ class MockTopologyConfig:
     # Pre-existing (static) partitions: list of (chip_index, profile_name,
     # core_start, hbm_start).
     static_partitions: list = field(default_factory=list)
+    # Capability attestation (DeviceLib.partitions_supported): the mock is
+    # a simulation backend, so True by default; tests flip it to model a
+    # real-silicon node where no runtime API can mutate partitions.
+    partitions_supported: bool = True
 
     @classmethod
     def from_json(cls, text: str) -> "MockTopologyConfig":

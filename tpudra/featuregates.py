@@ -37,6 +37,12 @@ TPU_DEVICE_HEALTH_CHECK = "TPUDeviceHealthCheck"
 #: Dynamic per-chip TensorCore partitioning (the dynamic-MIG analog).
 DYNAMIC_PARTITIONING = "DynamicPartitioning"
 
+#: Advertise dynamic partitions even when the device backend attests
+#: partitions_supported=false (real silicon: no TPU runtime API mutates
+#: sub-chip partitions).  The partitions are then a file-backed simulation
+#: the hardware never enforces — a test/dev override, never production.
+SIMULATED_PARTITIONS = "SimulatedPartitions"
+
 #: Store daemon membership in ComputeDomainClique CRs instead of CD status.
 COMPUTE_DOMAIN_CLIQUES = "ComputeDomainCliques"
 
@@ -71,6 +77,7 @@ DEFAULT_FEATURE_GATES: dict[str, tuple[VersionedSpec, ...]] = {
     DOMAIN_DAEMONS_WITH_DNS_NAMES: (VersionedSpec((0, 1), True, Stage.BETA),),
     PASSTHROUGH_SUPPORT: (VersionedSpec((0, 1), False, Stage.ALPHA),),
     DYNAMIC_PARTITIONING: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    SIMULATED_PARTITIONS: (VersionedSpec((0, 1), False, Stage.ALPHA),),
     TPU_DEVICE_HEALTH_CHECK: (VersionedSpec((0, 1), False, Stage.ALPHA),),
     COMPUTE_DOMAIN_CLIQUES: (VersionedSpec((0, 1), True, Stage.BETA),),
     CRASH_ON_ICI_FABRIC_ERRORS: (VersionedSpec((0, 1), True, Stage.BETA),),

@@ -77,6 +77,10 @@ class AllocatableDevice:
     partition_spec: Optional[PartitionSpec] = None
     live_partition: Optional[LivePartition] = None  # static partitions only
     vfio_index: Optional[int] = None
+    # Backend capability attestation, surfaced on chip devices so operators
+    # can see whether advertised partitions are hardware-enforced or
+    # file-backed simulation (DeviceLib.partitions_supported).
+    partitions_supported: bool = True
 
     @property
     def is_partition(self) -> bool:
@@ -98,6 +102,8 @@ class AllocatableDevice:
             "coordZ": {"int": chip.coords[2]},
             "cliqueID": {"string": chip.clique_id},
         }
+        if self.type == TYPE_CHIP:
+            attrs["partitionsSupported"] = {"bool": self.partitions_supported}
         if self.partition_spec is not None:
             attrs["profile"] = {"string": self.partition_spec.profile}
             attrs["coreStart"] = {"int": self.partition_spec.core_start}
@@ -143,6 +149,7 @@ def build_allocatable(
     static_partitions: list[LivePartition],
     dynamic_placements: dict[int, list[PartitionPlacement]] | None = None,
     with_vfio: bool = False,
+    partitions_supported: bool = True,
 ) -> dict[str, AllocatableDevice]:
     """Assemble the full allocatable map (enumerateAllPossibleDevices analog,
     nvlib.go:170).
@@ -170,7 +177,12 @@ def build_allocatable(
     for chip in chips:
         if chip.index in statically_partitioned:
             continue
-        dev = AllocatableDevice(type=TYPE_CHIP, name=chip_name(chip.index), chip=chip)
+        dev = AllocatableDevice(
+            type=TYPE_CHIP,
+            name=chip_name(chip.index),
+            chip=chip,
+            partitions_supported=partitions_supported,
+        )
         out[dev.name] = dev
         for placement in (dynamic_placements or {}).get(chip.index, []):
             spec = PartitionSpec(

@@ -125,6 +125,37 @@ class DeviceState:
         self._vfio = vfio_manager
         self._dynamic = featuregates.enabled(featuregates.DYNAMIC_PARTITIONING)
         self._passthrough = featuregates.enabled(featuregates.PASSTHROUGH_SUPPORT)
+        # Capability gating (the MIG-capability analog, nvlib.go:269-301):
+        # dynamic partitions are only advertised when the backend attests
+        # it can actually mutate them.  Real silicon attests False today —
+        # no public TPU runtime API exposes sub-chip partition mutation —
+        # so a hardware node advertises chips but not dynamic partitions;
+        # the SimulatedPartitions gate overrides for test/dev rigs (the
+        # partitions are then file-backed simulation the hardware never
+        # enforces; the native backend additionally needs
+        # TPUINFO_SIMULATE_PARTITIONS=1 so its registry exists).
+        partitions_supported = devicelib.partitions_supported()
+        if self._dynamic and not partitions_supported:
+            if featuregates.enabled(featuregates.SIMULATED_PARTITIONS):
+                # The override must never advertise devices the backend
+                # cannot even simulate (native without
+                # TPUINFO_SIMULATE_PARTITIONS has no registry: every
+                # prepare would fail and pods would wedge on phantom
+                # devices) — prove the mutation path with a real
+                # create/delete roundtrip before advertising.
+                self._probe_simulated_partitions(devicelib)
+                logger.warning(
+                    "backend attests partitions_supported=false; the "
+                    "SimulatedPartitions gate forces advertisement of "
+                    "file-backed simulated partitions (probe roundtrip ok)"
+                )
+            else:
+                logger.warning(
+                    "DynamicPartitioning requested but the backend attests "
+                    "partitions_supported=false (no TPU runtime API for "
+                    "sub-chip partition mutation): advertising chips only"
+                )
+                self._dynamic = False
 
         chips = devicelib.enumerate_chips()
         self._chips_by_index = {c.index: c for c in chips}
@@ -139,6 +170,7 @@ class DeviceState:
             chips,
             static_parts,
             dynamic_placements,
+            partitions_supported=partitions_supported,
             with_vfio=self._passthrough,
         )
         # Per-device edits cache with startup warmup (reference
@@ -310,6 +342,39 @@ class DeviceState:
                 else:
                     withheld.add(alloc.vfio_name(adev.chip.index))
         return withheld
+
+    @staticmethod
+    def _probe_simulated_partitions(devicelib: DeviceLib) -> None:
+        """Create-and-delete one real partition to prove the backend can
+        simulate before SimulatedPartitions advertises any (init-time
+        only).  Raises with the remedy when it cannot."""
+        chips = devicelib.enumerate_chips()
+        for chip in chips:
+            placements = devicelib.possible_placements(chip)
+            if not placements:
+                continue
+            p = placements[0]
+            spec = PartitionSpec(
+                parent_index=chip.index,
+                profile=p.profile.name,
+                core_start=p.core_start,
+                hbm_start=p.hbm_start,
+            )
+            try:
+                live = devicelib.create_partition(spec)
+            except DeviceLibError as e:
+                raise DeviceLibError(
+                    "SimulatedPartitions is enabled but the backend cannot "
+                    f"simulate partition mutation ({e}); on the native "
+                    "backend set TPUINFO_SIMULATE_PARTITIONS=1 so the "
+                    "file-backed registry exists"
+                ) from e
+            devicelib.delete_partition(live.uuid)
+            return
+        raise DeviceLibError(
+            "SimulatedPartitions is enabled but no chip offers a partition "
+            "placement (generation not partitionable?)"
+        )
 
     def destroy_unknown_partitions(self) -> int:
         """Startup reconciliation: with dynamic partitioning, every live
