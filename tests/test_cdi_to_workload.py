@@ -14,33 +14,9 @@ from tpudra.workload.envspec import ClaimEnv
 
 API_V = "resource.tpu.google.com/v1beta1"
 
-
-def apply_cdi(spec, requested_ids):
-    """containerd's CDI application, simplified: for each requested
-    "<kind>=<name>" id, merge that device's containerEdits (and the spec's
-    common containerEdits) into an OCI-ish container config."""
-    kind = spec["kind"]
-    by_name = {d["name"]: d for d in spec["devices"]}
-    env: dict = {}
-    device_nodes: list = []
-    mounts: list = []
-
-    def merge(edits):
-        for kv in edits.get("env", []):
-            k, _, v = kv.partition("=")
-            env[k] = v
-        device_nodes.extend(n["path"] for n in edits.get("deviceNodes", []))
-        mounts.extend(
-            (m["hostPath"], m["containerPath"]) for m in edits.get("mounts", [])
-        )
-
-    merge(spec.get("containerEdits", {}))
-    for cdi_id in requested_ids:
-        req_kind, _, name = cdi_id.partition("=")
-        assert req_kind == kind, f"foreign CDI kind {cdi_id}"
-        assert name in by_name, f"unresolvable CDI device {cdi_id}"
-        merge(by_name[name]["containerEdits"])
-    return env, device_nodes, mounts
+# containerd's CDI application, simplified — shared with the cluster sim
+# and bench's claim→jax loop (tpudra/sim/cdi.py).
+from tpudra.sim.cdi import apply_cdi  # noqa: E402
 
 
 @pytest.fixture

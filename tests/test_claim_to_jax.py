@@ -1,0 +1,47 @@
+"""Real-silicon claim → jax.devices() proof (VERDICT r3 #2).
+
+Runs whenever a live TPU runtime is reachable (skips with a reason
+otherwise): prepare a claim with the NATIVE backend on this host, spawn a
+workload process under the merged CDI environment exactly as containerd
+would assemble it, and assert the real libtpu sees exactly the granted
+chip — count, generation, ICI coordinates via TPUDRA_CHIP_COORDS — and can
+execute a jitted matmul; then unprepare.  The reference analog is the
+README demo pod against the real host GPU plus test_gpu_basic.bats:33's
+pod-READY assertion.
+
+The measurement/driver half lives in bench.py (bench_claim_to_jax), which
+records {granted, seen, matched} into each round's artifact as
+extras.claim_to_jax — this test is the same loop gated into the suite.
+"""
+
+import os
+
+import pytest
+
+from tpudra.devicelib.native import DEFAULT_LIB_PATH
+
+LIB_PATH = os.environ.get("TPUINFO_LIBRARY_PATH", DEFAULT_LIB_PATH)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(LIB_PATH),
+    reason="libtpuinfo.so not built (make -C native)",
+)
+def test_native_claim_grant_reaches_real_jax():
+    # bench_claim_to_jax runs its own runtime probe and reports the skip
+    # reason — probing here too would double the jax-importing subprocess
+    # cost for no information.
+    import bench
+
+    out = bench.bench_claim_to_jax()
+    if "skipped" in out:
+        pytest.skip(out["skipped"])
+    assert "error" not in out, out
+    assert out["matched"], out
+    # The loop's individual links, spelled out so a future mismatch names
+    # the broken one instead of just "matched is False":
+    seen, granted = out["seen"], out["granted"]
+    assert seen["platform"] == "tpu"
+    assert seen["num_devices"] == len(granted["devices"])
+    assert seen["claim_coords"] == granted["coords"]
+    assert seen["matmul_ok"] is True

@@ -85,13 +85,39 @@ class RuntimeProbe:
         return ""
 
 
+def hardware_env(base: Optional[dict] = None) -> dict:
+    """A copy of the environment with test-harness CPU pinning removed.
+
+    Under pytest, tests/conftest.py exports JAX_PLATFORMS=cpu and the
+    virtual-device XLA flag into os.environ; a child meant to see REAL
+    hardware (the runtime probe, bench's claim→jax workload) must not
+    inherit them — on a plain TPU VM they would pin the child to CPU and
+    the hardware gate would silently skip.  Only the cpu pin is dropped
+    (an operator's explicit JAX_PLATFORMS=tpu survives)."""
+    env = dict(os.environ if base is None else base)
+    if env.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        env.pop("JAX_PLATFORMS")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        kept = " ".join(
+            t for t in flags.split()
+            if "xla_force_host_platform_device_count" not in t
+        )
+        if kept:
+            env["XLA_FLAGS"] = kept
+        else:
+            env.pop("XLA_FLAGS")
+    return env
+
+
 def probe_runtime(timeout: float = 180.0, env: Optional[dict] = None) -> Optional[RuntimeProbe]:
     """Ask the live TPU runtime what it sees; None when there is none.
 
-    Runs in a fresh interpreter with the ambient environment (on Cloud TPU
-    VMs and under the remote-execution tunnel that is what pins jax to the
-    TPU); any failure — no jax, no TPU, CPU-only platform — is a clean
-    None, never an exception.
+    Runs in a fresh interpreter with the ambient environment minus any
+    test-harness CPU pinning (``hardware_env``) — on Cloud TPU VMs and
+    under the remote-execution tunnel the ambient env is what pins jax to
+    the TPU.  An explicit ``env`` is used verbatim.  Any failure — no jax,
+    no TPU, CPU-only platform — is a clean None, never an exception.
     """
     try:
         proc = subprocess.run(
@@ -99,7 +125,7 @@ def probe_runtime(timeout: float = 180.0, env: Optional[dict] = None) -> Optiona
             capture_output=True,
             text=True,
             timeout=timeout,
-            env=dict(os.environ if env is None else env),
+            env=hardware_env() if env is None else dict(env),
         )
     except (OSError, subprocess.TimeoutExpired) as e:
         logger.debug("runtime probe failed to run: %s", e)

@@ -1,0 +1,40 @@
+"""containerd's CDI application, simplified — shared by the cluster sim's
+consumers, the CDI-contract tests (tests/test_cdi_to_workload.py), and the
+bench's real-chip claim→jax loop (bench.bench_claim_to_jax).
+
+For each requested "<kind>=<name>" device id, merge that device's
+containerEdits (and the spec's common containerEdits) into an OCI-ish
+container view: env map, device-node list, (host, container) mount pairs.
+The full pod-runtime version (env rewriting through mounts, process spawn)
+lives in tpudra/sim/kubelet.py; this is the minimal merge both layers of
+the contract agree on.
+"""
+
+from __future__ import annotations
+
+
+def apply_cdi(spec: dict, requested_ids: list) -> tuple[dict, list, list]:
+    kind = spec["kind"]
+    by_name = {d["name"]: d for d in spec["devices"]}
+    env: dict = {}
+    device_nodes: list = []
+    mounts: list = []
+
+    def merge(edits: dict) -> None:
+        for kv in edits.get("env", []):
+            k, _, v = kv.partition("=")
+            env[k] = v
+        device_nodes.extend(n["path"] for n in edits.get("deviceNodes", []))
+        mounts.extend(
+            (m["hostPath"], m["containerPath"]) for m in edits.get("mounts", [])
+        )
+
+    merge(spec.get("containerEdits", {}))
+    for cdi_id in requested_ids:
+        req_kind, _, name = cdi_id.partition("=")
+        if req_kind != kind:
+            raise ValueError(f"foreign CDI kind {cdi_id}")
+        if name not in by_name:
+            raise ValueError(f"unresolvable CDI device {cdi_id}")
+        merge(by_name[name]["containerEdits"])
+    return env, device_nodes, mounts
