@@ -450,6 +450,206 @@ def bench_native_corroboration() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def bench_scale() -> dict:
+    """Production-scale machinery under load (VERDICT r3 #6), CPU-only:
+
+    - **churn**: 100 nodes x 500 mixed claims (1-chip / 2-chip / dynamic
+      partition) through the full prepare→unprepare path concurrently —
+      bind p50/p99 under contention, aggregate prepares/s.  Claims are
+      slotted (node = i%100, device by wave) so same-device conflicts are
+      rare; a straggler CAN still make waves collide on one device (tasks
+      200 apart), so overlap refusals retry briefly like kubelet would —
+      counted in ``overlap_retries`` — and what's measured is machinery
+      contention (flock, checkpoint RMW, CDI IO).
+    - **controller**: 100 ComputeDomains reconciled by the real controller
+      (informers + keyed queue + rate limiter) → reconciles/s to full
+      DaemonSet+RCT fan-out.
+    - **informer**: cache entries + approximate heap for the 100-slice
+      watch (tracemalloc).
+    - **qps**: the client-side token bucket under an 8-thread storm of 300
+      LISTs against the HTTP fake apiserver — held == effective rate
+      stayed at/under the configured 50 QPS (+burst amortized).
+    """
+    import concurrent.futures as cf
+    import threading
+    import tracemalloc
+
+    N_NODES, N_CLAIMS, WORKERS = 100, 500, 16
+    out: dict = {"nodes": N_NODES, "claims": N_CLAIMS, "workers": WORKERS}
+    try:
+        from tpudra import featuregates as fg
+        from tpudra.devicelib.mock import MockDeviceLib
+        from tpudra.devicelib.topology import MockTopologyConfig
+        from tpudra.kube import gvr
+        from tpudra.kube.fake import FakeKube
+        from tpudra.kube.informer import Informer
+        from tpudra.plugin.driver import Driver, DriverConfig
+
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        kube = FakeKube()
+        with tempfile.TemporaryDirectory() as tmp:
+            drivers = []
+            for n in range(N_NODES):
+                lib = MockDeviceLib(
+                    config=MockTopologyConfig(generation="v5p"),
+                    state_file=f"{tmp}/hw{n}.json",
+                )
+                drivers.append(
+                    Driver(
+                        DriverConfig(
+                            node_name=f"node-{n:03d}",
+                            plugin_dir=f"{tmp}/p{n}",
+                            registry_dir=f"{tmp}/r{n}",
+                            cdi_root=f"{tmp}/c{n}",
+                        ),
+                        kube,
+                        lib,
+                    )
+                )
+            t0 = time.perf_counter()
+            for d in drivers:
+                d.publish_resources()
+            out["publish_100_nodes_s"] = round(time.perf_counter() - t0, 2)
+
+            # Informer watching the 100 published slices.
+            tracemalloc.start()
+            stop = threading.Event()
+            inf = Informer(kube, gvr.RESOURCE_SLICES)
+            inf.start(stop)
+            inf.wait_for_sync()
+            current, peak = tracemalloc.get_traced_memory()
+            tracemalloc.stop()
+            out["informer"] = {
+                "cache_entries": len(inf.list()),
+                "heap_mb": round(current / 1e6, 2),
+                "heap_peak_mb": round(peak / 1e6, 2),
+            }
+
+            part_cfg = [{
+                "source": "FromClass",
+                "requests": [],
+                "opaque": {
+                    "driver": "tpu.google.com",
+                    "parameters": {
+                        "apiVersion": "resource.tpu.google.com/v1beta1",
+                        "kind": "TpuPartitionConfig",
+                    },
+                },
+            }]
+
+            from tests.test_device_state import mk_claim
+
+            overlap_retries = [0]
+            retry_lock = threading.Lock()
+
+            def one(i: int) -> float:
+                d = drivers[i % N_NODES]
+                wave = i // N_NODES
+                uid = f"scale-{i}"
+                if wave == 2:
+                    claim = mk_claim(
+                        uid, ["tpu-0-part-1c.4hbm-0-0"],
+                        configs=part_cfg, name=uid,
+                    )
+                elif wave == 3:
+                    claim = mk_claim(uid, ["tpu-2", "tpu-3"], name=uid)
+                else:
+                    claim = mk_claim(uid, [f"tpu-{wave % 4}"], name=uid)
+                for _attempt in range(100):
+                    t0 = time.perf_counter()
+                    resp = d.prepare_resource_claims([claim])
+                    dt = (time.perf_counter() - t0) * 1000.0
+                    err = resp["claims"][uid].get("error", "")
+                    if not err:
+                        d.unprepare_resource_claims([{"uid": uid}])
+                        return dt
+                    if "overlaps" not in err:
+                        raise RuntimeError(err)
+                    # A straggler holding the colliding grant: retry the
+                    # way kubelet would, without polluting the latency
+                    # sample with the wait.
+                    with retry_lock:
+                        overlap_retries[0] += 1
+                    time.sleep(0.02)
+                raise RuntimeError(f"claim {uid} never cleared its overlap")
+
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=WORKERS) as pool:
+                lat = sorted(pool.map(one, range(N_CLAIMS)))
+            wall = time.perf_counter() - t0
+            stop.set()
+            out["churn"] = {
+                "bind_p50_ms": round(lat[len(lat) // 2], 3),
+                "bind_p99_ms": round(lat[int(len(lat) * 0.99)], 3),
+                "bind_max_ms": round(lat[-1], 3),
+                "prepares_per_s": round(N_CLAIMS / wall, 1),
+                "wall_s": round(wall, 2),
+                "overlap_retries": overlap_retries[0],
+            }
+
+        # Controller reconcile fan-out over 100 ComputeDomains.
+        from tests.test_computedomain import mk_cd, mk_node
+        from tpudra.controller.controller import Controller, ManagerConfig
+
+        ckube = FakeKube()
+        for n in range(N_NODES):
+            mk_node(ckube, f"node-{n:03d}")
+        c = Controller(ckube, ManagerConfig(driver_namespace="tpudra-system"))
+        cstop = threading.Event()
+        ct = threading.Thread(target=c.run, args=(cstop,), daemon=True)
+        t0 = time.perf_counter()
+        for i in range(N_NODES):
+            mk_cd(ckube, name=f"cd-{i:03d}", num_nodes=2)
+        ct.start()
+        deadline = time.monotonic() + 120
+        want = N_NODES
+        while time.monotonic() < deadline:
+            n_ds = len(ckube.list(gvr.DAEMONSETS, "tpudra-system").get("items", []))
+            if n_ds >= want:
+                break
+            time.sleep(0.05)
+        elapsed = time.perf_counter() - t0
+        n_ds = len(ckube.list(gvr.DAEMONSETS, "tpudra-system").get("items", []))
+        cstop.set()
+        out["controller"] = {
+            "compute_domains": N_NODES,
+            "daemonsets_created": n_ds,
+            "full_fanout_s": round(elapsed, 2),
+            "reconciles_per_s": round(n_ds / elapsed, 1) if elapsed else 0,
+        }
+
+        # Sustained-QPS limiter under a storm, over the real HTTP client.
+        from tpudra.kube.client import KubeClient
+        from tpudra.kube.httpserver import FakeKubeServer
+
+        qps_limit, burst, n_req = 50.0, 25, 300
+        with FakeKubeServer() as server:
+            qc = KubeClient(server.url, qps=qps_limit, burst=burst)
+            t0 = time.perf_counter()
+            with cf.ThreadPoolExecutor(max_workers=8) as pool:
+                list(
+                    pool.map(
+                        lambda _: qc.list(gvr.NODES), range(n_req)
+                    )
+                )
+            elapsed = time.perf_counter() - t0
+        effective = (n_req - burst) / elapsed
+        out["qps"] = {
+            "limit": qps_limit,
+            "burst": burst,
+            "requests": n_req,
+            "elapsed_s": round(elapsed, 2),
+            "effective_qps": round(effective, 1),
+            # 10% slack for scheduling jitter; the storm must not pierce
+            # the bucket.
+            "held": effective <= qps_limit * 1.1,
+        }
+        return out
+    except Exception as e:  # noqa: BLE001 — bench must always print its line
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+        return out
+
+
 def bench_claim_to_jax() -> dict:
     """Close the north-star loop on the real chip (BASELINE.json's end
     state: "the pod sees exactly the chips granted by the ResourceClaim"):
@@ -707,6 +907,7 @@ SECTIONS = {
     "ab_ce_fused": lambda: bench_ab(ce_impl="fused"),
     "native": bench_native_corroboration,
     "claim_to_jax": bench_claim_to_jax,
+    "scale": bench_scale,
 }
 
 
@@ -746,7 +947,8 @@ SUMMARY_KEYS = (
     "model_tflops_per_s", "mfu_pct", "compile_s", "warm_compile_s",
     "bind_p50_ms", "bind_p99_ms", "available", "consistent",
     "checked_count", "psum_bus_gbps", "hook_exercised", "num_experts",
-    "matched",
+    "matched", "prepares_per_s", "reconciles_per_s", "effective_qps",
+    "held", "cache_entries", "heap_mb",
 )
 
 
@@ -822,6 +1024,9 @@ def main(argv=None) -> None:
         # North-star loop: native claim prepare → merged CDI env → the
         # real libtpu sees exactly the granted chip and runs a jitted op.
         "claim_to_jax": _run_section("claim_to_jax"),
+        # 100-node/500-claim churn, controller fan-out, informer memory,
+        # QPS limiter under storm (CPU-only).
+        "scale": _run_section("scale"),
     }
 
     headline = {
