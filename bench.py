@@ -335,7 +335,12 @@ def bench_long_context(seq: int, batch: int) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 
 
-def bench_ab(remat: str = None, attention: str = None, ce_impl: str = None) -> dict:
+def bench_ab(
+    remat: str = None,
+    attention: str = None,
+    ce_impl: str = None,
+    opt_impl: str = None,
+) -> dict:
     """A/B leg at the flagship config: one knob changed from the tuned
     default, so every tuning claim in model.py's docstring is backed by a
     driver-captured artifact (remat=dots / splash attention are the
@@ -353,12 +358,15 @@ def bench_ab(remat: str = None, attention: str = None, ce_impl: str = None) -> d
             kw["remat"] = remat
         if ce_impl:
             kw["ce_impl"] = ce_impl
+        if opt_impl:
+            kw["opt_impl"] = opt_impl
         cfg = m.ModelConfig(**kw)
         n_params, dt, _ = _time_train_step(cfg, BENCH_BATCH, iters=5)
         return {
             "remat": cfg.remat,
             "attention": cfg.attention,
             "ce_impl": cfg.ce_impl,
+            "opt_impl": cfg.opt_impl,
             **_model_metrics(
                 cfg, BENCH_BATCH, n_params, dt, jax.devices()[0].device_kind
             ),
@@ -905,6 +913,7 @@ SECTIONS = {
     "ab_remat_full": lambda: bench_ab(remat="full"),
     "ab_naive": lambda: bench_ab(attention="naive"),
     "ab_ce_fused": lambda: bench_ab(ce_impl="fused"),
+    "ab_opt_fused": lambda: bench_ab(opt_impl="fused"),
     "native": bench_native_corroboration,
     "claim_to_jax": bench_claim_to_jax,
     "scale": bench_scale,
@@ -1017,6 +1026,7 @@ def main(argv=None) -> None:
             "remat_full": _run_section("ab_remat_full"),
             "attention_naive": _run_section("ab_naive"),
             "ce_fused": _run_section("ab_ce_fused"),
+            "opt_fused": _run_section("ab_opt_fused"),
         },
         "collectives": bench_collectives(),
         "dynamic_partition": partition,

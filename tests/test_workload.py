@@ -261,6 +261,88 @@ class TestFlagshipModel:
         g.dryrun_multichip(8)
 
 
+class TestFusedAdamW:
+    def test_fused_matches_tree_map_update(self):
+        """The one-sweep pallas AdamW (opt_kernel.py) must be numerically
+        equivalent to the tree-map path it A/Bs against: same f32 math,
+        same bf16 moment rounding — run one real update on a small model
+        both ways (pallas in interpret mode on CPU) and compare."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpudra.workload import model as m
+
+        cfg = dict(
+            vocab=512, d_model=128, n_heads=2, n_layers=2, d_ff=256,
+            max_seq=64, attention="naive",
+        )
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (2, 64), 0, 512
+        )
+        outs = {}
+        for impl in ("tree", "fused"):
+            c = m.ModelConfig(**cfg, opt_impl=impl)
+            params = m.init_params(jax.random.PRNGKey(0), c)
+            init, step = m.make_train_step(c)
+            p1, o1, loss1 = step(params, init(params), tokens)
+            outs[impl] = (p1, o1, float(loss1))
+        pt, ot, losst = outs["tree"]
+        pf, of, lossf = outs["fused"]
+        assert losst == lossf  # identical forward, identical loss
+        # Params: equal to ~1 ULP (the only reorder is p+(-lr*x) vs
+        # p-lr*x).  A full multi-step comparison would only measure the
+        # bf16 model's gradient chaos amplifying that ULP, not the
+        # optimizer.
+        for a, b in zip(jax.tree.leaves(pt), jax.tree.leaves(pf)):
+            assert a.dtype == b.dtype
+            assert jnp.allclose(a, b, rtol=0, atol=1e-6), (
+                float(jnp.abs(a - b).max())
+            )
+        # Moments: bit-identical bf16 after identical f32 arithmetic.
+        for a, b in zip(jax.tree.leaves(ot[0]), jax.tree.leaves(of[0])):
+            assert jnp.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(ot[1]), jax.tree.leaves(of[1])):
+            assert jnp.array_equal(a, b)
+        # And the bare optimizer transforms agree on a synthetic leaf
+        # through two chained applications.
+        from tpudra.workload.model import adamw_bf16_moments
+        from tpudra.workload.opt_kernel import fused_adamw
+
+        p = {"w": jax.random.normal(jax.random.PRNGKey(2), (8, 1024))}
+        g = {"w": jax.random.normal(jax.random.PRNGKey(3), (8, 1024))}
+        ti, tu = adamw_bf16_moments(1e-3)
+        fi, fa = fused_adamw(1e-3)
+        ts, fs = ti(p), fi(p)
+        tp, fp = p, p
+        for _ in range(2):
+            u, ts = tu(g, ts, tp)
+            tp = jax.tree.map(lambda a, b: a + b, tp, u)
+            fp, fs = fa(fp, g, fs)
+        assert float(jnp.abs(tp["w"] - fp["w"]).max()) < 1e-6
+        assert jnp.array_equal(ts[0]["w"], fs[0]["w"])
+        assert jnp.array_equal(ts[1]["w"], fs[1]["w"])
+
+    def test_padding_leaves_round_trip(self):
+        """Leaf sizes that don't divide the 1024-lane block pad and slice
+        back exactly (the ln scales and small heads hit this)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpudra.workload.opt_kernel import fused_adamw
+
+        init, apply = fused_adamw(1e-3)
+        params = {"w": jnp.ones((3, 37), jnp.float32)}
+        grads = {"w": jnp.full((3, 37), 0.5, jnp.float32)}
+        state = init(params)
+        new_p, (mu, nu, count) = apply(params, grads, state)
+        assert new_p["w"].shape == (3, 37)
+        assert int(count) == 1
+        # Every element saw the same grad → identical update everywhere.
+        vals = set(float(x) for x in new_p["w"].reshape(-1))
+        assert len(vals) == 1
+        assert float(mu["w"][0, 0]) == pytest.approx(0.05, rel=1e-2)
+
+
 class TestPipelineParallel:
     """workload/pipeline.py: GPipe over the layer-stack scan axis via
     shard_map + ppermute, verified against the dense backbone."""

@@ -12,7 +12,7 @@ Built from scratch with the capabilities of NVIDIA's k8s-dra-driver-gpu
   orchestration, reference cmd/compute-domain-*).
 """
 
-__version__ = "0.2.0"
+__version__ = "0.4.0"
 
 # DRA driver names (reference: cmd/gpu-kubelet-plugin/main.go:41,
 # cmd/compute-domain-kubelet-plugin/main.go:42).

@@ -72,6 +72,12 @@ class ModelConfig:
     # recompute FLOPs outweigh the freed residual on this chip, so
     # chunked stays the default; fused is for memory-constrained configs.
     ce_impl: str = "chunked"
+    # Optimizer implementation: "tree" (XLA-fused tree-map AdamW, the
+    # measured default) or "fused" (one-sweep pallas kernel reading
+    # p/g/m/v and writing p'/m'/v' per block — opt_kernel.py).  The A/B is
+    # re-measured every round (bench.py extras.ab.opt_fused); the default
+    # follows the measurement.
+    opt_impl: str = "tree"
     # Attention core: "auto" | "naive" | "flash"/"splash".  Measured on
     # v5e (472M params; artifacts in BENCH_r{N}.json extras.ab): the
     # pallas splash kernel with 1024-wide blocks and its fused backward
@@ -124,6 +130,8 @@ class ModelConfig:
             )
         if self.ce_impl not in ("chunked", "fused"):
             raise ValueError(f"ce_impl must be chunked|fused, got {self.ce_impl!r}")
+        if self.opt_impl not in ("tree", "fused"):
+            raise ValueError(f"opt_impl must be tree|fused, got {self.opt_impl!r}")
         for name in ("attn_block_q", "attn_block_kv"):
             blk = getattr(self, name)
             if blk and (blk % 128 or self.max_seq % blk):
@@ -483,6 +491,18 @@ def adamw_bf16_moments(learning_rate: float, b1=0.9, b2=0.999, eps=1e-8, wd=1e-4
 def make_train_step(cfg: ModelConfig, learning_rate: float = 1e-3):
     """Returns (init_opt_state, train_step)."""
     import jax
+
+    if cfg.opt_impl == "fused":
+        from tpudra.workload.opt_kernel import fused_adamw
+
+        finit, fapply = fused_adamw(learning_rate)
+
+        def train_step(params, opt_state, tokens):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+            params, opt_state = fapply(params, grads, opt_state)
+            return params, opt_state, loss
+
+        return finit, train_step
 
     init, update = adamw_bf16_moments(learning_rate)
 

@@ -1,0 +1,155 @@
+"""Fused single-pass AdamW update (pallas).
+
+The tree-map AdamW (model.adamw_bf16_moments) runs as several XLA-fused
+loops per leaf — moment updates, the update rule, the parameter add — and
+BASELINE.md's step decomposition measures the whole optimizer phase at
+~18 ms on the 472M flagship, ~520 GB/s effective HBM against the chip's
+~819: the separate passes re-read params/grads/moments.  This kernel does
+the entire update in ONE sweep per leaf: read (p, g, m, v), write
+(p', m', v'), with the moment arithmetic in f32 and moments stored bf16,
+exactly matching the tree-map semantics bit-for-bit in f32 math.
+
+Ideal traffic at the flagship: (4+4+2+2) read + (4+2+2) write = 20 B per
+param → ~9.4 GB/step → ~11.5 ms at peak; whether the fusion actually
+recovers the gap is measured, not assumed — bench.py extras.ab.opt_fused
+records the A/B every round, and the default (ModelConfig.opt_impl)
+follows the measurement.
+
+Measured on v5e (round 4, same-session baseline): the fused path LOSES —
+418.7 ms / 60.2% MFU vs the tree-map's 379.4 ms / 66.4% at the flagship
+config, i.e. the kernel costs ~39 ms where the whole XLA-fused optimizer
+phase costs ~18.  XLA already fuses the tree-map update into few
+near-peak passes; this kernel's per-leaf launches and pad/reshape copies
+outweigh the single-sweep saving, and the one knob that could amortize
+them (bigger blocks) exceeds the 16 MB VMEM budget at 512 rows.  So
+``opt_impl="tree"`` stays the default; the kernel remains as the
+measured-and-rejected alternative, re-measured each round like ce_fused.
+
+Leaves are flattened to [rows, 1024] lane-aligned blocks; sizes that
+don't divide pad with zeros (pad lanes compute 0/eps = 0 updates and are
+sliced away).  Aliasing maps the padded p/m/v inputs onto the outputs so
+jit-donated buffers update in place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+COLS = 1024
+BLOCK_ROWS = 256  # 512-row blocks double-buffer past the 16 MB VMEM budget
+# (in 6 MB + out 4 MB per block, x2 pipelining) and fail Mosaic compile
+
+
+def _kernel(c_ref, p_ref, g_ref, m_ref, v_ref, po_ref, mo_ref, vo_ref,
+            *, lr, b1, b2, eps, wd):
+    g = g_ref[...].astype(jnp.float32)
+    # bf16-round the moments BEFORE the update rule reads them — the
+    # tree-map path stores then re-reads them, so parity requires the
+    # rounded values, not the transient f32 ones.
+    m16 = (b1 * m_ref[...].astype(jnp.float32) + (1.0 - b1) * g).astype(
+        jnp.bfloat16
+    )
+    v16 = (b2 * v_ref[...].astype(jnp.float32) + (1.0 - b2) * g * g).astype(
+        jnp.bfloat16
+    )
+    c1 = c_ref[0, 0]
+    c2 = c_ref[0, 1]
+    mhat = m16.astype(jnp.float32) / c1
+    vhat = v16.astype(jnp.float32) / c2
+    p = p_ref[...]
+    po_ref[...] = p - lr * (mhat / (jnp.sqrt(vhat) + eps) + wd * p)
+    mo_ref[...] = m16
+    vo_ref[...] = v16
+
+
+def _pad2d(x, rows):
+    n = x.size
+    flat = x.reshape(-1)
+    total = rows * COLS
+    if total != n:
+        flat = jnp.pad(flat, (0, total - n))
+    return flat.reshape(rows, COLS)
+
+
+def _leaf_update(p, g, m, v, c12, *, lr, b1, b2, eps, wd, interpret):
+    # No jit here: the caller (train_step) is the jit boundary, and the
+    # input_output_aliases below give the in-place behavior under it.
+    from jax.experimental import pallas as pl
+
+    shape, dtype = p.shape, p.dtype
+    n = p.size
+    rows = -(-n // COLS)
+    rows = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
+    p2 = _pad2d(p.astype(jnp.float32), rows)
+    g2 = _pad2d(g.astype(jnp.float32), rows)
+    m2 = _pad2d(m, rows)
+    v2 = _pad2d(v, rows)
+    blk = lambda: pl.BlockSpec(  # noqa: E731 — dtypes live in out_shape
+        (BLOCK_ROWS, COLS), lambda i: (i, 0)
+    )
+    po, mo, vo = pl.pallas_call(
+        functools.partial(_kernel, lr=lr, b1=b1, b2=b2, eps=eps, wd=wd),
+        grid=(rows // BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),  # c1, c2
+            blk(), blk(), blk(), blk(),
+        ],
+        out_specs=[blk(), blk(), blk()],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, COLS), jnp.float32),
+            jax.ShapeDtypeStruct((rows, COLS), jnp.bfloat16),
+            jax.ShapeDtypeStruct((rows, COLS), jnp.bfloat16),
+        ],
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(c12, p2, g2, m2, v2)
+    if rows * COLS == n:
+        unpad = lambda x: x.reshape(shape)  # noqa: E731 — reshape only
+    else:
+        unpad = lambda x: x.reshape(-1)[:n].reshape(shape)  # noqa: E731
+    return unpad(po).astype(dtype), unpad(mo), unpad(vo)
+
+
+def fused_adamw(learning_rate: float, b1=0.9, b2=0.999, eps=1e-8, wd=1e-4):
+    """(init, apply) with the same state as model.adamw_bf16_moments
+    ((mu, nu, count), both moments bf16) but a one-sweep apply that
+    returns NEW PARAMS directly (the add is part of the fusion).
+
+    apply(params, grads, state) -> (new_params, new_state).
+    """
+
+    def init(params):
+        zeros16 = lambda p: jnp.zeros_like(p, dtype=jnp.bfloat16)  # noqa: E731
+        return (
+            jax.tree.map(zeros16, params),
+            jax.tree.map(zeros16, params),
+            jnp.zeros((), jnp.int32),
+        )
+
+    def apply(params, grads, state):
+        mu, nu, count = state
+        count = count + 1
+        cf = count.astype(jnp.float32)
+        c12 = jnp.stack([1.0 - b1**cf, 1.0 - b2**cf]).reshape(1, 2)
+        interpret = jax.default_backend() != "tpu"
+        flat, treedef = jax.tree.flatten(params)
+        fm = jax.tree.flatten(mu)[0]
+        fv = jax.tree.flatten(nu)[0]
+        fg = jax.tree.flatten(grads)[0]
+        outs = [
+            _leaf_update(
+                p, g, m, v, c12,
+                lr=learning_rate, b1=b1, b2=b2, eps=eps, wd=wd,
+                interpret=interpret,
+            )
+            for p, g, m, v in zip(flat, fg, fm, fv)
+        ]
+        new_p = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        new_m = jax.tree.unflatten(treedef, [o[1] for o in outs])
+        new_v = jax.tree.unflatten(treedef, [o[2] for o in outs])
+        return new_p, (new_m, new_v, count)
+
+    return init, apply
