@@ -210,6 +210,28 @@ class TestProxy:
         proxy._note_connect_failure(("127.0.0.1", 1))
         assert read_registration(str(tmp_path)) is None
 
+    def test_restore_falls_back_to_rename_without_hardlinks(
+        self, tmp_path, monkeypatch
+    ):
+        """A non-stale registration caught mid-drop must survive even on
+        volumes without hard-link support (NFS root_squash, FUSE): the
+        os.link restore falls back to os.replace instead of deleting the
+        only copy."""
+        write_registration(str(tmp_path), "10.0.0.9", 7)
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=1,
+            min_fail_window=0, registration_grace=0,
+        )
+
+        def no_links(*a, **k):
+            raise PermissionError("hard links not supported")
+
+        monkeypatch.setattr(os, "link", no_links)
+        # Probed endpoint differs from the registered one → restore path.
+        proxy._drop_registration(("10.9.9.9", 1))
+        assert read_registration(str(tmp_path)) == ("10.0.0.9", 7)
+        assert os.listdir(tmp_path) == ["coordinator"]
+
     def test_timeout_class_failures_need_the_long_window(self, tmp_path):
         """Timeout/unreachable failures look identical to a transient
         daemon↔workload partition against a LIVE coordinator, so they may
