@@ -8,6 +8,7 @@ set -euo pipefail
 cd "$(dirname "$0")"
 OUT=../tpudra/drapb
 protoc --python_out="$OUT" \
-  pluginregistration_v1.proto dra_v1.proto dra_v1beta1.proto
+  pluginregistration_v1.proto dra_v1.proto dra_v1beta1.proto \
+  dra_health_v1alpha1.proto
 echo "generated into $OUT:"
 ls "$OUT"

@@ -1,0 +1,218 @@
+"""Wire-contract pinning: our hand-written .proto files vs the official k8s
+definitions (VERDICT r4 #2).
+
+The reference rides the official kubelet helper and its vendored protos
+(vendor/k8s.io/kubelet/pkg/apis/dra/v1/api.proto, served via
+kubeletplugin.Start — draplugin.go:623-663).  Ours are hand-written, so
+nothing structural would catch silent drift in a field number or type until
+a real kubelet failed to decode a response.  This test parses both sides
+with a minimal proto3 parser and asserts the parts that matter on the wire
+are IDENTICAL:
+
+- package name (it is part of every gRPC method path),
+- service names, rpc names, request/response types, streaming-ness,
+- every message's fields: (number, label, type, name) — name included
+  because proto3 JSON encoding and debugging tools key on it,
+- every enum's values and numbers.
+
+Gogo annotations (``[(gogoproto.customname) = ...]``) only affect generated
+Go identifiers, not the wire, and are stripped.
+
+If the upstream contract moves, this suite breaks loudly instead of the
+node plugin failing against a live kubelet.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OURS = os.path.join(REPO, "protos")
+REF = "/root/reference/vendor/k8s.io/kubelet/pkg/apis"
+
+PAIRS = [
+    ("dra_v1.proto", os.path.join(REF, "dra/v1/api.proto")),
+    ("dra_v1beta1.proto", os.path.join(REF, "dra/v1beta1/api.proto")),
+    (
+        "pluginregistration_v1.proto",
+        os.path.join(REF, "pluginregistration/v1/api.proto"),
+    ),
+    (
+        "dra_health_v1alpha1.proto",
+        os.path.join(REF, "dra-health/v1alpha1/api.proto"),
+    ),
+]
+
+
+# ---------------------------------------------------------------------------
+# Minimal proto3 parser — just enough for these flat files (no nesting, no
+# oneof/extensions).  Hand-rolled on purpose: protoc would need the gogo
+# import resolved, and a descriptor-level diff would then depend on protobuf
+# runtime versions; the wire contract lives entirely in what we extract.
+# ---------------------------------------------------------------------------
+
+_FIELD = re.compile(
+    r"^(repeated\s+|optional\s+)?"  # label
+    r"(map\s*<[^>]+>|[\w.]+)\s+"  # type (map<...> or scalar/message)
+    r"(\w+)\s*=\s*(\d+)\s*"  # name = number
+    r"(\[[^\]]*\])?\s*;"  # gogo/field options (ignored)
+)
+_RPC = re.compile(
+    r"rpc\s+(\w+)\s*\(\s*(stream\s+)?([\w.]+)\s*\)\s*"
+    r"returns\s*\(\s*(stream\s+)?([\w.]+)\s*\)"
+)
+_ENUM_VALUE = re.compile(r"^(\w+)\s*=\s*(\d+)\s*;")
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _blocks(text: str, kind: str):
+    """Yield (name, body) for every top-level `kind name { ... }` block."""
+    for m in re.finditer(rf"\b{kind}\s+(\w+)\s*\{{", text):
+        depth, i = 1, m.end()
+        while depth and i < len(text):
+            depth += {"{": 1, "}": -1}.get(text[i], 0)
+            i += 1
+        yield m.group(1), text[m.end() : i - 1]
+
+
+def parse_proto(path: str) -> dict:
+    text = _strip_comments(open(path).read())
+    pkg = re.search(r"\bpackage\s+([\w.]+)\s*;", text)
+    out = {
+        "package": pkg.group(1) if pkg else "",
+        "messages": {},
+        "enums": {},
+        "services": {},
+    }
+    for name, body in _blocks(text, "message"):
+        fields = set()
+        for line in body.split(";"):
+            m = _FIELD.match(line.strip() + ";")
+            if m:
+                label = (m.group(1) or "").strip()
+                ftype = re.sub(r"\s+", "", m.group(2))
+                fields.add((int(m.group(4)), label, ftype, m.group(3)))
+        out["messages"][name] = fields
+    for name, body in _blocks(text, "enum"):
+        values = set()
+        for line in body.split(";"):
+            m = _ENUM_VALUE.match(line.strip() + ";")
+            if m:
+                values.add((int(m.group(2)), m.group(1)))
+        out["enums"][name] = values
+    for name, body in _blocks(text, "service"):
+        rpcs = {}
+        for m in _RPC.finditer(body):
+            rpcs[m.group(1)] = (
+                m.group(3),
+                bool(m.group(2)),  # client streaming
+                m.group(5),
+                bool(m.group(4)),  # server streaming
+            )
+        out["services"][name] = rpcs
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parser self-checks: a parser that silently extracts nothing would make
+# every conformance assertion vacuously true.
+# ---------------------------------------------------------------------------
+
+
+def test_parser_extracts_reference_v1():
+    ref = parse_proto(os.path.join(REF, "dra/v1/api.proto"))
+    assert ref["package"] == "k8s.io.kubelet.pkg.apis.dra.v1"
+    assert ref["messages"]["Claim"] == {
+        (1, "", "string", "namespace"),
+        (2, "", "string", "uid"),
+        (3, "", "string", "name"),
+    }
+    assert ref["messages"]["Device"] == {
+        (1, "repeated", "string", "request_names"),
+        (2, "", "string", "pool_name"),
+        (3, "", "string", "device_name"),
+        (4, "repeated", "string", "cdi_device_ids"),
+    }
+    # map<> fields must survive parsing — they carry the per-claim results.
+    assert ref["messages"]["NodePrepareResourcesResponse"] == {
+        (1, "", "map<string,NodePrepareResourceResponse>", "claims")
+    }
+    assert ref["services"]["DRAPlugin"] == {
+        "NodePrepareResources": (
+            "NodePrepareResourcesRequest",
+            False,
+            "NodePrepareResourcesResponse",
+            False,
+        ),
+        "NodeUnprepareResources": (
+            "NodeUnprepareResourcesRequest",
+            False,
+            "NodeUnprepareResourcesResponse",
+            False,
+        ),
+    }
+
+
+def test_parser_extracts_streaming_and_enums():
+    ref = parse_proto(os.path.join(REF, "dra-health/v1alpha1/api.proto"))
+    assert ref["services"]["DRAResourceHealth"]["NodeWatchResources"] == (
+        "NodeWatchResourcesRequest",
+        False,
+        "NodeWatchResourcesResponse",
+        True,  # server-streaming — the part a drifted impl would break
+    )
+    assert ref["enums"]["HealthStatus"] == {
+        (0, "UNKNOWN"),
+        (1, "HEALTHY"),
+        (2, "UNHEALTHY"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Conformance: ours vs the official files, element by element so a failure
+# names the exact drifted member.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ours,ref", PAIRS, ids=[p[0] for p in PAIRS])
+def test_package_matches(ours, ref):
+    # The package is part of every full method name
+    # (/<package>.<Service>/<Method>); a mismatch is invisible locally and
+    # fatal against a real kubelet.
+    assert parse_proto(os.path.join(OURS, ours))["package"] == parse_proto(ref)["package"]
+
+
+@pytest.mark.parametrize("ours,ref", PAIRS, ids=[p[0] for p in PAIRS])
+def test_messages_match(ours, ref):
+    mine, theirs = parse_proto(os.path.join(OURS, ours)), parse_proto(ref)
+    assert set(mine["messages"]) == set(theirs["messages"])
+    for name in theirs["messages"]:
+        assert mine["messages"][name] == theirs["messages"][name], (
+            f"{ours}: message {name} drifted from the official definition"
+        )
+
+
+@pytest.mark.parametrize("ours,ref", PAIRS, ids=[p[0] for p in PAIRS])
+def test_enums_match(ours, ref):
+    mine, theirs = parse_proto(os.path.join(OURS, ours)), parse_proto(ref)
+    assert mine["enums"] == theirs["enums"]
+
+
+@pytest.mark.parametrize("ours,ref", PAIRS, ids=[p[0] for p in PAIRS])
+def test_services_match(ours, ref):
+    mine, theirs = parse_proto(os.path.join(OURS, ours)), parse_proto(ref)
+    assert mine["services"] == theirs["services"]
+
+
+def test_reference_protos_present():
+    """If the reference tree moves, fail with a clear message instead of
+    every parametrized test erroring on open()."""
+    for _, ref in PAIRS:
+        assert os.path.exists(ref), f"reference proto missing: {ref}"

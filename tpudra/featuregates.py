@@ -34,6 +34,13 @@ PASSTHROUGH_SUPPORT = "PassthroughSupport"
 #: Device health checking through the tpuinfo library (XID-analog interrupts).
 TPU_DEVICE_HEALTH_CHECK = "TPUDeviceHealthCheck"
 
+#: Serve the kubelet-facing v1alpha1.DRAResourceHealth gRPC stream on the
+#: plugin socket (beyond-reference: the k8s helper registers this service
+#: when a plugin implements it, vendored kubeletplugin/draplugin.go:623-663,
+#: but the reference driver never does).  Requires TPUDeviceHealthCheck —
+#: the stream is fed by the same health monitor.
+DRA_RESOURCE_HEALTH_SERVICE = "DRAResourceHealthService"
+
 #: Dynamic per-chip TensorCore partitioning (the dynamic-MIG analog).
 DYNAMIC_PARTITIONING = "DynamicPartitioning"
 
@@ -79,6 +86,7 @@ DEFAULT_FEATURE_GATES: dict[str, tuple[VersionedSpec, ...]] = {
     DYNAMIC_PARTITIONING: (VersionedSpec((0, 1), False, Stage.ALPHA),),
     SIMULATED_PARTITIONS: (VersionedSpec((0, 1), False, Stage.ALPHA),),
     TPU_DEVICE_HEALTH_CHECK: (VersionedSpec((0, 1), False, Stage.ALPHA),),
+    DRA_RESOURCE_HEALTH_SERVICE: (VersionedSpec((0, 1), False, Stage.ALPHA),),
     COMPUTE_DOMAIN_CLIQUES: (VersionedSpec((0, 1), True, Stage.BETA),),
     CRASH_ON_ICI_FABRIC_ERRORS: (VersionedSpec((0, 1), True, Stage.BETA),),
 }
@@ -191,6 +199,13 @@ class FeatureGates:
             raise FeatureGateError(
                 f"feature gate {COMPUTE_DOMAIN_CLIQUES} requires "
                 f"{DOMAIN_DAEMONS_WITH_DNS_NAMES} to also be enabled"
+            )
+        if self.enabled(DRA_RESOURCE_HEALTH_SERVICE) and not self.enabled(
+            TPU_DEVICE_HEALTH_CHECK
+        ):
+            raise FeatureGateError(
+                f"feature gate {DRA_RESOURCE_HEALTH_SERVICE} requires "
+                f"{TPU_DEVICE_HEALTH_CHECK} to also be enabled"
             )
         for other in (PASSTHROUGH_SUPPORT, TPU_DEVICE_HEALTH_CHECK, MULTI_PROCESS_SHARING):
             if self.enabled(DYNAMIC_PARTITIONING) and self.enabled(other):

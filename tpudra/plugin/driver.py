@@ -80,6 +80,10 @@ class Driver:
         )
         self._unhealthy: set[str] = set()
         self._unhealthy_lock = threading.Lock()
+        # Per-device last-status-change unix time for the DRAResourceHealth
+        # stream; devices absent here report the startup timestamp.
+        self._health_changed_at: dict[str, float] = {}
+        self._health_start_ts = time.time()
         # Serializes the whole snapshot→build→apply publication path: the
         # health thread and prepare RPC threads both publish, and an
         # interleaving could re-advertise silicon just marked unhealthy.
@@ -109,6 +113,24 @@ class Driver:
             n = self.state.destroy_unknown_partitions()
             if n:
                 logger.warning("startup reconciliation destroyed %d unknown partitions", n)
+        if featuregates.enabled(featuregates.DRA_RESOURCE_HEALTH_SERVICE):
+            # Implements-it-then-serve: the broadcaster must exist before the
+            # socket starts so the service is registered and advertised
+            # (helper semantics, draplugin.go:623-663).
+            from tpudra.plugin.healthservice import (
+                HealthBroadcaster,
+                snapshot_from_driver_state,
+            )
+
+            self._sockets.health_broadcaster = HealthBroadcaster(
+                snapshot_from_driver_state(
+                    allocatable=lambda: self.state.allocatable,
+                    unhealthy=self.unhealthy_devices,
+                    changed_at=self._health_timestamps,
+                    start_ts=int(self._health_start_ts),
+                    pool=alloc.pool_name(self._config.node_name),
+                )
+            )
         self._sockets.start()
         if featuregates.enabled(featuregates.TPU_DEVICE_HEALTH_CHECK):
             self._health_thread = threading.Thread(
@@ -272,12 +294,18 @@ class Driver:
             before = set(self._unhealthy)
             self._unhealthy.update(names)
             changed = self._unhealthy != before
+            if changed:
+                now = time.time()
+                for name in self._unhealthy - before:
+                    self._health_changed_at[name] = now
         if changed:
             logger.error(
                 "marking unhealthy after %s (%s): %s — republishing without them",
                 event.kind, event.detail, sorted(names),
             )
             self.publish_resources()
+            if self._sockets.health_broadcaster is not None:
+                self._sockets.health_broadcaster.notify()
 
     def _devices_for_event(self, event: HealthEvent) -> set[str]:
         if event.partition_uuid:
@@ -296,3 +324,7 @@ class Driver:
     def unhealthy_devices(self) -> set[str]:
         with self._unhealthy_lock:
             return set(self._unhealthy)
+
+    def _health_timestamps(self) -> dict[str, float]:
+        with self._unhealthy_lock:
+            return dict(self._health_changed_at)

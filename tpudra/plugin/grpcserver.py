@@ -138,6 +138,11 @@ class PluginSockets:
         self._registered = threading.Event()
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
+        # Optional third service on the DRA socket: the kubelet-facing
+        # v1alpha1.DRAResourceHealth stream.  Mirrors the official helper's
+        # implements-it-then-serve-and-advertise semantics
+        # (draplugin.go:623-663): set before start() or not at all.
+        self.health_broadcaster = None  # Optional[HealthBroadcaster]
 
     # ------------------------------------------------------------ DRA bridge
 
@@ -204,12 +209,20 @@ class PluginSockets:
 
     # ---------------------------------------------------------- registration
 
+    def supported_services(self) -> list[str]:
+        services = list(SUPPORTED_SERVICES)
+        if self.health_broadcaster is not None:
+            from tpudra.plugin.healthservice import HEALTH_SERVICE
+
+            services.append(HEALTH_SERVICE)
+        return services
+
     def _get_info(self, request, context):
         return regpb.PluginInfo(
             type=DRA_PLUGIN_TYPE,
             name=self.driver_name,
             endpoint=os.path.abspath(self.dra_socket_path),
-            supported_versions=SUPPORTED_SERVICES,
+            supported_versions=self.supported_services(),
         )
 
     def _notify(self, request, context):
@@ -233,13 +246,13 @@ class PluginSockets:
     def start(self) -> None:
         # DRA service first so the endpoint is live before kubelet can
         # discover the registration socket (draplugin.go:640 ordering).
-        self._dra_server = _serve(
-            self.dra_socket_path,
-            (
-                self._dra_handlers(_V1_SERVICE, drapb),
-                self._dra_handlers(_V1BETA1_SERVICE, drapb_beta),
-            ),
-        )
+        dra_handlers = [
+            self._dra_handlers(_V1_SERVICE, drapb),
+            self._dra_handlers(_V1BETA1_SERVICE, drapb_beta),
+        ]
+        if self.health_broadcaster is not None:
+            dra_handlers.append(self.health_broadcaster.handler())
+        self._dra_server = _serve(self.dra_socket_path, tuple(dra_handlers))
         self._reg_server = _serve(
             self.registration_socket_path,
             (
@@ -260,6 +273,10 @@ class PluginSockets:
         )
 
     def stop(self) -> None:
+        if self.health_broadcaster is not None:
+            # Unblock stream threads waiting on the broadcaster condition so
+            # the grace period below doesn't have to kill them.
+            self.health_broadcaster.stop()
         for server in (self._reg_server, self._dra_server):
             if server is not None:
                 server.stop(grace=1.0).wait()

@@ -1,0 +1,181 @@
+"""v1alpha1.DRAResourceHealth: kubelet-facing device-health streaming.
+
+Beyond-reference: the official k8s helper registers this gRPC service on the
+plugin socket when the plugin implements it (vendored
+kubeletplugin/draplugin.go:623-663 — service detection at :624 appends
+``v1alpha1.DRAResourceHealth`` to supported services, registration at
+:660-663), but the reference driver never implements it.  We already run the
+health monitor that republishes ResourceSlices without failed silicon
+(plugin/driver.py:256-294); this module streams the same truth to kubelet so
+pods using an affected device get a ResourceHealthStatus signal instead of
+silently computing on a sick chip.
+
+Contract (protos/dra_health_v1alpha1.proto, pinned against the official file
+by tests/test_proto_conformance.py): every ``NodeWatchResourcesResponse`` is
+a COMPLETE snapshot of the driver's devices — kubelet reconciles against its
+cache and ages devices missing from the snapshot to Unknown after a timeout,
+so the stream also re-sends periodically as a keepalive.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+import grpc
+
+from tpudra.drapb import dra_health_v1alpha1_pb2 as healthpb
+
+logger = logging.getLogger(__name__)
+
+HEALTH_SERVICE = "v1alpha1.DRAResourceHealth"
+
+#: Re-send the full snapshot at least this often so kubelet's staleness
+#: timeout never fires while the stream is healthy.
+DEFAULT_KEEPALIVE_S = 60.0
+
+
+@dataclass(frozen=True)
+class DeviceHealthInfo:
+    """One device's health as the snapshot provider reports it."""
+
+    pool_name: str
+    device_name: str
+    healthy: bool
+    #: Unix seconds when the plugin last (re)determined this status.
+    last_updated: int
+
+
+# Returns the complete current device-health snapshot.
+SnapshotFn = Callable[[], list[DeviceHealthInfo]]
+
+
+class HealthBroadcaster:
+    """Fans one health-snapshot source out to any number of kubelet streams.
+
+    ``notify()`` wakes every open stream to re-read the snapshot; each stream
+    additionally re-sends on ``keepalive_s`` idle so kubelet's reconcile
+    cache never ages our devices to Unknown.  Streams exit when the client
+    hangs up or ``stop()`` is called (server shutdown).
+    """
+
+    def __init__(self, snapshot: SnapshotFn, keepalive_s: float = DEFAULT_KEEPALIVE_S):
+        self._snapshot = snapshot
+        self._keepalive_s = keepalive_s
+        self._cond = threading.Condition()
+        self._seq = 0
+        self._stopped = False
+
+    def notify(self) -> None:
+        with self._cond:
+            self._seq += 1
+            self._cond.notify_all()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+
+    def _build_response(self) -> healthpb.NodeWatchResourcesResponse:
+        resp = healthpb.NodeWatchResourcesResponse()
+        for info in self._snapshot():
+            d = resp.devices.add()
+            d.device.pool_name = info.pool_name
+            d.device.device_name = info.device_name
+            d.health = healthpb.HEALTHY if info.healthy else healthpb.UNHEALTHY
+            d.last_updated_time = info.last_updated
+        return resp
+
+    def watch(self, request, context) -> Iterator[healthpb.NodeWatchResourcesResponse]:
+        """The NodeWatchResources handler: initial complete snapshot, then a
+        fresh snapshot on every notify() and on keepalive expiry."""
+        logger.info("kubelet opened a DRAResourceHealth watch")
+        with self._cond:
+            seen = self._seq
+        yield self._build_response()
+        while context.is_active():
+            with self._cond:
+                if self._stopped:
+                    return
+                if self._seq == seen:
+                    self._cond.wait(timeout=self._keepalive_s)
+                if self._stopped:
+                    return
+                seen = self._seq
+            if not context.is_active():
+                return
+            yield self._build_response()
+
+    def handler(self) -> grpc.GenericRpcHandler:
+        return grpc.method_handlers_generic_handler(
+            HEALTH_SERVICE,
+            {
+                "NodeWatchResources": grpc.unary_stream_rpc_method_handler(
+                    self.watch,
+                    request_deserializer=healthpb.NodeWatchResourcesRequest.FromString,
+                    response_serializer=(
+                        healthpb.NodeWatchResourcesResponse.SerializeToString
+                    ),
+                )
+            },
+        )
+
+
+class HealthWatchClient:
+    """The kubelet side of the stream (tests, e2e, bench)."""
+
+    def __init__(self, path: str):
+        import os
+
+        self._channel = grpc.insecure_channel("unix:" + os.path.abspath(path))
+
+    def watch(self, timeout: float | None = None) -> Iterator[dict]:
+        """Yields snapshots as {device_name: {"healthy": bool, "pool": str,
+        "ts": int}} dicts; raises grpc.RpcError on stream errors."""
+        rpc = self._channel.unary_stream(
+            f"/{HEALTH_SERVICE}/NodeWatchResources",
+            request_serializer=healthpb.NodeWatchResourcesRequest.SerializeToString,
+            response_deserializer=healthpb.NodeWatchResourcesResponse.FromString,
+        )
+        for resp in rpc(healthpb.NodeWatchResourcesRequest(), timeout=timeout):
+            yield {
+                d.device.device_name: {
+                    "healthy": d.health == healthpb.HEALTHY,
+                    "pool": d.device.pool_name,
+                    "ts": d.last_updated_time,
+                }
+                for d in resp.devices
+            }
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+def snapshot_from_driver_state(
+    allocatable: Callable[[], dict],
+    unhealthy: Callable[[], set[str]],
+    changed_at: Callable[[], dict],
+    start_ts: int,
+    pool: str,
+) -> SnapshotFn:
+    """Builds the Driver's snapshot function: every allocatable device,
+    HEALTHY unless the health monitor marked it, timestamped with the last
+    status-change time (startup time until a first event)."""
+
+    def snapshot() -> list[DeviceHealthInfo]:
+        bad = unhealthy()
+        stamps = changed_at()
+        return [
+            DeviceHealthInfo(
+                pool_name=pool,
+                device_name=name,
+                healthy=name not in bad,
+                last_updated=int(stamps.get(name, start_ts)),
+            )
+            for name in sorted(allocatable())
+        ]
+
+    return snapshot
