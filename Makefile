@@ -4,7 +4,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast bench image helm-render clean
+.PHONY: all native test test-fast bats bats-real bench image helm-render clean
 
 all: native test
 
@@ -20,6 +20,19 @@ test-fast:
 	  --ignore=tests/test_e2e.py \
 	  --ignore=tests/test_computedomain.py \
 	  --ignore=tests/test_native.py
+
+# Whole e2e suite under minibats (fast runner).
+bats: native
+	for f in tests/bats/test_*.bats; do \
+	  echo "== $$f"; bash tests/bats/minibats.sh $$f || exit 1; done
+
+# Real-bats-semantics lane (tests/bats/vendor/rbats): bats-core's process
+# model — fresh process per test, exported-env-only state from setup_file.
+# File list shared with tests/test_bats.py via vendor/lane-files.txt.
+bats-real: native
+	bash tests/bats/vendor/rbats \
+	  tests/bats/vendor/selftest/semantics.bats \
+	  $$(grep -v '^#' tests/bats/vendor/lane-files.txt | sed 's|^|tests/bats/|')
 
 bench: native
 	python bench.py

@@ -352,11 +352,19 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
     // (orphaning previously simulated partitions would leak them forever:
     // list/delete would stop seeing entries the checkpoint still names).
     // Fresh nodes (no file) get the new attest-false default.
+    // An EXPLICITLY-set TPUINFO_STATE_FILE was the pre-attestation opt-in
+    // mechanism and keeps working as one — only the built-in default path
+    // needs the new opt-ins (fresh node + default path = attest-false).
     {
-      std::string reg = getenv_or("TPUINFO_STATE_FILE", "/var/run/tpuinfo-state");
+      const char* explicit_reg = ::getenv("TPUINFO_STATE_FILE");
+      std::string reg = explicit_reg != nullptr && *explicit_reg != '\0'
+                            ? explicit_reg
+                            : "/var/run/tpuinfo-state";
       struct stat st {};
       bool legacy = ::stat(reg.c_str(), &st) == 0 && st.st_size > 0;
-      if (getenv_or("TPUINFO_SIMULATE_PARTITIONS", "") == "1" || legacy)
+      bool opted_in = getenv_or("TPUINFO_SIMULATE_PARTITIONS", "") == "1" ||
+                      (explicit_reg != nullptr && *explicit_reg != '\0');
+      if (opted_in || legacy)
         h->state_file = reg;
       else
         h->state_file = "";

@@ -1,0 +1,157 @@
+#!/usr/bin/env bats
+# The TPU_WORKER_HOSTNAMES reachability contract (ADVICE r4, medium):
+# multi-host channel workloads must be host-networked — the emitted worker
+# hostnames resolve to node IPs, where libtpu's inter-worker ports only
+# exist under hostNetwork.  Pod-networked pods are refused at prepare with
+# an actionable message, unless they override the hostnames with names
+# that resolve to the pods themselves (tpu.google.com/worker-hostnames,
+# headless-service style).  cdplugin/state.py:_worker_hostnames_policy.
+
+load helpers.sh
+
+setup_file() {
+  cluster_up --nodes 2 --cd
+}
+
+teardown_file() {
+  cluster_down
+}
+
+@test "domain forms" {
+  cat > "$TPUDRA_STATE/hostnet-cd.yaml" <<'EOF'
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: hostnet
+---
+apiVersion: resource.tpu.google.com/v1beta1
+kind: ComputeDomain
+metadata:
+  namespace: hostnet
+  name: hostnet
+spec:
+  numNodes: 2
+  channel:
+    resourceClaimTemplate:
+      name: hostnet-rct
+    allocationMode: Single
+EOF
+  kubectl apply -f "$TPUDRA_STATE/hostnet-cd.yaml"
+}
+
+@test "annotated pod-networked pod gets the override names and reaches a peer through them" {
+  # The override names here ("localhost") resolve to the pods themselves in
+  # the hermetic cluster — exactly the headless-service property the
+  # annotation promises in production.  Worker 0 binds a libtpu-style
+  # bootstrap port; worker 1 connects THROUGH the name emitted in its own
+  # TPU_WORKER_HOSTNAMES — reachability of a libtpu port via the emitted
+  # names, not just their presence.
+  BOOT_PORT="$TPUDRA_SCRATCH_PORT"
+  for n in 0 1; do
+    cat >> "$TPUDRA_STATE/annotated.yaml" <<EOF
+---
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: hostnet
+  name: ann-worker-$n
+  annotations:
+    tpu.google.com/worker-hostnames: "localhost,localhost"
+spec:
+  restartPolicy: Never
+  nodeSelector:
+    kubernetes.io/hostname: node-$n
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      env:
+        - name: BOOT_PORT
+          value: "$BOOT_PORT"
+      command: ["python", "-c"]
+      args:
+        - |
+          import os, socket, time
+          names = os.environ["TPU_WORKER_HOSTNAMES"].split(",")
+          assert names == ["localhost", "localhost"], names
+          port = int(os.environ["BOOT_PORT"])
+          wid = int(os.environ["TPU_WORKER_ID"])
+          if wid == 0:
+              srv = socket.socket()
+              srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+              srv.bind((names[0], port))
+              srv.listen(1)
+              srv.settimeout(240)
+              conn, _ = srv.accept()
+              assert conn.recv(5) == b"libtp"
+              conn.sendall(b"u-ok")
+              print("RESULT bootstrap served")
+          else:
+              deadline = time.time() + 240
+              while True:
+                  try:
+                      c = socket.create_connection((names[0], port), timeout=5)
+                      break
+                  except OSError:
+                      if time.time() > deadline: raise
+                      time.sleep(1)
+              c.sendall(b"libtp")
+              assert c.recv(4) == b"u-ok"
+              print("RESULT bootstrap reached worker-0 via emitted name")
+      resources:
+        claims:
+          - name: channel
+  resourceClaims:
+    - name: channel
+      resourceClaimTemplateName: hostnet-rct
+EOF
+  done
+  kubectl apply -f "$TPUDRA_STATE/annotated.yaml"
+  wait_until 300 pod_succeeded ann-worker-0 hostnet
+  wait_until 300 pod_succeeded ann-worker-1 hostnet
+  run kubectl logs ann-worker-1 -n hostnet
+  [[ "$output" == *"RESULT bootstrap reached worker-0 via emitted name"* ]]
+}
+
+@test "pod-networked multi-host channel claim is refused with the contract message" {
+  cat > "$TPUDRA_STATE/podnet.yaml" <<'EOF'
+apiVersion: v1
+kind: Pod
+metadata:
+  namespace: hostnet
+  name: podnet-worker
+spec:
+  restartPolicy: Never
+  nodeSelector:
+    kubernetes.io/hostname: node-0
+  containers:
+    - name: ctr
+      image: tpudra-workload:latest
+      command: ["python", "-c"]
+      args: ["print('must never run')"]
+      resources:
+        claims:
+          - name: channel
+  resourceClaims:
+    - name: channel
+      resourceClaimTemplateName: hostnet-rct
+EOF
+  kubectl apply -f "$TPUDRA_STATE/podnet.yaml"
+  # The plugin refuses at prepare; the sim kubelet surfaces the message on
+  # the pod's event annotation (sim.tpu.google.com/event) and the pod
+  # never starts.
+  refused() {
+    kubectl get pod podnet-worker -n hostnet -o json | grep -q "pod-networked pod"
+  }
+  wait_until 180 refused
+  [ "$(pod_phase podnet-worker hostnet)" != "Succeeded" ]
+  run kubectl get pod podnet-worker -n hostnet -o json
+  [[ "$output" == *"hostNetwork: true"* ]]
+  [[ "$output" == *"tpu.google.com/worker-hostnames"* ]]
+  kubectl delete pod podnet-worker -n hostnet
+}
+
+@test "teardown" {
+  kubectl delete pod ann-worker-0 ann-worker-1 -n hostnet --ignore-not-found
+  kubectl delete computedomains hostnet -n hostnet
+  wait_until 120 sh -c "! kubectl get computedomains -n hostnet -o name | grep -q hostnet"
+}

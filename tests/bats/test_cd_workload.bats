@@ -44,6 +44,11 @@ metadata:
   name: worker-$n
 spec:
   restartPolicy: Never
+  # Multi-host channel workloads are host-networked (the GKE podslice
+  # contract): TPU_WORKER_HOSTNAMES resolves to node IPs, so libtpu's
+  # inter-worker ports must bind there.  The plugin refuses pod-networked
+  # multi-host grants (cdplugin/state.py, test_cd_hostnet.bats).
+  hostNetwork: true
   nodeSelector:
     kubernetes.io/hostname: node-$n
   containers:

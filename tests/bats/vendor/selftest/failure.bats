@@ -1,0 +1,24 @@
+# rbats self-test for FAILURE semantics — every test here is expected to
+# fail; the pytest wrapper asserts the exact TAP verdicts.  A marker file
+# (argument via $RBATS_SELFTEST_DIR) records that teardown ran even for the
+# failing test.
+
+teardown() {
+  echo "teardown-ran-for-$BATS_TEST_NUMBER" >> "${RBATS_SELFTEST_DIR:-/tmp}/teardown.log"
+  if [ "$BATS_TEST_DESCRIPTION" = "failing teardown fails a passing test" ]; then
+    false
+  fi
+}
+
+@test "plain failure is reported" {
+  false
+}
+
+@test "errexit is live mid-body" {
+  false
+  echo "should never print"
+}
+
+@test "failing teardown fails a passing test" {
+  true
+}

@@ -1,0 +1,48 @@
+# rbats self-test: the bats-core behaviors that differ from minibats.
+# Passing under rbats proves the runner enforces real-bats state passing.
+
+setup_file() {
+  LEAKY_VAR="should-not-reach-tests"
+  export EXPORTED_VAR="reaches-tests"
+}
+
+@test "exported setup_file var reaches test" {
+  [ "${EXPORTED_VAR:-}" = "reaches-tests" ]
+}
+
+@test "non-exported setup_file var does NOT reach test (process isolation)" {
+  [ -z "${LEAKY_VAR:-}" ]
+}
+
+@test "skip is reported with reason" {
+  skip "because reasons"
+  false
+}
+
+@test "run captures status and output" {
+  run bash -c 'echo hi; exit 3'
+  [ "$status" -eq 3 ]
+  [ "$output" = "hi" ]
+  [ "${lines[0]}" = "hi" ]
+}
+
+@test "run -N asserts the expected status" {
+  run -3 bash -c 'exit 3'
+}
+
+@test "run ! asserts failure" {
+  run ! false
+}
+
+@test "bats tmpdirs exist and nest correctly" {
+  [ -d "$BATS_RUN_TMPDIR" ]
+  [ -d "$BATS_FILE_TMPDIR" ]
+  [ -d "$BATS_TEST_TMPDIR" ]
+  [[ "$BATS_TEST_TMPDIR" == "$BATS_FILE_TMPDIR"/* ]]
+}
+
+@test "test metadata variables are set" {
+  [ "$BATS_TEST_NUMBER" -ge 1 ]
+  [ -n "$BATS_TEST_DESCRIPTION" ]
+  [ -f "$BATS_TEST_FILENAME" ]
+}

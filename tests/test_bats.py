@@ -5,6 +5,13 @@ hardware CI runners; ours runs hermetically — minibats drives each file
 against a per-file simulated cluster (clusterctl up: fake apiserver + real
 driver binaries + scheduler/kubelet sim).  Real bats-core can run the same
 files against a real cluster via the kubectl shim.
+
+Two runners exercise the same files (VERDICT r4 #4): minibats (fast, leaky
+setup_file scoping) and rbats (tests/bats/vendor/ — bats-core's documented
+process model: fresh process per test, exported-env-only state passing,
+per-test re-sourcing).  Passing under both proves the suite is written in
+bats dialect, not locked to minibats quirks; TestRbatsSemantics pins the
+divergent behaviors themselves.
 """
 
 import glob
@@ -17,6 +24,19 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BATS_DIR = os.path.join(REPO, "tests", "bats")
 MINIBATS = os.path.join(BATS_DIR, "minibats.sh")
+RBATS = os.path.join(BATS_DIR, "vendor", "rbats")
+SELFTEST_DIR = os.path.join(BATS_DIR, "vendor", "selftest")
+
+# Representative slice for the real-bats-semantics lane, shared with
+# `make bats-real` via the manifest.  (Every file runs under minibats
+# below; running all twice would double suite wall time for marginal
+# extra signal.)
+with open(os.path.join(BATS_DIR, "vendor", "lane-files.txt")) as _f:
+    RBATS_FILES = [
+        line.strip()
+        for line in _f
+        if line.strip() and not line.startswith("#")
+    ]
 
 BATS_FILES = sorted(
     os.path.basename(p) for p in glob.glob(os.path.join(BATS_DIR, "*.bats"))
@@ -41,3 +61,66 @@ def test_bats_file(bats_file):
     assert proc.returncode == 0, (
         f"{bats_file} failed:\n{proc.stdout}\n{proc.stderr}"
     )
+
+
+def _run_rbats(files, env_extra=None, timeout=600):
+    env = dict(os.environ)
+    env.pop("KUBE_API_SERVER", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        ["bash", RBATS, *files],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=REPO,
+    )
+
+
+class TestRealBatsLane:
+    @pytest.mark.parametrize("bats_file", RBATS_FILES)
+    def test_suite_file_under_rbats(self, bats_file):
+        proc = _run_rbats([os.path.join(BATS_DIR, bats_file)])
+        assert proc.returncode == 0, (
+            f"{bats_file} under rbats failed:\n{proc.stdout}\n{proc.stderr}"
+        )
+        assert "not ok" not in proc.stdout
+
+
+class TestRbatsSemantics:
+    """Pin the behaviors where bats-core differs from minibats, so the lane
+    keeps having teeth if either runner changes."""
+
+    def test_semantics_fixture_passes(self):
+        proc = _run_rbats([os.path.join(SELFTEST_DIR, "semantics.bats")], timeout=60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        oks = [l for l in proc.stdout.splitlines() if l.startswith("ok ")]
+        assert len(oks) == 8, proc.stdout
+        assert "# SKIP because reasons" in proc.stdout
+
+    def test_minibats_leaks_where_rbats_does_not(self):
+        """The load-bearing difference: non-exported setup_file state leaks
+        through minibats but must not under real-bats semantics."""
+        fixture = os.path.join(SELFTEST_DIR, "semantics.bats")
+        rb = _run_rbats([fixture], timeout=60)
+        assert rb.returncode == 0, rb.stdout + rb.stderr
+        mb = subprocess.run(
+            ["bash", MINIBATS, fixture],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+        )
+        assert "not ok 2" in mb.stdout  # minibats leaks LEAKY_VAR into tests
+
+    def test_failure_semantics(self, tmp_path):
+        proc = _run_rbats(
+            [os.path.join(SELFTEST_DIR, "failure.bats")],
+            env_extra={"RBATS_SELFTEST_DIR": str(tmp_path)},
+            timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "not ok 1 plain failure is reported" in proc.stdout
+        assert "not ok 2 errexit is live mid-body" in proc.stdout
+        assert "should never print" not in proc.stdout
+        assert "not ok 3 failing teardown fails a passing test" in proc.stdout
+        # teardown ran for every test, including the failing ones.
+        log = (tmp_path / "teardown.log").read_text()
+        assert {f"teardown-ran-for-{i}" for i in (1, 2, 3)} <= set(log.split())

@@ -1434,3 +1434,157 @@ class TestFullLifecycle:
             third[0]["spec"]["pool"]["generation"]
             > second[0]["spec"]["pool"]["generation"]
         )
+
+
+class TestWorkerHostnamesPolicy:
+    """The TPU_WORKER_HOSTNAMES reachability contract (ADVICE r4 medium):
+    multi-host channel grants are refused for pod-networked consumers, the
+    tpu.google.com/worker-hostnames annotation overrides the emitted names,
+    and host-networked pods keep the daemon DNS names.
+    cdplugin/state.py:_worker_hostnames_policy."""
+
+    def _ready_cd(self, kube, tmp_path):
+        mk_node(kube, "node-a")
+        cd = mk_cd(kube, num_nodes=2)
+        uid = cd["metadata"]["uid"]
+        drv = _mk_cddriver(kube, tmp_path)
+        clique = CliqueManager(kube, NS, uid, "s1.0", "node-a", "10.0.0.1")
+        clique.join()
+        clique.update_daemon_status(True)
+        c = Controller(kube, ManagerConfig(driver_namespace=NS))
+        c.manager.sync_status(kube.get(gvr.COMPUTE_DOMAINS, "cd1", "user-ns"))
+        return cd, uid, drv
+
+    def _pod(self, kube, name="wl-pod", host_network=False, annotations=None):
+        pod = {
+            "metadata": {
+                "name": name,
+                "namespace": "user-ns",
+                "uid": f"uid-{name}",
+                "annotations": annotations or {},
+            },
+            "spec": {"hostNetwork": host_network, "containers": []},
+        }
+        return kube.create(gvr.PODS, pod, "user-ns")
+
+    def _reserved_claim(self, uid, cd_uid, pod, device="channel-5"):
+        claim = _channel_claim(uid, cd_uid, device)
+        claim["status"]["reservedFor"] = [
+            {"resource": "pods", "name": pod["metadata"]["name"],
+             "uid": pod["metadata"]["uid"]}
+        ]
+        return claim
+
+    def test_pod_networked_pod_is_refused(self, tmp_path):
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        pod = self._pod(kube, host_network=False)
+        resp = drv.prepare_resource_claims([self._reserved_claim("wl-p", uid, pod)])
+        result = resp["claims"]["wl-p"]
+        assert "error" in result and result["permanent"] is True
+        assert "pod-networked pod user-ns/wl-pod" in result["error"]
+        # The two remedies are in the message, inside the sim kubelet's
+        # 500-char annotation window (test_cd_hostnet.bats reads them there).
+        assert 0 <= result["error"].find("hostNetwork: true") < 500
+        assert 0 < result["error"].find("tpu.google.com/worker-hostnames") < 470
+
+    def test_host_networked_pod_keeps_daemon_names(self, tmp_path):
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        pod = self._pod(kube, host_network=True)
+        resp = drv.prepare_resource_claims([self._reserved_claim("wl-h", uid, pod)])
+        assert resp["claims"]["wl-h"].get("devices"), resp
+        env = drv.state._cdi.read_claim_spec("wl-h")["containerEdits"]["env"]
+        names = next(
+            e for e in env if e.startswith("TPU_WORKER_HOSTNAMES=")
+        ).split("=", 1)[1].split(",")
+        assert names == [dns_name(0), dns_name(1)]
+
+    def test_annotation_overrides_hostnames(self, tmp_path):
+        from tpudra.cdplugin.state import WORKER_HOSTNAMES_ANNOTATION
+
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        pod = self._pod(
+            kube,
+            host_network=False,
+            annotations={WORKER_HOSTNAMES_ANNOTATION: "w-0.workers,w-1.workers"},
+        )
+        resp = drv.prepare_resource_claims([self._reserved_claim("wl-a", uid, pod)])
+        assert resp["claims"]["wl-a"].get("devices"), resp
+        env = drv.state._cdi.read_claim_spec("wl-a")["containerEdits"]["env"]
+        assert "TPU_WORKER_HOSTNAMES=w-0.workers,w-1.workers" in env
+
+    def test_annotation_count_mismatch_is_permanent(self, tmp_path):
+        from tpudra.cdplugin.state import WORKER_HOSTNAMES_ANNOTATION
+
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        pod = self._pod(
+            kube,
+            host_network=False,
+            annotations={WORKER_HOSTNAMES_ANNOTATION: "only-one.workers"},
+        )
+        resp = drv.prepare_resource_claims([self._reserved_claim("wl-m", uid, pod)])
+        result = resp["claims"]["wl-m"]
+        assert "error" in result and result["permanent"] is True
+        assert "1 hostnames for a 2-host slice" in result["error"]
+
+    def test_unreserved_claim_proceeds_with_default_names(self, tmp_path):
+        """No reservedFor (manual prepare, conformance suites): nothing to
+        validate against — warn and keep the default contract."""
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        resp = drv.prepare_resource_claims([_channel_claim("wl-u", uid)])
+        assert resp["claims"]["wl-u"].get("devices"), resp
+
+    def test_any_pod_networked_consumer_refuses(self, tmp_path):
+        """Multi-consumer claims: the contract is validated for EVERY
+        reserved pod, not just the first (a shared grant env serves all)."""
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        good = self._pod(kube, name="wl-good", host_network=True)
+        bad = self._pod(kube, name="wl-bad", host_network=False)
+        claim = self._reserved_claim("wl-multi", uid, good)
+        claim["status"]["reservedFor"].append(
+            {"resource": "pods", "name": "wl-bad", "uid": bad["metadata"]["uid"]}
+        )
+        resp = drv.prepare_resource_claims([claim])
+        result = resp["claims"]["wl-multi"]
+        assert "error" in result and "wl-bad" in result["error"]
+
+    def test_non_pod_consumer_is_ignored(self, tmp_path):
+        """A non-pod ResourceClaimConsumerReference (resource != pods) must
+        not be looked up as a pod — a same-named pod could otherwise
+        impose its (irrelevant) network mode on the claim."""
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        # Same-named pod-networked pod exists; the consumer is NOT a pod.
+        self._pod(kube, name="train", host_network=False)
+        claim = _channel_claim("wl-np", uid)
+        claim["status"]["reservedFor"] = [
+            {"resource": "appwrappers", "name": "train", "uid": "aw-1"}
+        ]
+        resp = drv.prepare_resource_claims([claim])
+        assert resp["claims"]["wl-np"].get("devices"), resp
+
+    def test_conflicting_annotations_refuse(self, tmp_path):
+        from tpudra.cdplugin.state import WORKER_HOSTNAMES_ANNOTATION
+
+        kube = FakeKube()
+        cd, uid, drv = self._ready_cd(kube, tmp_path)
+        a = self._pod(
+            kube, name="wl-a1", host_network=False,
+            annotations={WORKER_HOSTNAMES_ANNOTATION: "x.w,y.w"},
+        )
+        self._pod(
+            kube, name="wl-a2", host_network=False,
+            annotations={WORKER_HOSTNAMES_ANNOTATION: "p.w,q.w"},
+        )
+        claim = self._reserved_claim("wl-conf", uid, a)
+        claim["status"]["reservedFor"].append(
+            {"resource": "pods", "name": "wl-a2", "uid": "uid-wl-a2"}
+        )
+        resp = drv.prepare_resource_claims([claim])
+        result = resp["claims"]["wl-conf"]
+        assert "error" in result and "conflicting" in result["error"]

@@ -438,3 +438,47 @@ class TestRendezvousThroughProxy:
         for idx, (p, out) in enumerate(zip(procs, outs)):
             assert p.returncode == 0, f"worker {idx} failed:\n{out}"
             assert f"OK host={idx}" in out, out
+
+
+class TestRegistrationReplaceRetry:
+    """ADVICE r4: a replacement host-0 under a different uid gets EPERM
+    replacing the dead owner's registration (sticky-bit dir); the writer
+    must wait out the proxy's probe-and-drop instead of crash-looping.
+    Root bypasses sticky enforcement, so the EPERM is injected."""
+
+    def test_eperm_waits_for_drop_then_succeeds(self, tmp_path, monkeypatch):
+        import os as _os
+
+        from tpudra.cddaemon.coordproxy import write_registration
+
+        real_replace = _os.replace
+        calls = {"n": 0}
+
+        def flaky_replace(src, dst):
+            calls["n"] += 1
+            if calls["n"] <= 3:
+                raise PermissionError(1, "Operation not permitted", dst)
+            return real_replace(src, dst)
+
+        monkeypatch.setattr("tpudra.cddaemon.coordproxy.os.replace", flaky_replace)
+        path = write_registration(
+            str(tmp_path), "10.0.0.7", 7777, replace_wait_s=30.0, poll_s=0.05
+        )
+        assert calls["n"] == 4
+        assert open(path).read().strip() == "10.0.0.7:7777"
+        # The unique temp file did not leak.
+        assert [p.name for p in tmp_path.iterdir()] == ["coordinator"]
+
+    def test_eperm_past_deadline_raises_with_diagnosis(self, tmp_path, monkeypatch):
+        from tpudra.cddaemon.coordproxy import write_registration
+
+        def always_eperm(src, dst):
+            raise PermissionError(1, "Operation not permitted", dst)
+
+        monkeypatch.setattr("tpudra.cddaemon.coordproxy.os.replace", always_eperm)
+        with pytest.raises(PermissionError, match="never dropped"):
+            write_registration(
+                str(tmp_path), "10.0.0.7", 7777, replace_wait_s=0.15, poll_s=0.05
+            )
+        # Best-effort temp cleanup on the fatal path.
+        assert list(tmp_path.iterdir()) == []

@@ -504,3 +504,68 @@ def test_vfio_per_device_mutex_registry(tmp_path):
     with open(tmp_path / "sys/bus/pci/devices" / chip.pci_address / "driver_override") as f:
         assert f.read().strip() == "vfio-pci"
     mgr.unconfigure(chip)
+
+
+class TestSimulatedPartitionsProbeRecovery:
+    """ADVICE r4: the SimulatedPartitions probe must not wedge the plugin
+    when its delete leg fails (leaked probe partition) or when a previous
+    crash left the probe partition live."""
+
+    def _lib(self, tmp_path):
+        return MockDeviceLib(
+            config=MockTopologyConfig(generation="v5p"),
+            state_file=str(tmp_path / "hw.json"),
+        )
+
+    def test_failed_probe_delete_does_not_fail_init(self, tmp_path):
+        from tpudra.devicelib import DeviceLibError
+
+        lib = self._lib(tmp_path)
+        real_delete = lib.delete_partition
+        fail = {"on": True}
+
+        def flaky_delete(uuid):
+            if fail["on"]:
+                raise DeviceLibError("injected delete failure")
+            return real_delete(uuid)
+
+        lib.delete_partition = flaky_delete
+        # Probe succeeds (create worked); the undeletable probe partition
+        # is left for startup reconciliation, not turned into an init
+        # failure with a misleading remedy.
+        DeviceState._probe_simulated_partitions(lib)
+        leaked = lib.list_partitions()
+        assert len(leaked) == 1
+        # Startup reconciliation reaps it (empty checkpoint: unknown).
+        fail["on"] = False
+        fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+        state = DeviceState(
+            lib,
+            CDIHandler(str(tmp_path / "cdi")),
+            CheckpointManager(str(tmp_path / "cp")),
+            "node-a",
+        )
+        assert state.destroy_unknown_partitions() == 1
+        assert lib.list_partitions() == []
+
+    def test_leaked_probe_partition_is_reaped_and_probe_retries(self, tmp_path):
+        from tpudra.devicelib import DeviceLibError
+        from tpudra.devicelib.base import PartitionSpec
+
+        lib = self._lib(tmp_path)
+        chip = lib.enumerate_chips()[0]
+        p = lib.possible_placements(chip)[0]
+        spec = PartitionSpec(chip.index, p.profile.name, p.core_start, p.hbm_start)
+        lib.create_partition(spec)  # the crashed-init leftover
+
+        real_create = lib.create_partition
+
+        def occupied_create(s):
+            # Simulate a backend that refuses to double-book a placement.
+            if any(live.spec == s for live in lib.list_partitions()):
+                raise DeviceLibError(f"placement occupied: {s}")
+            return real_create(s)
+
+        lib.create_partition = occupied_create
+        DeviceState._probe_simulated_partitions(lib)  # reaps + retries
+        assert lib.list_partitions() == []

@@ -130,7 +130,9 @@ class TestLibTpuInfo:
             lib.create_partition(PartitionSpec(0, "1c.4hbm", 0, 0))
         lib.close()
 
-        # Hardware path (sysfs): attests False by default...
+        # Hardware path (sysfs): attests False by default (empty
+        # TPUINFO_STATE_FILE == unset; the compiled-in default path is
+        # assumed absent in the test image)...
         from tpudra.devicelib.native import NativeDeviceLib
 
         pci_root = tmp_path / "sys" / "bus" / "pci" / "devices"
@@ -141,22 +143,31 @@ class TestLibTpuInfo:
         (tmp_path / "dev").mkdir()
         monkeypatch.setenv("TPUINFO_DEV_ROOT", str(tmp_path / "dev"))
         monkeypatch.setenv("TPUINFO_SYSFS_ROOT", str(tmp_path / "sys"))
-        monkeypatch.setenv("TPUINFO_STATE_FILE", str(tmp_path / "hw-state"))
+        monkeypatch.setenv("TPUINFO_STATE_FILE", "")
         monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
         monkeypatch.delenv("TPUINFO_SIMULATE_PARTITIONS", raising=False)
         lib = NativeDeviceLib(config_path="")
         assert lib.partitions_supported() is False
         lib.close()
-        # ...and True only under the explicit simulation opt-in.
+        # ...True under the explicit simulation opt-in...
         monkeypatch.setenv("TPUINFO_SIMULATE_PARTITIONS", "1")
         lib = NativeDeviceLib(config_path="")
         assert lib.partitions_supported() is True
         lib.close()
-
-        # Legacy adoption: an upgrading node with a NON-EMPTY registry
-        # keeps managing it even without the opt-in — orphaning
-        # previously simulated partitions would leak them forever.
+        # ...and an EXPLICITLY-set TPUINFO_STATE_FILE is itself the opt-in
+        # (ADVICE r4: it was the pre-attestation mechanism; ignoring it on
+        # a fresh node silently changed behavior across the upgrade).
         monkeypatch.delenv("TPUINFO_SIMULATE_PARTITIONS", raising=False)
+        monkeypatch.setenv("TPUINFO_STATE_FILE", str(tmp_path / "hw-state"))
+        lib = NativeDeviceLib(config_path="")
+        assert lib.partitions_supported() is True
+        lib.close()
+
+        # Legacy adoption: an upgrading node with a NON-EMPTY registry at
+        # the state path keeps managing it even without any opt-in env —
+        # orphaning previously simulated partitions would leak them
+        # forever.  (Exercised here through the explicit path; the same
+        # stat-nonempty branch guards the compiled-in default path.)
         (tmp_path / "hw-state").write_text(
             "p0\tuuid-legacy\t0\t1c.4hbm\t0\t0\n"
         )

@@ -77,18 +77,59 @@ def read_registration(dir_path: str) -> Optional[tuple[str, int]]:
     return host, int(port)
 
 
-def write_registration(dir_path: str, host: str, port: int) -> str:
+def write_registration(
+    dir_path: str,
+    host: str,
+    port: int,
+    replace_wait_s: float = 180.0,
+    poll_s: float = 2.0,
+) -> str:
     """Atomically publish the live coordinator endpoint (workload side).
 
     The temp name is unique per writer: the domain dir is sticky-bit
     shared (cdplugin/state.py), so a crashed previous workload's leftover
-    ``.tmp`` owned by another uid must not block this one's open."""
+    ``.tmp`` owned by another uid must not block this one's open.
+
+    The sticky bit also means a REPLACEMENT host-0 running under a
+    different uid cannot os.replace the dead previous owner's registration
+    (EPERM).  The daemon's proxy probe-and-drops that stale file on
+    forward failures (CoordinatorProxy drop_after / unreachable_window —
+    ≤ ~120 s even for timeout-class deaths), after which the replace
+    succeeds — so wait that window out here instead of failing fatally,
+    which would CrashLoopBackOff the pod and stack restart backoff on top
+    of the drop latency (ADVICE r4)."""
     path = os.path.join(dir_path, REGISTRATION_FILE)
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "w") as f:
         f.write(f"{host}:{port}\n")
-    os.replace(tmp, path)
-    return path
+    try:
+        os.replace(tmp, path)
+        return path
+    except PermissionError as e:
+        logger.warning(
+            "cannot replace existing registration %s (%s) — a dead "
+            "previous owner's file under the sticky bit; waiting up to "
+            "%.0fs for the daemon proxy to probe-and-drop it",
+            path, e, replace_wait_s,
+        )
+    deadline = time.monotonic() + replace_wait_s
+    while True:
+        time.sleep(poll_s)
+        try:
+            os.replace(tmp, path)
+            logger.info("registered coordinator after stale-file drop: %s", path)
+            return path
+        except PermissionError as e:
+            if time.monotonic() >= deadline:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise PermissionError(
+                    f"registration {path} still owned by a previous workload "
+                    f"after {replace_wait_s:.0f}s — the daemon proxy never "
+                    "dropped it (is the daemon running and probing?)"
+                ) from e
 
 
 class CoordinatorProxy:

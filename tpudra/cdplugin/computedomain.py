@@ -39,6 +39,12 @@ class ComputeDomainManager:
 
     # -- lookup -------------------------------------------------------------
 
+    @property
+    def kube(self):
+        """The cluster client (pod lookups for the worker-hostnames
+        reachability policy, cdplugin/state.py)."""
+        return self._kube
+
     def get_by_uid(self, uid: str) -> Optional[dict]:
         for cd in self._kube.list(gvr.COMPUTE_DOMAINS).get("items", []):
             if cd["metadata"]["uid"] == uid:

@@ -22,6 +22,20 @@ The worker hostnames are the per-domain daemon's stable DNS names
 resolvable by the daemon's /etc/hosts machinery — so they name exactly the
 TPU hosts libtpu must reach, in clique-index order, and survive daemon pod
 churn the same way the slice-watch peer list does.
+
+**Reachability contract** (the reason multi-host channel workloads must be
+host-networked): the daemon DNS names resolve to NODE IPs.  libtpu's
+inter-worker mesh-bootstrap servers bind inside the WORKLOAD pod's network
+namespace, and unlike the jax.distributed coordinator (proxied on the
+daemon's port, cddaemon/coordproxy.py) nothing forwards libtpu's ports.
+With ``hostNetwork: true`` (the GKE multi-host podslice contract) pod IP ==
+node IP and the names land on the worker's own sockets; with pod networking
+they land on the node where nothing listens and mesh formation hangs until
+libtpu's init timeout.  cdplugin/state.py therefore refuses multi-host
+channel grants to pod-networked pods unless the pod overrides the hostnames
+with names that resolve to the workload pods themselves (headless-service
+style, the ``tpu.google.com/worker-hostnames`` annotation → the
+``hostnames`` parameter of :func:`worker_env`).
 """
 
 from __future__ import annotations
@@ -70,12 +84,25 @@ def host_bounds(
     return fmt(grid), fmt(hb)
 
 
-def worker_env(topo: SliceTopology, chips: list[TpuChip]) -> dict[str, str]:
-    """The full contract for one host of the granted slice."""
+def worker_env(
+    topo: SliceTopology,
+    chips: list[TpuChip],
+    hostnames: list[str] | None = None,
+) -> dict[str, str]:
+    """The full contract for one host of the granted slice.
+
+    ``hostnames`` overrides the default daemon DNS names with caller-chosen
+    worker names in worker-id order (the pod-networked escape hatch — see
+    the module docstring's reachability contract)."""
+    if hostnames is not None and len(hostnames) != topo.num_hosts:
+        raise ValueError(
+            f"{len(hostnames)} worker hostnames for {topo.num_hosts} hosts"
+        )
     env = {
         "TPU_WORKER_ID": str(topo.host_index),
         "TPU_WORKER_HOSTNAMES": ",".join(
-            dns_name(i) for i in range(topo.num_hosts)
+            hostnames if hostnames is not None
+            else [dns_name(i) for i in range(topo.num_hosts)]
         ),
         "TPU_SKIP_MDS_QUERY": "true",
     }

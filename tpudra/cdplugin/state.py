@@ -51,6 +51,11 @@ from tpudra.plugin.device_state import (
 
 logger = logging.getLogger(__name__)
 
+#: Pod annotation overriding TPU_WORKER_HOSTNAMES for pod-networked
+#: multi-host workloads: comma-separated worker names in worker-id order
+#: that resolve to the workload pods themselves (headless-service style).
+WORKER_HOSTNAMES_ANNOTATION = "tpu.google.com/worker-hostnames"
+
 
 def _allocation_results(claim: dict) -> list[dict]:
     results = (
@@ -153,7 +158,9 @@ class ComputeDomainDeviceState:
 
         try:
             if isinstance(config, ComputeDomainChannelConfig):
-                group = self._apply_channel_config(uid, namespace, config, results)
+                group = self._apply_channel_config(
+                    uid, namespace, config, results, claim
+                )
             elif isinstance(config, ComputeDomainDaemonConfig):
                 group = self._apply_daemon_config(uid, config, results)
             else:
@@ -307,6 +314,7 @@ class ComputeDomainDeviceState:
         namespace: str,
         config: ComputeDomainChannelConfig,
         results: list[dict],
+        claim: dict,
     ) -> tuple[list[PreparedDevice], ContainerEdits]:
         try:
             self._cdm.assert_in_namespace(config.domain_id, namespace)
@@ -350,6 +358,7 @@ class ComputeDomainDeviceState:
         )
         topo = self._lib.slice_topology()
         chips = self._lib.enumerate_chips()
+        worker_hostnames = self._worker_hostnames_policy(namespace, claim, topo)
         from tpudra.cdplugin import libtpuenv
         from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
         from tpudra.cddaemon.dnsnames import dns_name
@@ -392,7 +401,11 @@ class ComputeDomainDeviceState:
             # (cdplugin/libtpuenv.py; GKE TPU device-plugin contract).
             + [
                 f"{k}={v}"
-                for k, v in sorted(libtpuenv.worker_env(topo, chips).items())
+                for k, v in sorted(
+                    libtpuenv.worker_env(
+                        topo, chips, hostnames=worker_hostnames
+                    ).items()
+                )
             ],
             device_nodes=[
                 self._cdi.host_path(alloc.channel_dev_path(i)) for i in granted
@@ -400,6 +413,94 @@ class ComputeDomainDeviceState:
             mounts=[(domain_dir, cd_dir_mount)],
         )
         return devices, edits
+
+    def _worker_hostnames_policy(
+        self, namespace: str, claim: dict, topo
+    ) -> list[str] | None:
+        """Enforce the TPU_WORKER_HOSTNAMES reachability contract
+        (libtpuenv.py module docstring) for multi-host channel grants.
+
+        Returns override hostnames from the consuming pod's
+        ``tpu.google.com/worker-hostnames`` annotation (headless-service
+        style, worker-id order), or None to use the daemon DNS names.
+        Raises PermanentError when the consuming pod is pod-networked with
+        no override — libtpu mesh formation would hang for ~300 s and fail
+        opaquely; refusing at prepare puts the actionable message on the
+        claim instead.
+        """
+        if topo.num_hosts <= 1:
+            return None  # no inter-host mesh to form
+        pods = self._consuming_pods(namespace, claim)
+        if not pods:
+            # reservedFor not set (conformance suites, manual prepares):
+            # nothing to validate against — keep the default contract.
+            logger.warning(
+                "multi-host channel claim %s has no resolvable consuming pod; "
+                "cannot validate the hostNetwork contract",
+                claim.get("metadata", {}).get("name", ""),
+            )
+            return None
+        # A claim can be reserved by several consumers (DRA allows 32); the
+        # grant env is one per claim, so every consumer is validated and an
+        # override must be unanimous.
+        annotations = {
+            pod.get("metadata", {})
+            .get("annotations", {})
+            .get(WORKER_HOSTNAMES_ANNOTATION, "")
+            for pod in pods
+        }
+        annotations.discard("")
+        if len(annotations) > 1:
+            raise PermanentError(
+                f"consuming pods of claim "
+                f"{claim.get('metadata', {}).get('name')} carry conflicting "
+                f"{WORKER_HOSTNAMES_ANNOTATION} annotations "
+                f"{sorted(annotations)} — the grant env is shared, so all "
+                "consumers must agree"
+            )
+        if annotations:
+            annotation = annotations.pop()
+            names = [n.strip() for n in annotation.split(",") if n.strip()]
+            if len(names) != topo.num_hosts:
+                raise PermanentError(
+                    f"{WORKER_HOSTNAMES_ANNOTATION} on the consuming pod(s) "
+                    f"of claim {claim.get('metadata', {}).get('name')} lists "
+                    f"{len(names)} hostnames for a {topo.num_hosts}-host slice"
+                )
+            return names
+        for pod in pods:
+            if not pod.get("spec", {}).get("hostNetwork"):
+                raise PermanentError(
+                    f"multi-host ComputeDomain channel claim consumed by "
+                    f"pod-networked pod {namespace}/{pod['metadata'].get('name')}: "
+                    "TPU_WORKER_HOSTNAMES names the host-networked domain daemons "
+                    "(node IPs), but libtpu's inter-worker ports bind inside the "
+                    "pod network namespace where nothing forwards them — ICI mesh "
+                    "formation would hang.  Set hostNetwork: true on the workload "
+                    "pod (the GKE multi-host podslice contract), or annotate it "
+                    f"with {WORKER_HOSTNAMES_ANNOTATION}=<name0,...> naming each "
+                    "worker pod (headless-service style, worker-id order)."
+                )
+        return None
+
+    def _consuming_pods(self, namespace: str, claim: dict) -> list[dict]:
+        """Every pod the scheduler reserved this claim for (resolvable
+        ones).  ResourceClaimConsumerReference carries resource (plural) +
+        name; only pod consumers have a spec to validate."""
+        from tpudra.kube import gvr
+
+        pods = []
+        for ref in claim.get("status", {}).get("reservedFor", []):
+            if ref.get("resource", "pods") != "pods":
+                continue
+            name = ref.get("name", "")
+            if not name:
+                continue
+            try:
+                pods.append(self._cdm.kube.get(gvr.PODS, name, namespace))
+            except Exception:  # noqa: BLE001 — pod may be gone already
+                continue
+        return pods
 
     def _apply_daemon_config(
         self, uid: str, config: ComputeDomainDaemonConfig, results: list[dict]

@@ -360,21 +360,60 @@ class DeviceState:
                 core_start=p.core_start,
                 hbm_start=p.hbm_start,
             )
+            remedy = (
+                "SimulatedPartitions is enabled but the backend cannot "
+                "simulate partition mutation ({}); on the native "
+                "backend set TPUINFO_SIMULATE_PARTITIONS=1 so the "
+                "file-backed registry exists"
+            )
             try:
                 live = devicelib.create_partition(spec)
             except DeviceLibError as e:
-                raise DeviceLibError(
-                    "SimulatedPartitions is enabled but the backend cannot "
-                    f"simulate partition mutation ({e}); on the native "
-                    "backend set TPUINFO_SIMULATE_PARTITIONS=1 so the "
-                    "file-backed registry exists"
-                ) from e
-            devicelib.delete_partition(live.uuid)
+                # A probe partition leaked by a crashed earlier init can
+                # make this create fail; reap any live partition matching
+                # the probe spec and retry once before misdiagnosing the
+                # backend as unable to simulate (ADVICE r4).
+                if not DeviceState._reap_probe_leftover(devicelib, spec):
+                    raise DeviceLibError(remedy.format(e)) from e
+                try:
+                    live = devicelib.create_partition(spec)
+                except DeviceLibError as e2:
+                    raise DeviceLibError(remedy.format(e2)) from e2
+            try:
+                devicelib.delete_partition(live.uuid)
+            except DeviceLibError as e:
+                # Best-effort: the probe partition is not in any checkpoint,
+                # so startup reconciliation (destroy_unknown_partitions)
+                # reaps it — failing init here would wedge the plugin over
+                # an already-recoverable leak.
+                logger.warning(
+                    "probe partition %s could not be deleted (%s); startup "
+                    "reconciliation will destroy it", live.uuid, e,
+                )
             return
         raise DeviceLibError(
             "SimulatedPartitions is enabled but no chip offers a partition "
             "placement (generation not partitionable?)"
         )
+
+    @staticmethod
+    def _reap_probe_leftover(devicelib: DeviceLib, spec: PartitionSpec) -> bool:
+        """Delete any live partition with exactly the probe's spec — only a
+        leaked probe from a crashed init can match it, since an occupied
+        placement would not have been offered by possible_placements."""
+        reaped = False
+        try:
+            for live in devicelib.list_partitions():
+                if live.spec == spec:
+                    logger.warning(
+                        "reaping leftover probe partition %s (%s)",
+                        live.uuid, live.spec,
+                    )
+                    devicelib.delete_partition(live.uuid)
+                    reaped = True
+        except DeviceLibError as e:
+            logger.warning("could not reap leftover probe partition: %s", e)
+        return reaped
 
     def destroy_unknown_partitions(self) -> int:
         """Startup reconciliation: with dynamic partitioning, every live

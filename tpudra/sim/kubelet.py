@@ -470,6 +470,21 @@ class ClusterSim:
                     pass
             logger.debug("pod %s/%s does not fit on %s: %s", ns, md["name"], node, e)
             return None
+        # Reserve every resolved claim for this pod (status.reservedFor),
+        # as the real scheduler's claim controller does on allocation — the
+        # CD plugin's worker-hostnames policy resolves the consuming pod
+        # through it (cdplugin/state.py:_consuming_pod).
+        pod_ref = {"resource": "pods", "name": md["name"], "uid": md["uid"]}
+        for i, claim in enumerate(resolved):
+            reserved = claim.setdefault("status", {}).setdefault("reservedFor", [])
+            if not any(r.get("uid") == md["uid"] for r in reserved):
+                reserved.append(pod_ref)
+                try:
+                    resolved[i] = self._kube.update_status(
+                        gvr.RESOURCE_CLAIMS, claim, ns
+                    )
+                except (Conflict, NotFound):
+                    pass  # concurrent writer/deleter; reservation is best-effort
         return resolved
 
     def _schedule(self, pods: list[dict], node_labels: dict) -> None:
