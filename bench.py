@@ -450,6 +450,11 @@ def bench_native_corroboration() -> dict:
                 lib = NativeDeviceLib(config_path=cfg, runtime_probe=probe)
             try:
                 out = lib.corroborate_runtime()
+                # Platform attestation for multi-process sharing (VERDICT
+                # r4 #5): can a second process open the chip while held?
+                # Probed live on the device node when one is visible;
+                # "unknown" behind the remote tunnel.
+                out["multiprocess_mode"] = lib.multiprocess_mode()
             finally:
                 lib.close()
             out["config_source"] = config_source
@@ -1013,7 +1018,7 @@ SUMMARY_KEYS = (
     "bind_p50_ms", "bind_p99_ms", "available", "consistent",
     "checked_count", "psum_bus_gbps", "hook_exercised", "num_experts",
     "matched", "prepares_per_s", "reconciles_per_s", "effective_qps",
-    "held", "cache_entries", "heap_mb",
+    "held", "cache_entries", "heap_mb", "multiprocess_mode",
     # incremental-line payloads (probe + headline)
     "metric", "value", "unit", "vs_baseline",
     "reachable", "backend", "n_devices", "probe_s",

@@ -6,6 +6,7 @@
 #include <fcntl.h>
 #include <sys/file.h>
 #include <sys/stat.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -62,6 +63,9 @@ struct tpuinfo_handle {
   tpuinfo_topology topo{};
   std::string state_file;  // partition registry; empty = partitions disabled
   std::string error;
+  // First granted /dev/accelN node (hardware mode only): the probe target
+  // for the multi-process concurrency attestation.  Empty = cannot attest.
+  std::string mp_probe_dev;
   // Real PCI addresses from sysfs probing, index-aligned with chips
   // (empty in config/env modes).
   std::vector<std::string> pci_addresses;
@@ -304,6 +308,9 @@ int tpuinfo_open(const char* config_path, tpuinfo_handle** out) {
     else if (gen_name == "v6litepod") gen_name = "v6e";
     auto accel = accel_device_indices(getenv_or("TPUINFO_DEV_ROOT", "/dev"));
     int dev_count = static_cast<int>(accel.size());
+    if (dev_count > 0)
+      h->mp_probe_dev = getenv_or("TPUINFO_DEV_ROOT", "/dev") + "/accel" +
+                        std::to_string(accel[0]);
     if (!pci.empty()) {
       // A container may see the host's full /sys but be granted only a
       // subset of accel device nodes via cgroups — the usable set is the
@@ -422,6 +429,38 @@ int tpuinfo_partitions_supported(tpuinfo_handle* h) {
    * silicon without the opt-in reports 0 — sub-chip partitioning awaits a
    * runtime API. */
   return h->state_file.empty() ? 0 : 1;
+}
+
+int tpuinfo_multiprocess_mode(tpuinfo_handle* h) {
+  /* See tpuinfo.h.  The child does only async-signal-safe work (open,
+   * _exit), so forking from a threaded caller is safe.  TPUINFO_MP_MODE
+   * overrides for tests/platforms where probing the node is unwanted. */
+  const char* forced = ::getenv("TPUINFO_MP_MODE");
+  if (forced != nullptr && *forced != '\0') {
+    if (strcmp(forced, "exclusive") == 0) return 1;
+    if (strcmp(forced, "concurrent") == 0) return 2;
+    return 0;
+  }
+  if (h->mp_probe_dev.empty()) return 0;
+  int fd = ::open(h->mp_probe_dev.c_str(), O_RDWR | O_CLOEXEC | O_NONBLOCK);
+  if (fd < 0)
+    /* EBUSY on the FIRST open is itself the attestation: some other
+     * process holds the node and this one was refused — exclusive. Any
+     * other failure leaves nothing to conclude. */
+    return errno == EBUSY ? 1 : 0;
+  pid_t pid = ::fork();
+  if (pid == 0) {
+    int fd2 = ::open(h->mp_probe_dev.c_str(), O_RDWR | O_CLOEXEC | O_NONBLOCK);
+    _exit(fd2 >= 0 ? 0 : (errno == EBUSY ? 1 : 2));
+  }
+  int mode = 0;
+  int status = 0;
+  if (pid > 0 && ::waitpid(pid, &status, 0) == pid && WIFEXITED(status)) {
+    int rc = WEXITSTATUS(status);
+    mode = rc == 0 ? 2 : (rc == 1 ? 1 : 0);
+  }
+  ::close(fd);
+  return mode;
 }
 
 int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,

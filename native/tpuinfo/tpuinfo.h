@@ -69,6 +69,21 @@ int tpuinfo_get_topology(tpuinfo_handle* h, tpuinfo_topology* out);
  * reference nvlib.go:269-301). */
 int tpuinfo_partitions_supported(tpuinfo_handle* h);
 
+/* Multi-process concurrency attestation (the MPS-enforcement-truth analog,
+ * reference sharing.go:123-445): can a SECOND process open this host's TPU
+ * device node while a first holds it?  Probed live — parent holds the
+ * first granted /dev/accelN open while a forked child attempts its own
+ * open.  Returns:
+ *   0  unknown     (no device node visible — config/env mode, remote
+ *                   tunnel — or the probe itself could not run)
+ *   1  exclusive   (child open refused with EBUSY: concurrent process
+ *                   sharing is impossible; the MP broker time-multiplexes)
+ *   2  concurrent  (child open succeeded: processes can share the chip;
+ *                   broker limits remain cooperative — nothing enforces
+ *                   percentages in hardware)
+ */
+int tpuinfo_multiprocess_mode(tpuinfo_handle* h);
+
 int tpuinfo_create_partition(tpuinfo_handle* h, int parent_index,
                              const char* profile, int core_start,
                              int hbm_start, tpuinfo_partition* out);

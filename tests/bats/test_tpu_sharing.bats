@@ -36,6 +36,31 @@ teardown_file() {
   [ "$output" = "True" ]
 }
 
+@test "broker surfaces the platform attestation (attested-vs-cooperative)" {
+  # The plugin probes whether a second process can open the chip while
+  # held (DeviceLib.multiprocess_mode) and the broker must surface the
+  # truth: materialized into limits.json and answered in STATUS.
+  uid=$(kubectl get resourceclaims -n tpu-sharing -o 'jsonpath={.items[0].metadata.uid}')
+  [ -n "$uid" ]
+  limits="/var/run/tpudra/mp/$uid/limits.json"
+  [ -f "$limits" ]
+  run cat "$limits"
+  [[ "$output" == *'"platformMode"'* ]]
+  [[ "$output" == *'"enforcement": "cooperative"'* ]]
+  pipe_dir=$(dirname "$limits")
+  run env TPUDRA_MP_PIPE_DIRECTORY="$pipe_dir" python3 -m tpudra.mpdaemon status
+  [ "$status" -eq 0 ]
+  [[ "$output" == READY* ]]
+  [[ "$output" == *"platform="* ]]
+  [[ "$output" == *"enforcement=cooperative"* ]]
+}
+
+@test "published chip devices carry the multiprocessMode attribute" {
+  run kubectl get resourceslices -o json
+  [ "$status" -eq 0 ]
+  [[ "$output" == *'"multiprocessMode"'* ]]
+}
+
 @test "unprepare tears the control daemon down" {
   kubectl delete pod mp-pod -n tpu-sharing
   wait_until 120 sh -c "! kubectl get deployments -n $TPUDRA_NAMESPACE -o name | grep -q tpu-mp"

@@ -218,6 +218,17 @@ class TestMultiProcessContract:
                     assert any(
                         v == "4096M" for v in limits["pinnedHbmLimits"].values()
                     ), limits
+                    # Platform attestation rode the Deployment env into the
+                    # broker's materialized limits (VERDICT r4 #5): the
+                    # mock backend attests concurrent (sim pods are plain
+                    # processes), enforcement is always cooperative.
+                    assert limits["platformMode"] == "concurrent"
+                    assert limits["enforcement"] == "cooperative"
+                from tpudra.mpdaemon import query
+
+                status_line = query(host_pipe, "STATUS")
+                assert "platform=concurrent" in status_line
+                assert "enforcement=cooperative" in status_line
             finally:
                 broker.stop()
             d.unprepare_resource_claims([{"uid": "mp-1"}])

@@ -453,13 +453,13 @@ class TestMPControlDaemonProcess:
             assert limits["activeTensorCorePercentage"] == 50
             assert limits["pinnedHbmLimits"]["chip-b"] == "6144Mi"
 
-            assert query(pipe_dir, "STATUS") == "READY 0"
+            assert query(pipe_dir, "STATUS").startswith("READY 0 ")
             resp = query(pipe_dir, "ATTACH 1234")
             assert resp.startswith("OK ")
             assert json.loads(resp[3:])["activeTensorCorePercentage"] == 50
-            assert query(pipe_dir, "STATUS") == "READY 1"
+            assert query(pipe_dir, "STATUS").startswith("READY 1 ")
             assert query(pipe_dir, "DETACH 1234") == "OK"
-            assert query(pipe_dir, "STATUS") == "READY 0"
+            assert query(pipe_dir, "STATUS").startswith("READY 0 ")
 
             # The readiness probe the Deployment template runs.
             probe = subprocess.run(

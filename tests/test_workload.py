@@ -155,12 +155,25 @@ class TestCollectives:
 
         mesh = mesh_from_devices(("data",))
         results = run_all(mesh, mib_per_device=1, iters=2)
-        assert {r.op for r in results} == {"psum", "all_gather", "ppermute_ring"}
+        assert {r.op for r in results} == {
+            "psum", "all_gather", "ppermute_ring", "reduce_scatter", "all_to_all"
+        }
         for r in results:
             assert r.n_devices == 8
             assert r.seconds_per_op > 0
             assert r.bus_gbps > 0
             assert "RESULT bandwidth:" in r.line()
+
+    def test_verify_collectives_covers_every_bench(self):
+        """The dryrun's correctness sweep: every op in ALL_BENCHES has a
+        numerical parity check (VERDICT r4 #7 — 5 collective patterns)."""
+        from tpudra.workload.collectives import verify_collectives
+        from tpudra.workload.envspec import mesh_from_devices
+
+        mesh = mesh_from_devices(("data",))
+        assert verify_collectives(mesh, "data") == [
+            "psum", "all_gather", "ppermute_ring", "reduce_scatter", "all_to_all"
+        ]
 
     def test_psum_is_correct(self):
         import jax
@@ -863,8 +876,8 @@ class TestMultiProcessClient:
             with env.attach_multiprocess() as limits:
                 assert limits["activeTensorCorePercentage"] == 25
                 assert limits["pinnedHbmLimits"] == {"chip-x": "2048Mi"}
-                assert query(pipe_dir, "STATUS") == "READY 1"
-            assert query(pipe_dir, "STATUS") == "READY 0"
+                assert query(pipe_dir, "STATUS").startswith("READY 1 ")
+            assert query(pipe_dir, "STATUS").startswith("READY 0 ")
         finally:
             daemon.stop()
 

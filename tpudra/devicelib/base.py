@@ -105,6 +105,25 @@ class DeviceLib(abc.ABC):
         real silicon reports False unless simulation is opted in)."""
         return True
 
+    def multiprocess_mode(self) -> str:
+        """Platform attestation for multi-process chip sharing (the
+        MPS-enforcement-truth analog, reference sharing.go:123-445):
+
+        - ``"concurrent"``: a second process CAN open the chip while a
+          first holds it — processes can share; broker limits stay
+          cooperative (nothing enforces percentages in hardware).
+        - ``"exclusive"``: a second open is refused (EBUSY) — concurrent
+          process sharing is impossible and the MP broker can only
+          time-multiplex attachment.
+        - ``"unknown"``: no device node to probe (remote tunnel, config
+          mode).
+
+        Published as a chip attribute and surfaced by the MP control
+        daemon's STATUS so operators see the truth, not the aspiration.
+        Default reflects simulation backends: pods are plain processes
+        sharing a CPU device, so concurrent."""
+        return "concurrent"
+
     @abc.abstractmethod
     def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
         """All (profile, placement) pairs the chip supports."""

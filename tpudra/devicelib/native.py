@@ -114,6 +114,8 @@ def _load(lib_path: str):
     lib.tpuinfo_last_error.restype = ctypes.c_char_p
     lib.tpuinfo_partitions_supported.argtypes = [ctypes.c_void_p]
     lib.tpuinfo_partitions_supported.restype = ctypes.c_int
+    lib.tpuinfo_multiprocess_mode.argtypes = [ctypes.c_void_p]
+    lib.tpuinfo_multiprocess_mode.restype = ctypes.c_int
     return lib
 
 
@@ -131,6 +133,7 @@ class NativeDeviceLib(DeviceLib):
             )
         self._lib = _load(lib_path)
         self._handle = ctypes.c_void_p()
+        self._mp_mode: str | None = None  # probe-once cache (multiprocess_mode)
         rc = self._lib.tpuinfo_open(
             config_path.encode() or None, ctypes.byref(self._handle)
         )
@@ -235,6 +238,19 @@ class NativeDeviceLib(DeviceLib):
         simulation — no public TPU runtime API mutates sub-chip
         partitions."""
         return bool(self._lib.tpuinfo_partitions_supported(self._handle))
+
+    def multiprocess_mode(self) -> str:
+        """Fork/double-open probe of the first granted /dev/accelN
+        (tpuinfo_multiprocess_mode, tpuinfo.h); "unknown" when there is no
+        node to probe (config mode, remote tunnel).  Probed once per
+        handle — the first call runs at DeviceState init, before any
+        workload holds the chip; re-probing on every MP claim would both
+        flap the published value with chip occupancy and briefly hold the
+        node O_RDWR on the prepare hot path."""
+        if self._mp_mode is None:
+            mode = self._lib.tpuinfo_multiprocess_mode(self._handle)
+            self._mp_mode = {1: "exclusive", 2: "concurrent"}.get(mode, "unknown")
+        return self._mp_mode
 
     def possible_placements(self, chip: TpuChip) -> list[PartitionPlacement]:
         spec = GENERATIONS[chip.generation]

@@ -62,6 +62,15 @@ class ControlDaemon:
                 for kv in env.get("TPUDRA_MP_PINNED_HBM_LIMITS", "").split(";")
                 if "=" in kv
             ),
+            # Platform truth vs broker behavior (VERDICT r4 #5): the plugin
+            # probes whether a second process can open the chip while held
+            # (DeviceLib.multiprocess_mode) and passes it through; the
+            # broker's own limit enforcement is cooperative either way —
+            # nothing enforces TensorCore percentages in TPU hardware, and
+            # an "exclusive" platform additionally means concurrent process
+            # sharing is impossible (attachment is time-multiplexed).
+            "platformMode": env.get("TPUDRA_MP_PLATFORM_MODE", "") or "unknown",
+            "enforcement": "cooperative",
         }
         self._clients: set[str] = set()
         self._lock = threading.Lock()
@@ -91,7 +100,11 @@ class ControlDaemon:
                         daemon._clients.discard(arg)
                         resp = "OK"
                     elif verb == "STATUS":
-                        resp = f"READY {len(daemon._clients)}"
+                        resp = (
+                            f"READY {len(daemon._clients)} "
+                            f"platform={daemon.limits['platformMode']} "
+                            f"enforcement={daemon.limits['enforcement']}"
+                        )
                     else:
                         resp = f"ERR unknown verb {verb!r}"
                 self.wfile.write((resp + "\n").encode())

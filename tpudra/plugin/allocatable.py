@@ -81,6 +81,10 @@ class AllocatableDevice:
     # can see whether advertised partitions are hardware-enforced or
     # file-backed simulation (DeviceLib.partitions_supported).
     partitions_supported: bool = True
+    # Multi-process concurrency attestation (DeviceLib.multiprocess_mode):
+    # concurrent | exclusive | unknown — whether a second process can open
+    # the chip while one holds it (probed live on the native backend).
+    multiprocess_mode: str = "unknown"
 
     @property
     def is_partition(self) -> bool:
@@ -104,6 +108,7 @@ class AllocatableDevice:
         }
         if self.type == TYPE_CHIP:
             attrs["partitionsSupported"] = {"bool": self.partitions_supported}
+            attrs["multiprocessMode"] = {"string": self.multiprocess_mode}
         if self.partition_spec is not None:
             attrs["profile"] = {"string": self.partition_spec.profile}
             attrs["coreStart"] = {"int": self.partition_spec.core_start}
@@ -150,6 +155,7 @@ def build_allocatable(
     dynamic_placements: dict[int, list[PartitionPlacement]] | None = None,
     with_vfio: bool = False,
     partitions_supported: bool = True,
+    multiprocess_mode: str = "unknown",
 ) -> dict[str, AllocatableDevice]:
     """Assemble the full allocatable map (enumerateAllPossibleDevices analog,
     nvlib.go:170).
@@ -182,6 +188,7 @@ def build_allocatable(
             name=chip_name(chip.index),
             chip=chip,
             partitions_supported=partitions_supported,
+            multiprocess_mode=multiprocess_mode,
         )
         out[dev.name] = dev
         for placement in (dynamic_placements or {}).get(chip.index, []):

@@ -171,6 +171,7 @@ class DeviceState:
             static_parts,
             dynamic_placements,
             partitions_supported=partitions_supported,
+            multiprocess_mode=devicelib.multiprocess_mode(),
             with_vfio=self._passthrough,
         )
         # Per-device edits cache with startup warmup (reference

@@ -96,6 +96,7 @@ class MultiProcessControlDaemon:
             tensorcore_pct=self.config.default_active_tensorcore_percentage or 100,
             hbm_limits=limits,
             pipe_dir=self.pipe_dir,
+            platform_mode=self._m.devicelib.multiprocess_mode(),
         )
         try:
             self._m.kube.create(gvr.DEPLOYMENTS, deployment, self._m.namespace)
@@ -229,6 +230,7 @@ class MultiProcessManager:
         tensorcore_pct: int,
         hbm_limits: dict[str, str],
         pipe_dir: str,
+        platform_mode: str = "unknown",
     ) -> dict:
         """Render templates/multi-process-daemon.tmpl.yaml
         (reference templates/mps-control-daemon.tmpl.yaml)."""
@@ -244,5 +246,6 @@ class MultiProcessManager:
             tensorcore_pct=tensorcore_pct,
             hbm_limits=";".join(f"{k}={v}" for k, v in sorted(hbm_limits.items())),
             pipe_dir=pipe_dir,
+            platform_mode=platform_mode,
         )
         return yaml.safe_load(rendered)

@@ -6,9 +6,14 @@ GB/s`` and the nvbandwidth multinode memcpy assertion
 (tests/bats/test_cd_mnnvl_workload.bats:18-52).  Instead of NCCL binaries,
 these are jitted XLA collectives over a ``Mesh``:
 
-- psum:       all-reduce — the BASELINE.json "JAX psum GB/s" metric
-- all_gather: payload replication along an axis
-- ppermute:   neighbor ring shift — raw single-link ICI bandwidth
+- psum:           all-reduce — the BASELINE.json "JAX psum GB/s" metric
+- all_gather:     payload replication along an axis
+- ppermute:       neighbor ring shift — raw single-link ICI bandwidth
+- reduce_scatter: the all-reduce half that ends sharded (psum_scatter) —
+                  the ZeRO/optimizer-sharding primitive
+- all_to_all:     full shuffle along an axis — the MoE expert-dispatch
+                  primitive (workload/moe.py routes through GSPMD, but the
+                  wire pattern XLA emits is this)
 
 Each benchmark is written with ``shard_map`` so the collective is explicit
 (not left to sharding propagation) and compiled once; timing loops run the
@@ -155,11 +160,150 @@ def bench_ppermute_ring(mesh, axis: str = "data", mib_per_device: int = 64, iter
     )
 
 
+def bench_reduce_scatter(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
+    """psum_scatter: the reduce-scatter half of a ring all-reduce — each
+    device ends with its 1/n shard of the sum (the gradient/optimizer
+    sharding primitive)."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    elems = max(n, mib_per_device * 2**20 // 2 // n * n)  # divisible by n
+    x = _mk_operand(mesh, axis, elems)
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None))
+    def rs(block):
+        # tiled: the (1, elems) block scatters to (1, elems/n) of the sum.
+        return jax.lax.psum_scatter(block, axis_name=axis, scatter_dimension=1, tiled=True)
+
+    fn = jax.jit(rs)
+    dt = _time_compiled(fn, (x,), iters)
+    payload = elems * 2  # input bytes per device (nccl-tests data-size convention)
+    return BenchResult(
+        op="reduce_scatter",
+        payload_bytes=payload,
+        n_devices=n,
+        seconds_per_op=dt,
+        algo_gbps=payload / dt / 1e9,
+        bus_gbps=((n - 1) / n) * payload / dt / 1e9,
+    )
+
+
+def bench_all_to_all(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
+    """Full shuffle: every device sends a distinct 1/n chunk to every other
+    device — the MoE dispatch/combine wire pattern."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    elems = max(n, mib_per_device * 2**20 // 2 // n * n)
+    x = _mk_operand(mesh, axis, elems)
+
+    @partial(
+        shard_map, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None, None)
+    )
+    def a2a(block):
+        # (1, n, k): chunk j goes to device j; received chunks concat on 0.
+        return jax.lax.all_to_all(
+            block.reshape(1, n, -1), axis_name=axis, split_axis=1, concat_axis=0
+        )
+
+    fn = jax.jit(a2a)
+    dt = _time_compiled(fn, (x,), iters)
+    payload = elems * 2
+    return BenchResult(
+        op="all_to_all",
+        payload_bytes=payload,
+        n_devices=n,
+        seconds_per_op=dt,
+        algo_gbps=payload / dt / 1e9,
+        bus_gbps=((n - 1) / n) * payload / dt / 1e9,
+    )
+
+
 ALL_BENCHES = {
     "psum": bench_psum,
     "all_gather": bench_all_gather,
     "ppermute_ring": bench_ppermute_ring,
+    "reduce_scatter": bench_reduce_scatter,
+    "all_to_all": bench_all_to_all,
 }
+
+
+def verify_collectives(mesh, axis: str = "data") -> list[str]:
+    """Numerical parity for every collective ALL_BENCHES measures, against
+    a local numpy reference on small exact-integer operands — the dryrun's
+    multi-pattern correctness sweep (the nvbandwidth multi-pattern analog,
+    reference test_cd_mnnvl_workload.bats:40-52; bandwidth is published
+    only from real ICI, correctness is asserted everywhere).  Returns the
+    verified op names in ALL_BENCHES order."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    k = 4
+    elems = n * k
+    x = np.arange(n * elems, dtype=np.float32).reshape(n, elems)
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(axis, None)))
+    sm = partial(shard_map, mesh=mesh, in_specs=P(axis, None))
+    verified: list[str] = []
+
+    out = jax.jit(sm(lambda b: jax.lax.psum(b, axis) / n, out_specs=P(axis, None)))(xs)
+    np.testing.assert_allclose(
+        np.asarray(out), np.broadcast_to(x.mean(0), (n, elems)), rtol=1e-6
+    )
+    verified.append("psum")
+
+    out = jax.jit(
+        sm(
+            lambda b: jax.lax.all_gather(b, axis_name=axis, axis=0).reshape(n, -1),
+            out_specs=P(None, None),
+            check_vma=False,
+        )
+    )(xs)
+    np.testing.assert_array_equal(np.asarray(out), x)
+    verified.append("all_gather")
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    out = jax.jit(
+        sm(lambda b: jax.lax.ppermute(b, axis_name=axis, perm=perm), out_specs=P(axis, None))
+    )(xs)
+    np.testing.assert_array_equal(np.asarray(out), np.roll(x, 1, axis=0))
+    verified.append("ppermute_ring")
+
+    out = jax.jit(
+        sm(
+            lambda b: jax.lax.psum_scatter(b, axis_name=axis, scatter_dimension=1, tiled=True),
+            out_specs=P(axis, None),
+        )
+    )(xs)
+    np.testing.assert_allclose(
+        np.asarray(out), x.sum(0).reshape(n, elems // n), rtol=1e-6
+    )
+    verified.append("reduce_scatter")
+
+    out = jax.jit(
+        sm(
+            lambda b: jax.lax.all_to_all(
+                b.reshape(1, n, -1), axis_name=axis, split_axis=1, concat_axis=0
+            ),
+            out_specs=P(axis, None, None),
+        )
+    )(xs)
+    # Device i receives chunk i of every device j: out[i, j] = x[j]'s chunk i.
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(n, n, k), x.reshape(n, n, k).transpose(1, 0, 2)
+    )
+    verified.append("all_to_all")
+
+    assert list(ALL_BENCHES) == verified, (list(ALL_BENCHES), verified)
+    return verified
 
 
 def run_all(mesh, axis: str = "data", mib_per_device: int = 8, iters: int = 5):
