@@ -250,6 +250,15 @@ def _time_train_step(cfg, batch: int, iters: int, chains: int = 2):
     return n_params, best, compile_s
 
 
+def _peak_for(kind: str) -> float | None:
+    """bf16 peak for a jax device_kind, or None when the generation is not
+    in the table (one matching rule for every section's MFU)."""
+    for key, peak in PEAK_BF16_TFLOPS:
+        if key in kind.lower():
+            return peak
+    return None
+
+
 def _model_metrics(cfg, batch: int, n_params: int, dt: float, kind: str) -> dict:
     """MFU accounting shared by every train-step section.  Counts model
     FLOPs as 6N per token plus the quadratic attention term (12·L·S·D) —
@@ -263,11 +272,10 @@ def _model_metrics(cfg, batch: int, n_params: int, dt: float, kind: str) -> dict
         "tokens_per_s": round(tokens_per_step / dt),
         "model_tflops_per_s": round(flops / dt / 1e12, 1),
     }
-    for key, peak in PEAK_BF16_TFLOPS:
-        if key in kind.lower():
-            out["peak_bf16_tflops"] = peak
-            out["mfu_pct"] = round(flops / dt / (peak * 1e12) * 100.0, 1)
-            break
+    peak = _peak_for(kind)
+    if peak is not None:
+        out["peak_bf16_tflops"] = peak
+        out["mfu_pct"] = round(flops / dt / (peak * 1e12) * 100.0, 1)
     return out
 
 
@@ -396,14 +404,53 @@ def bench_moe() -> dict:
         batch = 8
         n_params, dt, _ = _time_train_step(cfg, batch, iters=5)
         tokens_per_step = batch * (cfg.max_seq - 1)
-        return {
+
+        # Expert-FLOP accounting (VERDICT r4 #6): 6·n_params over-counts a
+        # top-1 Switch model by the (num_experts−1) expert FFNs each token
+        # never touches.  The dense-comparable MFU numerator uses ACTIVE
+        # params (one expert FFN per layer; router fully, every token
+        # computes it); the capacity padding XLA really computes (dispatch
+        # to E·C slots, capacity_factor 1.25, lane-aligned) is reported
+        # separately as hardware throughput + overhead, so the
+        # sparse-vs-dense comparison is normalized, not flattered.
+        ffn_params_per_expert = 2 * cfg.d_model * cfg.d_ff  # w1 + w2 (moe.py:59)
+        n_active = n_params - cfg.n_layers * (cfg.num_experts - 1) * ffn_params_per_expert
+        model_flops = tokens_per_step * (
+            6 * n_active + 12 * cfg.n_layers * cfg.max_seq * cfg.d_model
+        )
+        from tpudra.workload.moe import MoEConfig
+
+        moe_cfg = MoEConfig(
+            d_model=cfg.d_model, d_ff=cfg.d_ff, num_experts=cfg.num_experts
+        )
+        # Per-layer dispatch population: the train step feeds the model
+        # tokens[:, :-1] (model.py loss_fn), so each layer routes
+        # batch·(max_seq−1) tokens — same base as tokens_per_step above.
+        routed_tokens = tokens_per_step
+        capacity_slots = cfg.num_experts * moe_cfg.capacity(routed_tokens)
+        padded_extra = max(0, capacity_slots - routed_tokens)
+        computed_flops = model_flops + (
+            6 * ffn_params_per_expert * padded_extra * cfg.n_layers
+        )
+        out = {
             "num_experts": cfg.num_experts,
             "params_m": round(n_params / 1e6, 1),
+            "active_params_m": round(n_active / 1e6, 1),
             "batch": batch,
             "seq": cfg.max_seq,
             "step_ms": round(dt * 1000.0, 1),
             "tokens_per_s": round(tokens_per_step / dt),
+            "model_tflops_per_s": round(model_flops / dt / 1e12, 1),
+            "hw_tflops_per_s": round(computed_flops / dt / 1e12, 1),
+            "capacity_padding_overhead_pct": round(
+                100.0 * padded_extra / routed_tokens, 1
+            ),
         }
+        peak = _peak_for(jax.devices()[0].device_kind)
+        if peak is not None:
+            out["peak_bf16_tflops"] = peak
+            out["mfu_pct"] = round(model_flops / dt / (peak * 1e12) * 100.0, 1)
+        return out
     except Exception as e:  # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"[:300]}
 

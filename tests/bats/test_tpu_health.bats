@@ -12,7 +12,7 @@ setup_file() {
     return 1
   fi
   cluster_up --nodes 1 --chips-per-node 2 --native-backend \
-    --feature-gates TPUDeviceHealthCheck=true
+    --feature-gates TPUDeviceHealthCheck=true,DRAResourceHealthService=true
 }
 
 teardown_file() {
@@ -38,6 +38,25 @@ for s in json.load(sys.stdin)["items"]:
   wait_until 60 sh -c "! kubectl get resourceslices -o json | grep -q '\"tpu-0\"'"
   run kubectl get resourceslices -o json
   [[ "$output" == *'"tpu-1"'* ]]
+}
+
+@test "kubelet-facing DRAResourceHealth stream reports the fault" {
+  # The third service on the plugin socket (plugin/healthservice.py): act
+  # as kubelet, open the v1alpha1 stream, and read a complete snapshot —
+  # the faulted chip must be UNHEALTHY while its sibling stays HEALTHY,
+  # telling the same story as the slice withdrawal above.
+  run python3 -c "
+import sys
+from tpudra.plugin.healthservice import HealthWatchClient
+c = HealthWatchClient('$TPUDRA_STATE/node-0/plugin/dra.sock')
+snap = next(c.watch(timeout=20))
+c.close()
+print('HEALTH', ','.join(
+    k + '=' + ('H' if v['healthy'] else 'U') for k, v in sorted(snap.items())))
+"
+  [ "$status" -eq 0 ]
+  [[ "$output" == *"tpu-0=U"* ]]
+  [[ "$output" == *"tpu-1=H"* ]]
 }
 
 @test "no auto-reheal: the chip stays withheld" {
