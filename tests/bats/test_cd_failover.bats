@@ -38,6 +38,7 @@ metadata:
   name: worker-$n
 spec:
   restartPolicy: Never
+  hostNetwork: true  # multi-host channel contract (test_cd_hostnet.bats)
   nodeSelector:
     kubernetes.io/hostname: node-$n
   containers:

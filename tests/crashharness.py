@@ -1,0 +1,146 @@
+"""Shared scaffolding for the process-level SIGKILL crash sweeps.
+
+One crashable kubelet-plugin subprocess with the two-key crashpoint arming
+(TPUDRA_CRASHPOINT + TPUDRA_TEST_HOOKS, plugin/device_state._crashpoint),
+log capture, the DRA-socket readiness wait, and checkpoint introspection —
+used by tests/test_crash_sweep.py (TPU plugin) and
+tests/test_crash_sweep_cd.py (CD plugin), which differ only in the module
+they boot and the env/argv they add.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+from tests.test_system import wait_for
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The checkpoint boundaries both plugins arm (same names in
+#: plugin/device_state.py and cdplugin/state.py).
+POINTS = ["post-prepare-started", "post-mutate", "post-cdi", "post-completed"]
+
+
+class CrashablePlugin:
+    """One crashable plugin process over a persistent plugin dir."""
+
+    #: python -m target; subclasses set this.
+    module = ""
+
+    def __init__(self, tmp: str, server, node_name: str):
+        self.tmp = tmp
+        self.server = server
+        self.node_name = node_name
+        self.plugin_dir = os.path.join(tmp, "plugin")
+        self.cdi_root = os.path.join(tmp, "cdi")
+        self.log_i = 0
+        self.proc = None
+        self.log_path = None
+
+    # Subclass hooks -------------------------------------------------------
+
+    def extra_argv(self) -> list[str]:
+        return []
+
+    def extra_env(self) -> dict[str, str]:
+        return {}
+
+    # Lifecycle ------------------------------------------------------------
+
+    def start(self, crashpoint: str = ""):
+        env = dict(
+            os.environ,
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            KUBE_API_SERVER=self.server.url,
+            **self.extra_env(),
+        )
+        env.pop("KUBECONFIG", None)
+        if crashpoint:
+            env["TPUDRA_CRASHPOINT"] = crashpoint
+            env["TPUDRA_TEST_HOOKS"] = "1"  # two-key arming (device_state)
+        else:
+            env.pop("TPUDRA_CRASHPOINT", None)
+            env.pop("TPUDRA_TEST_HOOKS", None)
+        self.log_i += 1
+        self.log_path = os.path.join(self.tmp, f"plugin-{self.log_i}.log")
+        with open(self.log_path, "w") as out:
+            self.proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", self.module,
+                    "--node-name", self.node_name,
+                    "--plugin-dir", self.plugin_dir,
+                    "--registry-dir", os.path.join(self.tmp, "registry"),
+                    "--cdi-root", self.cdi_root,
+                    *self.extra_argv(),
+                ],
+                env=env,
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        # Up = the DRA unix socket accepts connections.  (ResourceSlice
+        # publication is the wrong signal for RESTARTS: the first run's
+        # slices persist in the apiserver and would report ready before
+        # the new process listens.)
+        sock_path = os.path.join(self.plugin_dir, "dra.sock")
+
+        def accepting():
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"plugin died during startup:\n{self.log()[-3000:]}"
+                )
+            if not os.path.exists(sock_path):
+                return False
+            s = socket.socket(socket.AF_UNIX)
+            try:
+                s.connect(sock_path)
+                return True
+            except OSError:
+                return False
+            finally:
+                s.close()
+
+        wait_for(accepting, msg="DRA socket accepting")
+        return self.proc
+
+    def log(self) -> str:
+        with open(self.log_path) as f:
+            return f.read()
+
+    def dra(self):
+        from tpudra.plugin.grpcserver import DRAClient
+
+        return DRAClient(os.path.join(self.plugin_dir, "dra.sock"))
+
+    def cdi_files(self):
+        try:
+            return sorted(os.listdir(self.cdi_root))
+        except FileNotFoundError:
+            return []
+
+    def checkpoint(self) -> dict:
+        with open(os.path.join(self.plugin_dir, "checkpoint.json")) as f:
+            return json.load(f)
+
+    def claim_statuses(self) -> dict:
+        """{uid: status} from the dual-version checkpoint (the v2 payload
+        is a JSON-encoded string under "data", checkpoint.py)."""
+        data = json.loads(self.checkpoint()["v2"]["data"])
+        return {
+            uid: c.get("status", "")
+            for uid, c in data.get("preparedClaims", {}).items()
+        }
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()

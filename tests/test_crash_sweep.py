@@ -22,11 +22,8 @@ flock'd state file is what survives the kill the way silicon would
 TPUDRA_CRASHPOINT env read by ``device_state._crashpoint``.
 """
 
-import json
 import os
 import signal
-import subprocess
-import sys
 
 import pytest
 
@@ -35,14 +32,13 @@ from tpudra.devicelib.native import DEFAULT_LIB_PATH
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeClient
 from tpudra.kube.httpserver import FakeKubeServer
-from tests.test_system import wait_for  # shared process-suite scaffolding
-from tpudra.plugin.grpcserver import DRAClient, RPCError
+from tpudra.plugin.grpcserver import RPCError
+from tests.crashharness import POINTS, CrashablePlugin
+from tests.test_system import wait_for
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LIB_PATH = os.environ.get("TPUINFO_LIBRARY_PATH", DEFAULT_LIB_PATH)
 
 API_V = "resource.tpu.google.com/v1beta1"
-POINTS = ["post-prepare-started", "post-mutate", "post-cdi", "post-completed"]
 
 pytestmark = pytest.mark.skipif(
     not os.path.exists(LIB_PATH),
@@ -50,112 +46,28 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-class Harness:
-    """One crashable plugin instance over a persistent hardware state."""
+class Harness(CrashablePlugin):
+    """One crashable TPU plugin over a persistent native hardware state."""
+
+    module = "tpudra.plugin.main"
 
     def __init__(self, tmp, server):
-        self.tmp = tmp
-        self.server = server
+        super().__init__(tmp, server, "crash-node")
         self.cfg_path = os.path.join(tmp, "tpuinfo.cfg")
         self.state_file = os.path.join(tmp, "tpuinfo-state")
-        self.plugin_dir = os.path.join(tmp, "plugin")
-        self.cdi_root = os.path.join(tmp, "cdi")
-        self.log_i = 0
-        self.proc = None
-        self.log_path = None
         with open(self.cfg_path, "w") as f:
             f.write(
                 "generation=v5p\nnum_chips=4\nhost_index=0\nnum_hosts=1\n"
                 f"slice_uuid=crash\nstate_file={self.state_file}\n"
             )
 
-    def start(self, crashpoint=""):
-        env = dict(
-            os.environ,
-            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
-            KUBE_API_SERVER=self.server.url,
-            FEATURE_GATES="DynamicPartitioning=true",
-            TPUINFO_LIBRARY_PATH=LIB_PATH,
-        )
-        env.pop("KUBECONFIG", None)
-        if crashpoint:
-            env["TPUDRA_CRASHPOINT"] = crashpoint
-            env["TPUDRA_TEST_HOOKS"] = "1"  # two-key arming (device_state)
-        else:
-            env.pop("TPUDRA_CRASHPOINT", None)
-            env.pop("TPUDRA_TEST_HOOKS", None)
-        self.log_i += 1
-        self.log_path = os.path.join(self.tmp, f"plugin-{self.log_i}.log")
-        out = open(self.log_path, "w")
-        try:
-            self.proc = subprocess.Popen(
-                [
-                    sys.executable, "-m", "tpudra.plugin.main",
-                    "--node-name", "crash-node",
-                    "--plugin-dir", self.plugin_dir,
-                    "--registry-dir", os.path.join(self.tmp, "registry"),
-                    "--cdi-root", self.cdi_root,
-                    "--device-backend", "native",
-                    "--tpuinfo-config", self.cfg_path,
-                ],
-                env=env,
-                stdout=out,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-        finally:
-            out.close()
-        # Up = the DRA unix socket accepts connections.  (ResourceSlice
-        # publication is the wrong signal for RESTARTS: the first run's
-        # slices persist in the apiserver and would report ready before
-        # the new process listens.)
-        import socket
+    def extra_argv(self):
+        return ["--device-backend", "native", "--tpuinfo-config", self.cfg_path]
 
-        sock_path = os.path.join(self.plugin_dir, "dra.sock")
-
-        def accepting():
-            if self.proc.poll() is not None:
-                raise AssertionError(
-                    f"plugin died during startup:\n{self.log()[-3000:]}"
-                )
-            if not os.path.exists(sock_path):
-                return False
-            s = socket.socket(socket.AF_UNIX)
-            try:
-                s.connect(sock_path)
-                return True
-            except OSError:
-                return False
-            finally:
-                s.close()
-
-        wait_for(accepting, msg="DRA socket accepting")
-        return self.proc
-
-    def log(self) -> str:
-        with open(self.log_path) as f:
-            return f.read()
-
-    def dra(self) -> DRAClient:
-        return DRAClient(os.path.join(self.plugin_dir, "dra.sock"))
-
-    def cdi_files(self):
-        try:
-            return sorted(os.listdir(self.cdi_root))
-        except FileNotFoundError:
-            return []
-
-    def checkpoint(self) -> dict:
-        with open(os.path.join(self.plugin_dir, "checkpoint.json")) as f:
-            return json.load(f)
-
-    def claim_statuses(self) -> dict:
-        """{uid: status} from the dual-version checkpoint (the v2 payload
-        is a JSON-encoded string under "data", checkpoint.py)."""
-        data = json.loads(self.checkpoint()["v2"]["data"])
+    def extra_env(self):
         return {
-            uid: c.get("status", "")
-            for uid, c in data.get("preparedClaims", {}).items()
+            "FEATURE_GATES": "DynamicPartitioning=true",
+            "TPUINFO_LIBRARY_PATH": LIB_PATH,
         }
 
     def live_partitions(self) -> list:
@@ -170,15 +82,6 @@ class Harness:
             ln for ln in text.splitlines()
             if ln.strip() and "part" in ln
         ]
-
-    def terminate(self):
-        if self.proc and self.proc.poll() is None:
-            self.proc.send_signal(signal.SIGTERM)
-            try:
-                self.proc.wait(timeout=20)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait()
 
 
 def chip_claim(uid):

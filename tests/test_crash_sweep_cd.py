@@ -1,0 +1,177 @@
+"""Process-level crash-consistency sweep for the COMPUTE-DOMAIN plugin.
+
+tests/test_crash_sweep.py SIGKILLs the TPU plugin at every checkpoint
+boundary; this file applies the same discipline to the CD plugin, whose
+"hardware mutation" is cluster/filesystem state instead of silicon: the
+node label that summons the domain DaemonSet, the per-domain host dir, and
+the channel CDI spec.  Kill points are the ``_crashpoint`` hooks in
+cdplugin/state.py (two-key arming, shared with plugin/device_state.py):
+
+- ``post-prepare-started``  intent (domainUID/configType) checkpointed,
+  no side effects yet — the rollback branch's whole knowledge
+- ``post-mutate``           node labeled + domain dir created, no CDI spec
+- ``post-cdi``              spec written, claim still PrepareStarted
+- ``post-completed``        checkpointed complete, RPC answer may be lost
+
+After each kill the restarted plugin must converge: kubelet's retry
+completes the claim (idempotent add_node_label), and unprepare of the
+final state removes the label, the spec, and the checkpoint entry — the
+StartedClaimRollback story (device_state.go:482 discipline), proven
+against a real process death rather than an injected exception.
+"""
+
+import os
+import signal
+
+import pytest
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra.api.computedomain import COMPUTE_DOMAIN_NODE_LABEL
+from tpudra.kube import gvr
+from tpudra.kube.client import KubeClient
+from tpudra.kube.httpserver import FakeKubeServer
+from tpudra.plugin.grpcserver import RPCError
+from tests.crashharness import POINTS, CrashablePlugin
+
+API_V = "resource.tpu.google.com/v1beta1"
+CD_UID = "cd-crash-uid"
+NODE = "crash-node"
+
+
+class CDHarness(CrashablePlugin):
+    module = "tpudra.cdplugin.main"
+
+    def __init__(self, tmp, server):
+        super().__init__(tmp, server, NODE)
+
+    def extra_argv(self):
+        # Mock backend: the CD plugin needs no real silicon, and the mock
+        # keeps this sweep runnable without the native build (its sibling
+        # TPU sweep is the one exercising libtpuinfo's flock'd registry).
+        return ["--device-backend", "mock"]
+
+    def domain_dirs(self):
+        try:
+            return sorted(os.listdir(os.path.join(self.plugin_dir, "domains")))
+        except FileNotFoundError:
+            return []
+
+
+def channel_claim(uid):
+    return {
+        "metadata": {"uid": uid, "namespace": "default", "name": uid},
+        "status": {"allocation": {"devices": {
+            "results": [{
+                "request": "channel",
+                "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                "pool": NODE,
+                "device": "channel-7",
+            }],
+            "config": [{
+                "source": "FromClaim",
+                "requests": [],
+                "opaque": {
+                    "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                    "parameters": {
+                        "apiVersion": API_V,
+                        "kind": "ComputeDomainChannelConfig",
+                        "domainID": CD_UID,
+                        "allocationMode": "Single",
+                    },
+                },
+            }],
+        }}},
+    }
+
+
+def seed_cluster(client):
+    """Node + a Ready-on-this-node ComputeDomain, so the channel prepare
+    passes the namespace and readiness gates and reaches the crashpoints."""
+    client.create(gvr.NODES, {"metadata": {"name": NODE, "labels": {}}})
+    client.create(
+        gvr.COMPUTE_DOMAINS,
+        {
+            "apiVersion": API_V,
+            "kind": "ComputeDomain",
+            "metadata": {"name": "cd-crash", "namespace": "default", "uid": CD_UID},
+            "spec": {"numNodes": 1},
+            "status": {
+                "status": "Ready",
+                "nodes": [{"name": NODE, "status": "Ready"}],
+            },
+        },
+        "default",
+    )
+
+
+def node_label(client):
+    node = client.get(gvr.NODES, NODE)
+    return node["metadata"].get("labels", {}).get(COMPUTE_DOMAIN_NODE_LABEL)
+
+
+@pytest.mark.parametrize("point", POINTS)
+def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point):
+    uid = f"cd-crash-{point}"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        seed_cluster(client)
+        h = CDHarness(short_tmp, server)
+        h.start(crashpoint=point)
+        try:
+            claim = channel_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            resp = None
+            try:
+                try:
+                    resp = dra.prepare([claim])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            if resp is not None and point != "post-completed":
+                assert "error" in resp["claims"].get(uid, {}), (point, resp)
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+
+            # -------- state the kill left behind
+            statuses = h.claim_statuses()
+            if point == "post-completed":
+                assert statuses.get(uid) == "PrepareCompleted"
+                assert any(uid in f for f in h.cdi_files())
+            else:
+                assert statuses.get(uid) == "PrepareStarted", statuses
+            if point == "post-prepare-started":
+                # Intent only: no side effect may precede the Started write.
+                assert node_label(client) is None
+                assert not any(uid in f for f in h.cdi_files())
+            if point in ("post-mutate", "post-cdi", "post-completed"):
+                assert node_label(client) == CD_UID
+                assert CD_UID in h.domain_dirs()
+            if point == "post-mutate":
+                assert not any(uid in f for f in h.cdi_files())
+            if point == "post-cdi":
+                assert any(uid in f for f in h.cdi_files())
+
+            # -------- restart without the crashpoint: must converge
+            h.start()
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                result = resp["claims"][uid]
+                assert result.get("devices"), (point, result)
+                assert len([f for f in h.cdi_files() if uid in f]) == 1
+                assert h.claim_statuses().get(uid) == "PrepareCompleted"
+                assert node_label(client) == CD_UID
+
+                # Teardown of the last claim rolls everything back — the
+                # PrepareStarted rollback branch and the completed path
+                # must both land in the same clean end state.
+                dra.unprepare([claim])
+            finally:
+                dra.close()
+            assert not any(uid in f for f in h.cdi_files())
+            assert uid not in h.claim_statuses()
+            assert node_label(client) is None
+        finally:
+            h.terminate()

@@ -47,6 +47,7 @@ from tpudra.plugin.device_state import (
     PrepareError,
     PreparedDeviceResult,
     _claim_identity,
+    _crashpoint,
 )
 
 logger = logging.getLogger(__name__)
@@ -155,6 +156,7 @@ class ComputeDomainDeviceState:
         self._cp.mutate(start)
         if cached:
             return cached
+        _crashpoint("post-prepare-started")
 
         try:
             if isinstance(config, ComputeDomainChannelConfig):
@@ -173,9 +175,13 @@ class ComputeDomainDeviceState:
             raise
 
         devices, edits = group
+        # Side effects so far: node label + per-domain host dir (channel) or
+        # daemon settings dir (daemon) — the CD plugin's "hardware mutation".
+        _crashpoint("post-mutate")
         self._cdi.create_claim_spec_file(
             uid, {d.canonical_name: ContainerEdits() for d in devices}, edits
         )
+        _crashpoint("post-cdi")
 
         def complete(cp: Checkpoint) -> None:
             cp.prepared_claims[uid] = PreparedClaim(
@@ -187,6 +193,7 @@ class ComputeDomainDeviceState:
             )
 
         self._cp.mutate(complete)
+        _crashpoint("post-completed")
         logger.info(
             "prepared CD claim %s/%s:%s t_prep=%.4fs",
             namespace, name, uid, time.monotonic() - t0,
