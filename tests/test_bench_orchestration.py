@@ -42,6 +42,14 @@ def stubbed(monkeypatch):
     return ran
 
 
+@pytest.fixture(autouse=True)
+def details_in_tmp(monkeypatch, tmp_path):
+    """bench resolves the details-file dir from its own __file__; point it
+    at tmp so test artifacts never land in the repo (narrow seam — not a
+    process-wide os.path.abspath patch)."""
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+
+
 def _lines(capsys):
     out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()]
     partials = [l for l in out if l.get("partial")]
@@ -51,14 +59,12 @@ def _lines(capsys):
 
 
 def test_hung_probe_degrades_to_diagnostic_and_parsed_headline(
-    stubbed, monkeypatch, capsys, tmp_path
+    stubbed, monkeypatch, capsys
 ):
     monkeypatch.setattr(
         bench, "_probe_device_backend",
         lambda timeout=180.0: {"reachable": False, "error": "timed out"},
     )
-    monkeypatch.chdir(tmp_path)  # details file lands here, not in the repo
-    monkeypatch.setattr(bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py"))
     bench.main([])
     partials, final = _lines(capsys)
     # The headline is parsed even with the device backend gone.
@@ -75,7 +81,7 @@ def test_hung_probe_degrades_to_diagnostic_and_parsed_headline(
     assert sections[0] == "probe" and "bind" in sections
 
 
-def test_healthy_single_chip_runs_device_sections(stubbed, monkeypatch, capsys, tmp_path):
+def test_healthy_single_chip_runs_device_sections(stubbed, monkeypatch, capsys):
     monkeypatch.setattr(
         bench, "_probe_device_backend",
         lambda timeout=180.0: {
@@ -83,7 +89,6 @@ def test_healthy_single_chip_runs_device_sections(stubbed, monkeypatch, capsys, 
             "device_kind": "TPU v5 lite", "n_devices": 1,
         },
     )
-    monkeypatch.setattr(bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py"))
     bench.main([])
     # Single chip: the collectives CPU hook path, not the multichip section.
     assert "collectives" not in stubbed
@@ -94,7 +99,7 @@ def test_healthy_single_chip_runs_device_sections(stubbed, monkeypatch, capsys, 
     _lines(capsys)
 
 
-def test_forced_cpu_mesh_never_publishes_ici_bandwidth(stubbed, monkeypatch, capsys, tmp_path):
+def test_forced_cpu_mesh_never_publishes_ici_bandwidth(stubbed, monkeypatch, capsys):
     """XLA_FLAGS-forced host devices look multi-chip (n=8) but the backend
     is cpu — the multichip collectives section must NOT run."""
     monkeypatch.setattr(
@@ -103,14 +108,13 @@ def test_forced_cpu_mesh_never_publishes_ici_bandwidth(stubbed, monkeypatch, cap
             "reachable": True, "backend": "cpu", "device_kind": "cpu", "n_devices": 8,
         },
     )
-    monkeypatch.setattr(bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py"))
     bench.main([])
     assert "collectives" not in stubbed
     _, final = _lines(capsys)
     assert final["extras"]["collectives"]["hook_exercised"] is True
 
 
-def test_full_flag_unlocks_ab_and_scale(stubbed, monkeypatch, capsys, tmp_path):
+def test_full_flag_unlocks_ab_and_scale(stubbed, monkeypatch, capsys):
     monkeypatch.setattr(
         bench, "_probe_device_backend",
         lambda timeout=180.0: {
@@ -118,14 +122,13 @@ def test_full_flag_unlocks_ab_and_scale(stubbed, monkeypatch, capsys, tmp_path):
             "device_kind": "TPU v5 lite", "n_devices": 1,
         },
     )
-    monkeypatch.setattr(bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py"))
     bench.main(["--full"])
     assert "scale" in stubbed
     assert {"ab_remat_full", "ab_naive", "ab_ce_fused", "ab_opt_fused"} <= set(stubbed)
     _lines(capsys)
 
 
-def test_wall_budget_exhaustion_skips_with_marker(stubbed, monkeypatch, capsys, tmp_path):
+def test_wall_budget_exhaustion_skips_with_marker(stubbed, monkeypatch, capsys):
     monkeypatch.setenv("TPUDRA_BENCH_WALL_S", "0")
     monkeypatch.setattr(
         bench, "_probe_device_backend",
@@ -134,7 +137,6 @@ def test_wall_budget_exhaustion_skips_with_marker(stubbed, monkeypatch, capsys, 
             "device_kind": "TPU v5 lite", "n_devices": 1,
         },
     )
-    monkeypatch.setattr(bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py"))
     bench.main([])
     assert stubbed == []  # nothing ran: budget already spent
     _, final = _lines(capsys)

@@ -275,3 +275,50 @@ class TestManifests:
         assert [w.lower() for w in last] == ["from", "runtime"], froms[-1]
         # And the workload stage must exist for the demo image build.
         assert any("as workload" in f.lower() for f in froms), froms
+
+
+class TestKubectliteJsonpath:
+    """The mini jsonpath used by the bats suite's kubectl shim — including
+    kubectl's two spellings for dotted annotation/label keys (the gap that
+    originally made test_cd_hostnet.bats fall back to -o json | grep)."""
+
+    def _jp(self):
+        import importlib
+        import sys
+
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            return importlib.import_module("kubectlite").jsonpath
+        finally:
+            sys.path.pop(0)
+
+    def test_paths_indexes_and_wildcards(self):
+        jp = self._jp()
+        obj = {"items": [{"status": {"phase": "Running"}},
+                         {"status": {"phase": "Pending"}}]}
+        assert jp(obj, "{.items[*].status.phase}") == ["Running", "Pending"]
+        assert jp(obj, "{.items[1].status.phase}") == ["Pending"]
+        assert jp(obj, "{.missing.key}") == []
+
+    def test_dotted_keys_escaped_and_bracketed(self):
+        jp = self._jp()
+        obj = {"metadata": {"annotations": {
+            "sim.tpu.google.com/event": "prepared", "plain": "x"}}}
+        assert jp(obj, r"{.metadata.annotations.sim\.tpu\.google\.com/event}") == [
+            "prepared"
+        ]
+        assert jp(obj, "{.metadata.annotations['sim.tpu.google.com/event']}") == [
+            "prepared"
+        ]
+        assert jp(obj, "{.metadata.annotations.plain}") == ["x"]
+
+    def test_negative_and_malformed(self):
+        jp = self._jp()
+        obj = {"items": [1, 2, 3]}
+        assert jp(obj, "{.items[-1]}") == [3]
+        assert jp(obj, "{.items[-5]}") == []  # out of range: empty, no crash
+        import pytest as _pytest
+
+        for bad in ("{.items[0.name}", "{.items[foo]}", "{.a[]}"):
+            with _pytest.raises(ValueError, match="malformed jsonpath"):
+                jp(obj, bad)

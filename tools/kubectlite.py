@@ -83,28 +83,73 @@ def load_docs(path: str) -> list[dict]:
 
 # ------------------------------------------------------------------ jsonpath
 
+def _jsonpath_tokens(expr: str):
+    """Tokenize a jsonpath body into key / index steps.
+
+    Beyond bare keys, supports kubectl's two ways to address keys that
+    contain dots (annotation/label keys like sim.tpu.google.com/event):
+    backslash-escaped dots (``.annotations.sim\\.tpu\\.google\\.com/event``)
+    and bracket-quoted keys (``.annotations['sim.tpu.google.com/event']``).
+    """
+    i, n = 0, len(expr)
+    while i < n:
+        c = expr[i]
+        if c == ".":
+            i += 1
+        elif c == "[":
+            j = expr.find("]", i)
+            if j < 0:
+                raise ValueError(f"unclosed '[' at offset {i}")
+            inner = expr[i + 1 : j]
+            if inner == "*":
+                yield ("wild", None)
+            elif len(inner) >= 2 and inner[0] in "'\"" and inner[-1] == inner[0]:
+                yield ("key", inner[1:-1])
+            else:
+                try:
+                    yield ("idx", int(inner))
+                except ValueError:
+                    raise ValueError(f"bad index/quoted key [{inner}]") from None
+            i = j + 1
+        else:
+            # Bare key: runs to the next unescaped '.' or '['.
+            out = []
+            while i < n and expr[i] not in ".[":
+                if expr[i] == "\\" and i + 1 < n:
+                    out.append(expr[i + 1])
+                    i += 2
+                else:
+                    out.append(expr[i])
+                    i += 1
+            yield ("key", "".join(out))
+
+
 def jsonpath(obj, expr: str):
-    """Minimal jsonpath: {.a.b[0].c} and [*] wildcards."""
+    """Minimal jsonpath: {.a.b[0].c}, [*] wildcards, ['quoted.key'] and
+    backslash-escaped dotted keys.  Raises ValueError (with the offending
+    segment) on malformed expressions, like kubectl's own parse error."""
+    orig = expr
     expr = expr.strip()
     if expr.startswith("{") and expr.endswith("}"):
         expr = expr[1:-1]
     expr = expr.lstrip(".")
+    try:
+        tokens = list(_jsonpath_tokens(expr))
+    except ValueError as e:
+        raise ValueError(f"malformed jsonpath {orig!r}: {e}") from None
     values = [obj]
-    token_re = re.compile(r"([^.\[\]]+)|\[(\*|\d+)\]")
-    for m in token_re.finditer(expr):
-        key, idx = m.group(1), m.group(2)
+    for kind, arg in tokens:
         next_values = []
         for v in values:
-            if key is not None:
-                if isinstance(v, dict) and key in v:
-                    next_values.append(v[key])
-            elif idx == "*":
+            if kind == "key":
+                if isinstance(v, dict) and arg in v:
+                    next_values.append(v[arg])
+            elif kind == "wild":
                 if isinstance(v, list):
                     next_values.extend(v)
             else:
-                i = int(idx)
-                if isinstance(v, list) and i < len(v):
-                    next_values.append(v[i])
+                if isinstance(v, list) and -len(v) <= arg < len(v):
+                    next_values.append(v[arg])
         values = next_values
     return values
 
@@ -202,7 +247,11 @@ def cmd_get(args) -> int:
     elif o and o.startswith("jsonpath="):
         expr = o[len("jsonpath="):]
         scope = objs[0] if (args.names and len(objs) == 1) else {"items": objs}
-        print(" ".join(fmt_value(v) for v in jsonpath(scope, expr)))
+        try:
+            values = jsonpath(scope, expr)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        print(" ".join(fmt_value(v) for v in values))
     else:
         rows = []
         for obj in objs:
@@ -263,7 +312,12 @@ def cmd_wait(args) -> int:
                 expr, _, want = mode[len("jsonpath="):].partition("=")
                 ok = bool(objs)
                 for o in objs:
-                    got = jsonpath(o, expr)
+                    try:
+                        got = jsonpath(o, expr)
+                    except ValueError as e:
+                        # Malformed expression never becomes true: error out
+                        # instead of polling until the wait timeout.
+                        sys.exit(f"error: {e}")
                     if want:
                         ok = ok and got and fmt_value(got[0]) == want
                     else:
