@@ -84,7 +84,8 @@ dump_cluster_state() {
   echo "--- pods:"; kubectl get pods -A || true
   echo "--- claims:"; kubectl get resourceclaims -A -o name || true
   echo "--- slices:"; kubectl get resourceslices -o name || true
-  for f in "$TPUDRA_STATE"/logs/*.log; do
-    echo "--- ${f##*/} (tail):"; tail -20 "$f"
+  for f in "${TPUDRA_STATE:-}"/logs/*.log; do
+    [ -f "$f" ] || continue  # unexpanded glob: no logs (partial cluster_up)
+    echo "--- ${f##*/} (tail):"; tail -20 "$f" || true
   done
 }

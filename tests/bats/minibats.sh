@@ -92,6 +92,12 @@ while IFS=$'\t' read -r idx name; do
   else
     echo "not ok $idx $name"
     sed 's/^/#   /' "$out_file"
+    # Failure hook (the reference's dump discipline, test_gpu_basic.bats:18):
+    # if the suite's helpers define dump_cluster_state, capture pods/claims/
+    # slices + log tails as TAP comments, bounded.
+    if declare -F dump_cluster_state >/dev/null; then
+      dump_cluster_state 2>&1 | sed 's/^/#   dump: /' | head -80
+    fi
     FAILED=$((FAILED + 1))
   fi
 done < "$TMP/names"
