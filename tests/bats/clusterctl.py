@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -199,10 +200,78 @@ def _mk_fake_sysfs(node_dir: str, topo: dict) -> str:
 # -------------------------------------------------------------------- up
 
 
+_ORPHAN_MARKERS = ("tpudra", "clusterctl", "tpu-slicewatchd", "tpu-mp-control")
+
+
+def reap_stale_orphans() -> int:
+    """Kill processes left over from SIGKILLed/aborted cluster runs.
+
+    A hermetic cluster's processes are recorded in <state>/procs.json and
+    torn down by ``down`` — but a runner killed with SIGKILL (CI timeout,
+    Ctrl-Z'd shell, aborted soak) never runs teardown, and the survivors
+    keep polling a dead apiserver forever (observed: 100+ daemons from one
+    round of aborted runs, distorting every co-resident benchmark).  The
+    heuristic is strict on purpose: only processes that (a) look like ours
+    (cmdline mentions tpudra/clusterctl/tpu-slicewatchd/tpu-mp-control) and
+    (b) reference a ``/tmp/tpubats-*`` state dir — in cmdline or environ —
+    that NO LONGER EXISTS are reaped — and only when the executable is
+    one of ours (python/our native binaries): an operator's pager or grep
+    holding a path like .../tpubats-gone/clusterctl.log must never be
+    collateral.  Never self or ancestors."""
+    state_dir_re = re.compile(rb"(/tmp/tpubats-[A-Za-z0-9_]{4,16})")
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    while pid > 1:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                pid = int(f.read().split(")")[-1].split()[1])
+            ancestors.add(pid)
+        except OSError:
+            break
+    reaped = 0
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit():
+            continue
+        pid = int(pid_s)
+        if pid == me or pid in ancestors:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read()
+            argv0 = os.path.basename(cmdline.split(b"\0", 1)[0]).decode(
+                errors="replace"
+            )
+            if not (argv0.startswith("python") or argv0.startswith("tpu-")):
+                continue
+            if not any(m.encode() in cmdline for m in _ORPHAN_MARKERS):
+                continue
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                blob = cmdline + f.read()
+        except OSError:
+            continue  # raced away or not ours to inspect
+        dirs = set(state_dir_re.findall(blob))
+        if not dirs or any(os.path.isdir(d.decode()) for d in dirs):
+            continue  # no state-dir tie, or its cluster is still live
+        try:
+            os.kill(pid, signal.SIGKILL)
+            reaped += 1
+        except OSError:
+            pass
+    if reaped:
+        print(f"reaped {reaped} stale process(es) from dead state dirs",
+              file=sys.stderr)
+    return reaped
+
+
 def cmd_up(args) -> int:
     from tpudra.kube import gvr
     from tpudra.kube.client import KubeClient
     from helmlite import Chart
+
+    # Self-healing: every cluster boot clears the debris of previously
+    # aborted runs before adding its own processes.
+    reap_stale_orphans()
 
     state = args.state
     os.makedirs(state, exist_ok=True)

@@ -124,3 +124,78 @@ class TestRbatsSemantics:
         # teardown ran for every test, including the failing ones.
         log = (tmp_path / "teardown.log").read_text()
         assert {f"teardown-ran-for-{i}" for i in (1, 2, 3)} <= set(log.split())
+
+
+class TestOrphanReaper:
+    """clusterctl.reap_stale_orphans: processes tied to a DELETED
+    /tmp/tpubats-* state dir are killed at the next cluster boot; live
+    clusters and unrelated processes are untouched (the leak class that
+    left 100+ daemons polling dead apiservers after aborted runs)."""
+
+    def _spawn(self, marker_dir):
+        # A sleeping process whose cmdline carries both an ours-marker and
+        # the state-dir path as real argv (like `clusterctl.py serve
+        # --url-file /tmp/tpubats-XXXXXX/apiserver.url`).
+        import sys as _sys
+
+        return subprocess.Popen(
+            [_sys.executable, "-c", "import time; time.sleep(300)",
+             "--tpudra-marker", f"{marker_dir}/x"],
+        )
+
+    def _reap(self):
+        import importlib
+        import sys
+
+        sys.path.insert(0, BATS_DIR)
+        try:
+            return importlib.import_module("clusterctl").reap_stale_orphans()
+        finally:
+            # clusterctl's module body inserts its own entries at position
+            # 0; remove exactly what this test added.
+            sys.path.remove(BATS_DIR)
+
+    def test_dead_state_dir_process_is_reaped_live_is_kept(self):
+        import tempfile
+        import time
+
+        dead = tempfile.mkdtemp(prefix="tpubats-", dir="/tmp")
+        live = tempfile.mkdtemp(prefix="tpubats-", dir="/tmp")
+        # Dir names must match the /tmp/tpubats-XXXXXX shape the reaper keys on.
+        p_dead = self._spawn(dead)
+        p_live = self._spawn(live)
+        try:
+            os.rmdir(dead)  # its cluster is gone
+            self._reap()
+            deadline = time.time() + 5
+            while p_dead.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            assert p_dead.poll() is not None, "dead-cluster process not reaped"
+            assert p_live.poll() is None, "live-cluster process was reaped"
+        finally:
+            for p in (p_dead, p_live):
+                if p.poll() is None:
+                    p.kill()
+                p.wait()
+            if os.path.isdir(live):
+                os.rmdir(live)
+
+    def test_unrelated_process_with_dead_dir_is_untouched(self):
+        import sys as _sys
+        import tempfile
+        import time
+
+        dead = tempfile.mkdtemp(prefix="tpubats-", dir="/tmp")
+        # Dead-dir path IS in argv, exe IS python — but no ours-marker:
+        # the marker gate alone must keep it alive.
+        p = subprocess.Popen(
+            [_sys.executable, "-c", "import time; time.sleep(300)", f"{dead}/x"]
+        )
+        try:
+            os.rmdir(dead)
+            self._reap()
+            time.sleep(0.3)
+            assert p.poll() is None
+        finally:
+            p.kill()
+            p.wait()
