@@ -1,10 +1,13 @@
 # Developer entry points (the reference's Makefile/versions.mk analog).
 
+# tier1 needs bash (pipefail / PIPESTATUS); everything else is fine under it.
+SHELL := /bin/bash
+
 IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast bats bats-real bench image helm-render clean
+.PHONY: all native test test-fast tier1 bats bats-real bench bench-bind image helm-render clean
 
 all: native test
 
@@ -21,6 +24,16 @@ test-fast:
 	  --ignore=tests/test_computedomain.py \
 	  --ignore=tests/test_native.py
 
+# The exact ROADMAP.md tier-1 verify command (what the PR driver runs).
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors \
+	  -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 \
+	  | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
 # Whole e2e suite under minibats (fast runner).
 bats: native
 	for f in tests/bats/test_*.bats; do \
@@ -34,8 +47,17 @@ bats-real: native
 	  tests/bats/vendor/selftest/semantics.bats \
 	  $$(grep -v '^#' tests/bats/vendor/lane-files.txt | sed 's|^|tests/bats/|')
 
+# Full bench; afterwards print the bind-p50 delta vs the newest prior-round
+# BENCH_r*.json (when one exists with a parsed headline).
 bench: native
-	python bench.py
+	set -o pipefail; python bench.py | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# CPU-only bind sections (headline + multi-claim batch) — the quick A/B
+# artifact for bind-path changes.
+bench-bind:
+	set -o pipefail; python bench.py --bind-only | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 image:
 	docker build -f deployments/container/Dockerfile \

@@ -29,10 +29,20 @@ end to end through every real layer of this driver):
   … then the matching unprepare.
 
 Run: ``python bench.py`` — prints exactly one JSON line.
+
+Knobs (for A/B runs on the bind path):
+
+  --iters N / --warmup N   iteration counts for the bind sections, so an A/B
+                           pair can trade precision for wall time and is not
+                           dominated by first-iteration cache effects
+  --bind-only              run ONLY the CPU-only bind sections (headline +
+                           multi-claim batch) and print their line — the
+                           before/after artifact for bind-path PRs
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import statistics
@@ -43,6 +53,7 @@ import time
 
 ITERS = 200
 WARMUP = 10
+BATCH_CLAIMS = 8  # claims per NodePrepareResources call in the batch bench
 BASELINE_BIND_MS = 8000.0  # reference e2e bound, test_gpu_basic.bats:33
 
 # bf16 peak TFLOP/s by TPU generation (public spec sheets), keyed by
@@ -71,20 +82,25 @@ BENCH_BATCH = 16
 STEP_ITERS = 10
 
 
-def bench_bind_p50() -> float:
-    from tests.test_device_state import mk_claim
+@contextlib.contextmanager
+def _bench_driver(generation: str = "v5p", num_chips: int = None):
+    """One bind-bench harness: mock-device driver + kubelet-side DRA gRPC
+    client on a scratch dir.  Yields (kube, client) — shared by the
+    single-claim headline and the multi-claim batch sections so both always
+    benchmark the identical driver configuration."""
     from tpudra.devicelib import MockTopologyConfig
     from tpudra.devicelib.mock import MockDeviceLib
-    from tpudra.kube import gvr
     from tpudra.kube.fake import FakeKube
     from tpudra.plugin.driver import Driver, DriverConfig
     from tpudra.plugin.grpcserver import DRAClient
 
     with tempfile.TemporaryDirectory() as tmp:
-        lib = MockDeviceLib(
-            config=MockTopologyConfig(generation="v5p"),
-            state_file=f"{tmp}/hw.json",
+        topo = (
+            MockTopologyConfig(generation=generation)
+            if num_chips is None
+            else MockTopologyConfig(generation=generation, num_chips=num_chips)
         )
+        lib = MockDeviceLib(config=topo, state_file=f"{tmp}/hw.json")
         kube = FakeKube()
         driver = Driver(
             DriverConfig(
@@ -99,26 +115,88 @@ def bench_bind_p50() -> float:
         driver.start()
         client = DRAClient(driver.sockets.dra_socket_path)
         try:
-            samples_ms: list[float] = []
-            for i in range(ITERS + WARMUP):
-                uid = f"bench-{i}"
-                claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
-                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
-                # Timed span = what kubelet experiences: the DRA gRPC call,
-                # including the plugin's claim-reference resolution.
-                t0 = time.perf_counter()
-                resp = client.prepare([claim])
-                dt = (time.perf_counter() - t0) * 1000.0
-                result = resp["claims"][uid]
-                if "error" in result:
-                    raise RuntimeError(f"prepare failed: {result['error']}")
-                client.unprepare([claim])
-                if i >= WARMUP:
-                    samples_ms.append(dt)
-            return statistics.median(samples_ms)
+            yield kube, client
         finally:
             client.close()
             driver.stop()
+
+
+def bench_bind_p50(iters: int = None, warmup: int = None) -> float:
+    iters = ITERS if iters is None else iters
+    warmup = WARMUP if warmup is None else warmup
+    from tests.test_device_state import mk_claim
+    from tpudra.kube import gvr
+
+    with _bench_driver() as (kube, client):
+        samples_ms: list[float] = []
+        for i in range(iters + warmup):
+            uid = f"bench-{i}"
+            claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            # Timed span = what kubelet experiences: the DRA gRPC call,
+            # including the plugin's claim-reference resolution.
+            t0 = time.perf_counter()
+            resp = client.prepare([claim])
+            dt = (time.perf_counter() - t0) * 1000.0
+            result = resp["claims"][uid]
+            if "error" in result:
+                raise RuntimeError(f"prepare failed: {result['error']}")
+            client.unprepare([claim])
+            if i >= warmup:
+                samples_ms.append(dt)
+        return statistics.median(samples_ms)
+
+
+def bench_bind_batch(
+    n_claims: int = BATCH_CLAIMS, iters: int = None, warmup: int = None
+) -> dict:
+    """Multi-claim batch bind: ONE NodePrepareResources call carrying
+    ``n_claims`` disjoint-footprint claims (kubelet batches exactly like
+    this when several pods land on a node at once).  This is the section
+    the batched checkpoint RMW exists for: the pre-batch engine paid two
+    checkpoint read-modify-write cycles PER CLAIM; the phased engine pays
+    two per BATCH, with per-claim side effects overlapped."""
+    iters = max(1, (ITERS if iters is None else iters) // 4)
+    warmup = max(1, (WARMUP if warmup is None else warmup) // 2)
+    from tests.test_device_state import mk_claim
+    from tpudra.kube import gvr
+
+    # v5e: 8 chips per host, so an 8-claim batch gets disjoint chips.
+    with _bench_driver(generation="v5e", num_chips=n_claims) as (kube, client):
+        samples_ms: list[float] = []
+        for i in range(iters + warmup):
+            claims = []
+            for c in range(n_claims):
+                uid = f"batch-{i}-{c}"
+                claim = mk_claim(uid, [f"tpu-{c}"], name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                claims.append(claim)
+            t0 = time.perf_counter()
+            resp = client.prepare(claims)
+            dt = (time.perf_counter() - t0) * 1000.0
+            for claim in claims:
+                uid = claim["metadata"]["uid"]
+                if "error" in resp["claims"][uid]:
+                    raise RuntimeError(
+                        f"prepare failed: {resp['claims'][uid]['error']}"
+                    )
+            client.unprepare(claims)
+            for claim in claims:
+                kube.delete(
+                    gvr.RESOURCE_CLAIMS, claim["metadata"]["name"], "default"
+                )
+            if i >= warmup:
+                samples_ms.append(dt)
+        p50 = statistics.median(samples_ms)
+        return {
+            "n_claims": n_claims,
+            # The batch section runs fewer iterations than the headline
+            # (each iteration binds n_claims claims); record the actual
+            # sample count so the A/B artifact is honest about precision.
+            "iters": iters,
+            "batch_bind_p50_ms": round(p50, 3),
+            "per_claim_p50_ms": round(p50 / n_claims, 3),
+        }
 
 
 def bench_bind_partition_p50() -> dict:
@@ -640,6 +718,7 @@ def bench_scale() -> dict:
             stop.set()
             out["churn"] = {
                 "bind_p50_ms": round(lat[len(lat) // 2], 3),
+                "bind_p90_ms": round(lat[int(len(lat) * 0.90)], 3),
                 "bind_p99_ms": round(lat[int(len(lat) * 0.99)], 3),
                 "bind_max_ms": round(lat[-1], 3),
                 "prepares_per_s": round(N_CLAIMS / wall, 1),
@@ -1062,7 +1141,8 @@ def _run_section(name: str, timeout: float = 1200.0) -> dict:
 SUMMARY_KEYS = (
     "device_kind", "seq", "batch", "step_ms", "tokens_per_s",
     "model_tflops_per_s", "mfu_pct", "compile_s", "warm_compile_s",
-    "bind_p50_ms", "bind_p99_ms", "available", "consistent",
+    "bind_p50_ms", "bind_p90_ms", "bind_p99_ms", "available", "consistent",
+    "n_claims", "batch_bind_p50_ms", "per_claim_p50_ms",
     "checked_count", "psum_bus_gbps", "hook_exercised", "num_experts",
     "matched", "prepares_per_s", "reconciles_per_s", "effective_qps",
     "held", "cache_entries", "heap_mb", "multiprocess_mode",
@@ -1110,12 +1190,47 @@ def _round_number() -> int:
     return (max(ns) + 1) if ns else 1
 
 
+def _pop_int_flag(argv: list, flag: str, minimum: int = 0) -> int | None:
+    """Extract ``--flag N`` from argv (mutating it); None when absent."""
+    if flag not in argv:
+        return None
+    i = argv.index(flag)
+    try:
+        value = int(argv[i + 1])
+    except (IndexError, ValueError):
+        raise SystemExit(f"{flag} requires an integer argument")
+    if value < minimum:
+        raise SystemExit(f"{flag} must be >= {minimum}, got {value}")
+    del argv[i : i + 2]
+    return value
+
+
 def main(argv=None) -> None:
-    argv = sys.argv[1:] if argv is None else argv
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # Pop the knobs BEFORE the --section check so `--section X --iters N`
+    # still runs section X (sections ignore the knobs) instead of silently
+    # falling through to the multi-minute full bench.
+    iters = _pop_int_flag(argv, "--iters", minimum=1)
+    warmup = _pop_int_flag(argv, "--warmup")
     if len(argv) == 2 and argv[0] == "--section":
         print(json.dumps(SECTIONS[argv[1]]()))
         return
     full = "--full" in argv
+
+    if "--bind-only" in argv:
+        # The A/B artifact for bind-path PRs: headline single-claim p50 +
+        # the multi-claim batch section, nothing that needs a device.
+        p50 = bench_bind_p50(iters=iters, warmup=warmup)
+        line = {
+            "metric": "resourceclaim_bind_p50_latency",
+            "value": round(p50, 3),
+            "unit": "ms",
+            "vs_baseline": round(BASELINE_BIND_MS / p50, 1),
+            "iters": iters if iters is not None else ITERS,
+            "batch": bench_bind_batch(iters=iters, warmup=warmup),
+        }
+        print(json.dumps(line))
+        return
 
     # Wall budget (VERDICT r4 #1): the driver's capture has a finite
     # timeout and a run that exceeds it yields rc=124 with an empty tail.
@@ -1155,7 +1270,7 @@ def main(argv=None) -> None:
     probe = _probe_device_backend()
     emit("probe", probe)
 
-    p50 = bench_bind_p50()
+    p50 = bench_bind_p50(iters=iters, warmup=warmup)
     headline = {
         "metric": "resourceclaim_bind_p50_latency",
         "value": round(p50, 3),
@@ -1163,6 +1278,8 @@ def main(argv=None) -> None:
         "vs_baseline": round(BASELINE_BIND_MS / p50, 1),
     }
     emit("bind", headline)
+    bind_batch = bench_bind_batch(iters=iters, warmup=warmup)
+    emit("bind_batch", bind_batch)
     partition = bench_bind_partition_p50()
     emit("dynamic_partition", partition)
 
@@ -1189,6 +1306,7 @@ def main(argv=None) -> None:
             tpu.update({k: warm[k] for k in warm if k != "compile_s"})
     extras = {
         "probe": probe,
+        "bind_batch": bind_batch,
         "tpu": tpu,
         "long_context": run_section("long8192", needs_device=True),
         "long_context_16k": run_section("long16384", needs_device=True),

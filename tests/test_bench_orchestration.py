@@ -29,7 +29,17 @@ def stubbed(monkeypatch):
         ran.append(name)
         return {"section_stub": name}
 
-    monkeypatch.setattr(bench, "bench_bind_p50", lambda: 2.5)
+    monkeypatch.setattr(
+        bench, "bench_bind_p50", lambda iters=None, warmup=None: 2.5
+    )
+    monkeypatch.setattr(
+        bench, "bench_bind_batch",
+        lambda n_claims=8, iters=None, warmup=None: {
+            "n_claims": n_claims,
+            "batch_bind_p50_ms": 8.0,
+            "per_claim_p50_ms": 1.0,
+        },
+    )
     monkeypatch.setattr(bench, "bench_bind_partition_p50", lambda: {"bind_p50_ms": 3.0})
     monkeypatch.setattr(bench, "_run_section", run_section)
     monkeypatch.setattr(
@@ -142,3 +152,33 @@ def test_wall_budget_exhaustion_skips_with_marker(stubbed, monkeypatch, capsys):
     _, final = _lines(capsys)
     assert "wall budget exhausted" in final["extras"]["tpu"]["skipped"]
     assert final["value"] == 2.5  # headline still measured and parsed
+
+
+def test_bind_only_mode_prints_single_line_with_knobs(
+    stubbed, monkeypatch, capsys
+):
+    """--bind-only is the A/B artifact for bind-path PRs: one JSON line,
+    CPU-only sections, no probe, --iters/--warmup honored."""
+    seen = {}
+
+    def spy_p50(iters=None, warmup=None):
+        seen["iters"], seen["warmup"] = iters, warmup
+        return 2.5
+
+    monkeypatch.setattr(bench, "bench_bind_p50", spy_p50)
+    bench.main(["--bind-only", "--iters", "12", "--warmup", "2"])
+    assert seen == {"iters": 12, "warmup": 2}
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1  # no partial lines, no probe
+    line = json.loads(out[0])
+    assert line["metric"] == "resourceclaim_bind_p50_latency"
+    assert line["iters"] == 12
+    assert line["batch"]["batch_bind_p50_ms"] == 8.0
+    assert stubbed == []  # no device sections ran
+
+
+def test_iters_flag_parse_errors():
+    with pytest.raises(SystemExit):
+        bench.main(["--bind-only", "--iters"])
+    with pytest.raises(SystemExit):
+        bench.main(["--bind-only", "--iters", "abc"])

@@ -1,4 +1,5 @@
 import json
+import os
 
 import pytest
 
@@ -180,15 +181,222 @@ def test_upgrade_reads_v1_only_file(tmp_path):
     assert claim.all_devices()[0].canonical_name == "tpu-1"
 
 
-def test_checksum_mismatch_detected(tmp_path):
+def test_corrupt_v2_falls_back_to_v1(tmp_path):
+    """A corrupted newer payload degrades (loudly) to the older version —
+    the point of the dual write — instead of wedging every prepare."""
+    from prometheus_client import REGISTRY
+
     mgr = CheckpointManager(str(tmp_path))
     mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
     envelope = json.load(open(mgr.path))
     envelope["v2"]["data"] = envelope["v2"]["data"].replace("tpu-0", "tpu-9")
     with open(mgr.path, "w") as f:
         json.dump(envelope, f)
+    before = (
+        REGISTRY.get_sample_value("tpudra_checkpoint_version_fallbacks_total")
+        or 0.0
+    )
+    got = mgr.read()
+    # V1 semantics: the claim survives, status degraded to completed-shape.
+    assert got.prepared_claims["u1"].all_devices()[0].canonical_name == "tpu-0"
+    assert (
+        REGISTRY.get_sample_value("tpudra_checkpoint_version_fallbacks_total")
+        == before + 1
+    )
+    # The stat-validated cache must not mask the corruption: fallback reads
+    # are never cached, so a second read of the same corrupt file re-logs
+    # and re-counts the fallback.
+    again = mgr.read()
+    assert again.prepared_claims["u1"].all_devices()[0].canonical_name == "tpu-0"
+    assert (
+        REGISTRY.get_sample_value("tpudra_checkpoint_version_fallbacks_total")
+        == before + 2
+    )
+
+
+def test_v1_fallback_keeps_started_claims_started(tmp_path):
+    """The v1 payload round-trips device types, and 'planned' devices only
+    exist on PrepareStarted claims — a fallback read must NOT promote such
+    a claim to completed (it has no CDI ids and no spec file; serving it as
+    a cached grant would hand the pod a dead device)."""
+    from tpudra.plugin.checkpoint import PREPARE_STARTED
+
+    mgr = CheckpointManager(str(tmp_path))
+    started = PreparedClaim(
+        uid="u-started",
+        namespace="ns",
+        name="claim-s",
+        status=PREPARE_STARTED,
+        groups=[
+            PreparedDeviceGroup(
+                devices=[PreparedDevice(canonical_name="tpu-1", type="planned")],
+                config_state={"plannedPartitions": "0:1c.4hbm:0:0"},
+            )
+        ],
+    )
+    mgr.write(
+        Checkpoint(prepared_claims={"u-started": started, "u-done": mk_claim("u-done")})
+    )
+    envelope = json.load(open(mgr.path))
+    envelope["v2"]["data"] += " "  # corrupt v2 only
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
+    got = mgr.read()
+    assert got.prepared_claims["u-started"].status == PREPARE_STARTED
+    assert got.prepared_claims["u-done"].status == PREPARE_COMPLETED
+    # plannedPartitions must ride the v1 payload too, or the retry's
+    # rollback becomes a silent no-op and crashed-prepare partitions leak.
+    assert (
+        got.prepared_claims["u-started"].groups[0].config_state["plannedPartitions"]
+        == "0:1c.4hbm:0:0"
+    )
+    # ... as must claim identity, or the stale-claim GC (which validates by
+    # namespace/name against the API server) can never reclaim the claim.
+    assert got.prepared_claims["u-started"].namespace == "ns"
+    assert got.prepared_claims["u-started"].name == "claim-s"
+
+
+def test_mutate_over_degraded_read_preserves_corrupt_original(tmp_path):
+    """The first RMW after a fallback finalizes the degraded payload (both
+    versions rewritten with valid checksums) — the corrupt original must
+    survive at <path>.corrupt for inspection, and subsequent reads are
+    clean (no more fallback)."""
+    import os as _os
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
+    envelope = json.load(open(mgr.path))
+    corrupt_v2 = envelope["v2"]["data"] + " "
+    envelope["v2"]["data"] = corrupt_v2
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
+    mgr.mutate(lambda cp: None)
+    saved = json.load(open(mgr.path + ".corrupt"))
+    assert saved["v2"]["data"] == corrupt_v2  # original preserved verbatim
+    # The live file is healed: v2 decodes with a valid checksum again.
+    healed = json.load(open(mgr.path))
+    import zlib as _zlib
+
+    assert _zlib.crc32(healed["v2"]["data"].encode()) == healed["v2"]["checksum"]
+    assert mgr.read().prepared_claims.keys() == {"u1"}
+
+
+def test_checksum_mismatch_on_all_versions_raises(tmp_path):
+    """With no version passing its checksum there is nothing to fall back
+    to: corruption fails loudly."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
+    envelope = json.load(open(mgr.path))
+    envelope["v2"]["data"] = envelope["v2"]["data"].replace("tpu-0", "tpu-9")
+    envelope["v1"]["data"] = envelope["v1"]["data"].replace("tpu-0", "tpu-9")
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
     with pytest.raises(ChecksumMismatch):
         mgr.read()
+
+
+def test_v1_to_v2_migration_roundtrip(tmp_path):
+    """Upgrade path: a v1-only file (old driver) read with today's decoder
+    and written back must yield a dual-version envelope whose v2 payload
+    carries the same claims with valid checksums — the _decode_v1 →
+    _encode_v2 migration the cache layer must never short-circuit."""
+    import zlib
+
+    mgr = CheckpointManager(str(tmp_path))
+    v1_data = json.dumps(
+        {
+            "preparedClaims": {
+                "old-uid": {
+                    "devices": [
+                        {
+                            "canonicalName": "tpu-1",
+                            "type": "chip",
+                            "poolName": "node-a",
+                            "requestNames": ["r0"],
+                            "cdiDeviceIds": ["k8s.tpu.google.com/claim=old-tpu-1"],
+                        }
+                    ]
+                }
+            }
+        }
+    )
+    envelope = {"v1": {"data": v1_data, "checksum": zlib.crc32(v1_data.encode())}}
+    with open(mgr.path, "w") as f:
+        json.dump(envelope, f)
+
+    migrated = mgr.read()
+    mgr.write(migrated)  # the write is the migration
+
+    envelope = json.load(open(mgr.path))
+    assert set(envelope) == {"v1", "v2"}
+    for version in ("v1", "v2"):
+        data = envelope[version]["data"]
+        assert zlib.crc32(data.encode()) == envelope[version]["checksum"]
+    v2 = json.loads(envelope["v2"]["data"])
+    claim = v2["preparedClaims"]["old-uid"]
+    assert claim["status"] == PREPARE_COMPLETED  # v1 claims were complete
+    dev = claim["groups"][0]["devices"][0]
+    assert dev["canonicalName"] == "tpu-1"
+    assert dev["requestNames"] == ["r0"]
+
+    # A fresh manager (cold cache) reading the migrated file agrees.
+    again = CheckpointManager(str(tmp_path)).read()
+    got = again.prepared_claims["old-uid"]
+    assert got.status == PREPARE_COMPLETED
+    assert got.all_devices()[0].canonical_name == "tpu-1"
+
+
+def test_read_cache_stat_validation(tmp_path):
+    """Reads under an unchanged file are served from memory; any replace of
+    the file (another process's flock-coordinated write) changes the stat
+    triple and forces the next read back to disk."""
+    from prometheus_client import REGISTRY
+
+    def reads(source):
+        return (
+            REGISTRY.get_sample_value(
+                "tpudra_checkpoint_reads_total", {"source": source}
+            )
+            or 0.0
+        )
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
+    # write() primes the cache: the first read is already a hit.
+    cache0, disk0 = reads("cache"), reads("disk")
+    assert mgr.read().prepared_claims.keys() == {"u1"}
+    assert (reads("cache"), reads("disk")) == (cache0 + 1, disk0)
+
+    # Mutating what read() returned must not poison the cache (copy-out).
+    got = mgr.read()
+    got.prepared_claims.clear()
+    assert mgr.read().prepared_claims.keys() == {"u1"}
+
+    # External writer = a second manager (own cache, same file, same
+    # os.replace protocol as another driver process).
+    other = CheckpointManager(str(tmp_path))
+    other.write(
+        Checkpoint(
+            prepared_claims={"u1": mk_claim(), "u2": mk_claim("u2")}
+        )
+    )
+    disk1 = reads("disk")
+    assert mgr.read().prepared_claims.keys() == {"u1", "u2"}
+    assert reads("disk") == disk1 + 1  # stat changed → disk, not stale cache
+    # ... and the re-read primes the cache again.
+    cache1 = reads("cache")
+    assert mgr.read().prepared_claims.keys() == {"u1", "u2"}
+    assert reads("cache") == cache1 + 1
+
+
+def test_read_cache_file_deleted(tmp_path):
+    """A deleted checkpoint (node reset) must not be resurrected from the
+    cache: read() returns a fresh empty checkpoint."""
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.write(Checkpoint(prepared_claims={"u1": mk_claim()}))
+    assert mgr.read().prepared_claims
+    os.remove(mgr.path)
+    assert mgr.read().prepared_claims == {}
 
 
 def test_forward_compat_unknown_fields(tmp_path):

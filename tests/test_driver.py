@@ -1,6 +1,7 @@
 """Driver layer: plugin sockets, claim fan-in, ResourceSlice publication,
 health-driven republication (reference gpu-kubelet-plugin/driver.go)."""
 
+import os
 import threading
 import time
 
@@ -237,6 +238,89 @@ class TestDriver:
         claim = mk_claim("uid-1", ["tpu-99"])  # not allocatable
         resp = d.prepare_resource_claims([claim])
         assert resp["claims"]["uid-1"]["permanent"] is True
+
+    def test_overlap_error_is_retryable(self, tmp_path):
+        """Overlap refusals must NOT be permanent: with the node lock
+        narrowed to the RMW phases, the overlapping claim may be
+        mid-teardown (its record durable until finish_unprepare) and the
+        kubelet retry succeeds once the silicon frees up."""
+        d = mk_driver(tmp_path)
+        d.prepare_resource_claims([mk_claim("uid-1", ["tpu-0"])])
+        resp = d.prepare_resource_claims(
+            [mk_claim("uid-2", ["tpu-0"], name="other")]
+        )
+        entry = resp["claims"]["uid-2"]
+        assert "overlaps" in entry["error"]
+        assert entry["permanent"] is False
+        # ... and after the teardown the retry lands cleanly.
+        d.unprepare_resource_claims([{"uid": "uid-1"}])
+        resp = d.prepare_resource_claims(
+            [mk_claim("uid-2", ["tpu-0"], name="other")]
+        )
+        assert resp["claims"]["uid-2"]["devices"]
+
+    def test_empty_batch_is_lock_and_disk_free(self, tmp_path):
+        """The health monitor pings prepare([]) — it must not touch the
+        node lock or rewrite the checkpoint (fsync per health tick)."""
+        d = mk_driver(tmp_path)
+        d.prepare_resource_claims([mk_claim("uid-1", ["tpu-0"])])
+        cp_path = d.state._cp.path
+        stat_before = (os.stat(cp_path).st_mtime_ns, os.stat(cp_path).st_ino)
+        assert d.prepare_resource_claims([]) == {"claims": {}}
+        assert d.unprepare_resource_claims([]) == {"claims": {}}
+        assert (
+            os.stat(cp_path).st_mtime_ns, os.stat(cp_path).st_ino
+        ) == stat_before
+
+    def test_same_uid_prepare_unprepare_serialize(self, tmp_path):
+        """Concurrent prepare and unprepare of the SAME uid must not
+        interleave at the effects phase (a 'prepared' grant whose CDI spec
+        the unprepare just deleted).  _claims_serialized holds a per-uid
+        mutex across the whole phased operation; disjoint uids never
+        contend."""
+        d = mk_driver(tmp_path)
+        d.prepare_resource_claims([mk_claim("uid-1", ["tpu-0"])])
+
+        entered = threading.Event()
+        release = threading.Event()
+        orig = d.state.run_unprepare_effects
+
+        def slow_unprepare(item):
+            entered.set()
+            assert release.wait(10)
+            return orig(item)
+
+        d.state.run_unprepare_effects = slow_unprepare
+        t = threading.Thread(
+            target=d.unprepare_resource_claims, args=([{"uid": "uid-1"}],)
+        )
+        t.start()
+        assert entered.wait(10)
+        # Same uid: the prepare must block until the teardown completes —
+        # and then run as a FRESH prepare (no cached grant from the record
+        # the unprepare was about to drop).
+        got = {}
+        t2 = threading.Thread(
+            target=lambda: got.update(
+                d.prepare_resource_claims([mk_claim("uid-1", ["tpu-0"])])
+            )
+        )
+        t2.start()
+        time.sleep(0.15)
+        assert not got  # still blocked on the per-uid mutex
+        # Disjoint uid: sails through while the teardown is still parked.
+        resp = d.prepare_resource_claims([mk_claim("uid-9", ["tpu-1"])])
+        assert resp["claims"]["uid-9"]["devices"]
+        release.set()
+        t.join(10)
+        t2.join(10)
+        assert got["claims"]["uid-1"]["devices"]
+        assert d.state._cdi.read_claim_spec("uid-1") is not None  # fresh spec
+        # The per-uid guard is a FILE lock (cross-process safe), and a
+        # completed unprepare garbage-collects it while holding it.
+        assert os.path.exists(d._claim_lock_path("uid-1"))
+        d.unprepare_resource_claims([{"uid": "uid-1"}])
+        assert not os.path.exists(d._claim_lock_path("uid-1"))
 
     def test_sockets_serve_dra_protocol(self, tmp_path):
         """Conformance: the two sockets speak the real kubelet wire contract —

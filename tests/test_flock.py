@@ -83,3 +83,88 @@ def test_context_manager(tmp_path):
     with Flock(path) as lock:
         assert lock.held
     assert not lock.held
+
+
+def test_concurrent_holders_serialize(tmp_path):
+    """Regression for the narrowed bind-path critical section: N Flock
+    objects contending on ONE path must still be mutually exclusive —
+    flock(2) excludes per open file description, so distinct Flock objects
+    (distinct fds) serialize even within one process, exactly like the
+    driver's fresh-Flock-per-RPC pattern."""
+    import threading
+
+    path = str(tmp_path / "pu.lock")
+    active = []
+    overlaps = []
+    order = []
+    guard = threading.Lock()
+
+    def hold(n):
+        lock = Flock(path, poll_interval=0.001)
+        with lock(timeout=10):
+            with guard:
+                active.append(n)
+                if len(active) > 1:
+                    overlaps.append(tuple(active))
+                order.append(n)
+            time.sleep(0.05)
+            with guard:
+                active.remove(n)
+
+    threads = [threading.Thread(target=hold, args=(n,)) for n in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=15)
+        assert not t.is_alive()
+    assert overlaps == []
+    assert sorted(order) == [0, 1, 2, 3]  # everyone eventually got the lock
+
+
+def test_acquire_records_wait_metric(tmp_path):
+    """acquire() exports its wait through ``last_wait`` and the
+    ``tpudra_flock_wait_seconds`` histogram (labelled by lock file name) —
+    the lock-contention signal the bind-path dashboards key on."""
+    from prometheus_client import REGISTRY
+
+    path = str(tmp_path / "waity.lock")
+
+    def count():
+        return (
+            REGISTRY.get_sample_value(
+                "tpudra_flock_wait_seconds_count", {"lock": "waity.lock"}
+            )
+            or 0.0
+        )
+
+    before = count()
+    lock = Flock(path)
+    with lock(timeout=1):
+        assert lock.last_wait >= 0.0
+    assert count() == before + 1
+
+    # A contended acquire records a wait at least as long as the hold.
+    sentinel = str(tmp_path / "held")
+    p = _spawn_holder(path, sentinel, "time.sleep(0.3)\nlock.release()\n")
+    try:
+        assert _wait_file(sentinel)
+        other = Flock(path, poll_interval=0.01)
+        with other(timeout=10):
+            pass
+        assert other.last_wait > 0.05
+        assert count() == before + 2
+    finally:
+        p.wait(timeout=10)
+
+    # A timed-out wait is still a sample — exactly the ones a contention
+    # investigation needs.
+    p = _spawn_holder(path, sentinel + "2", "time.sleep(0.6)\nlock.release()\n")
+    try:
+        assert _wait_file(sentinel + "2")
+        loser = Flock(path, poll_interval=0.01)
+        with pytest.raises(FlockTimeout):
+            loser.acquire(timeout=0.05)
+        assert loser.last_wait >= 0.05
+        assert count() == before + 3
+    finally:
+        p.wait(timeout=10)

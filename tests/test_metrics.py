@@ -60,6 +60,63 @@ class TestPrepareHistogram:
             hc.stop()
             d.stop()
 
+    def test_bind_phase_histograms_move_and_are_scrapeable(self, tmp_path):
+        """Every bind-path phase (lock-wait, checkpoint-read/-write,
+        cdi-write, config-apply) must land samples in
+        ``tpudra_bind_phase_seconds`` during one prepare/unprepare cycle,
+        and all of it must be visible on /metrics — the attribution the
+        batched-RMW bench story depends on."""
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        hc = Healthcheck(d.sockets)
+        hc.start()
+        try:
+            phases = (
+                metrics.PHASE_LOCK_WAIT,
+                metrics.PHASE_CHECKPOINT_READ,
+                metrics.PHASE_CHECKPOINT_WRITE,
+                metrics.PHASE_CDI_WRITE,
+                metrics.PHASE_CONFIG_APPLY,
+            )
+            before = {
+                p: sample("tpudra_bind_phase_seconds_count", {"phase": p})
+                for p in phases
+            }
+            claim = mk_claim("ph-1", ["tpu-0"], name="ph-1")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            resp = d.prepare_resource_claims([claim])
+            assert "error" not in resp["claims"]["ph-1"]
+            d.unprepare_resource_claims([{"uid": "ph-1"}])
+            # checkpoint-read is the one phase a single healthy cycle may
+            # legitimately skip — every read after the first write is a
+            # stat-validated cache hit.  A restarted manager (fresh cache,
+            # same file) is the guaranteed disk read.
+            from tpudra.plugin.checkpoint import CheckpointManager
+
+            CheckpointManager(str(tmp_path / "plugin")).read()
+            for p in phases:
+                after = sample("tpudra_bind_phase_seconds_count", {"phase": p})
+                assert after > before[p], f"phase {p} recorded no sample"
+
+            # Cache-vs-disk accounting moves too: the cycle's post-write
+            # reads must be stat-validated cache hits, the restart read a
+            # disk miss.
+            assert sample("tpudra_checkpoint_reads_total", {"source": "disk"}) > 0
+            assert sample("tpudra_checkpoint_reads_total", {"source": "cache"}) > 0
+
+            status, body = fetch(hc.port, "/metrics")
+            assert status == 200
+            text = body.decode()
+            assert "tpudra_bind_phase_seconds_bucket" in text
+            for p in phases:
+                assert f'tpudra_bind_phase_seconds_count{{phase="{p}"}}' in text
+            assert "tpudra_flock_wait_seconds_bucket" in text
+            assert "tpudra_checkpoint_reads_total" in text
+        finally:
+            hc.stop()
+            d.stop()
+
     def test_prepare_error_counted(self, tmp_path):
         from prometheus_client import REGISTRY
 

@@ -116,6 +116,85 @@ def test_token_bucket_limits():
     assert tb.reserve() > 0.0  # burst exhausted
 
 
+def test_token_bucket_burst_exhaustion_waits_grow_then_refill():
+    """Past the burst, each reserve() owes one more token than the last —
+    waits step up by ~1/qps — and elapsed wall time refills the bucket so
+    later reserves are free again (client-go BucketRateLimiter semantics)."""
+    qps, burst = 50.0, 3
+    tb = TokenBucket(qps=qps, burst=burst)
+    for _ in range(burst):
+        assert tb.reserve() == 0.0
+    w1, w2, w3 = tb.reserve(), tb.reserve(), tb.reserve()
+    assert 0.0 < w1 < w2 < w3
+    # Debt is linear in overdraft: the k-th over-burst reserve owes ~k/qps
+    # (loose upper bound only — wall time elapses between calls).
+    assert w3 <= 3.0 / qps + 0.01
+    # Refill: after enough wall time to repay the debt plus one token, a
+    # reserve is free again; and the bucket never exceeds its burst.
+    time.sleep(w3 + 1.5 / qps)
+    assert tb.reserve() == 0.0
+
+
+def test_token_bucket_never_exceeds_burst():
+    """Idle time must not bank more than ``burst`` free reserves."""
+    tb = TokenBucket(qps=1000.0, burst=2)
+    time.sleep(0.05)  # would be ~50 tokens without the cap
+    assert tb.reserve() == 0.0
+    assert tb.reserve() == 0.0
+    assert tb.reserve() > 0.0
+
+
+def test_exponential_backoff_forget_resets_retry_count():
+    """forget() must zero the per-item failure count — the hook WorkQueue
+    fires on success and on fresh keyed enqueues so an item that recovered
+    (or was superseded) retries from the base delay, not the cap."""
+    b = ExponentialBackoff(0.25, 3.0)
+    for _ in range(4):
+        b.when("item")
+    assert b.retries("item") == 4
+    b.forget("item")
+    assert b.retries("item") == 0
+    assert b.when("item") == 0.25  # back to base, not 4.0-capped
+    # forget of an unknown item is a no-op, not an error.
+    b.forget("never-seen")
+    assert b.retries("never-seen") == 0
+
+
+def test_rate_limiter_forget_propagates_to_backoff():
+    rl = RateLimiter(ExponentialBackoff(0.1, 5.0), TokenBucket(1000.0, 100))
+    rl.when("k")
+    rl.when("k")
+    assert rl.retries("k") == 2
+    rl.forget("k")
+    assert rl.retries("k") == 0
+
+
+def test_keyed_enqueue_resets_backoff_history():
+    """A fresh enqueue_keyed is new intent, not a retry: the key's backoff
+    history must reset so the new item runs promptly even after the old one
+    burned retries up to the cap."""
+    limiter = RateLimiter(ExponentialBackoff(0.05, 10.0))
+    q = WorkQueue(limiter)
+    stop, t = run_queue(q)
+    fails = []
+
+    def always_fails():
+        fails.append(1)
+        raise RuntimeError("boom")
+
+    q.enqueue_keyed("claim", always_fails)
+    assert wait_for(lambda: len(fails) >= 2, timeout=5.0)
+    assert limiter.retries("claim") >= 1
+    done = threading.Event()
+    q.enqueue_keyed("claim", done.set)
+    # Promptly = well under the delay the stale failure count would impose.
+    assert done.wait(2.0)
+    # The success-path forget runs just after the event sets; converge on it.
+    assert wait_for(lambda: limiter.retries("claim") == 0)
+    stop.set()
+    t.join(2)
+
+
 def test_presets_construct():
     assert prep_unprep_rate_limiter().when("a") >= 0.25
     assert daemon_rate_limiter().when("b") >= 0.005

@@ -1,0 +1,102 @@
+"""Print the bind-p50 delta between a fresh bench run and the newest prior
+round artifact.
+
+Usage: ``python tools/bench_delta.py <file-with-bench-stdout>``
+
+The file is whatever ``python bench.py`` just printed (``make bench`` tees
+it); the prior number comes from the newest ``BENCH_r*.json`` in the repo
+whose driver-recorded capture parsed (``parsed.value``, falling back to the
+first JSON line of ``tail``).  With no usable prior round the script says so
+and exits 0 — the delta is a convenience, not a gate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def current_headline(path: str) -> dict | None:
+    """Last line of the bench output that carries the headline metric."""
+    try:
+        lines = open(path).read().splitlines()
+    except OSError as e:
+        print(f"bench-delta: cannot read {path}: {e}")
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("metric") == "resourceclaim_bind_p50_latency":
+            return obj
+    return None
+
+
+def prior_headline() -> tuple[int, dict] | None:
+    """(round, headline) from the newest BENCH_r*.json that parsed."""
+    rounds = sorted(
+        (
+            (int(m.group(1)), f)
+            for f in glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+            for m in [re.search(r"BENCH_r(\d+)\.json$", f)]
+            if m
+        ),
+        reverse=True,
+    )
+    for n, f in rounds:
+        try:
+            rec = json.load(open(f))
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict) and "value" in parsed:
+            return n, parsed
+        for line in (rec.get("tail") or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if "value" in obj:
+                    return n, obj
+    return None
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__.strip().splitlines()[0])
+        print("usage: python tools/bench_delta.py <bench-stdout-file>")
+        return 2
+    now = current_headline(sys.argv[1])
+    if now is None:
+        print("bench-delta: no headline line in this run's output")
+        return 0
+    prior = prior_headline()
+    if prior is None:
+        print(
+            f"bench-delta: bind p50 {now['value']} ms "
+            "(no prior BENCH_r*.json with a parsed headline to compare)"
+        )
+        return 0
+    n, before = prior
+    delta_pct = (now["value"] - before["value"]) / before["value"] * 100.0
+    arrow = "faster" if delta_pct < 0 else "slower"
+    print(
+        f"bench-delta: bind p50 {before['value']} ms (round {n}) -> "
+        f"{now['value']} ms now  ({abs(delta_pct):.1f}% {arrow})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

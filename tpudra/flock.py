@@ -16,16 +16,44 @@ import fcntl
 import os
 import time
 
+from tpudra import metrics
+
 
 class FlockTimeout(TimeoutError):
     pass
 
 
+#: label → resolved FLOCK_WAIT_SECONDS child (labels() is registry-locked).
+_WAIT_CHILDREN: dict = {}
+
+#: Directories already ensured by acquire() — the bind path constructs
+#: several Flocks per claim and makedirs per acquire was measurable.
+_ENSURED_DIRS: set = set()
+
+
 class Flock:
-    def __init__(self, path: str, poll_interval: float = 0.01):
+    def __init__(
+        self,
+        path: str,
+        poll_interval: float = 0.01,
+        metric_label: str | None = None,
+    ):
         self._path = path
         self._poll_interval = poll_interval
         self._fd: int | None = None
+        #: Wall-time the last acquire() spent waiting (seconds); the driver
+        #: folds this into its per-phase bind histogram.
+        self.last_wait: float = 0.0
+        # Labelled children are cached per label: .labels() takes a registry
+        # lock and the bind path constructs several Flocks per claim.
+        # metric_label overrides the file-name label for lock families whose
+        # paths are unbounded (one lock file per claim uid).
+        label = metric_label or os.path.basename(path) or path
+        child = _WAIT_CHILDREN.get(label)
+        if child is None:
+            child = metrics.FLOCK_WAIT_SECONDS.labels(label)
+            _WAIT_CHILDREN[label] = child
+        self._wait_metric = child
 
     @property
     def path(self) -> str:
@@ -35,13 +63,25 @@ class Flock:
         """Acquire the exclusive lock, polling every ``poll_interval`` seconds.
 
         Raises FlockTimeout if the lock cannot be acquired within ``timeout``
-        seconds (None = wait forever).
+        seconds (None = wait forever).  The wait is recorded in the
+        ``tpudra_flock_wait_seconds`` histogram (labelled by lock file name)
+        and in ``last_wait`` — including timed-out waits, which are exactly
+        the samples a lock-contention investigation needs.
         """
         if self._fd is not None:
             raise RuntimeError(f"lock {self._path} already held by this object")
-        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
-        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
-        deadline = None if timeout is None else time.monotonic() + timeout
+        parent = os.path.dirname(self._path) or "."
+        if parent not in _ENSURED_DIRS:
+            os.makedirs(parent, exist_ok=True)
+            _ENSURED_DIRS.add(parent)
+        try:
+            fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        except FileNotFoundError:
+            # The ensured dir was removed since (tests tear down tempdirs).
+            os.makedirs(parent, exist_ok=True)
+            fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        t0 = time.monotonic()
+        deadline = None if timeout is None else t0 + timeout
         try:
             while True:
                 try:
@@ -60,6 +100,9 @@ class Flock:
             if self._fd is None:
                 os.close(fd)
             raise
+        finally:
+            self.last_wait = time.monotonic() - t0
+            self._wait_metric.observe(self.last_wait)
 
     def release(self) -> None:
         if self._fd is None:
@@ -73,6 +116,13 @@ class Flock:
     @property
     def held(self) -> bool:
         return self._fd is not None
+
+    def fileno(self) -> int:
+        """The held lock's fd (for fstat-based identity checks by lock
+        families whose files may be garbage-collected)."""
+        if self._fd is None:
+            raise RuntimeError(f"lock {self._path} not held")
+        return self._fd
 
     @contextlib.contextmanager
     def __call__(self, timeout: float | None = None):

@@ -41,15 +41,82 @@ logger = logging.getLogger(__name__)
 # reference's 8 s worst case and the O(seconds) partition-create hot op.
 _PREPARE_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 2.0, 4.0, 8.0, 16.0)
 
+# Per-phase buckets: the phases are fractions of a bind, so the resolution
+# starts an order of magnitude below the bind buckets — but the top of the
+# ladder must still quantify the contention tail (lock waits run up to
+# PU_LOCK_TIMEOUT = 10 s; collapsing those into +Inf would blind exactly
+# the investigation these histograms exist for).
+_PHASE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 16.0,
+)
+
+#: Phase label values for BIND_PHASE_SECONDS (one place so the bind-path
+#: instrumentation and the tests agree on spelling).
+PHASE_LOCK_WAIT = "lock-wait"
+PHASE_CHECKPOINT_READ = "checkpoint-read"
+PHASE_CHECKPOINT_WRITE = "checkpoint-write"
+PHASE_CDI_WRITE = "cdi-write"
+PHASE_CONFIG_APPLY = "config-apply"
+
+BIND_PHASE_SECONDS = Histogram(
+    "tpudra_bind_phase_seconds",
+    "Wall time of one bind-path phase (lock-wait, checkpoint-read, "
+    "checkpoint-write, cdi-write, config-apply) so a bench regression is "
+    "attributable to a phase instead of re-diagnosed from scratch",
+    ["phase"],
+    buckets=_PHASE_BUCKETS,
+)
+FLOCK_WAIT_SECONDS = Histogram(
+    "tpudra_flock_wait_seconds",
+    "Time spent waiting to acquire a cross-process flock, by lock file name",
+    ["lock"],
+    buckets=_PHASE_BUCKETS,
+)
+CHECKPOINT_READS_TOTAL = Counter(
+    "tpudra_checkpoint_reads_total",
+    "Checkpoint reads by source: 'cache' (stat-validated in-memory hit) "
+    "or 'disk' (full read + checksum + decode)",
+    ["source"],
+)
+CHECKPOINT_FALLBACKS_TOTAL = Counter(
+    "tpudra_checkpoint_version_fallbacks_total",
+    "Reads that fell back to an older checkpoint payload because a newer "
+    "version failed its checksum",
+)
+
+
+# Labelled children resolved once: .labels() takes a registry lock and the
+# bind path records several phase samples per claim.
+_PHASE_CHILDREN = {
+    p: BIND_PHASE_SECONDS.labels(p)
+    for p in (
+        PHASE_LOCK_WAIT,
+        PHASE_CHECKPOINT_READ,
+        PHASE_CHECKPOINT_WRITE,
+        PHASE_CDI_WRITE,
+        PHASE_CONFIG_APPLY,
+    )
+}
+
+
+def observe_phase(phase: str, seconds: float) -> None:
+    """Record one bind-path phase sample (helper so call sites stay short)."""
+    child = _PHASE_CHILDREN.get(phase)
+    (child if child is not None else BIND_PHASE_SECONDS.labels(phase)).observe(
+        seconds
+    )
+
 PREPARE_SECONDS = Histogram(
     "tpudra_prepare_seconds",
-    "Per-claim NodePrepareResources wall time (the t_prep path)",
+    "Per-call NodePrepareResources wall time (the t_prep path; one "
+    "sample per kubelet batch since the phased engine)",
     ["driver"],
     buckets=_PREPARE_BUCKETS,
 )
 UNPREPARE_SECONDS = Histogram(
     "tpudra_unprepare_seconds",
-    "Per-claim NodeUnprepareResources wall time",
+    "Per-call NodeUnprepareResources wall time (one sample per batch)",
     ["driver"],
     buckets=_PREPARE_BUCKETS,
 )

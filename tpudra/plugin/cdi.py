@@ -35,6 +35,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from tpudra import metrics
+
 CDI_VERSION = "0.6.0"
 
 # Vendor/class for transient per-claim specs (reference cdi.go:
@@ -174,6 +176,7 @@ class CDIHandler:
         to every container consuming any device of the claim (claim-wide env
         like the clique ID; reference cdi.go:194-304).
         """
+        t0 = time.monotonic()
         devices = []
         ids = []
         for device_name, edits in device_edits.items():
@@ -195,6 +198,7 @@ class CDIHandler:
         with open(tmp, "w") as f:
             json.dump(spec, f, indent=2)
         os.replace(tmp, self.spec_path(claim_uid))
+        metrics.observe_phase(metrics.PHASE_CDI_WRITE, time.monotonic() - t0)
         return ids
 
     def delete_claim_spec_file(self, claim_uid: str) -> None:

@@ -12,20 +12,44 @@ checkpoint.go:10-47, checkpointv.go:24-82).
   claim's namespace/name (needed by the stale-claim GC to validate claims
   against the API server by name+UID, reference cleanup.go:150).
 
-Reads prefer V2 and fall back to V1; unknown fields are tolerated (non-strict)
-so checkpoints written by newer drivers parse (reference api.go:54-58).
+Reads prefer V2 and fall back to V1 — including when V2 is present but fails
+its checksum (loudly: an error log plus the
+``tpudra_checkpoint_version_fallbacks_total`` counter), which is the whole
+point of the dual write: a torn/corrupt newer payload degrades to the older
+one instead of wedging every prepare on the node.  Only when *no* version
+passes its checksum does the read raise.  Unknown fields are tolerated
+(non-strict) so checkpoints written by newer drivers parse (reference
+api.go:54-58).
+
+Reads are served from an in-memory cache validated by stat (mtime_ns, size,
+inode): the bind path re-reads the checkpoint several times per claim under
+an uncontended lock, and each disk read costs open + JSON decode + CRC.
+Another process's write (the file is flock-coordinated and replaced
+atomically) changes the stat triple and invalidates the cache.
 """
 
 from __future__ import annotations
 
+import copy
 import json
+import logging
 import os
+import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from tpudra import metrics
 from tpudra.api import serde
 from tpudra.flock import Flock
+
+logger = logging.getLogger(__name__)
+
+# Labelled counter children resolved once (labels() is registry-locked and
+# the bind path reads the checkpoint several times per claim).
+_READS_CACHE = metrics.CHECKPOINT_READS_TOTAL.labels("cache")
+_READS_DISK = metrics.CHECKPOINT_READS_TOTAL.labels("disk")
 
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
@@ -97,69 +121,214 @@ def _decode_v2(data: str) -> Checkpoint:
 
 
 def _encode_v1(cp: Checkpoint) -> str:
-    """Legacy shape: uid → flat device list (no status, no claim identity)."""
-    out = {
-        "preparedClaims": {
-            uid: {"devices": [serde.encode(d) for d in claim.all_devices()]}
-            for uid, claim in cp.prepared_claims.items()
-        }
-    }
-    return json.dumps(out, sort_keys=True)
+    """Legacy shape: uid → flat device list, extended for fallback fidelity.
+
+    The flat ``devices`` list is what legacy readers expect; alongside it
+    ride ``namespace``/``name`` (without which the stale-claim GC can never
+    reclaim a fallen-back claim) and per-group ``groups`` with their
+    ``configState`` (without which a started claim's ``plannedPartitions``
+    is lost — the retry's rollback becomes a silent no-op and crashed-
+    prepare partitions leak — and a multi-group claim's teardown state,
+    timeslice/mp UUIDs, is truncated to one group).  Legacy readers decode
+    non-strict and ignore the extras."""
+    claims = {}
+    for uid, claim in cp.prepared_claims.items():
+        entry: dict = {"devices": [serde.encode(d) for d in claim.all_devices()]}
+        entry["status"] = claim.status
+        if claim.namespace:
+            entry["namespace"] = claim.namespace
+        if claim.name:
+            entry["name"] = claim.name
+        if any(g.config_state for g in claim.groups) or len(claim.groups) > 1:
+            entry["groups"] = [
+                {
+                    "devices": [serde.encode(d) for d in g.devices],
+                    "configState": g.config_state,
+                }
+                for g in claim.groups
+            ]
+        claims[uid] = entry
+    return json.dumps({"preparedClaims": claims}, sort_keys=True)
 
 
 def _decode_v1(data: str) -> Checkpoint:
     raw = json.loads(data)
     cp = Checkpoint()
     for uid, entry in raw.get("preparedClaims", {}).items():
-        devices = [
-            serde.decode(PreparedDevice, d, strict=False) for d in entry.get("devices", [])
-        ]
-        # V1 had no explicit status: a claim present in a V1 checkpoint was
-        # fully prepared (started-but-unfinished claims were not persisted).
+        if "groups" in entry:
+            # This driver's fallback payload: faithful group structure.
+            groups = [
+                PreparedDeviceGroup(
+                    devices=[
+                        serde.decode(PreparedDevice, d, strict=False)
+                        for d in g.get("devices", [])
+                    ],
+                    config_state=dict(g.get("configState", {})),
+                )
+                for g in entry["groups"]
+            ]
+        else:
+            groups = [
+                PreparedDeviceGroup(
+                    devices=[
+                        serde.decode(PreparedDevice, d, strict=False)
+                        for d in entry.get("devices", [])
+                    ]
+                )
+            ]
+        devices = [d for g in groups for d in g.devices]
+        # V1 written by THIS driver carries an explicit status (the claim-
+        # level field covers started claims with empty device lists — the
+        # cdplugin's shape — which no device-derived heuristic can).  V1
+        # written by an OLD driver has none: every claim in it was fully
+        # prepared — except that 'planned'-type devices only ever belong to
+        # a PrepareStarted claim, which must take the retry/rollback path,
+        # never be served as a completed cached grant (its devices have no
+        # CDI ids and no spec file).
+        status = entry.get("status") or (
+            PREPARE_STARTED
+            if any(d.type == "planned" for d in devices)
+            else PREPARE_COMPLETED
+        )
         cp.prepared_claims[uid] = PreparedClaim(
             uid=uid,
-            status=PREPARE_COMPLETED,
-            groups=[PreparedDeviceGroup(devices=devices)],
+            namespace=entry.get("namespace", ""),
+            name=entry.get("name", ""),
+            status=status,
+            groups=groups,
         )
     return cp
 
 
 class CheckpointManager:
     """Atomic read/write of the dual-version checkpoint file, with a
-    flock-guarded read-mutate-write helper (reference device_state.go:555-582)."""
+    flock-guarded read-mutate-write helper (reference device_state.go:555-582)
+    and a stat-validated in-memory read cache."""
 
     def __init__(self, plugin_dir: str):
         self._path = os.path.join(plugin_dir, CHECKPOINT_FILE)
-        self._lock = Flock(os.path.join(plugin_dir, CHECKPOINT_LOCK))
+        self._lock_path = os.path.join(plugin_dir, CHECKPOINT_LOCK)
         os.makedirs(plugin_dir, exist_ok=True)
+        # (stat key, decoded checkpoint). Callers may freely mutate what
+        # read() returns, so the cache holds its own copy.
+        self._cache: Optional[tuple[tuple[int, int, int], Checkpoint]] = None
+        self._cache_lock = threading.Lock()
 
     @property
     def path(self) -> str:
         return self._path
 
+    def _stat_key(self) -> Optional[tuple[int, int, int]]:
+        try:
+            st = os.stat(self._path)
+        except FileNotFoundError:
+            return None
+        # The inode guards against the mtime granularity of coarse
+        # filesystems: every write lands via os.replace, so a new file
+        # always means a new inode.
+        return (st.st_mtime_ns, st.st_size, st.st_ino)
+
     def read(self) -> Checkpoint:
-        """Read the newest readable version; fresh checkpoint if absent."""
+        return self._read_flagged()[0]
+
+    def _read_flagged(self, bypass_cache: bool = False) -> tuple[Checkpoint, bool]:
+        """(checkpoint, degraded) — the newest readable version; fresh
+        checkpoint if absent.  degraded means a corrupt newer version was
+        skipped and an older payload served.
+
+        Served from the in-memory cache when the file's stat triple is
+        unchanged since the last read/write (unless ``bypass_cache`` —
+        the flock-guarded RMW needs disk-true freshness).  The stat is
+        taken BEFORE the disk read: if another process replaces the file
+        in between, the cache holds newer content under an older key and
+        the next read simply misses — never the reverse (stale content
+        under a new key).
+        """
+        key = self._stat_key()
+        if key is None:
+            return Checkpoint(), False
+        if not bypass_cache:
+            with self._cache_lock:
+                cached = self._cache
+            if cached is not None and cached[0] == key:
+                _READS_CACHE.inc()
+                # Deepcopy outside the mutex: the cached object is never
+                # mutated in place (writers replace the tuple wholesale),
+                # so concurrent readers need not serialize on an O(size)
+                # copy.  The copy itself scales with prepared-claim count —
+                # still cheaper than the open+JSON+CRC+decode it replaces,
+                # but a read-only snapshot accessor would beat both if a
+                # scan-heavy caller ever shows up hot.
+                return copy.deepcopy(cached[1]), False
+        t0 = time.monotonic()
+        cp, degraded = self._read_disk()
+        _READS_DISK.inc()
+        metrics.observe_phase(
+            metrics.PHASE_CHECKPOINT_READ, time.monotonic() - t0
+        )
+        if not degraded:
+            # A version-fallback read is deliberately NOT cached: caching it
+            # would make the fallback loud exactly once and then silent —
+            # every read of a corrupt file must re-log and re-count while
+            # the node runs on the degraded payload.
+            with self._cache_lock:
+                self._cache = (key, copy.deepcopy(cp))
+        return cp, degraded
+
+    def _read_disk(self) -> tuple[Checkpoint, bool]:
+        """Decode the newest version that passes its checksum.  Returns
+        (checkpoint, degraded) — degraded means a newer corrupt version was
+        skipped and an older payload served."""
         try:
             with open(self._path) as f:
                 envelope = json.load(f)
         except FileNotFoundError:
-            return Checkpoint()
+            return Checkpoint(), False
         except ValueError as e:
             raise CheckpointError(f"corrupt checkpoint envelope: {e}") from e
+        corrupt: list[str] = []
         for version, decode in (("v2", _decode_v2), ("v1", _decode_v1)):
             entry = envelope.get(version)
             if not entry:
                 continue
             data, checksum = entry.get("data", ""), entry.get("checksum")
             if _checksum(data) != checksum:
-                raise ChecksumMismatch(
-                    f"checkpoint {version} checksum mismatch "
-                    f"(got {checksum}, want {_checksum(data)})"
+                corrupt.append(version)
+                logger.error(
+                    "checkpoint %s checksum mismatch (got %s, want %s): "
+                    "trying an older version",
+                    version, checksum, _checksum(data),
                 )
-            return decode(data)
+                continue
+            cp = decode(data)
+            if corrupt:
+                # Loud fallback: the older payload may lack newer-version
+                # state (V1 has no PrepareStarted claims), so an ACTUAL
+                # successful fallback must be visible in logs and metrics —
+                # counted only here, not when every version is corrupt and
+                # the read raises below.
+                logger.error(
+                    "checkpoint fell back to %s (corrupt: %s)",
+                    version, ", ".join(corrupt),
+                )
+                metrics.CHECKPOINT_FALLBACKS_TOTAL.inc()
+            return cp, bool(corrupt)
+        if corrupt:
+            raise ChecksumMismatch(
+                f"checkpoint has no version with a valid checksum "
+                f"(corrupt: {', '.join(corrupt)})"
+            )
         raise CheckpointError("checkpoint has no readable version")
 
     def write(self, cp: Checkpoint) -> None:
+        """Durably replace the checkpoint and prime the read cache.
+
+        Cache contract: the cache holds ``cp`` by REFERENCE (a deepcopy per
+        write was measurable on the bind path) — after write() the caller
+        must not mutate ``cp``.  mutate() guarantees this (its return value
+        is unused by design); read() hands out copies, never the cached
+        object."""
+        t0 = time.monotonic()
         v1, v2 = _encode_v1(cp), _encode_v2(cp)
         envelope = {
             "v1": {"data": v1, "checksum": _checksum(v1)},
@@ -171,15 +340,57 @@ class CheckpointManager:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
+        # The stat is taken after the replace, so the key matches exactly
+        # what a subsequent read would see for this content.
+        key = self._stat_key()
+        with self._cache_lock:
+            self._cache = (key, cp) if key is not None else None
+        metrics.observe_phase(
+            metrics.PHASE_CHECKPOINT_WRITE, time.monotonic() - t0
+        )
 
     def mutate(
         self, fn: Callable[[Checkpoint], Optional[Checkpoint]], timeout: float = 10.0
-    ) -> Checkpoint:
+    ) -> None:
         """flock-guarded read-mutate-write: fn may mutate in place (return
-        None) or return a replacement."""
-        with self._lock(timeout=timeout):
-            cp = self.read()
+        None) or return a replacement.  Returns nothing: the final object is
+        cached by reference (write()'s contract), so handing it out would
+        invite cache-poisoning mutations — re-``read()`` for a copy.
+
+        A mutate over a degraded read FINALIZES the fallback — the write
+        re-encodes both versions with valid checksums from the fallback
+        payload, after which the corruption signal stops firing and the
+        newer-version-only state is gone.  So before overwriting, the
+        corrupt original is preserved at ``<path>.corrupt`` for inspection
+        or manual repair, and the finalization itself is logged loudly."""
+        # Fresh Flock per mutate: one shared instance cannot be acquired
+        # twice, but in-process callers DO overlap (the GC thread mutates
+        # while RPC threads mutate) — each needs its own fd so the kernel
+        # serializes them instead of a RuntimeError failing the batch.
+        with Flock(self._lock_path)(timeout=timeout):
+            # Bypass the read cache inside the RMW: the stat triple is not
+            # collision-proof across processes (inode recycling + coarse
+            # mtime), and a false cache hit here would write a stale
+            # checkpoint back — the one path where the cache could corrupt
+            # durable state.  Plain reads keep the cache; the RMW pays one
+            # disk read for bulletproof freshness.
+            cp, degraded = self._read_flagged(bypass_cache=True)
             out = fn(cp)
             cp = out if out is not None else cp
+            if degraded:
+                corrupt_path = self._path + ".corrupt"
+                try:
+                    with open(self._path, "rb") as src, open(
+                        corrupt_path, "wb"
+                    ) as dst:
+                        dst.write(src.read())
+                except OSError:
+                    logger.exception(
+                        "cannot preserve corrupt checkpoint at %s", corrupt_path
+                    )
+                logger.error(
+                    "finalizing degraded checkpoint: rewriting all versions "
+                    "from the fallback payload; original preserved at %s",
+                    corrupt_path,
+                )
             self.write(cp)
-            return cp

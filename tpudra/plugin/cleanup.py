@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from typing import Callable, Optional
 
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
@@ -24,10 +25,22 @@ DEFAULT_PERIOD = 600.0
 
 
 class CheckpointCleanupManager:
-    def __init__(self, kube: KubeAPI, state: DeviceState, period: float = DEFAULT_PERIOD):
+    def __init__(
+        self,
+        kube: KubeAPI,
+        state: DeviceState,
+        period: float = DEFAULT_PERIOD,
+        unprepare: Optional[Callable[[str], None]] = None,
+    ):
         self._kube = kube
         self._state = state
         self._period = period
+        # The plugin driver passes its per-claim-uid-serialized unprepare so
+        # a GC teardown can't interleave with a kubelet retry of the same
+        # uid at the effects phase (state.unprepare alone no longer holds a
+        # lock across effects).  Callers whose state still tears down inside
+        # one atomic RMW (cdplugin) use it directly.
+        self._unprepare = unprepare if unprepare is not None else state.unprepare
         self._thread: threading.Thread | None = None
 
     def start(self, stop: threading.Event) -> None:
@@ -53,7 +66,7 @@ class CheckpointCleanupManager:
                     "unpreparing stale claim %s/%s:%s (status=%s)",
                     namespace, name, uid, status,
                 )
-                self._state.unprepare(uid)
+                self._unprepare(uid)
                 stale += 1
         return stale
 

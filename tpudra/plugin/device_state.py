@@ -16,6 +16,24 @@ of the driver (SURVEY.md §3.2, the ResourceClaim-bind-p50 path):
 - crash consistency: PrepareStarted is persisted *with the planned dynamic
   partitions* before any hardware mutation, so rollback after a crash needs
   only the checkpoint (device_state.go:231-242, 337)
+
+The engine is *batched and phased* (docs/bind-path.md): a kubelet batch of N
+claims costs two checkpoint read-modify-write cycles, not 2N.
+
+- ``begin_prepare``: ONE checkpoint RMW records PrepareStarted for every
+  claim in the batch (idempotency check, partial-retry rollback, and overlap
+  validation happen inside the same critical section).
+- ``run_prepare_effects``: per-claim side effects — config resolution,
+  partition creation, sharing daemons, the CDI spec write — run *outside*
+  any lock; the durable PrepareStarted record is what reserves the silicon
+  (overlap validation in other processes sees it) and what makes a crash
+  here convergent.
+- ``finish_prepare``: ONE checkpoint RMW flips every successful claim to
+  PrepareCompleted.
+
+``prepare``/``unprepare`` remain as batch-of-one wrappers; the Driver holds
+the node lock around the begin/finish phases and fans effects across a
+bounded pool (driver.py).
 """
 
 from __future__ import annotations
@@ -25,7 +43,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpudra import TPU_DRIVER_NAME, featuregates
+from tpudra import TPU_DRIVER_NAME, featuregates, metrics
 from tpudra.api import (
     ComputeDomainChannelConfig,
     ComputeDomainDaemonConfig,
@@ -103,6 +121,75 @@ class PreparedDeviceResult:
 class _ConfigGroup:
     config: object
     results: list[dict] = field(default_factory=list)
+
+
+@dataclass
+class PrepareItem:
+    """One claim's state as it moves through the phased prepare."""
+
+    claim: dict
+    uid: str = ""
+    namespace: str = ""
+    name: str = ""
+    results: list = field(default_factory=list)
+    planned: list = field(default_factory=list)
+    #: Idempotent hit: the claim was already PrepareCompleted.
+    cached: Optional[list[PreparedDeviceResult]] = None
+    error: Optional[Exception] = None
+    #: Set by run_prepare_effects on success; finish_prepare persists it.
+    plain_groups: Optional[list[PreparedDeviceGroup]] = None
+    #: A fresh PrepareStarted record was written for this claim.
+    started: bool = False
+    #: Retry of a partial prepare: (old record, owned-partition set) whose
+    #: orphan teardown runs at the START of the effects phase — hardware
+    #: rollback must not run inside the locked RMW.
+    rollback: Optional[tuple] = None
+
+    def device_names(self) -> list[str]:
+        return [r.get("device", "") for r in self.results]
+
+    def device_results(self) -> list[PreparedDeviceResult]:
+        """The grant to return to kubelet: idempotent-cached or fresh."""
+        if self.cached is not None:
+            return self.cached
+        return _results_from_groups(self.plain_groups or [])
+
+
+@dataclass
+class PrepareBatch:
+    items: list[PrepareItem] = field(default_factory=list)
+
+    def pending(self) -> list[PrepareItem]:
+        """Items that still need side effects run."""
+        return [
+            it for it in self.items if it.error is None and it.cached is None
+        ]
+
+
+@dataclass
+class UnprepareItem:
+    uid: str
+    #: Checkpoint record at begin time; None = nothing to tear down.
+    record: Optional[PreparedClaim] = None
+    #: Partition UUIDs owned by OTHER completed claims at begin time
+    #: (rollback of a partial claim must not destroy these).
+    owned_partitions: set = field(default_factory=set)
+    error: Optional[Exception] = None
+    #: Side effects finished; finish_unprepare drops the record.
+    done: bool = False
+
+    def device_names(self) -> list[str]:
+        if self.record is None:
+            return []
+        return [d.canonical_name for d in self.record.all_devices()]
+
+
+@dataclass
+class UnprepareBatch:
+    items: list[UnprepareItem] = field(default_factory=list)
+
+    def pending(self) -> list[UnprepareItem]:
+        return [it for it in self.items if it.error is None and not it.done]
 
 
 class DeviceState:
@@ -194,118 +281,272 @@ class DeviceState:
     # ------------------------------------------------------------------ API
 
     def prepare(self, claim: dict) -> list[PreparedDeviceResult]:
+        """Batch-of-one wrapper over the phased engine (tests, simple
+        callers).  Raises on failure exactly as the pre-batch engine did."""
         t0 = time.monotonic()
-        uid, namespace, name = _claim_identity(claim)
+        batch = self.begin_prepare([claim])
+        (item,) = batch.items
+        if item.error is not None:
+            raise item.error
+        if item.cached is not None:
+            return item.cached
+        self.run_prepare_effects(item)
+        self.finish_prepare(batch)
+        if item.error is not None:
+            raise item.error
+        logger.info(
+            "prepared claim %s/%s:%s t_prep=%.4fs",
+            item.namespace, item.name, item.uid, time.monotonic() - t0,
+        )
+        return _results_from_groups(item.plain_groups)
 
-        results = _allocation_results(claim)
-        if not results:
-            raise PermanentError(f"claim {namespace}/{name}:{uid} has no allocation for {TPU_DRIVER_NAME}")
-        planned_partitions = self._planned_partition_specs(results)
+    def unprepare(self, claim_uid: str) -> None:
+        t0 = time.monotonic()
+        batch = self.begin_unprepare([claim_uid])
+        (item,) = batch.items
+        if item.error is None:
+            self.run_unprepare_effects(item)
+        self.finish_unprepare(batch)
+        if item.error is not None:
+            raise item.error
+        logger.info("unprepared claim %s t_unprep=%.4fs", claim_uid, time.monotonic() - t0)
 
-        cached: list[PreparedDeviceResult] = []
+    # ------------------------------------------------- phased batch engine
 
-        def start(cp: Checkpoint) -> None:
-            existing = cp.prepared_claims.get(uid)
-            if existing is not None and existing.status == PREPARE_COMPLETED:
-                cached.extend(_results_from_claim(existing))
-                return
-            if existing is not None and existing.status == PREPARE_STARTED:
-                # Retry of a partial prepare: tear down its orphans first
-                # (device_state.go:223-228).
-                self._rollback_partial(cp, existing)
-            self._validate_no_overlap(cp, uid, results)
-            cp.prepared_claims[uid] = PreparedClaim(
-                uid=uid,
-                namespace=namespace,
-                name=name,
-                status=PREPARE_STARTED,
-                groups=[
-                    PreparedDeviceGroup(
-                        # Requested device names are recorded at Started so
-                        # concurrent prepares see this claim's footprint.
-                        devices=[
-                            PreparedDevice(
-                                canonical_name=r["device"], type="planned"
-                            )
-                            for r in results
-                        ],
-                        config_state={
-                            "plannedPartitions": _encode_specs(planned_partitions)
-                        },
+    def begin_prepare(self, claims: list[dict]) -> PrepareBatch:
+        """Phase 1 of a batched prepare: ONE checkpoint RMW that, for every
+        claim in the batch, resolves idempotent hits, rolls back partial
+        retries, validates silicon overlap (against durable claims AND the
+        earlier claims of this batch), and records PrepareStarted with the
+        planned dynamic partitions.
+
+        Per-claim failures land in ``item.error`` — one bad claim never
+        poisons the batch.  The caller serializes this phase under the
+        node-global lock (driver.py)."""
+        batch = PrepareBatch()
+        seen: dict[str, PrepareItem] = {}
+        for claim in claims:
+            item = PrepareItem(claim=claim)
+            try:
+                item.uid, item.namespace, item.name = _claim_identity(claim)
+            except PermanentError as e:
+                item.error = e
+                batch.items.append(item)
+                continue
+            if item.uid in seen:
+                # Kubelet never sends a uid twice; if a caller does, the
+                # first occurrence wins and duplicates alias its outcome
+                # (out dicts are keyed by uid anyway).
+                continue
+            seen[item.uid] = item
+            batch.items.append(item)
+            try:
+                item.results = _allocation_results(claim)
+                if not item.results:
+                    raise PermanentError(
+                        f"claim {item.namespace}/{item.name}:{item.uid} has "
+                        f"no allocation for {TPU_DRIVER_NAME}"
                     )
-                ],
+                item.planned = self._planned_partition_specs(item.results)
+            except Exception as e:  # noqa: BLE001 — per-claim barrier: one
+                item.error = e      # malformed claim must not fail the batch
+
+        def start_all(cp: Checkpoint) -> None:
+            for item in batch.items:
+                if item.error is not None:
+                    continue
+                try:
+                    self._start_one(cp, item)
+                except Exception as e:  # noqa: BLE001 — per-claim barrier
+                    item.error = e
+
+        self._cp.mutate(start_all)
+        if any(it.started for it in batch.items):
+            _crashpoint("post-prepare-started")
+        for item in batch.items:
+            if item.cached is not None:
+                logger.info(
+                    "claim %s already prepared (idempotent return)", item.uid
+                )
+        return batch
+
+    def _start_one(self, cp: Checkpoint, item: PrepareItem) -> None:
+        existing = cp.prepared_claims.get(item.uid)
+        if existing is not None and existing.status == PREPARE_COMPLETED:
+            item.cached = _results_from_claim(existing)
+            return
+        if existing is not None and existing.status == PREPARE_STARTED:
+            # Retry of a partial prepare: its orphans must be torn down
+            # before re-preparing (device_state.go:223-228) — but the
+            # teardown is O(seconds) hardware work, so it runs at the start
+            # of this item's effects phase, NOT here inside the locked RMW.
+            # Safe to defer: the new PrepareStarted record (same claim, same
+            # planned specs) keeps covering the orphans, so a crash before
+            # the deferred rollback converges exactly like a crash before
+            # an inline one.
+            item.rollback = (
+                existing, _owned_partition_uuids(cp, existing.uid)
             )
+        self._validate_no_overlap(cp, item.uid, item.results)
+        cp.prepared_claims[item.uid] = PreparedClaim(
+            uid=item.uid,
+            namespace=item.namespace,
+            name=item.name,
+            status=PREPARE_STARTED,
+            groups=[
+                PreparedDeviceGroup(
+                    # Requested device names are recorded at Started so
+                    # concurrent prepares see this claim's footprint.
+                    devices=[
+                        PreparedDevice(canonical_name=r["device"], type="planned")
+                        for r in item.results
+                    ],
+                    config_state={
+                        "plannedPartitions": _encode_specs(item.planned)
+                    },
+                )
+            ],
+        )
+        item.started = True
 
-        self._cp.mutate(start)
-        if cached:
-            logger.info("claim %s already prepared (idempotent return)", uid)
-            return cached
-        _crashpoint("post-prepare-started")
-
+    def run_prepare_effects(self, item: PrepareItem) -> None:
+        """Phase 2: one claim's side effects — config resolution, hardware
+        mutation, sharing, the CDI spec write.  Runs OUTSIDE every lock: the
+        durable PrepareStarted record already reserves the silicon, and a
+        crash anywhere in here converges from the checkpoint alone.  Raises
+        on failure (after best-effort undo); the claim stays PrepareStarted
+        so the retry's rollback covers anything the undo missed."""
+        if item.rollback is not None:
+            # Deferred partial-retry rollback (see _start_one): runs before
+            # this claim's own effects — serially within the same item, and
+            # the orphans share this claim's footprint so the effect-group
+            # net keeps other items off this silicon.
+            old_record, owned = item.rollback
+            self._rollback_partial(old_record, owned)
         undos: list = []
+        t0 = time.monotonic()
         try:
-            groups = self._prepare_devices(uid, results, _opaque_configs(claim), undos)
+            groups = self._prepare_devices(
+                item.uid, item.results, _opaque_configs(item.claim), undos
+            )
         except Exception:
-            # Best-effort immediate cleanup of applied side effects (sharing
-            # daemons, timeslice, partitions); the claim stays in
-            # PrepareStarted so the retry's checkpoint-driven rollback covers
-            # anything this misses (e.g. after a crash).
             for undo in reversed(undos):
                 try:
                     undo()
                 except Exception:  # noqa: BLE001
                     logger.exception("prepare-failure cleanup step failed")
             raise
-
-        _crashpoint("post-mutate")
-        self._write_cdi_spec(uid, groups)
-        _crashpoint("post-cdi")
-        t_cdi = time.monotonic()
-        plain_groups = [g for g, _ in groups]
-
-        def complete(cp: Checkpoint) -> None:
-            cp.prepared_claims[uid] = PreparedClaim(
-                uid=uid,
-                namespace=namespace,
-                name=name,
-                status=PREPARE_COMPLETED,
-                groups=plain_groups,
-            )
-
-        self._cp.mutate(complete)
-        _crashpoint("post-completed")
-        logger.info(
-            "prepared claim %s/%s:%s t_prep=%.4fs t_cdi_to_done=%.4fs",
-            namespace, name, uid, time.monotonic() - t0, time.monotonic() - t_cdi,
+        metrics.observe_phase(
+            metrics.PHASE_CONFIG_APPLY, time.monotonic() - t0
         )
-        return [
-            PreparedDeviceResult(
-                request_names=d.request_names,
-                pool_name=d.pool_name,
-                device_name=d.canonical_name,
-                cdi_device_ids=d.cdi_device_ids,
-            )
-            for g in plain_groups
-            for d in g.devices
+        _crashpoint("post-mutate")
+        self._write_cdi_spec(item.uid, groups)
+        _crashpoint("post-cdi")
+        item.plain_groups = [g for g, _ in groups]
+
+    def finish_prepare(self, batch: PrepareBatch) -> None:
+        """Phase 3: ONE checkpoint RMW flips every claim whose effects
+        succeeded to PrepareCompleted.  Failed claims stay PrepareStarted
+        for the retry's rollback."""
+        done = [it for it in batch.items if it.plain_groups is not None]
+        if not done:
+            return
+        def complete_all(cp: Checkpoint) -> None:
+            for item in done:
+                cp.prepared_claims[item.uid] = PreparedClaim(
+                    uid=item.uid,
+                    namespace=item.namespace,
+                    name=item.name,
+                    status=PREPARE_COMPLETED,
+                    groups=item.plain_groups,
+                )
+
+        self._cp.mutate(complete_all)
+        _crashpoint("post-completed")
+
+    def begin_unprepare(self, claim_uids: list[str]) -> UnprepareBatch:
+        """Phase 1 of a batched unprepare: ONE checkpoint read snapshots
+        each claim's record and the partition-ownership set rollback needs.
+        Nothing is written yet — the record stays in place (still reserving
+        its silicon) until finish_unprepare."""
+        batch = UnprepareBatch()
+        cp = self._cp.read()
+        seen: set[str] = set()
+        for uid in claim_uids:
+            if uid in seen:
+                continue
+            seen.add(uid)
+            item = UnprepareItem(uid=uid)
+            batch.items.append(item)
+            if not uid:
+                item.error = PermanentError("claim reference has no uid")
+                continue
+            item.record = cp.prepared_claims.get(uid)
+            if item.record is not None and item.record.status == PREPARE_STARTED:
+                item.owned_partitions = _owned_partition_uuids(cp, uid)
+        return batch
+
+    def run_unprepare_effects(self, item: UnprepareItem) -> None:
+        """Phase 2: teardown side effects for one claim, outside every lock.
+        All teardown steps are idempotent (partition delete tolerates
+        already-gone, timeslice reset is absolute, daemon stop is a delete),
+        so a crash between effects and finish_unprepare re-runs cleanly."""
+        if item.record is None:
+            self._cdi.delete_claim_spec_file(item.uid)
+            item.done = True
+            return
+        if item.record.status == PREPARE_STARTED:
+            self._rollback_partial(item.record, item.owned_partitions)
+        else:
+            self._unprepare_devices(item.record)
+        self._cdi.delete_claim_spec_file(item.uid)
+        item.done = True
+
+    def finish_unprepare(self, batch: UnprepareBatch) -> None:
+        """Phase 3: ONE checkpoint RMW drops every record whose teardown
+        completed.  No-op (zero disk writes) when nothing was recorded."""
+        drop = [it.uid for it in batch.items if it.done and it.record is not None]
+        if not drop:
+            return
+
+        def drop_all(cp: Checkpoint) -> None:
+            for uid in drop:
+                cp.prepared_claims.pop(uid, None)
+
+        self._cp.mutate(drop_all)
+
+    def effect_groups(self, keyed: list) -> list[list]:
+        """Partition batch items into groups whose device footprints overlap
+        (same silicon under any alias); the driver runs groups concurrently
+        and members sequentially.  ``keyed`` is [(item, device_names)].
+
+        Overlap validation already guarantees the started claims of one
+        batch are disjoint, so groups are normally singletons — the grouping
+        is the safety net for unvalidated shapes (duplicate names, unknown
+        devices) where serial order is the conservative answer."""
+        n = len(keyed)
+        parent = list(range(n))
+
+        def find(i: int) -> int:
+            while parent[i] != i:
+                parent[i] = parent[parent[i]]
+                i = parent[i]
+            return i
+
+        prints = [
+            [(name, self._footprint(name)) for name in names]
+            for _, names in keyed
         ]
-
-    def unprepare(self, claim_uid: str) -> None:
-        t0 = time.monotonic()
-
-        def go(cp: Checkpoint) -> None:
-            claim = cp.prepared_claims.get(claim_uid)
-            if claim is None:
-                self._cdi.delete_claim_spec_file(claim_uid)
-                return
-            if claim.status == PREPARE_STARTED:
-                self._rollback_partial(cp, claim)
-            else:
-                self._unprepare_devices(claim)
-            self._cdi.delete_claim_spec_file(claim_uid)
-            cp.prepared_claims.pop(claim_uid, None)
-
-        self._cp.mutate(go)
-        logger.info("unprepared claim %s t_unprep=%.4fs", claim_uid, time.monotonic() - t0)
+        for i in range(n):
+            for j in range(i + 1, n):
+                if find(i) == find(j):
+                    continue
+                if _names_clash(prints[i], prints[j]):
+                    parent[find(j)] = find(i)
+        groups: dict[int, list] = {}
+        for i, (item, _) in enumerate(keyed):
+            groups.setdefault(find(i), []).append(item)
+        return list(groups.values())
 
     def prepared_claim_uids(self) -> dict[str, tuple[str, str, str]]:
         """uid → (namespace, name, status) for the stale-claim GC."""
@@ -497,7 +738,14 @@ class DeviceState:
                     cores_clash = ours[1][0] < theirs[1][1] and theirs[1][0] < ours[1][1]
                     hbm_clash = ours[2][0] < theirs[2][1] and theirs[2][0] < ours[2][1]
                     if cores_clash or hbm_clash:
-                        raise PermanentError(
+                        # Retryable, not permanent: with the narrowed node
+                        # lock the other claim may be mid-teardown (its
+                        # record stays durable until finish_unprepare), and
+                        # kubelet's retry lands after the silicon frees up.
+                        # A genuine double-allocation keeps erroring loudly
+                        # on every retry (the kubelet DRA API retries all
+                        # prepare errors anyway).
+                        raise PrepareError(
                             f"device {name} overlaps {dev.canonical_name}, already "
                             f"prepared for claim {other.namespace}/{other.name}:{other_uid}"
                         )
@@ -725,26 +973,20 @@ class DeviceState:
                     if chip is not None:
                         self._vfio.unconfigure(chip)
 
-    def _rollback_partial(self, cp: Checkpoint, claim: PreparedClaim) -> None:
+    def _rollback_partial(self, claim: PreparedClaim, owned: set[str]) -> None:
         """Tear down partitions a crashed/failed prepare may have created.
 
         The planned specs were checkpointed before hardware mutation; any live
         partition matching a planned spec that is *not* owned by a completed
         claim is an orphan (unpreparePartiallyPrepairedClaim,
-        device_state.go:482 + guard on completed-claim usage)."""
+        device_state.go:482 + guard on completed-claim usage).  ``owned`` is
+        the completed-claim partition-UUID set snapshotted from the same
+        checkpoint view that produced ``claim``."""
         planned = _decode_specs(
             claim.groups[0].config_state.get("plannedPartitions", "") if claim.groups else ""
         )
         if not planned:
             return
-        owned: set[str] = set()
-        for other in cp.prepared_claims.values():
-            if other.uid == claim.uid or other.status != PREPARE_COMPLETED:
-                continue
-            for dev in other.all_devices():
-                uuid = dev.attributes.get("partitionUUID")
-                if uuid:
-                    owned.add(uuid)
         planned_set = set(planned)
         for live in self._lib.list_partitions():
             if live.spec in planned_set and live.uuid not in owned:
@@ -806,7 +1048,7 @@ def _opaque_configs(claim: dict) -> list[tuple[list[str], object]]:
     return out
 
 
-def _results_from_claim(claim: PreparedClaim) -> list[PreparedDeviceResult]:
+def _results_from_groups(groups: list[PreparedDeviceGroup]) -> list[PreparedDeviceResult]:
     return [
         PreparedDeviceResult(
             request_names=d.request_names,
@@ -814,9 +1056,43 @@ def _results_from_claim(claim: PreparedClaim) -> list[PreparedDeviceResult]:
             device_name=d.canonical_name,
             cdi_device_ids=d.cdi_device_ids,
         )
-        for g in claim.groups
+        for g in groups
         for d in g.devices
     ]
+
+
+def _results_from_claim(claim: PreparedClaim) -> list[PreparedDeviceResult]:
+    return _results_from_groups(claim.groups)
+
+
+def _owned_partition_uuids(cp: Checkpoint, exclude_uid: str) -> set[str]:
+    """Partition UUIDs owned by completed claims other than ``exclude_uid``
+    — the set a partial-claim rollback must never destroy."""
+    owned: set[str] = set()
+    for other in cp.prepared_claims.values():
+        if other.uid == exclude_uid or other.status != PREPARE_COMPLETED:
+            continue
+        for dev in other.all_devices():
+            uuid = dev.attributes.get("partitionUUID")
+            if uuid:
+                owned.add(uuid)
+    return owned
+
+
+def _names_clash(a: list, b: list) -> bool:
+    """True when any device of one (name, footprint) list shares silicon —
+    or a literal name — with any device of the other."""
+    for name_a, fp_a in a:
+        for name_b, fp_b in b:
+            if name_a and name_a == name_b:
+                return True
+            if fp_a is None or fp_b is None or fp_a[0] != fp_b[0]:
+                continue
+            cores = fp_a[1][0] < fp_b[1][1] and fp_b[1][0] < fp_a[1][1]
+            hbm = fp_a[2][0] < fp_b[2][1] and fp_b[2][0] < fp_a[2][1]
+            if cores or hbm:
+                return True
+    return False
 
 
 def _encode_specs(specs: list[PartitionSpec]) -> str:

@@ -2,10 +2,14 @@
 
 The analog of gpu-kubelet-plugin/driver.go:52-554:
 
-- ``prepare_resource_claims``/``unprepare_resource_claims`` fan a kubelet
-  batch into per-claim operations under the node-global ``pu.lock`` flock
-  (driver.go:298-400), with per-stage wall-time instrumentation
-  (t_prep_lock_acq / t_prep — the BASELINE bind-latency hooks).
+- ``prepare_resource_claims``/``unprepare_resource_claims`` run a kubelet
+  batch through the pipelined claim-bind path (docs/bind-path.md): the
+  node-global ``pu.lock`` flock is held only around the two batched
+  checkpoint RMW phases (begin/finish), and per-claim side effects run
+  concurrently across a bounded pool for claims whose silicon footprints
+  are disjoint — with per-stage wall-time instrumentation (t_prep_lock_acq
+  / t_prep log lines plus the tpudra_bind_phase_seconds histogram, the
+  BASELINE bind-latency hooks).
 - ``publish_resources`` pushes this node's pool as ResourceSlice objects,
   flat or KEP-4815 partitionable (driver.go:402-554).
 - a health monitor consumes device-lib events and republishes the pool
@@ -15,12 +19,14 @@ The analog of gpu-kubelet-plugin/driver.go:52-554:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from tpudra import TPU_DRIVER_NAME, featuregates, metrics
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
@@ -54,6 +60,9 @@ class DriverConfig:
     device_backend: str = "mock"
     device_backend_options: dict = field(default_factory=dict)
     health_ignored_kinds: tuple = HealthEventKind.DEFAULT_IGNORED
+    # Bound on concurrent per-claim side-effect work within one kubelet
+    # batch (footprint-disjoint claims only; see prepare_resource_claims).
+    prepare_concurrency: int = 8
 
 
 class Driver:
@@ -101,8 +110,25 @@ class Driver:
             unprepare=self.unprepare_resource_claims,
             resolve_claim=kube_claim_resolver(kube),
         )
-        self.cleanup = CheckpointCleanupManager(kube, self.state)
+        self.cleanup = CheckpointCleanupManager(
+            kube, self.state, unprepare=self._unprepare_serialized
+        )
         self._health_thread: Optional[threading.Thread] = None
+        # Side-effect fan-out pool.  Threads spawn lazily on first multi-
+        # claim batch; single-claim batches run inline on the RPC thread
+        # (no hop, no pool wakeup — the common kubelet case).
+        self._effects_pool = ThreadPoolExecutor(
+            max_workers=max(1, config.prepare_concurrency),
+            thread_name_prefix="claim-effects",
+        )
+        # Per-claim-uid serialization: with the node lock narrowed to the
+        # RMW phases, a prepare and an unprepare of the SAME uid could
+        # otherwise interleave at the effects phase (prepare returning a
+        # grant whose CDI spec a concurrent unprepare just deleted).  One
+        # flock file per uid so the guard holds across processes (a
+        # restart-overlap sibling driver) as well as threads; unprepare
+        # unlinks the file while holding it (see _acquire_claim_lock).
+        self._claim_locks_dir = os.path.join(config.plugin_dir, "claims")
 
     # ------------------------------------------------------------- lifecycle
 
@@ -143,6 +169,7 @@ class Driver:
     def stop(self) -> None:
         self._stop.set()
         self._sockets.stop()
+        self._effects_pool.shutdown(wait=False)
         self._lib.close()
 
     @property
@@ -152,6 +179,11 @@ class Driver:
     # ------------------------------------------------------ prepare/unprepare
 
     def prepare_resource_claims(self, claims: list[dict]) -> dict:
+        if not claims:
+            # The health monitor pings with an empty batch (health.py,
+            # reference health.go:122) — it must stay lock- and disk-free.
+            return {"claims": {}}
+        t0 = time.monotonic()
         out: dict[str, dict] = {}
         # Any prepare can flip sibling visibility in either direction (a vfio
         # grant withholds the chip; a chip grant withholds the vfio alias) —
@@ -159,42 +191,234 @@ class Driver:
         # (driver.go:361).  bound_sibling_devices is empty-and-free with
         # passthrough disabled.
         withheld_before = self.state.bound_sibling_devices()
-        for claim in claims:
-            uid = claim.get("metadata", {}).get("uid", "")
-            t0 = time.monotonic()
-            try:
-                out[uid] = self._prepare_one(claim)
-            except Exception as e:  # noqa: BLE001 — per-claim fault barrier
-                logger.exception("prepare failed for claim %s", uid)
-                metrics.PREPARE_ERRORS.labels(TPU_DRIVER_NAME).inc()
-                out[uid] = {"error": str(e), "permanent": isinstance(e, PermanentError)}
-            finally:
-                metrics.PREPARE_SECONDS.labels(TPU_DRIVER_NAME).observe(
-                    time.monotonic() - t0
+        uids = [c.get("metadata", {}).get("uid", "") for c in claims]
+        try:
+            with self._claims_serialized(uids):
+                # Phase 1 under the node lock: ONE checkpoint RMW records
+                # PrepareStarted (+ rollback/validation) for the whole batch.
+                with self._locked_pu():
+                    t_lock = time.monotonic() - t0
+                    batch = self.state.begin_prepare(claims)
+                # Phase 2 outside the lock: per-claim side effects,
+                # concurrent across footprint-disjoint claims.
+                self._run_effects(
+                    batch.pending(),
+                    self.state.run_prepare_effects,
+                    "prepare effects",
                 )
-        if self.state.bound_sibling_devices() != withheld_before:
-            self.publish_resources()
+                # Phase 3 under the node lock: ONE checkpoint RMW completes
+                # every claim whose effects succeeded.
+                with self._locked_pu():
+                    self.state.finish_prepare(batch)
+                for item in batch.items:
+                    if item.error is not None:
+                        # Failed claims may never see an unprepare (kubelet
+                        # only unprepares what prepared), so their lock file
+                        # would leak; unlink-while-held is always safe and a
+                        # retry recreates it on demand.
+                        self._gc_claim_lock(item.uid)
+        except Exception as e:  # noqa: BLE001 — lock timeout / checkpoint IO
+            self._republish_if_withheld_changed(withheld_before)
+            return self._batch_failure(claims, e, "prepare", t0)
+        t_prep = time.monotonic() - t0
+        # One sample per NodePrepareResources call: with phases batched,
+        # claims of a batch have no individual wall time to observe, and
+        # N batch-wide samples would inflate the histogram ~N-fold.
+        metrics.PREPARE_SECONDS.labels(TPU_DRIVER_NAME).observe(t_prep)
+        # Once per CALL, like the histogram sample: these are batch-wide
+        # wall times, and a line per claim would overstate per-claim
+        # latency ~N-fold to anyone grepping the t_prep hook.
+        logger.info(
+            "t_prep_lock_acq=%.4fs t_prep=%.4fs claims=%s",
+            t_lock, t_prep,
+            ",".join(it.uid or "<no uid>" for it in batch.items),
+        )
+        for item in batch.items:
+            if item.error is not None:
+                logger.error(
+                    "prepare failed for claim %s", item.uid or "<no uid>",
+                    exc_info=item.error,
+                )
+                metrics.PREPARE_ERRORS.labels(TPU_DRIVER_NAME).inc()
+                out[item.uid] = {
+                    "error": str(item.error),
+                    "permanent": isinstance(item.error, PermanentError),
+                }
+                continue
+            out[item.uid] = {
+                "devices": [
+                    {
+                        "requestNames": d.request_names,
+                        "poolName": d.pool_name,
+                        "deviceName": d.device_name,
+                        "cdiDeviceIDs": d.cdi_device_ids,
+                    }
+                    for d in item.device_results()
+                ]
+            }
+        self._republish_if_withheld_changed(withheld_before)
         return {"claims": out}
 
     def unprepare_resource_claims(self, claims: list[dict]) -> dict:
+        if not claims:
+            return {"claims": {}}
+        t0 = time.monotonic()
         out: dict[str, dict] = {}
         withheld_before = self.state.bound_sibling_devices()
+        uids = [
+            ref.get("uid") or ref.get("metadata", {}).get("uid", "")
+            for ref in claims
+        ]
+        try:
+            with self._claims_serialized(uids):
+                with self._locked_pu():
+                    batch = self.state.begin_unprepare(uids)
+                self._run_effects(
+                    batch.pending(),
+                    self.state.run_unprepare_effects,
+                    "unprepare effects",
+                )
+                with self._locked_pu():
+                    self.state.finish_unprepare(batch)
+                for item in batch.items:
+                    if item.done:  # record dropped; lock file is garbage
+                        self._gc_claim_lock(item.uid)
+        except Exception as e:  # noqa: BLE001 — lock timeout / checkpoint IO
+            self._republish_if_withheld_changed(withheld_before)
+            return self._batch_failure(claims, e, "unprepare", t0)
+        t_unprep = time.monotonic() - t0
+        metrics.UNPREPARE_SECONDS.labels(TPU_DRIVER_NAME).observe(t_unprep)
+        logger.info(
+            "t_unprep=%.4fs claims=%s",
+            t_unprep,
+            ",".join(it.uid or "<no uid>" for it in batch.items),
+        )
+        for item in batch.items:
+            if item.error is not None:
+                logger.error(
+                    "unprepare failed for claim %s", item.uid or "<no uid>",
+                    exc_info=item.error,
+                )
+                out[item.uid] = {"error": str(item.error)}
+            else:
+                out[item.uid] = {}
+        self._republish_if_withheld_changed(withheld_before)
+        return {"claims": out}
+
+    def _republish_if_withheld_changed(self, withheld_before: set) -> None:
+        """Republish when sibling visibility changed — on EVERY exit path:
+        even a failed batch may have written PrepareStarted records that
+        flip visibility, and the retry samples withheld_before after those
+        records exist, so a skipped republish would never self-heal."""
+        try:
+            if self.state.bound_sibling_devices() != withheld_before:
+                self.publish_resources()
+        except Exception:  # noqa: BLE001 — never mask the RPC result
+            logger.exception("republish after prepare/unprepare failed")
+
+    def _unprepare_serialized(self, uid: str) -> None:
+        """Single-claim unprepare under the per-uid lock — the GC's entry
+        point, so its teardown serializes against kubelet RPCs for the
+        same claim."""
+        with self._claims_serialized([uid]):
+            self.state.unprepare(uid)
+            self._gc_claim_lock(uid)
+
+    def _run_effects(self, items: list, effect: Callable, what: str) -> None:
+        """Run per-item side effects, fanning footprint-disjoint items
+        across the bounded pool.  Failures land in ``item.error`` (per-claim
+        fault barrier); items sharing silicon run serially within a group."""
+        items = [it for it in items if it.error is None]
+        if not items:
+            return
+        groups = self.state.effect_groups(
+            [(it, it.device_names()) for it in items]
+        )
+
+        def run_group(group: list) -> None:
+            for it in group:
+                try:
+                    effect(it)
+                except Exception as e:  # noqa: BLE001 — per-claim barrier
+                    it.error = e
+
+        if len(groups) == 1:
+            run_group(groups[0])
+            return
+        futures = [self._effects_pool.submit(run_group, g) for g in groups]
+        for f in futures:
+            try:
+                f.result()
+            except Exception:  # noqa: BLE001 — pool plumbing only
+                logger.exception("%s worker failed", what)
+
+    def _batch_failure(
+        self, claims: list[dict], e: Exception, op: str, t0: float
+    ) -> dict:
+        """A batch-wide fault (node lock timeout, unreadable checkpoint):
+        every claim of the batch gets the same retryable error (kubelet
+        re-calls).  The latency histogram still gets its sample — lock
+        timeouts ARE the tail a bind dashboard exists to catch."""
+        logger.error("%s batch failed", op, exc_info=e)
+        hist = (
+            metrics.PREPARE_SECONDS if op == "prepare"
+            else metrics.UNPREPARE_SECONDS
+        )
+        hist.labels(TPU_DRIVER_NAME).observe(time.monotonic() - t0)
+        out: dict[str, dict] = {}
         for ref in claims:
             uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
-            t0 = time.monotonic()
-            try:
-                self._unprepare_one(uid)
-                out[uid] = {}
-            except Exception as e:  # noqa: BLE001
-                logger.exception("unprepare failed for claim %s", uid)
-                out[uid] = {"error": str(e)}
-            finally:
-                metrics.UNPREPARE_SECONDS.labels(TPU_DRIVER_NAME).observe(
-                    time.monotonic() - t0
-                )
-        if self.state.bound_sibling_devices() != withheld_before:
-            self.publish_resources()  # siblings became visible again
+            if op == "prepare":
+                metrics.PREPARE_ERRORS.labels(TPU_DRIVER_NAME).inc()
+            out[uid] = {"error": f"node {op}: {e}", "permanent": False}
         return {"claims": out}
+
+    def _claim_lock_path(self, uid: str) -> str:
+        return os.path.join(self._claim_locks_dir, f"{uid}.lock")
+
+    def _acquire_claim_lock(self, uid: str, deadline: float) -> Flock:
+        """Acquire one claim-uid flock, surviving concurrent GC of the lock
+        file: after acquiring, re-stat the path — if the file was unlinked
+        or replaced between our open and our flock (an unpreparing holder
+        unlinks while holding), release and retry on the fresh file."""
+        while True:
+            lock = Flock(self._claim_lock_path(uid), metric_label="claim")
+            lock.acquire(timeout=max(0.0, deadline - time.monotonic()))
+            try:
+                st = os.stat(lock.path)
+            except FileNotFoundError:
+                st = None
+            if st is not None and os.fstat(lock.fileno()).st_ino == st.st_ino:
+                return lock
+            lock.release()
+
+    @contextlib.contextmanager
+    def _claims_serialized(self, uids):
+        """Hold a per-claim-uid flock for the whole phased operation, so
+        concurrent prepare/unprepare of the same claim — in this process or
+        a sibling driver process — serialize exactly as the old full-width
+        node lock made them.  Distinct uids never contend.  Locks are taken
+        in sorted order (no deadlock between batches sharing uids) with the
+        node-flock timeout: a wedged effects phase must fail same-uid
+        retries after PU_LOCK_TIMEOUT, not absorb a gRPC worker thread per
+        retry forever."""
+        deadline = time.monotonic() + PU_LOCK_TIMEOUT
+        locks = []
+        try:
+            for uid in sorted({u for u in uids if u}):
+                locks.append(self._acquire_claim_lock(uid, deadline))
+            yield
+        finally:
+            for lock in reversed(locks):
+                lock.release()
+
+    def _gc_claim_lock(self, uid: str) -> None:
+        """Unlink a claim's lock file; call ONLY while holding its lock
+        (the unlink-while-held + re-stat-after-acquire protocol keeps
+        racing acquirers correct, and the dir from growing with every
+        claim the node has ever seen)."""
+        with contextlib.suppress(OSError):
+            os.unlink(self._claim_lock_path(uid))
 
     def _pu_lock(self):
         """A fresh Flock per operation: one shared instance cannot be
@@ -203,40 +427,14 @@ class Driver:
         and processes."""
         return Flock(self._pu_lock_path)
 
-    def _prepare_one(self, claim: dict) -> dict:
-        t0 = time.monotonic()
-        try:
-            with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
-                t_lock = time.monotonic() - t0
-                devices = self.state.prepare(claim)
-        except FlockTimeout as e:
-            raise RuntimeError(f"node prepare lock: {e}") from e
-        logger.info(
-            "t_prep_lock_acq=%.4fs t_prep=%.4fs claim=%s",
-            t_lock, time.monotonic() - t0, claim.get("metadata", {}).get("uid"),
-        )
-        return {
-            "devices": [
-                {
-                    "requestNames": d.request_names,
-                    "poolName": d.pool_name,
-                    "deviceName": d.device_name,
-                    "cdiDeviceIDs": d.cdi_device_ids,
-                }
-                for d in devices
-            ]
-        }
-
-    def _unprepare_one(self, uid: str) -> None:
-        if not uid:
-            raise PermanentError("claim reference has no uid")
-        t0 = time.monotonic()
-        try:
-            with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
-                self.state.unprepare(uid)
-        except FlockTimeout as e:
-            raise RuntimeError(f"node unprepare lock: {e}") from e
-        logger.info("t_unprep=%.4fs claim=%s", time.monotonic() - t0, uid)
+    @contextlib.contextmanager
+    def _locked_pu(self):
+        """Acquire the node-global lock for one RMW phase, feeding the wait
+        into the per-phase bind histogram."""
+        lock = self._pu_lock()
+        with lock(timeout=PU_LOCK_TIMEOUT):
+            metrics.observe_phase(metrics.PHASE_LOCK_WAIT, lock.last_wait)
+            yield lock
 
     # ---------------------------------------------------------- publication
 

@@ -138,6 +138,11 @@ class PluginSockets:
         self._registered = threading.Event()
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
+        # Claim-reference resolution fan-out (threads spawn lazily; only
+        # multi-claim batches ever submit to it).
+        self._resolver_pool = futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="claim-resolve"
+        )
         # Optional third service on the DRA socket: the kubelet-facing
         # v1alpha1.DRAResourceHealth stream.  Mirrors the official helper's
         # implements-it-then-serve-and-advertise semantics
@@ -145,6 +150,22 @@ class PluginSockets:
         self.health_broadcaster = None  # Optional[HealthBroadcaster]
 
     # ------------------------------------------------------------ DRA bridge
+
+    def _resolve_all(self, refs) -> list[tuple]:
+        """Resolve every claim reference, concurrently when the batch has
+        more than one (each resolution is an independent API-server GET —
+        serial lookups would put N round-trips ahead of the bind path).
+        Returns [(ref, claim-or-None, error-or-None)] in request order."""
+        def one(ref):
+            try:
+                return ref, self._resolve_claim(ref.namespace, ref.name, ref.uid), None
+            except Exception as e:  # noqa: BLE001 — per-claim fault barrier
+                return ref, None, e
+
+        refs = list(refs)
+        if len(refs) <= 1:
+            return [one(ref) for ref in refs]
+        return list(self._resolver_pool.map(one, refs))
 
     def _node_prepare(self, request, context, pb):
         """Resolve claim refs → run the driver's prepare → proto response.
@@ -155,14 +176,13 @@ class PluginSockets:
         """
         resp = pb.NodePrepareResourcesResponse()
         full_claims = []
-        for ref in request.claims:
-            try:
-                claim = self._resolve_claim(ref.namespace, ref.name, ref.uid)
-                full_claims.append(claim)
-            except Exception as e:  # noqa: BLE001 — per-claim fault barrier
+        for ref, claim, err in self._resolve_all(request.claims):
+            if err is not None:
                 resp.claims[ref.uid].error = (
-                    f"resolve claim {ref.namespace}/{ref.name}: {e}"
+                    f"resolve claim {ref.namespace}/{ref.name}: {err}"
                 )
+            else:
+                full_claims.append(claim)
         if full_claims:
             result = self._prepare(full_claims)
             for uid, entry in result.get("claims", {}).items():
@@ -280,6 +300,9 @@ class PluginSockets:
         for server in (self._reg_server, self._dra_server):
             if server is not None:
                 server.stop(grace=1.0).wait()
+        # After the grace drain: an in-flight RPC may still be resolving
+        # claims, and a shut-down executor would fail it mid-grace.
+        self._resolver_pool.shutdown(wait=False)
         for path in (self.registration_socket_path, self.dra_socket_path):
             if os.path.exists(path):
                 os.unlink(path)
