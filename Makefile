@@ -7,9 +7,14 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast tier1 bats bats-real bench bench-bind image helm-render clean
+.PHONY: all native test test-fast lint tier1 bats bats-real bench bench-bind image helm-render clean
 
 all: native test
+
+# Static analysis gate: tpudra-lint (stdlib AST checker, docs/static-analysis.md)
+# plus ruff/mypy when installed.  Nonzero exit on any finding.
+lint:
+	bash hack/lint.sh
 
 native:
 	$(MAKE) -C native
@@ -24,8 +29,11 @@ test-fast:
 	  --ignore=tests/test_computedomain.py \
 	  --ignore=tests/test_native.py
 
-# The exact ROADMAP.md tier-1 verify command (what the PR driver runs).
-tier1:
+# The exact ROADMAP.md tier-1 verify command (what the PR driver runs),
+# with the lint gate first: an invariant violation fails fast, before ~15
+# minutes of tests.  (The raw pytest command also gates via
+# tests/test_lint.py::test_repo_is_clean.)
+tier1: lint
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \

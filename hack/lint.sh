@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# The repo lint gate: tpudra-lint (always), ruff + mypy (when installed).
+#
+# Exit nonzero on ANY finding, so this is usable as a CI gate outside make
+# (`make lint` is a thin wrapper).  tpudra-lint is stdlib-only and therefore
+# unconditional; ruff/mypy are optional in the hermetic image, so their
+# absence is a loud skip, never a silent pass-pretender: the tpudra-lint
+# rules and tests/test_lint.py::test_repo_is_clean still gate.
+set -u
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== tpudra-lint (python -m tpudra.analysis)"
+python -m tpudra.analysis || fail=1
+
+if python -m ruff --version >/dev/null 2>&1; then
+    echo "== ruff check"
+    python -m ruff check . || fail=1
+elif command -v ruff >/dev/null 2>&1; then
+    echo "== ruff check"
+    ruff check . || fail=1
+else
+    echo "== ruff not installed; skipping (pip install ruff to enable)"
+fi
+
+if python -m mypy --version >/dev/null 2>&1; then
+    echo "== mypy (scoped per pyproject.toml)"
+    python -m mypy || fail=1
+elif command -v mypy >/dev/null 2>&1; then
+    echo "== mypy (scoped per pyproject.toml)"
+    mypy || fail=1
+else
+    echo "== mypy not installed; skipping (pip install mypy to enable)"
+fi
+
+exit $fail

@@ -1,0 +1,26 @@
+"""tpudra-lint fixture: BLOCK-UNDER-LOCK must fire on every marked line."""
+
+import subprocess
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stub = None
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.1)  # EXPECT: BLOCK-UNDER-LOCK
+            subprocess.run(["true"])  # EXPECT: BLOCK-UNDER-LOCK
+            subprocess.Popen(["true"])  # EXPECT: BLOCK-UNDER-LOCK
+
+    def rpc_under_lock(self):
+        with self._lock:
+            self._stub.NodePrepareResources(None)  # EXPECT: BLOCK-UNDER-LOCK
+
+    def io_under_lock(self):
+        with self._lock:
+            with open("/tmp/state.json") as f:  # EXPECT: BLOCK-UNDER-LOCK
+                return f.read()

@@ -1,0 +1,33 @@
+"""tpudra-lint fixture: LOCK-ORDER must fire on every marked line.
+
+Never imported — parsed by tests/test_lint.py, which asserts the analyzer
+reports exactly the (line, rule) pairs carried by the EXPECT markers.
+"""
+
+import threading
+
+from tpudra.flock import Flock
+
+
+class Publisher:
+    def __init__(self):
+        self._publish_lock = threading.Lock()
+        self._cp = None
+
+    def publish_with_flock(self):
+        with self._publish_lock:
+            with Flock("/tmp/pu.lock"):  # EXPECT: LOCK-ORDER
+                pass
+
+    def publish_with_rmw(self):
+        with self._publish_lock:
+            self._cp.mutate(lambda cp: None)  # EXPECT: LOCK-ORDER
+
+    def serialize_unsorted(self, uids):
+        locks = []
+        for uid in uids:
+            locks.append(self._acquire_claim_lock(uid, 1.0))  # EXPECT: LOCK-ORDER
+        return locks
+
+    def _acquire_claim_lock(self, uid, deadline):
+        return Flock(f"/tmp/claims/{uid}.lock")

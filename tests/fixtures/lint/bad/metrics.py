@@ -1,0 +1,16 @@
+"""tpudra-lint fixture: METRICS-HYGIENE inside a file named metrics.py —
+prefix, duplicate-registration, non-literal-name and in-function cases."""
+
+from prometheus_client import Counter, Gauge
+
+BAD_PREFIX = Counter("requests_total", "missing the tpudra_ prefix")  # EXPECT: METRICS-HYGIENE
+
+DUP_A = Gauge("tpudra_queue_depth", "queue depth")
+DUP_B = Gauge("tpudra_queue_depth", "registered twice")  # EXPECT: METRICS-HYGIENE
+
+_NAME = "tpudra_dynamic_total"
+DYNAMIC = Counter(_NAME, "name not a literal")  # EXPECT: METRICS-HYGIENE
+
+
+def make_counter():
+    return Counter("tpudra_infn_total", "constructed per call")  # EXPECT: METRICS-HYGIENE

@@ -1,0 +1,6 @@
+"""tpudra-lint fixture: METRICS-HYGIENE on a metric declared outside
+metrics.py — the export surface must stay in one file."""
+
+from prometheus_client import Counter
+
+STRAY = Counter("tpudra_stray_total", "declared in the wrong module")  # EXPECT: METRICS-HYGIENE

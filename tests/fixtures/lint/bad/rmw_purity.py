@@ -1,0 +1,32 @@
+"""tpudra-lint fixture: RMW-PURITY must fire on every marked line."""
+
+import os
+
+
+class State:
+    def __init__(self, cp, lib, cdi):
+        self._cp = cp
+        self._lib = lib
+        self._cdi = cdi
+
+    def prepare(self, uid, spec):
+        def start(cp):
+            live = self._lib.create_partition(spec)  # EXPECT: RMW-PURITY
+            self._record(cp, uid, live)
+
+        self._cp.mutate(start)
+
+    def _record(self, cp, uid, live):
+        # One call deep from the mutator: still scanned.
+        self._cdi.create_claim_spec_file(uid, {}, None)  # EXPECT: RMW-PURITY
+        cp.prepared_claims[uid] = live
+
+    def unprepare(self, uid):
+        def drop(cp):
+            cp.prepared_claims.pop(uid, None)
+            os.unlink(f"/var/run/cdi/{uid}.json")  # EXPECT: RMW-PURITY
+
+        self._cp.mutate(drop)
+
+    def nested_rmw(self, uid):
+        self._cp.mutate(lambda cp: self._cp.mutate(lambda inner: None))  # EXPECT: RMW-PURITY

@@ -1,0 +1,36 @@
+"""tpudra-lint fixture: SHARED-STATE must fire on every marked line."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Tracker:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def kick(self):
+        def work():
+            self._count = self._count + 1  # EXPECT: SHARED-STATE
+
+        self._pool.submit(work)
+
+    def reset(self):
+        self._count = 0
+
+
+class Monitor:
+    def __init__(self):
+        self._status = ""
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._status = "running"  # EXPECT: SHARED-STATE
+
+    def clear(self):
+        self._status = ""

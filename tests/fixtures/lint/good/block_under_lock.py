@@ -1,0 +1,33 @@
+"""tpudra-lint fixture: blocking work stays outside critical sections —
+zero findings.  Includes the patterns the rule must NOT flag: cond.wait
+(releases the lock), blocking calls after the with block, and a justified
+suppression."""
+
+import subprocess
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._pending = []
+        self._proc = None
+
+    def tick(self):
+        with self._lock:
+            item = self._pending.pop() if self._pending else None
+        time.sleep(0.1)
+        if item:
+            subprocess.run(["true"])
+
+    def wait_for_work(self):
+        with self._cond:
+            while not self._pending:
+                self._cond.wait(timeout=1.0)
+            return self._pending.pop()
+
+    def spawn(self, argv):
+        with self._lock:
+            self._proc = subprocess.Popen(argv)  # tpudra-lint: disable=BLOCK-UNDER-LOCK spawn and publication must be atomic vs a concurrent watchdog

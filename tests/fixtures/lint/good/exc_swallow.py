@@ -1,0 +1,36 @@
+"""tpudra-lint fixture: compliant exception handling — zero findings.
+Typed-narrow suppression, logged broad handling, re-raise, and a broad
+swallow justified with a reasoned suppression."""
+
+import contextlib
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def teardown(cli):
+    try:
+        cli.close()
+    except OSError:
+        pass  # already closed: exactly the state teardown wants
+    try:
+        cli.flush()
+    except Exception:
+        logger.warning("flush on teardown failed", exc_info=True)
+    with contextlib.suppress(FileNotFoundError):
+        cli.unlink()
+
+
+def reraise(cli):
+    try:
+        cli.close()
+    except Exception:
+        logger.error("close failed")
+        raise
+
+
+def justified(cli):
+    try:
+        cli.close()
+    except Exception:  # tpudra-lint: disable=EXC-SWALLOW best-effort fd sweep on the exit path; nothing can act on a failure here
+        pass

@@ -1,0 +1,31 @@
+"""tpudra-lint fixture: the compliant lock-hierarchy idioms — zero findings.
+
+Mirrors driver.py: the RMW runs and the flocks release BEFORE the publish
+lock is taken; claim locks are acquired in sorted-uid order.
+"""
+
+import threading
+
+from tpudra.flock import Flock
+
+
+class Publisher:
+    def __init__(self):
+        self._publish_lock = threading.Lock()
+        self._cp = None
+        self._slices = []
+
+    def bind_then_publish(self, uids):
+        with Flock("/tmp/pu.lock"):
+            self._cp.mutate(lambda cp: None)
+        with self._publish_lock:
+            self._slices = list(uids)
+
+    def serialize_sorted(self, uids):
+        locks = []
+        for uid in sorted(set(uids)):
+            locks.append(self._acquire_claim_lock(uid, 1.0))
+        return locks
+
+    def _acquire_claim_lock(self, uid, deadline):
+        return Flock(f"/tmp/claims/{uid}.lock")

@@ -1,0 +1,14 @@
+"""tpudra-lint fixture: a compliant metrics module — zero findings.
+Named metrics.py on purpose: module-level tpudra_* literals, each
+registered once; collections.Counter must not trip the rule."""
+
+from collections import Counter as TallyCounter
+
+from prometheus_client import Counter, Histogram
+
+REQUESTS_TOTAL = Counter("tpudra_requests_total", "requests served")
+BIND_SECONDS = Histogram("tpudra_bind_seconds", "bind wall time")
+
+
+def tally(events):
+    return TallyCounter(events)

@@ -1,0 +1,32 @@
+"""tpudra-lint fixture: the phased-engine idiom — zero findings.  The
+mutator only moves checkpoint state; hardware and CDI effects run before
+or after the RMW (docs/bind-path.md's begin/effects/finish shape)."""
+
+
+class State:
+    def __init__(self, cp, lib, cdi):
+        self._cp = cp
+        self._lib = lib
+        self._cdi = cdi
+
+    def prepare(self, uid, spec):
+        def begin(cp):
+            self._validate(cp, uid)
+            cp.prepared_claims[uid] = {"status": "PrepareStarted"}
+
+        self._cp.mutate(begin)
+        live = self._lib.create_partition(spec)
+        self._cdi.create_claim_spec_file(uid, {}, None)
+
+        def finish(cp):
+            cp.prepared_claims[uid] = {"status": "PrepareCompleted", "uuid": live.uuid}
+
+        self._cp.mutate(finish)
+
+    def _validate(self, cp, uid):
+        if uid in cp.prepared_claims:
+            raise ValueError(f"claim {uid} already prepared")
+
+    def unprepare(self, uid):
+        self._cdi.delete_claim_spec_file(uid)
+        self._cp.mutate(lambda cp: cp.prepared_claims.pop(uid, None))

@@ -1,0 +1,57 @@
+"""tpudra-lint fixture: thread-shared attributes under a guard — zero
+findings.  Includes the patterns the rule must NOT flag: both writes
+locked, item-attribute writes from workers, and methods only ever called
+from the spawned thread."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Tracker:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=2)
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def kick(self):
+        def work():
+            with self._lock:
+                self._count = self._count + 1
+
+        self._pool.submit(work)
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+
+class Batch:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=2)
+
+    def run(self, items):
+        def work(item):
+            item.error = None  # per-item state is the worker's own
+
+        for it in items:
+            self._pool.submit(work, it)
+
+
+class Informer:
+    """_sync is written only on the watch thread — _loop calls it — so the
+    transitive fold must keep it out of the 'main-thread writer' set."""
+
+    def __init__(self):
+        self._thread = None
+        self._resource_version = ""
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._sync()
+
+    def _sync(self):
+        self._resource_version = "fresh"

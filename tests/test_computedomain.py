@@ -548,6 +548,38 @@ class TestProcessManager:
         finally:
             stop.set()
 
+    def test_reload_does_not_sleep_holding_lock(self):
+        """BLOCK-UNDER-LOCK regression (ISSUE 2 sleep audit): reload() must
+        wait out SIGNAL_SAFE_AGE with the supervisor lock RELEASED — the
+        worst would-be offender in the tree.  If the sleep ever moves back
+        inside the ``with self._lock`` block, every send_signal/ensure_started
+        (watchdog tick, stop path) stalls behind the full safe-age window —
+        and this test's lock probe times out."""
+        pm = ProcessManager(
+            [
+                sys.executable,
+                "-c",
+                "import signal, time; signal.signal(signal.SIGHUP, lambda *a: None);"
+                " time.sleep(60)",
+            ]
+        )
+        pm.SIGNAL_SAFE_AGE = 2.0
+        pm.ensure_started()
+        try:
+            reloader = threading.Thread(target=pm.reload, daemon=True)
+            reloader.start()
+            time.sleep(0.3)  # let reload enter its wait-out window
+            acquired = pm._lock.acquire(timeout=0.5)
+            assert acquired, "reload holds the supervisor lock across its sleep"
+            pm._lock.release()
+            reloader.join(timeout=10.0)
+            assert not reloader.is_alive(), "reload never finished"
+            # No assertion on pm.running: whether the child installed its
+            # SIGHUP handler within SIGNAL_SAFE_AGE is load-dependent test
+            # timing, not the lock property this test pins.
+        finally:
+            pm.stop()
+
 
 # -- status-socket stub (stands in for tpu-slicewatchd) ----------------------
 
@@ -1008,6 +1040,30 @@ def _channel_claim(uid, cd_uid, device="channel-5"):
             }],
         }}},
     }
+
+
+class TestGCUnprepareSerialization:
+    def test_gc_unprepare_takes_node_lock(self, tmp_path, monkeypatch):
+        """The GC's unprepare entry point must hold the node pu.lock:
+        unprepare's label GC runs AFTER its checkpoint RMW (RMW-PURITY
+        phasing), and only the node lock — held across the whole operation
+        on every path — keeps the decide-then-remove sequence atomic
+        against a concurrent channel prepare's add_node_label."""
+        from tpudra.flock import Flock, FlockTimeout
+
+        kube = FakeKube()
+        mk_node(kube, "node-a")
+        drv = _mk_cddriver(kube, tmp_path)
+        assert drv.cleanup._unprepare == drv._unprepare_locked
+        monkeypatch.setattr("tpudra.cdplugin.driver.PU_LOCK_TIMEOUT", 0.2)
+        blocker = Flock(os.path.join(str(tmp_path / "cdplug"), "pu.lock"))
+        blocker.acquire()
+        try:
+            with pytest.raises(FlockTimeout):
+                drv._unprepare_locked("no-such-uid")
+        finally:
+            blocker.release()
+        drv._unprepare_locked("no-such-uid")  # lock free: no-op teardown
 
 
 class TestStartedClaimRollback:

@@ -1,0 +1,165 @@
+"""tpudra-lint (tpudra/analysis): fixture corpus + the repo-clean CI gate.
+
+Every ``bad/`` fixture carries ``# EXPECT: RULE-ID`` markers on its
+offending lines; the engine must report exactly those (line, rule) pairs —
+no more (precision), no less (recall).  ``good/`` fixtures encode the
+compliant idioms and must stay silent.  ``test_repo_is_clean`` is the CI
+gate the Makefile's lint target mirrors: the analyzer reports zero
+findings on the repo at HEAD.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from tpudra.analysis import lint_paths, lint_source
+from tpudra.analysis.engine import DEFAULT_ROOTS, Suppressions
+from tpudra.analysis.rules import all_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "lint")
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9-]+(?:\s*,\s*[A-Z0-9-]+)*)")
+
+BAD = sorted(glob.glob(os.path.join(FIXTURES, "bad", "*.py")))
+GOOD = sorted(glob.glob(os.path.join(FIXTURES, "good", "*.py")))
+
+
+def _expected(path: str) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    with open(path) as f:
+        for lineno, line in enumerate(f.read().splitlines(), 1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                out.extend(
+                    (lineno, rid) for rid in re.split(r"\s*,\s*", m.group(1))
+                )
+    assert out, f"bad fixture {path} has no EXPECT markers"
+    return sorted(out)
+
+
+def _got(path: str) -> list[tuple[int, str]]:
+    with open(path) as f:
+        findings = lint_source(f.read(), path)
+    return sorted((f.line, f.rule_id) for f in findings)
+
+
+@pytest.mark.parametrize("path", BAD, ids=[os.path.basename(p) for p in BAD])
+def test_bad_fixture_fires_exactly(path):
+    assert _got(path) == _expected(path)
+
+
+@pytest.mark.parametrize("path", GOOD, ids=[os.path.basename(p) for p in GOOD])
+def test_good_fixture_is_clean(path):
+    assert _got(path) == []
+
+
+def test_every_rule_id_demonstrated():
+    """The corpus covers the whole rule set — a rule nobody can see fire
+    is a rule nobody trusts."""
+    demonstrated = {rid for p in BAD for _, rid in _expected(p)}
+    want = {r.rule_id for r in all_rules()} | {"SUPPRESS-REASON"}
+    assert want <= demonstrated, f"rules without a bad fixture: {want - demonstrated}"
+
+
+def test_repo_is_clean():
+    """The CI gate: HEAD lints clean.  A finding here means either fix the
+    code or suppress it inline with a stated reason."""
+    roots = [os.path.join(REPO_ROOT, r) for r in DEFAULT_ROOTS]
+    findings = lint_paths([r for r in roots if os.path.exists(r)])
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+# ---------------------------------------------------------------- suppressions
+
+
+def test_suppression_same_line():
+    src = (
+        "import time, threading\n"
+        "lock = threading.Lock()\n"
+        "with lock:\n"
+        "    time.sleep(1)  # tpudra-lint: disable=BLOCK-UNDER-LOCK test shim sleeps on purpose\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_suppression_preceding_comment_line():
+    src = (
+        "import time, threading\n"
+        "lock = threading.Lock()\n"
+        "with lock:\n"
+        "    # tpudra-lint: disable=BLOCK-UNDER-LOCK test shim sleeps on purpose\n"
+        "    time.sleep(1)\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_suppression_wrong_rule_does_not_cover():
+    src = (
+        "import time, threading\n"
+        "lock = threading.Lock()\n"
+        "with lock:\n"
+        "    time.sleep(1)  # tpudra-lint: disable=EXC-SWALLOW wrong rule id\n"
+    )
+    assert [f.rule_id for f in lint_source(src)] == ["BLOCK-UNDER-LOCK"]
+
+
+def test_suppression_inside_string_is_inert():
+    src = 's = "# tpudra-lint: disable=EXC-SWALLOW not a comment"\n'
+    sup = Suppressions(src)
+    assert not sup.covers(1, "EXC-SWALLOW")
+
+
+def test_unreasoned_suppression_is_flagged():
+    src = (
+        "import time, threading\n"
+        "lock = threading.Lock()\n"
+        "with lock:\n"
+        "    time.sleep(1)  # tpudra-lint: disable=BLOCK-UNDER-LOCK\n"
+    )
+    assert [f.rule_id for f in lint_source(src)] == ["SUPPRESS-REASON"]
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tpudra.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=120,
+    )
+
+
+def test_cli_nonzero_on_bad_fixtures():
+    proc = _run_cli(os.path.join(FIXTURES, "bad"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for rule_id in ("LOCK-ORDER", "RMW-PURITY", "METRICS-HYGIENE"):
+        assert rule_id in proc.stdout
+
+
+def test_cli_zero_on_repo_head():
+    proc = _run_cli()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in all_rules():
+        assert rule.rule_id in proc.stdout
+    assert "SUPPRESS-REASON" in proc.stdout
+
+
+def test_cli_missing_path_is_usage_error():
+    proc = _run_cli("no/such/path.py")
+    assert proc.returncode == 2

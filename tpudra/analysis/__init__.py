@@ -1,0 +1,26 @@
+"""tpudra-lint: AST-based invariant checker for the driver codebase.
+
+The analog of the reference driver's `go vet` + golangci-lint + race-detector
+discipline: the invariants that make the pipelined claim-bind path safe —
+the lock hierarchy, RMW purity, metrics hygiene (docs/bind-path.md) — live
+here as machine-checked rules instead of prose only.  Pure stdlib (``ast``),
+no third-party deps, so it runs in every environment the driver builds in.
+
+Usage::
+
+    python -m tpudra.analysis              # lint tpudra/, tools/, bench.py
+    python -m tpudra.analysis path [...]   # lint specific files/dirs
+    python -m tpudra.analysis --list-rules
+
+Suppression: ``# tpudra-lint: disable=RULE-ID reason`` on the offending
+line (or alone on the line just above it).  The reason is free text and
+required by convention — a suppression is a design decision, and the next
+reader needs to know which one.  Rules and rationale: docs/static-analysis.md.
+"""
+
+from tpudra.analysis.engine import (  # noqa: F401 — public API
+    DEFAULT_ROOTS,
+    Finding,
+    lint_paths,
+    lint_source,
+)

@@ -1,0 +1,101 @@
+"""CLI for tpudra-lint: ``python -m tpudra.analysis [paths...]``.
+
+Exit status: 0 clean, 1 findings, 2 usage/internal error — the contract
+``hack/lint.sh`` and the ``make lint`` gate build on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpudra.analysis.engine import DEFAULT_ROOTS, lint_paths
+
+
+def _repo_root() -> str:
+    """The directory holding the ``tpudra`` package — so the default roots
+    resolve no matter where the command is invoked from."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpudra.analysis",
+        description="tpudra-lint: driver-specific AST invariant checks "
+        "(docs/static-analysis.md)",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/directories to lint (default: {', '.join(DEFAULT_ROOTS)} "
+        "under the repo root)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rule IDs and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from tpudra.analysis.rules import all_rules
+
+        for rule in all_rules():
+            print(f"{rule.rule_id}: {rule.description}")
+        print(
+            "SUPPRESS-REASON: every '# tpudra-lint: disable=...' states a "
+            "reason (engine-level check)"
+        )
+        return 0
+
+    paths = args.paths
+    if not paths:
+        root = _repo_root()
+        paths = [
+            p for p in (os.path.join(root, r) for r in DEFAULT_ROOTS)
+            if os.path.exists(p)
+        ]
+        if not paths:
+            print("tpudra-lint: no default roots found; pass paths", file=sys.stderr)
+            return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"tpudra-lint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths)
+    if args.json:
+        print(
+            json.dumps(
+                [
+                    {
+                        "path": f.path,
+                        "line": f.line,
+                        "col": f.col,
+                        "rule": f.rule_id,
+                        "message": f.message,
+                    }
+                    for f in findings
+                ],
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(
+            f"tpudra-lint: {n} finding{'s' if n != 1 else ''}"
+            if n
+            else "tpudra-lint: clean"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

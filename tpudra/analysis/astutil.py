@@ -1,0 +1,127 @@
+"""Small AST helpers shared by the tpudra-lint rules.
+
+Everything here is name-heuristic by design: the analyzer has no type
+information, so rules classify objects by the naming conventions the
+codebase already follows (``self._publish_lock``, ``Flock(...)``,
+``*_stub``).  The conventions are part of the contract — a lock named
+``self.helper`` evades the checker, and review should catch the name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+#: Names that denote an in-process mutual-exclusion primitive.  ``_cond``
+#: is included: a Condition wraps a lock and ``with cond:`` holds it.
+_LOCKISH_SUFFIXES = ("_lock", "_cond", "_mutex")
+_LOCKISH_EXACT = {"lock", "cond", "mutex"}
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: ``self._cp.mutate`` →
+    ``self._cp.mutate``; unresolvable parts render as ``?``."""
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return f"{dotted_name(node.func)}()"
+    return "?"
+
+
+def terminal_name(node: ast.AST) -> str:
+    """The last path segment of an expression: the attribute name, the bare
+    name, or the called object's terminal name for ``X(...)``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return terminal_name(node.func)
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    """Terminal name of the called object (``self._lib.create_partition(...)``
+    → ``create_partition``)."""
+    return terminal_name(call.func)
+
+
+def is_lockish_name(name: str) -> bool:
+    low = name.lower()
+    return low in _LOCKISH_EXACT or low.endswith(_LOCKISH_SUFFIXES)
+
+
+def is_flockish(expr: ast.AST) -> bool:
+    """True when the expression denotes a cross-process flock rather than an
+    in-process lock: a ``Flock(...)`` construction (possibly called again,
+    ``Flock(p)(timeout=...)``), or any name with ``flock`` in it."""
+    names = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            names.add(node.id.lower())
+        elif isinstance(node, ast.Attribute):
+            names.add(node.attr.lower())
+    return any("flock" in n for n in names)
+
+
+def withitem_lock_kind(item: ast.withitem) -> Optional[tuple[str, str]]:
+    """Classify one ``with`` item: returns ``(kind, name)`` with kind
+    ``"flock"`` or ``"inproc"``, or None when the item is not lock-like.
+
+    Handles the codebase's forms: ``with self._publish_lock:``,
+    ``with lock(timeout=...):`` (a Flock object being called),
+    ``with Flock(path)(timeout=...):``, ``with self._cond:``.
+    """
+    expr = item.context_expr
+    if is_flockish(expr):
+        return ("flock", terminal_name(expr))
+    name = terminal_name(expr)
+    if is_lockish_name(name):
+        return ("inproc", name)
+    return None
+
+
+def iter_calls(node: ast.AST) -> Iterator[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def walk_body_shallow(stmts: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class
+    definitions — their bodies run later, not under the enclosing block."""
+    stack: list[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def collect_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    """Every function/method in the module by bare name (last definition
+    wins).  Used for the depth-limited call expansion of RMW-PURITY — a
+    name collision between classes errs toward scanning more, which can
+    only over-report, never under-report."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def self_attr_target(node: ast.AST) -> Optional[str]:
+    """``self.X`` when the node is an attribute on the name ``self``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None

@@ -1,0 +1,170 @@
+"""The tpudra-lint engine: file walking, parsing, suppressions, rule runs.
+
+Rules are small classes (tpudra/analysis/rules/) instantiated fresh per
+lint run so cross-file state (METRICS-HYGIENE's duplicate-registration
+check) never leaks between runs.  The engine owns everything that is not
+rule-specific: which files to scan, the suppression syntax, ordering.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+#: What `python -m tpudra.analysis` scans with no path arguments, relative
+#: to the repo root (the directory holding the ``tpudra`` package).
+DEFAULT_ROOTS = ("tpudra", "tools", "bench.py")
+
+#: Generated or vendored code the rules must not police.
+_SKIP_DIR_NAMES = {"__pycache__", "drapb", "build", "vendor"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpudra-lint:\s*disable=(?P<rules>[A-Z0-9-]+(?:,[A-Z0-9-]+)*)"
+    r"(?:\s+(?P<reason>[^#\s][^#]*))?"
+)
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule_id} {self.message}"
+
+
+@dataclass
+class ParsedModule:
+    path: str
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def __post_init__(self) -> None:
+        if not self.lines:
+            self.lines = self.source.splitlines()
+
+
+class Suppressions:
+    """``# tpudra-lint: disable=RULE[,RULE] reason`` comments of one file.
+
+    A suppression applies to findings on its own line; a comment that is
+    the only thing on its line additionally covers the next line, so long
+    statements keep their suppression adjacent.  Comments are found with
+    ``tokenize`` (not substring search) so the directive inside a string
+    literal is inert.
+    """
+
+    def __init__(self, source: str):
+        self._by_line: dict[int, set[str]] = {}
+        self.unreasoned: list[tuple[int, str]] = []
+        try:
+            tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = set(m.group("rules").split(","))
+                if not m.group("reason"):
+                    self.unreasoned.append((tok.start[0], m.group("rules")))
+                line = tok.start[0]
+                self._by_line.setdefault(line, set()).update(rules)
+                stripped = tok.line.strip()
+                if stripped.startswith("#"):  # comment-only line: cover the next
+                    self._by_line.setdefault(line + 1, set()).update(rules)
+        except tokenize.TokenError:
+            # Unterminated trailer after the last suppression; the file
+            # itself already parsed (ast.parse runs first), so any comment
+            # tokens yielded before the error are kept and the rest of the
+            # file simply has no suppressions.
+            pass
+
+    def covers(self, line: int, rule_id: str) -> bool:
+        return rule_id in self._by_line.get(line, ())
+
+
+def _iter_python_files(root: str) -> Iterable[str]:
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIR_NAMES)
+        for name in sorted(filenames):
+            if name.endswith(".py") and not name.endswith("_pb2.py"):
+                yield os.path.join(dirpath, name)
+
+
+def _make_rules() -> list:
+    from tpudra.analysis.rules import all_rules
+
+    return all_rules()
+
+
+def lint_source(
+    source: str, path: str = "<string>", rules: Optional[list] = None
+) -> list[Finding]:
+    """Lint one in-memory module (the fixture-test entrypoint)."""
+    active = rules if rules is not None else _make_rules()
+    findings = _lint_one(ParsedModule(path=path, source=source, tree=ast.parse(source)), active)
+    if rules is None:
+        for rule in active:
+            findings.extend(rule.finalize())
+        findings.sort()
+    return findings
+
+
+def _lint_one(module: ParsedModule, rules: list) -> list[Finding]:
+    suppressed = Suppressions(module.source)
+    out: list[Finding] = []
+    for rule in rules:
+        for f in rule.check_module(module):
+            if not suppressed.covers(f.line, f.rule_id):
+                out.append(f)
+    # A suppression is a design decision; without a reason the next reader
+    # cannot tell a considered exception from a silenced mistake.
+    for line, rules_str in suppressed.unreasoned:
+        if not suppressed.covers(line, "SUPPRESS-REASON"):
+            out.append(
+                Finding(
+                    module.path, line, 0, "SUPPRESS-REASON",
+                    f"suppression of {rules_str} states no reason — say why "
+                    "the rule is safe to ignore here",
+                )
+            )
+    return out
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """Lint files/directories; returns sorted findings.  Unparseable files
+    surface as SYNTAX findings rather than crashing the run — a file the
+    analyzer cannot read is a finding, not an excuse."""
+    rules = _make_rules()
+    findings: list[Finding] = []
+    for root in paths:
+        for filename in _iter_python_files(root):
+            try:
+                with open(filename, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=filename)
+            except (OSError, SyntaxError, ValueError) as e:
+                line = getattr(e, "lineno", 1) or 1
+                findings.append(
+                    Finding(filename, line, 0, "SYNTAX", f"cannot analyze: {e}")
+                )
+                continue
+            findings.extend(
+                _lint_one(ParsedModule(path=filename, source=source, tree=tree), rules)
+            )
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings.sort()
+    return findings

@@ -1,0 +1,49 @@
+"""The tpudra-lint rule set.
+
+Each rule is a class with a stable ``rule_id`` (the suppression and
+documentation handle), a one-line ``description`` (``--list-rules``), a
+``check_module`` hook, and an optional ``finalize`` hook for cross-file
+checks.  Rules are instantiated fresh per run (engine.py) so cross-file
+state never leaks.  Rationale per rule: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+from tpudra.analysis.engine import Finding, ParsedModule
+
+
+class Rule:
+    rule_id: str = ""
+    description: str = ""
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        raise NotImplementedError
+
+    def finalize(self) -> list[Finding]:
+        return []
+
+    def finding(self, module: ParsedModule, node, message: str) -> Finding:
+        return Finding(
+            path=module.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule_id=self.rule_id,
+            message=message,
+        )
+
+
+def all_rules() -> list[Rule]:
+    from tpudra.analysis.rules.exc_swallow import ExcSwallow
+    from tpudra.analysis.rules.locks import BlockUnderLock, LockOrder
+    from tpudra.analysis.rules.metrics_hygiene import MetricsHygiene
+    from tpudra.analysis.rules.rmw_purity import RmwPurity
+    from tpudra.analysis.rules.shared_state import SharedState
+
+    return [
+        LockOrder(),
+        BlockUnderLock(),
+        RmwPurity(),
+        SharedState(),
+        MetricsHygiene(),
+        ExcSwallow(),
+    ]

@@ -1,0 +1,81 @@
+"""EXC-SWALLOW: no silent broad excepts.
+
+A ``except:``/``except Exception:`` whose body is only ``pass`` (or a
+bare constant) swallows everything including the bugs this repo's
+prepare/unprepare convergence story depends on surfacing — a claim whose
+teardown half-fails silently is exactly the leak the checkpoint protocol
+exists to prevent.  ``contextlib.suppress(Exception)`` is the same
+construct in a trench coat.
+
+Narrow, typed suppression (``except DeviceLibError: pass`` with a comment
+saying why already-gone is fine) does not trip the rule; neither does a
+broad except that logs or re-raises.  Where a broad swallow really is the
+design (best-effort cleanup on an exit path), say so with
+``# tpudra-lint: disable=EXC-SWALLOW <why>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudra.analysis import astutil
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(exc_type: ast.expr | None) -> bool:
+    if exc_type is None:
+        return True  # bare except
+    if isinstance(exc_type, ast.Name):
+        return exc_type.id in _BROAD
+    if isinstance(exc_type, ast.Tuple):
+        return any(_is_broad(e) for e in exc_type.elts)
+    return False
+
+
+def _swallows(body: list[ast.stmt]) -> bool:
+    """True when the handler body does nothing observable: only ``pass``,
+    ``...``, or bare constants (a docstring-style comment)."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+class ExcSwallow(Rule):
+    rule_id = "EXC-SWALLOW"
+    description = "no bare/broad 'except: pass' (or suppress(Exception))"
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler):
+                if _is_broad(node.type) and _swallows(node.body):
+                    what = (
+                        "bare except" if node.type is None
+                        else f"except {astutil.dotted_name(node.type)}"
+                    )
+                    out.append(
+                        self.finding(
+                            module, node,
+                            f"{what} swallows every error silently — log it, "
+                            "narrow the type, or suppress with a stated reason",
+                        )
+                    )
+            elif isinstance(node, ast.Call) and astutil.call_name(node) == "suppress":
+                if any(
+                    isinstance(a, ast.Name) and a.id in _BROAD for a in node.args
+                ):
+                    out.append(
+                        self.finding(
+                            module, node,
+                            "contextlib.suppress(Exception) swallows every "
+                            "error silently — narrow it or handle and log",
+                        )
+                    )
+        return out

@@ -1,0 +1,190 @@
+"""LOCK-ORDER and BLOCK-UNDER-LOCK: the bind-path lock hierarchy.
+
+docs/bind-path.md §"Lock hierarchy" in prose; here as machine checks:
+
+- the publish lock (level 3) must never wait on a flock (level 1/2) or on
+  the checkpoint RMW (``mutate`` takes ``cp.lock``);
+- per-claim-uid flocks are acquired in sorted-uid order, or two batches
+  sharing uids deadlock;
+- an in-process-lock ``with`` body must not block: no ``time.sleep``, no
+  ``subprocess``, no gRPC stub calls, no ``open()`` — every other thread
+  needing the lock stalls for the duration, and on the bind path that is
+  a p99 regression hiding in a critical section.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudra.analysis import astutil
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+#: Attribute names that denote the ResourceSlice publish lock (level 3).
+_PUBLISH_LOCK_NAMES = {"_publish_lock", "publish_lock"}
+
+#: Helpers that acquire a per-claim-uid flock (driver.py).
+_CLAIM_LOCK_ACQUIRERS = {"_acquire_claim_lock"}
+
+
+def _is_publish_lock_with(item: ast.withitem) -> bool:
+    return astutil.terminal_name(item.context_expr) in _PUBLISH_LOCK_NAMES
+
+
+def _blocking_call(call: ast.Call) -> str:
+    """Non-empty description when the call blocks: sleep, subprocess, a
+    gRPC stub method, or file I/O via ``open``."""
+    dotted = astutil.dotted_name(call.func)
+    terminal = astutil.call_name(call)
+    if terminal == "sleep":
+        return "time.sleep"
+    if dotted.startswith("subprocess.") or terminal == "Popen":
+        return dotted
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "open()"
+    # A method on something named *stub* (gRPC convention: self._stub,
+    # node_stub, registration_stub ...).
+    receiver_parts = dotted.lower().split(".")[:-1]
+    if any("stub" in part for part in receiver_parts):
+        return f"gRPC stub call {dotted}"
+    return ""
+
+
+class LockOrder(Rule):
+    rule_id = "LOCK-ORDER"
+    description = (
+        "flocks and the checkpoint RMW are never awaited under the publish "
+        "lock; per-claim-uid locks are acquired in sorted order"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.With) and any(
+                _is_publish_lock_with(i) for i in node.items
+            ):
+                out.extend(self._check_publish_body(module, node))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                out.extend(self._check_claim_lock_loop(module, node))
+        return out
+
+    def _check_publish_body(self, module: ParsedModule, with_node: ast.With) -> list[Finding]:
+        """Nothing under ``_publish_lock`` may wait on a lower lock level:
+        no Flock construction/acquire/with, no ``mutate`` (cp.lock RMW).
+        One finding per line: a ``with Flock(...)`` is both a With and a
+        Call, and two findings for one offense reads as two bugs."""
+        out = []
+        seen_lines: set[int] = set()
+
+        def add(f: Finding) -> None:
+            if f.line not in seen_lines:
+                seen_lines.add(f.line)
+                out.append(f)
+        for sub in astutil.walk_body_shallow(with_node.body):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    kind = astutil.withitem_lock_kind(item)
+                    if kind is not None and kind[0] == "flock":
+                        add(
+                            self.finding(
+                                module, sub,
+                                f"flock '{kind[1]}' taken inside the publish-lock "
+                                "block — the publish lock (level 3) must never "
+                                "wait on a flock (docs/bind-path.md)",
+                            )
+                        )
+            if not isinstance(sub, ast.Call):
+                continue
+            name = astutil.call_name(sub)
+            if astutil.is_flockish(sub.func) and name in ("Flock", "acquire"):
+                add(
+                    self.finding(
+                        module, sub,
+                        f"'{astutil.dotted_name(sub.func)}' under the publish lock — "
+                        "flocks are below the publish lock in the hierarchy",
+                    )
+                )
+            elif name == "mutate":
+                add(
+                    self.finding(
+                        module, sub,
+                        "checkpoint RMW (mutate takes cp.lock) under the publish "
+                        "lock — run the RMW first, publish after",
+                    )
+                )
+        return out
+
+    def _check_claim_lock_loop(self, module: ParsedModule, loop: ast.For) -> list[Finding]:
+        """A loop acquiring per-claim-uid locks must iterate ``sorted(...)``
+        — unsorted acquisition order deadlocks two batches sharing uids."""
+        acquires = [
+            c
+            for c in astutil.walk_body_shallow(loop.body)
+            if isinstance(c, ast.Call)
+            and (
+                astutil.call_name(c) in _CLAIM_LOCK_ACQUIRERS
+                or (
+                    astutil.call_name(c) == "Flock"
+                    and any(
+                        "claim" in astutil.dotted_name(a).lower()
+                        for a in c.args
+                        if isinstance(a, (ast.Call, ast.Attribute, ast.Name))
+                    )
+                )
+            )
+        ]
+        if not acquires:
+            return []
+        it = loop.iter
+        if isinstance(it, ast.Call) and astutil.call_name(it) in ("sorted", "reversed"):
+            # reversed(sorted(...)) is still a total order; plain reversed
+            # of an arbitrary iterable is not — only accept it over sorted.
+            if astutil.call_name(it) == "sorted" or (
+                it.args
+                and isinstance(it.args[0], ast.Call)
+                and astutil.call_name(it.args[0]) == "sorted"
+            ):
+                return []
+        return [
+            self.finding(
+                module, acquires[0],
+                "per-claim-uid locks acquired from an unsorted iterable — "
+                "two batches sharing uids can deadlock; iterate sorted(uids)",
+            )
+        ]
+
+
+class BlockUnderLock(Rule):
+    rule_id = "BLOCK-UNDER-LOCK"
+    description = (
+        "no time.sleep / subprocess / gRPC stub call / open() inside an "
+        "in-process-lock with body"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [
+                kind
+                for kind in (astutil.withitem_lock_kind(i) for i in node.items)
+                if kind is not None and kind[0] == "inproc"
+            ]
+            if not locks:
+                continue
+            lock_name = locks[0][1]
+            for sub in astutil.walk_body_shallow(node.body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                what = _blocking_call(sub)
+                if what:
+                    out.append(
+                        self.finding(
+                            module, sub,
+                            f"{what} while holding in-process lock "
+                            f"'{lock_name}' — move the blocking work outside "
+                            "the critical section",
+                        )
+                    )
+        return out

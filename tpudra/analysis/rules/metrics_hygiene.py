@@ -1,0 +1,132 @@
+"""METRICS-HYGIENE: the Prometheus surface stays coherent.
+
+Every metric the driver exports: named ``tpudra_*`` (one grep finds the
+whole surface, dashboards never collide with another exporter), declared
+at module level of ``metrics.py`` (prometheus_client registers globally
+at construction — a constructor inside a function re-registers on second
+call and raises ``Duplicated timeseries``), and registered exactly once
+across the tree.
+
+Only constructors actually imported from ``prometheus_client`` count, so
+``collections.Counter`` never trips the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tpudra.analysis import astutil
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+_CONSTRUCTORS = {"Counter", "Gauge", "Histogram", "Summary", "Info", "Enum"}
+_METRICS_BASENAME = "metrics.py"
+_PREFIX = "tpudra_"
+
+
+def _prometheus_names(tree: ast.Module) -> set[str]:
+    """Local names bound to prometheus_client constructors in this module
+    (handles ``from prometheus_client import Counter as C``)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "prometheus_client":
+            for alias in node.names:
+                if alias.name in _CONSTRUCTORS:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+class MetricsHygiene(Rule):
+    rule_id = "METRICS-HYGIENE"
+    description = (
+        "prometheus metrics are tpudra_*-named literals, module-level in "
+        "metrics.py, registered exactly once"
+    )
+
+    def __init__(self) -> None:
+        #: metric name → (path, line) of its first registration.
+        self._registered: dict[str, tuple[str, int]] = {}
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        local = _prometheus_names(module.tree)
+        dotted_ok = {f"prometheus_client.{c}" for c in _CONSTRUCTORS}
+        nested_ids = {
+            id(sub)
+            for node in ast.walk(module.tree)
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+            )
+            for sub in ast.walk(node)
+            if sub is not node
+        }
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            is_ctor = (
+                isinstance(func, ast.Name) and func.id in local
+            ) or astutil.dotted_name(func) in dotted_ok
+            if not is_ctor:
+                continue
+            out.extend(self._check_ctor(module, node, id(node) not in nested_ids))
+        return out
+
+    def _check_ctor(
+        self, module: ParsedModule, call: ast.Call, at_module_level: bool
+    ) -> list[Finding]:
+        out: list[Finding] = []
+        if os.path.basename(module.path) != _METRICS_BASENAME:
+            out.append(
+                self.finding(
+                    module, call,
+                    "prometheus metric constructed outside metrics.py — "
+                    "all metric families live in tpudra/metrics.py so the "
+                    "export surface is one file",
+                )
+            )
+        elif not at_module_level:
+            out.append(
+                self.finding(
+                    module, call,
+                    "prometheus metric constructed inside a function/class — "
+                    "constructors register globally; a second call raises "
+                    "'Duplicated timeseries'. Declare at module level",
+                )
+            )
+        name_arg = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "name":
+                name_arg = kw.value
+        if not (isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str)):
+            out.append(
+                self.finding(
+                    module, call,
+                    "metric name must be a string literal (greppable, "
+                    "checkable); computed names hide the export surface",
+                )
+            )
+            return out
+        name = name_arg.value
+        if not name.startswith(_PREFIX):
+            out.append(
+                self.finding(
+                    module, call,
+                    f"metric '{name}' does not start with '{_PREFIX}' — every "
+                    "exported family carries the driver prefix",
+                )
+            )
+        first = self._registered.get(name)
+        if first is not None:
+            out.append(
+                self.finding(
+                    module, call,
+                    f"metric '{name}' already registered at "
+                    f"{first[0]}:{first[1]} — prometheus_client raises "
+                    "'Duplicated timeseries' on the second registration",
+                )
+            )
+        else:
+            self._registered[name] = (module.path, call.lineno)
+        return out

@@ -1,0 +1,171 @@
+"""RMW-PURITY: callables passed to ``CheckpointManager.mutate`` stay pure.
+
+docs/bind-path.md's batched-RMW protocol: the mutator runs under the
+``cp.lock`` flock, inside the two per-batch critical sections that every
+other driver process serializes on.  Side effects belong in phase 2
+(effects, outside every lock) — a partition create, a CDI write, a daemon
+start, or a kube call inside the mutator stretches the node-wide critical
+section by its whole latency AND breaks crash convergence (the crash-sweep
+contract is that effects are covered by a durable record written *before*
+they run, which an effect inside the RMW is not).
+
+The check is depth-limited interprocedural: the mutator's body is scanned,
+plus (up to 3 calls deep) any ``self.X(...)``/``X(...)`` callee defined in
+the same module — ``start_all`` delegating to ``_start_one`` is still
+covered.  Cross-module helpers are matched by name only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Union
+
+from tpudra.analysis import astutil
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+_MAX_DEPTH = 3
+
+#: Terminal call names that are side effects, grouped for the message.
+_FORBIDDEN = {
+    # hardware mutation
+    "create_partition": "partition create",
+    "delete_partition": "partition delete",
+    "set_timeslice": "timeslice mutation",
+    "set_exclusive": "exclusive-mode mutation",
+    "configure": "vfio configure",
+    "unconfigure": "vfio unconfigure",
+    # CDI spec files
+    "create_claim_spec_file": "CDI spec write",
+    "delete_claim_spec_file": "CDI spec delete",
+    "_write_cdi_spec": "CDI spec write",
+    # sharing-daemon lifecycle
+    "new_daemon": "daemon creation",
+    "assert_ready": "daemon readiness wait",
+    "start": "lifecycle start",
+    "stop": "lifecycle stop",
+    "restart": "lifecycle restart",
+    # kube / network
+    "publish_slices": "ResourceSlice publication",
+    "remove_node_label": "kube node-label write",
+    "add_node_label": "kube node-label write",
+    "cleanup_daemon_settings": "daemon-settings teardown",
+    # blocking / filesystem
+    "sleep": "sleep",
+    # nested RMW deadlocks on cp.lock
+    "mutate": "nested checkpoint RMW",
+}
+
+#: os-level filesystem mutations (matched as ``os.X`` only, so a domain
+#: method named ``replace`` does not trip the rule).
+_OS_EFFECTS = {"replace", "unlink", "makedirs", "rmdir", "remove", "rename"}
+
+
+def _forbidden_reason(call: ast.Call) -> str:
+    dotted = astutil.dotted_name(call.func)
+    terminal = astutil.call_name(call)
+    if terminal in _FORBIDDEN:
+        return _FORBIDDEN[terminal]
+    if dotted.startswith("subprocess.") or terminal == "Popen":
+        return "subprocess"
+    if isinstance(call.func, ast.Name) and call.func.id == "open":
+        return "file I/O"
+    if dotted.startswith("os.") and terminal in _OS_EFFECTS:
+        return "filesystem mutation"
+    receiver = dotted.lower().split(".")[:-1]
+    if any("stub" in part for part in receiver):
+        return "gRPC call"
+    if any(part in ("kube", "_kube") for part in receiver):
+        return "kube API call"
+    return ""
+
+
+class RmwPurity(Rule):
+    rule_id = "RMW-PURITY"
+    description = (
+        "callables passed to CheckpointManager.mutate must not run side "
+        "effects (CDI, partitions, daemons, kube, filesystem, sleep)"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        functions = astutil.collect_functions(module.tree)
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and astutil.call_name(node) == "mutate"):
+                continue
+            mutator = self._mutator_arg(node)
+            if mutator is None:
+                continue
+            target = self._resolve(mutator, functions)
+            if target is None:
+                continue
+            label = getattr(target, "name", "<lambda>")
+            out.extend(
+                self._scan(module, target, functions, chain=[label], visited=set())
+            )
+        return out
+
+    @staticmethod
+    def _mutator_arg(call: ast.Call) -> Optional[ast.expr]:
+        if call.args:
+            return call.args[0]
+        for kw in call.keywords:
+            if kw.arg in ("fn", "func", "mutator"):
+                return kw.value
+        return None
+
+    @staticmethod
+    def _resolve(
+        expr: ast.expr, functions: dict[str, ast.FunctionDef]
+    ) -> Optional[Union[ast.FunctionDef, ast.Lambda]]:
+        if isinstance(expr, ast.Lambda):
+            return expr
+        name = ""
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif astutil.self_attr_target(expr) is not None:
+            name = expr.attr
+        return functions.get(name)
+
+    def _scan(
+        self,
+        module: ParsedModule,
+        fn: Union[ast.FunctionDef, ast.Lambda],
+        functions: dict[str, ast.FunctionDef],
+        chain: list[str],
+        visited: set[str],
+    ) -> list[Finding]:
+        out: list[Finding] = []
+        body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+        for sub in astutil.walk_body_shallow(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            reason = _forbidden_reason(sub)
+            if reason:
+                where = " → ".join(chain)
+                out.append(
+                    self.finding(
+                        module, sub,
+                        f"mutator {where} performs {reason} "
+                        f"('{astutil.dotted_name(sub.func)}') inside the "
+                        "checkpoint RMW — side effects belong in the effects "
+                        "phase (docs/bind-path.md)",
+                    )
+                )
+                continue
+            if len(chain) >= _MAX_DEPTH:
+                continue
+            callee_name = ""
+            if isinstance(sub.func, ast.Name):
+                callee_name = sub.func.id
+            elif astutil.self_attr_target(sub.func) is not None:
+                callee_name = sub.func.attr
+            callee = functions.get(callee_name)
+            if callee is not None and callee_name not in visited:
+                visited.add(callee_name)
+                out.extend(
+                    self._scan(
+                        module, callee, functions, chain + [callee_name], visited
+                    )
+                )
+        return out

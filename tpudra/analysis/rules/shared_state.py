@@ -1,0 +1,184 @@
+"""SHARED-STATE: instance attributes written from a spawned thread AND
+from plain methods, with neither write under a lock.
+
+The Python analog of the Go race detector's most common catch in the
+reference driver: a worker submitted to a pool (or a ``threading.Thread``
+target) assigning ``self.x`` that a lock-free method also assigns.  The
+GIL makes single bytecodes atomic, not read-modify-write sequences — and
+even where it would save you, relying on it is the kind of invariant this
+linter exists to make explicit.
+
+Scope is deliberately narrow to stay precise with no type information:
+
+- only ``self.attr`` targets (locals and item attributes are per-task);
+- only functions reachable as a ``submit(...)`` first argument or a
+  ``Thread(target=...)`` within the class (nested defs and ``self.X``
+  methods resolve; anything else is out of reach); methods a threaded
+  function calls via ``self.X()`` fold into the threaded set transitively
+  — they run on that thread, not the main one.  A method called from both
+  sides folds into the threaded set (the rule errs toward silence, not
+  noise; the race detector analog is best-effort too);
+- ``__init__`` writes are exempt (construction happens before threads);
+- a write inside any in-process-lock ``with`` body counts as guarded, and
+  the rule only fires when BOTH sides are unguarded.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpudra.analysis import astutil
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+
+def _assignment_targets(node: ast.AST) -> list[ast.expr]:
+    if isinstance(node, ast.Assign):
+        return node.targets
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target]
+    return []
+
+
+def _unguarded_self_writes(fn: ast.AST, include_nested: bool) -> dict[str, int]:
+    """attr → first line of a ``self.attr`` write not under a lock with.
+
+    ``include_nested`` is True when scanning a threaded entry function (a
+    closure it defines runs on that same thread) and False when scanning a
+    plain method — a nested def there does not execute when the method
+    does; if it is handed to a pool, the threaded-entry resolution already
+    attributes its writes to the thread side."""
+
+    writes: dict[str, int] = {}
+
+    def visit(node: ast.AST, guarded: bool) -> None:
+        if not include_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            holds = any(
+                (k := astutil.withitem_lock_kind(i)) is not None and k[0] == "inproc"
+                for i in node.items
+            )
+            for child in node.body:
+                visit(child, guarded or holds)
+            return
+        for target in _assignment_targets(node):
+            attr = astutil.self_attr_target(target)
+            if attr is not None and not guarded:
+                writes.setdefault(attr, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            visit(child, guarded)
+
+    for stmt in fn.body:
+        visit(stmt, False)
+    return writes
+
+
+class SharedState(Rule):
+    rule_id = "SHARED-STATE"
+    description = (
+        "self attributes assigned from both threaded functions and "
+        "lock-free methods of the same class without a guard"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(self._check_class(module, node))
+        return out
+
+    def _check_class(self, module: ParsedModule, cls: ast.ClassDef) -> list[Finding]:
+        methods = {
+            n.name: n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        nested: dict[str, ast.FunctionDef] = {}
+        for m in methods.values():
+            for sub in ast.walk(m):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and sub is not m:
+                    nested[sub.name] = sub
+
+        threaded: dict[str, ast.AST] = {}
+        for m in methods.values():
+            for call in astutil.iter_calls(m):
+                target = self._thread_entry(call)
+                if target is None:
+                    continue
+                fn = self._resolve(target, methods, nested)
+                if fn is not None:
+                    threaded[fn.name] = fn
+        if not threaded:
+            return []
+        # A method invoked as self.X() from a threaded function runs on that
+        # same thread — fold it (transitively, to a fixpoint) into the
+        # threaded set rather than mistaking it for a main-thread writer.
+        frontier = list(threaded.values())
+        while frontier:
+            fn = frontier.pop()
+            for call in astutil.iter_calls(fn):
+                attr = astutil.self_attr_target(call.func)
+                callee = methods.get(attr) if attr else None
+                if callee is not None and callee.name not in threaded:
+                    threaded[callee.name] = callee
+                    frontier.append(callee)
+
+        threaded_writes: dict[str, tuple[int, str]] = {}
+        for name, fn in threaded.items():
+            for attr, line in _unguarded_self_writes(fn, include_nested=True).items():
+                threaded_writes.setdefault(attr, (line, name))
+
+        out: list[Finding] = []
+        for name, m in methods.items():
+            if name == "__init__" or name in threaded:
+                continue
+            for attr, line in _unguarded_self_writes(m, include_nested=False).items():
+                if attr not in threaded_writes:
+                    continue
+                tline, tname = threaded_writes[attr]
+                out.append(
+                    Finding(
+                        path=module.path,
+                        line=tline,
+                        col=0,
+                        rule_id=self.rule_id,
+                        message=(
+                            f"self.{attr} assigned in threaded function "
+                            f"'{tname}' (line {tline}) and in method "
+                            f"'{name}' (line {line}) with neither write "
+                            "under a lock — guard both or confine the "
+                            "attribute to one thread"
+                        ),
+                    )
+                )
+        return out
+
+    @staticmethod
+    def _thread_entry(call: ast.Call) -> Optional[ast.expr]:
+        """The function expression a call hands to another thread:
+        ``pool.submit(f, ...)`` or ``Thread(target=f)``."""
+        name = astutil.call_name(call)
+        if name == "submit" and call.args:
+            return call.args[0]
+        if name == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    return kw.value
+        return None
+
+    @staticmethod
+    def _resolve(
+        expr: ast.expr,
+        methods: dict[str, ast.FunctionDef],
+        nested: dict[str, ast.FunctionDef],
+    ) -> Optional[ast.FunctionDef]:
+        if isinstance(expr, ast.Name):
+            return nested.get(expr.id) or methods.get(expr.id)
+        attr = astutil.self_attr_target(expr)
+        if attr is not None:
+            return methods.get(attr)
+        return None

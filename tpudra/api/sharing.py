@@ -125,7 +125,7 @@ class MultiProcessConfig:
             text, ok = format_mebibytes(parse_quantity(self.default_pinned_hbm_limit))
             if not ok:
                 raise SharingValidationError(
-                    f"invalid limit: default value set too low: "
+                    "invalid limit: default value set too low: "
                     f"{self.default_pinned_hbm_limit}"
                 )
             for uuid in uuids:

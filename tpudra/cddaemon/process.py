@@ -40,7 +40,11 @@ class ProcessManager:
             if self.running:
                 return False
             self._expected_stop = False
-            self._proc = subprocess.Popen(self._argv)
+            # The spawn must be atomic with the _proc publication: with the
+            # fork outside the lock, a watchdog tick between spawn and
+            # publish sees "not running" and double-spawns the daemon.
+            # Popen here is fork+exec only (no wait), bounded at ms.
+            self._proc = subprocess.Popen(self._argv)  # tpudra-lint: disable=BLOCK-UNDER-LOCK spawn and _proc publish must be one atomic step vs the watchdog; no wait happens under the lock
             self._started_at = time.monotonic()
             logger.info("started %s (pid %d)", self._argv[0], self._proc.pid)
             return True

@@ -68,7 +68,15 @@ class CDDriver:
             unprepare=self.unprepare_resource_claims,
             resolve_claim=kube_claim_resolver(kube),
         )
-        self.cleanup = CheckpointCleanupManager(kube, self.state)
+        # GC teardown goes through the node lock like the kubelet RPC
+        # paths: with unprepare's label GC running after its checkpoint RMW
+        # (state.py), an unserialized GC unprepare could delete the node
+        # label a concurrent channel prepare just set — the pu.lock held
+        # across the whole operation is what makes the decide-then-remove
+        # sequence atomic against prepares.
+        self.cleanup = CheckpointCleanupManager(
+            kube, self.state, unprepare=self._unprepare_locked
+        )
         # Seeded from live slices so a restart outranks previous publishes.
         self._pool_generation = next_pool_generation(
             kube, config.node_name, config.node_name
@@ -95,6 +103,13 @@ class CDDriver:
         """Fresh Flock per operation — see tpudra/plugin/driver.py: one
         shared instance cannot serve concurrent kubelet RPC threads."""
         return Flock(self._pu_lock_path)
+
+    def _unprepare_locked(self, uid: str) -> None:
+        """Single-claim unprepare under the node lock — the GC's entry
+        point, so its teardown (including the post-RMW label removal)
+        serializes against kubelet prepare/unprepare RPCs."""
+        with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
+            self.state.unprepare(uid)
 
     def prepare_resource_claims(self, claims: list[dict]) -> dict:
         out: dict[str, dict] = {}

@@ -95,6 +95,29 @@ def _opaque_config(claim: dict):
     return decoded[0]
 
 
+def _teardown_targets(claim: PreparedClaim | None) -> tuple[str, set]:
+    """(domain uid, device kinds) a claim's teardown must touch — from the
+    recorded devices for a completed claim, plus the intent stamped at
+    PrepareStarted for one crashed mid-prepare (whose devices were never
+    recorded).  Pure: safe both outside and inside a checkpoint RMW."""
+    if claim is None:
+        return "", set()
+    domain_uid = ""
+    kinds: set = set()
+    if claim.status == PREPARE_STARTED:
+        for g in claim.groups:
+            domain_uid = g.config_state.get("domainUID", domain_uid)
+            ctype = g.config_state.get("configType", "")
+            if ctype == "channel":
+                kinds.add(alloc.TYPE_CHANNEL)
+            elif ctype == "daemon":
+                kinds.add(alloc.TYPE_DAEMON)
+    for dev in claim.all_devices():
+        domain_uid = dev.attributes.get("domainUID", domain_uid)
+        kinds.add(dev.type)
+    return domain_uid, kinds
+
+
 class ComputeDomainDeviceState:
     def __init__(
         self,
@@ -209,62 +232,70 @@ class ComputeDomainDeviceState:
         ]
 
     def unprepare(self, claim_uid: str) -> None:
-        def go(cp: Checkpoint) -> None:
-            claim = cp.prepared_claims.pop(claim_uid, None)
-            self._cdi.delete_claim_spec_file(claim_uid)
-            if claim is None:
-                return
-            domain_uid = ""
-            kinds = set()
-            if claim.status == PREPARE_STARTED:
-                # Rollback branch for a partially prepared claim: the side
-                # effects that can exist before PrepareCompleted are the node
-                # label (channel path) and the per-domain settings dir
-                # (daemon path); devices were never recorded, so read the
-                # intent stamped at PrepareStarted.
-                for g in claim.groups:
-                    domain_uid = g.config_state.get("domainUID", domain_uid)
-                    ctype = g.config_state.get("configType", "")
-                    if ctype == "channel":
-                        kinds.add(alloc.TYPE_CHANNEL)
-                    elif ctype == "daemon":
-                        kinds.add(alloc.TYPE_DAEMON)
-                logger.info(
-                    "rolling back partially prepared CD claim %s (domain %s)",
-                    claim_uid, domain_uid or "<unknown>",
-                )
-            for dev in claim.all_devices():
-                domain_uid = dev.attributes.get("domainUID", domain_uid)
-                kinds.add(dev.type)
-            if not domain_uid:
-                return
-            if alloc.TYPE_DAEMON in kinds:
-                self._cdm.cleanup_daemon_settings(domain_uid)
-            if alloc.TYPE_CHANNEL in kinds:
-                # The node label is owned by the *channel* path
-                # (_apply_channel_config is the only place that sets it), so
-                # only channel claims — completed ones via their devices,
-                # in-flight ones via their intent stamp — keep it alive.
-                # Counting daemon claims here would leak the label: the
-                # daemon unprepare path never removes it.
-                still_used = any(
-                    d.type == alloc.TYPE_CHANNEL
-                    and d.attributes.get("domainUID") == domain_uid
-                    for other in cp.prepared_claims.values()
-                    for d in other.all_devices()
-                ) or any(
-                    g.config_state.get("configType") == "channel"
-                    and g.config_state.get("domainUID") == domain_uid
-                    for other in cp.prepared_claims.values()
-                    for g in other.groups
-                )
-                if not still_used:
-                    try:
-                        self._cdm.remove_node_label(domain_uid)
-                    except Exception as e:  # noqa: BLE001 — label GC is best-effort
-                        logger.warning("removing CD node label: %s", e)
+        """Phased like the TPU plugin's unprepare (docs/bind-path.md): the
+        side effects — CDI spec delete, daemon-settings teardown, node-label
+        GC — run OUTSIDE the checkpoint RMW.  The claim record stays durable
+        until the final pure RMW drops it, so a crash anywhere in the
+        effects re-runs them on retry (all idempotent); the RMW itself only
+        moves checkpoint state (RMW-PURITY)."""
+        # Phase 1: snapshot the record (plain read, no cp.lock held after).
+        claim = self._cp.read().prepared_claims.get(claim_uid)
+        domain_uid, kinds = _teardown_targets(claim)
+        if claim is not None and claim.status == PREPARE_STARTED:
+            logger.info(
+                "rolling back partially prepared CD claim %s (domain %s)",
+                claim_uid, domain_uid or "<unknown>",
+            )
 
-        self._cp.mutate(go)
+        # Phase 2: effects, while the durable record still marks the claim.
+        self._cdi.delete_claim_spec_file(claim_uid)
+        if domain_uid and alloc.TYPE_DAEMON in kinds:
+            self._cdm.cleanup_daemon_settings(domain_uid)
+
+        # Phase 3: ONE pure RMW — drop the record and decide the label's
+        # fate from the post-drop view.  The cp.lock makes the scan
+        # consistent; what makes the decide-then-remove *sequence* safe
+        # against a concurrent channel prepare re-labeling the node between
+        # this RMW and the removal below is the CD driver's node pu.lock,
+        # held across the whole prepare/unprepare on every path (kubelet
+        # RPCs and the GC's _unprepare_locked alike).
+        drop_label = False
+
+        def drop(cp: Checkpoint) -> None:
+            nonlocal drop_label
+            cp.prepared_claims.pop(claim_uid, None)
+            if not domain_uid or alloc.TYPE_CHANNEL not in kinds:
+                return
+            # The node label is owned by the *channel* path
+            # (_apply_channel_config is the only place that sets it), so
+            # only channel claims — completed ones via their devices,
+            # in-flight ones via their intent stamp — keep it alive.
+            # Counting daemon claims here would leak the label: the
+            # daemon unprepare path never removes it.
+            still_used = any(
+                d.type == alloc.TYPE_CHANNEL
+                and d.attributes.get("domainUID") == domain_uid
+                for other in cp.prepared_claims.values()
+                for d in other.all_devices()
+            ) or any(
+                g.config_state.get("configType") == "channel"
+                and g.config_state.get("domainUID") == domain_uid
+                for other in cp.prepared_claims.values()
+                for g in other.groups
+            )
+            drop_label = not still_used
+
+        self._cp.mutate(drop)
+
+        # Label GC after the drop, best-effort as ever: a crash in the gap
+        # leaks the label only until the controller's periodic
+        # sweep_stale_labels (controller/node.py) or the CD's own deletion
+        # reconciles it.
+        if drop_label:
+            try:
+                self._cdm.remove_node_label(domain_uid)
+            except Exception as e:  # noqa: BLE001 — label GC is best-effort
+                logger.warning("removing CD node label: %s", e)
 
     def prepared_claim_uids(self) -> dict[str, tuple[str, str, str]]:
         cp = self._cp.read()
@@ -459,7 +490,7 @@ class ComputeDomainDeviceState:
         annotations.discard("")
         if len(annotations) > 1:
             raise PermanentError(
-                f"consuming pods of claim "
+                "consuming pods of claim "
                 f"{claim.get('metadata', {}).get('name')} carry conflicting "
                 f"{WORKER_HOSTNAMES_ANNOTATION} annotations "
                 f"{sorted(annotations)} — the grant env is shared, so all "
@@ -478,7 +509,7 @@ class ComputeDomainDeviceState:
         for pod in pods:
             if not pod.get("spec", {}).get("hostNetwork"):
                 raise PermanentError(
-                    f"multi-host ComputeDomain channel claim consumed by "
+                    "multi-host ComputeDomain channel claim consumed by "
                     f"pod-networked pod {namespace}/{pod['metadata'].get('name')}: "
                     "TPU_WORKER_HOSTNAMES names the host-networked domain daemons "
                     "(node IPs), but libtpu's inter-worker ports bind inside the "

@@ -17,7 +17,6 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
-import time
 from typing import Iterator
 
 from tpudra.devicelib.base import (

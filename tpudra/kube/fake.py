@@ -247,7 +247,7 @@ class FakeKube:
             if rv and rv != current["metadata"]["resourceVersion"]:
                 raise errors.Conflict(
                     f"operation cannot be fulfilled on {gvr.resource} {name}: "
-                    f"object has been modified"
+                    "object has been modified"
                 )
             if status_only:
                 updated = copy.deepcopy(current)

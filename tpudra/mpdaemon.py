@@ -28,7 +28,6 @@ import logging
 import os
 import socket
 import socketserver
-import sys
 import threading
 
 logger = logging.getLogger(__name__)

@@ -315,7 +315,7 @@ class CheckpointManager:
             return cp, bool(corrupt)
         if corrupt:
             raise ChecksumMismatch(
-                f"checkpoint has no version with a valid checksum "
+                "checkpoint has no version with a valid checksum "
                 f"(corrupt: {', '.join(corrupt)})"
             )
         raise CheckpointError("checkpoint has no readable version")

@@ -35,11 +35,12 @@ class CheckpointCleanupManager:
         self._kube = kube
         self._state = state
         self._period = period
-        # The plugin driver passes its per-claim-uid-serialized unprepare so
-        # a GC teardown can't interleave with a kubelet retry of the same
-        # uid at the effects phase (state.unprepare alone no longer holds a
-        # lock across effects).  Callers whose state still tears down inside
-        # one atomic RMW (cdplugin) use it directly.
+        # Both drivers pass a serialized unprepare: the TPU plugin its
+        # per-claim-uid-locked one (a GC teardown must not interleave with
+        # a kubelet retry of the same uid at the effects phase), the
+        # cdplugin its node-locked one (the post-RMW label removal must not
+        # interleave with a concurrent channel prepare's labeling).  The
+        # bare state.unprepare default exists for tests and simple callers.
         self._unprepare = unprepare if unprepare is not None else state.unprepare
         self._thread: threading.Thread | None = None
 

@@ -30,7 +30,7 @@ from typing import Callable, Optional
 
 from tpudra import TPU_DRIVER_NAME, featuregates, metrics
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
-from tpudra.flock import Flock, FlockTimeout
+from tpudra.flock import Flock
 from tpudra.kube.apply import next_pool_generation, publish_slices
 from tpudra.kube.client import KubeAPI
 from tpudra.plugin import allocatable as alloc

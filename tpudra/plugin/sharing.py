@@ -132,7 +132,7 @@ class MultiProcessControlDaemon:
         return ContainerEdits(
             env=[
                 f"TPUDRA_MP_PIPE_DIRECTORY=/var/run/tpudra/mp/{self.claim_uid}",
-                f"TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE="
+                "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE="
                 f"{self.config.default_active_tensorcore_percentage or 100}",
             ],
             mounts=[

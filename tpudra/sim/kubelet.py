@@ -35,7 +35,6 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, TPU_DRIVER_NAME
 from tpudra.kube import gvr
 from tpudra.kube.errors import ApiError, Conflict, NotFound
 from tpudra.sim.sched import (
@@ -899,7 +898,10 @@ class ClusterSim:
             try:
                 cli.close()
             except Exception:  # noqa: BLE001
-                pass
+                # Teardown must visit every client even when one close
+                # fails, but a failure is still worth a line: a wedged
+                # channel here has masked real plugin shutdown bugs.
+                logger.warning("closing DRA client failed", exc_info=True)
 
 
 def parse_config(path: str) -> tuple[str, list[NodeConfig], dict]:

@@ -176,10 +176,10 @@ class ClaimEnv:
                     # Crash loudly WITH the diagnosis: a silent skip here
                     # strands every peer in a 300 s connect timeout.
                     raise RuntimeError(
-                        f"host 0 could not register its coordinator in "
+                        "host 0 could not register its coordinator in "
                         f"{self.cd_dir}: {e} — peers dialing "
                         f"{self.coordinator} will hang; check the domain "
-                        f"dir mount and its permissions"
+                        "dir mount and its permissions"
                     ) from e
             elif _is_daemon_dns_name(self.coordinator):
                 # Peers will dial the daemon's proxy, which forwards to the

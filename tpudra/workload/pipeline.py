@@ -84,7 +84,7 @@ def pipelined_backbone(
         if dp_axis not in mesh.shape:
             raise ValueError(
                 f"mesh {dict(mesh.shape)} has no {dp_axis!r} axis; pass "
-                f"dp_axis=None to run without data parallelism"
+                "dp_axis=None to run without data parallelism"
             )
         if (B // M) % mesh.shape[dp_axis]:
             raise ValueError(
