@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint tier1 bats bats-real bench bench-bind image helm-render clean
+.PHONY: all native test test-fast lint tier1 bats bats-real bench bench-bind bench-apiserver image helm-render clean
 
 all: native test
 
@@ -65,6 +65,17 @@ bench: native
 # artifact for bind-path changes.
 bench-bind:
 	set -o pipefail; python bench.py --bind-only | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# The apiserver-RTT A/B in one command: bind sections plus the batch bind
+# at an injected 10 ms per-request RTT, watch-cached claim resolution
+# interleaved against per-claim GETs (docs/bind-path.md "Claim resolution
+# and slice publication").
+APISERVER_LATENCY_MS ?= 10
+bench-apiserver:
+	set -o pipefail; python bench.py --bind-only \
+	  --apiserver-latency-ms $(APISERVER_LATENCY_MS) \
+	  | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 image:

@@ -38,6 +38,14 @@ Knobs (for A/B runs on the bind path):
   --bind-only              run ONLY the CPU-only bind sections (headline +
                            multi-claim batch) and print their line — the
                            before/after artifact for bind-path PRs
+  --apiserver-latency-ms N with --bind-only: additionally run the
+                           apiserver-RTT A/B — the batch bind measured at
+                           an injected N ms per-request latency
+                           (FakeKube.set_latency), interleaving a
+                           watch-cached arm against a per-claim-GET arm
+                           (DriverConfig.claim_cache off), so the cost the
+                           claim cache removes is measured, not argued
+                           (`make bench-apiserver`)
 """
 
 from __future__ import annotations
@@ -83,11 +91,18 @@ STEP_ITERS = 10
 
 
 @contextlib.contextmanager
-def _bench_driver(generation: str = "v5p", num_chips: int = None):
+def _bench_driver(
+    generation: str = "v5p",
+    num_chips: int = None,
+    latency_ms: float = 0.0,
+    claim_cache: bool = True,
+):
     """One bind-bench harness: mock-device driver + kubelet-side DRA gRPC
-    client on a scratch dir.  Yields (kube, client) — shared by the
+    client on a scratch dir.  Yields (kube, client, driver) — shared by the
     single-claim headline and the multi-claim batch sections so both always
-    benchmark the identical driver configuration."""
+    benchmark the identical driver configuration.  ``latency_ms`` injects
+    per-request apiserver RTT (FakeKube.set_latency); ``claim_cache=False``
+    is the per-claim-GET arm of the apiserver A/B."""
     from tpudra.devicelib import MockTopologyConfig
     from tpudra.devicelib.mock import MockDeviceLib
     from tpudra.kube.fake import FakeKube
@@ -102,12 +117,17 @@ def _bench_driver(generation: str = "v5p", num_chips: int = None):
         )
         lib = MockDeviceLib(config=topo, state_file=f"{tmp}/hw.json")
         kube = FakeKube()
+        if latency_ms > 0:
+            kube.set_latency(latency_ms / 1000.0)
         driver = Driver(
             DriverConfig(
-                node_name="bench-node",
+                # "node-a": the node-scoped claim-cache filter matches the
+                # pool mk_claim stamps on allocation results.
+                node_name="node-a",
                 plugin_dir=f"{tmp}/plugin",
                 registry_dir=f"{tmp}/registry",
                 cdi_root=f"{tmp}/cdi",
+                claim_cache=claim_cache,
             ),
             kube,
             lib,
@@ -115,7 +135,15 @@ def _bench_driver(generation: str = "v5p", num_chips: int = None):
         driver.start()
         client = DRAClient(driver.sockets.dra_socket_path)
         try:
-            yield kube, client
+            # Steady state is what the section measures: resolution from a
+            # synced cache, not initial-LIST fallback noise.  A sync
+            # failure must be loud — a silently-degraded cached arm would
+            # print a false ~0 improvement as the canonical A/B artifact.
+            # (Inside the try so the started driver is torn down before
+            # the scratch dir is deleted.)
+            if claim_cache and not driver.wait_for_claim_cache(10):
+                raise RuntimeError("claim informer failed to sync in 10s")
+            yield kube, client, driver
         finally:
             client.close()
             driver.stop()
@@ -127,7 +155,7 @@ def bench_bind_p50(iters: int = None, warmup: int = None) -> float:
     from tests.test_device_state import mk_claim
     from tpudra.kube import gvr
 
-    with _bench_driver() as (kube, client):
+    with _bench_driver() as (kube, client, _driver):
         samples_ms: list[float] = []
         for i in range(iters + warmup):
             uid = f"bench-{i}"
@@ -162,7 +190,9 @@ def bench_bind_batch(
     from tpudra.kube import gvr
 
     # v5e: 8 chips per host, so an 8-claim batch gets disjoint chips.
-    with _bench_driver(generation="v5e", num_chips=n_claims) as (kube, client):
+    with _bench_driver(generation="v5e", num_chips=n_claims) as (
+        kube, client, _driver,
+    ):
         samples_ms: list[float] = []
         for i in range(iters + warmup):
             claims = []
@@ -197,6 +227,80 @@ def bench_bind_batch(
             "batch_bind_p50_ms": round(p50, 3),
             "per_claim_p50_ms": round(p50 / n_claims, 3),
         }
+
+
+def bench_bind_apiserver_ab(
+    latency_ms: float,
+    iters: int = None,
+    warmup: int = None,
+    n_claims: int = BATCH_CLAIMS,
+) -> dict:
+    """Apiserver-RTT A/B for the batch bind: the same batch-of-N section
+    run against a FakeKube that charges ``latency_ms`` per request, once
+    with the watch-backed claim cache (the production path) and once with
+    per-claim GETs (``claim_cache=False``, the pre-cache path).  The two
+    arms run INTERLEAVED — arm A iteration i, then arm B iteration i — so
+    host-side noise lands on both arms equally instead of becoming a fake
+    delta.  The uncached arm pays ~N serialized GET RTTs per bind (the
+    fake charges RTT per request under its table lock, which is what a
+    QPS-limited production client effectively pays); the cached arm's
+    resolution is apiserver-free, so the gap is the cost the cache
+    removes."""
+    iters = max(1, (ITERS if iters is None else iters) // 4)
+    warmup = max(1, (WARMUP if warmup is None else warmup) // 2)
+    from tests.test_device_state import mk_claim
+    from tpudra.kube import gvr
+
+    def one_batch(kube, client, driver, tag: str, i: int) -> float:
+        claims = []
+        for c in range(n_claims):
+            uid = f"ab-{tag}-{i}-{c}"
+            claim = mk_claim(uid, [f"tpu-{c}"], name=uid)
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            claims.append(claim)
+        if driver.claim_informer is not None:
+            # Measure steady-state resolution, not watch-delivery latency:
+            # kubelet prepares long after the claim exists.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and any(
+                driver.claim_informer.get(c["metadata"]["name"], "default") is None
+                for c in claims
+            ):
+                time.sleep(0.001)
+        t0 = time.perf_counter()
+        resp = client.prepare(claims)
+        dt = (time.perf_counter() - t0) * 1000.0
+        for claim in claims:
+            uid = claim["metadata"]["uid"]
+            if "error" in resp["claims"][uid]:
+                raise RuntimeError(f"prepare failed: {resp['claims'][uid]['error']}")
+        client.unprepare(claims)
+        for claim in claims:
+            kube.delete(gvr.RESOURCE_CLAIMS, claim["metadata"]["name"], "default")
+        return dt
+
+    samples: dict[str, list[float]] = {"cached": [], "uncached": []}
+    with _bench_driver(
+        "v5e", n_claims, latency_ms=latency_ms, claim_cache=True
+    ) as cached_arm, _bench_driver(
+        "v5e", n_claims, latency_ms=latency_ms, claim_cache=False
+    ) as uncached_arm:
+        arms = {"cached": cached_arm, "uncached": uncached_arm}
+        for i in range(iters + warmup):
+            for tag, (kube, client, driver) in arms.items():
+                dt = one_batch(kube, client, driver, tag, i)
+                if i >= warmup:
+                    samples[tag].append(dt)
+    cached_p50 = statistics.median(samples["cached"])
+    uncached_p50 = statistics.median(samples["uncached"])
+    return {
+        "latency_ms": latency_ms,
+        "n_claims": n_claims,
+        "iters": iters,
+        "cached_batch_p50_ms": round(cached_p50, 3),
+        "uncached_batch_p50_ms": round(uncached_p50, 3),
+        "improvement_ms": round(uncached_p50 - cached_p50, 3),
+    }
 
 
 def bench_bind_partition_p50() -> dict:
@@ -1212,6 +1316,7 @@ def main(argv=None) -> None:
     # falling through to the multi-minute full bench.
     iters = _pop_int_flag(argv, "--iters", minimum=1)
     warmup = _pop_int_flag(argv, "--warmup")
+    apiserver_latency_ms = _pop_int_flag(argv, "--apiserver-latency-ms")
     if len(argv) == 2 and argv[0] == "--section":
         print(json.dumps(SECTIONS[argv[1]]()))
         return
@@ -1220,6 +1325,8 @@ def main(argv=None) -> None:
     if "--bind-only" in argv:
         # The A/B artifact for bind-path PRs: headline single-claim p50 +
         # the multi-claim batch section, nothing that needs a device.
+        # --apiserver-latency-ms adds the remote-half A/B: batch bind at an
+        # injected RTT, watch-cached vs per-claim-GET resolution.
         p50 = bench_bind_p50(iters=iters, warmup=warmup)
         line = {
             "metric": "resourceclaim_bind_p50_latency",
@@ -1229,6 +1336,10 @@ def main(argv=None) -> None:
             "iters": iters if iters is not None else ITERS,
             "batch": bench_bind_batch(iters=iters, warmup=warmup),
         }
+        if apiserver_latency_ms is not None:
+            line["apiserver"] = bench_bind_apiserver_ab(
+                float(apiserver_latency_ms), iters=iters, warmup=warmup
+            )
         print(json.dumps(line))
         return
 

@@ -1,0 +1,330 @@
+"""Watch-backed claim resolution (plugin/claimresolver.py): cache hits skip
+the apiserver GET, every unsafe case falls back to a live read-through GET,
+and concurrent misses collapse to one GET via singleflight.  The end-to-end
+criterion — a churn run's apiserver traffic drops to ~watch-only — is
+asserted through the real DRA gRPC stack at the bottom."""
+
+import threading
+import time
+
+import pytest
+
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.kube.informer import Informer
+from tpudra.plugin.claimresolver import CachedClaimResolver, Singleflight
+
+from tests.test_device_state import mk_claim
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class GetCounter:
+    """FakeKube reactor counting ResourceClaim GETs."""
+
+    def __init__(self, kube: FakeKube):
+        self.count = 0
+        kube.react("get", gvr.RESOURCE_CLAIMS, self._hit)
+
+    def _hit(self, verb, g, obj):
+        self.count += 1
+
+
+@pytest.fixture
+def kube():
+    return FakeKube()
+
+
+def mk_resolver(kube, start=True):
+    informer = Informer(kube, gvr.RESOURCE_CLAIMS)
+    stop = threading.Event()
+    if start:
+        informer.start(stop)
+        assert informer.wait_for_sync(5)
+    return CachedClaimResolver(kube, informer), informer, stop
+
+
+class TestCachedResolver:
+    def test_cache_hit_skips_get(self, kube):
+        created = kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-1", ["tpu-0"], name="c1"), "default"
+        )
+        resolver, informer, stop = mk_resolver(kube)
+        assert wait_for(lambda: informer.get("c1", "default") is not None)
+        gets = GetCounter(kube)
+        claim = resolver("default", "c1", "u-1")
+        assert claim["metadata"]["uid"] == "u-1"
+        assert claim["status"]["allocation"]["devices"]["results"]
+        assert gets.count == 0, "a synced cache hit must not touch the apiserver"
+        # The returned object is a private copy, never the store object.
+        claim["metadata"]["uid"] = "mutated"
+        assert informer.get("c1", "default")["metadata"]["uid"] == "u-1"
+        assert created["metadata"]["uid"] == "u-1"
+        stop.set()
+
+    def test_presync_falls_back_to_get(self, kube):
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-1", ["tpu-0"], name="c1"), "default"
+        )
+        resolver, informer, _ = mk_resolver(kube, start=False)
+        assert not informer.has_synced
+        gets = GetCounter(kube)
+        claim = resolver("default", "c1", "u-1")
+        assert claim["metadata"]["uid"] == "u-1"
+        assert gets.count == 1, "pre-sync resolution must read through"
+
+    def test_miss_falls_back_to_get(self, kube):
+        resolver, informer, stop = mk_resolver(kube)
+        gets = GetCounter(kube)
+        # Created after sync but resolve before the watch delivers it:
+        # freeze the cache by stopping the informer first.
+        stop.set()
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-2", ["tpu-1"], name="c2"), "default"
+        )
+        claim = resolver("default", "c2", "u-2")
+        assert claim["metadata"]["uid"] == "u-2"
+        assert gets.count == 1, "a cache miss must read through"
+
+    def test_stale_cached_uid_rechecks_live_object(self, kube):
+        """Deleted-and-recreated claim where the watch hasn't caught up:
+        the cached object's uid mismatches, but the LIVE object matches —
+        resolution must succeed via a fallback GET, not error on the
+        cached copy."""
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-old", ["tpu-0"], name="flappy"), "default"
+        )
+        resolver, informer, stop = mk_resolver(kube)
+        assert wait_for(lambda: informer.get("flappy", "default") is not None)
+        stop.set()  # freeze the cache: it keeps the u-old copy forever
+        time.sleep(0.05)
+        kube.delete(gvr.RESOURCE_CLAIMS, "flappy", "default")
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-new", ["tpu-0"], name="flappy"), "default"
+        )
+        assert informer.get("flappy", "default")["metadata"]["uid"] == "u-old"
+
+        gets = GetCounter(kube)
+        claim = resolver("default", "flappy", "u-new")
+        assert claim["metadata"]["uid"] == "u-new"
+        assert gets.count == 1
+
+        # A uid matching NEITHER cache nor live is a real mismatch — and it
+        # must be grounded in the live GET (count moves again).
+        with pytest.raises(ValueError, match="UID mismatch"):
+            resolver("default", "flappy", "u-ghost")
+        assert gets.count == 2
+
+    def test_unallocated_cached_copy_falls_back(self, kube):
+        """A cached copy with no allocation is behind the scheduler's
+        status write — kubelet only prepares allocated claims, so the
+        resolver must read through rather than hand prepare a claim it
+        will reject."""
+        bare = {"metadata": {"uid": "u-3", "namespace": "default", "name": "c3"}}
+        kube.create(gvr.RESOURCE_CLAIMS, bare, "default")
+        resolver, informer, stop = mk_resolver(kube)
+        assert wait_for(lambda: informer.get("c3", "default") is not None)
+        stop.set()  # freeze: the cache keeps the unallocated copy
+        time.sleep(0.05)
+        live = kube.get(gvr.RESOURCE_CLAIMS, "c3", "default")
+        live["status"] = mk_claim("u-3", ["tpu-0"], name="c3")["status"]
+        kube.update_status(gvr.RESOURCE_CLAIMS, live, "default")
+
+        gets = GetCounter(kube)
+        claim = resolver("default", "c3", "u-3")
+        assert gets.count == 1
+        assert claim["status"]["allocation"]["devices"]["results"]
+
+    def test_singleflight_collapses_concurrent_misses(self, kube):
+        """Eight resolver threads missing on the same claim issue ONE GET:
+        the leader's GET blocks (reactor gate) until every follower is
+        parked on the singleflight, then all eight return the one result."""
+        from prometheus_client import REGISTRY
+
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-sf", ["tpu-0"], name="hot"), "default"
+        )
+        resolver, informer, _ = mk_resolver(kube, start=False)  # pre-sync: all miss
+        gets = GetCounter(kube)
+        release = threading.Event()
+        kube.react(
+            "get", gvr.RESOURCE_CLAIMS, lambda v, g, o: release.wait(5)
+        )
+
+        results, errors = [], []
+
+        def one():
+            try:
+                results.append(resolver("default", "hot", "u-sf"))
+            except Exception as e:  # noqa: BLE001 — surfaced via the assert
+                errors.append(e)
+
+        collapsed_before = (
+            REGISTRY.get_sample_value("tpudra_claim_singleflight_collapsed_total")
+            or 0.0
+        )
+        threads = [threading.Thread(target=one) for _ in range(8)]
+        for t in threads:
+            t.start()
+        # Deterministic: release the leader's GET only once all seven
+        # followers are parked on the in-flight call.
+        key = ("default", "hot", "u-sf")
+        assert wait_for(lambda: resolver._singleflight.waiting(key) == 7)
+        release.set()
+        for t in threads:
+            t.join(5)
+        assert not errors, errors
+        assert len(results) == 8
+        assert gets.count == 1, "concurrent misses must collapse to one GET"
+        assert {c["metadata"]["uid"] for c in results} == {"u-sf"}
+        # Followers get private copies, not eight views of one dict.
+        assert len({id(c) for c in results}) == 8
+        collapsed_after = (
+            REGISTRY.get_sample_value("tpudra_claim_singleflight_collapsed_total")
+            or 0.0
+        )
+        assert collapsed_after - collapsed_before == 7
+
+    def test_singleflight_leader_error_propagates_to_waiters(self):
+        sf = Singleflight()
+        gate = threading.Event()
+        calls = []
+
+        def boom():
+            calls.append(1)
+            gate.wait(5)
+            raise RuntimeError("apiserver said no")
+
+        errors = []
+
+        def leader():
+            try:
+                sf.do(("k",), boom)
+            except RuntimeError as e:
+                errors.append(e)
+
+        def follower():
+            try:
+                sf.do(("k",), lambda: {"never": "called"})
+            except RuntimeError as e:
+                errors.append(e)
+
+        tl = threading.Thread(target=leader)
+        tl.start()
+        assert wait_for(lambda: len(calls) == 1)
+        tf = threading.Thread(target=follower)
+        tf.start()
+        assert wait_for(lambda: sf.waiting(("k",)) == 1)
+        gate.set()
+        tl.join(5)
+        tf.join(5)
+        assert len(errors) == 2
+        assert all("apiserver said no" in str(e) for e in errors)
+
+
+class TestSteadyStateTraffic:
+    def test_churn_run_is_watch_only(self, tmp_path):
+        """The acceptance bar: prepare+unprepare churn over 100 claims
+        through the real DRA gRPC stack issues fallback GETs for < 5% of
+        resolutions once the informer has synced."""
+        from tpudra.kube.fake import FakeKube
+        from tpudra.plugin.grpcserver import DRAClient
+
+        from tests.test_driver import mk_driver
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            assert d.wait_for_claim_cache(10)
+            gets = GetCounter(kube)
+            client = DRAClient(d.sockets.dra_socket_path)
+            informer = d.claim_informer
+            for i in range(100):
+                uid = f"churn-{i}"
+                claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                # Steady state means the watch has delivered the claim; the
+                # criterion is about resolution traffic, not watch latency.
+                assert wait_for(lambda: informer.get(uid, "default") is not None)
+                resp = client.prepare([claim])
+                assert "error" not in resp["claims"][uid], resp
+                client.unprepare([claim])
+                kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            client.close()
+            assert gets.count < 5, (
+                f"{gets.count} fallback GETs over 100 resolutions — the "
+                "bind path is supposed to be watch-only at steady state"
+            )
+        finally:
+            d.stop()
+
+
+class TestWatchHealthGate:
+    def test_broken_watch_falls_back_to_get(self, kube):
+        """While the informer's watch is down (lag can grow to the relist
+        backoff), a synced cache must NOT serve hits — a deallocate→
+        reallocate of the same uid could hide in that window."""
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-w", ["tpu-0"], name="cw"), "default"
+        )
+        resolver, informer, stop = mk_resolver(kube)
+        assert wait_for(lambda: informer.get("cw", "default") is not None)
+        gets = GetCounter(kube)
+        assert resolver("default", "cw", "u-w")  # healthy: cache hit
+        assert gets.count == 0
+        informer._watch_ok = False  # what _run sets on a watch failure
+        assert resolver("default", "cw", "u-w")["metadata"]["uid"] == "u-w"
+        assert gets.count == 1, "an unhealthy watch must read through"
+        informer._watch_ok = True
+        assert resolver("default", "cw", "u-w")
+        assert gets.count == 1, "recovered watch serves from cache again"
+        stop.set()
+
+    def test_watch_failure_flips_health_and_relist_recovers(self, kube):
+        """End-to-end health transitions: a watch stream that dies mid-cycle
+        marks the informer unhealthy; the automatic relist restores it."""
+        import threading as _threading
+
+        class BreakingWatch:
+            """KubeAPI proxy whose watch raises once when armed."""
+
+            def __init__(self, api):
+                self._api = api
+                self.armed = _threading.Event()
+
+            def __getattr__(self, name):
+                return getattr(self._api, name)
+
+            def watch(self, *args, **kwargs):
+                for event in self._api.watch(*args, **kwargs):
+                    if self.armed.is_set():
+                        self.armed.clear()
+                        raise ConnectionError("watch stream dropped")
+                    yield event
+
+        api = BreakingWatch(kube)
+        informer = Informer(api, gvr.RESOURCE_CLAIMS)
+        stop = threading.Event()
+        informer.start(stop)
+        assert informer.wait_for_sync(5)
+        assert wait_for(lambda: informer.watch_healthy)
+        api.armed.set()
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-b", ["tpu-0"], name="boom"), "default"
+        )
+        assert wait_for(lambda: not informer.watch_healthy), (
+            "a dead watch must mark the informer unhealthy"
+        )
+        # The informer relists on its backoff and comes back healthy with
+        # the event it missed.
+        assert wait_for(lambda: informer.watch_healthy, timeout=10)
+        assert informer.get("boom", "default") is not None
+        stop.set()

@@ -156,7 +156,16 @@ class TestDriver:
         assert len(items) == 1
         assert items[0]["spec"]["nodeName"] == "node-a"
         gen0 = items[0]["spec"]["pool"]["generation"]
-        d.publish_resources()  # idempotent update, generation bumps
+        rv0 = items[0]["metadata"]["resourceVersion"]
+        # Identical rebuild: the content-hash gate skips the API write
+        # entirely (no generation bump, no resourceVersion churn).
+        d.publish_resources()
+        items = kube.list(gvr.RESOURCE_SLICES)["items"]
+        assert len(items) == 1
+        assert items[0]["spec"]["pool"]["generation"] == gen0
+        assert items[0]["metadata"]["resourceVersion"] == rv0
+        # Forced reassertion writes through the gate and bumps generation.
+        d.publish_resources(force=True)
         items = kube.list(gvr.RESOURCE_SLICES)["items"]
         assert len(items) == 1
         assert items[0]["spec"]["pool"]["generation"] == gen0 + 1
@@ -399,8 +408,20 @@ class TestDriver:
                     break
                 time.sleep(0.01)
             assert "tpu-0" in d.unhealthy_devices()
-            items = kube.list(gvr.RESOURCE_SLICES)["items"]
-            names = {dev["name"] for s in items for dev in s["spec"]["devices"]}
+
+            # Publication is async now (health events signal the publisher
+            # thread, which debounces): wait for the slice set to converge.
+            def advertised():
+                items = kube.list(gvr.RESOURCE_SLICES)["items"]
+                return {
+                    dev["name"] for s in items for dev in s["spec"]["devices"]
+                }
+
+            while time.monotonic() < deadline:
+                if "tpu-0" not in advertised():
+                    break
+                time.sleep(0.01)
+            names = advertised()
             assert "tpu-0" not in names and "tpu-1" in names
         finally:
             d.stop()
@@ -570,3 +591,196 @@ class TestCDISpecContract:
             d.unprepare_resource_claims([{"uid": "cdi-1"}])
         finally:
             d.stop()
+
+
+# -- Async slice publication (publisher thread, debounce, content hash) ------
+
+
+class SliceWriteCounter:
+    """Counts actual ResourceSlice API writes (create + update)."""
+
+    def __init__(self, kube):
+        self.count = 0
+        kube.react("create", gvr.RESOURCE_SLICES, self._hit)
+        kube.react("update", gvr.RESOURCE_SLICES, self._hit)
+
+    def _hit(self, verb, g, obj):
+        self.count += 1
+
+
+class TestAsyncPublication:
+    def test_health_burst_coalesces_to_one_write(self, tmp_path):
+        """A burst of K health events inside the debounce window costs ONE
+        slice write: the events flip state synchronously, the publisher
+        thread rebuilds once."""
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            assert d.drain_publishes(5)
+            writes = SliceWriteCounter(kube)
+            # Three distinct chips go unhealthy back-to-back (chip 3 stays,
+            # so the pool never empties).
+            for idx in range(3):
+                chip = d.state._chips_by_index[idx]
+                d._handle_health_event(
+                    HealthEvent(
+                        kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip.uuid
+                    )
+                )
+            assert d.unhealthy_devices() >= {"tpu-0", "tpu-1", "tpu-2"}
+            assert d.drain_publishes(5)
+            assert writes.count == 1, (
+                f"{writes.count} writes for a 3-event burst — the debounce "
+                "window exists to coalesce exactly this"
+            )
+            items = kube.list(gvr.RESOURCE_SLICES)["items"]
+            names = {dev["name"] for s in items for dev in s["spec"]["devices"]}
+            assert names == {"tpu-3"}
+        finally:
+            d.stop()
+
+    def test_identical_rebuild_writes_nothing(self, tmp_path):
+        """A publish signal that rebuilds identical content is stopped by
+        the content-hash gate: zero API writes, the no-op counter moves."""
+        from prometheus_client import REGISTRY
+
+        def noop_count():
+            return REGISTRY.get_sample_value(
+                "tpudra_resourceslice_publish_noop_total",
+                {"driver": TPU_DRIVER_NAME},
+            ) or 0.0
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            assert d.drain_publishes(5)
+            writes = SliceWriteCounter(kube)
+            before = noop_count()
+            d._request_publish()  # nothing changed since start()'s publish
+            assert d.drain_publishes(5)
+            assert writes.count == 0
+            assert noop_count() == before + 1
+        finally:
+            d.stop()
+
+    def test_rpc_threads_only_signal(self, tmp_path):
+        """The bind path itself must not write slices: a plain chip
+        prepare (no withheld-set change) issues zero slice writes, in
+        contrast to a vfio-style visibility flip which publishes (covered
+        by test_vfio_prepare_withholds_sibling_chip)."""
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.start()
+        try:
+            assert d.drain_publishes(5)
+            writes = SliceWriteCounter(kube)
+            claim = mk_claim("sig-1", ["tpu-0"], name="sig-1")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            resp = d.prepare_resource_claims([claim])
+            assert "error" not in resp["claims"]["sig-1"]
+            d.unprepare_resource_claims([{"uid": "sig-1"}])
+            assert d.drain_publishes(5)
+            assert writes.count == 0
+        finally:
+            d.stop()
+
+    def test_aged_slices_reasserted_through_noop_gate(self, tmp_path):
+        """The hash gate compares against what the driver last WROTE, not
+        live apiserver state — slices lost out-of-band must heal once the
+        last write is older than publish_reassert_s, without any content
+        change."""
+        kube = FakeKube()
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(generation="v5p"),
+            state_file=str(tmp_path / "hw.json"),
+        )
+        d = Driver(
+            DriverConfig(
+                node_name="node-a",
+                plugin_dir=str(tmp_path / "plugin"),
+                registry_dir=str(tmp_path / "registry"),
+                cdi_root=str(tmp_path / "cdi"),
+                publish_reassert_s=0.2,
+            ),
+            kube,
+            lib,
+        )
+        d.start()
+        try:
+            assert d.drain_publishes(5)
+            assert kube.list(gvr.RESOURCE_SLICES)["items"]
+            # Out-of-band loss: a stray kubectl delete / etcd restore.
+            for s in kube.list(gvr.RESOURCE_SLICES)["items"]:
+                kube.delete(gvr.RESOURCE_SLICES, s["metadata"]["name"])
+            assert not kube.list(gvr.RESOURCE_SLICES)["items"]
+            # No content change, no signal needed: the publisher's idle
+            # wakeup re-asserts once the write is older than the interval.
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if kube.list(gvr.RESOURCE_SLICES)["items"]:
+                    break
+                time.sleep(0.05)
+            assert kube.list(gvr.RESOURCE_SLICES)["items"], (
+                "aged published state must be re-asserted, not hidden "
+                "behind the no-op gate forever"
+            )
+        finally:
+            d.stop()
+
+    def test_failed_publish_retries_without_dropping_burst(self, tmp_path):
+        """A transient apiserver failure during the coalesced publish must
+        not absorb the burst's signals: the publisher keeps them pending
+        and retries until the write lands."""
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        failures = [2]  # fail the first two slice writes
+
+        def flaky(verb, g, obj):
+            if failures[0] > 0:
+                failures[0] -= 1
+                raise RuntimeError("injected apiserver blip")
+
+        d.start()
+        try:
+            assert d.drain_publishes(5)
+            kube.react("update", gvr.RESOURCE_SLICES, flaky)
+            kube.react("create", gvr.RESOURCE_SLICES, flaky)
+            chip0 = d.state._chips_by_index[0]
+            d._handle_health_event(
+                HealthEvent(
+                    kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid
+                )
+            )
+            # Two failed attempts (1 s backoff each) then success.
+            assert d.drain_publishes(10), "signals must stay pending until a write lands"
+            assert failures[0] == 0
+            names = {
+                dev["name"]
+                for s in kube.list(gvr.RESOURCE_SLICES)["items"]
+                for dev in s["spec"]["devices"]
+            }
+            assert "tpu-0" not in names and "tpu-1" in names
+        finally:
+            d.stop()
+
+    def test_unhealthy_gauge_updates_through_noop_gate(self, tmp_path):
+        """The unhealthy-devices gauge must track the unhealthy SET even
+        when the set change doesn't change slice content (an unknown or
+        already-withheld device) and the write is skipped."""
+        from prometheus_client import REGISTRY
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path, kube)
+        d.publish_resources()
+        with d._unhealthy_lock:
+            # A name not in allocatable: withheld-set content is unchanged.
+            d._unhealthy.add("ghost-device")
+        writes = SliceWriteCounter(kube)
+        d.publish_resources()  # content identical -> noop path
+        assert writes.count == 0
+        gauge = REGISTRY.get_sample_value(
+            "tpudra_unhealthy_devices", {"driver": TPU_DRIVER_NAME}
+        )
+        assert gauge == 1, "gauge must not go stale behind the noop gate"

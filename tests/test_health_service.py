@@ -166,9 +166,18 @@ class TestHealthServiceE2E:
             assert snapshot["tpu-1"]["ts"] <= snapshot["tpu-0"]["ts"]
 
             # The same fault also withdrew the device from the published
-            # pool — stream and slices tell one story.
-            items = kube.list(gvr.RESOURCE_SLICES)["items"]
-            names = {dev["name"] for s in items for dev in s["spec"]["devices"]}
+            # pool — stream and slices tell one story.  The slice write is
+            # async (publisher-thread debounce), so wait for convergence.
+            def advertised():
+                items = kube.list(gvr.RESOURCE_SLICES)["items"]
+                return {
+                    dev["name"] for s in items for dev in s["spec"]["devices"]
+                }
+
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and "tpu-0" in advertised():
+                time.sleep(0.01)
+            names = advertised()
             assert "tpu-0" not in names and "tpu-1" in names
             client.close()
         finally:

@@ -121,3 +121,151 @@ def test_mutation_cache_defeats_staleness(api):
     )
     assert cache.get("cd", "default")["spec"]["numNodes"] == 42
     stop.set()
+
+
+def test_index_maintained_on_update_and_delete(api):
+    """Real inverted indices: value changes move an object between index
+    buckets, deletes drop it, and stale values never linger."""
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    inf.add_index("nodes", lambda o: str(o["spec"].get("numNodes")))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    api.create(gvr.COMPUTE_DOMAINS, mk("a"))
+    api.create(gvr.COMPUTE_DOMAINS, mk("b"))
+    assert wait_for(lambda: len(inf.by_index("nodes", "1")) == 2)
+
+    obj = api.get(gvr.COMPUTE_DOMAINS, "a", "default")
+    obj["spec"]["numNodes"] = 9
+    api.update(gvr.COMPUTE_DOMAINS, obj)
+    assert wait_for(lambda: len(inf.by_index("nodes", "9")) == 1)
+    assert {o["metadata"]["name"] for o in inf.by_index("nodes", "1")} == {"b"}
+
+    api.delete(gvr.COMPUTE_DOMAINS, "a", "default")
+    assert wait_for(lambda: inf.by_index("nodes", "9") == [])
+    # The emptied bucket is dropped, not kept as a leak.
+    assert "9" not in inf._index_data["nodes"]
+    stop.set()
+
+
+def test_index_registered_late_covers_existing_store(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk("pre"))
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    # add_index AFTER the store is populated must index what's there.
+    inf.add_index("name", lambda o: o["metadata"]["name"])
+    assert [o["metadata"]["name"] for o in inf.by_index("name", "pre")] == ["pre"]
+    stop.set()
+
+
+def test_index_rebuilt_on_relist(api):
+    """A relist replaces the whole store; indices must be rebuilt from the
+    fresh listing, not carry keys of objects the relist dropped."""
+    created = api.create(gvr.COMPUTE_DOMAINS, mk("gone"))
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    inf.add_index("uid", lambda o: o["metadata"].get("uid"))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    uid = created["metadata"]["uid"]
+    assert len(inf.by_index("uid", uid)) == 1
+    stop.set()
+    # Simulate the object vanishing while the watch was down, then a
+    # fresh list+watch cycle (what _run does after a watch failure).
+    api.delete(gvr.COMPUTE_DOMAINS, "gone", "default")
+    stop2 = threading.Event()
+    t = threading.Thread(target=lambda: inf._list_and_watch(stop2), daemon=True)
+    t.start()
+    assert wait_for(lambda: inf.by_index("uid", uid) == [])
+    assert inf.get("gone", "default") is None
+    stop2.set()
+    t.join(5)
+
+
+def test_unknown_index_still_raises(api):
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        inf.by_index("nope", "x")
+
+
+def test_resync_redispatches_modified(api):
+    """resync_period re-dispatches MODIFIED for every cached object on the
+    period (client-go semantics): level-triggered handlers converge on
+    drift without a real event."""
+    api.create(gvr.COMPUTE_DOMAINS, mk("steady"))
+    inf = Informer(api, gvr.COMPUTE_DOMAINS, resync_period=0.1)
+    seen = []
+    inf.add_handler(lambda t, o: seen.append((t, o["metadata"]["name"])))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    # Beyond the initial ADDED, periodic MODIFIED re-dispatches accumulate
+    # with no writes happening at all.
+    assert wait_for(
+        lambda: seen.count(("MODIFIED", "steady")) >= 2, timeout=5
+    )
+    assert ("ADDED", "steady") in seen
+    stop.set()
+
+
+def test_resync_zero_spawns_no_resync(api):
+    inf = Informer(api, gvr.COMPUTE_DOMAINS)  # default: disabled
+    api.create(gvr.COMPUTE_DOMAINS, mk("quiet"))
+    seen = []
+    inf.add_handler(lambda t, o: seen.append(t))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    assert wait_for(lambda: "ADDED" in seen)
+    time.sleep(0.3)
+    assert "MODIFIED" not in seen
+    stop.set()
+
+
+def test_cache_filter_bounds_store_and_evicts(api):
+    """cache_filter: non-matching objects are never stored; an update that
+    stops matching evicts (dispatched as DELETED, the filtered-informer
+    convention); matching again re-admits."""
+    api.create(gvr.COMPUTE_DOMAINS, mk("big"))
+    big = api.get(gvr.COMPUTE_DOMAINS, "big", "default")
+    big["spec"]["numNodes"] = 50
+    api.update(gvr.COMPUTE_DOMAINS, big)
+    inf = Informer(
+        api, gvr.COMPUTE_DOMAINS,
+        cache_filter=lambda o: o["spec"].get("numNodes", 0) < 10,
+    )
+    seen = []
+    inf.add_handler(lambda t, o: seen.append((t, o["metadata"]["name"], o)))
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    assert inf.get("big", "default") is None  # filtered out of the LIST
+    assert not any(t == "ADDED" and n == "big" for t, n, _ in seen)
+
+    api.create(gvr.COMPUTE_DOMAINS, mk("small"))  # numNodes=1: matches
+    assert wait_for(lambda: inf.get("small", "default") is not None)
+    assert any(t == "ADDED" and n == "small" for t, n, _ in seen)
+
+    obj = api.get(gvr.COMPUTE_DOMAINS, "small", "default")
+    obj["spec"]["numNodes"] = 99
+    api.update(gvr.COMPUTE_DOMAINS, obj)  # stops matching -> evicted
+    assert wait_for(lambda: inf.get("small", "default") is None)
+    # Eviction payload is the LAST CACHED state (client-go's filtered
+    # OnDelete convention), not the non-matching object handlers never saw.
+    evicted = next(
+        o for t, n, o in seen if t == "DELETED" and n == "small"
+    )
+    assert evicted["spec"]["numNodes"] == 1
+
+    obj = api.get(gvr.COMPUTE_DOMAINS, "small", "default")
+    obj["spec"]["numNodes"] = 2
+    api.update(gvr.COMPUTE_DOMAINS, obj)  # matches again -> re-admitted
+    assert wait_for(lambda: inf.get("small", "default") is not None)
+    # Entering the cache by STARTING to match arrives as ADDED (client-go's
+    # filtering-handler convention), even though the wire event was MODIFIED.
+    assert [t for t, n, _ in seen if n == "small"].count("ADDED") == 2
+    stop.set()

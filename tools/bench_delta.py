@@ -87,6 +87,9 @@ def main() -> int:
             f"bench-delta: bind p50 {now['value']} ms "
             "(no prior BENCH_r*.json with a parsed headline to compare)"
         )
+        # The apiserver A/B is within-run by design — it must print even
+        # with no round history to compare the headline against.
+        print_apiserver_section(now)
         return 0
     n, before = prior
     delta_pct = (now["value"] - before["value"]) / before["value"] * 100.0
@@ -95,7 +98,29 @@ def main() -> int:
         f"bench-delta: bind p50 {before['value']} ms (round {n}) -> "
         f"{now['value']} ms now  ({abs(delta_pct):.1f}% {arrow})"
     )
+    print_apiserver_section(now)
     return 0
+
+
+def print_apiserver_section(now: dict) -> None:
+    """The --apiserver-latency-ms A/B, when this run carried it: the batch
+    bind at an injected RTT, watch-cached resolution vs per-claim GETs.
+    The interesting delta is within the run (the two interleaved arms),
+    not across rounds — RTT injection makes absolute numbers incomparable
+    with the headline history."""
+    ab = now.get("apiserver")
+    if not isinstance(ab, dict) or "cached_batch_p50_ms" not in ab:
+        return
+    cached = ab["cached_batch_p50_ms"]
+    uncached = ab["uncached_batch_p50_ms"]
+    rtt = ab.get("latency_ms", 0)
+    n = ab.get("n_claims", 0)
+    print(
+        f"bench-delta: apiserver A/B at {rtt:g} ms RTT "
+        f"(batch of {n}): cached {cached} ms vs per-claim-GET {uncached} ms "
+        f"({ab.get('improvement_ms', round(uncached - cached, 3))} ms "
+        f"left the hot path; ~{n} serialized GET RTTs = {n * rtt:g} ms)"
+    )
 
 
 if __name__ == "__main__":

@@ -107,6 +107,10 @@ class _Watcher:
 class FakeKube:
     """An in-memory KubeAPI implementation."""
 
+    #: Verbs the latency knob applies to.  Watch delivery stays instant:
+    #: it is the push channel the latency knob exists to favor.
+    LATENCY_VERBS = ("get", "list", "create", "update", "delete")
+
     def __init__(self):
         self._lock = threading.RLock()
         self._objects: dict[str, dict[tuple, dict]] = {}  # gvr_key -> {(ns, name): obj}
@@ -114,6 +118,7 @@ class FakeKube:
         self._history: list[tuple[int, str, dict]] = []  # (rv, gvr_key, event)
         self._watchers: list[_Watcher] = []
         self._reactors: list[tuple[str, str, Callable]] = []  # (verb, gvr_key, fn)
+        self._latency_s = 0.0
 
     # -- test hooks ---------------------------------------------------------
 
@@ -122,7 +127,21 @@ class FakeKube:
         "delete", "get", "list") executes; raise from it to inject failures."""
         self._reactors.append((verb, self._key(gvr), fn))
 
+    def set_latency(self, seconds: float) -> None:
+        """Simulate apiserver round-trip time: every request verb (not
+        watch delivery) sleeps ``seconds`` before executing, while holding
+        the store lock.  Sleeping under the lock is deliberate: requests
+        from one client serialize, which is what a production driver sees
+        anyway — its client-side QPS limiter (``--kube-api-qps``, default
+        5) spaces concurrent requests out far more aggressively than the
+        RTT itself.  N concurrent GETs therefore cost ~N×RTT, the cost the
+        watch-backed caches exist to remove (bench.py
+        --apiserver-latency-ms)."""
+        self._latency_s = float(seconds)
+
     def _run_reactors(self, verb: str, gvr: GVR, obj: dict | None) -> None:
+        if self._latency_s > 0 and verb in self.LATENCY_VERBS:
+            time.sleep(self._latency_s)
         for v, key, fn in self._reactors:
             if v in (verb, "*") and key == self._key(gvr):
                 fn(verb, gvr, obj)

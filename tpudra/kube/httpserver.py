@@ -162,8 +162,18 @@ class _Handler(BaseHTTPRequestHandler):
 class FakeKubeServer:
     """Serve a FakeKube over HTTP on localhost; use as a context manager."""
 
-    def __init__(self, fake: FakeKube | None = None, port: int = 0):
+    def __init__(
+        self,
+        fake: FakeKube | None = None,
+        port: int = 0,
+        latency_s: float = 0.0,
+    ):
         self.fake = fake or FakeKube()
+        if latency_s:
+            # Injected apiserver RTT for bind-path A/B runs — the HTTP
+            # server threads inherit the fake's per-request sleep, so the
+            # real client experiences the latency over the wire too.
+            self.fake.set_latency(latency_s)
         handler = type("BoundHandler", (_Handler,), {"fake": self.fake})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self._httpd.daemon_threads = True

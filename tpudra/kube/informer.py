@@ -3,7 +3,11 @@
 The analog of the generated informers the reference gets from informer-gen
 plus client-go's shared informer machinery: list, then watch from the list's
 resourceVersion, re-listing on watch failure; handlers fire on add/update/
-delete; ``wait_for_sync`` gates controller startup.
+delete; ``wait_for_sync`` gates controller startup.  Secondary indices
+(``add_index``/``by_index``) are real inverted maps maintained on every
+store mutation, and a nonzero ``resync_period`` re-dispatches MODIFIED for
+all cached objects on the period (client-go's periodic resync) as a drift
+backstop for level-triggered consumers.
 
 Also provides MutationCache: after a controller writes an object, the freshly
 written version is layered over the informer cache so the controller doesn't
@@ -18,6 +22,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from tpudra import metrics
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.gvr import GVR
 
@@ -40,6 +45,7 @@ class Informer:
         label_selector: Optional[str] = None,
         field_selector: Optional[str] = None,
         resync_period: float = 0.0,
+        cache_filter: Optional[Callable[[dict], bool]] = None,
     ):
         self._api = api
         self._gvr = gvr
@@ -47,13 +53,33 @@ class Informer:
         self._label_selector = label_selector
         self._field_selector = field_selector
         self._resync_period = resync_period
+        #: Client-side store filter: objects failing it are never cached
+        #: (and an update that stops matching evicts — dispatched as
+        #: DELETED, the filtered-informer convention).  Bounds a node
+        #: agent's cache to the objects it can ever act on when the
+        #: apiserver offers no server-side selector for the predicate.
+        self._cache_filter = cache_filter
         self._store: dict[tuple, dict] = {}
         self._lock = threading.Lock()
         self._handlers: list[Handler] = []
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._indices: dict[str, Callable[[dict], str | None]] = {}
+        #: index name -> index value -> store keys.  Real inverted indices,
+        #: maintained on every store mutation: ``by_index`` is called per
+        #: reconcile by the controller, and a full store scan per call turns
+        #: the informer cache into an O(store) lookup under load.
+        self._index_data: dict[str, dict[str, set[tuple]]] = {}
         self._backoff = 0.2  # relist backoff, reset by each successful list
+        self._watch_ok = False  # see watch_healthy
+        #: Serializes handler deliveries between the list/watch thread and
+        #: the resync thread — handlers are written for single-threaded
+        #: dispatch, and interleaving could hand them a resync replay
+        #: AFTER a fresher watch event (client-go serializes through one
+        #: processor queue for the same reason).  RLock: the resync loop
+        #: holds it across its store re-read + dispatch, and _dispatch
+        #: re-acquires it.
+        self._dispatch_lock = threading.RLock()
 
     # -- configuration ------------------------------------------------------
 
@@ -61,8 +87,40 @@ class Informer:
         self._handlers.append(handler)
 
     def add_index(self, name: str, fn: Callable[[dict], str | None]) -> None:
-        """Register a secondary index (e.g. by uid, by label value)."""
-        self._indices[name] = fn
+        """Register a secondary index (e.g. by uid, by label value).
+        Objects already in the store are indexed immediately."""
+        with self._lock:
+            self._indices[name] = fn
+            self._index_data[name] = {}
+            for key, obj in self._store.items():
+                self._index_one(name, fn, key, obj)
+
+    # -- index maintenance (every helper expects self._lock held) -----------
+
+    def _index_one(self, name: str, fn: Callable, key: tuple, obj: dict) -> None:
+        value = fn(obj)
+        if value is not None:
+            self._index_data[name].setdefault(value, set()).add(key)
+
+    def _index_add(self, key: tuple, obj: dict) -> None:
+        for name, fn in self._indices.items():
+            self._index_one(name, fn, key, obj)
+
+    def _index_drop(self, key: tuple, obj: dict) -> None:
+        for name, fn in self._indices.items():
+            value = fn(obj)
+            if value is None:
+                continue
+            keys = self._index_data[name].get(value)
+            if keys is not None:
+                keys.discard(key)
+                if not keys:
+                    del self._index_data[name][value]
+
+    def _index_rebuild(self) -> None:
+        self._index_data = {name: {} for name in self._indices}
+        for key, obj in self._store.items():
+            self._index_add(key, obj)
 
     # -- store access -------------------------------------------------------
 
@@ -75,9 +133,9 @@ class Informer:
             return list(self._store.values())
 
     def by_index(self, index: str, value: str) -> list[dict]:
-        fn = self._indices[index]
         with self._lock:
-            return [o for o in self._store.values() if fn(o) == value]
+            keys = self._index_data[index].get(value, ())
+            return [self._store[k] for k in keys]
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -86,6 +144,34 @@ class Informer:
             target=self._run, args=(stop,), daemon=True, name=f"informer-{self._gvr.resource}"
         )
         self._thread.start()
+        if self._resync_period > 0:
+            threading.Thread(
+                target=self._resync_loop,
+                args=(stop,),
+                daemon=True,
+                name=f"informer-resync-{self._gvr.resource}",
+            ).start()
+
+    def _resync_loop(self, stop: threading.Event) -> None:
+        """Periodic resync, as client-go's shared informer does it:
+        re-dispatch MODIFIED for every cached object on the period, so
+        level-triggered handlers converge on drift (a missed edge, an
+        external actor) without waiting for the next real event.  Each
+        object is re-read from the store at dispatch time under the
+        dispatch mutex, so a resync delivery is never an OLDER state than
+        an event the watch thread already delivered (client-go gets the
+        same guarantee from its single processor queue)."""
+        while not stop.wait(self._resync_period):
+            if not self._synced.is_set():
+                continue
+            with self._lock:
+                keys = list(self._store.keys())
+            for key in keys:
+                with self._dispatch_lock:
+                    with self._lock:
+                        obj = self._store.get(key)
+                    if obj is not None:
+                        self._dispatch("MODIFIED", obj)
 
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
         return self._synced.wait(timeout)
@@ -96,6 +182,15 @@ class Informer:
         store.  Read-through consumers must fall back to a direct API call
         until then — an empty pre-sync cache looks like 'nothing exists'."""
         return self._synced.is_set()
+
+    @property
+    def watch_healthy(self) -> bool:
+        """True while the current list+watch cycle is live (last LIST
+        succeeded, watch has not failed since).  While False the cache may
+        lag by up to the relist backoff (≤ 30 s); read-through consumers
+        that need tighter staleness than that should treat an unhealthy
+        watch like pre-sync and fall back to direct reads."""
+        return self._watch_ok
 
     def _run(self, stop: threading.Event) -> None:
         # Jittered exponential relist backoff: when the apiserver is down,
@@ -110,6 +205,7 @@ class Informer:
                 self._list_and_watch(stop)
                 self._backoff = 0.2
             except Exception as e:  # noqa: BLE001 — informer must survive apiserver blips
+                self._watch_ok = False
                 delay = self._backoff * (0.5 + random.random())
                 logger.warning(
                     "informer %s: list/watch failed: %s; re-listing in %.1fs",
@@ -130,11 +226,18 @@ class Informer:
         # escalate us to 30 s event-delivery gaps — client-go's reflector
         # resets on successful list the same way).
         self._backoff = 0.2
+        metrics.INFORMER_RELISTS.labels(self._gvr.resource).inc()
         rv = listing.get("metadata", {}).get("resourceVersion")
-        fresh = {obj_key(o): o for o in listing.get("items", [])}
+        fresh = {
+            obj_key(o): o
+            for o in listing.get("items", [])
+            if self._cache_filter is None or self._cache_filter(o)
+        }
         with self._lock:
             old = self._store
             self._store = fresh
+            self._index_rebuild()
+        self._watch_ok = True
         for key, obj in fresh.items():
             if key not in old:
                 self._dispatch("ADDED", obj)
@@ -147,6 +250,18 @@ class Informer:
                 self._dispatch("DELETED", obj)
         self._synced.set()
 
+        try:
+            self._watch_events(stop, rv)
+        finally:
+            # The watch is over — cleanly (a real apiserver closes streams
+            # on its server-side timeout every few minutes), by stop, or by
+            # exception: events are invisible until the next LIST lands, so
+            # the cache is no longer delivery-fresh.  Without this, clean
+            # closes would leave watch_healthy True across the whole relist
+            # window — exactly the staleness the flag exists to expose.
+            self._watch_ok = False
+
+    def _watch_events(self, stop: threading.Event, rv) -> None:
         for event in self._api.watch(
             self._gvr,
             self._namespace,
@@ -159,19 +274,42 @@ class Informer:
                 return
             etype, obj = event["type"], event["object"]
             key = obj_key(obj)
+            keep = etype != "DELETED" and (
+                self._cache_filter is None or self._cache_filter(obj)
+            )
             with self._lock:
-                if etype == "DELETED":
-                    self._store.pop(key, None)
-                else:
+                prev = self._store.get(key)
+                if prev is not None:
+                    self._index_drop(key, prev)
+                if keep:
                     self._store[key] = obj
-            self._dispatch(etype, obj)
+                    self._index_add(key, obj)
+                else:
+                    self._store.pop(key, None)
+            if self._cache_filter is None:
+                self._dispatch(etype, obj)
+            elif keep:
+                # Entering the cache by STARTING to match (e.g. a claim
+                # gaining its allocation via MODIFIED) is an Add to
+                # consumers, mirroring client-go's filtering handler.
+                self._dispatch("ADDED" if prev is None else etype, obj)
+            elif prev is not None:
+                # Stopped matching the filter: evicted from the cache, and
+                # handlers see the eviction the way client-go's filtered
+                # informers surface it — the DELETED payload is the LAST
+                # CACHED state (cleanup is keyed on what the handler saw),
+                # not the non-matching object it never saw.
+                self._dispatch("DELETED", prev)
 
     def _dispatch(self, etype: str, obj: dict) -> None:
-        for handler in self._handlers:
-            try:
-                handler(etype, obj)
-            except Exception:  # noqa: BLE001
-                logger.exception("informer %s handler failed", self._gvr.resource)
+        with self._dispatch_lock:
+            for handler in self._handlers:
+                try:
+                    handler(etype, obj)
+                except Exception:  # noqa: BLE001
+                    logger.exception(
+                        "informer %s handler failed", self._gvr.resource
+                    )
 
 
 class MutationCache:

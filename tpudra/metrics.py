@@ -135,6 +135,72 @@ SLICE_PUBLISH_TOTAL = Counter(
     "ResourceSlice publication passes",
     ["driver"],
 )
+#: Resolution-source label values for CLAIM_RESOLUTIONS (one place so the
+#: resolver and its tests agree on spelling).  ``cache`` is an informer hit;
+#: every ``get-*`` source is a read-through fallback GET, keyed by why the
+#: cache could not answer.
+RESOLVE_CACHE = "cache"
+RESOLVE_GET_PRESYNC = "get-presync"
+RESOLVE_GET_MISS = "get-miss"
+RESOLVE_GET_STALE_UID = "get-stale-uid"
+RESOLVE_GET_UNALLOCATED = "get-unallocated"
+RESOLVE_GET_WATCH_DOWN = "get-watch-down"
+
+CLAIM_RESOLUTIONS = Counter(
+    "tpudra_claim_resolutions_total",
+    "Claim-reference resolutions by source: 'cache' (watch-backed informer "
+    "hit) or 'get-*' (read-through apiserver GET: pre-sync, cache miss, "
+    "stale cached uid, cached copy not yet allocated, watch connection "
+    "down).  Steady state is ~all cache: fallback GETs are the apiserver "
+    "load the informer exists to remove",
+    ["source"],
+)
+#: Labelled children resolved once: .labels() takes a registry lock and the
+#: resolver counts one sample per claim resolution on the bind hot path
+#: (same pattern as _PHASE_CHILDREN below).
+_RESOLUTION_CHILDREN = {
+    s: CLAIM_RESOLUTIONS.labels(s)
+    for s in (
+        RESOLVE_CACHE,
+        RESOLVE_GET_PRESYNC,
+        RESOLVE_GET_MISS,
+        RESOLVE_GET_STALE_UID,
+        RESOLVE_GET_UNALLOCATED,
+        RESOLVE_GET_WATCH_DOWN,
+    )
+}
+
+
+def count_resolution(source: str) -> None:
+    """Record one claim-resolution sample (hot path: pre-resolved child)."""
+    child = _RESOLUTION_CHILDREN.get(source)
+    (child if child is not None else CLAIM_RESOLUTIONS.labels(source)).inc()
+
+
+CLAIM_SINGLEFLIGHT_COLLAPSED = Counter(
+    "tpudra_claim_singleflight_collapsed_total",
+    "Concurrent resolver threads that piggybacked on another thread's "
+    "in-flight GET for the same claim instead of issuing their own",
+)
+SLICE_PUBLISH_COALESCED = Counter(
+    "tpudra_resourceslice_publish_coalesced_total",
+    "Publish signals absorbed into an already-pending rebuild by the "
+    "publisher thread's debounce window (a burst of K health/withheld "
+    "events costing one rebuild records K-1 here)",
+    ["driver"],
+)
+SLICE_PUBLISH_NOOP = Counter(
+    "tpudra_resourceslice_publish_noop_total",
+    "Publication passes skipped because the rebuilt slice content hashed "
+    "identical to what is already published (no API write issued)",
+    ["driver"],
+)
+INFORMER_RELISTS = Counter(
+    "tpudra_informer_relists_total",
+    "Full LIST operations issued by an informer (initial sync plus every "
+    "relist after a watch failure), by resource",
+    ["resource"],
+)
 WORKQUEUE_DEPTH = Gauge(
     "tpudra_workqueue_depth",
     "Items waiting or in flight in a work queue",

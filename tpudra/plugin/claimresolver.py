@@ -1,0 +1,162 @@
+"""Watch-backed ResourceClaim resolution: the apiserver off the bind path.
+
+PR 1 made the node-local half of the bind path fast; the remote half still
+paid one synchronous apiserver GET per claim in every NodePrepareResources
+(`grpcserver.kube_claim_resolver`).  At production scale that is O(churn ×
+nodes) apiserver load sitting in front of every bind — the reference driver
+avoids it with client-go shared informers feeding its draclient lookups
+(vendored kubeletplugin/draplugin.go), and this module is that analog:
+
+- **Cache hit**: once the claim informer has synced AND its watch is
+  live, a cached object whose uid matches the reference kubelet sent is
+  returned without touching the apiserver.  The UID guard is what makes
+  the cache safe: kubelet names the exact object generation it wants
+  (namespace/name/uid), allocations only change through delete-and-
+  recreate (uid change) or an explicit deallocate→reallocate (a status
+  rewrite the watch delivers, and which evicts the claim from the
+  driver's filtered cache in between) — so with a live watch, a
+  uid-matching cached copy carrying an allocation matches a live GET for
+  every field the bind path reads, up to delivery lag of milliseconds.
+  While the watch is broken (``Informer.watch_healthy`` False), lag can
+  grow to the relist backoff, so resolution falls back to GETs.
+- **Read-through fallback**: pre-sync (an empty cache looks like "nothing
+  exists"), a cache miss, a cached object whose uid does NOT match (the
+  watch may lag a delete-and-recreate — the live object must get the final
+  word before a UID-mismatch error), or a cached copy with no allocation
+  yet (the status watch event may lag the scheduler) all fall back to a
+  direct GET, exactly what the resolver did before the cache existed.
+- **Singleflight**: N resolver-pool threads missing on the same claim
+  collapse into ONE in-flight GET; the rest wait for the leader's result.
+
+Every resolution outcome lands in ``tpudra_claim_resolutions_total`` and
+collapses in ``tpudra_claim_singleflight_collapsed_total`` — the
+steady-state criterion is ~all-cache with fallback GETs < 5% of
+resolutions (docs/bind-path.md).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+from typing import Callable
+
+from tpudra import metrics
+from tpudra.kube import gvr
+from tpudra.kube.informer import Informer
+
+logger = logging.getLogger(__name__)
+
+
+class _Call:
+    __slots__ = ("done", "result", "error", "waiters")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.waiters = 0
+
+
+class Singleflight:
+    """Deduplicate concurrent identical calls: the first caller for a key
+    (the leader) runs ``fn``; every caller that arrives while that call is
+    in flight waits for the leader's result instead of issuing its own.
+    Callers arriving after the leader finished start a fresh call — this
+    collapses concurrency, it is not a cache."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._calls: dict[tuple, _Call] = {}
+
+    def do(self, key: tuple, fn: Callable[[], dict]) -> tuple[dict, bool]:
+        """Run ``fn`` (or wait on whoever already is); returns
+        ``(result, leader)``.  Followers get a deep copy so no two callers
+        share one mutable claim dict; the leader's exception is re-raised
+        in every waiter."""
+        with self._lock:
+            call = self._calls.get(key)
+            leader = call is None
+            if leader:
+                call = _Call()
+                self._calls[key] = call
+            else:
+                call.waiters += 1
+        if not leader:
+            call.done.wait()
+            if call.error is not None:
+                raise call.error
+            return copy.deepcopy(call.result), False
+        try:
+            call.result = fn()
+        except BaseException as e:
+            call.error = e
+            raise
+        finally:
+            with self._lock:
+                self._calls.pop(key, None)
+            call.done.set()
+        return call.result, True
+
+    def waiting(self, key: tuple) -> int:
+        """How many followers are parked on ``key`` right now (tests)."""
+        with self._lock:
+            call = self._calls.get(key)
+            return call.waiters if call is not None else 0
+
+
+class CachedClaimResolver:
+    """A ``ClaimResolver`` (grpcserver contract: ``(namespace, name, uid)
+    -> full ResourceClaim dict, or raise``) served from an informer cache
+    with read-through GET fallback and singleflight deduplication."""
+
+    def __init__(self, kube, informer: Informer):
+        self._kube = kube
+        self._informer = informer
+        self._singleflight = Singleflight()
+
+    def __call__(self, namespace: str, name: str, uid: str) -> dict:
+        source = self._cache_lookup(namespace, name, uid)
+        if isinstance(source, dict):
+            metrics.count_resolution(metrics.RESOLVE_CACHE)
+            return source
+        metrics.count_resolution(source)
+        claim, leader = self._singleflight.do(
+            (namespace, name, uid),
+            lambda: self._kube.get(gvr.RESOURCE_CLAIMS, name, namespace),
+        )
+        if not leader:
+            metrics.CLAIM_SINGLEFLIGHT_COLLAPSED.inc()
+        have_uid = claim.get("metadata", {}).get("uid", "")
+        if uid and have_uid != uid:
+            raise ValueError(
+                f"UID mismatch: live claim has {have_uid!r}, want {uid!r}"
+            )
+        return claim
+
+    def _cache_lookup(self, namespace: str, name: str, uid: str):
+        """The cached claim (a private copy) on a safe hit, else the
+        fallback reason for the resolutions counter."""
+        if not self._informer.has_synced:
+            return metrics.RESOLVE_GET_PRESYNC
+        if not self._informer.watch_healthy:
+            # A broken watch widens cache lag from delivery latency
+            # (milliseconds) to the relist backoff (up to ~30 s) — wide
+            # enough for a deallocate→reallocate of the SAME uid to hide
+            # in.  Treat it like pre-sync until the relist lands.
+            return metrics.RESOLVE_GET_WATCH_DOWN
+        cached = self._informer.get(name, namespace)
+        if cached is None:
+            return metrics.RESOLVE_GET_MISS
+        have_uid = cached.get("metadata", {}).get("uid", "")
+        if uid and have_uid != uid:
+            # Deleted-and-recreated claim the watch hasn't caught up with:
+            # only the LIVE object may ground a UID-mismatch error.
+            return metrics.RESOLVE_GET_STALE_UID
+        if not cached.get("status", {}).get("allocation"):
+            # Kubelet only prepares allocated claims; a cached copy without
+            # an allocation is behind the scheduler's status write.
+            return metrics.RESOLVE_GET_UNALLOCATED
+        # Deep copy: the store object is shared with every other reader and
+        # the prepare path must never see a claim mutated under it.
+        return copy.deepcopy(cached)

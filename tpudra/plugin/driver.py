@@ -11,7 +11,13 @@ The analog of gpu-kubelet-plugin/driver.go:52-554:
   / t_prep log lines plus the tpudra_bind_phase_seconds histogram, the
   BASELINE bind-latency hooks).
 - ``publish_resources`` pushes this node's pool as ResourceSlice objects,
-  flat or KEP-4815 partitionable (driver.go:402-554).
+  flat or KEP-4815 partitionable (driver.go:402-554).  Since the
+  apiserver-off-the-hot-path work, RPC and health threads only *signal*
+  (``_request_publish``); a dedicated publisher thread debounces bursts
+  into one rebuild and a content hash skips no-op API writes.
+- claim references are resolved through a watch-backed informer cache
+  with singleflight GET fallback (claimresolver.py) instead of one
+  synchronous apiserver GET per claim.
 - a health monitor consumes device-lib events and republishes the pool
   without unhealthy silicon; there is deliberately no auto-reheal — a chip
   comes back only on plugin restart (driver.go:462-502).
@@ -20,6 +26,8 @@ The analog of gpu-kubelet-plugin/driver.go:52-554:
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import logging
 import os
 import threading
@@ -31,11 +39,14 @@ from typing import Callable, Optional
 from tpudra import TPU_DRIVER_NAME, featuregates, metrics
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
 from tpudra.flock import Flock
+from tpudra.kube import gvr
 from tpudra.kube.apply import next_pool_generation, publish_slices
 from tpudra.kube.client import KubeAPI
+from tpudra.kube.informer import Informer
 from tpudra.plugin import allocatable as alloc
 from tpudra.plugin.cdi import CDIHandler
 from tpudra.plugin.checkpoint import CheckpointManager
+from tpudra.plugin.claimresolver import CachedClaimResolver
 from tpudra.plugin.cleanup import CheckpointCleanupManager
 from tpudra.plugin.device_state import DeviceState, PermanentError
 from tpudra.plugin.grpcserver import PluginSockets, kube_claim_resolver
@@ -63,6 +74,27 @@ class DriverConfig:
     # Bound on concurrent per-claim side-effect work within one kubelet
     # batch (footprint-disjoint claims only; see prepare_resource_claims).
     prepare_concurrency: int = 8
+    # Watch-backed claim resolution (claimresolver.py): False resolves
+    # every claim reference with a direct apiserver GET, the pre-cache
+    # behavior (the bench A/B arm and an escape hatch).
+    claim_cache: bool = True
+    # Periodic claim-informer resync: re-dispatches MODIFIED to handlers
+    # on the period (client-go semantics — it replays the CACHE, it does
+    # not refresh it from the apiserver).  The resolver registers no
+    # handlers and its correctness does not depend on resync (uid guard +
+    # read-through fallbacks + watch-health gate), so the default is
+    # DISABLED — a nonzero period only makes sense once something
+    # subscribes to the claim informer.  <= 0 disables.
+    claim_informer_resync_s: float = 0.0
+    # Coalescing window of the async slice publisher: a burst of health /
+    # withheld-set events inside one window costs one rebuild+write.
+    publish_debounce_s: float = 0.05
+    # Write-through age for the content-hash gate: slices older than this
+    # are re-asserted (a real write) even when the rebuilt content is
+    # unchanged, so slices lost out-of-band (kubectl delete, an etcd
+    # restore) heal within the interval instead of only on restart.
+    # <= 0 disables reassertion (every identical rebuild is skipped).
+    publish_reassert_s: float = 300.0
 
 
 class Driver:
@@ -97,18 +129,49 @@ class Driver:
         # health thread and prepare RPC threads both publish, and an
         # interleaving could re-advertise silicon just marked unhealthy.
         self._publish_lock = threading.Lock()
+        # Async publisher state: RPC/health threads bump _publish_seq and
+        # notify; the publisher thread debounces, rebuilds once, and
+        # advances _publish_done.  Content-hash gate for no-op rebuilds.
+        self._publish_cond = threading.Condition()
+        self._publish_seq = 0
+        self._publish_done = 0
+        self._publisher_thread: Optional[threading.Thread] = None
+        self._published_hash: Optional[str] = None
+        self._published_slices: list[dict] = []
+        self._published_at: Optional[float] = None  # monotonic of last WRITE
         # Seeded from live slices so a restart outranks previous publishes.
         self._pool_generation = next_pool_generation(
             kube, config.node_name, alloc.pool_name(config.node_name)
         )
         self._stop = threading.Event()
+        # Claim-reference resolution: watch-backed cache with read-through
+        # GET fallback and singleflight (claimresolver.py), or the plain
+        # per-reference GET when the cache is disabled.
+        self._claim_informer: Optional[Informer] = None
+        if config.claim_cache:
+            self._claim_informer = Informer(
+                kube,
+                gvr.RESOURCE_CLAIMS,
+                resync_period=max(0.0, config.claim_informer_resync_s),
+                # The apiserver has no server-side selector for "claims
+                # allocated to this driver", so bound the cache client-side:
+                # only claims carrying an allocation result for OUR driver
+                # are stored (the resolver can only cache-hit those anyway).
+                # This also EVICTS a claim the moment it is deallocated, so
+                # a later same-uid reallocation can never be served from a
+                # pre-deallocation copy.
+                cache_filter=self._claim_is_ours,
+            )
+            resolve_claim = CachedClaimResolver(kube, self._claim_informer)
+        else:
+            resolve_claim = kube_claim_resolver(kube)
         self._sockets = PluginSockets(
             TPU_DRIVER_NAME,
             config.plugin_dir,
             config.registry_dir,
             prepare=self.prepare_resource_claims,
             unprepare=self.unprepare_resource_claims,
-            resolve_claim=kube_claim_resolver(kube),
+            resolve_claim=resolve_claim,
         )
         self.cleanup = CheckpointCleanupManager(
             kube, self.state, unprepare=self._unprepare_serialized
@@ -158,6 +221,14 @@ class Driver:
                 )
             )
         self._sockets.start()
+        if self._claim_informer is not None:
+            # Claim resolution falls back to direct GETs until the initial
+            # LIST lands (has_synced) — startup never blocks on the cache.
+            self._claim_informer.start(self._stop)
+        self._publisher_thread = threading.Thread(
+            target=self._publish_loop, daemon=True, name="slice-publisher"
+        )
+        self._publisher_thread.start()
         if featuregates.enabled(featuregates.TPU_DEVICE_HEALTH_CHECK):
             self._health_thread = threading.Thread(
                 target=self._health_loop, daemon=True, name="device-health"
@@ -168,6 +239,8 @@ class Driver:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._publish_cond:
+            self._publish_cond.notify_all()
         self._sockets.stop()
         self._effects_pool.shutdown(wait=False)
         self._lib.close()
@@ -175,6 +248,37 @@ class Driver:
     @property
     def sockets(self) -> PluginSockets:
         return self._sockets
+
+    @property
+    def claim_informer(self) -> Optional[Informer]:
+        """The ResourceClaim informer backing claim resolution (None when
+        the cache is disabled) — bench/tests wait on its sync."""
+        return self._claim_informer
+
+    def wait_for_claim_cache(self, timeout: float = 30.0) -> bool:
+        """Block until the claim informer has synced (immediately False
+        when the cache is disabled) — steady-state benches start here."""
+        if self._claim_informer is None:
+            return False
+        return self._claim_informer.wait_for_sync(timeout)
+
+    def _claim_is_ours(self, claim: dict) -> bool:
+        """Cache-filter predicate: the claim carries an allocation result
+        for this driver ON THIS NODE's pool (allocation results carry the
+        pool name, which is the node name — alloc.pool_name).  Node
+        scoping keeps each plugin's cache O(claims on this node), not
+        O(driver claims cluster-wide)."""
+        pool = alloc.pool_name(self._config.node_name)
+        results = (
+            claim.get("status", {})
+            .get("allocation", {})
+            .get("devices", {})
+            .get("results", [])
+        )
+        return any(
+            r.get("driver") == TPU_DRIVER_NAME and r.get("pool") == pool
+            for r in results
+        )
 
     # ------------------------------------------------------ prepare/unprepare
 
@@ -306,13 +410,15 @@ class Driver:
         return {"claims": out}
 
     def _republish_if_withheld_changed(self, withheld_before: set) -> None:
-        """Republish when sibling visibility changed — on EVERY exit path:
-        even a failed batch may have written PrepareStarted records that
-        flip visibility, and the retry samples withheld_before after those
-        records exist, so a skipped republish would never self-heal."""
+        """Signal a republish when sibling visibility changed — on EVERY
+        exit path: even a failed batch may have written PrepareStarted
+        records that flip visibility, and the retry samples withheld_before
+        after those records exist, so a skipped republish would never
+        self-heal.  The RPC thread only signals; the publisher thread owns
+        the rebuild+write (no apiserver traffic on the bind hot path)."""
         try:
             if self.state.bound_sibling_devices() != withheld_before:
-                self.publish_resources()
+                self._request_publish()
         except Exception:  # noqa: BLE001 — never mask the RPC result
             logger.exception("republish after prepare/unprepare failed")
 
@@ -438,7 +544,107 @@ class Driver:
 
     # ---------------------------------------------------------- publication
 
-    def publish_resources(self) -> list[dict]:
+    def _request_publish(self) -> None:
+        """Signal the publisher thread and return immediately.  Without a
+        live publisher (a driver used directly, never start()ed — unit
+        tests, bench harnesses) publication runs inline so the signal is
+        never silently dropped."""
+        thread = self._publisher_thread
+        if thread is None or not thread.is_alive():
+            self.publish_resources()
+            return
+        with self._publish_cond:
+            self._publish_seq += 1
+            # notify_all: drain_publishes waiters share this condition, and
+            # a bare notify() could wake one of them instead of the
+            # publisher, stalling the publish until the 1 s poll timeout.
+            self._publish_cond.notify_all()
+
+    def drain_publishes(self, timeout: float = 5.0) -> bool:
+        """Block until every signalled publish has been absorbed by a
+        rebuild (tests and orderly shutdown; True on drained)."""
+        deadline = time.monotonic() + timeout
+        with self._publish_cond:
+            while self._publish_seq != self._publish_done:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._publish_cond.wait(remaining)
+            return True
+
+    def _needs_reassert(self) -> bool:
+        """True when the last actual slice write is older than the
+        reassert interval — published state lost out-of-band must not hide
+        behind the content-hash gate forever."""
+        interval = self._config.publish_reassert_s
+        return (
+            interval > 0
+            and self._published_at is not None
+            and time.monotonic() - self._published_at > interval
+        )
+
+    def _publish_loop(self) -> None:
+        """The dedicated publisher: waits for a signal, debounces so a
+        burst of health/withheld events coalesces into one rebuild, then
+        publishes.  Signals landing during a rebuild trigger another pass,
+        so the last event always reaches the apiserver.  A FAILED publish
+        keeps its signals pending (``_publish_done`` does not advance) and
+        retries after a short backoff — one transient apiserver error must
+        not eat a coalesced burst.  Idle wakeups re-assert aged slices
+        through the hash gate (``publish_reassert_s``)."""
+        while True:
+            with self._publish_cond:
+                while (
+                    self._publish_seq == self._publish_done
+                    and not self._stop.is_set()
+                    and not self._needs_reassert()
+                ):
+                    self._publish_cond.wait(1.0)
+            if self._stop.is_set():
+                return
+            # Coalescing window — outside every lock (BLOCK-UNDER-LOCK).
+            if self._stop.wait(self._config.publish_debounce_s):
+                return  # shutting down: don't race teardown with a write
+            with self._publish_cond:
+                target = self._publish_seq
+            try:
+                self.publish_resources(force=self._needs_reassert())
+            except Exception:  # noqa: BLE001 — publisher must survive API blips
+                logger.exception(
+                    "async slice publication failed; retrying shortly"
+                )
+                self._stop.wait(1.0)
+                continue  # signals stay pending: the loop retries them
+            with self._publish_cond:
+                absorbed = target - self._publish_done - 1
+                self._publish_done = target
+                self._publish_cond.notify_all()  # wake drain_publishes waiters
+            if absorbed > 0:
+                metrics.SLICE_PUBLISH_COALESCED.labels(TPU_DRIVER_NAME).inc(
+                    absorbed
+                )
+
+    def _slice_content_hash(self, res) -> str:
+        """Digest of everything that determines the published slice set
+        EXCEPT the pool generation (which changes every write by design —
+        hashing it would defeat the no-op gate)."""
+        content = json.dumps(
+            {
+                "pool": res.pool_name,
+                "devices": res.devices,
+                "sharedCounters": res.shared_counters,
+                "partitionable": res.partitionable,
+                "k8sMinor": self._config.k8s_minor,
+            },
+            sort_keys=True,
+        )
+        return hashlib.sha256(content.encode()).hexdigest()
+
+    def publish_resources(self, force: bool = False) -> list[dict]:
+        """Rebuild and publish this node's ResourceSlices.  A rebuild whose
+        content hashes identical to the last successful publish skips the
+        API write entirely (``tpudra_resourceslice_publish_noop_total``) —
+        ``force=True`` writes regardless (restart-style reassertion)."""
         with self._publish_lock:
             partitionable = featuregates.enabled(featuregates.DYNAMIC_PARTITIONING)
             with self._unhealthy_lock:
@@ -450,6 +656,18 @@ class Driver:
                 partitionable=partitionable,
                 node_name=self._config.node_name,
             )
+            # Gauge before the gate: the unhealthy SET can change without
+            # changing slice content (an already-withheld sibling going
+            # unhealthy), and monitoring must see it either way.
+            metrics.UNHEALTHY_DEVICES.labels(TPU_DRIVER_NAME).set(len(unhealthy))
+            content_hash = self._slice_content_hash(res)
+            if not force and content_hash == self._published_hash:
+                metrics.SLICE_PUBLISH_NOOP.labels(TPU_DRIVER_NAME).inc()
+                logger.debug(
+                    "slice publish skipped: content unchanged (%d devices)",
+                    len(res.devices),
+                )
+                return self._published_slices
             slices = build_resource_slices(
                 res,
                 self._config.node_name,
@@ -463,8 +681,10 @@ class Driver:
                 self._config.node_name,
                 f"{self._config.node_name}-{TPU_DRIVER_NAME}-",
             )
+            self._published_hash = content_hash
+            self._published_slices = slices
+            self._published_at = time.monotonic()
             metrics.SLICE_PUBLISH_TOTAL.labels(TPU_DRIVER_NAME).inc()
-            metrics.UNHEALTHY_DEVICES.labels(TPU_DRIVER_NAME).set(len(unhealthy))
             logger.info(
                 "published %d ResourceSlice(s), %d devices, %d unhealthy",
                 len(slices), len(res.devices), len(unhealthy),
@@ -501,7 +721,10 @@ class Driver:
                 "marking unhealthy after %s (%s): %s — republishing without them",
                 event.kind, event.detail, sorted(names),
             )
-            self.publish_resources()
+            # Signal, don't publish: a cascade of health events (a chip
+            # taking its partitions down one event at a time) coalesces
+            # into one rebuild inside the publisher's debounce window.
+            self._request_publish()
             if self._sockets.health_broadcaster is not None:
                 self._sockets.health_broadcaster.notify()
 

@@ -54,12 +54,19 @@ ClaimResolver = Callable[[str, str, str], dict]
 
 
 def kube_claim_resolver(kube) -> ClaimResolver:
-    """The standard resolver both drivers use: GET the ResourceClaim and
-    enforce the stale-UID guard.  Kubelet only sends (namespace, uid, name)
-    on the wire; the allocation result lives in the API object — the same
+    """The direct-GET resolver: fetch the ResourceClaim and enforce the
+    stale-UID guard.  Kubelet only sends (namespace, uid, name) on the
+    wire; the allocation result lives in the API object — the same
     division of labor as the reference helper's draclient lookup.  A UID
     mismatch means the claim was deleted and re-created; preparing against
-    the old allocation would grant the wrong devices."""
+    the old allocation would grant the wrong devices.
+
+    This is the uncached fallback arm (``DriverConfig.claim_cache=False``
+    and the bench A/B): the production path routes resolution through the
+    watch-backed ``claimresolver.CachedClaimResolver``, which applies the
+    same UID guard against its cache and only GETs on miss/pre-sync —
+    with singleflight so N resolver-pool threads missing on one claim
+    issue one GET, not N."""
     from tpudra.kube import gvr  # local import to avoid a cycle at module load
 
     def resolve(namespace: str, name: str, uid: str) -> dict:
@@ -153,8 +160,10 @@ class PluginSockets:
 
     def _resolve_all(self, refs) -> list[tuple]:
         """Resolve every claim reference, concurrently when the batch has
-        more than one (each resolution is an independent API-server GET —
-        serial lookups would put N round-trips ahead of the bind path).
+        more than one (a resolution can be an API-server GET — serial
+        lookups would put N round-trips ahead of the bind path; with the
+        cached resolver a fan-out of hits costs nothing and concurrent
+        misses on one claim collapse to a single GET via singleflight).
         Returns [(ref, claim-or-None, error-or-None)] in request order."""
         def one(ref):
             try:

@@ -57,6 +57,40 @@ def build_parser() -> argparse.ArgumentParser:
         help="image for per-claim multi-process control daemons; the binary "
         "ships in the driver image [MP_DAEMON_IMAGE]",
     )
+    p.add_argument(
+        "--no-claim-cache",
+        action="store_true",
+        default=env_default("NO_CLAIM_CACHE", "").lower() == "true",
+        help="resolve every kubelet claim reference with a direct apiserver "
+        "GET instead of the watch-backed informer cache (escape hatch; the "
+        "cache is the default bind path) [NO_CLAIM_CACHE]",
+    )
+    p.add_argument(
+        "--claim-informer-resync-s",
+        type=float,
+        default=float(env_default("CLAIM_INFORMER_RESYNC_S", "0")),
+        help="claim-informer resync period: re-dispatch MODIFIED for cached "
+        "objects to handlers (client-go semantics; it replays the cache, "
+        "it does not refresh it — resolver safety rests on the UID guard "
+        "and needs no resync, hence default off); <= 0 disables "
+        "[CLAIM_INFORMER_RESYNC_S]",
+    )
+    p.add_argument(
+        "--publish-debounce-ms",
+        type=int,
+        default=int(env_default("PUBLISH_DEBOUNCE_MS", "50")),
+        help="coalescing window of the async ResourceSlice publisher: "
+        "health/withheld events within one window cost one rebuild+write "
+        "[PUBLISH_DEBOUNCE_MS]",
+    )
+    p.add_argument(
+        "--publish-reassert-s",
+        type=float,
+        default=float(env_default("PUBLISH_REASSERT_S", "300")),
+        help="re-assert published ResourceSlices older than this through "
+        "the no-op content-hash gate, healing slices lost out-of-band; "
+        "<= 0 disables [PUBLISH_REASSERT_S]",
+    )
     return p
 
 
@@ -80,6 +114,10 @@ def main(argv=None) -> int:
             driver_root=args.driver_root,
             k8s_minor=args.k8s_minor,
             device_backend=args.device_backend,
+            claim_cache=not args.no_claim_cache,
+            claim_informer_resync_s=args.claim_informer_resync_s,
+            publish_debounce_s=max(0.0, args.publish_debounce_ms / 1000.0),
+            publish_reassert_s=args.publish_reassert_s,
         ),
         kube,
         lib,
