@@ -7,14 +7,27 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint tier1 bats bats-real bench bench-bind bench-apiserver image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver image helm-render clean
 
 all: native test
 
-# Static analysis gate: tpudra-lint (stdlib AST checker, docs/static-analysis.md)
-# plus ruff/mypy when installed.  Nonzero exit on any finding.
+# Static analysis gate: tpudra-lint + tpudra-lockgraph (one stdlib AST
+# analyzer sharing one parse pass, docs/static-analysis.md) plus ruff/mypy
+# when installed.  Nonzero exit on any finding.
 lint:
 	bash hack/lint.sh
+
+# Just the whole-program lock rules (LOCK-CYCLE, BLOCK-UNDER-LOCK-IP,
+# FLOCK-INVERSION) — the quick loop while reworking concurrency.  Also part
+# of `make lint`/`make tier1` (hack/lint.sh runs the full analyzer), and
+# gated in-suite by tests/test_lockgraph.py::test_lockgraph_is_clean.
+lockgraph:
+	python -m tpudra.analysis --lockgraph
+
+# Regenerate the checked-in acquisition-order doc from the static model
+# (tests/test_lockgraph.py::test_lock_order_doc_is_fresh diffs it).
+lockgraph-docs:
+	python -m tpudra.analysis --emit-dot docs/lock-order.md
 
 native:
 	$(MAKE) -C native

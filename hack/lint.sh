@@ -11,7 +11,11 @@ cd "$(dirname "$0")/.."
 
 fail=0
 
-echo "== tpudra-lint (python -m tpudra.analysis)"
+echo "== tpudra-lint + tpudra-lockgraph (python -m tpudra.analysis)"
+# One invocation, one shared parse pass: the per-module lint rules AND the
+# whole-program lock rules (LOCK-CYCLE / BLOCK-UNDER-LOCK-IP /
+# FLOCK-INVERSION, docs/lock-order.md) run over the same parsed modules,
+# so the lockgraph costs no second walk of the tree.
 python -m tpudra.analysis || fail=1
 
 if python -m ruff --version >/dev/null 2>&1; then

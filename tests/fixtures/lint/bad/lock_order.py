@@ -16,7 +16,7 @@ class Publisher:
 
     def publish_with_flock(self):
         with self._publish_lock:
-            with Flock("/tmp/pu.lock"):  # EXPECT: LOCK-ORDER
+            with Flock("/tmp/pu.lock"):  # EXPECT: LOCK-ORDER, FLOCK-INVERSION
                 pass
 
     def publish_with_rmw(self):

@@ -1,0 +1,44 @@
+"""tpudra-lockgraph fixture: BLOCK-UNDER-LOCK-IP — blocking work reached
+*through calls* while an in-process lock is held, which the lexical
+BLOCK-UNDER-LOCK rule cannot see.  Also the dynamic-family annotation
+path: a per-device mutex handed out by a getter (the vfio.py idiom)."""
+
+import threading
+import time
+
+
+class Refresher:
+    def __init__(self, kube):
+        self._cache_lock = threading.Lock()
+        self._kube = kube
+        self._entries = {}
+
+    def refresh(self):
+        with self._cache_lock:
+            self._load()  # EXPECT: BLOCK-UNDER-LOCK-IP
+
+    def _load(self):
+        time.sleep(0.5)  # the sleep itself is lock-free lexically
+        self._entries.clear()
+
+
+class DeviceMutexes:
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._submutex = {}
+
+    def get(self, device):
+        with self._guard:
+            if device not in self._submutex:
+                # tpudra-lock: id=fixture.per-device family one mutex per device
+                self._submutex[device] = threading.Lock()
+            return self._submutex[device]
+
+
+mutexes = DeviceMutexes()
+
+
+def rebind(device):
+    # tpudra-lock: id=fixture.per-device
+    with mutexes.get(device):
+        time.sleep(0.1)  # EXPECT: BLOCK-UNDER-LOCK-IP

@@ -1,0 +1,35 @@
+"""tpudra-lockgraph fixture: LOCK-CYCLE — two threads taking the same two
+locks in opposite orders, each second acquisition hidden behind a helper
+so no single function ever shows both locks (exactly what the
+intraprocedural rules cannot see).
+
+The cycle finding anchors at the acquisition site of the cycle's
+lexicographically-first edge (log_lock → tx_lock, i.e. the helper call
+under the log lock)."""
+
+import threading
+
+
+class Wire:
+    def __init__(self):
+        self._tx_lock = threading.Lock()
+        self._log_lock = threading.Lock()
+        self._journal = []
+
+    # Thread A: tx_lock, then (via helper) log_lock.
+    def send(self, frame):
+        with self._tx_lock:
+            self._journal_frame(frame)
+
+    def _journal_frame(self, frame):
+        with self._log_lock:
+            self._journal.append(frame)
+
+    # Thread B: log_lock, then (via helper) tx_lock — the inversion.
+    def flush_journal(self):
+        with self._log_lock:
+            self._resend_pending()  # EXPECT: LOCK-CYCLE
+
+    def _resend_pending(self):
+        with self._tx_lock:
+            self._journal.clear()

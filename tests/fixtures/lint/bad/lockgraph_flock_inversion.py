@@ -1,0 +1,21 @@
+"""tpudra-lockgraph fixture: FLOCK-INVERSION — a cross-process flock
+acquired while an in-process lock is held, one call away so the lexical
+LOCK-ORDER publish-lock special case cannot see it."""
+
+import threading
+
+from tpudra.flock import Flock
+
+
+class Registry:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+        self._table = {}
+
+    def checkpoint(self):
+        with self._table_lock:
+            self._persist()  # EXPECT: FLOCK-INVERSION
+
+    def _persist(self):
+        with Flock("/var/lock/registry.lock")(timeout=5.0):
+            pass

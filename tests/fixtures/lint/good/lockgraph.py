@@ -1,0 +1,117 @@
+"""tpudra-lockgraph fixture: compliant whole-program lock discipline —
+zero findings.  The patterns the rules must NOT flag:
+
+- RLock re-entrancy (outer → helper re-acquiring the same RLock);
+- a consistent two-lock order used from two entry points (no cycle);
+- cond.wait on the very lock being held (it releases it);
+- blocking work reached only BEYOND the depth-4 horizon;
+- a sorted-family flock loop (intra-family order is LOCK-ORDER's
+  ``sorted()`` check, not a self-cycle);
+- blocking work sequenced after the critical section, through a helper.
+"""
+
+import threading
+import time
+
+from tpudra.flock import Flock
+
+
+class Reentrant:
+    def __init__(self):
+        self._state_lock = threading.RLock()
+        self._items = []
+
+    def outer(self):
+        with self._state_lock:
+            self._inner()
+
+    def _inner(self):
+        with self._state_lock:  # re-entrant: same RLock, not a cycle
+            self._items.append(1)
+
+
+class Ordered:
+    """Both entry points take a before b — a consistent global order."""
+
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def first(self):
+        with self._a_lock:
+            self._take_b()
+
+    def second(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def _take_b(self):
+        with self._b_lock:
+            pass
+
+
+class Waiter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def park(self):
+        with self._cond:
+            while not self._ready:
+                self._cond.wait(timeout=0.1)  # releases the held cond
+
+
+class DeepChain:
+    """The sleep sits five calls down — beyond MAX_BLOCK_DEPTH (4)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def tick(self):
+        with self._lock:
+            self._d1()
+
+    def _d1(self):
+        self._d2()
+
+    def _d2(self):
+        self._d3()
+
+    def _d3(self):
+        self._d4()
+
+    def _d4(self):
+        self._d5()
+
+    def _d5(self):
+        time.sleep(0.1)
+
+
+def serialize(uids):
+    """Sorted family acquisition: same lock ID acquired repeatedly is the
+    ordered-family idiom, not a self-deadlock."""
+    locks = []
+    try:
+        for uid in sorted(uids):
+            # tpudra-lock: id=flock:claim-uid family
+            lock = Flock(f"/var/lock/claims/{uid}.lock")
+            lock.acquire(timeout=5.0)
+            locks.append(lock)
+    finally:
+        for lock in reversed(locks):
+            lock.release()
+
+
+class AfterLock:
+    def __init__(self):
+        self._q_lock = threading.Lock()
+        self._queue = []
+
+    def drain(self):
+        with self._q_lock:
+            batch = list(self._queue)
+        self._flush(batch)  # blocking helper AFTER the lock is released
+
+    def _flush(self, batch):
+        time.sleep(0.01)

@@ -1,6 +1,7 @@
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -122,9 +123,11 @@ def test_concurrent_holders_serialize(tmp_path):
 
 
 def test_acquire_records_wait_metric(tmp_path):
-    """acquire() exports its wait through ``last_wait`` and the
-    ``tpudra_flock_wait_seconds`` histogram (labelled by lock file name) —
-    the lock-contention signal the bind-path dashboards key on."""
+    """acquire() RETURNS its wait (per-acquire state — a concurrent
+    same-path acquire through another object can never clobber it) and
+    exports it through the ``tpudra_flock_wait_seconds`` histogram
+    (labelled by lock file name) — the lock-contention signal the
+    bind-path dashboards key on."""
     from prometheus_client import REGISTRY
 
     path = str(tmp_path / "waity.lock")
@@ -139,32 +142,63 @@ def test_acquire_records_wait_metric(tmp_path):
 
     before = count()
     lock = Flock(path)
-    with lock(timeout=1):
-        assert lock.last_wait >= 0.0
+    with lock(timeout=1) as waited:
+        assert waited >= 0.0
     assert count() == before + 1
 
-    # A contended acquire records a wait at least as long as the hold.
+    # A contended acquire reports a wait at least as long as the hold.
     sentinel = str(tmp_path / "held")
     p = _spawn_holder(path, sentinel, "time.sleep(0.3)\nlock.release()\n")
     try:
         assert _wait_file(sentinel)
         other = Flock(path, poll_interval=0.01)
-        with other(timeout=10):
+        with other(timeout=10) as other_wait:
             pass
-        assert other.last_wait > 0.05
+        assert other_wait > 0.05
         assert count() == before + 2
     finally:
         p.wait(timeout=10)
 
-    # A timed-out wait is still a sample — exactly the ones a contention
-    # investigation needs.
+    # A timed-out wait is still a histogram sample — exactly the ones a
+    # contention investigation needs (acquire raises, so the wait is only
+    # observable through the metric).
     p = _spawn_holder(path, sentinel + "2", "time.sleep(0.6)\nlock.release()\n")
     try:
         assert _wait_file(sentinel + "2")
         loser = Flock(path, poll_interval=0.01)
         with pytest.raises(FlockTimeout):
             loser.acquire(timeout=0.05)
-        assert loser.last_wait >= 0.05
         assert count() == before + 3
     finally:
         p.wait(timeout=10)
+
+
+def test_acquire_wait_is_per_acquire_not_instance_state(tmp_path):
+    """Two sequential acquires through DISTINCT objects on one path each
+    get their own wait value; the second (contended) acquire's wait cannot
+    leak into the first object's result — the regression that existed when
+    the wait lived on the instance (``last_wait``) and was read after
+    release, racing a concurrent same-path acquire."""
+    path = str(tmp_path / "per.lock")
+    first = Flock(path)
+    uncontended = first.acquire(timeout=1)
+    assert uncontended < 0.05
+
+    results = {}
+
+    def contender():
+        lock = Flock(path, poll_interval=0.005)
+        results["wait"] = lock.acquire(timeout=10)
+        lock.release()
+
+    t = threading.Thread(target=contender)
+    t.start()
+    time.sleep(0.15)
+    first.release()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # The contender's wait reflects ITS contention only.
+    assert results["wait"] >= 0.1
+    # And the first acquire's sample is untouched by the second acquire
+    # (it was returned by value; there is no shared field to clobber).
+    assert uncontended < 0.05

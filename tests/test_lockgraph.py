@@ -1,0 +1,460 @@
+"""tpudra-lockgraph (tpudra/analysis/{callgraph,lockmodel,witness}.py):
+the whole-program lock rules, the acquisition-graph pins that keep the
+bind path's lock discipline from regressing, the generated lock-order
+doc, and the witness-merge semantics.
+
+The fixture corpus (tests/fixtures/lint/{bad,good}/lockgraph*.py) rides
+the exact-(line, rule) machinery in tests/test_lint.py; this file covers
+everything beyond per-fixture precision."""
+
+from __future__ import annotations
+
+import ast
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpudra.analysis.engine import DEFAULT_ROOTS, ParsedModule, lint_modules, parse_paths
+from tpudra.analysis.lockmodel import (
+    BIND_PATH_LOCKS,
+    LockAnnotations,
+    analyze_modules,
+)
+from tpudra.analysis.rules import lockgraph_rules
+from tpudra.analysis.witness import build_graph, emit_markdown, merge
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_module(source: str, path: str = "mod_under_test.py") -> ParsedModule:
+    return ParsedModule(path=path, source=source, tree=ast.parse(source))
+
+
+@pytest.fixture(scope="module")
+def graph():
+    """The static lock graph of the tpudra package, built once."""
+    return build_graph(os.path.join(REPO_ROOT, "tpudra"))
+
+
+# ------------------------------------------------------------------ CI gates
+
+
+def test_lockgraph_is_clean():
+    """The whole-program gate, mirroring test_repo_is_clean: zero
+    LOCK-CYCLE / BLOCK-UNDER-LOCK-IP / FLOCK-INVERSION findings at HEAD
+    (every deliberate exception carries a reasoned suppression)."""
+    roots = [
+        p
+        for p in (os.path.join(REPO_ROOT, r) for r in DEFAULT_ROOTS)
+        if os.path.exists(p)
+    ]
+    modules, parse_findings = parse_paths(roots)
+    findings = lint_modules(modules, parse_findings, rules=lockgraph_rules())
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_lock_order_doc_is_fresh(graph):
+    """docs/lock-order.md is generated; a lock or edge change must ship a
+    regenerated table (`make lockgraph-docs`)."""
+    doc = os.path.join(REPO_ROOT, "docs", "lock-order.md")
+    with open(doc, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == emit_markdown(graph), (
+        "docs/lock-order.md is stale — run `make lockgraph-docs` and commit "
+        "the result"
+    )
+
+
+# ------------------------------------------ acquisition-order pins (ISSUE 4)
+
+
+def test_bind_path_chain_edges_present(graph):
+    """The bind path's designed hierarchy is visible to the model: the
+    per-claim flock wraps the node lock wraps the checkpoint RMW wraps the
+    read-cache mutex.  If any of these edges vanish, the analyzer stopped
+    seeing the bind path and every 'clean' verdict is vacuous."""
+    edges = graph.edge_ids()
+    for pair in [
+        ("flock:claim-uid", "flock:pu.lock"),
+        ("flock:claim-uid", "flock:cp.lock"),
+        ("flock:pu.lock", "flock:cp.lock"),
+        ("flock:cp.lock", "checkpoint.cache_lock"),
+        ("driver.publish_lock", "driver.unhealthy_lock"),
+        ("informer.dispatch_lock", "informer.store_lock"),
+    ]:
+        assert pair in edges, f"expected acquisition edge {pair[0]} → {pair[1]}"
+
+
+def test_informer_dispatch_store_order_pinned(graph):
+    """Pin (ISSUE 4 satellite): between the watch and resync threads the
+    order is dispatch_lock → store_lock, never the reverse.  The watch
+    thread updates the store and RELEASES it before dispatching; the
+    resync thread holds the dispatch mutex across its at-dispatch store
+    re-read.  A store→dispatch edge would complete a deadlock cycle with
+    the resync thread."""
+    assert ("informer.dispatch_lock", "informer.store_lock") in graph.edge_ids()
+    assert ("informer.store_lock", "informer.dispatch_lock") not in graph.edge_ids()
+
+
+def test_health_publish_signal_order_pinned(graph):
+    """Pin (ISSUE 4 satellite): the health→publish signal path releases
+    ``_unhealthy_lock`` BEFORE touching the publish condition, and the
+    publisher takes the unhealthy lock only inside the publish lock.  An
+    unhealthy→publish edge would deadlock the health thread against a
+    concurrent publisher holding publish_lock and wanting the unhealthy
+    snapshot."""
+    edges = graph.edge_ids()
+    assert ("driver.unhealthy_lock", "driver.publish_cond") not in edges
+    assert ("driver.unhealthy_lock", "driver.publish_lock") not in edges
+    assert ("driver.publish_lock", "driver.unhealthy_lock") in edges
+
+
+def test_publish_lock_is_top_of_hierarchy(graph):
+    """The BLOCK-UNDER-LOCK-IP suppressions in publish_resources lean on
+    this: nothing acquires the publish lock while holding anything else,
+    so blocking inside it can stall only other publishers, never the bind
+    path."""
+    incoming = [a for (a, b) in graph.edge_ids() if b == "driver.publish_lock"]
+    assert incoming == [], f"publish_lock gained holders above it: {incoming}"
+
+
+def test_no_in_process_lock_above_bind_flocks(graph):
+    """FLOCK-INVERSION's repo-wide guarantee, as a pin: no in-process lock
+    is ever held when the bind-path flocks are acquired."""
+    for flock_id in ("flock:pu.lock", "flock:cp.lock", "flock:claim-uid"):
+        holders = [
+            a
+            for (a, b) in graph.edge_ids()
+            if b == flock_id and graph.locks[a].in_process
+        ]
+        assert holders == [], f"in-process locks held across {flock_id}: {holders}"
+
+
+# ----------------------------------------------------- model unit behaviors
+
+
+def test_interprocedural_cycle_detected():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a_lock:\n"
+        "            self.take_b()\n"
+        "    def take_b(self):\n"
+        "        with self._b_lock: pass\n"
+        "    def two(self):\n"
+        "        with self._b_lock:\n"
+        "            self.take_a()\n"
+        "    def take_a(self):\n"
+        "        with self._a_lock: pass\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    assert [f.rule_id for f in result.findings] == ["LOCK-CYCLE"]
+
+
+def test_contextmanager_yield_held_propagates():
+    """Locks held at a @contextmanager's yield are held over the caller's
+    with body — the Driver._claims_serialized/_locked_pu shape."""
+    src = (
+        "import contextlib, threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._outer_lock = threading.Lock()\n"
+        "        self._inner_lock = threading.Lock()\n"
+        "    @contextlib.contextmanager\n"
+        "    def scoped(self):\n"
+        "        with self._outer_lock:\n"
+        "            yield\n"
+        "    def work(self):\n"
+        "        with self.scoped():\n"
+        "            with self._inner_lock: pass\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    outer = "mod_under_test.C._outer_lock"
+    inner = "mod_under_test.C._inner_lock"
+    assert (outer, inner) in result.edge_ids()
+
+
+def test_acquires_annotation_threads_held_lock():
+    """# tpudra-lock: acquires=ID on a def marks callers as holding ID —
+    the _acquire_claim_lock 'returns a held lock' escape hatch."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._tail_lock = threading.Lock()\n"
+        "    # tpudra-lock: acquires=mod.handle returns the held lock\n"
+        "    def grab(self):\n"
+        "        return object()\n"
+        "    def work(self):\n"
+        "        h = self.grab()\n"
+        "        with self._tail_lock: pass\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    assert ("mod.handle", "mod_under_test.C._tail_lock") in result.edge_ids()
+
+
+def test_rlock_reentry_is_not_a_cycle():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._r_lock = threading.RLock()\n"
+        "    def outer(self):\n"
+        "        with self._r_lock:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._r_lock: pass\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    assert result.findings == []
+
+
+def test_plain_lock_self_reacquire_is_a_cycle():
+    """The RLock exemption must NOT extend to plain Locks: re-acquiring a
+    held Lock through a helper is a guaranteed self-deadlock."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._p_lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._p_lock:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._p_lock: pass\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    assert [f.rule_id for f in result.findings] == ["LOCK-CYCLE"]
+
+
+def test_nonblocking_annotation_stops_descent():
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._nb_lock = threading.Lock()\n"
+        "    def work(self):\n"
+        "        with self._nb_lock:\n"
+        "            self.helper()\n"
+        "    # tpudra-lock: nonblocking modeled-by-design sleep\n"
+        "    def helper(self):\n"
+        "        time.sleep(1)\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    assert result.findings == []
+
+
+def test_returns_lock_resolves_through_deep_wrappers_order_independently():
+    """Regression: returns_lock/acq_star results are full-depth and never
+    cached truncated — querying a deep wrapper chain FIRST must not poison
+    the cache for the inner factory (analysis order must not decide
+    whether a flock resolves)."""
+    src = (
+        "from tpudra.flock import Flock\n"
+        "class C:\n"
+        "    def a(self): return self.b()\n"
+        "    def b(self): return self.c()\n"
+        "    def c(self): return self.d()\n"
+        "    def d(self): return self.e()\n"
+        "    def e(self):\n"
+        "        return Flock('/var/lock/deep.lock')\n"
+        "    def use(self):\n"
+        "        with self.a()(timeout=1):\n"
+        "            pass\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    assert "flock:deep.lock" in result.locks
+
+
+def test_lockgraph_only_lane_ignores_unreasoned_other_rule_suppressions(tmp_path):
+    """Regression: `--lockgraph` (make lockgraph, the quick concurrency
+    loop) reports ONLY the lock rules — a reason-less suppression of a
+    per-module rule is the full lane's SUPPRESS-REASON business."""
+    mod = mk_module(
+        "x = 1  # tpudra-lint: disable=SHARED-STATE\n", "suppressed.py"
+    )
+    findings = lint_modules([mod], rules=lockgraph_rules())
+    assert findings == []
+    # The full run still flags it.
+    full = lint_modules([mod])
+    assert [f.rule_id for f in full] == ["SUPPRESS-REASON"]
+
+
+def test_lock_annotations_parse():
+    ann = LockAnnotations(
+        "x = 1  # tpudra-lock: id=flock:thing family because reasons\n"
+        "# tpudra-lock: nonblocking modeled\n"
+        "y = 2\n"
+        "z = 3  # tpudra-lock: acquires=some.lock returns held\n"
+    )
+    d1 = ann.at(1)
+    assert d1.lock_id == "flock:thing" and d1.family
+    assert ann.at(2).nonblocking  # comment-only line
+    assert ann.at(3).nonblocking  # ... covers the next line
+    assert ann.at(4).acquires == "some.lock"
+
+
+# ----------------------------------------------------------- witness merge
+
+
+def _write_log(tmp_path, records):
+    import json
+
+    path = str(tmp_path / "witness.jsonl")
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_witness_merge_clean(graph, tmp_path):
+    log = _write_log(
+        tmp_path,
+        [
+            {"t": "lock", "lock": "flock:pu.lock"},
+            {"t": "edge", "from": "flock:pu.lock", "to": "flock:cp.lock"},
+        ],
+    )
+    report = merge(graph, log)
+    assert report.ok
+    assert ("flock:pu.lock", "flock:cp.lock") in report.covered
+
+
+def test_witness_merge_model_gap_fails(graph, tmp_path):
+    """An edge the test suite exhibited but the model lacks must FAIL —
+    it means every other static verdict is built on a hole."""
+    log = _write_log(
+        tmp_path,
+        [{"t": "edge", "from": "flock:cp.lock", "to": "flock:pu.lock"}],
+    )
+    report = merge(graph, log)
+    assert not report.ok
+    assert ("flock:cp.lock", "flock:pu.lock") in report.model_gaps
+
+
+def test_witness_merge_cycle_fails(graph, tmp_path):
+    log = _write_log(
+        tmp_path,
+        [
+            {"t": "edge", "from": "flock:pu.lock", "to": "flock:cp.lock"},
+            {"t": "edge", "from": "flock:cp.lock", "to": "flock:pu.lock"},
+        ],
+    )
+    report = merge(graph, log)
+    assert report.witnessed_cycles
+    assert not report.ok
+
+
+def test_witness_coverage_counts_witnessable_only(graph):
+    """Edges between uninstrumented (plain threading) locks can never be
+    witnessed and must not be in the coverage denominator."""
+    witnessable = graph.witnessable_edge_ids()
+    for a, b in witnessable:
+        assert graph.locks[a].witnessable and graph.locks[b].witnessable
+    # The bind-path subset is witnessable by construction.
+    bind = {
+        e
+        for e in graph.edge_ids()
+        if e[0] in BIND_PATH_LOCKS and e[1] in BIND_PATH_LOCKS
+    }
+    assert bind <= witnessable
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tpudra.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=300,
+    )
+
+
+def test_cli_lockgraph_clean_at_head():
+    proc = _run_cli("--lockgraph")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tpudra-lockgraph: clean" in proc.stdout
+
+
+def test_cli_emit_dot(tmp_path):
+    out = str(tmp_path / "order.md")
+    proc = _run_cli("--emit-dot", out)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        content = f.read()
+    assert "## Canonical acquisition order" in content
+    assert "flock:pu.lock" in content
+
+
+def test_cli_witness_missing_log_is_usage_error():
+    proc = _run_cli("--witness", "no/such/log.jsonl")
+    assert proc.returncode == 2
+
+
+def test_cli_graph_modes_reject_lint_arguments(tmp_path):
+    """--witness/--emit-dot operate on the package's static model; lint
+    arguments must be rejected, not silently ignored."""
+    out = str(tmp_path / "o.md")
+    for extra in (["--json"], ["--lockgraph"], ["tpudra/plugin"]):
+        proc = _run_cli("--emit-dot", out, *extra)
+        assert proc.returncode == 2, (extra, proc.stdout, proc.stderr)
+
+
+def test_cd_pu_lock_is_a_distinct_witness_class(graph):
+    """Regression: the CD plugin's node flock shares the pu.lock file NAME
+    but is its own lock class — statically AND at runtime (witness_id is
+    passed), so CD runs can never mark main-driver bind edges covered."""
+    assert "flock:cd-pu.lock" in graph.locks
+    assert graph.locks["flock:cd-pu.lock"].kind == "flock"
+    import inspect
+
+    from tpudra.cdplugin import driver as cd_driver
+
+    src = inspect.getsource(cd_driver.CDDriver._pu_lock)
+    assert 'witness_id="flock:cd-pu.lock"' in src
+
+
+def test_acquires_annotation_of_in_process_lock_keeps_kind():
+    """Regression: an acquires= ID with no registered construction defaults
+    by the flock: prefix convention — a plain ID is an in-process lock, so
+    blocking under it IS flagged and no false FLOCK-INVERSION fires."""
+    src = (
+        "import threading, time\n"
+        "class C:\n"
+        "    # tpudra-lock: acquires=c.handoff returns the held lock\n"
+        "    def grab(self):\n"
+        "        return object()\n"
+        "    def work(self):\n"
+        "        h = self.grab()\n"
+        "        self.slow()\n"
+        "    def slow(self):\n"
+        "        time.sleep(1)\n"
+    )
+    result = analyze_modules([mk_module(src)])
+    rules_hit = sorted(f.rule_id for f in result.findings)
+    assert rules_hit == ["BLOCK-UNDER-LOCK-IP"], result.findings
+
+
+def test_cli_witness_merge(tmp_path):
+    import json
+
+    log = str(tmp_path / "w.jsonl")
+    with open(log, "w") as f:
+        f.write(
+            json.dumps(
+                {"t": "edge", "from": "flock:pu.lock", "to": "flock:cp.lock"}
+            )
+            + "\n"
+        )
+    proc = _run_cli("--witness", log)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "witness merge: OK" in proc.stdout

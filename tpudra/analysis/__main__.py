@@ -1,7 +1,16 @@
-"""CLI for tpudra-lint: ``python -m tpudra.analysis [paths...]``.
+"""CLI for tpudra-lint + tpudra-lockgraph: ``python -m tpudra.analysis``.
 
-Exit status: 0 clean, 1 findings, 2 usage/internal error — the contract
-``hack/lint.sh`` and the ``make lint`` gate build on.
+One shared parse pass feeds both the per-module lint rules and the
+whole-program lock analysis.  Extra modes:
+
+- ``--lockgraph``: only the lock rules (the ``make lockgraph`` lane);
+- ``--witness LOG``: merge a runtime witness log (tpudra/lockwitness.py)
+  into the static graph — witnessed cycles and model gaps fail;
+- ``--emit-dot [PATH]``: regenerate docs/lock-order.md from the model.
+
+Exit status: 0 clean, 1 findings (or a failed witness merge), 2 usage/
+internal error — the contract ``hack/lint.sh`` and ``make lint``/`
+``make lockgraph`` build on.
 """
 
 from __future__ import annotations
@@ -11,7 +20,7 @@ import json
 import os
 import sys
 
-from tpudra.analysis.engine import DEFAULT_ROOTS, lint_paths
+from tpudra.analysis.engine import DEFAULT_ROOTS, lint_modules, parse_paths
 
 
 def _repo_root() -> str:
@@ -40,6 +49,27 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print rule IDs and exit"
     )
+    parser.add_argument(
+        "--lockgraph",
+        action="store_true",
+        help="run only the whole-program lock rules (LOCK-CYCLE, "
+        "BLOCK-UNDER-LOCK-IP, FLOCK-INVERSION)",
+    )
+    parser.add_argument(
+        "--witness",
+        metavar="LOG",
+        help="merge a TPUDRA_LOCK_WITNESS jsonl log into the static lock "
+        "graph: witnessed cycles / model gaps fail, unwitnessed static "
+        "edges are reported as coverage",
+    )
+    parser.add_argument(
+        "--emit-dot",
+        nargs="?",
+        const="docs/lock-order.md",
+        metavar="PATH",
+        help="regenerate the lock-order document (default docs/lock-order.md) "
+        "from the static graph and exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -52,6 +82,28 @@ def main(argv: list[str] | None = None) -> int:
             "reason (engine-level check)"
         )
         return 0
+
+    if args.witness is not None or args.emit_dot is not None:
+        # Graph modes operate on the tpudra package's static model; the
+        # lint-mode arguments have no meaning there — reject rather than
+        # silently ignore them.
+        rejected = [
+            name
+            for name, present in (
+                ("--json", args.json),
+                ("--lockgraph", args.lockgraph),
+                ("paths", bool(args.paths)),
+            )
+            if present
+        ]
+        if rejected:
+            print(
+                "tpudra-lockgraph: --witness/--emit-dot cannot be combined "
+                f"with {', '.join(rejected)}",
+                file=sys.stderr,
+            )
+            return 2
+        return _graph_mode(args)
 
     paths = args.paths
     if not paths:
@@ -68,7 +120,13 @@ def main(argv: list[str] | None = None) -> int:
         print(f"tpudra-lint: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    findings = lint_paths(paths)
+    rules = None
+    if args.lockgraph:
+        from tpudra.analysis.rules import lockgraph_rules
+
+        rules = lockgraph_rules()
+    modules, parse_findings = parse_paths(paths)
+    findings = lint_modules(modules, parse_findings, rules=rules)
     if args.json:
         print(
             json.dumps(
@@ -86,15 +144,49 @@ def main(argv: list[str] | None = None) -> int:
             )
         )
     else:
+        name = "tpudra-lockgraph" if args.lockgraph else "tpudra-lint"
         for f in findings:
             print(f.render())
         n = len(findings)
         print(
-            f"tpudra-lint: {n} finding{'s' if n != 1 else ''}"
+            f"{name}: {n} finding{'s' if n != 1 else ''}"
             if n
-            else "tpudra-lint: clean"
+            else f"{name}: clean"
         )
     return 1 if findings else 0
+
+
+def _graph_mode(args) -> int:
+    """--witness / --emit-dot: operate on the static lock graph of the
+    tpudra package (the lockgraph's scope) rather than on lint findings."""
+    from tpudra.analysis.witness import build_graph, emit_markdown, merge
+
+    root = _repo_root()
+    if args.witness is not None and not os.path.exists(args.witness):
+        # Before the (multi-second) whole-program pass: a typo'd log path
+        # is a usage error, not a reason to build and maybe rewrite docs.
+        print(
+            f"tpudra-lockgraph: no witness log at {args.witness}",
+            file=sys.stderr,
+        )
+        return 2
+    result = build_graph(os.path.join(root, "tpudra"))
+    rc = 0
+    if args.emit_dot is not None:
+        out_path = args.emit_dot
+        if not os.path.isabs(out_path):
+            out_path = os.path.join(root, out_path)
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(emit_markdown(result))
+        print(
+            f"tpudra-lockgraph: wrote {out_path} "
+            f"({len(result.locks)} locks, {len(result.edges)} edges)"
+        )
+    if args.witness is not None:
+        report = merge(result, args.witness)
+        print(report.render())
+        rc = 0 if report.ok else 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,372 @@
+"""Module-qualified, class-method-aware call graph over parsed modules.
+
+The resolution layer under tpudra-lockgraph (lockmodel.py): given the one
+shared parse pass (engine.parse_paths), build a whole-program view of
+
+- which functions/methods exist (``mod:Class.method`` / ``mod:function``),
+- what each module imports (so ``metrics.observe_phase`` resolves to
+  ``tpudra.metrics.observe_phase``),
+- what type each ``self.attr`` holds (from ``self.x = ClassName(...)``
+  constructions, ``self.x = param`` with an annotated parameter, and
+  ``self.x: T = ...`` annotations),
+- and which definition a call expression lands on.
+
+Resolution is deliberately conservative: a call that cannot be resolved
+through imports, ``self``, attribute types, or local constructor inference
+falls back to a *unique-name* match — linked only when exactly one class
+in the corpus defines a method of that name.  Common names (``start``,
+``get``, ``wait``) therefore resolve to nothing rather than to everything,
+which errs toward missing edges instead of inventing lock-order cycles
+that do not exist.  The runtime witness (tpudra/lockwitness.py) is the
+cross-check for the missing-edge direction: an edge the model lacks but
+the test suite exhibits fails the witness merge as a model gap.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra.analysis.engine import ParsedModule
+
+
+def module_name(path: str) -> str:
+    """Dotted module name of a file path: anything under a ``tpudra``
+    directory gets its real package path (``tpudra.plugin.driver``);
+    everything else (bench.py, tools, fixtures) its bare stem."""
+    parts = os.path.normpath(path).split(os.sep)
+    stem = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    if "tpudra" in parts[:-1]:
+        idx = parts.index("tpudra")
+        pkg = parts[idx:-1]
+        if stem == "__init__":
+            return ".".join(pkg)
+        return ".".join(pkg + [stem])
+    return stem
+
+
+def short_module(mod: str) -> str:
+    """The human prefix used in derived lock IDs: ``tpudra.kube.informer``
+    → ``kube.informer`` (lock IDs should read at a glance, and every lock
+    in this repo lives under tpudra)."""
+    return mod[len("tpudra."):] if mod.startswith("tpudra.") else mod
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "tpudra.plugin.driver:Driver.prepare_resource_claims"
+    name: str
+    module: str  # dotted module name
+    path: str  # file path (findings anchor here)
+    node: ast.FunctionDef
+    class_name: str = ""  # "" for module-level functions
+    decorators: tuple[str, ...] = ()
+
+    @property
+    def is_contextmanager(self) -> bool:
+        return any(d.endswith("contextmanager") for d in self.decorators)
+
+
+@dataclass
+class ClassInfo:
+    qualname: str  # "tpudra.plugin.driver:Driver"
+    name: str
+    module: str
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    #: self.attr → class qualname (best effort)
+    attr_types: dict[str, str] = field(default_factory=dict)
+    bases: tuple[str, ...] = ()  # unresolved base-name strings
+
+
+def _decorator_names(node: ast.FunctionDef) -> tuple[str, ...]:
+    out = []
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        parts: list[str] = []
+        while isinstance(target, ast.Attribute):
+            parts.append(target.attr)
+            target = target.value
+        if isinstance(target, ast.Name):
+            parts.append(target.id)
+        out.append(".".join(reversed(parts)))
+    return tuple(out)
+
+
+class CallGraph:
+    def __init__(self, modules: list[ParsedModule]):
+        self.modules = modules
+        #: qualname → FunctionInfo
+        self.functions: dict[str, FunctionInfo] = {}
+        #: class qualname → ClassInfo
+        self.classes: dict[str, ClassInfo] = {}
+        #: bare class name → [class qualnames]  (import-free lookup)
+        self._class_by_name: dict[str, list[str]] = {}
+        #: method name → [FunctionInfo] across every class (unique-name fallback)
+        self._method_by_name: dict[str, list[FunctionInfo]] = {}
+        #: module → {alias → dotted target} for both module and symbol imports
+        self._imports: dict[str, dict[str, str]] = {}
+        #: dotted module → {name → FunctionInfo} module-level functions
+        self._module_functions: dict[str, dict[str, FunctionInfo]] = {}
+        for m in modules:
+            self._index_module(m)
+        # Attribute types need the class table complete, so second pass.
+        for info in list(self.classes.values()):
+            self._infer_attr_types(info)
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index_module(self, module: ParsedModule) -> None:
+        mod = module_name(module.path)
+        imports: dict[str, str] = {}
+        self._imports[mod] = imports
+        self._module_functions.setdefault(mod, {})
+        for node in module.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(module, mod, node, class_name="")
+            elif isinstance(node, ast.ClassDef):
+                self._add_class(module, mod, node)
+
+    def _add_function(
+        self, module: ParsedModule, mod: str, node, class_name: str
+    ) -> FunctionInfo:
+        qual = (
+            f"{mod}:{class_name}.{node.name}" if class_name else f"{mod}:{node.name}"
+        )
+        info = FunctionInfo(
+            qualname=qual,
+            name=node.name,
+            module=mod,
+            path=module.path,
+            node=node,
+            class_name=class_name,
+            decorators=_decorator_names(node),
+        )
+        self.functions[qual] = info
+        if class_name:
+            self._method_by_name.setdefault(node.name, []).append(info)
+        else:
+            self._module_functions[mod][node.name] = info
+        return info
+
+    def _add_class(self, module: ParsedModule, mod: str, node: ast.ClassDef) -> None:
+        qual = f"{mod}:{node.name}"
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        info = ClassInfo(qualname=qual, name=node.name, module=mod, bases=tuple(bases))
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods[child.name] = self._add_function(
+                    module, mod, child, class_name=node.name
+                )
+        self.classes[qual] = info
+        self._class_by_name.setdefault(node.name, []).append(qual)
+
+    # -- type/derivation helpers --------------------------------------------
+
+    def resolve_class_name(self, name: str, mod: str) -> Optional[str]:
+        """A bare class name, as visible from module ``mod``, to its class
+        qualname: local definition first, then imports, then a unique
+        global match."""
+        if f"{mod}:{name}" in self.classes:
+            return f"{mod}:{name}"
+        target = self._imports.get(mod, {}).get(name)
+        if target:
+            tmod, _, tname = target.rpartition(".")
+            if f"{tmod}:{tname}" in self.classes:
+                return f"{tmod}:{tname}"
+        quals = self._class_by_name.get(name, [])
+        if len(quals) == 1:
+            return quals[0]
+        return None
+
+    def _annotation_class(self, annotation, mod: str) -> Optional[str]:
+        """``param: ClassName`` / ``param: Optional[ClassName]`` → qualname."""
+        if annotation is None:
+            return None
+        node = annotation
+        if isinstance(node, ast.Subscript):  # Optional[X] / list[X] → X
+            node = node.slice
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # String annotation: take the last dotted segment.
+            return self.resolve_class_name(node.value.split(".")[-1], mod)
+        if isinstance(node, ast.Attribute):
+            return self.resolve_class_name(node.attr, mod)
+        if isinstance(node, ast.Name):
+            return self.resolve_class_name(node.id, mod)
+        return None
+
+    def _constructed_class(self, value, mod: str) -> Optional[str]:
+        """First class construction inside an assigned value expression:
+        ``DeviceState(...)`` → its qualname; handles ``x or Fallback(...)``."""
+        for node in ast.walk(value):
+            if isinstance(node, ast.Call):
+                name = ""
+                if isinstance(node.func, ast.Name):
+                    name = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                if name and name[0].isupper():
+                    qual = self.resolve_class_name(name, mod)
+                    if qual is not None:
+                        return qual
+        return None
+
+    def _infer_attr_types(self, info: ClassInfo) -> None:
+        for method in info.methods.values():
+            params: dict[str, Optional[str]] = {}
+            args = method.node.args
+            for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+                params[a.arg] = self._annotation_class(a.annotation, info.module)
+            for node in ast.walk(method.node):
+                target = None
+                value = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target, value = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    target, value = node.target, node.value
+                if (
+                    not isinstance(target, ast.Attribute)
+                    or not isinstance(target.value, ast.Name)
+                    or target.value.id != "self"
+                    or target.attr in info.attr_types
+                ):
+                    continue
+                if isinstance(node, ast.AnnAssign):
+                    qual = self._annotation_class(node.annotation, info.module)
+                    if qual:
+                        info.attr_types[target.attr] = qual
+                        continue
+                if value is None:
+                    continue
+                if isinstance(value, ast.Name) and value.id in params:
+                    if params[value.id]:
+                        info.attr_types[target.attr] = params[value.id]  # type: ignore[assignment]
+                    continue
+                qual = self._constructed_class(value, info.module)
+                if qual:
+                    info.attr_types[target.attr] = qual
+
+    # -- call resolution ----------------------------------------------------
+
+    def class_of(self, fn: FunctionInfo) -> Optional[ClassInfo]:
+        if not fn.class_name:
+            return None
+        return self.classes.get(f"{fn.module}:{fn.class_name}")
+
+    def method_on(self, class_qual: str, name: str) -> Optional[FunctionInfo]:
+        """Method lookup with one level of (corpus-resolvable) base-class
+        fallback — enough for the repo's shallow hierarchies."""
+        info = self.classes.get(class_qual)
+        if info is None:
+            return None
+        if name in info.methods:
+            return info.methods[name]
+        for base in info.bases:
+            base_qual = self.resolve_class_name(base, info.module)
+            if base_qual and base_qual != class_qual:
+                found = self.classes.get(base_qual, ClassInfo("", "", "")).methods.get(name)
+                if found:
+                    return found
+        return None
+
+    #: Names never resolved by the unique-name fallback: they collide with
+    #: file/socket/dict/thread object protocols, so "exactly one class in
+    #: the corpus defines it" proves nothing about an untyped receiver
+    #: (``f.read()`` on a local file handle must not resolve to
+    #: ``CheckpointManager.read``).  Typed receivers (self.attr, params,
+    #: locals) still resolve these precisely.
+    _FALLBACK_BLOCKLIST = frozenset(
+        {
+            "read", "write", "close", "open", "flush", "get", "set", "pop",
+            "put", "update", "add", "remove", "discard", "clear", "append",
+            "copy", "send", "recv", "acquire", "release", "wait", "notify",
+            "start", "stop", "run", "join", "items", "keys", "values",
+            "strip", "split", "encode", "decode", "submit", "result",
+            "cancel", "done", "poll", "terminate", "kill",
+        }
+    )
+
+    def unique_method(self, name: str) -> Optional[FunctionInfo]:
+        if name in self._FALLBACK_BLOCKLIST:
+            return None
+        owners = self._method_by_name.get(name, [])
+        if len(owners) == 1:
+            return owners[0]
+        return None
+
+    def module_function(self, mod: str, name: str) -> Optional[FunctionInfo]:
+        fn = self._module_functions.get(mod, {}).get(name)
+        if fn is not None:
+            return fn
+        target = self._imports.get(mod, {}).get(name)
+        if target:
+            tmod, _, tname = target.rpartition(".")
+            return self._module_functions.get(tmod, {}).get(tname)
+        return None
+
+    def resolve_call(
+        self,
+        call: ast.Call,
+        ctx: FunctionInfo,
+        local_types: Optional[dict[str, str]] = None,
+    ) -> Optional[FunctionInfo]:
+        """The definition a call lands on, or None.  ``local_types`` maps
+        local variable names to class qualnames (callgraph consumers feed
+        constructor/return inference in)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            qual = self.resolve_class_name(func.id, ctx.module)
+            if qual is not None:  # ClassName(...) → its __init__
+                return self.method_on(qual, "__init__")
+            return self.module_function(ctx.module, func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        recv = func.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and ctx.class_name:
+                found = self.method_on(f"{ctx.module}:{ctx.class_name}", attr)
+                if found:
+                    return found
+            elif local_types and recv.id in local_types:
+                return self.method_on(local_types[recv.id], attr)
+            else:
+                target = self._imports.get(ctx.module, {}).get(recv.id)
+                if target:  # imported module: mod_alias.func(...)
+                    fn = self._module_functions.get(target, {}).get(attr)
+                    if fn is not None:
+                        return fn
+                    # from-imported class used as namespace: Cls.method
+                    tmod, _, tname = target.rpartition(".")
+                    if f"{tmod}:{tname}" in self.classes:
+                        return self.method_on(f"{tmod}:{tname}", attr)
+                cls_qual = self.resolve_class_name(recv.id, ctx.module)
+                if cls_qual is not None:
+                    return self.method_on(cls_qual, attr)
+            return self.unique_method(attr)
+        if (
+            isinstance(recv, ast.Attribute)
+            and isinstance(recv.value, ast.Name)
+            and recv.value.id == "self"
+            and ctx.class_name
+        ):
+            owner = self.classes.get(f"{ctx.module}:{ctx.class_name}")
+            if owner is not None:
+                attr_cls = owner.attr_types.get(recv.attr)
+                if attr_cls is not None:
+                    found = self.method_on(attr_cls, attr)
+                    if found:
+                        return found
+        return self.unique_method(attr)

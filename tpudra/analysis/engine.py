@@ -114,40 +114,66 @@ def lint_source(
 ) -> list[Finding]:
     """Lint one in-memory module (the fixture-test entrypoint)."""
     active = rules if rules is not None else _make_rules()
-    findings = _lint_one(ParsedModule(path=path, source=source, tree=ast.parse(source)), active)
+    module = ParsedModule(path=path, source=source, tree=ast.parse(source))
+    suppressed = Suppressions(module.source)
+    findings = _lint_one(module, active, suppressed, engine_checks=rules is None)
     if rules is None:
         for rule in active:
             findings.extend(rule.finalize())
+    findings = _apply_suppressions(findings, {module.path: suppressed})
+    if rules is None:
         findings.sort()
     return findings
 
 
-def _lint_one(module: ParsedModule, rules: list) -> list[Finding]:
-    suppressed = Suppressions(module.source)
+def _lint_one(
+    module: ParsedModule,
+    rules: list,
+    suppressed: Suppressions,
+    engine_checks: bool = True,
+) -> list[Finding]:
     out: list[Finding] = []
     for rule in rules:
-        for f in rule.check_module(module):
-            if not suppressed.covers(f.line, f.rule_id):
-                out.append(f)
+        out.extend(rule.check_module(module))
+    if not engine_checks:
+        # A custom rule subset (the --lockgraph lane) must report only its
+        # own rules — SUPPRESS-REASON hygiene belongs to the full run.
+        return out
     # A suppression is a design decision; without a reason the next reader
     # cannot tell a considered exception from a silenced mistake.
     for line, rules_str in suppressed.unreasoned:
-        if not suppressed.covers(line, "SUPPRESS-REASON"):
-            out.append(
-                Finding(
-                    module.path, line, 0, "SUPPRESS-REASON",
-                    f"suppression of {rules_str} states no reason — say why "
-                    "the rule is safe to ignore here",
-                )
+        out.append(
+            Finding(
+                module.path, line, 0, "SUPPRESS-REASON",
+                f"suppression of {rules_str} states no reason — say why "
+                "the rule is safe to ignore here",
             )
+        )
     return out
 
 
-def lint_paths(paths: Iterable[str]) -> list[Finding]:
-    """Lint files/directories; returns sorted findings.  Unparseable files
-    surface as SYNTAX findings rather than crashing the run — a file the
-    analyzer cannot read is a finding, not an excuse."""
-    rules = _make_rules()
+def _apply_suppressions(
+    findings: list[Finding], suppressions: dict[str, Suppressions]
+) -> list[Finding]:
+    """Drop findings covered by their file's suppression comments.  Applied
+    once, AFTER finalize(): cross-file rules (lockgraph, metrics
+    registration) anchor their findings at real (path, line) sites, and a
+    suppression there must work exactly like one on an intra-file finding."""
+    out = []
+    for f in findings:
+        sup = suppressions.get(f.path)
+        if sup is not None and sup.covers(f.line, f.rule_id):
+            continue
+        out.append(f)
+    return out
+
+
+def parse_paths(paths: Iterable[str]) -> tuple[list[ParsedModule], list[Finding]]:
+    """One ``ast.parse`` per file, shared by every analysis that runs over
+    the tree (lint rules and the lockgraph both consume these modules —
+    the parse pass is the expensive part of a cold run and must not be
+    paid twice).  Unparseable files surface as SYNTAX findings."""
+    modules: list[ParsedModule] = []
     findings: list[Finding] = []
     for root in paths:
         for filename in _iter_python_files(root):
@@ -161,10 +187,37 @@ def lint_paths(paths: Iterable[str]) -> list[Finding]:
                     Finding(filename, line, 0, "SYNTAX", f"cannot analyze: {e}")
                 )
                 continue
-            findings.extend(
-                _lint_one(ParsedModule(path=filename, source=source, tree=tree), rules)
+            modules.append(ParsedModule(path=filename, source=source, tree=tree))
+    return modules, findings
+
+
+def lint_modules(
+    modules: list[ParsedModule],
+    parse_findings: Optional[list[Finding]] = None,
+    rules: Optional[list] = None,
+) -> list[Finding]:
+    """Run the rule set over already-parsed modules; returns sorted findings."""
+    active = rules if rules is not None else _make_rules()
+    findings: list[Finding] = list(parse_findings or [])
+    suppressions: dict[str, Suppressions] = {}
+    for module in modules:
+        suppressions[module.path] = Suppressions(module.source)
+        findings.extend(
+            _lint_one(
+                module, active, suppressions[module.path],
+                engine_checks=rules is None,
             )
-    for rule in rules:
+        )
+    for rule in active:
         findings.extend(rule.finalize())
+    findings = _apply_suppressions(findings, suppressions)
     findings.sort()
     return findings
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    """Lint files/directories; returns sorted findings.  Unparseable files
+    surface as SYNTAX findings rather than crashing the run — a file the
+    analyzer cannot read is a finding, not an excuse."""
+    modules, parse_findings = parse_paths(paths)
+    return lint_modules(modules, parse_findings)

@@ -1,0 +1,1305 @@
+"""tpudra-lockgraph: the whole-program lock model.
+
+Three layers on top of the call graph (callgraph.py):
+
+1. **Lock registry** — every ``threading.Lock/RLock/Condition`` attribute,
+   every ``lockwitness.make_*`` construction (the instrumented modules), and
+   every ``Flock`` family resolves to a *stable lock ID*.  IDs are lockdep
+   classes, not instances: every ``Informer``'s store lock is one node
+   (``informer.store_lock``), every per-claim flock is ``flock:claim-uid``.
+   Dynamic cases carry a ``# tpudra-lock: id=NAME`` annotation
+   (``vfio.py``'s per-device submutexes, ``Flock`` construction sites whose
+   path is computed).
+
+2. **Held-set propagation** — each function's body becomes an event tree
+   (lock ``with`` blocks, contextmanager expansions, calls, raw
+   acquire/release); walking it with the held set derives the global lock
+   *acquisition graph*: an edge A → B means "B was acquired while A was
+   held", with one concrete call path recorded per edge.
+
+3. **Rules** over that graph:
+
+   - ``LOCK-CYCLE``: a cycle in the acquisition graph is a static deadlock
+     candidate; reported once per cycle with the concrete path pair.
+     Re-entrant locks (RLock, Condition) and ordered families (claim-uid
+     flocks, per-device mutexes — their intra-family order is LOCK-ORDER's
+     ``sorted()`` check) do not self-cycle.
+   - ``BLOCK-UNDER-LOCK-IP``: the interprocedural upgrade of
+     BLOCK-UNDER-LOCK — sleep / subprocess / gRPC / apiserver calls /
+     blocking waits reachable within ``MAX_BLOCK_DEPTH`` calls while an
+     in-process lock is held.  Direct (depth-0) sleep/subprocess/open/stub
+     offenses stay BLOCK-UNDER-LOCK's; this rule owns everything the
+     lexical rule cannot see.
+   - ``FLOCK-INVERSION``: a cross-process flock acquired while an
+     in-process lock is held — the ordering that wedges a node when two
+     driver processes race (the in-process holder waits on a flock held by
+     a process waiting to enter the same in-process critical section).
+
+Annotations (comment on the line, or alone on the line above):
+
+    # tpudra-lock: id=NAME [family] <reason>      — name this lock
+    # tpudra-lock: acquires=NAME <reason>         — calling this function
+    #     leaves NAME held (it returns a held lock to its caller)
+    # tpudra-lock: nonblocking <reason>           — calls to this function
+    #     are not blocking for BLOCK-UNDER-LOCK-IP (modeled by design)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Union
+
+from tpudra.analysis import astutil
+from tpudra.analysis.callgraph import CallGraph, FunctionInfo, short_module
+from tpudra.analysis.engine import Finding, ParsedModule
+
+#: Max call depth BLOCK-UNDER-LOCK-IP follows under a held in-process lock.
+#: (The acquired-locks closure acq_star is full-depth by design — edges are
+#: correctness, not latency; only the blocking rule has a reach horizon.)
+MAX_BLOCK_DEPTH = 4
+
+#: Blocking categories the lexical BLOCK-UNDER-LOCK rule already owns at
+#: depth 0 — re-reporting them here would double-bill one offense.
+_LEXICAL_CATEGORIES = frozenset({"time.sleep", "subprocess", "open()", "gRPC stub call"})
+
+#: The lock IDs that make up the claim-bind path — the witness coverage
+#: criterion (docs/static-analysis.md) is computed over edges whose both
+#: endpoints are in this set.
+BIND_PATH_LOCKS = frozenset(
+    {
+        "flock:pu.lock",
+        "flock:cp.lock",
+        "flock:claim-uid",
+        "checkpoint.cache_lock",
+        "driver.publish_lock",
+        "driver.publish_cond",
+        "driver.unhealthy_lock",
+        "singleflight.lock",
+    }
+)
+
+_ANNOTATION_RE = re.compile(r"#\s*tpudra-lock:\s*(?P<body>.+)")
+_KV_RE = re.compile(r"^(id|acquires)=(\S+)$")
+
+_KUBE_VERBS = frozenset({"get", "list", "create", "patch", "delete", "watch", "apply"})
+_WITNESS_CTORS = {"make_lock": "lock", "make_rlock": "rlock", "make_condition": "cond"}
+_THREADING_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "cond"}
+
+
+@dataclass(frozen=True)
+class LockRef:
+    id: str
+    kind: str  # lock | rlock | cond | flock
+    family: bool = False
+    witnessable: bool = False
+    defined_at: str = ""  # "path:line" of the defining site (docs)
+
+    @property
+    def reentrant(self) -> bool:
+        # threading.Condition's default internal lock IS an RLock.
+        return self.kind in ("rlock", "cond")
+
+    @property
+    def in_process(self) -> bool:
+        return self.kind != "flock"
+
+
+# ---------------------------------------------------------------- annotations
+
+
+@dataclass
+class _Directive:
+    lock_id: Optional[str] = None
+    acquires: Optional[str] = None
+    family: bool = False
+    nonblocking: bool = False
+
+
+class LockAnnotations:
+    """``# tpudra-lock: ...`` directives of one file, by line (a directive
+    alone on its line also covers the next, like lint suppressions)."""
+
+    def __init__(self, source: str):
+        self.by_line: dict[int, _Directive] = {}
+        try:
+            tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _ANNOTATION_RE.search(tok.string)
+                if not m:
+                    continue
+                directive = _Directive()
+                for word in m.group("body").split():
+                    kv = _KV_RE.match(word)
+                    if kv:
+                        if kv.group(1) == "id":
+                            directive.lock_id = kv.group(2)
+                        else:
+                            directive.acquires = kv.group(2)
+                    elif word == "family":
+                        directive.family = True
+                    elif word == "nonblocking":
+                        directive.nonblocking = True
+                    else:
+                        break  # free-text reason starts
+                line = tok.start[0]
+                self.by_line[line] = directive
+                if tok.line.strip().startswith("#"):
+                    self.by_line.setdefault(line + 1, directive)
+        except tokenize.TokenError:
+            pass
+
+    def at(self, *lines: int) -> Optional[_Directive]:
+        for line in lines:
+            d = self.by_line.get(line)
+            if d is not None:
+                return d
+        return None
+
+
+# ------------------------------------------------------------------ event IR
+
+
+@dataclass
+class WithLockEv:
+    lock: LockRef
+    node: ast.AST
+    body: list = field(default_factory=list)
+    #: True when astutil.withitem_lock_kind would classify this item, i.e.
+    #: the lexical BLOCK-UNDER-LOCK rule already polices the body.
+    lexical: bool = False
+
+
+@dataclass
+class WithCMEv:
+    fn: FunctionInfo
+    node: ast.AST
+    body: list = field(default_factory=list)
+
+
+@dataclass
+class CallEv:
+    node: ast.Call
+    fn: Optional[FunctionInfo] = None
+    blocking: str = ""  # nonempty: the call itself blocks (label)
+    wait_on: Optional[LockRef] = None  # cond.wait(...) target
+    wait_exempt: bool = False  # wait on a lock this function lexically holds
+
+
+@dataclass
+class AcqEv:
+    lock: LockRef
+    node: ast.AST
+
+
+@dataclass
+class RelEv:
+    lock: LockRef
+    node: ast.AST
+
+
+@dataclass
+class YieldEv:
+    node: ast.AST
+
+
+Event = Union[WithLockEv, WithCMEv, CallEv, AcqEv, RelEv, YieldEv]
+
+
+# ------------------------------------------------------------------- results
+
+
+@dataclass
+class Edge:
+    src: LockRef
+    dst: LockRef
+    path: str  # file path of the acquisition site
+    line: int
+    chain: str  # human call chain, e.g. "Driver.prepare → _locked_pu"
+
+
+@dataclass
+class LockGraphResult:
+    locks: dict[str, LockRef]
+    edges: dict[tuple[str, str], Edge]
+    findings: list[Finding]
+
+    def edge_ids(self) -> set[tuple[str, str]]:
+        return set(self.edges)
+
+    def witnessable_edge_ids(self) -> set[tuple[str, str]]:
+        """Edges the runtime witness could ever observe: both endpoints
+        are instrumented locks (lockwitness-constructed or flocks)."""
+        return {
+            (a, b)
+            for (a, b), _ in self.edges.items()
+            if self.locks[a].witnessable and self.locks[b].witnessable
+        }
+
+
+# ------------------------------------------------------------------ analysis
+
+
+def _finding(rule_id: str, path: str, node, message: str) -> Finding:
+    return Finding(
+        path=path,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0),
+        rule_id=rule_id,
+        message=message,
+    )
+
+
+def _rel(path: str) -> str:
+    """Paths in messages/docs are repo-relative for stable output."""
+    for marker in ("tpudra" + os.sep, "tools" + os.sep):
+        idx = path.find(os.sep + marker)
+        if idx >= 0:
+            return path[idx + 1:]
+    return os.path.basename(path)
+
+
+class LockModel:
+    """Builds the registry, the per-function event IR, and the acquisition
+    graph over one corpus of parsed modules."""
+
+    def __init__(self, modules: list[ParsedModule], graph: Optional[CallGraph] = None):
+        self.modules = modules
+        self.graph = graph or CallGraph(modules)
+        self.annotations: dict[str, LockAnnotations] = {
+            m.path: LockAnnotations(m.source) for m in modules
+        }
+        #: (class_qual, attr) → LockRef
+        self.attr_locks: dict[tuple[str, str], LockRef] = {}
+        #: (module, name) → LockRef for module-level locks
+        self.module_locks: dict[tuple[str, str], LockRef] = {}
+        #: annotated id → LockRef (the registry of explicitly named locks)
+        self.named: dict[str, LockRef] = {}
+        self.nonblocking: set[str] = set()  # function qualnames
+        self.acquires_ann: dict[str, str] = {}  # function qualname → lock id
+        self._ir: dict[str, list[Event]] = {}
+        self._local_types: dict[str, dict[str, str]] = {}
+        self._local_locks: dict[str, dict[str, LockRef]] = {}
+        self._returns_lock: dict[str, Optional[LockRef]] = {}
+        self._returns_lock_stack: set[str] = set()
+        self._acq_star: dict[str, dict[str, tuple[LockRef, str]]] = {}
+        self._acq_star_stack: set[str] = set()
+        self._block_star: dict[tuple[str, int], list[tuple[str, str, int, str]]] = {}
+        self._cm_yield: dict[str, list[LockRef]] = {}
+        self._kube_quals = self._collect_kube_quals()
+        self._flock_quals = self._collect_flock_quals()
+        self._build_registry()
+
+    # -- registry -----------------------------------------------------------
+
+    def _collect_kube_quals(self) -> set[str]:
+        out = set()
+        for cls_name in ("KubeAPI", "KubeClient"):
+            info = self.graph.classes.get(f"tpudra.kube.client:{cls_name}")
+            if info is None:
+                continue
+            for name, fn in info.methods.items():
+                if name in _KUBE_VERBS:
+                    out.add(fn.qualname)
+        return out
+
+    def _collect_flock_quals(self) -> set[str]:
+        info = self.graph.classes.get("tpudra.flock:Flock")
+        if info is None:
+            return set()
+        return {
+            fn.qualname
+            for name, fn in info.methods.items()
+            if name in ("acquire", "__call__", "__enter__")
+        }
+
+    def _register(self, ref: LockRef) -> LockRef:
+        if ref.id in self.named:
+            return self.named[ref.id]
+        self.named[ref.id] = ref
+        return ref
+
+    def _ref_for_id(self, lock_id: str) -> LockRef:
+        """A LockRef for an annotation-named ID with no registered
+        construction site — the ``flock:`` prefix convention decides the
+        kind (and thus in_process / witnessability), exactly as in
+        resolve_lock's annotation path."""
+        known = self.named.get(lock_id)
+        if known is not None:
+            return known
+        if lock_id.startswith("flock:"):
+            return LockRef(lock_id, "flock", witnessable=True)
+        return LockRef(lock_id, "lock")
+
+    def _lock_ctor_ref(
+        self,
+        call: ast.Call,
+        module: ParsedModule,
+        owner: str,  # derived-id prefix: "Class.attr" site context
+        attr: str,
+    ) -> Optional[LockRef]:
+        """A LockRef when ``call`` constructs a lock, else None."""
+        terminal = astutil.call_name(call)
+        ann = self.annotations[module.path].at(call.lineno)
+        site = f"{_rel(module.path)}:{call.lineno}"
+        mod_short = short_module(_module_of(module))
+        if terminal in _WITNESS_CTORS:
+            lock_id = None
+            if call.args and isinstance(call.args[0], ast.Constant):
+                if isinstance(call.args[0].value, str):
+                    lock_id = call.args[0].value
+            if ann is not None and ann.lock_id:
+                lock_id = ann.lock_id
+            if lock_id is None:
+                lock_id = _derived_id(mod_short, owner, attr)
+            return LockRef(
+                lock_id,
+                _WITNESS_CTORS[terminal],
+                family=bool(ann and ann.family),
+                witnessable=True,
+                defined_at=site,
+            )
+        if terminal in _THREADING_CTORS:
+            lock_id = (
+                ann.lock_id if ann is not None and ann.lock_id
+                else _derived_id(mod_short, owner, attr)
+            )
+            return LockRef(
+                lock_id,
+                _THREADING_CTORS[terminal],
+                family=bool(ann and ann.family),
+                defined_at=site,
+            )
+        if terminal == "Flock" and astutil.is_flockish(call.func):
+            return self._flock_ref(call, module, owner)
+        return None
+
+    def _flock_ref(self, call: ast.Call, module: ParsedModule, owner: str) -> LockRef:
+        ann = self.annotations[module.path].at(call.lineno)
+        site = f"{_rel(module.path)}:{call.lineno}"
+        lock_id = None
+        family = bool(ann and ann.family)
+        if ann is not None and ann.lock_id:
+            lock_id = ann.lock_id
+        if lock_id is None:
+            for kw in call.keywords:
+                if (
+                    kw.arg == "witness_id"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    lock_id = kw.value.value
+        if lock_id is None and call.args:
+            arg = call.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                lock_id = f"flock:{os.path.basename(arg.value)}"
+        if lock_id is None:
+            # Deterministic per-site fallback; annotate sites that a
+            # witness run can reach so runtime and static IDs agree.
+            lock_id = f"flock:{short_module(_module_of(module))}.{owner or '?'}"
+        return LockRef(lock_id, "flock", family=family, witnessable=True, defined_at=site)
+
+    def _build_registry(self) -> None:
+        for module in self.modules:
+            mod = _module_of(module)
+            if mod == "tpudra.lockwitness":
+                # The witness is the measurement apparatus: its sink guard
+                # is held for an append+flush and never across another
+                # acquisition by construction; modeling it would only wrap
+                # every instrumented acquisition in a phantom lock node.
+                # (The module stays in the CALL graph so references into it
+                # resolve instead of degrading to unique-name guesses.)
+                continue
+            for node in module.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    target = node.targets[0]
+                    if isinstance(target, ast.Name) and isinstance(node.value, ast.Call):
+                        ref = self._lock_ctor_ref(node.value, module, "", target.id)
+                        if ref is not None:
+                            self.module_locks[(mod, target.id)] = self._register(ref)
+                elif isinstance(node, ast.ClassDef):
+                    self._register_class_locks(module, mod, node)
+            # Function-level directives: nonblocking / acquires on the def.
+            for fn in self.graph.functions.values():
+                if fn.path != module.path:
+                    continue
+                ann = self.annotations[module.path].at(fn.node.lineno)
+                if ann is None:
+                    continue
+                if ann.nonblocking:
+                    self.nonblocking.add(fn.qualname)
+                if ann.acquires:
+                    self.acquires_ann[fn.qualname] = ann.acquires
+
+    def _register_class_locks(
+        self, module: ParsedModule, mod: str, cls: ast.ClassDef
+    ) -> None:
+        cls_qual = f"{mod}:{cls.name}"
+        for fn_node in cls.body:
+            if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn_node):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                    continue
+                target = node.targets[0]
+                if not isinstance(node.value, ast.Call):
+                    continue
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    ref = self._lock_ctor_ref(
+                        node.value, module, cls.name, target.attr
+                    )
+                    if ref is not None:
+                        self.attr_locks[(cls_qual, target.attr)] = self._register(ref)
+                elif isinstance(target, (ast.Subscript, ast.Name)):
+                    # Dynamic-family (vfio submutexes) and annotated-local
+                    # cases: registered only through their annotation, so
+                    # the id's kind/family flags are known to every caller
+                    # regardless of analysis order.
+                    ann = self.annotations[module.path].at(node.value.lineno)
+                    if ann is not None and ann.lock_id:
+                        ref = self._lock_ctor_ref(node.value, module, cls.name, "?")
+                        if ref is not None:
+                            self._register(ref)
+
+    # -- lock resolution ----------------------------------------------------
+
+    def resolve_lock(
+        self,
+        expr: ast.AST,
+        ctx: FunctionInfo,
+        extra_lines: Iterable[int] = (),
+    ) -> Optional[LockRef]:
+        ann = self.annotations.get(ctx.path, LockAnnotations("")).at(
+            getattr(expr, "lineno", 0), *extra_lines
+        )
+        if ann is not None and ann.lock_id:
+            known = self.named.get(ann.lock_id)
+            if known is not None:
+                return known
+            # Convention: ``flock:`` ids ARE flocks (kind decides both the
+            # in-process rules and witness instrumentability).
+            if ann.lock_id.startswith("flock:"):
+                return self._register(
+                    LockRef(ann.lock_id, "flock", family=ann.family, witnessable=True)
+                )
+            return self._register(
+                LockRef(ann.lock_id, "lock", family=ann.family)
+            )
+        if isinstance(expr, ast.Name):
+            ref = self._locals_of(ctx)[1].get(expr.id)
+            if ref is not None:
+                return ref
+            return self.module_locks.get((ctx.module, expr.id))
+        if isinstance(expr, ast.Attribute):
+            return self._resolve_attr_lock(expr, ctx)
+        if isinstance(expr, ast.Call):
+            terminal = astutil.call_name(expr)
+            if terminal == "Flock" and astutil.is_flockish(expr.func):
+                return self._flock_ref(expr, _module_by_path(self.modules, ctx.path), ctx.name)
+            # Calling a lock object: ``lock(timeout=...)`` / ``Flock(p)(t)``.
+            inner = self.resolve_lock(expr.func, ctx, extra_lines)
+            if inner is not None:
+                return inner
+            callee = self.graph.resolve_call(expr, ctx, self._locals_of(ctx)[0])
+            if callee is not None:
+                return self.returns_lock(callee)
+        return None
+
+    def _resolve_attr_lock(self, expr: ast.Attribute, ctx: FunctionInfo) -> Optional[LockRef]:
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and ctx.class_name:
+            ref = self.attr_locks.get((f"{ctx.module}:{ctx.class_name}", expr.attr))
+            if ref is not None:
+                return ref
+            if self.graph.method_on(f"{ctx.module}:{ctx.class_name}", expr.attr):
+                # A lock-ish NAME that is actually a method (``_pu_lock()``
+                # factories) — resolution belongs to returns_lock().
+                return None
+            if astutil.is_lockish_name(expr.attr):
+                kind = "cond" if "cond" in expr.attr.lower() else "lock"
+                return self._register(
+                    LockRef(
+                        _derived_id(short_module(ctx.module), ctx.class_name, expr.attr),
+                        kind,
+                    )
+                )
+            return None
+        if isinstance(recv, ast.Name):
+            local_cls = self._locals_of(ctx)[0].get(recv.id)
+            if local_cls is not None:
+                return self.attr_locks.get((local_cls, expr.attr))
+        return None
+
+    def returns_lock(self, fn: FunctionInfo) -> Optional[LockRef]:
+        """The lock a function returns (``_pu_lock`` factories), computed
+        to full depth with a recursion-stack cycle guard — a truncated
+        result is NEVER cached, or analysis order would decide whether a
+        lock resolves."""
+        if fn.qualname in self._returns_lock:
+            return self._returns_lock[fn.qualname]
+        if fn.qualname in self._returns_lock_stack:
+            return None  # cycle: break without caching
+        self._returns_lock_stack.add(fn.qualname)
+        try:
+            result: Optional[LockRef] = None
+            for node in ast.walk(fn.node):
+                if not (isinstance(node, ast.Return) and node.value is not None):
+                    continue
+                value = node.value
+                if isinstance(value, ast.Call):
+                    terminal = astutil.call_name(value)
+                    if terminal == "Flock" and astutil.is_flockish(value.func):
+                        result = self._flock_ref(
+                            value, _module_by_path(self.modules, fn.path), fn.name
+                        )
+                        break
+                    callee = self.graph.resolve_call(value, fn, self._locals_of(fn)[0])
+                    if callee is not None:
+                        result = self.returns_lock(callee)
+                        if result is not None:
+                            break
+                elif isinstance(value, ast.Name):
+                    result = self._locals_of(fn)[1].get(value.id)
+                    if result is not None:
+                        break
+        finally:
+            self._returns_lock_stack.discard(fn.qualname)
+        self._returns_lock[fn.qualname] = result
+        return result
+
+    # -- per-function locals + IR -------------------------------------------
+
+    def _locals_of(self, fn: FunctionInfo) -> tuple[dict[str, str], dict[str, LockRef]]:
+        """(local class types, local lock refs) for one function: parameter
+        annotations plus single-assignment constructor/return inference."""
+        if fn.qualname in self._local_types:
+            return self._local_types[fn.qualname], self._local_locks[fn.qualname]
+        types: dict[str, str] = {}
+        locks: dict[str, LockRef] = {}
+        self._local_types[fn.qualname] = types
+        self._local_locks[fn.qualname] = locks
+        args = fn.node.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            qual = self.graph._annotation_class(a.annotation, fn.module)
+            if qual:
+                types[a.arg] = qual
+        for node in ast.walk(fn.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            value = node.value
+            if isinstance(value, ast.Name) and value.id in locks:
+                locks[target.id] = locks[value.id]
+                continue
+            if not isinstance(value, ast.Call):
+                continue
+            terminal = astutil.call_name(value)
+            if terminal in _THREADING_CTORS or terminal in _WITNESS_CTORS:
+                ref = self._lock_ctor_ref(
+                    value, _module_by_path(self.modules, fn.path), fn.name, target.id
+                )
+                if ref is not None:
+                    locks[target.id] = self._register(ref)
+                continue
+            if terminal == "Flock" and astutil.is_flockish(value.func):
+                locks[target.id] = self._flock_ref(
+                    value, _module_by_path(self.modules, fn.path), fn.name
+                )
+                continue
+            callee = self.graph.resolve_call(value, fn, types)
+            if callee is not None:
+                ref = self.returns_lock(callee)
+                if ref is not None:
+                    locks[target.id] = ref
+                    continue
+                cls = self.graph.class_of(callee)
+                if cls is not None and callee.name == "__init__":
+                    types[target.id] = cls.qualname
+        return types, locks
+
+    def ir(self, fn: FunctionInfo) -> list[Event]:
+        if fn.qualname in self._ir:
+            return self._ir[fn.qualname]
+        self._ir[fn.qualname] = []  # recursion guard
+        events = self._build_stmts(fn, fn.node.body, lexical_holds=[])
+        self._ir[fn.qualname] = events
+        return events
+
+    def _build_stmts(
+        self, fn: FunctionInfo, stmts: list, lexical_holds: list[str]
+    ) -> list[Event]:
+        events: list[Event] = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                events.extend(self._build_with(fn, stmt, lexical_holds))
+                continue
+            events.extend(self._build_expr_events(fn, stmt, lexical_holds))
+            for body in _sub_bodies(stmt):
+                events.extend(self._build_stmts(fn, body, lexical_holds))
+        return events
+
+    def _build_with(
+        self, fn: FunctionInfo, stmt, lexical_holds: list[str]
+    ) -> list[Event]:
+        """Nested WithLock/WithCM events for one with statement; unclassified
+        items contribute their context-expression calls and become
+        transparent."""
+        layers: list[Event] = []
+        prefix: list[Event] = []
+        for item in stmt.items:
+            expr = item.context_expr
+            ref = self.resolve_lock(expr, fn, extra_lines=(stmt.lineno,))
+            if ref is not None:
+                kind = astutil.withitem_lock_kind(item)
+                layers.append(
+                    WithLockEv(
+                        ref,
+                        stmt,
+                        lexical=bool(kind is not None and kind[0] == "inproc"),
+                    )
+                )
+                continue
+            if isinstance(expr, ast.Call):
+                callee = self.graph.resolve_call(expr, fn, self._locals_of(fn)[0])
+                if callee is not None and callee.is_contextmanager:
+                    layers.append(WithCMEv(callee, stmt))
+                    prefix.extend(self._calls_in(fn, list(expr.args), lexical_holds))
+                    continue
+            prefix.extend(self._calls_in(fn, [expr], lexical_holds))
+        inner_holds = lexical_holds + [
+            ev.lock.id for ev in layers if isinstance(ev, WithLockEv)
+        ]
+        body = self._build_stmts(fn, stmt.body, inner_holds)
+        for layer in reversed(layers):
+            layer.body = body
+            body = [layer]
+        return prefix + body
+
+    def _build_expr_events(
+        self, fn: FunctionInfo, stmt, lexical_holds: list[str]
+    ) -> list[Event]:
+        exprs = list(_stmt_exprs(stmt))
+        events = self._calls_in(fn, exprs, lexical_holds)
+        for expr in exprs:
+            for node in _walk_no_lambda(expr):
+                if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                    events.append(YieldEv(node))
+        return events
+
+    def _calls_in(
+        self, fn: FunctionInfo, exprs: list, lexical_holds: list[str]
+    ) -> list[Event]:
+        events: list[Event] = []
+        calls: list[ast.Call] = []
+        seen: set[int] = set()
+        for expr in exprs:
+            if expr is None:
+                continue
+            for node in _walk_no_lambda(expr):
+                if isinstance(node, ast.Call) and id(node) not in seen:
+                    seen.add(id(node))
+                    calls.append(node)
+        calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        types, locks = self._locals_of(fn)
+        for call in calls:
+            func = call.func
+            terminal = astutil.call_name(call)
+            # Raw acquire/release on a resolvable lock object.
+            if isinstance(func, ast.Attribute) and terminal in ("acquire", "release"):
+                ref = self.resolve_lock(func.value, fn)
+                if ref is not None:
+                    if terminal == "acquire":
+                        events.append(AcqEv(ref, call))
+                    else:
+                        events.append(RelEv(ref, call))
+                    continue
+            if isinstance(func, ast.Attribute) and terminal in ("wait", "wait_for"):
+                ref = self.resolve_lock(func.value, fn)
+                if ref is not None:
+                    events.append(
+                        CallEv(
+                            call,
+                            wait_on=ref,
+                            wait_exempt=ref.id in lexical_holds,
+                        )
+                    )
+                    continue
+            callee = self.graph.resolve_call(call, fn, types)
+            blocking = self._classify_blocking(call, callee)
+            if callee is not None and self.acquires_ann.get(callee.qualname):
+                held_ref = self._ref_for_id(self.acquires_ann[callee.qualname])
+                events.append(CallEv(call, fn=callee, blocking=blocking))
+                events.append(AcqEv(held_ref, call))
+                continue
+            if callee is not None or blocking:
+                events.append(CallEv(call, fn=callee, blocking=blocking))
+        return events
+
+    def _classify_blocking(
+        self, call: ast.Call, callee: Optional[FunctionInfo]
+    ) -> str:
+        if callee is not None:
+            if callee.qualname in self.nonblocking:
+                return ""
+            if callee.qualname in self._kube_quals:
+                return f"apiserver {callee.name}"
+            if callee.qualname in self._flock_quals:
+                return "flock-acquire"
+        dotted = astutil.dotted_name(call.func)
+        terminal = astutil.call_name(call)
+        if terminal == "sleep":
+            return "time.sleep"
+        if dotted.startswith("subprocess.") or terminal == "Popen":
+            return "subprocess"
+        if isinstance(call.func, ast.Name) and call.func.id == "open":
+            return "open()"
+        receiver_parts = dotted.lower().split(".")[:-1]
+        if any("stub" in part for part in receiver_parts):
+            return "gRPC stub call"
+        if callee is None and terminal == "result":
+            # Future.result().  (``join`` is deliberately absent: nearly
+            # every ``.join`` in this tree is str.join.)
+            return "blocking result()"
+        if callee is None and terminal == "wait" and isinstance(call.func, ast.Attribute):
+            return "blocking wait()"
+        return ""
+
+    # -- summaries ----------------------------------------------------------
+
+    def acq_star(self, fn: FunctionInfo) -> dict[str, tuple[LockRef, str]]:
+        """Every lock transitively acquired by ``fn``: id → (ref, chain).
+        Full-depth with a recursion-stack cycle guard; in-progress callers
+        contribute nothing but are NOT cached truncated (a depth-keyed or
+        partial cache would make edges depend on analysis order)."""
+        if fn.qualname in self._acq_star:
+            return self._acq_star[fn.qualname]
+        if fn.qualname in self._acq_star_stack:
+            return {}  # cycle: break without caching
+        self._acq_star_stack.add(fn.qualname)
+        out: dict[str, tuple[LockRef, str]] = {}
+        try:
+
+            def visit(events: list[Event]) -> None:
+                for ev in events:
+                    if isinstance(ev, WithLockEv):
+                        out.setdefault(ev.lock.id, (ev.lock, _label(fn)))
+                        visit(ev.body)
+                    elif isinstance(ev, AcqEv):
+                        out.setdefault(ev.lock.id, (ev.lock, _label(fn)))
+                    elif isinstance(ev, WithCMEv):
+                        self._merge_star(out, ev.fn)
+                        visit(ev.body)
+                    elif isinstance(ev, CallEv) and ev.fn is not None:
+                        self._merge_star(out, ev.fn)
+
+            visit(self.ir(fn))
+            ann = self.acquires_ann.get(fn.qualname)
+            if ann is not None and ann not in out:
+                out[ann] = (self._ref_for_id(ann), _label(fn))
+        finally:
+            self._acq_star_stack.discard(fn.qualname)
+        self._acq_star[fn.qualname] = out
+        return out
+
+    def _merge_star(
+        self, out: dict[str, tuple[LockRef, str]], callee: FunctionInfo
+    ) -> None:
+        for lock_id, (ref, chain) in self.acq_star(callee).items():
+            out.setdefault(lock_id, (ref, f"{_label(callee)} ← {chain}" if chain != _label(callee) else chain))
+
+    def block_star(self, fn: FunctionInfo, depth: int) -> list[tuple[str, str, int, str]]:
+        """Blocking operations reachable within ``depth`` calls:
+        (label, path, line, chain).  Stops at flock bodies — the flock
+        acquire itself is the reported operation there."""
+        key = (fn.qualname, depth)
+        if key in self._block_star:
+            return self._block_star[key]
+        self._block_star[key] = []  # recursion guard
+        out: list[tuple[str, str, int, str]] = []
+
+        def visit(events: list[Event]) -> None:
+            for ev in events:
+                if isinstance(ev, WithLockEv):
+                    if ev.lock.kind == "flock":
+                        out.append(
+                            (
+                                f"flock-acquire '{ev.lock.id}'",
+                                fn.path,
+                                ev.node.lineno,
+                                _label(fn),
+                            )
+                        )
+                        continue  # contents attributed to the flock acquire
+                    visit(ev.body)
+                elif isinstance(ev, AcqEv):
+                    if ev.lock.kind == "flock":
+                        out.append(
+                            (
+                                f"flock-acquire '{ev.lock.id}'",
+                                fn.path,
+                                ev.node.lineno,
+                                _label(fn),
+                            )
+                        )
+                elif isinstance(ev, WithCMEv):
+                    self._merge_block(out, ev.fn, depth)
+                    visit(ev.body)
+                elif isinstance(ev, CallEv):
+                    if ev.wait_on is not None:
+                        if not ev.wait_exempt:
+                            out.append(
+                                (
+                                    f"wait on '{ev.wait_on.id}'",
+                                    fn.path,
+                                    ev.node.lineno,
+                                    _label(fn),
+                                )
+                            )
+                        continue
+                    if ev.blocking:
+                        out.append((ev.blocking, fn.path, ev.node.lineno, _label(fn)))
+                        continue
+                    if ev.fn is not None:
+                        self._merge_block(out, ev.fn, depth)
+
+        visit(self.ir(fn))
+        self._block_star[key] = out
+        return out
+
+    def _merge_block(self, out: list, callee: FunctionInfo, depth: int) -> None:
+        if depth <= 1 or callee.qualname in self.nonblocking:
+            return
+        for label, path, line, chain in self.block_star(callee, depth - 1):
+            out.append((label, path, line, f"{_label(callee)}: {chain}" if chain != _label(callee) else chain))
+
+    def cm_yield(self, fn: FunctionInfo) -> list[LockRef]:
+        """Locks held at a contextmanager function's yield — what the
+        ``with`` body of its callers executes under."""
+        if fn.qualname in self._cm_yield:
+            return self._cm_yield[fn.qualname]
+        self._cm_yield[fn.qualname] = []  # recursion guard
+        found: list[LockRef] = []
+
+        def visit(events: list[Event], held: list[LockRef]) -> bool:
+            tail: list[LockRef] = []
+            for ev in events:
+                if isinstance(ev, YieldEv):
+                    found.extend(held + tail)
+                    return True
+                if isinstance(ev, AcqEv):
+                    tail.append(ev.lock)
+                elif isinstance(ev, RelEv):
+                    for i in range(len(tail) - 1, -1, -1):
+                        if tail[i].id == ev.lock.id:
+                            del tail[i]
+                            break
+                elif isinstance(ev, WithLockEv):
+                    if visit(ev.body, held + tail + [ev.lock]):
+                        return True
+                elif isinstance(ev, WithCMEv):
+                    if visit(ev.body, held + tail + self.cm_yield(ev.fn)):
+                        return True
+            return False
+
+        visit(self.ir(fn), [])
+        self._cm_yield[fn.qualname] = found
+        return found
+
+
+def _module_of(module: ParsedModule) -> str:
+    from tpudra.analysis.callgraph import module_name
+
+    return module_name(module.path)
+
+
+def _module_by_path(modules: list[ParsedModule], path: str) -> ParsedModule:
+    for m in modules:
+        if m.path == path:
+            return m
+    raise KeyError(path)
+
+
+def _label(fn: FunctionInfo) -> str:
+    return f"{fn.class_name}.{fn.name}" if fn.class_name else fn.name
+
+
+def _derived_id(mod_short: str, owner: str, attr: str) -> str:
+    if owner:
+        return f"{mod_short}.{owner}.{attr}"
+    return f"{mod_short}.{attr}"
+
+
+def _sub_bodies(stmt) -> list[list]:
+    out = []
+    for name in ("body", "orelse", "finalbody"):
+        body = getattr(stmt, name, None)
+        if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+            out.append(body)
+    for handler in getattr(stmt, "handlers", []) or []:
+        out.append(handler.body)
+    return out
+
+
+def _stmt_exprs(stmt) -> Iterable[ast.AST]:
+    """Expression children of one statement (not its nested statements)."""
+    for name, value in ast.iter_fields(stmt):
+        if name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, ast.expr):
+                    yield item
+
+
+def _walk_no_lambda(root: ast.AST):
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.Lambda, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+# ------------------------------------------------------------ the full pass
+
+
+class LockGraphAnalysis:
+    """Runs held-set propagation over every function and derives the
+    acquisition graph plus the three rule finding sets."""
+
+    def __init__(self, modules: list[ParsedModule], graph: Optional[CallGraph] = None):
+        self.model = LockModel(modules, graph)
+        self.edges: dict[tuple[str, str], Edge] = {}
+        self.locks: dict[str, LockRef] = {}
+        self.block_findings: list[Finding] = []
+        self.inversion_findings: list[Finding] = []
+        self._seen_findings: set[tuple] = set()
+
+    def run(self) -> LockGraphResult:
+        for fn in list(self.model.graph.functions.values()):
+            self._scan(fn)
+        for ref in self.model.named.values():
+            self.locks.setdefault(ref.id, ref)
+        findings = self.block_findings + self.inversion_findings + self._cycle_findings()
+        return LockGraphResult(locks=self.locks, edges=self.edges, findings=findings)
+
+    # -- edges --------------------------------------------------------------
+
+    def _note_lock(self, ref: LockRef) -> None:
+        prev = self.locks.get(ref.id)
+        if prev is None or (not prev.defined_at and ref.defined_at):
+            self.locks[ref.id] = ref
+
+    def _add_edge(
+        self, src: LockRef, dst: LockRef, path: str, node, chain: str
+    ) -> None:
+        self._note_lock(src)
+        self._note_lock(dst)
+        if src.id == dst.id:
+            if src.reentrant or src.family:
+                return
+        key = (src.id, dst.id)
+        if key not in self.edges:
+            self.edges[key] = Edge(
+                src, dst, path, getattr(node, "lineno", 1), chain
+            )
+
+    # -- held-set walk ------------------------------------------------------
+
+    def _scan(self, fn: FunctionInfo) -> None:
+        self._walk(fn, self.model.ir(fn), held=[], lex_depth=0)
+
+    def _walk(
+        self, fn: FunctionInfo, events: list[Event], held: list[LockRef], lex_depth: int
+    ) -> None:
+        tail: list[LockRef] = []
+
+        def current() -> list[LockRef]:
+            return held + tail
+
+        for ev in events:
+            if isinstance(ev, WithLockEv):
+                self._on_acquire(fn, ev.lock, ev.node, current())
+                nested_lex = lex_depth + (
+                    1 if ev.lexical and ev.lock.in_process else 0
+                )
+                self._walk(fn, ev.body, current() + [ev.lock], nested_lex)
+            elif isinstance(ev, AcqEv):
+                self._on_acquire(fn, ev.lock, ev.node, current())
+                tail.append(ev.lock)
+            elif isinstance(ev, RelEv):
+                for i in range(len(tail) - 1, -1, -1):
+                    if tail[i].id == ev.lock.id:
+                        del tail[i]
+                        break
+            elif isinstance(ev, WithCMEv):
+                self._on_call(fn, ev.fn, ev.node, current())
+                self._walk(
+                    fn, ev.body, current() + self.model.cm_yield(ev.fn), lex_depth
+                )
+            elif isinstance(ev, CallEv):
+                if ev.wait_on is not None:
+                    self._on_wait(fn, ev, current())
+                    continue
+                if ev.blocking:
+                    self._on_direct_blocking(fn, ev, current(), lex_depth)
+                if ev.fn is not None:
+                    # A blocking-terminal callee (kube verb, Flock.acquire)
+                    # was already reported whole; don't descend for more.
+                    self._on_call(
+                        fn, ev.fn, ev.node, current(), skip_block=bool(ev.blocking)
+                    )
+
+    def _on_acquire(
+        self, fn: FunctionInfo, lock: LockRef, node, held: list[LockRef]
+    ) -> None:
+        self._note_lock(lock)
+        for h in held:
+            self._add_edge(h, lock, fn.path, node, _label(fn))
+        if lock.kind == "flock":
+            holder = _innermost_in_process(held)
+            if holder is not None:
+                self._report_inversion(fn, node, holder, lock, _label(fn))
+
+    def _on_wait(self, fn: FunctionInfo, ev: CallEv, held: list[LockRef]) -> None:
+        assert ev.wait_on is not None
+        others = [h for h in held if h.id != ev.wait_on.id and h.in_process]
+        if not others or ev.wait_exempt:
+            return
+        self._report_block(
+            fn,
+            ev.node,
+            others[-1],
+            f"wait on '{ev.wait_on.id}'",
+            _label(fn),
+        )
+
+    def _on_direct_blocking(
+        self, fn: FunctionInfo, ev: CallEv, held: list[LockRef], lex_depth: int
+    ) -> None:
+        holder = _innermost_in_process(held)
+        if holder is None:
+            return
+        if ev.blocking == "flock-acquire":
+            self._report_inversion(fn, ev.node, holder, None, _label(fn))
+            return
+        if ev.blocking in _LEXICAL_CATEGORIES and lex_depth > 0:
+            return  # the lexical BLOCK-UNDER-LOCK rule owns this offense
+        self._report_block(fn, ev.node, holder, ev.blocking, _label(fn))
+
+    def _on_call(
+        self,
+        fn: FunctionInfo,
+        callee: FunctionInfo,
+        node,
+        held: list[LockRef],
+        skip_block: bool = False,
+    ) -> None:
+        if held:
+            for lock_id, (ref, chain) in self.model.acq_star(callee).items():
+                for h in held:
+                    self._add_edge(
+                        h, ref, fn.path, node, f"{_label(fn)} → {chain}"
+                    )
+        holder = _innermost_in_process(held)
+        if holder is None or skip_block or callee.qualname in self.model.nonblocking:
+            return
+        for label, bpath, bline, chain in self.model.block_star(
+            callee, MAX_BLOCK_DEPTH
+        ):
+            where = f"{_label(fn)} → {chain} ({_rel(bpath)}:{bline})"
+            if label.startswith("flock-acquire"):
+                flock_id = label.partition("'")[2].rstrip("'") or None
+                ref = self.locks.get(flock_id) if flock_id else None
+                if ref is None and flock_id:
+                    ref = LockRef(flock_id, "flock")
+                self._report_inversion(fn, node, holder, ref, where)
+                continue
+            self._report_block(fn, node, holder, label, where)
+
+    # -- findings -----------------------------------------------------------
+
+    def _report_block(
+        self, fn: FunctionInfo, node, holder: LockRef, label: str, chain: str
+    ) -> None:
+        key = ("BLOCK-UNDER-LOCK-IP", fn.path, getattr(node, "lineno", 1), holder.id, label)
+        if key in self._seen_findings:
+            return
+        self._seen_findings.add(key)
+        self.block_findings.append(
+            _finding(
+                "BLOCK-UNDER-LOCK-IP",
+                fn.path,
+                node,
+                f"{label} reachable while holding in-process lock "
+                f"'{holder.id}' (via {chain}) — blocking work must leave "
+                "the critical section",
+            )
+        )
+
+    def _report_inversion(
+        self,
+        fn: FunctionInfo,
+        node,
+        holder: LockRef,
+        flock: Optional[LockRef],
+        chain: str,
+    ) -> None:
+        flock_id = flock.id if flock is not None else "a flock"
+        key = ("FLOCK-INVERSION", fn.path, getattr(node, "lineno", 1), holder.id, flock_id)
+        if key in self._seen_findings:
+            return
+        self._seen_findings.add(key)
+        self.inversion_findings.append(
+            _finding(
+                "FLOCK-INVERSION",
+                fn.path,
+                node,
+                f"cross-process flock '{flock_id}' acquired while holding "
+                f"in-process lock '{holder.id}' (via {chain}) — an "
+                "in-process lock must never wait on a flock: a sibling "
+                "process holding the flock and wanting the in-process "
+                "critical section wedges the node",
+            )
+        )
+
+    def _cycle_findings(self) -> list[Finding]:
+        adj: dict[str, list[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        for dsts in adj.values():
+            dsts.sort()
+        out: list[Finding] = []
+        for cycle in _find_cycles(adj):
+            pairs = list(zip(cycle, cycle[1:] + cycle[:1]))
+            parts = []
+            for a, b in pairs:
+                e = self.edges[(a, b)]
+                parts.append(f"{a} → {b} (in {e.chain}, {_rel(e.path)}:{e.line})")
+            anchor = self.edges[pairs[0]]
+            out.append(
+                Finding(
+                    path=anchor.path,
+                    line=anchor.line,
+                    col=0,
+                    rule_id="LOCK-CYCLE",
+                    message=(
+                        "lock acquisition cycle — a static deadlock candidate: "
+                        + "; ".join(parts)
+                    ),
+                )
+            )
+        return out
+
+
+def _innermost_in_process(held: list[LockRef]) -> Optional[LockRef]:
+    for h in reversed(held):
+        if h.in_process:
+            return h
+    return None
+
+
+def _find_cycles(adj: dict[str, list[str]]) -> list[list[str]]:
+    """One representative simple cycle per strongly connected component
+    (plus self-loops), deterministically ordered."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(adj.get(v, [])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(adj.get(w, []))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(sorted(comp))
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+
+    cycles: list[list[str]] = []
+    for comp in sorted(sccs):
+        if len(comp) == 1:
+            v = comp[0]
+            if v in adj.get(v, []):
+                cycles.append([v])
+            continue
+        # Deterministic representative cycle: DFS within the component from
+        # its smallest node back to itself.
+        start = comp[0]
+        comp_set = set(comp)
+        path = [start]
+        seen = {start}
+
+        def dfs(node: str) -> bool:
+            for w in adj.get(node, []):
+                if w == start and len(path) > 1:
+                    return True
+                if w in comp_set and w not in seen:
+                    seen.add(w)
+                    path.append(w)
+                    if dfs(w):
+                        return True
+                    path.pop()
+            return False
+
+        if dfs(start):
+            cycles.append(path)
+    return cycles
+
+
+def analyze_modules(
+    modules: list[ParsedModule], graph: Optional[CallGraph] = None
+) -> LockGraphResult:
+    return LockGraphAnalysis(modules, graph).run()

@@ -34,11 +34,19 @@ class Rule:
 
 def all_rules() -> list[Rule]:
     from tpudra.analysis.rules.exc_swallow import ExcSwallow
+    from tpudra.analysis.rules.lockgraph import (
+        BlockUnderLockIP,
+        FlockInversion,
+        LockCycle,
+        LockgraphState,
+    )
     from tpudra.analysis.rules.locks import BlockUnderLock, LockOrder
     from tpudra.analysis.rules.metrics_hygiene import MetricsHygiene
     from tpudra.analysis.rules.rmw_purity import RmwPurity
     from tpudra.analysis.rules.shared_state import SharedState
 
+    # The three lockgraph rules share ONE whole-program analysis per run.
+    lockgraph = LockgraphState()
     return [
         LockOrder(),
         BlockUnderLock(),
@@ -46,4 +54,20 @@ def all_rules() -> list[Rule]:
         SharedState(),
         MetricsHygiene(),
         ExcSwallow(),
+        LockCycle(lockgraph),
+        BlockUnderLockIP(lockgraph),
+        FlockInversion(lockgraph),
     ]
+
+
+def lockgraph_rules() -> list[Rule]:
+    """Just the whole-program lock rules (the ``make lockgraph`` lane)."""
+    from tpudra.analysis.rules.lockgraph import (
+        BlockUnderLockIP,
+        FlockInversion,
+        LockCycle,
+        LockgraphState,
+    )
+
+    state = LockgraphState()
+    return [LockCycle(state), BlockUnderLockIP(state), FlockInversion(state)]

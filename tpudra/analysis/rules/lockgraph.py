@@ -1,0 +1,78 @@
+"""LOCK-CYCLE, BLOCK-UNDER-LOCK-IP, FLOCK-INVERSION: the whole-program
+lock rules (tpudra-lockgraph).
+
+The heavy lifting lives in tpudra/analysis/lockmodel.py; these Rule
+shells adapt it to the engine's per-module + finalize protocol.  All
+three rules SHARE one analysis (all_rules wires one ``LockgraphState``
+into the three instances), so the held-set propagation runs once per
+lint run no matter how many of its rules are active — and the modules
+they consume are the engine's shared parse pass, so the lockgraph adds
+zero extra ``ast.parse`` work on top of tpudra-lint.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.lockmodel import LockGraphResult, analyze_modules
+from tpudra.analysis.rules import Rule
+
+
+class LockgraphState:
+    """Accumulates the modules of one lint run; analyzes once on demand."""
+
+    def __init__(self) -> None:
+        self.modules: list[ParsedModule] = []
+        self._paths: set[str] = set()
+        self._result: Optional[LockGraphResult] = None
+
+    def add(self, module: ParsedModule) -> None:
+        if module.path not in self._paths:
+            self._paths.add(module.path)
+            self.modules.append(module)
+            self._result = None
+
+    def result(self) -> LockGraphResult:
+        if self._result is None:
+            self._result = analyze_modules(self.modules)
+        return self._result
+
+
+class _LockgraphRule(Rule):
+    def __init__(self, state: Optional[LockgraphState] = None):
+        self.state = state or LockgraphState()
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        self.state.add(module)
+        return []
+
+    def finalize(self) -> list[Finding]:
+        return [
+            f for f in self.state.result().findings if f.rule_id == self.rule_id
+        ]
+
+
+class LockCycle(_LockgraphRule):
+    rule_id = "LOCK-CYCLE"
+    description = (
+        "the global lock acquisition graph is acyclic — a cycle is a "
+        "static deadlock candidate, reported with a concrete call-path pair"
+    )
+
+
+class BlockUnderLockIP(_LockgraphRule):
+    rule_id = "BLOCK-UNDER-LOCK-IP"
+    description = (
+        "no sleep / subprocess / gRPC / apiserver call / blocking wait "
+        "reachable through calls while an in-process lock is held "
+        "(interprocedural BLOCK-UNDER-LOCK)"
+    )
+
+
+class FlockInversion(_LockgraphRule):
+    rule_id = "FLOCK-INVERSION"
+    description = (
+        "no cross-process flock acquired while an in-process lock is held "
+        "— the inversion that wedges a node when two driver processes race"
+    )

@@ -1,0 +1,214 @@
+"""Witness merge + lock-order doc generation for tpudra-lockgraph.
+
+The static acquisition graph (lockmodel.py) and the runtime witness log
+(tpudra/lockwitness.py) validate each other:
+
+- a cycle among *witnessed* edges is an ordering inconsistency the test
+  suite actually exhibited — fail;
+- a witnessed edge the static model lacks is a **model gap** (the
+  analyzer's resolution missed a call path) — fail, because every other
+  guarantee the static rules make is only as good as the model;
+- a static edge never witnessed is a coverage statement, reported but
+  non-failing (static analysis over-approximates by design).
+
+Coverage is computed over *witnessable* edges only — both endpoints
+instrumented (lockwitness-constructed locks and flocks); an edge between
+two plain ``threading`` locks can never appear in a log, and counting it
+against coverage would just punish unwired modules.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpudra import lockwitness
+from tpudra.analysis.engine import parse_paths
+from tpudra.analysis.lockmodel import (
+    BIND_PATH_LOCKS,
+    LockGraphResult,
+    _find_cycles,
+    _rel,
+    analyze_modules,
+)
+
+
+def build_graph(root: str) -> LockGraphResult:
+    """The static lock graph of the tree under ``root`` (normally the
+    ``tpudra`` package directory) — one shared parse pass."""
+    modules, _ = parse_paths([root])
+    return analyze_modules(modules)
+
+
+@dataclass
+class MergeReport:
+    witnessed_locks: set
+    witnessed_edges: set
+    witnessed_cycles: list = field(default_factory=list)
+    model_gaps: list = field(default_factory=list)  # witnessed, not modeled
+    covered: set = field(default_factory=set)  # static ∩ witnessed
+    uncovered: set = field(default_factory=set)  # witnessable static, never seen
+    bind_covered: set = field(default_factory=set)
+    bind_uncovered: set = field(default_factory=set)
+
+    @property
+    def ok(self) -> bool:
+        return not self.witnessed_cycles and not self.model_gaps
+
+    def coverage(self) -> float:
+        total = len(self.covered) + len(self.uncovered)
+        return (len(self.covered) / total) if total else 1.0
+
+    def bind_path_coverage(self) -> float:
+        total = len(self.bind_covered) + len(self.bind_uncovered)
+        return (len(self.bind_covered) / total) if total else 1.0
+
+    def render(self) -> str:
+        lines = [
+            f"witnessed: {len(self.witnessed_locks)} locks, "
+            f"{len(self.witnessed_edges)} edges",
+        ]
+        for cycle in self.witnessed_cycles:
+            lines.append(
+                "WITNESSED CYCLE: " + " → ".join(cycle + cycle[:1])
+            )
+        for a, b in sorted(self.model_gaps):
+            lines.append(
+                f"MODEL GAP: runtime acquired '{b}' while holding '{a}' but "
+                "the static graph has no such edge — teach lockmodel.py the "
+                "call path (or annotate it) before trusting the other rules"
+            )
+        lines.append(
+            f"static edge coverage: {len(self.covered)}/"
+            f"{len(self.covered) + len(self.uncovered)} "
+            f"({self.coverage():.0%}) of witnessable edges"
+        )
+        lines.append(
+            f"bind-path edge coverage: {len(self.bind_covered)}/"
+            f"{len(self.bind_covered) + len(self.bind_uncovered)} "
+            f"({self.bind_path_coverage():.0%})"
+        )
+        for a, b in sorted(self.uncovered):
+            lines.append(f"  never witnessed: {a} → {b}")
+        lines.append("witness merge: " + ("OK" if self.ok else "FAILED"))
+        return "\n".join(lines)
+
+
+def merge(result: LockGraphResult, log_path: str) -> MergeReport:
+    locks, edges = lockwitness.read_log(log_path)
+    edges = {(a, b) for (a, b) in edges if a != b}
+    report = MergeReport(witnessed_locks=locks, witnessed_edges=edges)
+
+    adj: dict[str, list[str]] = {}
+    for a, b in sorted(edges):
+        adj.setdefault(a, []).append(b)
+    report.witnessed_cycles = _find_cycles(adj)
+
+    static_edges = result.edge_ids()
+    report.model_gaps = sorted(e for e in edges if e not in static_edges)
+
+    witnessable = result.witnessable_edge_ids()
+    report.covered = {e for e in witnessable if e in edges}
+    report.uncovered = witnessable - report.covered
+    bind = {
+        e for e in witnessable if e[0] in BIND_PATH_LOCKS and e[1] in BIND_PATH_LOCKS
+    }
+    report.bind_covered = {e for e in bind if e in edges}
+    report.bind_uncovered = bind - report.bind_covered
+    return report
+
+
+# --------------------------------------------------------------- lock-order doc
+
+
+def _topo_order(result: LockGraphResult) -> list[list[str]]:
+    """Topological levels of the acquisition DAG's participating locks
+    (level N may be acquired while anything in levels < N is held)."""
+    nodes = sorted({a for a, _ in result.edges} | {b for _, b in result.edges})
+    preds: dict[str, set] = {n: set() for n in nodes}
+    for a, b in result.edges:
+        if a != b:
+            preds[b].add(a)
+    levels: list[list[str]] = []
+    placed: set = set()
+    while len(placed) < len(nodes):
+        ready = sorted(
+            n for n in nodes if n not in placed and preds[n] <= placed
+        )
+        if not ready:  # cycle: emit the remainder as one level (lint fails it)
+            levels.append(sorted(n for n in nodes if n not in placed))
+            break
+        levels.append(ready)
+        placed.update(ready)
+    return levels
+
+
+def emit_markdown(result: LockGraphResult) -> str:
+    """docs/lock-order.md: the canonical acquisition-order table plus the
+    raw graph, regenerated by ``python -m tpudra.analysis --emit-dot``.
+    Deterministic output — a freshness test diffs it against the file."""
+    out = [
+        "# Lock acquisition order",
+        "",
+        "**Generated** by `python -m tpudra.analysis --emit-dot docs/lock-order.md`",
+        "(`make lockgraph-docs`) from the tpudra-lockgraph static model — do not",
+        "edit by hand.  Rules and witness workflow:",
+        "[static-analysis.md](static-analysis.md); the prose argument for the",
+        "hierarchy: [bind-path.md](bind-path.md).",
+        "",
+        "A lock may only be acquired while holding locks from *strictly earlier*",
+        "levels (or none).  `flock:` locks are cross-process `flock(2)` files;",
+        "everything else is in-process.  *family* locks are ID classes with many",
+        "runtime instances, acquired intra-family in sorted order (LOCK-ORDER).",
+        "",
+        "## Canonical acquisition order",
+        "",
+        "| level | lock | kind | defined at |",
+        "|---|---|---|---|",
+    ]
+    ordered: set = set()
+    for i, level in enumerate(_topo_order(result), 1):
+        for lock_id in level:
+            ordered.add(lock_id)
+            ref = result.locks[lock_id]
+            kind = ref.kind + (" (family)" if ref.family else "")
+            out.append(f"| {i} | `{lock_id}` | {kind} | {ref.defined_at or '—'} |")
+    out += [
+        "",
+        "## Acquisition edges",
+        "",
+        "`A → B`: B is acquired while A is held, with one concrete call path.",
+        "",
+        "| held | acquires | via |",
+        "|---|---|---|",
+    ]
+    for (a, b) in sorted(result.edges):
+        e = result.edges[(a, b)]
+        out.append(f"| `{a}` | `{b}` | {e.chain} ({_rel(e.path)}:{e.line}) |")
+    isolated = sorted(set(result.locks) - ordered)
+    if isolated:
+        out += [
+            "",
+            "## Locks with no ordering constraints",
+            "",
+            "Never held together with another modeled lock (leaf critical",
+            "sections).",
+            "",
+            "| lock | kind | defined at |",
+            "|---|---|---|",
+        ]
+        for lock_id in isolated:
+            ref = result.locks[lock_id]
+            kind = ref.kind + (" (family)" if ref.family else "")
+            out.append(f"| `{lock_id}` | {kind} | {ref.defined_at or '—'} |")
+    out += [
+        "",
+        "## Graphviz",
+        "",
+        "```dot",
+        "digraph lockorder {",
+        "  rankdir=LR;",
+    ]
+    for (a, b) in sorted(result.edges):
+        out.append(f'  "{a}" -> "{b}";')
+    out += ["}", "```", ""]
+    return "\n".join(out)

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from tpudra import lockwitness
+
 logger = logging.getLogger(__name__)
 
 
@@ -27,7 +29,7 @@ class ProcessManager:
         self._argv = list(argv)
         self._term_grace = term_grace
         self._proc: Optional[subprocess.Popen] = None
-        self._lock = threading.RLock()
+        self._lock = lockwitness.make_rlock("process.lock")
         self._expected_stop = False
         self._started_at = 0.0
         self.restarts = 0

@@ -102,7 +102,11 @@ class CDDriver:
     def _pu_lock(self):
         """Fresh Flock per operation — see tpudra/plugin/driver.py: one
         shared instance cannot serve concurrent kubelet RPC threads."""
-        return Flock(self._pu_lock_path)
+        # Distinct lock class from the TPU plugin's pu.lock: same file
+        # NAME, different plugin_dir/file — collapsing them would let CD
+        # runs mark main-driver bind edges as witnessed (and vice versa).
+        # witness_id doubles as the static model's ID for this family.
+        return Flock(self._pu_lock_path, witness_id="flock:cd-pu.lock")
 
     def _unprepare_locked(self, uid: str) -> None:
         """Single-claim unprepare under the node lock — the GC's entry

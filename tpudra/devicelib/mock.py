@@ -205,6 +205,7 @@ class MockDeviceLib(DeviceLib):
 
     def create_partition(self, spec: PartitionSpec) -> LivePartition:
         with self._lock:
+            # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP the state file IS the simulated silicon — its write must be atomic with the in-memory registry, exactly like the hardware mutation it stands in for
             return self._create_unlocked(spec)
 
     def delete_partition(self, uuid: str) -> None:
@@ -212,6 +213,7 @@ class MockDeviceLib(DeviceLib):
             if uuid not in self._partitions:
                 raise DeviceLibError(f"no partition with uuid {uuid}")
             del self._partitions[uuid]
+            # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP the state file IS the simulated silicon — its write must be atomic with the registry drop
             self._save_state()
 
     def list_partitions(self) -> list[LivePartition]:

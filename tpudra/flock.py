@@ -16,7 +16,7 @@ import fcntl
 import os
 import time
 
-from tpudra import metrics
+from tpudra import lockwitness, metrics
 
 
 class FlockTimeout(TimeoutError):
@@ -37,13 +37,11 @@ class Flock:
         path: str,
         poll_interval: float = 0.01,
         metric_label: str | None = None,
+        witness_id: str | None = None,
     ):
         self._path = path
         self._poll_interval = poll_interval
         self._fd: int | None = None
-        #: Wall-time the last acquire() spent waiting (seconds); the driver
-        #: folds this into its per-phase bind histogram.
-        self.last_wait: float = 0.0
         # Labelled children are cached per label: .labels() takes a registry
         # lock and the bind path constructs several Flocks per claim.
         # metric_label overrides the file-name label for lock families whose
@@ -54,19 +52,29 @@ class Flock:
             child = metrics.FLOCK_WAIT_SECONDS.labels(label)
             _WAIT_CHILDREN[label] = child
         self._wait_metric = child
+        # Lock-witness identity (docs/static-analysis.md): families whose
+        # file names are unbounded (one per claim uid) pass an explicit
+        # class id; everything else is identified by its file name.  The
+        # enabled() check runs once per construction so production pays
+        # one env lookup, never per-acquire work.
+        self._witness_id = witness_id or f"flock:{os.path.basename(path) or path}"
+        self._witnessing = lockwitness.enabled()
 
     @property
     def path(self) -> str:
         return self._path
 
-    def acquire(self, timeout: float | None = None) -> None:
-        """Acquire the exclusive lock, polling every ``poll_interval`` seconds.
+    def acquire(self, timeout: float | None = None) -> float:
+        """Acquire the exclusive lock, polling every ``poll_interval``
+        seconds; returns the wall-time this acquire spent waiting (seconds)
+        — per-acquire state, so concurrent acquires through distinct Flock
+        objects on one path never race on a shared field.
 
         Raises FlockTimeout if the lock cannot be acquired within ``timeout``
-        seconds (None = wait forever).  The wait is recorded in the
+        seconds (None = wait forever).  The wait is also recorded in the
         ``tpudra_flock_wait_seconds`` histogram (labelled by lock file name)
-        and in ``last_wait`` — including timed-out waits, which are exactly
-        the samples a lock-contention investigation needs.
+        — including timed-out waits, which are exactly the samples a
+        lock-contention investigation needs.
         """
         if self._fd is not None:
             raise RuntimeError(f"lock {self._path} already held by this object")
@@ -87,7 +95,9 @@ class Flock:
                 try:
                     fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
                     self._fd = fd
-                    return
+                    if self._witnessing:
+                        lockwitness.note_acquire(self._witness_id)
+                    return time.monotonic() - t0
                 except OSError as e:
                     if e.errno not in (errno.EAGAIN, errno.EACCES):
                         raise
@@ -101,13 +111,14 @@ class Flock:
                 os.close(fd)
             raise
         finally:
-            self.last_wait = time.monotonic() - t0
-            self._wait_metric.observe(self.last_wait)
+            self._wait_metric.observe(time.monotonic() - t0)
 
     def release(self) -> None:
         if self._fd is None:
             return
         fd, self._fd = self._fd, None
+        if self._witnessing:
+            lockwitness.note_release(self._witness_id)
         # Closing the fd releases the flock; explicit unlock first for clarity.
         with contextlib.suppress(OSError):
             fcntl.flock(fd, fcntl.LOCK_UN)
@@ -126,9 +137,12 @@ class Flock:
 
     @contextlib.contextmanager
     def __call__(self, timeout: float | None = None):
-        self.acquire(timeout=timeout)
+        """Scoped acquire; the bound value is this acquire's wait time in
+        seconds (``with lock(timeout=...) as waited:``), so callers thread
+        the wait into their histograms without shared mutable state."""
+        waited = self.acquire(timeout=timeout)
         try:
-            yield self
+            yield waited
         finally:
             self.release()
 

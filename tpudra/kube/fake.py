@@ -139,6 +139,7 @@ class FakeKube:
         --apiserver-latency-ms)."""
         self._latency_s = float(seconds)
 
+    # tpudra-lock: nonblocking the latency sleep is the simulated-RTT knob itself — set_latency's docstring argues why it sleeps under the store lock on purpose
     def _run_reactors(self, verb: str, gvr: GVR, obj: dict | None) -> None:
         if self._latency_s > 0 and verb in self.LATENCY_VERBS:
             time.sleep(self._latency_s)

@@ -22,7 +22,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from tpudra import metrics
+from tpudra import lockwitness, metrics
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.gvr import GVR
 
@@ -60,7 +60,7 @@ class Informer:
         #: apiserver offers no server-side selector for the predicate.
         self._cache_filter = cache_filter
         self._store: dict[tuple, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("informer.store_lock")
         self._handlers: list[Handler] = []
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -79,7 +79,7 @@ class Informer:
         #: processor queue for the same reason).  RLock: the resync loop
         #: holds it across its store re-read + dispatch, and _dispatch
         #: re-acquires it.
-        self._dispatch_lock = threading.RLock()
+        self._dispatch_lock = lockwitness.make_rlock("informer.dispatch_lock")
 
     # -- configuration ------------------------------------------------------
 
@@ -321,7 +321,7 @@ class MutationCache:
         self._informer = informer
         self._ttl = ttl
         self._mutated: dict[tuple, tuple[float, dict]] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("mutationcache.lock")
 
     def mutated(self, obj: dict) -> None:
         with self._lock:

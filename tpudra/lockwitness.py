@@ -1,0 +1,276 @@
+"""Runtime lock-acquisition witness (the lockdep dynamic side).
+
+tpudra-lockgraph's static model (tpudra/analysis/lockmodel.py) derives the
+lock acquisition graph from the AST; this module is its runtime
+cross-check.  With ``TPUDRA_LOCK_WITNESS=1`` in the environment, the
+lock-heavy modules construct *instrumented* primitives (``make_lock`` /
+``make_rlock`` / ``make_condition``; ``Flock`` hooks in directly) that
+maintain a per-thread held stack and append every first-seen acquisition
+edge "A was held when B was acquired" to a JSONL witness log
+(``TPUDRA_LOCK_WITNESS_LOG``, default ``tpudra-lock-witness.jsonl`` in the
+working directory).  ``python -m tpudra.analysis --witness <log>`` then
+merges the log into the static graph: witnessed cycles and edges the
+static model lacks (model gaps) fail the run; static edges never
+witnessed are a coverage report.
+
+With the variable unset (every production path), the factories return the
+plain ``threading`` primitives — zero wrapping, zero overhead.
+
+Conventions shared with the static model:
+
+- IDs are lock *classes*, not instances (every ``Informer``'s store lock
+  is one node, every claim-uid flock is ``flock:claim-uid``).
+- Same-ID edges are never recorded: for re-entrant locks they are
+  re-entry, for families (claim-uid flocks, per-device mutexes) intra-
+  family order is governed by LOCK-ORDER's ``sorted()`` check, which a
+  class-collapsed witness cannot re-derive.
+- ``Condition.wait`` keeps the cond on the held stack: the waiting thread
+  is blocked and records nothing, and the implicit re-acquire on wake is
+  not a new ordering decision.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional, Union
+
+ENV_WITNESS = "TPUDRA_LOCK_WITNESS"
+ENV_WITNESS_LOG = "TPUDRA_LOCK_WITNESS_LOG"
+DEFAULT_LOG = "tpudra-lock-witness.jsonl"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_WITNESS, "") not in ("", "0")
+
+
+def log_path() -> str:
+    return os.environ.get(ENV_WITNESS_LOG, "") or os.path.join(
+        os.getcwd(), DEFAULT_LOG
+    )
+
+
+# ----------------------------------------------------------------- recording
+
+_tls = threading.local()
+_sink_guard = threading.Lock()
+_sink = None  # opened lazily, OUTSIDE _sink_guard (no open-under-lock)
+_written: set = set()
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = []
+        _tls.held = held
+    return held
+
+
+def _emit(record: dict) -> None:
+    global _sink
+    if _sink is None:
+        # Open before taking the guard; a racing double-open leaves one
+        # extra O_APPEND handle to close, never a torn line.
+        fh = open(log_path(), "a", encoding="utf-8")
+        with _sink_guard:
+            if _sink is None:
+                _sink = fh
+                fh = None
+        if fh is not None:
+            fh.close()
+    line = json.dumps(record, sort_keys=True) + "\n"
+    with _sink_guard:
+        _sink.write(line)
+        _sink.flush()
+
+
+def note_acquire(lock_id: str) -> None:
+    """Record that the current thread acquired ``lock_id``: one ``lock``
+    record per first-seen ID, one ``edge`` record per first-seen (held,
+    acquired) pair.  Called by the instrumented wrappers and by
+    ``Flock.acquire`` — must never itself take an instrumented lock."""
+    held = _held()
+    thread = threading.current_thread().name
+    new_records = []
+    with _sink_guard:
+        known = ("lock", lock_id) in _written
+        if not known:
+            _written.add(("lock", lock_id))
+    if not known:
+        new_records.append({"t": "lock", "lock": lock_id, "thread": thread})
+    for holder in dict.fromkeys(held):  # de-dup, order-preserving
+        if holder == lock_id:
+            continue  # re-entry / intra-family: not an ordering edge
+        key = ("edge", holder, lock_id)
+        with _sink_guard:
+            seen = key in _written
+            if not seen:
+                _written.add(key)
+        if not seen:
+            new_records.append(
+                {"t": "edge", "from": holder, "to": lock_id, "thread": thread}
+            )
+    held.append(lock_id)
+    for record in new_records:
+        _emit(record)
+
+
+def note_release(lock_id: str) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == lock_id:
+            del held[i]
+            return
+
+
+def held_by_current_thread() -> tuple:
+    """The current thread's held-ID stack (tests)."""
+    return tuple(_held())
+
+
+def reset_for_tests() -> None:
+    """Drop the in-process dedup/sink state so a test can witness into a
+    fresh log file."""
+    global _sink, _written
+    with _sink_guard:
+        sink, _sink = _sink, None
+        _written = set()
+    if sink is not None:
+        sink.close()
+
+
+# ------------------------------------------------------------------ wrappers
+
+
+class _WitnessLock:
+    """threading.Lock with acquisition-edge recording."""
+
+    _reentrant = False
+
+    def __init__(self, lock_id: str):
+        self._inner = self._make_inner()
+        self.lock_id = lock_id
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            note_acquire(self.lock_id)
+        return ok
+
+    def release(self) -> None:
+        note_release(self.lock_id)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _WitnessRLock(_WitnessLock):
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def locked(self) -> bool:  # RLock has no locked(); mirror 3.12 surface
+        return bool(getattr(self._inner, "_is_owned", lambda: False)())
+
+
+class _WitnessCondition:
+    """threading.Condition with acquisition-edge recording.  ``wait`` keeps
+    the cond on the held stack (see module docstring)."""
+
+    def __init__(self, lock_id: str):
+        self._inner = threading.Condition()
+        self.lock_id = lock_id
+
+    def __enter__(self):
+        self._inner.__enter__()
+        note_acquire(self.lock_id)
+        return self
+
+    def __exit__(self, *exc):
+        note_release(self.lock_id)
+        return self._inner.__exit__(*exc)
+
+    def acquire(self, *args):
+        ok = self._inner.acquire(*args)
+        if ok:
+            note_acquire(self.lock_id)
+        return ok
+
+    def release(self) -> None:
+        note_release(self.lock_id)
+        self._inner.release()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+LockLike = Union[threading.Lock, _WitnessLock]
+ConditionLike = Union[threading.Condition, _WitnessCondition]
+
+
+def make_lock(lock_id: str):
+    """A mutex carrying a stable witness ID.  Plain ``threading.Lock()``
+    unless the witness is armed — the ID string doubles as the static
+    model's name for this lock (lockmodel.py reads it off the call)."""
+    return _WitnessLock(lock_id) if enabled() else threading.Lock()
+
+
+def make_rlock(lock_id: str):
+    return _WitnessRLock(lock_id) if enabled() else threading.RLock()
+
+
+def make_condition(lock_id: str):
+    return _WitnessCondition(lock_id) if enabled() else threading.Condition()
+
+
+# ------------------------------------------------------------------- reading
+
+
+def read_log(path: str) -> tuple[set, set]:
+    """(lock IDs, edges) recorded in a witness log.  Malformed lines are
+    skipped — a crashed witness process may tear its final line."""
+    locks: set = set()
+    edges: set = set()
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("t") == "lock" and rec.get("lock"):
+                    locks.add(rec["lock"])
+                elif rec.get("t") == "edge" and rec.get("from") and rec.get("to"):
+                    locks.add(rec["from"])
+                    locks.add(rec["to"])
+                    edges.add((rec["from"], rec["to"]))
+    except FileNotFoundError:
+        pass
+    return locks, edges

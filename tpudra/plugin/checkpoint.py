@@ -34,13 +34,12 @@ import copy
 import json
 import logging
 import os
-import threading
 import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpudra import metrics
+from tpudra import lockwitness, metrics
 from tpudra.api import serde
 from tpudra.flock import Flock
 
@@ -212,7 +211,7 @@ class CheckpointManager:
         # (stat key, decoded checkpoint). Callers may freely mutate what
         # read() returns, so the cache holds its own copy.
         self._cache: Optional[tuple[tuple[int, int, int], Checkpoint]] = None
-        self._cache_lock = threading.Lock()
+        self._cache_lock = lockwitness.make_lock("checkpoint.cache_lock")
 
     @property
     def path(self) -> str:
@@ -367,7 +366,7 @@ class CheckpointManager:
         # twice, but in-process callers DO overlap (the GC thread mutates
         # while RPC threads mutate) — each needs its own fd so the kernel
         # serializes them instead of a RuntimeError failing the batch.
-        with Flock(self._lock_path)(timeout=timeout):
+        with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock
             # Bypass the read cache inside the RMW: the stat triple is not
             # collision-proof across processes (inode recycling + coarse
             # mtime), and a false cache hit here would write a stale

@@ -41,8 +41,9 @@ import logging
 import threading
 from typing import Callable
 
-from tpudra import metrics
+from tpudra import lockwitness, metrics
 from tpudra.kube import gvr
+from tpudra.kube.client import KubeAPI
 from tpudra.kube.informer import Informer
 
 logger = logging.getLogger(__name__)
@@ -66,7 +67,7 @@ class Singleflight:
     collapses concurrency, it is not a cache."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("singleflight.lock")
         self._calls: dict[tuple, _Call] = {}
 
     def do(self, key: tuple, fn: Callable[[], dict]) -> tuple[dict, bool]:
@@ -110,7 +111,7 @@ class CachedClaimResolver:
     -> full ResourceClaim dict, or raise``) served from an informer cache
     with read-through GET fallback and singleflight deduplication."""
 
-    def __init__(self, kube, informer: Informer):
+    def __init__(self, kube: KubeAPI, informer: Informer):
         self._kube = kube
         self._informer = informer
         self._singleflight = Singleflight()

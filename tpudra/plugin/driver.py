@@ -36,7 +36,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpudra import TPU_DRIVER_NAME, featuregates, metrics
+from tpudra import TPU_DRIVER_NAME, featuregates, lockwitness, metrics
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
 from tpudra.flock import Flock
 from tpudra.kube import gvr
@@ -120,7 +120,7 @@ class Driver:
             vfio_manager=vfio_manager,
         )
         self._unhealthy: set[str] = set()
-        self._unhealthy_lock = threading.Lock()
+        self._unhealthy_lock = lockwitness.make_lock("driver.unhealthy_lock")
         # Per-device last-status-change unix time for the DRAResourceHealth
         # stream; devices absent here report the startup timestamp.
         self._health_changed_at: dict[str, float] = {}
@@ -128,11 +128,11 @@ class Driver:
         # Serializes the whole snapshot→build→apply publication path: the
         # health thread and prepare RPC threads both publish, and an
         # interleaving could re-advertise silicon just marked unhealthy.
-        self._publish_lock = threading.Lock()
+        self._publish_lock = lockwitness.make_lock("driver.publish_lock")
         # Async publisher state: RPC/health threads bump _publish_seq and
         # notify; the publisher thread debounces, rebuilds once, and
         # advances _publish_done.  Content-hash gate for no-op rebuilds.
-        self._publish_cond = threading.Condition()
+        self._publish_cond = lockwitness.make_condition("driver.publish_cond")
         self._publish_seq = 0
         self._publish_done = 0
         self._publisher_thread: Optional[threading.Thread] = None
@@ -482,13 +482,19 @@ class Driver:
     def _claim_lock_path(self, uid: str) -> str:
         return os.path.join(self._claim_locks_dir, f"{uid}.lock")
 
+    # tpudra-lock: acquires=flock:claim-uid returns the still-held lock to _claims_serialized
     def _acquire_claim_lock(self, uid: str, deadline: float) -> Flock:
         """Acquire one claim-uid flock, surviving concurrent GC of the lock
         file: after acquiring, re-stat the path — if the file was unlinked
         or replaced between our open and our flock (an unpreparing holder
         unlinks while holding), release and retry on the fresh file."""
         while True:
-            lock = Flock(self._claim_lock_path(uid), metric_label="claim")
+            # tpudra-lock: id=flock:claim-uid family one lock file per claim uid
+            lock = Flock(
+                self._claim_lock_path(uid),
+                metric_label="claim",
+                witness_id="flock:claim-uid",
+            )
             lock.acquire(timeout=max(0.0, deadline - time.monotonic()))
             try:
                 st = os.stat(lock.path)
@@ -531,15 +537,17 @@ class Driver:
         acquired twice, but kubelet issues concurrent prepare RPCs — each
         call gets its own fd and the kernel serializes across both threads
         and processes."""
-        return Flock(self._pu_lock_path)
+        return Flock(self._pu_lock_path)  # tpudra-lock: id=flock:pu.lock
 
     @contextlib.contextmanager
     def _locked_pu(self):
         """Acquire the node-global lock for one RMW phase, feeding the wait
-        into the per-phase bind histogram."""
+        into the per-phase bind histogram.  The wait comes back from the
+        acquire itself (not instance state): a concurrent same-path acquire
+        through another Flock object can never clobber the sample."""
         lock = self._pu_lock()
-        with lock(timeout=PU_LOCK_TIMEOUT):
-            metrics.observe_phase(metrics.PHASE_LOCK_WAIT, lock.last_wait)
+        with lock(timeout=PU_LOCK_TIMEOUT) as waited:
+            metrics.observe_phase(metrics.PHASE_LOCK_WAIT, waited)
             yield lock
 
     # ---------------------------------------------------------- publication
@@ -652,6 +660,7 @@ class Driver:
             res = generate_driver_resources(
                 self.state.allocatable,
                 unhealthy=unhealthy,
+                # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP publish_lock is the publisher thread's top-of-hierarchy lock — nothing on the bind path ever waits on it, and the withheld-set snapshot must be atomic with the build (docs/lock-order.md)
                 withheld=self.state.bound_sibling_devices(),
                 partitionable=partitionable,
                 node_name=self._config.node_name,
@@ -675,6 +684,7 @@ class Driver:
                 generation=self._pool_generation,
             )
             self._pool_generation += 1
+            # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP deliberate: publish_lock serializes snapshot→build→write so an interleaved publish can never re-advertise silicon just marked unhealthy; it is the top of the hierarchy (no lock is ever taken while it is held by another thread's bind path) and only the publisher thread holds it in steady state (docs/lock-order.md)
             publish_slices(
                 self._kube,
                 slices,

@@ -42,6 +42,7 @@ class PerDeviceMutex:
     def get(self, device: str) -> threading.Lock:
         with self._lock:
             if device not in self._submutex:
+                # tpudra-lock: id=vfio.per-device family one mutex per PCI address, keyed in self._submutex
                 self._submutex[device] = threading.Lock()
             return self._submutex[device]
 
@@ -82,6 +83,7 @@ class VfioManager:
             return None
         return os.path.basename(os.path.realpath(link))
 
+    # tpudra-lock: nonblocking sysfs attribute read — a bounded in-kernel store lookup, not I/O latency; serializing it under the device mutex is the point
     def iommu_group(self, chip: TpuChip) -> str:
         path = os.path.join(self._device_dir(chip.pci_address), "iommu_group")
         if os.path.islink(path) or os.path.isdir(path):
@@ -95,6 +97,7 @@ class VfioManager:
 
     # -- configure / unconfigure -------------------------------------------
 
+    # tpudra-lock: nonblocking sysfs attribute store — the multi-write rebind dance is exactly what the per-device mutex serializes (reference PerGPUMutex), and each store is a bounded in-kernel write, not disk/network latency
     def _write(self, path: str, value: str) -> None:
         with open(path, "w") as f:
             f.write(value)
@@ -103,6 +106,7 @@ class VfioManager:
         """Rebind to vfio-pci; returns the iommu group
         (reference Configure, vfio-device.go:176-178 — incl. taking the
         device's mutex around the rebind sequence)."""
+        # tpudra-lock: id=vfio.per-device
         with per_device_lock.get(chip.pci_address):
             dev_dir = self._device_dir(chip.pci_address)
             if not os.path.isdir(dev_dir):
@@ -122,6 +126,7 @@ class VfioManager:
     def unconfigure(self, chip: TpuChip) -> None:
         """Return the function to the TPU driver
         (reference Unconfigure, vfio-device.go:207-209)."""
+        # tpudra-lock: id=vfio.per-device
         with per_device_lock.get(chip.pci_address):
             dev_dir = self._device_dir(chip.pci_address)
             if not os.path.isdir(dev_dir):

@@ -24,6 +24,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from tpudra import lockwitness
+
 logger = logging.getLogger(__name__)
 
 
@@ -35,7 +37,7 @@ class ExponentialBackoff:
         self.cap = cap
         self.jitter = jitter
         self._failures: dict[object, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("workqueue.backoff_lock")
 
     def when(self, item: object) -> float:
         with self._lock:
@@ -63,7 +65,7 @@ class TokenBucket:
         self.burst = burst
         self._tokens = float(burst)
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = lockwitness.make_lock("workqueue.bucket_lock")
 
     def reserve(self) -> float:
         with self._lock:
@@ -138,7 +140,7 @@ class WorkQueue:
     ):
         self._limiter = rate_limiter or default_controller_rate_limiter()
         self._heap: list[_Entry] = []
-        self._cond = threading.Condition()
+        self._cond = lockwitness.make_condition("workqueue.cond")
         self._seq = itertools.count()
         self._gens: dict[object, int] = {}
         self._active_keys: set[object] = set()
