@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint image helm-render clean
 
 all: native test
 
@@ -88,6 +88,14 @@ APISERVER_LATENCY_MS ?= 10
 bench-apiserver:
 	set -o pipefail; python bench.py --bind-only \
 	  --apiserver-latency-ms $(APISERVER_LATENCY_MS) \
+	  | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Checkpoint-storage churn A/B (docs/bind-path.md "Checkpoint storage"):
+# N resident claims x M status-flip mutates, interleaved WAL-vs-snapshot
+# arms, plus the 8-way group-commit fsync count (medians of 3 waves).
+bench-checkpoint:
+	set -o pipefail; python bench.py --checkpoint-churn \
 	  | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 

@@ -303,6 +303,163 @@ def bench_bind_apiserver_ab(
     }
 
 
+def bench_checkpoint_churn(iters: int = None) -> dict:
+    """Checkpoint-persistence churn A/B (ISSUE 5, `make bench-checkpoint`):
+    N resident claims × M status-flip mutates through CheckpointManager,
+    interleaved WAL-vs-snapshot arms (``journal=True`` vs the
+    ``--no-journal`` behavior), plus the 8-way group-commit fsync count —
+    medians of 3 waves.  The claims the journal makes measurable:
+
+    - bytes written per mutate in the journal arm are independent of the
+      resident-claim count (O(delta)); the snapshot arm re-encodes every
+      resident claim per mutate (O(state));
+    - 8 concurrent mutators cost ≤2 fsyncs end to end (group commit: the
+      first leader commits its own entry; everyone who enqueued while it
+      held the flock rides the SECOND leader's single batch) against 16
+      for the snapshot arm (per mutate: temp-file fsync + the
+      rename-durability directory fsync)."""
+    import statistics as st
+    import threading
+
+    from prometheus_client import REGISTRY
+
+    from tpudra.plugin.checkpoint import (
+        PREPARE_COMPLETED,
+        PREPARE_STARTED,
+        Checkpoint,
+        CheckpointManager,
+        PreparedClaim,
+        PreparedDevice,
+        PreparedDeviceGroup,
+    )
+
+    M = 60 if iters is None else max(4, iters)
+
+    def metric(name: str, labels: dict = None) -> float:
+        return REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+    def all_fsyncs() -> float:
+        return sum(
+            metric("tpudra_checkpoint_fsyncs_total", {"kind": k})
+            for k in ("journal", "snapshot", "dir")
+        )
+
+    def mk_resident(n: int) -> Checkpoint:
+        cp = Checkpoint()
+        for i in range(n):
+            uid = f"res-{i}"
+            cp.prepared_claims[uid] = PreparedClaim(
+                uid=uid, namespace="default", name=uid,
+                status=PREPARE_COMPLETED,
+                groups=[PreparedDeviceGroup(devices=[PreparedDevice(
+                    canonical_name=f"tpu-{i % 8}", type="chip",
+                    pool_name="node-a", request_names=["r0"],
+                    cdi_device_ids=[f"tpu.google.com/tpu={uid}-tpu-{i % 8}"],
+                    attributes={"uuid": f"uuid-{i}"},
+                )])],
+            )
+        return cp
+
+    def flip(cp: Checkpoint, uid: str) -> None:
+        claim = cp.prepared_claims[uid]
+        claim.status = (
+            PREPARE_STARTED
+            if claim.status == PREPARE_COMPLETED
+            else PREPARE_COMPLETED
+        )
+
+    out: dict = {"mutates_per_arm": M, "resident": {}}
+    bytes_kind = {"journal": "journal", "snapshot": "snapshot"}
+    for n_resident in (8, 128):
+        with tempfile.TemporaryDirectory() as tmp:
+            mgrs = {
+                "journal": CheckpointManager(f"{tmp}/wal", journal=True),
+                "snapshot": CheckpointManager(f"{tmp}/snap", journal=False),
+            }
+            for mgr in mgrs.values():
+                mgr.write(mk_resident(n_resident))
+            samples = {arm: [] for arm in mgrs}
+            bytes0 = {
+                arm: metric(
+                    "tpudra_checkpoint_bytes_written_total",
+                    {"kind": bytes_kind[arm]},
+                )
+                for arm in mgrs
+            }
+            # Iteration-interleaved arms: host noise lands on both equally.
+            for i in range(M):
+                for arm, mgr in mgrs.items():
+                    uid = f"res-{i % n_resident}"
+                    t0 = time.perf_counter()
+                    mgr.mutate(lambda cp, uid=uid: flip(cp, uid), touched=[uid])
+                    samples[arm].append((time.perf_counter() - t0) * 1000.0)
+            out["resident"][str(n_resident)] = {
+                arm: {
+                    "mutate_p50_ms": round(st.median(samples[arm]), 3),
+                    "bytes_per_mutate": round(
+                        (
+                            metric(
+                                "tpudra_checkpoint_bytes_written_total",
+                                {"kind": bytes_kind[arm]},
+                            )
+                            - bytes0[arm]
+                        )
+                        / M
+                    ),
+                }
+                for arm in mgrs
+            }
+    j8 = out["resident"]["8"]["journal"]["bytes_per_mutate"]
+    j128 = out["resident"]["128"]["journal"]["bytes_per_mutate"]
+    s8 = out["resident"]["8"]["snapshot"]["bytes_per_mutate"]
+    s128 = out["resident"]["128"]["snapshot"]["bytes_per_mutate"]
+    out["journal_bytes_ratio_128_vs_8"] = round(j128 / j8, 2) if j8 else None
+    out["snapshot_bytes_ratio_128_vs_8"] = round(s128 / s8, 2) if s8 else None
+
+    # 8-way group-commit fsync count, medians of 3 waves per arm: every
+    # wave is 8 barrier-aligned threads each committing one status flip.
+    def one_wave(mgr: CheckpointManager) -> float:
+        barrier = threading.Barrier(8)
+        errors: list = []
+
+        def worker(i: int) -> None:
+            try:
+                barrier.wait(timeout=30)
+                uid = f"res-{i}"
+                mgr.mutate(lambda cp, uid=uid: flip(cp, uid), touched=[uid])
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        f0 = all_fsyncs()
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        if errors:
+            raise RuntimeError(f"group-commit wave failed: {errors[0]}")
+        return all_fsyncs() - f0
+
+    group: dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for arm in ("journal", "snapshot"):
+            mgr = CheckpointManager(f"{tmp}/{arm}", journal=(arm == "journal"))
+            mgr.write(mk_resident(8))
+            # Warmup: the first-ever append pays a one-time directory
+            # fsync for the WAL file's creation; waves measure steady state.
+            mgr.mutate(lambda cp: flip(cp, "res-0"), touched=["res-0"])
+            mgr.mutate(lambda cp: flip(cp, "res-0"), touched=["res-0"])
+            waves = sorted(one_wave(mgr) for _ in range(3))
+            group[arm] = {
+                "fsyncs_per_8claim_wave_median": waves[1],
+                "fsyncs_per_8claim_wave_all": waves,
+            }
+    out["group_commit"] = group
+    return out
+
+
 def bench_bind_partition_p50() -> dict:
     """Dynamic-partition bind p50 through the NATIVE C++ library.
 
@@ -1151,6 +1308,7 @@ def bench_collectives_hook() -> dict:
 # ---------------------------------------------------------------------------
 
 SECTIONS = {
+    "checkpoint": bench_checkpoint_churn,
     "tpu": bench_tpu_step,
     "long8192": lambda: bench_long_context(8192, 2),
     "long16384": lambda: bench_long_context(16384, 1),
@@ -1250,6 +1408,8 @@ SUMMARY_KEYS = (
     "checked_count", "psum_bus_gbps", "hook_exercised", "num_experts",
     "matched", "prepares_per_s", "reconciles_per_s", "effective_qps",
     "held", "cache_entries", "heap_mb", "multiprocess_mode",
+    "mutate_p50_ms", "bytes_per_mutate", "journal_bytes_ratio_128_vs_8",
+    "snapshot_bytes_ratio_128_vs_8", "fsyncs_per_8claim_wave_median",
     # incremental-line payloads (probe + headline)
     "metric", "value", "unit", "vs_baseline",
     "reachable", "backend", "n_devices", "probe_s",
@@ -1322,6 +1482,17 @@ def main(argv=None) -> None:
         return
     full = "--full" in argv
 
+    if "--checkpoint-churn" in argv:
+        # The A/B artifact for checkpoint-storage PRs (`make
+        # bench-checkpoint`): WAL-vs-snapshot churn + group-commit fsyncs,
+        # CPU-only, no driver stack.
+        line = {
+            "metric": "checkpoint_churn",
+            **bench_checkpoint_churn(iters=iters),
+        }
+        print(json.dumps(line))
+        return
+
     if "--bind-only" in argv:
         # The A/B artifact for bind-path PRs: headline single-claim p50 +
         # the multi-claim batch section, nothing that needs a device.
@@ -1391,6 +1562,7 @@ def main(argv=None) -> None:
     emit("bind", headline)
     bind_batch = bench_bind_batch(iters=iters, warmup=warmup)
     emit("bind_batch", bind_batch)
+    checkpoint = run_section("checkpoint")
     partition = bench_bind_partition_p50()
     emit("dynamic_partition", partition)
 
@@ -1423,6 +1595,7 @@ def main(argv=None) -> None:
         "long_context_16k": run_section("long16384", needs_device=True),
         "moe": run_section("moe", needs_device=True),
         "collectives": collectives,
+        "checkpoint": checkpoint,
         "dynamic_partition": partition,
         "native_corroboration": run_section("native", needs_device=True),
         # North-star loop: native claim prepare → merged CDI env → the

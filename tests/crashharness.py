@@ -21,9 +21,26 @@ from tests.test_system import wait_for
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-#: The checkpoint boundaries both plugins arm (same names in
-#: plugin/device_state.py and cdplugin/state.py).
-POINTS = ["post-prepare-started", "post-mutate", "post-cdi", "post-completed"]
+#: The checkpoint boundaries both plugins arm: the four claim-lifecycle
+#: points (same names in plugin/device_state.py and cdplugin/state.py) plus
+#: the two storage-layer points inside CheckpointManager (checkpoint.py) —
+#: after the journal group-commit fsync, and mid-compaction between the
+#: snapshot replace and the journal truncate.
+POINTS = [
+    "post-prepare-started",
+    "post-mutate",
+    "post-cdi",
+    "post-completed",
+    "post-journal-append",
+    "mid-compaction",
+]
+
+#: Points that kill the very first checkpoint commit of a prepare: the
+#: claim is durably PrepareStarted (journal or snapshot), NO side effect
+#: has run yet — the sweeps assert the post-prepare-started state shape.
+STARTED_ONLY_POINTS = frozenset(
+    {"post-prepare-started", "post-journal-append", "mid-compaction"}
+)
 
 
 class CrashablePlugin:
@@ -63,9 +80,15 @@ class CrashablePlugin:
         if crashpoint:
             env["TPUDRA_CRASHPOINT"] = crashpoint
             env["TPUDRA_TEST_HOOKS"] = "1"  # two-key arming (device_state)
+            if crashpoint == "mid-compaction":
+                # Force a compaction on the first journal commit so the
+                # crashpoint between the snapshot replace and the journal
+                # truncate is reached during the prepare under test.
+                env["TPUDRA_JOURNAL_MAX_RECORDS"] = "1"
         else:
             env.pop("TPUDRA_CRASHPOINT", None)
             env.pop("TPUDRA_TEST_HOOKS", None)
+            env.pop("TPUDRA_JOURNAL_MAX_RECORDS", None)
         self.log_i += 1
         self.log_path = os.path.join(self.tmp, f"plugin-{self.log_i}.log")
         with open(self.log_path, "w") as out:
@@ -128,13 +151,34 @@ class CrashablePlugin:
             return json.load(f)
 
     def claim_statuses(self) -> dict:
-        """{uid: status} from the dual-version checkpoint (the v2 payload
-        is a JSON-encoded string under "data", checkpoint.py)."""
-        data = json.loads(self.checkpoint()["v2"]["data"])
+        """{uid: status} through the REAL recovery path (snapshot + journal
+        replay with torn-tail truncation) — exactly the view a restarted
+        plugin assembles."""
+        from tpudra.plugin.checkpoint import CheckpointManager
+
+        cp = CheckpointManager(self.plugin_dir).read()
+        return {uid: c.status for uid, c in cp.prepared_claims.items()}
+
+    def snapshot_statuses(self) -> dict:
+        """{uid: status} from checkpoint.json ALONE (no journal replay) —
+        what a pre-journal (downgraded) driver would see; {} when no
+        snapshot has been written yet."""
+        try:
+            data = json.loads(self.checkpoint()["v2"]["data"])
+        except FileNotFoundError:
+            return {}
         return {
             uid: c.get("status", "")
             for uid, c in data.get("preparedClaims", {}).items()
         }
+
+    def journal_size(self) -> int:
+        try:
+            return os.path.getsize(
+                os.path.join(self.plugin_dir, "checkpoint.wal")
+            )
+        except FileNotFoundError:
+            return 0
 
     def terminate(self):
         if self.proc and self.proc.poll() is None:

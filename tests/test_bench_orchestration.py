@@ -85,7 +85,9 @@ def test_hung_probe_degrades_to_diagnostic_and_parsed_headline(
     for key in ("tpu", "long_context", "long_context_16k", "moe",
                 "native_corroboration", "claim_to_jax"):
         assert "unreachable" in final["extras"][key]["skipped"]
-    assert stubbed == []
+    # The checkpoint-churn section is CPU-only: it runs (and only it)
+    # even with the device backend gone.
+    assert stubbed == ["checkpoint"]
     # Incremental evidence: probe + headline landed as partial lines first.
     sections = [p["section"] for p in partials]
     assert sections[0] == "probe" and "bind" in sections

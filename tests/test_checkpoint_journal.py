@@ -1,0 +1,685 @@
+"""The journaled checkpoint layer (docs/bind-path.md "Checkpoint storage"):
+WAL framing, delta mutates, group commit, compaction, recovery, and the
+rename-durability fix.
+
+The process-level crash sweeps (test_crash_sweep*.py) prove convergence
+against real SIGKILLs; this file pins the storage-layer mechanics
+deterministically: record framing and torn-tail truncation, O(delta)
+bytes-written independence from resident-claim count, the single-fsync
+group commit, the compaction triggers and their downgrade contract, the
+directory fsync after ``os.replace``, and the copy-free ``read_view``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import stat as stat_mod
+import threading
+import time
+
+import pytest
+from prometheus_client import REGISTRY
+
+from tpudra.plugin import journal
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    Checkpoint,
+    CheckpointError,
+    CheckpointManager,
+    PreparedClaim,
+    PreparedDevice,
+    PreparedDeviceGroup,
+)
+
+
+def sample(name: str, labels: dict | None = None) -> float:
+    return REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+
+def mk_claim(uid: str, status: str = PREPARE_COMPLETED, dev: str = "tpu-0") -> PreparedClaim:
+    return PreparedClaim(
+        uid=uid,
+        namespace="ns",
+        name=f"claim-{uid}",
+        status=status,
+        groups=[
+            PreparedDeviceGroup(
+                devices=[
+                    PreparedDevice(
+                        canonical_name=dev,
+                        type="chip",
+                        pool_name="node-a",
+                        request_names=["r0"],
+                        cdi_device_ids=[f"tpu.google.com/tpu={uid}-{dev}"],
+                        attributes={"uuid": f"uuid-{uid}"},
+                    )
+                ],
+                config_state={"timeslice": "Default"},
+            )
+        ],
+    )
+
+
+def wal_size(mgr: CheckpointManager) -> int:
+    try:
+        return os.path.getsize(mgr.journal_path)
+    except FileNotFoundError:
+        return 0
+
+
+def resident(n: int) -> Checkpoint:
+    cp = Checkpoint()
+    for i in range(n):
+        cp.prepared_claims[f"res-{i}"] = mk_claim(f"res-{i}", dev=f"tpu-{i % 8}")
+    return cp
+
+
+# ------------------------------------------------------------------ framing
+
+
+class TestFraming:
+    def test_roundtrip(self):
+        records = [
+            {"op": "upsert", "uid": "u1", "claim": {"uid": "u1"}},
+            {"op": "status", "uid": "u1", "status": "PrepareCompleted"},
+            {"op": "drop", "uid": "u1"},
+        ]
+        data = b"".join(journal.encode_record(r) for r in records)
+        decoded, good, torn = journal.decode_records(data)
+        assert decoded == records
+        assert good == len(data)
+        assert torn is False
+
+    def test_empty(self):
+        assert journal.decode_records(b"") == ([], 0, False)
+
+    @pytest.mark.parametrize(
+        "tail",
+        [
+            b"\x07",  # short header
+            b"\xff\xff\xff\x00\x00\x00\x00\x00",  # length past EOF
+            b"\x04\x00\x00\x00\x99\x99\x99\x99... ",  # CRC mismatch
+        ],
+    )
+    def test_torn_tail_stops_at_last_good_frame(self, tail):
+        good_frame = journal.encode_record({"op": "drop", "uid": "u1"})
+        decoded, good, torn = journal.decode_records(good_frame + tail)
+        assert decoded == [{"op": "drop", "uid": "u1"}]
+        assert good == len(good_frame)
+        assert torn is True
+
+    def test_crc_catches_bit_flip_mid_payload(self):
+        frame = bytearray(journal.encode_record({"op": "drop", "uid": "u1"}))
+        frame[-3] ^= 0x40
+        decoded, good, torn = journal.decode_records(bytes(frame))
+        assert decoded == [] and good == 0 and torn is True
+
+
+# ------------------------------------------------------------- delta writes
+
+
+class TestDeltaPersistence:
+    def test_mutate_appends_journal_not_snapshot(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(4))
+        snap_stat = os.stat(mgr.path)
+
+        def flip(cp):
+            cp.prepared_claims["res-1"].status = PREPARE_STARTED
+
+        mgr.mutate(flip, touched=["res-1"])
+        assert os.path.getsize(mgr.journal_path) > 0
+        after = os.stat(mgr.path)
+        assert (after.st_mtime_ns, after.st_ino) == (
+            snap_stat.st_mtime_ns, snap_stat.st_ino,
+        )
+        assert mgr.read().prepared_claims["res-1"].status == PREPARE_STARTED
+
+    def test_status_only_change_emits_status_record(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+        mgr.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["res-0"], "status", PREPARE_STARTED
+            ),
+            touched=["res-0"],
+        )
+        with open(mgr.journal_path, "rb") as f:
+            records, _, torn = journal.decode_records(f.read())
+        assert not torn
+        assert records == [
+            {"op": "status", "uid": "res-0", "status": PREPARE_STARTED}
+        ]
+
+    def test_upsert_and_drop_records(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+
+        def add(cp):
+            cp.prepared_claims["new-1"] = mk_claim("new-1", dev="tpu-7")
+
+        def drop(cp):
+            cp.prepared_claims.pop("res-0", None)
+
+        mgr.mutate(add, touched=["new-1"])
+        mgr.mutate(drop, touched=["res-0"])
+        with open(mgr.journal_path, "rb") as f:
+            records, _, _ = journal.decode_records(f.read())
+        assert [r["op"] for r in records] == ["upsert", "drop"]
+        got = CheckpointManager(str(tmp_path)).read()
+        assert set(got.prepared_claims) == {"res-1", "new-1"}
+        assert got.prepared_claims["new-1"] == mk_claim("new-1", dev="tpu-7")
+
+    def test_noop_mutate_writes_nothing(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+
+        def touch_nothing(cp):
+            assert "res-0" in cp.prepared_claims
+
+        mgr.mutate(touch_nothing, touched=["res-0"])
+        assert wal_size(mgr) == 0
+
+    def test_delta_mutator_must_not_drift_outside_touched(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+
+        def rogue(cp):
+            cp.prepared_claims["unlisted"] = mk_claim("unlisted")
+
+        with pytest.raises(CheckpointError, match="touched"):
+            mgr.mutate(rogue, touched=["res-0"])
+        assert "unlisted" not in CheckpointManager(str(tmp_path)).read().prepared_claims
+
+    def test_in_place_mutation_of_untouched_claim_is_caught(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+
+        def rogue(cp):
+            cp.prepared_claims["res-1"].status = PREPARE_STARTED
+
+        with pytest.raises(CheckpointError, match="in place"):
+            mgr.mutate(rogue, touched=["res-0"])
+
+    def test_queued_follower_honors_its_own_timeout(self, tmp_path):
+        from tpudra.flock import FlockTimeout
+
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+        leader_in_fn = threading.Event()
+        release_leader = threading.Event()
+
+        def slow(cp):
+            leader_in_fn.set()
+            assert release_leader.wait(30)
+            cp.prepared_claims["res-0"].status = PREPARE_STARTED
+
+        leader = threading.Thread(
+            target=lambda: mgr.mutate(slow, touched=["res-0"])
+        )
+        leader.start()
+        assert leader_in_fn.wait(30)
+        t0 = time.monotonic()
+        with pytest.raises(FlockTimeout):
+            mgr.mutate(
+                lambda cp: setattr(
+                    cp.prepared_claims["res-1"], "status", PREPARE_STARTED
+                ),
+                timeout=0.3,
+                touched=["res-1"],
+            )
+        assert time.monotonic() - t0 < 5.0
+        release_leader.set()
+        leader.join(timeout=30)
+        assert not leader.is_alive()
+        got = mgr.read()
+        assert got.prepared_claims["res-0"].status == PREPARE_STARTED
+        assert got.prepared_claims["res-1"].status == PREPARE_COMPLETED
+
+    def test_failing_mutator_leaves_state_untouched(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+
+        def boom(cp):
+            cp.prepared_claims["res-0"].status = PREPARE_STARTED
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            mgr.mutate(boom, touched=["res-0"])
+        assert mgr.read().prepared_claims["res-0"].status == PREPARE_COMPLETED
+        assert wal_size(mgr) == 0
+
+    def test_bytes_written_scale_with_delta_not_resident_count(self, tmp_path):
+        per_mutate = {}
+        for n in (8, 128):
+            mgr = CheckpointManager(str(tmp_path / f"j{n}"))
+            mgr.write(resident(n))
+            before = sample(
+                "tpudra_checkpoint_bytes_written_total", {"kind": "journal"}
+            )
+            for i in range(10):
+                uid = f"res-{i % n}"
+
+                def flip(cp, uid=uid):
+                    claim = cp.prepared_claims[uid]
+                    claim.status = (
+                        PREPARE_STARTED
+                        if claim.status == PREPARE_COMPLETED
+                        else PREPARE_COMPLETED
+                    )
+
+                mgr.mutate(flip, touched=[uid])
+            per_mutate[n] = (
+                sample(
+                    "tpudra_checkpoint_bytes_written_total", {"kind": "journal"}
+                )
+                - before
+            ) / 10
+        assert per_mutate[8] > 0
+        # The journal cost of one status flip is the record, not the state.
+        assert per_mutate[128] <= per_mutate[8] * 1.5
+
+        # The snapshot arm is the contrast: bytes per mutate grow with the
+        # resident-claim count.
+        snap = {}
+        for n in (8, 128):
+            mgr = CheckpointManager(str(tmp_path / f"s{n}"), journal=False)
+            mgr.write(resident(n))
+            before = sample(
+                "tpudra_checkpoint_bytes_written_total", {"kind": "snapshot"}
+            )
+            mgr.mutate(
+                lambda cp: setattr(
+                    cp.prepared_claims["res-0"], "status", PREPARE_STARTED
+                )
+            )
+            snap[n] = (
+                sample(
+                    "tpudra_checkpoint_bytes_written_total", {"kind": "snapshot"}
+                )
+                - before
+            )
+        assert snap[128] > snap[8] * 4
+
+
+# ------------------------------------------------------------ group commit
+
+
+class TestGroupCommit:
+    def test_concurrent_mutators_share_fsyncs(self, tmp_path, monkeypatch):
+        """8 barrier-aligned mutators must cost ≤2 fsyncs (one leader
+        commits its own entry, the second leader commits everyone who
+        queued during the first fsync) — against 16 for the snapshot arm
+        (a temp-file fsync + a directory fsync per mutate)."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(8))
+        # Warmup commit: the first-ever append also fsyncs the directory
+        # (file creation durability); measure steady-state waves.
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(warm=mk_claim("warm")),
+            touched=["warm"],
+        )
+
+        real_fsync = os.fsync
+
+        def slow_fsync(fd):
+            # Widen the commit window so thread-scheduling jitter cannot
+            # split the batch: any thread parked at the barrier has
+            # enqueued long before the first leader's fsync returns.
+            time.sleep(0.005)
+            return real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", slow_fsync)
+        barrier = threading.Barrier(8)
+        errors: list[Exception] = []
+
+        def fsyncs() -> float:
+            return sum(
+                sample("tpudra_checkpoint_fsyncs_total", {"kind": k})
+                for k in ("journal", "snapshot", "dir")
+            )
+
+        before = fsyncs()
+
+        def worker(i: int) -> None:
+            try:
+                barrier.wait(timeout=30)
+
+                def flip(cp, uid=f"res-{i}"):
+                    cp.prepared_claims[uid].status = PREPARE_STARTED
+
+                mgr.mutate(flip, touched=[f"res-{i}"])
+            except Exception as e:  # noqa: BLE001 — surfaced via assert
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert errors == []
+        assert fsyncs() - before <= 2
+        got = CheckpointManager(str(tmp_path)).read()
+        assert all(
+            got.prepared_claims[f"res-{i}"].status == PREPARE_STARTED
+            for i in range(8)
+        )
+
+    def test_batch_size_histogram_observes(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(1))
+        before = sample("tpudra_checkpoint_group_commit_batch_size_count")
+        mgr.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["res-0"], "status", PREPARE_STARTED
+            ),
+            touched=["res-0"],
+        )
+        assert sample("tpudra_checkpoint_group_commit_batch_size_count") == before + 1
+
+    def test_one_failing_entry_does_not_poison_the_batch(self, tmp_path, monkeypatch):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(4))
+        real_fsync = os.fsync
+        monkeypatch.setattr(
+            os, "fsync", lambda fd: (time.sleep(0.005), real_fsync(fd))[1]
+        )
+        barrier = threading.Barrier(4)
+        outcomes: dict[int, Exception | None] = {}
+
+        def worker(i: int) -> None:
+            def fn(cp, i=i):
+                if i == 2:
+                    raise RuntimeError("claim 2 is cursed")
+                cp.prepared_claims[f"res-{i}"].status = PREPARE_STARTED
+
+            try:
+                barrier.wait(timeout=30)
+                mgr.mutate(fn, touched=[f"res-{i}"])
+                outcomes[i] = None
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                outcomes[i] = e
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive()
+        assert isinstance(outcomes[2], RuntimeError)
+        assert [outcomes[i] for i in (0, 1, 3)] == [None, None, None]
+        got = CheckpointManager(str(tmp_path)).read()
+        for i in (0, 1, 3):
+            assert got.prepared_claims[f"res-{i}"].status == PREPARE_STARTED
+        assert got.prepared_claims["res-2"].status == PREPARE_COMPLETED
+
+
+# ------------------------------------------------------- recovery/compaction
+
+
+class TestRecoveryAndCompaction:
+    def test_fresh_manager_replays_journal_over_snapshot(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(3))
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(new=mk_claim("new")),
+            touched=["new"],
+        )
+        mgr.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["res-1"], "status", PREPARE_STARTED
+            ),
+            touched=["res-1"],
+        )
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.pop("res-2"), touched=["res-2"]
+        )
+        expected = mgr.read()
+        recovered = CheckpointManager(str(tmp_path)).read()
+        assert recovered == expected
+        assert set(recovered.prepared_claims) == {"res-0", "res-1", "new"}
+        assert recovered.prepared_claims["res-1"].status == PREPARE_STARTED
+
+    def test_torn_tail_is_loud_and_next_commit_repairs(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+        mgr.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["res-0"], "status", PREPARE_STARTED
+            ),
+            touched=["res-0"],
+        )
+        good_size = os.path.getsize(mgr.journal_path)
+        with open(mgr.journal_path, "ab") as f:
+            f.write(b"\x0c\x00\x00\x00\xde\xad\xbe\xefhalf")
+
+        before = sample("tpudra_checkpoint_journal_truncations_total")
+        fresh = CheckpointManager(str(tmp_path))
+        got = fresh.read()
+        assert got.prepared_claims["res-0"].status == PREPARE_STARTED
+        assert sample("tpudra_checkpoint_journal_truncations_total") == before + 1
+        # Un-repaired damage stays loud: a torn read is never cached.
+        fresh.read()
+        assert sample("tpudra_checkpoint_journal_truncations_total") == before + 2
+
+        fresh.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["res-1"], "status", PREPARE_STARTED
+            ),
+            touched=["res-1"],
+        )
+        with open(fresh.journal_path, "rb") as f:
+            data = f.read()
+        records, good, torn = journal.decode_records(data)
+        assert not torn and good == len(data) > good_size
+        assert records[-1] == {
+            "op": "status", "uid": "res-1", "status": PREPARE_STARTED,
+        }
+
+    def test_record_threshold_triggers_compaction(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), journal_max_records=3)
+        mgr.write(resident(2))
+        before = sample(
+            "tpudra_checkpoint_compactions_total", {"reason": "records"}
+        )
+        for i in range(3):
+            mgr.mutate(
+                lambda cp, i=i: cp.prepared_claims.update(
+                    {f"n{i}": mk_claim(f"n{i}")}
+                ),
+                touched=[f"n{i}"],
+            )
+        assert (
+            sample("tpudra_checkpoint_compactions_total", {"reason": "records"})
+            == before + 1
+        )
+        assert wal_size(mgr) == 0
+        # The snapshot alone (what a downgraded driver reads) is current.
+        with open(mgr.path) as f:
+            envelope = json.load(f)
+        v2 = json.loads(envelope["v2"]["data"])
+        assert set(v2["preparedClaims"]) == {"res-0", "res-1", "n0", "n1", "n2"}
+
+    def test_size_threshold_triggers_compaction(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), journal_max_bytes=200)
+        mgr.write(resident(1))
+        before = sample(
+            "tpudra_checkpoint_compactions_total", {"reason": "size"}
+        )
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(big=mk_claim("big")),
+            touched=["big"],
+        )
+        assert (
+            sample("tpudra_checkpoint_compactions_total", {"reason": "size"})
+            == before + 1
+        )
+        assert wal_size(mgr) == 0
+
+    def test_close_compacts_for_downgrade(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(1))
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(late=mk_claim("late")),
+            touched=["late"],
+        )
+        assert os.path.getsize(mgr.journal_path) > 0
+        before = sample(
+            "tpudra_checkpoint_compactions_total", {"reason": "shutdown"}
+        )
+        mgr.close()
+        assert (
+            sample("tpudra_checkpoint_compactions_total", {"reason": "shutdown"})
+            == before + 1
+        )
+        assert wal_size(mgr) == 0
+        # The downgrade contract: an old driver parses checkpoint.json
+        # alone and sees the post-journal state.
+        with open(os.path.join(str(tmp_path), "checkpoint.json")) as f:
+            envelope = json.load(f)
+        v1 = json.loads(envelope["v1"]["data"])
+        assert "late" in v1["preparedClaims"]
+
+    def test_mutate_after_close_snapshots_instead_of_journaling(self, tmp_path):
+        """A mutate racing shutdown (the GC thread mid-cycle) must not
+        write WAL records AFTER the downgrade-gate compaction — past
+        close(), persistence falls back to full dual-version snapshots,
+        so a downgraded driver reading only checkpoint.json sees it."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(1))
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(early=mk_claim("early")),
+            touched=["early"],
+        )
+        mgr.close()
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(late=mk_claim("late")),
+            touched=["late"],
+        )
+        assert wal_size(mgr) == 0
+        with open(mgr.path) as f:
+            envelope = json.load(f)
+        v2 = json.loads(envelope["v2"]["data"])
+        assert {"early", "late"} <= set(v2["preparedClaims"])
+
+    def test_legacy_mutate_without_touched_compacts_inline(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(1))
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(j=mk_claim("j")),
+            touched=["j"],
+        )
+        assert os.path.getsize(mgr.journal_path) > 0
+
+        def legacy(cp):
+            cp.prepared_claims["legacy"] = mk_claim("legacy")
+
+        mgr.mutate(legacy)  # no touched: the old full-write contract
+        assert wal_size(mgr) == 0
+        got = CheckpointManager(str(tmp_path)).read()
+        assert {"res-0", "j", "legacy"} <= set(got.prepared_claims)
+
+    def test_cross_manager_convergence(self, tmp_path):
+        """Two managers over one plugin dir (the sibling-process shape):
+        each sees the other's journal appends; the incremental leader path
+        replays only the foreign delta."""
+        a = CheckpointManager(str(tmp_path))
+        b = CheckpointManager(str(tmp_path))
+        a.write(resident(1))
+        a.mutate(
+            lambda cp: cp.prepared_claims.update(ua=mk_claim("ua")),
+            touched=["ua"],
+        )
+        b.mutate(
+            lambda cp: cp.prepared_claims.update(ub=mk_claim("ub")),
+            touched=["ub"],
+        )
+
+        def flip(cp):
+            assert "ub" in cp.prepared_claims  # b's append is visible to a
+            cp.prepared_claims["ua"].status = PREPARE_STARTED
+
+        a.mutate(flip, touched=["ua"])
+        got = CheckpointManager(str(tmp_path)).read()
+        assert set(got.prepared_claims) == {"res-0", "ua", "ub"}
+        assert got.prepared_claims["ua"].status == PREPARE_STARTED
+        assert b.read() == got
+
+    def test_no_journal_mutate_ignores_incidental_return(self, tmp_path):
+        """A lambda ending in dict.pop returns the popped claim; the
+        snapshot arm must not mistake it for a replacement checkpoint and
+        write a single claim out as the node's whole state."""
+        mgr = CheckpointManager(str(tmp_path), journal=False)
+        mgr.write(resident(2))
+        mgr.mutate(lambda cp: cp.prepared_claims.pop("res-0", None))
+        got = CheckpointManager(str(tmp_path)).read()
+        assert set(got.prepared_claims) == {"res-1"}
+
+    def test_zero_threshold_is_refused(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), journal_max_records=0)
+        assert mgr._journal_max_records > 0
+
+    def test_no_journal_manager_still_replays_leftover_journal(self, tmp_path):
+        journaling = CheckpointManager(str(tmp_path))
+        journaling.write(resident(1))
+        journaling.mutate(
+            lambda cp: cp.prepared_claims.update(w=mk_claim("w")),
+            touched=["w"],
+        )
+        plain = CheckpointManager(str(tmp_path), journal=False)
+        assert "w" in plain.read().prepared_claims
+        # Its first (full-write) mutate folds the journal away.
+        plain.mutate(lambda cp: None)
+        assert wal_size(plain) == 0
+
+
+# ----------------------------------------------------- durability + views
+
+
+class TestDurabilityAndViews:
+    def test_write_fsyncs_the_directory_after_replace(self, tmp_path, monkeypatch):
+        synced: list[tuple[bool, int]] = []
+        real_fsync = os.fsync
+
+        def spy(fd):
+            synced.append((stat_mod.S_ISDIR(os.fstat(fd).st_mode), fd))
+            return real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", spy)
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(1))
+        kinds = [is_dir for is_dir, _ in synced]
+        assert kinds == [False, True]
+        assert os.path.exists(mgr.path)
+
+    def test_read_view_shares_without_copy_and_is_immutable(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(2))
+        v1 = mgr.read_view()
+        v2 = mgr.read_view()
+        assert v1.prepared_claims["res-0"] is v2.prepared_claims["res-0"]
+        with pytest.raises(TypeError):
+            v1.prepared_claims["rogue"] = mk_claim("rogue")
+        # read() keeps copy semantics for mutating callers.
+        copy_out = mgr.read()
+        assert copy_out.prepared_claims["res-0"] is not v1.prepared_claims["res-0"]
+        copy_out.prepared_claims.clear()
+        assert set(mgr.read_view().prepared_claims) == {"res-0", "res-1"}
+
+    def test_read_view_survives_later_commits(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.write(resident(1))
+        view = mgr.read_view()
+        mgr.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["res-0"], "status", PREPARE_STARTED
+            ),
+            touched=["res-0"],
+        )
+        # Copy-on-write: the old generation's view is untouched; a fresh
+        # view sees the new state.
+        assert view.prepared_claims["res-0"].status == PREPARE_COMPLETED
+        assert mgr.read_view().prepared_claims["res-0"].status == PREPARE_STARTED

@@ -33,7 +33,7 @@ from tpudra.kube import gvr
 from tpudra.kube.client import KubeClient
 from tpudra.kube.httpserver import FakeKubeServer
 from tpudra.plugin.grpcserver import RPCError
-from tests.crashharness import POINTS, CrashablePlugin
+from tests.crashharness import POINTS, STARTED_ONLY_POINTS, CrashablePlugin
 from tests.test_system import wait_for
 
 LIB_PATH = os.environ.get("TPUINFO_LIBRARY_PATH", DEFAULT_LIB_PATH)
@@ -162,12 +162,24 @@ def test_sigkill_at_checkpoint_boundary_converges(short_tmp, point, kind):
                 assert statuses.get(uid) == "PrepareStarted", statuses
             if point == "post-cdi":
                 assert any(uid in f for f in h.cdi_files())
-            if point == "post-prepare-started":
+            if point in STARTED_ONLY_POINTS:
                 assert not any(uid in f for f in h.cdi_files())
                 if kind == "partition":
                     assert not h.live_partitions(), (
                         "mutation must not precede the started checkpoint"
                     )
+            if point == "post-journal-append":
+                # The record is durable in the WAL alone: the crash landed
+                # after the group-commit fsync, before any compaction — the
+                # snapshot (if one even exists) does not carry the claim.
+                assert uid not in h.snapshot_statuses()
+                assert h.journal_size() > 0
+            if point == "mid-compaction":
+                # The compaction's snapshot replace landed; the journal
+                # truncate did not — recovery replays the stale records
+                # over the snapshot idempotently.
+                assert h.snapshot_statuses().get(uid) == "PrepareStarted"
+                assert h.journal_size() > 0
             if point in ("post-mutate", "post-cdi", "post-completed"):
                 if kind == "partition":
                     assert h.live_partitions(), (
@@ -211,5 +223,61 @@ def test_sigkill_at_checkpoint_boundary_converges(short_tmp, point, kind):
             if kind == "partition":
                 assert not h.live_partitions()
             assert uid not in h.claim_statuses()
+        finally:
+            h.terminate()
+
+
+def test_torn_journal_tail_truncated_on_recovery(short_tmp):
+    """A half-written journal record (power cut mid-append) must be
+    dropped at replay — loudly — and the restarted plugin must converge to
+    exactly the pre-torn state: the claim binds, retries are idempotent,
+    teardown leaves nothing."""
+    uid = "crash-torn-tail"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        h = Harness(short_tmp, server)
+        h.start(crashpoint="post-journal-append")
+        try:
+            claim = chip_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            try:
+                try:
+                    dra.prepare([claim])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            assert h.claim_statuses().get(uid) == "PrepareStarted"
+
+            # Inject the torn tail: a frame header promising more payload
+            # bytes than exist (exactly what a crash mid-append leaves).
+            wal = os.path.join(h.plugin_dir, "checkpoint.wal")
+            good_size = os.path.getsize(wal)
+            with open(wal, "ab") as f:
+                f.write(b"\xff\xff\x00\x00GARBAGE")
+            # Recovery ignores the tail: same statuses as before the tear.
+            assert h.claim_statuses().get(uid) == "PrepareStarted"
+
+            h.start()
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid].get("devices"), resp
+                assert h.claim_statuses().get(uid) == "PrepareCompleted"
+                dra.unprepare([claim])
+            finally:
+                dra.close()
+            assert uid not in h.claim_statuses()
+            # The first commit after recovery repaired the file: every
+            # byte now decodes as a whole frame — no torn tail left.
+            from tpudra.plugin.journal import decode_records
+
+            with open(wal, "rb") as f:
+                _, good, torn = decode_records(f.read())
+            assert not torn and good >= good_size
+            assert "torn/corrupt tail" in h.log()
         finally:
             h.terminate()

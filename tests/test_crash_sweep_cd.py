@@ -31,7 +31,7 @@ from tpudra.kube import gvr
 from tpudra.kube.client import KubeClient
 from tpudra.kube.httpserver import FakeKubeServer
 from tpudra.plugin.grpcserver import RPCError
-from tests.crashharness import POINTS, CrashablePlugin
+from tests.crashharness import POINTS, STARTED_ONLY_POINTS, CrashablePlugin
 
 API_V = "resource.tpu.google.com/v1beta1"
 CD_UID = "cd-crash-uid"
@@ -141,10 +141,19 @@ def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point):
                 assert any(uid in f for f in h.cdi_files())
             else:
                 assert statuses.get(uid) == "PrepareStarted", statuses
-            if point == "post-prepare-started":
+            if point in STARTED_ONLY_POINTS:
                 # Intent only: no side effect may precede the Started write.
                 assert node_label(client) is None
                 assert not any(uid in f for f in h.cdi_files())
+            if point == "post-journal-append":
+                # Durable in the WAL alone — no snapshot yet.
+                assert uid not in h.snapshot_statuses()
+                assert h.journal_size() > 0
+            if point == "mid-compaction":
+                # Snapshot replaced, journal not yet truncated: recovery
+                # replays the stale records idempotently.
+                assert h.snapshot_statuses().get(uid) == "PrepareStarted"
+                assert h.journal_size() > 0
             if point in ("post-mutate", "post-cdi", "post-completed"):
                 assert node_label(client) == CD_UID
                 assert CD_UID in h.domain_dirs()
@@ -173,5 +182,57 @@ def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point):
             assert not any(uid in f for f in h.cdi_files())
             assert uid not in h.claim_statuses()
             assert node_label(client) is None
+        finally:
+            h.terminate()
+
+
+def test_cd_torn_journal_tail_truncated_on_recovery(short_tmp):
+    """CD-plugin twin of the TPU torn-tail sweep (runs without the native
+    build): a half-written WAL record after a SIGKILL is dropped loudly and
+    the retry converges to a completed claim, then a clean teardown."""
+    uid = "cd-crash-torn-tail"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        seed_cluster(client)
+        h = CDHarness(short_tmp, server)
+        h.start(crashpoint="post-journal-append")
+        try:
+            claim = channel_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            try:
+                try:
+                    dra.prepare([claim])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            assert h.claim_statuses().get(uid) == "PrepareStarted"
+
+            wal = os.path.join(h.plugin_dir, "checkpoint.wal")
+            good_size = os.path.getsize(wal)
+            with open(wal, "ab") as f:
+                f.write(b"\x10\x00\x00\x00\x99\x99\x99\x99half")
+            assert h.claim_statuses().get(uid) == "PrepareStarted"
+
+            h.start()
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid].get("devices"), (resp, h.log())
+                assert h.claim_statuses().get(uid) == "PrepareCompleted"
+                dra.unprepare([claim])
+            finally:
+                dra.close()
+            assert uid not in h.claim_statuses()
+            assert node_label(client) is None
+            from tpudra.plugin.journal import decode_records
+
+            with open(wal, "rb") as f:
+                _, good, torn = decode_records(f.read())
+            assert not torn and good >= good_size
+            assert "torn/corrupt tail" in h.log()
         finally:
             h.terminate()

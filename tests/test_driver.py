@@ -273,13 +273,22 @@ class TestDriver:
         node lock or rewrite the checkpoint (fsync per health tick)."""
         d = mk_driver(tmp_path)
         d.prepare_resource_claims([mk_claim("uid-1", ["tpu-0"])])
-        cp_path = d.state._cp.path
-        stat_before = (os.stat(cp_path).st_mtime_ns, os.stat(cp_path).st_ino)
+
+        def stamp(path):
+            # Journaled persistence: mutations land in checkpoint.wal and
+            # the snapshot may not exist yet — track both files.
+            try:
+                st = os.stat(path)
+            except FileNotFoundError:
+                return None
+            return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+        paths = (d.state._cp.path, d.state._cp.journal_path)
+        stat_before = [stamp(p) for p in paths]
+        assert any(s is not None for s in stat_before)
         assert d.prepare_resource_claims([]) == {"claims": {}}
         assert d.unprepare_resource_claims([]) == {"claims": {}}
-        assert (
-            os.stat(cp_path).st_mtime_ns, os.stat(cp_path).st_ino
-        ) == stat_before
+        assert [stamp(p) for p in paths] == stat_before
 
     def test_same_uid_prepare_unprepare_serialize(self, tmp_path):
         """Concurrent prepare and unprepare of the SAME uid must not

@@ -273,6 +273,9 @@ def test_bind_churn_witness_no_cycles_no_gaps(witness_log, static_graph, tmp_pat
         ("flock:claim-uid", "flock:pu.lock"),
         ("flock:pu.lock", "flock:cp.lock"),
         ("flock:cp.lock", "checkpoint.cache_lock"),
+        # The group-commit leader drains its queue under the checkpoint
+        # flock (ISSUE 5) — the commit condition nests inside cp.lock.
+        ("flock:cp.lock", "checkpoint.commit_cond"),
         ("driver.publish_lock", "driver.unhealthy_lock"),
         ("informer.dispatch_lock", "informer.store_lock"),
     ]:

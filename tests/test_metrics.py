@@ -136,6 +136,106 @@ class TestPrepareHistogram:
         assert after == before + 1
 
 
+class TestCheckpointJournalMetrics:
+    def test_journal_families_registered_and_move(self, tmp_path):
+        """The checkpoint-storage surface (ISSUE 5): records appended,
+        group-commit batch sizes, bytes written by kind, fsyncs by target,
+        compactions by reason, torn-tail truncations — all registered once
+        in metrics.py (METRICS-HYGIENE) and all moving under the journal's
+        real code paths."""
+        from tpudra.plugin.checkpoint import (
+            PREPARE_COMPLETED,
+            Checkpoint,
+            CheckpointManager,
+            PreparedClaim,
+        )
+
+        def snap(name, labels=None):
+            return sample(name, labels or {})
+
+        before = {
+            "records": snap("tpudra_checkpoint_journal_records_total"),
+            "batches": snap("tpudra_checkpoint_group_commit_batch_size_count"),
+            "jbytes": snap(
+                "tpudra_checkpoint_bytes_written_total", {"kind": "journal"}
+            ),
+            "sbytes": snap(
+                "tpudra_checkpoint_bytes_written_total", {"kind": "snapshot"}
+            ),
+            "jfsync": snap(
+                "tpudra_checkpoint_fsyncs_total", {"kind": "journal"}
+            ),
+            "dirfsync": snap("tpudra_checkpoint_fsyncs_total", {"kind": "dir"}),
+            "compact": snap(
+                "tpudra_checkpoint_compactions_total", {"reason": "records"}
+            ),
+            "trunc": snap("tpudra_checkpoint_journal_truncations_total"),
+        }
+
+        mgr = CheckpointManager(str(tmp_path), journal_max_records=2)
+        mgr.write(Checkpoint(prepared_claims={"u1": PreparedClaim(uid="u1")}))
+        mgr.mutate(
+            lambda cp: setattr(
+                cp.prepared_claims["u1"], "status", PREPARE_COMPLETED
+            ),
+            touched=["u1"],
+        )
+        assert snap("tpudra_checkpoint_journal_records_total") == before["records"] + 1
+        assert (
+            snap("tpudra_checkpoint_group_commit_batch_size_count")
+            == before["batches"] + 1
+        )
+        assert (
+            snap("tpudra_checkpoint_bytes_written_total", {"kind": "journal"})
+            > before["jbytes"]
+        )
+        assert (
+            snap("tpudra_checkpoint_fsyncs_total", {"kind": "journal"})
+            == before["jfsync"] + 1
+        )
+        # write() fsyncs the snapshot temp file AND the directory.
+        assert (
+            snap("tpudra_checkpoint_bytes_written_total", {"kind": "snapshot"})
+            > before["sbytes"]
+        )
+        assert snap("tpudra_checkpoint_fsyncs_total", {"kind": "dir"}) > before["dirfsync"]
+
+        # Second record crosses journal_max_records=2: a 'records' compaction.
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(u2=PreparedClaim(uid="u2")),
+            touched=["u2"],
+        )
+        assert (
+            snap("tpudra_checkpoint_compactions_total", {"reason": "records"})
+            == before["compact"] + 1
+        )
+
+        # A torn tail is counted on every read until repaired.
+        mgr.mutate(
+            lambda cp: cp.prepared_claims.update(u3=PreparedClaim(uid="u3")),
+            touched=["u3"],
+        )
+        with open(mgr.journal_path, "ab") as f:
+            f.write(b"\x09\x00\x00\x00\x01\x02\x03\x04torn")
+        CheckpointManager(str(tmp_path)).read()
+        assert (
+            snap("tpudra_checkpoint_journal_truncations_total")
+            == before["trunc"] + 1
+        )
+
+        body, _ = metrics.render_latest()
+        text = body.decode()
+        for family in (
+            "tpudra_checkpoint_journal_records_total",
+            "tpudra_checkpoint_group_commit_batch_size_bucket",
+            "tpudra_checkpoint_compactions_total",
+            "tpudra_checkpoint_journal_truncations_total",
+            "tpudra_checkpoint_bytes_written_total",
+            "tpudra_checkpoint_fsyncs_total",
+        ):
+            assert family in text
+
+
 class TestDebugSurface:
     def test_debug_stacks_lists_threads(self, tmp_path):
         d = mk_driver(tmp_path)

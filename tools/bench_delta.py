@@ -21,8 +21,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def current_headline(path: str) -> dict | None:
-    """Last line of the bench output that carries the headline metric."""
+def current_headline(path: str, metric: str = "resourceclaim_bind_p50_latency") -> dict | None:
+    """Last line of the bench output that carries ``metric``."""
     try:
         lines = open(path).read().splitlines()
     except OSError as e:
@@ -36,7 +36,7 @@ def current_headline(path: str) -> dict | None:
             obj = json.loads(line)
         except ValueError:
             continue
-        if obj.get("metric") == "resourceclaim_bind_p50_latency":
+        if obj.get("metric") == metric:
             return obj
     return None
 
@@ -78,8 +78,12 @@ def main() -> int:
         print("usage: python tools/bench_delta.py <bench-stdout-file>")
         return 2
     now = current_headline(sys.argv[1])
+    churn = current_headline(sys.argv[1], metric="checkpoint_churn")
+    if churn is not None:
+        print_checkpoint_section(churn)
     if now is None:
-        print("bench-delta: no headline line in this run's output")
+        if churn is None:
+            print("bench-delta: no headline line in this run's output")
         return 0
     prior = prior_headline()
     if prior is None:
@@ -121,6 +125,39 @@ def print_apiserver_section(now: dict) -> None:
         f"({ab.get('improvement_ms', round(uncached - cached, 3))} ms "
         f"left the hot path; ~{n} serialized GET RTTs = {n * rtt:g} ms)"
     )
+
+
+def print_checkpoint_section(churn: dict) -> None:
+    """The `--checkpoint-churn` A/B (make bench-checkpoint): WAL vs
+    snapshot arms, within-run by design — the bytes/fsync ratios ARE the
+    artifact, absolute latencies bounce with the box's fsync cost."""
+    group = churn.get("group_commit", {})
+    j = group.get("journal", {}).get("fsyncs_per_8claim_wave_median")
+    s = group.get("snapshot", {}).get("fsyncs_per_8claim_wave_median")
+    if j is not None and s is not None:
+        print(
+            f"bench-delta: checkpoint group commit: {j:g} fsync(s) per "
+            f"8-claim churn wave (WAL) vs {s:g} (snapshot-per-mutate)"
+        )
+    for n, arms in sorted(
+        churn.get("resident", {}).items(), key=lambda kv: int(kv[0])
+    ):
+        ja, sa = arms.get("journal", {}), arms.get("snapshot", {})
+        print(
+            f"bench-delta: checkpoint churn @{n} resident: WAL "
+            f"{ja.get('bytes_per_mutate')} B/mutate p50 "
+            f"{ja.get('mutate_p50_ms')} ms vs snapshot "
+            f"{sa.get('bytes_per_mutate')} B/mutate p50 "
+            f"{sa.get('mutate_p50_ms')} ms"
+        )
+    ratio_j = churn.get("journal_bytes_ratio_128_vs_8")
+    ratio_s = churn.get("snapshot_bytes_ratio_128_vs_8")
+    if ratio_j is not None:
+        print(
+            f"bench-delta: checkpoint bytes/mutate at 128 vs 8 resident: "
+            f"WAL x{ratio_j:g} (delta-sized), snapshot x{ratio_s:g} "
+            "(state-sized)"
+        )
 
 
 if __name__ == "__main__":

@@ -75,6 +75,7 @@ BIND_PATH_LOCKS = frozenset(
         "flock:cp.lock",
         "flock:claim-uid",
         "checkpoint.cache_lock",
+        "checkpoint.commit_cond",
         "driver.publish_lock",
         "driver.publish_cond",
         "driver.unhealthy_lock",

@@ -42,6 +42,10 @@ class CDDriverConfig:
     registry_dir: str
     cdi_root: str
     driver_root: str = "/"
+    # Journaled checkpoint persistence — see tpudra/plugin/driver.py's
+    # DriverConfig.journal (same WAL + group-commit layer, same downgrade
+    # gate via the clean-shutdown compaction in stop()).
+    journal: bool = True
 
 
 class CDDriver:
@@ -52,10 +56,13 @@ class CDDriver:
         os.makedirs(config.plugin_dir, exist_ok=True)
         self._pu_lock_path = os.path.join(config.plugin_dir, "pu.lock")
         self.cd_manager = ComputeDomainManager(kube, config.node_name, config.plugin_dir)
+        self._checkpoints = CheckpointManager(
+            config.plugin_dir, journal=config.journal
+        )
         self.state = ComputeDomainDeviceState(
             devicelib,
             CDIHandler(config.cdi_root, config.driver_root),
-            CheckpointManager(config.plugin_dir),
+            self._checkpoints,
             self.cd_manager,
             config.node_name,
         )
@@ -92,6 +99,9 @@ class CDDriver:
     def stop(self) -> None:
         self._stop.set()
         self._sockets.stop()
+        # Clean-shutdown journal compaction — the downgrade gate (see
+        # CheckpointManager.close()).
+        self._checkpoints.close()
 
     @property
     def sockets(self) -> PluginSockets:

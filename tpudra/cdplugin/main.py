@@ -41,6 +41,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--healthcheck-port", type=int,
         default=int(env_default("HEALTHCHECK_PORT", "-1")),
     )
+    p.add_argument(
+        "--no-journal",
+        action="store_true",
+        default=env_default("NO_JOURNAL", "").lower() == "true",
+        help="disable the append-only checkpoint journal (see the TPU "
+        "plugin's flag: full-snapshot writes per mutation, the "
+        "mixed-version escape hatch) [NO_JOURNAL]",
+    )
     return p
 
 
@@ -60,6 +68,7 @@ def main(argv=None) -> int:
             registry_dir=args.registry_dir,
             cdi_root=args.cdi_root,
             driver_root=args.driver_root,
+            journal=not args.no_journal,
         ),
         kube,
         lib,

@@ -176,7 +176,7 @@ class ComputeDomainDeviceState:
                 groups=[PreparedDeviceGroup(devices=[], config_state=intent)],
             )
 
-        self._cp.mutate(start)
+        self._cp.mutate(start, touched=[uid])
         if cached:
             return cached
         _crashpoint("post-prepare-started")
@@ -215,7 +215,7 @@ class ComputeDomainDeviceState:
                 groups=[PreparedDeviceGroup(devices=devices, config_state={})],
             )
 
-        self._cp.mutate(complete)
+        self._cp.mutate(complete, touched=[uid])
         _crashpoint("post-completed")
         logger.info(
             "prepared CD claim %s/%s:%s t_prep=%.4fs",
@@ -285,7 +285,7 @@ class ComputeDomainDeviceState:
             )
             drop_label = not still_used
 
-        self._cp.mutate(drop)
+        self._cp.mutate(drop, touched=[claim_uid])
 
         # Label GC after the drop, best-effort as ever: a crash in the gap
         # leaks the label only until the controller's periodic
@@ -298,7 +298,7 @@ class ComputeDomainDeviceState:
                 logger.warning("removing CD node label: %s", e)
 
     def prepared_claim_uids(self) -> dict[str, tuple[str, str, str]]:
-        cp = self._cp.read()
+        cp = self._cp.read_view()
         return {
             uid: (c.namespace, c.name, c.status)
             for uid, c in cp.prepared_claims.items()

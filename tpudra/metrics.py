@@ -84,6 +84,43 @@ CHECKPOINT_FALLBACKS_TOTAL = Counter(
     "Reads that fell back to an older checkpoint payload because a newer "
     "version failed its checksum",
 )
+CHECKPOINT_JOURNAL_RECORDS_TOTAL = Counter(
+    "tpudra_checkpoint_journal_records_total",
+    "Delta records (claim upsert / drop / status transition) appended to "
+    "the checkpoint journal (checkpoint.wal)",
+)
+CHECKPOINT_GROUP_COMMIT_BATCH_SIZE = Histogram(
+    "tpudra_checkpoint_group_commit_batch_size",
+    "Mutations folded into one checkpoint group commit — one leader, one "
+    "cp.lock acquisition, one fsync for the whole batch",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+)
+CHECKPOINT_COMPACTIONS_TOTAL = Counter(
+    "tpudra_checkpoint_compactions_total",
+    "Journal-into-snapshot compactions by trigger: 'size' / 'records' "
+    "(thresholds), 'shutdown' (the clean-exit compact that gates driver "
+    "downgrade)",
+    ["reason"],
+)
+CHECKPOINT_JOURNAL_TRUNCATIONS_TOTAL = Counter(
+    "tpudra_checkpoint_journal_truncations_total",
+    "Torn/CRC-bad journal tails dropped at replay — crash artifacts; each "
+    "read of an unrepaired tail re-counts (loud until a commit repairs it)",
+)
+CHECKPOINT_BYTES_WRITTEN_TOTAL = Counter(
+    "tpudra_checkpoint_bytes_written_total",
+    "Bytes written to checkpoint storage by kind: 'journal' (delta "
+    "records — O(delta) per mutate) or 'snapshot' (full dual-version "
+    "envelope — O(state) per write/compaction)",
+    ["kind"],
+)
+CHECKPOINT_FSYNCS_TOTAL = Counter(
+    "tpudra_checkpoint_fsyncs_total",
+    "fsync(2) calls issued by checkpoint storage by target: 'journal' "
+    "(one per group commit), 'snapshot' (temp file before rename), 'dir' "
+    "(parent directory after rename — what makes the rename durable)",
+    ["kind"],
+)
 
 
 # Labelled children resolved once: .labels() takes a registry lock and the

@@ -1,9 +1,9 @@
-"""Versioned, checksummed node-local checkpoint.
+"""Versioned, checksummed node-local checkpoint with a journaled delta layer.
 
 The analog of gpu-kubelet-plugin/{checkpoint,checkpointv}.go: a JSON file that
 is the node-local source of truth for idempotent prepare, partition teardown,
 channel-conflict detection, and stale-claim GC.  Both V1 and V2 payloads are
-written on every mutation, each with its own checksum, so that *both* driver
+written on every snapshot, each with its own checksum, so that *both* driver
 upgrade and downgrade find a checkpoint they can read (reference
 checkpoint.go:10-47, checkpointv.go:24-82).
 
@@ -21,11 +21,38 @@ passes its checksum does the read raise.  Unknown fields are tolerated
 (non-strict) so checkpoints written by newer drivers parse (reference
 api.go:54-58).
 
-Reads are served from an in-memory cache validated by stat (mtime_ns, size,
-inode): the bind path re-reads the checkpoint several times per claim under
-an uncontended lock, and each disk read costs open + JSON decode + CRC.
-Another process's write (the file is flock-coordinated and replaced
-atomically) changes the stat triple and invalidates the cache.
+**Journaled persistence (docs/bind-path.md "Checkpoint storage").**  With the
+journal enabled (the default), a mutation no longer re-encodes and fsyncs the
+whole dual-version snapshot: ``mutate(fn, touched=[uids])`` applies the
+mutator against the cached state and appends CRC-framed *delta* records
+(claim upsert / drop / status transition) to ``checkpoint.wal`` — O(delta)
+bytes and ONE fsync, regardless of how many claims are resident.  Concurrent
+in-process mutators (RPC threads, the GC thread, the batch engine) **group
+commit**: they enqueue their closures, one leader takes the ``cp.lock``
+flock, applies the whole queue, and issues a single fsync for the batch.
+Compaction — size/record-count thresholds, clean shutdown (``close()``), any
+legacy ``touched=None`` mutate, and degraded-read finalization — folds the
+journal into a fresh dual-version snapshot via ``write()`` (temp file fsync +
+``os.replace`` + directory fsync) and truncates the journal *after* the
+replace, so a crash anywhere between leaves a snapshot plus stale journal
+records whose replay is idempotent.  Recovery replays the journal over the
+snapshot, truncating at the first torn/CRC-bad tail record — loudly
+(``tpudra_checkpoint_journal_truncations_total``).
+
+**Downgrade contract.**  A journal written by this driver is invisible to
+older drivers (they read only ``checkpoint.json``), so state is current for
+them only after a compaction: downgrade requires the clean-shutdown compact
+(``close()``, wired into both plugins' ``stop()``), or any prior threshold
+compaction covering the final records.  ``--no-journal`` restores the
+per-mutate full-snapshot behavior for mixed-version windows.
+
+Reads are served from an in-memory cache validated by the stat triples
+(mtime_ns, size, inode) of BOTH files: the bind path re-reads the checkpoint
+several times per claim, and each disk read costs open + JSON decode + CRC +
+journal replay.  Another process's write changes a stat triple and
+invalidates the cache.  ``read()`` hands out deep copies (safe for mutating
+callers); ``read_view()`` hands out an immutable shared view for scan-heavy
+read-only callers (stale-claim GC, resourceslice rebuild).
 """
 
 from __future__ import annotations
@@ -37,11 +64,13 @@ import os
 import time
 import zlib
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from types import MappingProxyType
+from typing import Callable, Iterable, Optional
 
 from tpudra import lockwitness, metrics
 from tpudra.api import serde
-from tpudra.flock import Flock
+from tpudra.flock import Flock, FlockTimeout
+from tpudra.plugin import journal as journal_mod
 
 logger = logging.getLogger(__name__)
 
@@ -49,12 +78,43 @@ logger = logging.getLogger(__name__)
 # the bind path reads the checkpoint several times per claim).
 _READS_CACHE = metrics.CHECKPOINT_READS_TOTAL.labels("cache")
 _READS_DISK = metrics.CHECKPOINT_READS_TOTAL.labels("disk")
+_BYTES_JOURNAL = metrics.CHECKPOINT_BYTES_WRITTEN_TOTAL.labels("journal")
+_BYTES_SNAPSHOT = metrics.CHECKPOINT_BYTES_WRITTEN_TOTAL.labels("snapshot")
+_FSYNC_JOURNAL = metrics.CHECKPOINT_FSYNCS_TOTAL.labels("journal")
+_FSYNC_SNAPSHOT = metrics.CHECKPOINT_FSYNCS_TOTAL.labels("snapshot")
+_FSYNC_DIR = metrics.CHECKPOINT_FSYNCS_TOTAL.labels("dir")
 
 PREPARE_STARTED = "PrepareStarted"
 PREPARE_COMPLETED = "PrepareCompleted"
 
 CHECKPOINT_FILE = "checkpoint.json"
+CHECKPOINT_JOURNAL = "checkpoint.wal"
 CHECKPOINT_LOCK = "cp.lock"
+
+#: Journal compaction thresholds (env-overridable: the crash sweeps force a
+#: compaction on the first commit via TPUDRA_JOURNAL_MAX_RECORDS=1, and an
+#: operator can tune replay-at-recovery cost against write amplification).
+DEFAULT_JOURNAL_MAX_BYTES = 256 * 1024
+DEFAULT_JOURNAL_MAX_RECORDS = 1024
+
+
+def _crashpoint(point: str) -> None:
+    """Injectable SIGKILL for the process-level crash-consistency sweeps
+    (tests/test_crash_sweep*.py): when TPUDRA_CRASHPOINT names this
+    checkpoint boundary, die with no cleanup — the restarted plugin must
+    converge from the checkpoint alone (SURVEY §3.4's three GC layers;
+    reference device_state.go:223-242,337).  Two-key arming: the kill also
+    requires TPUDRA_TEST_HOOKS=1, so a single leaked env var in a copied
+    manifest cannot turn every production prepare into a crash loop.
+    Unarmed cost: one env read and string compare per boundary."""
+    if (
+        os.environ.get("TPUDRA_CRASHPOINT") == point
+        and os.environ.get("TPUDRA_TEST_HOOKS") == "1"
+    ):
+        import signal
+
+        logger.warning("crashpoint %s armed: SIGKILL self", point)
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 class CheckpointError(Exception):
@@ -199,23 +259,105 @@ def _decode_v1(data: str) -> Checkpoint:
     return cp
 
 
+@dataclass
+class _Mutation:
+    """One enqueued mutate(): the closure, its touched-uid contract, and the
+    completion flags the group-commit leader publishes under the commit
+    condition."""
+
+    fn: Callable[[Checkpoint], Optional[Checkpoint]]
+    touched: Optional[list[str]]
+    done: bool = False
+    error: Optional[BaseException] = None
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r", name, os.environ.get(name))
+        return default
+
+
+def _threshold(value: Optional[int], env: str, default: int) -> int:
+    """A compaction threshold: explicit argument over env over default —
+    `is None` (not falsy-or), so an explicit 0 is refused loudly instead
+    of silently ignored (a zero threshold would compact on EVERY commit,
+    an O(state) write per mutation that defeats the journal)."""
+    if value is None:
+        value = _env_int(env, default)
+    if value <= 0:
+        logger.warning(
+            "%s=%r is not a positive threshold; using %d", env, value, default
+        )
+        return default
+    return value
+
+
 class CheckpointManager:
     """Atomic read/write of the dual-version checkpoint file, with a
-    flock-guarded read-mutate-write helper (reference device_state.go:555-582)
-    and a stat-validated in-memory read cache."""
+    journaled, group-committed read-mutate-write helper (reference
+    device_state.go:555-582) and a stat-validated in-memory read cache."""
 
-    def __init__(self, plugin_dir: str):
+    def __init__(
+        self,
+        plugin_dir: str,
+        journal: Optional[bool] = None,
+        journal_max_bytes: Optional[int] = None,
+        journal_max_records: Optional[int] = None,
+    ):
         self._path = os.path.join(plugin_dir, CHECKPOINT_FILE)
         self._lock_path = os.path.join(plugin_dir, CHECKPOINT_LOCK)
         os.makedirs(plugin_dir, exist_ok=True)
-        # (stat key, decoded checkpoint). Callers may freely mutate what
-        # read() returns, so the cache holds its own copy.
-        self._cache: Optional[tuple[tuple[int, int, int], Checkpoint]] = None
+        if journal is None:
+            journal = os.environ.get("TPUDRA_NO_JOURNAL", "").lower() not in (
+                "1", "true",
+            )
+        self._journal_enabled = journal
+        self._journal = journal_mod.Journal(
+            os.path.join(plugin_dir, CHECKPOINT_JOURNAL)
+        )
+        self._journal_max_bytes = _threshold(
+            journal_max_bytes, "TPUDRA_JOURNAL_MAX_BYTES",
+            DEFAULT_JOURNAL_MAX_BYTES,
+        )
+        self._journal_max_records = _threshold(
+            journal_max_records, "TPUDRA_JOURNAL_MAX_RECORDS",
+            DEFAULT_JOURNAL_MAX_RECORDS,
+        )
+        # (stat-pair key, decoded checkpoint).  read() hands out copies;
+        # read_view() shares the cached graph read-only — writers REPLACE
+        # the cached object (copy-on-write per commit), never mutate it.
+        self._cache: Optional[tuple[tuple, Checkpoint]] = None
         self._cache_lock = lockwitness.make_lock("checkpoint.cache_lock")
+        # Group-commit queue: mutators enqueue under the condition; the
+        # first to find no active leader leads — it takes the cp.lock
+        # flock, drains the queue, persists the whole batch with one
+        # fsync, and publishes per-entry results here.  The flock is NEVER
+        # acquired while the condition is held (FLOCK-INVERSION).
+        self._commit_cond = lockwitness.make_condition("checkpoint.commit_cond")
+        self._commit_queue: list[_Mutation] = []
+        self._commit_leader = False
+        # Leader-only incremental view (touched only under the cp.lock
+        # flock): the applied state plus the journal position it reflects,
+        # so a steady-state commit replays a sibling process's few new
+        # records instead of re-reading O(state) from disk.
+        self._applied_state: Optional[Checkpoint] = None
+        self._applied_snap_key: Optional[tuple] = None
+        self._applied_jrn_ino: Optional[int] = None
+        self._applied_jrn_offset = 0
+        self._journal_records = 0
+        #: Base snapshot lacks a version (old-driver file): the next
+        #: commit forces a migrating dual-version snapshot write.
+        self._snapshot_needs_migration = False
 
     @property
     def path(self) -> str:
         return self._path
+
+    @property
+    def journal_path(self) -> str:
+        return self._journal.path
 
     def _stat_key(self) -> Optional[tuple[int, int, int]]:
         try:
@@ -227,64 +369,156 @@ class CheckpointManager:
         # always means a new inode.
         return (st.st_mtime_ns, st.st_size, st.st_ino)
 
+    # ----------------------------------------------------------------- reads
+
     def read(self) -> Checkpoint:
         return self._read_flagged()[0]
 
-    def _read_flagged(self, bypass_cache: bool = False) -> tuple[Checkpoint, bool]:
-        """(checkpoint, degraded) — the newest readable version; fresh
-        checkpoint if absent.  degraded means a corrupt newer version was
-        skipped and an older payload served.
+    def read_view(self) -> Checkpoint:
+        """A read-only snapshot WITHOUT the per-read deep copy: the claims
+        map is a ``MappingProxyType`` over the cached graph, shared with
+        every other view of the same generation.  Safe because writers
+        replace the cached object wholesale (copy-on-write per commit) and
+        never mutate it in place.  Scan-heavy read-only callers (stale-
+        claim GC, resourceslice sibling-visibility rebuild) use this;
+        anything that mutates what it read must use ``read()``."""
+        jkey = self._journal.stat_key()
+        skey = self._stat_key()
+        with self._cache_lock:
+            cached = self._cache
+        if cached is not None and cached[0] == (skey, jkey):
+            _READS_CACHE.inc()
+            return Checkpoint(
+                prepared_claims=MappingProxyType(cached[1].prepared_claims)
+            )
+        cp, _ = self._read_flagged()
+        return Checkpoint(prepared_claims=MappingProxyType(cp.prepared_claims))
 
-        Served from the in-memory cache when the file's stat triple is
+    def _read_flagged(self, bypass_cache: bool = False) -> tuple[Checkpoint, bool]:
+        """(checkpoint, degraded) — snapshot + journal replay; fresh
+        checkpoint when neither file exists.  degraded means a corrupt
+        newer snapshot version was skipped and an older payload served.
+
+        Served from the in-memory cache when BOTH stat triples are
         unchanged since the last read/write (unless ``bypass_cache`` —
-        the flock-guarded RMW needs disk-true freshness).  The stat is
-        taken BEFORE the disk read: if another process replaces the file
-        in between, the cache holds newer content under an older key and
-        the next read simply misses — never the reverse (stale content
-        under a new key).
-        """
-        key = self._stat_key()
-        if key is None:
-            return Checkpoint(), False
-        if not bypass_cache:
-            with self._cache_lock:
-                cached = self._cache
-            if cached is not None and cached[0] == key:
-                _READS_CACHE.inc()
-                # Deepcopy outside the mutex: the cached object is never
-                # mutated in place (writers replace the tuple wholesale),
-                # so concurrent readers need not serialize on an O(size)
-                # copy.  The copy itself scales with prepared-claim count —
-                # still cheaper than the open+JSON+CRC+decode it replaces,
-                # but a read-only snapshot accessor would beat both if a
-                # scan-heavy caller ever shows up hot.
-                return copy.deepcopy(cached[1]), False
-        t0 = time.monotonic()
-        cp, degraded = self._read_disk()
+        the no-journal RMW needs disk-true freshness).  Stats are taken
+        BEFORE the disk reads: if another process writes in between, the
+        cache holds newer content under an older key and the next read
+        simply misses — never the reverse (stale content under a new key).
+
+        Consistency without a lock: the journal is read BEFORE the
+        snapshot and accepted only if its stat is unchanged afterwards.
+        Compaction replaces the snapshot FIRST and truncates the journal
+        after, so a stable journal plus a possibly-newer snapshot is at
+        worst "new snapshot + stale records", whose replay is idempotent
+        (the snapshot already contains their effects); an empty journal
+        means the replace it followed is already visible to our later
+        snapshot read.  A moving journal stat (concurrent append's partial
+        frame, or a compaction's truncate) triggers a retry — writers all
+        serialize on the cp.lock flock, so the pair stabilizes; if churn
+        outlasts the retries we serve the last pair with a warning (plain
+        reads tolerate a transiently stale view; every state-WRITING read
+        path runs under the flock and never gets here)."""
+        result = None
+        for attempt in range(8):
+            jkey = self._journal.stat_key()
+            skey = self._stat_key()
+            if skey is None and jkey is None:
+                return Checkpoint(), False
+            key = (skey, jkey)
+            if not bypass_cache:
+                with self._cache_lock:
+                    cached = self._cache
+                if cached is not None and cached[0] == key:
+                    _READS_CACHE.inc()
+                    # Deepcopy outside the mutex: the cached object is
+                    # never mutated in place (writers replace the tuple
+                    # wholesale), so concurrent readers need not serialize
+                    # on an O(size) copy.
+                    return copy.deepcopy(cached[1]), False
+            t0 = time.monotonic()
+            jdata = self._journal.read_bytes()
+            pair = self._read_disk()
+            if bypass_cache or self._journal.stat_key() == jkey:
+                result = (key, jdata, pair, t0)
+                break
+        if result is None:
+            logger.warning(
+                "checkpoint journal kept moving across %d read attempts; "
+                "serving the last (possibly transiently stale) view", attempt + 1
+            )
+            result = (key, jdata, pair, t0)
+        key, jdata, (cp, degraded, _versions), t0 = result
+        torn = self._replay(cp, jdata)
         _READS_DISK.inc()
         metrics.observe_phase(
             metrics.PHASE_CHECKPOINT_READ, time.monotonic() - t0
         )
-        if not degraded:
-            # A version-fallback read is deliberately NOT cached: caching it
-            # would make the fallback loud exactly once and then silent —
-            # every read of a corrupt file must re-log and re-count while
-            # the node runs on the degraded payload.
+        if not degraded and not torn:
+            # A version-fallback or torn-tail read is deliberately NOT
+            # cached: caching it would make the corruption signal loud
+            # exactly once and then silent — every read of a damaged file
+            # must re-log and re-count until a commit repairs it.
             with self._cache_lock:
                 self._cache = (key, copy.deepcopy(cp))
         return cp, degraded
 
-    def _read_disk(self) -> tuple[Checkpoint, bool]:
-        """Decode the newest version that passes its checksum.  Returns
-        (checkpoint, degraded) — degraded means a newer corrupt version was
-        skipped and an older payload served."""
+    @staticmethod
+    def _apply_record(cp: Checkpoint, record: dict) -> None:
+        """Apply one journal delta record in place (replay; ``cp`` must be
+        a private object — the leader's incremental path copies first)."""
+        op = record.get("op")
+        uid = record.get("uid", "")
+        if op == "upsert":
+            cp.prepared_claims[uid] = serde.decode(
+                PreparedClaim, record.get("claim", {}), strict=False
+            )
+        elif op == "drop":
+            cp.prepared_claims.pop(uid, None)
+        elif op == "status":
+            claim = cp.prepared_claims.get(uid)
+            if claim is None:
+                logger.warning(
+                    "journal status record for unknown claim %s: skipped", uid
+                )
+            else:
+                claim.status = record.get("status", claim.status)
+        else:
+            # Forward compat: a newer driver's record kind degrades to a
+            # loud skip, not a wedged node (mirrors non-strict decode).
+            logger.warning("unknown journal record op %r: skipped", op)
+
+    def _replay(self, cp: Checkpoint, jdata: bytes) -> bool:
+        """Replay journal bytes over ``cp``; True when a torn tail was
+        dropped (loud + counted — recovery semantics, docs/bind-path.md)."""
+        if not jdata:
+            return False
+        records, good, torn = journal_mod.decode_records(jdata)
+        if torn:
+            logger.error(
+                "checkpoint journal has a torn/corrupt tail: replaying %d "
+                "record(s) (%d of %d bytes) and dropping the rest",
+                len(records), good, len(jdata),
+            )
+            metrics.CHECKPOINT_JOURNAL_TRUNCATIONS_TOTAL.inc()
+        for record in records:
+            self._apply_record(cp, record)
+        return torn
+
+    def _read_disk(self) -> tuple[Checkpoint, bool, frozenset]:
+        """Decode the newest snapshot version that passes its checksum.
+        Returns (checkpoint, degraded, versions-present) — degraded means
+        a newer corrupt version was skipped and an older payload served;
+        the version set lets the commit path force a migrating snapshot
+        when an old driver's file (v1-only) is the base."""
         try:
             with open(self._path) as f:
                 envelope = json.load(f)
         except FileNotFoundError:
-            return Checkpoint(), False
+            return Checkpoint(), False, frozenset()
         except ValueError as e:
             raise CheckpointError(f"corrupt checkpoint envelope: {e}") from e
+        versions = frozenset(envelope) & {"v1", "v2"}
         corrupt: list[str] = []
         for version, decode in (("v2", _decode_v2), ("v1", _decode_v1)):
             entry = envelope.get(version)
@@ -311,7 +545,7 @@ class CheckpointManager:
                     version, ", ".join(corrupt),
                 )
                 metrics.CHECKPOINT_FALLBACKS_TOTAL.inc()
-            return cp, bool(corrupt)
+            return cp, bool(corrupt), versions
         if corrupt:
             raise ChecksumMismatch(
                 "checkpoint has no version with a valid checksum "
@@ -319,8 +553,18 @@ class CheckpointManager:
             )
         raise CheckpointError("checkpoint has no readable version")
 
+    # ---------------------------------------------------------------- writes
+
     def write(self, cp: Checkpoint) -> None:
-        """Durably replace the checkpoint and prime the read cache.
+        """Durably replace the dual-version snapshot, truncate the journal
+        it supersedes, and prime the read cache.
+
+        Durability order: temp-file fsync → ``os.replace`` → DIRECTORY
+        fsync (without which a crash can lose the rename itself) → journal
+        truncate.  A crash between the replace and the truncate leaves
+        stale journal records whose replay over the new snapshot is
+        idempotent (the snapshot already contains their effects) — the
+        ``mid-compaction`` crash sweep proves the convergence.
 
         Cache contract: the cache holds ``cp`` by REFERENCE (a deepcopy per
         write was measurable on the bind path) — after write() the caller
@@ -333,35 +577,485 @@ class CheckpointManager:
             "v1": {"data": v1, "checksum": _checksum(v1)},
             "v2": {"data": v2, "checksum": _checksum(v2)},
         }
+        data = json.dumps(envelope)
         tmp = self._path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(envelope, f)
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
-        # The stat is taken after the replace, so the key matches exactly
-        # what a subsequent read would see for this content.
-        key = self._stat_key()
+        journal_mod.fsync_dir(os.path.dirname(self._path) or ".")
+        _FSYNC_SNAPSHOT.inc()
+        _FSYNC_DIR.inc()
+        _BYTES_SNAPSHOT.inc(len(data))
+        _crashpoint("mid-compaction")
+        jkey = self._journal.stat_key()
+        if jkey is not None and jkey[1] > 0:
+            self._journal.truncate_locked(0)
+        # The stats are taken after the replace/truncate, so the key matches
+        # exactly what a subsequent read would see for this content.
+        key = (self._stat_key(), self._journal.stat_key())
         with self._cache_lock:
-            self._cache = (key, cp) if key is not None else None
+            self._cache = (key, cp) if key[0] is not None else None
         metrics.observe_phase(
             metrics.PHASE_CHECKPOINT_WRITE, time.monotonic() - t0
         )
 
     def mutate(
-        self, fn: Callable[[Checkpoint], Optional[Checkpoint]], timeout: float = 10.0
+        self,
+        fn: Callable[[Checkpoint], Optional[Checkpoint]],
+        timeout: float = 10.0,
+        touched: Optional[Iterable[str]] = None,
     ) -> None:
-        """flock-guarded read-mutate-write: fn may mutate in place (return
-        None) or return a replacement.  Returns nothing: the final object is
-        cached by reference (write()'s contract), so handing it out would
-        invite cache-poisoning mutations — re-``read()`` for a copy.
+        """Group-committed read-mutate-write.  Returns nothing: the final
+        object is cached by reference (write()'s contract), so handing it
+        out would invite cache-poisoning mutations — re-``read()`` for a
+        copy.
 
-        A mutate over a degraded read FINALIZES the fallback — the write
+        ``touched`` is the delta contract: the uids (a superset is fine)
+        whose claims ``fn`` may add, remove, or mutate — everything else it
+        may only READ.  With it, persistence is O(delta): the commit
+        appends upsert/drop/status records for the touched claims that
+        actually changed.  Without it (``touched=None``), ``fn`` may do
+        anything the old API allowed — mutate any claim in place or return
+        a replacement — and the commit falls back to a full snapshot
+        write.  With the journal disabled, every mutate takes the
+        un-batched flock + full-write path regardless.
+
+        A mutate over a degraded read FINALIZES the fallback — the commit
         re-encodes both versions with valid checksums from the fallback
         payload, after which the corruption signal stops firing and the
         newer-version-only state is gone.  So before overwriting, the
         corrupt original is preserved at ``<path>.corrupt`` for inspection
         or manual repair, and the finalization itself is logged loudly."""
+        if not self._journal_enabled:
+            self._mutate_snapshot(fn, timeout)
+            return
+        mutation = _Mutation(
+            fn=fn, touched=None if touched is None else list(touched)
+        )
+        lead = False
+        deadline = time.monotonic() + timeout
+        with self._commit_cond:
+            self._commit_queue.append(mutation)
+            while not mutation.done and self._commit_leader:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and mutation in self._commit_queue:
+                    # Still queued (no leader drained it): abandoning is
+                    # safe, and honors this CALLER's timeout instead of
+                    # silently inheriting the leader's.  Once drained, the
+                    # leader owns it and we must see the outcome through.
+                    self._commit_queue.remove(mutation)
+                    raise FlockTimeout(
+                        "timeout waiting for checkpoint group commit "
+                        f"after {timeout}s"
+                    )
+                self._commit_cond.wait(min(1.0, max(0.05, remaining)))
+            if not mutation.done:
+                self._commit_leader = True
+                lead = True
+        if lead:
+            try:
+                self._lead_commit(timeout)
+            finally:
+                with self._commit_cond:
+                    self._commit_leader = False
+                    self._commit_cond.notify_all()
+        if mutation.error is not None:
+            raise mutation.error
+
+    def _lead_commit(self, timeout: float) -> None:
+        """The group-commit leader: one flock, the whole queue, one fsync.
+        The queue is drained AFTER the flock lands — mutations enqueued
+        while the leader waited ride this batch, which is where batching
+        under contention comes from."""
+        batch: list[_Mutation] = []
+        try:
+            with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock
+                with self._commit_cond:
+                    batch = list(self._commit_queue)
+                    self._commit_queue.clear()
+                self._commit_batch_locked(batch)
+        except BaseException as e:  # noqa: BLE001 — flock timeout / IO error
+            # A batch-wide fault (the flock timed out, the checkpoint is
+            # unreadable): every entry of this batch — including any still
+            # queued — gets the error; callers retry exactly as the
+            # un-batched path made them.
+            with self._commit_cond:
+                batch.extend(self._commit_queue)
+                self._commit_queue.clear()
+                for m in batch:
+                    if not m.done:
+                        m.error = m.error or e
+                        m.done = True
+                self._commit_cond.notify_all()
+            return
+        with self._commit_cond:
+            for m in batch:
+                m.done = True
+            self._commit_cond.notify_all()
+
+    def _preserve_corrupt(self) -> None:
+        """Keep the corrupt original at ``<path>.corrupt`` before a commit
+        finalizes a degraded (fallback) read."""
+        corrupt_path = self._path + ".corrupt"
+        try:
+            with open(self._path, "rb") as src, open(corrupt_path, "wb") as dst:
+                dst.write(src.read())
+        except OSError:
+            logger.exception(
+                "cannot preserve corrupt checkpoint at %s", corrupt_path
+            )
+        logger.error(
+            "finalizing degraded checkpoint: rewriting all versions "
+            "from the fallback payload; original preserved at %s",
+            corrupt_path,
+        )
+
+    def _load_locked(self) -> tuple[Checkpoint, bool]:
+        """Disk-true state for a commit (caller holds the cp.lock flock).
+
+        Steady state is O(delta): when the snapshot stat is unchanged and
+        the journal only grew (the invariant: every truncation is paired
+        with a snapshot replace, so same-snapshot ⇒ append-only journal),
+        only the bytes past the leader's last-known offset are read and
+        replayed — copy-on-write, so previously handed-out read views stay
+        immutable.  Anything else (fresh manager, sibling compaction,
+        degraded snapshot) is a full reload."""
+        snap_key = self._stat_key()
+        jkey = self._journal.stat_key()
+        jrn_ino = jkey[2] if jkey is not None else None
+        jrn_size = jkey[1] if jkey is not None else 0
+        if (
+            self._applied_state is not None
+            and snap_key == self._applied_snap_key
+            and jrn_ino == self._applied_jrn_ino
+            and jrn_size >= self._applied_jrn_offset
+        ):
+            if jrn_size == self._applied_jrn_offset:
+                _READS_CACHE.inc()
+                return self._applied_state, False
+            # A sibling process appended: replay just its records.
+            data = self._journal.read_bytes(self._applied_jrn_offset)
+            records, good, torn = journal_mod.decode_records(data)
+            if not torn and good == len(data):
+                work = Checkpoint(
+                    prepared_claims=dict(self._applied_state.prepared_claims)
+                )
+                for record in records:
+                    self._apply_record_cow(work, record)
+                self._applied_state = work
+                self._applied_jrn_offset += good
+                self._journal_records += len(records)
+                _READS_CACHE.inc()
+                return work, False
+            # A torn frame inside the incremental window is NOT repaired
+            # from here: the stat-pair match is not collision-proof across
+            # processes (the same caveat _mutate_snapshot documents for
+            # its cache bypass), and on a collision these bytes could be a
+            # sibling's REWRITTEN journal read at a stale offset —
+            # truncating would destroy its fsynced records.  Discard the
+            # incremental base and let the whole-file reload below decide;
+            # only a from-byte-zero parse may repair.
+            logger.warning(
+                "incremental journal window did not decode cleanly at "
+                "offset %d; falling back to a full reload",
+                self._applied_jrn_offset,
+            )
+            self._applied_state = None
+        t0 = time.monotonic()
+        jdata = self._journal.read_bytes()
+        cp, degraded, versions = self._read_disk()
+        # A base written by a different driver generation (v1-only file
+        # from a pre-V2 driver, or v2-only from some future one) must not
+        # linger under an ever-growing journal: the first commit over it
+        # forces a full snapshot, restoring the dual-version envelope —
+        # the migrate-on-first-write property the journal would otherwise
+        # defer to an arbitrary later compaction.
+        self._snapshot_needs_migration = bool(versions) and versions != {
+            "v1", "v2",
+        }
+        records, good, torn = journal_mod.decode_records(jdata)
+        if torn:
+            logger.error(
+                "checkpoint journal has a torn/corrupt tail: replaying %d "
+                "record(s) and truncating to %d of %d bytes",
+                len(records), good, len(jdata),
+            )
+            metrics.CHECKPOINT_JOURNAL_TRUNCATIONS_TOTAL.inc()
+            self._journal.truncate_locked(good)
+        for record in records:
+            self._apply_record(cp, record)
+        _READS_DISK.inc()
+        metrics.observe_phase(
+            metrics.PHASE_CHECKPOINT_READ, time.monotonic() - t0
+        )
+        if degraded:
+            # Don't adopt a degraded view as the incremental base: if this
+            # commit dies before finalizing, the next one must re-read and
+            # re-detect (the corruption signal stays loud).
+            self._applied_state = None
+            return cp, True
+        jkey = self._journal.stat_key()
+        self._applied_state = cp
+        self._applied_snap_key = self._stat_key()
+        self._applied_jrn_ino = jkey[2] if jkey is not None else None
+        self._applied_jrn_offset = good
+        self._journal_records = len(records)
+        return cp, False
+
+    def _apply_record_cow(self, work: Checkpoint, record: dict) -> None:
+        """Apply a sibling's record to ``work`` without mutating claim
+        objects shared with handed-out read views: the in-place ``status``
+        op copies its target first (upsert/drop already bind fresh
+        objects into ``work``'s private dict)."""
+        if record.get("op") == "status":
+            uid = record.get("uid", "")
+            claim = work.prepared_claims.get(uid)
+            if claim is not None:
+                work.prepared_claims[uid] = copy.deepcopy(claim)
+        self._apply_record(work, record)
+
+    def _commit_batch_locked(self, batch: list[_Mutation]) -> None:
+        """Apply every queued mutation against the cached state, persist
+        the result — delta records + ONE fsync, or a full snapshot when the
+        batch contains a legacy/finalizing entry — and prime the caches.
+        Runs under the cp.lock flock and NO in-process lock."""
+        t0 = time.monotonic()
+        state, degraded = self._load_locked()
+        if degraded:
+            self._preserve_corrupt()
+        # Copy-on-write working state: a fresh top-level dict per commit,
+        # fresh objects only for the claims this batch touches — handed-out
+        # read views keep the previous generation's graph, untouched.
+        work = Checkpoint(prepared_claims=dict(state.prepared_claims))
+        records: list[dict] = []
+        # not journal_enabled: a commit racing close() — the shutdown
+        # compaction already ran or is imminent, so appending would write
+        # records a downgraded driver never sees; snapshot instead.
+        force_snapshot = (
+            degraded
+            or self._snapshot_needs_migration
+            or not self._journal_enabled
+        )
+        for m in batch:
+            try:
+                if m.touched is None:
+                    # Legacy contract: fn may mutate anything or return a
+                    # replacement.  Isolate on a scratch copy so a fn that
+                    # mutates and THEN raises cannot poison the batch.
+                    scratch = copy.deepcopy(work)
+                    out = m.fn(scratch)
+                    # isinstance, not is-not-None: incidental returns (a
+                    # lambda ending in dict.pop) must not become the state.
+                    work = out if isinstance(out, Checkpoint) else scratch
+                    force_snapshot = True
+                else:
+                    records.extend(self._apply_delta(work, m))
+            except BaseException as e:  # noqa: BLE001 — per-entry barrier
+                m.error = e
+        if force_snapshot:
+            self.write(work)
+            self._journal_records = 0
+            self._snapshot_needs_migration = False
+        elif records:
+            payloads = [journal_mod.encode_record(r) for r in records]
+            n, dir_synced = self._journal.append_locked(payloads)
+            _FSYNC_JOURNAL.inc()
+            if dir_synced:
+                _FSYNC_DIR.inc()
+            _BYTES_JOURNAL.inc(n)
+            metrics.CHECKPOINT_JOURNAL_RECORDS_TOTAL.inc(len(records))
+            self._journal_records += len(records)
+            _crashpoint("post-journal-append")
+            jkey = self._journal.stat_key()
+            if self._journal_records >= self._journal_max_records:
+                self._compact_locked(work, "records")
+            elif jkey is not None and jkey[1] >= self._journal_max_bytes:
+                self._compact_locked(work, "size")
+            else:
+                key = (self._stat_key(), jkey)
+                with self._cache_lock:
+                    self._cache = (key, work)
+        else:
+            # Nothing durable changed (idempotent hits, all-error batch):
+            # zero disk writes, but the assembled state is still the
+            # freshest view — prime the caches.
+            key = (self._stat_key(), self._journal.stat_key())
+            with self._cache_lock:
+                self._cache = (key, work)
+        metrics.CHECKPOINT_GROUP_COMMIT_BATCH_SIZE.observe(len(batch))
+        metrics.observe_phase(
+            metrics.PHASE_CHECKPOINT_WRITE, time.monotonic() - t0
+        )
+        jkey = self._journal.stat_key()
+        self._applied_state = work
+        self._applied_snap_key = self._stat_key()
+        self._applied_jrn_ino = jkey[2] if jkey is not None else None
+        self._applied_jrn_offset = jkey[1] if jkey is not None else 0
+
+    def _apply_delta(self, work: Checkpoint, m: _Mutation) -> list[dict]:
+        """Run one touched-contract mutator against ``work`` and derive its
+        delta records.  The touched claims are copied in first (CoW), the
+        pre-images kept for the diff and for rollback if ``fn`` raises."""
+        pre: dict[str, Optional[PreparedClaim]] = {}
+        for uid in dict.fromkeys(m.touched or ()):
+            cur = work.prepared_claims.get(uid)
+            pre[uid] = cur
+            if cur is not None:
+                work.prepared_claims[uid] = copy.deepcopy(cur)
+        keys_before = set(work.prepared_claims)
+        # Untouched-claim integrity guard, armed under the test suite and
+        # the crash harnesses only (an O(state) deepcopy per commit): an
+        # in-place write to a claim OUTSIDE the touched set would poison
+        # the cache generation shared with read_view() AND emit no record
+        # (silently lost on restart) — the key-set drift check below
+        # cannot see it, so CI enforces the contract where production
+        # relies on it.
+        guarded = (
+            "PYTEST_CURRENT_TEST" in os.environ
+            or os.environ.get("TPUDRA_TEST_HOOKS") == "1"
+        )
+        untouched_copy: dict[str, PreparedClaim] = {}
+        if guarded:
+            untouched_copy = {
+                uid: copy.deepcopy(claim)
+                for uid, claim in work.prepared_claims.items()
+                if uid not in pre
+            }
+        try:
+            out = m.fn(work)
+            # Incidental return values (a lambda ending in dict.pop/update)
+            # are fine; only an actual replacement-Checkpoint return — the
+            # legacy contract delta mode cannot honor — is refused.
+            if isinstance(out, Checkpoint) and out is not work:
+                raise CheckpointError(
+                    "a delta mutate (touched=[...]) must mutate in place, "
+                    "not return a replacement checkpoint"
+                )
+            drifted = (set(work.prepared_claims) ^ keys_before) - set(pre)
+            if drifted:
+                raise CheckpointError(
+                    "delta mutate added/removed claims outside its touched "
+                    f"set: {sorted(drifted)} — widen `touched` or use "
+                    "touched=None"
+                )
+            if guarded:
+                dirty = [
+                    uid
+                    for uid, snapshot in untouched_copy.items()
+                    if work.prepared_claims.get(uid) != snapshot
+                ]
+                if dirty:
+                    raise CheckpointError(
+                        "delta mutate modified claims outside its touched "
+                        f"set in place: {sorted(dirty)} — the change would "
+                        "poison the shared cache generation and never be "
+                        "persisted; widen `touched` or use touched=None"
+                    )
+        except BaseException:
+            # This entry contributes nothing: its touched claims roll back
+            # so the rest of the batch commits from a clean state.
+            for uid, old in pre.items():
+                if old is None:
+                    work.prepared_claims.pop(uid, None)
+                else:
+                    work.prepared_claims[uid] = old
+            raise
+        records: list[dict] = []
+        for uid, old in pre.items():
+            new = work.prepared_claims.get(uid)
+            if new is None:
+                if old is not None:
+                    records.append({"op": "drop", "uid": uid})
+                continue
+            if old == new:
+                continue
+            if (
+                old is not None
+                and old.status != new.status
+                and old.groups == new.groups
+                and old.namespace == new.namespace
+                and old.name == new.name
+            ):
+                records.append(
+                    {"op": "status", "uid": uid, "status": new.status}
+                )
+                continue
+            records.append(
+                {"op": "upsert", "uid": uid, "claim": serde.encode(new)}
+            )
+        return records
+
+    def _compact_locked(self, state: Checkpoint, reason: str) -> None:
+        """Fold the journal into a fresh dual-version snapshot (write()
+        replaces the snapshot, then truncates the journal).  After this,
+        a downgraded driver reading only checkpoint.json is current."""
+        logger.info(
+            "compacting checkpoint journal (%s): %d record(s) fold into "
+            "the snapshot", reason, self._journal_records,
+        )
+        self.write(state)
+        self._journal_records = 0
+        metrics.CHECKPOINT_COMPACTIONS_TOTAL.labels(reason).inc()
+
+    def close(self) -> None:
+        """Clean-shutdown compaction: fold any journal remainder into the
+        dual-version snapshot.  This is the DOWNGRADE GATE — an older
+        driver never reads checkpoint.wal, so its view is current only
+        after this compact (docs/bind-path.md "Checkpoint storage").
+        Best-effort: a failure leaves the journal in place for the next
+        journal-aware start to replay.
+
+        Straggler-safe: any in-flight group commit is waited out first,
+        then journaling is switched off so a mutate that races shutdown
+        (the GC thread mid-cycle) takes the full-snapshot path — its
+        state lands in checkpoint.json, never in a WAL record written
+        AFTER the gate compaction (which a downgraded driver would lose).
+        The append fd is closed under the flock, so it can never be
+        closed out from under a committing leader."""
+        if not self._journal_enabled:
+            self._journal.close()
+            return
+        with self._commit_cond:
+            deadline = time.monotonic() + 10.0
+            while self._commit_leader and time.monotonic() < deadline:
+                self._commit_cond.wait(1.0)
+            # From here every mutate — including a group commit already
+            # queued — persists via a full snapshot (_commit_batch_locked
+            # treats disabled journaling as force_snapshot).
+            self._journal_enabled = False
+        try:
+            # The fd closes only while the flock is held: every journal
+            # write happens under cp.lock, so under it no leader — not
+            # even one that outlived the drain deadline — can be mid-
+            # append on the fd we close.
+            with Flock(self._lock_path)(timeout=5.0):  # tpudra-lock: id=flock:cp.lock
+                jkey = self._journal.stat_key()
+                if jkey is not None and jkey[1] > 0:
+                    state, degraded = self._load_locked()
+                    if degraded:
+                        self._preserve_corrupt()
+                    self._compact_locked(state, "shutdown")
+                self._journal.close()
+        except Exception:  # noqa: BLE001 — shutdown must not wedge on IO
+            # The flock never landed (a sibling or an overrunning leader
+            # holds it): the fd stays OPEN — closing it without the flock
+            # could land mid-append.  One fd leaks in an exiting process;
+            # the journal stays for the next journal-aware start to replay.
+            logger.exception(
+                "clean-shutdown checkpoint compaction failed; journal left "
+                "in place for the next start to replay"
+            )
+
+    def _mutate_snapshot(
+        self, fn: Callable[[Checkpoint], Optional[Checkpoint]], timeout: float
+    ) -> None:
+        """The pre-journal RMW (``--no-journal``): flock-guarded read,
+        mutate, full dual-version write — every mutate pays O(state) and
+        its own fsyncs, the A/B baseline arm and the mixed-version escape
+        hatch.  (A journal left behind by an earlier journaling run is
+        still replayed by the read and folded into the write's snapshot.)"""
         # Fresh Flock per mutate: one shared instance cannot be acquired
         # twice, but in-process callers DO overlap (the GC thread mutates
         # while RPC threads mutate) — each needs its own fd so the kernel
@@ -375,21 +1069,11 @@ class CheckpointManager:
             # disk read for bulletproof freshness.
             cp, degraded = self._read_flagged(bypass_cache=True)
             out = fn(cp)
-            cp = out if out is not None else cp
+            # Only an actual Checkpoint return replaces the state: the
+            # delta contract blesses incidental returns (a lambda ending
+            # in dict.pop), and this arm must not diverge by writing a
+            # popped claim out as the whole checkpoint.
+            cp = out if isinstance(out, Checkpoint) else cp
             if degraded:
-                corrupt_path = self._path + ".corrupt"
-                try:
-                    with open(self._path, "rb") as src, open(
-                        corrupt_path, "wb"
-                    ) as dst:
-                        dst.write(src.read())
-                except OSError:
-                    logger.exception(
-                        "cannot preserve corrupt checkpoint at %s", corrupt_path
-                    )
-                logger.error(
-                    "finalizing degraded checkpoint: rewriting all versions "
-                    "from the fallback payload; original preserved at %s",
-                    corrupt_path,
-                )
+                self._preserve_corrupt()
             self.write(cp)

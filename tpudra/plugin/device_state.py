@@ -70,32 +70,12 @@ from tpudra.plugin.checkpoint import (
     PreparedClaim,
     PreparedDevice,
     PreparedDeviceGroup,
+    _crashpoint,  # re-export: the crash sweeps and cdplugin import it here
 )
 from tpudra.plugin.sharing import MultiProcessManager, TimeSlicingManager
 from tpudra.plugin.vfio import VfioManager
 
 logger = logging.getLogger(__name__)
-
-
-def _crashpoint(point: str) -> None:
-    """Injectable SIGKILL for the process-level crash-consistency sweep
-    (tests/test_crash_sweep.py): when TPUDRA_CRASHPOINT names this
-    checkpoint boundary, die with no cleanup — the restarted plugin must
-    converge from the checkpoint alone (SURVEY §3.4's three GC layers;
-    reference device_state.go:223-242,337).  Two-key arming: the kill also
-    requires TPUDRA_TEST_HOOKS=1, so a single leaked env var in a copied
-    manifest cannot turn every production prepare into a crash loop.
-    Unarmed cost: one env read and string compare per boundary."""
-    import os
-
-    if (
-        os.environ.get("TPUDRA_CRASHPOINT") == point
-        and os.environ.get("TPUDRA_TEST_HOOKS") == "1"
-    ):
-        import signal
-
-        logger.warning("crashpoint %s armed: SIGKILL self", point)
-        os.kill(os.getpid(), signal.SIGKILL)
 
 
 class PermanentError(Exception):
@@ -360,7 +340,12 @@ class DeviceState:
                 except Exception as e:  # noqa: BLE001 — per-claim barrier
                     item.error = e
 
-        self._cp.mutate(start_all)
+        # Delta contract: start_all reads every claim (overlap validation)
+        # but writes only the batch's uids — the commit appends O(batch)
+        # journal records, not an O(state) snapshot.
+        self._cp.mutate(
+            start_all, touched=[it.uid for it in batch.items if it.uid]
+        )
         if any(it.started for it in batch.items):
             _crashpoint("post-prepare-started")
         for item in batch.items:
@@ -461,7 +446,7 @@ class DeviceState:
                     groups=item.plain_groups,
                 )
 
-        self._cp.mutate(complete_all)
+        self._cp.mutate(complete_all, touched=[it.uid for it in done])
         _crashpoint("post-completed")
 
     def begin_unprepare(self, claim_uids: list[str]) -> UnprepareBatch:
@@ -513,7 +498,7 @@ class DeviceState:
             for uid in drop:
                 cp.prepared_claims.pop(uid, None)
 
-        self._cp.mutate(drop_all)
+        self._cp.mutate(drop_all, touched=drop)
 
     def effect_groups(self, keyed: list) -> list[list]:
         """Partition batch items into groups whose device footprints overlap
@@ -549,8 +534,9 @@ class DeviceState:
         return list(groups.values())
 
     def prepared_claim_uids(self) -> dict[str, tuple[str, str, str]]:
-        """uid → (namespace, name, status) for the stale-claim GC."""
-        cp = self._cp.read()
+        """uid → (namespace, name, status) for the stale-claim GC (read-
+        only scan: the copy-free ``read_view``)."""
+        cp = self._cp.read_view()
         return {
             uid: (c.namespace, c.name, c.status) for uid, c in cp.prepared_claims.items()
         }
@@ -566,7 +552,9 @@ class DeviceState:
         """
         if not self._passthrough:
             return set()
-        cp = self._cp.read()
+        # Read-only scan on the publish path: the copy-free read_view —
+        # this runs on every slice rebuild and scales with resident claims.
+        cp = self._cp.read_view()
         withheld: set[str] = set()
         for claim in cp.prepared_claims.values():
             for dev in claim.all_devices():
@@ -663,7 +651,7 @@ class DeviceState:
         (DestroyUnknownMIGDevices, device_state.go:337)."""
         if not self._dynamic:
             return 0
-        cp = self._cp.read()
+        cp = self._cp.read_view()
         known: set[str] = set()
         for claim in cp.prepared_claims.values():
             for dev in claim.all_devices():

@@ -86,6 +86,13 @@ class DriverConfig:
     # DISABLED — a nonzero period only makes sense once something
     # subscribes to the claim informer.  <= 0 disables.
     claim_informer_resync_s: float = 0.0
+    # Journaled checkpoint persistence (docs/bind-path.md "Checkpoint
+    # storage"): mutations append O(delta) records to checkpoint.wal with
+    # group commit, compacted into the dual-version snapshot on thresholds
+    # and clean shutdown.  False restores the per-mutate full-snapshot
+    # write (the bench A/B baseline arm, and the escape hatch for
+    # mixed-version windows — an old driver never reads the journal).
+    journal: bool = True
     # Coalescing window of the async slice publisher: a burst of health /
     # withheld-set events inside one window costs one rebuild+write.
     publish_debounce_s: float = 0.05
@@ -111,10 +118,13 @@ class Driver:
         self._lib = devicelib
         os.makedirs(config.plugin_dir, exist_ok=True)
         self._pu_lock_path = os.path.join(config.plugin_dir, PU_LOCK)
+        self._checkpoints = CheckpointManager(
+            config.plugin_dir, journal=config.journal
+        )
         self.state = DeviceState(
             devicelib,
             CDIHandler(config.cdi_root, config.driver_root),
-            CheckpointManager(config.plugin_dir),
+            self._checkpoints,
             config.node_name,
             mp_manager=mp_manager,
             vfio_manager=vfio_manager,
@@ -243,6 +253,10 @@ class Driver:
             self._publish_cond.notify_all()
         self._sockets.stop()
         self._effects_pool.shutdown(wait=False)
+        # Clean-shutdown compaction: fold the checkpoint journal into the
+        # dual-version snapshot — the downgrade gate (an old driver never
+        # reads checkpoint.wal).  Best-effort inside close().
+        self._checkpoints.close()
         self._lib.close()
 
     @property

@@ -1,0 +1,187 @@
+"""Append-only checkpoint journal (WAL): CRC framing + file plumbing.
+
+The delta layer under ``CheckpointManager`` (checkpoint.py): instead of
+re-encoding and fsyncing the whole dual-version snapshot on every mutation,
+the manager appends CRC-framed JSON delta records (claim upsert / drop /
+status transition) to ``checkpoint.wal`` and folds them back into the
+snapshot only at compaction.  This module owns the byte-level concerns —
+frame encode/decode with torn-tail detection, the append fd, truncation,
+directory fsync — and knows nothing about checkpoint semantics (record
+dicts go in, record dicts come out), so there is no import cycle with
+checkpoint.py.
+
+Frame format, chosen for torn-write detection rather than compactness::
+
+    <u32 little-endian payload length> <u32 crc32(payload)> <payload bytes>
+
+A record interrupted by a crash (short header, short payload, CRC or JSON
+mismatch) ends the readable journal: ``decode_records`` returns everything
+before it plus the byte offset of the last good frame, and the caller
+truncates/ignores the tail.  Every complete frame written before the torn
+one was fsynced by an earlier group commit, so nothing acknowledged is
+lost.
+
+Concurrency contract: ``append_locked``/``truncate_locked``/
+``_ensure_fd_locked`` require the caller to hold the checkpoint flock
+(``cp.lock``) — they are the write half.  ``read_bytes``/``stat_key`` are
+lock-free and may observe a concurrent append's partial frame; the reader
+(checkpoint.py) distinguishes that from a real torn tail by re-statting.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Optional
+
+_HEADER = struct.Struct("<II")
+
+#: Sanity bound on one frame: a garbage length field must not make the
+#: decoder treat megabytes of unrelated bytes as a pending record.
+MAX_RECORD_BYTES = 1 << 22
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-completed ``os.replace``/create in it is
+    durable.  fsyncing the file alone persists its *contents*; the rename
+    that makes the file *reachable* lives in the directory, and a crash
+    between the two can lose it (the classic rename-durability gap)."""
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def encode_frame(payload: bytes) -> bytes:
+    return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def encode_record(record: dict) -> bytes:
+    return encode_frame(json.dumps(record, sort_keys=True).encode())
+
+
+def decode_records(data: bytes) -> tuple[list[dict], int, bool]:
+    """(records, good_bytes, torn) — JSON records decoded frame by frame,
+    stopping at the first incomplete/corrupt frame.  ``good_bytes`` is the
+    offset just past the last good frame (a valid truncation/append
+    point); ``torn`` is True when trailing bytes were dropped."""
+    records: list[dict] = []
+    pos, n = 0, len(data)
+    while pos < n:
+        if n - pos < _HEADER.size:
+            return records, pos, True
+        length, crc = _HEADER.unpack_from(data, pos)
+        start = pos + _HEADER.size
+        if length > MAX_RECORD_BYTES or start + length > n:
+            return records, pos, True
+        payload = data[start : start + length]
+        if zlib.crc32(payload) != crc:
+            return records, pos, True
+        try:
+            record = json.loads(payload)
+        except ValueError:
+            return records, pos, True
+        if not isinstance(record, dict):
+            return records, pos, True
+        records.append(record)
+        pos = start + length
+    return records, pos, False
+
+
+class Journal:
+    """The ``checkpoint.wal`` file: lock-free reads, flock-guarded writes.
+
+    The append fd is kept open across commits (O_APPEND, so every write
+    lands at the current end) and re-opened when the path's inode no
+    longer matches — a test tearing down the directory, never normal
+    operation: compaction truncates in place (``ftruncate``), it does not
+    replace the file, which is what keeps "snapshot stat unchanged ⇒
+    journal grew append-only" true for the incremental readers."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fd: Optional[int] = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def stat_key(self) -> Optional[tuple[int, int, int]]:
+        """(mtime_ns, size, inode) of the journal, or None when absent."""
+        try:
+            st = os.stat(self._path)
+        except FileNotFoundError:
+            return None
+        return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+    def read_bytes(self, offset: int = 0) -> bytes:
+        """The journal's bytes from ``offset`` (lock-free; b"" if absent)."""
+        try:
+            with open(self._path, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+    def _ensure_fd_locked(self) -> tuple[int, bool]:
+        """(fd, created) — the append fd, re-opened if the path's inode
+        changed under us.  Caller holds the checkpoint flock."""
+        fd = self._fd
+        if fd is not None:
+            try:
+                if os.fstat(fd).st_ino == os.stat(self._path).st_ino:
+                    return fd, False
+            except FileNotFoundError:
+                # The file vanished (test teardown): fall through and
+                # recreate on a fresh fd.
+                ...
+            os.close(fd)
+            self._fd = None
+        parent = os.path.dirname(self._path) or "."
+        os.makedirs(parent, exist_ok=True)
+        created = not os.path.exists(self._path)
+        fd = os.open(self._path, os.O_CREAT | os.O_RDWR | os.O_APPEND, 0o600)
+        self._fd = fd
+        return fd, created
+
+    def append_locked(self, payloads: list[bytes]) -> tuple[int, bool]:
+        """Append pre-encoded frames as ONE write + ONE fsync (the group
+        commit's whole durability cost); returns (bytes written, directory
+        fsynced).  A first append also fsyncs the directory so the new
+        file itself survives — reported to the caller so the fsync
+        accounting (tpudra_checkpoint_fsyncs_total) stays truthful."""
+        buf = b"".join(payloads)
+        fd, created = self._ensure_fd_locked()
+        # Loop out short writes (ENOSPC-adjacent / interrupted): fsyncing
+        # and acknowledging a partially-written frame would hand the next
+        # replay a "torn tail" for a mutation the caller was told is
+        # durable.
+        view = memoryview(buf)
+        while view:
+            written = os.write(fd, view)
+            if written <= 0:
+                raise OSError(
+                    f"short write appending {len(view)} byte(s) to {self._path}"
+                )
+            view = view[written:]
+        os.fsync(fd)
+        if created:
+            fsync_dir(os.path.dirname(self._path) or ".")
+        return len(buf), created
+
+    def truncate_locked(self, size: int = 0) -> None:
+        """Cut the journal to ``size`` bytes: 0 after a compaction folded
+        it into the snapshot, or a good-frame boundary when repairing a
+        torn tail.  No fsync — a crash that resurrects the dropped bytes
+        re-drops them at the next replay (truncation is convergent)."""
+        fd, _ = self._ensure_fd_locked()
+        os.ftruncate(fd, size)
+
+    def close(self) -> None:
+        fd, self._fd = self._fd, None
+        if fd is not None:
+            os.close(fd)

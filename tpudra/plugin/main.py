@@ -76,6 +76,17 @@ def build_parser() -> argparse.ArgumentParser:
         "[CLAIM_INFORMER_RESYNC_S]",
     )
     p.add_argument(
+        "--no-journal",
+        action="store_true",
+        default=env_default("NO_JOURNAL", "").lower() == "true",
+        help="disable the append-only checkpoint journal: every mutation "
+        "rewrites the full dual-version snapshot (the pre-journal "
+        "behavior; bench A/B arm and the escape hatch for mixed-version "
+        "node windows — old drivers never read checkpoint.wal, so a "
+        "downgrade otherwise requires the clean-shutdown compaction) "
+        "[NO_JOURNAL]",
+    )
+    p.add_argument(
         "--publish-debounce-ms",
         type=int,
         default=int(env_default("PUBLISH_DEBOUNCE_MS", "50")),
@@ -115,6 +126,7 @@ def main(argv=None) -> int:
             k8s_minor=args.k8s_minor,
             device_backend=args.device_backend,
             claim_cache=not args.no_claim_cache,
+            journal=not args.no_journal,
             claim_informer_resync_s=args.claim_informer_resync_s,
             publish_debounce_s=max(0.0, args.publish_debounce_ms / 1000.0),
             publish_reassert_s=args.publish_reassert_s,
