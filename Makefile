@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster image helm-render clean
 
 all: native test
 
@@ -96,6 +96,20 @@ bench-apiserver:
 # arms, plus the 8-way group-commit fsync count (medians of 3 waves).
 bench-checkpoint:
 	set -o pipefail; python bench.py --checkpoint-churn \
+	  | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Cluster-scale control-plane A/B (docs/cluster-scale.md): N simulated
+# nodes + one controller under seeded churn, fixed arm (serialize-once
+# fan-out, fair queue, bulk publication) interleaved against the legacy
+# arm.  CLUSTER_NODES sweeps node counts; CPU-only.  Wall time is bound
+# by the box's thread/syscall cost, not the harness: minutes on a
+# developer machine, hours for the full sweep on a 2-core sandboxed CI
+# box (run one node count at a time there: CLUSTER_NODES=256).
+CLUSTER_NODES ?= 8,128,256
+bench-cluster:
+	set -o pipefail; python bench.py --cluster-scale \
+	  --nodes $(CLUSTER_NODES) \
 	  | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 

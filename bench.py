@@ -1050,6 +1050,120 @@ def bench_scale() -> dict:
         return out
 
 
+def bench_cluster_scale(
+    nodes_list: list[int] = None,
+    churn: int = None,
+    seed: int = 0,
+    waves: int = 2,
+) -> dict:
+    """Cluster-scale A/B (`make bench-cluster`, docs/cluster-scale.md): N
+    simulated nodes (each a real in-process plugin driver with its own
+    claim informer) + one real controller against one accounted FakeKube,
+    under seeded claim churn and ComputeDomain spec flips.
+
+    Two arms per node count, TRULY interleaved (arm A wave i, then arm B
+    wave i, both harnesses alive throughout so idle-thread load taxes both
+    arms equally):
+
+      fixed  — serialize-once watch fan-out, priority lanes + per-key fair
+               dispatch, bulk slice publication (this PR)
+      legacy — per-watcher event deepcopy, single-heap FIFO queue,
+               3-requests-per-node publication (the pre-PR control plane)
+
+    Reported per arm: bind p50/p99 pooled across waves, controller
+    reconcile p50/p99 (CD flip waves), apiserver requests by verb + QPS
+    over the churn windows, informer event lag, watch fan-out stats,
+    startup publication cost, and the flapping-CD injection (max quiet-key
+    wait under one hot key — the starvation bound)."""
+    from tpudra.sim.cluster import ClusterScaleConfig, ClusterScaleSim, latency_summary
+
+    nodes_list = nodes_list or [8, 128, 256]
+    out: dict = {"seed": seed, "waves": waves}
+    for n_nodes in nodes_list:
+        # Per-wave claim count scales DOWN with node count: the per-event
+        # fan-out cost grows with N, and the wave exists to sample bind
+        # latency under that load, not to saturate the host for an hour.
+        n_churn = churn if churn is not None else max(12, min(32, 4096 // n_nodes))
+        arm_cfg = {
+            "fixed": dict(fair=True, share_watch_events=True, bulk_publish=True),
+            "legacy": dict(fair=False, share_watch_events=False, bulk_publish=False),
+        }
+        sims = {}
+        report: dict = {"churn_per_wave": n_churn}
+        try:
+            for tag, knobs in arm_cfg.items():
+                sims[tag] = ClusterScaleSim(
+                    ClusterScaleConfig(
+                        nodes=n_nodes,
+                        churn_claims=n_churn,
+                        compute_domains=8,
+                        seed=seed,
+                        **knobs,
+                    )
+                ).start()
+                sims[tag].seed_compute_domains()
+            bind: dict[str, list] = {t: [] for t in sims}
+            bind_errors: dict[str, int] = {t: 0 for t in sims}
+            first_error: dict[str, str] = {}
+            reconcile: dict[str, list] = {t: [] for t in sims}
+            verbs: dict[str, dict] = {t: {} for t in sims}
+            churn_wall: dict[str, float] = {t: 0.0 for t in sims}
+            for wave in range(waves):
+                for tag, sim in sims.items():
+                    # Churn + CD flips in flight together: reconcile p99
+                    # under live claim fan-out is the measured scenario.
+                    def run(s=sim, t=tag, i=wave):
+                        churn_out, cd_out = s.combined_wave(
+                            f"{t}-{i}", flip_to=(i % 2) + 1
+                        )
+                        return {"churn": churn_out, "cd": cd_out}
+
+                    w = sim.measured_window(run)
+                    bind[tag].extend(w["churn"].pop("samples_ms"))
+                    # Errored binds return early and FAST — pooling their
+                    # samples without the error count would let a broken
+                    # arm report a flattering p99.
+                    bind_errors[tag] += w["churn"].get("bind_errors", 0)
+                    if "first_error" in w["churn"]:
+                        first_error.setdefault(tag, w["churn"]["first_error"])
+                    reconcile[tag].extend(w["cd"].pop("samples_ms"))
+                    for verb, count in w["apiserver"]["by_verb"].items():
+                        verbs[tag][verb] = verbs[tag].get(verb, 0) + count
+                    churn_wall[tag] += w["apiserver"]["wall_s"]
+            for tag, sim in sims.items():
+                flap = sim.flapping_injection(victims=16)
+                total = sum(verbs[tag].values())
+                bind_summary = latency_summary(bind[tag])
+                bind_summary["errors"] = bind_errors[tag]
+                if tag in first_error:
+                    bind_summary["first_error"] = first_error[tag]
+                report[tag] = {
+                    "bind": bind_summary,
+                    "reconcile": latency_summary(reconcile[tag]),
+                    "apiserver": {
+                        "by_verb": verbs[tag],
+                        "qps": round(total / max(churn_wall[tag], 1e-9), 1),
+                    },
+                    "event_lag": sim.lag_report(),
+                    "publish": sim.publish_stats,
+                    "watch": sim.watch_report(),
+                    "flap": flap,
+                }
+        except Exception as e:  # noqa: BLE001 — bench must always print its line
+            report["error"] = f"{type(e).__name__}: {e}"[:300]
+        finally:
+            for sim in sims.values():
+                try:
+                    sim.close()
+                except Exception as e:  # noqa: BLE001 — teardown must visit every arm
+                    print(
+                        f"cluster-scale: arm teardown failed: {e}",
+                        file=sys.stderr,
+                    )
+        out[str(n_nodes)] = report
+    return out
+
+
 def bench_claim_to_jax() -> dict:
     """Close the north-star loop on the real chip (BASELINE.json's end
     state: "the pod sees exactly the chips granted by the ResourceClaim"):
@@ -1454,6 +1568,19 @@ def _round_number() -> int:
     return (max(ns) + 1) if ns else 1
 
 
+def _pop_str_flag(argv: list, flag: str) -> str | None:
+    """Extract ``--flag VALUE`` from argv (mutating it); None when absent."""
+    if flag not in argv:
+        return None
+    i = argv.index(flag)
+    try:
+        value = argv[i + 1]
+    except IndexError:
+        raise SystemExit(f"{flag} requires an argument")
+    del argv[i : i + 2]
+    return value
+
+
 def _pop_int_flag(argv: list, flag: str, minimum: int = 0) -> int | None:
     """Extract ``--flag N`` from argv (mutating it); None when absent."""
     if flag not in argv:
@@ -1481,6 +1608,29 @@ def main(argv=None) -> None:
         print(json.dumps(SECTIONS[argv[1]]()))
         return
     full = "--full" in argv
+
+    if "--cluster-scale" in argv:
+        # The control-plane A/B artifact (`make bench-cluster`): N-node
+        # sweep, fixed-vs-legacy arms interleaved, CPU-only, no devices.
+        # --nodes "8,128,256" overrides the sweep, --churn M the per-wave
+        # claim count, --seed S the churn/backoff RNG.
+        argv.remove("--cluster-scale")
+        nodes_arg = _pop_str_flag(argv, "--nodes")
+        churn_arg = _pop_int_flag(argv, "--churn", minimum=1)
+        seed_arg = _pop_int_flag(argv, "--seed") or 0
+        nodes_list = (
+            [int(x) for x in nodes_arg.split(",") if x.strip()]
+            if nodes_arg
+            else None
+        )
+        line = {
+            "metric": "cluster_scale",
+            **bench_cluster_scale(
+                nodes_list=nodes_list, churn=churn_arg, seed=seed_arg
+            ),
+        }
+        print(json.dumps(line))
+        return
 
     if "--checkpoint-churn" in argv:
         # The A/B artifact for checkpoint-storage PRs (`make

@@ -1,0 +1,235 @@
+"""Cluster-scale harness (tpudra/sim/cluster.py): N in-process drivers +
+one controller against one accounted FakeKube.
+
+Sized for CI: a handful of nodes proves the machinery (construction, bulk
+publication, churn through the real resolver+bind path, reconcile
+instrumentation, fairness injection); bench.py --cluster-scale owns the
+hundreds-of-nodes measurements."""
+
+import threading
+import time
+
+import pytest
+
+from tpudra.kube import gvr
+from tpudra.sim.cluster import (
+    ClusterScaleConfig,
+    ClusterScaleSim,
+    latency_summary,
+    make_claim,
+    percentile,
+)
+
+NODES = 6
+
+
+@pytest.fixture(scope="module")
+def sim():
+    s = ClusterScaleSim(
+        ClusterScaleConfig(
+            nodes=NODES,
+            chips_per_node=2,
+            churn_claims=8,
+            workers=8,
+            compute_domains=2,
+            seed=7,
+        )
+    )
+    s.start()
+    s.seed_compute_domains()
+    yield s
+    s.close()
+
+
+def test_percentile_helpers():
+    assert percentile([], 0.5) == 0.0
+    assert percentile([1.0, 2.0, 3.0, 4.0], 0.5) == 3.0
+    out = latency_summary([5.0, 1.0, 9.0])
+    assert out["n"] == 3 and out["p50_ms"] == 5.0 and out["max_ms"] == 9.0
+
+
+def test_startup_publishes_every_node_in_one_list(sim):
+    """Bulk publication: N nodes' slices land with ONE existence LIST —
+    N+1 requests, not ~3 per node."""
+    slices = sim.kube.list(gvr.RESOURCE_SLICES).get("items", [])
+    assert len(slices) == NODES
+    assert {s["spec"]["nodeName"] for s in slices} == set(sim.node_names)
+    assert sim.publish_stats["requests"] == NODES + 1
+
+
+def test_churn_wave_binds_across_nodes(sim):
+    out = sim.measured_window(lambda: sim.churn_wave("t0"))
+    assert out["bind_errors"] == 0
+    assert out["n"] == 8
+    assert out["p50_ms"] > 0
+    # The wave's apiserver window carries the harness's own traffic.
+    assert out["apiserver"]["by_verb"]["create"] >= 8
+    assert out["apiserver"]["by_verb"]["delete"] >= 8
+    # Nothing leaked: every churn claim was deleted again.
+    assert not sim.kube.list(gvr.RESOURCE_CLAIMS).get("items", [])
+    # Event lag was observed for the churned claims.
+    assert sim.lag_report()["n"] >= 8
+
+
+def test_cd_wave_reconciles_and_samples_latency(sim):
+    before = sim.reconcile_report()["n"]
+    out = sim.cd_wave(flip_to=2)
+    assert out["n"] >= sim.config.compute_domains
+    assert sim.reconcile_report()["n"] > before
+    # The controller actually fanned out: per-CD DaemonSets exist.
+    ds = sim.kube.list(gvr.DAEMONSETS, sim.config.driver_namespace).get("items", [])
+    assert len(ds) >= sim.config.compute_domains
+
+
+def test_combined_wave_overlaps_churn_and_reconciles(sim):
+    """combined_wave runs claim churn and CD flips in flight together and
+    hands back both summaries (the bench's measured unit)."""
+    churn, cd = sim.combined_wave("combo", flip_to=1)
+    assert churn["bind_errors"] == 0 and churn["n"] == sim.config.churn_claims
+    assert cd["n"] >= sim.config.compute_domains
+
+
+def test_flapping_cd_does_not_starve_victims(sim):
+    """The acceptance bound: one flapping ComputeDomain, quiet victims
+    arriving once — every victim reconciles, and the slowest victim's wait
+    stays bounded (newest-wins collapse + fair dispatch), instead of
+    scaling with the flap volume."""
+    out = sim.flapping_injection(victims=8, warm_s=0.2, timeout=30.0)
+    assert out["victims_reconciled"] == 8
+    assert out["flap_updates"] > 50, "flapper was not actually hot"
+    # Generous CI bound: the victims' worst wait must be seconds, not the
+    # unbounded backlog a starved key would see.
+    assert out["victim_wait_max_ms"] < 15000
+
+
+def test_watch_fanout_shares_payloads(sim):
+    stats = sim.watch_report()
+    # One lag informer + N node informers + controller informers are live.
+    assert stats["watchers"] >= NODES + 1
+    # Serialize-once: deliveries fan out well past materializations.
+    assert stats["deliveries"] > stats["materializations"]
+    assert stats["overflows"] == 0
+
+
+def test_resolver_rides_node_informers(sim):
+    """A claim resolved on the node it was allocated to hits that node's
+    informer cache once the watch delivers — direct proof the per-node
+    informers are wired into the bind path."""
+    node = sim.node_names[0]
+    driver = sim.drivers[0]
+    uid = "cache-probe"
+    claim = make_claim(uid, node, ["tpu-0"], name=uid)
+    sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+    try:
+        deadline = time.monotonic() + 5
+        while (
+            driver.claim_informer.get(uid, "default") is None
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert driver.claim_informer.get(uid, "default") is not None
+        resolved = driver.sockets.resolve_claim("default", uid, uid)
+        assert resolved["metadata"]["uid"] == uid
+    finally:
+        sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+
+
+def test_legacy_arms_construct():
+    """The pre-PR arms stay runnable (they are the bench baseline): FIFO
+    queue, per-watcher copies, per-node publication."""
+    s = ClusterScaleSim(
+        ClusterScaleConfig(
+            nodes=2,
+            chips_per_node=2,
+            churn_claims=2,
+            workers=2,
+            compute_domains=0,
+            seed=7,
+            fair=False,
+            share_watch_events=False,
+            bulk_publish=False,
+            node_informers=False,
+        )
+    )
+    s.start(controller=False)
+    try:
+        # Legacy publication pays the per-node request tax.
+        assert s.publish_stats["requests"] > 2 + 1
+        out = s.churn_wave("legacy")
+        assert out["bind_errors"] == 0 and out["n"] == 2
+        # Legacy fan-out arm deep-copies per watcher.
+        assert s.kube._per_watcher_copy
+    finally:
+        s.close()
+
+
+def test_stop_event_reaches_watchers():
+    """close() must end the harness promptly: watcher loops see the stop
+    event within their idle-poll timeout, not never."""
+    s = ClusterScaleSim(
+        ClusterScaleConfig(
+            nodes=2, chips_per_node=2, churn_claims=2, workers=2,
+            compute_domains=0, seed=1,
+        )
+    )
+    s.start(controller=False)
+    n_threads = threading.active_count()
+    t0 = time.monotonic()
+    s.close()
+    assert time.monotonic() - t0 < 10
+    assert n_threads > 0  # sanity: the harness did run threads
+
+
+def test_bulk_publisher_survives_concurrent_slice_delete():
+    """A slice deleted behind the seed LIST (GC, operator) must be
+    recreated by the per-slice fallback — never abort the other nodes'
+    publications mid-pass."""
+    from tpudra.kube.fake import FakeKube
+    from tpudra.kube.apply import BulkSlicePublisher
+
+    kube = FakeKube()
+    mk = lambda n: {"metadata": {"name": f"{n}-tpu-0"}, "spec": {"nodeName": n}}
+    pub = BulkSlicePublisher(kube)
+    pub([mk("node-a")], "node-a", "node-a-tpu-")
+    pub([mk("node-b")], "node-b", "node-b-tpu-")
+    # node-a's slice vanishes after the publisher's seed.
+    kube.delete(gvr.RESOURCE_SLICES, "node-a-tpu-0")
+    sa, sb = mk("node-a"), mk("node-b")
+    sa["spec"]["gen"] = sb["spec"]["gen"] = 2
+    pub([sa], "node-a", "node-a-tpu-")
+    pub([sb], "node-b", "node-b-tpu-")
+    live = {
+        s["metadata"]["name"]: s
+        for s in kube.list(gvr.RESOURCE_SLICES)["items"]
+    }
+    assert live["node-a-tpu-0"]["spec"]["gen"] == 2  # recreated
+    assert live["node-b-tpu-0"]["spec"]["gen"] == 2  # unaffected
+
+
+def test_resync_sweep_keeps_terminating_cds_high():
+    """The LOW-lane resync backstop must not demote a terminating CD: its
+    deletion event earned HIGH, and the sweep re-enqueues it at HIGH."""
+    from tpudra.controller.controller import Controller, ManagerConfig
+    from tpudra.kube.fake import FakeKube
+    from tpudra.workqueue import PRIORITY_HIGH, PRIORITY_LOW
+
+    ctrl = Controller(FakeKube(), ManagerConfig(driver_namespace="ns"))
+    seen = {}
+    ctrl._enqueue_cd = lambda ns, name, priority: seen.__setitem__(name, priority)
+
+    class _Store:
+        def list(self):
+            return [
+                {"metadata": {"namespace": "d", "name": "quiet"}},
+                {
+                    "metadata": {
+                        "namespace": "d",
+                        "name": "terminating",
+                        "deletionTimestamp": "2026-01-01T00:00:00Z",
+                    }
+                },
+            ]
+
+    ctrl._cd_informer = _Store()
+    ctrl._resync_once()
+    assert seen == {"quiet": PRIORITY_LOW, "terminating": PRIORITY_HIGH}

@@ -139,3 +139,27 @@ class TestRateLimiting:
         # Defaults mirror the reference (kubeclient.go:54-69).
         args = p.parse_args([])
         assert args.kube_api_qps == 5.0 and args.kube_api_burst == 10
+
+
+def test_watch_410_travels_the_http_transport():
+    """The in-band 410 ERROR event (fake.py's compacted-history answer) is
+    just another chunk to the HTTP frontend and just another event dict to
+    the real client — ``errors.from_status`` rehydrates ``Expired`` from
+    it exactly as the Informer does over the in-process transport."""
+    from tpudra.kube.fake import FakeKube
+
+    fake = FakeKube(watch_history_limit=2)
+    with FakeKubeServer(fake=fake) as s:
+        client = KubeClient(s.url)
+        for i in range(6):  # compact history well past rv=1
+            client.create(gvr.NODES, mk_node(f"n{i}"))
+        stop = threading.Event()
+        events = []
+        for ev in client.watch(gvr.NODES, resource_version="1", stop=stop):
+            events.append(ev)
+            break
+        stop.set()
+        assert events and events[0]["type"] == "ERROR"
+        status = events[0]["object"]
+        err = errors.from_status(status, int(status.get("code") or 500))
+        assert isinstance(err, errors.Expired)

@@ -159,6 +159,103 @@ def test_watch_live_and_resume(api):
     assert ("DELETED", "live") in events
 
 
+def test_watch_fanout_materializes_once_for_100_watchers(api):
+    """The cluster-scale contract: one event, one deep copy, shared by
+    every watcher — 100 watchers must not cost 100 materializations."""
+    stop = threading.Event()
+    received = [None] * 100
+
+    def consume(i):
+        for ev in api.watch(gvr.COMPUTE_DOMAINS, "default", stop=stop):
+            received[i] = ev
+            return
+
+    threads = [
+        threading.Thread(target=consume, args=(i,), daemon=True) for i in range(100)
+    ]
+    for t in threads:
+        t.start()
+    # Watchers register inside the generator body; wait until all 100 are
+    # live so every one takes the queue (not the replay) path.
+    deadline = threading.Event()
+    for _ in range(200):
+        with api._lock:
+            if len(api._watchers) >= 100:
+                break
+        deadline.wait(0.05)
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("shared"))
+    for t in threads:
+        t.join(5)
+    stop.set()
+    assert all(ev is not None for ev in received)
+    first = received[0]
+    assert all(ev is first for ev in received), "watchers must share one payload"
+    assert api.watch_stats["materializations"] == 1
+    assert api.watch_stats["deliveries"] == 100
+
+
+def test_watch_replay_shares_history_payload(api):
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("early"))
+    gens = [api.watch(gvr.COMPUTE_DOMAINS, "default", resource_version="0") for _ in range(10)]
+    events = [next(g) for g in gens]
+    for g in gens:
+        g.close()
+    assert all(ev is events[0] for ev in events)
+    assert api.watch_stats["materializations"] == 1
+
+
+def test_watch_per_watcher_copy_legacy_arm():
+    api = FakeKube(per_watcher_copy=True)
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("early"))
+    gens = [api.watch(gvr.COMPUTE_DOMAINS, "default", resource_version="0") for _ in range(5)]
+    events = [next(g) for g in gens]
+    for g in gens:
+        g.close()
+    # One materialization at emit + one per replaying watcher.
+    assert api.watch_stats["materializations"] == 6
+    assert len({id(ev) for ev in events}) == 5
+
+
+def test_watch_overflow_closes_stream_with_410(api=None):
+    api = FakeKube(watch_queue_depth=4)
+    api.create(gvr.COMPUTE_DOMAINS, mk_cd("seed"))
+    gen = api.watch(gvr.COMPUTE_DOMAINS, "default", resource_version="0")
+    ev = next(gen)  # replay registers the watcher and hands back "seed"
+    assert ev["object"]["metadata"]["name"] == "seed"
+    # 10 live events against a depth-4 queue: the 5th onward overflow.
+    for i in range(10):
+        api.create(gvr.COMPUTE_DOMAINS, mk_cd(f"burst-{i}"))
+    assert api.watch_stats["overflows"] == 1
+    err = next(gen)
+    assert err["type"] == "ERROR"
+    assert err["object"]["code"] == 410
+    assert err["object"]["reason"] == "Expired"
+    with pytest.raises(StopIteration):
+        next(gen)
+    # The overflowed watcher is deregistered — later emits don't try it.
+    with api._lock:
+        assert not api._watchers
+
+
+def test_watch_resume_too_old_rv_gets_410(api=None):
+    api = FakeKube(watch_history_limit=4)
+    for i in range(10):
+        api.create(gvr.COMPUTE_DOMAINS, mk_cd(f"cd-{i}"))
+    assert api.watch_stats["compactions"] > 0
+    # rv=2 predates the retained window (events 7..10): 410 Expired.
+    gen = api.watch(gvr.COMPUTE_DOMAINS, "default", resource_version="2")
+    err = next(gen)
+    assert err["type"] == "ERROR"
+    assert err["object"]["code"] == 410
+    with pytest.raises(StopIteration):
+        next(gen)
+    # A resume inside the window still replays normally.
+    gen = api.watch(gvr.COMPUTE_DOMAINS, "default", resource_version="8")
+    names = [next(gen)["object"]["metadata"]["name"] for _ in range(2)]
+    gen.close()
+    assert names == ["cd-8", "cd-9"]
+
+
 def test_reactor_injects_failure(api):
     def boom(verb, g, obj):
         raise errors.Forbidden("nope")

@@ -3,7 +3,7 @@ import time
 
 import pytest
 
-from tpudra.kube import gvr
+from tpudra.kube import errors, gvr
 from tpudra.kube.fake import FakeKube
 from tpudra.kube.informer import Informer, MutationCache
 
@@ -27,6 +27,106 @@ def mk(name, ns="default", labels=None):
         "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
         "spec": {"numNodes": 1},
     }
+
+
+class _ExpiringKube:
+    """KubeAPI wrapper whose FIRST watch terminates with a 410 ERROR event
+    and whose SECOND list (the relist the 410 demands) blocks on ``gate`` —
+    so the relist window is held open long enough to assert
+    ``watch_healthy`` semantics inside it deterministically."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.gate = threading.Event()
+        self.lists = 0
+        self.watches = 0
+
+    def list(self, *args, **kwargs):
+        self.lists += 1
+        if self.lists == 2:
+            self.gate.wait(10)
+        return self.inner.list(*args, **kwargs)
+
+    def watch(self, *args, **kwargs):
+        self.watches += 1
+        if self.watches == 1:
+            yield {"type": "ERROR", "object": errors.Expired("compacted").to_status()}
+            return
+        yield from self.inner.watch(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def test_informer_relists_immediately_on_expired(api):
+    """A 410 Expired watch termination is answered with an immediate
+    relist (client-go reflector semantics), and ``watch_healthy`` is False
+    for exactly the relist window: the cache may lag, read-through
+    consumers must fall back."""
+    api.create(gvr.COMPUTE_DOMAINS, mk("n1"))
+    wrapped = _ExpiringKube(api)
+    inf = Informer(wrapped, gvr.COMPUTE_DOMAINS)
+    stop = threading.Event()
+    t0 = time.monotonic()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    # The first watch dies with 410 at once; the informer must enter its
+    # relist (second list) promptly, not after the failure backoff ladder.
+    deadline = time.monotonic() + 5
+    while wrapped.lists < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert wrapped.lists >= 2, "410 did not trigger a relist"
+    assert time.monotonic() - t0 < 3.0, "relist waited out a backoff"
+    # Mid-window: the store is still readable (synced once) but flagged
+    # stale — exactly the pre-sync-like degraded mode consumers key on.
+    assert inf.has_synced
+    assert not inf.watch_healthy
+    assert inf.get("n1", "default") is not None
+    wrapped.gate.set()
+    deadline = time.monotonic() + 5
+    while not inf.watch_healthy and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert inf.watch_healthy
+    stop.set()
+
+
+def test_informer_survives_watch_queue_overflow(api):
+    """A slow consumer overflows its bounded watcher queue: the fake
+    closes the stream with 410, the informer relists, and the cache
+    converges — bounded memory, no lost state."""
+    slow = FakeKube(watch_queue_depth=2)
+    slow.create(gvr.COMPUTE_DOMAINS, mk("seed"))
+    inf = Informer(slow, gvr.COMPUTE_DOMAINS)
+    release = threading.Event()
+    blocked = threading.Event()
+
+    def handler(etype, obj):
+        if obj.get("metadata", {}).get("name") == "burst-0":
+            blocked.set()
+            release.wait(10)
+
+    inf.add_handler(handler)
+    stop = threading.Event()
+    inf.start(stop)
+    assert inf.wait_for_sync(5)
+    # First burst event wedges the dispatch thread; the rest pile into the
+    # depth-2 watcher queue and overflow it.
+    for i in range(8):
+        slow.create(gvr.COMPUTE_DOMAINS, mk(f"burst-{i}"))
+    assert blocked.wait(5)
+    deadline = time.monotonic() + 5
+    while slow.watch_stats["overflows"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert slow.watch_stats["overflows"] >= 1
+    release.set()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if len(inf.list()) == 9 and inf.watch_healthy:
+            break
+        time.sleep(0.01)
+    assert len(inf.list()) == 9, "relist did not converge the cache"
+    assert inf.watch_healthy
+    stop.set()
 
 
 def test_informer_sync_and_events(api):

@@ -286,3 +286,31 @@ class TestDebugSurface:
         q.shutdown()
         depth = REGISTRY.get_sample_value("tpudra_workqueue_depth", {"queue": "mq"})
         assert depth == 0
+
+
+class TestClusterScaleFamilies:
+    def test_reconcile_latency_histogram_registered(self):
+        """tpudra_reconcile_latency_seconds: one sample per reconcile pass,
+        requeues included (controller.py observes in a finally)."""
+        before = sample(
+            "tpudra_reconcile_latency_seconds_count", {"manager": "computedomain"}
+        )
+        metrics.RECONCILE_LATENCY_SECONDS.labels("computedomain").observe(0.01)
+        assert (
+            sample(
+                "tpudra_reconcile_latency_seconds_count",
+                {"manager": "computedomain"},
+            )
+            == before + 1
+        )
+
+    def test_apiserver_requests_family_moves_through_wrapper(self):
+        from tpudra.kube.accounting import AccountingKube
+
+        api = AccountingKube(FakeKube())
+        before = sample("tpudra_apiserver_requests_total", {"verb": "list"})
+        api.list(gvr.RESOURCE_CLAIMS)
+        assert (
+            sample("tpudra_apiserver_requests_total", {"verb": "list"})
+            == before + 1
+        )

@@ -81,8 +81,11 @@ def main() -> int:
     churn = current_headline(sys.argv[1], metric="checkpoint_churn")
     if churn is not None:
         print_checkpoint_section(churn)
+    cluster = current_headline(sys.argv[1], metric="cluster_scale")
+    if cluster is not None:
+        print_cluster_section(cluster)
     if now is None:
-        if churn is None:
+        if churn is None and cluster is None:
             print("bench-delta: no headline line in this run's output")
         return 0
     prior = prior_headline()
@@ -157,6 +160,49 @@ def print_checkpoint_section(churn: dict) -> None:
             f"bench-delta: checkpoint bytes/mutate at 128 vs 8 resident: "
             f"WAL x{ratio_j:g} (delta-sized), snapshot x{ratio_s:g} "
             "(state-sized)"
+        )
+
+
+def print_cluster_section(cluster: dict) -> None:
+    """The `--cluster-scale` A/B (make bench-cluster): fixed-vs-legacy
+    control-plane arms, within-run by design — the interleaved arms ARE
+    the artifact; absolute latencies bounce with the box's thread/syscall
+    cost."""
+    for key, report in sorted(
+        ((k, v) for k, v in cluster.items() if k.isdigit()),
+        key=lambda kv: int(kv[0]),
+    ):
+        fixed, legacy = report.get("fixed"), report.get("legacy")
+        if not isinstance(fixed, dict) or not isinstance(legacy, dict):
+            if report.get("error"):
+                print(f"bench-delta: cluster @{key} nodes: {report['error']}")
+            continue
+        print(
+            f"bench-delta: cluster @{key} nodes: reconcile p99 "
+            f"{fixed['reconcile']['p99_ms']:g} ms (fixed) vs "
+            f"{legacy['reconcile']['p99_ms']:g} ms (legacy); bind p99 "
+            f"{fixed['bind']['p99_ms']:g} vs {legacy['bind']['p99_ms']:g} ms; "
+            f"apiserver {fixed['apiserver']['qps']:g} vs "
+            f"{legacy['apiserver']['qps']:g} qps over the churn windows"
+        )
+        for tag, arm in (("fixed", fixed), ("legacy", legacy)):
+            if arm["bind"].get("errors"):
+                # A broken arm's fast error-returns flatter its p99; say so
+                # louder than the headline.
+                print(
+                    f"bench-delta: cluster @{key} nodes: WARNING {tag} arm "
+                    f"had {arm['bind']['errors']} bind errors "
+                    f"(first: {arm['bind'].get('first_error', '?')}) — its "
+                    "latency numbers are not trustworthy"
+                )
+        print(
+            f"bench-delta: cluster @{key} nodes: flap victims' max wait "
+            f"{fixed['flap']['victim_wait_max_ms']:g} ms (fixed) vs "
+            f"{legacy['flap']['victim_wait_max_ms']:g} ms (legacy); "
+            f"event materializations {fixed['watch']['materializations']} "
+            f"vs {legacy['watch']['materializations']}; startup publish "
+            f"{fixed['publish']['requests']} vs "
+            f"{legacy['publish']['requests']} requests"
         )
 
 

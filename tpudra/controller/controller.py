@@ -11,8 +11,11 @@ safety net.
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import time
 from dataclasses import dataclass
+from typing import Optional
 
 from tpudra.controller.cleanup import CleanupManager
 from tpudra.controller.computedomain import ComputeDomainManager, RetryLater
@@ -21,13 +24,20 @@ from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.informer import Informer
 from tpudra import metrics
-from tpudra.workqueue import WorkQueue, default_controller_rate_limiter
+from tpudra.workqueue import (
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    WorkQueue,
+    default_controller_rate_limiter,
+)
 
 logger = logging.getLogger(__name__)
 
 _RECONCILE_OK = metrics.RECONCILES_TOTAL.labels("computedomain", "ok")
 _RECONCILE_REQUEUE = metrics.RECONCILES_TOTAL.labels("computedomain", "requeue")
 _RECONCILE_ERROR = metrics.RECONCILES_TOTAL.labels("computedomain", "error")
+_RECONCILE_LATENCY = metrics.RECONCILE_LATENCY_SECONDS.labels("computedomain")
 
 
 @dataclass
@@ -40,6 +50,13 @@ class ManagerConfig:
     # Rendered into spawned daemon pods as LOG_VERBOSITY (the reference's
     # klog -v template propagation, daemonset.go:45-56).
     log_verbosity: int = 0
+    # Priority-lane + per-key-fair work-queue dispatch (workqueue.py);
+    # False restores the single-heap FIFO — the measurable "before" arm of
+    # bench.py --cluster-scale.
+    fair_queue: bool = True
+    # Seeds the rate limiter's backoff jitter so cluster-scale A/B arms
+    # replay identical retry schedules; None keeps the module-global RNG.
+    seed: Optional[int] = None
 
 
 class Controller:
@@ -54,8 +71,15 @@ class Controller:
             additional_namespaces=self._config.additional_namespaces,
             log_verbosity=self._config.log_verbosity,
         )
+        rng = (
+            random.Random(self._config.seed)
+            if self._config.seed is not None
+            else None
+        )
         self.queue = WorkQueue(
-            rate_limiter=default_controller_rate_limiter(), name="controller"
+            rate_limiter=default_controller_rate_limiter(rng=rng),
+            name="controller",
+            fair=self._config.fair_queue,
         )
         self._cd_informer = Informer(kube, gvr.COMPUTE_DOMAINS)
         self._clique_informer = Informer(
@@ -91,13 +115,18 @@ class Controller:
 
     # -- event plumbing -----------------------------------------------------
 
-    def _enqueue_cd(self, namespace: str, name: str) -> None:
+    def _enqueue_cd(
+        self, namespace: str, name: str, priority: int = PRIORITY_NORMAL
+    ) -> None:
         key = ("cd", namespace, name)
         self.queue.enqueue_keyed(
-            key, lambda: self._reconcile_with_retry(namespace, name, key)
+            key,
+            lambda: self._reconcile_with_retry(namespace, name, key),
+            priority=priority,
         )
 
     def _reconcile_with_retry(self, namespace: str, name: str, key) -> None:
+        t0 = time.monotonic()
         try:
             self.manager.reconcile(namespace, name)
             _RECONCILE_OK.inc()
@@ -109,10 +138,20 @@ class Controller:
             logger.exception("reconcile %s/%s failed", namespace, name)
             _RECONCILE_ERROR.inc()
             raise
+        finally:
+            # Every pass samples, requeues and errors included: the latency
+            # a hot object inflicts is the p99 this histogram exists for.
+            _RECONCILE_LATENCY.observe(time.monotonic() - t0)
 
     def _on_cd_event(self, _etype: str, obj: dict) -> None:
         meta = obj.get("metadata", {})
-        self._enqueue_cd(meta.get("namespace", ""), meta.get("name", ""))
+        # Teardown outranks routine reconciles: a terminating CD holds a
+        # finalizer the user is waiting on, and behind a busy lane it
+        # would queue with the crowd (workqueue priority lanes).
+        priority = (
+            PRIORITY_HIGH if meta.get("deletionTimestamp") else PRIORITY_NORMAL
+        )
+        self._enqueue_cd(meta.get("namespace", ""), meta.get("name", ""), priority)
 
     def _on_clique_event(self, _etype: str, obj: dict) -> None:
         cd_uid = obj.get("spec", {}).get("computeDomainUID", "")
@@ -172,6 +211,20 @@ class Controller:
             stop.wait(self._config.resync_period)
             if stop.is_set():
                 return
-            for cd in self._cd_informer.list():
-                meta = cd.get("metadata", {})
-                self._enqueue_cd(meta.get("namespace", ""), meta.get("name", ""))
+            self._resync_once()
+
+    def _resync_once(self) -> None:
+        for cd in self._cd_informer.list():
+            meta = cd.get("metadata", {})
+            # The periodic backstop must never preempt event-driven work —
+            # a 1000-CD sweep rides the LOW lane — EXCEPT for terminating
+            # CDs, which keep the HIGH urgency their deletion event earned
+            # (the workqueue also refuses to demote a pending HIGH entry,
+            # but a sweep that lands after the teardown pass failed and
+            # drained must not requeue it as LOW).
+            priority = (
+                PRIORITY_HIGH if meta.get("deletionTimestamp") else PRIORITY_LOW
+            )
+            self._enqueue_cd(
+                meta.get("namespace", ""), meta.get("name", ""), priority
+            )

@@ -1,0 +1,144 @@
+"""Per-verb request accounting over any ``KubeAPI`` implementation.
+
+The production driver's apiserver footprint is invisible until something
+counts it: the reference relies on client-go's ``rest_client_requests_total``
+family; this wrapper is our analog, feeding
+``tpudra_apiserver_requests_total{verb}`` plus an in-process counter table
+that bench harnesses snapshot around a measurement window (QPS by verb =
+window delta / wall time — docs/cluster-scale.md).
+
+It wraps, never replaces: ``AccountingKube(FakeKube())`` in the cluster
+harness, ``AccountingKube(KubeClient(...))`` in a binary — everything else
+keeps talking plain ``KubeAPI``.  Unknown attributes (``react``,
+``set_latency``, ``watch_stats``) pass through to the wrapped
+implementation so test hooks keep working.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator, Optional
+
+from tpudra import lockwitness, metrics
+from tpudra.kube.gvr import GVR
+
+#: One label value per KubeAPI verb; ``update_status`` is its own verb the
+#: way apiserver audit logs split status writes out (they hit a different
+#: endpoint and a different controller's write budget).
+VERBS = (
+    "get",
+    "list",
+    "create",
+    "update",
+    "update_status",
+    "patch",
+    "delete",
+    "watch",
+)
+
+# Labelled children resolved once: .labels() takes a registry lock and the
+# wrapper sits on every control-plane request.
+_VERB_CHILDREN = {v: metrics.APISERVER_REQUESTS_TOTAL.labels(v) for v in VERBS}
+
+
+class AccountingKube:
+    """A ``KubeAPI`` that counts every request by verb, then delegates."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self._counts = {v: 0 for v in VERBS}
+        self._counts_lock = lockwitness.make_lock("accounting.counts_lock")
+
+    def _count(self, verb: str) -> None:
+        with self._counts_lock:
+            self._counts[verb] += 1
+        # Outside the lock: the prometheus child takes its own mutex.
+        _VERB_CHILDREN[verb].inc()
+
+    def snapshot(self) -> dict[str, int]:
+        """Cumulative per-verb request counts; subtract two snapshots for a
+        measurement window."""
+        with self._counts_lock:
+            return dict(self._counts)
+
+    @staticmethod
+    def window(before: dict[str, int], after: dict[str, int]) -> dict[str, int]:
+        """Per-verb deltas between two snapshots, zero verbs dropped."""
+        return {
+            v: after.get(v, 0) - before.get(v, 0)
+            for v in VERBS
+            if after.get(v, 0) - before.get(v, 0)
+        }
+
+    # -- KubeAPI -------------------------------------------------------------
+
+    def get(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> dict:
+        self._count("get")
+        return self._inner.get(gvr, name, namespace)
+
+    def list(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+    ) -> dict:
+        self._count("list")
+        return self._inner.list(
+            gvr,
+            namespace,
+            label_selector=label_selector,
+            field_selector=field_selector,
+        )
+
+    def create(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        self._count("create")
+        return self._inner.create(gvr, obj, namespace)
+
+    def update(self, gvr: GVR, obj: dict, namespace: Optional[str] = None) -> dict:
+        self._count("update")
+        return self._inner.update(gvr, obj, namespace)
+
+    def update_status(
+        self, gvr: GVR, obj: dict, namespace: Optional[str] = None
+    ) -> dict:
+        self._count("update_status")
+        return self._inner.update_status(gvr, obj, namespace)
+
+    def patch(
+        self, gvr: GVR, name: str, patch: dict, namespace: Optional[str] = None
+    ) -> dict:
+        self._count("patch")
+        return self._inner.patch(gvr, name, patch, namespace)
+
+    def delete(self, gvr: GVR, name: str, namespace: Optional[str] = None) -> None:
+        self._count("delete")
+        self._inner.delete(gvr, name, namespace)
+
+    def watch(
+        self,
+        gvr: GVR,
+        namespace: Optional[str] = None,
+        resource_version: Optional[str] = None,
+        label_selector: Optional[str] = None,
+        field_selector: Optional[str] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> Iterator[dict]:
+        # One count per watch ESTABLISHMENT; streamed events are free, the
+        # same way the client-side QPS limiter charges watches (client.py).
+        self._count("watch")
+        return self._inner.watch(
+            gvr,
+            namespace,
+            resource_version=resource_version,
+            label_selector=label_selector,
+            field_selector=field_selector,
+            stop=stop,
+        )
+
+    # -- passthrough ---------------------------------------------------------
+
+    def __getattr__(self, name: str):
+        # Test hooks and fake-only surfaces (react, set_latency,
+        # watch_stats) reach the wrapped implementation untouched.
+        return getattr(self._inner, name)

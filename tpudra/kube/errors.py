@@ -52,8 +52,22 @@ class Forbidden(ApiError):
     reason = "Forbidden"
 
 
+class Expired(ApiError):
+    """410 Gone — the requested resourceVersion is older than the server's
+    retained watch history (apimachinery's StatusReasonExpired).  A watch
+    client answers it with a fresh LIST + watch, never a blind retry: the
+    events between its resourceVersion and the server's horizon are
+    unrecoverable."""
+
+    code = 410
+    reason = "Expired"
+
+
 _BY_REASON = {
-    cls.reason: cls for cls in (NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden)
+    cls.reason: cls
+    for cls in (
+        NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden, Expired,
+    )
 }
 
 
@@ -62,7 +76,12 @@ def from_status(status: dict, http_code: int) -> ApiError:
     message = status.get("message", "")
     cls = _BY_REASON.get(reason)
     if cls is None:
-        cls = {404: NotFound, 409: Conflict, 422: Invalid, 400: BadRequest, 403: Forbidden}.get(
-            http_code, ApiError
-        )
+        cls = {
+            404: NotFound,
+            409: Conflict,
+            422: Invalid,
+            400: BadRequest,
+            403: Forbidden,
+            410: Expired,
+        }.get(http_code, ApiError)
     return cls(message)

@@ -14,6 +14,16 @@ apiserver semantics the driver's controllers actually rely on:
 - list with label/field selectors
 - watch with resourceVersion resume (event history replay + live queues)
 
+Cluster-scale semantics (docs/cluster-scale.md): each event is materialized
+ONCE and the same frozen payload is shared by the history and every watcher
+queue — N watchers cost N queue appends, not N deep copies (watch consumers
+must treat delivered objects as read-only, the client-go contract).  Watcher
+queues are bounded: a consumer that falls ``watch_queue_depth`` events behind
+has its stream closed with a 410 "Expired" ERROR event (what a real apiserver
+does to slow watchers), and the watch history is compacted to the newest
+``watch_history_limit`` events — resuming from a resourceVersion older than
+the horizon gets the same 410, which an Informer answers with a relist.
+
 It implements the same ``KubeAPI`` protocol as the real REST client, and can be
 served over HTTP (kube/httpserver.py) so the real client can be tested against
 it end-to-end.
@@ -84,6 +94,16 @@ def match_field_selector(selector: str | None, obj: dict) -> bool:
     return True
 
 
+def _expired_event(message: str) -> dict:
+    """The in-band watch-termination event a real apiserver sends when the
+    requested resourceVersion predates its retained history (a slow watcher
+    or a too-old resume): ``{"type": "ERROR", "object": <410 Status>}``.
+    It travels the same path as data events, so the HTTP frontend needs no
+    special-casing mid-stream and the Informer sees identical semantics
+    over both transports."""
+    return {"type": "ERROR", "object": errors.Expired(message).to_status()}
+
+
 class _Watcher:
     def __init__(
         self,
@@ -91,17 +111,26 @@ class _Watcher:
         namespace: Optional[str],
         label_selector: Optional[str],
         field_selector: Optional[str] = None,
+        depth: int = 0,
     ):
         self.gvr_key = gvr_key
         self.namespace = namespace
         self.label_selector = label_selector
         self.field_selector = field_selector
-        self.queue: queue.Queue = queue.Queue()
+        self.queue: queue.Queue = queue.Queue(maxsize=depth)
         self.stopped = threading.Event()
+        #: Set by the emitter when this watcher's queue overflowed: the
+        #: stream has a gap, so delivery stops with a 410 ERROR event.
+        self.overflowed = threading.Event()
 
     def stop(self) -> None:
         self.stopped.set()
-        self.queue.put(None)
+        try:
+            self.queue.put_nowait(None)
+        except queue.Full:
+            # The consumer is behind anyway; the stopped flag alone ends
+            # the loop on its next wakeup.
+            pass
 
 
 class FakeKube:
@@ -111,7 +140,19 @@ class FakeKube:
     #: it is the push channel the latency knob exists to favor.
     LATENCY_VERBS = ("get", "list", "create", "update", "delete")
 
-    def __init__(self):
+    #: Default bound on how far one watcher may fall behind before its
+    #: stream is closed with 410 (a real apiserver's slow-watcher drop).
+    WATCH_QUEUE_DEPTH = 1024
+    #: Default number of events retained for resourceVersion resume; older
+    #: resumes get 410 Expired and must relist (etcd compaction analog).
+    WATCH_HISTORY_LIMIT = 4096
+
+    def __init__(
+        self,
+        watch_queue_depth: int = WATCH_QUEUE_DEPTH,
+        watch_history_limit: int = WATCH_HISTORY_LIMIT,
+        per_watcher_copy: bool = False,
+    ):
         self._lock = threading.RLock()
         self._objects: dict[str, dict[tuple, dict]] = {}  # gvr_key -> {(ns, name): obj}
         self._rv = 0
@@ -119,6 +160,24 @@ class FakeKube:
         self._watchers: list[_Watcher] = []
         self._reactors: list[tuple[str, str, Callable]] = []  # (verb, gvr_key, fn)
         self._latency_s = 0.0
+        self._watch_queue_depth = int(watch_queue_depth)
+        self._watch_history_limit = int(watch_history_limit)
+        #: rv of the newest event dropped by history compaction — resumes
+        #: at or below an OLDER rv than this are unrecoverable (410).
+        self._compacted_rv = 0
+        #: True restores the pre-cluster-scale behavior (one deepcopy per
+        #: watcher per event) — the "before" arm of bench --cluster-scale.
+        self._per_watcher_copy = per_watcher_copy
+        #: Observability for the fan-out path (bench + regression tests):
+        #: materializations counts event deep-copies, deliveries counts
+        #: watcher-queue appends, overflows counts slow-watcher stream
+        #: closes, compactions counts history-trim passes.
+        self.watch_stats = {
+            "materializations": 0,
+            "deliveries": 0,
+            "overflows": 0,
+            "compactions": 0,
+        }
 
     # -- test hooks ---------------------------------------------------------
 
@@ -164,10 +223,22 @@ class FakeKube:
         return str(self._rv)
 
     def _emit(self, gvr: GVR, event_type: str, obj: dict) -> None:
+        # ONE materialization per event: the history entry and every
+        # matching watcher share the same payload.  Per-watcher deep copies
+        # turn each mutation into O(watchers) serialization work — the
+        # fan-out cost that dominates a 1000-node control plane (each
+        # node's informer is a watcher).  Consumers own the read-only
+        # contract (client-go's: never mutate a watch-delivered object).
         event = {"type": event_type, "object": copy.deepcopy(obj)}
+        self.watch_stats["materializations"] += 1
         self._history.append((int(obj["metadata"]["resourceVersion"]), self._key(gvr), event))
+        if len(self._history) > self._watch_history_limit:
+            drop = len(self._history) - self._watch_history_limit
+            self._compacted_rv = self._history[drop - 1][0]
+            del self._history[:drop]
+            self.watch_stats["compactions"] += 1
         for w in list(self._watchers):
-            if w.gvr_key != self._key(gvr):
+            if w.gvr_key != self._key(gvr) or w.overflowed.is_set():
                 continue
             meta = obj.get("metadata", {})
             if w.namespace and meta.get("namespace") != w.namespace:
@@ -176,7 +247,20 @@ class FakeKube:
                 continue
             if not match_field_selector(w.field_selector, obj):
                 continue
-            w.queue.put(copy.deepcopy(event))
+            payload = copy.deepcopy(event) if self._per_watcher_copy else event
+            if self._per_watcher_copy:
+                self.watch_stats["materializations"] += 1
+            try:
+                w.queue.put_nowait(payload)
+                self.watch_stats["deliveries"] += 1
+            except queue.Full:
+                # The consumer fell watch_queue_depth events behind: its
+                # stream now has a gap, so terminate it the way a real
+                # apiserver does — 410 on the stream, client must relist.
+                # The flag (not a queued sentinel — the queue is full)
+                # makes the delivery loop surface the ERROR event.
+                w.overflowed.set()
+                self.watch_stats["overflows"] += 1
 
     # -- KubeAPI protocol ---------------------------------------------------
 
@@ -383,7 +467,10 @@ class FakeKube:
         """Yield {"type": ..., "object": ...} events.
 
         With resource_version, replays history events newer than it first
-        (k8s watch resume), then streams live events.  Terminates when
+        (k8s watch resume), then streams live events.  A resume older than
+        the compacted history horizon, or a consumer that overflows its
+        bounded queue, gets a terminal ``{"type": "ERROR"}`` event carrying
+        a 410 Expired Status — the client's cue to relist.  Terminates when
         ``stop`` is set.
         """
         watcher = _Watcher(
@@ -391,31 +478,63 @@ class FakeKube:
             namespace if gvr.namespaced else None,
             label_selector,
             field_selector,
+            depth=self._watch_queue_depth,
         )
         with self._lock:
             backlog = []
             if resource_version is not None:
                 rv = int(resource_version)
-                for ev_rv, key, event in self._history:
-                    if key != watcher.gvr_key or ev_rv <= rv:
-                        continue
-                    meta = event["object"].get("metadata", {})
-                    if watcher.namespace and meta.get("namespace") != watcher.namespace:
-                        continue
-                    if not match_label_selector(label_selector, meta.get("labels", {})):
-                        continue
-                    if not match_field_selector(field_selector, event["object"]):
-                        continue
-                    backlog.append(copy.deepcopy(event))
-            self._watchers.append(watcher)
+                if rv < self._compacted_rv:
+                    # Events in (rv, compacted_rv] are gone; replay would
+                    # silently skip them.  410, exactly like etcd-compacted
+                    # history behind a real apiserver.
+                    backlog = None
+                else:
+                    for ev_rv, key, event in self._history:
+                        if key != watcher.gvr_key or ev_rv <= rv:
+                            continue
+                        meta = event["object"].get("metadata", {})
+                        if watcher.namespace and meta.get("namespace") != watcher.namespace:
+                            continue
+                        if not match_label_selector(label_selector, meta.get("labels", {})):
+                            continue
+                        if not match_field_selector(field_selector, event["object"]):
+                            continue
+                        if self._per_watcher_copy:
+                            event = copy.deepcopy(event)
+                            self.watch_stats["materializations"] += 1
+                        backlog.append(event)
+            if backlog is not None:
+                self._watchers.append(watcher)
+        if backlog is None:
+            yield _expired_event(
+                f"too old resource version: {resource_version} "
+                f"(history starts after {self._compacted_rv})"
+            )
+            return
         try:
             yield from backlog
             while True:
                 if stop is not None and stop.is_set():
                     return
+                if watcher.overflowed.is_set():
+                    yield _expired_event(
+                        f"watch fell more than {self._watch_queue_depth} "
+                        "events behind; resume requires a fresh list"
+                    )
+                    return
                 try:
-                    event = watcher.queue.get(timeout=0.05)
+                    # Deliveries wake the blocking get instantly; the
+                    # timeout only bounds stop-latency.  Keep it LONG: at
+                    # cluster scale every watcher is a thread, and N×20
+                    # idle wakeups/s of GIL+futex churn was measurably
+                    # slower than the churn being benchmarked.
+                    event = watcher.queue.get(timeout=1.0)
                 except queue.Empty:
+                    # The stop() sentinel can be lost when the queue is at
+                    # capacity; the flag ends the loop once drained.
+                    if watcher.stopped.is_set():
+                        return
                     continue
                 if event is None:
                     return

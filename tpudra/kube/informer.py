@@ -23,6 +23,7 @@ import time
 from typing import Callable, Optional
 
 from tpudra import lockwitness, metrics
+from tpudra.kube import errors
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.gvr import GVR
 
@@ -204,6 +205,20 @@ class Informer:
             try:
                 self._list_and_watch(stop)
                 self._backoff = 0.2
+            except errors.Expired as e:
+                # 410 Gone: the server compacted past our resourceVersion
+                # (too-old resume, or it dropped us as a slow watcher).
+                # This is the server TELLING us to relist — client-go's
+                # reflector relists immediately, without backoff: the
+                # apiserver is healthy, our vantage point is just stale.
+                # The tiny wait only guards against a pathological server
+                # that answers every watch with 410.
+                self._watch_ok = False
+                logger.info(
+                    "informer %s: watch expired (%s); re-listing",
+                    self._gvr.resource, e,
+                )
+                stop.wait(0.01)
             except Exception as e:  # noqa: BLE001 — informer must survive apiserver blips
                 self._watch_ok = False
                 delay = self._backoff * (0.5 + random.random())
@@ -273,6 +288,13 @@ class Informer:
             if stop.is_set():
                 return
             etype, obj = event["type"], event["object"]
+            if etype == "ERROR":
+                # In-band watch termination (a Status object, not a
+                # resource): raise the typed error so _run picks the right
+                # recovery — Expired relists immediately, anything else
+                # takes the backoff path.
+                status = obj if isinstance(obj, dict) else {}
+                raise errors.from_status(status, int(status.get("code") or 500))
             key = obj_key(obj)
             keep = etype != "DELETED" and (
                 self._cache_filter is None or self._cache_filter(obj)

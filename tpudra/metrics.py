@@ -253,6 +253,22 @@ RECONCILES_TOTAL = Counter(
     "Controller reconcile passes by outcome",
     ["manager", "outcome"],
 )
+RECONCILE_LATENCY_SECONDS = Histogram(
+    "tpudra_reconcile_latency_seconds",
+    "Wall time of one controller reconcile pass (including passes that "
+    "end in a requeue or error — the tail a flapping object inflicts on "
+    "its queue is exactly what this histogram exists to expose), by "
+    "manager",
+    ["manager"],
+    buckets=_PREPARE_BUCKETS,
+)
+APISERVER_REQUESTS_TOTAL = Counter(
+    "tpudra_apiserver_requests_total",
+    "Requests issued through an accounting-wrapped kube client "
+    "(kube/accounting.py), by verb — the control plane's apiserver load; "
+    "divide a window's delta by its wall time for QPS by verb",
+    ["verb"],
+)
 
 
 def render_latest() -> tuple[bytes, str]:

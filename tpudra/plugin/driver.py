@@ -102,6 +102,11 @@ class DriverConfig:
     # restore) heal within the interval instead of only on restart.
     # <= 0 disables reassertion (every identical rebuild is skipped).
     publish_reassert_s: float = 300.0
+    # Seed for the ResourceSlice pool generation.  None (production) lists
+    # live slices to outrank a previous process's leftovers; the cluster
+    # harness passes 1 against a fresh fake so constructing N hundred
+    # drivers costs zero LISTs instead of N scans of a growing slice set.
+    initial_pool_generation: Optional[int] = None
 
 
 class Driver:
@@ -149,9 +154,14 @@ class Driver:
         self._published_hash: Optional[str] = None
         self._published_slices: list[dict] = []
         self._published_at: Optional[float] = None  # monotonic of last WRITE
-        # Seeded from live slices so a restart outranks previous publishes.
-        self._pool_generation = next_pool_generation(
-            kube, config.node_name, alloc.pool_name(config.node_name)
+        # Seeded from live slices so a restart outranks previous publishes
+        # (or from the config when the caller already knows the answer).
+        self._pool_generation = (
+            config.initial_pool_generation
+            if config.initial_pool_generation is not None
+            else next_pool_generation(
+                kube, config.node_name, alloc.pool_name(config.node_name)
+            )
         )
         self._stop = threading.Event()
         # Claim-reference resolution: watch-backed cache with read-through
@@ -662,11 +672,20 @@ class Driver:
         )
         return hashlib.sha256(content.encode()).hexdigest()
 
-    def publish_resources(self, force: bool = False) -> list[dict]:
+    def publish_resources(
+        self,
+        force: bool = False,
+        applier: Optional[Callable[[list[dict], str, str], None]] = None,
+    ) -> list[dict]:
         """Rebuild and publish this node's ResourceSlices.  A rebuild whose
         content hashes identical to the last successful publish skips the
         API write entirely (``tpudra_resourceslice_publish_noop_total``) —
-        ``force=True`` writes regardless (restart-style reassertion)."""
+        ``force=True`` writes regardless (restart-style reassertion).
+        ``applier`` overrides the write step (slices, node_name,
+        name_prefix → apiserver): the cluster harness passes a
+        ``BulkSlicePublisher`` so hundreds of co-located drivers share one
+        existence LIST instead of paying 3 requests per node; driver-side
+        bookkeeping (generation, content hash) is identical either way."""
         with self._publish_lock:
             partitionable = featuregates.enabled(featuregates.DYNAMIC_PARTITIONING)
             with self._unhealthy_lock:
@@ -698,13 +717,17 @@ class Driver:
                 generation=self._pool_generation,
             )
             self._pool_generation += 1
-            # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP deliberate: publish_lock serializes snapshot→build→write so an interleaved publish can never re-advertise silicon just marked unhealthy; it is the top of the hierarchy (no lock is ever taken while it is held by another thread's bind path) and only the publisher thread holds it in steady state (docs/lock-order.md)
-            publish_slices(
-                self._kube,
-                slices,
-                self._config.node_name,
-                f"{self._config.node_name}-{TPU_DRIVER_NAME}-",
-            )
+            name_prefix = f"{self._config.node_name}-{TPU_DRIVER_NAME}-"
+            if applier is not None:
+                applier(slices, self._config.node_name, name_prefix)
+            else:
+                # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP deliberate: publish_lock serializes snapshot→build→write so an interleaved publish can never re-advertise silicon just marked unhealthy; it is the top of the hierarchy (no lock is ever taken while it is held by another thread's bind path) and only the publisher thread holds it in steady state (docs/lock-order.md)
+                publish_slices(
+                    self._kube,
+                    slices,
+                    self._config.node_name,
+                    name_prefix,
+                )
             self._published_hash = content_hash
             self._published_slices = slices
             self._published_at = time.monotonic()

@@ -156,6 +156,14 @@ class PluginSockets:
         # (draplugin.go:623-663): set before start() or not at all.
         self.health_broadcaster = None  # Optional[HealthBroadcaster]
 
+    @property
+    def resolve_claim(self) -> ClaimResolver:
+        """The claim-reference resolver the DRA service runs on every
+        NodePrepareResources.  The cluster harness (sim/cluster.py) calls
+        it directly to model kubelet's ref→object step without paying a
+        gRPC server per simulated node."""
+        return self._resolve_claim
+
     # ------------------------------------------------------------ DRA bridge
 
     def _resolve_all(self, refs) -> list[tuple]:

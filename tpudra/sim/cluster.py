@@ -1,0 +1,505 @@
+"""Cluster-scale harness: hundreds of simulated nodes against one control plane.
+
+Everything else in this repo exercises ONE node.  This harness is the
+"millions of users" axis (ROADMAP item 1): N simulated nodes — each a real
+in-process plugin ``Driver`` with its own checkpoint, device lib, and
+(optionally) its own ResourceClaim informer — one real ``Controller``, one
+shared ``FakeKube`` wrapped in per-verb request accounting, and a seeded
+claim/ComputeDomain churn generator.  What it measures is the control
+plane, not the silicon:
+
+- **bind p50/p99** across nodes under sustained churn, through the real
+  resolver (informer cache hit or read-through GET) and the real phased
+  bind engine;
+- **controller reconcile p50/p99** (every pass sampled, requeues included);
+- **apiserver QPS by verb** over any measurement window (AccountingKube);
+- **informer event lag**: create→handler-dispatch latency through the
+  fake's watch fan-out;
+- **watch fan-out stats**: event materializations, deliveries, slow-watcher
+  overflows, history compactions (FakeKube.watch_stats).
+
+Every contested mechanism has a legacy arm so the fixes are measured, not
+argued (``bench.py --cluster-scale`` interleaves the arms):
+
+=====================  ======================================  ==========================
+knob                   fixed arm (default)                     legacy arm
+=====================  ======================================  ==========================
+share_watch_events     serialize-once event fan-out            deepcopy per watcher
+fair                   priority lanes + per-key round-robin    single-heap FIFO
+bulk_publish           one LIST for all nodes' slices          3 requests per node
+=====================  ======================================  ==========================
+
+Checkpoints live under ``/dev/shm`` when available (in-memory: the harness
+measures control-plane behavior, not the host's fsync latency — the
+checkpoint bench owns that axis).  Node count is bounded only by thread
+headroom: each node informer is one thread; 256 nodes is the CI target,
+1024 runs on a developer box.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tpudra import TPU_DRIVER_NAME
+from tpudra.controller.controller import Controller, ManagerConfig
+from tpudra.kube import gvr
+from tpudra.kube.accounting import AccountingKube
+from tpudra.kube.apply import BulkSlicePublisher
+from tpudra.kube.errors import NotFound
+from tpudra.kube.fake import FakeKube
+from tpudra.kube.informer import Informer
+
+logger = logging.getLogger(__name__)
+
+CD_API_V = "resource.tpu.google.com/v1beta1"
+
+
+def percentile(sorted_samples: list[float], q: float) -> float:
+    """Nearest-rank percentile over an ascending-sorted sample list."""
+    if not sorted_samples:
+        return 0.0
+    idx = min(len(sorted_samples) - 1, int(len(sorted_samples) * q))
+    return sorted_samples[idx]
+
+
+def latency_summary(samples_ms: list[float]) -> dict:
+    s = sorted(samples_ms)
+    return {
+        "n": len(s),
+        "p50_ms": round(percentile(s, 0.50), 3),
+        "p99_ms": round(percentile(s, 0.99), 3),
+        "max_ms": round(s[-1], 3) if s else 0.0,
+    }
+
+
+def make_claim(uid: str, node: str, devices: list[str], name: str, ns: str = "default") -> dict:
+    """An allocated ResourceClaim bound to ``node``'s pool — the object the
+    scheduler's allocator would have written (pool == node name, the
+    driver's cache-filter contract)."""
+    return {
+        "metadata": {"uid": uid, "namespace": ns, "name": name},
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": [
+                        {
+                            "request": f"r{i}",
+                            "driver": TPU_DRIVER_NAME,
+                            "pool": node,
+                            "device": d,
+                        }
+                        for i, d in enumerate(devices)
+                    ],
+                    "config": [],
+                }
+            }
+        },
+    }
+
+
+def make_cd(name: str, ns: str = "default", num_nodes: int = 1) -> dict:
+    return {
+        "apiVersion": CD_API_V,
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "numNodes": num_nodes,
+            "channel": {
+                "resourceClaimTemplate": {"name": f"{name}-channel"},
+                "allocationMode": "Single",
+            },
+        },
+    }
+
+
+def scratch_base() -> str:
+    """An in-memory-backed scratch root when the host offers one: the
+    harness's checkpoints must cost RAM, not fsync latency."""
+    shm = "/dev/shm"
+    if os.path.isdir(shm) and os.access(shm, os.W_OK):
+        return shm
+    return tempfile.gettempdir()
+
+
+@dataclass
+class ClusterScaleConfig:
+    nodes: int = 8
+    chips_per_node: int = 4
+    generation: str = "v5e"
+    #: Claims per churn wave; capped at nodes*chips so a wave's slots are
+    #: disjoint (machinery contention, not allocation conflicts, is the
+    #: thing under measurement).
+    churn_claims: int = 64
+    workers: int = 16
+    #: Static ComputeDomain population whose spec flips each CD wave.
+    compute_domains: int = 8
+    seed: int = 0
+    # -- A/B knobs (fixed arm defaults) -------------------------------------
+    fair: bool = True
+    share_watch_events: bool = True
+    bulk_publish: bool = True
+    #: One ResourceClaim informer per node (the production plugin's cache):
+    #: this is what makes watch fan-out scale with N.
+    node_informers: bool = True
+    watch_queue_depth: int = 8192
+    watch_history_limit: int = 32768
+    driver_namespace: str = "tpudra-system"
+    base_dir: Optional[str] = None
+
+
+class ClusterScaleSim:
+    """N plugin drivers + one controller against one accounted FakeKube."""
+
+    def __init__(self, config: ClusterScaleConfig):
+        # Imports deferred so `import tpudra.sim.cluster` stays cheap for
+        # tools that only want the claim/CD builders.
+        from tpudra.devicelib.mock import MockDeviceLib
+        from tpudra.devicelib.topology import MockTopologyConfig
+        from tpudra.plugin.driver import Driver, DriverConfig
+
+        self.config = config
+        self._rng = random.Random(config.seed)
+        self.kube = AccountingKube(
+            FakeKube(
+                watch_queue_depth=config.watch_queue_depth,
+                watch_history_limit=config.watch_history_limit,
+                per_watcher_copy=not config.share_watch_events,
+            )
+        )
+        self._stop = threading.Event()
+        self._tmp = tempfile.TemporaryDirectory(
+            prefix="tpudra-cluster-", dir=config.base_dir or scratch_base()
+        )
+        base = self._tmp.name
+
+        self.node_names: list[str] = [f"node-{i:04d}" for i in range(config.nodes)]
+        for name in self.node_names:
+            self.kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
+
+        def build_node(i: int):
+            lib = MockDeviceLib(
+                config=MockTopologyConfig(
+                    generation=config.generation, num_chips=config.chips_per_node
+                ),
+                state_file=os.path.join(base, f"hw-{i}.json"),
+            )
+            driver = Driver(
+                DriverConfig(
+                    node_name=self.node_names[i],
+                    plugin_dir=os.path.join(base, f"p{i}"),
+                    registry_dir=os.path.join(base, f"r{i}"),
+                    cdi_root=os.path.join(base, f"c{i}"),
+                    claim_cache=config.node_informers,
+                    # Fresh fake: no prior slices to outrank, and N
+                    # constructor LISTs over a growing slice set would
+                    # be O(N^2) startup work.
+                    initial_pool_generation=1,
+                ),
+                self.kube,
+                lib,
+            )
+            return lib, driver
+
+        # Node construction is syscall-bound (checkpoint dirs, device-state
+        # files) and the syscalls release the GIL — build concurrently or a
+        # 1024-node cluster pays minutes of serial mkdir/stat.
+        with ThreadPoolExecutor(max_workers=max(8, config.workers)) as ctor_pool:
+            built = list(ctor_pool.map(build_node, range(config.nodes)))
+        self._libs = [lib for lib, _ in built]
+        self.drivers = [driver for _, driver in built]
+
+        self.controller = Controller(
+            self.kube,
+            ManagerConfig(
+                driver_namespace=config.driver_namespace,
+                fair_queue=config.fair,
+                seed=config.seed,
+            ),
+        )
+        # Reconcile instrumentation: every pass (ok / requeue / error) is
+        # one latency sample plus a completion-log record for per-key wait
+        # analysis (the flapping-CD injection reads it).
+        self.reconcile_samples: list[float] = []
+        self._reconcile_log: list[tuple[str, float]] = []  # (name, t_done)
+        inner_reconcile = self.controller.manager.reconcile
+
+        def timed_reconcile(namespace: str, name: str) -> None:
+            t0 = time.perf_counter()
+            try:
+                inner_reconcile(namespace, name)
+            finally:
+                done = time.perf_counter()
+                self.reconcile_samples.append(done - t0)
+                self._reconcile_log.append((name, done))
+
+        self.controller.manager.reconcile = timed_reconcile
+
+        # Event-lag probe: one claims informer whose handler clocks
+        # create→dispatch latency for claims this harness stamped.
+        self._births: dict[str, float] = {}
+        self._births_lock = threading.Lock()
+        self.event_lag_samples: list[float] = []
+        self._lag_informer = Informer(self.kube, gvr.RESOURCE_CLAIMS)
+        self._lag_informer.add_handler(self._observe_lag)
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=config.workers, thread_name_prefix="churn"
+        )
+        self._started = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, controller: bool = True) -> "ClusterScaleSim":
+        """Publish every node's slices, start per-node informers, the lag
+        probe, and (by default) the controller.  Returns self."""
+        t0 = time.perf_counter()
+        before = self.kube.snapshot()
+        applier = BulkSlicePublisher(self.kube) if self.config.bulk_publish else None
+        for d in self.drivers:
+            d.publish_resources(applier=applier)
+        self.publish_stats = {
+            "wall_s": round(time.perf_counter() - t0, 3),
+            "requests": sum(
+                AccountingKube.window(before, self.kube.snapshot()).values()
+            ),
+        }
+        if self.config.node_informers:
+            for d in self.drivers:
+                d.claim_informer.start(self._stop)
+        self._lag_informer.start(self._stop)
+        self._lag_informer.wait_for_sync()
+        if controller:
+            self.controller.start(self._stop)
+            self.controller._cd_informer.wait_for_sync()
+        if self.config.node_informers:
+            deadline = time.monotonic() + 60
+            for d in self.drivers:
+                d.claim_informer.wait_for_sync(
+                    max(0.1, deadline - time.monotonic())
+                )
+        self._started = True
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        self.controller.queue.shutdown()
+        self._pool.shutdown(wait=False)
+        for d in self.drivers:
+            try:
+                d.stop()
+            except Exception:  # noqa: BLE001 — teardown must visit every node
+                logger.exception("driver stop failed")
+        self._tmp.cleanup()
+
+    def __enter__(self) -> "ClusterScaleSim":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------- measurement
+
+    def _observe_lag(self, etype: str, obj: dict) -> None:
+        if etype != "ADDED":
+            return
+        uid = obj.get("metadata", {}).get("uid", "")
+        with self._births_lock:
+            born = self._births.pop(uid, None)
+        if born is not None:
+            self.event_lag_samples.append(time.monotonic() - born)
+
+    def measured_window(self, fn: Callable[[], dict]) -> dict:
+        """Run ``fn`` and annotate its result with the window's apiserver
+        load: per-verb request deltas and aggregate QPS."""
+        before = self.kube.snapshot()
+        t0 = time.perf_counter()
+        out = fn()
+        wall = max(time.perf_counter() - t0, 1e-9)
+        window = AccountingKube.window(before, self.kube.snapshot())
+        out["apiserver"] = {
+            "by_verb": window,
+            "total": sum(window.values()),
+            "qps": round(sum(window.values()) / wall, 1),
+            "wall_s": round(wall, 3),
+        }
+        return out
+
+    # --------------------------------------------------------------- churn
+
+    def churn_wave(self, tag: str, n_claims: Optional[int] = None) -> dict:
+        """One claim-churn wave: create → resolve (through the node's real
+        resolver) → prepare → unprepare → delete, fanned across the worker
+        pool on disjoint (node, chip) slots, order shuffled by the seeded
+        RNG.  Returns bind latency percentiles for the wave."""
+        cfg = self.config
+        n = min(
+            n_claims if n_claims is not None else cfg.churn_claims,
+            cfg.nodes * cfg.chips_per_node,
+        )
+        slots = [(i % cfg.nodes, (i // cfg.nodes) % cfg.chips_per_node) for i in range(n)]
+        self._rng.shuffle(slots)
+        errors: list[str] = []
+        err_lock = threading.Lock()
+
+        def one(i: int) -> float:
+            node_idx, chip = slots[i]
+            driver = self.drivers[node_idx]
+            node = self.node_names[node_idx]
+            uid = f"churn-{tag}-{i}"
+            claim = make_claim(uid, node, [f"tpu-{chip}"], name=uid)
+            with self._births_lock:
+                self._births[uid] = time.monotonic()
+            self.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            t0 = time.perf_counter()
+            try:
+                # The kubelet path: a claim REFERENCE resolved into the full
+                # object (informer cache or read-through GET), then the
+                # phased bind engine.
+                resolved = driver.sockets.resolve_claim("default", uid, uid)
+                resp = driver.prepare_resource_claims([resolved])
+                dt = (time.perf_counter() - t0) * 1000.0
+                err = resp["claims"][uid].get("error")
+                if err:
+                    with err_lock:
+                        errors.append(err)
+                    return dt
+                driver.unprepare_resource_claims([{"uid": uid}])
+                return dt
+            finally:
+                try:
+                    self.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+                except NotFound:
+                    pass
+
+        samples = list(self._pool.map(one, range(n)))
+        out = latency_summary(samples)
+        out["samples_ms"] = samples  # raw, for cross-wave pooling (bench)
+        out["bind_errors"] = len(errors)
+        if errors:
+            out["first_error"] = errors[0][:160]
+        return out
+
+    # ----------------------------------------------------------- controller
+
+    def seed_compute_domains(self) -> None:
+        for i in range(self.config.compute_domains):
+            self.kube.create(
+                gvr.COMPUTE_DOMAINS, make_cd(f"cd-{i:03d}", num_nodes=1), "default"
+            )
+
+    def cd_wave(self, flip_to: int, timeout: float = 60.0) -> dict:
+        """Flip every static CD's spec (numNodes) and wait for the
+        controller to drain the resulting reconciles.  Returns the wave's
+        reconcile-latency percentiles (from the samples the wave added)."""
+        n_before = len(self.reconcile_samples)
+        for i in range(self.config.compute_domains):
+            name = f"cd-{i:03d}"
+            cd = self.kube.get(gvr.COMPUTE_DOMAINS, name, "default")
+            cd["spec"]["numNodes"] = flip_to
+            self.kube.update(gvr.COMPUTE_DOMAINS, cd, "default")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (
+                len(self.reconcile_samples) - n_before >= self.config.compute_domains
+                and self.controller.queue.drain(0.2)
+            ):
+                break
+        wave = [s * 1000.0 for s in self.reconcile_samples[n_before:]]
+        out = latency_summary(wave)
+        out["samples_ms"] = wave  # raw, for cross-wave pooling (bench)
+        return out
+
+    def combined_wave(self, tag: str, flip_to: int) -> tuple[dict, dict]:
+        """One churn wave and one CD-flip wave IN FLIGHT TOGETHER — the
+        cluster-scale scenario proper: the controller reconciles while the
+        claim churn's watch fan-out and apiserver traffic are live, so
+        reconcile p99 carries the contention a quiet-cluster measurement
+        would hide.  Returns (churn summary, reconcile summary)."""
+        churn_result: dict = {}
+
+        def churn() -> None:
+            churn_result.update(self.churn_wave(tag))
+
+        churn_thread = threading.Thread(target=churn, name=f"churn-{tag}")
+        churn_thread.start()
+        cd = self.cd_wave(flip_to)
+        churn_thread.join()
+        return churn_result, cd
+
+    def flapping_injection(
+        self, victims: int = 32, warm_s: float = 0.2, timeout: float = 30.0
+    ) -> dict:
+        """One ComputeDomain flaps (metadata churn at full producer speed)
+        while ``victims`` quiet CDs arrive once each.  Reports how long the
+        LAST victim waited for its first reconcile — the "no single key
+        starves 999 others" bound — plus the flap volume absorbed."""
+        flapper = self.kube.create(
+            gvr.COMPUTE_DOMAINS, make_cd("flapper", num_nodes=1), "default"
+        )
+        stop_flap = threading.Event()
+        flaps = [0]
+
+        def flap() -> None:
+            while not stop_flap.is_set():
+                try:
+                    self.kube.patch(
+                        gvr.COMPUTE_DOMAINS,
+                        "flapper",
+                        {"metadata": {"labels": {"flap": str(flaps[0])}}},
+                        "default",
+                    )
+                    flaps[0] += 1
+                except Exception:  # noqa: BLE001 — racing teardown
+                    return
+
+        flap_thread = threading.Thread(target=flap, daemon=True, name="cd-flapper")
+        flap_thread.start()
+        time.sleep(warm_s)
+        victim_names = {f"victim-{i:03d}" for i in range(victims)}
+        log_start = len(self._reconcile_log)
+        t0 = time.perf_counter()
+        for name in sorted(victim_names):
+            self.kube.create(gvr.COMPUTE_DOMAINS, make_cd(name, num_nodes=1), "default")
+        waits: dict[str, float] = {}
+        deadline = time.monotonic() + timeout
+        while len(waits) < victims and time.monotonic() < deadline:
+            for name, t_done in self._reconcile_log[log_start:]:
+                if name in victim_names and name not in waits:
+                    waits[name] = (t_done - t0) * 1000.0
+            time.sleep(0.01)
+        stop_flap.set()
+        flap_thread.join(2)
+        for name in sorted(victim_names) + ["flapper"]:
+            try:
+                self.kube.delete(gvr.COMPUTE_DOMAINS, name, "default")
+            except NotFound:
+                pass
+        vals = sorted(waits.values())
+        return {
+            "victims": victims,
+            "victims_reconciled": len(waits),
+            "flap_updates": flaps[0],
+            "victim_wait_p50_ms": round(percentile(vals, 0.50), 1),
+            "victim_wait_max_ms": round(vals[-1], 1) if vals else float("inf"),
+        }
+
+    # --------------------------------------------------------------- report
+
+    def watch_report(self) -> dict:
+        stats = dict(self.kube.watch_stats)
+        stats["watchers"] = len(self.kube._watchers)
+        return stats
+
+    def lag_report(self) -> dict:
+        return latency_summary([s * 1000.0 for s in self.event_lag_samples])
+
+    def reconcile_report(self) -> dict:
+        return latency_summary([s * 1000.0 for s in self.reconcile_samples])

@@ -11,6 +11,17 @@ Limiter presets mirror the reference's (workqueue.go:49-63):
 - prepare/unprepare: per-item exponential 250ms→3s plus a global 5/s bucket
 - compute-domain daemon: exponential 5ms→6s with jitter
 - controller default: exponential 5ms→1000s plus a global 10/s bucket
+
+Cluster-scale dispatch (docs/cluster-scale.md): ready work is served from
+priority lanes (higher ``priority`` first) with per-key round-robin inside
+each lane — every key with ready work gets one item per rotation, so a
+flapping ComputeDomain that floods the queue cannot push 999 quiet domains'
+single items arbitrarily far back.  Unkeyed closures share ONE fairness
+bucket (anonymous work is a single rotation participant, not a crowd that
+can monopolize the rotation).  ``fair=False`` restores the pre-lanes
+single-heap FIFO — the "before" arm of ``bench.py --cluster-scale``.
+Backoff jitter accepts an injected ``random.Random`` so A/B arms replay
+identical schedules from one seed.
 """
 
 from __future__ import annotations
@@ -21,6 +32,7 @@ import logging
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -30,12 +42,23 @@ logger = logging.getLogger(__name__)
 
 
 class ExponentialBackoff:
-    """Per-item exponential backoff: base * 2^failures, capped."""
+    """Per-item exponential backoff: base * 2^failures, capped.
 
-    def __init__(self, base: float, cap: float, jitter: float = 0.0):
+    ``rng`` injects the jitter source (``random.Random(seed)``) so
+    cluster-scale A/B arms are reproducible; default is the module-global
+    generator (the pre-seed behavior)."""
+
+    def __init__(
+        self,
+        base: float,
+        cap: float,
+        jitter: float = 0.0,
+        rng: Optional[random.Random] = None,
+    ):
         self.base = base
         self.cap = cap
         self.jitter = jitter
+        self.rng = rng if rng is not None else random
         self._failures: dict[object, int] = {}
         self._lock = lockwitness.make_lock("workqueue.backoff_lock")
 
@@ -45,7 +68,7 @@ class ExponentialBackoff:
             self._failures[item] = n + 1
         delay = min(self.base * (2**n), self.cap)
         if self.jitter:
-            delay *= 1.0 + random.uniform(0, self.jitter)
+            delay *= 1.0 + self.rng.uniform(0, self.jitter)
         return delay
 
     def forget(self, item: object) -> None:
@@ -98,19 +121,28 @@ class RateLimiter:
         return self.backoff.retries(item)
 
 
-def prep_unprep_rate_limiter() -> RateLimiter:
+def prep_unprep_rate_limiter(rng: Optional[random.Random] = None) -> RateLimiter:
     """Preset for claim prepare/unprepare retries (reference workqueue.go:49-59)."""
-    return RateLimiter(ExponentialBackoff(0.25, 3.0), TokenBucket(5.0, 10))
+    return RateLimiter(ExponentialBackoff(0.25, 3.0, rng=rng), TokenBucket(5.0, 10))
 
 
-def daemon_rate_limiter() -> RateLimiter:
+def daemon_rate_limiter(rng: Optional[random.Random] = None) -> RateLimiter:
     """Preset for compute-domain daemon loops (reference workqueue.go:61-63)."""
-    return RateLimiter(ExponentialBackoff(0.005, 6.0, jitter=0.5))
+    return RateLimiter(ExponentialBackoff(0.005, 6.0, jitter=0.5, rng=rng))
 
 
-def default_controller_rate_limiter() -> RateLimiter:
+def default_controller_rate_limiter(rng: Optional[random.Random] = None) -> RateLimiter:
     """client-go's DefaultControllerRateLimiter equivalent."""
-    return RateLimiter(ExponentialBackoff(0.005, 1000.0), TokenBucket(10.0, 100))
+    return RateLimiter(
+        ExponentialBackoff(0.005, 1000.0, rng=rng), TokenBucket(10.0, 100)
+    )
+
+
+#: Priority-lane conventions.  Any int works; these name the intent so call
+#: sites across the tree agree on relative order.
+PRIORITY_HIGH = 10
+PRIORITY_NORMAL = 0
+PRIORITY_LOW = -10
 
 
 @dataclass(order=True)
@@ -120,6 +152,19 @@ class _Entry:
     fn: Callable[[], None] = field(compare=False)
     key: Optional[object] = field(compare=False, default=None)
     gen: int = field(compare=False, default=0)
+    priority: int = field(compare=False, default=0)
+
+
+class _Lane:
+    """Ready entries of one priority: per-key FIFO buckets served
+    round-robin.  Invariant (under the queue cond): a fairness key is in
+    ``rotation`` exactly once iff its bucket is non-empty."""
+
+    __slots__ = ("by_key", "rotation")
+
+    def __init__(self) -> None:
+        self.by_key: dict[object, deque[_Entry]] = {}
+        self.rotation: deque[object] = deque()
 
 
 class WorkQueue:
@@ -130,6 +175,13 @@ class WorkQueue:
       supersedes earlier queued/retrying entries (newest wins; stale retries
       are dropped on pop).
     - ``run(stop)``: worker loop; call from one or more threads.
+
+    With ``fair=True`` (default), READY entries dispatch from priority
+    lanes (higher ``priority`` first) with per-key round-robin inside a
+    lane; not-yet-ready entries (retries, defers) wait in the timer heap
+    and migrate to their lane when due.  ``fair=False`` is the pre-lanes
+    behavior: one heap, strict (ready_at, seq) order, no priorities — kept
+    as the measurable "before" arm.
     """
 
     def __init__(
@@ -137,9 +189,23 @@ class WorkQueue:
         rate_limiter: Optional[RateLimiter] = None,
         max_retries: int | None = None,
         name: str = "default",
+        fair: bool = True,
+        rng: Optional[random.Random] = None,
     ):
-        self._limiter = rate_limiter or default_controller_rate_limiter()
+        self._limiter = rate_limiter or default_controller_rate_limiter(rng=rng)
+        if rng is not None and rate_limiter is not None:
+            # An explicit seed overrides the limiter's jitter source, so one
+            # WorkQueue(seeded) call reproduces the whole retry schedule.
+            self._limiter.backoff.rng = rng
         self._heap: list[_Entry] = []
+        self._fair = fair
+        self._lanes: dict[int, _Lane] = {}
+        self._ready_count = 0
+        #: key -> priority of its live (newest-generation) entry.
+        #: Supersession must never DEMOTE: a LOW resync enqueue landing on
+        #: a key whose pending entry is HIGH (a terminating CD) would drop
+        #: the HIGH entry as stale and bury the teardown in the LOW lane.
+        self._live_priority: dict[object, int] = {}
         self._cond = lockwitness.make_condition("workqueue.cond")
         self._seq = itertools.count()
         self._gens: dict[object, int] = {}
@@ -157,30 +223,83 @@ class WorkQueue:
 
     def _update_depth(self) -> None:
         """Caller must hold self._cond."""
-        self._depth_gauge.set(len(self._heap) + self._inflight)
+        self._depth_gauge.set(len(self._heap) + self._ready_count + self._inflight)
 
     # -- producers ----------------------------------------------------------
 
-    def enqueue(self, fn: Callable[[], None]) -> None:
-        self._push(fn, key=None, delay=0.0, gen=0)
+    def enqueue(self, fn: Callable[[], None], priority: int = PRIORITY_NORMAL) -> None:
+        self._push(fn, key=None, delay=0.0, gen=0, priority=priority)
 
-    def enqueue_keyed(self, key: object, fn: Callable[[], None]) -> None:
+    def enqueue_keyed(
+        self, key: object, fn: Callable[[], None], priority: int = PRIORITY_NORMAL
+    ) -> None:
         with self._cond:
             gen = self._gens.get(key, 0) + 1
             self._gens[key] = gen
+            # Superseding a pending entry inherits the max of the two
+            # priorities: newest-wins replaces the WORK, not the urgency
+            # (a LOW backstop sweep must not demote a pending HIGH
+            # teardown into the LOW lane).
+            priority = max(priority, self._live_priority.get(key, priority))
+            self._live_priority[key] = priority
         # A fresh enqueue resets the key's backoff history: the newest intent
         # is a new piece of work, not a retry of the old one.
         self._limiter.forget(key)
-        self._push(fn, key=key, delay=0.0, gen=gen)
+        self._push(fn, key=key, delay=0.0, gen=gen, priority=priority)
 
-    def _push(self, fn, key, delay, gen) -> None:
-        entry = _Entry(time.monotonic() + delay, next(self._seq), fn, key, gen)
+    def _push(self, fn, key, delay, gen, priority=PRIORITY_NORMAL) -> None:
+        entry = _Entry(
+            time.monotonic() + delay, next(self._seq), fn, key, gen, priority
+        )
         with self._cond:
             if self._shutdown:
                 return
-            heapq.heappush(self._heap, entry)
+            if self._fair and delay <= 0:
+                self._ready_add(entry)
+            else:
+                heapq.heappush(self._heap, entry)
             self._update_depth()
             self._cond.notify()
+
+    # -- fair-dispatch internals (every helper expects self._cond held) -----
+
+    def _fairness_key(self, entry: _Entry) -> object:
+        # Keyed work rotates per key; ALL unkeyed work shares one bucket —
+        # an anonymous flood is one rotation participant, not a crowd.
+        return entry.key
+
+    def _ready_add(self, entry: _Entry) -> None:
+        lane = self._lanes.get(entry.priority)
+        if lane is None:
+            lane = self._lanes[entry.priority] = _Lane()
+        fkey = self._fairness_key(entry)
+        bucket = lane.by_key.get(fkey)
+        if bucket is None:
+            bucket = lane.by_key[fkey] = deque()
+            lane.rotation.append(fkey)
+        bucket.append(entry)
+        self._ready_count += 1
+
+    def _ready_pop(self) -> Optional[_Entry]:
+        for priority in sorted(self._lanes, reverse=True):
+            lane = self._lanes[priority]
+            if not lane.rotation:
+                continue
+            fkey = lane.rotation.popleft()
+            bucket = lane.by_key[fkey]
+            entry = bucket.popleft()
+            if bucket:
+                lane.rotation.append(fkey)
+            else:
+                del lane.by_key[fkey]
+            self._ready_count -= 1
+            return entry
+        return None
+
+    def _migrate_due(self, now: float) -> None:
+        """Move due timer-heap entries into their priority lane."""
+        while self._heap and self._heap[0].ready_at <= now:
+            self._ready_add(heapq.heappop(self._heap))
 
 
     # -- consumer -----------------------------------------------------------
@@ -205,7 +324,7 @@ class WorkQueue:
                         # semantics). Defer briefly and re-check.
                         entry = _Entry(
                             time.monotonic() + 0.005, next(self._seq),
-                            entry.fn, entry.key, entry.gen,
+                            entry.fn, entry.key, entry.gen, entry.priority,
                         )
                         heapq.heappush(self._heap, entry)
                         self._inflight -= 1
@@ -230,7 +349,7 @@ class WorkQueue:
                     delay = self._limiter.when(item)
                     logger.debug("work item %r failed (%s); retrying in %.3fs", item, e, delay)
                     self._retries_counter.inc()
-                    self._push(entry.fn, entry.key, delay, entry.gen)
+                    self._push(entry.fn, entry.key, delay, entry.gen, entry.priority)
             else:
                 self._limiter.forget(entry.key if entry.key is not None else entry.fn)
             finally:
@@ -246,26 +365,36 @@ class WorkQueue:
                             and not self._has_queued_key(entry.key)
                         ):
                             del self._gens[entry.key]
+                            self._live_priority.pop(entry.key, None)
                     self._inflight -= 1
                     self._update_depth()
                     self._cond.notify_all()
 
     def _has_queued_key(self, key: object) -> bool:
         """Caller must hold self._cond."""
-        return any(e.key == key for e in self._heap)
+        if any(e.key == key for e in self._heap):
+            return True
+        return any(lane.by_key.get(key) for lane in self._lanes.values())
 
     def _pop(self, stop: threading.Event) -> Optional[_Entry]:
         with self._cond:
             while True:
                 if self._shutdown or stop.is_set():
                     return None
-                if self._heap:
-                    now = time.monotonic()
-                    head = self._heap[0]
-                    if head.ready_at <= now:
+                now = time.monotonic()
+                if self._fair:
+                    self._migrate_due(now)
+                    entry = self._ready_pop()
+                    if entry is not None:
                         self._inflight += 1
-                        return heapq.heappop(self._heap)
-                    self._cond.wait(timeout=min(head.ready_at - now, 0.1))
+                        return entry
+                elif self._heap and self._heap[0].ready_at <= now:
+                    self._inflight += 1
+                    return heapq.heappop(self._heap)
+                if self._heap:
+                    self._cond.wait(
+                        timeout=min(self._heap[0].ready_at - now, 0.1)
+                    )
                 else:
                     self._cond.wait(timeout=0.1)
 
@@ -280,7 +409,7 @@ class WorkQueue:
         """Block until the queue is empty and no item is in flight."""
         deadline = time.monotonic() + timeout
         with self._cond:
-            while self._heap or self._inflight:
+            while self._heap or self._ready_count or self._inflight:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -289,4 +418,4 @@ class WorkQueue:
 
     def __len__(self) -> int:
         with self._cond:
-            return len(self._heap)
+            return len(self._heap) + self._ready_count
