@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster soak image helm-render clean
 
 all: native test
 
@@ -32,12 +32,14 @@ lockgraph-docs:
 native:
 	$(MAKE) -C native
 
+# slow-marked lanes (the chaos soak wrapper) have their own entry points
+# (`make soak`, `pytest -m slow`) — neither dev loop should pay them.
 test: native
-	python -m pytest tests/ -q
+	python -m pytest tests/ -q -m 'not slow'
 
 # The quick loop: skip the slower e2e/stress/native suites.
 test-fast:
-	python -m pytest tests/ -q \
+	python -m pytest tests/ -q -m 'not slow' \
 	  --ignore=tests/test_e2e.py \
 	  --ignore=tests/test_computedomain.py \
 	  --ignore=tests/test_native.py
@@ -112,6 +114,21 @@ bench-cluster:
 	  --nodes $(CLUSTER_NODES) \
 	  | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Chaos soak (docs/chaos.md): compound-fault long-run — apiserver latency
+# spikes + forced watch closes + kubelet restarts + SIGKILL-equivalent
+# plugin crashes at random checkpoint boundaries + torn WAL tails + GC
+# clock skew — against the cluster sim, with invariants asserted
+# CONTINUOUSLY and a JSON SLO report as the exit gate.  The short profile
+# is seeded and ≤ 120 s wall for ≥ 1 simulated hour of churn; the lock
+# witness is armed and merged at finalize.  Not tier-1 (wall-time cost);
+# `pytest -m slow` runs the same profile via tests/test_soak.py.
+SOAK_SEED ?= 42
+SOAK_REPORT ?= /tmp/tpudra_soak.json
+soak:
+	python -m tpudra.sim.chaos --profile short --seed $(SOAK_SEED) \
+	  --report $(SOAK_REPORT)
+	python tools/soak_report.py $(SOAK_REPORT) --assert-slo
 
 image:
 	docker build -f deployments/container/Dockerfile \

@@ -1,0 +1,121 @@
+"""tpudra/backoff.py — the shared capped-exponential-full-jitter policy.
+
+The distribution assertions are what make the module worth having: the
+point of full jitter is *decorrelation* (delays spread uniformly over the
+growing window, so a fleet of informers recovering from one apiserver
+flap does not relist in lockstep), and a refactor that quietly reverted
+to half-jitter or no jitter would pass any single-value test.
+"""
+
+import random
+
+import pytest
+
+from tpudra.backoff import Backoff, capped_exponential, full_jitter_delay
+
+
+class TestCappedExponential:
+    def test_growth_and_cap(self):
+        assert capped_exponential(0.2, 30.0, 0) == pytest.approx(0.2)
+        assert capped_exponential(0.2, 30.0, 1) == pytest.approx(0.4)
+        assert capped_exponential(0.2, 30.0, 4) == pytest.approx(3.2)
+        assert capped_exponential(0.2, 30.0, 8) == 30.0  # 51.2 capped
+        assert capped_exponential(0.2, 30.0, 100) == 30.0
+
+    def test_huge_attempt_does_not_overflow(self):
+        # 2**5000 would raise OverflowError on the naive float math; a
+        # retry loop that survived a week-long outage must not die of
+        # arithmetic on its next tick.
+        assert capped_exponential(0.2, 30.0, 5000) == 30.0
+
+    def test_degenerate_inputs(self):
+        assert capped_exponential(0.0, 30.0, 5) == 0.0
+        assert capped_exponential(-1.0, 30.0, 5) == 0.0
+        assert capped_exponential(0.2, 30.0, -3) == pytest.approx(0.2)
+
+
+class TestFullJitterDistribution:
+    def test_bounded_by_window(self):
+        rng = random.Random(7)
+        for attempt in range(12):
+            window = capped_exponential(0.25, 3.0, attempt)
+            for _ in range(200):
+                d = full_jitter_delay(0.25, 3.0, attempt, rng)
+                assert 0.0 <= d <= window
+
+    def test_uniform_over_window(self):
+        """Full jitter is uniform on [0, window]: mean ~ window/2 and both
+        halves of the window are populated — a half-jitter ([w/2, w]) or
+        multiplicative-jitter regression shifts the mean and empties the
+        low half."""
+        rng = random.Random(11)
+        attempt = 6  # window = min(30, 0.2 * 64) = 12.8
+        window = capped_exponential(0.2, 30.0, attempt)
+        samples = [
+            full_jitter_delay(0.2, 30.0, attempt, rng) for _ in range(4000)
+        ]
+        mean = sum(samples) / len(samples)
+        assert mean == pytest.approx(window / 2, rel=0.08)
+        low = sum(1 for s in samples if s < window / 2)
+        assert 0.4 < low / len(samples) < 0.6
+
+    def test_capped_window_still_jitters(self):
+        rng = random.Random(3)
+        samples = [full_jitter_delay(1.0, 4.0, 50, rng) for _ in range(1000)]
+        assert max(samples) <= 4.0
+        assert min(samples) < 1.0  # full jitter reaches the low end
+        assert len({round(s, 6) for s in samples}) > 100
+
+    def test_seeded_rng_reproducible(self):
+        a = [full_jitter_delay(0.2, 30.0, i, random.Random(42)) for i in range(8)]
+        b = [full_jitter_delay(0.2, 30.0, i, random.Random(42)) for i in range(8)]
+        assert a == b
+
+
+class TestBackoffState:
+    def test_next_delay_widens_and_reset_collapses(self):
+        b = Backoff(0.5, 30.0, rng=random.Random(1))
+        delays = [b.next_delay() for _ in range(8)]
+        assert all(
+            d <= capped_exponential(0.5, 30.0, i) for i, d in enumerate(delays)
+        )
+        assert b.attempt == 8
+        b.reset()
+        assert b.attempt == 0
+        assert b.next_delay() <= 0.5
+
+    def test_two_seeded_instances_decorrelate(self):
+        """Distinct rng streams (what a fleet of informers gets) must not
+        produce the same schedule — the whole reason jitter exists."""
+        a = Backoff(0.2, 30.0, rng=random.Random(100))
+        b = Backoff(0.2, 30.0, rng=random.Random(200))
+        assert [a.next_delay() for _ in range(6)] != [
+            b.next_delay() for _ in range(6)
+        ]
+
+
+class TestConsumersShareThePolicy:
+    def test_informer_uses_shared_backoff(self):
+        from tpudra.kube.informer import Informer
+
+        inf = Informer.__new__(Informer)  # no api needed for this check
+        Informer.__init__(
+            inf, api=None, gvr=None, rng=random.Random(5)
+        )
+        assert isinstance(inf._relist_backoff, Backoff)
+        assert inf._relist_backoff.base == pytest.approx(0.2)
+        assert inf._relist_backoff.cap == pytest.approx(30.0)
+        d = inf._relist_backoff.next_delay()
+        assert 0.0 <= d <= 0.2
+
+    def test_workqueue_limiter_uses_shared_window_math(self):
+        from tpudra.workqueue import ExponentialBackoff
+
+        eb = ExponentialBackoff(0.25, 3.0, rng=random.Random(9))
+        # Window math is the shared capped_exponential: 0.25, 0.5, ... 3.0.
+        delays = [eb.when("item") for _ in range(6)]
+        for i, d in enumerate(delays):
+            assert d == pytest.approx(capped_exponential(0.25, 3.0, i))
+        eb_huge = ExponentialBackoff(0.25, 3.0)
+        eb_huge._failures["x"] = 5000  # a week of failures: no overflow
+        assert eb_huge.when("x") == 3.0

@@ -1,0 +1,308 @@
+"""Chaos-soak machinery (tpudra/sim/chaos.py) at unit scale.
+
+The slow-marked end-to-end soak lives in tests/test_soak.py (and `make
+soak`); this file pins the pieces fast enough for tier-1: the in-process
+crash hook, crash-stop/restart recovery through the real checkpoint
+path, the forced watch close, the invariant monitor actually catching
+planted faults, report/SLO plumbing, and a seconds-scale mini soak.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin import checkpoint as checkpoint_mod
+from tpudra.plugin.checkpoint import SimulatedCrash
+from tpudra.sim.chaos import (
+    ChaosConfig,
+    ChaosSoak,
+    CRASH_POINTS,
+    SimClock,
+    SLOBudget,
+)
+from tpudra.sim.cluster import ClusterScaleConfig, ClusterScaleSim, make_claim
+from tools.soak_report import assert_slo, render
+
+
+class TestSimClock:
+    def test_compression(self):
+        clock = SimClock(compression=100.0)
+        time.sleep(0.05)
+        sim = clock.now_sim()
+        assert 4.0 < sim < 60.0  # ~5 sim-seconds, generous box tolerance
+        assert clock.wall_of(100.0) == pytest.approx(1.0)
+
+
+class TestArmedCrash:
+    def test_armed_point_raises_simulated_crash(self):
+        with checkpoint_mod.armed_crash("post-journal-append"):
+            with pytest.raises(SimulatedCrash) as exc:
+                checkpoint_mod._crashpoint("post-journal-append")
+            assert exc.value.point == "post-journal-append"
+
+    def test_other_points_and_other_threads_do_not_fire(self):
+        with checkpoint_mod.armed_crash("post-cdi"):
+            checkpoint_mod._crashpoint("post-mutate")  # different point: no-op
+            hits = []
+
+            def other_thread():
+                checkpoint_mod._crashpoint("post-cdi")  # unarmed thread
+                hits.append("survived")
+
+            t = threading.Thread(target=other_thread)
+            t.start()
+            t.join()
+            assert hits == ["survived"]
+
+    def test_disarmed_after_exit(self):
+        with checkpoint_mod.armed_crash("post-cdi"):
+            pass
+        checkpoint_mod._crashpoint("post-cdi")  # no-op
+
+    def test_simulated_crash_pierces_exception_barriers(self):
+        # The whole point: `except Exception` fault barriers must NOT
+        # absorb it, exactly as no handler runs under a real SIGKILL.
+        assert not isinstance(SimulatedCrash("x"), Exception)
+        assert isinstance(SimulatedCrash("x"), BaseException)
+
+
+@pytest.fixture
+def two_node_sim():
+    sim = ClusterScaleSim(
+        ClusterScaleConfig(nodes=2, chips_per_node=2, seed=3, workers=4)
+    ).start(controller=False)
+    yield sim
+    sim.close()
+
+
+class TestCrashStopRestart:
+    @pytest.mark.parametrize(
+        "point", ["post-prepare-started", "post-journal-append", "mid-compaction"]
+    )
+    def test_in_process_crash_then_restart_converges(self, two_node_sim, point):
+        """The in-process twin of the subprocess crash sweep: arm a
+        boundary, watch the prepare die there, abandon the driver with no
+        shutdown compaction, rebuild over the same dirs, and assert the
+        retry converges through the real recovery path."""
+        sim = two_node_sim
+        driver = sim.drivers[0]
+        uid = f"chaos-{point}"
+        claim = make_claim(uid, sim.node_names[0], ["tpu-0"], name=uid)
+        sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        if point == "mid-compaction":
+            driver._checkpoints._journal_max_records = 1
+        with pytest.raises(SimulatedCrash):
+            with checkpoint_mod.armed_crash(point):
+                resolved = driver.sockets.resolve_claim("default", uid, uid)
+                driver.prepare_resource_claims([resolved])
+        # The record the "kill" left behind is PrepareStarted — durable.
+        statuses = {
+            u: s for u, (_, _, s) in driver.state.prepared_claim_uids().items()
+        }
+        assert statuses.get(uid) == "PrepareStarted"
+
+        sim.crash_node(0)
+        sim.restart_node(0)
+        fresh = sim.drivers[0]
+        assert fresh is not driver
+        resp = fresh.prepare_resource_claims([claim])
+        assert resp["claims"][uid].get("devices"), resp
+        statuses = {
+            u: s for u, (_, _, s) in fresh.state.prepared_claim_uids().items()
+        }
+        assert statuses.get(uid) == "PrepareCompleted"
+        fresh.unprepare_resource_claims([{"uid": uid}])
+        assert uid not in fresh.state.prepared_claim_uids()
+
+    def test_torn_wal_tail_recovered_in_process(self, two_node_sim):
+        sim = two_node_sim
+        driver = sim.drivers[1]
+        uid = "chaos-torn"
+        claim = make_claim(uid, sim.node_names[1], ["tpu-0"], name=uid)
+        sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        with pytest.raises(SimulatedCrash):
+            with checkpoint_mod.armed_crash("post-journal-append"):
+                driver.prepare_resource_claims([claim])
+        wal = os.path.join(sim._base, "p1", "checkpoint.wal")
+        assert os.path.getsize(wal) > 0
+        with open(wal, "ab") as f:
+            f.write(b"\xff\xff\x00\x00TORN")
+        sim.crash_node(1)
+        sim.restart_node(1)
+        fresh = sim.drivers[1]
+        resp = fresh.prepare_resource_claims([claim])
+        assert resp["claims"][uid].get("devices"), resp
+        fresh.unprepare_resource_claims([{"uid": uid}])
+
+    def test_abandon_skips_shutdown_compaction(self, two_node_sim):
+        """crash_stop must leave the WAL in place (close() would compact
+        it away — and hide exactly the recovery path the soak exercises)."""
+        sim = two_node_sim
+        driver = sim.drivers[0]
+        uid = "chaos-abandon"
+        claim = make_claim(uid, sim.node_names[0], ["tpu-1"], name=uid)
+        sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        resp = driver.prepare_resource_claims([claim])
+        assert resp["claims"][uid].get("devices")
+        wal = os.path.join(sim._base, "p0", "checkpoint.wal")
+        size_before = os.path.getsize(wal)
+        assert size_before > 0
+        sim.crash_node(0)
+        assert os.path.getsize(wal) == size_before  # no compaction ran
+        sim.restart_node(0)
+        sim.drivers[0].unprepare_resource_claims([{"uid": uid}])
+
+
+class TestWatchCloseInjector:
+    def test_close_watches_forces_informer_relist(self):
+        kube = FakeKube()
+        from tpudra.kube.informer import Informer
+
+        inf = Informer(kube, gvr.RESOURCE_CLAIMS)
+        stop = threading.Event()
+        inf.start(stop)
+        try:
+            assert inf.wait_for_sync(10)
+            deadline = time.monotonic() + 5
+            while not inf.watch_healthy and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert inf.watch_healthy
+            relists_before = kube.watch_stats["forced_closes"]
+            assert kube.close_watches() >= 1
+            assert kube.watch_stats["forced_closes"] > relists_before
+            # The informer answers the in-band 410 with a relist and a
+            # fresh watch — back to healthy, no thread lost.
+            deadline = time.monotonic() + 10
+            recovered = False
+            while time.monotonic() < deadline:
+                if inf.watch_healthy:
+                    recovered = True
+                    break
+                time.sleep(0.02)
+            assert recovered
+            # And the new stream delivers events.
+            seen = []
+            inf.add_handler(lambda et, obj: seen.append(et))
+            kube.create(
+                gvr.RESOURCE_CLAIMS,
+                {"metadata": {"uid": "u", "name": "c", "namespace": "default"}},
+                "default",
+            )
+            deadline = time.monotonic() + 10
+            while not seen and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert "ADDED" in seen
+        finally:
+            stop.set()
+
+
+def _mini_config(tmp_path, **overrides) -> ChaosConfig:
+    kwargs = dict(
+        nodes=2,
+        chips_per_node=3,
+        seed=11,
+        wall_s=8.0,
+        compression=450.0,  # 8 s wall = 1 simulated hour
+        fault_mean_gap_sim_s=450.0,
+        churn_workers=2,
+        witness=False,
+        report_path=str(tmp_path / "soak.json"),
+    )
+    kwargs.update(overrides)
+    return ChaosConfig(**kwargs)
+
+
+class TestMiniSoak:
+    def test_mini_soak_clean_run_passes_slo(self, tmp_path):
+        """A seconds-scale soak: compound churn, every invariant checked,
+        zero violations, report passes the SLO gate end to end (through
+        tools/soak_report.py, the same code `make soak` gates on)."""
+        report = ChaosSoak(_mini_config(tmp_path)).run()
+        assert report["violations"] == [], report["violations"]
+        assert report["sim_hours"] >= 0.9
+        assert report["bind"]["overall"]["n"] > 50
+        for inv in ("claim-stuck", "cdi-leak", "flock-leak"):
+            assert report["invariants"][inv]["checks"] > 0
+        assert all(e["ok"] for e in report["slo"].values())
+        # The report file round-trips through the renderer and the gate.
+        with open(tmp_path / "soak.json") as f:
+            loaded = json.load(f)
+        assert "chaos soak" in render(loaded)
+        failures = assert_slo(loaded, min_sim_hours=0.9, min_faults=1)
+        # Kind coverage is a short-profile property, not a mini-run one:
+        # drop only those failures before asserting the rest are clean.
+        failures = [f for f in failures if "never injected" not in f]
+        assert failures == [], failures
+
+    def test_planted_leak_is_caught_and_replayable(self, tmp_path):
+        """Plant a CDI spec with no checkpoint record: the monitor must
+        flag it once its sim-age passes the leak grace, and the violation
+        must carry the seed + fault timeline for replay."""
+        config = _mini_config(
+            tmp_path,
+            wall_s=4.0,
+            fault_kinds=("apiserver_latency",),
+            budget=SLOBudget(leak_grace_sim_s=150.0),
+        )
+        soak = ChaosSoak(config)
+        # Plant before run(): the orphan ages from the first monitor pass.
+        cdi_dir = os.path.join(soak.sim._base, "c0")
+        os.makedirs(cdi_dir, exist_ok=True)
+        with open(os.path.join(cdi_dir, "tpu.google.com-leaked-uid.json"), "w") as f:
+            f.write("{}")
+        report = soak.run()
+        leaks = [
+            v for v in report["violations"] if v["invariant"] == "cdi-leak"
+        ]
+        assert leaks, report["invariants"]
+        assert leaks[0]["replay"]["seed"] == config.seed
+        assert "timeline" in leaks[0]["replay"]
+        assert report["slo"]["invariant_violations"]["ok"] is False
+        failures = assert_slo(report, min_sim_hours=0.0, min_faults=0)
+        assert any("invariant_violations" in f for f in failures)
+
+    def test_crash_points_cover_the_sweep_points(self):
+        assert set(CRASH_POINTS) == {
+            "post-prepare-started",
+            "post-mutate",
+            "post-cdi",
+            "post-completed",
+            "post-journal-append",
+            "mid-compaction",
+        }
+
+    def test_replay_executes_recorded_timeline(self, tmp_path):
+        """A replayed run injects exactly the recorded faults (kind by
+        kind, in order) instead of drawing fresh ones."""
+        first = ChaosSoak(
+            _mini_config(
+                tmp_path,
+                wall_s=6.0,
+                fault_kinds=("watch_close", "kubelet_restart"),
+                fault_mean_gap_sim_s=300.0,
+            )
+        ).run()
+        recorded = [
+            {k: f[k] for k in ("kind", "t_sim", "node", "point", "params")}
+            for f in first["faults"]["timeline"]
+        ]
+        assert recorded, "seed run injected no faults to replay"
+        # Replay gets wall headroom beyond the recorded span: injections
+        # execute at their recorded SIM times, and on a loaded box the
+        # last one may otherwise still be pending when the run ends.
+        replay_cfg = _mini_config(
+            tmp_path,
+            wall_s=12.0,
+            seed=first["config"]["seed"],
+            report_path=str(tmp_path / "replay.json"),
+            replay_timeline=recorded,
+        )
+        second = ChaosSoak(replay_cfg).run()
+        assert [f["kind"] for f in second["faults"]["timeline"]] == [
+            f["kind"] for f in recorded
+        ]

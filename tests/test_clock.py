@@ -1,0 +1,177 @@
+"""tpudra/clock.py — the monotonic GC-staleness discipline, and the
+stale-claim GC audited under injected wall skew.
+
+The chaos soak's ``clock_skew`` fault (sim/chaos.py) steps the wall clock
+±10 minutes mid-churn; these are the unit-level regressions that pin WHY
+that fault can't break anything: every GC staleness decision runs on
+monotonic observation time through the ``Clock`` seam, so wall skew is
+invisible to it in both directions (no premature unprepare, no
+infinitely-deferred GC).
+"""
+
+import threading
+
+import pytest
+
+from tpudra.clock import Clock, MonotonicAger, SkewedClock, SYSTEM
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin.cleanup import CheckpointCleanupManager
+
+
+class TestClockSeam:
+    def test_system_clock_tracks_time(self):
+        assert isinstance(SYSTEM, Clock)
+        a = SYSTEM.monotonic()
+        assert SYSTEM.monotonic() >= a
+        assert SYSTEM.wall() > 1.6e9  # sometime after 2020
+
+    def test_skewed_clock_offsets(self):
+        clock = SkewedClock(wall_skew_s=600.0)
+        assert clock.wall() - SYSTEM.wall() == pytest.approx(600.0, abs=1.0)
+        assert clock.monotonic() - SYSTEM.monotonic() == pytest.approx(
+            0.0, abs=1.0
+        )
+        clock.monotonic_skew_s = 42.0
+        assert clock.monotonic() - SYSTEM.monotonic() == pytest.approx(
+            42.0, abs=1.0
+        )
+
+
+class TestMonotonicAger:
+    def test_first_observation_is_age_zero(self):
+        ager = MonotonicAger(SkewedClock())
+        assert ager.age("k", ("ino", 1)) == 0.0
+
+    def test_age_grows_with_monotonic_time_only(self):
+        clock = SkewedClock()
+        ager = MonotonicAger(clock)
+        ager.age("k", "id")
+        clock.wall_skew_s = 600.0  # wall step: irrelevant
+        assert ager.age("k", "id") == pytest.approx(0.0, abs=0.5)
+        clock.monotonic_skew_s = 30.0
+        assert ager.age("k", "id") == pytest.approx(30.0, abs=0.5)
+
+    def test_identity_change_resets(self):
+        clock = SkewedClock()
+        ager = MonotonicAger(clock)
+        ager.age("k", "id-1")
+        clock.monotonic_skew_s = 30.0
+        assert ager.age("k", "id-2") == 0.0  # replaced: fresh observation
+        clock.monotonic_skew_s = 45.0
+        assert ager.age("k", "id-2") == pytest.approx(15.0, abs=0.5)
+
+    def test_forget_and_prune(self):
+        ager = MonotonicAger(SkewedClock())
+        ager.age("a", 1)
+        ager.age("b", 1)
+        ager.forget("a")
+        assert ager.tracked() == {"b"}
+        ager.age("c", 1)
+        ager.prune(["c"])
+        assert ager.tracked() == {"c"}
+
+
+class _StubState:
+    """The two DeviceState surfaces the GC touches."""
+
+    def __init__(self, claims):
+        self.claims = claims  # uid -> (ns, name, status)
+        self.unprepared = []
+
+    def prepared_claim_uids(self):
+        return dict(self.claims)
+
+    def unprepare(self, uid):
+        self.unprepared.append(uid)
+        self.claims.pop(uid, None)
+
+
+def _mk_claim(kube, uid, name, ns="default"):
+    return kube.create(
+        gvr.RESOURCE_CLAIMS,
+        {"metadata": {"uid": uid, "name": name, "namespace": ns}},
+        ns,
+    )
+
+
+class TestStaleClaimGCUnderSkew:
+    def test_live_claim_survives_ten_minute_skew_both_ways(self):
+        """±10 min wall steps during a GC pass change nothing: validity is
+        apiserver evidence and aging is monotonic."""
+        kube = FakeKube()
+        _mk_claim(kube, "u1", "c1")
+        state = _StubState({"u1": ("default", "c1", "PrepareCompleted")})
+        clock = SkewedClock()
+        mgr = CheckpointCleanupManager(kube, state, clock=clock)
+        for skew in (0.0, 600.0, -600.0):
+            clock.wall_skew_s = skew
+            assert mgr.cleanup_once() == 0
+        assert state.unprepared == []
+
+    def test_stale_claim_collected_despite_backward_skew(self):
+        """A checkpointed claim whose API object is gone is collected even
+        while the wall clock reads 10 minutes early — no deferred-forever
+        failure mode."""
+        kube = FakeKube()
+        state = _StubState({"gone": ("default", "gone", "PrepareCompleted")})
+        clock = SkewedClock(wall_skew_s=-600.0)
+        mgr = CheckpointCleanupManager(kube, state, clock=clock)
+        assert mgr.cleanup_once() == 1
+        assert state.unprepared == ["gone"]
+
+    def test_stale_grace_defers_by_monotonic_observation(self):
+        """With stale_grace > 0 the claim must be CONTINUOUSLY stale for
+        the grace on the monotonic clock; forward wall skew cannot shortcut
+        it (premature GC), and monotonic progress alone completes it."""
+        kube = FakeKube()
+        state = _StubState({"gone": ("default", "gone", "PrepareCompleted")})
+        clock = SkewedClock()
+        mgr = CheckpointCleanupManager(
+            kube, state, clock=clock, stale_grace=30.0
+        )
+        clock.wall_skew_s = 600.0  # forward step: must not count as age
+        assert mgr.cleanup_once() == 0
+        assert state.unprepared == []
+        clock.monotonic_skew_s = 31.0  # genuinely watched past the grace
+        assert mgr.cleanup_once() == 1
+        assert state.unprepared == ["gone"]
+
+    def test_claim_turning_valid_resets_the_grace(self):
+        """Stale → valid → stale again restarts the observation: a claim
+        that was only transiently unresolvable is never torn down on
+        stitched-together observations."""
+        kube = FakeKube()
+        state = _StubState({"u2": ("default", "c2", "PrepareCompleted")})
+        clock = SkewedClock()
+        mgr = CheckpointCleanupManager(
+            kube, state, clock=clock, stale_grace=30.0
+        )
+        assert mgr.cleanup_once() == 0  # stale (no API object): obs starts
+        clock.monotonic_skew_s = 20.0
+        _mk_claim(kube, "u2", "c2")  # reappears: valid again
+        assert mgr.cleanup_once() == 0
+        kube.delete(gvr.RESOURCE_CLAIMS, "c2", "default")
+        clock.monotonic_skew_s = 45.0  # 25s since re-stale < 30s grace...
+        assert mgr.cleanup_once() == 0
+        clock.monotonic_skew_s = 80.0
+        assert mgr.cleanup_once() == 1
+
+    def test_cleanup_runs_in_thread_with_clock_seam(self):
+        """The periodic loop still works end to end with an injected clock
+        (smoke: the seam does not disturb the thread plumbing)."""
+        kube = FakeKube()
+        state = _StubState({"gone": ("default", "gone", "PrepareCompleted")})
+        mgr = CheckpointCleanupManager(
+            kube, state, period=0.05, clock=SkewedClock()
+        )
+        stop = threading.Event()
+        mgr.start(stop)
+        try:
+            deadline = 100
+            while state.claims and deadline:
+                threading.Event().wait(0.05)
+                deadline -= 1
+            assert state.unprepared == ["gone"]
+        finally:
+            stop.set()

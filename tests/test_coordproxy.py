@@ -175,18 +175,63 @@ class TestProxy:
         """A registration younger than registration_grace must survive any
         number of failed probes: host 0 registers BEFORE
         jax.distributed.initialize binds the listener, and it registers
-        exactly once — a drop in that startup window would kill the job."""
+        exactly once — a drop in that startup window would kill the job.
+        Age is the daemon's own continuous MONOTONIC observation of the
+        file (clock.MonotonicAger), so it is advanced here by skewing the
+        injected clock's monotonic reading, not by backdating mtime —
+        which the next test proves is exactly what must NOT age it."""
+        from tpudra.clock import SkewedClock
+
+        clock = SkewedClock()
         write_registration(str(tmp_path), "127.0.0.1", 1)
         proxy = CoordinatorProxy(
             0, str(tmp_path), host="127.0.0.1", drop_after=2,
-            min_fail_window=0, registration_grace=60,
+            min_fail_window=0, registration_grace=60, clock=clock,
         )
         for _ in range(5):
             proxy._note_connect_failure(("127.0.0.1", 1))
         assert read_registration(str(tmp_path)) == ("127.0.0.1", 1)
-        # Backdate the file past the grace: now the same probes drop it.
+        # Age the OBSERVATION past the grace: now the same probes drop it.
+        clock.monotonic_skew_s += 120
+        for _ in range(2):
+            proxy._note_connect_failure(("127.0.0.1", 1))
+        assert read_registration(str(tmp_path)) is None
+
+    def test_wall_clock_skew_cannot_age_or_rejuvenate_a_registration(
+        self, tmp_path
+    ):
+        """±10 min wall-clock steps (NTP correction, VM migration) must not
+        change drop decisions in either direction:
+
+        - forward skew (or a backdated mtime) must NOT make a just-written
+          registration look aged-out — the old ``wall_now - mtime`` math
+          dropped a live coordinator here, which is fatal to the job;
+        - backward skew (mtime "in the future") must NOT defer the drop of
+          a genuinely dead registration forever — the old math made its
+          age negative and write_registration's 180 s replace-wait starve.
+        """
+        from tpudra.clock import SkewedClock
+
+        clock = SkewedClock()
+        write_registration(str(tmp_path), "127.0.0.1", 1)
         reg = tmp_path / "coordinator"
-        os.utime(reg, (os.stat(reg).st_atime, os.stat(reg).st_mtime - 120))
+        # A backdated mtime (equivalently: wall jumped forward 10 min)
+        # must not count as age — only watched monotonic time does.
+        os.utime(reg, (os.stat(reg).st_atime, os.stat(reg).st_mtime - 600))
+        proxy = CoordinatorProxy(
+            0, str(tmp_path), host="127.0.0.1", drop_after=2,
+            min_fail_window=0, registration_grace=60, clock=clock,
+        )
+        clock.wall_skew_s = 600.0
+        for _ in range(5):
+            proxy._note_connect_failure(("127.0.0.1", 1))
+        assert read_registration(str(tmp_path)) == ("127.0.0.1", 1)
+
+        # Backward skew: wall now reads 10 min early (mtime looks to be in
+        # the future).  Once the daemon has WATCHED the registration past
+        # the grace, the drop proceeds regardless.
+        clock.wall_skew_s = -600.0
+        clock.monotonic_skew_s += 120
         for _ in range(2):
             proxy._note_connect_failure(("127.0.0.1", 1))
         assert read_registration(str(tmp_path)) is None

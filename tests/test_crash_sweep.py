@@ -227,6 +227,101 @@ def test_sigkill_at_checkpoint_boundary_converges(short_tmp, point, kind):
             h.terminate()
 
 
+def test_mid_compaction_sigkill_with_kubelet_restart_in_flight(short_tmp):
+    """Composed crash (the chaos-soak scenario, proven at process level):
+    SIGKILL lands at ``mid-compaction`` — snapshot replaced, journal not
+    yet truncated — while the kubelet is itself RESTARTING: the kubelet
+    that issued the dying prepare never hears the answer, and its
+    replacement starts a blind retry storm BEFORE the plugin is back,
+    re-preparing the in-flight claim and a second claim from another pod
+    it rediscovered.  Both must converge: the stale journal records
+    replay idempotently over the new snapshot, the retried claim comes
+    out granted, the concurrent fresh claim binds beside it, and the
+    teardown leaves nothing."""
+    import threading
+
+    uid_a, uid_b = "crash-composed-a", "crash-composed-b"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        h = Harness(short_tmp, server)
+        h.start(crashpoint="mid-compaction")
+        try:
+            claim_a = chip_claim(uid_a)
+            claim_b = chip_claim(uid_b)
+            claim_b["status"]["allocation"]["devices"]["results"][0][
+                "device"
+            ] = "tpu-2"
+            client.create(gvr.RESOURCE_CLAIMS, claim_a, "default")
+            client.create(gvr.RESOURCE_CLAIMS, claim_b, "default")
+            dra = h.dra()
+            try:
+                try:
+                    dra.prepare([claim_a])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            # The mid-compaction signature: snapshot carries the claim,
+            # journal still holds the stale (now idempotent) records.
+            assert h.snapshot_statuses().get(uid_a) == "PrepareStarted"
+            assert h.journal_size() > 0
+
+            # The RESTARTED kubelet starts retrying while the plugin is
+            # still down — a loop of failing RPCs that must seamlessly
+            # turn into a grant once the plugin is back.
+            results: dict[str, dict] = {}
+
+            def kubelet_retry(claim, uid):
+                deadline = 60
+                while deadline:
+                    deadline -= 1
+                    cli = h.dra()
+                    try:
+                        resp = cli.prepare([claim])
+                        entry = resp["claims"].get(uid, {})
+                        if entry.get("devices"):
+                            results[uid] = entry
+                            return
+                    except RPCError:
+                        pass  # plugin still down (or mid-restart)
+                    finally:
+                        cli.close()
+                    threading.Event().wait(0.5)
+
+            retriers = [
+                threading.Thread(target=kubelet_retry, args=(claim_a, uid_a)),
+                threading.Thread(target=kubelet_retry, args=(claim_b, uid_b)),
+            ]
+            for t in retriers:
+                t.start()
+            threading.Event().wait(1.0)  # retries genuinely in flight first
+            h.start()  # plugin restart races the retry storm
+            for t in retriers:
+                t.join(timeout=60)
+            assert results.get(uid_a, {}).get("devices"), (results, h.log()[-2000:])
+            assert results.get(uid_b, {}).get("devices"), (results, h.log()[-2000:])
+            statuses = h.claim_statuses()
+            assert statuses.get(uid_a) == "PrepareCompleted"
+            assert statuses.get(uid_b) == "PrepareCompleted"
+            assert len([f for f in h.cdi_files() if uid_a in f]) == 1
+            assert len([f for f in h.cdi_files() if uid_b in f]) == 1
+
+            dra = h.dra()
+            try:
+                dra.unprepare([claim_a, claim_b])
+            finally:
+                dra.close()
+            assert uid_a not in h.claim_statuses()
+            assert uid_b not in h.claim_statuses()
+            assert not any(
+                uid_a in f or uid_b in f for f in h.cdi_files()
+            )
+        finally:
+            h.terminate()
+
+
 def test_torn_journal_tail_truncated_on_recovery(short_tmp):
     """A half-written journal record (power cut mid-append) must be
     dropped at replay — loudly — and the restarted plugin must converge to

@@ -186,6 +186,95 @@ def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point):
             h.terminate()
 
 
+def test_cd_mid_compaction_sigkill_with_kubelet_restart_in_flight(short_tmp):
+    """Composed crash, CD twin of the TPU sweep's scenario: SIGKILL at
+    ``mid-compaction`` (snapshot replaced, journal not truncated) while a
+    RESTARTED kubelet is already blind-retrying — the dying channel claim
+    plus a second channel it rediscovered.  Both must converge through
+    the idempotent journal replay + add_node_label path, and the teardown
+    of both must clear the label, specs, and checkpoint."""
+    import threading
+
+    uid_a, uid_b = "cd-crash-composed-a", "cd-crash-composed-b"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        seed_cluster(client)
+        h = CDHarness(short_tmp, server)
+        h.start(crashpoint="mid-compaction")
+        try:
+            claim_a = channel_claim(uid_a)
+            claim_b = channel_claim(uid_b)
+            claim_b["status"]["allocation"]["devices"]["results"][0][
+                "device"
+            ] = "channel-9"
+            client.create(gvr.RESOURCE_CLAIMS, claim_a, "default")
+            client.create(gvr.RESOURCE_CLAIMS, claim_b, "default")
+            dra = h.dra()
+            try:
+                try:
+                    dra.prepare([claim_a])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            assert h.snapshot_statuses().get(uid_a) == "PrepareStarted"
+            assert h.journal_size() > 0
+            # Started-only state: the label side effect never ran.
+            assert node_label(client) is None
+
+            results: dict[str, dict] = {}
+
+            def kubelet_retry(claim, uid):
+                deadline = 60
+                while deadline:
+                    deadline -= 1
+                    cli = h.dra()
+                    try:
+                        resp = cli.prepare([claim])
+                        entry = resp["claims"].get(uid, {})
+                        if entry.get("devices"):
+                            results[uid] = entry
+                            return
+                    except RPCError:
+                        pass  # plugin still down (or mid-restart)
+                    finally:
+                        cli.close()
+                    threading.Event().wait(0.5)
+
+            retriers = [
+                threading.Thread(target=kubelet_retry, args=(claim_a, uid_a)),
+                threading.Thread(target=kubelet_retry, args=(claim_b, uid_b)),
+            ]
+            for t in retriers:
+                t.start()
+            threading.Event().wait(1.0)  # retries in flight before restart
+            h.start()
+            for t in retriers:
+                t.join(timeout=60)
+            assert results.get(uid_a, {}).get("devices"), (results, h.log()[-2000:])
+            assert results.get(uid_b, {}).get("devices"), (results, h.log()[-2000:])
+            statuses = h.claim_statuses()
+            assert statuses.get(uid_a) == "PrepareCompleted"
+            assert statuses.get(uid_b) == "PrepareCompleted"
+            assert node_label(client) == CD_UID
+
+            dra = h.dra()
+            try:
+                dra.unprepare([claim_a, claim_b])
+            finally:
+                dra.close()
+            assert uid_a not in h.claim_statuses()
+            assert uid_b not in h.claim_statuses()
+            assert node_label(client) is None
+            assert not any(
+                uid_a in f or uid_b in f for f in h.cdi_files()
+            )
+        finally:
+            h.terminate()
+
+
 def test_cd_torn_journal_tail_truncated_on_recovery(short_tmp):
     """CD-plugin twin of the TPU torn-tail sweep (runs without the native
     build): a half-written WAL record after a SIGKILL is dropped loudly and

@@ -1,0 +1,119 @@
+"""Ambient apiserver deadlines (tpudra/kube/deadline.py).
+
+The hardening the chaos soak's ``apiserver_latency`` fault forces: a
+latency spike may consume a caller's budget but never exceed it — the
+verb fails fast with the typed 504 instead of wedging a bind past its
+gRPC deadline.
+"""
+
+import time
+
+import pytest
+
+from tpudra.kube import deadline, errors, gvr
+from tpudra.kube.deadline import api_deadline
+from tpudra.kube.fake import FakeKube
+
+
+def _claim(uid="u1", name="c1"):
+    return {"metadata": {"uid": uid, "name": name, "namespace": "default"}}
+
+
+class TestDeadlineContext:
+    def test_no_ambient_deadline_by_default(self):
+        assert deadline.remaining() is None
+        deadline.check()  # no-op
+        assert deadline.clamp(30.0) == 30.0
+
+    def test_remaining_counts_down(self):
+        with api_deadline(5.0):
+            rem = deadline.remaining()
+            assert rem is not None and 4.5 < rem <= 5.0
+        assert deadline.remaining() is None
+
+    def test_nesting_only_tightens(self):
+        with api_deadline(10.0):
+            with api_deadline(60.0):  # may not outlive the outer budget
+                assert deadline.remaining() <= 10.0
+            with api_deadline(1.0):
+                assert deadline.remaining() <= 1.0
+            assert 9.0 < deadline.remaining() <= 10.0
+
+    def test_clamp_and_check_raise_when_spent(self):
+        with api_deadline(-1.0):  # already expired
+            with pytest.raises(errors.Timeout):
+                deadline.check("get")
+            with pytest.raises(errors.Timeout):
+                deadline.clamp(30.0)
+
+    def test_clamp_bounds_socket_timeout(self):
+        with api_deadline(2.0):
+            assert deadline.clamp(30.0) <= 2.0
+            assert deadline.clamp(0.5) == 0.5
+
+
+class TestFakeKubeHonorsDeadline:
+    def test_latency_within_budget_just_sleeps(self):
+        kube = FakeKube()
+        kube.create(gvr.RESOURCE_CLAIMS, _claim(), "default")
+        kube.set_latency(0.05)
+        with api_deadline(5.0):
+            assert kube.get(gvr.RESOURCE_CLAIMS, "c1", "default")
+
+    def test_latency_spike_fails_at_the_deadline_not_after(self):
+        """RTT 5 s against a 0.2 s budget: the verb must fail in ~0.2 s
+        with the typed 504 — this is the wedge the deadline exists to
+        remove (a bind's fallback GET during an apiserver latency spike)."""
+        kube = FakeKube()
+        kube.create(gvr.RESOURCE_CLAIMS, _claim(), "default")
+        kube.set_latency(5.0)
+        t0 = time.monotonic()
+        with api_deadline(0.2):
+            with pytest.raises(errors.Timeout):
+                kube.get(gvr.RESOURCE_CLAIMS, "c1", "default")
+        assert time.monotonic() - t0 < 1.0
+
+    def test_expired_budget_fails_without_sleeping(self):
+        kube = FakeKube()
+        kube.create(gvr.RESOURCE_CLAIMS, _claim(), "default")
+        t0 = time.monotonic()
+        with api_deadline(-1.0):
+            with pytest.raises(errors.Timeout):
+                kube.list(gvr.RESOURCE_CLAIMS, "default")
+        assert time.monotonic() - t0 < 0.5
+
+    def test_no_deadline_keeps_legacy_latency_behavior(self):
+        kube = FakeKube()
+        kube.create(gvr.RESOURCE_CLAIMS, _claim(), "default")
+        kube.set_latency(0.1)
+        t0 = time.monotonic()
+        assert kube.get(gvr.RESOURCE_CLAIMS, "c1", "default")
+        assert time.monotonic() - t0 >= 0.1
+
+    def test_timeout_is_retryable_shape(self):
+        """The 504 carries apimachinery's Timeout reason so callers (and
+        the informer's error classifier) treat it as transient."""
+        err = errors.Timeout("x")
+        assert err.code == 504
+        assert err.to_status()["reason"] == "Timeout"
+        assert isinstance(
+            errors.from_status(err.to_status(), 504), errors.Timeout
+        )
+
+
+class TestResolverUnderDeadline:
+    def test_fallback_get_fails_fast_under_latency_spike(self):
+        """The direct-GET resolver arm (what every cache fallback runs)
+        inherits the ambient RPC budget instead of blocking for the full
+        injected RTT."""
+        from tpudra.plugin.grpcserver import kube_claim_resolver
+
+        kube = FakeKube()
+        kube.create(gvr.RESOURCE_CLAIMS, _claim(), "default")
+        resolve = kube_claim_resolver(kube)
+        kube.set_latency(5.0)
+        t0 = time.monotonic()
+        with api_deadline(0.2):
+            with pytest.raises(errors.Timeout):
+                resolve("default", "c1", "u1")
+        assert time.monotonic() - t0 < 1.0

@@ -1,0 +1,58 @@
+"""The full chaos soak as a slow-marked pytest lane: `pytest -m slow`.
+
+Runs the same seeded short profile as `make soak` — in a SUBPROCESS, like
+the crash sweeps, because the soak arms the process-wide lock witness and
+a pytest worker must not inherit that env.  Excluded from tier-1 by the
+marker (`-m 'not slow'`); the acceptance bar is the module's own SLO gate
+(tools/soak_report.py --assert-slo).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_short_profile_soak_passes_slo_gate(tmp_path):
+    report_path = tmp_path / "soak.json"
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        JAX_PLATFORMS="cpu",
+    )
+    run = subprocess.run(
+        [
+            sys.executable, "-m", "tpudra.sim.chaos",
+            "--profile", "short", "--seed", "42",
+            "--report", str(report_path),
+        ],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert run.returncode == 0, f"soak failed:\n{run.stdout}\n{run.stderr}"
+    gate = subprocess.run(
+        [
+            sys.executable, "tools/soak_report.py", str(report_path),
+            "--assert-slo",
+        ],
+        env=env, capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert gate.returncode == 0, f"SLO gate failed:\n{gate.stdout}\n{gate.stderr}"
+
+    with open(report_path) as f:
+        report = json.load(f)
+    # The acceptance criteria, restated where a human will read them:
+    # ≥ 1 simulated hour of compound churn, zero invariant violations,
+    # bind p99 inside budget, every fault kind injected, witness merged.
+    assert report["sim_hours"] >= 1.0
+    assert report["violations"] == []
+    assert report["slo"]["bind_p99_ms"]["ok"]
+    assert set(report["config"]["fault_kinds"]) == set(
+        report["faults"]["by_kind"]
+    )
+    assert report["invariants"]["lock-witness"]["checks"] == 1
+    assert report["invariants"]["lock-witness"]["violations"] == 0

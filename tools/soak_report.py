@@ -1,0 +1,161 @@
+#!/usr/bin/env python3
+"""Render a chaos-soak JSON report; ``--assert-slo`` is the make-soak gate.
+
+Reads the report ``tpudra.sim.chaos`` writes and prints the human view:
+fault timeline with recovery times, per-window bind latency, invariant
+check/violation counts, and the SLO verdict.  With ``--assert-slo`` the
+exit code is the gate (0 = every budget met), checking:
+
+- zero invariant violations;
+- bind p99 within budget and max claim-stuck < T (the report's own
+  ``slo`` section);
+- the run actually covered ground: ≥ ``--min-sim-hours`` of simulated
+  churn, at least ``--min-faults`` faults with every enabled kind
+  injected at least once, and a nonzero check count for every
+  continuously-monitored invariant (a soak that never checked anything
+  passes no SLO);
+- when the lock witness was armed, its merge ran.
+
+Violations embed their seed + fault timeline; re-run with
+``python -m tpudra.sim.chaos --replay <report.json>`` to reproduce.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: Invariants the monitor must have evaluated at least once per run
+#: (lock-witness is only required when the report says it was armed;
+#: slice-convergence only asserts in quiet windows, so a fault-saturated
+#: short run may legitimately end with zero passes).
+REQUIRED_CHECKED = ("claim-stuck", "cdi-leak", "flock-leak")
+
+
+def render(report: dict) -> str:
+    cfg = report["config"]
+    lines = [
+        f"chaos soak — seed {cfg['seed']}, {cfg['nodes']} nodes × "
+        f"{cfg['chips_per_node']} chips, {cfg['wall_s']:.0f}s wall × "
+        f"{cfg['compression']:.0f}x = {report['sim_hours']:.2f} simulated hours",
+        "",
+        f"faults injected: {report['faults']['injected_total']}",
+    ]
+    for kind, n in sorted(report["faults"]["by_kind"].items()):
+        lines.append(f"  {kind:<20} {n}")
+    lines.append("")
+    lines.append("bind latency by fault window (ms):")
+    lines.append(f"  {'window':<40} {'n':>6} {'p50':>9} {'p99':>9} {'max':>9}")
+    windows = dict(report["bind"]["by_window"])
+    for tag in sorted(windows, key=lambda t: (t != "quiet", t)):
+        s = windows[tag]
+        lines.append(
+            f"  {tag:<40} {s['n']:>6} {s['p50_ms']:>9.2f} "
+            f"{s['p99_ms']:>9.2f} {s['max_ms']:>9.2f}"
+        )
+    errs = report["bind"]["errors"]
+    lines.append(
+        f"  bind errors: {errs['total']} "
+        f"({', '.join(f'{k}={v}' for k, v in sorted(errs['by_window'].items())) or 'none'})"
+    )
+    lines.append("")
+    lines.append("invariants (continuous checks):")
+    for inv, counts in sorted(report["invariants"].items()):
+        flag = "OK " if counts["violations"] == 0 else "FAIL"
+        lines.append(
+            f"  [{flag}] {inv:<20} checks={counts['checks']:<6} "
+            f"violations={counts['violations']}"
+        )
+    rec = report["recovery"]
+    lines.append("")
+    lines.append(
+        f"recovery: {len(rec['samples_sim_s'])} fault recoveries, max "
+        f"{rec['max_sim_s']:.0f} sim-s (budget {rec['budget_sim_s']:.0f})"
+    )
+    if report.get("anomalies"):
+        lines.append("")
+        lines.append("anomalies (non-failing):")
+        for a in report["anomalies"]:
+            lines.append(f"  - {a}")
+    lines.append("")
+    lines.append("SLO:")
+    for name, entry in sorted(report["slo"].items()):
+        flag = "OK " if entry["ok"] else "FAIL"
+        lines.append(
+            f"  [{flag}] {name:<24} value={entry['value']} "
+            f"budget={entry['budget']}"
+        )
+    for v in report.get("violations", []):
+        lines.append("")
+        lines.append(
+            f"VIOLATION [{v['invariant']}] at t_sim={v['t_sim']}: "
+            f"{v['detail']}"
+        )
+        lines.append(
+            f"  replay: python -m tpudra.sim.chaos --replay <this report> "
+            f"(seed {v['replay']['seed']}, "
+            f"{len(v['replay']['timeline'])} fault(s) in timeline)"
+        )
+    return "\n".join(lines)
+
+
+def assert_slo(
+    report: dict, min_sim_hours: float, min_faults: int
+) -> list[str]:
+    """Every reason the report fails the gate (empty = pass)."""
+    failures = []
+    for name, entry in report["slo"].items():
+        if not entry["ok"]:
+            failures.append(
+                f"SLO {name}: value {entry['value']} vs budget {entry['budget']}"
+            )
+    if report["sim_hours"] < min_sim_hours:
+        failures.append(
+            f"covered only {report['sim_hours']:.2f} simulated hours "
+            f"(need ≥ {min_sim_hours})"
+        )
+    if report["faults"]["injected_total"] < min_faults:
+        failures.append(
+            f"only {report['faults']['injected_total']} faults injected "
+            f"(need ≥ {min_faults})"
+        )
+    for kind in report["config"]["fault_kinds"]:
+        if report["faults"]["by_kind"].get(kind, 0) < 1:
+            failures.append(f"fault kind {kind!r} was never injected")
+    for inv in REQUIRED_CHECKED:
+        if report["invariants"].get(inv, {}).get("checks", 0) < 1:
+            failures.append(f"invariant {inv!r} was never checked")
+    if report["config"].get("witness") and (
+        report["invariants"].get("lock-witness", {}).get("checks", 0) < 1
+    ):
+        failures.append("witness was armed but the merge never ran")
+    if report["bind"]["overall"]["n"] < 1:
+        failures.append("no successful binds recorded — the churn never ran")
+    return failures
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("report", help="path to the soak's JSON report")
+    parser.add_argument("--assert-slo", action="store_true")
+    parser.add_argument("--min-sim-hours", type=float, default=1.0)
+    parser.add_argument("--min-faults", type=int, default=8)
+    args = parser.parse_args(argv)
+    with open(args.report) as f:
+        report = json.load(f)
+    print(render(report))
+    if not args.assert_slo:
+        return 0
+    failures = assert_slo(report, args.min_sim_hours, args.min_faults)
+    if failures:
+        print("\nSLO GATE: FAILED", file=sys.stderr)
+        for reason in failures:
+            print(f"  - {reason}", file=sys.stderr)
+        return 1
+    print("\nSLO GATE: PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

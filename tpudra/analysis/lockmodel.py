@@ -69,6 +69,17 @@ _LEXICAL_CATEGORIES = frozenset({"time.sleep", "subprocess", "open()", "gRPC stu
 #: The lock IDs that make up the claim-bind path — the witness coverage
 #: criterion (docs/static-analysis.md) is computed over edges whose both
 #: endpoints are in this set.
+#: The request-accounting wrapper's counter mutex (kube/accounting.py).
+#: Every ``KubeAPI`` verb may run through ``AccountingKube`` — the
+#: standard wrapper in the binaries and every harness — which takes this
+#: lock inside the verb.  The call graph cannot see that dispatch (the
+#: verb resolves to the ``KubeAPI`` protocol, not to a concrete class),
+#: so the walker models it: an apiserver-verb call under held locks
+#: contributes ``held → accounting.counts_lock`` edges.  Without this the
+#: runtime witness reports a model gap the first time a soak publishes
+#: slices (publish_lock held) through an accounted fake.
+ACCOUNTING_COUNTS_LOCK = "accounting.counts_lock"
+
 BIND_PATH_LOCKS = frozenset(
     {
         "flock:pu.lock",
@@ -803,8 +814,21 @@ class LockModel:
                     elif isinstance(ev, WithCMEv):
                         self._merge_star(out, ev.fn)
                         visit(ev.body)
-                    elif isinstance(ev, CallEv) and ev.fn is not None:
-                        self._merge_star(out, ev.fn)
+                    elif isinstance(ev, CallEv):
+                        if ev.blocking.startswith("apiserver"):
+                            # Protocol dispatch the call graph cannot see:
+                            # the verb may run through AccountingKube,
+                            # which takes its counter mutex inside the
+                            # call (ACCOUNTING_COUNTS_LOCK).
+                            out.setdefault(
+                                ACCOUNTING_COUNTS_LOCK,
+                                (
+                                    self._ref_for_id(ACCOUNTING_COUNTS_LOCK),
+                                    _label(fn),
+                                ),
+                            )
+                        if ev.fn is not None:
+                            self._merge_star(out, ev.fn)
 
             visit(self.ir(fn))
             ann = self.acquires_ann.get(fn.qualname)
@@ -1063,6 +1087,17 @@ class LockGraphAnalysis:
                     continue
                 if ev.blocking:
                     self._on_direct_blocking(fn, ev, current(), lex_depth)
+                    if ev.blocking.startswith("apiserver") and current():
+                        # Protocol dispatch the graph can't resolve: the
+                        # verb may run through AccountingKube, which takes
+                        # its counter mutex inside the call (see
+                        # ACCOUNTING_COUNTS_LOCK).
+                        counts = self.model._ref_for_id(ACCOUNTING_COUNTS_LOCK)
+                        for h in current():
+                            self._add_edge(
+                                h, counts, fn.path, ev.node,
+                                f"{_label(fn)} → AccountingKube._count",
+                            )
                 if ev.fn is not None:
                     # A blocking-terminal callee (kube verb, Flock.acquire)
                     # was already reported whole; don't descend for more.

@@ -1,0 +1,93 @@
+"""Capped exponential backoff with full jitter — the one retry-delay policy.
+
+Before this module, every retry loop in the tree rolled its own delay
+math: the informer relist doubled a float with half-jitter, the workqueue
+limiter multiplied an optional jitter factor, the slice publisher slept a
+flat second.  The math differences are mostly harmless; the *jitter*
+differences are not.  At cluster scale an apiserver flap puts hundreds of
+informers into their failure loops within milliseconds of each other, and
+any deterministic (or narrowly-jittered) schedule marches them back in
+lockstep — the relist storm arrives as one synchronized wave exactly when
+the apiserver is weakest.  "Full jitter" (delay drawn uniformly from
+``[0, min(cap, base·2ⁿ)]``) decorrelates the herd: the retry *budget*
+still grows exponentially, but each client lands at an independent point
+in the window, so the recovering server sees a flat trickle instead of
+spikes (the AWS architecture-blog result; client-go's reflector jitters
+for the same reason).
+
+Two layers:
+
+- :func:`capped_exponential` / :func:`full_jitter_delay` — pure delay
+  arithmetic, shared with the workqueue's :class:`ExponentialBackoff`
+  (which keeps its own per-item failure bookkeeping and its historical
+  multiplicative-jitter contract).
+- :class:`Backoff` — a stateful helper for the common loop shape
+  (informer relist, publisher retry): ``next_delay()`` grows the window,
+  ``reset()`` collapses it after a success.
+
+``rng`` is injectable everywhere (``random.Random(seed)``) so the chaos
+soak and the cluster-scale bench replay identical schedules from a seed;
+the default is the module-global generator.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+def capped_exponential(base: float, cap: float, attempt: int) -> float:
+    """``min(cap, base * 2**attempt)`` without overflow: the exponent is
+    clamped so attempt counts from a long outage cannot overflow a float
+    (2**1024 raises OverflowError; a retry loop must never die of
+    arithmetic)."""
+    if base <= 0:
+        return 0.0
+    if attempt > 62:  # base * 2**62 already dwarfs any sane cap
+        return cap
+    return min(cap, base * (2.0 ** max(0, attempt)))
+
+
+def full_jitter_delay(
+    base: float,
+    cap: float,
+    attempt: int,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """One full-jitter delay: uniform over ``[0, capped_exponential(...)]``."""
+    window = capped_exponential(base, cap, attempt)
+    return (rng if rng is not None else random).uniform(0.0, window)
+
+
+class Backoff:
+    """Stateful capped-exponential-with-full-jitter for one retry loop.
+
+    Not thread-safe by design: each loop (an informer's run thread, the
+    publisher thread) owns its own instance, the way each owns its own
+    failure count today.  Share across threads and the worst case is a
+    sloppy attempt counter, but don't."""
+
+    def __init__(
+        self,
+        base: float,
+        cap: float,
+        rng: Optional[random.Random] = None,
+    ):
+        self.base = base
+        self.cap = cap
+        self._rng = rng if rng is not None else random
+        self._attempt = 0
+
+    @property
+    def attempt(self) -> int:
+        return self._attempt
+
+    def next_delay(self) -> float:
+        """The delay before the next retry; each call widens the window."""
+        delay = full_jitter_delay(self.base, self.cap, self._attempt, self._rng)
+        self._attempt += 1
+        return delay
+
+    def reset(self) -> None:
+        """Collapse the window after a success."""
+        self._attempt = 0

@@ -37,7 +37,14 @@ listener, and never rewritten — a false drop is fatal to the job):
 
 - a registration younger than ``registration_grace`` seconds is never
   dropped (host 0's bind follows its registration within the same process;
-  refusals in that window are startup, not death);
+  refusals in that window are startup, not death).  Age is measured by how
+  long THIS daemon has continuously observed the same file identity
+  (inode + mtime_ns) on the MONOTONIC clock (tpudra/clock.py
+  ``MonotonicAger``), never by ``wall_now - mtime``: a wall-clock step
+  (NTP correction, VM migration — the chaos soak's ``clock_skew`` fault)
+  would otherwise make a just-written registration look aged-out
+  (premature drop, fatal to the job) or a long-dead one look eternally
+  young (drop deferred past the replacement's ``replace_wait_s``);
 - the failure streak must *span* ``min_fail_window`` seconds, so N
   simultaneous in-flight connects failing on one network blip don't count
   as N probes;
@@ -58,6 +65,8 @@ import socket
 import threading
 import time
 from typing import Optional
+
+from tpudra.clock import Clock, MonotonicAger, SYSTEM
 
 logger = logging.getLogger(__name__)
 
@@ -148,6 +157,7 @@ class CoordinatorProxy:
         min_fail_window: float = 5.0,
         registration_grace: float = 10.0,
         unreachable_window: float = 120.0,
+        clock: Optional[Clock] = None,
     ):
         self.port = port
         self._dir = registration_dir
@@ -172,6 +182,12 @@ class CoordinatorProxy:
         self._fail_count = 0  # all consecutive failures
         self._fail_refused = 0  # the refused-class subset
         self._fail_first_ts = 0.0
+        self._clock = clock if clock is not None else SYSTEM
+        # Registration age = continuous monotonic observation of one file
+        # identity (module docstring "Guard rails"); fed on every connect
+        # failure so the age accrues across the failure streak and the
+        # grace check at drop time sees the streak's whole span.
+        self._reg_ager = MonotonicAger(self._clock)
 
     @property
     def bound_port(self) -> int:
@@ -324,7 +340,13 @@ class CoordinatorProxy:
         span ``unreachable_window`` first.  A partition that heals resets
         the streak on the next successful forward, so only an endpoint
         that stays dark for the whole long window is dropped."""
-        now = time.monotonic()
+        # Observe the registration file on every failure so its monotonic
+        # age accrues across the streak: by the time the streak spans the
+        # drop window, the observation spans it too, and the grace check
+        # in _drop_registration compares real watched time (stat happens
+        # out here — no IO under the in-process fail lock).
+        self._registration_age(os.path.join(self._dir, REGISTRATION_FILE))
+        now = self._clock.monotonic()
         with self._fail_lock:
             if self._fail_target != target:
                 self._fail_target = target
@@ -351,17 +373,38 @@ class CoordinatorProxy:
             self._fail_refused = 0
         self._drop_registration(target)
 
+    def _registration_age(self, path: str) -> Optional[float]:
+        """How long this daemon has continuously observed the registration
+        at ``path`` with an unchanged (inode, mtime_ns) identity, on the
+        monotonic clock — None when the file is absent.  A rewrite or
+        replacement changes the identity and restarts the age at 0; a
+        wall-clock step changes nothing (the skew-immunity the module
+        docstring's grace guard rail promises).
+
+        An absent file does NOT forget the observation: the canonical
+        path is legitimately missing for the instant a concurrent
+        ``_drop_registration`` holds it renamed aside, and a forget here
+        would reset the aged observation mid-drop — deferring the drop of
+        a genuinely dead registration by a fresh grace every burst.  A
+        *replacement* file re-ages naturally through its new
+        (inode, mtime_ns) identity."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return self._reg_ager.age("registration", (st.st_ino, st.st_mtime_ns))
+
     def _drop_registration(self, target: tuple[str, int]) -> None:
         """Remove the registration iff it is the probed endpoint's own,
         aged-out file.  Rename-aside first: a replacement's ``os.replace``
         landing mid-drop creates a fresh file at the canonical path that
         this never touches — no unlink-the-new-registration race."""
         path = os.path.join(self._dir, REGISTRATION_FILE)
-        try:
-            if time.time() - os.stat(path).st_mtime < self._registration_grace:
-                return  # young registration: startup window, never drop
-        except OSError:
+        age = self._registration_age(path)
+        if age is None:
             return  # already gone
+        if age < self._registration_grace:
+            return  # young (or not-yet-watched) registration: never drop
         probe = f"{path}.probe.{os.getpid()}"
         try:
             os.rename(path, probe)
@@ -371,9 +414,14 @@ class CoordinatorProxy:
             st = os.stat(probe)
             with open(probe) as f:
                 content = f.read().strip()
+            # rename(2) preserves inode and mtime, so the identity key is
+            # the same observation the ager has been aging all along — a
+            # fresh file swapped in between the age check and the rename
+            # has a new identity and ages out at 0 here (restored below).
             stale = (
                 content == f"{target[0]}:{target[1]}"
-                and time.time() - st.st_mtime >= self._registration_grace
+                and self._reg_ager.age("registration", (st.st_ino, st.st_mtime_ns))
+                >= self._registration_grace
             )
         except OSError:
             stale = False
@@ -382,6 +430,7 @@ class CoordinatorProxy:
                 os.unlink(probe)
             except OSError:
                 pass
+            self._reg_ager.forget("registration")
             logger.info(
                 "dropped stale coordinator registration %s:%d after %d "
                 "consecutive failed connects", target[0], target[1],

@@ -1,0 +1,116 @@
+"""Clock seam: monotonic-by-default time for staleness/GC decisions.
+
+The repo's concurrency discipline already insists on ``time.monotonic()``
+for intervals, but a few GC-flavored decisions are forced to touch the
+WALL clock because their evidence is wall-anchored (file mtimes, API
+timestamps) — and the wall clock steps.  An NTP correction, a VM
+migration, or a chrony slew of ±minutes is routine on real nodes, and a
+staleness rule written as ``wall_now - mtime >= grace`` turns that step
+into either a *premature* GC (clock jumps forward: everything suddenly
+looks aged-out) or an *infinitely deferred* one (clock jumps back: ages
+go negative and nothing ever qualifies).  Both failure modes are exactly
+the kind of fault the chaos soak injects (sim/chaos.py ``clock_skew``).
+
+This module gives those sites one injectable seam:
+
+- :class:`Clock` — ``monotonic()`` + ``wall()``; the process-wide
+  :data:`SYSTEM` instance is the default everywhere.
+- :class:`SkewedClock` — a test/chaos clock whose wall (and optionally
+  monotonic) reading is offset by a mutable skew, so a ±10-minute NTP
+  step is one attribute assignment in a test.
+- :class:`MonotonicAger` — the *discipline*, packaged: age an observed
+  identity (a file's ``(ino, mtime_ns)``, a claim uid + status) by how
+  long THIS process has continuously observed it on the monotonic clock,
+  never by subtracting a wall mtime from wall now.  An identity change
+  resets the age (the thing was replaced); wall skew cannot touch it.
+
+The cost of monotonic aging is that a freshly restarted observer waits
+one full grace period before acting — a bounded, safe-direction delay,
+versus the unbounded wrong-direction failure of wall math under skew.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Hashable, Optional
+
+
+class Clock:
+    """Process-time source.  ``monotonic()`` for intervals, ``wall()``
+    for timestamps compared against external wall-anchored evidence."""
+
+    def monotonic(self) -> float:
+        return time.monotonic()
+
+    def wall(self) -> float:
+        return time.time()
+
+
+#: The default clock every production call site uses.
+SYSTEM = Clock()
+
+
+class SkewedClock(Clock):
+    """A clock with injectable skew (tests and the chaos soak).
+
+    ``wall_skew_s`` models an NTP step / bad RTC: it offsets ``wall()``
+    only.  ``monotonic_skew_s`` exists for completeness (a paused VM's
+    suspended monotonic clock) but defaults to 0 — CLOCK_MONOTONIC does
+    not step on real kernels, which is the whole reason the GC discipline
+    anchors on it."""
+
+    def __init__(self, wall_skew_s: float = 0.0, monotonic_skew_s: float = 0.0):
+        self.wall_skew_s = wall_skew_s
+        self.monotonic_skew_s = monotonic_skew_s
+
+    def monotonic(self) -> float:
+        return time.monotonic() + self.monotonic_skew_s
+
+    def wall(self) -> float:
+        return time.time() + self.wall_skew_s
+
+
+class MonotonicAger:
+    """Continuous-observation aging for GC staleness decisions.
+
+    ``age(key, identity)`` returns how long (monotonic seconds) ``key``
+    has been observed with an unchanged ``identity``; the first
+    observation — and every identity change — restarts the timer at 0.
+    ``forget(key)`` drops a key whose object disappeared, so a later
+    reappearance starts fresh.
+
+    This is the skew-immune replacement for ``wall_now - mtime``: the
+    observer vouches only for time it actually watched, on a clock that
+    cannot step.  Thread-safe (GC threads and probe threads share one)."""
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self._clock = clock if clock is not None else SYSTEM
+        self._lock = threading.Lock()
+        self._seen: dict[Hashable, tuple[Hashable, float]] = {}
+
+    def age(self, key: Hashable, identity: Hashable) -> float:
+        now = self._clock.monotonic()
+        with self._lock:
+            entry = self._seen.get(key)
+            if entry is None or entry[0] != identity:
+                self._seen[key] = (identity, now)
+                return 0.0
+            return now - entry[1]
+
+    def forget(self, key: Hashable) -> None:
+        with self._lock:
+            self._seen.pop(key, None)
+
+    def tracked(self) -> set:
+        with self._lock:
+            return set(self._seen)
+
+    def prune(self, live_keys) -> None:
+        """Drop every tracked key not in ``live_keys`` — call once per GC
+        pass so a long-lived observer's table tracks live objects, not
+        every object it has ever seen."""
+        live = set(live_keys)
+        with self._lock:
+            for key in [k for k in self._seen if k not in live]:
+                del self._seen[key]

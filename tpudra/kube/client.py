@@ -19,7 +19,7 @@ from typing import Iterator, Optional, Protocol
 
 import yaml
 
-from tpudra.kube import errors
+from tpudra.kube import deadline, errors
 from tpudra.kube.gvr import GVR
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -176,9 +176,17 @@ class KubeClient:
             req.add_header("Content-Type", content_type)
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
+        # Ambient deadline (kube/deadline.py): the socket timeout never
+        # exceeds the caller's remaining budget, and an exhausted budget
+        # fails typed-and-fast instead of opening a doomed connection.
+        # Watches opt out via their explicit hour-long stream timeout
+        # (the deadline covers request verbs, not the push channel).
+        effective = timeout or self._timeout
+        if not stream:
+            effective = deadline.clamp(effective)
         try:
             resp = urllib.request.urlopen(
-                req, timeout=timeout or self._timeout, context=self._ssl_ctx
+                req, timeout=effective, context=self._ssl_ctx
             )
         except urllib.error.HTTPError as e:
             payload = e.read()
@@ -187,10 +195,31 @@ class KubeClient:
             except (ValueError, TypeError):
                 status = {"message": payload.decode(errors="replace")}
             raise errors.from_status(status, e.code) from None
+        except TimeoutError as e:
+            raise errors.Timeout(
+                f"{method} {path}: no response within {effective:.1f}s"
+            ) from e
+        except urllib.error.URLError as e:
+            # HTTPError was handled above (it subclasses URLError); what is
+            # left is transport-level.  socket timeouts become the typed
+            # deadline fault; everything else keeps its original shape.
+            if isinstance(getattr(e, "reason", None), TimeoutError):
+                raise errors.Timeout(
+                    f"{method} {path}: no response within {effective:.1f}s"
+                ) from e
+            raise
         if stream:
             return resp
-        with resp:
-            payload = resp.read()
+        try:
+            with resp:
+                payload = resp.read()
+        except TimeoutError as e:
+            # The server stalled mid-body (headers landed, the read timed
+            # out): same typed fault as a connect/headers timeout, or the
+            # retryable-504 contract would leak a raw TimeoutError.
+            raise errors.Timeout(
+                f"{method} {path}: response body stalled past {effective:.1f}s"
+            ) from e
         return json.loads(payload) if payload else None
 
     # -- KubeAPI ------------------------------------------------------------

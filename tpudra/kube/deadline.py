@@ -1,0 +1,92 @@
+"""Ambient deadline propagation for apiserver verbs.
+
+The bind path is allowed to touch the apiserver in exactly one place —
+the claim resolver's read-through GET — but "one place" is enough to
+wedge: a NodePrepareResources whose fallback GET lands during an
+apiserver latency spike used to sit in that GET for the client's full
+socket timeout (30 s), sail past kubelet's gRPC deadline, and burn a gRPC
+worker thread answering a call nobody was waiting for anymore.  The chaos
+soak's ``apiserver_latency`` fault manufactures exactly this scenario.
+
+The fix is the same one gRPC itself uses: a *deadline* that travels with
+the request.  ``with api_deadline(seconds):`` establishes (or tightens —
+nesting only ever shortens) a monotonic deadline in a ``contextvars``
+context; every KubeAPI implementation consults it:
+
+- ``FakeKube`` sleeps its injected RTT only up to the deadline, then
+  raises :class:`tpudra.kube.errors.Timeout` — the fault the latency knob
+  should produce, instead of unbounded blocking;
+- ``KubeClient`` clamps its per-request socket timeout to the remaining
+  budget and maps the socket timeout to the same typed error.
+
+Deadlines are ambient rather than threaded through every call signature
+because the verbs are behind the ``KubeAPI`` protocol shared by a dozen
+call sites; a ``timeout=`` parameter on each would churn every signature
+for what is fundamentally per-*request-context* state.  ``contextvars``
+(not a bare thread-local) so call paths that fan out through an executor
+can carry it along with ``contextvars.copy_context()`` — which is what
+the DRA socket's claim-resolution pool does (grpcserver._resolve_all).
+
+A raised :class:`~tpudra.kube.errors.Timeout` is retryable by contract:
+kubelet re-calls a failed NodePrepareResources, the informer relist loop
+backs off and retries, the publisher keeps its signals pending.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Iterator, Optional
+
+from tpudra.kube import errors
+
+_DEADLINE: contextvars.ContextVar[Optional[float]] = contextvars.ContextVar(
+    "tpudra_api_deadline", default=None
+)
+
+
+@contextlib.contextmanager
+def api_deadline(seconds: float) -> Iterator[float]:
+    """Establish an ambient apiserver deadline ``seconds`` from now.
+
+    Nested deadlines only tighten (the inner scope may not outlive the
+    outer budget).  Yields the absolute monotonic deadline in force."""
+    proposed = time.monotonic() + seconds
+    current = _DEADLINE.get()
+    effective = proposed if current is None else min(current, proposed)
+    token = _DEADLINE.set(effective)
+    try:
+        yield effective
+    finally:
+        _DEADLINE.reset(token)
+
+
+def remaining() -> Optional[float]:
+    """Seconds left in the ambient deadline (negative when overrun), or
+    None when no deadline is in force."""
+    d = _DEADLINE.get()
+    return None if d is None else d - time.monotonic()
+
+
+def check(what: str = "request") -> None:
+    """Raise :class:`errors.Timeout` if the ambient deadline has passed —
+    the cheap guard a verb runs before doing real work."""
+    rem = remaining()
+    if rem is not None and rem <= 0:
+        raise errors.Timeout(
+            f"{what}: deadline exceeded by {-rem:.3f}s before it started"
+        )
+
+
+def clamp(timeout: float) -> float:
+    """``timeout`` clamped to the remaining ambient budget (for handing to
+    a socket-level API).  Raises :class:`errors.Timeout` when the budget
+    is already spent — a zero-second socket timeout would surface as a
+    confusing transport error instead of the typed deadline fault."""
+    rem = remaining()
+    if rem is None:
+        return timeout
+    if rem <= 0:
+        raise errors.Timeout("deadline exceeded before the request was sent")
+    return min(timeout, rem)

@@ -52,6 +52,18 @@ class Forbidden(ApiError):
     reason = "Forbidden"
 
 
+class Timeout(ApiError):
+    """504 — the request outlived its deadline (apimachinery's
+    StatusReasonTimeout).  Raised by the fake when an injected latency
+    spike would exceed the ambient ``kube.deadline`` budget and by the
+    real client on a socket timeout: a bind-path apiserver call fails
+    FAST and retryably instead of wedging past its caller's gRPC
+    deadline."""
+
+    code = 504
+    reason = "Timeout"
+
+
 class Expired(ApiError):
     """410 Gone — the requested resourceVersion is older than the server's
     retained watch history (apimachinery's StatusReasonExpired).  A watch
@@ -66,7 +78,8 @@ class Expired(ApiError):
 _BY_REASON = {
     cls.reason: cls
     for cls in (
-        NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden, Expired,
+        NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden,
+        Expired, Timeout,
     )
 }
 
@@ -83,5 +96,6 @@ def from_status(status: dict, http_code: int) -> ApiError:
             400: BadRequest,
             403: Forbidden,
             410: Expired,
+            504: Timeout,
         }.get(http_code, ApiError)
     return cls(message)

@@ -38,7 +38,7 @@ import time
 import uuid as uuidlib
 from typing import Callable, Iterator, Optional
 
-from tpudra.kube import errors
+from tpudra.kube import deadline, errors
 from tpudra.kube.gvr import GVR
 
 
@@ -122,6 +122,10 @@ class _Watcher:
         #: Set by the emitter when this watcher's queue overflowed: the
         #: stream has a gap, so delivery stops with a 410 ERROR event.
         self.overflowed = threading.Event()
+        #: Set by FakeKube.close_watches (the chaos harness's apiserver
+        #: watch-flap injector): delivery stops with the same in-band 410
+        #: a real apiserver sends when it expires a stream server-side.
+        self.expired = threading.Event()
 
     def stop(self) -> None:
         self.stopped.set()
@@ -177,6 +181,7 @@ class FakeKube:
             "deliveries": 0,
             "overflows": 0,
             "compactions": 0,
+            "forced_closes": 0,
         }
 
     # -- test hooks ---------------------------------------------------------
@@ -185,6 +190,28 @@ class FakeKube:
         """Install a reactor called before ``verb`` ("create", "update",
         "delete", "get", "list") executes; raise from it to inject failures."""
         self._reactors.append((verb, self._key(gvr), fn))
+
+    def close_watches(self, gvr: Optional[GVR] = None) -> int:
+        """Force-close live watch streams with an in-band 410 ERROR — the
+        chaos soak's watch-flap injector (a real apiserver expires streams
+        server-side on timeouts, restarts, and etcd compactions; clients
+        must answer with a relist).  ``gvr`` narrows the flap to one
+        resource; default is every stream.  Returns the number of streams
+        closed.  A consumer parked in its queue wait notices within its
+        1 s poll — the same order of delay a TCP FIN takes to surface
+        through a real client's buffered reader."""
+        with self._lock:
+            targets = [
+                w
+                for w in self._watchers
+                if (gvr is None or w.gvr_key == self._key(gvr))
+                and not w.expired.is_set()
+                and not w.overflowed.is_set()
+            ]
+            for w in targets:
+                w.expired.set()
+                self.watch_stats["forced_closes"] += 1
+        return len(targets)
 
     def set_latency(self, seconds: float) -> None:
         """Simulate apiserver round-trip time: every request verb (not
@@ -200,8 +227,25 @@ class FakeKube:
 
     # tpudra-lock: nonblocking the latency sleep is the simulated-RTT knob itself — set_latency's docstring argues why it sleeps under the store lock on purpose
     def _run_reactors(self, verb: str, gvr: GVR, obj: dict | None) -> None:
-        if self._latency_s > 0 and verb in self.LATENCY_VERBS:
-            time.sleep(self._latency_s)
+        if verb in self.LATENCY_VERBS:
+            # Ambient deadline (kube/deadline.py): a latency spike may
+            # consume a caller's remaining budget but never exceed it —
+            # sleep to the deadline, then fail with the typed 504 the
+            # real client maps socket timeouts to.  This is what lets a
+            # bind's fallback GET fail fast and retryably during the chaos
+            # soak's apiserver_latency fault instead of wedging the RPC
+            # past its gRPC deadline.
+            rem = deadline.remaining()
+            if self._latency_s > 0:
+                if rem is not None and self._latency_s >= rem:
+                    time.sleep(max(0.0, min(self._latency_s, rem)))
+                    raise errors.Timeout(
+                        f"{verb}: simulated RTT {self._latency_s:.3f}s "
+                        f"exceeds the caller's remaining deadline"
+                    )
+                time.sleep(self._latency_s)
+            elif rem is not None and rem <= 0:
+                raise errors.Timeout(f"{verb}: deadline already exceeded")
         for v, key, fn in self._reactors:
             if v in (verb, "*") and key == self._key(gvr):
                 fn(verb, gvr, obj)
@@ -238,7 +282,11 @@ class FakeKube:
             del self._history[:drop]
             self.watch_stats["compactions"] += 1
         for w in list(self._watchers):
-            if w.gvr_key != self._key(gvr) or w.overflowed.is_set():
+            if (
+                w.gvr_key != self._key(gvr)
+                or w.overflowed.is_set()
+                or w.expired.is_set()
+            ):
                 continue
             meta = obj.get("metadata", {})
             if w.namespace and meta.get("namespace") != w.namespace:
@@ -521,6 +569,12 @@ class FakeKube:
                     yield _expired_event(
                         f"watch fell more than {self._watch_queue_depth} "
                         "events behind; resume requires a fresh list"
+                    )
+                    return
+                if watcher.expired.is_set():
+                    yield _expired_event(
+                        "watch stream closed by the server; resume "
+                        "requires a fresh list"
                     )
                     return
                 try:

@@ -23,6 +23,7 @@ import time
 from typing import Callable, Optional
 
 from tpudra import lockwitness, metrics
+from tpudra.backoff import Backoff
 from tpudra.kube import errors
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.gvr import GVR
@@ -47,6 +48,7 @@ class Informer:
         field_selector: Optional[str] = None,
         resync_period: float = 0.0,
         cache_filter: Optional[Callable[[dict], bool]] = None,
+        rng=None,
     ):
         self._api = api
         self._gvr = gvr
@@ -71,7 +73,14 @@ class Informer:
         #: reconcile by the controller, and a full store scan per call turns
         #: the informer cache into an O(store) lookup under load.
         self._index_data: dict[str, dict[str, set[tuple]]] = {}
-        self._backoff = 0.2  # relist backoff, reset by each successful list
+        #: Relist backoff: capped exponential with FULL jitter (shared
+        #: tpudra/backoff.py policy), reset by each successful list.  At
+        #: cluster scale every node informer enters this loop within
+        #: milliseconds of an apiserver flap; full jitter is what keeps
+        #: their relists from landing as one synchronized storm at
+        #: recovery.  ``rng`` (an optional ``random.Random``) makes the
+        #: schedule reproducible for the chaos soak and benches.
+        self._relist_backoff = Backoff(0.2, 30.0, rng=rng)
         self._watch_ok = False  # see watch_healthy
         #: Serializes handler deliveries between the list/watch thread and
         #: the resync thread — handlers are written for single-threaded
@@ -198,13 +207,11 @@ class Informer:
         # every informer in every binary hits this loop at once — fixed
         # short sleeps synchronize them into a relist storm at recovery
         # (client-go's reflector backs off the same way).
-        import random
-
-        self._backoff = 0.2
+        self._relist_backoff.reset()
         while not stop.is_set():
             try:
                 self._list_and_watch(stop)
-                self._backoff = 0.2
+                self._relist_backoff.reset()
             except errors.Expired as e:
                 # 410 Gone: the server compacted past our resourceVersion
                 # (too-old resume, or it dropped us as a slow watcher).
@@ -221,12 +228,11 @@ class Informer:
                 stop.wait(0.01)
             except Exception as e:  # noqa: BLE001 — informer must survive apiserver blips
                 self._watch_ok = False
-                delay = self._backoff * (0.5 + random.random())
+                delay = self._relist_backoff.next_delay()
                 logger.warning(
                     "informer %s: list/watch failed: %s; re-listing in %.1fs",
                     self._gvr.resource, e, delay,
                 )
-                self._backoff = min(self._backoff * 2, 30.0)
                 stop.wait(delay)
 
     def _list_and_watch(self, stop: threading.Event) -> None:
@@ -240,7 +246,7 @@ class Informer:
         # dies every cycle (an LB idle-timeout resetting watches must not
         # escalate us to 30 s event-delivery gaps — client-go's reflector
         # resets on successful list the same way).
-        self._backoff = 0.2
+        self._relist_backoff.reset()
         metrics.INFORMER_RELISTS.labels(self._gvr.resource).inc()
         rv = listing.get("metadata", {}).get("resourceVersion")
         fresh = {

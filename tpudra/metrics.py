@@ -262,6 +262,22 @@ RECONCILE_LATENCY_SECONDS = Histogram(
     ["manager"],
     buckets=_PREPARE_BUCKETS,
 )
+SOAK_FAULTS_INJECTED_TOTAL = Counter(
+    "tpudra_soak_faults_injected_total",
+    "Faults injected by the chaos soak (sim/chaos.py), by kind: "
+    "apiserver_latency, watch_close, kubelet_restart, plugin_crash, "
+    "torn_wal, clock_skew — the denominator every soak SLO is asserted "
+    "against",
+    ["kind"],
+)
+SOAK_INVARIANT_CHECKS_TOTAL = Counter(
+    "tpudra_soak_invariant_checks_total",
+    "Continuous invariant evaluations by the soak's monitor thread, by "
+    "invariant (claim-stuck, cdi-leak, flock-leak, slice-convergence, "
+    "lock-witness) and result (ok / violation) — a healthy soak is all "
+    "ok with a nonzero check count per invariant",
+    ["invariant", "result"],
+)
 APISERVER_REQUESTS_TOTAL = Counter(
     "tpudra_apiserver_requests_total",
     "Requests issued through an accounting-wrapped kube client "

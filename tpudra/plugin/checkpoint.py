@@ -57,10 +57,12 @@ read-only callers (stale-claim GC, resourceslice rebuild).
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import json
 import logging
 import os
+import threading
 import time
 import zlib
 from dataclasses import dataclass, field
@@ -98,6 +100,45 @@ DEFAULT_JOURNAL_MAX_BYTES = 256 * 1024
 DEFAULT_JOURNAL_MAX_RECORDS = 1024
 
 
+class SimulatedCrash(BaseException):
+    """An in-process stand-in for SIGKILL at a checkpoint boundary.
+
+    Deliberately a ``BaseException``: every ``except Exception`` fault
+    barrier on the bind path (per-claim isolation, batch failure mapping)
+    must let it through, exactly as a real SIGKILL runs no handlers — the
+    harness that armed it catches it at the top of its own call and then
+    abandons the driver instance (``Driver.crash_stop``), so on-disk
+    state is frozen at the boundary just as a process death leaves it.
+    ``finally`` blocks do still run (releasing flocks), which matches the
+    kernel's behavior at process exit: flocks are released when the fds
+    close, so recovery sees the same lock state either way."""
+
+    def __init__(self, point: str):
+        super().__init__(f"simulated crash at checkpoint boundary {point!r}")
+        self.point = point
+
+
+_crash_tls = threading.local()
+
+
+@contextlib.contextmanager
+def armed_crash(point: str):
+    """Arm an IN-PROCESS crash for the current thread: the next time this
+    thread reaches the named checkpoint boundary, ``_crashpoint`` raises
+    :class:`SimulatedCrash` instead of SIGKILLing the process.  The chaos
+    soak (sim/chaos.py) uses this to kill one simulated node's driver at
+    a random boundary while the other N-1 nodes — same process — keep
+    running; the subprocess crash sweeps keep the env-armed real SIGKILL.
+    Thread-local by construction: a boundary reached by any other thread
+    (another node's bind, a GC pass) never fires."""
+    prev = getattr(_crash_tls, "point", None)
+    _crash_tls.point = point
+    try:
+        yield
+    finally:
+        _crash_tls.point = prev
+
+
 def _crashpoint(point: str) -> None:
     """Injectable SIGKILL for the process-level crash-consistency sweeps
     (tests/test_crash_sweep*.py): when TPUDRA_CRASHPOINT names this
@@ -106,7 +147,11 @@ def _crashpoint(point: str) -> None:
     reference device_state.go:223-242,337).  Two-key arming: the kill also
     requires TPUDRA_TEST_HOOKS=1, so a single leaked env var in a copied
     manifest cannot turn every production prepare into a crash loop.
-    Unarmed cost: one env read and string compare per boundary."""
+    Unarmed cost: one env read and string compare per boundary (plus one
+    thread-local read for the in-process arming, see ``armed_crash``)."""
+    if getattr(_crash_tls, "point", None) == point:
+        logger.warning("crashpoint %s armed in-process: simulating crash", point)
+        raise SimulatedCrash(point)
     if (
         os.environ.get("TPUDRA_CRASHPOINT") == point
         and os.environ.get("TPUDRA_TEST_HOOKS") == "1"
@@ -1046,6 +1091,28 @@ class CheckpointManager:
             logger.exception(
                 "clean-shutdown checkpoint compaction failed; journal left "
                 "in place for the next start to replay"
+            )
+
+    def abandon(self) -> None:
+        """Drop this manager WITHOUT the clean-shutdown compaction: the
+        journal stays on disk exactly as the last group commit left it —
+        the on-disk state a SIGKILL would leave.  The chaos harness's
+        ``Driver.crash_stop`` uses this to model a plugin crash in-process
+        (a fresh manager over the same dir then takes the REAL recovery
+        path: snapshot + journal replay with torn-tail truncation).  Only
+        the append fd is released, under the flock so it can never close
+        out from under a committing leader; if the flock cannot be taken
+        the fd is deliberately leaked in the abandoned instance — the same
+        tradeoff close() documents."""
+        with self._commit_cond:
+            self._journal_enabled = False  # no further appends from here
+        try:
+            with Flock(self._lock_path)(timeout=5.0):  # tpudra-lock: id=flock:cp.lock
+                self._journal.close()
+        except Exception:  # noqa: BLE001 — abandoning must not wedge
+            logger.warning(
+                "abandon: could not take the checkpoint flock; leaking the "
+                "journal fd in the abandoned instance"
             )
 
     def _mutate_snapshot(

@@ -6,6 +6,18 @@ longer exist (or were re-created with a new UID) in the API server.  On
 startup and every ``period`` seconds, every checkpointed claim is validated by
 name+UID against the API server; stale ones are unprepared
 (reference cleanup.go:41-213, 10-minute period).
+
+Clock discipline (tpudra/clock.py): every time-based decision here runs on
+the MONOTONIC clock through the injectable ``Clock`` seam — staleness is
+decided by apiserver evidence (NotFound / UID mismatch / terminating
+without allocation), never by subtracting wall-clock timestamps, so an NTP
+step of ±minutes (the chaos soak's ``clock_skew`` fault) can neither
+trigger a premature unprepare nor defer GC forever.  The optional
+``stale_grace`` requires a claim to be *continuously* observed stale for
+that many monotonic seconds before teardown — a hedge against acting on a
+single observation during an apiserver wobble (a relist window where a GET
+can race a delete-and-recreate), measured by this process's own
+observation time (``MonotonicAger``), which wall skew cannot touch.
 """
 
 from __future__ import annotations
@@ -14,6 +26,7 @@ import logging
 import threading
 from typing import Callable, Optional
 
+from tpudra.clock import Clock, MonotonicAger, SYSTEM
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
 from tpudra.kube.errors import NotFound
@@ -31,6 +44,8 @@ class CheckpointCleanupManager:
         state: DeviceState,
         period: float = DEFAULT_PERIOD,
         unprepare: Optional[Callable[[str], None]] = None,
+        clock: Optional[Clock] = None,
+        stale_grace: float = 0.0,
     ):
         self._kube = kube
         self._state = state
@@ -42,6 +57,15 @@ class CheckpointCleanupManager:
         # interleave with a concurrent channel prepare's labeling).  The
         # bare state.unprepare default exists for tests and simple callers.
         self._unprepare = unprepare if unprepare is not None else state.unprepare
+        self._clock = clock if clock is not None else SYSTEM
+        # > 0: a claim must be seen stale on passes spanning >= this many
+        # MONOTONIC seconds before it is unprepared.  0 (the default, and
+        # the reference driver's behavior) acts on the first validated
+        # observation — the validation itself is apiserver evidence, not
+        # time math, so immediate action is sound; the grace exists for
+        # operators who want two-pass confirmation under apiserver churn.
+        self._stale_grace = stale_grace
+        self._stale_ager = MonotonicAger(self._clock)
         self._thread: threading.Thread | None = None
 
     def start(self, stop: threading.Event) -> None:
@@ -61,14 +85,31 @@ class CheckpointCleanupManager:
     def cleanup_once(self) -> int:
         """One validation pass; returns number of stale claims unprepared."""
         stale = 0
-        for uid, (namespace, name, status) in self._state.prepared_claim_uids().items():
-            if self._is_stale(uid, namespace, name):
+        claims = self._state.prepared_claim_uids()
+        for uid, (namespace, name, status) in claims.items():
+            if not self._is_stale(uid, namespace, name):
+                # Valid again (or unvalidatable this pass): any staleness
+                # observation restarts from zero.
+                self._stale_ager.forget(uid)
+                continue
+            age = self._stale_ager.age(uid, ("stale", namespace, name))
+            if age < self._stale_grace:
                 logger.info(
-                    "unpreparing stale claim %s/%s:%s (status=%s)",
-                    namespace, name, uid, status,
+                    "claim %s/%s:%s stale for %.1fs (< %.1fs grace): "
+                    "deferring unprepare to a later pass",
+                    namespace, name, uid, age, self._stale_grace,
                 )
-                self._unprepare(uid)
-                stale += 1
+                continue
+            logger.info(
+                "unpreparing stale claim %s/%s:%s (status=%s)",
+                namespace, name, uid, status,
+            )
+            self._unprepare(uid)
+            self._stale_ager.forget(uid)
+            stale += 1
+        # Claims that left the checkpoint between passes (a clean kubelet
+        # unprepare) must not pin ager entries forever.
+        self._stale_ager.prune(claims.keys())
         return stale
 
     def _is_stale(self, uid: str, namespace: str, name: str) -> bool:

@@ -37,6 +37,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from tpudra import TPU_DRIVER_NAME, featuregates, lockwitness, metrics
+from tpudra.backoff import Backoff
+from tpudra.clock import Clock
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
 from tpudra.flock import Flock
 from tpudra.kube import gvr
@@ -107,6 +109,11 @@ class DriverConfig:
     # harness passes 1 against a fresh fake so constructing N hundred
     # drivers costs zero LISTs instead of N scans of a growing slice set.
     initial_pool_generation: Optional[int] = None
+    # Clock for the stale-claim GC (tpudra/clock.py seam).  None = the
+    # system clock; the chaos soak injects a SkewedClock so its clock_skew
+    # fault can step the wall reading under live GC passes and prove the
+    # monotonic staleness discipline holds.
+    gc_clock: Optional[Clock] = None
 
 
 class Driver:
@@ -194,7 +201,8 @@ class Driver:
             resolve_claim=resolve_claim,
         )
         self.cleanup = CheckpointCleanupManager(
-            kube, self.state, unprepare=self._unprepare_serialized
+            kube, self.state, unprepare=self._unprepare_serialized,
+            clock=config.gc_clock,
         )
         self._health_thread: Optional[threading.Thread] = None
         # Side-effect fan-out pool.  Threads spawn lazily on first multi-
@@ -267,6 +275,25 @@ class Driver:
         # dual-version snapshot — the downgrade gate (an old driver never
         # reads checkpoint.wal).  Best-effort inside close().
         self._checkpoints.close()
+        self._lib.close()
+
+    def crash_stop(self) -> None:
+        """Abandon this driver the way a SIGKILL would, minus the process
+        death: threads are told to stop and sockets close, but the
+        checkpoint journal is NOT compacted (``CheckpointManager.abandon``)
+        — on-disk state stays frozen at whatever boundary the last commit
+        reached.  The chaos soak (sim/chaos.py) pairs this with
+        ``checkpoint.armed_crash`` to kill one simulated node among N in
+        one process, then builds a fresh Driver over the same plugin dir,
+        which must converge through the REAL recovery path (snapshot +
+        journal replay + torn-tail truncation + startup GC), exactly like
+        the subprocess crash sweeps prove for a whole plugin process."""
+        self._stop.set()
+        with self._publish_cond:
+            self._publish_cond.notify_all()
+        self._sockets.stop()
+        self._effects_pool.shutdown(wait=False)
+        self._checkpoints.abandon()
         self._lib.close()
 
     @property
@@ -621,9 +648,13 @@ class Driver:
         publishes.  Signals landing during a rebuild trigger another pass,
         so the last event always reaches the apiserver.  A FAILED publish
         keeps its signals pending (``_publish_done`` does not advance) and
-        retries after a short backoff — one transient apiserver error must
-        not eat a coalesced burst.  Idle wakeups re-assert aged slices
+        retries after a capped-exponential full-jitter backoff (shared
+        tpudra/backoff.py policy; reset by the next success) — one
+        transient apiserver error must not eat a coalesced burst, and at
+        cluster scale N nodes' publishers failing on one apiserver flap
+        must not retry in lockstep.  Idle wakeups re-assert aged slices
         through the hash gate (``publish_reassert_s``)."""
+        retry = Backoff(0.5, 15.0)
         while True:
             with self._publish_cond:
                 while (
@@ -642,11 +673,13 @@ class Driver:
             try:
                 self.publish_resources(force=self._needs_reassert())
             except Exception:  # noqa: BLE001 — publisher must survive API blips
+                delay = retry.next_delay()
                 logger.exception(
-                    "async slice publication failed; retrying shortly"
+                    "async slice publication failed; retrying in %.1fs", delay
                 )
-                self._stop.wait(1.0)
+                self._stop.wait(delay)
                 continue  # signals stay pending: the loop retries them
+            retry.reset()
             with self._publish_cond:
                 absorbed = target - self._publish_done - 1
                 self._publish_done = target

@@ -24,6 +24,7 @@ dependency is needed.
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import os
 import threading
@@ -31,6 +32,8 @@ from concurrent import futures
 from typing import Callable, Optional
 
 import grpc
+
+from tpudra.kube.deadline import api_deadline
 
 from tpudra.drapb import dra_v1_pb2 as drapb
 from tpudra.drapb import dra_v1beta1_pb2 as drapb_beta
@@ -51,6 +54,13 @@ _REG_SERVICE = "pluginregistration.Registration"
 
 # Resolves a Claim reference to the full ResourceClaim object, or raises.
 ClaimResolver = Callable[[str, str, str], dict]
+
+#: Apiserver budget for one NodePrepare/NodeUnprepare call: kubelet's DRA
+#: client deadline is 30 s (DRAClient mirrors it) — leave headroom so a
+#: latency-spiked apiserver verb fails the RPC *inside* the deadline with
+#: a retryable per-claim error instead of wedging a gRPC worker past it
+#: (kube/deadline.py; the chaos soak's apiserver_latency fault pins this).
+DEFAULT_RPC_API_BUDGET_S = 25.0
 
 
 def kube_claim_resolver(kube) -> ClaimResolver:
@@ -172,7 +182,11 @@ class PluginSockets:
         lookups would put N round-trips ahead of the bind path; with the
         cached resolver a fan-out of hits costs nothing and concurrent
         misses on one claim collapse to a single GET via singleflight).
-        Returns [(ref, claim-or-None, error-or-None)] in request order."""
+        Pool workers run under a COPY of the calling context so the RPC's
+        ambient apiserver deadline (kube/deadline.py) travels with each
+        fallback GET — contextvars do not cross executor threads on their
+        own.  Returns [(ref, claim-or-None, error-or-None)] in request
+        order."""
         def one(ref):
             try:
                 return ref, self._resolve_claim(ref.namespace, ref.name, ref.uid), None
@@ -182,7 +196,10 @@ class PluginSockets:
         refs = list(refs)
         if len(refs) <= 1:
             return [one(ref) for ref in refs]
-        return list(self._resolver_pool.map(one, refs))
+        ctx = contextvars.copy_context()
+        return list(
+            self._resolver_pool.map(lambda ref: ctx.copy().run(one, ref), refs)
+        )
 
     def _node_prepare(self, request, context, pb):
         """Resolve claim refs → run the driver's prepare → proto response.
@@ -190,30 +207,37 @@ class PluginSockets:
         Every requested claim gets an entry (kubelet re-calls for missing
         ones); a reference that fails to resolve gets a per-claim error, the
         same contract as the reference helper's claim lookup.
+
+        The whole call runs under an ambient apiserver deadline
+        (``DEFAULT_RPC_API_BUDGET_S``): any apiserver verb on the path —
+        the resolver's fallback GET above all — fails fast with a typed,
+        retryable 504 once the budget is gone, so an apiserver latency
+        spike cannot wedge this handler past kubelet's own gRPC deadline.
         """
         resp = pb.NodePrepareResourcesResponse()
-        full_claims = []
-        for ref, claim, err in self._resolve_all(request.claims):
-            if err is not None:
-                resp.claims[ref.uid].error = (
-                    f"resolve claim {ref.namespace}/{ref.name}: {err}"
-                )
-            else:
-                full_claims.append(claim)
-        if full_claims:
-            result = self._prepare(full_claims)
-            for uid, entry in result.get("claims", {}).items():
-                if entry.get("error"):
-                    resp.claims[uid].error = entry["error"]
-                    continue
-                out = resp.claims[uid]
-                for d in entry.get("devices", []):
-                    out.devices.add(
-                        request_names=d.get("requestNames", []),
-                        pool_name=d.get("poolName", ""),
-                        device_name=d.get("deviceName", ""),
-                        cdi_device_ids=d.get("cdiDeviceIDs", []),
+        with api_deadline(DEFAULT_RPC_API_BUDGET_S):
+            full_claims = []
+            for ref, claim, err in self._resolve_all(request.claims):
+                if err is not None:
+                    resp.claims[ref.uid].error = (
+                        f"resolve claim {ref.namespace}/{ref.name}: {err}"
                     )
+                else:
+                    full_claims.append(claim)
+            if full_claims:
+                result = self._prepare(full_claims)
+                for uid, entry in result.get("claims", {}).items():
+                    if entry.get("error"):
+                        resp.claims[uid].error = entry["error"]
+                        continue
+                    out = resp.claims[uid]
+                    for d in entry.get("devices", []):
+                        out.devices.add(
+                            request_names=d.get("requestNames", []),
+                            pool_name=d.get("poolName", ""),
+                            device_name=d.get("deviceName", ""),
+                            cdi_device_ids=d.get("cdiDeviceIDs", []),
+                        )
         return resp
 
     def _node_unprepare(self, request, context, pb):
@@ -221,7 +245,9 @@ class PluginSockets:
             {"uid": c.uid, "namespace": c.namespace, "name": c.name}
             for c in request.claims
         ]
-        result = self._unprepare(refs)
+        # Same ambient apiserver budget as prepare (see _node_prepare).
+        with api_deadline(DEFAULT_RPC_API_BUDGET_S):
+            result = self._unprepare(refs)
         resp = pb.NodeUnprepareResourcesResponse()
         for uid, entry in result.get("claims", {}).items():
             resp.claims[uid].error = entry.get("error", "")

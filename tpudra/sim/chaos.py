@@ -1,0 +1,1290 @@
+"""Chaos soak: SLO-asserted compound-fault long-run (ROADMAP item 5).
+
+The crash sweeps prove point-in-time recovery; the cluster harness proves
+scale under clean churn.  Nothing before this module proved *steady-state
+SLOs while faults compound* — an apiserver flap during a kubelet restart
+during a WAL compaction is the production scenario the north star
+implies, and this soak is its hermetic reproduction:
+
+- **time compression**: the run is scheduled in *simulated* seconds
+  (``compression`` sim-seconds per wall second, default 60×), so a
+  two-minute wall run covers hours of simulated churn and every budget
+  in the SLO (claim-stuck T, leak grace, recovery windows) is expressed
+  in sim time;
+- **seeded fault scheduler**: one thread draws faults from a seeded RNG
+  and composes the repo's existing injectors —
+
+  ===================  ====================================================
+  kind                 what it does
+  ===================  ====================================================
+  apiserver_latency    ``FakeKube.set_latency`` spike for a sim window
+                       (stays active while OTHER faults run: compounding)
+  watch_close          ``FakeKube.close_watches`` — every informer stream
+                       gets the in-band 410 and must relist (with the
+                       shared full-jitter backoff)
+  kubelet_restart      a node's kubelet loses its memory mid-flight:
+                       re-prepare of a live claim must be idempotent, and
+                       a claim whose API object vanished while kubelet was
+                       down must be reclaimed by the stale-claim GC
+  plugin_crash         ``checkpoint.armed_crash`` raises SimulatedCrash at
+                       a random checkpoint boundary (the crash sweeps' six
+                       points incl. post-journal-append / mid-compaction),
+                       the driver is abandoned (``crash_stop``, no
+                       shutdown compaction) and rebuilt over the same dirs
+                       through the REAL recovery path
+  torn_wal             plugin_crash at post-journal-append plus garbage
+                       appended to ``checkpoint.wal`` before restart
+                       (power-cut-mid-append recovery, loudly truncated)
+  clock_skew           ±10 min wall steps on the shared GC clock while
+                       stale-claim GC passes run — the monotonic staleness
+                       discipline (tpudra/clock.py) must hold in both
+                       directions
+  ===================  ====================================================
+
+- **continuous invariant monitor**: a thread asserts, every few hundred
+  sim-seconds, that no claim sits in a non-terminal phase longer than T,
+  that no CDI spec or per-uid flock file outlives its checkpoint record,
+  and that published ResourceSlice content reconverges to checkpoint
+  truth after every fault window; at finalize the lock-witness log (when
+  armed) is merged against the static model — no cycles, no model gaps.
+  Every check lands in ``tpudra_soak_invariant_checks_total``.
+
+- **machine-readable SLO report**: JSON with per-fault-window bind
+  latency histograms, invariant check/violation counts, and recovery
+  times — consumed by ``tools/soak_report.py --assert-slo`` (the ``make
+  soak`` exit gate).  Every violation carries the seed and the fault
+  timeline up to that instant, and ``--replay <report.json>`` re-executes
+  that recorded timeline instead of drawing a fresh one.
+
+Concurrency discipline: the soak's own locks (``chaos.*``) are never held
+across a call into driver or kube code — worker threads take them only
+for pure bookkeeping (node picking, sample append, window tagging), so
+the lock witness sees no soak→driver edges and the static model stays
+closed under ``make lockgraph``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics
+from tpudra.clock import MonotonicAger, SkewedClock
+from tpudra.kube import gvr
+from tpudra.kube.deadline import api_deadline
+from tpudra.kube.errors import ApiError, NotFound
+from tpudra.plugin import checkpoint as checkpoint_mod
+from tpudra.plugin.checkpoint import PREPARE_STARTED, SimulatedCrash
+from tpudra.sim.cluster import (
+    ClusterScaleConfig,
+    ClusterScaleSim,
+    latency_summary,
+    make_claim,
+)
+
+logger = logging.getLogger(__name__)
+
+#: The checkpoint boundaries the crash injector may arm — the same six
+#: points the subprocess crash sweeps kill at (tests/crashharness.POINTS;
+#: redeclared here because tpudra must not import from tests/).
+CRASH_POINTS = (
+    "post-prepare-started",
+    "post-mutate",
+    "post-cdi",
+    "post-completed",
+    "post-journal-append",
+    "mid-compaction",
+)
+
+FAULT_KINDS = (
+    "apiserver_latency",
+    "watch_close",
+    "kubelet_restart",
+    "plugin_crash",
+    "torn_wal",
+    "clock_skew",
+)
+
+#: Invariant label values (METRICS-HYGIENE: one spelling, shared with the
+#: metrics docstring and soak_report).
+INV_CLAIM_STUCK = "claim-stuck"
+INV_CDI_LEAK = "cdi-leak"
+INV_FLOCK_LEAK = "flock-leak"
+INV_SLICE_CONVERGENCE = "slice-convergence"
+INV_LOCK_WITNESS = "lock-witness"
+INV_FAULT_RECOVERY = "fault-recovery"
+INVARIANTS = (
+    INV_CLAIM_STUCK,
+    INV_CDI_LEAK,
+    INV_FLOCK_LEAK,
+    INV_SLICE_CONVERGENCE,
+    INV_LOCK_WITNESS,
+    INV_FAULT_RECOVERY,
+)
+
+
+@dataclass
+class SLOBudget:
+    """The soak's pass/fail budgets.  Latency budgets are wall-clock (the
+    bind path runs in real time); lifecycle budgets are sim-clock (they
+    scale with the compressed schedule)."""
+
+    bind_p99_ms: float = 2000.0
+    #: T: max time a claim may sit in a non-terminal phase (sim seconds).
+    max_claim_stuck_sim_s: float = 600.0
+    #: A CDI spec / flock file with no checkpoint record may exist at most
+    #: this long (sim seconds) — covers the in-flight windows.
+    leak_grace_sim_s: float = 300.0
+    #: Slice content must reconverge to checkpoint truth within this many
+    #: sim seconds after the last fault window closes.
+    convergence_sim_s: float = 300.0
+    #: A crashed node must serve a correct re-prepare within this (sim).
+    recovery_sim_s: float = 900.0
+
+
+@dataclass
+class ChaosConfig:
+    nodes: int = 4
+    chips_per_node: int = 4
+    seed: int = 0
+    #: Wall-clock run length and the sim-seconds-per-wall-second factor:
+    #: 75 s × 60 = 4500 sim seconds = 1.25 simulated hours.
+    wall_s: float = 75.0
+    compression: float = 60.0
+    #: Mean gap between scheduled faults (sim seconds, exponential draw).
+    fault_mean_gap_sim_s: float = 180.0
+    churn_workers: int = 2
+    #: Harness cadences in SIM seconds, so the monitor's sampling rate and
+    #: the GC's reclaim latency scale with compression the same way the
+    #: budgets they police do (a wall-anchored GC cadence at high
+    #: compression would let every orphan blow the sim-time claim-stuck
+    #: budget before its first reclaim pass).
+    monitor_interval_sim_s: float = 30.0
+    gc_interval_sim_s: float = 60.0
+    #: Latency-spike RTTs in SIM seconds for the same reason: a
+    #: wall-anchored 400 ms RTT is 24 sim-seconds at 60x but 160 at 400x,
+    #: which silently re-scales the fault severity against every sim
+    #: budget.  3/9/24 sim-s ≙ 50/150/400 ms at the default 60x.
+    latency_rtt_sim_choices: tuple = (3.0, 9.0, 24.0)
+    fault_kinds: tuple = FAULT_KINDS
+    budget: SLOBudget = field(default_factory=SLOBudget)
+    #: Arm the lock witness for the run (subprocess/make-soak mode; the
+    #: in-process unit tests leave it off so they don't flip the
+    #: process-wide witness env).
+    witness: bool = False
+    report_path: str = "/tmp/tpudra_soak.json"
+    #: Replay mode: execute this recorded fault timeline (list of fault
+    #: spec dicts) instead of drawing from the RNG.
+    replay_timeline: Optional[list] = None
+
+
+@dataclass
+class FaultRecord:
+    kind: str
+    t_sim_start: float
+    t_sim_end: Optional[float] = None
+    node: Optional[int] = None
+    point: Optional[str] = None
+    params: dict = field(default_factory=dict)
+    recovered_sim_s: Optional[float] = None
+
+    def spec(self) -> dict:
+        """The replayable part: what to inject, not what happened."""
+        return {
+            "kind": self.kind,
+            "t_sim": round(self.t_sim_start, 1),
+            "node": self.node,
+            "point": self.point,
+            "params": self.params,
+        }
+
+
+class SimClock:
+    """Wall → simulated time: ``now_sim() = elapsed_wall × compression``."""
+
+    def __init__(self, compression: float):
+        self.compression = compression
+        self._t0 = time.monotonic()
+
+    def now_sim(self) -> float:
+        return (time.monotonic() - self._t0) * self.compression
+
+    def wall_of(self, sim_seconds: float) -> float:
+        return sim_seconds / self.compression
+
+
+class ChaosSoak:
+    """One soak run over a ClusterScaleSim.  ``run()`` blocks for
+    ``config.wall_s`` and returns the report dict."""
+
+    def __init__(self, config: ChaosConfig):
+        self.config = config
+        self.budget = config.budget
+        self._rng = random.Random(config.seed)
+        self._gc_clock = SkewedClock()
+        if config.witness:
+            os.environ[lockwitness.ENV_WITNESS] = "1"
+            os.environ.setdefault(
+                lockwitness.ENV_WITNESS_LOG,
+                os.path.join(
+                    os.path.dirname(config.report_path) or ".",
+                    "soak-lock-witness.jsonl",
+                ),
+            )
+            lockwitness.reset_for_tests()
+        self.sim = ClusterScaleSim(
+            ClusterScaleConfig(
+                nodes=config.nodes,
+                chips_per_node=config.chips_per_node,
+                seed=config.seed,
+                workers=max(4, config.churn_workers * 2),
+                compute_domains=2,
+                gc_clock=self._gc_clock,
+            )
+        )
+        self.simclock = SimClock(config.compression)
+        self._stop = threading.Event()
+
+        # -- shared soak state.  The condition serializes node picking /
+        # quarantine / in-flight accounting; the plain locks guard the
+        # sample and record sinks.  NONE of them is ever held across a
+        # call into driver or kube code (module docstring).
+        self._churn_cond = lockwitness.make_condition("chaos.churn_cond")
+        self._quarantine: set[int] = set()
+        self._inflight: dict[int, int] = {i: 0 for i in range(config.nodes)}
+        self._churn_gate_open = True
+        self._samples_lock = lockwitness.make_lock("chaos.samples_lock")
+        self._bind_samples: list[tuple[float, float, str]] = []  # (t_sim, ms, tag)
+        self._bind_errors: list[tuple[float, str, str]] = []  # (t_sim, tag, err)
+        self._records_lock = lockwitness.make_lock("chaos.records_lock")
+        self._timeline: list[FaultRecord] = []
+        self._active: dict[str, FaultRecord] = {}
+        self._latency_end_sim: Optional[float] = None
+        self._latency_record: Optional[FaultRecord] = None
+        self._violations: list[dict] = []
+        self._violated_keys: set = set()
+        self._checks: dict[str, dict[str, int]] = {
+            inv: {"ok": 0, "violation": 0} for inv in INVARIANTS
+        }
+        self._stuck_ager = MonotonicAger()
+        self._leak_ager = MonotonicAger()
+        # First pass through the kinds is a seeded shuffle of ALL of them:
+        # a short run must still exercise every enabled injector at least
+        # once (soak_report asserts it), and a plain choice() leaves that
+        # to luck.  Draws after the cycle are uniform.
+        self._kind_cycle: list[str] = list(config.fault_kinds)
+        self._rng.shuffle(self._kind_cycle)
+        self._max_stuck_sim = 0.0
+        self._recovery_samples: list[float] = []
+        self._fault_counter = 0
+        self._anomalies: list[str] = []
+
+    # ------------------------------------------------------------- plumbing
+
+    def _now(self) -> float:
+        return self.simclock.now_sim()
+
+    def _current_tag(self) -> str:
+        with self._records_lock:
+            active = sorted(self._active)
+        return "+".join(active) if active else "quiet"
+
+    def _record_fault(self, record: FaultRecord) -> None:
+        metrics.SOAK_FAULTS_INJECTED_TOTAL.labels(record.kind).inc()
+        with self._records_lock:
+            self._timeline.append(record)
+            self._active[record.kind] = record
+
+    def _end_fault(self, record: FaultRecord) -> None:
+        record.t_sim_end = self._now()
+        with self._records_lock:
+            if self._active.get(record.kind) is record:
+                del self._active[record.kind]
+
+    def _check(self, invariant: str, ok: bool, key=None, detail: str = "") -> None:
+        """Count one invariant evaluation; a violation (deduped per key)
+        dumps the seed + fault timeline needed to replay it."""
+        result = "ok" if ok else "violation"
+        metrics.SOAK_INVARIANT_CHECKS_TOTAL.labels(invariant, result).inc()
+        with self._records_lock:
+            self._checks[invariant][result] += 1
+            if ok or (invariant, key) in self._violated_keys:
+                return
+            self._violated_keys.add((invariant, key))
+            self._violations.append(
+                {
+                    "invariant": invariant,
+                    "key": repr(key),
+                    "t_sim": round(self._now(), 1),
+                    "detail": detail,
+                    "replay": {
+                        "seed": self.config.seed,
+                        "timeline": [r.spec() for r in self._timeline],
+                    },
+                }
+            )
+        logger.error("SOAK INVARIANT VIOLATION [%s] %r: %s", invariant, key, detail)
+
+    def _pass_check(self, invariant: str) -> None:
+        """Count one 'ok' evaluation for a completed scan pass: candidate
+        objects count individually on top, but a pass that found nothing
+        to examine still asserted the invariant over the whole cluster —
+        'checks' in the report must reflect continuous evaluation, not
+        just how many suspicious objects happened to exist."""
+        metrics.SOAK_INVARIANT_CHECKS_TOTAL.labels(invariant, "ok").inc()
+        with self._records_lock:
+            self._checks[invariant]["ok"] += 1
+
+    def _anomaly(self, msg: str) -> None:
+        """Something off-script that is not an invariant violation (e.g. a
+        crash arm that never fired) — reported, not failed."""
+        logger.warning("soak anomaly: %s", msg)
+        with self._records_lock:
+            self._anomalies.append(msg)
+
+    # ---------------------------------------------- node reservation (churn)
+
+    def _acquire_node(self, rng: random.Random) -> Optional[int]:
+        with self._churn_cond:
+            candidates = [
+                i
+                for i in range(self.config.nodes)
+                if i not in self._quarantine and self._churn_gate_open
+            ]
+            if not candidates:
+                return None
+            node = rng.choice(candidates)
+            self._inflight[node] += 1
+            return node
+
+    def _release_node(self, node: int) -> None:
+        with self._churn_cond:
+            self._inflight[node] -= 1
+            self._churn_cond.notify_all()
+
+    def _quarantine_node(self, node: int, timeout: float = 30.0) -> None:
+        """Reserve a node for the fault thread: churn skips it and any
+        in-flight op drains first — which also guarantees the fault thread
+        leads its own group commits on that node's checkpoint (an armed
+        in-process crashpoint must fire on the armed thread)."""
+        deadline = time.monotonic() + timeout
+        with self._churn_cond:
+            self._quarantine.add(node)
+            while self._inflight[node] > 0 and time.monotonic() < deadline:
+                self._churn_cond.wait(0.1)
+
+    def _unquarantine_node(self, node: int) -> None:
+        with self._churn_cond:
+            self._quarantine.discard(node)
+            self._churn_cond.notify_all()
+
+    def _close_churn_gate(self, timeout: float = 30.0) -> bool:
+        """Stop new churn and wait for in-flight ops to drain; True when
+        fully drained.  Generous timeout: one op under a compounding
+        latency window can span several stacked 5 s api_deadline phases."""
+        deadline = time.monotonic() + timeout
+        with self._churn_cond:
+            self._churn_gate_open = False
+            while (
+                any(self._inflight[i] > 0 for i in range(self.config.nodes))
+                and time.monotonic() < deadline
+            ):
+                self._churn_cond.wait(0.1)
+            return not any(
+                self._inflight[i] > 0 for i in range(self.config.nodes)
+            )
+
+    def _open_churn_gate(self) -> None:
+        with self._churn_cond:
+            self._churn_gate_open = True
+            self._churn_cond.notify_all()
+
+    # ----------------------------------------------------------------- churn
+
+    def _churn_loop(self, worker: int) -> None:
+        """One sustained-churn worker: create → resolve → prepare →
+        unprepare → delete, forever, on chips 1..N-1 (chip 0 of every node
+        is the fault injectors' reserved slot).  Workers partition the
+        chip space so they never contend on silicon; every apiserver step
+        runs under a deadline so a latency spike degrades to typed,
+        retryable errors instead of wedged threads."""
+        rng = random.Random((self.config.seed << 8) ^ worker)
+        chips = [
+            c
+            for c in range(1, self.config.chips_per_node)
+            if (c - 1) % self.config.churn_workers == worker
+        ]
+        if not chips:
+            return
+        seq = 0
+        while not self._stop.is_set():
+            node = self._acquire_node(rng)
+            if node is None:
+                self._stop.wait(0.05)
+                continue
+            try:
+                chip = rng.choice(chips)
+                uid = f"soak-{worker}-{seq}"
+                seq += 1
+                self._one_bind(node, chip, uid)
+            finally:
+                self._release_node(node)
+
+    def _one_bind(self, node: int, chip: int, uid: str) -> None:
+        driver = self.sim.drivers[node]
+        node_name = self.sim.node_names[node]
+        claim = make_claim(uid, node_name, [f"tpu-{chip}"], name=uid)
+        tag = self._current_tag()
+        t_sim = self._now()
+        t0 = time.perf_counter()
+        prepared = False
+        created = False
+        try:
+            with api_deadline(5.0):
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                created = True
+                resolved = driver.sockets.resolve_claim("default", uid, uid)
+                resp = driver.prepare_resource_claims([resolved])
+            err = resp["claims"][uid].get("error")
+            if err:
+                raise ApiError(f"prepare: {err}")
+            prepared = True
+            dt_ms = (time.perf_counter() - t0) * 1000.0
+            with api_deadline(5.0):
+                resp = driver.unprepare_resource_claims([{"uid": uid}])
+            err = resp["claims"][uid].get("error")
+            if err:
+                raise ApiError(f"unprepare: {err}")
+            prepared = False
+            with self._samples_lock:
+                self._bind_samples.append((t_sim, dt_ms, tag))
+        except ApiError as e:
+            # Expected under fault windows (deadline 504s, latency-failed
+            # verbs): recorded, cleaned up, and — when cleanup itself is
+            # beaten by the fault — left for the stale-claim GC, which the
+            # invariant monitor then holds to its budget.
+            with self._samples_lock:
+                self._bind_errors.append((t_sim, tag, str(e)[:120]))
+            if prepared:
+                self._best_effort_unprepare(driver, uid)
+        except Exception as e:  # noqa: BLE001 — a worker death would end churn
+            logger.exception("soak churn op %s failed unexpectedly", uid)
+            self._anomaly(f"churn op {uid}: {e}")
+            with self._samples_lock:
+                self._bind_errors.append((t_sim, tag, f"unexpected: {e}"[:120]))
+            if prepared:
+                self._best_effort_unprepare(driver, uid)
+        finally:
+            if created:
+                try:
+                    with api_deadline(5.0):
+                        self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+                except (NotFound, ApiError):
+                    ...  # GC reclaims the record; cascade covers the object
+
+    def _best_effort_unprepare(self, driver, uid: str) -> None:
+        try:
+            with api_deadline(5.0):
+                driver.unprepare_resource_claims([{"uid": uid}])
+        except Exception:  # noqa: BLE001 — the GC is the backstop
+            logger.info("soak: best-effort unprepare of %s failed", uid)
+
+    # ------------------------------------------------------ fault injectors
+
+    def _fault_loop(self) -> None:
+        """Draw (or replay) faults until the run ends; between faults, run
+        stale-claim GC passes round-robin so the GC path is continuously
+        live (the clock_skew fault then steps the clock under it)."""
+        replay_mode = self.config.replay_timeline is not None
+        replay = list(self.config.replay_timeline or [])
+        gc_interval_wall = self.simclock.wall_of(self.config.gc_interval_sim_s)
+        next_gc_wall = time.monotonic()
+        gc_node = 0
+        while not self._stop.is_set():
+            if replay_mode:
+                if not replay:
+                    # Timeline replayed to the end: no fresh draws — idle
+                    # (GC cadence only) so the run reproduces, not extends.
+                    spec = None
+                    gap_sim = 60.0
+                else:
+                    spec = replay.pop(0)
+                    gap_sim = max(0.0, spec["t_sim"] - self._now())
+            else:
+                spec = None
+                gap_sim = self._rng.expovariate(
+                    1.0 / self.config.fault_mean_gap_sim_s
+                )
+            deadline = time.monotonic() + self.simclock.wall_of(gap_sim)
+            while time.monotonic() < deadline and not self._stop.is_set():
+                self._maybe_clear_latency()
+                if time.monotonic() >= next_gc_wall:
+                    next_gc_wall = time.monotonic() + gc_interval_wall
+                    gc_node = (gc_node + 1) % self.config.nodes
+                    self._gc_pass(gc_node)
+                self._stop.wait(min(0.1, max(0.01, gc_interval_wall / 2)))
+            if self._stop.is_set():
+                break
+            if replay_mode and spec is None:
+                continue
+            try:
+                self._inject(spec)
+            except Exception as e:  # noqa: BLE001 — one fault must not end the soak
+                logger.exception("fault injection failed")
+                self._anomaly(f"fault injection raised: {e}")
+        self._maybe_clear_latency(force=True)
+
+    def _inject(self, spec: Optional[dict]) -> None:
+        if spec is None:
+            if self._kind_cycle:
+                kind = self._kind_cycle.pop(0)
+            else:
+                kind = self._rng.choice(list(self.config.fault_kinds))
+            node = self._rng.randrange(self.config.nodes)
+            point = self._rng.choice(CRASH_POINTS)
+            params: dict = {}
+            if kind == "apiserver_latency":
+                params = {
+                    "rtt_sim_s": self._rng.choice(
+                        list(self.config.latency_rtt_sim_choices)
+                    ),
+                    "window_sim_s": self._rng.uniform(60, 300),
+                }
+            elif kind == "clock_skew":
+                params = {"skew_s": self._rng.choice([-600.0, 600.0])}
+        else:
+            kind = spec["kind"]
+            node = spec.get("node") or 0
+            point = spec.get("point") or "post-journal-append"
+            params = dict(spec.get("params") or {})
+        self._fault_counter += 1
+        logger.info(
+            "soak fault #%d: %s node=%s point=%s params=%s (t_sim=%.0f)",
+            self._fault_counter, kind, node, point, params, self._now(),
+        )
+        if kind == "apiserver_latency":
+            self._inject_latency(params)
+        elif kind == "watch_close":
+            self._inject_watch_close()
+        elif kind == "kubelet_restart":
+            self._inject_kubelet_restart(node)
+        elif kind == "plugin_crash":
+            self._inject_crash(node, point, torn=False)
+        elif kind == "torn_wal":
+            self._inject_crash(node, "post-journal-append", torn=True)
+        elif kind == "clock_skew":
+            self._inject_clock_skew(params)
+        else:
+            self._anomaly(f"unknown fault kind {kind!r}")
+
+    def _inject_latency(self, params: dict) -> None:
+        record = FaultRecord(
+            kind="apiserver_latency", t_sim_start=self._now(), params=params
+        )
+        # Overlapping spikes are routine (windows up to 300 sim-s, mean
+        # gap 180): the new spike supersedes the old WINDOW, so close the
+        # displaced record first — a forever-open record would make every
+        # later quiet-window computation see an active fault and silently
+        # disable the slice-convergence checks.
+        with self._records_lock:
+            prev = (
+                self._latency_record
+                if self._latency_end_sim is not None
+                else None
+            )
+        if prev is not None:
+            self._end_fault(prev)
+        self._record_fault(record)
+        rtt_wall = self.simclock.wall_of(params["rtt_sim_s"])
+        record.params["rtt_wall_ms"] = round(rtt_wall * 1000.0, 1)
+        self.sim.kube.set_latency(rtt_wall)
+        # The window stays OPEN while the scheduler moves on to the next
+        # fault — this is where compounding comes from (a crash or a
+        # kubelet restart lands inside the spike).
+        with self._records_lock:
+            self._latency_end_sim = self._now() + params["window_sim_s"]
+            self._latency_record = record
+
+    def _maybe_clear_latency(self, force: bool = False) -> None:
+        with self._records_lock:
+            end = self._latency_end_sim
+        if end is None or (self._now() < end and not force):
+            return
+        self.sim.kube.set_latency(0.0)
+        with self._records_lock:
+            self._latency_end_sim = None
+            record = getattr(self, "_latency_record", None)
+        if record is not None:
+            self._end_fault(record)
+
+    def _inject_watch_close(self) -> None:
+        record = FaultRecord(kind="watch_close", t_sim_start=self._now())
+        self._record_fault(record)
+        closed = self.sim.kube.close_watches()
+        record.params["streams_closed"] = closed
+        # Recovery: every node's claim informer back to a live watch.
+        deadline = time.monotonic() + self.simclock.wall_of(
+            self.budget.recovery_sim_s
+        )
+        informers = [
+            d.claim_informer
+            for d in self.sim.drivers
+            if d.claim_informer is not None
+        ]
+        while time.monotonic() < deadline:
+            if all(inf.watch_healthy for inf in informers):
+                break
+            time.sleep(0.05)
+        recovered = all(inf.watch_healthy for inf in informers)
+        self._end_fault(record)
+        record.recovered_sim_s = (
+            record.t_sim_end - record.t_sim_start if recovered else None
+        )
+        if recovered:
+            self._recovery_samples.append(record.recovered_sim_s)
+        self._check(
+            INV_FAULT_RECOVERY,
+            recovered,
+            key=("watch_close", self._fault_counter),
+            detail="an informer watch never recovered after a forced close",
+        )
+
+    def _retry_prepare(self, node: int, claim: dict, budget_sim: float) -> bool:
+        """Kubelet's retry loop: re-prepare until granted or the sim
+        budget runs out (faults may be compounding — each attempt runs
+        under its own deadline and backs off with full jitter)."""
+        from tpudra.backoff import Backoff
+
+        driver_getter = lambda: self.sim.drivers[node]  # noqa: E731
+        uid = claim["metadata"]["uid"]
+        # Module-global jitter source, NOT the schedule rng: retry counts
+        # vary with wall timing, and feeding them from self._rng would let
+        # timing noise shift every later fault draw — the seed must pin
+        # the fault sequence, not the backoff jitter.
+        backoff = Backoff(0.1, 2.0)
+        deadline = time.monotonic() + self.simclock.wall_of(budget_sim)
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                with api_deadline(5.0):
+                    resp = driver_getter().prepare_resource_claims([claim])
+                entry = resp["claims"].get(uid, {})
+                if entry.get("devices"):
+                    return True
+                if entry.get("error") and entry.get("permanent"):
+                    return False
+            except ApiError:
+                ...  # deadline/latency: retry below
+            # The backoff sleep is wall time; cap it in SIM terms too so a
+            # high-compression run's retry loop gets more than a couple of
+            # attempts inside its sim-time recovery budget.
+            time.sleep(
+                min(
+                    backoff.next_delay(),
+                    0.5,
+                    max(0.02, self.simclock.wall_of(30.0)),
+                )
+            )
+        return False
+
+    def _inject_kubelet_restart(self, node: int) -> None:
+        """The kubelet-restart scenario, compressed: a kubelet that dies
+        between prepare and its own bookkeeping, then restarts.  Two
+        consequences must both hold (sim/kubelet.py's retry semantics):
+        the restarted kubelet's blind re-prepare of a live claim is
+        idempotent (same grant, no double-bind), and a claim whose API
+        object was deleted while kubelet was down is reclaimed by the
+        stale-claim GC — not leaked, not double-freed."""
+        record = FaultRecord(
+            kind="kubelet_restart", t_sim_start=self._now(), node=node
+        )
+        self._record_fault(record)
+        self._quarantine_node(node)
+        t0_sim = self._now()
+        try:
+            driver = self.sim.drivers[node]
+            node_name = self.sim.node_names[node]
+            uid = f"soak-kr-{self._fault_counter}"
+            claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
+            with api_deadline(5.0):
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            ok = self._retry_prepare(node, claim, self.budget.recovery_sim_s / 2)
+            # kubelet "restarts": its memory is gone; it re-prepares every
+            # pod claim it rediscovers.  The grant must come back without
+            # error (idempotent cached path).
+            redo = self._retry_prepare(node, claim, self.budget.recovery_sim_s / 2)
+            self._check(
+                INV_FAULT_RECOVERY,
+                ok and redo,
+                key=("kubelet_restart", self._fault_counter),
+                detail="re-prepare after simulated kubelet restart not idempotent",
+            )
+            # The pod was force-deleted while kubelet was down: the API
+            # object vanishes with no unprepare.  The stale-claim GC must
+            # reclaim the checkpoint record.
+            with api_deadline(5.0):
+                self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            reclaimed = 0
+            deadline = time.monotonic() + self.simclock.wall_of(
+                self.budget.recovery_sim_s
+            )
+            while time.monotonic() < deadline:
+                reclaimed = self._gc_pass(node)
+                if uid not in driver.state.prepared_claim_uids():
+                    break
+                time.sleep(0.1)
+            record.params["gc_reclaimed"] = reclaimed
+            self._check(
+                INV_FAULT_RECOVERY,
+                uid not in driver.state.prepared_claim_uids(),
+                key=("kubelet_restart_gc", self._fault_counter),
+                detail="orphaned claim not reclaimed by stale-claim GC",
+            )
+        finally:
+            self._unquarantine_node(node)
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+            self._recovery_samples.append(record.recovered_sim_s)
+
+    def _inject_crash(self, node: int, point: str, torn: bool) -> None:
+        """SIGKILL-equivalent at a checkpoint boundary, then recovery
+        through the real restart path — optionally with a torn WAL tail
+        injected before the restart (the power-cut-mid-append shape)."""
+        record = FaultRecord(
+            kind="torn_wal" if torn else "plugin_crash",
+            t_sim_start=self._now(),
+            node=node,
+            point=point,
+        )
+        self._record_fault(record)
+        self._quarantine_node(node)
+        t0_sim = self._now()
+        uid = f"soak-crash-{self._fault_counter}"
+        try:
+            driver = self.sim.drivers[node]
+            node_name = self.sim.node_names[node]
+            claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
+            with api_deadline(5.0):
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            if point == "mid-compaction":
+                # Force a compaction on the armed commit, the same lever
+                # the subprocess sweep pulls via TPUDRA_JOURNAL_MAX_RECORDS
+                # (the abandoned instance never needs the old value back).
+                driver._checkpoints._journal_max_records = 1
+            crashed = False
+            for _ in range(5):
+                try:
+                    with checkpoint_mod.armed_crash(point):
+                        with api_deadline(5.0):
+                            resolved = driver.sockets.resolve_claim(
+                                "default", uid, uid
+                            )
+                            driver.prepare_resource_claims([resolved])
+                    break  # prepare finished without reaching the boundary
+                except SimulatedCrash:
+                    crashed = True
+                    break
+                except ApiError:
+                    time.sleep(0.2)  # latency spike beat the resolve; retry
+            if not crashed:
+                self._anomaly(
+                    f"crash arm at {point} on node {node} never fired"
+                )
+            if torn:
+                wal = os.path.join(
+                    self.sim._base, f"p{node}", "checkpoint.wal"
+                )
+                with open(wal, "ab") as f:
+                    f.write(b"\xff\xff\x00\x00SOAK-TORN-TAIL")
+            # The process "dies": abandon without the shutdown compaction,
+            # then restart over the same dirs — the REAL recovery path.
+            self.sim.crash_node(node)
+            self.sim.restart_node(node)
+            recovered = self._retry_prepare(
+                node, claim, self.budget.recovery_sim_s
+            )
+            self._check(
+                INV_FAULT_RECOVERY,
+                recovered,
+                key=(record.kind, self._fault_counter),
+                detail=(
+                    f"claim did not converge to a grant after a crash at "
+                    f"{point} (torn={torn})"
+                ),
+            )
+            self._best_effort_unprepare(self.sim.drivers[node], uid)
+        finally:
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            except (NotFound, ApiError):
+                ...
+            self._unquarantine_node(node)
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+            self._recovery_samples.append(record.recovered_sim_s)
+
+    def _inject_clock_skew(self, params: dict) -> None:
+        """Step the shared GC wall clock ±10 min and run live stale-claim
+        GC passes under the skew.  With churn drained (gate closed), every
+        checkpointed claim has a live API object, so ANY unprepare here is
+        a premature GC — the failure the monotonic discipline forbids."""
+        record = FaultRecord(
+            kind="clock_skew", t_sim_start=self._now(), params=params
+        )
+        self._record_fault(record)
+        drained = self._close_churn_gate()
+        try:
+            if not drained:
+                # A churn op outlived the drain (compounding latency can
+                # stack several deadline windows): the zero-collection
+                # assertion would misattribute that op's genuine orphan to
+                # the skew.  Step the clock and run the passes anyway —
+                # the claim-stuck/leak monitors still police the outcome —
+                # but don't assert the count.
+                self._anomaly(
+                    "clock_skew: churn did not drain; skew GC passes ran "
+                    "unasserted"
+                )
+                self._gc_clock.wall_skew_s = params["skew_s"]
+                for i in range(self.config.nodes):
+                    self._gc_pass(i)
+                return
+            # Drain genuine orphans (a churn op whose cleanup a fault beat)
+            # UNskewed first: with the gate closed and this thread the only
+            # fault source, anything the skewed passes then collect can
+            # only be skew-induced.
+            for i in range(self.config.nodes):
+                self._gc_pass(i)
+            self._gc_clock.wall_skew_s = params["skew_s"]
+            collected = sum(
+                self._gc_pass(i) for i in range(self.config.nodes)
+            )
+            record.params["collected_under_skew"] = collected
+            self._check(
+                INV_FAULT_RECOVERY,
+                collected == 0,
+                key=("clock_skew", self._fault_counter),
+                detail=(
+                    f"stale-claim GC unprepared {collected} live claim(s) "
+                    f"under {params['skew_s']:+.0f}s wall skew"
+                ),
+            )
+        finally:
+            self._gc_clock.wall_skew_s = 0.0
+            self._open_churn_gate()
+            self._end_fault(record)
+
+    def _gc_pass(self, node: int) -> int:
+        try:
+            with api_deadline(3.0):
+                return self.sim.drivers[node].cleanup.cleanup_once()
+        except Exception:  # noqa: BLE001 — GC races churn/crashes by design
+            logger.info("soak GC pass on node %d failed", node, exc_info=True)
+            return 0
+
+    # ------------------------------------------------------------- monitor
+
+    def _monitor_loop(self) -> None:
+        interval_wall = max(
+            0.05, self.simclock.wall_of(self.config.monitor_interval_sim_s)
+        )
+        while not self._stop.wait(interval_wall):
+            try:
+                self._monitor_once()
+            except Exception:  # noqa: BLE001 — the monitor must outlive faults
+                logger.exception("invariant monitor pass failed")
+        self._monitor_once()  # final pass after churn stops
+
+    def _monitor_once(self) -> None:
+        self._check_claim_stuck()
+        self._check_leaks()
+        self._check_slice_convergence()
+
+    def _check_claim_stuck(self) -> None:
+        """No claim may sit in a non-terminal phase (PrepareStarted) for
+        more than T sim seconds — across crashes, restarts, and GC."""
+        live_keys = []
+        for i in range(self.config.nodes):
+            try:
+                statuses = self.sim.drivers[i].state.prepared_claim_uids()
+            except Exception:  # noqa: BLE001 — mid-restart window
+                logger.info("claim-stuck scan skipped node %d", i, exc_info=True)
+                continue
+            for uid, (_, _, status) in statuses.items():
+                key = (i, uid)
+                live_keys.append(key)
+                if status != PREPARE_STARTED:
+                    self._stuck_ager.forget(key)
+                    continue
+                age_sim = (
+                    self._stuck_ager.age(key, status) * self.config.compression
+                )
+                with self._records_lock:
+                    self._max_stuck_sim = max(self._max_stuck_sim, age_sim)
+                self._check(
+                    INV_CLAIM_STUCK,
+                    age_sim <= self.budget.max_claim_stuck_sim_s,
+                    key=key,
+                    detail=(
+                        f"claim {uid} on node {i} stuck in {status} for "
+                        f"{age_sim:.0f} sim-seconds (budget "
+                        f"{self.budget.max_claim_stuck_sim_s:.0f})"
+                    ),
+                )
+        self._stuck_ager.prune(live_keys)
+        self._pass_check(INV_CLAIM_STUCK)
+
+    def _check_leaks(self) -> None:
+        """No CDI spec file and no per-uid flock file may outlive its
+        checkpoint record beyond the leak grace (sim time) — the leaks a
+        crashed prepare or a half-done unprepare would leave."""
+        grace = self.budget.leak_grace_sim_s
+        live_keys = []
+        for i in range(self.config.nodes):
+            try:
+                uids = set(self.sim.drivers[i].state.prepared_claim_uids())
+            except Exception:  # noqa: BLE001 — mid-restart window
+                logger.info("leak scan skipped node %d", i, exc_info=True)
+                continue
+            for sub, invariant in (("c", INV_CDI_LEAK), ("p", INV_FLOCK_LEAK)):
+                root = os.path.join(self.sim._base, f"{sub}{i}")
+                if sub == "p":
+                    root = os.path.join(root, "claims")
+                try:
+                    names = os.listdir(root)
+                except OSError:
+                    continue
+                for name in names:
+                    if sub == "p" and not name.endswith(".lock"):
+                        continue
+                    if sub == "c" and not name.endswith(".json"):
+                        continue
+                    orphan = not any(uid in name for uid in uids)
+                    key = (invariant, i, name)
+                    live_keys.append(key)
+                    if not orphan:
+                        self._leak_ager.forget(key)
+                        continue
+                    age_sim = (
+                        self._leak_ager.age(key, "orphan")
+                        * self.config.compression
+                    )
+                    self._check(
+                        invariant,
+                        age_sim <= grace,
+                        key=key,
+                        detail=(
+                            f"{name} on node {i} has no checkpoint record "
+                            f"for {age_sim:.0f} sim-seconds (grace {grace:.0f})"
+                        ),
+                    )
+        self._leak_ager.prune(live_keys)
+        self._pass_check(INV_CDI_LEAK)
+        self._pass_check(INV_FLOCK_LEAK)
+
+    def _check_slice_convergence(self) -> None:
+        """After every fault window (plus the convergence budget), the
+        published ResourceSlice content must equal checkpoint truth: every
+        allocatable device of every node advertised, nothing else.  Only
+        asserted in QUIET windows — while faults are live the slices may
+        legitimately lag."""
+        now = self._now()
+        with self._records_lock:
+            if self._active:
+                return
+            last_end = max(
+                (r.t_sim_end or now for r in self._timeline), default=0.0
+            )
+        if now - last_end < self.budget.convergence_sim_s and last_end > 0:
+            return
+        try:
+            listing = self.sim.kube.list(gvr.RESOURCE_SLICES)
+        except ApiError:
+            return
+        by_node: dict[str, set] = {}
+        for item in listing.get("items", []):
+            spec = item.get("spec", {})
+            if spec.get("driver") == TPU_DRIVER_NAME:
+                devs = by_node.setdefault(spec.get("nodeName", ""), set())
+                for d in spec.get("devices", []):
+                    devs.add(d.get("name"))
+        for i in range(self.config.nodes):
+            node_name = self.sim.node_names[i]
+            try:
+                driver = self.sim.drivers[i]
+                expected = (
+                    set(driver.state.allocatable)
+                    - driver.unhealthy_devices()
+                    - driver.state.bound_sibling_devices()
+                )
+            except Exception:  # noqa: BLE001 — mid-restart window
+                logger.info("slice scan skipped node %d", i, exc_info=True)
+                continue
+            published = by_node.get(node_name, set())
+            self._check(
+                INV_SLICE_CONVERGENCE,
+                published == expected,
+                key=(i, "slices", len(self._timeline)),
+                detail=(
+                    f"node {node_name}: published {sorted(published)} != "
+                    f"checkpoint truth {sorted(expected)} in a quiet window"
+                ),
+            )
+        self._pass_check(INV_SLICE_CONVERGENCE)
+
+    def _check_lock_witness(self) -> None:
+        """Finalize-time merge of the runtime witness log against the
+        static lock model: a witnessed cycle or a model gap under compound
+        faults is an ordering bug the quiet-path tests never provoked."""
+        if not self.config.witness:
+            return
+        log = lockwitness.log_path()
+        if not os.path.exists(log):
+            self._anomaly("witness armed but no witness log was written")
+            return
+        from tpudra.analysis.witness import build_graph, merge
+
+        graph = build_graph(os.path.dirname(os.path.dirname(__file__)))
+        report = merge(graph, log)
+        self._check(
+            INV_LOCK_WITNESS,
+            report.ok,
+            key="witness",
+            detail=(
+                f"cycles={report.witnessed_cycles} "
+                f"gaps={report.model_gaps}"
+            ),
+        )
+
+    # ------------------------------------------------------------------ run
+
+    def run(self) -> dict:
+        self.sim.start()
+        workers = [
+            threading.Thread(
+                target=self._churn_loop, args=(w,), name=f"soak-churn-{w}"
+            )
+            for w in range(self.config.churn_workers)
+        ]
+        fault_thread = threading.Thread(target=self._fault_loop, name="soak-faults")
+        monitor = threading.Thread(target=self._monitor_loop, name="soak-monitor")
+        for t in (*workers, fault_thread, monitor):
+            t.start()
+        try:
+            time.sleep(self.config.wall_s)
+        finally:
+            self._stop.set()
+            for t in (*workers, fault_thread, monitor):
+                t.join(timeout=30)
+            self._maybe_clear_latency(force=True)
+        # Post-run settle: one GC sweep + a final convergence check in a
+        # guaranteed-quiet cluster, then the witness merge.
+        for i in range(self.config.nodes):
+            self._gc_pass(i)
+        self._check_lock_witness()
+        report = self._report()
+        self.sim.close()
+        path = self.config.report_path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        logger.info("soak report written to %s", path)
+        return report
+
+    # --------------------------------------------------------------- report
+
+    def _report(self) -> dict:
+        with self._samples_lock:
+            samples = list(self._bind_samples)
+            errors = list(self._bind_errors)
+        with self._records_lock:
+            timeline = list(self._timeline)
+            checks = {k: dict(v) for k, v in self._checks.items()}
+            violations = list(self._violations)
+            anomalies = list(self._anomalies)
+            max_stuck = self._max_stuck_sim
+        by_window: dict[str, list[float]] = {}
+        for _, ms, tag in samples:
+            by_window.setdefault(tag, []).append(ms)
+        err_by_window: dict[str, int] = {}
+        for _, tag, _ in errors:
+            err_by_window[tag] = err_by_window.get(tag, 0) + 1
+        all_ms = [ms for _, ms, _ in samples]
+        overall = latency_summary(all_ms)
+        by_kind: dict[str, int] = {}
+        for r in timeline:
+            by_kind[r.kind] = by_kind.get(r.kind, 0) + 1
+        sim_hours = self._now() / 3600.0
+        budget = self.budget
+        slo = {
+            "bind_p99_ms": {
+                "value": overall["p99_ms"],
+                "budget": budget.bind_p99_ms,
+                "ok": bool(all_ms) and overall["p99_ms"] <= budget.bind_p99_ms,
+            },
+            "max_claim_stuck_sim_s": {
+                "value": round(max_stuck, 1),
+                "budget": budget.max_claim_stuck_sim_s,
+                "ok": max_stuck < budget.max_claim_stuck_sim_s,
+            },
+            "invariant_violations": {
+                "value": len(violations),
+                "budget": 0,
+                "ok": not violations,
+            },
+        }
+        return {
+            "config": {
+                "seed": self.config.seed,
+                "nodes": self.config.nodes,
+                "chips_per_node": self.config.chips_per_node,
+                "wall_s": self.config.wall_s,
+                "compression": self.config.compression,
+                "fault_kinds": list(self.config.fault_kinds),
+                "budget": asdict(budget),
+                "witness": self.config.witness,
+            },
+            "sim_hours": round(sim_hours, 3),
+            "faults": {
+                "injected_total": len(timeline),
+                "by_kind": by_kind,
+                "timeline": [
+                    {
+                        **r.spec(),
+                        "t_sim_end": (
+                            round(r.t_sim_end, 1)
+                            if r.t_sim_end is not None
+                            else None
+                        ),
+                        "recovered_sim_s": (
+                            round(r.recovered_sim_s, 1)
+                            if r.recovered_sim_s is not None
+                            else None
+                        ),
+                    }
+                    for r in timeline
+                ],
+            },
+            "bind": {
+                "overall": overall,
+                "by_window": {
+                    tag: latency_summary(ms) for tag, ms in by_window.items()
+                },
+                "errors": {
+                    "total": len(errors),
+                    "by_window": err_by_window,
+                },
+            },
+            "invariants": {
+                inv: {
+                    "checks": counts["ok"] + counts["violation"],
+                    "violations": counts["violation"],
+                }
+                for inv, counts in checks.items()
+            },
+            "recovery": {
+                "samples_sim_s": [round(s, 1) for s in self._recovery_samples],
+                "max_sim_s": (
+                    round(max(self._recovery_samples), 1)
+                    if self._recovery_samples
+                    else 0.0
+                ),
+                "budget_sim_s": budget.recovery_sim_s,
+            },
+            "anomalies": anomalies,
+            "violations": violations,
+            "slo": slo,
+        }
+
+
+# --------------------------------------------------------------------- CLI
+
+PROFILES = {
+    # ≤ 120 s wall including the witness merge; ≥ 1 simulated hour.
+    "short": dict(wall_s=75.0, compression=60.0),
+    # A developer-box long run: ~10 simulated hours.
+    "long": dict(wall_s=600.0, compression=60.0),
+}
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Chaos soak: compound-fault long-run with continuous "
+        "invariant assertions and a JSON SLO report (docs/chaos.md)."
+    )
+    parser.add_argument("--profile", choices=sorted(PROFILES), default="short")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--nodes", type=int, default=None)
+    parser.add_argument("--wall-s", type=float, default=None)
+    parser.add_argument("--compression", type=float, default=None)
+    parser.add_argument("--report", default="/tmp/tpudra_soak.json")
+    parser.add_argument(
+        "--replay",
+        default=None,
+        metavar="REPORT_JSON",
+        help="re-execute the fault timeline recorded in a prior report "
+        "(or in one of its violations) instead of drawing a fresh one",
+    )
+    parser.add_argument(
+        "--no-witness",
+        action="store_true",
+        help="skip the lock-witness arming + finalize merge",
+    )
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    cfg_kwargs = dict(PROFILES[args.profile])
+    if args.nodes is not None:
+        cfg_kwargs["nodes"] = args.nodes
+    if args.wall_s is not None:
+        cfg_kwargs["wall_s"] = args.wall_s
+    if args.compression is not None:
+        cfg_kwargs["compression"] = args.compression
+    replay_timeline = None
+    seed = args.seed
+    if args.replay:
+        with open(args.replay) as f:
+            prior = json.load(f)
+        if prior.get("violations"):
+            replay = prior["violations"][0]["replay"]
+            replay_timeline = replay["timeline"]
+            seed = replay["seed"]
+        else:
+            replay_timeline = prior["faults"]["timeline"]
+            seed = prior["config"]["seed"]
+    config = ChaosConfig(
+        seed=seed,
+        report_path=args.report,
+        witness=not args.no_witness,
+        replay_timeline=replay_timeline,
+        **cfg_kwargs,
+    )
+    report = ChaosSoak(config).run()
+    ok = all(entry["ok"] for entry in report["slo"].values())
+    print(
+        json.dumps(
+            {
+                "sim_hours": report["sim_hours"],
+                "faults": report["faults"]["by_kind"],
+                "bind_p99_ms": report["bind"]["overall"]["p99_ms"],
+                "violations": len(report["violations"]),
+                "slo_ok": ok,
+                "report": args.report,
+            },
+            indent=2,
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from tpudra import TPU_DRIVER_NAME
+from tpudra.clock import Clock
 from tpudra.controller.controller import Controller, ManagerConfig
 from tpudra.kube import gvr
 from tpudra.kube.accounting import AccountingKube
@@ -153,18 +154,16 @@ class ClusterScaleConfig:
     watch_history_limit: int = 32768
     driver_namespace: str = "tpudra-system"
     base_dir: Optional[str] = None
+    #: Clock handed to every driver's stale-claim GC (tpudra/clock.py).
+    #: The chaos soak passes a SkewedClock so its clock_skew fault can
+    #: step the wall reading under live GC passes; None = system clock.
+    gc_clock: Optional[Clock] = None
 
 
 class ClusterScaleSim:
     """N plugin drivers + one controller against one accounted FakeKube."""
 
     def __init__(self, config: ClusterScaleConfig):
-        # Imports deferred so `import tpudra.sim.cluster` stays cheap for
-        # tools that only want the claim/CD builders.
-        from tpudra.devicelib.mock import MockDeviceLib
-        from tpudra.devicelib.topology import MockTopologyConfig
-        from tpudra.plugin.driver import Driver, DriverConfig
-
         self.config = config
         self._rng = random.Random(config.seed)
         self.kube = AccountingKube(
@@ -175,44 +174,29 @@ class ClusterScaleSim:
             )
         )
         self._stop = threading.Event()
+        #: Per-node stop events for the claim informers: a crashed node's
+        #: informer must actually STOP (a dead plugin holds no watch), not
+        #: ride the sim-wide event until close() — each plugin_crash in a
+        #: long soak would otherwise leak a thread plus a live FakeKube
+        #: watcher still being fanned events.
+        self._node_stops: list[threading.Event] = [
+            threading.Event() for _ in range(config.nodes)
+        ]
         self._tmp = tempfile.TemporaryDirectory(
             prefix="tpudra-cluster-", dir=config.base_dir or scratch_base()
         )
         base = self._tmp.name
 
+        self._base = base
         self.node_names: list[str] = [f"node-{i:04d}" for i in range(config.nodes)]
         for name in self.node_names:
             self.kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
-
-        def build_node(i: int):
-            lib = MockDeviceLib(
-                config=MockTopologyConfig(
-                    generation=config.generation, num_chips=config.chips_per_node
-                ),
-                state_file=os.path.join(base, f"hw-{i}.json"),
-            )
-            driver = Driver(
-                DriverConfig(
-                    node_name=self.node_names[i],
-                    plugin_dir=os.path.join(base, f"p{i}"),
-                    registry_dir=os.path.join(base, f"r{i}"),
-                    cdi_root=os.path.join(base, f"c{i}"),
-                    claim_cache=config.node_informers,
-                    # Fresh fake: no prior slices to outrank, and N
-                    # constructor LISTs over a growing slice set would
-                    # be O(N^2) startup work.
-                    initial_pool_generation=1,
-                ),
-                self.kube,
-                lib,
-            )
-            return lib, driver
 
         # Node construction is syscall-bound (checkpoint dirs, device-state
         # files) and the syscalls release the GIL — build concurrently or a
         # 1024-node cluster pays minutes of serial mkdir/stat.
         with ThreadPoolExecutor(max_workers=max(8, config.workers)) as ctor_pool:
-            built = list(ctor_pool.map(build_node, range(config.nodes)))
+            built = list(ctor_pool.map(self._build_node, range(config.nodes)))
         self._libs = [lib for lib, _ in built]
         self.drivers = [driver for _, driver in built]
 
@@ -255,6 +239,71 @@ class ClusterScaleSim:
         )
         self._started = False
 
+    def _build_node(self, i: int, initial_pool_generation: Optional[int] = 1):
+        """Construct node ``i``'s device lib + plugin driver over its
+        persistent dirs (``hw-{i}.json`` / ``p{i}`` / ``r{i}`` / ``c{i}``
+        under the scratch base).  First build passes pool generation 1
+        (fresh fake: nothing to outrank, and N constructor LISTs over a
+        growing slice set would be O(N²) startup work); ``restart_node``
+        passes None so a restarted driver takes the production reseed path
+        and outranks its previous incarnation's slices."""
+        # Imports deferred so `import tpudra.sim.cluster` stays cheap for
+        # tools that only want the claim/CD builders.
+        from tpudra.devicelib.mock import MockDeviceLib
+        from tpudra.devicelib.topology import MockTopologyConfig
+        from tpudra.plugin.driver import Driver, DriverConfig
+
+        config = self.config
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(
+                generation=config.generation, num_chips=config.chips_per_node
+            ),
+            state_file=os.path.join(self._base, f"hw-{i}.json"),
+        )
+        driver = Driver(
+            DriverConfig(
+                node_name=self.node_names[i],
+                plugin_dir=os.path.join(self._base, f"p{i}"),
+                registry_dir=os.path.join(self._base, f"r{i}"),
+                cdi_root=os.path.join(self._base, f"c{i}"),
+                claim_cache=config.node_informers,
+                initial_pool_generation=initial_pool_generation,
+                gc_clock=config.gc_clock,
+            ),
+            self.kube,
+            lib,
+        )
+        return lib, driver
+
+    # ----------------------------------------------------- fault injection
+
+    def crash_node(self, i: int) -> None:
+        """Abandon node ``i``'s driver the way SIGKILL would (no clean-
+        shutdown journal compaction — ``Driver.crash_stop``).  The node's
+        on-disk state freezes at whatever boundary its last checkpoint
+        commit reached; ``restart_node`` must then converge through the
+        real recovery path.  The node's claim informer stops with it — a
+        dead plugin holds no watch.  The chaos soak (sim/chaos.py) is the
+        caller."""
+        self._node_stops[i].set()
+        self.drivers[i].crash_stop()
+
+    def restart_node(self, i: int) -> None:
+        """Rebuild node ``i``'s driver over the same persistent dirs — the
+        crashed (or cleanly stopped) plugin's restart.  Recovery is the
+        REAL path: checkpoint snapshot + journal replay with torn-tail
+        truncation, pool generation reseeded from live slices, informer
+        re-sync, slice republication."""
+        lib, driver = self._build_node(i, initial_pool_generation=None)
+        self._libs[i] = lib
+        self.drivers[i] = driver
+        self._node_stops[i] = threading.Event()
+        if self._started:
+            driver.publish_resources()
+            if self.config.node_informers and driver.claim_informer is not None:
+                driver.claim_informer.start(self._node_stops[i])
+                driver.claim_informer.wait_for_sync(30)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self, controller: bool = True) -> "ClusterScaleSim":
@@ -272,8 +321,8 @@ class ClusterScaleSim:
             ),
         }
         if self.config.node_informers:
-            for d in self.drivers:
-                d.claim_informer.start(self._stop)
+            for i, d in enumerate(self.drivers):
+                d.claim_informer.start(self._node_stops[i])
         self._lag_informer.start(self._stop)
         self._lag_informer.wait_for_sync()
         if controller:
@@ -290,6 +339,8 @@ class ClusterScaleSim:
 
     def close(self) -> None:
         self._stop.set()
+        for stop in self._node_stops:
+            stop.set()
         self.controller.queue.shutdown()
         self._pool.shutdown(wait=False)
         for d in self.drivers:

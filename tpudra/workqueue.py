@@ -37,12 +37,17 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from tpudra import lockwitness
+from tpudra.backoff import capped_exponential
 
 logger = logging.getLogger(__name__)
 
 
 class ExponentialBackoff:
-    """Per-item exponential backoff: base * 2^failures, capped.
+    """Per-item exponential backoff: base * 2^failures, capped — the
+    window arithmetic comes from the shared ``tpudra/backoff.py`` policy
+    (overflow-clamped ``capped_exponential``); this class adds the
+    per-item failure bookkeeping and the limiter's historical
+    multiplicative-jitter contract on top.
 
     ``rng`` injects the jitter source (``random.Random(seed)``) so
     cluster-scale A/B arms are reproducible; default is the module-global
@@ -66,7 +71,7 @@ class ExponentialBackoff:
         with self._lock:
             n = self._failures.get(item, 0)
             self._failures[item] = n + 1
-        delay = min(self.base * (2**n), self.cap)
+        delay = capped_exponential(self.base, self.cap, n)
         if self.jitter:
             delay *= 1.0 + self.rng.uniform(0, self.jitter)
         return delay
