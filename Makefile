@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang e2e-multihost soak image helm-render clean
 
 all: native test
 
@@ -113,6 +113,22 @@ bench-cluster:
 	set -o pipefail; python bench.py --cluster-scale \
 	  --nodes $(CLUSTER_NODES) \
 	  | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Multi-host e2e (docs/multi-host.md): gang-reserve a ComputeDomain claim
+# for a 4-node slice, launch one real OS process per node, run a
+# cross-process jax.distributed psum, and prove the kill-one-rank case
+# rolls back to zero bound claims — plus the gang crash sweep
+# (mid-gang-reserve / mid-gang-rollback, tests/test_gang.py).
+e2e-multihost:
+	env JAX_PLATFORMS=cpu python -m pytest -q -m multihost tests/test_multihost.py
+	env JAX_PLATFORMS=cpu python -m pytest -q tests/test_gang.py
+
+# Gang-bind latency A/B (docs/multi-host.md): p50/p99 for 2/4/8-node
+# slices with interleaved bound-vs-rollback arms, through real CD plugin
+# drivers; CPU-only.
+bench-gang:
+	set -o pipefail; python bench.py --gang | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 # Chaos soak (docs/chaos.md): compound-fault long-run — apiserver latency

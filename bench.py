@@ -46,6 +46,11 @@ Knobs (for A/B runs on the bind path):
                            (DriverConfig.claim_cache off), so the cost the
                            claim cache removes is measured, not argued
                            (`make bench-apiserver`)
+  --gang [--sizes 2,4,8]   gang-reservation A/B (`make bench-gang`,
+                           docs/multi-host.md): all-or-nothing gang bind
+                           p50/p99 by slice size, interleaved
+                           bound-vs-rollback arms through real CD plugin
+                           drivers
 """
 
 from __future__ import annotations
@@ -1050,6 +1055,128 @@ def bench_scale() -> dict:
         return out
 
 
+def bench_gang(sizes: list[int] = None, iters: int = None) -> dict:
+    """Gang-bind latency A/B (`make bench-gang`, docs/multi-host.md):
+    all-or-nothing slice reservation (controller/gang.py) through REAL CD
+    plugin drivers, for 2/4/8-node slices.
+
+    Two arms per size, TRULY interleaved (bound iter i, then rollback
+    iter i — same drivers, same checkpoint files, so filesystem-cache
+    drift taxes both arms equally):
+
+      bound     — every member binds; reserve() wall time measured, the
+                  (untimed) release tears down between iters
+      rollback  — the LAST member's bind fails (its ComputeDomain is
+                  unknown on that node), so reserve() pays N-1 binds plus
+                  the full unwind; the measured time is the price of the
+                  all-or-nothing guarantee on the failure path
+
+    Checkpoints live on the in-memory scratch base (the gang section
+    measures control-plane work, not host fsync — bench-checkpoint owns
+    that axis).
+    """
+    import shutil
+    import tempfile
+
+    from tpudra.controller.gang import (
+        GangBindError,
+        GangMember,
+        GangReservationManager,
+    )
+    from tpudra.kube import gvr as gvr_mod
+    from tpudra.kube.fake import FakeKube
+    from tpudra.plugin.checkpoint import CheckpointManager
+    from tpudra.sim.cluster import latency_summary, scratch_base
+    from tpudra.sim.multihost import (
+        DriverGangBinder,
+        build_cd_stack,
+        close_cd_stack,
+        make_channel_claim,
+        make_compute_domain,
+    )
+
+    sizes = sizes or [2, 4, 8]
+    iters = iters if iters is not None else 15
+    max_nodes = max(sizes)
+    base = tempfile.mkdtemp(prefix="tpudra-gangbench-", dir=scratch_base())
+    out: dict = {"sizes": sizes, "iters": iters}
+    drivers: dict = {}
+    gang_cp = None
+    try:
+        kube = FakeKube()
+        nodes = [f"gb-node-{i}" for i in range(max_nodes)]
+        for name in nodes:
+            kube.create(gvr_mod.NODES, {"metadata": {"name": name}, "spec": {}})
+        drivers = build_cd_stack(kube, nodes, base, num_hosts=max_nodes)
+        gang_cp = CheckpointManager(os.path.join(base, "gangs"))
+        mgr = GangReservationManager(gang_cp, DriverGangBinder(drivers))
+
+        def mk_domain(uid: str, member_nodes: list[str]) -> None:
+            kube.create(
+                gvr_mod.COMPUTE_DOMAINS,
+                make_compute_domain(uid, uid, member_nodes),
+                "default",
+            )
+
+        seq = [0]
+
+        def one_gang(k: int, rollback_arm: bool) -> float:
+            seq[0] += 1
+            gang_id = f"bench-{seq[0]}"
+            uid = f"{gang_id}-uid"
+            member_nodes = nodes[:k]
+            mk_domain(uid, member_nodes)
+            members = [
+                GangMember(node=n, claim_uid=f"{gang_id}-m{j}")
+                for j, n in enumerate(member_nodes)
+            ]
+            claims = {}
+            for j, m in enumerate(members):
+                # Rollback arm: the LAST member's claim names a domain
+                # this cluster has never seen → its bind fails after the
+                # first k-1 members are bound, forcing the full unwind.
+                domain = (
+                    "no-such-domain"
+                    if rollback_arm and j == len(members) - 1
+                    else uid
+                )
+                claims[m.claim_uid] = make_channel_claim(
+                    m.claim_uid, m.node, domain
+                )
+            t0 = time.perf_counter()
+            try:
+                mgr.reserve(gang_id, members, claims)
+                dt = (time.perf_counter() - t0) * 1000.0
+                mgr.release(gang_id)
+            except GangBindError:
+                dt = (time.perf_counter() - t0) * 1000.0
+            kube.delete(gvr_mod.COMPUTE_DOMAINS, uid, "default")
+            return dt
+
+        for k in sizes:
+            bound_ms: list[float] = []
+            rollback_ms: list[float] = []
+            one_gang(k, False)  # warmup (checkpoint files, label paths)
+            for _ in range(iters):
+                bound_ms.append(one_gang(k, False))
+                rollback_ms.append(one_gang(k, True))
+            out[f"nodes_{k}"] = {
+                "bound": latency_summary(bound_ms),
+                "rollback": latency_summary(rollback_ms),
+            }
+    except Exception as e:  # noqa: BLE001 — bench must always print its line
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        close_cd_stack(drivers)
+        if gang_cp is not None:
+            try:
+                gang_cp.close()
+            except Exception:  # tpudra-lint: disable=EXC-SWALLOW the scratch dir is removed next line; a failed shutdown compaction has no one to report to
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def bench_cluster_scale(
     nodes_list: list[int] = None,
     churn: int = None,
@@ -1629,6 +1756,21 @@ def main(argv=None) -> None:
                 nodes_list=nodes_list, churn=churn_arg, seed=seed_arg
             ),
         }
+        print(json.dumps(line))
+        return
+
+    if "--gang" in argv:
+        # The gang-reservation A/B artifact (`make bench-gang`): bind
+        # p50/p99 for 2/4/8-node slices, interleaved bound-vs-rollback
+        # arms through real CD plugin drivers; CPU-only.
+        argv.remove("--gang")
+        sizes_arg = _pop_str_flag(argv, "--sizes")
+        sizes = (
+            [int(x) for x in sizes_arg.split(",") if x.strip()]
+            if sizes_arg
+            else None
+        )
+        line = {"metric": "gang_bind", **bench_gang(sizes=sizes, iters=iters)}
         print(json.dumps(line))
         return
 

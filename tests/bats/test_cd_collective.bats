@@ -86,6 +86,11 @@ spec:
           assert os.environ["TPU_SKIP_MDS_QUERY"] == "true"
           assert os.environ["TPU_HOST_BOUNDS"], "no host bounds injected"
           assert os.environ["TPU_CHIPS_PER_HOST_BOUNDS"], "no chip bounds"
+          # Slice geometry rides the grant (cdplugin/libtpuenv.slice_env):
+          # each rank learns its mesh position from the claim alone.
+          mesh = [int(v) for v in os.environ["TPUDRA_MESH_SHAPE"].split(",")]
+          coords = [int(v) for v in os.environ["TPUDRA_HOST_COORDS"].split(",")]
+          assert len(mesh) == 3 and all(c < m for c, m in zip(coords, mesh)), (coords, mesh)
           import jax
           jax.config.update("jax_platforms", "cpu")
           from tpudra.workload.envspec import ClaimEnv
@@ -116,8 +121,8 @@ spec:
 EOF
   done
   kubectl apply -f "$TPUDRA_STATE/coll.yaml"
-  wait_until 300 pod_succeeded worker-0 coll
-  wait_until 300 pod_succeeded worker-1 coll
+  wait_until 240 pod_succeeded worker-0 coll
+  wait_until 240 pod_succeeded worker-1 coll
   run kubectl logs worker-0 -n coll
   [[ "$output" == *"RESULT psum: 12.0 host 0"* ]]
   run kubectl logs worker-1 -n coll
@@ -166,8 +171,8 @@ for d in docs:
 print(yaml.safe_dump_all(docs))
 PYEOF
   kubectl apply -f "$TPUDRA_STATE/coll2.yaml"
-  wait_until 300 pod_succeeded worker2-0 coll
-  wait_until 300 pod_succeeded worker2-1 coll
+  wait_until 240 pod_succeeded worker2-0 coll
+  wait_until 240 pod_succeeded worker2-1 coll
   run kubectl logs worker2-1 -n coll
   [[ "$output" == *"RESULT psum: 12.0 host 1"* ]]
   # The replacement daemon served the proxy: same deterministic pod name,
@@ -217,8 +222,8 @@ for d in docs:
 print(yaml.safe_dump_all(docs))
 PYEOF
   kubectl apply -f "$TPUDRA_STATE/coll3-h0.yaml"
-  wait_until 300 pod_succeeded worker3-0 coll
-  wait_until 300 pod_succeeded worker3-1 coll
+  wait_until 240 pod_succeeded worker3-0 coll
+  wait_until 240 pod_succeeded worker3-1 coll
   run kubectl logs worker3-1 -n coll
   [[ "$output" == *"RESULT psum: 12.0 host 1"* ]]
 }

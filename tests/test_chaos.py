@@ -306,3 +306,65 @@ class TestMiniSoak:
         assert [f["kind"] for f in second["faults"]["timeline"]] == [
             f["kind"] for f in recorded
         ]
+
+
+class TestCdWave:
+    """The cd_wave fault: gang reservations through real CD plugin
+    drivers inside the soak (ISSUE 9 satellite — ROADMAP item 5's "CD
+    stack inside the soak" headroom)."""
+
+    def test_cd_wave_binds_and_converges_to_zero(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            soak._inject({"kind": "cd_wave", "t_sim": 0.0, "node": 0,
+                          "point": None, "params": {"nodes": [0, 1]}})
+            assert soak._gang_mgr is not None
+            record = soak._timeline[-1]
+            assert record.kind == "cd_wave"
+            assert record.params.get("outcome") == "bound"
+            # Converged: no gang record, no bound members, recovery timed.
+            assert soak._gang_mgr.gangs() == {}
+            for d in soak._cd_drivers.values():
+                assert not [
+                    u for u in d.state.prepared_claim_uids()
+                    if u.startswith("soak-cdw-")
+                ]
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert soak._checks["gang-atomicity"]["violation"] == 0
+            # The quiet-window monitor check passes over the steady state.
+            soak._check_gang_atomicity()
+            assert soak._checks["gang-atomicity"]["ok"] > 0
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak.sim.close()
+
+    def test_cd_wave_under_latency_rolls_back_atomically(self, tmp_path):
+        """A latency spike harsh enough to beat the 5 s member deadline:
+        whatever the outcome, no partial gang may survive the wave."""
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            # ~0.9 s per verb: a member bind (several verbs under one 5 s
+            # deadline) dies mid-gang with high probability.
+            soak.sim.kube.set_latency(0.9)
+            soak._inject({"kind": "cd_wave", "t_sim": 0.0, "node": 0,
+                          "point": None, "params": {"nodes": [0, 1]}})
+            soak.sim.kube.set_latency(0.0)
+            record = soak._timeline[-1]
+            assert record.kind == "cd_wave"
+            # Atomicity holds regardless of which way the wave went.
+            assert soak._checks["gang-atomicity"]["violation"] == 0
+            if soak._gang_mgr is not None:
+                assert soak._gang_mgr.gangs() == {}
+                for d in soak._cd_drivers.values():
+                    assert not [
+                        u for u in d.state.prepared_claim_uids()
+                        if u.startswith("soak-cdw-")
+                    ]
+        finally:
+            soak.sim.kube.set_latency(0.0)
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak.sim.close()

@@ -1,0 +1,400 @@
+"""Gang slice reservation: the all-or-nothing state machine and its crash
+consistency (tpudra/controller/gang.py).
+
+Two layers:
+
+- state-machine tests over a recording fake binder: all-bound on success,
+  none-bound after any member failure, rollback-retry via recover() when
+  an unbind fails, idempotent re-reserve, release;
+- the gang crash sweep: in-process armed crashes (``armed_crash`` — the
+  chaos soak's SIGKILL stand-in, BaseException past every fault barrier)
+  at the two gang boundaries ``mid-gang-reserve`` / ``mid-gang-rollback``
+  plus the storage boundaries ``post-journal-append`` / ``mid-compaction``
+  reached through gang mutates, against REAL CD plugin drivers — after
+  every crash a fresh manager over the same checkpoint dir must
+  ``recover()`` to all-bound or none-bound, never partial, with zero CDI
+  spec leaks (the ISSUE 9 acceptance assertion).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from tpudra.controller.gang import (
+    GANG_UID_PREFIX,
+    GangBindError,
+    GangMember,
+    GangReservationManager,
+    GangRollbackIncomplete,
+)
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin import checkpoint as checkpoint_mod
+from tpudra.plugin.checkpoint import CheckpointManager, SimulatedCrash
+from tpudra.sim.multihost import (
+    CD_API_V,
+    DriverGangBinder,
+    make_channel_claim,
+)
+
+#: Gang crash boundaries: the two gang-specific points plus the storage
+#: points every gang mutate rides (the WAL layer's own sweep points).
+GANG_CRASH_POINTS = (
+    "mid-gang-reserve",
+    "mid-gang-rollback",
+    "post-journal-append",
+    "mid-compaction",
+)
+
+
+class RecordingBinder:
+    """Binder whose bound-set outlives any manager instance (the node
+    plugins keep running when the controller crashes)."""
+
+    def __init__(self, fail_on: frozenset = frozenset(), fail_unbind: frozenset = frozenset()):
+        self.bound: set[str] = set()
+        self.bind_calls: list[str] = []
+        self.unbind_calls: list[str] = []
+        self.fail_on = set(fail_on)
+        self.fail_unbind = set(fail_unbind)
+
+    def bind(self, member: GangMember, claim: dict) -> None:
+        self.bind_calls.append(member.claim_uid)
+        if member.claim_uid in self.fail_on:
+            raise RuntimeError(f"injected bind failure for {member.claim_uid}")
+        self.bound.add(member.claim_uid)
+
+    def unbind(self, member: GangMember) -> None:
+        self.unbind_calls.append(member.claim_uid)
+        if member.claim_uid in self.fail_unbind:
+            raise RuntimeError(f"injected unbind failure for {member.claim_uid}")
+        self.bound.discard(member.claim_uid)
+
+
+def mk_members(n: int) -> list[GangMember]:
+    return [GangMember(node=f"n{i}", claim_uid=f"c{i}") for i in range(n)]
+
+
+def mk_claims(members) -> dict:
+    return {m.claim_uid: {"metadata": {"uid": m.claim_uid}} for m in members}
+
+
+@pytest.fixture
+def cp(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "gangs"))
+    yield mgr
+    mgr.close()
+
+
+class TestGangStateMachine:
+    def test_reserve_binds_every_member_in_order(self, cp):
+        binder = RecordingBinder()
+        members = mk_members(4)
+        mgr = GangReservationManager(cp, binder)
+        status = mgr.reserve("g1", members, mk_claims(members))
+        assert status.phase == "bound"
+        assert binder.bind_calls == ["c0", "c1", "c2", "c3"]
+        assert binder.bound == {"c0", "c1", "c2", "c3"}
+        assert mgr.gangs()["g1"].phase == "bound"
+
+    def test_member_failure_rolls_back_to_none_bound(self, cp):
+        binder = RecordingBinder(fail_on=frozenset({"c2"}))
+        members = mk_members(4)
+        mgr = GangReservationManager(cp, binder)
+        with pytest.raises(GangBindError) as ei:
+            mgr.reserve("g1", members, mk_claims(members))
+        assert "c2" in str(ei.value)
+        assert binder.bound == set()
+        # EVERY member is unbound (not just the bound prefix): a crash
+        # between bind and journal could leave an unjournaled bind.
+        assert set(binder.unbind_calls) == {"c0", "c1", "c2", "c3"}
+        assert mgr.gangs() == {}
+
+    def test_failed_unbind_keeps_record_for_recovery(self, cp):
+        binder = RecordingBinder(
+            fail_on=frozenset({"c3"}), fail_unbind=frozenset({"c1"})
+        )
+        members = mk_members(4)
+        mgr = GangReservationManager(cp, binder)
+        with pytest.raises(GangRollbackIncomplete):
+            mgr.reserve("g1", members, mk_claims(members))
+        assert mgr.gangs()["g1"].phase == "rollback"
+        # The retry (recover) finishes the teardown once the fault clears.
+        binder.fail_unbind = set()
+        assert mgr.recover() == ["g1"]
+        assert binder.bound == set()
+        assert mgr.gangs() == {}
+
+    def test_completed_gang_reserve_is_idempotent(self, cp):
+        binder = RecordingBinder()
+        members = mk_members(2)
+        mgr = GangReservationManager(cp, binder)
+        mgr.reserve("g1", members, mk_claims(members))
+        n_binds = len(binder.bind_calls)
+        status = mgr.reserve("g1", members, mk_claims(members))
+        assert status.phase == "bound"
+        assert len(binder.bind_calls) == n_binds  # no re-bind
+
+    def test_conflicting_member_set_refused(self, cp):
+        binder = RecordingBinder()
+        members = mk_members(2)
+        mgr = GangReservationManager(cp, binder)
+        mgr.reserve("g1", members, mk_claims(members))
+        other = mk_members(3)
+        with pytest.raises(GangBindError):
+            mgr.reserve("g1", other, mk_claims(other))
+        # The refused attempt must not have disturbed the bound gang.
+        assert mgr.gangs()["g1"].phase == "bound"
+        assert binder.bound == {"c0", "c1"}
+
+    def test_release_unbinds_and_drops(self, cp):
+        binder = RecordingBinder()
+        members = mk_members(3)
+        mgr = GangReservationManager(cp, binder)
+        mgr.reserve("g1", members, mk_claims(members))
+        mgr.release("g1")
+        assert binder.bound == set()
+        assert mgr.gangs() == {}
+        mgr.release("g1")  # idempotent
+
+    def test_recover_rolls_back_inflight_leaves_complete(self, cp):
+        binder = RecordingBinder()
+        a = mk_members(2)
+        mgr = GangReservationManager(cp, binder)
+        mgr.reserve("done", a, mk_claims(a))
+        # Forge an in-flight record the way a crash mid-reserve leaves one
+        # (members journaled, status PrepareStarted), with its members
+        # "bound" on the nodes.
+        b = [GangMember(node="nx", claim_uid="cx"), GangMember(node="ny", claim_uid="cy")]
+        binder.bound.update({"cx", "cy"})
+
+        def plant(state):
+            state.prepared_claims[GANG_UID_PREFIX + "crashed"] = (
+                GangReservationManager._record("crashed", b, "reserving", ["cx"])
+            )
+
+        cp.mutate(plant, touched=[GANG_UID_PREFIX + "crashed"])
+        rolled = GangReservationManager(cp, binder).recover()
+        assert rolled == ["crashed"]
+        assert binder.bound == {"c0", "c1"}  # the completed gang is untouched
+        gangs = GangReservationManager(cp, binder).gangs()
+        assert set(gangs) == {"done"} and gangs["done"].phase == "bound"
+
+    def test_partially_bound_probe(self, cp):
+        binder = RecordingBinder()
+        members = mk_members(3)
+        mgr = GangReservationManager(cp, binder)
+        mgr.reserve("g1", members, mk_claims(members))
+        probe = lambda m: m.claim_uid in binder.bound  # noqa: E731
+        assert mgr.partially_bound(probe) == []
+        binder.bound.discard("c1")  # a member silently lost its bind
+        assert mgr.partially_bound(probe) == ["g1"]
+
+    def test_empty_gang_refused(self, cp):
+        mgr = GangReservationManager(cp, RecordingBinder())
+        with pytest.raises(ValueError):
+            mgr.reserve("g1", [], {})
+
+
+# ------------------------------------------------------------- crash sweep
+
+
+DOMAIN_UID = "gang-crash-cd-uid"
+
+
+def _cd_stack(tmp_path, n=3):
+    """n real CD plugin drivers over persistent dirs + one FakeKube with a
+    Ready ComputeDomain — the node half that keeps running when the
+    controller crashes mid-gang."""
+    from tpudra.sim.multihost import build_cd_stack
+
+    kube = FakeKube()
+    nodes = [f"gn{i}" for i in range(n)]
+    for name in nodes:
+        kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
+    kube.create(
+        gvr.COMPUTE_DOMAINS,
+        {
+            "apiVersion": CD_API_V,
+            "kind": "ComputeDomain",
+            "metadata": {"name": "gc", "namespace": "default", "uid": DOMAIN_UID},
+            "spec": {"numNodes": n},
+            "status": {
+                "status": "Ready",
+                "nodes": [{"name": x, "status": "Ready"} for x in nodes],
+            },
+        },
+        "default",
+    )
+    drivers = build_cd_stack(kube, nodes, str(tmp_path))
+    return kube, nodes, drivers
+
+
+def _gang_inputs(kube, nodes):
+    members = [
+        GangMember(node=name, claim_uid=f"{DOMAIN_UID}-m{i}")
+        for i, name in enumerate(nodes)
+    ]
+    claims = {
+        m.claim_uid: make_channel_claim(m.claim_uid, m.node, DOMAIN_UID)
+        for m in members
+    }
+    for claim in claims.values():
+        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+    return members, claims
+
+
+def _bound_member_count(drivers, members) -> int:
+    uids = {m.claim_uid for m in members}
+    return sum(
+        sum(1 for uid in d.state.prepared_claim_uids() if uid in uids)
+        for d in drivers.values()
+    )
+
+
+def _cdi_leaks(drivers) -> int:
+    return sum(len(d.state._cdi.list_claim_uids()) for d in drivers.values())
+
+
+@pytest.mark.parametrize("point", GANG_CRASH_POINTS)
+def test_gang_crash_sweep_converges_all_or_nothing(tmp_path, point):
+    """Crash the gang path at ``point``; a fresh manager over the same
+    checkpoint dir must recover to all-bound or none-bound — never a
+    partial gang — and rollback must leave no CDI spec on any node."""
+    kube, nodes, drivers = _cd_stack(tmp_path)
+    members, claims = _gang_inputs(kube, nodes)
+    gang_dir = str(tmp_path / "gangs")
+    kwargs = (
+        # Force a compaction on the armed commit (the subprocess sweeps'
+        # TPUDRA_JOURNAL_MAX_RECORDS=1 lever, as a constructor arg here).
+        {"journal_max_records": 1} if point == "mid-compaction" else {}
+    )
+    cp = CheckpointManager(gang_dir, **kwargs)
+    mgr = GangReservationManager(cp, DriverGangBinder(drivers))
+    if point == "mid-gang-rollback":
+        # Reach the rollback path for real: the LAST member's bind fails
+        # (its channel is already held by a conflicting claim on that
+        # node), so the rollback of the bound prefix is mid-flight when
+        # the crash fires.
+        squatter = make_channel_claim("squatter-uid", nodes[-1], DOMAIN_UID)
+        drivers[nodes[-1]].prepare_resource_claims([squatter])
+
+    crashed = False
+    try:
+        with checkpoint_mod.armed_crash(point):
+            mgr.reserve("gsweep", members, claims)
+    except SimulatedCrash:
+        crashed = True
+    assert crashed, f"crash arm at {point} never fired"
+    # The dying controller's manager is abandoned as SIGKILL would leave
+    # it: no shutdown compaction, journal frozen at the last commit.
+    cp.abandon()
+
+    # Restart: fresh manager over the same dir, REAL recovery path.
+    cp2 = CheckpointManager(gang_dir)
+    mgr2 = GangReservationManager(cp2, DriverGangBinder(drivers))
+    rolled = mgr2.recover()
+    bound = _bound_member_count(drivers, members)
+    gangs = mgr2.gangs()
+    if gangs:
+        # All-bound outcome: the crash hit after the completion commit.
+        assert set(gangs) == {"gsweep"} and gangs["gsweep"].phase == "bound"
+        assert bound == len(members), (bound, rolled)
+    else:
+        # None-bound outcome: recovery unwound every member.
+        assert bound == 0, (bound, rolled)
+        assert _cdi_leaks(drivers) == (
+            # The squatter claim's spec legitimately survives in the
+            # rollback scenario — only gang members must be clean.
+            1 if point == "mid-gang-rollback" else 0
+        )
+    # Either way: re-running recovery is a no-op (converged).
+    assert mgr2.recover() == []
+    assert _bound_member_count(drivers, members) in (0, len(members))
+    cp2.close()
+    for d in drivers.values():
+        d._checkpoints.close()
+
+
+def test_gang_reserve_through_real_drivers_roundtrip(tmp_path):
+    """No crash: the CD-driver-backed gang binds all members, release
+    unwinds to zero bound claims and zero CDI specs (the tier-1 shadow of
+    the multihost e2e's reservation half)."""
+    kube, nodes, drivers = _cd_stack(tmp_path)
+    members, claims = _gang_inputs(kube, nodes)
+    cp = CheckpointManager(str(tmp_path / "gangs"))
+    mgr = GangReservationManager(cp, DriverGangBinder(drivers))
+    status = mgr.reserve("rt", members, claims)
+    assert status.phase == "bound"
+    assert _bound_member_count(drivers, members) == len(members)
+    # Topology attributes ride every member's checkpointed device record.
+    for name in nodes:
+        cp_state = drivers[name].state._cp.read_view()
+        devs = [
+            d
+            for rec in cp_state.prepared_claims.values()
+            for d in rec.all_devices()
+        ]
+        assert devs and all(d.attributes.get("meshShape") for d in devs)
+        assert all(d.attributes.get("hostCoords") for d in devs)
+    mgr.release("rt")
+    assert _bound_member_count(drivers, members) == 0
+    assert _cdi_leaks(drivers) == 0
+    cp.close()
+    for d in drivers.values():
+        d._checkpoints.close()
+
+
+def test_controller_gang_wiring_recovers_at_start_and_compacts_on_stop(tmp_path):
+    """The production integration point (ManagerConfig.gang_state_dir +
+    injected binder): a controller built over a crashed predecessor's
+    gang checkpoint recovers to none-bound during run() startup, and its
+    shutdown closes the gang checkpoint (the WAL compaction the plugins'
+    stop() performs — the journal downgrade gate)."""
+    from tpudra.controller.controller import Controller, ManagerConfig
+
+    kube, nodes, drivers = _cd_stack(tmp_path)
+    members, claims = _gang_inputs(kube, nodes)
+    gang_dir = str(tmp_path / "gangs")
+    cp = CheckpointManager(gang_dir)
+    mgr = GangReservationManager(cp, DriverGangBinder(drivers))
+    with checkpoint_mod.armed_crash("mid-gang-reserve"):
+        try:
+            mgr.reserve("w", members, claims)
+        except SimulatedCrash:
+            pass
+    cp.abandon()
+    assert _bound_member_count(drivers, members) >= 1  # the partial gang
+
+    c = Controller(
+        kube,
+        ManagerConfig(driver_namespace="tpudra-system", gang_state_dir=gang_dir),
+        gang_binder=DriverGangBinder(drivers),
+    )
+    stop = threading.Event()
+    t = c.start(stop)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (
+                not c.gangs.gangs()
+                and _bound_member_count(drivers, members) == 0
+            ):
+                break
+            time.sleep(0.05)
+        assert c.gangs.gangs() == {}
+        assert _bound_member_count(drivers, members) == 0
+    finally:
+        stop.set()
+        c.queue.shutdown()
+        t.join(15)
+    assert not t.is_alive()
+    # Clean shutdown compacted the gang WAL (close() ran on the run path).
+    wal = os.path.join(gang_dir, "checkpoint.wal")
+    assert (not os.path.exists(wal)) or os.path.getsize(wal) == 0
+    for d in drivers.values():
+        d._checkpoints.close()

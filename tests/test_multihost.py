@@ -1,0 +1,52 @@
+"""Multi-host e2e: gang-reserved ComputeDomain claim → one OS process per
+node → cross-process jax.distributed psum (tpudra/sim/multihost.py).
+
+The ``multihost`` lane (``make e2e-multihost``): excluded from tier-1 like
+the soak (each case spawns num_hosts real JAX processes — seconds of
+interpreter+jax startup per rank, and tier-1's wall budget is already
+timeout-bound on CI boxes).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpudra.sim import multihost
+
+pytestmark = [pytest.mark.slow, pytest.mark.multihost]
+
+
+def test_four_node_claim_yields_four_processes_and_psum():
+    """ISSUE 9 acceptance: a ComputeDomain claim for a 4-node slice yields
+    4 OS processes whose jax.distributed psum completes with the granted
+    mesh visible in jax.devices()."""
+    out = multihost.run_e2e(num_hosts=4, deadline_s=120.0)
+    assert out["ok"], out
+    assert out["bound_claims"] == 4
+    for rank in out["ranks"]:
+        assert rank["rc"] == 0, rank
+        # v5p, 4 hosts: mesh (2,2,4) = 16 chips — every rank saw all 16.
+        assert "devices 16 mesh 2,2,4" in rank["tail"], rank
+        # psum over ranks 1..4, 4 local devices, 8 cols: 8*4*(1+2+3+4).
+        assert "RESULT gang-psum: 320.0" in rank["tail"], rank
+    assert out["bound_claims_after_release"] == 0
+    assert out["cdi_leaks_after_release"] == 0
+
+
+def test_two_node_gang():
+    out = multihost.run_e2e(num_hosts=2, deadline_s=120.0)
+    assert out["ok"], out
+    for rank in out["ranks"]:
+        # mesh (2,2,2) = 8 devices; psum 8*4*(1+2) = 96.
+        assert "RESULT gang-psum: 96.0" in rank["tail"], rank
+
+
+def test_kill_one_rank_rolls_back_to_zero_bound():
+    """ISSUE 9 acceptance: the kill-one-rank case rolls back to zero
+    bound claims (and zero CDI spec leaks) on every node."""
+    out = multihost.run_e2e(num_hosts=4, kill_rank=2, deadline_s=25.0)
+    assert out["ok"], out
+    assert not out["launch_ok"]
+    assert out["ranks"][2]["rc"] != 0  # the victim died
+    assert out["bound_claims_after_release"] == 0
+    assert out["cdi_leaks_after_release"] == 0

@@ -84,8 +84,11 @@ def main() -> int:
     cluster = current_headline(sys.argv[1], metric="cluster_scale")
     if cluster is not None:
         print_cluster_section(cluster)
+    gang = current_headline(sys.argv[1], metric="gang_bind")
+    if gang is not None:
+        print_gang_section(gang)
     if now is None:
-        if churn is None and cluster is None:
+        if churn is None and cluster is None and gang is None:
             print("bench-delta: no headline line in this run's output")
         return 0
     prior = prior_headline()
@@ -128,6 +131,27 @@ def print_apiserver_section(now: dict) -> None:
         f"({ab.get('improvement_ms', round(uncached - cached, 3))} ms "
         f"left the hot path; ~{n} serialized GET RTTs = {n * rtt:g} ms)"
     )
+
+
+def print_gang_section(gang: dict) -> None:
+    """The `--gang` A/B (make bench-gang): all-or-nothing gang bind
+    p50/p99 by slice size, interleaved bound-vs-rollback arms — within-run
+    by design (the rollback arm's price relative to the bound arm is the
+    artifact, not the absolute ms of either)."""
+    if gang.get("error"):
+        print(f"bench-delta: gang section errored: {gang['error']}")
+        return
+    for k in gang.get("sizes", []):
+        arms = gang.get(f"nodes_{k}")
+        if not isinstance(arms, dict):
+            continue
+        bound = arms.get("bound", {})
+        rb = arms.get("rollback", {})
+        print(
+            f"bench-delta: gang {k}-node bind p50 {bound.get('p50_ms')} ms "
+            f"/ p99 {bound.get('p99_ms')} ms; rollback arm p50 "
+            f"{rb.get('p50_ms')} ms (the all-or-nothing failure price)"
+        )
 
 
 def print_checkpoint_section(churn: dict) -> None:

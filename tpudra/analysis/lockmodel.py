@@ -54,7 +54,12 @@ from dataclasses import dataclass, field
 from typing import Iterable, Optional, Union
 
 from tpudra.analysis import astutil
-from tpudra.analysis.callgraph import CallGraph, FunctionInfo, short_module
+from tpudra.analysis.callgraph import (
+    CallGraph,
+    FunctionInfo,
+    module_name,
+    short_module,
+)
 from tpudra.analysis.engine import Finding, ParsedModule
 
 #: Max call depth BLOCK-UNDER-LOCK-IP follows under a held in-process lock.
@@ -306,6 +311,48 @@ class LockModel:
         self._kube_quals = self._collect_kube_quals()
         self._flock_quals = self._collect_flock_quals()
         self._build_registry()
+        #: Functions registered as informer event handlers
+        #: (``Informer.add_handler(fn)``): callback dispatch the call
+        #: graph cannot resolve — ``Informer._dispatch`` invokes them
+        #: under ``informer.dispatch_lock``, so every lock a handler takes
+        #: is an edge from the dispatch lock (the cd_wave soak witnessed
+        #: informer.dispatch_lock → workqueue.cond/backoff_lock exactly
+        #: this way: controller handlers enqueue reconciles in-handler).
+        self._handler_targets = self._collect_handler_targets()
+
+    def _collect_handler_targets(self) -> list[FunctionInfo]:
+        """Every function passed to an ``add_handler(...)`` registration:
+        ``self._method`` args resolve on the registering class, bare names
+        as module functions.  Order-stable and deduped so the derived IR
+        (and therefore docs/lock-order.md) is deterministic."""
+        targets: list[FunctionInfo] = []
+        seen: set[str] = set()
+        for fn in self.graph.functions.values():
+            for node in ast.walk(fn.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and astutil.call_name(node) == "add_handler"
+                    and node.args
+                ):
+                    continue
+                arg = node.args[0]
+                target: Optional[FunctionInfo] = None
+                if (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                ):
+                    cls = self.graph.class_of(fn)
+                    if cls is not None:
+                        target = self.graph.method_on(cls.qualname, arg.attr)
+                elif isinstance(arg, ast.Name):
+                    target = self.graph.module_function(
+                        module_name(fn.path), arg.id
+                    )
+                if target is not None and target.qualname not in seen:
+                    seen.add(target.qualname)
+                    targets.append(target)
+        return targets
 
     # -- registry -----------------------------------------------------------
 
@@ -750,6 +797,20 @@ class LockModel:
                     )
                     continue
             callee = self.graph.resolve_call(call, fn, types)
+            if (
+                callee is None
+                and isinstance(func, ast.Name)
+                and fn.qualname.endswith("Informer._dispatch")
+            ):
+                # Callback dispatch (see _collect_handler_targets): any
+                # unresolved bare-name call inside the dispatch loop is
+                # the handler invocation — keyed on the function, not the
+                # loop variable's spelling, so a rename can't silently
+                # drop the dispatch-lock edges.  Model it as calling
+                # every registered handler.
+                for target in self._handler_targets:
+                    events.append(CallEv(call, fn=target))
+                continue
             blocking = self._classify_blocking(call, callee)
             if callee is not None and self.acquires_ann.get(callee.qualname):
                 held_ref = self._ref_for_id(self.acquires_ann[callee.qualname])

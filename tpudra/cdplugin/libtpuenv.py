@@ -44,9 +44,33 @@ import logging
 
 from tpudra.cddaemon.dnsnames import dns_name
 from tpudra.devicelib.base import TpuChip
-from tpudra.devicelib.topology import GENERATIONS, SliceTopology
+from tpudra.devicelib.topology import GENERATIONS, SliceTopology, host_origin
 
 logger = logging.getLogger(__name__)
+
+
+def slice_env(topo: SliceTopology, chips: list[TpuChip]) -> dict[str, str]:
+    """The slice-geometry half of the grant env: the full ICI mesh shape
+    and this host's block origin within it, straight from the device
+    library's topology model.  Together with TPUDRA_NUM_HOSTS /
+    TPUDRA_HOST_INDEX / TPUDRA_COORDINATOR (cdplugin/state.py), a rank
+    learns its coordinator address, process count, and mesh position from
+    the claim alone — no metadata server, no out-of-band config
+    (ROADMAP item 2's "claim is the whole contract" requirement).
+
+    TPUDRA_HOST_COORDS is emitted only when a generation spec is
+    available to place the host block (same degraded-node rule as
+    host_bounds: a chipless node keeps worker identity, loses footprint).
+    """
+    env = {
+        "TPUDRA_MESH_SHAPE": ",".join(str(v) for v in topo.mesh_shape),
+    }
+    spec = GENERATIONS.get(chips[0].generation) if chips else None
+    if spec is not None:
+        env["TPUDRA_HOST_COORDS"] = ",".join(
+            str(v) for v in host_origin(spec, topo.host_index)
+        )
+    return env
 
 
 def host_bounds(

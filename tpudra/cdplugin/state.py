@@ -367,6 +367,24 @@ class ComputeDomainDeviceState:
                 f"{self._node_name} yet"
             )
 
+        topo = self._lib.slice_topology()
+        chips = self._lib.enumerate_chips()
+        from tpudra.cdplugin import libtpuenv
+
+        # The slice geometry rides the claim itself — recorded on every
+        # prepared device (the checkpointed "what was granted" record) and
+        # injected as env below, so each rank of a gang learns its mesh
+        # position from the grant alone (ROADMAP item 2; the reference's
+        # clusterUUID/cliqueID fabric attributes, nvlib.go:201-356).
+        geometry = libtpuenv.slice_env(topo, chips)
+        topo_attrs = {
+            "numHosts": str(topo.num_hosts),
+            "hostIndex": str(topo.host_index),
+            "meshShape": geometry["TPUDRA_MESH_SHAPE"],
+        }
+        if "TPUDRA_HOST_COORDS" in geometry:
+            topo_attrs["hostCoords"] = geometry["TPUDRA_HOST_COORDS"]
+
         channel_ids: list[int] = []
         devices: list[PreparedDevice] = []
         for r in results:
@@ -386,6 +404,7 @@ class ComputeDomainDeviceState:
                     attributes={
                         "domainUID": config.domain_id,
                         "channelID": str(cid),
+                        **topo_attrs,
                     },
                 )
             )
@@ -394,10 +413,7 @@ class ComputeDomainDeviceState:
             if config.allocation_mode == CHANNEL_ALLOCATION_MODE_ALL
             else sorted(channel_ids)
         )
-        topo = self._lib.slice_topology()
-        chips = self._lib.enumerate_chips()
         worker_hostnames = self._worker_hostnames_policy(namespace, claim, topo)
-        from tpudra.cdplugin import libtpuenv
         from tpudra.cdplugin.computedomain import DEFAULT_COORDINATOR_PORT
         from tpudra.cddaemon.dnsnames import dns_name
 
@@ -432,6 +448,10 @@ class ComputeDomainDeviceState:
                 f"TPUDRA_COORDINATOR={dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
                 f"TPUDRA_CD_DIR={cd_dir_mount}",
             ]
+            # Slice geometry (mesh shape + this host's block origin): the
+            # same values recorded on the prepared devices above, so env
+            # and checkpoint attributes can never drift apart.
+            + [f"{k}={v}" for k, v in sorted(geometry.items())]
             # The libtpu worker-bootstrap contract (TPU_WORKER_ID /
             # TPU_WORKER_HOSTNAMES / TPU_SKIP_MDS_QUERY / host+chip bounds):
             # jax.distributed rendezvous above is necessary but not
@@ -559,7 +579,13 @@ class ComputeDomainDeviceState:
 
         env = self._cdm.prepare_daemon_settings(
             config.domain_id, clique_id, topo.num_hosts, topo.host_index,
-            libtpu_env=libtpuenv.worker_env(topo, chips),
+            # Worker-bootstrap contract + slice geometry: the daemon's
+            # settings record the same mesh env the channel grants inject,
+            # so operators read one file for the slice's formation state.
+            libtpu_env={
+                **libtpuenv.worker_env(topo, chips),
+                **libtpuenv.slice_env(topo, chips),
+            },
         )
         devices = [
             PreparedDevice(

@@ -1,0 +1,422 @@
+"""Gang ("all-or-nothing") slice reservation for ComputeDomains.
+
+PAPER.md's north star — ``kubectl apply`` of a ComputeDomain claim → a JAX
+all-reduce across a v5p slice — needs a property no node-local path can
+give: a claim for an N-node slice must bind **all N node-local claims or
+none**.  A partial gang is worse than a failed one: the bound members hold
+channels, node labels, and CDI specs that gate other domains off their
+nodes, while the workload can never start (libtpu mesh formation needs
+every worker).  This manager is the reference driver's IMEX-domain
+formation discipline applied to TPU pod-slice reservation:
+
+- **reserve(gang, members)** journals the gang's *intent* (the full member
+  list) through the checkpoint WAL before any member binds, binds members
+  one at a time through the injected :class:`GangBinder`, journals each
+  member's bind, and flips the gang record to ``PrepareCompleted`` only
+  when every member is bound.  Any member failure rolls the bound prefix
+  back through the binder's unbind (the existing unprepare path — the
+  same idempotent teardown kubelet retries ride) and drops the record.
+
+- **crash consistency**: the WAL record is written *before* the first
+  bind, so a controller crash mid-gang (the ``mid-gang-reserve`` /
+  ``mid-gang-rollback`` crash points, swept by tests/test_gang.py) leaves
+  a durable ``PrepareStarted`` gang whose member list is the rollback
+  plan.  :meth:`recover` — run at controller start — unbinds **every**
+  member of every non-completed gang (unbind of a never-bound member is a
+  no-op by the unprepare path's contract) and drops the record: recovery
+  converges to all-bound or none-bound, never partial.
+
+- the gang record rides the same :class:`CheckpointManager` WAL as claim
+  records (``gang/<id>`` uids — the prefix keeps them out of any
+  claim-shaped scan), so group commit, torn-tail repair, and the
+  ``post-journal-append`` / ``mid-compaction`` crash points all apply to
+  gang state for free.
+
+The binder is injected because the transport differs by context: the
+multi-host harness and the chaos soak bind through in-process CD plugin
+drivers (``tpudra/sim/multihost.DriverGangBinder`` — the harness plays
+kubelet), a production controller would drive per-node claims through the
+apiserver and watch their status.  The manager owns only the all-or-
+nothing state machine and its durability.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Protocol
+
+from tpudra import metrics
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    Checkpoint,
+    CheckpointManager,
+    PreparedClaim,
+    PreparedDeviceGroup,
+)
+from tpudra.plugin.device_state import _crashpoint
+
+logger = logging.getLogger(__name__)
+
+#: Checkpoint-uid namespace for gang records.  "/" cannot appear in a k8s
+#: object uid, so no claim record can ever collide with a gang record.
+GANG_UID_PREFIX = "gang/"
+
+#: config_state phases of a PrepareStarted gang record.  A completed gang
+#: (status PREPARE_COMPLETED) is phase-less: all members bound.
+PHASE_RESERVING = "reserving"
+PHASE_ROLLBACK = "rollback"
+
+_GANGS_BOUND = metrics.GANG_RESERVATIONS_TOTAL.labels("bound")
+_GANGS_ROLLED_BACK = metrics.GANG_RESERVATIONS_TOTAL.labels("rolled-back")
+_GANGS_RECOVERED = metrics.GANG_RESERVATIONS_TOTAL.labels("recovered")
+_GANGS_RELEASED = metrics.GANG_RESERVATIONS_TOTAL.labels("released")
+
+
+class GangBindError(Exception):
+    """A member bind failed; the gang was rolled back to none-bound."""
+
+
+class GangRollbackIncomplete(Exception):
+    """One or more member unbinds failed; the gang record is KEPT in the
+    rollback phase so :meth:`GangReservationManager.recover` retries the
+    teardown — the record outliving the failure is what makes the
+    all-or-nothing contract crash-proof rather than best-effort."""
+
+
+@dataclass(frozen=True)
+class GangMember:
+    """One node-local claim of the gang."""
+
+    node: str
+    claim_uid: str
+    namespace: str = "default"
+
+    def to_state(self) -> dict:
+        return {
+            "node": self.node,
+            "claimUID": self.claim_uid,
+            "namespace": self.namespace,
+        }
+
+    @classmethod
+    def from_state(cls, d: dict) -> "GangMember":
+        return cls(
+            node=d.get("node", ""),
+            claim_uid=d.get("claimUID", ""),
+            namespace=d.get("namespace", "default"),
+        )
+
+
+@dataclass
+class GangStatus:
+    """One gang record, as read back from the checkpoint."""
+
+    gang_id: str
+    phase: str  # "bound" | "reserving" | "rollback"
+    members: list[GangMember]
+    bound: list[str]  # claim uids journaled as bound
+
+
+class GangBinder(Protocol):
+    """Transport for one member's bind/unbind.
+
+    ``bind`` raises on failure (any exception — the manager maps it to a
+    rollback); ``unbind`` must be idempotent for members that never bound
+    (the unprepare path's existing contract: dropping an unknown claim is
+    a no-op), because recovery unbinds the *whole* intent list."""
+
+    def bind(self, member: GangMember, claim: dict) -> None: ...
+
+    def unbind(self, member: GangMember) -> None: ...
+
+
+class GangReservationManager:
+    """All-or-nothing reservation of N node-local claims, journaled.
+
+    One instance per controller; ``checkpoints`` is a dedicated
+    CheckpointManager over the controller's state dir (gang records must
+    not share a file with any plugin's claim records — different process,
+    different lock, different GC)."""
+
+    def __init__(self, checkpoints: CheckpointManager, binder: GangBinder):
+        self._cp = checkpoints
+        self._binder = binder
+
+    # -------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _guid(gang_id: str) -> str:
+        return GANG_UID_PREFIX + gang_id
+
+    @staticmethod
+    def _record(
+        gang_id: str, members: list[GangMember], phase: str, bound: list[str]
+    ) -> PreparedClaim:
+        return PreparedClaim(
+            uid=GANG_UID_PREFIX + gang_id,
+            namespace="",
+            name=gang_id,
+            status=PREPARE_STARTED,
+            groups=[
+                PreparedDeviceGroup(
+                    devices=[],
+                    # configState values are strings by the checkpoint's
+                    # v2 schema (api/serde typing): the member and bound
+                    # lists ride as JSON documents inside it.
+                    config_state={
+                        "phase": phase,
+                        "members": json.dumps([m.to_state() for m in members]),
+                        "bound": json.dumps(list(bound)),
+                    },
+                )
+            ],
+        )
+
+    @staticmethod
+    def _parse(rec: PreparedClaim) -> GangStatus:
+        state = rec.groups[0].config_state if rec.groups else {}
+        phase = (
+            "bound"
+            if rec.status == PREPARE_COMPLETED
+            else state.get("phase", PHASE_RESERVING)
+        )
+        return GangStatus(
+            gang_id=rec.uid[len(GANG_UID_PREFIX):],
+            phase=phase,
+            members=[
+                GangMember.from_state(m)
+                for m in json.loads(state.get("members", "[]"))
+            ],
+            bound=list(json.loads(state.get("bound", "[]"))),
+        )
+
+    def gangs(self) -> dict[str, GangStatus]:
+        """Every gang record in the checkpoint (complete and in-flight)."""
+        cp = self._cp.read_view()
+        return {
+            rec.uid[len(GANG_UID_PREFIX):]: self._parse(rec)
+            for uid, rec in cp.prepared_claims.items()
+            if uid.startswith(GANG_UID_PREFIX)
+        }
+
+    # -------------------------------------------------------------- reserve
+
+    def reserve(
+        self,
+        gang_id: str,
+        members: list[GangMember],
+        claims: dict[str, dict],
+        on_member_bound: Optional[Callable[[GangMember], None]] = None,
+    ) -> GangStatus:
+        """Bind every member or none.  ``claims`` maps member claim uid →
+        the allocated ResourceClaim object handed to the binder.  Raises
+        :class:`GangBindError` after a clean rollback,
+        :class:`GangRollbackIncomplete` when the rollback itself needs the
+        recovery path to finish.  Idempotent: re-reserving a completed
+        gang with the same member set returns its status without
+        re-binding (the controller-restart / requeue case)."""
+        if not members:
+            raise ValueError("a gang needs at least one member")
+        guid = self._guid(gang_id)
+        t0 = time.monotonic()
+        cached: list[GangStatus] = []
+
+        def start(cp: Checkpoint) -> None:
+            existing = cp.prepared_claims.get(guid)
+            if existing is not None:
+                status = self._parse(existing)
+                same_members = {m.claim_uid for m in status.members} == {
+                    m.claim_uid for m in members
+                }
+                if status.phase == "bound" and same_members:
+                    cached.append(status)
+                    return
+                if same_members:
+                    raise GangBindError(
+                        f"gang {gang_id!r} exists in phase {status.phase!r}: "
+                        "its teardown has not converged yet — recover() "
+                        "retries it; re-reserve after"
+                    )
+                raise GangBindError(
+                    f"gang {gang_id!r} already exists in phase "
+                    f"{status.phase!r} with a different member set"
+                )
+            cp.prepared_claims[guid] = self._record(
+                gang_id, members, PHASE_RESERVING, []
+            )
+
+        self._cp.mutate(start, touched=[guid])
+        if cached:
+            return cached[0]
+
+        bound: list[GangMember] = []
+        failed_stage = "member bind"
+        try:
+            for member in members:
+                failed_stage = f"bind of claim {member.claim_uid!r}"
+                self._binder.bind(member, claims[member.claim_uid])
+                bound.append(member)
+
+                def journal_bound(cp: Checkpoint, uid=member.claim_uid) -> None:
+                    rec = cp.prepared_claims.get(guid)
+                    if rec is None or not rec.groups:
+                        return  # dropped by a concurrent release; rollback wins
+                    state = rec.groups[0].config_state
+                    done = json.loads(state.get("bound", "[]"))
+                    if uid not in done:
+                        done.append(uid)
+                        state["bound"] = json.dumps(done)
+
+                failed_stage = f"bind journal for claim {member.claim_uid!r}"
+                self._cp.mutate(journal_bound, touched=[guid])
+                # Fires (when armed) after the FIRST member is durably
+                # bound and before the rest: the canonical partial-gang
+                # crash for the sweep, as long as the gang has ≥2 members.
+                _crashpoint("mid-gang-reserve")
+                if on_member_bound is not None:
+                    failed_stage = f"post-bind callback for {member.claim_uid!r}"
+                    on_member_bound(member)
+        except Exception as e:
+            logger.warning(
+                "gang %s: %s failed after %d/%d bound: %s — rolling back",
+                gang_id, failed_stage, len(bound), len(members), e,
+            )
+            self._rollback(gang_id, members)
+            _GANGS_ROLLED_BACK.inc()
+            raise GangBindError(
+                f"gang {gang_id!r}: {failed_stage} failed ({e}); "
+                f"all {len(bound)} bound member(s) rolled back"
+            ) from e
+
+        def complete(cp: Checkpoint) -> None:
+            rec = cp.prepared_claims.get(guid)
+            if rec is None:
+                return
+            rec.status = PREPARE_COMPLETED
+
+        self._cp.mutate(complete, touched=[guid])
+        _GANGS_BOUND.inc()
+        metrics.GANG_BIND_SECONDS.labels(str(len(members))).observe(
+            time.monotonic() - t0
+        )
+        logger.info(
+            "gang %s: all %d members bound in %.3fs",
+            gang_id, len(members), time.monotonic() - t0,
+        )
+        return GangStatus(
+            gang_id=gang_id,
+            phase="bound",
+            members=list(members),
+            bound=[m.claim_uid for m in members],
+        )
+
+    # ------------------------------------------------------------- rollback
+
+    def _rollback(self, gang_id: str, members: list[GangMember]) -> None:
+        """Unbind EVERY member (not just the journaled-bound prefix: a
+        crash between a bind and its journal append leaves a bound member
+        the record never saw) and drop the gang record.  A failed unbind
+        keeps the record in the rollback phase and raises — recover()
+        retries until the teardown converges."""
+        guid = self._guid(gang_id)
+
+        def mark(cp: Checkpoint) -> None:
+            rec = cp.prepared_claims.get(guid)
+            if rec is None or not rec.groups:
+                return
+            rec.status = PREPARE_STARTED
+            rec.groups[0].config_state["phase"] = PHASE_ROLLBACK
+
+        self._cp.mutate(mark, touched=[guid])
+        failures: list[str] = []
+        first = True
+        for member in reversed(members):
+            try:
+                self._binder.unbind(member)
+            except Exception as e:  # noqa: BLE001 — every member must be visited
+                logger.warning(
+                    "gang %s: unbind of %s on %s failed: %s",
+                    gang_id, member.claim_uid, member.node, e,
+                )
+                failures.append(f"{member.claim_uid}@{member.node}: {e}")
+            if first:
+                # Fires (when armed) after the first member's unbind,
+                # while the rollback-phase record still names the rest.
+                first = False
+                _crashpoint("mid-gang-rollback")
+        if failures:
+            raise GangRollbackIncomplete(
+                f"gang {gang_id!r}: {len(failures)} member unbind(s) failed "
+                f"({'; '.join(failures[:3])}); record kept for recovery"
+            )
+
+        def drop(cp: Checkpoint) -> None:
+            cp.prepared_claims.pop(guid, None)
+
+        self._cp.mutate(drop, touched=[guid])
+
+    def release(self, gang_id: str) -> None:
+        """Tear down a bound gang (workload done): unbind every member,
+        drop the record.  Also accepts an in-flight record (the operator's
+        force-release)."""
+        rec = self.gangs().get(gang_id)
+        if rec is None:
+            return
+        self._rollback(gang_id, rec.members)
+        _GANGS_RELEASED.inc()
+
+    # ------------------------------------------------------------- recovery
+
+    def recover(self) -> list[str]:
+        """Converge every non-completed gang to none-bound — the crash-
+        recovery sweep, run at controller start.  Returns the rolled-back
+        gang ids.  A completed gang is left alone (all members bound — the
+        other consistent outcome).  EVERY gang is attempted even when one
+        rollback fails (one unreachable node must not strand the others'
+        fully-achievable teardowns); the failures aggregate into one
+        :class:`GangRollbackIncomplete` raised after the sweep, with the
+        failed gangs' records kept for the next retry."""
+        rolled: list[str] = []
+        failures: list[str] = []
+        for gang_id, status in sorted(self.gangs().items()):
+            if status.phase == "bound":
+                continue
+            logger.warning(
+                "gang %s: recovering %s-phase record (%d members, %d journaled bound)",
+                gang_id, status.phase, len(status.members), len(status.bound),
+            )
+            try:
+                self._rollback(gang_id, status.members)
+            except GangRollbackIncomplete as e:
+                failures.append(f"{gang_id}: {e}")
+                continue
+            _GANGS_RECOVERED.inc()
+            rolled.append(gang_id)
+        if failures:
+            raise GangRollbackIncomplete(
+                f"{len(failures)} gang(s) did not converge this pass "
+                f"({'; '.join(failures[:3])}); records kept for retry"
+            )
+        return rolled
+
+    def partially_bound(
+        self, bound_probe: Callable[[GangMember], bool]
+    ) -> list[str]:
+        """Gang ids whose members are PARTIALLY bound right now, per the
+        caller's probe (e.g. "is this claim uid in that node's plugin
+        checkpoint").  The chaos soak's gang-atomicity invariant: in a
+        quiet window this list must be empty — every gang is all-bound
+        (complete record) or none-bound (no members bound)."""
+        partial = []
+        for gang_id, status in self.gangs().items():
+            n_bound = sum(1 for m in status.members if bound_probe(m))
+            if status.phase == "bound":
+                if n_bound != len(status.members):
+                    partial.append(gang_id)
+            elif 0 < n_bound < len(status.members):
+                partial.append(gang_id)
+        return partial

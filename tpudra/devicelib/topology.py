@@ -192,6 +192,19 @@ class MockTopologyConfig:
         return spec, num, mesh
 
 
+def host_origin(
+    spec: TpuGenerationSpec, host_index: int
+) -> tuple[int, int, int]:
+    """Origin of one host's chip block within the slice mesh — the host's
+    ICI *position*, as distinct from its index.  One definition shared by
+    chip layout (:func:`chip_coords_for_host`) and the per-node grant env
+    (``TPUDRA_HOST_COORDS``, cdplugin/libtpuenv.slice_env): a rank that
+    knows its origin plus the slice mesh shape can place itself without
+    enumerating any chip."""
+    hb = spec.host_bounds
+    return (0, 0, host_index * hb[2])
+
+
 def chip_coords_for_host(
     spec: TpuGenerationSpec, host_index: int, num_chips: int
 ) -> list[tuple[int, int, int]]:
@@ -206,7 +219,7 @@ def chip_coords_for_host(
             f"{hb[0]}x{hb[1]}x{hb[2]}"
         )
     coords = []
-    base_z = host_index * hb[2]
+    base_z = host_origin(spec, host_index)[2]
     i = 0
     for z in range(hb[2]):
         for y in range(hb[1]):

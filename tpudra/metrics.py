@@ -266,17 +266,33 @@ SOAK_FAULTS_INJECTED_TOTAL = Counter(
     "tpudra_soak_faults_injected_total",
     "Faults injected by the chaos soak (sim/chaos.py), by kind: "
     "apiserver_latency, watch_close, kubelet_restart, plugin_crash, "
-    "torn_wal, clock_skew — the denominator every soak SLO is asserted "
-    "against",
+    "torn_wal, clock_skew, cd_wave — the denominator every soak SLO is "
+    "asserted against",
     ["kind"],
 )
 SOAK_INVARIANT_CHECKS_TOTAL = Counter(
     "tpudra_soak_invariant_checks_total",
     "Continuous invariant evaluations by the soak's monitor thread, by "
     "invariant (claim-stuck, cdi-leak, flock-leak, slice-convergence, "
-    "lock-witness) and result (ok / violation) — a healthy soak is all "
-    "ok with a nonzero check count per invariant",
+    "lock-witness, gang-atomicity) and result (ok / violation) — a "
+    "healthy soak is all ok with a nonzero check count per invariant",
     ["invariant", "result"],
+)
+GANG_RESERVATIONS_TOTAL = Counter(
+    "tpudra_gang_reservations_total",
+    "Gang (all-or-nothing) slice reservations by outcome: bound (every "
+    "member bound), rolled-back (a member bind failed and the bound "
+    "prefix was unwound), recovered (a crash-interrupted gang converged "
+    "to none-bound at controller start), released (a bound gang torn "
+    "down) — controller/gang.py",
+    ["outcome"],
+)
+GANG_BIND_SECONDS = Histogram(
+    "tpudra_gang_bind_seconds",
+    "Wall time of one successful gang reservation (journal intent + N "
+    "member binds + completion commit), by gang size",
+    ["nodes"],
+    buckets=_PREPARE_BUCKETS,
 )
 APISERVER_REQUESTS_TOTAL = Counter(
     "tpudra_apiserver_requests_total",

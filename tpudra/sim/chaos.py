@@ -39,6 +39,14 @@ implies, and this soak is its hermetic reproduction:
                        stale-claim GC passes run — the monotonic staleness
                        discipline (tpudra/clock.py) must hold in both
                        directions
+  cd_wave              a gang slice reservation (controller/gang.py) is
+                       issued through real CD plugin drivers WHILE the
+                       other fault windows are live — a bound gang must be
+                       all-bound, a failed one must roll back to
+                       none-bound, and teardown must converge to zero
+                       bound members within the recovery budget; the
+                       monitor's quiet-window gang-atomicity invariant
+                       holds the residue to "never partial"
   ===================  ====================================================
 
 - **continuous invariant monitor**: a thread asserts, every few hundred
@@ -110,6 +118,7 @@ FAULT_KINDS = (
     "plugin_crash",
     "torn_wal",
     "clock_skew",
+    "cd_wave",
 )
 
 #: Invariant label values (METRICS-HYGIENE: one spelling, shared with the
@@ -120,6 +129,7 @@ INV_FLOCK_LEAK = "flock-leak"
 INV_SLICE_CONVERGENCE = "slice-convergence"
 INV_LOCK_WITNESS = "lock-witness"
 INV_FAULT_RECOVERY = "fault-recovery"
+INV_GANG_ATOMICITY = "gang-atomicity"
 INVARIANTS = (
     INV_CLAIM_STUCK,
     INV_CDI_LEAK,
@@ -127,6 +137,7 @@ INVARIANTS = (
     INV_SLICE_CONVERGENCE,
     INV_LOCK_WITNESS,
     INV_FAULT_RECOVERY,
+    INV_GANG_ATOMICITY,
 )
 
 
@@ -285,6 +296,15 @@ class ChaosSoak:
         self._recovery_samples: list[float] = []
         self._fault_counter = 0
         self._anomalies: list[str] = []
+        # -- cd_wave stack: per-node CD plugin drivers + one gang manager,
+        # built lazily by the FAULT THREAD on the first cd_wave (node
+        # construction is kube/syscall work — never under a soak lock).
+        # The monitor thread only reads the references (atomic in Python).
+        self._cd_drivers: dict[str, object] = {}
+        self._gang_mgr = None
+        self._gang_cp = None
+        self._cd_wave_seq = 0
+        self._cd_wave_inflight = 0  # guarded by _records_lock
 
     # ------------------------------------------------------------- plumbing
 
@@ -559,6 +579,15 @@ class ChaosSoak:
                 }
             elif kind == "clock_skew":
                 params = {"skew_s": self._rng.choice([-600.0, 600.0])}
+            elif kind == "cd_wave":
+                params = {
+                    "nodes": sorted(
+                        self._rng.sample(
+                            range(self.config.nodes),
+                            min(2, self.config.nodes),
+                        )
+                    )
+                }
         else:
             kind = spec["kind"]
             node = spec.get("node") or 0
@@ -581,6 +610,8 @@ class ChaosSoak:
             self._inject_crash(node, "post-journal-append", torn=True)
         elif kind == "clock_skew":
             self._inject_clock_skew(params)
+        elif kind == "cd_wave":
+            self._inject_cd_wave(params)
         else:
             self._anomaly(f"unknown fault kind {kind!r}")
 
@@ -881,6 +912,293 @@ class ChaosSoak:
             self._open_churn_gate()
             self._end_fault(record)
 
+    # ------------------------------------------------------------- cd wave
+
+    def _ensure_cd_stack(self) -> None:
+        """Build the CD plugin drivers + gang manager on first use (fault
+        thread only; ROADMAP item 5's "run the CD stack inside the soak").
+        The CD drivers share the soak's accounted kube and its fault
+        surface — latency spikes and watch closes hit their prepares."""
+        if self._gang_mgr is not None:
+            return
+        from tpudra.controller.gang import GangReservationManager
+        from tpudra.plugin.checkpoint import CheckpointManager
+        from tpudra.sim.multihost import DriverGangBinder, build_cd_stack
+
+        base = self.sim._base
+        drivers = build_cd_stack(
+            self.sim.kube,
+            self.sim.node_names,
+            base,
+            num_hosts=self.config.nodes,
+            prefix="cdw",
+        )
+
+        inner = DriverGangBinder(drivers)
+
+        class _DeadlineBinder:
+            """Every member bind/unbind under its own apiserver deadline,
+            so a latency spike degrades a gang to a typed, rolled-back
+            failure instead of a wedged fault thread."""
+
+            def bind(self, member, claim):
+                with api_deadline(5.0):
+                    inner.bind(member, claim)
+
+            def unbind(self, member):
+                with api_deadline(5.0):
+                    inner.unbind(member)
+
+        self._gang_cp = CheckpointManager(os.path.join(base, "cdw-gangs"))
+        self._gang_mgr = GangReservationManager(self._gang_cp, _DeadlineBinder())
+        self._cd_drivers = drivers
+
+    def _close_cd_stack(self) -> None:
+        from tpudra.sim.multihost import close_cd_stack
+
+        close_cd_stack(self._cd_drivers)
+        if self._gang_cp is not None:
+            try:
+                self._gang_cp.close()
+            except Exception:  # noqa: BLE001
+                logger.exception("gang checkpoint close failed")
+
+    def _bound_gang_members(self, members) -> int:
+        n = 0
+        for m in members:
+            d = self._cd_drivers.get(m.node)
+            if d is not None and m.claim_uid in d.state.prepared_claim_uids():
+                n += 1
+        return n
+
+    def _inject_cd_wave(self, params: dict) -> None:
+        """One gang reservation while whatever other fault windows are
+        open stay open — the compounding scenario ROADMAP item 5 names
+        ("informers suffer watch flaps while CD waves are in flight").
+        The wave's own contract: whatever the outcome (bound, rolled
+        back, rollback needing retries), the gang converges to zero bound
+        members within the recovery budget; the quiet-window monitor then
+        holds the steady state to "never partial"."""
+        from tpudra.controller.gang import (
+            GangBindError,
+            GangMember,
+            GangRollbackIncomplete,
+        )
+        from tpudra.sim.multihost import make_channel_claim, make_compute_domain
+
+        self._ensure_cd_stack()
+        idxs = [
+            i for i in (params.get("nodes") or [0]) if i < self.config.nodes
+        ] or [0]
+        self._cd_wave_seq += 1
+        wave = self._cd_wave_seq
+        gang_id = f"soak-cdw-{wave}"
+        domain_uid = f"{gang_id}-uid"
+        record = FaultRecord(
+            kind="cd_wave", t_sim_start=self._now(), params={"nodes": idxs}
+        )
+        self._record_fault(record)
+        with self._records_lock:
+            self._cd_wave_inflight += 1
+        t0_sim = self._now()
+        nodes = [self.sim.node_names[i] for i in idxs]
+        members = [
+            GangMember(node=n, claim_uid=f"{gang_id}-m{k}")
+            for k, n in enumerate(nodes)
+        ]
+        claims = {
+            m.claim_uid: make_channel_claim(m.claim_uid, m.node, domain_uid)
+            for m in members
+        }
+        try:
+            try:
+                with api_deadline(5.0):
+                    # Wave-start hygiene: a previous wave whose label GC a
+                    # fault beat would fail this wave's add_node_label —
+                    # sweep OUR label off the member nodes first (the
+                    # controller's sweep_stale_labels analog; only cd_wave
+                    # domains ever set it in the soak).
+                    self._sweep_cd_labels(nodes)
+                    self.sim.kube.create(
+                        gvr.COMPUTE_DOMAINS,
+                        # ready=False: the LIVE soak controller owns the
+                        # status — aggregated from the clique CR below.
+                        make_compute_domain(
+                            gang_id, domain_uid, nodes, ready=False
+                        ),
+                        "default",
+                    )
+                    # The wave plays the per-node daemons' role (as it
+                    # plays kubelet's for binds): one clique CR naming the
+                    # member nodes Ready.  The LIVE soak controller then
+                    # aggregates it into CD status — the real readiness
+                    # path the channel prepare gates on, under whatever
+                    # fault windows are currently open.
+                    self.sim.kube.create(
+                        gvr.COMPUTE_DOMAIN_CLIQUES,
+                        {
+                            "apiVersion": "resource.tpu.google.com/v1beta1",
+                            "kind": "ComputeDomainClique",
+                            "metadata": {
+                                "name": f"{gang_id}-clique",
+                                "namespace": self.sim.config.driver_namespace,
+                            },
+                            "spec": {"computeDomainUID": domain_uid},
+                            "status": {
+                                "daemons": [
+                                    {
+                                        "nodeName": n,
+                                        "ipAddress": "127.0.0.1",
+                                        "cliqueID": f"{gang_id}.0",
+                                        "index": k,
+                                        "status": "Ready",
+                                    }
+                                    for k, n in enumerate(nodes)
+                                ]
+                            },
+                        },
+                        self.sim.config.driver_namespace,
+                    )
+                    for claim in claims.values():
+                        self.sim.kube.create(
+                            gvr.RESOURCE_CLAIMS, claim, "default"
+                        )
+            except ApiError as e:
+                # The wave lost to a latency window before any member
+                # could bind: nothing reserved, nothing to assert.
+                record.params["aborted"] = str(e)[:120]
+                return
+            # Readiness is the controller's to grant: wait (bounded) for
+            # the clique aggregation to mark the CD Ready.  A fault window
+            # outliving the wait just means the gang binds into the
+            # readiness gate and rolls back — atomicity still asserted.
+            ready_deadline = time.monotonic() + self.simclock.wall_of(
+                self.budget.recovery_sim_s / 2
+            )
+            while time.monotonic() < ready_deadline and not self._stop.is_set():
+                try:
+                    with api_deadline(3.0):
+                        cd = self.sim.kube.get(
+                            gvr.COMPUTE_DOMAINS, gang_id, "default"
+                        )
+                    if cd.get("status", {}).get("status") == "Ready":
+                        break
+                except (NotFound, ApiError):
+                    ...
+                time.sleep(0.02)
+            try:
+                self._gang_mgr.reserve(gang_id, members, claims)
+                record.params["outcome"] = "bound"
+                n_bound = self._bound_gang_members(members)
+                self._check(
+                    INV_GANG_ATOMICITY,
+                    n_bound == len(members),
+                    key=("wave-bound", wave),
+                    detail=(
+                        f"gang reported bound with {n_bound}/{len(members)} "
+                        "members actually bound"
+                    ),
+                )
+            except GangBindError:
+                record.params["outcome"] = "rolled-back"
+                n_bound = self._bound_gang_members(members)
+                self._check(
+                    INV_GANG_ATOMICITY,
+                    n_bound == 0,
+                    key=("wave-rollback", wave),
+                    detail=(
+                        f"rolled-back gang left {n_bound}/{len(members)} "
+                        "members bound"
+                    ),
+                )
+            except GangRollbackIncomplete:
+                # A fault beat the rollback mid-teardown; the convergence
+                # loop below retries through recover().
+                record.params["outcome"] = "rollback-incomplete"
+
+            # Teardown-to-zero: whatever happened, the wave must converge
+            # to no gang record and no bound members inside the budget.
+            deadline = time.monotonic() + self.simclock.wall_of(
+                self.budget.recovery_sim_s
+            )
+            converged = False
+            while time.monotonic() < deadline and not self._stop.is_set():
+                try:
+                    gangs = self._gang_mgr.gangs()
+                    if gang_id not in gangs:
+                        if self._bound_gang_members(members) == 0:
+                            converged = True
+                            break
+                    elif gangs[gang_id].phase == "bound":
+                        self._gang_mgr.release(gang_id)
+                    else:
+                        self._gang_mgr.recover()
+                except Exception:  # noqa: BLE001 — retried under faults
+                    logger.info("cd_wave teardown retry", exc_info=True)
+                time.sleep(0.05)
+            self._check(
+                INV_FAULT_RECOVERY,
+                converged,
+                key=("cd_wave", self._fault_counter),
+                detail="gang did not converge to zero bound members",
+            )
+            if converged:
+                self._recovery_samples.append(self._now() - t0_sim)
+        finally:
+            for claim in claims.values():
+                try:
+                    with api_deadline(5.0):
+                        self.sim.kube.delete(
+                            gvr.RESOURCE_CLAIMS,
+                            claim["metadata"]["uid"],
+                            "default",
+                        )
+                except (NotFound, ApiError):
+                    ...
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.delete(
+                        gvr.COMPUTE_DOMAIN_CLIQUES,
+                        f"{gang_id}-clique",
+                        self.sim.config.driver_namespace,
+                    )
+            except (NotFound, ApiError):
+                ...
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.delete(gvr.COMPUTE_DOMAINS, gang_id, "default")
+            except (NotFound, ApiError):
+                ...
+            with self._records_lock:
+                self._cd_wave_inflight -= 1
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+
+    def _sweep_cd_labels(self, nodes: list[str]) -> None:
+        from tpudra.api.computedomain import COMPUTE_DOMAIN_NODE_LABEL
+
+        for name in nodes:
+            try:
+                node = self.sim.kube.get(gvr.NODES, name)
+            except (NotFound, ApiError):
+                continue
+            label = node.get("metadata", {}).get("labels", {}).get(
+                COMPUTE_DOMAIN_NODE_LABEL
+            )
+            if label and label.startswith("soak-cdw-"):
+                try:
+                    self.sim.kube.patch(
+                        gvr.NODES,
+                        name,
+                        {
+                            "metadata": {
+                                "labels": {COMPUTE_DOMAIN_NODE_LABEL: None}
+                            }
+                        },
+                    )
+                except ApiError:
+                    ...  # next wave sweeps again
+
     def _gc_pass(self, node: int) -> int:
         try:
             with api_deadline(3.0):
@@ -906,6 +1224,68 @@ class ChaosSoak:
         self._check_claim_stuck()
         self._check_leaks()
         self._check_slice_convergence()
+        self._check_gang_atomicity()
+
+    def _check_gang_atomicity(self) -> None:
+        """QUIET-WINDOW check: no gang may be partially bound — every gang
+        is all-bound (complete record, every member claim in its node's
+        plugin checkpoint) or none-bound (no record, no member claims).
+        While faults or a wave are in flight the gang may legitimately be
+        mid-bind/mid-rollback, so — like slice convergence — the check
+        only asserts in quiet windows; a vacuous pass (no gangs yet)
+        still counts as one whole-cluster evaluation."""
+        with self._records_lock:
+            busy = bool(self._active) or self._cd_wave_inflight > 0
+        if busy:
+            return
+        mgr = self._gang_mgr
+        if mgr is not None:
+            drivers = self._cd_drivers
+
+            def probe(m) -> bool:
+                d = drivers.get(m.node)
+                return (
+                    d is not None
+                    and m.claim_uid in d.state.prepared_claim_uids()
+                )
+
+            try:
+                partial = mgr.partially_bound(probe)
+                known = {
+                    m.claim_uid
+                    for status in mgr.gangs().values()
+                    for m in status.members
+                }
+            except Exception:  # noqa: BLE001 — mid-teardown window
+                logger.info("gang-atomicity scan skipped", exc_info=True)
+                return
+            for gang_id in partial:
+                self._check(
+                    INV_GANG_ATOMICITY,
+                    False,
+                    key=("partial", gang_id),
+                    detail=f"gang {gang_id} partially bound in a quiet window",
+                )
+            # Residue: a bound member claim whose gang record is gone is
+            # the other partial shape (rollback dropped the record but a
+            # member survived).
+            for node, d in drivers.items():
+                try:
+                    uids = d.state.prepared_claim_uids()
+                except Exception:  # noqa: BLE001 — mid-teardown window
+                    continue
+                for uid in uids:
+                    if uid.startswith("soak-cdw-") and uid not in known:
+                        self._check(
+                            INV_GANG_ATOMICITY,
+                            False,
+                            key=("orphan", node, uid),
+                            detail=(
+                                f"bound gang member {uid} on {node} has no "
+                                "gang record"
+                            ),
+                        )
+        self._pass_check(INV_GANG_ATOMICITY)
 
     def _check_claim_stuck(self) -> None:
         """No claim may sit in a non-terminal phase (PrepareStarted) for
@@ -1090,6 +1470,7 @@ class ChaosSoak:
             self._gc_pass(i)
         self._check_lock_witness()
         report = self._report()
+        self._close_cd_stack()
         self.sim.close()
         path = self.config.report_path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -1,0 +1,647 @@
+"""Multi-host launch harness: ComputeDomain claim → gang → real psum.
+
+PAPER.md's end state, hermetically: a ComputeDomain claim for an N-node
+slice is gang-reserved (controller/gang.py) through N real CD plugin
+drivers, and the resulting grants launch **one real OS process per
+simulated node** that joins ``jax.distributed`` from the grant env alone
+and runs a cross-process psum — the first harness that exercises the
+cluster *vertically* (claim → allocation → grant env → mesh formation →
+collective) instead of node-locally.
+
+What is real here:
+
+- the CD plugin bind path per node (checkpointed prepare, channel
+  conflict detection, CDI spec write, node label) — the same code kubelet
+  drives in production;
+- the gang reservation state machine and its WAL journaling;
+- the grant env: each rank process receives EXACTLY the env the claim's
+  CDI spec carries (plus the sim's platform shims below) — coordinator
+  address, process count, mesh shape, host coords, the libtpu
+  worker-bootstrap contract;
+- the DCN rendezvous relay: host 0 binds its coordinator locally and
+  registers it in the per-domain dir; peers dial the REAL
+  ``cddaemon.coordproxy.CoordinatorProxy`` which forwards to the
+  registration — the production path minus only the stable DNS name
+  (both "hosts" are this machine, so the name is swapped for loopback);
+- the collective: ``jax.distributed.initialize`` + a jitted psum across
+  all ranks (gloo CPU collectives — the multiprocess CPU shim
+  ``workload/envspec._enable_cpu_collectives`` enables for simulations).
+
+Sim shims, each one env-visible: ``JAX_PLATFORMS=cpu`` (no TPU in CI),
+``XLA_FLAGS=--xla_force_host_platform_device_count=<chips/host>`` (each
+rank fields as many "chips" as its granted host block, so
+``jax.devices()`` must equal the granted slice's chip count), and
+``TPUDRA_SIM_COORDINATOR`` (loopback for the stable daemon DNS name).
+
+Entry points: ``make e2e-multihost`` (tests/test_multihost.py, the
+``multihost`` marker lane) and ``python -m tpudra.sim.multihost`` (the
+demo CLI; ``--kill-rank K`` exercises the failure path: a dead rank fails
+the launch, and release/rollback must leave zero bound claims and zero
+CDI spec files on every node).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra.controller.gang import (
+    GangBindError,
+    GangMember,
+    GangReservationManager,
+)
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin.checkpoint import CheckpointManager
+
+logger = logging.getLogger(__name__)
+
+CD_API_V = "resource.tpu.google.com/v1beta1"
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_channel_claim(
+    uid: str,
+    node: str,
+    domain_uid: str,
+    channel_id: int = 0,
+    namespace: str = "default",
+) -> dict:
+    """An allocated ComputeDomain channel claim bound to ``node``'s pool —
+    what the scheduler's allocator writes for one member of the gang."""
+    return {
+        "metadata": {"uid": uid, "namespace": namespace, "name": uid},
+        "status": {
+            "allocation": {
+                "devices": {
+                    "results": [
+                        {
+                            "request": "channel",
+                            "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                            "pool": node,
+                            "device": f"channel-{channel_id}",
+                        }
+                    ],
+                    "config": [
+                        {
+                            "source": "FromClaim",
+                            "requests": [],
+                            "opaque": {
+                                "driver": COMPUTE_DOMAIN_DRIVER_NAME,
+                                "parameters": {
+                                    "apiVersion": CD_API_V,
+                                    "kind": "ComputeDomainChannelConfig",
+                                    "domainID": domain_uid,
+                                    "allocationMode": "Single",
+                                },
+                            },
+                        }
+                    ],
+                }
+            }
+        },
+    }
+
+
+def make_compute_domain(
+    name: str,
+    uid: str,
+    nodes: list[str],
+    namespace: str = "default",
+    ready: bool = True,
+) -> dict:
+    """A ComputeDomain object for ``nodes``.  ``ready=True`` stamps the
+    aggregated Ready status directly (harness/bench contexts with no live
+    controller); ``ready=False`` leaves status to a running controller's
+    clique aggregation (the chaos soak's cd-wave)."""
+    cd = {
+        "apiVersion": CD_API_V,
+        "kind": "ComputeDomain",
+        "metadata": {"name": name, "namespace": namespace, "uid": uid},
+        "spec": {"numNodes": len(nodes)},
+    }
+    if ready:
+        cd["status"] = {
+            "status": "Ready",
+            "nodes": [{"name": n, "status": "Ready"} for n in nodes],
+        }
+    return cd
+
+
+def build_cd_stack(
+    kube,
+    node_names: list[str],
+    base: str,
+    num_hosts: Optional[int] = None,
+    generation: str = "v5p",
+    slice_uuid: Optional[str] = None,
+    prefix: str = "cd",
+) -> dict[str, object]:
+    """Per-node CD plugin drivers over persistent dirs under ``base`` —
+    the one construction shared by this harness, the chaos soak's cd-wave
+    stack, and ``bench.py --gang`` (node ``i`` is host ``i`` of an
+    ``num_hosts``-host slice)."""
+    from tpudra.cdplugin.driver import CDDriver, CDDriverConfig
+    from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.devicelib.topology import MockTopologyConfig
+
+    n = num_hosts if num_hosts is not None else len(node_names)
+    drivers: dict[str, object] = {}
+    for i, name in enumerate(node_names):
+        topo_kwargs = dict(generation=generation, num_hosts=n, host_index=i)
+        if slice_uuid is not None:
+            topo_kwargs["slice_uuid"] = slice_uuid
+        lib = MockDeviceLib(
+            config=MockTopologyConfig(**topo_kwargs),
+            state_file=os.path.join(base, f"{prefix}-hw{i}.json"),
+        )
+        drivers[name] = CDDriver(
+            CDDriverConfig(
+                node_name=name,
+                plugin_dir=os.path.join(base, f"{prefix}-p{i}"),
+                registry_dir=os.path.join(base, f"{prefix}-r{i}"),
+                cdi_root=os.path.join(base, f"{prefix}-c{i}"),
+            ),
+            kube,
+            lib,
+        )
+    return drivers
+
+
+def close_cd_stack(drivers: dict[str, object]) -> None:
+    """Teardown counterpart of :func:`build_cd_stack`: every driver's
+    checkpoint gets its clean-shutdown close (the journal compaction the
+    plugins wire into stop() — the WAL downgrade gate)."""
+    for d in drivers.values():
+        try:
+            d._checkpoints.close()
+        except Exception:  # noqa: BLE001 — teardown must visit every node
+            logger.exception("cd driver checkpoint close failed")
+
+
+class DriverGangBinder:
+    """GangBinder over in-process CD plugin drivers — the harness (like
+    the cluster sim's churn) plays kubelet: bind = the node's real
+    checkpointed prepare, unbind = its real unprepare.  Used by the
+    multi-host harness, the chaos soak's cd-wave, and ``bench.py --gang``.
+    """
+
+    def __init__(self, drivers: dict[str, object]):
+        self._drivers = drivers  # node name -> CDDriver
+
+    def bind(self, member: GangMember, claim: dict) -> None:
+        driver = self._drivers[member.node]
+        resp = driver.prepare_resource_claims([claim])
+        entry = resp["claims"].get(member.claim_uid, {})
+        err = entry.get("error")
+        if err:
+            raise GangBindError(
+                f"prepare on {member.node}: {err}"
+                + (" (permanent)" if entry.get("permanent") else "")
+            )
+
+    def unbind(self, member: GangMember) -> None:
+        driver = self._drivers[member.node]
+        resp = driver.unprepare_resource_claims([{"uid": member.claim_uid}])
+        err = resp["claims"].get(member.claim_uid, {}).get("error")
+        if err:
+            raise RuntimeError(f"unprepare on {member.node}: {err}")
+
+
+@dataclass
+class RankResult:
+    rank: int
+    returncode: Optional[int]
+    output: str
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+@dataclass
+class MultiHostConfig:
+    num_hosts: int = 4
+    generation: str = "v5p"
+    namespace: str = "default"
+    domain_name: str = "gang-e2e"
+    base_dir: Optional[str] = None
+    #: Wall deadline for the rank processes (jax.distributed's own
+    #: initialization timeout is 300 s; a harness must fail faster).
+    launch_deadline_s: float = 120.0
+    extra_env: dict = field(default_factory=dict)
+
+
+class MultiHostGang:
+    """N simulated TPU hosts, one gang, one launch.
+
+    Lifecycle: ``up()`` → ``reserve()`` → ``launch()`` → ``release()`` →
+    ``close()`` (or use as a context manager for up/close)."""
+
+    def __init__(self, config: MultiHostConfig | None = None):
+        self.config = config or MultiHostConfig()
+        self.kube = FakeKube()
+        self.domain_uid = f"{self.config.domain_name}-uid"
+        self.node_names = [
+            f"mh-node-{i}" for i in range(self.config.num_hosts)
+        ]
+        self._tmp: Optional[tempfile.TemporaryDirectory] = None
+        self.drivers: dict[str, object] = {}
+        self.gangs: Optional[GangReservationManager] = None
+        self._gang_cp: Optional[CheckpointManager] = None
+        self.grant: Optional[object] = None
+        self._members: list[GangMember] = []
+        self._proxy = None
+        self._procs: list[subprocess.Popen] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def up(self) -> "MultiHostGang":
+        cfg = self.config
+        self._tmp = tempfile.TemporaryDirectory(prefix="tpudra-multihost-")
+        base = self._tmp.name
+        for name in self.node_names:
+            self.kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
+        # The ComputeDomain object, already Ready on every member node:
+        # the harness plays the controller's status-aggregation role (the
+        # bats suite exercises the real daemon/clique path; this harness
+        # exercises the gang + launch path).
+        self.kube.create(
+            gvr.COMPUTE_DOMAINS,
+            make_compute_domain(
+                cfg.domain_name,
+                self.domain_uid,
+                self.node_names,
+                namespace=cfg.namespace,
+            ),
+            cfg.namespace,
+        )
+        self.drivers = build_cd_stack(
+            self.kube,
+            self.node_names,
+            base,
+            num_hosts=cfg.num_hosts,
+            generation=cfg.generation,
+            slice_uuid=f"{cfg.domain_name}-slice",
+        )
+        self._gang_cp = CheckpointManager(os.path.join(base, "controller"))
+        self.gangs = GangReservationManager(
+            self._gang_cp, DriverGangBinder(self.drivers)
+        )
+        return self
+
+    def close(self) -> None:
+        self._kill_procs()
+        if self._proxy is not None:
+            self._proxy.stop()
+            self._proxy = None
+        close_cd_stack(self.drivers)
+        if self._gang_cp is not None:
+            try:
+                self._gang_cp.close()
+            except Exception:  # noqa: BLE001 — teardown continues
+                logger.exception("gang checkpoint close failed")
+            self._gang_cp = None
+        if self._tmp is not None:
+            self._tmp.cleanup()
+            self._tmp = None
+
+    def __enter__(self) -> "MultiHostGang":
+        return self.up()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------- reservation
+
+    def members(self) -> list[GangMember]:
+        return [
+            GangMember(node=n, claim_uid=f"{self.domain_uid}-m{i}",
+                       namespace=self.config.namespace)
+            for i, n in enumerate(self.node_names)
+        ]
+
+    def reserve(self) -> object:
+        """Gang-reserve one channel claim per node; returns the
+        GangStatus.  Raises GangBindError (rolled back) on any member
+        failure."""
+        self._members = self.members()
+        claims = {
+            m.claim_uid: make_channel_claim(
+                m.claim_uid,
+                m.node,
+                self.domain_uid,
+                namespace=self.config.namespace,
+            )
+            for m in self._members
+        }
+        for claim in claims.values():
+            self.kube.create(gvr.RESOURCE_CLAIMS, claim, self.config.namespace)
+        self.grant = self.gangs.reserve(
+            self.config.domain_name, self._members, claims
+        )
+        return self.grant
+
+    def release(self) -> None:
+        self.gangs.release(self.config.domain_name)
+        self.grant = None
+
+    # -------------------------------------------------------------- probes
+
+    def bound_claim_count(self) -> int:
+        """Gang-member claims currently bound across every node's plugin
+        checkpoint — the rollback assertions' "zero bound claims"."""
+        uids = {m.claim_uid for m in (self._members or self.members())}
+        n = 0
+        for d in self.drivers.values():
+            n += sum(1 for uid in d.state.prepared_claim_uids() if uid in uids)
+        return n
+
+    def cdi_leak_count(self) -> int:
+        """Claim CDI spec files present across every node — zero after a
+        rollback/release (the "zero CDI leaks" assertion)."""
+        return sum(
+            len(d.state._cdi.list_claim_uids()) for d in self.drivers.values()
+        )
+
+    # --------------------------------------------------------------- launch
+
+    def _grant_env(self, node: str, claim_uid: str) -> dict[str, str]:
+        """The env a container consuming this claim would see: the CDI
+        spec's claim-wide containerEdits env, with mount containerPaths
+        rewritten to their hostPaths (what the runtime's bind mount does)."""
+        driver = self.drivers[node]
+        spec = driver.state._cdi.read_claim_spec(claim_uid)
+        if spec is None:
+            raise RuntimeError(f"no CDI spec for {claim_uid} on {node}")
+        edits = spec.get("containerEdits", {})
+        mount_map = {
+            m["containerPath"]: m["hostPath"] for m in edits.get("mounts", [])
+        }
+        env: dict[str, str] = {}
+        for kv in edits.get("env", []):
+            k, _, v = kv.partition("=")
+            env[k] = mount_map.get(v, v)
+        return env
+
+    def launch(
+        self,
+        kill_rank: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> list[RankResult]:
+        """One OS process per granted rank; returns per-rank results.
+
+        ``kill_rank`` SIGKILLs that rank shortly after spawn (the
+        dead-worker failure path); survivors blocked in the gang barrier
+        are killed at the deadline and report nonzero."""
+        if self.grant is None:
+            raise RuntimeError("reserve() before launch()")
+        from tpudra.cddaemon.coordproxy import CoordinatorProxy
+
+        deadline_s = deadline_s or self.config.launch_deadline_s
+        host0 = self._members[0]
+        domain_dir = self.drivers[host0.node].cd_manager.domain_dir(
+            self.domain_uid
+        )
+        coord_port = _free_port()
+        # Peers dial the REAL daemon coordinator proxy; it forwards to the
+        # registration host 0 writes into the shared domain dir.
+        self._proxy = CoordinatorProxy(
+            port=0, registration_dir=domain_dir, host="127.0.0.1"
+        )
+        self._proxy.start()
+
+        self._procs = []
+        logs: list[str] = []
+        for rank, member in enumerate(self._members):
+            env = self._grant_env(member.node, member.claim_uid)
+            chips_block = 1
+            for v in env.get("TPU_CHIPS_PER_HOST_BOUNDS", "1").split(","):
+                chips_block *= int(v)
+            sim_coord = (
+                f"127.0.0.1:{coord_port}"
+                if rank == 0
+                else f"127.0.0.1:{self._proxy.bound_port}"
+            )
+            full_env = {
+                # The grant is the contract; the process env starts from it.
+                **env,
+                # Sim platform shims (module docstring).
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    f"--xla_force_host_platform_device_count={chips_block}"
+                ),
+                "TPUDRA_SIM_COORDINATOR": sim_coord,
+                # Process plumbing.
+                "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+                "PATH": os.environ.get("PATH", ""),
+                "HOME": os.environ.get("HOME", "/root"),
+                **self.config.extra_env,
+            }
+            log_path = os.path.join(self._tmp.name, f"rank-{rank}.log")
+            logs.append(log_path)
+            with open(log_path, "w") as out:
+                self._procs.append(
+                    subprocess.Popen(
+                        [sys.executable, "-m", "tpudra.sim.multihost", "--worker"],
+                        env=full_env,
+                        stdout=out,
+                        stderr=subprocess.STDOUT,
+                        text=True,
+                    )
+                )
+        if kill_rank is not None:
+            # Mid-gang death: the victim dies while the gang is forming
+            # (well inside rendezvous — a full healthy run takes seconds).
+            time.sleep(0.3)
+            self._procs[kill_rank].send_signal(signal.SIGKILL)
+
+        deadline = time.monotonic() + deadline_s
+        for proc in self._procs:
+            remaining = max(0.1, deadline - time.monotonic())
+            try:
+                proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        results = []
+        for rank, (proc, log_path) in enumerate(zip(self._procs, logs)):
+            try:
+                with open(log_path) as f:
+                    output = f.read()
+            except OSError:
+                output = ""
+            results.append(
+                RankResult(rank=rank, returncode=proc.returncode, output=output)
+            )
+        self._procs = []
+        self._proxy.stop()
+        self._proxy = None
+        return results
+
+    def _kill_procs(self) -> None:
+        for proc in self._procs:
+            if proc.poll() is None:
+                proc.kill()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        self._procs = []
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ------------------------------------------------------------------ worker
+
+
+def _worker_main() -> int:
+    """One rank of the gang, driven by the grant env alone."""
+    from tpudra.workload.envspec import ClaimEnv
+
+    env = ClaimEnv.from_environ()
+    # The libtpu worker-bootstrap contract must be complete BEFORE jax
+    # loads (libtpu reads the real process env) — assert, then re-export.
+    assert env.num_hosts > 1, f"not a multi-host grant: {env.num_hosts}"
+    assert env.worker_id == env.host_index, (env.worker_id, env.host_index)
+    assert len(env.worker_hostnames) == env.num_hosts, env.worker_hostnames
+    assert env.skip_mds_query, "grant did not set TPU_SKIP_MDS_QUERY"
+    assert env.host_bounds and env.chips_per_host_bounds, "no host bounds"
+    assert env.mesh_shape, "grant carried no TPUDRA_MESH_SHAPE"
+    assert env.host_coords, "grant carried no TPUDRA_HOST_COORDS"
+    assert all(
+        c < m for c, m in zip(env.host_coords, env.mesh_shape)
+    ), (env.host_coords, env.mesh_shape)
+    assert env.coordinator, "grant injected no coordinator"
+    env.apply_libtpu_env()
+    # Sim-only address override (the stable daemon DNS name does not
+    # resolve on one machine); the relay itself stays real — peers reach
+    # host 0 through the daemon's coordinator proxy.
+    env.coordinator = os.environ.get("TPUDRA_SIM_COORDINATOR") or env.coordinator
+    env.initialize_distributed()
+
+    import jax
+
+    n_slice = env.slice_device_count
+    devices = jax.devices()
+    local = jax.local_devices()
+    assert jax.process_count() == env.num_hosts, jax.process_count()
+    # THE topology assertion: the runtime sees exactly the granted slice —
+    # every chip of the mesh, this host fielding exactly its chip block.
+    assert len(devices) == n_slice, (len(devices), n_slice)
+    chips_block = 1
+    for v in env.chips_per_host_bounds.split(","):
+        chips_block *= int(v)
+    assert len(local) == chips_block, (len(local), chips_block)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
+
+    mesh = Mesh(np.asarray(devices).reshape(-1), ("dp",))
+    cols = 8
+    block = jnp.ones((len(local), cols), jnp.float32) * (env.host_index + 1)
+    garr = multihost_utils.host_local_array_to_global_array(
+        block, mesh, P("dp", None)
+    )
+    total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(
+        garr
+    )
+    val = float(total.addressable_data(0))
+    expect = cols * chips_block * sum(
+        r + 1 for r in range(env.num_hosts)
+    )
+    assert val == expect, (val, expect)
+    print(
+        f"RESULT gang-psum: {val} host {env.host_index} "
+        f"devices {len(devices)} mesh {','.join(map(str, env.mesh_shape))}",
+        flush=True,
+    )
+    return 0
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def run_e2e(
+    num_hosts: int, kill_rank: Optional[int] = None, deadline_s: float = 120.0
+) -> dict:
+    """The whole loop as one call (the `make e2e-multihost` CLI body and
+    tests/test_multihost.py's engine).  Returns a JSON-able summary."""
+    cfg = MultiHostConfig(num_hosts=num_hosts, launch_deadline_s=deadline_s)
+    out: dict = {"num_hosts": num_hosts, "kill_rank": kill_rank}
+    with MultiHostGang(cfg) as gang:
+        t0 = time.perf_counter()
+        gang.reserve()
+        out["gang_bind_ms"] = round((time.perf_counter() - t0) * 1000.0, 2)
+        out["bound_claims"] = gang.bound_claim_count()
+        results = gang.launch(kill_rank=kill_rank)
+        out["ranks"] = [
+            {"rank": r.rank, "rc": r.returncode, "tail": r.output[-400:]}
+            for r in results
+        ]
+        out["launch_ok"] = all(r.ok for r in results)
+        gang.release()
+        out["bound_claims_after_release"] = gang.bound_claim_count()
+        out["cdi_leaks_after_release"] = gang.cdi_leak_count()
+    if kill_rank is None:
+        out["ok"] = (
+            out["launch_ok"]
+            and out["bound_claims"] == num_hosts
+            and out["bound_claims_after_release"] == 0
+            and out["cdi_leaks_after_release"] == 0
+        )
+    else:
+        out["ok"] = (
+            not out["launch_ok"]
+            and out["bound_claims_after_release"] == 0
+            and out["cdi_leaks_after_release"] == 0
+        )
+    return out
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--worker":
+        return _worker_main()
+    parser = argparse.ArgumentParser(
+        description="Multi-host gang harness: ComputeDomain claim → gang "
+        "reservation → one OS process per node → jax.distributed psum "
+        "(docs/multi-host.md)."
+    )
+    parser.add_argument("--hosts", type=int, default=4)
+    parser.add_argument(
+        "--kill-rank",
+        type=int,
+        default=None,
+        help="kill this rank mid-gang and assert rollback instead",
+    )
+    parser.add_argument("--deadline-s", type=float, default=120.0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    out = run_e2e(args.hosts, kill_rank=args.kill_rank, deadline_s=args.deadline_s)
+    print(json.dumps(out, indent=2))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

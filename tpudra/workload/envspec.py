@@ -11,10 +11,13 @@ shape follows the granted topology rather than a hardcoded world size.
 
 from __future__ import annotations
 
+import logging
 import os
 from contextlib import contextmanager as _contextmanager
 from dataclasses import dataclass, field
 from typing import Optional
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -40,6 +43,11 @@ class ClaimEnv:
     # Multi-process sharing (MPS analog): the per-claim control daemon's
     # pipe directory, injected by the plugin's CDI edits.
     mp_pipe_dir: str = ""
+    # Slice geometry from the grant (cdplugin/libtpuenv.slice_env): the
+    # full ICI mesh of the slice and this host's block origin within it.
+    # () = not granted (single-host chip claims carry no slice env).
+    mesh_shape: tuple = ()
+    host_coords: tuple = ()
     # The libtpu worker-bootstrap contract (cdplugin/libtpuenv.py): the env
     # libtpu itself reads to form the ICI mesh on a multi-host slice —
     # orthogonal to the JAX-level rendezvous above.
@@ -74,6 +82,16 @@ class ClaimEnv:
         out.host_index = int(env.get("TPUDRA_HOST_INDEX", "0") or "0")
         out.coordinator = env.get("TPUDRA_COORDINATOR", "")
         out.cd_dir = env.get("TPUDRA_CD_DIR", "")
+        for attr, key in (
+            ("mesh_shape", "TPUDRA_MESH_SHAPE"),
+            ("host_coords", "TPUDRA_HOST_COORDS"),
+        ):
+            raw = env.get(key, "")
+            if raw:
+                try:
+                    setattr(out, attr, tuple(int(v) for v in raw.split(",")))
+                except ValueError:
+                    pass  # garbled → "not granted", like worker_id below
         out.mp_pipe_dir = env.get("TPUDRA_MP_PIPE_DIRECTORY", "")
         try:
             out.worker_id = int(env.get("TPU_WORKER_ID", ""))
@@ -151,6 +169,7 @@ class ClaimEnv:
             return
         import jax
 
+        _enable_cpu_collectives(jax)
         address = self.coordinator
         _, _, port = self.coordinator.rpartition(":")
         if self.host_index == 0 and port.isdigit():
@@ -235,6 +254,43 @@ class ClaimEnv:
                 query(self.mp_pipe_dir, f"DETACH {me}")
             except OSError:
                 pass  # daemon went away; nothing to release
+
+
+    @property
+    def slice_device_count(self) -> int:
+        """Chips in the granted slice, from the mesh-shape grant env — the
+        number ``jax.devices()`` must report once the slice-wide runtime is
+        up (the multi-host harness's "pod sees exactly the granted
+        topology" assertion).  0 when the grant carried no slice env."""
+        if not self.mesh_shape:
+            return 0
+        n = 1
+        for v in self.mesh_shape:
+            n *= v
+        return n
+
+
+def _enable_cpu_collectives(jax) -> None:
+    """Multi-process collectives on the CPU backend need an explicit
+    cross-process implementation (gloo); without it every cross-process
+    jit is rejected with "Multiprocess computations aren't implemented on
+    the CPU backend" — the failure that held test_cd_collective.bats in a
+    600 s timeout.  Real TPU processes never take this branch, and jax
+    builds without the knob (or with CPU collectives already default) are
+    left alone."""
+    import os as _os
+
+    platforms = _os.environ.get("JAX_PLATFORMS", "")
+    try:
+        configured = jax.config.jax_platforms or ""
+    except AttributeError:
+        configured = ""
+    if "cpu" not in (platforms, configured):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — knob absent: newer jax defaults it
+        logger.info("jax_cpu_collectives_implementation knob unavailable")
 
 
 def _is_daemon_dns_name(coordinator: str) -> bool:

@@ -197,8 +197,15 @@ class WorkQueue:
         fair: bool = True,
         rng: Optional[random.Random] = None,
     ):
-        self._limiter = rate_limiter or default_controller_rate_limiter(rng=rng)
-        if rng is not None and rate_limiter is not None:
+        explicit_limiter = rate_limiter is not None
+        # Plain param assignment (not `x or default()`): the lockgraph's
+        # attr-type inference reads the annotation off the param, which is
+        # what lets it model `self._limiter.forget()`'s backoff_lock edge
+        # under callers' held locks (informer handler dispatch).
+        if rate_limiter is None:
+            rate_limiter = default_controller_rate_limiter(rng=rng)
+        self._limiter = rate_limiter
+        if rng is not None and explicit_limiter:
             # An explicit seed overrides the limiter's jitter source, so one
             # WorkQueue(seeded) call reproduces the whole retry schedule.
             self._limiter.backoff.rng = rng
