@@ -340,6 +340,102 @@ class TestCdWave:
             soak._close_cd_stack()
             soak.sim.close()
 
+class TestChipFault:
+    """The chip_fault injector: a chip dies under a bound claim AND a
+    live gang member — escalation (claim condition + slice withhold),
+    degraded-gang remediation onto a slice-health-filtered spare, zero
+    grants on dead silicon, then the restart repair."""
+
+    def test_chip_fault_escalates_remediates_and_reheals(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path, nodes=4))
+        soak.sim.start()
+        try:
+            soak._inject({"kind": "chip_fault", "t_sim": 0.0, "node": 1,
+                          "point": None, "params": {}})
+            record = soak._timeline[-1]
+            assert record.kind == "chip_fault"
+            # The gang leg ran and moved the sick member to a spare.
+            assert record.params.get("remediated_to"), record.params
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert soak._checks["gang-degraded"]["violation"] == 0
+            assert soak._checks["grant-health"]["violation"] == 0
+            assert soak._checks["gang-atomicity"]["violation"] == 0
+            # Converged: gang released, nothing bound on the CD stack.
+            assert soak._gang_mgr.gangs() == {}
+            # The repair restart re-healed the chip: it is advertised again.
+            assert "tpu-0" in soak._advertised_devices(soak.sim.node_names[1])
+            # Quiet-window monitor passes over the healed steady state.
+            soak._monitor_once()
+            assert soak._checks["slice-health"]["violation"] == 0
+            assert soak._checks["grant-health"]["violation"] == 0
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak._close_daemon_stack()
+            soak.sim.close()
+
+    def test_chip_fault_without_gang_capacity_still_escalates(self, tmp_path):
+        """2 nodes (< 3): the gang leg is skipped, but escalation and the
+        slice withhold must still be asserted."""
+        soak = ChaosSoak(_mini_config(tmp_path, nodes=2))
+        soak.sim.start()
+        try:
+            soak._inject({"kind": "chip_fault", "t_sim": 0.0, "node": 0,
+                          "point": None, "params": {}})
+            record = soak._timeline[-1]
+            assert "remediated_to" not in record.params
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            # Both the withhold and the escalation checks counted ok.
+            assert soak._checks["fault-recovery"]["ok"] >= 2
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak._close_daemon_stack()
+            soak.sim.close()
+
+
+class TestDaemonCrash:
+    """The daemon_crash injector over the REAL ProcessManager watchdog +
+    CoordinatorProxy."""
+
+    def test_slicewatchd_sigkill_respawns_through_watchdog(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            soak._inject({"kind": "daemon_crash", "t_sim": 0.0, "node": 0,
+                          "point": None, "params": {"target": "slicewatchd"}})
+            record = soak._timeline[-1]
+            assert record.params.get("restarts", 0) >= 1
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert soak._daemon_pm.running
+            # A second kill widens the backoff window but still recovers.
+            soak._inject({"kind": "daemon_crash", "t_sim": 0.0, "node": 0,
+                          "point": None, "params": {"target": "slicewatchd"}})
+            assert soak._timeline[-1].params.get("restarts", 0) >= 2
+            assert soak._checks["fault-recovery"]["violation"] == 0
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak._close_daemon_stack()
+            soak.sim.close()
+
+    def test_coordproxy_bounce_forwards_to_registration_again(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            soak._inject({"kind": "daemon_crash", "t_sim": 0.0, "node": 0,
+                          "point": None, "params": {"target": "coordproxy"}})
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            # The restarted proxy re-read the registration and splices.
+            assert soak._probe_proxy()
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak._close_daemon_stack()
+            soak.sim.close()
+
+
+class TestCdWaveLatency:
     def test_cd_wave_under_latency_rolls_back_atomically(self, tmp_path):
         """A latency spike harsh enough to beat the 5 s member deadline:
         whatever the outcome, no partial gang may survive the wave."""

@@ -26,6 +26,19 @@ def wait_for(pred, timeout=5.0):
     return False
 
 
+def freeze_informer(informer, stop):
+    """Deterministically freeze an informer's cache: signal stop and JOIN
+    its run thread, so no in-flight watch delivery can land after this
+    returns.  The old sleep-bounded version (stop.set(); sleep(0.05)) let
+    a loaded box deliver the next mutation anyway — the PR 8-recorded
+    flake when this file ran concurrently with the soak."""
+    stop.set()
+    thread = informer._thread
+    if thread is not None:
+        thread.join(timeout=10.0)
+        assert not thread.is_alive(), "informer thread did not stop"
+
+
 class GetCounter:
     """FakeKube reactor counting ResourceClaim GETs."""
 
@@ -84,8 +97,9 @@ class TestCachedResolver:
         resolver, informer, stop = mk_resolver(kube)
         gets = GetCounter(kube)
         # Created after sync but resolve before the watch delivers it:
-        # freeze the cache by stopping the informer first.
-        stop.set()
+        # freeze the cache by stopping the informer first (joined — an
+        # in-flight watch thread must not deliver the create below).
+        freeze_informer(informer, stop)
         kube.create(
             gvr.RESOURCE_CLAIMS, mk_claim("u-2", ["tpu-1"], name="c2"), "default"
         )
@@ -103,8 +117,8 @@ class TestCachedResolver:
         )
         resolver, informer, stop = mk_resolver(kube)
         assert wait_for(lambda: informer.get("flappy", "default") is not None)
-        stop.set()  # freeze the cache: it keeps the u-old copy forever
-        time.sleep(0.05)
+        # Freeze the cache: it keeps the u-old copy forever.
+        freeze_informer(informer, stop)
         kube.delete(gvr.RESOURCE_CLAIMS, "flappy", "default")
         kube.create(
             gvr.RESOURCE_CLAIMS, mk_claim("u-new", ["tpu-0"], name="flappy"), "default"
@@ -131,8 +145,8 @@ class TestCachedResolver:
         kube.create(gvr.RESOURCE_CLAIMS, bare, "default")
         resolver, informer, stop = mk_resolver(kube)
         assert wait_for(lambda: informer.get("c3", "default") is not None)
-        stop.set()  # freeze: the cache keeps the unallocated copy
-        time.sleep(0.05)
+        # Freeze: the cache keeps the unallocated copy.
+        freeze_informer(informer, stop)
         live = kube.get(gvr.RESOURCE_CLAIMS, "c3", "default")
         live["status"] = mk_claim("u-3", ["tpu-0"], name="c3")["status"]
         kube.update_status(gvr.RESOURCE_CLAIMS, live, "default")
@@ -316,6 +330,17 @@ class TestWatchHealthGate:
         informer.start(stop)
         assert informer.wait_for_sync(5)
         assert wait_for(lambda: informer.watch_healthy)
+        # Hold the RELIST open so the unhealthy window cannot close before
+        # this thread observes it — with a jittered ~0 s relist backoff,
+        # polling the flag raced the recovery and flaked under load (the
+        # same deflake class as freeze_informer above).  The initial LIST
+        # already happened; only post-failure relists hit the gate.
+        relist_gate = _threading.Event()
+
+        def hold_relist(verb, g, obj):
+            assert relist_gate.wait(10), "test never released the relist"
+
+        kube.react("list", gvr.RESOURCE_CLAIMS, hold_relist)
         api.armed.set()
         kube.create(
             gvr.RESOURCE_CLAIMS, mk_claim("u-b", ["tpu-0"], name="boom"), "default"
@@ -323,8 +348,9 @@ class TestWatchHealthGate:
         assert wait_for(lambda: not informer.watch_healthy), (
             "a dead watch must mark the informer unhealthy"
         )
-        # The informer relists on its backoff and comes back healthy with
-        # the event it missed.
+        # Release the relist: the informer comes back healthy with the
+        # event it missed.
+        relist_gate.set()
         assert wait_for(lambda: informer.watch_healthy, timeout=10)
         assert informer.get("boom", "default") is not None
         stop.set()

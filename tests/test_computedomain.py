@@ -548,6 +548,129 @@ class TestProcessManager:
         finally:
             stop.set()
 
+    def test_restart_backoff_decorrelates_and_resets_when_stable(self):
+        """The watchdog's restart delay is the shared full-jitter policy
+        (tpudra/backoff.py), seeded-rng injectable: same seed replays the
+        same delay schedule, different seeds decorrelate (the herd
+        property the backoff module exists for), and the window collapses
+        once the child proves stable for STABLE_UPTIME."""
+        import random
+
+        from tpudra.backoff import full_jitter_delay
+
+        pm_a = ProcessManager(["true"], restart_rng=random.Random(7))
+        pm_b = ProcessManager(["true"], restart_rng=random.Random(7))
+        pm_c = ProcessManager(["true"], restart_rng=random.Random(8))
+        seq_a = [pm_a._restart_backoff.next_delay() for _ in range(4)]
+        seq_b = [pm_b._restart_backoff.next_delay() for _ in range(4)]
+        seq_c = [pm_c._restart_backoff.next_delay() for _ in range(4)]
+        assert seq_a == seq_b, "same seed must replay the same schedule"
+        assert seq_a != seq_c, "different seeds must decorrelate"
+        # The schedule IS full jitter over the capped-exponential window.
+        rng = random.Random(7)
+        expect = [
+            full_jitter_delay(
+                ProcessManager.RESTART_BACKOFF_BASE,
+                ProcessManager.RESTART_BACKOFF_CAP,
+                attempt,
+                rng,
+            )
+            for attempt in range(4)
+        ]
+        assert seq_a == expect
+        # Stable-uptime reset: the watchdog collapses the window before
+        # drawing when the child ran ≥ STABLE_UPTIME.
+        assert pm_a._restart_backoff.attempt == 4
+        pm_a._restart_backoff.reset()
+        assert pm_a._restart_backoff.attempt == 0
+
+    def test_watchdog_restart_counts_metric_and_paces_with_backoff(self):
+        """A crash-looping child is respawned through the backoff (delay
+        observed via the widened attempt counter) and every restart lands
+        in tpudra_daemon_restarts_total{daemon}."""
+        import random
+
+        from prometheus_client import REGISTRY
+
+        def metric():
+            return (
+                REGISTRY.get_sample_value(
+                    "tpudra_daemon_restarts_total",
+                    {"daemon": os.path.basename(sys.executable)},
+                )
+                or 0.0
+            )
+
+        before = metric()
+        pm = ProcessManager(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            restart_rng=random.Random(3),
+        )
+        stop = threading.Event()
+        pm.ensure_started()
+        pm.start_watchdog(stop, tick=0.02)
+        try:
+            pid1 = pm.pid
+            os.kill(pid1, signal.SIGKILL)
+            wait_for(lambda: pm.running and pm.pid != pid1, msg="first restart")
+            assert pm.restarts == 1
+            assert metric() - before == 1.0
+            # The window widened: the next draw comes from attempt 1.
+            assert pm._restart_backoff.attempt == 1
+            pid2 = pm.pid
+            os.kill(pid2, signal.SIGKILL)
+            wait_for(
+                lambda: pm.running and pm.pid != pid2, msg="second restart",
+                timeout=10.0,
+            )
+            assert pm.restarts == 2
+            assert metric() - before == 2.0
+            assert pm._restart_backoff.attempt == 2
+        finally:
+            stop.set()
+            pm.stop()
+
+    def test_reload_after_watchdog_respawn_waits_signal_safe_age(self):
+        """SIGNAL_SAFE_AGE × backoff interaction: a watchdog respawn
+        resets the spawn timestamp, so a reload() racing the respawn must
+        wait out the fresh handler-install window — a SIGHUP landing
+        before the NEW child's handler is installed would kill it and
+        spin the restart loop."""
+        import random
+
+        pm = ProcessManager(
+            [
+                sys.executable,
+                "-c",
+                "import signal, time; signal.signal(signal.SIGHUP, lambda *a: None);"
+                " time.sleep(60)",
+            ],
+            restart_rng=random.Random(5),
+        )
+        pm.SIGNAL_SAFE_AGE = 0.5
+        stop = threading.Event()
+        pm.ensure_started()
+        pm.start_watchdog(stop, tick=0.02)
+        try:
+            pid1 = pm.pid
+            os.kill(pid1, signal.SIGKILL)
+            wait_for(lambda: pm.running and pm.pid != pid1, msg="respawn")
+            # Immediately reload: the fresh child is younger than
+            # SIGNAL_SAFE_AGE, so reload must stall past the window and
+            # the child must SURVIVE the eventual SIGHUP.
+            t0 = time.monotonic()
+            age_at_reload = time.monotonic() - pm._started_at
+            pm.reload()
+            waited = time.monotonic() - t0
+            if age_at_reload < pm.SIGNAL_SAFE_AGE:
+                assert waited >= pm.SIGNAL_SAFE_AGE - age_at_reload - 0.05
+            time.sleep(0.1)
+            assert pm.running, "reload's SIGHUP killed the fresh child"
+            assert pm.restarts == 1  # no extra respawn triggered
+        finally:
+            stop.set()
+            pm.stop()
+
     def test_reload_does_not_sleep_holding_lock(self):
         """BLOCK-UNDER-LOCK regression (ISSUE 2 sleep audit): reload() must
         wait out SIGNAL_SAFE_AGE with the supervisor lock RELEASED — the

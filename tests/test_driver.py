@@ -147,6 +147,163 @@ class TestResourceSliceGeneration:
 # -- Driver lifecycle --------------------------------------------------------
 
 
+class TestSliceHealthAnnotation:
+    def test_unhealthy_count_rides_every_slice(self, tmp_path):
+        """Published slice health, consumable without node access: the
+        withheld-for-health count is stamped on every built slice (the
+        remediation's spare-selection input — gang.select_healthy_spares)."""
+        from tpudra.plugin.resourceslice import SLICE_UNHEALTHY_ANNOTATION
+
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable, unhealthy={"tpu-0"}, node_name="node-a"
+        )
+        assert res.unhealthy_count >= 1
+        slices = build_resource_slices(res, "node-a")
+        for s in slices:
+            assert s["metadata"]["annotations"][
+                SLICE_UNHEALTHY_ANNOTATION
+            ] == str(res.unhealthy_count)
+        healthy = generate_driver_resources(
+            d.state.allocatable, node_name="node-a"
+        )
+        assert healthy.unhealthy_count == 0
+        for s in build_resource_slices(healthy, "node-a"):
+            assert s["metadata"]["annotations"][
+                SLICE_UNHEALTHY_ANNOTATION
+            ] == "0"
+
+    def test_sibling_withhold_is_not_counted_unhealthy(self, tmp_path):
+        d = mk_driver(tmp_path)
+        res = generate_driver_resources(
+            d.state.allocatable, withheld={"tpu-1"}, node_name="node-a"
+        )
+        assert res.unhealthy_count == 0
+        assert all(dev["name"] != "tpu-1" for dev in res.devices)
+
+
+class TestBoundClaimHealthEscalation:
+    """The health loop's claim-facing half: a device dying under a BOUND
+    claim is surfaced on the claim's status (condition + per-device
+    health) by cross-referencing the checkpoint's bound claims through
+    read_view() — withholding from future slices does nothing for a claim
+    already holding the silicon."""
+
+    def _bound(self, tmp_path, kube, uid="u-esc", devices=("tpu-0",), name="esc"):
+        d = mk_driver(tmp_path, kube)
+        claim = mk_claim(uid, list(devices), name=name)
+        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        resp = d.prepare_resource_claims([claim])
+        assert "error" not in resp["claims"][uid], resp
+        return d
+
+    def test_fault_under_bound_claim_writes_condition(self, tmp_path):
+        from tpudra.plugin.driver import CLAIM_UNHEALTHY_CONDITION
+
+        kube = FakeKube()
+        d = self._bound(tmp_path, kube)
+        chip0 = d.state._chips_by_index[0]
+        d._handle_health_event(
+            HealthEvent(kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid)
+        )
+        live = kube.get(gvr.RESOURCE_CLAIMS, "esc", "default")
+        cond = next(
+            c
+            for c in live["status"]["conditions"]
+            if c["type"] == CLAIM_UNHEALTHY_CONDITION
+        )
+        assert cond["status"] == "True"
+        assert cond["reason"] == HealthEventKind.HBM_ECC_ERROR
+        assert "tpu-0" in cond["message"]
+        dev = next(
+            e for e in live["status"]["devices"] if e["device"] == "tpu-0"
+        )
+        assert dev["driver"] == TPU_DRIVER_NAME
+        assert dev["conditions"][0]["type"] == "Healthy"
+        assert dev["conditions"][0]["status"] == "False"
+        d.stop()
+
+    def test_fault_on_unbound_silicon_touches_no_claim(self, tmp_path):
+        from tpudra.plugin.driver import CLAIM_UNHEALTHY_CONDITION
+
+        kube = FakeKube()
+        d = self._bound(tmp_path, kube, devices=("tpu-1",))
+        chip0 = d.state._chips_by_index[0]  # NOT the claim's chip
+        d._handle_health_event(
+            HealthEvent(kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid)
+        )
+        live = kube.get(gvr.RESOURCE_CLAIMS, "esc", "default")
+        assert not any(
+            c.get("type") == CLAIM_UNHEALTHY_CONDITION
+            for c in live.get("status", {}).get("conditions", [])
+        )
+        d.stop()
+
+    def test_stale_uid_skips_the_write(self, tmp_path):
+        """The claim was deleted and recreated under the same name: the
+        new incarnation never held this silicon, so no condition lands on
+        it (and the escalation does not raise)."""
+        from tpudra.plugin.driver import CLAIM_UNHEALTHY_CONDITION
+
+        kube = FakeKube()
+        d = self._bound(tmp_path, kube)
+        kube.delete(gvr.RESOURCE_CLAIMS, "esc", "default")
+        kube.create(
+            gvr.RESOURCE_CLAIMS, mk_claim("u-new", ["tpu-2"], name="esc"), "default"
+        )
+        chip0 = d.state._chips_by_index[0]
+        d._handle_health_event(
+            HealthEvent(kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid)
+        )
+        live = kube.get(gvr.RESOURCE_CLAIMS, "esc", "default")
+        assert not any(
+            c.get("type") == CLAIM_UNHEALTHY_CONDITION
+            for c in live.get("status", {}).get("conditions", [])
+        )
+        d.stop()
+
+    def test_escalation_failure_never_breaks_the_health_path(self, tmp_path):
+        """An apiserver error mid-escalation is counted and swallowed —
+        the withhold (slice republish) must land regardless."""
+        from prometheus_client import REGISTRY
+
+        kube = FakeKube()
+        d = self._bound(tmp_path, kube)
+
+        def boom(verb, g, obj):
+            raise RuntimeError("apiserver down")
+
+        # update_status rides the fake's "update" verb reactors.
+        kube.react("update", gvr.RESOURCE_CLAIMS, boom)
+        failed_before = (
+            REGISTRY.get_sample_value(
+                "tpudra_claim_health_escalations_total", {"result": "failed"}
+            )
+            or 0.0
+        )
+        chip0 = d.state._chips_by_index[0]
+        d._handle_health_event(
+            HealthEvent(kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid)
+        )
+        failed_after = (
+            REGISTRY.get_sample_value(
+                "tpudra_claim_health_escalations_total", {"result": "failed"}
+            )
+            or 0.0
+        )
+        assert failed_after - failed_before == 1.0, (
+            "the failure path never fired — the reactor missed the verb"
+        )
+        assert "tpu-0" in d.unhealthy_devices()
+        names = {
+            dev["name"]
+            for s in kube.list(gvr.RESOURCE_SLICES)["items"]
+            for dev in s["spec"]["devices"]
+        }
+        assert "tpu-0" not in names
+        d.stop()
+
+
 class TestDriver:
     def test_publish_creates_and_replaces_slices(self, tmp_path):
         kube = FakeKube()

@@ -200,6 +200,195 @@ class TestGangStateMachine:
             mgr.reserve("g1", [], {})
 
 
+class TestGangRemediation:
+    """Degraded-gang state machine: mark_degraded → remediate onto a spare
+    → all-bound-on-healthy or cleanly-released, never partial."""
+
+    def _bound_gang(self, cp, n=4):
+        binder = RecordingBinder()
+        members = mk_members(n)
+        mgr = GangReservationManager(cp, binder)
+        mgr.reserve("g1", members, mk_claims(members))
+        return binder, members, mgr
+
+    def test_mark_degraded_keeps_gang_all_bound(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        assert mgr.mark_degraded("g1", ["c2"], reason="HbmEccError")
+        st = mgr.gangs()["g1"]
+        assert st.phase == "degraded"
+        assert st.unhealthy == ["c2"]
+        # Degraded ≠ partial: every member is still bound.
+        assert binder.bound == {"c0", "c1", "c2", "c3"}
+        probe = lambda m: m.claim_uid in binder.bound  # noqa: E731
+        assert mgr.partially_bound(probe) == []
+        # Idempotent merge.
+        assert mgr.mark_degraded("g1", ["c3"])
+        assert mgr.gangs()["g1"].unhealthy == ["c2", "c3"]
+
+    def test_mark_degraded_on_missing_or_inflight_gang_is_false(self, cp):
+        mgr = GangReservationManager(cp, RecordingBinder())
+        assert not mgr.mark_degraded("ghost", ["c0"])
+
+    def test_remediate_moves_whole_gang_off_sick_member(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        mgr.mark_degraded("g1", ["c2"], reason="chip")
+        repl = GangMember(node="spare", claim_uid="r2")
+        target = [repl if m.claim_uid == "c2" else m for m in members]
+        status = mgr.remediate("g1", {"c2": repl}, mk_claims(target))
+        assert status.phase == "bound"
+        # COORDINATED: every old member was unbound (the whole mesh moves),
+        # then every target member bound.
+        assert {"c0", "c1", "c2", "c3"} <= set(binder.unbind_calls)
+        assert binder.bound == {"c0", "c1", "r2", "c3"}
+        st = mgr.gangs()["g1"]
+        assert st.phase == "bound"
+        assert {m.claim_uid for m in st.members} == {"c0", "c1", "r2", "c3"}
+        assert st.unhealthy == [] and st.target == []
+
+    def test_remediate_rebind_failure_releases_cleanly(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        mgr.mark_degraded("g1", ["c2"])
+        repl = GangMember(node="spare", claim_uid="r2")
+        target = [repl if m.claim_uid == "c2" else m for m in members]
+        binder.fail_on = {"r2"}
+        with pytest.raises(GangBindError):
+            mgr.remediate("g1", {"c2": repl}, mk_claims(target))
+        # Cleanly released: nothing bound anywhere, record gone.
+        assert binder.bound == set()
+        assert mgr.gangs() == {}
+
+    def test_remediate_refuses_unknown_member_and_missing_claims(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        repl = GangMember(node="spare", claim_uid="rX")
+        with pytest.raises(GangBindError, match="non-member"):
+            mgr.remediate("g1", {"ghost": repl}, {})
+        with pytest.raises(GangBindError, match="no claim object"):
+            mgr.remediate("g1", {"c2": repl}, {})
+        # The refused attempts disturbed nothing.
+        assert mgr.gangs()["g1"].phase == "bound"
+        assert binder.bound == {"c0", "c1", "c2", "c3"}
+
+    def test_recover_leaves_degraded_gangs_alone(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        mgr.mark_degraded("g1", ["c0"])
+        assert mgr.recover() == []
+        assert mgr.gangs()["g1"].phase == "degraded"
+        assert binder.bound == {"c0", "c1", "c2", "c3"}
+
+    def test_recover_resumes_interrupted_remediation_with_resolver(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        mgr.mark_degraded("g1", ["c2"])
+        repl = GangMember(node="spare", claim_uid="r2")
+        target = [repl if m.claim_uid == "c2" else m for m in members]
+        with checkpoint_mod.armed_crash("mid-gang-remediate"):
+            with pytest.raises(SimulatedCrash):
+                mgr.remediate("g1", {"c2": repl}, mk_claims(target))
+        # The crash fired with the plan journaled and the OLD members
+        # still bound.
+        assert mgr.gangs()["g1"].phase == "remediating"
+        assert binder.bound == {"c0", "c1", "c2", "c3"}
+        cp.abandon()
+
+        cp2 = CheckpointManager(os.path.dirname(cp._path))
+        mgr2 = GangReservationManager(
+            cp2, binder,
+            claim_resolver=lambda m: {"metadata": {"uid": m.claim_uid}},
+        )
+        assert mgr2.recover() == ["g1"]
+        st = mgr2.gangs()["g1"]
+        assert st.phase == "bound"
+        assert {m.claim_uid for m in st.members} == {"c0", "c1", "r2", "c3"}
+        assert binder.bound == {"c0", "c1", "r2", "c3"}
+        cp2.close()
+
+    def test_recover_releases_interrupted_remediation_without_resolver(self, cp):
+        binder, members, mgr = self._bound_gang(cp)
+        mgr.mark_degraded("g1", ["c2"])
+        repl = GangMember(node="spare", claim_uid="r2")
+        target = [repl if m.claim_uid == "c2" else m for m in members]
+        with checkpoint_mod.armed_crash("mid-gang-remediate"):
+            with pytest.raises(SimulatedCrash):
+                mgr.remediate("g1", {"c2": repl}, mk_claims(target))
+        cp.abandon()
+
+        cp2 = CheckpointManager(os.path.dirname(cp._path))
+        mgr2 = GangReservationManager(cp2, binder)  # no resolver
+        assert mgr2.recover() == ["g1"]
+        # Cleanly released: no resolver to refetch the target claims.
+        assert binder.bound == set()
+        assert mgr2.gangs() == {}
+        cp2.close()
+
+    def test_release_of_interrupted_remediation_tears_down_target_binds(
+        self, cp
+    ):
+        """Force-release of a crash-interrupted REMEDIATING gang must
+        unwind the journaled TARGET members too: a crash mid-re-bind
+        leaves replacement binds the member list never names — releasing
+        only rec.members would leak them forever."""
+        binder, members, mgr = self._bound_gang(cp)
+        mgr.mark_degraded("g1", ["c2"])
+        repl = GangMember(node="spare", claim_uid="r2")
+        target = [repl if m.claim_uid == "c2" else m for m in members]
+        # Crash inside the re-bind loop, after the first target member is
+        # bound and journaled (the reserve-path crash point fires there).
+        with checkpoint_mod.armed_crash("mid-gang-reserve"):
+            with pytest.raises(SimulatedCrash):
+                mgr.remediate("g1", {"c2": repl}, mk_claims(target))
+        st = mgr.gangs()["g1"]
+        assert st.phase == "remediating" and st.target
+        assert binder.bound  # ≥1 target bind survived the crash
+        # Operator force-release instead of recover(): nothing may leak.
+        mgr.release("g1")
+        assert binder.bound == set()
+        assert mgr.gangs() == {}
+
+    def test_concurrent_op_on_same_gang_refused(self, cp):
+        from tpudra.controller.gang import GangOpInProgress
+
+        binder, members, mgr = self._bound_gang(cp)
+        with mgr._gang_op("g1", "test"):
+            with pytest.raises(GangOpInProgress):
+                mgr.release("g1")
+        mgr.release("g1")  # guard released with the context
+        assert mgr.gangs() == {}
+
+    def test_select_healthy_spares_filters_on_published_slices(self, tmp_path):
+        """Spare selection reads PUBLISHED ResourceSlices: a node whose
+        slices carry a nonzero unhealthy-count annotation (or advertise
+        nothing) never qualifies."""
+        from tpudra.controller.gang import (
+            published_slice_health,
+            select_healthy_spares,
+        )
+        from tpudra.devicelib import HealthEvent, HealthEventKind
+
+        from tests.test_driver import mk_driver
+
+        kube = FakeKube()
+        healthy = mk_driver(tmp_path / "a", kube)
+        healthy._config.node_name = "node-a"
+        sick = mk_driver(tmp_path / "b", kube)
+        sick._config.node_name = "node-b"
+        healthy.publish_resources()
+        chip0 = sick.state._chips_by_index[0]
+        sick._handle_health_event(
+            HealthEvent(
+                kind=HealthEventKind.HBM_ECC_ERROR, chip_uuid=chip0.uuid
+            )
+        )
+        sick.publish_resources()
+        health = published_slice_health(kube)
+        assert health["node-a"].healthy
+        assert not health["node-b"].healthy and health["node-b"].unhealthy > 0
+        assert select_healthy_spares(kube, ["node-a", "node-b"]) == ["node-a"]
+        assert select_healthy_spares(
+            kube, ["node-a", "node-b"], exclude={"node-a"}
+        ) == []
+        healthy._checkpoints.close()
+        sick._checkpoints.close()
+
+
 # ------------------------------------------------------------- crash sweep
 
 
@@ -320,6 +509,87 @@ def test_gang_crash_sweep_converges_all_or_nothing(tmp_path, point):
         d._checkpoints.close()
 
 
+@pytest.mark.parametrize("resume", [True, False])
+def test_remediation_crash_sweep_through_real_drivers(tmp_path, resume):
+    """Crash at ``mid-gang-remediate`` (plan journaled, old members still
+    bound) against REAL CD plugin drivers; a fresh manager must converge:
+    with a claim resolver the remediation RESUMES (all-bound on the spare,
+    nothing on the displaced node), without one the gang is cleanly
+    released — never partial, zero CDI leaks either way."""
+    kube, nodes, drivers = _cd_stack(tmp_path, n=4)
+    # Gang on the first 3 nodes; the 4th is the healthy spare.
+    gang_nodes = nodes[:3]
+    members = [
+        GangMember(node=name, claim_uid=f"{DOMAIN_UID}-m{i}")
+        for i, name in enumerate(gang_nodes)
+    ]
+    claims = {
+        m.claim_uid: make_channel_claim(m.claim_uid, m.node, DOMAIN_UID)
+        for m in members
+    }
+    for claim in claims.values():
+        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+    gang_dir = str(tmp_path / "gangs")
+    cp = CheckpointManager(gang_dir)
+    mgr = GangReservationManager(cp, DriverGangBinder(drivers))
+    mgr.reserve("grm", members, claims)
+    mgr.mark_degraded("grm", [members[1].claim_uid], reason="chip_fault")
+
+    replacement = GangMember(node=nodes[3], claim_uid=f"{DOMAIN_UID}-r1")
+    target = [replacement if m is members[1] else m for m in members]
+    target_claims = {
+        m.claim_uid: make_channel_claim(m.claim_uid, m.node, DOMAIN_UID)
+        for m in target
+    }
+    kube.create(
+        gvr.RESOURCE_CLAIMS, target_claims[replacement.claim_uid], "default"
+    )
+    crashed = False
+    try:
+        with checkpoint_mod.armed_crash("mid-gang-remediate"):
+            mgr.remediate(
+                "grm", {members[1].claim_uid: replacement}, target_claims
+            )
+    except SimulatedCrash:
+        crashed = True
+    assert crashed, "mid-gang-remediate never fired"
+    cp.abandon()
+
+    cp2 = CheckpointManager(gang_dir)
+    resolver = (
+        (lambda m: make_channel_claim(m.claim_uid, m.node, DOMAIN_UID))
+        if resume
+        else None
+    )
+    mgr2 = GangReservationManager(
+        cp2, DriverGangBinder(drivers), claim_resolver=resolver
+    )
+    assert mgr2.recover() == ["grm"]
+    bound_target = _bound_member_count(drivers, target)
+    bound_old = _bound_member_count(drivers, [members[1]])
+    if resume:
+        st = mgr2.gangs()["grm"]
+        assert st.phase == "bound"
+        assert {m.claim_uid for m in st.members} == {
+            m.claim_uid for m in target
+        }
+        assert bound_target == len(target)
+        # Nothing left on the displaced member's node.
+        assert bound_old == 0
+        assert members[1].claim_uid not in (
+            drivers[members[1].node].state._cdi.list_claim_uids()
+        )
+        mgr2.release("grm")
+    else:
+        assert mgr2.gangs() == {}
+        assert bound_target == 0 and bound_old == 0
+    assert _cdi_leaks(drivers) == 0
+    assert mgr2.recover() == []
+    cp2.close()
+    for d in drivers.values():
+        d._checkpoints.close()
+
+
 def test_gang_reserve_through_real_drivers_roundtrip(tmp_path):
     """No crash: the CD-driver-backed gang binds all members, release
     unwinds to zero bound claims and zero CDI specs (the tier-1 shadow of
@@ -345,6 +615,125 @@ def test_gang_reserve_through_real_drivers_roundtrip(tmp_path):
     assert _bound_member_count(drivers, members) == 0
     assert _cdi_leaks(drivers) == 0
     cp.close()
+    for d in drivers.values():
+        d._checkpoints.close()
+
+
+def test_controller_escalation_wiring_remediates_degraded_gang(tmp_path):
+    """The controller half of the escalation chain: a claim health
+    condition (on_claim_health_condition — what a watch on the plugin's
+    DeviceUnhealthy conditions feeds) marks the owning gang degraded and
+    the queued remediation pass moves it onto the planner's spare."""
+    from tpudra.controller.controller import Controller, ManagerConfig
+    from tpudra.controller.gang import GangStatus
+
+    kube, nodes, drivers = _cd_stack(tmp_path, n=4)
+    gang_nodes = nodes[:3]
+    members = [
+        GangMember(node=name, claim_uid=f"{DOMAIN_UID}-m{i}")
+        for i, name in enumerate(gang_nodes)
+    ]
+    claims = {
+        m.claim_uid: make_channel_claim(m.claim_uid, m.node, DOMAIN_UID)
+        for m in members
+    }
+    for claim in claims.values():
+        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+    # The LIVE controller owns CD status (it re-aggregates from clique
+    # CRs, overwriting _cd_stack's hand-stamped Ready) — give it a real
+    # clique with Ready daemons on every node, spares included.
+    kube.create(
+        gvr.COMPUTE_DOMAIN_CLIQUES,
+        {
+            "apiVersion": CD_API_V,
+            "kind": "ComputeDomainClique",
+            "metadata": {"name": "gc-clique", "namespace": "tpudra-system"},
+            "spec": {"computeDomainUID": DOMAIN_UID},
+            "status": {
+                "daemons": [
+                    {
+                        "nodeName": n,
+                        "ipAddress": "127.0.0.1",
+                        "cliqueID": "gc.0",
+                        "index": k,
+                        "status": "Ready",
+                    }
+                    for k, n in enumerate(nodes)
+                ]
+            },
+        },
+        "tpudra-system",
+    )
+
+    spare = GangMember(node=nodes[3], claim_uid=f"{DOMAIN_UID}-r1")
+
+    def planner(status: GangStatus):
+        sick = status.unhealthy[0]
+        target_claims = {
+            spare.claim_uid: make_channel_claim(
+                spare.claim_uid, spare.node, DOMAIN_UID
+            ),
+            **{
+                m.claim_uid: claims[m.claim_uid]
+                for m in status.members
+                if m.claim_uid != sick
+            },
+        }
+        kube.create(
+            gvr.RESOURCE_CLAIMS, target_claims[spare.claim_uid], "default"
+        )
+        return {sick: spare}, target_claims
+
+    c = Controller(
+        kube,
+        ManagerConfig(
+            driver_namespace="tpudra-system",
+            gang_state_dir=str(tmp_path / "gangs"),
+        ),
+        gang_binder=DriverGangBinder(drivers),
+        gang_remediation_planner=planner,
+    )
+    c.gangs.reserve("w", members, claims)
+    stop = threading.Event()
+    t = c.start(stop)
+    try:
+        # The FULL chain: write the plugin's escalation condition onto the
+        # member claim through the apiserver — the controller's
+        # claim-health informer must pick it up, mark the gang degraded,
+        # and queue the remediation (no direct method call).
+        from tpudra import CLAIM_UNHEALTHY_CONDITION
+
+        live = kube.get(gvr.RESOURCE_CLAIMS, members[1].claim_uid, "default")
+        live.setdefault("status", {})["conditions"] = [
+            {
+                "type": CLAIM_UNHEALTHY_CONDITION,
+                "status": "True",
+                "reason": "HbmEccError",
+            }
+        ]
+        kube.update_status(gvr.RESOURCE_CLAIMS, live, "default")
+        deadline = time.monotonic() + 20
+        moved = False
+        while time.monotonic() < deadline:
+            st = c.gangs.gangs().get("w")
+            if st and st.phase == "bound" and any(
+                m.claim_uid == spare.claim_uid for m in st.members
+            ):
+                moved = True
+                break
+            time.sleep(0.05)
+        assert moved, c.gangs.gangs()
+        assert _bound_member_count(
+            drivers, [spare] + [m for m in members if m is not members[1]]
+        ) == len(members)
+        # The displaced member left nothing behind.
+        assert _bound_member_count(drivers, [members[1]]) == 0
+        # A condition for a claim in no gang is a clean no-op.
+        c.on_claim_health_condition("not-a-gang-member")
+    finally:
+        stop.set()
+        c.queue.shutdown()
+        t.join(15)
     for d in drivers.values():
         d._checkpoints.close()
 

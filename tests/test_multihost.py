@@ -50,3 +50,80 @@ def test_kill_one_rank_rolls_back_to_zero_bound():
     assert out["ranks"][2]["rc"] != 0  # the victim died
     assert out["bound_claims_after_release"] == 0
     assert out["cdi_leaks_after_release"] == 0
+
+
+def test_chip_fault_remediates_to_spare_and_psum_completes():
+    """ISSUE 10 acceptance: fault a chip on a bound member of a 4-node
+    gang with a spare healthy node → the gang remediates to the spare
+    (member selection filtered on published slice health) → the
+    relaunched ranks' psum completes on the new membership — with zero
+    CDI leaks and ZERO partially-bound windows observed throughout (a
+    completed/degraded record never coexists with a missing member
+    bind)."""
+    import threading
+    import time
+
+    cfg = multihost.MultiHostConfig(num_hosts=4, spare_slots=(2,))
+    with multihost.MultiHostGang(cfg) as gang:
+        gang.reserve()
+        assert gang.bound_claim_count() == 4
+
+        # Partial-bound observer: whenever the gang RECORD claims all-bound
+        # (bound or degraded phase), every member must actually be bound.
+        partial_windows: list = []
+        stop = threading.Event()
+
+        def probe(member) -> bool:
+            d = gang.drivers.get(member.node)
+            return (
+                d is not None
+                and member.claim_uid in d.state.prepared_claim_uids()
+            )
+
+        def monitor() -> None:
+            while not stop.is_set():
+                try:
+                    partial = gang.gangs.partially_bound(probe)
+                except Exception:  # noqa: BLE001 — mid-mutate read window
+                    partial = []
+                if partial:
+                    partial_windows.append(tuple(partial))
+                time.sleep(0.002)
+
+        t = threading.Thread(target=monitor)
+        t.start()
+        try:
+            gang.fault_chip(2)
+            # The faulted node's published slices now withhold the chip and
+            # carry a nonzero unhealthy-count annotation.
+            from tpudra.controller.gang import published_slice_health
+
+            health = published_slice_health(gang.kube)
+            assert not health["mh-node-2"].healthy, health
+            assert health["mh-spare-2"].healthy, health
+
+            status = gang.remediate_unhealthy()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert partial_windows == [], partial_windows
+        assert status.phase == "bound"
+        assert [m.node for m in status.members] == [
+            "mh-node-0", "mh-node-1", "mh-spare-2", "mh-node-3",
+        ]
+        assert gang.bound_claim_count() == 4
+        # The displaced member left nothing on the faulted node.
+        sick_driver = gang.drivers["mh-node-2"]
+        assert not sick_driver.state.prepared_claim_uids()
+        assert not sick_driver.state._cdi.list_claim_uids()
+
+        # The relaunch: same slice geometry, rank 2 now on the spare.
+        results = gang.launch()
+        for r in results:
+            assert r.ok, (r.rank, r.output[-400:])
+            assert "RESULT gang-psum: 320.0" in r.output, r.output[-400:]
+            assert "devices 16 mesh 2,2,4" in r.output, r.output[-400:]
+
+        gang.release()
+        assert gang.bound_claim_count() == 0
+        assert gang.cdi_leak_count() == 0

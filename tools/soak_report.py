@@ -28,9 +28,26 @@ import sys
 
 #: Invariants the monitor must have evaluated at least once per run
 #: (lock-witness is only required when the report says it was armed;
-#: slice-convergence only asserts in quiet windows, so a fault-saturated
-#: short run may legitimately end with zero passes).
-REQUIRED_CHECKED = ("claim-stuck", "cdi-leak", "flock-leak")
+#: slice-convergence/slice-health/grant-health only assert in quiet
+#: windows, so a fault-saturated short run may legitimately end with zero
+#: passes of those).
+REQUIRED_CHECKED = ("claim-stuck", "cdi-leak", "flock-leak", "gang-degraded")
+
+#: Fault kinds every soak run must have injected at least once — checked
+#: against the INJECTED set, not just the configured one, so a run whose
+#: config silently dropped chip_fault or daemon_crash (the health/daemon
+#: blast radius) cannot pass the gate.
+REQUIRED_KINDS = (
+    "apiserver_latency",
+    "watch_close",
+    "kubelet_restart",
+    "plugin_crash",
+    "torn_wal",
+    "clock_skew",
+    "cd_wave",
+    "chip_fault",
+    "daemon_crash",
+)
 
 
 def render(report: dict) -> str:
@@ -120,7 +137,9 @@ def assert_slo(
             f"only {report['faults']['injected_total']} faults injected "
             f"(need ≥ {min_faults})"
         )
-    for kind in report["config"]["fault_kinds"]:
+    for kind in dict.fromkeys(
+        tuple(report["config"]["fault_kinds"]) + REQUIRED_KINDS
+    ):
         if report["faults"]["by_kind"].get(kind, 0) < 1:
             failures.append(f"fault kind {kind!r} was never injected")
     for inv in REQUIRED_CHECKED:
@@ -140,7 +159,7 @@ def main(argv=None) -> int:
     parser.add_argument("report", help="path to the soak's JSON report")
     parser.add_argument("--assert-slo", action="store_true")
     parser.add_argument("--min-sim-hours", type=float, default=1.0)
-    parser.add_argument("--min-faults", type=int, default=8)
+    parser.add_argument("--min-faults", type=int, default=9)
     args = parser.parse_args(argv)
     with open(args.report) as f:
         report = json.load(f)

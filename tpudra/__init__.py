@@ -22,3 +22,11 @@ COMPUTE_DOMAIN_DRIVER_NAME = "compute-domain.tpu.google.com"
 # API group for our custom resources (reference: api/nvidia.com/resource/v1beta1).
 API_GROUP = "resource.tpu.google.com"
 API_VERSION = "v1beta1"
+
+# Claim-status condition type for the bound-claim health escalation:
+# WRITTEN by the node plugin's health loop (plugin/driver.py) when granted
+# silicon goes unhealthy under a bound claim, CONSUMED by the controller's
+# claim-health watch to trigger degraded-gang remediation
+# (controller/controller.py).  Lives here because both ends import it and
+# neither may import the other.
+CLAIM_UNHEALTHY_CONDITION = f"{TPU_DRIVER_NAME}/DeviceUnhealthy"

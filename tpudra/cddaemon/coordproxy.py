@@ -211,12 +211,25 @@ class CoordinatorProxy:
     def stop(self) -> None:
         self._stop.set()
         if self._server is not None:
+            # shutdown() BEFORE close(): on Linux, closing a listening
+            # socket from another thread does not wake a thread blocked in
+            # accept() — the old close-only stop left the accept thread
+            # parked until the next connection and this join timing out
+            # (a silent ~5 s stall on every daemon shutdown, surfaced by
+            # the chaos soak's daemon_crash proxy bounce).  shutdown()
+            # does wake it, with an OSError the loop maps to clean exit.
+            try:
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # never connected / already closed: nothing parked
             try:
                 self._server.close()
             except OSError:
                 pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                logger.warning("coordinator proxy accept thread did not exit")
 
     # ------------------------------------------------------------- internals
 

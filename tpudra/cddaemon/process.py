@@ -4,18 +4,30 @@ The analog of compute-domain-daemon/process.go:33-223: start/stop/signal a
 child process (``tpu-slicewatchd``; nvidia-imex in the reference) plus a
 watchdog that restarts it on unexpected death.  Stop is graceful (SIGTERM,
 then SIGKILL after a grace period).
+
+Restart pacing is the shared full-jitter policy (tpudra/backoff.py): a
+crash-looping daemon (bad config, broken binary) must not be respawned in
+a tight loop — and at fleet scale N nodes' daemons dying on one shared
+cause (a pushed bad config) must not march back in lockstep.  The window
+collapses after the child proves stable (``STABLE_UPTIME`` seconds of
+continuous run), so an isolated crash after weeks of uptime restarts
+near-instantly.  Every watchdog restart counts in
+``tpudra_daemon_restarts_total{daemon}``.
 """
 
 from __future__ import annotations
 
 import logging
+import os
+import random
 import signal
 import subprocess
 import threading
 import time
 from typing import Optional, Sequence
 
-from tpudra import lockwitness
+from tpudra import lockwitness, metrics
+from tpudra.backoff import Backoff
 
 logger = logging.getLogger(__name__)
 
@@ -25,7 +37,20 @@ class ProcessManager:
     # have installed its handlers yet, and the default SIGHUP action kills it.
     SIGNAL_SAFE_AGE = 0.5
 
-    def __init__(self, argv: Sequence[str], term_grace: float = 5.0):
+    #: A child alive this long is considered stable: the next death resets
+    #: the restart backoff window instead of widening it.
+    STABLE_UPTIME = 30.0
+
+    #: Watchdog restart-delay window bounds (full jitter draws inside it).
+    RESTART_BACKOFF_BASE = 0.5
+    RESTART_BACKOFF_CAP = 30.0
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        term_grace: float = 5.0,
+        restart_rng: Optional[random.Random] = None,
+    ):
         self._argv = list(argv)
         self._term_grace = term_grace
         self._proc: Optional[subprocess.Popen] = None
@@ -33,6 +58,14 @@ class ProcessManager:
         self._expected_stop = False
         self._started_at = 0.0
         self.restarts = 0
+        #: Full-jitter restart pacing; the rng is injectable so tests (and
+        #: the chaos soak) replay deterministic delay schedules.
+        self._restart_backoff = Backoff(
+            self.RESTART_BACKOFF_BASE, self.RESTART_BACKOFF_CAP, rng=restart_rng
+        )
+        self._restarts_metric = metrics.DAEMON_RESTARTS_TOTAL.labels(
+            os.path.basename(self._argv[0]) if self._argv else "unknown"
+        )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -105,7 +138,11 @@ class ProcessManager:
     # -- watchdog -----------------------------------------------------------
 
     def watchdog(self, stop: threading.Event, tick: float = 1.0) -> None:
-        """Restart the child if it died unexpectedly (process.go:170-202)."""
+        """Restart the child if it died unexpectedly (process.go:170-202),
+        paced by the shared full-jitter backoff: each unexpected death
+        widens the delay window; a child that stayed up ``STABLE_UPTIME``
+        before dying collapses it first.  The delay rides ``stop.wait`` so
+        shutdown is never held hostage by a backed-off respawn."""
         while not stop.is_set():
             with self._lock:
                 died = (
@@ -113,13 +150,40 @@ class ProcessManager:
                     and self._proc.poll() is not None
                     and not self._expected_stop
                 )
+                uptime = time.monotonic() - self._started_at
             if died:
+                if uptime >= self.STABLE_UPTIME:
+                    self._restart_backoff.reset()
+                delay = self._restart_backoff.next_delay()
                 logger.error(
-                    "%s exited unexpectedly (rc=%s); restarting",
-                    self._argv[0], self._proc.returncode,
+                    "%s exited unexpectedly (rc=%s); restarting in %.2fs "
+                    "(attempt %d)",
+                    self._argv[0], self._proc.returncode, delay,
+                    self._restart_backoff.attempt,
                 )
+                if stop.wait(delay):
+                    return
+                with self._lock:
+                    # Re-check under the lock after the backoff wait: a
+                    # stop() landing inside the (up to 30 s) window set
+                    # _expected_stop, and respawning past it would
+                    # resurrect a deliberately-stopped daemon — a race the
+                    # pre-backoff microsecond window never really exposed.
+                    if self._expected_stop:
+                        continue
                 self.restarts += 1
-                self.ensure_started()
+                self._restarts_metric.inc()
+                try:
+                    self.ensure_started()
+                except Exception:  # noqa: BLE001 — supervision must outlive spawn failures
+                    # A failed spawn (binary mid-upgrade, transient EMFILE)
+                    # must not kill the watchdog thread: the child is still
+                    # dead, so the next tick re-enters the died branch and
+                    # retries with a wider backoff window.
+                    logger.exception(
+                        "respawn of %s failed; retrying on the backoff",
+                        self._argv[0],
+                    )
             stop.wait(tick)
 
     def start_watchdog(self, stop: threading.Event, tick: float = 1.0) -> threading.Thread:

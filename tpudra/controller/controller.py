@@ -17,6 +17,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from tpudra import CLAIM_UNHEALTHY_CONDITION
 from tpudra.controller.cleanup import CleanupManager
 from tpudra.controller.computedomain import ComputeDomainManager, RetryLater
 from tpudra.controller.resourceclaimtemplate import CD_UID_LABEL
@@ -33,6 +34,17 @@ from tpudra.workqueue import (
 )
 
 logger = logging.getLogger(__name__)
+
+
+def _has_unhealthy_condition(claim: dict) -> bool:
+    """Cache-filter for the claim-health informer: keep only claims whose
+    status carries the plugin's DeviceUnhealthy escalation (entering the
+    filtered cache dispatches ADDED — the remediation trigger)."""
+    return any(
+        c.get("type") == CLAIM_UNHEALTHY_CONDITION and c.get("status") == "True"
+        for c in claim.get("status", {}).get("conditions", [])
+    )
+
 
 _RECONCILE_OK = metrics.RECONCILES_TOTAL.labels("computedomain", "ok")
 _RECONCILE_REQUEUE = metrics.RECONCILES_TOTAL.labels("computedomain", "requeue")
@@ -76,19 +88,29 @@ class Controller:
         kube: KubeAPI,
         config: ManagerConfig | None = None,
         gang_binder=None,
+        gang_claim_resolver=None,
+        gang_remediation_planner=None,
     ):
         self._kube = kube
         self._config = config or ManagerConfig()
         #: Gang slice reservation (controller/gang.py): present when the
         #: config names a state dir and a binder transport was injected.
+        #: ``gang_claim_resolver`` lets crash recovery RESUME an
+        #: interrupted remediation (refetch target claims);
+        #: ``gang_remediation_planner`` turns a degraded GangStatus into
+        #: ``(replacements, claims)`` for the remediation sweep — without
+        #: one, degraded gangs stay degraded until an operator acts.
         self.gangs = None
         self._gang_cp = None
+        self._gang_planner = gang_remediation_planner
         if self._config.gang_state_dir is not None and gang_binder is not None:
             from tpudra.controller.gang import GangReservationManager
             from tpudra.plugin.checkpoint import CheckpointManager
 
             self._gang_cp = CheckpointManager(self._config.gang_state_dir)
-            self.gangs = GangReservationManager(self._gang_cp, gang_binder)
+            self.gangs = GangReservationManager(
+                self._gang_cp, gang_binder, claim_resolver=gang_claim_resolver
+            )
         self.manager = ComputeDomainManager(
             kube,
             self._config.driver_namespace,
@@ -111,6 +133,22 @@ class Controller:
         self._clique_informer = Informer(
             kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._config.driver_namespace
         )
+        # Claim-health watch: the node plugins escalate device faults onto
+        # bound claims as a DeviceUnhealthy status condition
+        # (plugin/driver.py); this informer is how the controller SEES
+        # those conditions without node access and feeds them into gang
+        # remediation.  Gated on the gang manager (its only consumer), and
+        # cache-filtered to claims CARRYING the condition — O(sick
+        # claims), not O(cluster claims), so the gang feature does not buy
+        # a full claim cache.
+        self._claim_health_informer = None
+        if self.gangs is not None:
+            self._claim_health_informer = Informer(
+                kube,
+                gvr.RESOURCE_CLAIMS,
+                cache_filter=_has_unhealthy_condition,
+            )
+            self._claim_health_informer.add_handler(self._on_claim_health_event)
         # Per-CD daemon pods (daemonsetpods.go analog): non-fabric node
         # membership reads through this cache, and pod readiness flips
         # drive status syncs as events instead of waiting for a resync.
@@ -234,6 +272,8 @@ class Controller:
         self._cd_informer.start(stop)
         self._clique_informer.start(stop)
         self._pod_informer.start(stop)
+        if self._claim_health_informer is not None:
+            self._claim_health_informer.start(stop)
         self._cd_informer.wait_for_sync()
         self._clique_informer.wait_for_sync()
         self._pod_informer.wait_for_sync()
@@ -282,6 +322,143 @@ class Controller:
             logger.warning(
                 "recovered %d interrupted gang(s): %s", len(rolled), rolled
             )
+        # Degraded gangs survive recovery all-bound (gang.py's recover
+        # contract) — hand them straight to the remediation sweep instead
+        # of waiting for the first resync tick.
+        self._sweep_degraded_gangs()
+
+    # ------------------------------------------------------- gang health
+
+    def _on_claim_health_event(self, etype: str, obj: dict) -> None:
+        """Claim-health informer handler: a claim entered the filtered
+        cache (it carries the DeviceUnhealthy condition) — resolve it off
+        the dispatch lock via a queued pass.  DELETED (condition cleared /
+        claim gone) needs nothing: remediation reads gang state, not the
+        condition."""
+        if etype == "DELETED":
+            return
+        uid = obj.get("metadata", {}).get("uid", "")
+        reason = next(
+            (
+                c.get("reason", "")
+                for c in obj.get("status", {}).get("conditions", [])
+                if c.get("type") == CLAIM_UNHEALTHY_CONDITION
+            ),
+            "",
+        )
+        if uid:
+            self.queue.enqueue_keyed(
+                ("claim-health", uid),
+                lambda: self._claim_health_pass(uid, reason),
+            )
+
+    def _claim_health_pass(self, claim_uid: str, reason: str) -> None:
+        """The queued claim-health closure: raises when the owning gang
+        exists but cannot be marked yet (mid-reserve — the record is not
+        PREPARE_COMPLETED), so the work queue's rate limiter retries the
+        escalation until the reserve settles instead of dropping the
+        one-shot signal on the floor."""
+        if not self.on_claim_health_condition(claim_uid, reason=reason):
+            raise RetryLater(
+                f"claim {claim_uid}: owning gang still in-flight; "
+                "re-marking after it settles"
+            )
+
+    def on_claim_health_condition(
+        self, claim_uid: str, reason: str = ""
+    ) -> bool:
+        """Entry point for the bound-claim health escalation (the
+        claim-status condition plugin/driver.py writes): map the claim to
+        its gang, journal the degraded mark, and enqueue remediation.  A
+        claim belonging to no gang is a node-local concern — nothing to
+        do here.  Returns False ONLY when the owning gang exists but is
+        not yet markable (in-flight reserve) — the caller should retry."""
+        if self.gangs is None:
+            return True
+        for gang_id, status in self.gangs.gangs().items():
+            if any(m.claim_uid == claim_uid for m in status.members):
+                if self.gangs.mark_degraded(gang_id, [claim_uid], reason=reason):
+                    self.request_gang_remediation(gang_id)
+                    return True
+                # Terminal-ish phases settle on their own (rollback /
+                # remediating already end released or re-bound); only a
+                # reserving-phase gang needs the escalation re-delivered
+                # once it completes to bound.
+                return status.phase != "reserving"
+        return True
+
+    def request_gang_remediation(self, gang_id: str) -> None:
+        """Queue one remediation pass for a degraded gang (keyed: bursts
+        of member escalations collapse to one pass; the rate limiter owns
+        retry backoff when the pass raises)."""
+        self.queue.enqueue_keyed(
+            ("gang-remediate", gang_id),
+            lambda: self._remediate_gang(gang_id),
+        )
+
+    def _sweep_degraded_gangs(self) -> None:
+        """Enqueue remediation for every degraded OR stranded-remediating
+        gang — the resync-time backstop for escalations that raced a
+        controller restart and for remediations a transient failure left
+        in the remediating phase."""
+        if self.gangs is None:
+            return
+        from tpudra.controller.gang import PHASE_DEGRADED, PHASE_REMEDIATING
+
+        for gang_id, status in self.gangs.gangs().items():
+            if status.phase in (PHASE_DEGRADED, PHASE_REMEDIATING):
+                self.request_gang_remediation(gang_id)
+
+    def _remediate_gang(self, gang_id: str) -> None:
+        """One remediation pass on a queue worker.  The planner turns the
+        degraded status into (replacements, claims) — selection filtered
+        on PUBLISHED slice health (gang.select_healthy_spares) is the
+        planner's job, since only the caller knows the candidate node
+        population.  No planner / no viable plan keeps the gang degraded
+        (journaled; the next sweep retries); a plan runs through
+        gangs.remediate, which converges to all-bound-on-healthy or
+        cleanly-released.  A gang a FAILED pass left in the remediating
+        phase resumes through recover() — without this arm the queued
+        retry the comments promise would be a no-op."""
+        from tpudra.controller.gang import (
+            PHASE_DEGRADED,
+            PHASE_REMEDIATING,
+            GangOpInProgress,
+        )
+
+        status = self.gangs.gangs().get(gang_id)
+        if status is None:
+            return  # released / recovered since enqueue
+        if status.phase == PHASE_REMEDIATING:
+            # A prior pass (or crash) left the journaled plan mid-flight:
+            # recover() resumes it (re-bind targets via the claim
+            # resolver, else clean release) — raising on failure so the
+            # rate limiter owns the retry.
+            self.gangs.recover()
+            return
+        if status.phase != PHASE_DEGRADED:
+            return  # remediated or healthy again
+        if self._gang_planner is None:
+            logger.warning(
+                "gang %s is degraded but no remediation planner is "
+                "configured; leaving it journaled", gang_id,
+            )
+            return
+        plan = self._gang_planner(status)
+        if plan is None:
+            logger.warning(
+                "gang %s: no viable remediation plan (no healthy spares?); "
+                "will retry on the next sweep", gang_id,
+            )
+            return
+        replacements, claims = plan
+        try:
+            self.gangs.remediate(gang_id, replacements, claims)
+        except GangOpInProgress:
+            ...  # a live reserve/release owns the gang; the sweep re-checks
+        # GangBindError/GangRollbackIncomplete propagate: the work queue's
+        # rate limiter schedules the retry, and the record (kept, or
+        # cleanly dropped by remediate itself) already tells the truth.
 
     def start(self, stop: threading.Event) -> threading.Thread:
         t = threading.Thread(target=self.run, args=(stop,), daemon=True, name="controller")
@@ -296,6 +473,7 @@ class Controller:
             self._resync_once()
 
     def _resync_once(self) -> None:
+        self._sweep_degraded_gangs()
         for cd in self._cd_informer.list():
             meta = cd.get("metadata", {})
             # The periodic backstop must never preempt event-driven work —

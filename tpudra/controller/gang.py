@@ -42,13 +42,15 @@ nothing state machine and its durability.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
-from tpudra import metrics
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics
+from tpudra.kube import gvr
 from tpudra.plugin.checkpoint import (
     PREPARE_COMPLETED,
     PREPARE_STARTED,
@@ -58,6 +60,7 @@ from tpudra.plugin.checkpoint import (
     PreparedDeviceGroup,
 )
 from tpudra.plugin.device_state import _crashpoint
+from tpudra.plugin.resourceslice import SLICE_UNHEALTHY_ANNOTATION
 
 logger = logging.getLogger(__name__)
 
@@ -66,18 +69,38 @@ logger = logging.getLogger(__name__)
 GANG_UID_PREFIX = "gang/"
 
 #: config_state phases of a PrepareStarted gang record.  A completed gang
-#: (status PREPARE_COMPLETED) is phase-less: all members bound.
+#: (status PREPARE_COMPLETED) with no degraded mark is phase-less: all
+#: members bound.
 PHASE_RESERVING = "reserving"
 PHASE_ROLLBACK = "rollback"
+#: A bound gang with a health condition on ≥1 member: all members are
+#: still bound (it is NOT partial) but one sits on sick silicon — the
+#: remediation loop's input state.
+PHASE_DEGRADED = "degraded"
+#: Remediation in flight: the target member plan is journaled, the old
+#: members are being torn down / the targets re-bound.  Recovery resumes
+#: from this record alone.
+PHASE_REMEDIATING = "remediating"
 
 _GANGS_BOUND = metrics.GANG_RESERVATIONS_TOTAL.labels("bound")
 _GANGS_ROLLED_BACK = metrics.GANG_RESERVATIONS_TOTAL.labels("rolled-back")
 _GANGS_RECOVERED = metrics.GANG_RESERVATIONS_TOTAL.labels("recovered")
 _GANGS_RELEASED = metrics.GANG_RESERVATIONS_TOTAL.labels("released")
+_REMEDIATED = metrics.GANG_REMEDIATIONS_TOTAL.labels("remediated")
+_REMEDIATION_RELEASED = metrics.GANG_REMEDIATIONS_TOTAL.labels("released")
+_REMEDIATION_FAILED = metrics.GANG_REMEDIATIONS_TOTAL.labels("failed")
 
 
 class GangBindError(Exception):
     """A member bind failed; the gang was rolled back to none-bound."""
+
+
+class GangOpInProgress(Exception):
+    """Another thread is mid-operation on this gang (reserve, release,
+    remediate, or a recovery pass) — the caller retries after it settles.
+    The guard is a non-blocking per-gang claim, never a lock held across
+    binder I/O (docs/lock-order.md: ``gang.ops_lock`` guards only the
+    active-set mutation)."""
 
 
 class GangRollbackIncomplete(Exception):
@@ -85,6 +108,32 @@ class GangRollbackIncomplete(Exception):
     rollback phase so :meth:`GangReservationManager.recover` retries the
     teardown — the record outliving the failure is what makes the
     all-or-nothing contract crash-proof rather than best-effort."""
+
+
+class _BindStageFailed(Exception):
+    """Internal: one stage of a member-bind loop failed (the caller maps
+    it to its own rollback semantics)."""
+
+    def __init__(self, stage: str, cause: Exception):
+        super().__init__(f"{stage}: {cause}")
+        self.stage = stage
+        self.cause = cause
+
+
+def _dedup_members(*lists: list["GangMember"]) -> list["GangMember"]:
+    """Concatenate member lists keeping the first of each (node, claim_uid)
+    — the teardown set every coordinated rollback visits (old members AND
+    any target binds a crash may have left), shared by remediation,
+    recovery, and force-release so the paths cannot diverge."""
+    seen: set = set()
+    out: list[GangMember] = []
+    for members in lists:
+        for m in members:
+            key = (m.node, m.claim_uid)
+            if key not in seen:
+                seen.add(key)
+                out.append(m)
+    return out
 
 
 @dataclass(frozen=True)
@@ -116,9 +165,14 @@ class GangStatus:
     """One gang record, as read back from the checkpoint."""
 
     gang_id: str
-    phase: str  # "bound" | "reserving" | "rollback"
+    phase: str  # "bound" | "reserving" | "rollback" | "degraded" | "remediating"
     members: list[GangMember]
     bound: list[str]  # claim uids journaled as bound
+    #: Member claim uids marked unhealthy (degraded / remediating phases).
+    unhealthy: list[str] = field(default_factory=list)
+    #: The journaled remediation plan: the member list the gang is moving
+    #: to (remediating phase only).
+    target: list[GangMember] = field(default_factory=list)
 
 
 class GangBinder(Protocol):
@@ -140,11 +194,45 @@ class GangReservationManager:
     One instance per controller; ``checkpoints`` is a dedicated
     CheckpointManager over the controller's state dir (gang records must
     not share a file with any plugin's claim records — different process,
-    different lock, different GC)."""
+    different lock, different GC).
 
-    def __init__(self, checkpoints: CheckpointManager, binder: GangBinder):
+    ``claim_resolver`` (optional) refetches a member's allocated
+    ResourceClaim object by :class:`GangMember` — what lets
+    :meth:`recover` RESUME an interrupted remediation (re-bind the
+    journaled target members) instead of only releasing it; without one,
+    recovery of a remediating gang converges to cleanly-released."""
+
+    def __init__(
+        self,
+        checkpoints: CheckpointManager,
+        binder: GangBinder,
+        claim_resolver: Optional[Callable[[GangMember], Optional[dict]]] = None,
+    ):
         self._cp = checkpoints
         self._binder = binder
+        self._claim_resolver = claim_resolver
+        # Per-gang operation guard: reserve/release/remediate/recover of
+        # ONE gang never interleave (two threads unbinding the same
+        # member set would double-free), while distinct gangs proceed
+        # concurrently.  The lock guards only the active-set mutation —
+        # binder I/O always runs outside it.
+        self._ops_lock = lockwitness.make_lock("gang.ops_lock")
+        self._active_ops: set[str] = set()
+
+    @contextlib.contextmanager
+    def _gang_op(self, gang_id: str, what: str):
+        with self._ops_lock:
+            if gang_id in self._active_ops:
+                raise GangOpInProgress(
+                    f"gang {gang_id!r}: another operation is in flight "
+                    f"(wanted {what})"
+                )
+            self._active_ops.add(gang_id)
+        try:
+            yield
+        finally:
+            with self._ops_lock:
+                self._active_ops.discard(gang_id)
 
     # -------------------------------------------------------------- helpers
 
@@ -154,7 +242,11 @@ class GangReservationManager:
 
     @staticmethod
     def _record(
-        gang_id: str, members: list[GangMember], phase: str, bound: list[str]
+        gang_id: str,
+        members: list[GangMember],
+        phase: str,
+        bound: list[str],
+        extra: Optional[dict] = None,
     ) -> PreparedClaim:
         return PreparedClaim(
             uid=GANG_UID_PREFIX + gang_id,
@@ -171,6 +263,7 @@ class GangReservationManager:
                         "phase": phase,
                         "members": json.dumps([m.to_state() for m in members]),
                         "bound": json.dumps(list(bound)),
+                        **(extra or {}),
                     },
                 )
             ],
@@ -179,11 +272,16 @@ class GangReservationManager:
     @staticmethod
     def _parse(rec: PreparedClaim) -> GangStatus:
         state = rec.groups[0].config_state if rec.groups else {}
-        phase = (
-            "bound"
-            if rec.status == PREPARE_COMPLETED
-            else state.get("phase", PHASE_RESERVING)
-        )
+        if rec.status == PREPARE_COMPLETED:
+            # A completed record is all-bound; a degraded mark rides on
+            # top of it (the gang is sick, not partial).
+            phase = (
+                PHASE_DEGRADED
+                if state.get("phase") == PHASE_DEGRADED
+                else "bound"
+            )
+        else:
+            phase = state.get("phase", PHASE_RESERVING)
         return GangStatus(
             gang_id=rec.uid[len(GANG_UID_PREFIX):],
             phase=phase,
@@ -192,6 +290,11 @@ class GangReservationManager:
                 for m in json.loads(state.get("members", "[]"))
             ],
             bound=list(json.loads(state.get("bound", "[]"))),
+            unhealthy=list(json.loads(state.get("unhealthy", "[]"))),
+            target=[
+                GangMember.from_state(m)
+                for m in json.loads(state.get("target", "[]"))
+            ],
         )
 
     def gangs(self) -> dict[str, GangStatus]:
@@ -232,7 +335,9 @@ class GangReservationManager:
                 same_members = {m.claim_uid for m in status.members} == {
                     m.claim_uid for m in members
                 }
-                if status.phase == "bound" and same_members:
+                if status.phase in ("bound", PHASE_DEGRADED) and same_members:
+                    # A degraded gang is still all-bound: idempotent
+                    # re-reserve returns it (remediation owns the move).
                     cached.append(status)
                     return
                 if same_members:
@@ -249,56 +354,27 @@ class GangReservationManager:
                 gang_id, members, PHASE_RESERVING, []
             )
 
-        self._cp.mutate(start, touched=[guid])
-        if cached:
-            return cached[0]
-
-        bound: list[GangMember] = []
-        failed_stage = "member bind"
-        try:
-            for member in members:
-                failed_stage = f"bind of claim {member.claim_uid!r}"
-                self._binder.bind(member, claims[member.claim_uid])
-                bound.append(member)
-
-                def journal_bound(cp: Checkpoint, uid=member.claim_uid) -> None:
-                    rec = cp.prepared_claims.get(guid)
-                    if rec is None or not rec.groups:
-                        return  # dropped by a concurrent release; rollback wins
-                    state = rec.groups[0].config_state
-                    done = json.loads(state.get("bound", "[]"))
-                    if uid not in done:
-                        done.append(uid)
-                        state["bound"] = json.dumps(done)
-
-                failed_stage = f"bind journal for claim {member.claim_uid!r}"
-                self._cp.mutate(journal_bound, touched=[guid])
-                # Fires (when armed) after the FIRST member is durably
-                # bound and before the rest: the canonical partial-gang
-                # crash for the sweep, as long as the gang has ≥2 members.
-                _crashpoint("mid-gang-reserve")
-                if on_member_bound is not None:
-                    failed_stage = f"post-bind callback for {member.claim_uid!r}"
-                    on_member_bound(member)
-        except Exception as e:
-            logger.warning(
-                "gang %s: %s failed after %d/%d bound: %s — rolling back",
-                gang_id, failed_stage, len(bound), len(members), e,
-            )
-            self._rollback(gang_id, members)
-            _GANGS_ROLLED_BACK.inc()
-            raise GangBindError(
-                f"gang {gang_id!r}: {failed_stage} failed ({e}); "
-                f"all {len(bound)} bound member(s) rolled back"
-            ) from e
-
-        def complete(cp: Checkpoint) -> None:
-            rec = cp.prepared_claims.get(guid)
-            if rec is None:
-                return
-            rec.status = PREPARE_COMPLETED
-
-        self._cp.mutate(complete, touched=[guid])
+        with self._gang_op(gang_id, "reserve"):
+            self._cp.mutate(start, touched=[guid])
+            if cached:
+                return cached[0]
+            try:
+                self._bind_members(
+                    gang_id, members, claims, on_member_bound,
+                    crash_point="mid-gang-reserve",
+                )
+            except _BindStageFailed as e:
+                logger.warning(
+                    "gang %s: %s failed: %s — rolling back",
+                    gang_id, e.stage, e.cause,
+                )
+                self._rollback(gang_id, members)
+                _GANGS_ROLLED_BACK.inc()
+                raise GangBindError(
+                    f"gang {gang_id!r}: {e.stage} failed ({e.cause}); "
+                    "all bound member(s) rolled back"
+                ) from e.cause
+            self._complete(guid)
         _GANGS_BOUND.inc()
         metrics.GANG_BIND_SECONDS.labels(str(len(members))).observe(
             time.monotonic() - t0
@@ -314,14 +390,81 @@ class GangReservationManager:
             bound=[m.claim_uid for m in members],
         )
 
+    def _bind_members(
+        self,
+        gang_id: str,
+        members: list[GangMember],
+        claims: dict[str, dict],
+        on_member_bound: Optional[Callable[[GangMember], None]],
+        crash_point: str,
+    ) -> None:
+        """Bind every member in order, journaling each bind.  Raises
+        :class:`_BindStageFailed` on any failure — the CALLER owns the
+        rollback (reserve unwinds to none-bound; remediate unwinds the
+        re-bind targets and releases)."""
+        guid = self._guid(gang_id)
+        stage = "member bind"
+        try:
+            for member in members:
+                stage = f"bind of claim {member.claim_uid!r}"
+                self._binder.bind(member, claims[member.claim_uid])
+
+                def journal_bound(cp: Checkpoint, uid=member.claim_uid) -> None:
+                    rec = cp.prepared_claims.get(guid)
+                    if rec is None or not rec.groups:
+                        return  # dropped by a concurrent release; rollback wins
+                    state = rec.groups[0].config_state
+                    done = json.loads(state.get("bound", "[]"))
+                    if uid not in done:
+                        done.append(uid)
+                        state["bound"] = json.dumps(done)
+
+                stage = f"bind journal for claim {member.claim_uid!r}"
+                self._cp.mutate(journal_bound, touched=[guid])
+                # Fires (when armed) after the FIRST member is durably
+                # bound and before the rest: the canonical partial-gang
+                # crash for the sweep, as long as the gang has ≥2 members.
+                _crashpoint(crash_point)
+                if on_member_bound is not None:
+                    stage = f"post-bind callback for {member.claim_uid!r}"
+                    on_member_bound(member)
+        except _BindStageFailed:
+            raise
+        except Exception as e:
+            raise _BindStageFailed(stage, e) from e
+
+    def _complete(self, guid: str) -> None:
+        def complete(cp: Checkpoint) -> None:
+            rec = cp.prepared_claims.get(guid)
+            if rec is None or not rec.groups:
+                return
+            rec.status = PREPARE_COMPLETED
+            state = rec.groups[0].config_state
+            # Clear any remediation residue: a completed gang is healthy
+            # until the next escalation says otherwise.
+            state.pop("phase", None)
+            state.pop("unhealthy", None)
+            state.pop("target", None)
+            state.pop("degradedReason", None)
+
+        self._cp.mutate(complete, touched=[guid])
+
     # ------------------------------------------------------------- rollback
 
-    def _rollback(self, gang_id: str, members: list[GangMember]) -> None:
+    def _rollback(
+        self,
+        gang_id: str,
+        members: list[GangMember],
+        phase: str = PHASE_ROLLBACK,
+        drop_record: bool = True,
+    ) -> None:
         """Unbind EVERY member (not just the journaled-bound prefix: a
         crash between a bind and its journal append leaves a bound member
-        the record never saw) and drop the gang record.  A failed unbind
-        keeps the record in the rollback phase and raises — recover()
-        retries until the teardown converges."""
+        the record never saw) and drop the gang record (``drop_record``)
+        — or, for a remediation's coordinated teardown, keep the record
+        in ``phase`` with its bound list cleared so the re-reserve resumes
+        from durable state.  A failed unbind keeps the record in ``phase``
+        and raises — recover() retries until the teardown converges."""
         guid = self._guid(gang_id)
 
         def mark(cp: Checkpoint) -> None:
@@ -329,7 +472,7 @@ class GangReservationManager:
             if rec is None or not rec.groups:
                 return
             rec.status = PREPARE_STARTED
-            rec.groups[0].config_state["phase"] = PHASE_ROLLBACK
+            rec.groups[0].config_state["phase"] = phase
 
         self._cp.mutate(mark, touched=[guid])
         failures: list[str] = []
@@ -345,7 +488,7 @@ class GangReservationManager:
                 failures.append(f"{member.claim_uid}@{member.node}: {e}")
             if first:
                 # Fires (when armed) after the first member's unbind,
-                # while the rollback-phase record still names the rest.
+                # while the phase-marked record still names the rest.
                 first = False
                 _crashpoint("mid-gang-rollback")
         if failures:
@@ -353,45 +496,258 @@ class GangReservationManager:
                 f"gang {gang_id!r}: {len(failures)} member unbind(s) failed "
                 f"({'; '.join(failures[:3])}); record kept for recovery"
             )
+        if drop_record:
+            def drop(cp: Checkpoint) -> None:
+                cp.prepared_claims.pop(guid, None)
 
-        def drop(cp: Checkpoint) -> None:
-            cp.prepared_claims.pop(guid, None)
+            self._cp.mutate(drop, touched=[guid])
+        else:
+            def clear_bound(cp: Checkpoint) -> None:
+                rec = cp.prepared_claims.get(guid)
+                if rec is None or not rec.groups:
+                    return
+                rec.groups[0].config_state["bound"] = json.dumps([])
 
-        self._cp.mutate(drop, touched=[guid])
+            self._cp.mutate(clear_bound, touched=[guid])
 
     def release(self, gang_id: str) -> None:
         """Tear down a bound gang (workload done): unbind every member,
         drop the record.  Also accepts an in-flight record (the operator's
-        force-release)."""
-        rec = self.gangs().get(gang_id)
-        if rec is None:
-            return
-        self._rollback(gang_id, rec.members)
+        force-release) — including a crash-interrupted REMEDIATING one,
+        whose journaled target members may hold binds the member list
+        never names (the same union recovery tears down).  The snapshot is
+        read INSIDE the op guard: reading it before could tear down a
+        stale member list after a concurrent remediation moved the gang,
+        stranding the new members' binds recordless."""
+        with self._gang_op(gang_id, "release"):
+            rec = self.gangs().get(gang_id)
+            if rec is None:
+                return
+            self._rollback(gang_id, _dedup_members(rec.members, rec.target))
         _GANGS_RELEASED.inc()
+
+    # ----------------------------------------------------------- remediation
+
+    def mark_degraded(
+        self, gang_id: str, unhealthy_member_uids: list[str], reason: str = ""
+    ) -> bool:
+        """Journal a health condition on a BOUND gang: the gang stays
+        all-bound (it is degraded, not partial) and becomes the
+        remediation loop's input.  Returns False when the gang is absent
+        or not bound/degraded (an in-flight gang's health is settled by
+        its own rollback path).  Idempotent: re-marking merges uids."""
+        guid = self._guid(gang_id)
+        changed: list[bool] = []
+
+        def mark(cp: Checkpoint) -> None:
+            rec = cp.prepared_claims.get(guid)
+            if rec is None or not rec.groups or rec.status != PREPARE_COMPLETED:
+                return
+            state = rec.groups[0].config_state
+            state["phase"] = PHASE_DEGRADED
+            have = set(json.loads(state.get("unhealthy", "[]")))
+            have.update(unhealthy_member_uids)
+            state["unhealthy"] = json.dumps(sorted(have))
+            if reason:
+                state["degradedReason"] = reason
+            changed.append(True)
+
+        self._cp.mutate(mark, touched=[guid])
+        if changed:
+            logger.warning(
+                "gang %s marked degraded (%s): unhealthy members %s",
+                gang_id, reason or "unspecified", unhealthy_member_uids,
+            )
+        return bool(changed)
+
+    def remediate(
+        self,
+        gang_id: str,
+        replacements: dict[str, GangMember],
+        claims: dict[str, dict],
+        on_member_bound: Optional[Callable[[GangMember], None]] = None,
+    ) -> GangStatus:
+        """Move a degraded (or bound) gang onto healthy silicon: journal
+        the target member plan, COORDINATED rollback of the whole current
+        gang (all members — a multi-host mesh cannot run partial, so the
+        healthy members' binds are torn down with the sick one's), then
+        re-reserve every target member.  ``replacements`` maps old member
+        claim uid → its replacement member; unmapped members re-bind
+        unchanged (their claims must also appear in ``claims``).
+
+        Converges to all-bound-on-target-members or — when the re-reserve
+        fails — cleanly-released (targets unwound, record dropped), never
+        partial, never on the old silicon.  A crash anywhere resumes from
+        the journaled record (:meth:`recover`).  The gang snapshot is read
+        and validated INSIDE the op guard: a pre-guard read could race a
+        concurrent release (record gone — targets would bind recordless)
+        or a finished remediation (stale member list)."""
+        guid = self._guid(gang_id)
+        t0 = time.monotonic()
+        with self._gang_op(gang_id, "remediate"):
+            status = self.gangs().get(gang_id)
+            if status is None:
+                raise GangBindError(f"gang {gang_id!r} does not exist")
+            if status.phase not in ("bound", PHASE_DEGRADED):
+                raise GangBindError(
+                    f"gang {gang_id!r} is in phase {status.phase!r}: only a "
+                    "bound or degraded gang can be remediated (recover() owns "
+                    "in-flight records)"
+                )
+            unknown = set(replacements) - {m.claim_uid for m in status.members}
+            if unknown:
+                raise GangBindError(
+                    f"gang {gang_id!r}: replacement(s) for non-member claim(s) "
+                    f"{sorted(unknown)}"
+                )
+            target = [replacements.get(m.claim_uid, m) for m in status.members]
+            missing = [m.claim_uid for m in target if m.claim_uid not in claims]
+            if missing:
+                raise GangBindError(
+                    f"gang {gang_id!r}: no claim object for target member(s) "
+                    f"{missing}"
+                )
+            planned: list[bool] = []
+
+            def plan(cp: Checkpoint) -> None:
+                rec = cp.prepared_claims.get(guid)
+                if rec is None or not rec.groups:
+                    return  # vanished under the guard-protected read? abort
+                rec.status = PREPARE_STARTED
+                state = rec.groups[0].config_state
+                state["phase"] = PHASE_REMEDIATING
+                state["target"] = json.dumps([m.to_state() for m in target])
+                planned.append(True)
+
+            self._cp.mutate(plan, touched=[guid])
+            if not planned:
+                raise GangBindError(
+                    f"gang {gang_id!r} record vanished before the "
+                    "remediation plan could be journaled"
+                )
+            # Fires (when armed) with the plan durable and every OLD
+            # member still bound — the canonical mid-remediation crash:
+            # recovery must finish the rollback and resume (or release).
+            _crashpoint("mid-gang-remediate")
+            try:
+                self._finish_remediation(
+                    gang_id, status.members, target, claims, on_member_bound
+                )
+            except (GangRollbackIncomplete, GangOpInProgress):
+                _REMEDIATION_FAILED.inc()
+                raise
+        logger.info(
+            "gang %s: remediated onto %s in %.3fs",
+            gang_id, [m.node for m in target], time.monotonic() - t0,
+        )
+        _REMEDIATED.inc()
+        return GangStatus(
+            gang_id=gang_id,
+            phase="bound",
+            members=list(target),
+            bound=[m.claim_uid for m in target],
+        )
+
+    def _finish_remediation(
+        self,
+        gang_id: str,
+        old_members: list[GangMember],
+        target: list[GangMember],
+        claims: dict[str, dict],
+        on_member_bound: Optional[Callable[[GangMember], None]] = None,
+    ) -> None:
+        """The teardown + re-bind half of a remediation, shared with
+        recovery: old members all unbound (record kept, remediating
+        phase), targets bound and completed; a target-bind failure unwinds
+        the targets and drops the record (cleanly released).  Assumes the
+        caller holds the gang op and has journaled the target plan."""
+        guid = self._guid(gang_id)
+        # Coordinated rollback of the WHOLE gang — old AND target members.
+        # Recovery re-runs this path, and a crash mid-re-bind leaves
+        # target binds the bound list may not name (same reasoning as
+        # reserve's unwind-everything contract); unbind of a never-bound
+        # member is a no-op.
+        self._rollback(
+            gang_id,
+            _dedup_members(old_members, target),
+            phase=PHASE_REMEDIATING,
+            drop_record=False,
+        )
+        try:
+            self._bind_members(
+                gang_id, target, claims, on_member_bound,
+                crash_point="mid-gang-reserve",
+            )
+        except _BindStageFailed as e:
+            logger.warning(
+                "gang %s: remediation re-bind %s failed: %s — releasing",
+                gang_id, e.stage, e.cause,
+            )
+            self._rollback(gang_id, target)  # drops the record
+            _REMEDIATION_RELEASED.inc()
+            raise GangBindError(
+                f"gang {gang_id!r}: remediation {e.stage} failed "
+                f"({e.cause}); gang cleanly released"
+            ) from e.cause
+
+        def retarget(cp: Checkpoint) -> None:
+            rec = cp.prepared_claims.get(guid)
+            if rec is None or not rec.groups:
+                return
+            state = rec.groups[0].config_state
+            state["members"] = json.dumps([m.to_state() for m in target])
+
+        self._cp.mutate(retarget, touched=[guid])
+        self._complete(guid)
 
     # ------------------------------------------------------------- recovery
 
     def recover(self) -> list[str]:
-        """Converge every non-completed gang to none-bound — the crash-
-        recovery sweep, run at controller start.  Returns the rolled-back
-        gang ids.  A completed gang is left alone (all members bound — the
-        other consistent outcome).  EVERY gang is attempted even when one
-        rollback fails (one unreachable node must not strand the others'
-        fully-achievable teardowns); the failures aggregate into one
-        :class:`GangRollbackIncomplete` raised after the sweep, with the
-        failed gangs' records kept for the next retry."""
+        """Converge every in-flight gang to a consistent state — the
+        crash-recovery sweep, run at controller start.  Returns the gang
+        ids acted on.  A completed gang is left alone (all members bound),
+        and so is a DEGRADED one (all-bound on sick silicon — the
+        remediation loop owns the move; tearing it down here would turn a
+        running-but-degraded job into a dead one).  A REMEDIATING gang
+        resumes from its journaled plan: finish the coordinated rollback,
+        then re-bind the target members when a ``claim_resolver`` can
+        refetch their claims — otherwise cleanly release.  Reserving /
+        rollback records roll back to none-bound as before.  EVERY gang is
+        attempted even when one fails (one unreachable node must not
+        strand the others' achievable teardowns); failures aggregate into
+        one :class:`GangRollbackIncomplete` raised after the sweep, with
+        the failed gangs' records kept for the next retry."""
         rolled: list[str] = []
         failures: list[str] = []
-        for gang_id, status in sorted(self.gangs().items()):
-            if status.phase == "bound":
-                continue
-            logger.warning(
-                "gang %s: recovering %s-phase record (%d members, %d journaled bound)",
-                gang_id, status.phase, len(status.members), len(status.bound),
-            )
+        for gang_id in sorted(self.gangs()):
             try:
-                self._rollback(gang_id, status.members)
-            except GangRollbackIncomplete as e:
+                with self._gang_op(gang_id, "recover"):
+                    # Re-read INSIDE the guard: acting on a pre-guard
+                    # snapshot could tear down a gang a concurrent
+                    # remediation just moved to bound-on-targets (the
+                    # same TOCTOU release/remediate guard against).
+                    status = self.gangs().get(gang_id)
+                    if status is None or status.phase in (
+                        "bound", PHASE_DEGRADED,
+                    ):
+                        continue
+                    logger.warning(
+                        "gang %s: recovering %s-phase record "
+                        "(%d members, %d journaled bound)",
+                        gang_id, status.phase,
+                        len(status.members), len(status.bound),
+                    )
+                    if status.phase == PHASE_REMEDIATING:
+                        self._resume_remediation(gang_id, status)
+                    else:
+                        self._rollback(gang_id, status.members)
+            except GangOpInProgress:
+                logger.info(
+                    "gang %s: live operation in flight; recovery skipped",
+                    gang_id,
+                )
+                continue
+            except (GangRollbackIncomplete, GangBindError) as e:
                 failures.append(f"{gang_id}: {e}")
                 continue
             _GANGS_RECOVERED.inc()
@@ -403,6 +759,45 @@ class GangReservationManager:
             )
         return rolled
 
+    def _resume_remediation(self, gang_id: str, status: GangStatus) -> None:
+        """Resume a crash-interrupted remediation from its journaled
+        record.  With a claim resolver and a resolvable target plan, the
+        remediation completes (all-bound on the targets); otherwise the
+        whole gang — old members and any target binds the crash left — is
+        cleanly released.  Never partial either way."""
+        target = status.target
+        claims: dict[str, dict] = {}
+        if target and self._claim_resolver is not None:
+            for m in target:
+                try:
+                    claim = self._claim_resolver(m)
+                except Exception:  # noqa: BLE001 — resolver blip: release below
+                    logger.exception(
+                        "gang %s: claim resolve for %s failed", gang_id, m.claim_uid
+                    )
+                    claim = None
+                if claim is None:
+                    claims = {}
+                    break
+                claims[m.claim_uid] = claim
+        if target and len(claims) == len(target):
+            logger.warning(
+                "gang %s: resuming remediation onto %s",
+                gang_id, [m.node for m in target],
+            )
+            try:
+                self._finish_remediation(gang_id, status.members, target, claims)
+            except GangBindError:
+                # _finish_remediation already released cleanly (and
+                # counted the outcome): converged, just not onto targets.
+                return
+            _REMEDIATED.inc()
+            return
+        # No plan, or the target claims are gone: release everything the
+        # record names (old members plus any target binds).
+        self._rollback(gang_id, _dedup_members(status.members, target))
+        _REMEDIATION_RELEASED.inc()
+
     def partially_bound(
         self, bound_probe: Callable[[GangMember], bool]
     ) -> list[str]:
@@ -410,13 +805,93 @@ class GangReservationManager:
         caller's probe (e.g. "is this claim uid in that node's plugin
         checkpoint").  The chaos soak's gang-atomicity invariant: in a
         quiet window this list must be empty — every gang is all-bound
-        (complete record) or none-bound (no members bound)."""
+        (complete record, degraded included) or none-bound (no members
+        bound).  A REMEDIATING gang is exempt: it is transitional by
+        construction, and the gang-degraded age invariant (sim/chaos.py)
+        owns how long it may stay that way."""
         partial = []
         for gang_id, status in self.gangs().items():
+            if status.phase == PHASE_REMEDIATING:
+                continue
             n_bound = sum(1 for m in status.members if bound_probe(m))
-            if status.phase == "bound":
+            if status.phase in ("bound", PHASE_DEGRADED):
                 if n_bound != len(status.members):
                     partial.append(gang_id)
             elif 0 < n_bound < len(status.members):
                 partial.append(gang_id)
         return partial
+
+
+# ------------------------------------------------- published slice health
+
+@dataclass(frozen=True)
+class NodeSliceHealth:
+    """What one node's published ResourceSlices say about its silicon."""
+
+    node: str
+    advertised: int  # devices currently advertised
+    unhealthy: int  # withheld-for-health count (SLICE_UNHEALTHY_ANNOTATION)
+
+    @property
+    def healthy(self) -> bool:
+        return self.unhealthy == 0 and self.advertised > 0
+
+
+def published_slice_health(
+    kube, driver: str = TPU_DRIVER_NAME
+) -> dict[str, NodeSliceHealth]:
+    """Read every node's health straight from its published ResourceSlices
+    — the controller-side view the remediation's member selection filters
+    on (no node access, no plugin RPC: the slices ARE the advertisement).
+    A node with unhealthy silicon publishes a nonzero
+    ``SLICE_UNHEALTHY_ANNOTATION`` and the sick devices are absent from
+    the device list (plugin/resourceslice.py)."""
+    advertised: dict[str, int] = {}
+    unhealthy: dict[str, int] = {}
+    for item in kube.list(gvr.RESOURCE_SLICES).get("items", []):
+        spec = item.get("spec", {})
+        if spec.get("driver") != driver:
+            continue
+        node = spec.get("nodeName", "")
+        advertised[node] = advertised.get(node, 0) + len(spec.get("devices", []))
+        ann = (
+            item.get("metadata", {})
+            .get("annotations", {})
+            .get(SLICE_UNHEALTHY_ANNOTATION)
+        )
+        if ann is not None:
+            try:
+                # One count per node pool; slices of one pool repeat it.
+                unhealthy[node] = max(unhealthy.get(node, 0), int(ann))
+            except ValueError:
+                ...  # a foreign/garbled annotation never fails selection
+    return {
+        node: NodeSliceHealth(
+            node=node,
+            advertised=advertised.get(node, 0),
+            unhealthy=unhealthy.get(node, 0),
+        )
+        for node in advertised
+    }
+
+
+def select_healthy_spares(
+    kube,
+    candidates: list[str],
+    exclude: Optional[set] = None,
+    driver: str = TPU_DRIVER_NAME,
+) -> list[str]:
+    """Filter candidate spare nodes on PUBLISHED slice health: a node
+    qualifies only when its slices advertise ≥1 device with a zero
+    unhealthy count and it is not excluded (the degraded gang's current
+    nodes).  Returns qualifying nodes, most-advertised first — the
+    remediation picks from the front."""
+    exclude = exclude or set()
+    health = published_slice_health(kube, driver=driver)
+    good = [
+        health[n]
+        for n in candidates
+        if n not in exclude and n in health and health[n].healthy
+    ]
+    good.sort(key=lambda h: (-h.advertised, h.node))
+    return [h.node for h in good]

@@ -74,6 +74,30 @@ class HealthEvent:
     partition_uuid: Optional[str] = None  # set when scoped to a partition
     detail: str = ""
 
+    def to_line(self) -> str:
+        """The native backend's event-file wire form: one event per line,
+        ``<kind> <chipUUID> <partUUID|-> <detail>``.  Shared by whatever
+        writes the fifo (tests, the chaos soak's chip_fault injector, an
+        operator's manual fault injection) so the injector and the parser
+        cannot drift."""
+        return " ".join(
+            (self.kind, self.chip_uuid, self.partition_uuid or "-", self.detail)
+        ).rstrip()
+
+    @classmethod
+    def from_line(cls, line: str) -> Optional["HealthEvent"]:
+        """Parse one event-file line; None for blank/short lines (the
+        native stream skips them rather than dying on a torn write)."""
+        parts = line.split(None, 3)
+        if len(parts) < 2:
+            return None
+        return cls(
+            kind=parts[0],
+            chip_uuid=parts[1],
+            partition_uuid=parts[2] if len(parts) > 2 and parts[2] != "-" else None,
+            detail=parts[3].strip() if len(parts) > 3 else "",
+        )
+
 
 class DeviceLibError(Exception):
     pass

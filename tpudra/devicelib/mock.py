@@ -64,6 +64,7 @@ class MockDeviceLib(DeviceLib):
         self._timeslice: dict[str, str] = {}
         self._exclusive: dict[str, bool] = {}
         self._health_queues: list[queue.Queue] = []
+        self._injected_events: list[HealthEvent] = []
 
         spec, num_chips, mesh = config.resolve()
         coords = chip_coords_for_host(spec, config.host_index, num_chips)
@@ -246,8 +247,29 @@ class MockDeviceLib(DeviceLib):
 
     def inject_health_event(self, event: HealthEvent) -> None:
         with self._lock:
+            self._injected_events.append(event)
             for q in self._health_queues:
                 q.put(event)
+
+    def fault_chip(
+        self, index: int, kind: str = "HbmEccError", detail: str = ""
+    ) -> HealthEvent:
+        """Inject a chip-scoped fault by index — the one-call injector the
+        chaos soak's chip_fault and the multihost harness use (resolving
+        the uuid here keeps every injector honest about which silicon it
+        faulted).  Returns the injected event."""
+        event = HealthEvent(
+            kind=kind, chip_uuid=self.chip_by_index(index).uuid, detail=detail
+        )
+        self.inject_health_event(event)
+        return event
+
+    @property
+    def injected_events(self) -> list[HealthEvent]:
+        """Every event ever injected (introspection for harness
+        invariants: 'which chips have been faulted on this node')."""
+        with self._lock:
+            return list(self._injected_events)
 
     def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
         q: queue.Queue = queue.Queue()

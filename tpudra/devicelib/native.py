@@ -403,19 +403,12 @@ class NativeDeviceLib(DeviceLib):
     def health_events(self, stop: threading.Event) -> Iterator[HealthEvent]:
         path = self._health_events_path
         if path:
-            # Explicit event file/fifo: "<kind> <chipUUID> <partUUID|-> <detail>".
+            # Explicit event file/fifo, one HealthEvent.to_line() per line
+            # (the shared wire form — writers and this parser cannot drift).
             for line in self._tail_lines(path, stop, from_end=False):
-                parts = line.split(None, 3)
-                if len(parts) < 2:
-                    continue
-                yield HealthEvent(
-                    kind=parts[0],
-                    chip_uuid=parts[1],
-                    partition_uuid=parts[2]
-                    if len(parts) > 2 and parts[2] != "-"
-                    else None,
-                    detail=parts[3].strip() if len(parts) > 3 else "",
-                )
+                event = HealthEvent.from_line(line)
+                if event is not None:
+                    yield event
             return
         # No explicit source: scan the kernel log for accel driver faults
         # (the real interrupt surface on TPU VM hosts).

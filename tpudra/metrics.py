@@ -266,17 +266,43 @@ SOAK_FAULTS_INJECTED_TOTAL = Counter(
     "tpudra_soak_faults_injected_total",
     "Faults injected by the chaos soak (sim/chaos.py), by kind: "
     "apiserver_latency, watch_close, kubelet_restart, plugin_crash, "
-    "torn_wal, clock_skew, cd_wave — the denominator every soak SLO is "
-    "asserted against",
+    "torn_wal, clock_skew, cd_wave, chip_fault, daemon_crash — the "
+    "denominator every soak SLO is asserted against",
     ["kind"],
 )
 SOAK_INVARIANT_CHECKS_TOTAL = Counter(
     "tpudra_soak_invariant_checks_total",
     "Continuous invariant evaluations by the soak's monitor thread, by "
     "invariant (claim-stuck, cdi-leak, flock-leak, slice-convergence, "
-    "lock-witness, gang-atomicity) and result (ok / violation) — a "
-    "healthy soak is all ok with a nonzero check count per invariant",
+    "lock-witness, gang-atomicity, slice-health, gang-degraded, "
+    "grant-health) and result (ok / violation) — a healthy soak is all "
+    "ok with a nonzero check count per invariant",
     ["invariant", "result"],
+)
+CLAIM_HEALTH_ESCALATIONS = Counter(
+    "tpudra_claim_health_escalations_total",
+    "Bound-claim health escalations by the node plugin's health loop "
+    "(plugin/driver.py): an unhealthy device transition that intersected "
+    "a checkpointed bound claim and was surfaced on the claim's status, "
+    "by result (written / failed) — a nonzero failed rate means claim "
+    "holders are computing on sick silicon without a signal",
+    ["result"],
+)
+DAEMON_RESTARTS_TOTAL = Counter(
+    "tpudra_daemon_restarts_total",
+    "Watchdog restarts of a supervised child process "
+    "(cddaemon/process.py), by daemon (argv[0] basename) — a climbing "
+    "rate is a crash-looping slice daemon the full-jitter backoff is "
+    "pacing, not curing",
+    ["daemon"],
+)
+GANG_REMEDIATIONS_TOTAL = Counter(
+    "tpudra_gang_remediations_total",
+    "Degraded-gang remediations (controller/gang.py) by outcome: "
+    "remediated (re-reserved onto healthy spare nodes), released (no "
+    "viable spares — cleanly torn down), failed (the remediation pass "
+    "raised and the record was kept for recovery)",
+    ["outcome"],
 )
 GANG_RESERVATIONS_TOTAL = Counter(
     "tpudra_gang_reservations_total",

@@ -36,7 +36,13 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpudra import TPU_DRIVER_NAME, featuregates, lockwitness, metrics
+from tpudra import (
+    CLAIM_UNHEALTHY_CONDITION,
+    TPU_DRIVER_NAME,
+    featuregates,
+    lockwitness,
+    metrics,
+)
 from tpudra.backoff import Backoff
 from tpudra.clock import Clock
 from tpudra.devicelib import DeviceLib, HealthEvent, HealthEventKind
@@ -60,6 +66,90 @@ logger = logging.getLogger(__name__)
 
 PU_LOCK = "pu.lock"
 PU_LOCK_TIMEOUT = 10.0  # reference driver.go:341
+
+# The escalation writes CLAIM_UNHEALTHY_CONDITION (tpudra package root —
+# shared with the controller's claim-health watch): a device granted to a
+# claim went unhealthy AFTER binding.  Withholding sick silicon from
+# future ResourceSlices (the health loop's original job) is invisible to
+# a claim that already holds it; the condition is the claim-holder-facing
+# half, mirroring the reference's claim-status device-health surfacing.
+
+
+def escalate_claim_condition(
+    kube: KubeAPI,
+    namespace: str,
+    name: str,
+    uid: str,
+    devices: list[dict],
+    reason: str,
+    message: str,
+) -> bool:
+    """Write the device-unhealthy escalation onto one claim's status:
+    a claim-level condition (the controller's watch signal) plus per-device
+    entries under ``status.devices`` with a ``Healthy=False`` condition
+    (the DRA v1 per-device health shape).  Returns False — without raising
+    — when the live claim is gone or its uid moved on (a deleted claim
+    needs no escalation; a recreated one never held this silicon).  A 409
+    Conflict (another status writer won the optimistic-concurrency race)
+    re-reads and retries — the unhealthy transition fires ONCE, so a
+    single lost write would silence the escalation forever.  Any other
+    error (an apiserver blip) propagates: the caller must count it as a
+    FAILED escalation, not mistake it for claim-absent."""
+    from tpudra.kube.errors import Conflict, NotFound
+
+    for attempt in range(4):
+        try:
+            claim = kube.get(gvr.RESOURCE_CLAIMS, name, namespace)
+        except NotFound:
+            return False
+        if claim.get("metadata", {}).get("uid") != uid:
+            return False
+        status = claim.setdefault("status", {})
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        condition = {
+            "type": CLAIM_UNHEALTHY_CONDITION,
+            "status": "True",
+            "reason": reason,
+            "message": message,
+            "lastTransitionTime": now,
+        }
+        conditions = [
+            c for c in status.get("conditions", [])
+            if c.get("type") != CLAIM_UNHEALTHY_CONDITION
+        ]
+        conditions.append(condition)
+        status["conditions"] = conditions
+        dev_entries = status.setdefault("devices", [])
+        for dev in devices:
+            key = (dev["driver"], dev["pool"], dev["device"])
+            entry = next(
+                (
+                    e
+                    for e in dev_entries
+                    if (e.get("driver"), e.get("pool"), e.get("device")) == key
+                ),
+                None,
+            )
+            if entry is None:
+                entry = {"driver": key[0], "pool": key[1], "device": key[2]}
+                dev_entries.append(entry)
+            entry["conditions"] = [
+                {
+                    "type": "Healthy",
+                    "status": "False",
+                    "reason": reason,
+                    "message": message,
+                    "lastTransitionTime": now,
+                }
+            ]
+        try:
+            kube.update_status(gvr.RESOURCE_CLAIMS, claim, namespace)
+        except Conflict:
+            if attempt == 3:
+                raise
+            continue  # re-read at the fresh resourceVersion and retry
+        return True
+    return True  # unreachable: the loop returns or raises
 
 
 @dataclass
@@ -700,6 +790,10 @@ class Driver:
                 "sharedCounters": res.shared_counters,
                 "partitionable": res.partitionable,
                 "k8sMinor": self._config.k8s_minor,
+                # The health annotation can change while the device list
+                # does not (an already-withheld sibling going unhealthy) —
+                # it must reach the apiserver either way.
+                "unhealthyCount": res.unhealthy_count,
             },
             sort_keys=True,
         )
@@ -807,6 +901,57 @@ class Driver:
             self._request_publish()
             if self._sockets.health_broadcaster is not None:
                 self._sockets.health_broadcaster.notify()
+            # Escalate to BOUND claims: withholding from future slices does
+            # nothing for a claim already holding the silicon.  Outside
+            # every lock — this walks the checkpoint view and writes claim
+            # status through the apiserver.
+            self._escalate_unhealthy(names, event)
+
+    def _escalate_unhealthy(self, names: set[str], event: HealthEvent) -> None:
+        """Cross-reference freshly-unhealthy devices against the
+        checkpoint's bound claims (copy-free ``read_view``) and surface the
+        fault on each affected claim's status.  Failures are counted, not
+        raised — the health loop must keep consuming events."""
+        try:
+            cp = self._checkpoints.read_view()
+        except Exception:  # noqa: BLE001 — a torn checkpoint: publish already warned
+            logger.exception("health escalation could not read the checkpoint")
+            return
+        for uid, rec in cp.prepared_claims.items():
+            held = [
+                d for d in rec.all_devices() if d.canonical_name in names
+            ]
+            if not held:
+                continue
+            devices = [
+                {
+                    "driver": TPU_DRIVER_NAME,
+                    "pool": d.pool_name or alloc.pool_name(self._config.node_name),
+                    "device": d.canonical_name,
+                }
+                for d in held
+            ]
+            message = (
+                f"{event.kind}: device(s) "
+                f"{', '.join(sorted(d.canonical_name for d in held))} "
+                f"on node {self._config.node_name} went unhealthy under "
+                "this bound claim"
+            )
+            try:
+                written = escalate_claim_condition(
+                    self._kube, rec.namespace, rec.name, uid, devices,
+                    reason=event.kind, message=message,
+                )
+            except Exception:  # noqa: BLE001 — apiserver blip: count and move on
+                logger.exception("health escalation failed for claim %s", uid)
+                metrics.CLAIM_HEALTH_ESCALATIONS.labels("failed").inc()
+                continue
+            if written:
+                logger.warning(
+                    "escalated %s to bound claim %s/%s (%s)",
+                    event.kind, rec.namespace, rec.name, uid,
+                )
+                metrics.CLAIM_HEALTH_ESCALATIONS.labels("written").inc()
 
     def _devices_for_event(self, event: HealthEvent) -> set[str]:
         if event.partition_uuid:

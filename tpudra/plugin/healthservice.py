@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Iterator
 
@@ -35,6 +36,13 @@ HEALTH_SERVICE = "v1alpha1.DRAResourceHealth"
 #: Re-send the full snapshot at least this often so kubelet's staleness
 #: timeout never fires while the stream is healthy.
 DEFAULT_KEEPALIVE_S = 60.0
+
+#: Flap-coalescing window: after a notify wakes a stream, trailing
+#: notifies inside this window ride the same snapshot — a chip taking its
+#: partitions down one event at a time (or a tight healthy→unhealthy
+#: cascade) costs kubelet ONE reconcile, not one per event.  Mirrors the
+#: slice publisher's debounce (driver.publish_debounce_s).
+DEFAULT_COALESCE_S = 0.05
 
 
 @dataclass(frozen=True)
@@ -61,9 +69,15 @@ class HealthBroadcaster:
     hangs up or ``stop()`` is called (server shutdown).
     """
 
-    def __init__(self, snapshot: SnapshotFn, keepalive_s: float = DEFAULT_KEEPALIVE_S):
+    def __init__(
+        self,
+        snapshot: SnapshotFn,
+        keepalive_s: float = DEFAULT_KEEPALIVE_S,
+        coalesce_s: float = DEFAULT_COALESCE_S,
+    ):
         self._snapshot = snapshot
         self._keepalive_s = keepalive_s
+        self._coalesce_s = coalesce_s
         self._cond = threading.Condition()
         self._seq = 0
         self._stopped = False
@@ -90,7 +104,11 @@ class HealthBroadcaster:
 
     def watch(self, request, context) -> Iterator[healthpb.NodeWatchResourcesResponse]:
         """The NodeWatchResources handler: initial complete snapshot, then a
-        fresh snapshot on every notify() and on keepalive expiry."""
+        fresh snapshot per notify burst (``coalesce_s`` window) and on
+        keepalive expiry.  A stream opened after a plugin restart gets the
+        restarted driver's CURRENT state in its first response — resume is
+        a replay of truth, not of history (every response is a complete
+        snapshot by the proto contract)."""
         logger.info("kubelet opened a DRAResourceHealth watch")
         with self._cond:
             seen = self._seq
@@ -101,6 +119,14 @@ class HealthBroadcaster:
                     return
                 if self._seq == seen:
                     self._cond.wait(timeout=self._keepalive_s)
+                if self._stopped:
+                    return
+                notified = self._seq != seen
+            if notified and self._coalesce_s > 0:
+                # Coalescing window, outside the condition: trailing flaps
+                # land in _seq and are absorbed by the re-read below.
+                time.sleep(self._coalesce_s)
+            with self._cond:
                 if self._stopped:
                     return
                 seen = self._seq

@@ -59,6 +59,15 @@ def device_consumed_counters(dev: AllocatableDevice) -> list[dict]:
     return [{"counterSet": counter_set_name(chip.index), "counters": counters}]
 
 
+#: Slice annotation carrying the count of devices withheld for HEALTH on
+#: this node (sibling-withholds excluded).  Unhealthy silicon is absent
+#: from the device list by design, which leaves consumers unable to tell
+#: "small node" from "sick node"; the gang remediation's spare-node
+#: selection (controller/gang.py) filters on this without having to know
+#: every node's expected chip count.
+SLICE_UNHEALTHY_ANNOTATION = "tpu.google.com/unhealthy-device-count"
+
+
 @dataclass
 class DriverResources:
     """One pool's worth of publication data for this node."""
@@ -67,6 +76,9 @@ class DriverResources:
     devices: list[dict] = field(default_factory=list)
     shared_counters: list[dict] = field(default_factory=list)
     partitionable: bool = False
+    #: Devices withheld for health (not sibling visibility) — published as
+    #: SLICE_UNHEALTHY_ANNOTATION on every built slice.
+    unhealthy_count: int = 0
 
 
 def generate_driver_resources(
@@ -97,7 +109,10 @@ def generate_driver_resources(
     seen_counter_chips: set[int] = set()
     for name in sorted(allocatable):
         dev = allocatable[name]
-        if name in unhealthy or name in withheld or dev.chip.index in bad_chips:
+        if name in unhealthy or dev.chip.index in bad_chips:
+            res.unhealthy_count += 1
+            continue
+        if name in withheld:
             continue
         entry = dev.to_resource_device()
         if partitionable:
@@ -151,7 +166,12 @@ def build_resource_slices(
             {
                 "apiVersion": "resource.k8s.io/v1",
                 "kind": "ResourceSlice",
-                "metadata": {"name": f"{node_name}-{TPU_DRIVER_NAME}-{name_suffix}"},
+                "metadata": {
+                    "name": f"{node_name}-{TPU_DRIVER_NAME}-{name_suffix}",
+                    "annotations": {
+                        SLICE_UNHEALTHY_ANNOTATION: str(res.unhealthy_count)
+                    },
+                },
                 "spec": spec,
             }
         )

@@ -119,6 +119,8 @@ FAULT_KINDS = (
     "torn_wal",
     "clock_skew",
     "cd_wave",
+    "chip_fault",
+    "daemon_crash",
 )
 
 #: Invariant label values (METRICS-HYGIENE: one spelling, shared with the
@@ -130,6 +132,15 @@ INV_SLICE_CONVERGENCE = "slice-convergence"
 INV_LOCK_WITNESS = "lock-witness"
 INV_FAULT_RECOVERY = "fault-recovery"
 INV_GANG_ATOMICITY = "gang-atomicity"
+#: No quiet-window ResourceSlice may advertise silicon its driver holds
+#: unhealthy (the health loop's withhold must actually reach the API).
+INV_SLICE_HEALTH = "slice-health"
+#: No gang may sit in the degraded/remediating phases past the recovery
+#: budget — remediation must converge or release, not linger.
+INV_GANG_DEGRADED = "gang-degraded"
+#: No bound gang grant may live on a node with faulted silicon after its
+#: remediation completed (and none in any quiet window).
+INV_GRANT_HEALTH = "grant-health"
 INVARIANTS = (
     INV_CLAIM_STUCK,
     INV_CDI_LEAK,
@@ -138,6 +149,9 @@ INVARIANTS = (
     INV_LOCK_WITNESS,
     INV_FAULT_RECOVERY,
     INV_GANG_ATOMICITY,
+    INV_SLICE_HEALTH,
+    INV_GANG_DEGRADED,
+    INV_GRANT_HEALTH,
 )
 
 
@@ -215,6 +229,63 @@ class FaultRecord:
             "point": self.point,
             "params": self.params,
         }
+
+
+class _PongServer:
+    """Stand-in for the host-0 workload's jax coordinator: accepts on
+    loopback and answers ``pong`` — the registered upstream the daemon
+    proxy must keep forwarding to across daemon_crash faults."""
+
+    def __init__(self):
+        import socket as socket_mod
+
+        self._sock = socket_mod.socket(
+            socket_mod.AF_INET, socket_mod.SOCK_STREAM
+        )
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = threading.Event()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._serve, daemon=True, name="soak-pong"
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                conn.sendall(b"pong\n")
+            except OSError:
+                ...
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    ...
+
+    def stop(self) -> None:
+        import socket as socket_mod
+
+        self._stopped.set()
+        # shutdown() before close(): close alone does not wake a Linux
+        # thread blocked in accept() (the CoordinatorProxy.stop bug this
+        # same module's daemon_crash fault surfaced) — without it the
+        # soak-pong thread leaks parked in accept() every run.
+        try:
+            self._sock.shutdown(socket_mod.SHUT_RDWR)
+        except OSError:
+            ...
+        try:
+            self._sock.close()
+        except OSError:
+            ...
 
 
 class SimClock:
@@ -305,6 +376,19 @@ class ChaosSoak:
         self._gang_cp = None
         self._cd_wave_seq = 0
         self._cd_wave_inflight = 0  # guarded by _records_lock
+        # Degraded-gang age tracking for INV_GANG_DEGRADED.
+        self._degraded_ager = MonotonicAger()
+        # -- daemon stack (chip_fault's sibling blast radius): a supervised
+        # dummy slice daemon under the REAL ProcessManager watchdog (full-
+        # jitter restart backoff) plus a REAL CoordinatorProxy forwarding
+        # to a registered upstream — daemon_crash SIGKILLs the child /
+        # bounces the proxy while other fault windows stay open.  Fault
+        # thread only.
+        self._daemon_pm = None
+        self._daemon_stop: Optional[threading.Event] = None
+        self._daemon_proxy = None
+        self._daemon_upstream: Optional[object] = None
+        self._daemon_dir: Optional[str] = None
 
     # ------------------------------------------------------------- plumbing
 
@@ -588,6 +672,10 @@ class ChaosSoak:
                         )
                     )
                 }
+            elif kind == "daemon_crash":
+                params = {
+                    "target": self._rng.choice(["slicewatchd", "coordproxy"])
+                }
         else:
             kind = spec["kind"]
             node = spec.get("node") or 0
@@ -612,6 +700,10 @@ class ChaosSoak:
             self._inject_clock_skew(params)
         elif kind == "cd_wave":
             self._inject_cd_wave(params)
+        elif kind == "chip_fault":
+            self._inject_chip_fault(node)
+        elif kind == "daemon_crash":
+            self._inject_daemon_crash(params)
         else:
             self._anomaly(f"unknown fault kind {kind!r}")
 
@@ -1012,80 +1104,13 @@ class ChaosSoak:
         }
         try:
             try:
-                with api_deadline(5.0):
-                    # Wave-start hygiene: a previous wave whose label GC a
-                    # fault beat would fail this wave's add_node_label —
-                    # sweep OUR label off the member nodes first (the
-                    # controller's sweep_stale_labels analog; only cd_wave
-                    # domains ever set it in the soak).
-                    self._sweep_cd_labels(nodes)
-                    self.sim.kube.create(
-                        gvr.COMPUTE_DOMAINS,
-                        # ready=False: the LIVE soak controller owns the
-                        # status — aggregated from the clique CR below.
-                        make_compute_domain(
-                            gang_id, domain_uid, nodes, ready=False
-                        ),
-                        "default",
-                    )
-                    # The wave plays the per-node daemons' role (as it
-                    # plays kubelet's for binds): one clique CR naming the
-                    # member nodes Ready.  The LIVE soak controller then
-                    # aggregates it into CD status — the real readiness
-                    # path the channel prepare gates on, under whatever
-                    # fault windows are currently open.
-                    self.sim.kube.create(
-                        gvr.COMPUTE_DOMAIN_CLIQUES,
-                        {
-                            "apiVersion": "resource.tpu.google.com/v1beta1",
-                            "kind": "ComputeDomainClique",
-                            "metadata": {
-                                "name": f"{gang_id}-clique",
-                                "namespace": self.sim.config.driver_namespace,
-                            },
-                            "spec": {"computeDomainUID": domain_uid},
-                            "status": {
-                                "daemons": [
-                                    {
-                                        "nodeName": n,
-                                        "ipAddress": "127.0.0.1",
-                                        "cliqueID": f"{gang_id}.0",
-                                        "index": k,
-                                        "status": "Ready",
-                                    }
-                                    for k, n in enumerate(nodes)
-                                ]
-                            },
-                        },
-                        self.sim.config.driver_namespace,
-                    )
-                    for claim in claims.values():
-                        self.sim.kube.create(
-                            gvr.RESOURCE_CLAIMS, claim, "default"
-                        )
+                self._create_cd_objects(gang_id, domain_uid, nodes, claims)
             except ApiError as e:
                 # The wave lost to a latency window before any member
                 # could bind: nothing reserved, nothing to assert.
                 record.params["aborted"] = str(e)[:120]
                 return
-            # Readiness is the controller's to grant: wait (bounded) for
-            # the clique aggregation to mark the CD Ready.  A fault window
-            # outliving the wait just means the gang binds into the
-            # readiness gate and rolls back — atomicity still asserted.
-            ready_deadline = time.monotonic() + self.simclock.wall_of(
-                self.budget.recovery_sim_s / 2
-            )
-            while time.monotonic() < ready_deadline and not self._stop.is_set():
-                try:
-                    with api_deadline(3.0):
-                        cd = self.sim.kube.get(
-                            gvr.COMPUTE_DOMAINS, gang_id, "default"
-                        )
-                    if cd.get("status", {}).get("status") == "Ready":
-                        break
-                except (NotFound, ApiError):
-                    ...
-                time.sleep(0.02)
+            self._await_cd_ready(gang_id)
             try:
                 self._gang_mgr.reserve(gang_id, members, claims)
                 record.params["outcome"] = "bound"
@@ -1145,32 +1170,543 @@ class ChaosSoak:
             if converged:
                 self._recovery_samples.append(self._now() - t0_sim)
         finally:
+            self._delete_cd_objects(gang_id, claims)
+            with self._records_lock:
+                self._cd_wave_inflight -= 1
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+
+    # ------------------------------------------- CD object lifecycle helpers
+
+    def _create_cd_objects(
+        self, gang_id: str, domain_uid: str, nodes: list[str], claims: dict
+    ) -> None:
+        """Create the CD + clique CR + member channel claims for one gang
+        (shared by cd_wave and chip_fault).  The clique CR plays the
+        per-node daemons' role; the LIVE soak controller aggregates it
+        into CD Ready status — the real readiness path the channel
+        prepare gates on.  Raises ApiError when a latency window wins."""
+        from tpudra.sim.multihost import make_compute_domain
+
+        with api_deadline(5.0):
+            # Start hygiene: a previous gang whose label GC a fault beat
+            # would fail this gang's add_node_label — sweep OUR label off
+            # the member nodes first (the controller's sweep_stale_labels
+            # analog; only soak domains ever set it here).
+            self._sweep_cd_labels(nodes)
+            self.sim.kube.create(
+                gvr.COMPUTE_DOMAINS,
+                # ready=False: the LIVE soak controller owns the status.
+                make_compute_domain(gang_id, domain_uid, nodes, ready=False),
+                "default",
+            )
+            self.sim.kube.create(
+                gvr.COMPUTE_DOMAIN_CLIQUES,
+                {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": "ComputeDomainClique",
+                    "metadata": {
+                        "name": f"{gang_id}-clique",
+                        "namespace": self.sim.config.driver_namespace,
+                    },
+                    "spec": {"computeDomainUID": domain_uid},
+                    "status": {
+                        "daemons": [
+                            {
+                                "nodeName": n,
+                                "ipAddress": "127.0.0.1",
+                                "cliqueID": f"{gang_id}.0",
+                                "index": k,
+                                "status": "Ready",
+                            }
+                            for k, n in enumerate(nodes)
+                        ]
+                    },
+                },
+                self.sim.config.driver_namespace,
+            )
             for claim in claims.values():
-                try:
-                    with api_deadline(5.0):
-                        self.sim.kube.delete(
-                            gvr.RESOURCE_CLAIMS,
-                            claim["metadata"]["uid"],
-                            "default",
-                        )
-                except (NotFound, ApiError):
-                    ...
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+
+    def _await_cd_ready(self, gang_id: str) -> None:
+        """Wait (bounded) for the controller's clique aggregation to mark
+        the CD Ready.  A fault window outliving the wait just means the
+        gang binds into the readiness gate and rolls back — atomicity is
+        still asserted."""
+        ready_deadline = time.monotonic() + self.simclock.wall_of(
+            self.budget.recovery_sim_s / 2
+        )
+        while time.monotonic() < ready_deadline and not self._stop.is_set():
+            try:
+                with api_deadline(3.0):
+                    cd = self.sim.kube.get(
+                        gvr.COMPUTE_DOMAINS, gang_id, "default"
+                    )
+                if cd.get("status", {}).get("status") == "Ready":
+                    return
+            except (NotFound, ApiError):
+                ...
+            time.sleep(0.02)
+
+    def _delete_cd_objects(self, gang_id: str, claims: dict) -> None:
+        for claim in claims.values():
             try:
                 with api_deadline(5.0):
                     self.sim.kube.delete(
-                        gvr.COMPUTE_DOMAIN_CLIQUES,
-                        f"{gang_id}-clique",
-                        self.sim.config.driver_namespace,
+                        gvr.RESOURCE_CLAIMS, claim["metadata"]["uid"], "default"
                     )
             except (NotFound, ApiError):
                 ...
+        try:
+            with api_deadline(5.0):
+                self.sim.kube.delete(
+                    gvr.COMPUTE_DOMAIN_CLIQUES,
+                    f"{gang_id}-clique",
+                    self.sim.config.driver_namespace,
+                )
+        except (NotFound, ApiError):
+            ...
+        try:
+            with api_deadline(5.0):
+                self.sim.kube.delete(gvr.COMPUTE_DOMAINS, gang_id, "default")
+        except (NotFound, ApiError):
+            ...
+
+    # ----------------------------------------------------------- chip fault
+
+    def _inject_chip_fault(self, node: int) -> None:
+        """A chip dies on a node with (1) a BOUND node-local claim on the
+        silicon and (2) a live gang member — the escalation + remediation
+        path end to end: the health handler must withhold the chip from
+        published slices AND surface the fault on the bound claim's
+        status; the gang must go degraded and remediate onto a healthy
+        spare (selection filtered on published slice health), leaving no
+        grant on the faulted node and zero CDI leaks.  The node is then
+        crash/restarted — the plugin-replacement repair, the only re-heal
+        path the reference admits."""
+        from tpudra.controller.gang import GangMember
+        from tpudra.devicelib import HealthEvent, HealthEventKind
+        from tpudra.plugin.driver import CLAIM_UNHEALTHY_CONDITION
+        from tpudra.sim.multihost import make_channel_claim
+
+        record = FaultRecord(
+            kind="chip_fault", t_sim_start=self._now(), node=node
+        )
+        self._record_fault(record)
+        self._quarantine_node(node)
+        t0_sim = self._now()
+        n_fault = self._fault_counter
+        uid = f"soak-chip-{n_fault}"
+        gang_id = f"soak-chipg-{n_fault}"
+        domain_uid = f"{gang_id}-uid"
+        node_name = self.sim.node_names[node]
+        gang_members: list = []
+        gang_claims: dict = {}
+        gang_reserved = False
+        withheld = False  # read by the finally's recovery-sample gate
+        try:
+            driver = self.sim.drivers[node]
+            # (1) a bound claim on tpu-0, the fault injectors' reserved
+            # slot — the claim holder the escalation exists for.
+            claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
+            with api_deadline(5.0):
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            bound = self._retry_prepare(
+                node, claim, self.budget.recovery_sim_s / 2
+            )
+            # (2) a live 2-member gang including this node, with the whole
+            # cluster in the domain so healthy peers qualify as spares
+            # (daemons run on spares too — that is what makes them spares).
+            if bound and self.config.nodes >= 3:
+                self._ensure_cd_stack()
+                others = [i for i in range(self.config.nodes) if i != node]
+                peer_name = self.sim.node_names[others[0]]
+                gang_members = [
+                    GangMember(node=node_name, claim_uid=f"{gang_id}-m0"),
+                    GangMember(node=peer_name, claim_uid=f"{gang_id}-m1"),
+                ]
+                gang_claims = {
+                    m.claim_uid: make_channel_claim(
+                        m.claim_uid, m.node, domain_uid
+                    )
+                    for m in gang_members
+                }
+                try:
+                    self._create_cd_objects(
+                        gang_id, domain_uid, list(self.sim.node_names),
+                        gang_claims,
+                    )
+                    self._await_cd_ready(gang_id)
+                    self._gang_mgr.reserve(gang_id, gang_members, gang_claims)
+                    gang_reserved = True
+                except Exception as e:  # noqa: BLE001 — a fault window won
+                    record.params["gang_aborted"] = str(e)[:120]
+            # (3) THE FAULT — delivered through the real handler (health
+            # loop body): withhold + escalate + health-stream notify.
+            event = HealthEvent(
+                kind=HealthEventKind.HBM_ECC_ERROR,
+                chip_uuid=self.sim._libs[node].chip_by_index(0).uuid,
+                detail=f"soak chip_fault #{n_fault}",
+            )
+            try:
+                driver._handle_health_event(event)
+            except Exception:  # noqa: BLE001 — latency window beat the publish
+                logger.info("chip_fault handler pass deferred", exc_info=True)
+            # The slice withhold must land (retrying through the latency
+            # window — the health loop's republish would).
+            deadline = time.monotonic() + self.simclock.wall_of(
+                self.budget.recovery_sim_s
+            )
+            withheld = False
+            while time.monotonic() < deadline and not self._stop.is_set():
+                if "tpu-0" not in self._advertised_devices(node_name):
+                    withheld = True
+                    break
+                try:
+                    with api_deadline(5.0):
+                        driver.publish_resources()
+                except Exception:  # noqa: BLE001 — retried until the window closes
+                    logger.info("chip_fault republish retrying", exc_info=True)
+                time.sleep(0.05)
+            self._check(
+                INV_FAULT_RECOVERY,
+                withheld,
+                key=("chip_fault_withhold", n_fault),
+                detail="faulted chip still advertised in ResourceSlices",
+            )
+            if bound:
+                # Escalation: the bound claim must carry the condition.
+                escalated = False
+                try:
+                    with api_deadline(5.0):
+                        live = self.sim.kube.get(
+                            gvr.RESOURCE_CLAIMS, uid, "default"
+                        )
+                    escalated = any(
+                        c.get("type") == CLAIM_UNHEALTHY_CONDITION
+                        and c.get("status") == "True"
+                        for c in live.get("status", {}).get("conditions", [])
+                    )
+                except (NotFound, ApiError):
+                    ...
+                self._check(
+                    INV_FAULT_RECOVERY,
+                    escalated,
+                    key=("chip_fault_escalation", n_fault),
+                    detail=(
+                        "bound claim on faulted silicon got no "
+                        "DeviceUnhealthy status condition"
+                    ),
+                )
+            if gang_reserved:
+                self._remediate_chip_fault_gang(
+                    record, gang_id, domain_uid, gang_members, gang_claims,
+                    node_name, n_fault,
+                )
+            # Teardown: gang first (so its channel unprepare still finds
+            # the CD), then the node claim, then the repair restart.
+            if gang_reserved:
+                try:
+                    self._gang_mgr.release(gang_id)
+                except Exception:  # noqa: BLE001 — recover() owns stragglers
+                    logger.info("chip_fault gang release retrying", exc_info=True)
+                    try:
+                        self._gang_mgr.recover()
+                    except Exception:  # noqa: BLE001 — next wave retries
+                        logger.info(
+                            "chip_fault gang recovery deferred", exc_info=True
+                        )
+            self._best_effort_unprepare(driver, uid)
+            # The repair: replace the plugin over the same dirs — the only
+            # way sick silicon re-enters advertisement (driver.go:462-502),
+            # and what keeps a long soak from grinding to all-unhealthy.
+            self.sim.crash_node(node)
+            self.sim.restart_node(node)
+        finally:
             try:
                 with api_deadline(5.0):
-                    self.sim.kube.delete(gvr.COMPUTE_DOMAINS, gang_id, "default")
+                    self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
             except (NotFound, ApiError):
                 ...
-            with self._records_lock:
-                self._cd_wave_inflight -= 1
+            if gang_claims:
+                self._delete_cd_objects(gang_id, gang_claims)
+            self._unquarantine_node(node)
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+            # Sample only genuine recoveries (the cd_wave/daemon_crash
+            # convention): a leg that timed out at the full budget already
+            # recorded its invariant violation — feeding the whole budget
+            # into the recovery percentiles would double-count it.
+            if withheld:
+                self._recovery_samples.append(record.recovered_sim_s)
+
+    def _remediate_chip_fault_gang(
+        self,
+        record: FaultRecord,
+        gang_id: str,
+        domain_uid: str,
+        gang_members: list,
+        gang_claims: dict,
+        faulted_node: str,
+        n_fault: int,
+    ) -> None:
+        """The degraded→remediated leg of a chip fault: mark the member on
+        the faulted node degraded (the controller's condition-watch role),
+        pick a spare FILTERED ON PUBLISHED SLICE HEALTH, remediate, and
+        assert the post-conditions: all-bound off the faulted node, no
+        grant left on it, no CDI leak from the displaced member."""
+        from tpudra.controller.gang import GangMember, select_healthy_spares
+        from tpudra.sim.multihost import make_channel_claim
+
+        sick = next(m for m in gang_members if m.node == faulted_node)
+        self._gang_mgr.mark_degraded(
+            gang_id, [sick.claim_uid], reason="chip_fault"
+        )
+        gang_nodes = {m.node for m in gang_members}
+        spares = select_healthy_spares(
+            self.sim.kube,
+            [n for n in self.sim.node_names if n not in gang_nodes],
+            exclude=gang_nodes,
+        )
+        if not spares:
+            record.params["remediation"] = "no healthy spares"
+            self._anomaly(
+                f"chip_fault #{n_fault}: no healthy spare for gang {gang_id}"
+            )
+            return
+        replacement = GangMember(
+            node=spares[0], claim_uid=f"{gang_id}-r0"
+        )
+        replacement_claim = make_channel_claim(
+            replacement.claim_uid, replacement.node, domain_uid
+        )
+        try:
+            with api_deadline(5.0):
+                self.sim.kube.create(
+                    gvr.RESOURCE_CLAIMS, replacement_claim, "default"
+                )
+        except ApiError as e:
+            record.params["remediation"] = f"aborted: {e}"[:120]
+            return
+        gang_claims[replacement.claim_uid] = replacement_claim
+        target_claims = {
+            replacement.claim_uid: replacement_claim,
+            **{
+                m.claim_uid: gang_claims[m.claim_uid]
+                for m in gang_members
+                if m.claim_uid != sick.claim_uid
+            },
+        }
+        remediated = False
+        try:
+            status = self._gang_mgr.remediate(
+                gang_id, {sick.claim_uid: replacement}, target_claims
+            )
+            remediated = status.phase == "bound"
+        except Exception as e:  # noqa: BLE001 — released/failed under faults
+            record.params["remediation"] = f"{type(e).__name__}: {e}"[:120]
+        record.params["remediated_to"] = replacement.node
+        self._check(
+            INV_GANG_DEGRADED,
+            remediated
+            or self._gang_mgr.gangs().get(gang_id) is None,
+            key=("chip_fault_remediate", n_fault),
+            detail=(
+                "degraded gang neither remediated nor cleanly released "
+                "inside its fault window"
+            ),
+        )
+        if remediated:
+            # No grant on dead silicon: the displaced member's bind and
+            # CDI spec must be gone from the faulted node.
+            d = self._cd_drivers.get(faulted_node)
+            leaked = d is not None and (
+                sick.claim_uid in d.state.prepared_claim_uids()
+                or sick.claim_uid in d.state._cdi.list_claim_uids()
+            )
+            self._check(
+                INV_GRANT_HEALTH,
+                not leaked,
+                key=("chip_fault_grant", n_fault),
+                detail=(
+                    f"remediated gang left a grant/CDI spec for "
+                    f"{sick.claim_uid} on faulted node {faulted_node}"
+                ),
+            )
+            n_bound = self._bound_gang_members(
+                [replacement]
+                + [m for m in gang_members if m.claim_uid != sick.claim_uid]
+            )
+            self._check(
+                INV_GANG_ATOMICITY,
+                n_bound == len(gang_members),
+                key=("chip_fault_bound", n_fault),
+                detail=(
+                    f"remediated gang has {n_bound}/{len(gang_members)} "
+                    "members bound"
+                ),
+            )
+
+    def _advertised_devices(self, node_name: str) -> set:
+        try:
+            with api_deadline(3.0):
+                listing = self.sim.kube.list(gvr.RESOURCE_SLICES)
+        except ApiError:
+            return {"__unknown__"}  # indeterminate: caller retries
+        out: set = set()
+        for item in listing.get("items", []):
+            spec = item.get("spec", {})
+            if (
+                spec.get("driver") == TPU_DRIVER_NAME
+                and spec.get("nodeName") == node_name
+            ):
+                for d in spec.get("devices", []):
+                    out.add(d.get("name"))
+        return out
+
+    # --------------------------------------------------------- daemon crash
+
+    def _ensure_daemon_stack(self) -> None:
+        """Build the CD daemon stack the soak supervises: a dummy slice
+        daemon under the REAL ProcessManager watchdog (shared full-jitter
+        restart backoff, seeded rng) and a REAL CoordinatorProxy forwarding
+        to a registered upstream (an in-process stand-in for the host-0
+        workload's jax coordinator).  Fault thread only."""
+        if self._daemon_pm is not None:
+            return
+        import sys
+
+        from tpudra.cddaemon.coordproxy import CoordinatorProxy, write_registration
+        from tpudra.cddaemon.process import ProcessManager
+
+        self._daemon_dir = os.path.join(self.sim._base, "daemon-domain")
+        os.makedirs(self._daemon_dir, exist_ok=True)
+        self._daemon_upstream = _PongServer()
+        self._daemon_upstream.start()
+        write_registration(
+            self._daemon_dir, "127.0.0.1", self._daemon_upstream.port
+        )
+        self._daemon_proxy = CoordinatorProxy(
+            0, self._daemon_dir, host="127.0.0.1"
+        )
+        self._daemon_proxy.start()
+        self._daemon_stop = threading.Event()
+        self._daemon_pm = ProcessManager(
+            [sys.executable, "-c", "import time; time.sleep(3600)"],
+            restart_rng=random.Random(self.config.seed ^ 0xDA3),
+        )
+        self._daemon_pm.ensure_started()
+        self._daemon_pm.start_watchdog(self._daemon_stop, tick=0.05)
+
+    def _close_daemon_stack(self) -> None:
+        if self._daemon_stop is not None:
+            self._daemon_stop.set()
+        if self._daemon_pm is not None:
+            try:
+                self._daemon_pm.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception("daemon stack stop failed")
+        if self._daemon_proxy is not None:
+            self._daemon_proxy.stop()
+        if self._daemon_upstream is not None:
+            self._daemon_upstream.stop()
+
+    def _probe_proxy(self, timeout: float = 5.0) -> bool:
+        """One rendezvous through the proxy: connect to the coordinator
+        port, expect the registered upstream's payload back."""
+        import socket as socket_mod
+
+        try:
+            with socket_mod.create_connection(
+                ("127.0.0.1", self._daemon_proxy.bound_port), timeout=timeout
+            ) as s:
+                s.settimeout(timeout)
+                return s.recv(16).startswith(b"pong")
+        except OSError:
+            return False
+
+    def _inject_daemon_crash(self, params: dict) -> None:
+        """SIGKILL the slice daemon (watchdog must respawn it through the
+        full-jitter backoff) or bounce the coordinator proxy (the restart
+        must re-read the registration and forward again) — while whatever
+        other fault windows are open stay open."""
+        import signal as signal_mod
+
+        target = params.get("target") or "slicewatchd"
+        record = FaultRecord(
+            kind="daemon_crash", t_sim_start=self._now(), params=dict(params)
+        )
+        self._record_fault(record)
+        t0_sim = self._now()
+        try:
+            self._ensure_daemon_stack()
+            if target == "slicewatchd":
+                deadline = time.monotonic() + self.simclock.wall_of(
+                    self.budget.recovery_sim_s
+                )
+                pm = self._daemon_pm
+                # STABLE_UPTIME is 30 WALL seconds — a compressed soak's
+                # child never qualifies as stable, so repeated kills would
+                # accumulate the jitter window across injections until the
+                # wall-of(sim) budget loses to a correctly-pacing
+                # watchdog.  Each injection tests "the watchdog respawns
+                # through the backoff", not cumulative pacing (the pacing
+                # law itself is unit-tested), so reset per injection.
+                pm._restart_backoff.reset()
+                pid_before = pm.pid
+                restarts_before = pm.restarts
+                pm.send_signal(signal_mod.SIGKILL)
+                recovered = False
+                while time.monotonic() < deadline and not self._stop.is_set():
+                    if (
+                        pm.running
+                        and pm.pid != pid_before
+                        and pm.restarts > restarts_before
+                    ):
+                        recovered = True
+                        break
+                    time.sleep(0.02)
+                record.params["restarts"] = pm.restarts
+                self._check(
+                    INV_FAULT_RECOVERY,
+                    recovered,
+                    key=("daemon_crash", self._fault_counter),
+                    detail=(
+                        "watchdog did not respawn the slice daemon inside "
+                        "the recovery budget"
+                    ),
+                )
+            else:
+                from tpudra.cddaemon.coordproxy import CoordinatorProxy
+
+                self._daemon_proxy.stop()
+                self._daemon_proxy = CoordinatorProxy(
+                    0, self._daemon_dir, host="127.0.0.1"
+                )
+                self._daemon_proxy.start()
+                # The recovery clock starts at the restart, like the
+                # watchdog variant's (the crash itself has no budget).
+                deadline = time.monotonic() + self.simclock.wall_of(
+                    self.budget.recovery_sim_s
+                )
+                recovered = False
+                while time.monotonic() < deadline and not self._stop.is_set():
+                    if self._probe_proxy(timeout=1.0):
+                        recovered = True
+                        break
+                    time.sleep(0.02)
+                self._check(
+                    INV_FAULT_RECOVERY,
+                    recovered,
+                    key=("daemon_crash_proxy", self._fault_counter),
+                    detail=(
+                        "restarted coordinator proxy never forwarded to "
+                        "the registered endpoint again"
+                    ),
+                )
+            if recovered:
+                self._recovery_samples.append(self._now() - t0_sim)
+        finally:
             self._end_fault(record)
             record.recovered_sim_s = record.t_sim_end - t0_sim
 
@@ -1185,7 +1721,7 @@ class ChaosSoak:
             label = node.get("metadata", {}).get("labels", {}).get(
                 COMPUTE_DOMAIN_NODE_LABEL
             )
-            if label and label.startswith("soak-cdw-"):
+            if label and label.startswith("soak-"):
                 try:
                     self.sim.kube.patch(
                         gvr.NODES,
@@ -1225,6 +1761,130 @@ class ChaosSoak:
         self._check_leaks()
         self._check_slice_convergence()
         self._check_gang_atomicity()
+        self._check_slice_health()
+        self._check_gang_degraded()
+        self._check_grant_health()
+
+    def _quiet_and_settled(self) -> bool:
+        """True when no fault window is open AND the convergence budget
+        has elapsed since the last one closed — the precondition shared by
+        every published-state invariant."""
+        now = self._now()
+        with self._records_lock:
+            if self._active or self._cd_wave_inflight > 0:
+                return False
+            last_end = max(
+                (r.t_sim_end or now for r in self._timeline), default=0.0
+            )
+        return not (
+            now - last_end < self.budget.convergence_sim_s and last_end > 0
+        )
+
+    def _check_slice_health(self) -> None:
+        """QUIET-WINDOW: no published ResourceSlice may advertise silicon
+        its driver currently holds unhealthy — the withhold must actually
+        have reached the apiserver, not just the in-memory set."""
+        if not self._quiet_and_settled():
+            return
+        try:
+            listing = self.sim.kube.list(gvr.RESOURCE_SLICES)
+        except ApiError:
+            return
+        advertised: dict[str, set] = {}
+        for item in listing.get("items", []):
+            spec = item.get("spec", {})
+            if spec.get("driver") == TPU_DRIVER_NAME:
+                devs = advertised.setdefault(spec.get("nodeName", ""), set())
+                for d in spec.get("devices", []):
+                    devs.add(d.get("name"))
+        for i in range(self.config.nodes):
+            node_name = self.sim.node_names[i]
+            try:
+                bad = self.sim.drivers[i].unhealthy_devices()
+            except Exception:  # noqa: BLE001 — mid-restart window
+                continue
+            leaked = advertised.get(node_name, set()) & bad
+            if leaked:
+                self._check(
+                    INV_SLICE_HEALTH,
+                    False,
+                    key=(i, tuple(sorted(leaked))),
+                    detail=(
+                        f"node {node_name} advertises unhealthy silicon "
+                        f"{sorted(leaked)} in a quiet window"
+                    ),
+                )
+        self._pass_check(INV_SLICE_HEALTH)
+
+    def _check_gang_degraded(self) -> None:
+        """No gang may sit degraded/remediating longer than the recovery
+        budget (sim time, monotonic-aged) — remediation must converge to
+        all-bound-on-healthy or cleanly-released, not linger."""
+        mgr = self._gang_mgr
+        live_keys: list = []
+        if mgr is not None:
+            try:
+                gangs = mgr.gangs()
+            except Exception:  # noqa: BLE001 — mid-teardown window
+                return
+            for gang_id, status in gangs.items():
+                if status.phase not in ("degraded", "remediating"):
+                    self._degraded_ager.forget(gang_id)
+                    continue
+                live_keys.append(gang_id)
+                age_sim = (
+                    self._degraded_ager.age(gang_id, status.phase)
+                    * self.config.compression
+                )
+                self._check(
+                    INV_GANG_DEGRADED,
+                    age_sim <= self.budget.recovery_sim_s,
+                    key=("aged", gang_id),
+                    detail=(
+                        f"gang {gang_id} {status.phase} for {age_sim:.0f} "
+                        f"sim-seconds (budget "
+                        f"{self.budget.recovery_sim_s:.0f})"
+                    ),
+                )
+            self._degraded_ager.prune(live_keys)
+        self._pass_check(INV_GANG_DEGRADED)
+
+    def _check_grant_health(self) -> None:
+        """QUIET-WINDOW: no fully-bound gang may hold a member grant on a
+        node whose driver reports unhealthy silicon — after every
+        remediation wave, grants live only on healthy nodes."""
+        if not self._quiet_and_settled():
+            return
+        mgr = self._gang_mgr
+        if mgr is not None:
+            node_idx = {n: i for i, n in enumerate(self.sim.node_names)}
+            try:
+                gangs = mgr.gangs()
+            except Exception:  # noqa: BLE001 — mid-teardown window
+                return
+            for gang_id, status in gangs.items():
+                if status.phase != "bound":
+                    continue  # degraded/remediating: the age check owns it
+                for m in status.members:
+                    i = node_idx.get(m.node)
+                    if i is None:
+                        continue
+                    try:
+                        bad = self.sim.drivers[i].unhealthy_devices()
+                    except Exception:  # noqa: BLE001 — mid-restart window
+                        continue
+                    if bad:
+                        self._check(
+                            INV_GRANT_HEALTH,
+                            False,
+                            key=("quiet", gang_id, m.node),
+                            detail=(
+                                f"bound gang {gang_id} holds a grant on "
+                                f"{m.node} with unhealthy silicon "
+                                f"{sorted(bad)}"
+                            ),
+                        )
+        self._pass_check(INV_GRANT_HEALTH)
 
     def _check_gang_atomicity(self) -> None:
         """QUIET-WINDOW check: no gang may be partially bound — every gang
@@ -1275,7 +1935,9 @@ class ChaosSoak:
                 except Exception:  # noqa: BLE001 — mid-teardown window
                     continue
                 for uid in uids:
-                    if uid.startswith("soak-cdw-") and uid not in known:
+                    # Gang-member uids from BOTH gang-creating faults
+                    # (cd_wave and chip_fault, incl. its -rN replacements).
+                    if uid.startswith(("soak-cdw-", "soak-chipg-")) and uid not in known:
                         self._check(
                             INV_GANG_ATOMICITY,
                             False,
@@ -1365,6 +2027,47 @@ class ChaosSoak:
                             f"for {age_sim:.0f} sim-seconds (grace {grace:.0f})"
                         ),
                     )
+        # The CD plugin stack's CDI roots (cdw-c{i}): a remediation wave
+        # must not leave a displaced member's spec behind — the "zero CDI
+        # leaks across remediation waves" contract.
+        cd_drivers = self._cd_drivers
+        if cd_drivers:
+            for i, node_name in enumerate(self.sim.node_names):
+                d = cd_drivers.get(node_name)
+                if d is None:
+                    continue
+                try:
+                    uids = set(d.state.prepared_claim_uids())
+                except Exception:  # noqa: BLE001 — mid-teardown window
+                    continue
+                root = os.path.join(self.sim._base, f"cdw-c{i}")
+                try:
+                    names = os.listdir(root)
+                except OSError:
+                    continue
+                for name in names:
+                    if not name.endswith(".json"):
+                        continue
+                    orphan = not any(uid in name for uid in uids)
+                    key = (INV_CDI_LEAK, f"cd-{i}", name)
+                    live_keys.append(key)
+                    if not orphan:
+                        self._leak_ager.forget(key)
+                        continue
+                    age_sim = (
+                        self._leak_ager.age(key, "orphan")
+                        * self.config.compression
+                    )
+                    self._check(
+                        INV_CDI_LEAK,
+                        age_sim <= grace,
+                        key=key,
+                        detail=(
+                            f"CD spec {name} on node {i} has no checkpoint "
+                            f"record for {age_sim:.0f} sim-seconds "
+                            f"(grace {grace:.0f})"
+                        ),
+                    )
         self._leak_ager.prune(live_keys)
         self._pass_check(INV_CDI_LEAK)
         self._pass_check(INV_FLOCK_LEAK)
@@ -1375,14 +2078,7 @@ class ChaosSoak:
         allocatable device of every node advertised, nothing else.  Only
         asserted in QUIET windows — while faults are live the slices may
         legitimately lag."""
-        now = self._now()
-        with self._records_lock:
-            if self._active:
-                return
-            last_end = max(
-                (r.t_sim_end or now for r in self._timeline), default=0.0
-            )
-        if now - last_end < self.budget.convergence_sim_s and last_end > 0:
+        if not self._quiet_and_settled():
             return
         try:
             listing = self.sim.kube.list(gvr.RESOURCE_SLICES)
@@ -1471,6 +2167,7 @@ class ChaosSoak:
         self._check_lock_witness()
         report = self._report()
         self._close_cd_stack()
+        self._close_daemon_stack()
         self.sim.close()
         path = self.config.report_path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -147,11 +147,14 @@ def build_cd_stack(
     generation: str = "v5p",
     slice_uuid: Optional[str] = None,
     prefix: str = "cd",
+    host_indices: Optional[list[int]] = None,
 ) -> dict[str, object]:
     """Per-node CD plugin drivers over persistent dirs under ``base`` —
     the one construction shared by this harness, the chaos soak's cd-wave
     stack, and ``bench.py --gang`` (node ``i`` is host ``i`` of an
-    ``num_hosts``-host slice)."""
+    ``num_hosts``-host slice).  ``host_indices`` overrides the default
+    identity mapping — how a HOT SPARE node is cabled at the slot it can
+    replace (its grants must carry the displaced host's mesh position)."""
     from tpudra.cdplugin.driver import CDDriver, CDDriverConfig
     from tpudra.devicelib.mock import MockDeviceLib
     from tpudra.devicelib.topology import MockTopologyConfig
@@ -159,7 +162,10 @@ def build_cd_stack(
     n = num_hosts if num_hosts is not None else len(node_names)
     drivers: dict[str, object] = {}
     for i, name in enumerate(node_names):
-        topo_kwargs = dict(generation=generation, num_hosts=n, host_index=i)
+        host_index = host_indices[i] if host_indices is not None else i
+        topo_kwargs = dict(
+            generation=generation, num_hosts=n, host_index=host_index
+        )
         if slice_uuid is not None:
             topo_kwargs["slice_uuid"] = slice_uuid
         lib = MockDeviceLib(
@@ -241,6 +247,14 @@ class MultiHostConfig:
     #: initialization timeout is 300 s; a harness must fail faster).
     launch_deadline_s: float = 120.0
     extra_env: dict = field(default_factory=dict)
+    #: Hot-standby nodes: each listed slot k gets a spare node
+    #: (``mh-spare-k``) cabled at host position k — a CD driver whose
+    #: grants carry slot k's mesh coordinates, so a chip fault on member k
+    #: can remediate onto it without changing the slice geometry.  Spares
+    #: (and members) then also get per-node TPU health drivers publishing
+    #: real ResourceSlices, because remediation's member selection filters
+    #: on PUBLISHED slice health (controller/gang.select_healthy_spares).
+    spare_slots: tuple = ()
 
 
 class MultiHostGang:
@@ -258,6 +272,11 @@ class MultiHostGang:
         ]
         self._tmp: Optional[tempfile.TemporaryDirectory] = None
         self.drivers: dict[str, object] = {}
+        #: Per-node TPU plugin drivers (health + slice publication), built
+        #: only when spare_slots asks for the remediation machinery.
+        self.tpu_drivers: dict[str, object] = {}
+        #: Spare node name → the host slot it can replace.
+        self.spare_slot: dict[str, int] = {}
         self.gangs: Optional[GangReservationManager] = None
         self._gang_cp: Optional[CheckpointManager] = None
         self.grant: Optional[object] = None
@@ -271,35 +290,78 @@ class MultiHostGang:
         cfg = self.config
         self._tmp = tempfile.TemporaryDirectory(prefix="tpudra-multihost-")
         base = self._tmp.name
-        for name in self.node_names:
+        self.spare_slot = {
+            f"mh-spare-{slot}": slot for slot in cfg.spare_slots
+        }
+        all_nodes = self.node_names + sorted(self.spare_slot)
+        for name in all_nodes:
             self.kube.create(gvr.NODES, {"metadata": {"name": name}, "spec": {}})
-        # The ComputeDomain object, already Ready on every member node:
-        # the harness plays the controller's status-aggregation role (the
-        # bats suite exercises the real daemon/clique path; this harness
-        # exercises the gang + launch path).
+        # The ComputeDomain object, already Ready on every member AND
+        # spare node (daemons run on spares too — that is what makes them
+        # spares): the harness plays the controller's status-aggregation
+        # role (the bats suite exercises the real daemon/clique path; this
+        # harness exercises the gang + launch path).
         self.kube.create(
             gvr.COMPUTE_DOMAINS,
             make_compute_domain(
                 cfg.domain_name,
                 self.domain_uid,
-                self.node_names,
+                all_nodes,
                 namespace=cfg.namespace,
             ),
             cfg.namespace,
         )
         self.drivers = build_cd_stack(
             self.kube,
-            self.node_names,
+            all_nodes,
             base,
             num_hosts=cfg.num_hosts,
             generation=cfg.generation,
             slice_uuid=f"{cfg.domain_name}-slice",
+            # Members take their own slot; each spare is cabled at the
+            # slot it replaces.
+            host_indices=[
+                self.spare_slot.get(name, i if i < cfg.num_hosts else 0)
+                for i, name in enumerate(all_nodes)
+            ],
         )
+        if self.spare_slot:
+            self._build_tpu_health_drivers(base, all_nodes)
         self._gang_cp = CheckpointManager(os.path.join(base, "controller"))
         self.gangs = GangReservationManager(
             self._gang_cp, DriverGangBinder(self.drivers)
         )
         return self
+
+    def _build_tpu_health_drivers(self, base: str, nodes: list[str]) -> None:
+        """One TPU plugin Driver per node, publishing real ResourceSlices
+        into the shared fake — the published-slice-health substrate the
+        remediation's spare selection reads.  Never start()ed: publication
+        runs inline and health events are delivered straight to the
+        handler (the health loop's body)."""
+        from tpudra.devicelib.mock import MockDeviceLib
+        from tpudra.devicelib.topology import MockTopologyConfig
+        from tpudra.plugin.driver import Driver, DriverConfig
+
+        for i, name in enumerate(nodes):
+            lib = MockDeviceLib(
+                config=MockTopologyConfig(num_chips=4),
+                state_file=os.path.join(base, f"tpu-hw{i}.json"),
+            )
+            driver = Driver(
+                DriverConfig(
+                    node_name=name,
+                    plugin_dir=os.path.join(base, f"tpu-p{i}"),
+                    registry_dir=os.path.join(base, f"tpu-r{i}"),
+                    cdi_root=os.path.join(base, f"tpu-c{i}"),
+                    claim_cache=False,
+                    initial_pool_generation=1,
+                ),
+                self.kube,
+                lib,
+            )
+            driver.publish_resources()
+            self.tpu_drivers[name] = driver
 
     def close(self) -> None:
         self._kill_procs()
@@ -307,6 +369,11 @@ class MultiHostGang:
             self._proxy.stop()
             self._proxy = None
         close_cd_stack(self.drivers)
+        for d in self.tpu_drivers.values():
+            try:
+                d._checkpoints.close()
+            except Exception:  # noqa: BLE001 — teardown must visit every node
+                logger.exception("tpu health driver checkpoint close failed")
         if self._gang_cp is not None:
             try:
                 self._gang_cp.close()
@@ -356,6 +423,104 @@ class MultiHostGang:
     def release(self) -> None:
         self.gangs.release(self.config.domain_name)
         self.grant = None
+
+    # --------------------------------------------------------- remediation
+
+    def fault_chip(self, member_index: int, chip_index: int = 0):
+        """Fault a chip on a bound member's node, through the TPU driver's
+        real health handler: the chip leaves the published ResourceSlices
+        (with the unhealthy-count annotation bumped) and any bound TPU
+        claim holding it gets the status-condition escalation.  Returns
+        the injected HealthEvent."""
+        if not self.tpu_drivers:
+            raise RuntimeError("fault_chip needs spare_slots (health drivers)")
+        from tpudra.devicelib import HealthEvent, HealthEventKind
+
+        node = self.node_names[member_index]
+        driver = self.tpu_drivers[node]
+        event = HealthEvent(
+            kind=HealthEventKind.HBM_ECC_ERROR,
+            chip_uuid=driver._lib.chip_by_index(chip_index).uuid,
+            detail=f"harness fault on {node}",
+        )
+        driver._handle_health_event(event)
+        return event
+
+    def remediate_unhealthy(self):
+        """The controller's remediation-loop role, one pass: find gang
+        members whose nodes' PUBLISHED slices report unhealthy silicon,
+        mark the gang degraded, pick spares (filtered on published slice
+        health, matched by the slot they are cabled at), and run the
+        coordinated remediation.  Returns the new GangStatus; updates the
+        member list `launch()` uses."""
+        from tpudra.controller.gang import (
+            GangMember,
+            published_slice_health,
+            select_healthy_spares,
+        )
+
+        members = self._members or self.members()
+        health = published_slice_health(self.kube)
+        sick = [
+            m for m in members
+            if m.node in health and not health[m.node].healthy
+        ]
+        if not sick:
+            raise RuntimeError("no member node reports unhealthy slices")
+        self.gangs.mark_degraded(
+            self.config.domain_name,
+            [m.claim_uid for m in sick],
+            reason="published-slice-health",
+        )
+        member_nodes = {m.node for m in members}
+        healthy_spares = set(
+            select_healthy_spares(
+                self.kube, sorted(self.spare_slot), exclude=member_nodes
+            )
+        )
+        replacements: dict[str, GangMember] = {}
+        claims: dict[str, dict] = {}
+        for m in sick:
+            slot = members.index(m)
+            spare = next(
+                (
+                    name
+                    for name, s in sorted(self.spare_slot.items())
+                    if s == slot and name in healthy_spares
+                ),
+                None,
+            )
+            if spare is None:
+                raise RuntimeError(
+                    f"no healthy spare cabled at slot {slot} for {m.node}"
+                )
+            replacement = GangMember(
+                node=spare,
+                claim_uid=f"{self.domain_uid}-r{slot}",
+                namespace=self.config.namespace,
+            )
+            replacements[m.claim_uid] = replacement
+        target = [replacements.get(m.claim_uid, m) for m in members]
+        new_uids = {r.claim_uid for r in replacements.values()}
+        for m in target:
+            claims[m.claim_uid] = make_channel_claim(
+                m.claim_uid, m.node, self.domain_uid,
+                namespace=self.config.namespace,
+            )
+            if m.claim_uid in new_uids:
+                # Replacement claims are new API objects; the surviving
+                # members' claims were created at reserve().
+                self.kube.create(
+                    gvr.RESOURCE_CLAIMS,
+                    claims[m.claim_uid],
+                    self.config.namespace,
+                )
+        status = self.gangs.remediate(
+            self.config.domain_name, replacements, claims
+        )
+        self._members = list(status.members)
+        self.grant = status
+        return status
 
     # -------------------------------------------------------------- probes
 
