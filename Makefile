@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang e2e-multihost soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace e2e-multihost soak image helm-render clean
 
 all: native test
 
@@ -44,11 +44,19 @@ test-fast:
 	  --ignore=tests/test_computedomain.py \
 	  --ignore=tests/test_native.py
 
+# Trace propagation gate (docs/tracing.md): a traced mini-bench — gang
+# reservation through real CD drivers + one stand-in rank process per
+# member — asserted to yield a COMPLETE root→rank span tree through
+# tools/trace_report.py.  Seconds of wall time, no jax; part of the
+# tier-1 prerequisite chain so a broken propagation edge fails fast.
+trace-check:
+	env JAX_PLATFORMS=cpu python tools/trace_report.py --self-check
+
 # The exact ROADMAP.md tier-1 verify command (what the PR driver runs),
 # with the lint gate first: an invariant violation fails fast, before ~15
 # minutes of tests.  (The raw pytest command also gates via
 # tests/test_lint.py::test_repo_is_clean.)
-tier1: lint
+tier1: lint trace-check
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -129,6 +137,14 @@ e2e-multihost:
 # drivers; CPU-only.
 bench-gang:
 	set -o pipefail; python bench.py --gang | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Tracing-overhead A/B (docs/tracing.md): the single-claim bind with
+# TPUDRA_TRACE=1 interleaved against disabled, plus the span critical
+# path from the traced arm's log — the ≤5% overhead gate, and the phase
+# attribution future bind-path PRs cite alongside their p50 deltas.
+bench-trace:
+	set -o pipefail; python bench.py --trace-ab | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 # Chaos soak (docs/chaos.md): compound-fault long-run — apiserver latency

@@ -51,6 +51,12 @@ Knobs (for A/B runs on the bind path):
                            p50/p99 by slice size, interleaved
                            bound-vs-rollback arms through real CD plugin
                            drivers
+  --trace-ab               tracing-overhead A/B (`make bench-trace`,
+                           docs/tracing.md): the single-claim bind with
+                           TPUDRA_TRACE=1 interleaved against disabled,
+                           plus the span critical path from the traced
+                           arm's log — overhead measured, attribution
+                           printed
 """
 
 from __future__ import annotations
@@ -305,6 +311,76 @@ def bench_bind_apiserver_ab(
         "cached_batch_p50_ms": round(cached_p50, 3),
         "uncached_batch_p50_ms": round(uncached_p50, 3),
         "improvement_ms": round(uncached_p50 - cached_p50, 3),
+    }
+
+
+def bench_trace_ab(iters: int = None, warmup: int = None) -> dict:
+    """Traced-vs-disabled bind A/B plus the span critical path
+    (docs/tracing.md): the single-claim headline run with arms
+    INTERLEAVED — iteration i traced (TPUDRA_TRACE=1, spans appended to a
+    scratch log), iteration i untraced — so the overhead number is the
+    tracing layer's own cost, not box noise.  The traced arm's log is then
+    fed through tools/trace_report's phase aggregation, so the artifact
+    carries the ATTRIBUTION (mean ms per bind phase along the
+    rpc.NodePrepareResources tree) next to the p50s — future perf PRs cite
+    which phase moved, not just that the p50 did."""
+    iters = ITERS if iters is None else iters
+    warmup = WARMUP if warmup is None else warmup
+    from tests.test_device_state import mk_claim
+    from tpudra import trace
+    from tpudra.kube import gvr
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        from trace_report import phase_means
+    finally:
+        sys.path.pop(0)
+
+    samples: dict[str, list[float]] = {"traced": [], "disabled": []}
+    prev = {
+        k: os.environ.get(k) for k in (trace.ENV_TRACE, trace.ENV_TRACE_LOG)
+    }
+    with tempfile.TemporaryDirectory(prefix="tpudra-trace-ab-") as tmp:
+        log = os.path.join(tmp, "trace.jsonl")
+        try:
+            with _bench_driver() as (kube, client, _driver):
+                for i in range(iters + warmup):
+                    for arm in ("disabled", "traced"):
+                        if arm == "traced":
+                            os.environ[trace.ENV_TRACE] = "1"
+                            os.environ[trace.ENV_TRACE_LOG] = log
+                        else:
+                            os.environ.pop(trace.ENV_TRACE, None)
+                        uid = f"trace-{arm}-{i}"
+                        claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+                        kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                        t0 = time.perf_counter()
+                        resp = client.prepare([claim])
+                        dt = (time.perf_counter() - t0) * 1000.0
+                        if "error" in resp["claims"][uid]:
+                            raise RuntimeError(resp["claims"][uid]["error"])
+                        client.unprepare([claim])
+                        kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+                        if i >= warmup:
+                            samples[arm].append(dt)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            trace.reset_for_tests()
+        phases = phase_means(trace.read_log(log), "rpc.NodePrepareResources")
+    traced_p50 = statistics.median(samples["traced"])
+    disabled_p50 = statistics.median(samples["disabled"])
+    return {
+        "iters": iters,
+        "bind_p50_traced_ms": round(traced_p50, 3),
+        "bind_p50_disabled_ms": round(disabled_p50, 3),
+        "overhead_pct": round(
+            100.0 * (traced_p50 - disabled_p50) / disabled_p50, 1
+        ),
+        "critical_path": phases,
     }
 
 
@@ -1781,6 +1857,18 @@ def main(argv=None) -> None:
         line = {
             "metric": "checkpoint_churn",
             **bench_checkpoint_churn(iters=iters),
+        }
+        print(json.dumps(line))
+        return
+
+    if "--trace-ab" in argv:
+        # The tracing-overhead artifact (`make bench-trace`,
+        # docs/tracing.md): traced-vs-disabled bind p50 interleaved, plus
+        # the span critical path — the ≤5% overhead gate and the phase
+        # attribution future perf PRs cite.
+        line = {
+            "metric": "trace_overhead",
+            **bench_trace_ab(iters=iters, warmup=warmup),
         }
         print(json.dumps(line))
         return

@@ -1,0 +1,35 @@
+"""SPAN-HYGIENE fixtures: computed span names and orphaned manual starts."""
+
+from tpudra import trace
+from tpudra.trace import start_span
+
+PHASE = "rmw-begin"
+
+
+def computed_name():
+    with trace.start_span("bind." + PHASE):  # EXPECT: SPAN-HYGIENE
+        pass
+
+
+def fstring_name(uid):
+    with trace.start_span(f"bind-{uid}"):  # EXPECT: SPAN-HYGIENE
+        pass
+
+
+def keyword_name():
+    with trace.start_span(name=PHASE):  # EXPECT: SPAN-HYGIENE
+        pass
+
+
+def orphaned_start():
+    span = trace.start_span("bind.orphan")  # EXPECT: SPAN-HYGIENE
+    span.set_attr("claim", "uid-1")
+
+
+def orphaned_bare_import():
+    return start_span("bind.returned")  # EXPECT: SPAN-HYGIENE
+
+
+def both_violations():
+    span = start_span(PHASE)  # EXPECT: SPAN-HYGIENE, SPAN-HYGIENE
+    return span

@@ -1,0 +1,25 @@
+"""SPAN-HYGIENE compliant idioms: literal names, with-statement usage."""
+
+from tpudra import trace
+from tpudra.trace import start_span
+
+
+def literal_with(uid):
+    # The variable part belongs in attrs, not the name.
+    with trace.start_span("bind.example", attrs={"claim": uid}) as span:
+        span.set_attr("phase", "effects")
+
+
+def stacked_items():
+    with trace.start_span("bind.outer"), trace.start_span("bind.inner"):
+        pass
+
+
+def bare_import_with():
+    with start_span("bind.bare", parent=None):
+        pass
+
+
+def retro_record_is_exempt(t0, dur):
+    # record_span has no open/close window to leak — not start_span's rule.
+    trace.record_span("checkpoint.commit", t0, dur, attrs={"led": True})

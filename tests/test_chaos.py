@@ -239,10 +239,17 @@ class TestMiniSoak:
         failures = [f for f in failures if "never injected" not in f]
         assert failures == [], failures
 
-    def test_planted_leak_is_caught_and_replayable(self, tmp_path):
+    def test_planted_leak_is_caught_and_replayable(self, tmp_path, monkeypatch):
         """Plant a CDI spec with no checkpoint record: the monitor must
         flag it once its sim-age passes the leak grace, and the violation
-        must carry the seed + fault timeline for replay."""
+        must carry the seed + fault timeline for replay PLUS the trace
+        flight recorder's recent spans (the causal middle: what the
+        system was doing when the invariant broke)."""
+        from tpudra import trace
+
+        monkeypatch.setenv(trace.ENV_TRACE, "1")
+        monkeypatch.setenv(trace.ENV_TRACE_LOG, str(tmp_path / "soak.jsonl"))
+        trace.reset_for_tests()
         config = _mini_config(
             tmp_path,
             wall_s=4.0,
@@ -255,13 +262,22 @@ class TestMiniSoak:
         os.makedirs(cdi_dir, exist_ok=True)
         with open(os.path.join(cdi_dir, "tpu.google.com-leaked-uid.json"), "w") as f:
             f.write("{}")
-        report = soak.run()
+        try:
+            report = soak.run()
+        finally:
+            trace.reset_for_tests()
         leaks = [
             v for v in report["violations"] if v["invariant"] == "cdi-leak"
         ]
         assert leaks, report["invariants"]
         assert leaks[0]["replay"]["seed"] == config.seed
         assert "timeline" in leaks[0]["replay"]
+        # The flight-recorder dump rides the violation: recent spans from
+        # the sim's live binds (plugin.prepare etc.), newest first.
+        spans = leaks[0]["spans"]
+        assert isinstance(spans, list) and spans, "violation carried no spans"
+        assert any(s["name"] == "plugin.prepare" for s in spans)
+        assert report["config"]["trace"] is True
         assert report["slo"]["invariant_violations"]["ok"] is False
         failures = assert_slo(report, min_sim_hours=0.0, min_faults=0)
         assert any("invariant_violations" in f for f in failures)

@@ -262,6 +262,72 @@ class TestDebugSurface:
         finally:
             ep.stop()
 
+    def test_debug_traces_serves_flight_recorder(
+        self, tmp_path, monkeypatch
+    ):
+        """/debug/traces: the trace flight recorder's recent spans as
+        JSON, newest-first and bounded — alongside /metrics and
+        /debug/stacks on both the plugin healthcheck listener and the
+        standalone endpoint."""
+        import json
+
+        from tpudra import trace
+
+        monkeypatch.setenv(trace.ENV_TRACE, "1")
+        monkeypatch.setenv(trace.ENV_TRACE_LOG, str(tmp_path / "t.jsonl"))
+        trace.reset_for_tests()
+        try:
+            for i in range(3):
+                with trace.start_span("debug.sample", attrs={"i": i}):
+                    pass
+            ep = metrics.DebugEndpoint()
+            ep.start()
+            try:
+                status, body = fetch(ep.port, "/debug/traces")
+            finally:
+                ep.stop()
+            assert status == 200
+            payload = json.loads(body)
+            assert payload["enabled"] is True
+            names = [s["name"] for s in payload["spans"]]
+            assert names.count("debug.sample") == 3
+            samples = [
+                s for s in payload["spans"] if s["name"] == "debug.sample"
+            ]
+            assert [s["attrs"]["i"] for s in samples] == [2, 1, 0]  # newest first
+            assert len(payload["spans"]) <= 256  # bounded
+
+            # The plugin healthcheck listener mounts the same route.
+            d = mk_driver(tmp_path / "plugin")
+            d.start()
+            hc = Healthcheck(d.sockets)
+            hc.start()
+            try:
+                status, body = fetch(hc.port, "/debug/traces")
+                assert status == 200 and json.loads(body)["enabled"] is True
+            finally:
+                hc.stop()
+                d.stop()
+        finally:
+            trace.reset_for_tests()
+
+    def test_debug_traces_disabled_is_empty(self):
+        import json
+
+        from tpudra import trace
+
+        trace.reset_for_tests()
+        ep = metrics.DebugEndpoint()
+        ep.start()
+        try:
+            status, body = fetch(ep.port, "/debug/traces")
+        finally:
+            ep.stop()
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["enabled"] is False
+        assert payload["spans"] == []
+
     def test_sigusr1_dump_does_not_kill_process(self):
         metrics.install_debug_handlers()
         os.kill(os.getpid(), signal.SIGUSR1)  # faulthandler writes to stderr

@@ -41,6 +41,68 @@ def test_two_node_gang():
         assert "RESULT gang-psum: 96.0" in rank["tail"], rank
 
 
+def test_traced_gang_yields_one_root_to_rank_trace(tmp_path, monkeypatch):
+    """ISSUE 11 acceptance: the multihost e2e with tracing enabled yields
+    ONE trace — controller gang-reserve root span → N per-member bind
+    spans (each with checkpoint/CDI child phases) → per-rank worker spans
+    joined via the grant env alone — and tools/trace_report.py renders
+    its timeline and critical path."""
+    import os
+    import sys
+
+    from tpudra import trace
+
+    log = str(tmp_path / "e2e-trace.jsonl")
+    monkeypatch.setenv(trace.ENV_TRACE, "1")
+    monkeypatch.setenv(trace.ENV_TRACE_LOG, log)
+    trace.reset_for_tests()
+    try:
+        out = multihost.run_e2e(num_hosts=2, deadline_s=120.0)
+        assert out["ok"], out
+        trace.flush()
+    finally:
+        trace.reset_for_tests()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    spans = trace.read_log(log)
+    traces = trace_report.build_traces(spans)
+    gang_traces = [
+        t for t in traces.values()
+        if any(r["name"] == "gang.reserve" for r in t["roots"])
+    ]
+    assert len(gang_traces) == 1, "expected ONE gang-reserve-rooted trace"
+    (t,) = gang_traces
+    root = next(r for r in t["roots"] if r["name"] == "gang.reserve")
+    binds = [
+        s for s in trace_report.descendants(root, t["children"])
+        if s["name"] == "gang.bind-member"
+    ]
+    assert len(binds) == 2
+    for bind in binds:
+        names = {
+            s["name"] for s in trace_report.descendants(bind, t["children"])
+        }
+        assert {"plugin.prepare", "checkpoint.commit", "bind.cdi-write"} <= names
+    ranks = [s for s in t["spans"].values() if s["name"] == "rank.worker"]
+    assert len(ranks) == 2
+    pids = {s["pid"] for s in ranks}
+    assert len(pids) == 2 and root["pid"] not in pids  # real rank processes
+    for rank in ranks:
+        chain = trace_report._ancestor_chain(rank, t["spans"])
+        assert "gang.bind-member" in chain and "gang.reserve" in chain
+
+    # trace_report renders the timeline + critical path for this trace.
+    text = trace_report.report(log, trace_id=root["trace"])
+    assert "gang.reserve" in text
+    assert "rank.worker" in text
+    assert "critical path" in text
+
+
 def test_kill_one_rank_rolls_back_to_zero_bound():
     """ISSUE 9 acceptance: the kill-one-rank case rolls back to zero
     bound claims (and zero CDI spec leaks) on every node."""

@@ -1,0 +1,425 @@
+"""End-to-end claim tracing (tpudra/trace.py): span mechanics, the
+disabled zero-allocation fast path, the flight recorder, and every
+propagation edge the driver owns — gRPC metadata across the kubelet
+boundary, the WAL traceparent across a crash, and the grant env across
+the rank process boundary."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpudra import trace
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def traced(tmp_path, monkeypatch):
+    """Arm tracing into a per-test log; reset the module's sink/ring on
+    both sides so tests never share a file or a flight recorder."""
+    log = str(tmp_path / "trace.jsonl")
+    monkeypatch.setenv(trace.ENV_TRACE, "1")
+    monkeypatch.setenv(trace.ENV_TRACE_LOG, log)
+    trace.reset_for_tests()
+    yield log
+    trace.reset_for_tests()
+
+
+def read(log: str) -> list:
+    trace.flush()
+    return trace.read_log(log)
+
+
+def by_name(spans: list, name: str) -> list:
+    return [s for s in spans if s["name"] == name]
+
+
+# ----------------------------------------------------------- span mechanics
+
+
+class TestSpanMechanics:
+    def test_nesting_parents_and_jsonl(self, traced):
+        with trace.start_span("t.root", attrs={"k": 1}) as root:
+            with trace.start_span("t.child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+        spans = read(traced)
+        (r,) = by_name(spans, "t.root")
+        (c,) = by_name(spans, "t.child")
+        assert c["parent"] == r["span"]
+        assert c["trace"] == r["trace"]
+        assert r["parent"] == ""
+        assert r["attrs"] == {"k": 1}
+        assert r["dur_ms"] >= c["dur_ms"] >= 0
+        assert r["pid"] == os.getpid()
+
+    def test_exception_recorded_and_propagated(self, traced):
+        with pytest.raises(ValueError):
+            with trace.start_span("t.boom"):
+                raise ValueError("payload")
+        (s,) = by_name(read(traced), "t.boom")
+        assert "ValueError: payload" in s["error"]
+
+    def test_traceparent_roundtrip_and_malformed(self):
+        trace_id, span_id = "ab" * 16, "cd" * 8
+        tp = trace.format_traceparent(trace_id, span_id)
+        assert trace.parse_traceparent(tp) == (trace_id, span_id)
+        for bad in (
+            "", None, "00-short-cd-01", "garbage",
+            "00-" + "zz" * 16 + "-" + "cd" * 8 + "-01",
+            "00-" + "ab" * 16 + "-" + "cd" * 8,
+        ):
+            assert trace.parse_traceparent(bad) is None
+
+    def test_explicit_parent_adopts_remote_trace(self, traced):
+        remote = trace.format_traceparent("12" * 16, "34" * 8)
+        with trace.start_span("t.adopted", parent=remote):
+            pass
+        (s,) = by_name(read(traced), "t.adopted")
+        assert s["trace"] == "12" * 16
+        assert s["parent"] == "34" * 8
+
+    def test_garbled_parent_degrades_to_fresh_trace(self, traced):
+        with trace.start_span("t.fresh", parent="not-a-traceparent"):
+            pass
+        (s,) = by_name(read(traced), "t.fresh")
+        assert s["parent"] == ""
+        assert len(s["trace"]) == 32
+
+    def test_record_span_parents_on_active_span(self, traced):
+        with trace.start_span("t.op") as op:
+            trace.record_span("t.retro", time.time(), 0.001, attrs={"n": 2})
+        spans = read(traced)
+        (retro,) = by_name(spans, "t.retro")
+        assert retro["parent"] == op.span_id
+        assert retro["trace"] == op.trace_id
+        assert retro["attrs"] == {"n": 2}
+
+    def test_current_traceparent_inside_and_outside(self, traced):
+        assert trace.current_traceparent() == ""
+        with trace.start_span("t.active") as s:
+            assert trace.current_traceparent() == s.traceparent
+        assert trace.current_traceparent() == ""
+
+
+class TestDisabledFastPath:
+    def test_shared_noop_no_allocation_no_file(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(trace.ENV_TRACE, raising=False)
+        log = tmp_path / "never.jsonl"
+        monkeypatch.setenv(trace.ENV_TRACE_LOG, str(log))
+        trace.reset_for_tests()
+        # ONE shared object: the disabled path allocates nothing per call.
+        a = trace.start_span("t.a")
+        b = trace.start_span("t.b", attrs={"x": 1})
+        assert a is b is trace.NOOP_SPAN
+        with a as s:
+            s.set_attr("ignored", True)
+            assert s.traceparent == ""
+            with trace.start_span("t.nested"):
+                pass
+        trace.record_span("t.retro", time.time(), 0.1)
+        assert trace.current_traceparent() == ""
+        assert not log.exists()
+        assert trace.recent_spans() == []
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_newest_first(self, traced, monkeypatch):
+        monkeypatch.setenv(trace.ENV_TRACE_RING, "4")
+        trace.reset_for_tests()  # ring size is read at first record
+        for i in range(7):
+            with trace.start_span("t.ring", attrs={"i": i}):
+                pass
+        recent = trace.recent_spans()
+        assert len(recent) == 4
+        assert [s["attrs"]["i"] for s in recent] == [6, 5, 4, 3]
+        assert trace.recent_spans(2) == recent[:2]
+
+    def test_unwritable_log_drops_spans_never_raises(
+        self, tmp_path, monkeypatch, caplog
+    ):
+        """The observability layer must never take down the bind path: a
+        trace log pointing at a missing directory drops batches with one
+        warning, and the in-memory ring keeps recording."""
+        monkeypatch.setenv(trace.ENV_TRACE, "1")
+        monkeypatch.setenv(
+            trace.ENV_TRACE_LOG, str(tmp_path / "no-such-dir" / "t.jsonl")
+        )
+        trace.reset_for_tests()
+        try:
+            with trace.start_span("t.dropped"):
+                pass
+            trace.flush()  # forces a write attempt — must not raise
+            assert [s["name"] for s in trace.recent_spans()] == ["t.dropped"]
+        finally:
+            trace.reset_for_tests()
+
+    def test_non_json_attr_degrades_to_repr(self, traced):
+        """A set (or any non-JSON value) in span attrs must not poison
+        the batch or escape into the traced bind path — it serializes as
+        its repr and every other record survives."""
+        with trace.start_span("t.bad-attr") as s:
+            s.set_attr("nodes", {"n1"})
+        with trace.start_span("t.good"):
+            pass
+        spans = read(traced)
+        assert {s["name"] for s in spans} == {"t.bad-attr", "t.good"}
+        (bad,) = by_name(spans, "t.bad-attr")
+        assert bad["attrs"]["nodes"] == repr({"n1"})
+
+    def test_torn_log_line_is_skipped(self, traced):
+        with trace.start_span("t.keep"):
+            pass
+        trace.flush()
+        with open(traced, "a") as f:
+            f.write('{"t": "span", "trace": "x", "span"')  # torn tail
+        spans = trace.read_log(traced)
+        assert [s["name"] for s in spans] == ["t.keep"]
+
+
+# ------------------------------------------------------- propagation edges
+
+
+class TestGrpcPropagation:
+    def test_metadata_roundtrip_through_real_sockets(self, traced, tmp_path):
+        """Client span → gRPC metadata → server rpc span → plugin spans:
+        ONE trace across the kubelet wire boundary, with the client-side
+        span as the RPC span's parent."""
+        from tests.test_device_state import mk_claim
+        from tests.test_driver import mk_driver
+        from tpudra.plugin.grpcserver import DRAClient
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path / "plugin", kube)
+        d.start()
+        client = DRAClient(d.sockets.dra_socket_path)
+        try:
+            claim = mk_claim("tr-1", ["tpu-0"], name="tr-1")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            with trace.start_span("test.kubelet") as kubelet_span:
+                resp = client.prepare([claim])
+                assert "error" not in resp["claims"]["tr-1"]
+                client.unprepare([claim])
+        finally:
+            client.close()
+            d.stop()
+        spans = read(traced)
+        (rpc,) = by_name(spans, "rpc.NodePrepareResources")
+        assert rpc["trace"] == kubelet_span.trace_id
+        assert rpc["parent"] == kubelet_span.span_id
+        # The plugin's phase spans chain under the RPC span in-process.
+        (prep,) = by_name(spans, "plugin.prepare")
+        assert prep["trace"] == kubelet_span.trace_id
+        assert prep["parent"] == rpc["span"]
+        phase_names = {
+            s["name"] for s in spans if s["trace"] == kubelet_span.trace_id
+        }
+        assert {
+            "bind.rmw-begin", "bind.effects", "bind.rmw-finish",
+            "bind.cdi-write", "checkpoint.commit", "checkpoint.fsync",
+        } <= phase_names
+
+    def test_untraced_client_sends_no_metadata(self, tmp_path, monkeypatch):
+        """Disabled tracing: no metadata key on the wire, no spans, and
+        the RPC still works — the production-default path."""
+        monkeypatch.delenv(trace.ENV_TRACE, raising=False)
+        trace.reset_for_tests()
+        from tests.test_device_state import mk_claim
+        from tests.test_driver import mk_driver
+        from tpudra.plugin.grpcserver import DRAClient
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path / "plugin", kube)
+        d.start()
+        client = DRAClient(d.sockets.dra_socket_path)
+        try:
+            claim = mk_claim("tr-2", ["tpu-0"], name="tr-2")
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            resp = client.prepare([claim])
+            assert "error" not in resp["claims"]["tr-2"]
+            client.unprepare([claim])
+        finally:
+            client.close()
+            d.stop()
+        assert trace.recent_spans() == []
+
+
+class TestWalPropagation:
+    def test_claim_record_journals_traceparent(self, traced, tmp_path):
+        """The WAL edge, plugin side: a traced bind journals its
+        traceparent on the claim record; an untraced bind journals None
+        (byte-identical checkpoints to pre-trace drivers)."""
+        from tests.test_device_state import mk_claim
+        from tests.test_driver import mk_driver
+
+        kube = FakeKube()
+        d = mk_driver(tmp_path / "plugin", kube)
+        claim = mk_claim("tp-1", ["tpu-0"], name="tp-1")
+        with trace.start_span("test.bind") as s:
+            d.prepare_resource_claims([claim])
+        rec = d.state._cp.read().prepared_claims["tp-1"]
+        parsed = trace.parse_traceparent(rec.traceparent)
+        assert parsed is not None and parsed[0] == s.trace_id
+        d.unprepare_resource_claims([{"uid": "tp-1"}])
+        # Untraced arm: the field stays None (serde drops it entirely).
+        os.environ.pop(trace.ENV_TRACE, None)
+        claim2 = mk_claim("tp-2", ["tpu-1"], name="tp-2")
+        d.prepare_resource_claims([claim2])
+        assert d.state._cp.read().prepared_claims["tp-2"].traceparent is None
+        d._checkpoints.close()
+
+    def test_gang_recovery_resumes_original_trace(self, traced, tmp_path):
+        """The WAL edge across a CRASH (riding the existing
+        mid-gang-reserve sweep point): a fresh manager's recover() emits
+        its spans into the trace journaled at reserve time."""
+        from tests.test_gang import (
+            RecordingBinder,
+            mk_claims,
+            mk_members,
+        )
+        from tpudra.controller.gang import GangReservationManager
+        from tpudra.plugin import checkpoint as checkpoint_mod
+        from tpudra.plugin.checkpoint import CheckpointManager, SimulatedCrash
+
+        members = mk_members(3)
+        claims = mk_claims(members)
+        binder = RecordingBinder()
+        cp = CheckpointManager(str(tmp_path / "gangs"))
+        mgr = GangReservationManager(cp, binder)
+        with trace.start_span("test.reserve") as reserve_span:
+            with checkpoint_mod.armed_crash("mid-gang-reserve"):
+                with pytest.raises(SimulatedCrash):
+                    mgr.reserve("tg", members, claims)
+        cp.abandon()
+        assert binder.bound  # the partial gang the crash left
+
+        cp2 = CheckpointManager(str(tmp_path / "gangs"))
+        mgr2 = GangReservationManager(cp2, binder)
+        rec = mgr2.gangs()["tg"]
+        assert trace.parse_traceparent(rec.traceparent) is not None
+        assert rec.traceparent.split("-")[1] == reserve_span.trace_id
+        assert mgr2.recover() == ["tg"]
+        assert not binder.bound
+        cp2.close()
+        spans = read(traced)
+        (recover,) = by_name(spans, "gang.recover")
+        # The recovery span landed in the ORIGINAL reserve trace.
+        assert recover["trace"] == reserve_span.trace_id
+        assert recover["attrs"]["gang"] == "tg"
+
+
+class TestGrantEnvPropagation:
+    def test_rank_process_emits_child_span_from_grant_env(
+        self, traced, tmp_path
+    ):
+        """The process-boundary edge: a stand-in rank, handed ONLY the
+        claim's CDI grant env, emits a span that chains into the bind's
+        trace in the shared log."""
+        from tests.test_gang import _cd_stack, _gang_inputs
+        from tpudra.controller.gang import GangReservationManager
+        from tpudra.plugin.checkpoint import CheckpointManager
+        from tpudra.sim.multihost import DriverGangBinder
+
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            # The rank stand-in and grant-env parsing are trace_report's
+            # (the make trace-check body) — one copy of the contract.
+            from trace_report import _RANK_SNIPPET, _grant_env
+        finally:
+            sys.path.pop(0)
+
+        kube, nodes, drivers = _cd_stack(tmp_path, n=2)
+        members, claims = _gang_inputs(kube, nodes)
+        cp = CheckpointManager(str(tmp_path / "gangs"))
+        mgr = GangReservationManager(cp, DriverGangBinder(drivers))
+        mgr.reserve("tg-env", members, claims)
+        member = members[0]
+        env = _grant_env(drivers[member.node], member.claim_uid)
+        tp = env[trace.TRACEPARENT_ENV]
+        assert trace.parse_traceparent(tp) is not None
+        proc = subprocess.run(
+            [sys.executable, "-c", _RANK_SNIPPET],
+            env={
+                trace.ENV_TRACE: "1",
+                trace.ENV_TRACE_LOG: traced,
+                trace.TRACEPARENT_ENV: tp,
+                "PYTHONPATH": REPO,
+                "PATH": os.environ.get("PATH", ""),
+            },
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        mgr.release("tg-env")
+        cp.close()
+        for d in drivers.values():
+            d._checkpoints.close()
+        spans = read(traced)
+        (rank,) = by_name(spans, "rank.worker")
+        (reserve,) = by_name(spans, "gang.reserve")
+        assert rank["trace"] == reserve["trace"]
+        assert rank["pid"] != reserve["pid"]
+        # The rank's parent is a span of the member bind's subtree.
+        binds = by_name(spans, "gang.bind-member")
+        spans_by_id = {s["span"]: s for s in spans}
+        node = spans_by_id[rank["parent"]]
+        while node["name"] != "gang.bind-member":
+            node = spans_by_id[node["parent"]]
+        assert node["span"] in {b["span"] for b in binds}
+
+    def test_claimenv_parses_traceparent(self):
+        from tpudra.workload.envspec import ClaimEnv
+
+        env = ClaimEnv.from_environ({"TPUDRA_TRACEPARENT": "00-x-y-01"})
+        assert env.traceparent == "00-x-y-01"
+        assert ClaimEnv.from_environ({}).traceparent == ""
+
+
+# ------------------------------------------------------------ trace_report
+
+
+class TestTraceReport:
+    def _report_mod(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import trace_report
+
+            return trace_report
+        finally:
+            sys.path.pop(0)
+
+    def test_critical_path_picks_latest_ending_chain(self, traced):
+        tr = self._report_mod()
+        with trace.start_span("t.root"):
+            with trace.start_span("t.fast"):
+                pass
+            with trace.start_span("t.slow"):
+                time.sleep(0.02)
+        traces = tr.build_traces(read(traced))
+        (t,) = traces.values()
+        (root,) = t["roots"]
+        path = [s["name"] for s in tr.critical_path(root, t["children"])]
+        assert path == ["t.root", "t.slow"]
+        summary = tr.critical_path_summary(root, t["children"])
+        assert summary[0]["pct"] == 100.0
+
+    def test_report_renders_and_phase_means(self, traced):
+        tr = self._report_mod()
+        with trace.start_span("t.root"):
+            with trace.start_span("t.phase"):
+                pass
+        trace.flush()  # same-process reader (the flush-cadence contract)
+        text = tr.report(traced)
+        assert "t.root" in text and "critical path" in text
+        means = tr.phase_means(read(traced), "t.root")
+        assert set(means) == {"t.root", "t.phase"}
+        assert means["t.phase"]["n"] == 1

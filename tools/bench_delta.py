@@ -87,8 +87,11 @@ def main() -> int:
     gang = current_headline(sys.argv[1], metric="gang_bind")
     if gang is not None:
         print_gang_section(gang)
+    trace_ab = current_headline(sys.argv[1], metric="trace_overhead")
+    if trace_ab is not None:
+        print_trace_section(trace_ab)
     if now is None:
-        if churn is None and cluster is None and gang is None:
+        if churn is None and cluster is None and gang is None and trace_ab is None:
             print("bench-delta: no headline line in this run's output")
         return 0
     prior = prior_headline()
@@ -131,6 +134,31 @@ def print_apiserver_section(now: dict) -> None:
         f"({ab.get('improvement_ms', round(uncached - cached, 3))} ms "
         f"left the hot path; ~{n} serialized GET RTTs = {n * rtt:g} ms)"
     )
+
+
+def print_trace_section(ab: dict) -> None:
+    """The `--trace-ab` artifact (make bench-trace, docs/tracing.md):
+    tracing overhead (interleaved arms, within-run by design) plus the
+    span CRITICAL PATH — so a bind-path PR cites which phase moved, not
+    just that the p50 did."""
+    traced = ab.get("bind_p50_traced_ms")
+    disabled = ab.get("bind_p50_disabled_ms")
+    if traced is None or disabled is None:
+        return
+    print(
+        f"bench-delta: tracing overhead: bind p50 {disabled} ms disabled "
+        f"vs {traced} ms traced ({ab.get('overhead_pct')}% — budget ≤5%)"
+    )
+    phases = ab.get("critical_path")
+    if isinstance(phases, dict) and phases:
+        print("bench-delta: traced-bind phase attribution (mean ms/span):")
+        for name, entry in sorted(
+            phases.items(), key=lambda kv: -kv[1].get("mean_ms", 0.0)
+        ):
+            print(
+                f"bench-delta:   {name:<28} {entry.get('mean_ms'):>8} ms "
+                f"(n={entry.get('n')})"
+            )
 
 
 def print_gang_section(gang: dict) -> None:

@@ -1,0 +1,436 @@
+"""Reconstruct claim/gang timelines and critical paths from a tpudra trace log.
+
+Usage:
+    python tools/trace_report.py <trace.jsonl> [--trace ID] [--limit N] [--json]
+    python tools/trace_report.py --self-check
+
+The log is what ``TPUDRA_TRACE=1`` runs append (tpudra/trace.py): one JSON
+span per line, possibly from several processes (controller, plugin
+threads, worker ranks) sharing one file.  The report groups spans into
+traces, renders each trace as an indented timeline (start offset,
+duration, name, pid, key attrs), and prints the CRITICAL PATH — at each
+node, the child whose end determines the parent's completion — so a 67 ms
+gang bind decomposes into "which phase of which member on which node"
+instead of a p50 delta.
+
+``--self-check`` is the ``make trace-check`` body: it runs a traced
+mini-bench — a 2-node gang reservation through REAL CD plugin drivers,
+plus one subprocess per member standing in for a worker rank (it emits a
+``rank.worker`` span parented ONLY on the grant env's
+``TPUDRA_TRACEPARENT``) — then asserts this module parses the log into a
+complete root→rank span tree: ``gang.reserve`` root, one
+``gang.bind-member`` per member, checkpoint + CDI child phases under each
+bind, and a rank span that chains to its member across the process
+boundary.  It exercises every propagation edge we own except gRPC
+metadata (covered by tests/test_trace.py) in a few seconds, with no jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tpudra import trace  # noqa: E402
+
+
+# ------------------------------------------------------------------- model
+
+
+def build_traces(spans: list) -> dict:
+    """Group span records by trace id: {trace_id: {"spans": {span_id:
+    rec}, "children": {span_id: [rec]}, "roots": [rec]}}.  A span whose
+    parent is absent from the log (a torn line, a foreign parent) is
+    treated as a root — the report degrades, never crashes."""
+    traces: dict = {}
+    for rec in spans:
+        t = traces.setdefault(
+            rec["trace"], {"spans": {}, "children": {}, "roots": []}
+        )
+        t["spans"][rec["span"]] = rec
+    for t in traces.values():
+        for rec in t["spans"].values():
+            parent = rec.get("parent") or ""
+            if parent and parent in t["spans"]:
+                t["children"].setdefault(parent, []).append(rec)
+            else:
+                t["roots"].append(rec)
+        for kids in t["children"].values():
+            kids.sort(key=lambda r: r.get("start", 0.0))
+        t["roots"].sort(key=lambda r: r.get("start", 0.0))
+    return traces
+
+
+def _end(rec: dict) -> float:
+    return rec.get("start", 0.0) + rec.get("dur_ms", 0.0) / 1000.0
+
+
+def critical_path(root: dict, children: dict) -> list:
+    """Root-to-leaf chain where each hop is the child whose END time
+    determines its parent's completion — the span sequence a perf PR must
+    shorten to move the parent's latency."""
+    path = [root]
+    node = root
+    while True:
+        kids = children.get(node["span"], [])
+        if not kids:
+            return path
+        node = max(kids, key=_end)
+        path.append(node)
+
+
+def critical_path_summary(root: dict, children: dict) -> list:
+    """[{name, dur_ms, pct, pid, attrs}] along the critical path, pct
+    relative to the root's duration."""
+    total = max(root.get("dur_ms", 0.0), 1e-9)
+    out = []
+    for rec in critical_path(root, children):
+        out.append(
+            {
+                "name": rec["name"],
+                "dur_ms": rec.get("dur_ms", 0.0),
+                "pct": round(100.0 * rec.get("dur_ms", 0.0) / total, 1),
+                "pid": rec.get("pid"),
+                "attrs": rec.get("attrs", {}),
+            }
+        )
+    return out
+
+
+def descendants(rec: dict, children: dict) -> list:
+    out = []
+    stack = [rec]
+    while stack:
+        node = stack.pop()
+        for kid in children.get(node["span"], []):
+            out.append(kid)
+            stack.append(kid)
+    return out
+
+
+def _ancestor_chain(rec: dict, spans: dict) -> list:
+    """Parent chain from ``rec`` to its root (names), following parent
+    span ids within one trace."""
+    chain = []
+    node = rec
+    seen = set()
+    while True:
+        parent = node.get("parent") or ""
+        if not parent or parent not in spans or parent in seen:
+            return chain
+        seen.add(parent)
+        node = spans[parent]
+        chain.append(node["name"])
+
+
+def phase_means(spans: list, root_name: str) -> dict:
+    """Mean duration (ms) per span name across every trace rooted at
+    ``root_name`` — the attribution table bench prints next to its p50s
+    (how the bind p50 decomposes into phases, not just that it moved)."""
+    traces = build_traces(spans)
+    sums: dict = {}
+    counts: dict = {}
+    for t in traces.values():
+        for root in t["roots"]:
+            if root["name"] != root_name:
+                continue
+            for rec in [root] + descendants(root, t["children"]):
+                sums[rec["name"]] = sums.get(rec["name"], 0.0) + rec.get(
+                    "dur_ms", 0.0
+                )
+                counts[rec["name"]] = counts.get(rec["name"], 0) + 1
+    return {
+        name: {"mean_ms": round(sums[name] / counts[name], 3), "n": counts[name]}
+        for name in sums
+    }
+
+
+# ------------------------------------------------------------------ render
+
+
+def _render_span(rec: dict, t0: float, depth: int) -> str:
+    offset_ms = (rec.get("start", 0.0) - t0) * 1000.0
+    attrs = rec.get("attrs", {})
+    attr_str = (
+        " " + " ".join(f"{k}={attrs[k]}" for k in sorted(attrs)) if attrs else ""
+    )
+    err = f" ERROR({rec['error']})" if rec.get("error") else ""
+    return (
+        f"{offset_ms:9.1f}ms {'  ' * depth}{rec['name']} "
+        f"[{rec.get('dur_ms', 0.0):.2f}ms pid={rec.get('pid')}]"
+        f"{attr_str}{err}"
+    )
+
+
+def render_trace(trace_id: str, t: dict) -> str:
+    lines = [f"trace {trace_id} ({len(t['spans'])} spans)"]
+    if not t["roots"]:
+        return lines[0] + "\n  (no roots)"
+    t0 = t["roots"][0].get("start", 0.0)
+
+    def walk(rec: dict, depth: int) -> None:
+        lines.append(_render_span(rec, t0, depth))
+        for kid in t["children"].get(rec["span"], []):
+            walk(kid, depth + 1)
+
+    for root in t["roots"]:
+        walk(root, 0)
+        lines.append("  critical path:")
+        for hop in critical_path_summary(root, t["children"]):
+            lines.append(
+                f"    {hop['name']:<28} {hop['dur_ms']:9.2f}ms "
+                f"{hop['pct']:5.1f}% pid={hop['pid']}"
+            )
+    return "\n".join(lines)
+
+
+def report(path: str, trace_id: str = None, limit: int = 16) -> str:
+    spans = trace.read_log(path)
+    if not spans:
+        return f"trace-report: no spans in {path}"
+    traces = build_traces(spans)
+    if trace_id is not None:
+        traces = {k: v for k, v in traces.items() if k.startswith(trace_id)}
+        if not traces:
+            return f"trace-report: no trace matching {trace_id!r}"
+    # Largest traces first: the gang/batch timelines an investigation
+    # wants outrank single-mutate noise traces.
+    ordered = sorted(
+        traces.items(), key=lambda kv: len(kv[1]["spans"]), reverse=True
+    )
+    shown = ordered[: max(1, limit)]
+    out = [render_trace(tid, t) for tid, t in shown]
+    if len(ordered) > len(shown):
+        out.append(
+            f"... {len(ordered) - len(shown)} smaller trace(s) omitted "
+            "(--limit raises the cap)"
+        )
+    return "\n\n".join(out)
+
+
+# -------------------------------------------------------------- self-check
+
+#: What a complete root→rank tree must contain (the make trace-check gate).
+_RANK_SNIPPET = """\
+import os
+from tpudra import trace
+
+with trace.start_span(
+    "rank.worker",
+    parent=os.environ.get(trace.TRACEPARENT_ENV) or None,
+    attrs={"rank": int(os.environ.get("TRACE_CHECK_RANK", "0"))},
+):
+    pass
+"""
+
+
+def _grant_env(driver, claim_uid: str) -> dict:
+    """The env a container consuming this claim would see (the CDI spec's
+    claim-wide env — sim/multihost.MultiHostGang._grant_env without the
+    mount rewrite, which the rank stand-in does not need)."""
+    spec = driver.state._cdi.read_claim_spec(claim_uid)
+    if spec is None:
+        raise RuntimeError(f"no CDI spec for {claim_uid}")
+    env = {}
+    for kv in spec.get("containerEdits", {}).get("env", []):
+        k, _, v = kv.partition("=")
+        env[k] = v
+    return env
+
+
+def self_check() -> int:
+    """Traced mini-bench + tree assertions; 0 on a complete root→rank tree."""
+    from tpudra.controller.gang import GangMember, GangReservationManager
+    from tpudra.kube import gvr
+    from tpudra.kube.fake import FakeKube
+    from tpudra.plugin.checkpoint import CheckpointManager
+    from tpudra.sim.multihost import (
+        DriverGangBinder,
+        build_cd_stack,
+        close_cd_stack,
+        make_channel_claim,
+        make_compute_domain,
+    )
+
+    nodes = ["tc-node-0", "tc-node-1"]
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="tpudra-trace-check-") as base:
+        log = os.path.join(base, "trace.jsonl")
+        os.environ[trace.ENV_TRACE] = "1"
+        os.environ[trace.ENV_TRACE_LOG] = log
+        trace.reset_for_tests()
+        try:
+            kube = FakeKube()
+            for n in nodes:
+                kube.create(gvr.NODES, {"metadata": {"name": n}, "spec": {}})
+            kube.create(
+                gvr.COMPUTE_DOMAINS,
+                make_compute_domain("trace-check", "trace-check-uid", nodes),
+                "default",
+            )
+            drivers = build_cd_stack(kube, nodes, base, prefix="tc")
+            gang_cp = CheckpointManager(os.path.join(base, "controller"))
+            gangs = GangReservationManager(gang_cp, DriverGangBinder(drivers))
+            members = [
+                GangMember(node=n, claim_uid=f"tc-m{i}")
+                for i, n in enumerate(nodes)
+            ]
+            claims = {
+                m.claim_uid: make_channel_claim(
+                    m.claim_uid, m.node, "trace-check-uid"
+                )
+                for m in members
+            }
+            for claim in claims.values():
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            gangs.reserve("trace-check", members, claims)
+            # One stand-in rank process per member: the grant env is the
+            # ONLY thing carried across the process boundary.
+            for i, m in enumerate(members):
+                env = _grant_env(drivers[m.node], m.claim_uid)
+                tp = env.get(trace.TRACEPARENT_ENV, "")
+                if not tp:
+                    failures.append(
+                        f"grant env for {m.claim_uid} carries no "
+                        f"{trace.TRACEPARENT_ENV}"
+                    )
+                    continue
+                proc = subprocess.run(
+                    [sys.executable, "-c", _RANK_SNIPPET],
+                    env={
+                        trace.ENV_TRACE: "1",
+                        trace.ENV_TRACE_LOG: log,
+                        trace.TRACEPARENT_ENV: tp,
+                        "TRACE_CHECK_RANK": str(i),
+                        "PYTHONPATH": REPO,
+                        "PATH": os.environ.get("PATH", ""),
+                    },
+                    capture_output=True,
+                    text=True,
+                    timeout=60,
+                )
+                if proc.returncode != 0:
+                    failures.append(
+                        f"rank stand-in {i} failed: {proc.stderr[-300:]}"
+                    )
+            gangs.release("trace-check")
+            close_cd_stack(drivers)
+            gang_cp.close()
+            trace.flush()  # same-process reader: drain the buffered tail
+            failures.extend(_assert_tree(log, len(members)))
+        finally:
+            os.environ.pop(trace.ENV_TRACE, None)
+            os.environ.pop(trace.ENV_TRACE_LOG, None)
+            trace.reset_for_tests()
+    if failures:
+        for f in failures:
+            print(f"trace-check: FAIL: {f}")
+        return 1
+    print("trace-check: OK (complete gang.reserve → rank.worker span tree)")
+    return 0
+
+
+def _assert_tree(log: str, n_members: int) -> list:
+    """The completeness assertions: one trace, gang.reserve root, one
+    bind-member per member with checkpoint+CDI child phases, and one
+    rank.worker per member chaining to its bind-member."""
+    failures: list[str] = []
+    spans = trace.read_log(log)
+    traces = build_traces(spans)
+    gang_traces = [
+        (tid, t)
+        for tid, t in traces.items()
+        if any(r["name"] == "gang.reserve" for r in t["roots"])
+    ]
+    if len(gang_traces) != 1:
+        return [f"expected exactly 1 gang.reserve-rooted trace, got {len(gang_traces)}"]
+    tid, t = gang_traces[0]
+    root = next(r for r in t["roots"] if r["name"] == "gang.reserve")
+    if root.get("parent"):
+        failures.append("gang.reserve is not a root span")
+    binds = [
+        rec for rec in descendants(root, t["children"])
+        if rec["name"] == "gang.bind-member"
+    ]
+    if len(binds) != n_members:
+        failures.append(
+            f"expected {n_members} gang.bind-member spans under the root, "
+            f"got {len(binds)}"
+        )
+    for bind in binds:
+        names = {rec["name"] for rec in descendants(bind, t["children"])}
+        for want in ("plugin.prepare", "checkpoint.commit", "bind.cdi-write"):
+            if want not in names:
+                failures.append(
+                    f"bind-member {bind.get('attrs', {}).get('claim')} has no "
+                    f"{want} child phase (got {sorted(names)})"
+                )
+    ranks = [rec for rec in t["spans"].values() if rec["name"] == "rank.worker"]
+    if len(ranks) != n_members:
+        failures.append(f"expected {n_members} rank.worker spans, got {len(ranks)}")
+    for rank in ranks:
+        chain = _ancestor_chain(rank, t["spans"])
+        if "gang.bind-member" not in chain or "gang.reserve" not in chain:
+            failures.append(
+                f"rank.worker (pid {rank.get('pid')}) does not chain to a "
+                f"gang.bind-member under the root (chain: {chain})"
+            )
+        if rank.get("pid") == root.get("pid"):
+            failures.append(
+                "rank.worker span was emitted by the controller process — "
+                "the process boundary was not crossed"
+            )
+    return failures
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render per-claim/per-gang timelines and critical "
+        "paths from a tpudra trace log (docs/tracing.md)."
+    )
+    parser.add_argument("log", nargs="?", help="trace JSONL file")
+    parser.add_argument("--trace", default=None, help="trace id (prefix ok)")
+    parser.add_argument("--limit", type=int, default=16)
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit {trace_id: critical_path_summary} as JSON",
+    )
+    parser.add_argument(
+        "--self-check", action="store_true",
+        help="run the traced mini-bench and assert a complete "
+        "root→rank span tree (the make trace-check gate)",
+    )
+    args = parser.parse_args(argv)
+    if args.self_check:
+        return self_check()
+    if not args.log:
+        parser.error("a trace log path is required (or --self-check)")
+    if args.json:
+        spans = trace.read_log(args.log)
+        traces = build_traces(spans)
+        out = {
+            tid: [
+                critical_path_summary(root, t["children"])
+                for root in t["roots"]
+            ]
+            for tid, t in traces.items()
+            if args.trace is None or tid.startswith(args.trace)
+        }
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    print(report(args.log, trace_id=args.trace, limit=args.limit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

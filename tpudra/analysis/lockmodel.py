@@ -466,13 +466,15 @@ class LockModel:
     def _build_registry(self) -> None:
         for module in self.modules:
             mod = _module_of(module)
-            if mod == "tpudra.lockwitness":
-                # The witness is the measurement apparatus: its sink guard
-                # is held for an append+flush and never across another
-                # acquisition by construction; modeling it would only wrap
-                # every instrumented acquisition in a phantom lock node.
-                # (The module stays in the CALL graph so references into it
-                # resolve instead of degrading to unique-name guesses.)
+            if mod in ("tpudra.lockwitness", "tpudra.trace"):
+                # The witness and the tracer are the measurement apparatus:
+                # their sink/ring guards are held for an append+flush and
+                # never across another acquisition by construction;
+                # modeling them would only wrap every instrumented
+                # acquisition (and every span close) in a phantom lock
+                # node.  (The modules stay in the CALL graph so references
+                # into them resolve instead of degrading to unique-name
+                # guesses.)
                 continue
             for node in module.tree.body:
                 if isinstance(node, ast.Assign) and len(node.targets) == 1:

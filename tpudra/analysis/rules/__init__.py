@@ -44,6 +44,7 @@ def all_rules() -> list[Rule]:
     from tpudra.analysis.rules.metrics_hygiene import MetricsHygiene
     from tpudra.analysis.rules.rmw_purity import RmwPurity
     from tpudra.analysis.rules.shared_state import SharedState
+    from tpudra.analysis.rules.span_hygiene import SpanHygiene
 
     # The three lockgraph rules share ONE whole-program analysis per run.
     lockgraph = LockgraphState()
@@ -54,6 +55,7 @@ def all_rules() -> list[Rule]:
         SharedState(),
         MetricsHygiene(),
         ExcSwallow(),
+        SpanHygiene(),
         LockCycle(lockgraph),
         BlockUnderLockIP(lockgraph),
         FlockInversion(lockgraph),

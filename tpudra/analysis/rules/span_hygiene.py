@@ -1,0 +1,85 @@
+"""SPAN-HYGIENE: trace spans are greppable and can never leak open.
+
+Two contracts on ``start_span`` (tpudra/trace.py):
+
+- the span NAME is a string literal.  ``grep start_span`` must enumerate
+  the whole span vocabulary (trace_report's tree assertions, the docs'
+  span table, and dashboards all key on names); a computed name hides
+  part of the surface and can explode label cardinality.
+- every call is the context expression of a ``with`` statement.  A
+  manually-started span has no guaranteed close: any exception path (and
+  the bind path is built from per-claim fault barriers) leaks it open,
+  silently truncating the trace tree — exactly the kind of half-present
+  data that makes people stop trusting the tool.  The context-manager
+  protocol is also what scopes the contextvar parent correctly; an
+  orphaned span would re-parent unrelated siblings.
+
+``trace.record_span`` (the retroactive form) is exempt by construction:
+it has no open/close window to leak.  Any name ending in ``start_span``
+counts — ``trace.start_span``, a bare imported ``start_span`` — so an
+aliased import cannot dodge the rule.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+
+def _is_start_span(call: ast.Call) -> bool:
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id == "start_span"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "start_span"
+    return False
+
+
+class SpanHygiene(Rule):
+    rule_id = "SPAN-HYGIENE"
+    description = (
+        "start_span names are string literals and every call is a "
+        "with-statement context manager (no orphaned manual starts)"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        with_exprs = {
+            id(item.context_expr)
+            for node in ast.walk(module.tree)
+            if isinstance(node, (ast.With, ast.AsyncWith))
+            for item in node.items
+        }
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not _is_start_span(node):
+                continue
+            name_arg = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_arg = kw.value
+            if not (
+                isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+            ):
+                out.append(
+                    self.finding(
+                        module, node,
+                        "span name must be a string literal — computed "
+                        "names hide the span vocabulary from grep and "
+                        "from trace_report's tree assertions; put the "
+                        "variable part in attrs",
+                    )
+                )
+            if id(node) not in with_exprs:
+                out.append(
+                    self.finding(
+                        module, node,
+                        "start_span must be used as a context manager "
+                        "(`with trace.start_span(...)`) — a manually "
+                        "started span leaks open on any exception path "
+                        "and re-parents unrelated spans",
+                    )
+                )
+        return out

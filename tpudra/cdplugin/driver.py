@@ -15,7 +15,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, metrics
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, metrics, trace
 from tpudra.cdplugin.allocatable import build_devices
 from tpudra.cdplugin.computedomain import ComputeDomainManager
 from tpudra.cdplugin.state import ComputeDomainDeviceState
@@ -131,7 +131,10 @@ class CDDriver:
             uid = claim.get("metadata", {}).get("uid", "")
             t0 = time.monotonic()
             try:
-                with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
+                with trace.start_span(
+                    "plugin.prepare",
+                    attrs={"node": self._config.node_name, "claims": 1},
+                ), self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                     devices = self.state.prepare(claim)
                 out[uid] = {
                     "devices": [
@@ -164,7 +167,10 @@ class CDDriver:
             uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
             t0 = time.monotonic()
             try:
-                with self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
+                with trace.start_span(
+                    "plugin.unprepare",
+                    attrs={"node": self._config.node_name, "claims": 1},
+                ), self._pu_lock()(timeout=PU_LOCK_TIMEOUT):
                     self.state.unprepare(uid)
                 out[uid] = {}
             except Exception as e:  # noqa: BLE001

@@ -22,7 +22,7 @@ import logging
 import os
 import time
 
-from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, trace
 from tpudra.api import DecodeError, decode_config
 from tpudra.api.computedomain import (
     CHANNEL_ALLOCATION_MODE_ALL,
@@ -145,6 +145,9 @@ class ComputeDomainDeviceState:
                 f"{COMPUTE_DOMAIN_DRIVER_NAME}"
             )
         config = _opaque_config(claim)
+        # Captured on the CALLING thread: the mutator closures run on
+        # whichever thread leads the group commit (tpudra/trace.py).
+        bind_traceparent = trace.current_traceparent() or None
 
         cached: list[PreparedDeviceResult] = []
 
@@ -173,6 +176,7 @@ class ComputeDomainDeviceState:
                 namespace=namespace,
                 name=name,
                 status=PREPARE_STARTED,
+                traceparent=bind_traceparent,
                 groups=[PreparedDeviceGroup(devices=[], config_state=intent)],
             )
 
@@ -182,16 +186,17 @@ class ComputeDomainDeviceState:
         _crashpoint("post-prepare-started")
 
         try:
-            if isinstance(config, ComputeDomainChannelConfig):
-                group = self._apply_channel_config(
-                    uid, namespace, config, results, claim
-                )
-            elif isinstance(config, ComputeDomainDaemonConfig):
-                group = self._apply_daemon_config(uid, config, results)
-            else:
-                raise PermanentError(
-                    f"{type(config).__name__} belongs to the TPU plugin"
-                )
+            with trace.start_span("bind.config-apply", attrs={"claim": uid}):
+                if isinstance(config, ComputeDomainChannelConfig):
+                    group = self._apply_channel_config(
+                        uid, namespace, config, results, claim
+                    )
+                elif isinstance(config, ComputeDomainDaemonConfig):
+                    group = self._apply_daemon_config(uid, config, results)
+                else:
+                    raise PermanentError(
+                        f"{type(config).__name__} belongs to the TPU plugin"
+                    )
         except Exception:
             # Leave the claim in PrepareStarted: kubelet retries (the
             # readiness-gating path relies on this, §3.3).
@@ -201,9 +206,10 @@ class ComputeDomainDeviceState:
         # Side effects so far: node label + per-domain host dir (channel) or
         # daemon settings dir (daemon) — the CD plugin's "hardware mutation".
         _crashpoint("post-mutate")
-        self._cdi.create_claim_spec_file(
-            uid, {d.canonical_name: ContainerEdits() for d in devices}, edits
-        )
+        with trace.start_span("bind.cdi-write", attrs={"claim": uid}):
+            self._cdi.create_claim_spec_file(
+                uid, {d.canonical_name: ContainerEdits() for d in devices}, edits
+            )
         _crashpoint("post-cdi")
 
         def complete(cp: Checkpoint) -> None:
@@ -212,6 +218,7 @@ class ComputeDomainDeviceState:
                 namespace=namespace,
                 name=name,
                 status=PREPARE_COMPLETED,
+                traceparent=bind_traceparent,
                 groups=[PreparedDeviceGroup(devices=devices, config_state={})],
             )
 
@@ -448,6 +455,16 @@ class ComputeDomainDeviceState:
                 f"TPUDRA_COORDINATOR={dns_name(0)}:{DEFAULT_COORDINATOR_PORT}",
                 f"TPUDRA_CD_DIR={cd_dir_mount}",
             ]
+            # Trace propagation into the workload (tpudra/trace.py): the
+            # bind's active span rides the grant env, so every worker rank
+            # of the gang emits child spans of the member bind that
+            # granted it — the controller→plugin→rank chain trace_report
+            # reconstructs.  Absent when the bind ran untraced.
+            + (
+                [f"{trace.TRACEPARENT_ENV}={tp}"]
+                if (tp := trace.current_traceparent())
+                else []
+            )
             # Slice geometry (mesh shape + this host's block origin): the
             # same values recorded on the prepared devices above, so env
             # and checkpoint attributes can never drift apart.

@@ -49,7 +49,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
-from tpudra import TPU_DRIVER_NAME, lockwitness, metrics
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, trace
 from tpudra.kube import gvr
 from tpudra.plugin.checkpoint import (
     PREPARE_COMPLETED,
@@ -173,6 +173,11 @@ class GangStatus:
     #: The journaled remediation plan: the member list the gang is moving
     #: to (remediating phase only).
     target: list[GangMember] = field(default_factory=list)
+    #: Traceparent journaled at reserve time (tpudra/trace.py): recovery
+    #: and remediation of this gang emit spans into the ORIGINAL trace,
+    #: so a crash does not orphan the causal chain.  "" when the gang was
+    #: reserved untraced.
+    traceparent: str = ""
 
 
 class GangBinder(Protocol):
@@ -247,6 +252,7 @@ class GangReservationManager:
         phase: str,
         bound: list[str],
         extra: Optional[dict] = None,
+        traceparent: str = "",
     ) -> PreparedClaim:
         return PreparedClaim(
             uid=GANG_UID_PREFIX + gang_id,
@@ -263,6 +269,7 @@ class GangReservationManager:
                         "phase": phase,
                         "members": json.dumps([m.to_state() for m in members]),
                         "bound": json.dumps(list(bound)),
+                        **({"traceparent": traceparent} if traceparent else {}),
                         **(extra or {}),
                     },
                 )
@@ -295,6 +302,7 @@ class GangReservationManager:
                 GangMember.from_state(m)
                 for m in json.loads(state.get("target", "[]"))
             ],
+            traceparent=state.get("traceparent", ""),
         )
 
     def gangs(self) -> dict[str, GangStatus]:
@@ -327,6 +335,12 @@ class GangReservationManager:
         guid = self._guid(gang_id)
         t0 = time.monotonic()
         cached: list[GangStatus] = []
+        # Captured on the CALLING thread, inside the gang.reserve span
+        # (assigned below, read by the closure at call time): the mutator
+        # runs on whichever thread leads the group commit, whose context
+        # is not this reserve's (tpudra/trace.py lineage rules — the same
+        # hoist device_state.begin_prepare and cdplugin state.prepare do).
+        reserve_traceparent = ""
 
         def start(cp: Checkpoint) -> None:
             existing = cp.prepared_claims.get(guid)
@@ -351,10 +365,14 @@ class GangReservationManager:
                     f"{status.phase!r} with a different member set"
                 )
             cp.prepared_claims[guid] = self._record(
-                gang_id, members, PHASE_RESERVING, []
+                gang_id, members, PHASE_RESERVING, [],
+                traceparent=reserve_traceparent,
             )
 
-        with self._gang_op(gang_id, "reserve"):
+        with trace.start_span(
+            "gang.reserve", attrs={"gang": gang_id, "members": len(members)}
+        ), self._gang_op(gang_id, "reserve"):
+            reserve_traceparent = trace.current_traceparent()
             self._cp.mutate(start, touched=[guid])
             if cached:
                 return cached[0]
@@ -406,28 +424,32 @@ class GangReservationManager:
         stage = "member bind"
         try:
             for member in members:
-                stage = f"bind of claim {member.claim_uid!r}"
-                self._binder.bind(member, claims[member.claim_uid])
+                with trace.start_span(
+                    "gang.bind-member",
+                    attrs={"claim": member.claim_uid, "node": member.node},
+                ):
+                    stage = f"bind of claim {member.claim_uid!r}"
+                    self._binder.bind(member, claims[member.claim_uid])
 
-                def journal_bound(cp: Checkpoint, uid=member.claim_uid) -> None:
-                    rec = cp.prepared_claims.get(guid)
-                    if rec is None or not rec.groups:
-                        return  # dropped by a concurrent release; rollback wins
-                    state = rec.groups[0].config_state
-                    done = json.loads(state.get("bound", "[]"))
-                    if uid not in done:
-                        done.append(uid)
-                        state["bound"] = json.dumps(done)
+                    def journal_bound(cp: Checkpoint, uid=member.claim_uid) -> None:
+                        rec = cp.prepared_claims.get(guid)
+                        if rec is None or not rec.groups:
+                            return  # dropped by a concurrent release; rollback wins
+                        state = rec.groups[0].config_state
+                        done = json.loads(state.get("bound", "[]"))
+                        if uid not in done:
+                            done.append(uid)
+                            state["bound"] = json.dumps(done)
 
-                stage = f"bind journal for claim {member.claim_uid!r}"
-                self._cp.mutate(journal_bound, touched=[guid])
-                # Fires (when armed) after the FIRST member is durably
-                # bound and before the rest: the canonical partial-gang
-                # crash for the sweep, as long as the gang has ≥2 members.
-                _crashpoint(crash_point)
-                if on_member_bound is not None:
-                    stage = f"post-bind callback for {member.claim_uid!r}"
-                    on_member_bound(member)
+                    stage = f"bind journal for claim {member.claim_uid!r}"
+                    self._cp.mutate(journal_bound, touched=[guid])
+                    # Fires (when armed) after the FIRST member is durably
+                    # bound and before the rest: the canonical partial-gang
+                    # crash for the sweep, as long as the gang has ≥2 members.
+                    _crashpoint(crash_point)
+                    if on_member_bound is not None:
+                        stage = f"post-bind callback for {member.claim_uid!r}"
+                        on_member_bound(member)
         except _BindStageFailed:
             raise
         except Exception as e:
@@ -523,7 +545,12 @@ class GangReservationManager:
             rec = self.gangs().get(gang_id)
             if rec is None:
                 return
-            self._rollback(gang_id, _dedup_members(rec.members, rec.target))
+            with trace.start_span(
+                "gang.release",
+                parent=rec.traceparent or None,
+                attrs={"gang": gang_id, "members": len(rec.members)},
+            ):
+                self._rollback(gang_id, _dedup_members(rec.members, rec.target))
         _GANGS_RELEASED.inc()
 
     # ----------------------------------------------------------- remediation
@@ -619,23 +646,31 @@ class GangReservationManager:
                 state["target"] = json.dumps([m.to_state() for m in target])
                 planned.append(True)
 
-            self._cp.mutate(plan, touched=[guid])
-            if not planned:
-                raise GangBindError(
-                    f"gang {gang_id!r} record vanished before the "
-                    "remediation plan could be journaled"
-                )
-            # Fires (when armed) with the plan durable and every OLD
-            # member still bound — the canonical mid-remediation crash:
-            # recovery must finish the rollback and resume (or release).
-            _crashpoint("mid-gang-remediate")
-            try:
-                self._finish_remediation(
-                    gang_id, status.members, target, claims, on_member_bound
-                )
-            except (GangRollbackIncomplete, GangOpInProgress):
-                _REMEDIATION_FAILED.inc()
-                raise
+            with trace.start_span(
+                "gang.remediate",
+                parent=status.traceparent or None,
+                attrs={
+                    "gang": gang_id,
+                    "replaced": sorted(replacements),
+                },
+            ):
+                self._cp.mutate(plan, touched=[guid])
+                if not planned:
+                    raise GangBindError(
+                        f"gang {gang_id!r} record vanished before the "
+                        "remediation plan could be journaled"
+                    )
+                # Fires (when armed) with the plan durable and every OLD
+                # member still bound — the canonical mid-remediation crash:
+                # recovery must finish the rollback and resume (or release).
+                _crashpoint("mid-gang-remediate")
+                try:
+                    self._finish_remediation(
+                        gang_id, status.members, target, claims, on_member_bound
+                    )
+                except (GangRollbackIncomplete, GangOpInProgress):
+                    _REMEDIATION_FAILED.inc()
+                    raise
         logger.info(
             "gang %s: remediated onto %s in %.3fs",
             gang_id, [m.node for m in target], time.monotonic() - t0,
@@ -737,10 +772,18 @@ class GangReservationManager:
                         gang_id, status.phase,
                         len(status.members), len(status.bound),
                     )
-                    if status.phase == PHASE_REMEDIATING:
-                        self._resume_remediation(gang_id, status)
-                    else:
-                        self._rollback(gang_id, status.members)
+                    # Recovery spans resume the ORIGINAL trace: the
+                    # traceparent journaled at reserve time rides the WAL
+                    # record across the crash.
+                    with trace.start_span(
+                        "gang.recover",
+                        parent=status.traceparent or None,
+                        attrs={"gang": gang_id, "phase": status.phase},
+                    ):
+                        if status.phase == PHASE_REMEDIATING:
+                            self._resume_remediation(gang_id, status)
+                        else:
+                            self._rollback(gang_id, status.members)
             except GangOpInProgress:
                 logger.info(
                     "gang %s: live operation in flight; recovery skipped",

@@ -9,8 +9,10 @@ and the SIGUSR1/SIGUSR2 goroutine-dump handlers every binary installs
   legacyregistry carried (workqueue depth, client latencies) plus the
   prepare-path histogram that the reference only ever logged as
   ``t_prep`` lines (gpu-kubelet-plugin/driver.go:340-386);
-- ``DebugEndpoint`` serves ``/metrics`` and ``/debug/stacks`` (the
-  goroutine-profile analog: a dump of every Python thread's stack);
+- ``DebugEndpoint`` serves ``/metrics``, ``/debug/stacks`` (the
+  goroutine-profile analog: a dump of every Python thread's stack) and
+  ``/debug/traces`` (the trace flight recorder's recent spans,
+  tpudra/trace.py);
 - ``install_debug_handlers`` registers SIGUSR1/SIGUSR2 via faulthandler —
   ``kill -USR1 <pid>`` writes all thread stacks to stderr without
   disturbing the process.
@@ -19,6 +21,7 @@ and the SIGUSR1/SIGUSR2 goroutine-dump handlers every binary installs
 from __future__ import annotations
 
 import faulthandler
+import json
 import logging
 import signal
 import sys
@@ -368,7 +371,8 @@ def parse_http_endpoint(value: str) -> tuple[str, int]:
 
 
 class DebugEndpoint:
-    """Opt-in HTTP endpoint serving /metrics, /debug/stacks and /healthz.
+    """Opt-in HTTP endpoint serving /metrics, /debug/stacks, /debug/traces
+    and /healthz.
 
     The controller binary binds it from ``--http-endpoint`` (reference
     SetupHTTPEndpoint, main.go:256); the node plugins mount the same routes
@@ -408,7 +412,7 @@ class DebugEndpoint:
 
 
 def handle_debug_request(handler: BaseHTTPRequestHandler) -> bool:
-    """Serve /metrics, /debug/stacks and /healthz on any
+    """Serve /metrics, /debug/stacks, /debug/traces and /healthz on any
     BaseHTTPRequestHandler.  Returns False — with nothing written to the
     connection — when the path is not a debug route, so the caller decides
     what a miss means (404 or its own routing)."""
@@ -424,6 +428,21 @@ def handle_debug_request(handler: BaseHTTPRequestHandler) -> bool:
         body = format_thread_stacks().encode()
         handler.send_response(200)
         handler.send_header("Content-Type", "text/plain; charset=utf-8")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+        return True
+    if handler.path == "/debug/traces":
+        # The trace flight recorder (tpudra/trace.py): recent spans,
+        # newest first, bounded by the ring — the live half of what a
+        # soak violation dumps.  Empty list when tracing is disabled.
+        from tpudra import trace
+
+        body = json.dumps(
+            {"enabled": trace.enabled(), "spans": trace.recent_spans(256)}
+        ).encode()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/json")
         handler.send_header("Content-Length", str(len(body)))
         handler.end_headers()
         handler.wfile.write(body)

@@ -69,7 +69,7 @@ from dataclasses import dataclass, field
 from types import MappingProxyType
 from typing import Callable, Iterable, Optional
 
-from tpudra import lockwitness, metrics
+from tpudra import lockwitness, metrics, trace
 from tpudra.api import serde
 from tpudra.flock import Flock, FlockTimeout
 from tpudra.plugin import journal as journal_mod
@@ -200,6 +200,11 @@ class PreparedClaim:
     name: str = field(default="", metadata={"json": "name"})
     status: str = field(default=PREPARE_STARTED, metadata={"json": "status"})
     groups: list[PreparedDeviceGroup] = field(default_factory=list, metadata={"json": "groups"})
+    # Traceparent of the bind that journaled this record (tpudra/trace.py):
+    # crash recovery and retry-rollback emit their spans into the ORIGINAL
+    # trace.  None (dropped by serde.encode) when the bind ran untraced,
+    # so untraced checkpoints are byte-identical to pre-trace ones.
+    traceparent: Optional[str] = field(default=None, metadata={"json": "traceparent"})
 
     def all_devices(self) -> list[PreparedDevice]:
         return [d for g in self.groups for d in g.devices]
@@ -624,12 +629,17 @@ class CheckpointManager:
         }
         data = json.dumps(envelope)
         tmp = self._path + ".tmp"
+        tf_wall, tf0 = time.time(), time.perf_counter()
         with open(tmp, "w") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
         journal_mod.fsync_dir(os.path.dirname(self._path) or ".")
+        trace.record_span(
+            "checkpoint.fsync", tf_wall, time.perf_counter() - tf0,
+            attrs={"kind": "snapshot", "bytes": len(data)},
+        )
         _FSYNC_SNAPSHOT.inc()
         _FSYNC_DIR.inc()
         _BYTES_SNAPSHOT.inc(len(data))
@@ -681,31 +691,43 @@ class CheckpointManager:
         )
         lead = False
         deadline = time.monotonic() + timeout
-        with self._commit_cond:
-            self._commit_queue.append(mutation)
-            while not mutation.done and self._commit_leader:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0 and mutation in self._commit_queue:
-                    # Still queued (no leader drained it): abandoning is
-                    # safe, and honors this CALLER's timeout instead of
-                    # silently inheriting the leader's.  Once drained, the
-                    # leader owns it and we must see the outcome through.
-                    self._commit_queue.remove(mutation)
-                    raise FlockTimeout(
-                        "timeout waiting for checkpoint group commit "
-                        f"after {timeout}s"
-                    )
-                self._commit_cond.wait(min(1.0, max(0.05, remaining)))
-            if not mutation.done:
-                self._commit_leader = True
-                lead = True
-        if lead:
-            try:
-                self._lead_commit(timeout)
-            finally:
-                with self._commit_cond:
-                    self._commit_leader = False
-                    self._commit_cond.notify_all()
+        # One RETRO span per mutate (trace.record_span — plain counters,
+        # the cheapest instrumentation the layer has): a follower's
+        # duration IS its group-commit wait; the leader's covers flock +
+        # apply + fsync — the "why was this bind slow" attribution the
+        # phase histogram aggregates away.
+        t_wall, t0 = time.time(), time.perf_counter()
+        try:
+            with self._commit_cond:
+                self._commit_queue.append(mutation)
+                while not mutation.done and self._commit_leader:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 and mutation in self._commit_queue:
+                        # Still queued (no leader drained it): abandoning is
+                        # safe, and honors this CALLER's timeout instead of
+                        # silently inheriting the leader's.  Once drained, the
+                        # leader owns it and we must see the outcome through.
+                        self._commit_queue.remove(mutation)
+                        raise FlockTimeout(
+                            "timeout waiting for checkpoint group commit "
+                            f"after {timeout}s"
+                        )
+                    self._commit_cond.wait(min(1.0, max(0.05, remaining)))
+                if not mutation.done:
+                    self._commit_leader = True
+                    lead = True
+            if lead:
+                try:
+                    self._lead_commit(timeout)
+                finally:
+                    with self._commit_cond:
+                        self._commit_leader = False
+                        self._commit_cond.notify_all()
+        finally:
+            trace.record_span(
+                "checkpoint.commit", t_wall, time.perf_counter() - t0,
+                attrs={"led": lead},
+            )
         if mutation.error is not None:
             raise mutation.error
 
@@ -905,7 +927,12 @@ class CheckpointManager:
             self._snapshot_needs_migration = False
         elif records:
             payloads = [journal_mod.encode_record(r) for r in records]
+            tf_wall, tf0 = time.time(), time.perf_counter()
             n, dir_synced = self._journal.append_locked(payloads)
+            trace.record_span(
+                "checkpoint.fsync", tf_wall, time.perf_counter() - tf0,
+                attrs={"kind": "journal", "records": len(records)},
+            )
             _FSYNC_JOURNAL.inc()
             if dir_synced:
                 _FSYNC_DIR.inc()

@@ -43,7 +43,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpudra import TPU_DRIVER_NAME, featuregates, metrics
+from tpudra import TPU_DRIVER_NAME, featuregates, metrics, trace
 from tpudra.api import (
     ComputeDomainChannelConfig,
     ComputeDomainDaemonConfig,
@@ -304,6 +304,10 @@ class DeviceState:
         poisons the batch.  The caller serializes this phase under the
         node-global lock (driver.py)."""
         batch = PrepareBatch()
+        # Captured on the CALLING thread: the mutator closure below runs on
+        # whichever thread leads the group commit, whose context is not
+        # this bind's (tpudra/trace.py lineage rules).
+        batch_traceparent = trace.current_traceparent() or None
         seen: dict[str, PrepareItem] = {}
         for claim in claims:
             item = PrepareItem(claim=claim)
@@ -336,7 +340,7 @@ class DeviceState:
                 if item.error is not None:
                     continue
                 try:
-                    self._start_one(cp, item)
+                    self._start_one(cp, item, batch_traceparent)
                 except Exception as e:  # noqa: BLE001 — per-claim barrier
                     item.error = e
 
@@ -355,7 +359,10 @@ class DeviceState:
                 )
         return batch
 
-    def _start_one(self, cp: Checkpoint, item: PrepareItem) -> None:
+    def _start_one(
+        self, cp: Checkpoint, item: PrepareItem,
+        traceparent: Optional[str] = None,
+    ) -> None:
         existing = cp.prepared_claims.get(item.uid)
         if existing is not None and existing.status == PREPARE_COMPLETED:
             item.cached = _results_from_claim(existing)
@@ -378,6 +385,7 @@ class DeviceState:
             namespace=item.namespace,
             name=item.name,
             status=PREPARE_STARTED,
+            traceparent=traceparent,
             groups=[
                 PreparedDeviceGroup(
                     # Requested device names are recorded at Started so
@@ -405,15 +413,26 @@ class DeviceState:
             # Deferred partial-retry rollback (see _start_one): runs before
             # this claim's own effects — serially within the same item, and
             # the orphans share this claim's footprint so the effect-group
-            # net keeps other items off this silicon.
+            # net keeps other items off this silicon.  The span resumes the
+            # INTERRUPTED bind's trace (the traceparent its record
+            # journaled), so the crashed prepare and its cleanup read as
+            # one causal chain in trace_report.
             old_record, owned = item.rollback
-            self._rollback_partial(old_record, owned)
+            with trace.start_span(
+                "bind.retry-rollback",
+                parent=old_record.traceparent or None,
+                attrs={"claim": item.uid},
+            ):
+                self._rollback_partial(old_record, owned)
         undos: list = []
         t0 = time.monotonic()
         try:
-            groups = self._prepare_devices(
-                item.uid, item.results, _opaque_configs(item.claim), undos
-            )
+            with trace.start_span(
+                "bind.config-apply", attrs={"claim": item.uid}
+            ):
+                groups = self._prepare_devices(
+                    item.uid, item.results, _opaque_configs(item.claim), undos
+                )
         except Exception:
             for undo in reversed(undos):
                 try:
@@ -425,7 +444,8 @@ class DeviceState:
             metrics.PHASE_CONFIG_APPLY, time.monotonic() - t0
         )
         _crashpoint("post-mutate")
-        self._write_cdi_spec(item.uid, groups)
+        with trace.start_span("bind.cdi-write", attrs={"claim": item.uid}):
+            self._write_cdi_spec(item.uid, groups)
         _crashpoint("post-cdi")
         item.plain_groups = [g for g, _ in groups]
 
@@ -438,11 +458,15 @@ class DeviceState:
             return
         def complete_all(cp: Checkpoint) -> None:
             for item in done:
+                prev = cp.prepared_claims.get(item.uid)
                 cp.prepared_claims[item.uid] = PreparedClaim(
                     uid=item.uid,
                     namespace=item.namespace,
                     name=item.name,
                     status=PREPARE_COMPLETED,
+                    # The ORIGINAL bind's trace rides the record across the
+                    # started→completed flip (and any crash in between).
+                    traceparent=prev.traceparent if prev is not None else None,
                     groups=item.plain_groups,
                 )
 

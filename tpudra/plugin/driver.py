@@ -26,6 +26,7 @@ The analog of gpu-kubelet-plugin/driver.go:52-554:
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import hashlib
 import json
 import logging
@@ -42,6 +43,7 @@ from tpudra import (
     featuregates,
     lockwitness,
     metrics,
+    trace,
 )
 from tpudra.backoff import Backoff
 from tpudra.clock import Clock
@@ -438,22 +440,27 @@ class Driver:
         withheld_before = self.state.bound_sibling_devices()
         uids = [c.get("metadata", {}).get("uid", "") for c in claims]
         try:
-            with self._claims_serialized(uids):
+            with trace.start_span(
+                "plugin.prepare",
+                attrs={"node": self._config.node_name, "claims": len(claims)},
+            ), self._claims_serialized(uids):
                 # Phase 1 under the node lock: ONE checkpoint RMW records
                 # PrepareStarted (+ rollback/validation) for the whole batch.
-                with self._locked_pu():
+                with trace.start_span("bind.rmw-begin") as sp, self._locked_pu():
                     t_lock = time.monotonic() - t0
+                    sp.set_attr("lock_wait_s", round(t_lock, 6))
                     batch = self.state.begin_prepare(claims)
                 # Phase 2 outside the lock: per-claim side effects,
                 # concurrent across footprint-disjoint claims.
-                self._run_effects(
-                    batch.pending(),
-                    self.state.run_prepare_effects,
-                    "prepare effects",
-                )
+                with trace.start_span("bind.effects"):
+                    self._run_effects(
+                        batch.pending(),
+                        self.state.run_prepare_effects,
+                        "prepare effects",
+                    )
                 # Phase 3 under the node lock: ONE checkpoint RMW completes
                 # every claim whose effects succeeded.
-                with self._locked_pu():
+                with trace.start_span("bind.rmw-finish"), self._locked_pu():
                     self.state.finish_prepare(batch)
                 for item in batch.items:
                     if item.error is not None:
@@ -515,15 +522,19 @@ class Driver:
             for ref in claims
         ]
         try:
-            with self._claims_serialized(uids):
-                with self._locked_pu():
+            with trace.start_span(
+                "plugin.unprepare",
+                attrs={"node": self._config.node_name, "claims": len(claims)},
+            ), self._claims_serialized(uids):
+                with trace.start_span("bind.rmw-begin"), self._locked_pu():
                     batch = self.state.begin_unprepare(uids)
-                self._run_effects(
-                    batch.pending(),
-                    self.state.run_unprepare_effects,
-                    "unprepare effects",
-                )
-                with self._locked_pu():
+                with trace.start_span("bind.effects"):
+                    self._run_effects(
+                        batch.pending(),
+                        self.state.run_unprepare_effects,
+                        "unprepare effects",
+                    )
+                with trace.start_span("bind.rmw-finish"), self._locked_pu():
                     self.state.finish_unprepare(batch)
                 for item in batch.items:
                     if item.done:  # record dropped; lock file is garbage
@@ -592,7 +603,15 @@ class Driver:
         if len(groups) == 1:
             run_group(groups[0])
             return
-        futures = [self._effects_pool.submit(run_group, g) for g in groups]
+        # Pool workers run under a COPY of the calling context so the
+        # active trace span's lineage travels into the fan-out (contextvars
+        # do not cross executor threads on their own — the resolver pool
+        # does the same, grpcserver._resolve_all).
+        ctx = contextvars.copy_context()
+        futures = [
+            self._effects_pool.submit(ctx.copy().run, run_group, g)
+            for g in groups
+        ]
         for f in futures:
             try:
                 f.result()
@@ -658,8 +677,10 @@ class Driver:
         deadline = time.monotonic() + PU_LOCK_TIMEOUT
         locks = []
         try:
-            for uid in sorted({u for u in uids if u}):
-                locks.append(self._acquire_claim_lock(uid, deadline))
+            with trace.start_span("bind.flock-wait") as sp:
+                for uid in sorted({u for u in uids if u}):
+                    locks.append(self._acquire_claim_lock(uid, deadline))
+                sp.set_attr("locks", len(locks))
             yield
         finally:
             for lock in reversed(locks):
@@ -813,7 +834,11 @@ class Driver:
         ``BulkSlicePublisher`` so hundreds of co-located drivers share one
         existence LIST instead of paying 3 requests per node; driver-side
         bookkeeping (generation, content hash) is identical either way."""
-        with self._publish_lock:
+        # The span opens BEFORE the publish lock and closes after it: its
+        # exit (a log append) must never run under the lock.
+        with trace.start_span(
+            "plugin.publish", attrs={"node": self._config.node_name}
+        ), self._publish_lock:
             partitionable = featuregates.enabled(featuregates.DYNAMIC_PARTITIONING)
             with self._unhealthy_lock:
                 unhealthy = set(self._unhealthy)

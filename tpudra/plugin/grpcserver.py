@@ -33,6 +33,7 @@ from typing import Callable, Optional
 
 import grpc
 
+from tpudra import trace
 from tpudra.kube.deadline import api_deadline
 
 from tpudra.drapb import dra_v1_pb2 as drapb
@@ -97,6 +98,20 @@ class RPCError(Exception):
 
 def _unix_addr(path: str) -> str:
     return "unix:" + os.path.abspath(path)
+
+
+def _metadata_traceparent(context) -> Optional[str]:
+    """The caller's traceparent from gRPC invocation metadata, or None —
+    the kubelet boundary half of trace propagation (DRAClient sends it,
+    the handlers adopt it as the RPC span's parent)."""
+    try:
+        metadata = context.invocation_metadata() if context is not None else ()
+    except Exception:  # noqa: BLE001 — a sim context without metadata
+        return None
+    for key, value in metadata or ():
+        if key == trace.GRPC_METADATA_KEY:
+            return value
+    return None
 
 
 def _serve(path: str, generic_handlers: tuple) -> grpc.Server:
@@ -215,9 +230,24 @@ class PluginSockets:
         spike cannot wedge this handler past kubelet's own gRPC deadline.
         """
         resp = pb.NodePrepareResourcesResponse()
-        with api_deadline(DEFAULT_RPC_API_BUDGET_S):
+        with trace.start_span(
+            "rpc.NodePrepareResources",
+            parent=_metadata_traceparent(context),
+            attrs={"claims": len(request.claims)},
+        ), api_deadline(DEFAULT_RPC_API_BUDGET_S):
             full_claims = []
-            for ref, claim, err in self._resolve_all(request.claims):
+            # A resolve span only for multi-claim batches: a single
+            # cached-hit resolution is cheaper than its span, and its cost
+            # is visible anyway as the gap before the plugin.prepare
+            # child (the ≤5% overhead budget, bench --trace-ab).
+            if len(request.claims) > 1:
+                with trace.start_span(
+                    "bind.resolve", attrs={"claims": len(request.claims)}
+                ):
+                    resolved = self._resolve_all(request.claims)
+            else:
+                resolved = self._resolve_all(request.claims)
+            for ref, claim, err in resolved:
                 if err is not None:
                     resp.claims[ref.uid].error = (
                         f"resolve claim {ref.namespace}/{ref.name}: {err}"
@@ -246,7 +276,11 @@ class PluginSockets:
             for c in request.claims
         ]
         # Same ambient apiserver budget as prepare (see _node_prepare).
-        with api_deadline(DEFAULT_RPC_API_BUDGET_S):
+        with trace.start_span(
+            "rpc.NodeUnprepareResources",
+            parent=_metadata_traceparent(context),
+            attrs={"claims": len(refs)},
+        ), api_deadline(DEFAULT_RPC_API_BUDGET_S):
             result = self._unprepare(refs)
         resp = pb.NodeUnprepareResourcesResponse()
         for uid, entry in result.get("claims", {}).items():
@@ -373,8 +407,15 @@ class DRAClient:
             request_serializer=type(request).SerializeToString,
             response_deserializer=resp_cls.FromString,
         )
+        # Trace propagation across the kubelet boundary: the active span
+        # (if any) rides gRPC metadata; the server handlers adopt it as
+        # the RPC span's parent (tpudra/trace.py).
+        traceparent = trace.current_traceparent()
+        metadata = (
+            ((trace.GRPC_METADATA_KEY, traceparent),) if traceparent else None
+        )
         try:
-            return rpc(request, timeout=self._timeout)
+            return rpc(request, timeout=self._timeout, metadata=metadata)
         except grpc.RpcError as e:
             raise RPCError(f"{method}: {e.code().name}: {e.details()}") from e
 

@@ -79,7 +79,7 @@ class Healthcheck:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self) -> None:  # noqa: N802 — http.server API
-                if self.path in ("/metrics", "/debug/stacks"):
+                if self.path in ("/metrics", "/debug/stacks", "/debug/traces"):
                     # The plugins mount the observability routes on this
                     # listener instead of running a second HTTP server
                     # (controller equivalent: --http-endpoint).

@@ -83,7 +83,7 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
-from tpudra import TPU_DRIVER_NAME, lockwitness, metrics
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, trace
 from tpudra.clock import MonotonicAger, SkewedClock
 from tpudra.kube import gvr
 from tpudra.kube.deadline import api_deadline
@@ -432,6 +432,11 @@ class ChaosSoak:
                         "seed": self.config.seed,
                         "timeline": [r.spec() for r in self._timeline],
                     },
+                    # The flight recorder: what the system was DOING when
+                    # the invariant broke — recent spans (newest first,
+                    # tpudra/trace.py ring) next to the seed + timeline
+                    # that replay it.  [] when the soak ran untraced.
+                    "spans": trace.recent_spans(200),
                 }
             )
         logger.error("SOAK INVARIANT VIOLATION [%s] %r: %s", invariant, key, detail)
@@ -2228,6 +2233,7 @@ class ChaosSoak:
                 "fault_kinds": list(self.config.fault_kinds),
                 "budget": asdict(budget),
                 "witness": self.config.witness,
+                "trace": trace.enabled(),
             },
             "sim_hours": round(sim_hours, 3),
             "faults": {
@@ -2319,6 +2325,14 @@ def main(argv: Optional[list[str]] = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    # Tracing is ON for the soak (like the lock witness): the flight
+    # recorder must have spans to dump when an invariant fires, and the
+    # SLO gate doubles as the "soak passes with tracing on" proof.  An
+    # operator opts out (or redirects the log) via the env.
+    os.environ.setdefault(trace.ENV_TRACE, "1")
+    os.environ.setdefault(
+        trace.ENV_TRACE_LOG, os.path.abspath(args.report) + ".trace.jsonl"
     )
     cfg_kwargs = dict(PROFILES[args.profile])
     if args.nodes is not None:

@@ -55,7 +55,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpudra import COMPUTE_DOMAIN_DRIVER_NAME
+from tpudra import COMPUTE_DOMAIN_DRIVER_NAME, trace
 from tpudra.controller.gang import (
     GangBindError,
     GangMember,
@@ -614,6 +614,15 @@ class MultiHostGang:
                 "HOME": os.environ.get("HOME", "/root"),
                 **self.config.extra_env,
             }
+            if trace.enabled():
+                # The rank process appends its spans to the SAME trace log
+                # (a shared-log absolute path), parented on the grant env's
+                # TPUDRA_TRACEPARENT — the process-boundary half of the
+                # controller→plugin→rank chain.
+                full_env.setdefault(trace.ENV_TRACE, "1")
+                full_env.setdefault(
+                    trace.ENV_TRACE_LOG, os.path.abspath(trace.log_path())
+                )
             log_path = os.path.join(self._tmp.name, f"rank-{rank}.log")
             logs.append(log_path)
             with open(log_path, "w") as out:
@@ -700,6 +709,18 @@ def _worker_main() -> int:
     # resolve on one machine); the relay itself stays real — peers reach
     # host 0 through the daemon's coordinator proxy.
     env.coordinator = os.environ.get("TPUDRA_SIM_COORDINATOR") or env.coordinator
+    # The rank's span parents on the grant env's traceparent: the claim's
+    # CDI environment alone connects this process to the member bind that
+    # granted it (the last hop of the controller→plugin→rank chain).
+    with trace.start_span(
+        "rank.worker",
+        parent=env.traceparent or None,
+        attrs={"host": env.host_index, "num_hosts": env.num_hosts},
+    ):
+        return _worker_body(env)
+
+
+def _worker_body(env) -> int:
     env.initialize_distributed()
 
     import jax

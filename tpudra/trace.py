@@ -1,0 +1,457 @@
+"""End-to-end claim tracing: spans across controller → plugin → checkpoint → rank.
+
+The bind-path histograms (``tpudra_bind_phase_seconds``) answer "how slow
+is phase X on average"; this module answers the question aggregates
+cannot: *which* phase of *which* member on *which* node was the critical
+path of one particular gang bind, across the controller/kubelet process
+boundary.  It is the span layer every later perf and placement PR reads
+— ``tools/trace_report.py`` reconstructs per-claim/per-gang timelines
+from its output and prints a critical-path breakdown.
+
+Construction mirrors ``tpudra/lockwitness.py`` (the other opt-in
+measurement apparatus): with ``TPUDRA_TRACE=1`` in the environment,
+``start_span`` returns a real :class:`Span` that appends one JSONL record
+to ``TPUDRA_TRACE_LOG`` (default ``tpudra-trace.jsonl`` in the working
+directory) when it closes; with the variable unset — every production
+default — it returns one shared no-op object, so the disabled fast path
+allocates nothing and writes nothing.
+
+Span model (W3C-trace-context-shaped, stdlib only):
+
+- a span is (trace_id, span_id, parent_id, name, wall start, duration,
+  attrs); IDs are random hex (16-byte trace, 8-byte span).
+- the ACTIVE span is a contextvar: a span opened while another is active
+  becomes its child, and ``contextvars.copy_context()`` carries the
+  lineage across thread-pool hops (the resolver pool, the effects pool).
+- ``current_traceparent()`` renders the active context as a
+  ``00-<trace>-<span>-01`` string — the one value that crosses every
+  boundary we own: gRPC metadata (:data:`GRPC_METADATA_KEY`) on
+  NodePrepare/NodeUnprepare, a ``traceparent`` field journaled in WAL
+  gang/claim records (recovery resumes the original trace), and
+  :data:`TRACEPARENT_ENV` in the grant env (worker ranks emit child
+  spans from the claim's CDI environment alone).
+- ``start_span(name, parent=...)`` adopts a remote parent from such a
+  string; spans record which process (pid) and thread emitted them, so
+  one log file shared by N rank processes still yields one coherent tree.
+
+**Flight recorder.**  Every closed span also lands in a bounded
+in-process ring (``TPUDRA_TRACE_RING`` entries, default 512).  The chaos
+soak dumps ``recent_spans()`` next to the seed + fault timeline on every
+invariant violation — the causal middle of "what was the system doing
+when the invariant broke" — and ``DebugEndpoint`` serves the same ring at
+``/debug/traces``.
+
+Span hygiene is machine-checked (tpudra-lint SPAN-HYGIENE): span names
+are literal strings and ``start_span`` is always used as a context
+manager, so no span can leak open and no name can hide from grep.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextvars
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_TRACE = "TPUDRA_TRACE"
+ENV_TRACE_LOG = "TPUDRA_TRACE_LOG"
+ENV_TRACE_RING = "TPUDRA_TRACE_RING"
+DEFAULT_LOG = "tpudra-trace.jsonl"
+DEFAULT_RING = 512
+
+#: The env var the grant (CDI spec / daemon settings) carries so worker
+#: ranks join the bind's trace (workload/envspec.ClaimEnv.traceparent).
+TRACEPARENT_ENV = "TPUDRA_TRACEPARENT"
+#: gRPC metadata key on NodePrepareResources/NodeUnprepareResources
+#: (metadata keys must be lowercase per the gRPC spec).
+GRPC_METADATA_KEY = "tpudra-traceparent"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_TRACE, "") not in ("", "0")
+
+
+def log_path() -> str:
+    return os.environ.get(ENV_TRACE_LOG, "") or os.path.join(
+        os.getcwd(), DEFAULT_LOG
+    )
+
+
+# ------------------------------------------------------------- trace context
+
+#: (trace_id, span_id) of the active span in this context; None at a root.
+_CURRENT: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
+    "tpudra-trace-current", default=None
+)
+
+
+_tls = threading.local()
+
+
+def _new_id(nbytes: int) -> str:
+    """Random hex from a per-thread PRNG seeded once from os.urandom:
+    span IDs need uniqueness, not cryptographic strength, and the two
+    urandom syscalls per span were a measurable slice of the traced-bind
+    overhead budget (the ≤5% A/B gate, bench --trace-ab)."""
+    rng = getattr(_tls, "rng", None)
+    if rng is None:
+        rng = random.Random(os.urandom(16))
+        _tls.rng = rng
+    return "%0*x" % (nbytes * 2, rng.getrandbits(nbytes * 8))
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[tuple]:
+    """(trace_id, span_id) from a ``00-<trace>-<span>-01`` string; None on
+    anything malformed — a garbled traceparent degrades to a fresh trace,
+    never a crash (the same contract as envspec's mesh-env parse)."""
+    if not value:
+        return None
+    parts = value.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _, trace_id, span_id, _ = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    return (trace_id, span_id)
+
+
+def current_traceparent() -> str:
+    """The active span as a traceparent string, or "" when tracing is
+    disabled or no span is active — callers propagate it verbatim and the
+    receiving side's ``parse_traceparent`` treats "" as no-parent."""
+    if not enabled():
+        return ""
+    current = _CURRENT.get()
+    if current is None:
+        return ""
+    return format_traceparent(current[0], current[1])
+
+
+# ----------------------------------------------------------------- recording
+
+_sink_guard = threading.Lock()
+_sink = None  # opened lazily, OUTSIDE _sink_guard (no open-under-lock)
+_buf_guard = threading.Lock()  # guards _pending AND _ring (one hop per span)
+_pending: list = []  # records awaiting serialization (the hot-path buffer)
+_ring: Optional[deque] = None
+_PID = os.getpid()
+#: Write cadence: a span close only APPENDS its record dict to the
+#: pending buffer and the flight-recorder ring (one lock, no
+#: serialization, no syscall); json.dumps + the write + the flush happen
+#: at most once per window (plus at interpreter exit via atexit, and
+#: explicitly via ``flush()``).  Per-span serialization and flush
+#: syscalls were the bulk of the traced-bind overhead budget (the ≤5%
+#: A/B gate, bench --trace-ab).  A crash can lose at most the last
+#: window's UNWRITTEN records — and the flight-recorder RING (what a
+#: soak violation dumps) is in-memory and loses nothing.
+_FLUSH_INTERVAL_S = 0.25
+_last_flush = 0.0
+
+
+def _thread_name() -> str:
+    name = getattr(_tls, "name", None)
+    if name is None:
+        name = threading.current_thread().name
+        _tls.name = name
+    return name
+
+
+def _submit(record: dict) -> None:
+    """Ring + pending buffer under ONE lock; drain when the window is
+    due.  The window is claimed BEFORE the I/O so concurrent closers keep
+    buffering instead of queueing behind the writer."""
+    global _last_flush, _ring
+    now = time.monotonic()
+    batch = None
+    with _buf_guard:
+        if _ring is None:
+            try:
+                size = int(os.environ.get(ENV_TRACE_RING, "") or DEFAULT_RING)
+            except ValueError:
+                size = DEFAULT_RING
+            _ring = deque(maxlen=max(1, size))
+            atexit.register(flush)
+        _ring.append(record)
+        _pending.append(record)
+        if now - _last_flush >= _FLUSH_INTERVAL_S:
+            _last_flush = now
+            batch = list(_pending)
+            _pending.clear()
+    if batch is not None:
+        _write_batch(batch)
+
+
+_write_warned = False
+
+
+def _write_batch(batch: list) -> None:
+    """Serialize + append one batch.  An unwritable log (missing dir,
+    full disk) DROPS the batch with one warning per process instead of
+    raising: a span close sits inside the production bind path when
+    tracing is armed, and the observability layer must never take it
+    down — the flight-recorder ring keeps the spans either way."""
+    global _sink, _write_warned
+    try:
+        if _sink is None:
+            # Open before taking the guard; a racing double-open leaves one
+            # extra O_APPEND handle to close, never a torn line.
+            fh = open(log_path(), "a", encoding="utf-8")
+            with _sink_guard:
+                if _sink is None:
+                    _sink = fh
+                    fh = None
+            if fh is not None:
+                fh.close()
+        # default=repr: a non-JSON attr value (a set, a custom object)
+        # degrades to its repr instead of poisoning the whole batch —
+        # and whatever json still rejects is caught below, never raised
+        # into the traced bind path.
+        lines = "".join(
+            json.dumps(record, sort_keys=True, default=repr) + "\n"
+            for record in batch
+        )
+        with _sink_guard:
+            _sink.write(lines)
+            _sink.flush()
+    except (OSError, TypeError, ValueError) as e:  # ValueError: closed sink
+        if not _write_warned:
+            _write_warned = True
+            logger.warning(
+                "trace log %s is unwritable (%s): dropping span batches; "
+                "the in-memory flight recorder keeps recording",
+                log_path(), e,
+            )
+
+
+def flush() -> None:
+    """Drain the pending buffer to the log and flush it (readers that
+    consume the log from the SAME process — tests, trace_report's
+    self-check, bench's phase aggregation — call this before reading;
+    cross-process readers wait for the writer's exit hook or its next
+    cadence window)."""
+    with _buf_guard:
+        batch = list(_pending)
+        _pending.clear()
+    if batch:
+        _write_batch(batch)
+    else:
+        with _sink_guard:
+            if _sink is not None:
+                _sink.flush()
+
+
+def recent_spans(limit: Optional[int] = None) -> list:
+    """The flight recorder's recent spans, NEWEST FIRST, bounded by the
+    ring size (and ``limit`` when given).  Cheap: a snapshot of the ring,
+    no file IO — safe to call from an invariant monitor or a debug
+    endpoint while binds are in flight."""
+    with _buf_guard:
+        spans = list(_ring) if _ring is not None else []
+    spans.reverse()
+    if limit is not None:
+        spans = spans[: max(0, limit)]
+    return spans
+
+
+def reset_for_tests() -> None:
+    """Drain pending records, then drop the sink and flight-recorder
+    state so a test can trace into a fresh log file (the lockwitness
+    reset contract)."""
+    global _sink, _ring, _last_flush, _write_warned
+    flush()
+    with _sink_guard:
+        sink, _sink = _sink, None
+    with _buf_guard:
+        _ring = None
+        _pending.clear()
+        _last_flush = 0.0
+    _write_warned = False
+    if sink is not None:
+        sink.close()
+
+
+# --------------------------------------------------------------------- spans
+
+
+class Span:
+    """One traced operation; use ONLY as a context manager (SPAN-HYGIENE).
+
+    The span becomes the context's active span between ``__enter__`` and
+    ``__exit__``; on exit it appends its record to the JSONL log and the
+    flight-recorder ring.  ``set_attr`` attaches small JSON-able values
+    (phase timings, claim uids, node names) — the attribution payload
+    ``trace_report`` prints."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "attrs",
+        "_t0", "_wall0", "_token",
+    )
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str, name: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.attrs: dict = {}
+        self._t0 = 0.0
+        self._wall0 = 0.0
+        self._token = None
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+    def __enter__(self) -> "Span":
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        self._token = _CURRENT.set((self.trace_id, self.span_id))
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        record = {
+            "t": "span",
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "start": round(self._wall0, 6),
+            "dur_ms": round(dur * 1000.0, 3),
+            "pid": _PID,
+            "thread": _thread_name(),
+        }
+        if exc_type is not None:
+            record["error"] = f"{exc_type.__name__}: {exc}"
+        if self.attrs:
+            record["attrs"] = self.attrs
+        _submit(record)
+        return False
+
+
+class _NoopSpan:
+    """The disabled fast path: ONE shared instance, no allocation per
+    call, every method a no-op.  Safe to nest — it keeps no state."""
+
+    __slots__ = ()
+
+    def set_attr(self, key: str, value) -> None:
+        pass
+
+    @property
+    def traceparent(self) -> str:
+        return ""
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def start_span(name: str, parent: Optional[str] = None, attrs: Optional[dict] = None):
+    """Open a span named ``name`` (a LITERAL string — SPAN-HYGIENE).
+
+    Parentage, in priority order: an explicit ``parent`` traceparent
+    string (a remote context from gRPC metadata, a WAL record, or the
+    grant env), else the context's active span, else a fresh root trace.
+    Returns the shared no-op object when tracing is disabled."""
+    if not enabled():
+        return NOOP_SPAN
+    ctx = parse_traceparent(parent) if parent else None
+    if ctx is None:
+        current = _CURRENT.get()
+        if current is not None:
+            ctx = current
+    if ctx is not None:
+        trace_id, parent_id = ctx
+    else:
+        trace_id, parent_id = _new_id(16), ""
+    span = Span(trace_id, _new_id(8), parent_id, name)
+    if attrs:
+        span.attrs.update(attrs)
+    return span
+
+
+def record_span(
+    name: str,
+    wall_start: float,
+    dur_s: float,
+    attrs: Optional[dict] = None,
+) -> None:
+    """Emit a RETROACTIVE span measured with plain counters — for paths
+    hot enough that even the context-manager protocol is measurable (the
+    per-mutate group-commit wait, the per-batch fsync).  The span parents
+    on the context's ACTIVE span but never becomes anyone's parent (it is
+    already over), so concurrent children keep their real lineage.  The
+    disabled cost is one env check."""
+    if not enabled():
+        return
+    current = _CURRENT.get()
+    if current is not None:
+        trace_id, parent_id = current
+    else:
+        trace_id, parent_id = _new_id(16), ""
+    record = {
+        "t": "span",
+        "trace": trace_id,
+        "span": _new_id(8),
+        "parent": parent_id,
+        "name": name,
+        "start": round(wall_start, 6),
+        "dur_ms": round(dur_s * 1000.0, 3),
+        "pid": _PID,
+        "thread": _thread_name(),
+    }
+    if attrs:
+        record["attrs"] = attrs
+    _submit(record)
+
+
+# ------------------------------------------------------------------- reading
+
+
+def read_log(path: str) -> list:
+    """Span records from a JSONL trace log, in file order.  Malformed
+    lines are skipped — a crashed process may tear its final line (the
+    lockwitness read contract)."""
+    spans: list = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("t") == "span" and rec.get("span") and rec.get("trace"):
+                    spans.append(rec)
+    except FileNotFoundError:
+        pass
+    return spans

@@ -43,6 +43,10 @@ class ClaimEnv:
     # Multi-process sharing (MPS analog): the per-claim control daemon's
     # pipe directory, injected by the plugin's CDI edits.
     mp_pipe_dir: str = ""
+    # Trace context of the bind that granted this claim (tpudra/trace.py
+    # TPUDRA_TRACEPARENT): worker ranks open child spans of the member
+    # bind, completing the controller→plugin→rank chain.  "" = untraced.
+    traceparent: str = ""
     # Slice geometry from the grant (cdplugin/libtpuenv.slice_env): the
     # full ICI mesh of the slice and this host's block origin within it.
     # () = not granted (single-host chip claims carry no slice env).
@@ -93,6 +97,7 @@ class ClaimEnv:
                 except ValueError:
                     pass  # garbled → "not granted", like worker_id below
         out.mp_pipe_dir = env.get("TPUDRA_MP_PIPE_DIRECTORY", "")
+        out.traceparent = env.get("TPUDRA_TRACEPARENT", "")
         try:
             out.worker_id = int(env.get("TPU_WORKER_ID", ""))
         except ValueError:
