@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace e2e-multihost soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage e2e-multihost soak image helm-render clean
 
 all: native test
 
@@ -145,6 +145,14 @@ bench-gang:
 # attribution future bind-path PRs cite alongside their p50 deltas.
 bench-trace:
 	set -o pipefail; python bench.py --trace-ab | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Degraded-mode shed A/B (docs/bind-path.md "Storage fault contract"):
+# healthy bind p50 vs the fail-fast typed-error shed path with the
+# checkpoint dir ENOSPC-faulted through the storage seam, plus heal
+# convergence — the bounded-p99 acceptance arm for storage-fault PRs.
+bench-storage:
+	set -o pipefail; python bench.py --storage-degraded | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 # Chaos soak (docs/chaos.md): compound-fault long-run — apiserver latency

@@ -541,6 +541,94 @@ def bench_checkpoint_churn(iters: int = None) -> dict:
     return out
 
 
+def bench_storage_degraded(iters: int = None, warmup: int = None) -> dict:
+    """Degraded-mode shed A/B (`make bench-storage`, docs/bind-path.md
+    "Storage fault contract"): healthy bind p50 vs the fail-fast shed
+    path with the checkpoint dir faulted ENOSPC through the storage seam.
+    The acceptance bar is BOUNDED shed latency — the typed retryable
+    error must come back without flock/checkpoint/disk work — plus proof
+    the node converges back to healthy binds after heal."""
+    import errno
+
+    from tests.test_device_state import mk_claim
+    from tpudra import storage
+    from tpudra.kube import gvr
+
+    iters = ITERS if iters is None else iters
+    warmup = WARMUP if warmup is None else warmup
+    with _bench_driver() as (kube, client, driver):
+        healthy_ms: list[float] = []
+        for i in range(iters + warmup):
+            uid = f"sb-h-{i}"
+            claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            t0 = time.perf_counter()
+            resp = client.prepare([claim])
+            dt = (time.perf_counter() - t0) * 1000.0
+            if "error" in resp["claims"][uid]:
+                raise RuntimeError(f"prepare failed: {resp['claims'][uid]}")
+            client.unprepare([claim])
+            kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            if i >= warmup:
+                healthy_ms.append(dt)
+        # Fault the checkpoint dir and flip the driver degraded with one
+        # full-cost failing bind; every later attempt is a shed.
+        plan = storage.FaultPlan()
+        plugin_dir = driver._config.plugin_dir  # noqa: SLF001 — bench introspection
+        plan.add(op="write", path=plugin_dir, err=errno.ENOSPC, times=None)
+        plan.add(op="fsync", path=plugin_dir, err=errno.ENOSPC, times=None)
+        storage.install_fault_plan(plan)
+        shed_ms: list[float] = []
+        try:
+            first = mk_claim("sb-flip", ["tpu-0"], name="sb-flip")
+            kube.create(gvr.RESOURCE_CLAIMS, first, "default")
+            resp = client.prepare([first])
+            if "error" not in resp["claims"]["sb-flip"]:
+                raise RuntimeError("faulted bind unexpectedly succeeded")
+            if not driver.storage_degraded:
+                raise RuntimeError("driver never entered degraded mode")
+            for i in range(iters + warmup):
+                uid = f"sb-d-{i}"
+                claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                t0 = time.perf_counter()
+                resp = client.prepare([claim])
+                dt = (time.perf_counter() - t0) * 1000.0
+                err = resp["claims"][uid].get("error", "")
+                if storage.DEGRADED_ERROR_PREFIX not in err:
+                    raise RuntimeError(f"expected typed shed error, got: {err!r}")
+                kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+                if i >= warmup:
+                    shed_ms.append(dt)
+        finally:
+            plan.heal()
+            storage.clear_fault_plan()
+        # Heal convergence: the supervisor's probe + compaction must bring
+        # real binds back.
+        deadline = time.monotonic() + 30
+        while driver.storage_degraded and time.monotonic() < deadline:
+            time.sleep(0.05)
+        recovered = not driver.storage_degraded
+        if recovered:
+            post = mk_claim("sb-post", ["tpu-1"], name="sb-post")
+            kube.create(gvr.RESOURCE_CLAIMS, post, "default")
+            resp = client.prepare([post])
+            recovered = "error" not in resp["claims"]["sb-post"]
+            if recovered:
+                client.unprepare([post])
+        shed_sorted = sorted(shed_ms)
+        return {
+            "iters": iters,
+            "healthy_bind_p50_ms": round(statistics.median(healthy_ms), 3),
+            "shed_p50_ms": round(statistics.median(shed_ms), 3),
+            "shed_p99_ms": round(
+                shed_sorted[max(0, int(len(shed_sorted) * 0.99) - 1)], 3
+            ),
+            "shed_max_ms": round(max(shed_ms), 3),
+            "recovered_after_heal": recovered,
+        }
+
+
 def bench_bind_partition_p50() -> dict:
     """Dynamic-partition bind p50 through the NATIVE C++ library.
 
@@ -1857,6 +1945,18 @@ def main(argv=None) -> None:
         line = {
             "metric": "checkpoint_churn",
             **bench_checkpoint_churn(iters=iters),
+        }
+        print(json.dumps(line))
+        return
+
+    if "--storage-degraded" in argv:
+        # The degraded-mode artifact (`make bench-storage`): healthy bind
+        # p50 vs the fail-fast shed path under an ENOSPC-faulted
+        # checkpoint dir, plus heal convergence; CPU-only.
+        argv.remove("--storage-degraded")
+        line = {
+            "metric": "storage_degraded_shed",
+            **bench_storage_degraded(iters=iters, warmup=warmup),
         }
         print(json.dumps(line))
         return

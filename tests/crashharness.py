@@ -69,7 +69,7 @@ class CrashablePlugin:
 
     # Lifecycle ------------------------------------------------------------
 
-    def start(self, crashpoint: str = ""):
+    def start(self, crashpoint: str = "", storage_fault: str = ""):
         env = dict(
             os.environ,
             PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -89,6 +89,14 @@ class CrashablePlugin:
             env.pop("TPUDRA_CRASHPOINT", None)
             env.pop("TPUDRA_TEST_HOOKS", None)
             env.pop("TPUDRA_JOURNAL_MAX_RECORDS", None)
+        if storage_fault:
+            # The ENOSPC/EIO arm (tpudra/storage.py env arming, same
+            # two-key gating): the plugin process runs under a storage
+            # fault plan composed with whatever crashpoint is armed above.
+            env["TPUDRA_STORAGE_FAULT"] = storage_fault
+            env["TPUDRA_TEST_HOOKS"] = "1"
+        else:
+            env.pop("TPUDRA_STORAGE_FAULT", None)
         self.log_i += 1
         self.log_path = os.path.join(self.tmp, f"plugin-{self.log_i}.log")
         with open(self.log_path, "w") as out:

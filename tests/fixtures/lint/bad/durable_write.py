@@ -1,0 +1,29 @@
+"""BAD: persistence writes that dodge the storage seam (DURABLE-WRITE).
+
+Raw write-mode open / os.replace / os.fsync in a persistence module opt
+out of fault injection and the fail-stop durability contract — the exact
+shape of the pre-seam CDI spec write that could lose an acknowledged
+grant across a crash.
+"""
+
+import os
+
+
+def write_snapshot(path: str, data: str) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:  # EXPECT: DURABLE-WRITE
+        f.write(data)
+        os.fsync(f.fileno())  # EXPECT: DURABLE-WRITE
+    os.replace(tmp, path)  # EXPECT: DURABLE-WRITE
+
+
+def append_record(path: str, frame: bytes) -> None:
+    fd = os.open(path, os.O_CREAT | os.O_APPEND | os.O_WRONLY)  # EXPECT: DURABLE-WRITE
+    os.write(fd, frame)  # EXPECT: DURABLE-WRITE
+    os.close(fd)
+
+
+def rotate(path: str) -> None:
+    os.rename(path, path + ".old")  # EXPECT: DURABLE-WRITE
+    with open(path, mode="ab") as f:  # EXPECT: DURABLE-WRITE
+        f.write(b"")

@@ -1,0 +1,38 @@
+"""GOOD: persistence writes routed through the storage seam, reads left
+alone, and a reasoned suppression for a genuine in-place exception."""
+
+import json
+import os
+
+from tpudra import storage
+
+
+def write_spec(path: str, spec: dict) -> None:
+    storage.atomic_replace(path, json.dumps(spec).encode(), site="cdi")
+
+
+def append_frames(path: str, frames: list) -> None:
+    fd = storage.open(path, os.O_CREAT | os.O_APPEND | os.O_WRONLY)
+    try:
+        for frame in frames:
+            storage.write(fd, frame)
+        storage.fsync(fd)
+    finally:
+        storage.close(fd)
+
+
+def read_spec(path: str) -> dict:
+    # Read-mode open is fine: the degraded-mode contract keeps read paths
+    # alive and un-seamed.
+    with open(path) as f:
+        return json.load(f)
+
+
+def stat_size(path: str) -> int:
+    return os.stat(path).st_size
+
+
+def poke_sysfs(path: str, value: str) -> None:
+    # tpudra-lint: disable=DURABLE-WRITE sysfs attribute store: an in-kernel control write with nothing to fsync or rename
+    with open(path, "w") as f:
+        f.write(value)

@@ -237,6 +237,18 @@ class TestMiniSoak:
         # Kind coverage is a short-profile property, not a mini-run one:
         # drop only those failures before asserting the rest are clean.
         failures = [f for f in failures if "never injected" not in f]
+        # Same for acknowledged-mutation-durability: it is only checked at
+        # crash-shaped faults (plugin_crash / torn_wal / disk_fault's
+        # composed SIGKILL), so a mini draw that injected none of those
+        # legitimately has zero checks — the full short profile's shuffled
+        # kind cycle guarantees them.
+        if not {"plugin_crash", "torn_wal", "disk_fault"} & set(
+            loaded["faults"]["by_kind"]
+        ):
+            failures = [
+                f for f in failures
+                if "acknowledged-mutation-durability" not in f
+            ]
         assert failures == [], failures
 
     def test_planted_leak_is_caught_and_replayable(self, tmp_path, monkeypatch):
@@ -479,4 +491,73 @@ class TestCdWaveLatency:
             soak.sim.kube.set_latency(0.0)
             soak._stop.set()
             soak._close_cd_stack()
+            soak.sim.close()
+
+
+class TestDiskFault:
+    """The disk_fault injector: a storage fault plan against one node's
+    checkpoint + CDI dirs — degraded-mode entry (typed shed errors +
+    storage-degraded slice annotation), the composed SIGKILL + restart
+    against the broken dir with acknowledged-mutation durability, and
+    heal convergence."""
+
+    def test_enospc_with_composed_crash_degrades_and_heals(self, tmp_path):
+        # compression 60 (not the mini 450): the heal supervisor probes on
+        # a wall-time backoff, and the wall deadlines derived from sim
+        # budgets must comfortably contain it.
+        soak = ChaosSoak(_mini_config(tmp_path, compression=60.0))
+        soak.sim.start()
+        try:
+            soak._inject({
+                "kind": "disk_fault", "t_sim": 0.0, "node": 1, "point": None,
+                "params": {
+                    "variant": "enospc_write", "compose_crash": True,
+                    "restart_storm": True, "window_sim_s": 10.0,
+                },
+            })
+            record = soak._timeline[-1]
+            assert record.kind == "disk_fault"
+            assert record.params.get("degraded_observed") is True
+            assert record.params.get("shed_max_ms", 1e9) < 250.0
+            assert record.params.get("annotation_cleared") is True
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert soak._checks["acknowledged-mutation-durability"]["ok"] >= 2
+            assert soak._checks["acknowledged-mutation-durability"]["violation"] == 0
+            # Converged: the node binds again and is not degraded.
+            assert not soak.sim.drivers[1].storage_degraded
+            # The monitor's convergence invariant passes over steady state.
+            soak._check_storage_degraded()
+            assert soak._checks["storage-degraded-convergence"]["violation"] == 0
+            assert soak._checks["storage-degraded-convergence"]["ok"] > 0
+        finally:
+            soak._stop.set()
+            soak.sim.close()
+
+    def test_slow_io_variant_binds_through_the_stall(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path, compression=60.0))
+        soak.sim.start()
+        try:
+            soak._inject({
+                "kind": "disk_fault", "t_sim": 0.0, "node": 0, "point": None,
+                "params": {"variant": "slow_io", "window_sim_s": 5.0},
+            })
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert not soak.sim.drivers[0].storage_degraded
+        finally:
+            soak._stop.set()
+            soak.sim.close()
+
+    def test_enospc_once_is_a_retryable_blip(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path, compression=60.0))
+        soak.sim.start()
+        try:
+            soak._inject({
+                "kind": "disk_fault", "t_sim": 0.0, "node": 0, "point": None,
+                "params": {"variant": "enospc_once", "window_sim_s": 5.0},
+            })
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert soak._checks["acknowledged-mutation-durability"]["violation"] == 0
+            assert not soak.sim.drivers[0].storage_degraded
+        finally:
+            soak._stop.set()
             soak.sim.close()

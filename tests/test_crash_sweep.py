@@ -24,6 +24,7 @@ TPUDRA_CRASHPOINT env read by ``device_state._crashpoint``.
 
 import os
 import signal
+import time
 
 import pytest
 
@@ -374,5 +375,82 @@ def test_torn_journal_tail_truncated_on_recovery(short_tmp):
                 _, good, torn = decode_records(f.read())
             assert not torn and good >= good_size
             assert "torn/corrupt tail" in h.log()
+        finally:
+            h.terminate()
+
+
+def test_enospc_failed_bind_then_sigkill_composes(short_tmp):
+    """The ENOSPC arm composed at an existing crash point: the FIRST
+    prepare dies at the journal append (fail-once ENOSPC through the
+    storage seam's env arming) — un-acknowledged, nothing checkpointed,
+    WAL left at a clean frame boundary.  The kubelet-style retry rides
+    through the degraded window (typed retryable shed errors while the
+    heal probe converges) until the bind is acknowledged — at which point
+    the armed ``post-completed`` SIGKILL lands.  The restarted plugin must
+    show the acknowledged mutation durable and serve the idempotent
+    retry: acknowledged-mutation-durability, disk faults notwithstanding.
+    """
+    uid = "crash-enospc-composed"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        h = Harness(short_tmp, server)
+        h.start(
+            crashpoint="post-completed",
+            storage_fault="write:ENOSPC:1:checkpoint.wal",
+        )
+        try:
+            claim = chip_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            crashed = granted = False
+            try:
+                # First attempt: the ENOSPC batch failure — a per-claim
+                # retryable error, never a grant, never a SIGKILL (the
+                # crashpoint sits past the commit that just failed).
+                resp = dra.prepare([claim])
+                result = resp["claims"].get(uid, {})
+                assert "error" in result, result
+                assert uid not in h.claim_statuses()
+                # WAL at a clean frame boundary after the poison rollback.
+                assert h.journal_size() == 0
+                # Retry until acknowledged (shedding may answer while the
+                # in-process heal probe converges) — the SIGKILL then
+                # fires at post-completed.
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        resp = dra.prepare([claim])
+                    except RPCError:
+                        crashed = True
+                        break
+                    entry = resp["claims"].get(uid, {})
+                    if entry.get("devices"):
+                        granted = True
+                        break  # post-completed raced the signal: fine
+                    assert "storage-degraded" in entry.get("error", ""), entry
+                    time.sleep(0.2)
+            finally:
+                dra.close()
+            # The composed scenario actually happened: the retry either
+            # died on the armed SIGKILL mid-RPC or was acknowledged just
+            # before the signal — a deadline exhaustion is a failure.
+            assert crashed or granted
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            # The acknowledged bind IS durable across the kill.
+            assert h.claim_statuses().get(uid) == "PrepareCompleted"
+
+            # Restart with neither the fault nor the crashpoint: the
+            # retry is idempotent and teardown converges to nothing.
+            h.start()
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid].get("devices"), resp
+                dra.unprepare([claim])
+            finally:
+                dra.close()
+            assert uid not in h.claim_statuses()
+            assert not any(uid in f for f in h.cdi_files())
         finally:
             h.terminate()

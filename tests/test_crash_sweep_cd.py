@@ -22,6 +22,7 @@ against a real process death rather than an injected exception.
 
 import os
 import signal
+import time
 
 import pytest
 
@@ -323,5 +324,75 @@ def test_cd_torn_journal_tail_truncated_on_recovery(short_tmp):
                 _, good, torn = decode_records(f.read())
             assert not torn and good >= good_size
             assert "torn/corrupt tail" in h.log()
+        finally:
+            h.terminate()
+
+
+def test_cd_eio_fsync_failed_bind_then_sigkill_composes(short_tmp):
+    """The EIO-on-fsync (fsyncgate) arm composed at an existing crash
+    point, CD twin of the TPU sweep's ENOSPC arm: the first channel
+    prepare's journal fsync fails once — the batch is un-acknowledged,
+    the poisoned fd's bytes are rolled back to a clean frame boundary,
+    and NO side effect may survive (no node label, no CDI spec for an
+    un-acknowledged claim is the whole point of phase ordering).  The
+    retry rides through the degraded window until acknowledged, the armed
+    ``post-completed`` SIGKILL lands, and the restarted plugin shows the
+    acknowledged mutation durable."""
+    uid = "cd-crash-eio-composed"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        seed_cluster(client)
+        h = CDHarness(short_tmp, server)
+        h.start(
+            crashpoint="post-completed",
+            storage_fault="fsync:EIO:1:checkpoint.wal",
+        )
+        try:
+            claim = channel_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                result = resp["claims"].get(uid, {})
+                assert "error" in result, result
+                assert uid not in h.claim_statuses()
+                assert h.journal_size() == 0  # poison rollback boundary
+                # The failed begin commit means the intent was never
+                # durable, so no side effect may have run.
+                assert node_label(client) is None
+                assert not any(uid in f for f in h.cdi_files())
+                crashed = granted = False
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        resp = dra.prepare([claim])
+                    except RPCError:
+                        crashed = True
+                        break  # SIGKILL at post-completed: expected
+                    entry = resp["claims"].get(uid, {})
+                    if entry.get("devices"):
+                        granted = True
+                        break  # answered before the signal landed: fine
+                    assert "storage-degraded" in entry.get("error", ""), entry
+                    time.sleep(0.2)
+                # The composed scenario actually happened — deadline
+                # exhaustion (neither crash nor grant) is a failure.
+                assert crashed or granted
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            assert h.claim_statuses().get(uid) == "PrepareCompleted"
+
+            h.start()
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid].get("devices"), resp
+                dra.unprepare([claim])
+            finally:
+                dra.close()
+            assert uid not in h.claim_statuses()
+            assert node_label(client) is None
         finally:
             h.terminate()

@@ -1,0 +1,527 @@
+"""The storage seam + the fail-stop durability contract + degraded mode
+(docs/bind-path.md "Storage fault contract").
+
+Everything here injects disk misbehavior through ``tpudra/storage.py``'s
+fault plans — no ``os`` monkeypatching — and pins the three layers the
+disk_fault soak kind composes at speed:
+
+- **journal poisoning** (fsyncgate): a failed write/fsync fails the whole
+  un-acknowledged batch, never retry-fsyncs dirty pages, rolls the WAL
+  back to a clean frame boundary, and recovers by reopening from
+  known-durable bytes;
+- **snapshot fail-stop**: a failed tmp fsync never ``os.replace``s over
+  the good checkpoint file;
+- **degraded mode**: a driver whose checkpoint cannot persist sheds
+  prepare/unprepare fail-fast with the typed retryable error, keeps
+  reads/publication alive, advertises the storage-degraded slice
+  annotation (which gang spare selection filters on), and auto-recovers
+  through the heal probe + convergent compaction.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import time
+
+import pytest
+from prometheus_client import REGISTRY
+
+from tpudra import storage
+from tpudra.plugin import journal
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    CheckpointManager,
+    PreparedClaim,
+    PreparedDeviceGroup,
+)
+
+
+def sample(name: str, labels: dict | None = None) -> float:
+    return REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    yield
+    storage.clear_fault_plan()
+
+
+def put_claim(uid: str, status: str = PREPARE_COMPLETED):
+    def mutate(cp):
+        cp.prepared_claims[uid] = PreparedClaim(
+            uid=uid, namespace="default", name=uid, status=status,
+            groups=[PreparedDeviceGroup()],
+        )
+
+    return mutate
+
+
+# --------------------------------------------------------------- fault plan
+
+
+class TestFaultPlan:
+    def test_path_scoping_and_fail_once(self, tmp_path):
+        plan = storage.FaultPlan()
+        plan.add(op="write", path="/p1/", err=errno.ENOSPC, times=1)
+        assert plan.match("write", "/base/p12/checkpoint.wal") is None
+        assert plan.match("fsync", "/base/p1/checkpoint.wal") is None
+        assert plan.match("write", "/base/p1/checkpoint.wal") is not None
+        # fail-once: the second match is a miss.
+        assert plan.match("write", "/base/p1/checkpoint.wal") is None
+        assert plan.fired_total() == 1
+
+    def test_until_healed_and_heal(self):
+        plan = storage.FaultPlan()
+        plan.add(op="fsync", err=errno.EIO, times=None)
+        for _ in range(3):
+            assert plan.match("fsync", "/anything") is not None
+        plan.heal()
+        assert plan.match("fsync", "/anything") is None
+
+    def test_injected_errno_counts_metric(self, tmp_path):
+        before = sample(
+            "tpudra_storage_faults_total", {"op": "fsync", "errno": "EIO"}
+        )
+        path = str(tmp_path / "f")
+        with storage.fault_plan(op="fsync", err=errno.EIO, times=1):
+            fd = storage.open(path, os.O_CREAT | os.O_WRONLY)
+            try:
+                with pytest.raises(OSError) as ei:
+                    storage.fsync(fd)
+            finally:
+                storage.close(fd)
+            assert ei.value.errno == errno.EIO
+        assert sample(
+            "tpudra_storage_faults_total", {"op": "fsync", "errno": "EIO"}
+        ) == before + 1
+
+    def test_env_arming_two_key(self, monkeypatch):
+        monkeypatch.setenv(storage.ENV_FAULT, "write:ENOSPC:1:checkpoint.wal")
+        monkeypatch.delenv("TPUDRA_TEST_HOOKS", raising=False)
+        assert storage._plan_from_env() is None  # hooks key missing: inert
+        monkeypatch.setenv("TPUDRA_TEST_HOOKS", "1")
+        plan = storage._plan_from_env()
+        rule = plan.match("write", "/p/checkpoint.wal")
+        assert rule is not None and rule.err == errno.ENOSPC
+        assert plan.match("write", "/p/checkpoint.wal") is None  # times=1
+
+    def test_env_arming_inf_and_garbage(self, monkeypatch):
+        monkeypatch.setenv("TPUDRA_TEST_HOOKS", "1")
+        monkeypatch.setenv(storage.ENV_FAULT, "fsync:EIO:inf")
+        plan = storage._plan_from_env()
+        for _ in range(4):
+            assert plan.match("fsync", "/x") is not None
+        monkeypatch.setenv(storage.ENV_FAULT, "fsync:NOT_AN_ERRNO:1")
+        with pytest.raises(ValueError):
+            storage._plan_from_env()
+
+    def test_atomic_replace_failure_leaves_no_tmp(self, tmp_path):
+        path = str(tmp_path / "spec.json")
+        with storage.fault_plan(op="replace", err=errno.EROFS, times=1):
+            with pytest.raises(OSError):
+                storage.atomic_replace(path, b"{}", site="test")
+        assert not os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")
+
+
+# --------------------------------------------- journal fail-stop poisoning
+
+
+class TestJournalPoisoning:
+    def test_failed_fsync_fails_batch_without_false_ack(self, tmp_path):
+        """fsyncgate: the batch whose fsync failed is NOT acknowledged,
+        the writer never retry-fsyncs the same fd, and after the fault the
+        manager recovers by reopening from known-durable bytes."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.mutate(put_claim("durable"), touched=["durable"])
+        with storage.fault_plan(op="fsync", path="checkpoint.wal", err=errno.EIO, times=1):
+            with pytest.raises(OSError):
+                mgr.mutate(put_claim("lost"), touched=["lost"])
+        assert mgr.storage_degraded
+        # Not acknowledged ⇒ not present: neither through this manager nor
+        # through a cold-start recovery over the same dir.
+        assert "lost" not in mgr.read().prepared_claims
+        fresh = CheckpointManager(str(tmp_path))
+        assert set(fresh.read().prepared_claims) == {"durable"}
+        # Fault exhausted: the next mutate lands on a reopened fd and
+        # clears the degraded flag (a proven durable write is the heal).
+        mgr.mutate(put_claim("after"), touched=["after"])
+        assert not mgr.storage_degraded
+        assert set(
+            CheckpointManager(str(tmp_path)).read().prepared_claims
+        ) == {"durable", "after"}
+
+    def test_enospc_mid_append_leaves_clean_frame_boundary(self, tmp_path):
+        """A partial frame lands, ENOSPC kills the rest: the poison
+        rollback must cut the WAL back to the last acknowledged frame."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.mutate(put_claim("a"), touched=["a"])
+        boundary = os.path.getsize(mgr.journal_path)
+        with storage.fault_plan(
+            op="write", path="checkpoint.wal", err=errno.ENOSPC,
+            times=1, partial_bytes=7,
+        ):
+            with pytest.raises(OSError):
+                mgr.mutate(put_claim("b"), touched=["b"])
+        assert os.path.getsize(mgr.journal_path) == boundary
+        records, good, torn = journal.decode_records(
+            open(mgr.journal_path, "rb").read()
+        )
+        assert not torn and good == boundary
+        # Convergent repair on heal: the retried mutate succeeds and both
+        # claims survive a cold-start recovery.
+        mgr.mutate(put_claim("b"), touched=["b"])
+        assert set(
+            CheckpointManager(str(tmp_path)).read().prepared_claims
+        ) == {"a", "b"}
+
+    def test_blocked_rollback_repairs_at_next_commit(self, tmp_path):
+        """When the rollback truncate ALSO fails (the disk is still
+        refusing work), the torn tail stays — and must be dropped by CRC
+        at replay and repaired by the next successful commit."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.mutate(put_claim("a"), touched=["a"])
+        boundary = os.path.getsize(mgr.journal_path)
+        plan = storage.FaultPlan()
+        plan.add(op="write", path="checkpoint.wal", err=errno.ENOSPC,
+                 times=1, partial_bytes=7)
+        plan.add(op="truncate", path="checkpoint.wal", err=errno.EIO, times=None)
+        plan.add(op="open", path="checkpoint.wal", err=errno.EIO, times=None)
+        with storage.fault_plan(plan):
+            with pytest.raises(OSError):
+                mgr.mutate(put_claim("b"), touched=["b"])
+        assert os.path.getsize(mgr.journal_path) == boundary + 7
+        # Reads drop the torn tail loudly; the un-acknowledged bytes never
+        # surface as state.
+        assert set(mgr.read().prepared_claims) == {"a"}
+        # Heal: the next commit's good-frame repair truncates the tail and
+        # appends cleanly.
+        mgr.mutate(put_claim("b"), touched=["b"])
+        data = open(mgr.journal_path, "rb").read()
+        records, good, torn = journal.decode_records(data)
+        assert not torn and good == len(data)
+        assert set(
+            CheckpointManager(str(tmp_path)).read().prepared_claims
+        ) == {"a", "b"}
+
+    def test_acknowledged_mutation_survives_abandon(self, tmp_path):
+        """The acknowledgment rule: mutate() returning IS the durability
+        promise — a SIGKILL-shaped abandon right after must lose nothing."""
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.mutate(put_claim("acked"), touched=["acked"])
+        mgr.abandon()
+        assert "acked" in CheckpointManager(str(tmp_path)).read().prepared_claims
+
+
+# ------------------------------------------------------ snapshot fail-stop
+
+
+class TestSnapshotFailStop:
+    def test_failed_snapshot_fsync_never_replaces_good_file(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), journal=False)
+        mgr.mutate(put_claim("good"))
+        before = open(mgr.path).read()
+        with storage.fault_plan(
+            op="fsync", path="checkpoint.json.tmp", err=errno.ENOSPC, times=None
+        ):
+            with pytest.raises(OSError):
+                mgr.mutate(put_claim("doomed"))
+        assert open(mgr.path).read() == before
+        assert not os.path.exists(mgr.path + ".tmp")
+        assert mgr.storage_degraded
+        assert set(
+            CheckpointManager(str(tmp_path), journal=False).read().prepared_claims
+        ) == {"good"}
+
+    def test_try_recover_probe_and_convergent_compaction(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.mutate(put_claim("a"), touched=["a"])
+        assert os.path.getsize(mgr.journal_path) > 0
+        with storage.fault_plan(op="write", err=errno.ENOSPC, times=None):
+            with pytest.raises(OSError):
+                mgr.mutate(put_claim("b"), touched=["b"])
+            assert mgr.storage_degraded
+            # Probe fails while the disk is broken: stays degraded.
+            assert not mgr.try_recover()
+            assert mgr.storage_degraded
+        # Healed: probe passes, the compaction rewrite folds the WAL into
+        # a fresh dual-version snapshot and truncates it.
+        assert mgr.try_recover()
+        assert not mgr.storage_degraded
+        assert os.path.getsize(mgr.journal_path) == 0
+        assert set(
+            CheckpointManager(str(tmp_path)).read().prepared_claims
+        ) == {"a"}
+        assert sample(
+            "tpudra_checkpoint_compactions_total", {"reason": "storage-heal"}
+        ) >= 1
+
+
+# ------------------------------------------------------------ CDI durability
+
+
+class TestCDIDurability:
+    def test_cdi_spec_write_is_durable(self, tmp_path):
+        """Regression for the tmp+rename-with-no-fsync CDI write: the spec
+        now goes through atomic_replace — one file fsync + one directory
+        fsync per write, counted under site=cdi."""
+        from tpudra.plugin.cdi import CDIHandler, ContainerEdits
+
+        handler = CDIHandler(str(tmp_path))
+        before = sample("tpudra_storage_fsyncs_total", {"site": "cdi"})
+        ids = handler.create_claim_spec_file(
+            "uid-1", {"tpu-0": ContainerEdits(env=["A=1"])}
+        )
+        assert ids
+        assert (
+            sample("tpudra_storage_fsyncs_total", {"site": "cdi"})
+            == before + 2
+        )
+        spec = handler.read_claim_spec("uid-1")
+        assert spec["devices"][0]["name"] == "uid-1-tpu-0"
+        assert not os.path.exists(handler.spec_path("uid-1") + ".tmp")
+
+    def test_cdi_spec_write_fault_leaves_no_torn_spec(self, tmp_path):
+        from tpudra.plugin.cdi import CDIHandler, ContainerEdits
+
+        handler = CDIHandler(str(tmp_path))
+        handler.create_claim_spec_file(
+            "uid-1", {"tpu-0": ContainerEdits(env=["A=1"])}
+        )
+        good = handler.read_claim_spec("uid-1")
+        with storage.fault_plan(op="fsync", err=errno.EIO, times=None):
+            with pytest.raises(OSError):
+                handler.create_claim_spec_file(
+                    "uid-1", {"tpu-0": ContainerEdits(env=["A=2"])}
+                )
+        assert handler.read_claim_spec("uid-1") == good
+
+
+# --------------------------------------------------- degraded-mode driver
+
+
+def _mk_driver(tmp_path):
+    from tpudra.devicelib import MockTopologyConfig
+    from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.kube.fake import FakeKube
+    from tpudra.plugin.driver import Driver, DriverConfig
+
+    kube = FakeKube()
+    lib = MockDeviceLib(
+        config=MockTopologyConfig(generation="v5p"),
+        state_file=str(tmp_path / "hw.json"),
+    )
+    driver = Driver(
+        DriverConfig(
+            node_name="node-a",
+            plugin_dir=str(tmp_path / "plugin"),
+            registry_dir=str(tmp_path / "registry"),
+            cdi_root=str(tmp_path / "cdi"),
+            claim_cache=False,
+        ),
+        kube,
+        lib,
+    )
+    return kube, driver
+
+
+def _node_slices(kube):
+    from tpudra.kube import gvr
+
+    return [
+        s
+        for s in kube.list(gvr.RESOURCE_SLICES).get("items", [])
+        if s.get("spec", {}).get("nodeName") == "node-a"
+    ]
+
+
+class TestDegradedModeDriver:
+    def test_shed_annotate_and_heal(self, tmp_path):
+        from tests.test_device_state import mk_claim
+        from tpudra.plugin.resourceslice import SLICE_STORAGE_DEGRADED_ANNOTATION
+
+        kube, driver = _mk_driver(tmp_path)
+        driver.start_storage_supervisor()
+        try:
+            plugin_dir = str(tmp_path / "plugin")
+            claim = mk_claim("c1", ["tpu-0"], name="c1")
+            resp = driver.prepare_resource_claims([claim])
+            assert "error" not in resp["claims"]["c1"]
+            driver.unprepare_resource_claims([{"uid": "c1"}])
+            with storage.fault_plan(
+                op="write", path=plugin_dir, err=errno.ENOSPC, times=None
+            ):
+                # First bind pays the full failed-commit cost and flips
+                # the degraded flag...
+                resp = driver.prepare_resource_claims([mk_claim("c2", ["tpu-0"], name="c2")])
+                assert resp["claims"]["c2"].get("error")
+                assert driver.storage_degraded
+                shed_before = sample(
+                    "tpudra_storage_shed_total", {"op": "prepare"}
+                )
+                # ...every later batch sheds FAIL-FAST with the typed
+                # retryable error, no flock, no checkpoint IO.
+                t0 = time.perf_counter()
+                resp = driver.prepare_resource_claims(
+                    [mk_claim("c3", ["tpu-1"], name="c3")]
+                )
+                shed_ms = (time.perf_counter() - t0) * 1000.0
+                entry = resp["claims"]["c3"]
+                assert storage.DEGRADED_ERROR_PREFIX in entry["error"]
+                assert entry["permanent"] is False
+                assert shed_ms < 100.0, f"shed took {shed_ms:.1f} ms"
+                assert (
+                    sample("tpudra_storage_shed_total", {"op": "prepare"})
+                    == shed_before + 1
+                )
+                un = driver.unprepare_resource_claims([{"uid": "c2"}])
+                assert storage.DEGRADED_ERROR_PREFIX in un["claims"]["c2"]["error"]
+                # Read paths + publication stay alive: slices publish WITH
+                # the storage-degraded annotation.
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    slices = _node_slices(kube)
+                    if slices and all(
+                        s["metadata"]["annotations"].get(
+                            SLICE_STORAGE_DEGRADED_ANNOTATION
+                        )
+                        == "true"
+                        for s in slices
+                    ):
+                        break
+                    time.sleep(0.05)
+                else:
+                    pytest.fail("storage-degraded annotation never published")
+            # Heal: the supervisor's probe + compaction converge the node
+            # back — flag dropped, annotation gone, binds granted.
+            deadline = time.monotonic() + 15
+            while driver.storage_degraded and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not driver.storage_degraded
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                slices = _node_slices(kube)
+                if slices and not any(
+                    SLICE_STORAGE_DEGRADED_ANNOTATION
+                    in s["metadata"]["annotations"]
+                    for s in slices
+                ):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("storage-degraded annotation never cleared")
+            resp = driver.prepare_resource_claims(
+                [mk_claim("c4", ["tpu-2"], name="c4")]
+            )
+            assert "error" not in resp["claims"]["c4"], resp
+        finally:
+            driver.stop()
+
+    def test_acknowledged_bind_survives_fault_window(self, tmp_path):
+        """A claim acknowledged BEFORE the disk broke is still in the
+        recovered checkpoint after the fault window + heal."""
+        from tests.test_device_state import mk_claim
+
+        kube, driver = _mk_driver(tmp_path)
+        try:
+            resp = driver.prepare_resource_claims(
+                [mk_claim("anchor", ["tpu-0"], name="anchor")]
+            )
+            assert "error" not in resp["claims"]["anchor"]
+            with storage.fault_plan(
+                op="fsync", path=str(tmp_path / "plugin"),
+                err=errno.EIO, times=None,
+            ):
+                resp = driver.prepare_resource_claims(
+                    [mk_claim("x", ["tpu-1"], name="x")]
+                )
+                assert resp["claims"]["x"].get("error")
+            # Cold recovery over the same dir: the acknowledged bind is
+            # there, the failed one is not.
+            recovered = CheckpointManager(str(tmp_path / "plugin")).read()
+            assert "anchor" in recovered.prepared_claims
+            assert "x" not in recovered.prepared_claims
+        finally:
+            driver.stop()
+
+
+class TestWireShed:
+    def test_grpc_handlers_shed_before_claim_resolution(self, tmp_path):
+        """The kubelet-path shed: a degraded node refuses the batch at the
+        gRPC handler, BEFORE any claim-reference resolution — proven by
+        shedding claims that have no API object at all (a resolve would
+        404, a shed answers with the typed error)."""
+        from tpudra.plugin.grpcserver import DRAClient
+
+        _kube, driver = _mk_driver(tmp_path)
+        driver.start()
+        client = DRAClient(driver.sockets.dra_socket_path)
+        try:
+            with storage.fault_plan(
+                op="write", path=str(tmp_path / "plugin"),
+                err=errno.ENOSPC, times=None,
+            ):
+                from tests.test_device_state import mk_claim
+
+                # Flip degraded with one full-cost failing bind (this one
+                # resolves, so it needs a real API object).
+                real = mk_claim("flip", ["tpu-0"], name="flip")
+                from tpudra.kube import gvr
+
+                _kube.create(gvr.RESOURCE_CLAIMS, real, "default")
+                resp = client.prepare([real])
+                assert resp["claims"]["flip"].get("error")
+                assert driver.storage_degraded
+                ghost = {
+                    "metadata": {
+                        "uid": "ghost", "namespace": "default", "name": "ghost",
+                    }
+                }
+                resp = client.prepare([ghost])
+                err = resp["claims"]["ghost"]["error"]
+                assert storage.DEGRADED_ERROR_PREFIX in err
+                assert "resolve claim" not in err  # never reached the resolver
+                resp = client.unprepare([ghost])
+                assert storage.DEGRADED_ERROR_PREFIX in resp["claims"]["ghost"]["error"]
+        finally:
+            client.close()
+            driver.stop()
+
+
+# ------------------------------------------- controller placement avoidance
+
+
+class TestPlacementAvoidsDegradedNodes:
+    def test_spare_selection_filters_storage_degraded(self):
+        from tpudra.controller.gang import select_healthy_spares
+        from tpudra.kube import gvr
+        from tpudra.kube.fake import FakeKube
+        from tpudra.plugin.resourceslice import (
+            SLICE_STORAGE_DEGRADED_ANNOTATION,
+            SLICE_UNHEALTHY_ANNOTATION,
+        )
+
+        kube = FakeKube()
+        for node, extra in (
+            ("n-healthy", {}),
+            ("n-degraded", {SLICE_STORAGE_DEGRADED_ANNOTATION: "true"}),
+        ):
+            kube.create(
+                gvr.RESOURCE_SLICES,
+                {
+                    "metadata": {
+                        "name": f"{node}-slice",
+                        "annotations": {SLICE_UNHEALTHY_ANNOTATION: "0", **extra},
+                    },
+                    "spec": {
+                        "driver": "tpu.google.com",
+                        "nodeName": node,
+                        "devices": [{"name": "tpu-0"}, {"name": "tpu-1"}],
+                    },
+                },
+                None,
+            )
+        got = select_healthy_spares(kube, ["n-healthy", "n-degraded"])
+        assert got == ["n-healthy"]

@@ -87,6 +87,9 @@ def main() -> int:
     gang = current_headline(sys.argv[1], metric="gang_bind")
     if gang is not None:
         print_gang_section(gang)
+    storage = current_headline(sys.argv[1], metric="storage_degraded_shed")
+    if storage is not None:
+        print_storage_section(storage)
     trace_ab = current_headline(sys.argv[1], metric="trace_overhead")
     if trace_ab is not None:
         print_trace_section(trace_ab)
@@ -133,6 +136,22 @@ def print_apiserver_section(now: dict) -> None:
         f"(batch of {n}): cached {cached} ms vs per-claim-GET {uncached} ms "
         f"({ab.get('improvement_ms', round(uncached - cached, 3))} ms "
         f"left the hot path; ~{n} serialized GET RTTs = {n * rtt:g} ms)"
+    )
+
+
+def print_storage_section(shed: dict) -> None:
+    """The `--storage-degraded` A/B (make bench-storage, docs/bind-path.md
+    "Storage fault contract"): fail-fast shed latency under a faulted
+    checkpoint dir vs the healthy bind, plus the heal-convergence bit."""
+    if "error" in shed:
+        print(f"bench-delta: storage section errored: {shed['error']}")
+        return
+    print(
+        "bench-delta: storage-degraded shed: "
+        f"p50 {shed.get('shed_p50_ms')} ms / p99 {shed.get('shed_p99_ms')} ms "
+        f"/ max {shed.get('shed_max_ms')} ms (typed retryable error) vs "
+        f"healthy bind p50 {shed.get('healthy_bind_p50_ms')} ms; "
+        f"recovered after heal: {shed.get('recovered_after_heal')}"
     )
 
 

@@ -30,13 +30,23 @@ import sys
 #: (lock-witness is only required when the report says it was armed;
 #: slice-convergence/slice-health/grant-health only assert in quiet
 #: windows, so a fault-saturated short run may legitimately end with zero
-#: passes of those).
-REQUIRED_CHECKED = ("claim-stuck", "cdi-leak", "flock-leak", "gang-degraded")
+#: passes of those).  acknowledged-mutation-durability is asserted at
+#: every crash-shaped recovery (plugin_crash / torn_wal / disk_fault's
+#: composed SIGKILL) and storage-degraded-convergence on every monitor
+#: pass — a run that skipped either proves nothing about the disk.
+REQUIRED_CHECKED = (
+    "claim-stuck",
+    "cdi-leak",
+    "flock-leak",
+    "gang-degraded",
+    "acknowledged-mutation-durability",
+    "storage-degraded-convergence",
+)
 
 #: Fault kinds every soak run must have injected at least once — checked
 #: against the INJECTED set, not just the configured one, so a run whose
-#: config silently dropped chip_fault or daemon_crash (the health/daemon
-#: blast radius) cannot pass the gate.
+#: config silently dropped chip_fault, daemon_crash, or disk_fault (the
+#: health/daemon/storage blast radii) cannot pass the gate.
 REQUIRED_KINDS = (
     "apiserver_latency",
     "watch_close",
@@ -47,6 +57,7 @@ REQUIRED_KINDS = (
     "cd_wave",
     "chip_fault",
     "daemon_crash",
+    "disk_fault",
 )
 
 
@@ -159,7 +170,7 @@ def main(argv=None) -> int:
     parser.add_argument("report", help="path to the soak's JSON report")
     parser.add_argument("--assert-slo", action="store_true")
     parser.add_argument("--min-sim-hours", type=float, default=1.0)
-    parser.add_argument("--min-faults", type=int, default=9)
+    parser.add_argument("--min-faults", type=int, default=10)
     args = parser.parse_args(argv)
     with open(args.report) as f:
         report = json.load(f)

@@ -33,6 +33,7 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
+    from tpudra.analysis.rules.durable_write import DurableWrite
     from tpudra.analysis.rules.exc_swallow import ExcSwallow
     from tpudra.analysis.rules.lockgraph import (
         BlockUnderLockIP,
@@ -56,6 +57,7 @@ def all_rules() -> list[Rule]:
         MetricsHygiene(),
         ExcSwallow(),
         SpanHygiene(),
+        DurableWrite(),
         LockCycle(lockgraph),
         BlockUnderLockIP(lockgraph),
         FlockInversion(lockgraph),

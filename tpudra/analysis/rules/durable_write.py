@@ -1,0 +1,126 @@
+"""DURABLE-WRITE: persistence-layer writes go through the storage seam.
+
+The plugins' crash-safety contracts (fail-stop fsync poisoning, the
+tmp-fsync → rename → dir-fsync atomic idiom, degraded-mode detection,
+disk-fault injection — docs/bind-path.md "Storage fault contract") only
+hold for bytes that travel through ``tpudra/storage.py``.  A new call
+site that writes a checkpoint/CDI-adjacent file with raw ``open(...,
+"w")`` or ``os.replace`` silently opts out of all of it: the chaos soak's
+``disk_fault`` kind cannot fail it, a crashed rename can lose it, and a
+failed fsync on it goes unnoticed — exactly how the pre-seam CDI spec
+write lost acknowledged grants.
+
+So, in the persistence modules (scope below), the raw durable-write
+primitives — write-mode builtin ``open``, ``os.open``/``os.write``/
+``os.fsync``/``os.replace``/``os.rename``/``os.ftruncate`` — are
+findings; route the write through ``storage.atomic_replace`` /
+``storage.write_file`` / the fd ops instead.  Read-mode ``open`` and
+stat-family calls are untouched (the degraded-mode contract keeps read
+paths alive and un-seamed).  Deliberate exceptions carry a reasoned
+suppression: the in-place ``/etc/hosts`` rewrite (rename onto a
+bind-mount target fails EBUSY) and sysfs attribute stores (in-kernel
+control writes with nothing to make durable).
+
+Scope is the module list, not the whole tree: trace/lockwitness logs, the
+mock devicelib's simulated silicon, and report sinks are measurement
+apparatus whose durability is not load-bearing, and dragging them through
+the seam would only manufacture suppression noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+#: The persistence layer: everything the plugins' crash-safety story
+#: depends on.  (cddaemon/coordproxy.py is deliberately out of scope: its
+#: registration files are liveness-probed and rewritten on a cadence, so
+#: crash durability is not load-bearing there.)  The two fixture paths
+#: keep the rule demonstrable in the lint corpus.
+SCOPE_SUFFIXES = (
+    "tpudra/plugin/cdi.py",
+    "tpudra/plugin/checkpoint.py",
+    "tpudra/plugin/journal.py",
+    "tpudra/plugin/vfio.py",
+    "tpudra/cdplugin/computedomain.py",
+    "tpudra/cdplugin/state.py",
+    "tpudra/cddaemon/dnsnames.py",
+    "fixtures/lint/bad/durable_write.py",
+    "fixtures/lint/good/durable_write.py",
+)
+
+#: os.<name> spellings that put bytes on disk (or move them) — the seam's
+#: job.  Stat/close/read-side os calls are not listed.
+OS_WRITE_CALLS = frozenset(
+    {"open", "write", "fsync", "replace", "rename", "ftruncate"}
+)
+
+_WRITE_MODE_CHARS = frozenset("wax+")
+
+
+def _in_scope(path: str) -> bool:
+    return path.replace(os.sep, "/").endswith(SCOPE_SUFFIXES)
+
+
+def _open_mode(call: ast.Call):
+    """The mode argument of a builtin open() call, or None."""
+    if len(call.args) > 1:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            return kw.value
+    return None
+
+
+class DurableWrite(Rule):
+    rule_id = "DURABLE-WRITE"
+    description = (
+        "persistence-module file writes route through tpudra.storage "
+        "(the fault-injectable seam / atomic durable-write helpers), "
+        "never raw open('w')/os.replace/os.fsync"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        if not _in_scope(module.path):
+            return []
+        out: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "os"
+                and func.attr in OS_WRITE_CALLS
+            ):
+                out.append(
+                    self.finding(
+                        module, node,
+                        f"raw os.{func.attr} in a persistence module: "
+                        "route it through tpudra.storage so fault "
+                        "injection and the fail-stop durability contract "
+                        "cover this call site",
+                    )
+                )
+            elif isinstance(func, ast.Name) and func.id == "open":
+                mode = _open_mode(node)
+                if (
+                    isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)
+                    and set(mode.value) & _WRITE_MODE_CHARS
+                ):
+                    out.append(
+                        self.finding(
+                            module, node,
+                            "write-mode open() in a persistence module: "
+                            "use storage.atomic_replace / "
+                            "storage.write_file (the fault-injectable "
+                            "seam) so a crash or a misbehaving disk "
+                            "cannot silently lose or tear this file",
+                        )
+                    )
+        return out

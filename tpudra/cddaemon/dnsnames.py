@@ -17,6 +17,8 @@ from __future__ import annotations
 import logging
 import os
 
+from tpudra import storage
+
 logger = logging.getLogger(__name__)
 
 DNS_NAME_FORMAT = "compute-domain-daemon-%04d"
@@ -46,8 +48,12 @@ class DNSNameManager:
 
         content = "\n".join(line(i) for i in range(self._max_nodes)) + "\n"
         os.makedirs(os.path.dirname(self._nodes_config_path) or ".", exist_ok=True)
-        with open(self._nodes_config_path, "w") as f:
-            f.write(content)
+        # Atomic durable write through the storage seam: the slice daemon
+        # reads this at startup, and a half-written peer list after a
+        # crash would feed it a truncated world view.
+        storage.atomic_replace(
+            self._nodes_config_path, content.encode(), site="dnsnames-config"
+        )
         return self._nodes_config_path
 
     def update_hosts_file(self, ips_by_index: dict[int, str]) -> bool:
@@ -77,6 +83,10 @@ class DNSNameManager:
         # In-place write, NOT an atomic rename: kubelet bind-mounts /etc/hosts
         # as a single file, and rename(2) onto a bind-mount target fails with
         # EBUSY (the reference writes in place too, dnsnames.go:183).
+        # Durability is not load-bearing either — the pod's /etc/hosts is
+        # reconstructed by kubelet on restart and the next membership event
+        # rewrites the managed block.
+        # tpudra-lint: disable=DURABLE-WRITE deliberate in-place /etc/hosts rewrite: rename onto a bind-mount target fails EBUSY, and kubelet regenerates the file on pod restart so crash durability buys nothing
         with open(self._hosts_path, "w") as f:
             f.write(new)
         logger.info("updated %s with %d peer mappings", self._hosts_path, len(ips_by_index))

@@ -13,6 +13,7 @@ import logging
 import os
 from typing import Optional
 
+from tpudra import storage
 from tpudra.api.computedomain import (
     COMPUTE_DOMAIN_NODE_LABEL,
     COMPUTE_DOMAIN_STATUS_READY,
@@ -145,9 +146,14 @@ class ComputeDomainManager:
             "COORDINATOR_DIR": DAEMON_CD_MOUNT,
         }
         env.update(libtpu_env or {})
-        with open(os.path.join(d, "daemon.env"), "w") as f:
-            for k, v in sorted(env.items()):
-                f.write(f"{k}={v}\n")
+        # Atomic durable write (storage seam): the daemon claim's CDI grant
+        # mounts this file, and an acknowledged channel prepare must never
+        # leave a torn/absent daemon.env behind a crash.
+        content = "".join(f"{k}={v}\n" for k, v in sorted(env.items()))
+        storage.atomic_replace(
+            os.path.join(d, "daemon.env"), content.encode(),
+            site="cd-daemon-settings",
+        )
         return env
 
     def cleanup_daemon_settings(self, uid: str) -> None:

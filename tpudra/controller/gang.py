@@ -60,7 +60,10 @@ from tpudra.plugin.checkpoint import (
     PreparedDeviceGroup,
 )
 from tpudra.plugin.device_state import _crashpoint
-from tpudra.plugin.resourceslice import SLICE_UNHEALTHY_ANNOTATION
+from tpudra.plugin.resourceslice import (
+    SLICE_STORAGE_DEGRADED_ANNOTATION,
+    SLICE_UNHEALTHY_ANNOTATION,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -874,10 +877,20 @@ class NodeSliceHealth:
     node: str
     advertised: int  # devices currently advertised
     unhealthy: int  # withheld-for-health count (SLICE_UNHEALTHY_ANNOTATION)
+    #: The node's plugin checkpoint cannot persist (binds are being shed
+    #: with a retryable error — SLICE_STORAGE_DEGRADED_ANNOTATION).  Its
+    #: silicon may be perfectly healthy, but a gang member placed there
+    #: would only spin on shed errors until the disk heals, so placement
+    #: treats it as unavailable.
+    storage_degraded: bool = False
 
     @property
     def healthy(self) -> bool:
-        return self.unhealthy == 0 and self.advertised > 0
+        return (
+            self.unhealthy == 0
+            and self.advertised > 0
+            and not self.storage_degraded
+        )
 
 
 def published_slice_health(
@@ -891,28 +904,29 @@ def published_slice_health(
     the device list (plugin/resourceslice.py)."""
     advertised: dict[str, int] = {}
     unhealthy: dict[str, int] = {}
+    degraded: set[str] = set()
     for item in kube.list(gvr.RESOURCE_SLICES).get("items", []):
         spec = item.get("spec", {})
         if spec.get("driver") != driver:
             continue
         node = spec.get("nodeName", "")
         advertised[node] = advertised.get(node, 0) + len(spec.get("devices", []))
-        ann = (
-            item.get("metadata", {})
-            .get("annotations", {})
-            .get(SLICE_UNHEALTHY_ANNOTATION)
-        )
+        annotations = item.get("metadata", {}).get("annotations", {})
+        ann = annotations.get(SLICE_UNHEALTHY_ANNOTATION)
         if ann is not None:
             try:
                 # One count per node pool; slices of one pool repeat it.
                 unhealthy[node] = max(unhealthy.get(node, 0), int(ann))
             except ValueError:
                 ...  # a foreign/garbled annotation never fails selection
+        if annotations.get(SLICE_STORAGE_DEGRADED_ANNOTATION) in ("true", "1"):
+            degraded.add(node)
     return {
         node: NodeSliceHealth(
             node=node,
             advertised=advertised.get(node, 0),
             unhealthy=unhealthy.get(node, 0),
+            storage_degraded=node in degraded,
         )
         for node in advertised
     }
@@ -926,9 +940,10 @@ def select_healthy_spares(
 ) -> list[str]:
     """Filter candidate spare nodes on PUBLISHED slice health: a node
     qualifies only when its slices advertise ≥1 device with a zero
-    unhealthy count and it is not excluded (the degraded gang's current
-    nodes).  Returns qualifying nodes, most-advertised first — the
-    remediation picks from the front."""
+    unhealthy count, carry no storage-degraded annotation (a bind there
+    would only spin on shed errors), and it is not excluded (the degraded
+    gang's current nodes).  Returns qualifying nodes, most-advertised
+    first — the remediation picks from the front."""
     exclude = exclude or set()
     health = published_slice_health(kube, driver=driver)
     good = [

@@ -323,6 +323,43 @@ GANG_BIND_SECONDS = Histogram(
     ["nodes"],
     buckets=_PREPARE_BUCKETS,
 )
+STORAGE_FAULTS_TOTAL = Counter(
+    "tpudra_storage_faults_total",
+    "Storage-errno failures (ENOSPC/EIO/EROFS/EDQUOT/ENODEV) surfaced by "
+    "the storage seam (tpudra/storage.py), injected or real, by op "
+    "(open/write/fsync/fsync_dir/replace/truncate) and errno name — the "
+    "misbehaving-disk signal every degraded-mode transition traces back "
+    "to",
+    ["op", "errno"],
+)
+STORAGE_FSYNCS_TOTAL = Counter(
+    "tpudra_storage_fsyncs_total",
+    "fsyncs issued by the seam's durable-write helpers (atomic_replace / "
+    "write_file), by call site (cdi, checkpoint-snapshot, storage-probe, "
+    "dnsnames-config, cd-daemon-settings, ...) — each durable "
+    "atomic_replace costs two (file + parent directory), so a site whose "
+    "rate is odd or zero has lost its durability",
+    ["site"],
+)
+STORAGE_DEGRADED = Gauge(
+    "tpudra_storage_degraded",
+    "1 while the plugin's checkpoint storage cannot persist (a commit "
+    "failed with a storage errno and the heal probe has not yet "
+    "succeeded) — new prepare/unprepare work is shed with a typed "
+    "retryable error while this is set, by node (node-labeled because "
+    "the cluster sim runs many drivers in one process; a single-writer "
+    "driver-name label would let one node's heal edge mask another's "
+    "open degraded window)",
+    ["node"],
+)
+STORAGE_SHED_TOTAL = Counter(
+    "tpudra_storage_shed_total",
+    "NodePrepare/NodeUnprepare batches refused fail-fast because the "
+    "checkpoint storage is degraded (plugin/driver.py shed path), by op "
+    "(prepare / unprepare) — kubelet retries these; a climbing rate with "
+    "a zero degraded gauge is a bug",
+    ["op"],
+)
 APISERVER_REQUESTS_TOTAL = Counter(
     "tpudra_apiserver_requests_total",
     "Requests issued through an accounting-wrapped kube client "

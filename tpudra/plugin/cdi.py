@@ -35,7 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpudra import metrics
+from tpudra import metrics, storage
 
 CDI_VERSION = "0.6.0"
 
@@ -194,10 +194,20 @@ class CDIHandler:
         }
         if common_edits is not None:
             spec["containerEdits"] = common_edits.to_cdi()
-        tmp = self.spec_path(claim_uid) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(spec, f, indent=2)
-        os.replace(tmp, self.spec_path(claim_uid))
+        # Durable atomic write through the storage seam: tmp fsync →
+        # rename → directory fsync.  The pre-seam version renamed an
+        # UNSYNCED tmp file with no directory fsync, so a crash after an
+        # acknowledged prepare could lose or tear the grant's spec — the
+        # container runtime would then fail (or mis-wire) a pod whose
+        # claim the checkpoint says is PrepareCompleted.  Fsyncs are
+        # counted under site="cdi" (tpudra_storage_fsyncs_total) and
+        # pinned by test_cdi_spec_write_is_durable.
+        storage.atomic_replace(
+            self.spec_path(claim_uid),
+            json.dumps(spec, indent=2).encode(),
+            site="cdi",
+            tmp_path=self.spec_path(claim_uid) + ".tmp",
+        )
         metrics.observe_phase(metrics.PHASE_CDI_WRITE, time.monotonic() - t0)
         return ids
 

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import errno
 import json
 import logging
 import os
@@ -69,7 +70,7 @@ from dataclasses import dataclass, field
 from types import MappingProxyType
 from typing import Callable, Iterable, Optional
 
-from tpudra import lockwitness, metrics, trace
+from tpudra import lockwitness, metrics, storage, trace
 from tpudra.api import serde
 from tpudra.flock import Flock, FlockTimeout
 from tpudra.plugin import journal as journal_mod
@@ -400,6 +401,13 @@ class CheckpointManager:
         #: Base snapshot lacks a version (old-driver file): the next
         #: commit forces a migrating dual-version snapshot write.
         self._snapshot_needs_migration = False
+        # Storage-degraded state (docs/bind-path.md "Storage fault
+        # contract"): set when a commit fails with a storage errno
+        # (ENOSPC/EIO/EROFS/…), cleared when a durable write provably
+        # succeeds again (an organic commit, or try_recover's probe +
+        # compaction).  The Driver reads this to shed bind work.
+        self._storage_lock = lockwitness.make_lock("checkpoint.storage_lock")
+        self._storage_fault: Optional[str] = None
 
     @property
     def path(self) -> str:
@@ -408,6 +416,79 @@ class CheckpointManager:
     @property
     def journal_path(self) -> str:
         return self._journal.path
+
+    # ------------------------------------------------- storage-degraded mode
+
+    @property
+    def storage_degraded(self) -> bool:
+        with self._storage_lock:
+            return self._storage_fault is not None
+
+    @property
+    def storage_fault_detail(self) -> Optional[str]:
+        """Why persistence is degraded (None = healthy) — the detail the
+        shed path's typed error carries back to kubelet."""
+        with self._storage_lock:
+            return self._storage_fault
+
+    def _note_storage_failure(self, op: str, e: OSError) -> None:
+        detail = f"{op}: [{errno.errorcode.get(e.errno or 0, e.errno)}] {e}"
+        with self._storage_lock:
+            first = self._storage_fault is None
+            self._storage_fault = detail
+        if first:
+            logger.error(
+                "checkpoint storage DEGRADED at %s — persistence is shed "
+                "until a heal probe or a commit proves the disk durable "
+                "again (%s)", self._path, detail,
+            )
+
+    def _mark_storage_ok(self) -> bool:
+        with self._storage_lock:
+            was, self._storage_fault = self._storage_fault, None
+        if was:
+            logger.warning(
+                "checkpoint storage HEALED at %s (was: %s)", self._path, was
+            )
+        return was is not None
+
+    def try_recover(self, timeout: float = 5.0) -> bool:
+        """Heal detection + convergent recovery, the degraded-mode exit
+        path: (1) probe — one durable atomic write of ``.storage-probe``
+        in the checkpoint dir proves the disk takes fsynced writes again;
+        (2) rewrite — under the cp.lock flock, reload state from byte
+        zero (only known-durable bytes plus journal replay are trusted
+        after a fail-stop poison) and compact it into a fresh dual-version
+        snapshot, truncating the WAL.  Returns True when storage is (now)
+        healthy; False keeps the caller's backoff loop going.  Safe to
+        call concurrently with commits — everything runs under the same
+        flock the group-commit leader takes."""
+        if not self.storage_degraded:
+            return True
+        probe = os.path.join(
+            os.path.dirname(self._path) or ".", ".storage-probe"
+        )
+        try:
+            storage.atomic_replace(probe, b"ok\n", site="storage-probe")
+        except OSError:
+            return False  # still broken; detail stays as first noted
+        try:
+            with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock
+                # Full reload: the incremental base was discarded at
+                # poison time; only a from-byte-zero parse may repair.
+                self._applied_state = None
+                state, degraded = self._load_locked()
+                if degraded:
+                    self._preserve_corrupt()
+                self._compact_locked(state, "storage-heal")
+        except (OSError, FlockTimeout, CheckpointError) as e:
+            logger.warning(
+                "storage heal compaction failed; staying degraded: %s", e
+            )
+            if isinstance(e, OSError) and storage.is_storage_error(e):
+                self._note_storage_failure("heal compaction", e)
+            return False
+        return not self.storage_degraded
 
     def _stat_key(self) -> Optional[tuple[int, int, int]]:
         try:
@@ -630,12 +711,21 @@ class CheckpointManager:
         data = json.dumps(envelope)
         tmp = self._path + ".tmp"
         tf_wall, tf0 = time.time(), time.perf_counter()
-        with open(tmp, "w") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path)
-        journal_mod.fsync_dir(os.path.dirname(self._path) or ".")
+        try:
+            # The whole tmp-fsync → replace → dir-fsync idiom lives in the
+            # storage seam: a failed tmp fsync raises BEFORE the replace,
+            # so the good on-disk snapshot is never overwritten by bytes
+            # whose durability the kernel just declined to promise (the
+            # fail-stop snapshot contract; pinned by
+            # test_failed_snapshot_fsync_never_replaces_good_file).
+            storage.atomic_replace(
+                self._path, data.encode(), site="checkpoint-snapshot",
+                tmp_path=tmp,
+            )
+        except OSError as e:
+            if storage.is_storage_error(e):
+                self._note_storage_failure("snapshot write", e)
+            raise
         trace.record_span(
             "checkpoint.fsync", tf_wall, time.perf_counter() - tf0,
             attrs={"kind": "snapshot", "bytes": len(data)},
@@ -645,8 +735,23 @@ class CheckpointManager:
         _BYTES_SNAPSHOT.inc(len(data))
         _crashpoint("mid-compaction")
         jkey = self._journal.stat_key()
-        if jkey is not None and jkey[1] > 0:
-            self._journal.truncate_locked(0)
+        try:
+            if jkey is not None and jkey[1] > 0:
+                self._journal.truncate_locked(0)
+        except OSError as e:
+            # The snapshot IS durable (replace + dir fsync landed), so the
+            # mutation this write carries is acknowledged correctly; the
+            # stale journal records left behind replay idempotently over
+            # it.  Storage stays flagged degraded — truncation failing
+            # means the disk is still refusing work.
+            logger.warning(
+                "journal truncate after snapshot replace failed (replay "
+                "stays idempotent): %s", e
+            )
+            if storage.is_storage_error(e):
+                self._note_storage_failure("journal truncate", e)
+        else:
+            self._mark_storage_ok()
         # The stats are taken after the replace/truncate, so the key matches
         # exactly what a subsequent read would see for this content.
         key = (self._stat_key(), self._journal.stat_key())
@@ -767,8 +872,8 @@ class CheckpointManager:
         finalizes a degraded (fallback) read."""
         corrupt_path = self._path + ".corrupt"
         try:
-            with open(self._path, "rb") as src, open(corrupt_path, "wb") as dst:
-                dst.write(src.read())
+            with open(self._path, "rb") as src:
+                storage.write_file(corrupt_path, src.read(), site="corrupt-preserve")
         except OSError:
             logger.exception(
                 "cannot preserve corrupt checkpoint at %s", corrupt_path
@@ -928,7 +1033,23 @@ class CheckpointManager:
         elif records:
             payloads = [journal_mod.encode_record(r) for r in records]
             tf_wall, tf0 = time.time(), time.perf_counter()
-            n, dir_synced = self._journal.append_locked(payloads)
+            try:
+                n, dir_synced = self._journal.append_locked(payloads)
+            except OSError as e:
+                # Fail-stop: the append poisoned and rolled back the fd
+                # (journal.Journal.append_locked); everything derived past
+                # the last known-durable byte is untrusted, so the leader's
+                # incremental base and the read cache are dropped — the
+                # next commit (or try_recover) re-reads from disk.  The
+                # whole batch fails un-acknowledged: _lead_commit's
+                # batch-wide barrier hands every caller this error.
+                self._applied_state = None
+                with self._cache_lock:
+                    self._cache = None
+                if storage.is_storage_error(e):
+                    self._note_storage_failure("journal append", e)
+                raise
+            self._mark_storage_ok()
             trace.record_span(
                 "checkpoint.fsync", tf_wall, time.perf_counter() - tf0,
                 attrs={"kind": "journal", "records": len(records)},

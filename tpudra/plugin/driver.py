@@ -43,6 +43,7 @@ from tpudra import (
     featuregates,
     lockwitness,
     metrics,
+    storage,
     trace,
 )
 from tpudra.backoff import Backoff
@@ -291,12 +292,21 @@ class Driver:
             prepare=self.prepare_resource_claims,
             unprepare=self.unprepare_resource_claims,
             resolve_claim=resolve_claim,
+            # Degraded-mode shed at the wire: the gRPC handlers probe this
+            # BEFORE resolving claim references, so a shed costs zero
+            # apiserver work even on the kubelet path.
+            shed_probe=self.storage_shed_message,
         )
         self.cleanup = CheckpointCleanupManager(
             kube, self.state, unprepare=self._unprepare_serialized,
             clock=config.gc_clock,
         )
         self._health_thread: Optional[threading.Thread] = None
+        # Degraded-mode supervisor (started in start()): watches the
+        # checkpoint manager's storage-degraded flag, announces the
+        # transition (gauge + storage-degraded slice annotation) and
+        # drives the heal probe + convergent compaction on a backoff.
+        self._storage_heal_thread: Optional[threading.Thread] = None
         # Side-effect fan-out pool.  Threads spawn lazily on first multi-
         # claim batch; single-claim batches run inline on the RPC thread
         # (no hop, no pool wakeup — the common kubelet case).
@@ -354,6 +364,7 @@ class Driver:
                 target=self._health_loop, daemon=True, name="device-health"
             )
             self._health_thread.start()
+        self.start_storage_supervisor()
         self.cleanup.start(self._stop)
         self.publish_resources()
 
@@ -363,6 +374,12 @@ class Driver:
             self._publish_cond.notify_all()
         self._sockets.stop()
         self._effects_pool.shutdown(wait=False)
+        # The heal supervisor must be OUT before the checkpoint manager
+        # closes: a try_recover racing close() could re-open (recreate)
+        # checkpoint.wal after the downgrade-gate compaction declared
+        # checkpoint.json complete.  Bounded join — the loop polls _stop
+        # every ≤2 s and try_recover's flock waits are themselves bounded.
+        self._join_storage_supervisor()
         # Clean-shutdown compaction: fold the checkpoint journal into the
         # dual-version snapshot — the downgrade gate (an old driver never
         # reads checkpoint.wal).  Best-effort inside close().
@@ -385,6 +402,11 @@ class Driver:
             self._publish_cond.notify_all()
         self._sockets.stop()
         self._effects_pool.shutdown(wait=False)
+        # A real SIGKILL takes the heal thread with the process; in this
+        # in-process stand-in it would live on and a late try_recover
+        # could COMPACT the on-disk state the crash froze — join it out
+        # before abandoning.
+        self._join_storage_supervisor()
         self._checkpoints.abandon()
         self._lib.close()
 
@@ -430,6 +452,9 @@ class Driver:
             # The health monitor pings with an empty batch (health.py,
             # reference health.go:122) — it must stay lock- and disk-free.
             return {"claims": {}}
+        shed = self._shed_if_degraded(claims, "prepare")
+        if shed is not None:
+            return shed
         t0 = time.monotonic()
         out: dict[str, dict] = {}
         # Any prepare can flip sibling visibility in either direction (a vfio
@@ -444,24 +469,33 @@ class Driver:
                 "plugin.prepare",
                 attrs={"node": self._config.node_name, "claims": len(claims)},
             ), self._claims_serialized(uids):
-                # Phase 1 under the node lock: ONE checkpoint RMW records
-                # PrepareStarted (+ rollback/validation) for the whole batch.
-                with trace.start_span("bind.rmw-begin") as sp, self._locked_pu():
-                    t_lock = time.monotonic() - t0
-                    sp.set_attr("lock_wait_s", round(t_lock, 6))
-                    batch = self.state.begin_prepare(claims)
-                # Phase 2 outside the lock: per-claim side effects,
-                # concurrent across footprint-disjoint claims.
-                with trace.start_span("bind.effects"):
-                    self._run_effects(
-                        batch.pending(),
-                        self.state.run_prepare_effects,
-                        "prepare effects",
-                    )
-                # Phase 3 under the node lock: ONE checkpoint RMW completes
-                # every claim whose effects succeeded.
-                with trace.start_span("bind.rmw-finish"), self._locked_pu():
-                    self.state.finish_prepare(batch)
+                try:
+                    # Phase 1 under the node lock: ONE checkpoint RMW
+                    # records PrepareStarted (+ rollback/validation) for
+                    # the whole batch.
+                    with trace.start_span("bind.rmw-begin") as sp, self._locked_pu():
+                        t_lock = time.monotonic() - t0
+                        sp.set_attr("lock_wait_s", round(t_lock, 6))
+                        batch = self.state.begin_prepare(claims)
+                    # Phase 2 outside the lock: per-claim side effects,
+                    # concurrent across footprint-disjoint claims.
+                    with trace.start_span("bind.effects"):
+                        self._run_effects(
+                            batch.pending(),
+                            self.state.run_prepare_effects,
+                            "prepare effects",
+                        )
+                    # Phase 3 under the node lock: ONE checkpoint RMW
+                    # completes every claim whose effects succeeded.
+                    with trace.start_span("bind.rmw-finish"), self._locked_pu():
+                        self.state.finish_prepare(batch)
+                except Exception:
+                    # Wholesale batch failure with the uid locks still
+                    # held: unlink the lock files of claims that never
+                    # reached the checkpoint — nothing (no kubelet retry
+                    # obligation, no GC record) would ever visit them.
+                    self._gc_failed_batch_locks(uids)
+                    raise
                 for item in batch.items:
                     if item.error is not None:
                         # Failed claims may never see an unprepare (kubelet
@@ -514,6 +548,9 @@ class Driver:
     def unprepare_resource_claims(self, claims: list[dict]) -> dict:
         if not claims:
             return {"claims": {}}
+        shed = self._shed_if_degraded(claims, "unprepare")
+        if shed is not None:
+            return shed
         t0 = time.monotonic()
         out: dict[str, dict] = {}
         withheld_before = self.state.bound_sibling_devices()
@@ -526,16 +563,20 @@ class Driver:
                 "plugin.unprepare",
                 attrs={"node": self._config.node_name, "claims": len(claims)},
             ), self._claims_serialized(uids):
-                with trace.start_span("bind.rmw-begin"), self._locked_pu():
-                    batch = self.state.begin_unprepare(uids)
-                with trace.start_span("bind.effects"):
-                    self._run_effects(
-                        batch.pending(),
-                        self.state.run_unprepare_effects,
-                        "unprepare effects",
-                    )
-                with trace.start_span("bind.rmw-finish"), self._locked_pu():
-                    self.state.finish_unprepare(batch)
+                try:
+                    with trace.start_span("bind.rmw-begin"), self._locked_pu():
+                        batch = self.state.begin_unprepare(uids)
+                    with trace.start_span("bind.effects"):
+                        self._run_effects(
+                            batch.pending(),
+                            self.state.run_unprepare_effects,
+                            "unprepare effects",
+                        )
+                    with trace.start_span("bind.rmw-finish"), self._locked_pu():
+                        self.state.finish_unprepare(batch)
+                except Exception:
+                    self._gc_failed_batch_locks(uids)
+                    raise
                 for item in batch.items:
                     if item.done:  # record dropped; lock file is garbage
                         self._gc_claim_lock(item.uid)
@@ -639,6 +680,124 @@ class Driver:
             out[uid] = {"error": f"node {op}: {e}", "permanent": False}
         return {"claims": out}
 
+    # ------------------------------------------------- storage-degraded mode
+
+    @property
+    def storage_degraded(self) -> bool:
+        """True while the checkpoint cannot persist (bind work is shed)."""
+        return self._checkpoints.storage_degraded
+
+    def start_storage_supervisor(self) -> None:
+        """Start just the storage-heal supervisor (``start()`` includes
+        it).  Harnesses that drive a driver without ``start()`` — the
+        cluster sim runs hundreds of drivers with no socket/publisher
+        threads — call this directly so degraded-mode announce/heal runs
+        there exactly as in production.  Idempotent."""
+        t = self._storage_heal_thread
+        if t is not None and t.is_alive():
+            return
+        self._storage_heal_thread = threading.Thread(
+            target=self._storage_heal_loop, daemon=True, name="storage-heal"
+        )
+        self._storage_heal_thread.start()
+
+    def _join_storage_supervisor(self, timeout: float = 10.0) -> None:
+        """Wait the heal supervisor out (``_stop`` must already be set).
+        Bounded: an overrunning try_recover (a wedged flock) is logged and
+        left to die with the process rather than wedging shutdown."""
+        t = self._storage_heal_thread
+        if t is None or not t.is_alive():
+            return
+        t.join(timeout)
+        if t.is_alive():
+            logger.warning(
+                "storage-heal supervisor did not exit within %.0fs; "
+                "proceeding with shutdown", timeout,
+            )
+
+    def storage_shed_message(self, op: str) -> Optional[str]:
+        """The typed degraded-mode refusal for one would-be batch, or None
+        while healthy.  A non-None return counts one shed
+        (``tpudra_storage_shed_total{op}``) — callers refuse the whole
+        batch with it.  Probed by the gRPC handlers BEFORE claim
+        resolution and by the in-process batch entry points."""
+        detail = self._checkpoints.storage_fault_detail
+        if detail is None:
+            return None
+        metrics.STORAGE_SHED_TOTAL.labels(op).inc()
+        return (
+            f"{storage.DEGRADED_ERROR_PREFIX} node "
+            f"{self._config.node_name}: checkpoint storage cannot persist "
+            f"({detail}); shedding {op} until the disk heals (retryable)"
+        )
+
+    def _shed_if_degraded(self, refs: list[dict], op: str) -> Optional[dict]:
+        """Degraded-mode bind shedding (docs/bind-path.md "Storage fault
+        contract"): while the checkpoint storage cannot persist, every
+        NodePrepare/NodeUnprepare batch is refused UP FRONT — before any
+        flock, checkpoint read, or effect — with a typed, retryable
+        per-claim error.  Kubelet retries on its own cadence, nothing
+        half-binds against a disk that cannot record it, and the refusal
+        is O(1) per claim (the fail-fast p99 the bench's degraded arm
+        measures).  Read paths, health, GC scans, and slice publication
+        stay up; the storage-heal loop clears the flag."""
+        msg = self.storage_shed_message(op)
+        if msg is None:
+            return None
+        logger.info(
+            "shedding %s batch of %d claim(s): storage degraded",
+            op, len(refs),
+        )
+        out: dict[str, dict] = {}
+        for ref in refs:
+            uid = ref.get("uid") or ref.get("metadata", {}).get("uid", "")
+            out[uid] = {"error": msg, "permanent": False}
+        return {"claims": out}
+
+    def _storage_heal_loop(self) -> None:
+        """The degraded-mode supervisor: polls the checkpoint manager's
+        storage flag; on the healthy→degraded edge it raises the gauge and
+        republishes slices WITH the storage-degraded annotation (so the
+        controller's gang placement avoids this node), then drives
+        ``CheckpointManager.try_recover`` — heal probe + convergent
+        compaction rewrite — on a capped full-jitter backoff; on the
+        degraded→healthy edge (probe success, or an organic commit that
+        proved the disk durable) it clears the gauge and republishes to
+        drop the annotation.  The backoff cap is deliberately small: a
+        probe is one tiny durable write, and heal DETECTION latency is
+        what the storage-degraded-convergence budget measures."""
+        backoff = Backoff(0.25, 2.0)
+        announced = False
+        while not self._stop.is_set():
+            degraded = self._checkpoints.storage_degraded
+            if degraded and not announced:
+                announced = True
+                metrics.STORAGE_DEGRADED.labels(self._config.node_name).set(1)
+                logger.error(
+                    "node %s entering storage-degraded mode: %s",
+                    self._config.node_name,
+                    self._checkpoints.storage_fault_detail,
+                )
+                self._request_publish()
+            elif not degraded and announced:
+                announced = False
+                metrics.STORAGE_DEGRADED.labels(self._config.node_name).set(0)
+                logger.warning(
+                    "node %s leaving storage-degraded mode (healed)",
+                    self._config.node_name,
+                )
+                backoff.reset()
+                self._request_publish()
+            if degraded:
+                if self._stop.is_set():
+                    return  # shutting down: never race close()/abandon()
+                if self._checkpoints.try_recover():
+                    continue  # next pass observes the flip and announces
+                if self._stop.wait(backoff.next_delay()):
+                    return
+            elif self._stop.wait(1.0):
+                return
+
     def _claim_lock_path(self, uid: str) -> str:
         return os.path.join(self._claim_locks_dir, f"{uid}.lock")
 
@@ -685,6 +844,28 @@ class Driver:
         finally:
             for lock in reversed(locks):
                 lock.release()
+
+    def _gc_failed_batch_locks(self, uids) -> None:
+        """Lock-file GC for a WHOLESALE batch failure (a storage-failed
+        begin RMW, a checkpoint flock timeout): uids that never reached
+        the checkpoint have no retry obligation (kubelet only unprepares
+        what prepared) and no GC record, so nothing would ever unlink
+        their per-uid lock files — the flock-leak the disk_fault soak
+        caught.  Must run INSIDE ``_claims_serialized`` (the locks are
+        held: unlink-while-held keeps racing acquirers correct).  Claims
+        that DID land a record keep their files — the retry/GC paths own
+        those."""
+        try:
+            recorded = set(self._checkpoints.read_view().prepared_claims)
+        except Exception:  # noqa: BLE001 — unreadable checkpoint: keep the files
+            logger.info(
+                "failed-batch lock GC skipped: checkpoint unreadable",
+                exc_info=True,
+            )
+            return
+        for uid in {u for u in uids if u}:
+            if uid not in recorded:
+                self._gc_claim_lock(uid)
 
     def _gc_claim_lock(self, uid: str) -> None:
         """Unlink a claim's lock file; call ONLY while holding its lock
@@ -815,6 +996,9 @@ class Driver:
                 # does not (an already-withheld sibling going unhealthy) —
                 # it must reach the apiserver either way.
                 "unhealthyCount": res.unhealthy_count,
+                # Same shape for the storage-degraded flag: an
+                # annotation-only transition must still write.
+                "storageDegraded": res.storage_degraded,
             },
             sort_keys=True,
         )
@@ -834,6 +1018,10 @@ class Driver:
         ``BulkSlicePublisher`` so hundreds of co-located drivers share one
         existence LIST instead of paying 3 requests per node; driver-side
         bookkeeping (generation, content hash) is identical either way."""
+        # Storage-degraded flag read OUTSIDE the publish lock (it has its
+        # own lock, and a mid-publish flip is indistinguishable from one
+        # an instant later — the heal loop republishes on every edge).
+        storage_degraded = self._checkpoints.storage_degraded
         # The span opens BEFORE the publish lock and closes after it: its
         # exit (a log append) must never run under the lock.
         with trace.start_span(
@@ -850,6 +1038,10 @@ class Driver:
                 partitionable=partitionable,
                 node_name=self._config.node_name,
             )
+            # Storage-degraded flag rides every published slice so the
+            # controller's spare selection can avoid this node without
+            # node access (controller/gang.py published_slice_health).
+            res.storage_degraded = storage_degraded
             # Gauge before the gate: the unhealthy SET can change without
             # changing slice content (an already-withheld sibling going
             # unhealthy), and monitoring must see it either way.

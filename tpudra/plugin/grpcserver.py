@@ -158,6 +158,7 @@ class PluginSockets:
         prepare: Callable[[list[dict]], dict],
         unprepare: Callable[[list[dict]], dict],
         resolve_claim: ClaimResolver,
+        shed_probe: Optional[Callable[[str], Optional[str]]] = None,
     ):
         self.driver_name = driver_name
         self.dra_socket_path = os.path.join(plugin_dir, "dra.sock")
@@ -167,6 +168,13 @@ class PluginSockets:
         self._prepare = prepare
         self._unprepare = unprepare
         self._resolve_claim = resolve_claim
+        # Degraded-mode probe (docs/bind-path.md "Storage fault
+        # contract"): called with the op name BEFORE any claim-reference
+        # resolution; a non-None return is the typed retryable error every
+        # claim of the batch gets — so a node whose checkpoint storage is
+        # down sheds with ZERO apiserver work, even when the resolver's
+        # fallback GET would itself be slow (a compounding latency spike).
+        self._shed_probe = shed_probe
         self._registered = threading.Event()
         self._dra_server: Optional[grpc.Server] = None
         self._reg_server: Optional[grpc.Server] = None
@@ -235,6 +243,11 @@ class PluginSockets:
             parent=_metadata_traceparent(context),
             attrs={"claims": len(request.claims)},
         ), api_deadline(DEFAULT_RPC_API_BUDGET_S):
+            shed = self._shed_probe("prepare") if self._shed_probe else None
+            if shed is not None:
+                for ref in request.claims:
+                    resp.claims[ref.uid].error = shed
+                return resp
             full_claims = []
             # A resolve span only for multi-claim batches: a single
             # cached-hit resolution is cheaper than its span, and its cost
@@ -281,6 +294,12 @@ class PluginSockets:
             parent=_metadata_traceparent(context),
             attrs={"claims": len(refs)},
         ), api_deadline(DEFAULT_RPC_API_BUDGET_S):
+            shed = self._shed_probe("unprepare") if self._shed_probe else None
+            if shed is not None:
+                resp = pb.NodeUnprepareResourcesResponse()
+                for ref in refs:
+                    resp.claims[ref["uid"]].error = shed
+                return resp
             result = self._unprepare(refs)
         resp = pb.NodeUnprepareResourcesResponse()
         for uid, entry in result.get("claims", {}).items():

@@ -21,6 +21,18 @@ truncates/ignores the tail.  Every complete frame written before the torn
 one was fsynced by an earlier group commit, so nothing acknowledged is
 lost.
 
+Every write-side op goes through the storage seam (tpudra/storage.py) so
+a fault plan can fail this file's writes, fsyncs, and truncations per
+call site.  **Fail-stop contract (fsyncgate semantics):** a failed write
+or fsync POISONS the append fd — the kernel may have dropped the dirty
+pages and cleared the error, so retrying fsync on the same fd and
+assuming the bytes landed would acknowledge a mutation the disk never
+saw.  ``append_locked`` instead closes the fd, rolls the file back to the
+pre-append frame boundary on a fresh fd (best-effort — if the rollback
+itself fails, the CRC framing plus the next commit's good-frame repair
+make the leftover tail harmless), and raises; the caller fails the whole
+un-acknowledged batch and re-establishes state from known-durable bytes.
+
 Concurrency contract: ``append_locked``/``truncate_locked``/
 ``_ensure_fd_locked`` require the caller to hold the checkpoint flock
 (``cp.lock``) — they are the write half.  ``read_bytes``/``stat_key`` are
@@ -30,11 +42,17 @@ lock-free and may observe a concurrent append's partial frame; the reader
 
 from __future__ import annotations
 
+import contextlib
 import json
+import logging
 import os
 import struct
 import zlib
 from typing import Optional
+
+from tpudra import storage
+
+logger = logging.getLogger(__name__)
 
 _HEADER = struct.Struct("<II")
 
@@ -44,15 +62,11 @@ MAX_RECORD_BYTES = 1 << 22
 
 
 def fsync_dir(path: str) -> None:
-    """fsync a directory so a just-completed ``os.replace``/create in it is
-    durable.  fsyncing the file alone persists its *contents*; the rename
-    that makes the file *reachable* lives in the directory, and a crash
-    between the two can lose it (the classic rename-durability gap)."""
-    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+    """Directory fsync through the storage seam — kept under its original
+    name because callers across the tree (checkpoint, tests) grew up on
+    ``journal.fsync_dir``; the implementation and its rationale live in
+    :func:`tpudra.storage.fsync_dir`."""
+    storage.fsync_dir(path)
 
 
 def encode_frame(payload: bytes) -> bytes:
@@ -139,12 +153,14 @@ class Journal:
                 # The file vanished (test teardown): fall through and
                 # recreate on a fresh fd.
                 ...
-            os.close(fd)
+            storage.close(fd)
             self._fd = None
         parent = os.path.dirname(self._path) or "."
         os.makedirs(parent, exist_ok=True)
         created = not os.path.exists(self._path)
-        fd = os.open(self._path, os.O_CREAT | os.O_RDWR | os.O_APPEND, 0o600)
+        fd = storage.open(
+            self._path, os.O_CREAT | os.O_RDWR | os.O_APPEND, 0o600
+        )
         self._fd = fd
         return fd, created
 
@@ -153,25 +169,65 @@ class Journal:
         commit's whole durability cost); returns (bytes written, directory
         fsynced).  A first append also fsyncs the directory so the new
         file itself survives — reported to the caller so the fsync
-        accounting (tpudra_checkpoint_fsyncs_total) stays truthful."""
+        accounting (tpudra_checkpoint_fsyncs_total) stays truthful.
+
+        Any OSError on the way — short write, ENOSPC mid-append, a failed
+        fsync — poisons the fd (module docstring): the un-acknowledged
+        bytes are rolled back to the pre-append frame boundary and the
+        error propagates, so the caller never fsync-retries dirty pages
+        whose fate the kernel no longer guarantees."""
         buf = b"".join(payloads)
         fd, created = self._ensure_fd_locked()
-        # Loop out short writes (ENOSPC-adjacent / interrupted): fsyncing
-        # and acknowledging a partially-written frame would hand the next
-        # replay a "torn tail" for a mutation the caller was told is
-        # durable.
-        view = memoryview(buf)
-        while view:
-            written = os.write(fd, view)
-            if written <= 0:
-                raise OSError(
-                    f"short write appending {len(view)} byte(s) to {self._path}"
-                )
-            view = view[written:]
-        os.fsync(fd)
-        if created:
-            fsync_dir(os.path.dirname(self._path) or ".")
+        pre_size = os.fstat(fd).st_size
+        try:
+            # Loop out short writes (ENOSPC-adjacent / interrupted):
+            # fsyncing and acknowledging a partially-written frame would
+            # hand the next replay a "torn tail" for a mutation the caller
+            # was told is durable.
+            view = memoryview(buf)
+            while view:
+                written = storage.write(fd, view)
+                if written <= 0:
+                    raise OSError(
+                        f"short write appending {len(view)} byte(s) to "
+                        f"{self._path}"
+                    )
+                view = view[written:]
+            storage.fsync(fd)
+            if created:
+                storage.fsync_dir(os.path.dirname(self._path) or ".")
+        except OSError:
+            self._poison_locked(pre_size)
+            raise
         return len(buf), created
+
+    def _poison_locked(self, pre_size: int) -> None:
+        """Fail-stop after a failed append: close the (possibly-lying) fd
+        and cut the file back to the pre-append boundary on a FRESH fd, so
+        bytes whose mutation was reported as failed cannot become durable
+        via a later commit's fsync.  Best-effort: when the rollback itself
+        fails (the disk is still refusing work), the leftover tail is
+        either a partial frame (dropped by CRC at every replay) or whole
+        frames that the next successful commit's good-frame repair pass —
+        or the heal-time compaction — truncates."""
+        fd, self._fd = self._fd, None
+        if fd is not None:
+            with contextlib.suppress(OSError):
+                storage.close(fd)
+        try:
+            nfd = storage.open(self._path, os.O_RDWR)
+            try:
+                if os.fstat(nfd).st_size > pre_size:
+                    storage.ftruncate(nfd, pre_size)
+            finally:
+                with contextlib.suppress(OSError):
+                    storage.close(nfd)
+        except OSError:
+            logger.warning(
+                "journal poison rollback to offset %d failed; the "
+                "un-acknowledged tail is left for CRC/replay-time repair",
+                pre_size,
+            )
 
     def truncate_locked(self, size: int = 0) -> None:
         """Cut the journal to ``size`` bytes: 0 after a compaction folded
@@ -179,9 +235,9 @@ class Journal:
         torn tail.  No fsync — a crash that resurrects the dropped bytes
         re-drops them at the next replay (truncation is convergent)."""
         fd, _ = self._ensure_fd_locked()
-        os.ftruncate(fd, size)
+        storage.ftruncate(fd, size)
 
     def close(self) -> None:
         fd, self._fd = self._fd, None
         if fd is not None:
-            os.close(fd)
+            storage.close(fd)

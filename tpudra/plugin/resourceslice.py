@@ -67,6 +67,15 @@ def device_consumed_counters(dev: AllocatableDevice) -> list[dict]:
 #: every node's expected chip count.
 SLICE_UNHEALTHY_ANNOTATION = "tpu.google.com/unhealthy-device-count"
 
+#: Slice annotation flagging that this node's plugin is in STORAGE-DEGRADED
+#: mode (its checkpoint cannot persist; bind work is being shed with a
+#: typed retryable error — docs/bind-path.md "Storage fault contract").
+#: Present with value "true" only while degraded; the controller's gang
+#: placement (controller/gang.py published_slice_health) filters such
+#: nodes out of spare selection, because a gang member bound there would
+#: sit un-journaled behind the shed gate until the disk heals.
+SLICE_STORAGE_DEGRADED_ANNOTATION = "tpu.google.com/storage-degraded"
+
 
 @dataclass
 class DriverResources:
@@ -79,6 +88,9 @@ class DriverResources:
     #: Devices withheld for health (not sibling visibility) — published as
     #: SLICE_UNHEALTHY_ANNOTATION on every built slice.
     unhealthy_count: int = 0
+    #: Plugin checkpoint storage is degraded (binds shed) — published as
+    #: SLICE_STORAGE_DEGRADED_ANNOTATION on every built slice when True.
+    storage_degraded: bool = False
 
 
 def generate_driver_resources(
@@ -159,6 +171,12 @@ def build_resource_slices(
 
     slices: list[dict] = []
 
+    annotations = {SLICE_UNHEALTHY_ANNOTATION: str(res.unhealthy_count)}
+    if res.storage_degraded:
+        # Presence-only: a healthy node publishes NO storage annotation,
+        # so foreign tooling diffing slices sees degraded windows exactly.
+        annotations[SLICE_STORAGE_DEGRADED_ANNOTATION] = "true"
+
     def add(name_suffix: str, spec_extra: dict) -> None:
         spec = {k: (dict(v) if isinstance(v, dict) else v) for k, v in common_spec.items()}
         spec.update(spec_extra)
@@ -168,9 +186,7 @@ def build_resource_slices(
                 "kind": "ResourceSlice",
                 "metadata": {
                     "name": f"{node_name}-{TPU_DRIVER_NAME}-{name_suffix}",
-                    "annotations": {
-                        SLICE_UNHEALTHY_ANNOTATION: str(res.unhealthy_count)
-                    },
+                    "annotations": dict(annotations),
                 },
                 "spec": spec,
             }

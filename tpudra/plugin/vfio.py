@@ -99,6 +99,7 @@ class VfioManager:
 
     # tpudra-lock: nonblocking sysfs attribute store — the multi-write rebind dance is exactly what the per-device mutex serializes (reference PerGPUMutex), and each store is a bounded in-kernel write, not disk/network latency
     def _write(self, path: str, value: str) -> None:
+        # tpudra-lint: disable=DURABLE-WRITE sysfs attribute store: a single in-kernel control write with nothing to fsync or rename — atomicity/durability are meaningless for it; the per-device mutex (not the storage seam) is the safety mechanism here
         with open(path, "w") as f:
             f.write(value)
 

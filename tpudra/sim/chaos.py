@@ -47,6 +47,20 @@ implies, and this soak is its hermetic reproduction:
                        bound members within the recovery budget; the
                        monitor's quiet-window gang-atomicity invariant
                        holds the residue to "never partial"
+  disk_fault           a storage fault plan (tpudra/storage.py) is
+                       installed against ONE node's checkpoint + CDI dirs
+                       — ENOSPC on writes, EIO on fsync (fsyncgate),
+                       EROFS everywhere (read-only remount), slow-I/O
+                       stalls, or a fail-once blip — optionally composed
+                       with a SIGKILL mid-fault and a restart storm
+                       against the broken dir; the node must enter
+                       degraded mode (typed retryable shed errors,
+                       storage-degraded slice annotation) with reads and
+                       publication alive, every ACKNOWLEDGED mutation
+                       must survive the composed crash, and after heal
+                       the node must converge back to healthy (probe +
+                       compaction rewrite, annotation cleared) within
+                       the recovery budget
   ===================  ====================================================
 
 - **continuous invariant monitor**: a thread asserts, every few hundred
@@ -74,6 +88,8 @@ closed under ``make lockgraph``.
 from __future__ import annotations
 
 import argparse
+import contextlib
+import errno
 import json
 import logging
 import os
@@ -83,13 +99,14 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
-from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, trace
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, storage, trace
 from tpudra.clock import MonotonicAger, SkewedClock
 from tpudra.kube import gvr
 from tpudra.kube.deadline import api_deadline
 from tpudra.kube.errors import ApiError, NotFound
 from tpudra.plugin import checkpoint as checkpoint_mod
 from tpudra.plugin.checkpoint import PREPARE_STARTED, SimulatedCrash
+from tpudra.plugin.resourceslice import SLICE_STORAGE_DEGRADED_ANNOTATION
 from tpudra.sim.cluster import (
     ClusterScaleConfig,
     ClusterScaleSim,
@@ -121,6 +138,17 @@ FAULT_KINDS = (
     "cd_wave",
     "chip_fault",
     "daemon_crash",
+    "disk_fault",
+)
+
+#: disk_fault variants — what the misbehaving disk does (storage.FaultPlan
+#: rules scoped to one node's checkpoint + CDI dirs).
+DISK_FAULT_VARIANTS = (
+    "enospc_write",  # every write fails ENOSPC until heal
+    "eio_fsync",     # every fsync fails EIO until heal (fsyncgate)
+    "erofs",         # the whole write surface fails EROFS (ro remount)
+    "slow_io",       # fsyncs stall; nothing fails
+    "enospc_once",   # one write fails ENOSPC mid-append, then recovers
 )
 
 #: Invariant label values (METRICS-HYGIENE: one spelling, shared with the
@@ -141,6 +169,16 @@ INV_GANG_DEGRADED = "gang-degraded"
 #: No bound gang grant may live on a node with faulted silicon after its
 #: remediation completed (and none in any quiet window).
 INV_GRANT_HEALTH = "grant-health"
+#: Every mutate that returned success is present after crash+recovery —
+#: disk faults notwithstanding.  Checked with an "anchor" claim bound and
+#: acknowledged BEFORE each crash-shaped fault (plugin_crash, torn_wal,
+#: disk_fault's composed SIGKILL) and asserted present in the recovered
+#: checkpoint afterwards.
+INV_ACK_DURABILITY = "acknowledged-mutation-durability"
+#: No node may sit in storage-degraded mode past the recovery budget once
+#: no disk fault is active — heal detection + the convergent compaction
+#: rewrite must bring it back.
+INV_STORAGE_DEGRADED = "storage-degraded-convergence"
 INVARIANTS = (
     INV_CLAIM_STUCK,
     INV_CDI_LEAK,
@@ -152,6 +190,8 @@ INVARIANTS = (
     INV_SLICE_HEALTH,
     INV_GANG_DEGRADED,
     INV_GRANT_HEALTH,
+    INV_ACK_DURABILITY,
+    INV_STORAGE_DEGRADED,
 )
 
 
@@ -378,6 +418,10 @@ class ChaosSoak:
         self._cd_wave_inflight = 0  # guarded by _records_lock
         # Degraded-gang age tracking for INV_GANG_DEGRADED.
         self._degraded_ager = MonotonicAger()
+        # Storage-degraded age tracking for INV_STORAGE_DEGRADED: a node
+        # only ages while NO disk fault is active (while one is, being
+        # degraded is the correct state).
+        self._storage_ager = MonotonicAger()
         # -- daemon stack (chip_fault's sibling blast radius): a supervised
         # dummy slice daemon under the REAL ProcessManager watchdog (full-
         # jitter restart backoff) plus a REAL CoordinatorProxy forwarding
@@ -440,6 +484,19 @@ class ChaosSoak:
                 }
             )
         logger.error("SOAK INVARIANT VIOLATION [%s] %r: %s", invariant, key, detail)
+
+    def _check_or_interrupted(
+        self, invariant: str, ok: bool, key, detail: str, what: str
+    ) -> None:
+        """A fault-tail assertion the run's END can interrupt (recovery
+        waits, heal convergence): a bad outcome with ``_stop`` set means
+        the contract is unfinished, not broken — reported as an anomaly,
+        never a violation.  Every injector tail goes through here so the
+        guard cannot drift per fault kind."""
+        if not ok and self._stop.is_set():
+            self._anomaly(f"{what} interrupted by run end")
+            return
+        self._check(invariant, ok, key=key, detail=detail)
 
     def _pass_check(self, invariant: str) -> None:
         """Count one 'ok' evaluation for a completed scan pass: candidate
@@ -681,6 +738,18 @@ class ChaosSoak:
                 params = {
                     "target": self._rng.choice(["slicewatchd", "coordproxy"])
                 }
+            elif kind == "disk_fault":
+                variant = self._rng.choice(list(DISK_FAULT_VARIANTS))
+                params = {
+                    "variant": variant,
+                    # Only the fail-until-healed variants compose a SIGKILL
+                    # mid-fault / a restart storm against the broken dir.
+                    "compose_crash": variant
+                    in ("enospc_write", "eio_fsync", "erofs")
+                    and self._rng.random() < 0.6,
+                    "restart_storm": self._rng.random() < 0.5,
+                    "window_sim_s": self._rng.uniform(60, 180),
+                }
         else:
             kind = spec["kind"]
             node = spec.get("node") or 0
@@ -709,6 +778,8 @@ class ChaosSoak:
             self._inject_chip_fault(node)
         elif kind == "daemon_crash":
             self._inject_daemon_crash(params)
+        elif kind == "disk_fault":
+            self._inject_disk_fault(node, params)
         else:
             self._anomaly(f"unknown fault kind {kind!r}")
 
@@ -847,11 +918,12 @@ class ChaosSoak:
             # pod claim it rediscovers.  The grant must come back without
             # error (idempotent cached path).
             redo = self._retry_prepare(node, claim, self.budget.recovery_sim_s / 2)
-            self._check(
+            self._check_or_interrupted(
                 INV_FAULT_RECOVERY,
                 ok and redo,
                 key=("kubelet_restart", self._fault_counter),
                 detail="re-prepare after simulated kubelet restart not idempotent",
+                what=f"kubelet_restart recovery on node {node}",
             )
             # The pod was force-deleted while kubelet was down: the API
             # object vanishes with no unprepare.  The stale-claim GC must
@@ -894,9 +966,14 @@ class ChaosSoak:
         self._quarantine_node(node)
         t0_sim = self._now()
         uid = f"soak-crash-{self._fault_counter}"
+        anchor: Optional[str] = None
         try:
             driver = self.sim.drivers[node]
             node_name = self.sim.node_names[node]
+            # An acknowledged bind BEFORE the crash: whatever boundary the
+            # armed claim dies at, this one's success was reported — it
+            # must be in the recovered checkpoint (INV_ACK_DURABILITY).
+            anchor = self._bind_anchor(node)
             claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
             with api_deadline(5.0):
                 self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
@@ -934,10 +1011,12 @@ class ChaosSoak:
             # then restart over the same dirs — the REAL recovery path.
             self.sim.crash_node(node)
             self.sim.restart_node(node)
+            if anchor is not None:
+                self._check_ack_durability(node, anchor, f"{record.kind}@{point}")
             recovered = self._retry_prepare(
                 node, claim, self.budget.recovery_sim_s
             )
-            self._check(
+            self._check_or_interrupted(
                 INV_FAULT_RECOVERY,
                 recovered,
                 key=(record.kind, self._fault_counter),
@@ -945,6 +1024,7 @@ class ChaosSoak:
                     f"claim did not converge to a grant after a crash at "
                     f"{point} (torn={torn})"
                 ),
+                what=f"{record.kind} recovery on node {node}",
             )
             self._best_effort_unprepare(self.sim.drivers[node], uid)
         finally:
@@ -953,10 +1033,342 @@ class ChaosSoak:
                     self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
             except (NotFound, ApiError):
                 ...
+            if anchor is not None:
+                self._release_anchor(node, anchor)
             self._unquarantine_node(node)
             self._end_fault(record)
             record.recovered_sim_s = record.t_sim_end - t0_sim
             self._recovery_samples.append(record.recovered_sim_s)
+
+    # -------------------------------------------- acknowledged-bind anchors
+
+    def _bind_anchor(self, node: int) -> Optional[str]:
+        """Bind one claim that STAYS bound across the upcoming fault — the
+        acknowledged mutation INV_ACK_DURABILITY tracks through
+        crash+recovery.  The node is quarantined (churn drained) when this
+        runs; chips 1..N-1 are tried in order because chip 0 is the fault
+        injectors' working slot and a churn straggler may still hold a
+        higher chip.  None when no chip binds (the check is then skipped
+        for this fault, not faked)."""
+        driver = self.sim.drivers[node]
+        node_name = self.sim.node_names[node]
+        for chip in range(1, self.config.chips_per_node):
+            uid = f"soak-anchor-{self._fault_counter}-{chip}"
+            claim = make_claim(uid, node_name, [f"tpu-{chip}"], name=uid)
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                    resolved = driver.sockets.resolve_claim("default", uid, uid)
+                    resp = driver.prepare_resource_claims([resolved])
+                if not resp["claims"][uid].get("error"):
+                    return uid
+            except Exception:  # noqa: BLE001 — latency/conflict: next chip
+                logger.info(
+                    "anchor bind on node %d chip %d failed", node, chip,
+                    exc_info=True,
+                )
+            with contextlib.suppress(NotFound, ApiError):
+                with api_deadline(5.0):
+                    self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+        return None
+
+    def _check_ack_durability(self, node: int, uid: str, when: str) -> None:
+        """Assert one acknowledged claim is present in the node's RECOVERED
+        checkpoint view (the real recovery path: snapshot + journal replay
+        + torn-tail truncation)."""
+        try:
+            present = uid in self.sim.drivers[node].state.prepared_claim_uids()
+        except Exception:  # noqa: BLE001 — mid-restart window: skip, don't fake
+            logger.info("ack-durability probe on node %d skipped", node, exc_info=True)
+            return
+        self._check(
+            INV_ACK_DURABILITY,
+            present,
+            key=(uid, when),
+            detail=(
+                f"acknowledged claim {uid} missing from node {node}'s "
+                f"checkpoint after {when}"
+            ),
+        )
+
+    def _release_anchor(self, node: int, uid: str) -> None:
+        self._best_effort_unprepare(self.sim.drivers[node], uid)
+        try:
+            with api_deadline(5.0):
+                self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+        except (NotFound, ApiError):
+            ...  # GC reclaims the record; cascade covers the object
+
+    # ----------------------------------------------------------- disk_fault
+
+    def _disk_fault_rules(self, node: int, variant: str) -> list[dict]:
+        """FaultPlan rule kwargs for one variant, scoped to the node's
+        checkpoint (p{node}) and CDI (c{node}) dirs.  The trailing slash
+        keeps /p1/ from matching /p12/ (and the CD stack's cdw-p1/)."""
+        scopes = [f"/p{node}/", f"/c{node}/"]
+        if variant == "enospc_write":
+            return [
+                dict(op="write", path=s, err=errno.ENOSPC, times=None)
+                for s in scopes
+            ]
+        if variant == "eio_fsync":
+            return [
+                dict(op="fsync", path=s, err=errno.EIO, times=None)
+                for s in scopes
+            ]
+        if variant == "erofs":
+            erofs = errno.EROFS
+            return [
+                dict(op=op, path=s, err=erofs, times=None)
+                for s in scopes
+                for op in ("open", "write", "fsync", "fsync_dir", "replace", "truncate")
+            ]
+        if variant == "slow_io":
+            # Stall every fsync on the node; nothing fails.  0.15 s wall
+            # per fsync keeps a multi-fsync bind well inside the p99
+            # budget while being very visible in the window histogram.
+            return [
+                dict(op="fsync", path=s, err=None, times=None, delay_s=0.15)
+                for s in scopes
+            ]
+        # enospc_once: one real mid-append tear — a frame prefix lands,
+        # then the device gives up; the journal's poison rollback (or the
+        # next commit's good-frame repair) must leave a clean boundary.
+        return [
+            dict(
+                op="write", path=f"/p{node}/",
+                err=errno.ENOSPC, times=1, partial_bytes=7,
+            )
+        ]
+
+    def _inject_disk_fault(self, node: int, params: dict) -> None:
+        """The misbehaving-disk scenario (docs/chaos.md): a storage fault
+        plan against one node's checkpoint + CDI dirs, optionally composed
+        with a SIGKILL mid-fault and a restart storm against the broken
+        dir.  Asserts the whole degraded-mode contract: fail-fast typed
+        shedding, reads/publication alive, acknowledged-mutation
+        durability across the composed crash, and heal convergence
+        (degraded flag dropped, storage-degraded annotation cleared, a
+        fresh bind granted) within the recovery budget."""
+        variant = params.get("variant", "enospc_write")
+        failing = variant in ("enospc_write", "eio_fsync", "erofs")
+        record = FaultRecord(
+            kind="disk_fault", t_sim_start=self._now(), node=node,
+            params=dict(params),
+        )
+        self._record_fault(record)
+        self._quarantine_node(node)
+        node_name = self.sim.node_names[node]
+        plan = storage.FaultPlan()
+        anchor: Optional[str] = None
+        heal_t_sim: Optional[float] = None
+        try:
+            anchor = self._bind_anchor(node)
+            for kw in self._disk_fault_rules(node, variant):
+                plan.add(**kw)
+            storage.install_fault_plan(plan)
+            if failing:
+                self._drive_node_degraded(node, record)
+                if params.get("compose_crash"):
+                    # SIGKILL mid-fault; optionally a restart storm, every
+                    # restart recovering against the STILL-BROKEN dir —
+                    # reads must work (the recovery view is read-only) and
+                    # the acknowledged anchor must be in it.
+                    self.sim.crash_node(node)
+                    if params.get("restart_storm"):
+                        self.sim.restart_node(node)
+                        self.sim.crash_node(node)
+                    self.sim.restart_node(node)
+                    if anchor is not None:
+                        self._check_ack_durability(
+                            node, anchor, f"disk_fault({variant})+crash"
+                        )
+                # Open window: churn sheds against the broken node.
+                self._unquarantine_node(node)
+                self._stop.wait(
+                    self.simclock.wall_of(params.get("window_sim_s", 60.0))
+                )
+                self._quarantine_node(node)
+            else:
+                # Non-failing variants: binds must still SUCCEED while the
+                # fault is live (a stall or a single blip is retryable,
+                # not an outage).
+                uid = f"soak-df-{self._fault_counter}-live"
+                claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
+                with api_deadline(5.0):
+                    self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                ok = self._retry_prepare(
+                    node, claim, self.budget.recovery_sim_s / 2
+                )
+                self._check_or_interrupted(
+                    INV_FAULT_RECOVERY,
+                    ok,
+                    key=("disk_fault_live", self._fault_counter),
+                    detail=(
+                        f"bind did not converge under non-failing disk "
+                        f"fault {variant}"
+                    ),
+                    what=f"disk_fault live-bind probe on node {node}",
+                )
+                self._best_effort_unprepare(self.sim.drivers[node], uid)
+                with contextlib.suppress(NotFound, ApiError):
+                    with api_deadline(5.0):
+                        self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+                self._unquarantine_node(node)
+                self._stop.wait(
+                    self.simclock.wall_of(
+                        min(30.0, params.get("window_sim_s", 30.0))
+                    )
+                )
+                self._quarantine_node(node)
+        finally:
+            plan.heal()
+            storage.clear_fault_plan()
+            heal_t_sim = self._now()
+            try:
+                recovered = self._await_storage_heal(node, record)
+                self._check_or_interrupted(
+                    INV_FAULT_RECOVERY,
+                    recovered,
+                    key=("disk_fault", self._fault_counter),
+                    detail=(
+                        f"node {node} did not converge back to healthy "
+                        f"binds after disk fault {variant} healed"
+                    ),
+                    what=f"disk_fault heal wait on node {node}",
+                )
+                if anchor is not None:
+                    self._check_ack_durability(
+                        node, anchor, f"disk_fault({variant})+heal"
+                    )
+                    self._release_anchor(node, anchor)
+            finally:
+                self._unquarantine_node(node)
+                self._end_fault(record)
+                record.recovered_sim_s = record.t_sim_end - heal_t_sim
+                self._recovery_samples.append(record.recovered_sim_s)
+
+    def _drive_node_degraded(self, node: int, record: FaultRecord) -> None:
+        """Push bind attempts at the faulted node until its driver flips
+        into degraded mode, then sample the fail-fast shed path: the typed
+        retryable error must come back without touching flock/checkpoint
+        (bounded latency, recorded in the fault record)."""
+        driver_of = lambda: self.sim.drivers[node]  # noqa: E731 — crash may swap it
+        node_name = self.sim.node_names[node]
+        # Wall floor on the sim-derived deadline: at high compression the
+        # sim budget can shrink below the heal supervisor's own wall-time
+        # probe cadence, which would turn compression into fault severity.
+        deadline = time.monotonic() + max(
+            self.simclock.wall_of(self.budget.recovery_sim_s / 2), 5.0
+        )
+        seq = 0
+        while (
+            not driver_of().storage_degraded
+            and time.monotonic() < deadline
+            and not self._stop.is_set()
+        ):
+            uid = f"soak-df-{self._fault_counter}-p{seq}"
+            seq += 1
+            claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                    resolved = driver_of().sockets.resolve_claim("default", uid, uid)
+                    driver_of().prepare_resource_claims([resolved])
+            except ApiError:
+                ...  # latency window beat the resolve; try again
+            finally:
+                with contextlib.suppress(NotFound, ApiError):
+                    with api_deadline(5.0):
+                        self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            time.sleep(0.05)
+        degraded = driver_of().storage_degraded
+        record.params["degraded_observed"] = degraded
+        if not degraded:
+            self._anomaly(
+                f"disk_fault on node {node} never flipped the driver "
+                "storage-degraded"
+            )
+            return
+        # Shed-path sample: while degraded, every batch is refused up
+        # front with the typed prefix — time a few.
+        shed_ms: list[float] = []
+        uid = f"soak-df-{self._fault_counter}-shed"
+        ref = {"metadata": {"uid": uid, "namespace": "default", "name": uid}}
+        for _ in range(5):
+            t0 = time.perf_counter()
+            resp = driver_of().prepare_resource_claims([ref])
+            shed_ms.append((time.perf_counter() - t0) * 1000.0)
+            err = resp["claims"].get(uid, {}).get("error", "")
+            if storage.DEGRADED_ERROR_PREFIX not in err:
+                self._anomaly(
+                    f"degraded node {node} shed without the typed "
+                    f"storage-degraded error: {err[:120]!r}"
+                )
+                break
+        if shed_ms:
+            record.params["shed_max_ms"] = round(max(shed_ms), 3)
+
+    def _await_storage_heal(self, node: int, record: FaultRecord) -> bool:
+        """After heal: degraded flag dropped, the storage-degraded slice
+        annotation cleared, and a fresh bind granted — all within the
+        recovery budget."""
+        # Same wall floor as _drive_node_degraded: the heal supervisor
+        # probes on wall-time backoff (≤2 s), which a high-compression sim
+        # budget must not undercut.
+        deadline = time.monotonic() + max(
+            self.simclock.wall_of(self.budget.recovery_sim_s / 2), 5.0
+        )
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                if not self.sim.drivers[node].storage_degraded:
+                    break
+            except Exception:  # noqa: BLE001 — mid-restart window
+                logger.info(
+                    "degraded probe on node %d mid-restart", node, exc_info=True
+                )
+            time.sleep(0.1)
+        else:
+            return False
+        node_name = self.sim.node_names[node]
+        annotation_clear = False
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if not self._node_slices_flag_degraded(node_name):
+                annotation_clear = True
+                break
+            time.sleep(0.1)
+        record.params["annotation_cleared"] = annotation_clear
+        uid = f"soak-df-{self._fault_counter}-heal"
+        claim = make_claim(uid, node_name, ["tpu-0"], name=uid)
+        try:
+            with api_deadline(5.0):
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+        except ApiError:
+            return False
+        granted = self._retry_prepare(node, claim, self.budget.recovery_sim_s / 2)
+        self._best_effort_unprepare(self.sim.drivers[node], uid)
+        with contextlib.suppress(NotFound, ApiError):
+            with api_deadline(5.0):
+                self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+        return granted and annotation_clear
+
+    def _node_slices_flag_degraded(self, node_name: str) -> bool:
+        try:
+            listing = self.sim.kube.list(gvr.RESOURCE_SLICES)
+        except ApiError:
+            return True  # can't tell: keep waiting
+        for item in listing.get("items", []):
+            spec = item.get("spec", {})
+            if (
+                spec.get("driver") == TPU_DRIVER_NAME
+                and spec.get("nodeName") == node_name
+                and item.get("metadata", {})
+                .get("annotations", {})
+                .get(SLICE_STORAGE_DEGRADED_ANNOTATION)
+                == "true"
+            ):
+                return True
+        return False
 
     def _inject_clock_skew(self, params: dict) -> None:
         """Step the shared GC wall clock ±10 min and run live stale-claim
@@ -1769,6 +2181,7 @@ class ChaosSoak:
         self._check_slice_health()
         self._check_gang_degraded()
         self._check_grant_health()
+        self._check_storage_degraded()
 
     def _quiet_and_settled(self) -> bool:
         """True when no fault window is open AND the convergence budget
@@ -1820,6 +2233,40 @@ class ChaosSoak:
                     ),
                 )
         self._pass_check(INV_SLICE_HEALTH)
+
+    def _check_storage_degraded(self) -> None:
+        """No node may sit storage-degraded past the recovery budget once
+        no disk fault is active (heal probe + convergent compaction must
+        clear the flag) — monotonic-aged, like the gang check.  While a
+        disk_fault window is open, being degraded is the CORRECT state and
+        nothing ages."""
+        with self._records_lock:
+            fault_active = "disk_fault" in self._active
+        live_keys: list = []
+        for i in range(self.config.nodes):
+            try:
+                degraded = self.sim.drivers[i].storage_degraded
+            except Exception:  # noqa: BLE001 — mid-restart window
+                continue
+            if not degraded or fault_active:
+                self._storage_ager.forget(i)
+                continue
+            live_keys.append(i)
+            age_sim = (
+                self._storage_ager.age(i, "degraded") * self.config.compression
+            )
+            self._check(
+                INV_STORAGE_DEGRADED,
+                age_sim <= self.budget.recovery_sim_s,
+                key=("degraded", i),
+                detail=(
+                    f"node {i} storage-degraded for {age_sim:.0f} sim-s "
+                    f"with no disk fault active (budget "
+                    f"{self.budget.recovery_sim_s:.0f})"
+                ),
+            )
+        self._storage_ager.prune(live_keys)
+        self._pass_check(INV_STORAGE_DEGRADED)
 
     def _check_gang_degraded(self) -> None:
         """No gang may sit degraded/remediating longer than the recovery
@@ -2165,6 +2612,9 @@ class ChaosSoak:
             for t in (*workers, fault_thread, monitor):
                 t.join(timeout=30)
             self._maybe_clear_latency(force=True)
+            # A fault thread stopped mid-disk_fault must not leave the
+            # process-global plan faulting the post-run settle.
+            storage.clear_fault_plan()
         # Post-run settle: one GC sweep + a final convergence check in a
         # guaranteed-quiet cluster, then the witness merge.
         for i in range(self.config.nodes):

@@ -273,6 +273,11 @@ class ClusterScaleSim:
             self.kube,
             lib,
         )
+        # The harness never start()s its drivers (no sockets, no publisher
+        # thread — publish is inline), but the degraded-mode contract must
+        # still hold under the soak's disk faults: the storage-heal
+        # supervisor is the one production thread each node keeps.
+        driver.start_storage_supervisor()
         return lib, driver
 
     # ----------------------------------------------------- fault injection

@@ -1,0 +1,391 @@
+"""Fault-injectable storage seam under everything the plugins persist.
+
+Every byte the kubelet plugins stake crash-safety on — the checkpoint
+snapshot, the WAL (``plugin/journal.py``), CDI spec files, the CD daemon's
+config files — reaches the disk through the small os-ops layer in this
+module: ``open``/``write``/``fsync``/``replace``/``ftruncate``/
+``fsync_dir`` plus the two composed helpers ``atomic_replace`` (tmp write →
+file fsync → rename → directory fsync, the rename-durability idiom) and
+``write_file``.  Two reasons it exists:
+
+1. **Fault injection.**  A :class:`FaultPlan` installed via
+   :func:`install_fault_plan` (or the ``TPUDRA_STORAGE_FAULT`` env, gated
+   on ``TPUDRA_TEST_HOOKS=1`` like the crashpoints) makes any call site
+   fail with a chosen errno — per op (write vs fsync vs replace…), per
+   path substring (one node's plugin dir, just ``checkpoint.wal``),
+   fail-once or fail-until-healed, optionally with a slow-I/O stall or a
+   partial write before the error.  The chaos soak's ``disk_fault`` kind
+   and the storage-fault unit tests drive everything through here; no
+   test ever monkeypatches ``os`` internals.
+
+2. **One place for the fail-stop contract.**  The durability rules the
+   callers implement (a failed fsync poisons the fd — fsyncgate; never
+   ``os.replace`` over a good file after a failed tmp fsync; acknowledge a
+   mutation only after its bytes are provably durable) only hold if every
+   write goes through a layer whose failures are typed and observable.
+   ``tpudra_storage_faults_total{op,errno}`` counts every storage-errno
+   failure surfaced here, injected or real; the ``DURABLE-WRITE`` lint
+   rule (tpudra/analysis/rules/durable_write.py) keeps new persistence
+   call sites from dodging the seam.
+
+Reads are deliberately NOT routed here: the degraded-mode contract
+(docs/bind-path.md "Storage fault contract") keeps read paths, health,
+and slice publication alive while the disk refuses writes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno as errno_mod
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from tpudra import lockwitness, metrics
+
+#: Errnos that mean "the disk/filesystem misbehaved" (vs. a programming
+#: error like ENOENT on a bad path).  Only these flip the checkpoint
+#: manager into storage-degraded mode.
+STORAGE_ERRNOS = frozenset(
+    {
+        errno_mod.ENOSPC,
+        errno_mod.EIO,
+        errno_mod.EROFS,
+        errno_mod.EDQUOT,
+        errno_mod.ENODEV,
+    }
+)
+
+#: Greppable marker every degraded-mode shed error carries across the DRA
+#: gRPC boundary — the "typed" half of the typed retryable error (the
+#: response dict's ``permanent: False`` is the retryable half).
+DEGRADED_ERROR_PREFIX = "[storage-degraded]"
+
+#: Env arming for subprocess harnesses (the crash sweeps): a semicolon-
+#: separated list of ``op:ERRNO_NAME:times:path_substring`` rules, honored
+#: only under ``TPUDRA_TEST_HOOKS=1`` (two-key arming, like
+#: TPUDRA_CRASHPOINT).  ``times`` is an integer or ``inf`` (= until
+#: healed).  Example: ``write:ENOSPC:1:checkpoint.wal``.
+ENV_FAULT = "TPUDRA_STORAGE_FAULT"
+
+#: The op vocabulary rules may name (also the ``op`` label values of
+#: ``tpudra_storage_faults_total``).
+OPS = ("open", "write", "fsync", "fsync_dir", "replace", "truncate")
+
+
+def is_storage_error(e: BaseException) -> bool:
+    return isinstance(e, OSError) and e.errno in STORAGE_ERRNOS
+
+
+def _errno_name(code: Optional[int]) -> str:
+    return errno_mod.errorcode.get(code or 0, str(code))
+
+
+def _count_fault(op: str, code: Optional[int]) -> None:
+    if code in STORAGE_ERRNOS:
+        metrics.STORAGE_FAULTS_TOTAL.labels(op, _errno_name(code)).inc()
+
+
+@dataclass
+class FaultRule:
+    """One injected misbehavior.  ``err=None`` is a pure slow-I/O stall;
+    ``times=None`` fails until the plan is healed; ``partial_bytes`` (write
+    op only) really writes that prefix before raising — the mid-append
+    torn-frame shape."""
+
+    op: str
+    path: str = ""  # substring of the op's path; "" matches every path
+    err: Optional[int] = errno_mod.EIO
+    times: Optional[int] = 1
+    delay_s: float = 0.0
+    partial_bytes: Optional[int] = None
+    fired: int = 0
+
+
+class FaultPlan:
+    """A thread-safe rule set; first matching rule wins per op."""
+
+    def __init__(self):
+        self._lock = lockwitness.make_lock("storage.fault_plan_lock")
+        self._rules: list[FaultRule] = []
+
+    def add(
+        self,
+        op: str,
+        path: str = "",
+        err: Optional[int] = errno_mod.EIO,
+        times: Optional[int] = 1,
+        delay_s: float = 0.0,
+        partial_bytes: Optional[int] = None,
+    ) -> FaultRule:
+        if op not in OPS:
+            raise ValueError(f"unknown storage op {op!r} (want one of {OPS})")
+        rule = FaultRule(
+            op=op, path=path, err=err, times=times,
+            delay_s=delay_s, partial_bytes=partial_bytes,
+        )
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def heal(self) -> None:
+        """Clear every rule — the disk starts behaving again."""
+        with self._lock:
+            self._rules.clear()
+
+    def fired_total(self) -> int:
+        with self._lock:
+            return sum(r.fired for r in self._rules)
+
+    def match(self, op: str, path: str) -> Optional[FaultRule]:
+        """Claim one firing of the first live rule matching (op, path)."""
+        with self._lock:
+            for rule in self._rules:
+                if rule.op != op or rule.path not in path:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                rule.fired += 1
+                return rule
+        return None
+
+
+_plan_lock = lockwitness.make_lock("storage.plan_lock")
+_active_plan: Optional[FaultPlan] = None
+
+
+def install_fault_plan(plan: Optional[FaultPlan]) -> None:
+    global _active_plan
+    with _plan_lock:
+        _active_plan = plan
+
+
+def clear_fault_plan() -> None:
+    install_fault_plan(None)
+
+
+def active_fault_plan() -> Optional[FaultPlan]:
+    return _active_plan
+
+
+@contextlib.contextmanager
+def fault_plan(plan: Optional[FaultPlan] = None, **rule_kwargs):
+    """Test scope: install ``plan`` (or a one-rule plan built from
+    ``rule_kwargs``) for the duration of the with-block."""
+    plan = plan or FaultPlan()
+    if rule_kwargs:
+        plan.add(**rule_kwargs)
+    prev = _active_plan
+    install_fault_plan(plan)
+    try:
+        yield plan
+    finally:
+        install_fault_plan(prev)
+
+
+def _plan_from_env() -> Optional[FaultPlan]:
+    spec = os.environ.get(ENV_FAULT, "")
+    if not spec or os.environ.get("TPUDRA_TEST_HOOKS") != "1":
+        return None
+    plan = FaultPlan()
+    for part in spec.split(";"):
+        if not part.strip():
+            continue
+        fields = part.split(":", 3)
+        if len(fields) < 2:
+            raise ValueError(f"bad {ENV_FAULT} rule {part!r}")
+        op, err_name = fields[0], fields[1]
+        times_s = fields[2] if len(fields) > 2 and fields[2] else "1"
+        path = fields[3] if len(fields) > 3 else ""
+        err = getattr(errno_mod, err_name, None)
+        if err is None:
+            raise ValueError(f"unknown errno {err_name!r} in {ENV_FAULT}")
+        times = None if times_s == "inf" else int(times_s)
+        plan.add(op=op, path=path, err=err, times=times)
+    return plan
+
+
+def _raise_injected(op: str, path: str, rule: FaultRule) -> None:
+    _count_fault(op, rule.err)
+    raise OSError(
+        rule.err, f"injected: {os.strerror(rule.err)}", path or None
+    )
+
+
+def _gate(op: str, path: str) -> None:
+    """Consult the active fault plan before a real op.  The stall (if any)
+    runs outside every lock; the raised OSError carries the rule's errno."""
+    plan = _active_plan
+    if plan is None:
+        return
+    rule = plan.match(op, path)
+    if rule is None:
+        return
+    if rule.delay_s > 0:
+        time.sleep(rule.delay_s)
+    if rule.err is not None:
+        _raise_injected(op, path, rule)
+
+
+# fd → path, so fd-based ops (write/fsync/truncate) can be matched by the
+# path rules of a fault plan.  Only fds opened through this seam register.
+_fd_lock = lockwitness.make_lock("storage.fd_lock")
+_fd_paths: dict[int, str] = {}
+
+
+def _fd_path(fd: int) -> str:
+    with _fd_lock:
+        return _fd_paths.get(fd, "")
+
+
+def open(path: str, flags: int, mode: int = 0o600) -> int:  # noqa: A001 — deliberate seam name
+    _gate("open", path)
+    try:
+        fd = os.open(path, flags, mode)
+    except OSError as e:
+        _count_fault("open", e.errno)
+        raise
+    with _fd_lock:
+        _fd_paths[fd] = path
+    return fd
+
+
+def close(fd: int) -> None:
+    with _fd_lock:
+        _fd_paths.pop(fd, None)
+    os.close(fd)
+
+
+def write(fd: int, data) -> int:
+    path = _fd_path(fd)
+    plan = _active_plan
+    if plan is not None:
+        rule = plan.match("write", path)
+        if rule is not None:
+            if rule.delay_s > 0:
+                time.sleep(rule.delay_s)
+            if rule.err is not None:
+                if rule.partial_bytes:
+                    # The mid-append shape: a real prefix lands, then the
+                    # device gives up — exactly what a torn frame is.
+                    with contextlib.suppress(OSError):
+                        os.write(fd, bytes(data)[: rule.partial_bytes])
+                _raise_injected("write", path, rule)
+    try:
+        return os.write(fd, data)
+    except OSError as e:
+        _count_fault("write", e.errno)
+        raise
+
+
+def fsync(fd: int) -> None:
+    _gate("fsync", _fd_path(fd))
+    try:
+        os.fsync(fd)
+    except OSError as e:
+        _count_fault("fsync", e.errno)
+        raise
+
+
+def ftruncate(fd: int, size: int) -> None:
+    _gate("truncate", _fd_path(fd))
+    try:
+        os.ftruncate(fd, size)
+    except OSError as e:
+        _count_fault("truncate", e.errno)
+        raise
+
+
+def replace(src: str, dst: str) -> None:
+    _gate("replace", dst)
+    try:
+        os.replace(src, dst)
+    except OSError as e:
+        _count_fault("replace", e.errno)
+        raise
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-completed rename/create in it is
+    durable.  fsyncing the file alone persists its *contents*; the rename
+    that makes the file *reachable* lives in the directory, and a crash
+    between the two can lose it (the classic rename-durability gap)."""
+    _gate("fsync_dir", path)
+    fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    try:
+        try:
+            os.fsync(fd)
+        except OSError as e:
+            _count_fault("fsync_dir", e.errno)
+            raise
+    finally:
+        os.close(fd)
+
+
+# ------------------------------------------------------------- composed ops
+
+
+def write_file(
+    path: str,
+    data: bytes,
+    site: str = "file",
+    durable: bool = False,
+    mode: int = 0o644,
+) -> None:
+    """Write ``path`` in place through the seam (no rename).  ``durable``
+    adds a file fsync.  For data whose durability is not load-bearing
+    (best-effort diagnostics) or whose target cannot be renamed over.
+    ``mode`` defaults to the builtin-open 0644 these helpers replaced —
+    several of the files (CDI specs, daemon.env, the dnsnames config) are
+    read by OTHER processes/containers, possibly as non-root."""
+    fd = open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+    try:
+        view = memoryview(data)
+        while view:
+            n = write(fd, view)
+            if n <= 0:
+                raise OSError(f"short write of {len(view)} byte(s) to {path}")
+            view = view[n:]
+        if durable:
+            fsync(fd)
+            metrics.STORAGE_FSYNCS_TOTAL.labels(site).inc()
+    finally:
+        close(fd)
+
+
+def atomic_replace(
+    path: str,
+    data: bytes,
+    site: str = "file",
+    tmp_path: Optional[str] = None,
+    durable: bool = True,
+    mode: int = 0o644,
+) -> None:
+    """The atomic durable-write idiom, in one place: write a temp file,
+    fsync it, rename over ``path``, fsync the parent directory — so a
+    crash at any point leaves either the old complete file or the new
+    complete file, reachable.  A failed tmp fsync NEVER renames over the
+    good file (the fail-stop snapshot contract); the tmp is unlinked
+    best-effort and the error propagates.  ``durable=False`` skips both
+    fsyncs for atomic-but-rewritten-on-a-cadence data (registration
+    files).  Fsyncs are counted per call site
+    (``tpudra_storage_fsyncs_total{site}``) so the durability of each
+    family of files is auditable from metrics alone."""
+    tmp = tmp_path if tmp_path is not None else path + ".tmp"
+    try:
+        write_file(tmp, data, site=site, durable=durable, mode=mode)
+        replace(tmp, path)
+        if durable:
+            fsync_dir(os.path.dirname(path) or ".")
+            metrics.STORAGE_FSYNCS_TOTAL.labels(site).inc()
+    except OSError:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+# Env arming happens once at import, like the crashpoint env reads: the
+# subprocess crash sweeps set TPUDRA_STORAGE_FAULT before exec and the
+# whole plugin process runs under the plan.
+_active_plan = _plan_from_env()
