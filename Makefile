@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage e2e-multihost soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition e2e-multihost soak image helm-render clean
 
 all: native test
 
@@ -153,6 +153,15 @@ bench-trace:
 # convergence — the bounded-p99 acceptance arm for storage-fault PRs.
 bench-storage:
 	set -o pipefail; python bench.py --storage-degraded | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Fractional-chip A/B (docs/partitioning.md): interleaved
+# partitioned-vs-whole-chip bind p50/p99 through the real bind path
+# (partition create + per-partition WAL records), plus the
+# packing-efficiency scenario (N half-chip claims per chip vs whole-chip
+# claims — resident claims and claims placed per chip-hour); CPU-only.
+bench-partition:
+	set -o pipefail; python bench.py --partition | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 # Chaos soak (docs/chaos.md): compound-fault long-run — apiserver latency

@@ -629,6 +629,139 @@ def bench_storage_degraded(iters: int = None, warmup: int = None) -> dict:
         }
 
 
+def bench_partition_ab(iters: int = None, warmup: int = None) -> dict:
+    """Fractional-chip A/B (`make bench-partition`, docs/partitioning.md):
+
+    (1) INTERLEAVED bind latency — whole-chip claims vs dynamic-partition
+    claims (partition create + per-partition WAL records on the bind
+    path) through the same DRA gRPC → flock → checkpoint → CDI path, p50
+    and p99 per arm.  The acceptance bar: partitioned bind within 2× the
+    whole-chip p50.
+
+    (2) PACKING — fill the node to saturation with whole-chip claims,
+    then with small (half-chip) partition claims: resident claims per
+    chip is the packing-efficiency ratio (the "millions of users" shape —
+    many small inference claims per chip), and a timed churn window
+    yields claims placed per chip-hour for each arm."""
+    from tests.test_device_state import mk_claim, opaque
+    from tpudra import featuregates as fg
+    from tpudra.kube import gvr
+
+    fg.feature_gates().set_from_map({fg.DYNAMIC_PARTITIONING: True})
+    iters = ITERS if iters is None else iters
+    warmup = WARMUP if warmup is None else warmup
+    api_v = "resource.tpu.google.com/v1beta1"
+    part_cfg = [opaque({"apiVersion": api_v, "kind": "TpuPartitionConfig"})]
+    chips = 4
+
+    def part_name(chip: int, placement: int) -> str:
+        return f"tpu-{chip}-part-1c.4hbm-{placement}-{placement * 4}"
+
+    with _bench_driver(num_chips=chips) as (kube, client, driver):
+        def one(uid: str, devices: list[str], configs) -> float:
+            claim = mk_claim(uid, devices, configs=configs, name=uid)
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            t0 = time.perf_counter()
+            resp = client.prepare([claim])
+            dt = (time.perf_counter() - t0) * 1000.0
+            if "error" in resp["claims"][uid]:
+                raise RuntimeError(f"prepare failed: {resp['claims'][uid]}")
+            client.unprepare([claim])
+            kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            return dt
+
+        chip_ms: list[float] = []
+        part_ms: list[float] = []
+        for i in range(iters + warmup):
+            # Interleaved arms: box drift hits both equally.
+            dt_c = one(f"bp-c-{i}", [f"tpu-{i % chips}"], None)
+            dt_p = one(f"bp-p-{i}", [part_name(i % chips, 0)], part_cfg)
+            if i >= warmup:
+                chip_ms.append(dt_c)
+                part_ms.append(dt_p)
+
+        def stats(samples: list[float]) -> dict:
+            s = sorted(samples)
+            return {
+                "p50_ms": round(statistics.median(s), 3),
+                "p99_ms": round(s[max(0, int(len(s) * 0.99) - 1)], 3),
+            }
+
+        # -- packing: saturation residency, then churn throughput --------
+        def fill(mk_devices, configs, prefix: str) -> list[dict]:
+            resident = []
+            for k in range(chips * 8):  # far past any real capacity
+                uid = f"{prefix}-{k}"
+                devices = mk_devices(k)
+                if devices is None:
+                    break
+                claim = mk_claim(uid, devices, configs=configs, name=uid)
+                kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                resp = client.prepare([claim])
+                if "error" in resp["claims"][uid]:
+                    kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+                    break
+                resident.append(claim)
+            return resident
+
+        def drain(resident: list[dict]) -> None:
+            for claim in resident:
+                uid = claim["metadata"]["uid"]
+                client.unprepare([claim])
+                kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+
+        whole = fill(
+            lambda k: [f"tpu-{k}"] if k < chips else None, None, "pk-c"
+        )
+        chip_resident = len(whole)
+        drain(whole)
+        placements = [
+            part_name(c, p) for c in range(chips) for p in (0, 1)
+        ]
+        small = fill(
+            lambda k: [placements[k]] if k < len(placements) else None,
+            part_cfg, "pk-p",
+        )
+        part_resident = len(small)
+        drain(small)
+
+        def churn(mk_devices, configs, prefix: str, window_s: float = 2.0) -> int:
+            """Bind+release small claims for a fixed wall window; the
+            count normalizes to claims placed per chip-hour."""
+            placed = 0
+            deadline = time.perf_counter() + window_s
+            while time.perf_counter() < deadline:
+                one(f"{prefix}-{placed}", mk_devices(placed), configs)
+                placed += 1
+            return placed
+
+        window_s = 2.0
+        chip_placed = churn(
+            lambda k: [f"tpu-{k % chips}"], None, "ch-c", window_s
+        )
+        part_placed = churn(
+            lambda k: [placements[k % len(placements)]], part_cfg, "ch-p",
+            window_s,
+        )
+        per_hour = 3600.0 / window_s / chips
+        return {
+            "iters": iters,
+            "whole_chip": stats(chip_ms),
+            "partition": stats(part_ms),
+            "bind_ratio_p50": round(
+                statistics.median(part_ms) / max(1e-9, statistics.median(chip_ms)), 2
+            ),
+            "packing": {
+                "chips": chips,
+                "whole_chip_resident": chip_resident,
+                "partition_resident": part_resident,
+                "efficiency": round(part_resident / max(1, chip_resident), 2),
+                "whole_chip_claims_per_chip_hour": round(chip_placed * per_hour),
+                "partition_claims_per_chip_hour": round(part_placed * per_hour),
+            },
+        }
+
+
 def bench_bind_partition_p50() -> dict:
     """Dynamic-partition bind p50 through the NATIVE C++ library.
 
@@ -1957,6 +2090,18 @@ def main(argv=None) -> None:
         line = {
             "metric": "storage_degraded_shed",
             **bench_storage_degraded(iters=iters, warmup=warmup),
+        }
+        print(json.dumps(line))
+        return
+
+    if "--partition" in argv:
+        # The fractional-chip artifact (`make bench-partition`,
+        # docs/partitioning.md): interleaved partitioned-vs-whole-chip
+        # bind p50/p99 plus the packing-efficiency scenario; CPU-only.
+        argv.remove("--partition")
+        line = {
+            "metric": "partition_bind",
+            **bench_partition_ab(iters=iters, warmup=warmup),
         }
         print(json.dumps(line))
         return

@@ -1,0 +1,30 @@
+"""BAD: partition lifecycle outside the effects phase (PARTITION-PHASE).
+
+Hardware mutation under a held lock serializes every bind on the node
+behind an O(seconds) devicelib call; inside a mutator closure it
+additionally runs on the group-commit leader under the cp.lock flock.
+"""
+
+
+class BadDriver:
+    def prepare_under_node_lock(self, spec):
+        with self._locked_pu():
+            self._lib.create_partition(spec)  # EXPECT: PARTITION-PHASE
+
+    def prepare_under_publish_lock(self, spec):
+        with self._publish_lock:
+            live = self._lib.create_partition(spec)  # EXPECT: PARTITION-PHASE
+        return live
+
+    def destroy_inside_mutator(self, uuid):
+        def drop_and_destroy(cp):
+            cp.prepared_claims.pop(uuid, None)
+            self._lib.delete_partition(uuid)  # EXPECT: PARTITION-PHASE, RMW-PURITY
+
+        self._cp.mutate(drop_and_destroy, touched=[uuid])
+
+    def destroy_inside_lambda_mutator(self, uuid):
+        self._cp.mutate(
+            lambda cp: self._lib.delete_partition(uuid),  # EXPECT: PARTITION-PHASE, RMW-PURITY
+            touched=[uuid],
+        )

@@ -1,0 +1,32 @@
+"""GOOD: the phased partition discipline (PARTITION-PHASE clean).
+
+Lifecycle calls run in the effects phase — lock-free (the per-claim-uid
+flock family is exempt by design: effects DO run under it) — and the
+checkpoint mutators only journal intent records.
+"""
+
+
+class GoodDriver:
+    def run_prepare_effects(self, item):
+        # Effects phase: no lock held; the durable PrepareStarted record
+        # is what reserves the silicon.
+        for spec in item.planned:
+            item.live.append(self._lib.create_partition(spec))
+
+    def prepare(self, claims):
+        with self._claims_serialized([c["uid"] for c in claims]):
+            # The claim-uid flock is the designed effects serialization:
+            # lifecycle calls under it are the correct shape.
+            for claim in claims:
+                self._lib.create_partition(claim["spec"])
+
+    def begin_unprepare(self, uid):
+        def mark_destroying(cp):
+            # Mutators journal INTENT; the hardware delete happens in the
+            # effects phase after the commit.
+            rec = cp.prepared_claims.get(uid)
+            if rec is not None:
+                rec.status = "Destroying"
+
+        self._cp.mutate(mark_destroying, touched=[uid])
+        self._lib.delete_partition(uid)

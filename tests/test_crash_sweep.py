@@ -228,6 +228,123 @@ def test_sigkill_at_checkpoint_boundary_converges(short_tmp, point, kind):
             h.terminate()
 
 
+def test_sigkill_at_mid_partition_create_leaks_nothing(short_tmp):
+    """SIGKILL in the new window between the per-partition Creating
+    journal append and the hardware mutation (docs/partitioning.md): the
+    'hardware' must show NO partition, the claim stays retryable, and the
+    restarted plugin's recovery sweep + kubelet retry converge to a clean
+    grant."""
+    uid = "crash-part-create"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        h = Harness(short_tmp, server)
+        h.start(crashpoint="mid-partition-create")
+        try:
+            claim = partition_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            try:
+                try:
+                    dra.prepare([claim])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+
+            # The kill's signature: Creating record + PrepareStarted claim
+            # durable, NO live partition (the record precedes the mutation).
+            statuses = h.claim_statuses()
+            assert statuses.get(uid) == "PrepareStarted", statuses
+            part_records = [
+                u for u in statuses if u.startswith("partition/")
+            ]
+            assert part_records, statuses
+            assert not h.live_partitions(), (
+                "no hardware may exist before the Creating record's window closes"
+            )
+
+            # Restart: the sweep drops the stale record; the retry binds.
+            h.start()
+            assert not h.live_partitions()
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid].get("devices"), resp
+                assert len(h.live_partitions()) == 1
+                dra.unprepare([claim])
+            finally:
+                dra.close()
+            assert not h.live_partitions()
+            statuses = h.claim_statuses()
+            assert uid not in statuses
+            assert not any(u.startswith("partition/") for u in statuses)
+        finally:
+            h.terminate()
+
+
+def test_sigkill_at_mid_partition_destroy_sweep_destroys_orphan(short_tmp):
+    """SIGKILL between the Destroying journal append and the hardware
+    delete: the orphan partition carries journaled destroy intent — the
+    restarted plugin's recovery sweep destroys it BEFORE serving, and the
+    kubelet's unprepare retry converges to nothing."""
+    uid = "crash-part-destroy"
+    with FakeKubeServer() as server:
+        client = KubeClient(server.url)
+        h = Harness(short_tmp, server)
+        h.start()
+        try:
+            claim = partition_claim(uid)
+            client.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            dra = h.dra()
+            try:
+                resp = dra.prepare([claim])
+                assert resp["claims"][uid].get("devices"), resp
+            finally:
+                dra.close()
+            assert len(h.live_partitions()) == 1
+
+            # Restart with the destroy-window crashpoint armed; the
+            # unprepare dies between the intent journal and the delete.
+            h.terminate()
+            h.start(crashpoint="mid-partition-destroy")
+            dra = h.dra()
+            try:
+                try:
+                    dra.unprepare([claim])
+                except RPCError:
+                    pass  # connection died mid-RPC: the expected shape
+            finally:
+                dra.close()
+            h.proc.wait(timeout=30)
+            assert h.proc.returncode == -signal.SIGKILL, h.log()
+            assert len(h.live_partitions()) == 1, "orphan with destroy intent"
+            statuses = h.claim_statuses()
+            assert statuses.get(uid) == "PrepareCompleted"
+
+            # Recovery: the sweep destroys the orphan from checkpoint
+            # truth alone, before the plugin serves.
+            h.start()
+            wait_for(
+                lambda: "destroying unknown partition" in h.log(),
+                timeout=30,
+                msg="recovery sweep destroys the orphan",
+            )
+            assert not h.live_partitions()
+            dra = h.dra()
+            try:
+                dra.unprepare([claim])  # kubelet retries the unprepare
+            finally:
+                dra.close()
+            statuses = h.claim_statuses()
+            assert uid not in statuses
+            assert not any(u.startswith("partition/") for u in statuses)
+            assert not any(uid in f for f in h.cdi_files())
+        finally:
+            h.terminate()
+
+
 def test_mid_compaction_sigkill_with_kubelet_restart_in_flight(short_tmp):
     """Composed crash (the chaos-soak scenario, proven at process level):
     SIGKILL lands at ``mid-compaction`` — snapshot replaced, journal not

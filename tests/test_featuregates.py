@@ -73,14 +73,22 @@ def test_dependency_validation_cliques_require_dns():
     gates.validate()  # both off: fine
 
 
-@pytest.mark.parametrize(
-    "other", [PASSTHROUGH_SUPPORT, TPU_DEVICE_HEALTH_CHECK, MULTI_PROCESS_SHARING]
-)
-def test_mutual_exclusion_with_dynamic_partitioning(other):
+def test_mutual_exclusion_with_dynamic_partitioning():
     gates = fg.feature_gates()
-    gates.set_from_map({DYNAMIC_PARTITIONING: True, other: True})
+    gates.set_from_map({DYNAMIC_PARTITIONING: True, PASSTHROUGH_SUPPORT: True})
     with pytest.raises(FeatureGateError, match="mutually"):
         gates.validate()
+
+
+@pytest.mark.parametrize(
+    "other", [TPU_DEVICE_HEALTH_CHECK, MULTI_PROCESS_SHARING]
+)
+def test_dynamic_partitioning_composes(other):
+    # The fractional-chip subsystem (docs/partitioning.md): partitions +
+    # multi-process sharing / partition-scoped health are one scenario.
+    gates = fg.feature_gates()
+    gates.set_from_map({DYNAMIC_PARTITIONING: True, other: True})
+    gates.validate()
 
 
 def test_versioned_defaults():

@@ -1,0 +1,168 @@
+"""Fractional chips end to end (docs/partitioning.md, the acceptance e2e):
+a MultiProcess claim for TWO fractional partitions of ONE chip yields
+
+- two dynamically created partitions, each with a Live per-partition
+  checkpoint record;
+- one RUNNING control-daemon process (the real ``tpu-mp-control-daemon``
+  spawned through the LocalDaemonRunner seam), gating prepare on its
+  READY probe;
+- a CDI grant whose env/mounts hand a workload the broker's pipe dir;
+- a REAL workload OS process that joins only via that grant env, ATTACHes
+  through ``control.sock``, and sees its ``TPUDRA_MP_*`` env and the
+  per-partition HBM/TensorCore limits;
+- a release that stops the daemon and destroys the partitions to ZERO
+  leaks (no live partition, no record, no CDI spec, no daemon pid).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from tests.test_device_state import mk_claim, opaque
+from tests.test_e2e import mk_driver
+from tpudra import featuregates as fg
+from tpudra.kube import gvr
+from tpudra.kube.fake import FakeKube
+from tpudra.plugin import partitions as partrec
+from tpudra.plugin.sharing import LocalDaemonRunner, MultiProcessManager
+from tpudra.sim.cdi import apply_cdi
+
+API_V = "resource.tpu.google.com/v1beta1"
+
+PART_A = "tpu-0-part-1c.4hbm-0-0"
+PART_B = "tpu-0-part-1c.4hbm-1-4"
+
+# The workload body: parse the grant env exactly as a containerized JAX
+# process would (ClaimEnv), ATTACH through the broker's control socket,
+# and report what it saw — run as a REAL OS process joined only by env.
+WORKLOAD = r"""
+import json, os
+from tpudra.workload.envspec import ClaimEnv
+
+env = ClaimEnv.from_environ()
+with env.attach_multiprocess() as limits:
+    print(json.dumps({
+        "pipe_dir": env.mp_pipe_dir,
+        "pct_env": os.environ["TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE"],
+        "partitions": os.environ.get("TPUDRA_PARTITIONS", ""),
+        "limits": limits,
+    }))
+"""
+
+
+def test_multiprocess_claim_over_two_fractional_partitions(tmp_path):
+    fg.feature_gates().set_from_map(
+        {fg.DYNAMIC_PARTITIONING: True, fg.MULTI_PROCESS_SHARING: True}
+    )
+    fg.validate()  # the gates must COMPOSE (the lifted exclusion)
+    kube = FakeKube()
+    d = mk_driver(tmp_path, kube)
+    runner = LocalDaemonRunner()
+    d.state._mp = MultiProcessManager(
+        kube, d.state._lib, "node-a",
+        pipe_root=str(tmp_path / "mp"), runner=runner,
+    )
+    d.start()
+    try:
+        claim = mk_claim(
+            "mp-frac", [PART_A, PART_B],
+            configs=[opaque({
+                "apiVersion": API_V,
+                "kind": "TpuPartitionConfig",
+                "sharing": {
+                    "strategy": "MultiProcess",
+                    "multiProcessConfig": {},
+                },
+            })],
+            name="mp-frac",
+        )
+        resp = d.prepare_resource_claims([claim])
+        result = resp["claims"]["mp-frac"]
+        assert "error" not in result, result
+
+        # Two live partitions of ONE chip, each with a Live record.
+        live = d.state._lib.list_partitions()
+        assert len(live) == 2
+        assert {p.spec.parent_index for p in live} == {0}
+        recs = partrec.records_in(d.state._cp.read())
+        assert {r.phase for r in recs.values()} == {partrec.PHASE_LIVE}
+        assert {r.partition_uuid for r in recs.values()} == {
+            p.uuid for p in live
+        }
+
+        # The control daemon is a RUNNING process, READY on its socket.
+        pipe_dir = os.path.join(str(tmp_path / "mp"), "mp-frac")
+        pid = runner.pid("mp-frac", pipe_dir)
+        assert pid is not None and _alive(pid)
+        from tpudra.mpdaemon import query
+
+        assert query(pipe_dir, "STATUS").startswith("READY 0 ")
+        # limits.json carries the per-PARTITION budgets: 1c.4hbm on a v5p
+        # chip (95 Gi, 8 slices) → 4/8 of HBM each, 50% of 2 TensorCores.
+        with open(os.path.join(pipe_dir, "limits.json")) as f:
+            limits = json.load(f)
+        part_uuids = {p.uuid for p in live}
+        assert set(limits["chipUUIDs"]) == part_uuids
+        assert limits["activeTensorCorePercentage"] == 50
+        assert set(limits["pinnedHbmLimits"]) == part_uuids
+        half_hbm_mi = 95 * 1024 // 2
+        assert all(
+            v == f"{half_hbm_mi}M" for v in limits["pinnedHbmLimits"].values()
+        )
+
+        # The Deployment shape is stamped too (production execution).
+        deps = kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"]
+        assert [x["metadata"]["name"] for x in deps] == [
+            "tpu-mp-control-daemon-mp-frac"
+        ]
+
+        # -- the REAL workload process, joined only via the CDI grant ----
+        spec = d.state._cdi.read_claim_spec("mp-frac")
+        ids = [i for dev in result["devices"] for i in dev["cdiDeviceIDs"]]
+        env, _, mounts = apply_cdi(spec, ids)
+        # containerd would bind-mount hostPath → containerPath; the sim
+        # resolves the container pipe path back to the host dir.
+        host_of = {c: h for h, c in mounts}
+        wl_env = dict(os.environ)
+        wl_env.update(env)
+        wl_env["TPUDRA_MP_PIPE_DIRECTORY"] = host_of[
+            env["TPUDRA_MP_PIPE_DIRECTORY"]
+        ]
+        proc = subprocess.run(
+            [sys.executable, "-c", WORKLOAD],
+            env=wl_env, capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        seen = json.loads(proc.stdout)
+        assert seen["pct_env"] == "50"
+        assert PART_A in seen["partitions"] and PART_B in seen["partitions"]
+        assert set(seen["limits"]["chipUUIDs"]) == part_uuids
+        assert seen["limits"]["activeTensorCorePercentage"] == 50
+        # The workload DETACHed on context exit: broker back to 0 clients.
+        assert query(pipe_dir, "STATUS").startswith("READY 0 ")
+
+        # -- release: zero leaks ----------------------------------------
+        resp = d.unprepare_resource_claims([{"uid": "mp-frac"}])
+        assert "error" not in resp["claims"]["mp-frac"]
+        assert d.state._lib.list_partitions() == []
+        assert partrec.records_in(d.state._cp.read()) == {}
+        assert d.state.prepared_claim_uids() == {}
+        assert d.state._cdi.read_claim_spec("mp-frac") is None
+        deadline = time.monotonic() + 10
+        while _alive(pid) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not _alive(pid), "control daemon must die with the claim"
+        assert not os.path.exists(os.path.join(pipe_dir, "daemon.pid"))
+        assert kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"] == []
+    finally:
+        d.stop()
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True

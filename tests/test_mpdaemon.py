@@ -1,0 +1,245 @@
+"""The multi-process control daemon's broker contract, over real unix
+sockets and a real daemon process (docs/partitioning.md "Daemon
+handshake"): STATUS/ATTACH/DETACH semantics, the LocalDaemonRunner
+execution seam, and the plugin's AssertReady gate mapping daemon-not-ready
+to a RETRYABLE prepare error."""
+
+import os
+import time
+
+import pytest
+
+from tpudra import featuregates as fg
+from tpudra.mpdaemon import ControlDaemon, query
+from tpudra.plugin.sharing import LocalDaemonRunner, MultiProcessManager
+
+API_V = "resource.tpu.google.com/v1beta1"
+
+
+def wait_until(cond, timeout=10.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg or cond}")
+
+
+# -- broker verbs over a real socket ----------------------------------------
+
+
+@pytest.fixture
+def broker(tmp_path):
+    d = ControlDaemon(
+        str(tmp_path / "pipe"),
+        env={
+            "TPUDRA_MP_CHIP_UUIDS": "part-aa,part-bb",
+            "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE": "50",
+            "TPUDRA_MP_PINNED_HBM_LIMITS": "part-aa=4096M;part-bb=4096M",
+            "TPUDRA_MP_PLATFORM_MODE": "concurrent",
+        },
+    )
+    d.start()
+    yield d
+    d.stop()
+
+
+def test_status_counts_attached_clients(broker):
+    assert query(broker.pipe_dir, "STATUS").startswith("READY 0 ")
+    assert query(broker.pipe_dir, "ATTACH client-1").startswith("OK ")
+    assert query(broker.pipe_dir, "ATTACH client-2").startswith("OK ")
+    assert query(broker.pipe_dir, "STATUS").startswith("READY 2 ")
+    assert query(broker.pipe_dir, "DETACH client-1") == "OK"
+    assert query(broker.pipe_dir, "STATUS").startswith("READY 1 ")
+    # DETACH of an unknown client is idempotent, not an error.
+    assert query(broker.pipe_dir, "DETACH ghost") == "OK"
+
+
+def test_attach_hands_back_limits_json(broker):
+    import json
+
+    resp = query(broker.pipe_dir, "ATTACH me")
+    limits = json.loads(resp[len("OK "):])
+    assert limits["chipUUIDs"] == ["part-aa", "part-bb"]
+    assert limits["activeTensorCorePercentage"] == 50
+    assert limits["pinnedHbmLimits"]["part-aa"] == "4096M"
+    assert limits["platformMode"] == "concurrent"
+    assert limits["enforcement"] == "cooperative"
+
+
+def test_unknown_verb_is_an_error_not_a_crash(broker):
+    assert query(broker.pipe_dir, "FROBNICATE x").startswith("ERR ")
+    assert query(broker.pipe_dir, "STATUS").startswith("READY ")
+
+
+def test_limits_json_materialized_in_pipe_dir(broker):
+    import json
+
+    with open(os.path.join(broker.pipe_dir, "limits.json")) as f:
+        limits = json.load(f)
+    assert limits["activeTensorCorePercentage"] == 50
+
+
+# -- the LocalDaemonRunner seam ---------------------------------------------
+
+
+def test_runner_spawns_real_daemon_and_stop_kills_it(tmp_path):
+    runner = LocalDaemonRunner()
+    pipe_dir = str(tmp_path / "mp" / "u1")
+    pid = runner.start(
+        "u1", pipe_dir,
+        {
+            "TPUDRA_MP_PIPE_DIRECTORY": pipe_dir,
+            "TPUDRA_MP_CHIP_UUIDS": "part-xx",
+            "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE": "25",
+            "TPUDRA_MP_PINNED_HBM_LIMITS": "part-xx=1024M",
+            "TPUDRA_MP_PLATFORM_MODE": "concurrent",
+        },
+    )
+    try:
+        wait_until(
+            lambda: os.path.exists(os.path.join(pipe_dir, "control.sock"))
+            and query(pipe_dir, "STATUS").startswith("READY"),
+            msg="daemon READY",
+        )
+        assert query(pipe_dir, "STATUS").startswith("READY 0 ")
+        assert runner.pid("u1", pipe_dir) == pid
+        with open(os.path.join(pipe_dir, "daemon.pid")) as f:
+            assert int(f.read()) == pid
+    finally:
+        runner.stop("u1", pipe_dir)
+    wait_until(lambda: not _alive(pid), msg="daemon dead")
+    assert not os.path.exists(os.path.join(pipe_dir, "daemon.pid"))
+
+
+def test_runner_stop_by_pidfile_survives_plugin_restart(tmp_path):
+    """A crashed plugin's runner handle dies with it; a FRESH runner must
+    still stop the orphan daemon through the pid file alone — the
+    cleanup_stale convergence path."""
+    pipe_dir = str(tmp_path / "mp" / "u-orphan")
+    old = LocalDaemonRunner()
+    pid = old.start(
+        "u-orphan", pipe_dir,
+        {"TPUDRA_MP_PIPE_DIRECTORY": pipe_dir, "TPUDRA_MP_CHIP_UUIDS": "x"},
+    )
+    wait_until(
+        lambda: os.path.exists(os.path.join(pipe_dir, "control.sock")),
+        msg="daemon up",
+    )
+    fresh = LocalDaemonRunner()  # the restarted plugin's runner
+    assert fresh.pid("u-orphan", pipe_dir) == pid
+    fresh.stop("u-orphan", pipe_dir)
+    # The old handle reaps the child (it stays a zombie of THIS process
+    # until waited; a real restarted plugin has no such parenthood).
+    old._procs["u-orphan"].wait(10)
+    assert not os.path.exists(os.path.join(pipe_dir, "daemon.pid"))
+
+
+def test_cleanup_stale_kills_recordless_local_daemon(tmp_path):
+    from tpudra.devicelib import MockTopologyConfig
+    from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.kube.fake import FakeKube
+
+    lib = MockDeviceLib(config=MockTopologyConfig(generation="v5p"))
+    runner = LocalDaemonRunner()
+    mp = MultiProcessManager(
+        FakeKube(), lib, "node-a", pipe_root=str(tmp_path / "mp"),
+        runner=runner,
+    )
+    pipe_dir = os.path.join(mp.pipe_root, "u-leaked")
+    pid = runner.start(
+        "u-leaked", pipe_dir,
+        {"TPUDRA_MP_PIPE_DIRECTORY": pipe_dir, "TPUDRA_MP_CHIP_UUIDS": "x"},
+    )
+    wait_until(lambda: _alive(pid), msg="daemon up")
+    removed = mp.cleanup_stale(valid_claim_uids={"u-live"})
+    assert removed == 1
+    wait_until(lambda: not _alive(pid), msg="leaked daemon dead")
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+# -- the AssertReady gate ----------------------------------------------------
+
+
+def test_daemon_not_ready_is_a_retryable_prepare_error(tmp_path):
+    """A broker that never comes up must fail the bind with
+    permanent=false — kubelet retries while the daemon starts, exactly
+    like the CD plugin's not-ready gate."""
+    from tests.test_device_state import mk_claim, opaque
+    from tests.test_e2e import mk_driver
+    from tpudra.kube.fake import FakeKube
+
+    fg.feature_gates().set_from_map({fg.MULTI_PROCESS_SHARING: True})
+
+    class NeverStartsRunner(LocalDaemonRunner):
+        def start(self, claim_uid, pipe_dir, env):  # noqa: ARG002
+            os.makedirs(pipe_dir, exist_ok=True)
+            return 0  # stamps nothing: the socket never appears
+
+    d = mk_driver(tmp_path, FakeKube())
+    d.state._mp = MultiProcessManager(
+        d._kube, d.state._lib, "node-a",
+        pipe_root=str(tmp_path / "mp"), runner=NeverStartsRunner(),
+    )
+    import tpudra.plugin.sharing as sharing_mod
+
+    orig = sharing_mod.MultiProcessControlDaemon.assert_ready
+    sharing_mod.MultiProcessControlDaemon.assert_ready = (
+        lambda self, timeout=0.3, poll=0.05: orig(
+            self, timeout=0.3, poll=0.05
+        )
+    )
+    try:
+        claim = mk_claim(
+            "u-gate", ["tpu-0"],
+            configs=[opaque({
+                "apiVersion": API_V,
+                "kind": "TpuConfig",
+                "sharing": {"strategy": "MultiProcess", "multiProcessConfig": {}},
+            })],
+            name="gate",
+        )
+        resp = d.prepare_resource_claims([claim])
+        result = resp["claims"]["u-gate"]
+        assert "not ready" in result["error"]
+        assert result["permanent"] is False  # kubelet retries
+    finally:
+        sharing_mod.MultiProcessControlDaemon.assert_ready = orig
+    # The failed prepare leaked nothing: undo stopped the daemon stamp.
+    from tpudra.kube import gvr
+
+    assert d._kube.list(gvr.DEPLOYMENTS, namespace="tpudra-system")["items"] == []
+
+
+def test_assert_ready_probes_socket_with_runner(tmp_path):
+    """With a local runner, readiness truth is the control socket itself
+    — no Deployment status reactor needed (FakeKube never sets
+    readyReplicas and the gate still opens)."""
+    from tpudra.api.sharing import MultiProcessConfig
+    from tpudra.devicelib import MockTopologyConfig
+    from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.kube.fake import FakeKube
+
+    lib = MockDeviceLib(config=MockTopologyConfig(generation="v5p"))
+    mp = MultiProcessManager(
+        FakeKube(), lib, "node-a", pipe_root=str(tmp_path / "mp"),
+        runner=LocalDaemonRunner(),
+    )
+    daemon = mp.new_daemon(
+        "u-sock", [lib.enumerate_chips()[0].uuid], MultiProcessConfig()
+    )
+    daemon.start()
+    try:
+        daemon.assert_ready(timeout=15.0, poll=0.05)
+        assert daemon.probe_ready()
+    finally:
+        daemon.stop()
+    # Stop killed the daemon: a fresh probe must fail.
+    assert not daemon.probe_ready()

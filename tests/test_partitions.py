@@ -1,0 +1,235 @@
+"""Per-partition WAL records and the partition recovery sweep
+(docs/partitioning.md): every dynamic partition's lifecycle is journaled
+(Creating → Live → Destroying) in its own ~70 B checkpoint record, the two
+new crash windows (``mid-partition-create`` / ``mid-partition-destroy``)
+converge through the REAL recovery path, and the sweep reconciles records
+⟷ hardware in both directions."""
+
+import pytest
+
+from tests.test_device_state import Harness, mk_claim, opaque
+from tpudra import featuregates as fg
+from tpudra.devicelib import PartitionSpec
+from tpudra.plugin import partitions as partrec
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    SimulatedCrash,
+    armed_crash,
+)
+from tpudra.plugin.device_state import DeviceState
+from tpudra.plugin.journal import decode_records
+
+API_V = "resource.tpu.google.com/v1beta1"
+
+PART_A = "tpu-0-part-1c.4hbm-0-0"
+PART_B = "tpu-0-part-1c.4hbm-1-4"
+
+
+def dyn(tmp_path, **kw):
+    fg.feature_gates().set_from_spec("DynamicPartitioning=true")
+    return Harness(tmp_path, **kw)
+
+
+def records(h):
+    return partrec.records_in(h.cp.read())
+
+
+# -- record lifecycle on the bind path --------------------------------------
+
+
+def test_prepare_journals_live_partition_records(tmp_path):
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A, PART_B]))
+    recs = records(h)
+    assert set(recs) == {partrec.record_uid(PART_A), partrec.record_uid(PART_B)}
+    live_uuids = {p.uuid for p in h.lib.list_partitions()}
+    for rec in recs.values():
+        assert rec.phase == partrec.PHASE_LIVE
+        assert rec.claim_uid == "u1"
+        assert rec.partition_uuid in live_uuids
+        assert rec.spec is not None
+
+    # The WAL carries the per-partition deltas as their own records:
+    # Creating upserts from begin's commit, Live upserts from finish's.
+    with open(h.cp.journal_path, "rb") as f:
+        wal_records, _, torn = decode_records(f.read())
+    assert not torn
+    part_ops = [
+        r for r in wal_records
+        if partrec.is_partition_record(r.get("uid", ""))
+    ]
+    assert len(part_ops) >= 4  # 2 Creating + 2 Live
+    phases = [
+        r["claim"]["groups"][0]["configState"]["partitionPhase"]
+        for r in part_ops
+    ]
+    assert phases[:2] == ["Creating", "Creating"]
+    assert phases[-2:] == ["Live", "Live"]
+
+
+def test_unprepare_drops_partition_records(tmp_path):
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A]))
+    h.state.unprepare("u1")
+    assert records(h) == {}
+    assert h.lib.list_partitions() == []
+    assert h.state.prepared_claim_uids() == {}
+
+
+def test_partition_records_invisible_to_claim_gc_scan(tmp_path):
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A]))
+    # The stale-claim GC's input: partition records must never appear
+    # (no namespace/name, no apiserver object to validate against).
+    assert set(h.state.prepared_claim_uids()) == {"u1"}
+
+
+# -- the two new crash windows ----------------------------------------------
+
+
+def test_crash_at_mid_partition_create_leaks_nothing(tmp_path):
+    """SIGKILL between the Creating journal append and the hardware
+    mutation: no partition exists, the Creating record + PrepareStarted
+    claim are durable, the sweep drops the stale record, and the retry
+    binds clean."""
+    h = dyn(tmp_path)
+    claim = mk_claim("u1", [PART_A])
+    with pytest.raises(SimulatedCrash):
+        with armed_crash("mid-partition-create"):
+            h.state.prepare(claim)
+    assert h.lib.list_partitions() == []  # no hardware before the record
+    recs = records(h)
+    assert recs[partrec.record_uid(PART_A)].phase == partrec.PHASE_CREATING
+    assert h.state.prepared_claim_uids()["u1"][2] == PREPARE_STARTED
+
+    # "Restart": fresh DeviceState over the same dirs, real recovery.
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    assert state2.destroy_unknown_partitions() == 0  # nothing leaked
+    assert records(h) == {}  # stale Creating record dropped
+    out = state2.prepare(claim)  # the kubelet retry
+    assert out[0].device_name == PART_A
+    assert len(h.lib.list_partitions()) == 1
+    assert records(h)[partrec.record_uid(PART_A)].phase == partrec.PHASE_LIVE
+    state2.unprepare("u1")
+    assert h.lib.list_partitions() == []
+
+
+def test_crash_at_mid_partition_destroy_sweep_destroys_orphan(tmp_path):
+    """SIGKILL between the Destroying journal append and the hardware
+    delete: the partition is an orphan with journaled destroy intent —
+    the recovery sweep destroys it and the unprepare retry converges."""
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A]))
+    with pytest.raises(SimulatedCrash):
+        with armed_crash("mid-partition-destroy"):
+            h.state.unprepare("u1")
+    assert len(h.lib.list_partitions()) == 1  # hardware outlived the crash
+    recs = records(h)
+    assert recs[partrec.record_uid(PART_A)].phase == partrec.PHASE_DESTROYING
+    # The claim record is still present (finish never ran).
+    assert h.state.prepared_claim_uids()["u1"][2] == PREPARE_COMPLETED
+
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    destroyed = state2.destroy_unknown_partitions()
+    assert destroyed == 1  # the orphan with journaled intent
+    assert h.lib.list_partitions() == []
+    assert records(h) == {}
+    state2.unprepare("u1")  # kubelet retries; must be idempotent
+    assert h.state.prepared_claim_uids() == {}
+
+
+# -- sweep reconciliation (record ⟷ hardware, both directions) --------------
+
+
+def test_sweep_drops_live_record_when_hardware_vanished(tmp_path):
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A]))
+    rec = records(h)[partrec.record_uid(PART_A)]
+    # Out-of-band hardware loss (operator intervention, device reset).
+    h.lib.delete_partition(rec.partition_uuid)
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    assert state2.destroy_unknown_partitions() == 0
+    assert records(h) == {}  # the lying record is gone
+
+
+def test_sweep_destroys_partition_whose_claim_vanished(tmp_path):
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A]))
+    # Force-drop the claim record, keeping the Live partition record —
+    # the corrupt-fallback / manual-repair shape.
+    h.cp.mutate(
+        lambda cp: cp.prepared_claims.pop("u1", None) and None, touched=["u1"]
+    )
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    assert state2.destroy_unknown_partitions() == 1
+    assert h.lib.list_partitions() == []
+    assert records(h) == {}
+
+
+def test_sweep_still_destroys_recordless_partition(tmp_path):
+    # The original DestroyUnknownMIGDevices contract is unchanged: live
+    # silicon with NO explanation at all is destroyed.
+    h = dyn(tmp_path)
+    h.lib.create_partition(PartitionSpec(1, "1c.4hbm", 0, 0))
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    assert state2.destroy_unknown_partitions() == 1
+    assert h.lib.list_partitions() == []
+
+
+def test_sweep_leaves_healthy_state_alone(tmp_path):
+    h = dyn(tmp_path)
+    h.state.prepare(mk_claim("u1", [PART_A]))
+    state2 = DeviceState(h.lib, h.cdi, h.cp, "node-a")
+    assert state2.destroy_unknown_partitions() == 0
+    assert len(h.lib.list_partitions()) == 1
+    assert records(h)[partrec.record_uid(PART_A)].phase == partrec.PHASE_LIVE
+
+
+def test_failed_create_retry_reconverges_records(tmp_path):
+    """The injected-hardware-fault shape: a half-failed multi-partition
+    prepare leaves Creating records; the retry re-journals and completes
+    them — records and hardware agree at every quiet point."""
+    from tests.test_device_state import inject_create_failure
+    from tpudra.plugin.device_state import PrepareError
+
+    h = dyn(tmp_path)
+    inject_create_failure(h.lib, (1, 4))
+    with pytest.raises(PrepareError):
+        h.state.prepare(mk_claim("u1", [PART_A, PART_B]))
+    recs = records(h)
+    assert {r.phase for r in recs.values()} == {partrec.PHASE_CREATING}
+    out = h.state.prepare(mk_claim("u1", [PART_A, PART_B]))
+    assert len(out) == 2
+    recs = records(h)
+    assert {r.phase for r in recs.values()} == {partrec.PHASE_LIVE}
+    live = {p.uuid for p in h.lib.list_partitions()}
+    assert {r.partition_uuid for r in recs.values()} == live
+
+
+# -- publication surface -----------------------------------------------------
+
+
+def test_partition_templates_carry_fraction_and_counters(tmp_path):
+    from tpudra.plugin.resourceslice import generate_driver_resources
+
+    h = dyn(tmp_path)
+    res = generate_driver_resources(
+        h.state.allocatable, partitionable=True, node_name="node-a"
+    )
+    by_name = {d["name"]: d for d in res.devices}
+    part = by_name[PART_A]
+    # profile × TensorCore-fraction × HBM budget, advertised.  The
+    # fraction is an integer percent so CEL comparisons order correctly.
+    assert part["attributes"]["profile"]["string"] == "1c.4hbm"
+    assert part["attributes"]["tensorcorePercent"]["int"] == 50
+    assert part["attributes"]["hbmSlices"]["int"] == 4
+    # hbm-slice-* capacity counters let the scheduler pack disjoint
+    # fractions of one chip (KEP-4815 arithmetic).
+    consumed = part["consumesCounters"][0]["counters"]
+    assert {f"hbm-slice-{i}" for i in range(4)} <= set(consumed)
+    assert consumed["tensorcores"]["value"] == "1"
+    # The chip's counter set advertises the full budget.
+    counters = {c["name"]: c for c in res.shared_counters}
+    assert "tpu-0-counters" in counters
+    assert len(counters["tpu-0-counters"]["counters"]) == 1 + 8

@@ -87,6 +87,9 @@ def main() -> int:
     gang = current_headline(sys.argv[1], metric="gang_bind")
     if gang is not None:
         print_gang_section(gang)
+    partition = current_headline(sys.argv[1], metric="partition_bind")
+    if partition:
+        print_partition_section(partition)
     storage = current_headline(sys.argv[1], metric="storage_degraded_shed")
     if storage is not None:
         print_storage_section(storage)
@@ -136,6 +139,30 @@ def print_apiserver_section(now: dict) -> None:
         f"(batch of {n}): cached {cached} ms vs per-claim-GET {uncached} ms "
         f"({ab.get('improvement_ms', round(uncached - cached, 3))} ms "
         f"left the hot path; ~{n} serialized GET RTTs = {n * rtt:g} ms)"
+    )
+
+
+def print_partition_section(ab: dict) -> None:
+    """Fractional-chip A/B (docs/partitioning.md): partitioned vs
+    whole-chip bind latency plus the packing-efficiency scenario."""
+    if "error" in ab:
+        print(f"bench-delta: partition section errored: {ab['error']}")
+        return
+    whole, part = ab.get("whole_chip", {}), ab.get("partition", {})
+    pk = ab.get("packing", {})
+    print(
+        "bench-delta: partition bind p50 "
+        f"{part.get('p50_ms')}ms vs whole-chip {whole.get('p50_ms')}ms "
+        f"(ratio {ab.get('bind_ratio_p50')}x, budget ≤2x), p99 "
+        f"{part.get('p99_ms')} vs {whole.get('p99_ms')}ms"
+    )
+    print(
+        "bench-delta: packing "
+        f"{pk.get('partition_resident')} partition claims vs "
+        f"{pk.get('whole_chip_resident')} whole-chip on {pk.get('chips')} "
+        f"chips (efficiency {pk.get('efficiency')}x, budget ≥2x); "
+        f"claims/chip-hour {pk.get('partition_claims_per_chip_hour')} vs "
+        f"{pk.get('whole_chip_claims_per_chip_hour')}"
     )
 
 

@@ -41,6 +41,7 @@ REQUIRED_CHECKED = (
     "gang-degraded",
     "acknowledged-mutation-durability",
     "storage-degraded-convergence",
+    "partition-leak",
 )
 
 #: Fault kinds every soak run must have injected at least once — checked
@@ -58,6 +59,7 @@ REQUIRED_KINDS = (
     "chip_fault",
     "daemon_crash",
     "disk_fault",
+    "partition_fault",
 )
 
 
@@ -170,7 +172,7 @@ def main(argv=None) -> int:
     parser.add_argument("report", help="path to the soak's JSON report")
     parser.add_argument("--assert-slo", action="store_true")
     parser.add_argument("--min-sim-hours", type=float, default=1.0)
-    parser.add_argument("--min-faults", type=int, default=10)
+    parser.add_argument("--min-faults", type=int, default=11)
     args = parser.parse_args(argv)
     with open(args.report) as f:
         report = json.load(f)

@@ -319,6 +319,43 @@ class LockModel:
         #: informer.dispatch_lock → workqueue.cond/backoff_lock exactly
         #: this way: controller handlers enqueue reconciles in-handler).
         self._handler_targets = self._collect_handler_targets()
+        #: Methods passed to ``Driver._run_effects`` (the effects-phase
+        #: fan-out invokes them through a function-valued ``effect``
+        #: parameter the call graph cannot resolve) — modeled as direct
+        #: callees of the dispatch, like Informer handlers above.  The
+        #: partition_fault soak witnessed flock:claim-uid →
+        #: accounting.counts_lock exactly this way: the MP control-daemon
+        #: stamp is an apiserver write inside the prepare effects phase.
+        self._effect_targets = self._collect_effect_targets()
+
+    def _collect_effect_targets(self) -> list[FunctionInfo]:
+        """Every bound method passed as the effect callable to a
+        ``_run_effects(...)`` call (``self.state.run_prepare_effects``
+        shapes): resolved by unique method name across the graph — the
+        same last-resort resolution the call graph itself uses, precise
+        here because the effect entry points are uniquely named."""
+        targets: list[FunctionInfo] = []
+        seen: set[str] = set()
+        for fn in self.graph.functions.values():
+            for node in ast.walk(fn.node):
+                if not (
+                    isinstance(node, ast.Call)
+                    and astutil.call_name(node) == "_run_effects"
+                    and len(node.args) >= 2
+                ):
+                    continue
+                arg = node.args[1]
+                if not isinstance(arg, ast.Attribute):
+                    continue
+                for cand in self.graph.functions.values():
+                    if (
+                        cand.name == arg.attr
+                        and cand.class_name
+                        and cand.qualname not in seen
+                    ):
+                        seen.add(cand.qualname)
+                        targets.append(cand)
+        return sorted(targets, key=lambda f: f.qualname)
 
     def _collect_handler_targets(self) -> list[FunctionInfo]:
         """Every function passed to an ``add_handler(...)`` registration:
@@ -694,6 +731,17 @@ class LockModel:
             return self._ir[fn.qualname]
         self._ir[fn.qualname] = []  # recursion guard
         events = self._build_stmts(fn, fn.node.body, lexical_holds=[])
+        if fn.qualname.endswith("Driver._run_effects"):
+            # The effects-phase fan-out invokes a function-valued
+            # ``effect`` parameter from inside a nested worker def the
+            # statement walk deliberately skips — model the dispatch as
+            # calling every registered effect method directly (see
+            # _collect_effect_targets), so the locks effects take (the MP
+            # daemon stamp's accounted apiserver write, devicelib
+            # mutations) contribute edges from whatever the dispatching
+            # bind holds (the partition_fault soak witnessed
+            # flock:claim-uid → accounting.counts_lock exactly here).
+            events.extend(CallEv(fn.node, fn=t) for t in self._effect_targets)
         self._ir[fn.qualname] = events
         return events
 

@@ -43,6 +43,7 @@ def all_rules() -> list[Rule]:
     )
     from tpudra.analysis.rules.locks import BlockUnderLock, LockOrder
     from tpudra.analysis.rules.metrics_hygiene import MetricsHygiene
+    from tpudra.analysis.rules.partition_phase import PartitionPhase
     from tpudra.analysis.rules.rmw_purity import RmwPurity
     from tpudra.analysis.rules.shared_state import SharedState
     from tpudra.analysis.rules.span_hygiene import SpanHygiene
@@ -58,6 +59,7 @@ def all_rules() -> list[Rule]:
         ExcSwallow(),
         SpanHygiene(),
         DurableWrite(),
+        PartitionPhase(),
         LockCycle(lockgraph),
         BlockUnderLockIP(lockgraph),
         FlockInversion(lockgraph),

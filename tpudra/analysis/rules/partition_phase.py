@@ -1,0 +1,142 @@
+"""PARTITION-PHASE: partition lifecycle calls run in the effects phase.
+
+The phased bind discipline (docs/bind-path.md, docs/partitioning.md)
+puts hardware mutation — ``create_partition`` / ``delete_partition``,
+O(seconds) on real silicon — in the EFFECTS phase: outside the node-wide
+``pu.lock`` and every in-process lock, and never inside a checkpoint
+mutator closure (the RMW phases must stay pure and O(µs); a devicelib
+call in a mutator would also run on whichever thread leads the group
+commit, under the ``cp.lock`` flock, serializing every other bind on the
+node behind a hardware op).  The per-claim-uid flock family is exempt by
+design — effects DO run under ``_claims_serialized``.
+
+Two shapes are findings in the scoped modules:
+
+- a lifecycle call lexically inside a ``with`` whose context is a lock
+  (``_locked_pu()`` / ``_pu_lock()`` / a ``Flock`` acquisition / any
+  ``*_lock`` / ``*_cond`` attribute);
+- a lifecycle call inside a function (or lambda) passed to a
+  ``mutate(...)`` call — a checkpoint mutator closure.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from tpudra.analysis import astutil
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+SCOPE_SUFFIXES = (
+    "tpudra/plugin/device_state.py",
+    "tpudra/plugin/driver.py",
+    "fixtures/lint/bad/partition_phase.py",
+    "fixtures/lint/good/partition_phase.py",
+)
+
+LIFECYCLE_CALLS = frozenset({"create_partition", "delete_partition"})
+
+#: With-contexts that mark the locked (non-effects) phases.  The
+#: claim-uid flock helper (``_claims_serialized``) is deliberately NOT
+#: here: effects run under it by design.
+_LOCK_CALL_NAMES = frozenset({"_locked_pu", "_pu_lock", "Flock"})
+
+
+def _in_scope(path: str) -> bool:
+    return path.replace(os.sep, "/").endswith(SCOPE_SUFFIXES)
+
+
+def _is_lockish_context(expr) -> bool:
+    """True when a with-item context expression is a lock acquisition."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name in _LOCK_CALL_NAMES:
+                return True
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name and (name.endswith("_lock") or name.endswith("_cond")):
+            return True
+    return False
+
+
+class PartitionPhase(Rule):
+    rule_id = "PARTITION-PHASE"
+    description = (
+        "partition lifecycle calls (create_partition/delete_partition) "
+        "must run in the effects phase: not under in-process/pu locks, "
+        "not inside checkpoint mutator closures"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        if not _in_scope(module.path):
+            return []
+        out: list[Finding] = []
+        # Functions/lambdas handed to mutate(...) are mutator closures.
+        mutator_names: set[str] = set()
+        mutator_lambdas: set[int] = set()
+        for node in ast.walk(module.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and astutil.call_name(node) == "mutate"
+                and node.args
+            ):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                mutator_names.add(arg.id)
+            elif isinstance(arg, ast.Lambda):
+                mutator_lambdas.add(id(arg))
+
+        def scan(node, in_mutator: bool, lock_depth: int) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                in_mutator = in_mutator or node.name in mutator_names
+                # A fresh def resets the lexical lock context: its body
+                # runs when CALLED, not where it is defined — except that
+                # a mutator closure's body always runs inside the commit.
+                lock_depth = 0
+            elif isinstance(node, ast.Lambda):
+                in_mutator = in_mutator or id(node) in mutator_lambdas
+                lock_depth = 0
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                lockish = any(
+                    _is_lockish_context(item.context_expr)
+                    for item in node.items
+                )
+                for item in node.items:
+                    scan(item.context_expr, in_mutator, lock_depth)
+                for child in node.body:
+                    scan(child, in_mutator, lock_depth + int(lockish))
+                return
+            if (
+                isinstance(node, ast.Call)
+                and astutil.call_name(node) in LIFECYCLE_CALLS
+            ):
+                if in_mutator:
+                    out.append(
+                        self.finding(
+                            module, node,
+                            f"{astutil.call_name(node)} inside a checkpoint "
+                            "mutator closure: partition lifecycle is "
+                            "effects-phase work — the RMW must journal "
+                            "intent, never mutate hardware",
+                        )
+                    )
+                elif lock_depth > 0:
+                    out.append(
+                        self.finding(
+                            module, node,
+                            f"{astutil.call_name(node)} under a held lock: "
+                            "partition lifecycle is effects-phase work — "
+                            "run it outside the locked RMW phases",
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                scan(child, in_mutator, lock_depth)
+
+        scan(module.tree, in_mutator=False, lock_depth=0)
+        return out

@@ -207,12 +207,19 @@ class FeatureGates:
                 f"feature gate {DRA_RESOURCE_HEALTH_SERVICE} requires "
                 f"{TPU_DEVICE_HEALTH_CHECK} to also be enabled"
             )
-        for other in (PASSTHROUGH_SUPPORT, TPU_DEVICE_HEALTH_CHECK, MULTI_PROCESS_SHARING):
-            if self.enabled(DYNAMIC_PARTITIONING) and self.enabled(other):
-                raise FeatureGateError(
-                    f"feature gate {DYNAMIC_PARTITIONING} is currently mutually "
-                    f"exclusive with {other}"
-                )
+        # DynamicPartitioning composes with MultiProcessSharing (a
+        # MultiProcess claim over fractional partitions is the MPS-on-MIG
+        # analog; the partition subsystem journals per-partition records
+        # and the MP broker is stamped per claim — docs/partitioning.md)
+        # and with TPUDeviceHealthCheck (partition-scoped health events
+        # resolve through live_partition uuids).  Passthrough stays
+        # mutually exclusive: rebinding a partitioned chip's PCI function
+        # to vfio would yank silicon out from under live partitions.
+        if self.enabled(DYNAMIC_PARTITIONING) and self.enabled(PASSTHROUGH_SUPPORT):
+            raise FeatureGateError(
+                f"feature gate {DYNAMIC_PARTITIONING} is currently mutually "
+                f"exclusive with {PASSTHROUGH_SUPPORT}"
+            )
 
 
 # ---------------------------------------------------------------------------

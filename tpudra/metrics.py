@@ -323,6 +323,17 @@ GANG_BIND_SECONDS = Histogram(
     ["nodes"],
     buckets=_PREPARE_BUCKETS,
 )
+PARTITION_LIFECYCLE_TOTAL = Counter(
+    "tpudra_partition_lifecycle_total",
+    "Dynamic-partition hardware mutations and record reconciliations "
+    "(docs/partitioning.md), by op: create / destroy are bind-path "
+    "devicelib mutations, sweep-destroy is a recovery-sweep teardown of "
+    "an unexplained or Destroying-phase partition, record-drop is a "
+    "sweep-dropped checkpoint record with no live hardware to explain "
+    "it — nonzero sweep rates in steady state mean crashes are leaking "
+    "partitions",
+    ["op"],
+)
 STORAGE_FAULTS_TOTAL = Counter(
     "tpudra_storage_faults_total",
     "Storage-errno failures (ENOSPC/EIO/EROFS/EDQUOT/ENODEV) surfaced by "

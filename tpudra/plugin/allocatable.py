@@ -113,6 +113,18 @@ class AllocatableDevice:
             attrs["profile"] = {"string": self.partition_spec.profile}
             attrs["coreStart"] = {"int": self.partition_spec.core_start}
             attrs["hbmStart"] = {"int": self.partition_spec.hbm_start}
+            # The packing surface (docs/partitioning.md): what fraction of
+            # the parent chip's TensorCores this template grants, as an
+            # integer PERCENT so a CEL selector can ask for "at least half
+            # a chip" with an ordered comparison (a "1/2" string would
+            # compare lexicographically) without knowing the generation's
+            # core count.
+            cores, hbm_slices = _profile_counts(self.partition_spec.profile)
+            if chip.tensorcores:
+                attrs["tensorcorePercent"] = {
+                    "int": round(100 * cores / chip.tensorcores)
+                }
+            attrs["hbmSlices"] = {"int": hbm_slices}
             if self.live_partition is not None:
                 attrs["uuid"] = {"string": self.live_partition.uuid}
                 attrs["parentUUID"] = {"string": chip.uuid}

@@ -72,10 +72,18 @@ from tpudra.plugin.checkpoint import (
     PreparedDeviceGroup,
     _crashpoint,  # re-export: the crash sweeps and cdplugin import it here
 )
+from tpudra.plugin import partitions as partrec
 from tpudra.plugin.sharing import MultiProcessManager, TimeSlicingManager
 from tpudra.plugin.vfio import VfioManager
 
 logger = logging.getLogger(__name__)
+
+# Labelled counter children resolved once (METRICS-HYGIENE: .labels() is
+# registry-locked and partition create sits on the bind hot path).
+_PART_CREATED = metrics.PARTITION_LIFECYCLE_TOTAL.labels("create")
+_PART_DESTROYED = metrics.PARTITION_LIFECYCLE_TOTAL.labels("destroy")
+_PART_SWEPT = metrics.PARTITION_LIFECYCLE_TOTAL.labels("sweep-destroy")
+_PART_RECORD_DROPPED = metrics.PARTITION_LIFECYCLE_TOTAL.labels("record-drop")
 
 
 class PermanentError(Exception):
@@ -128,6 +136,13 @@ class PrepareItem:
     def device_names(self) -> list[str]:
         return [r.get("device", "") for r in self.results]
 
+    def partition_record_uids(self) -> list[str]:
+        """Checkpoint keys of this claim's per-partition records (one per
+        planned dynamic partition, docs/partitioning.md)."""
+        return [
+            partrec.record_uid(alloc.partition_name(s)) for s in self.planned
+        ]
+
     def device_results(self) -> list[PreparedDeviceResult]:
         """The grant to return to kubelet: idempotent-cached or fresh."""
         if self.cached is not None:
@@ -154,6 +169,9 @@ class UnprepareItem:
     #: Partition UUIDs owned by OTHER completed claims at begin time
     #: (rollback of a partial claim must not destroy these).
     owned_partitions: set = field(default_factory=set)
+    #: Checkpoint keys of the claim's per-partition records: flipped to
+    #: Destroying at begin, dropped with the claim record at finish.
+    partition_uids: list = field(default_factory=list)
     error: Optional[Exception] = None
     #: Side effects finished; finish_unprepare drops the record.
     done: bool = False
@@ -345,11 +363,13 @@ class DeviceState:
                     item.error = e
 
         # Delta contract: start_all reads every claim (overlap validation)
-        # but writes only the batch's uids — the commit appends O(batch)
-        # journal records, not an O(state) snapshot.
-        self._cp.mutate(
-            start_all, touched=[it.uid for it in batch.items if it.uid]
-        )
+        # but writes only the batch's uids plus their per-partition record
+        # keys — the commit appends O(batch + planned partitions) journal
+        # records (~70 B each), not an O(state) snapshot.
+        touched = [it.uid for it in batch.items if it.uid]
+        for item in batch.items:
+            touched.extend(item.partition_record_uids())
+        self._cp.mutate(start_all, touched=touched)
         if any(it.started for it in batch.items):
             _crashpoint("post-prepare-started")
         for item in batch.items:
@@ -380,6 +400,17 @@ class DeviceState:
                 existing, _owned_partition_uuids(cp, existing.uid)
             )
         self._validate_no_overlap(cp, item.uid, item.results)
+        # Journal one per-partition record per planned dynamic partition
+        # (phase=Creating) in the SAME commit as PrepareStarted: the
+        # partition's lifecycle is durable intent before any hardware
+        # mutation, and the recovery sweep owns anything that dies between
+        # this record and the Live flip.  An idempotent retry re-upserts
+        # identical records — zero delta bytes.
+        for spec in item.planned:
+            pname = alloc.partition_name(spec)
+            cp.prepared_claims[partrec.record_uid(pname)] = partrec.make_record(
+                pname, partrec.PHASE_CREATING, item.uid, spec
+            )
         cp.prepared_claims[item.uid] = PreparedClaim(
             uid=item.uid,
             namespace=item.namespace,
@@ -424,6 +455,12 @@ class DeviceState:
                 attrs={"claim": item.uid},
             ):
                 self._rollback_partial(old_record, owned)
+        if item.planned:
+            # The new crash window this subsystem introduces: the Creating
+            # records are durable (begin's commit), NO hardware has been
+            # mutated — a SIGKILL here must leak nothing (the recovery
+            # sweep drops the stale records; the claim stays retryable).
+            _crashpoint("mid-partition-create")
         undos: list = []
         t0 = time.monotonic()
         try:
@@ -456,6 +493,17 @@ class DeviceState:
         done = [it for it in batch.items if it.plain_groups is not None]
         if not done:
             return
+
+        def _live_partitions(item: PrepareItem) -> list[tuple[str, str]]:
+            """(canonical name, live uuid) of the claim's fresh dynamic
+            partitions, straight from the effects phase's grant."""
+            return [
+                (d.canonical_name, d.attributes.get("partitionUUID", ""))
+                for g in item.plain_groups
+                for d in g.devices
+                if d.type == alloc.TYPE_PARTITION_DYNAMIC
+            ]
+
         def complete_all(cp: Checkpoint) -> None:
             for item in done:
                 prev = cp.prepared_claims.get(item.uid)
@@ -469,15 +517,37 @@ class DeviceState:
                     traceparent=prev.traceparent if prev is not None else None,
                     groups=item.plain_groups,
                 )
+                # The same commit flips each partition record Creating →
+                # Live with the hardware uuid: claim completion and
+                # partition-record truth can never diverge across a crash.
+                for pname, uuid in _live_partitions(item):
+                    spec = alloc.parse_partition_name(pname)
+                    if spec is None:
+                        continue
+                    cp.prepared_claims[partrec.record_uid(pname)] = (
+                        partrec.make_record(
+                            pname, partrec.PHASE_LIVE, item.uid, spec,
+                            partition_uuid=uuid,
+                        )
+                    )
 
-        self._cp.mutate(complete_all, touched=[it.uid for it in done])
+        touched = [it.uid for it in done]
+        for item in done:
+            touched.extend(
+                partrec.record_uid(pname) for pname, _ in _live_partitions(item)
+            )
+        self._cp.mutate(complete_all, touched=touched)
         _crashpoint("post-completed")
 
     def begin_unprepare(self, claim_uids: list[str]) -> UnprepareBatch:
         """Phase 1 of a batched unprepare: ONE checkpoint read snapshots
         each claim's record and the partition-ownership set rollback needs.
-        Nothing is written yet — the record stays in place (still reserving
-        its silicon) until finish_unprepare."""
+        The claim record stays in place (still reserving its silicon)
+        until finish_unprepare; claims holding dynamic partitions
+        additionally journal destroy INTENT — their per-partition records
+        flip to Destroying in one commit — so a crash between here and the
+        hardware delete leaves orphans the recovery sweep destroys
+        (``mid-partition-destroy``)."""
         batch = UnprepareBatch()
         cp = self._cp.read()
         seen: set[str] = set()
@@ -493,6 +563,26 @@ class DeviceState:
             item.record = cp.prepared_claims.get(uid)
             if item.record is not None and item.record.status == PREPARE_STARTED:
                 item.owned_partitions = _owned_partition_uuids(cp, uid)
+            if item.record is not None:
+                item.partition_uids = _claim_partition_record_uids(item.record)
+        flip = [u for it in batch.items for u in it.partition_uids]
+        if flip:
+
+            def mark_destroying(cpw: Checkpoint) -> None:
+                for rec_uid in flip:
+                    claim = cpw.prepared_claims.get(rec_uid)
+                    if claim is None:
+                        continue
+                    rec = partrec.parse_record(rec_uid, claim)
+                    if rec is None or rec.spec is None:
+                        continue
+                    cpw.prepared_claims[rec_uid] = partrec.make_record(
+                        rec.name, partrec.PHASE_DESTROYING, rec.claim_uid,
+                        rec.spec, partition_uuid=rec.partition_uuid,
+                    )
+
+            self._cp.mutate(mark_destroying, touched=flip)
+            _crashpoint("mid-partition-destroy")
         return batch
 
     def run_unprepare_effects(self, item: UnprepareItem) -> None:
@@ -513,8 +603,14 @@ class DeviceState:
 
     def finish_unprepare(self, batch: UnprepareBatch) -> None:
         """Phase 3: ONE checkpoint RMW drops every record whose teardown
-        completed.  No-op (zero disk writes) when nothing was recorded."""
-        drop = [it.uid for it in batch.items if it.done and it.record is not None]
+        completed — the claim record AND its per-partition records in one
+        commit.  No-op (zero disk writes) when nothing was recorded."""
+        drop = [
+            u
+            for it in batch.items
+            if it.done and it.record is not None
+            for u in (it.uid, *it.partition_uids)
+        ]
         if not drop:
             return
 
@@ -559,10 +655,14 @@ class DeviceState:
 
     def prepared_claim_uids(self) -> dict[str, tuple[str, str, str]]:
         """uid → (namespace, name, status) for the stale-claim GC (read-
-        only scan: the copy-free ``read_view``)."""
+        only scan: the copy-free ``read_view``).  Partition records are
+        NOT claims — they have no apiserver object to validate, so the GC
+        must never see them (the recovery sweep owns their lifecycle)."""
         cp = self._cp.read_view()
         return {
-            uid: (c.namespace, c.name, c.status) for uid, c in cp.prepared_claims.items()
+            uid: (c.namespace, c.name, c.status)
+            for uid, c in cp.prepared_claims.items()
+            if not partrec.is_partition_record(uid)
         }
 
     def bound_sibling_devices(self) -> set[str]:
@@ -670,24 +770,120 @@ class DeviceState:
         return reaped
 
     def destroy_unknown_partitions(self) -> int:
-        """Startup reconciliation: with dynamic partitioning, every live
-        partition must be explained by the checkpoint; others are destroyed
-        (DestroyUnknownMIGDevices, device_state.go:337)."""
+        """The partition RECOVERY SWEEP (docs/partitioning.md): converge
+        live hardware and per-partition checkpoint records to each other —
+        both directions — from checkpoint truth alone.
+
+        Hardware side (DestroyUnknownMIGDevices, device_state.go:337):
+        every live partition must be explained by a completed claim's
+        grant or a Live-phase record; others are destroyed — including
+        partitions whose record journaled destroy intent (``Destroying``,
+        the ``mid-partition-destroy`` crash window) or create intent the
+        claim never completed (``Creating``, ``mid-partition-create``).
+
+        Record side: Creating/Destroying records are dropped after their
+        hardware is confirmed gone, and a Live record whose partition or
+        claim vanished is reconciled — so the soak's partition-leak
+        invariant (record ⟷ live partition, quiet windows) restarts true
+        after every crash.  Hardware mutation runs BEFORE the record
+        commit (the phased discipline: a crash in between re-runs
+        idempotently).  Returns the number of partitions destroyed."""
         if not self._dynamic:
             return 0
         cp = self._cp.read_view()
-        known: set[str] = set()
-        for claim in cp.prepared_claims.values():
+        records = partrec.records_in(cp)
+        # uuid → owning completed claim.  The sweep must never destroy
+        # another claim's granted silicon, but a record's journaled
+        # destroy intent DOES override its own claim's grant — unprepare
+        # was requested, the grant is already dead to kubelet.
+        owned: dict[str, str] = {}
+        for uid, claim in cp.prepared_claims.items():
+            if partrec.is_partition_record(uid):
+                continue
+            if claim.status != PREPARE_COMPLETED:
+                continue
             for dev in claim.all_devices():
                 uuid = dev.attributes.get("partitionUUID")
                 if uuid:
-                    known.add(uuid)
+                    owned[uuid] = uid
+        live = {p.uuid: p for p in self._lib.list_partitions()}
+        live_by_spec = {p.spec: p for p in live.values()}
         destroyed = 0
-        for live in self._lib.list_partitions():
-            if live.uuid not in known:
-                logger.warning("destroying unknown partition %s (%s)", live.uuid, live.spec)
-                self._lib.delete_partition(live.uuid)
-                destroyed += 1
+        drop: list[str] = []
+
+        def _destroy(uuid: str, why: str) -> bool:
+            nonlocal destroyed
+            logger.warning(
+                "destroying unknown partition %s (%s)", uuid, why
+            )
+            try:
+                self._lib.delete_partition(uuid)
+            except DeviceLibError as e:
+                logger.warning("sweep could not destroy %s: %s", uuid, e)
+                return False
+            _PART_SWEPT.inc()
+            live.pop(uuid, None)
+            destroyed += 1
+            return True
+
+        for rec_uid, rec in sorted(records.items()):
+            claim = cp.prepared_claims.get(rec.claim_uid)
+            if rec.phase == partrec.PHASE_DESTROYING:
+                # Journaled destroy intent: finish what the crash cut off.
+                # The record's OWN claim's grant does not protect the
+                # partition (unprepare was already requested); any other
+                # claim's does.
+                target = live.get(rec.partition_uuid)
+                if target is None and rec.spec is not None:
+                    target = live_by_spec.get(rec.spec)
+                # A FAILED destroy keeps the record: the journaled intent
+                # is the retry plan (the next sweep, or the unprepare
+                # retry's own idempotent delete) — dropping it would leave
+                # the partition with no checkpoint tracker.
+                if (
+                    target is None
+                    or owned.get(target.uuid, rec.claim_uid) != rec.claim_uid
+                    or _destroy(target.uuid, f"record {rec_uid} phase=Destroying")
+                ):
+                    drop.append(rec_uid)
+            elif rec.phase == partrec.PHASE_CREATING:
+                # Create intent the claim never completed: any matching
+                # hardware is an orphan; the claim (if still present)
+                # stays PrepareStarted and the retry re-journals.
+                target = live_by_spec.get(rec.spec) if rec.spec else None
+                if (
+                    target is None
+                    or target.uuid in owned
+                    or _destroy(target.uuid, f"record {rec_uid} phase=Creating")
+                ):
+                    drop.append(rec_uid)
+            elif rec.phase == partrec.PHASE_LIVE:
+                if rec.partition_uuid not in live:
+                    # Hardware vanished out-of-band: the record lies.
+                    drop.append(rec_uid)
+                elif claim is None:
+                    # Owning claim gone (forced drop, corrupt fallback):
+                    # the partition is unexplained silicon.  The record
+                    # only drops once the hardware is actually gone.
+                    if _destroy(
+                        rec.partition_uuid, f"record {rec_uid} claim gone"
+                    ):
+                        drop.append(rec_uid)
+        known = set(owned) | {
+            rec.partition_uuid
+            for rec_uid, rec in records.items()
+            if rec.phase == partrec.PHASE_LIVE and rec_uid not in drop
+        }
+        for uuid in list(live):
+            if uuid not in known:
+                _destroy(uuid, str(live[uuid].spec))
+        if drop:
+            def drop_records(cpw: Checkpoint) -> None:
+                for rec_uid in drop:
+                    cpw.prepared_claims.pop(rec_uid, None)
+
+            self._cp.mutate(drop_records, touched=drop)
+            _PART_RECORD_DROPPED.inc(len(drop))
         return destroyed
 
     # ------------------------------------------------------- prepare internals
@@ -738,8 +934,8 @@ class DeviceState:
         including in-flight PrepareStarted claims (device_state.go:1118)."""
         wanted = {r["device"]: self._footprint(r["device"]) for r in results}
         for other_uid, other in cp.prepared_claims.items():
-            if other_uid == uid:
-                continue
+            if other_uid == uid or partrec.is_partition_record(other_uid):
+                continue  # partition records carry no devices (own sweep)
             for dev in other.all_devices():
                 theirs = self._footprint(dev.canonical_name)
                 if theirs is None:
@@ -824,6 +1020,7 @@ class DeviceState:
         config_state: dict[str, str] = {}
         group_edits = ContainerEdits()
 
+        partition_sharing = False
         if isinstance(config, TpuConfig):
             if types - {alloc.TYPE_CHIP}:
                 raise PermanentError(
@@ -835,6 +1032,13 @@ class DeviceState:
                 raise PermanentError(
                     f"TpuPartitionConfig applied to non-partition devices: {sorted(types)}"
                 )
+            # Multi-process sharing OF partitions (the MPS-on-MIG analog)
+            # is applied AFTER the device loop below: the broker brokers
+            # live partition uuids, which exist only once the hardware
+            # mutation has run.
+            partition_sharing = (
+                config.sharing is not None and config.sharing.is_multi_process
+            )
         elif isinstance(config, VfioDeviceConfig):
             if types != {alloc.TYPE_VFIO}:
                 raise PermanentError(
@@ -861,6 +1065,7 @@ class DeviceState:
                 except DeviceLibError as e:
                     raise PrepareError(f"creating partition for {dev.name}: {e}") from e
                 undos.append(lambda u=live.uuid: self._lib.delete_partition(u))
+                _PART_CREATED.inc()
                 attributes["partitionUUID"] = live.uuid
                 logger.info(
                     "t_prep_create_partition=%.4fs device=%s", time.monotonic() - t0, dev.name
@@ -879,6 +1084,10 @@ class DeviceState:
                     cdi_device_ids=[self._cdi.qualified_device_id(uid, dev.name)],
                     attributes=attributes,
                 )
+            )
+        if partition_sharing:
+            config_state, group_edits = self._apply_partition_sharing(
+                uid, config, devices, prepared, undos
             )
         return PreparedDeviceGroup(devices=prepared, config_state=config_state), group_edits
 
@@ -915,6 +1124,77 @@ class DeviceState:
                 daemon.get_cdi_edits(),
             )
         return {}, ContainerEdits()
+
+    def _apply_partition_sharing(
+        self,
+        uid: str,
+        config: TpuPartitionConfig,
+        devices: list[AllocatableDevice],
+        prepared: list,
+        undos: list,
+    ) -> tuple[dict[str, str], ContainerEdits]:
+        """Multi-process sharing of FRACTIONAL chips: one per-claim
+        control daemon brokers the claim's live partition uuids, each
+        pinned to an HBM budget derived from its profile's HBM fraction
+        (only explicit PER-DEVICE limits override — the claim-level
+        ``defaultPinnedHbmLimit`` is a whole-chip knob and must not blow
+        a half-chip partition's budget past its profile) and a TensorCore
+        percentage defaulting to the smallest partition's fraction of its
+        chip.  Runs after partition creation — the broker needs the live
+        uuids."""
+        from tpudra.api.quantity import format_mebibytes
+        from tpudra.api.sharing import MultiProcessConfig
+        from tpudra.devicelib import HBM_SLICES_PER_CHIP
+
+        if not featuregates.enabled(featuregates.MULTI_PROCESS_SHARING):
+            raise PermanentError(
+                "MultiProcess sharing requires the MultiProcessSharing gate"
+            )
+        if self._mp is None:
+            raise PermanentError("multi-process manager is not configured")
+        mp_config = config.sharing.get_multi_process_config() or MultiProcessConfig()
+        part_uuids: list[str] = []
+        derived: dict[str, str] = {}
+        min_fraction = 100
+        for dev, pdev in zip(devices, prepared):
+            uuid = pdev.attributes.get("partitionUUID", "")
+            if not uuid:
+                raise PrepareError(
+                    f"partition {dev.name} has no live uuid for sharing"
+                )
+            part_uuids.append(uuid)
+            spec = dev.partition_spec
+            cores, hbm_slices = alloc._profile_counts(spec.profile)
+            budget = dev.chip.hbm_bytes * hbm_slices // HBM_SLICES_PER_CHIP
+            text, ok = format_mebibytes(budget)
+            if ok:
+                derived[uuid] = text
+            if dev.chip.tensorcores:
+                min_fraction = min(
+                    min_fraction, round(100 * cores / dev.chip.tensorcores)
+                )
+        limits = dict(derived)
+        per_device = MultiProcessConfig(
+            default_per_device_pinned_hbm_limit=(
+                mp_config.default_per_device_pinned_hbm_limit
+            )
+        )
+        limits.update(per_device.normalized_limits(part_uuids))
+        daemon = self._mp.new_daemon(
+            uid, part_uuids, mp_config,
+            limits=limits, tensorcore_pct=min_fraction, exclusive=False,
+        )
+        daemon.start()
+        undos.append(daemon.stop)
+        daemon.assert_ready()
+        return (
+            {
+                "mpDaemon": uid,
+                "mpUUIDs": ",".join(part_uuids),
+                "mpPartition": "1",
+            },
+            daemon.get_cdi_edits(),
+        )
 
     def _write_cdi_spec(
         self, uid: str, groups: list[tuple[PreparedDeviceGroup, ContainerEdits]]
@@ -970,13 +1250,18 @@ class DeviceState:
                 self._ts.reset(uuids)
             if "mpDaemon" in state and self._mp is not None:
                 uuids = [u for u in state.get("mpUUIDs", "").split(",") if u]
-                self._mp.daemon_for(claim.uid, uuids).stop()
+                # Partition-mode daemons never pinned chips exclusive
+                # (sibling partitions may belong to other claims).
+                self._mp.daemon_for(
+                    claim.uid, uuids, exclusive="mpPartition" not in state
+                ).stop()
             for dev in group.devices:
                 if dev.type == alloc.TYPE_PARTITION_DYNAMIC:
                     uuid = dev.attributes.get("partitionUUID")
                     if uuid:
                         try:
                             self._lib.delete_partition(uuid)
+                            _PART_DESTROYED.inc()
                         except DeviceLibError:
                             logger.warning("partition %s already gone", uuid)
                 elif dev.type == alloc.TYPE_VFIO and self._vfio is not None:
@@ -1005,6 +1290,7 @@ class DeviceState:
                 logger.info("rollback: destroying orphan partition %s", live.uuid)
                 try:
                     self._lib.delete_partition(live.uuid)
+                    _PART_DESTROYED.inc()
                 except DeviceLibError:
                     pass
 
@@ -1075,6 +1361,26 @@ def _results_from_groups(groups: list[PreparedDeviceGroup]) -> list[PreparedDevi
 
 def _results_from_claim(claim: PreparedClaim) -> list[PreparedDeviceResult]:
     return _results_from_groups(claim.groups)
+
+
+def _claim_partition_record_uids(record: PreparedClaim) -> list[str]:
+    """Checkpoint keys of a claim's per-partition records, from its
+    granted dynamic-partition devices (completed claims) and its planned
+    specs (started claims — the retry/rollback shapes)."""
+    names = {
+        d.canonical_name
+        for d in record.all_devices()
+        if d.type == alloc.TYPE_PARTITION_DYNAMIC
+    }
+    for group in record.groups:
+        planned = group.config_state.get("plannedPartitions", "")
+        if planned:
+            try:
+                for spec in _decode_specs(planned):
+                    names.add(alloc.partition_name(spec))
+            except ValueError:
+                pass  # garbled planned set: the sweep converges by spec
+    return sorted(partrec.record_uid(n) for n in names)
 
 
 def _owned_partition_uuids(cp: Checkpoint, exclude_uid: str) -> set[str]:

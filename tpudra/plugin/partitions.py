@@ -1,0 +1,146 @@
+"""Per-partition checkpoint records: the partition lifecycle's WAL truth.
+
+The fractional-chip subsystem (docs/partitioning.md) makes dynamically
+created TensorCore partitions first-class *journaled* state, not just an
+attribute buried in a claim record.  Every dynamic partition a prepare is
+about to carve gets its own record in the plugin checkpoint — keyed
+``partition/<canonical-device-name>`` in the same ``prepared_claims`` map
+the journal already knows how to delta-encode (the gang subsystem's
+``gang/<id>`` idiom: one WAL upsert per record, ~70 B through the PR 5
+journal) — and the record's phase tracks the hardware:
+
+======================  =====================================================
+phase                   meaning
+======================  =====================================================
+``Creating``            journaled intent: the bind's effects phase is about
+                        to call ``devicelib.create_partition`` (the
+                        ``mid-partition-create`` crash window sits between
+                        the journal append and the hardware mutation)
+``Live``                the partition exists and is owned by the claim in
+                        ``claimUID``; ``partitionUUID`` is the hardware id
+``Destroying``          journaled intent to destroy: unprepare's begin phase
+                        flips the record before the effects phase deletes
+                        the hardware (the ``mid-partition-destroy`` window)
+======================  =====================================================
+
+Recovery is a two-sided sweep (``DeviceState.destroy_unknown_partitions``):
+live partitions unexplained by checkpoint truth are destroyed, and records
+unexplained by live hardware + live claims are dropped — the partition-leak
+invariant the chaos soak holds in quiet windows (no live partition without
+a record, no ``Live`` record without a partition).
+
+Records ride the claim map but are NOT claims: every claim-scan in the
+plugin (stale-claim GC, overlap validation, health escalation) must skip
+``is_partition_record`` uids — they have no namespace/name, no devices,
+and no apiserver object to validate against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from tpudra.devicelib import PartitionSpec
+from tpudra.plugin.checkpoint import (
+    PREPARE_COMPLETED,
+    PREPARE_STARTED,
+    Checkpoint,
+    PreparedClaim,
+    PreparedDeviceGroup,
+)
+
+PARTITION_RECORD_PREFIX = "partition/"
+
+PHASE_CREATING = "Creating"
+PHASE_LIVE = "Live"
+PHASE_DESTROYING = "Destroying"
+
+
+def record_uid(partition_name: str) -> str:
+    """Checkpoint key for one partition placement: the canonical device
+    name is unique per placement (overlap validation guarantees at most
+    one claim ever plans it), so create/destroy cycles reuse the key and
+    an idempotent retry's re-upsert emits zero delta records."""
+    return PARTITION_RECORD_PREFIX + partition_name
+
+
+def is_partition_record(uid: str) -> bool:
+    return uid.startswith(PARTITION_RECORD_PREFIX)
+
+
+@dataclass
+class PartitionRecord:
+    """Decoded view of one ``partition/<name>`` checkpoint record."""
+
+    name: str  # canonical device name, e.g. tpu-0-part-1c.4hbm-0-0
+    phase: str
+    claim_uid: str
+    spec: Optional[PartitionSpec] = None
+    partition_uuid: str = ""
+
+    @property
+    def uid(self) -> str:
+        return record_uid(self.name)
+
+
+def make_record(
+    name: str,
+    phase: str,
+    claim_uid: str,
+    spec: PartitionSpec,
+    partition_uuid: str = "",
+) -> PreparedClaim:
+    """Encode a partition record as a PreparedClaim-shaped checkpoint
+    entry (the v2 string schema: everything in configState).  The status
+    field mirrors the phase — ``Live`` records read as completed, the
+    transient phases as started — so a pre-partition driver's generic
+    status scan degrades sanely instead of misreading them."""
+    from tpudra.plugin.device_state import _encode_specs
+
+    config_state = {
+        "partitionPhase": phase,
+        "claimUID": claim_uid,
+        "partitionSpec": _encode_specs([spec]),
+    }
+    if partition_uuid:
+        config_state["partitionUUID"] = partition_uuid
+    return PreparedClaim(
+        uid=record_uid(name),
+        status=PREPARE_COMPLETED if phase == PHASE_LIVE else PREPARE_STARTED,
+        groups=[PreparedDeviceGroup(devices=[], config_state=config_state)],
+    )
+
+
+def parse_record(uid: str, claim: PreparedClaim) -> Optional[PartitionRecord]:
+    """Decode one checkpoint entry; None when it is not a (well-formed)
+    partition record — a malformed one is skipped loudly by the sweep,
+    never a crash on the recovery path."""
+    from tpudra.plugin.device_state import _decode_specs
+
+    if not is_partition_record(uid) or not claim.groups:
+        return None
+    state = claim.groups[0].config_state
+    phase = state.get("partitionPhase", "")
+    if phase not in (PHASE_CREATING, PHASE_LIVE, PHASE_DESTROYING):
+        return None
+    try:
+        specs = _decode_specs(state.get("partitionSpec", ""))
+    except ValueError:
+        specs = []  # garbled spec: the sweep still converges by uuid
+    return PartitionRecord(
+        name=uid[len(PARTITION_RECORD_PREFIX):],
+        phase=phase,
+        claim_uid=state.get("claimUID", ""),
+        spec=specs[0] if specs else None,
+        partition_uuid=state.get("partitionUUID", ""),
+    )
+
+
+def records_in(cp: Checkpoint) -> dict[str, PartitionRecord]:
+    """All well-formed partition records of a checkpoint, by record uid."""
+    out: dict[str, PartitionRecord] = {}
+    for uid, claim in cp.prepared_claims.items():
+        rec = parse_record(uid, claim)
+        if rec is not None:
+            out[uid] = rec
+    return out

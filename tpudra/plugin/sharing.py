@@ -17,8 +17,12 @@ The analog of gpu-kubelet-plugin/sharing.go:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import signal
+import subprocess
+import sys
 import time
 from typing import Optional
 
@@ -37,9 +41,128 @@ from tpudra.paths import template_path
 
 DEFAULT_TEMPLATE_PATH = template_path("multi-process-daemon.tmpl.yaml")
 
+DAEMON_PID_FILE = "daemon.pid"
+
 
 class SharingError(Exception):
     pass
+
+
+class LocalDaemonRunner:
+    """Runs the real ``tpu-mp-control-daemon`` as a host subprocess.
+
+    The production shape is the stamped Deployment (the pod runs the same
+    console script; its readinessProbe is ``tpu-mp-control-daemon
+    status``).  Harnesses with no kubelet — the e2e suite, the chaos
+    soak, bats — hand the manager this runner so the broker contract
+    (``limits.json`` + ``control.sock`` ATTACH/DETACH) is exercised by a
+    REAL process, exactly like the multihost harness runs real rank
+    processes.  A pid file in the pipe dir makes stop convergent across
+    plugin restarts: a crashed plugin's orphan daemon is killed by pid at
+    the next ``cleanup_stale`` even though the process handle died with
+    the plugin."""
+
+    def __init__(self):
+        self._procs: dict[str, subprocess.Popen] = {}
+
+    @staticmethod
+    def _pid_path(pipe_dir: str) -> str:
+        return os.path.join(pipe_dir, DAEMON_PID_FILE)
+
+    def start(self, claim_uid: str, pipe_dir: str, env: dict[str, str]) -> int:
+        os.makedirs(pipe_dir, exist_ok=True)
+        full_env = dict(os.environ)
+        full_env.update(env)
+        # The child must import tpudra regardless of the caller's cwd
+        # (harnesses launch from scratch dirs): pin the package root.
+        import tpudra
+
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.abspath(tpudra.__file__))
+        )
+        full_env["PYTHONPATH"] = (
+            repo_root + os.pathsep + full_env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        log_path = os.path.join(pipe_dir, "daemon.log")
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpudra.mpdaemon", "run"],
+                env=full_env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+            )
+        self._procs[claim_uid] = proc
+        with open(self._pid_path(pipe_dir), "w") as f:
+            f.write(str(proc.pid))
+        logger.info(
+            "mp control daemon for claim %s spawned (pid %d, pipe %s)",
+            claim_uid, proc.pid, pipe_dir,
+        )
+        return proc.pid
+
+    def pid(self, claim_uid: str, pipe_dir: str) -> Optional[int]:
+        """The daemon's pid, or None when it is not running.  A pid read
+        from the FILE (a prior plugin incarnation's daemon) is only
+        trusted when the live process is identifiably OUR daemon — pids
+        recycle, and signaling a recycled pid would kill an innocent
+        process."""
+        proc = self._procs.get(claim_uid)
+        if proc is not None and proc.poll() is None:
+            return proc.pid
+        try:
+            with open(self._pid_path(pipe_dir)) as f:
+                pid = int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+        return pid if _pid_is_mpdaemon(pid) else None
+
+    def stop(self, claim_uid: str, pipe_dir: str, timeout: float = 5.0) -> None:
+        """Terminate the daemon (tracked handle, or the pid file when the
+        handle died with a previous plugin incarnation).  Idempotent.
+        The pid-file path only ever signals a process ``pid()`` verified
+        as our daemon, and re-verifies before the SIGKILL escalation —
+        pid recycling must never cost an unrelated process its life."""
+        proc = self._procs.pop(claim_uid, None)
+        if proc is not None:
+            # poll() first: a child that already exited was (or will be)
+            # reaped, and its pid may belong to someone else by now — the
+            # same recycling hazard the pid-file path verifies against.
+            if proc.poll() is None:
+                with contextlib.suppress(OSError):
+                    os.kill(proc.pid, signal.SIGTERM)
+                try:
+                    proc.wait(timeout)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+        else:
+            pid = self.pid(claim_uid, pipe_dir)
+            if pid is not None:
+                with contextlib.suppress(OSError):
+                    os.kill(pid, signal.SIGTERM)
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline and _pid_is_mpdaemon(pid):
+                    time.sleep(0.05)
+                if _pid_is_mpdaemon(pid):
+                    with contextlib.suppress(OSError):
+                        os.kill(pid, signal.SIGKILL)
+        with contextlib.suppress(OSError):
+            os.unlink(self._pid_path(pipe_dir))
+
+
+def _pid_is_mpdaemon(pid: int) -> bool:
+    """True when ``pid`` is a live process identifiable as the mp control
+    daemon (``/proc/<pid>/cmdline`` names tpudra.mpdaemon or the console
+    script).  Unreadable cmdline (no /proc, a zombie child — which only
+    a tracked handle can reap anyway) counts as NOT ours: the failure
+    mode of a false negative is a leaked daemon the next cleanup pass
+    retries; a false positive is a SIGKILL to an innocent process."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\x00", b" ")
+    except OSError:
+        return False
+    return b"mpdaemon" in cmdline or b"tpu-mp-control-daemon" in cmdline
 
 
 class TimeSlicingManager:
@@ -68,11 +191,26 @@ class MultiProcessControlDaemon:
         claim_uid: str,
         chip_uuids: list[str],
         config: MultiProcessConfig,
+        limits: Optional[dict[str, str]] = None,
+        tensorcore_pct: Optional[int] = None,
+        exclusive: bool = True,
     ):
         self._m = manager
         self.claim_uid = claim_uid
+        #: The UUIDs the broker brokers: whole-chip UUIDs for a chip claim,
+        #: LIVE PARTITION UUIDs for a fractional (partition) claim.
         self.chip_uuids = chip_uuids
         self.config = config
+        #: Pre-normalized pinned-HBM budgets (uuid → "NM"): the partition
+        #: path derives each partition's budget from its profile's HBM
+        #: fraction and overlays any explicit per-device limits; None
+        #: falls back to the config's own normalization (chip mode).
+        self._limits = limits
+        self._pct = tensorcore_pct
+        #: Chip mode pins the silicon exclusive (the MPS-owns-the-GPU
+        #: analog); partition mode must NOT — sibling partitions of the
+        #: same chip may belong to other claims' brokers.
+        self.exclusive = exclusive
         self.name = MP_DAEMON_NAME_PREFIX + claim_uid
 
     @property
@@ -83,43 +221,86 @@ class MultiProcessControlDaemon:
     def shm_dir(self) -> str:
         return os.path.join(self._m.pipe_root, self.claim_uid, "shm")
 
+    def resolved_limits(self) -> dict[str, str]:
+        if self._limits is not None:
+            return dict(self._limits)
+        return self.config.normalized_limits(self.chip_uuids)
+
+    def resolved_pct(self) -> int:
+        if self.config.default_active_tensorcore_percentage is not None:
+            return self.config.default_active_tensorcore_percentage
+        return self._pct if self._pct is not None else 100
+
+    def daemon_env(self, limits: dict[str, str]) -> dict[str, str]:
+        """The broker's own env — one rendering shared by the Deployment
+        template and the local runner, so the two execution shapes cannot
+        drift (tpudra/mpdaemon.py consumes exactly these)."""
+        return {
+            "TPUDRA_MP_PIPE_DIRECTORY": self.pipe_dir,
+            "TPUDRA_MP_CHIP_UUIDS": ",".join(self.chip_uuids),
+            "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE": str(self.resolved_pct()),
+            "TPUDRA_MP_PINNED_HBM_LIMITS": ";".join(
+                f"{k}={v}" for k, v in sorted(limits.items())
+            ),
+            "TPUDRA_MP_PLATFORM_MODE": self._m.devicelib.multiprocess_mode(),
+        }
+
     def start(self) -> None:
-        """Pin chips exclusive and stamp the daemon Deployment onto this node
-        (reference sharing.go:186-291)."""
-        self._m.devicelib.set_exclusive(self.chip_uuids, True)
+        """Pin chips exclusive (chip mode), stamp the daemon Deployment
+        onto this node (reference sharing.go:186-291), and — when the
+        manager carries a local runner — actually spawn the broker
+        process the Deployment describes."""
+        if self.exclusive:
+            self._m.devicelib.set_exclusive(self.chip_uuids, True)
         os.makedirs(self.shm_dir, exist_ok=True)
-        limits = self.config.normalized_limits(self.chip_uuids)
+        limits = self.resolved_limits()
         deployment = self._m.render_template(
             name=self.name,
             claim_uid=self.claim_uid,
             chip_uuids=self.chip_uuids,
-            tensorcore_pct=self.config.default_active_tensorcore_percentage or 100,
+            tensorcore_pct=self.resolved_pct(),
             hbm_limits=limits,
             pipe_dir=self.pipe_dir,
             platform_mode=self._m.devicelib.multiprocess_mode(),
         )
-        try:
-            self._m.kube.create(gvr.DEPLOYMENTS, deployment, self._m.namespace)
-        except Exception as e:  # AlreadyExists on retry is fine
-            from tpudra.kube.errors import AlreadyExists
+        self._m.stamp_deployment(deployment)
+        if self._m.runner is not None:
+            self._m.runner.start(
+                self.claim_uid, self.pipe_dir, self.daemon_env(limits)
+            )
 
-            if not isinstance(e, AlreadyExists):
-                raise
+    def probe_ready(self) -> bool:
+        """One READY probe of the broker's control socket — the same
+        contract the pod's readinessProbe runs (``tpu-mp-control-daemon
+        status``), asked directly over the hostPath pipe dir."""
+        from tpudra import mpdaemon
+
+        try:
+            return mpdaemon.query(self.pipe_dir, "STATUS").startswith("READY")
+        except OSError:
+            return False
 
     def assert_ready(self, timeout: float = 30.0, poll: float = 1.0) -> None:
-        """Block until the daemon Deployment reports a ready replica
-        (reference AssertReady, sharing.go:293-349).  Check-first, then a
-        gentle poll — this runs inside NodePrepareResources, and tens of
-        concurrent prepares hammering the apiserver at high frequency would
-        be self-inflicted load."""
+        """Block until the broker is READY (reference AssertReady,
+        sharing.go:293-349).  With a local runner the truth is the
+        control socket itself; without one (production: the daemon runs
+        inside the stamped pod) the Deployment's readyReplicas — fed by
+        the pod's ``status``-subcommand readinessProbe — is the kubelet's
+        word for the same probe.  Check-first, then a gentle poll: this
+        runs inside NodePrepareResources, and tens of concurrent prepares
+        hammering the apiserver at high frequency would be self-inflicted
+        load.  Not-ready raises SharingError, which the bind path maps to
+        a RETRYABLE prepare error (permanent=false): kubelet retries
+        while the daemon comes up."""
         deadline = time.monotonic() + timeout
         while True:
-            try:
-                dep = self._m.kube.get(gvr.DEPLOYMENTS, self.name, self._m.namespace)
-            except Exception:
-                dep = None
-            if dep and dep.get("status", {}).get("readyReplicas", 0) >= 1:
-                return
+            if self._m.runner is not None:
+                if self.probe_ready():
+                    return
+            else:
+                dep = self._m.get_deployment(self.name)
+                if dep and dep.get("status", {}).get("readyReplicas", 0) >= 1:
+                    return
             if time.monotonic() >= deadline:
                 raise SharingError(
                     f"multi-process control daemon {self.name} not ready after {timeout}s"
@@ -133,7 +314,7 @@ class MultiProcessControlDaemon:
             env=[
                 f"TPUDRA_MP_PIPE_DIRECTORY=/var/run/tpudra/mp/{self.claim_uid}",
                 "TPUDRA_MP_ACTIVE_TENSORCORE_PERCENTAGE="
-                f"{self.config.default_active_tensorcore_percentage or 100}",
+                f"{self.resolved_pct()}",
             ],
             mounts=[
                 (self.pipe_dir, f"/var/run/tpudra/mp/{self.claim_uid}"),
@@ -142,13 +323,11 @@ class MultiProcessControlDaemon:
         )
 
     def stop(self) -> None:
-        from tpudra.kube.errors import NotFound
-
-        try:
-            self._m.kube.delete(gvr.DEPLOYMENTS, self.name, self._m.namespace)
-        except NotFound:
-            pass
-        self._m.devicelib.set_exclusive(self.chip_uuids, False)
+        self._m.delete_deployment(self.name)
+        if self._m.runner is not None:
+            self._m.runner.stop(self.claim_uid, self.pipe_dir)
+        if self.exclusive:
+            self._m.devicelib.set_exclusive(self.chip_uuids, False)
 
 
 class MultiProcessManager:
@@ -164,6 +343,10 @@ class MultiProcessManager:
         # tpu-mp-control-daemon); the chart passes the deployed driver
         # image via --mp-daemon-image / MP_DAEMON_IMAGE.
         image: str = "tpudra:latest",
+        # Optional execution seam: a LocalDaemonRunner actually spawns
+        # the broker process the Deployment describes (harnesses without
+        # a kubelet); None leaves execution to the stamped pod.
+        runner: Optional[LocalDaemonRunner] = None,
     ):
         self.kube = kube
         self.devicelib = devicelib
@@ -172,15 +355,59 @@ class MultiProcessManager:
         self.pipe_root = pipe_root
         self.template_path = template_path
         self.image = image
+        self.runner = runner
 
     def new_daemon(
-        self, claim_uid: str, chip_uuids: list[str], config: MultiProcessConfig
+        self,
+        claim_uid: str,
+        chip_uuids: list[str],
+        config: MultiProcessConfig,
+        limits: Optional[dict[str, str]] = None,
+        tensorcore_pct: Optional[int] = None,
+        exclusive: bool = True,
     ) -> MultiProcessControlDaemon:
-        return MultiProcessControlDaemon(self, claim_uid, chip_uuids, config)
+        return MultiProcessControlDaemon(
+            self, claim_uid, chip_uuids, config,
+            limits=limits, tensorcore_pct=tensorcore_pct, exclusive=exclusive,
+        )
 
-    def daemon_for(self, claim_uid: str, chip_uuids: list[str]) -> MultiProcessControlDaemon:
+    def daemon_for(
+        self, claim_uid: str, chip_uuids: list[str], exclusive: bool = True
+    ) -> MultiProcessControlDaemon:
         """Reconstruct a handle for stop() from checkpoint state."""
-        return MultiProcessControlDaemon(self, claim_uid, chip_uuids, MultiProcessConfig())
+        return MultiProcessControlDaemon(
+            self, claim_uid, chip_uuids, MultiProcessConfig(),
+            exclusive=exclusive,
+        )
+
+    # The apiserver verbs live on the MANAGER, not the daemon handle: the
+    # daemon reaches the cluster only through these, which keeps the
+    # static lock model exact — ``self.kube`` is a one-hop annotated
+    # attribute the analyzer resolves to KubeAPI verbs, so the
+    # effects-phase edge flock:claim-uid → accounting.counts_lock (the
+    # edge the partition_fault soak witnessed) derives statically.
+
+    def stamp_deployment(self, deployment: dict) -> None:
+        from tpudra.kube.errors import AlreadyExists
+
+        try:
+            self.kube.create(gvr.DEPLOYMENTS, deployment, self.namespace)
+        except AlreadyExists:
+            pass  # retry of a crashed prepare: the stamp already landed
+
+    def get_deployment(self, name: str) -> Optional[dict]:
+        try:
+            return self.kube.get(gvr.DEPLOYMENTS, name, self.namespace)
+        except Exception:  # noqa: BLE001 — not-ready poll tolerates blips
+            return None
+
+    def delete_deployment(self, name: str) -> None:
+        from tpudra.kube.errors import NotFound
+
+        try:
+            self.kube.delete(gvr.DEPLOYMENTS, name, self.namespace)
+        except NotFound:
+            pass
 
     def cleanup_stale(self, valid_claim_uids: set[str]) -> int:
         """Startup GC: delete control-daemon Deployments on this node whose
@@ -198,10 +425,12 @@ class MultiProcessManager:
             ),
         )
         removed = 0
+        reaped_uids: set[str] = set()
         for dep in listing.get("items", []):
             uid = dep["metadata"].get("labels", {}).get("tpu.google.com/claim-uid", "")
             if uid in valid_claim_uids:
                 continue
+            reaped_uids.add(uid)
             chip_uuids = []
             for c in dep.get("spec", {}).get("template", {}).get("spec", {}).get(
                 "containers", []
@@ -220,6 +449,28 @@ class MultiProcessManager:
                 except Exception:  # noqa: BLE001 — chips may be gone
                     logger.warning("could not release chips %s", chip_uuids)
             removed += 1
+        # Local-runner convergence: a daemon PROCESS leaked by a crashed
+        # plugin (its handle died with the plugin; only the pid file
+        # remains) is killed by pid for every pipe dir whose claim is no
+        # longer checkpointed — the "no live daemon without a checkpoint
+        # record" half of the partition-leak story.
+        if self.runner is not None:
+            try:
+                pipe_entries = os.listdir(self.pipe_root)
+            except FileNotFoundError:
+                pipe_entries = []
+            for uid in pipe_entries:
+                pipe_dir = os.path.join(self.pipe_root, uid)
+                if uid in valid_claim_uids or not os.path.isdir(pipe_dir):
+                    continue
+                if self.runner.pid(uid, pipe_dir) is None:
+                    continue  # dead already (pid() verifies liveness+identity)
+                logger.info("stopping stale local mp daemon for claim %s", uid)
+                self.runner.stop(uid, pipe_dir)
+                # One stale claim = one removal, even when both its
+                # Deployment and its local process were reaped this pass.
+                if uid not in reaped_uids:
+                    removed += 1
         return removed
 
     def render_template(

@@ -47,6 +47,16 @@ implies, and this soak is its hermetic reproduction:
                        bound members within the recovery budget; the
                        monitor's quiet-window gang-atomicity invariant
                        holds the residue to "never partial"
+  partition_fault      the fractional-chip lifecycle breaks on one node
+                       (docs/partitioning.md): partition create fails
+                       mid-bind (retryable error, clean retry), the MP
+                       control daemon — a REAL process — is SIGKILLed
+                       mid-ATTACH, or the destroy leg fails composed with
+                       a SIGKILL so only the restarted plugin's recovery
+                       sweep can reap the orphan; the node must converge
+                       to zero live partitions and zero records, and the
+                       monitor's partition-leak invariant holds the
+                       record ⟷ hardware bijection in quiet windows
   disk_fault           a storage fault plan (tpudra/storage.py) is
                        installed against ONE node's checkpoint + CDI dirs
                        — ENOSPC on writes, EIO on fsync (fsyncgate),
@@ -139,6 +149,17 @@ FAULT_KINDS = (
     "chip_fault",
     "daemon_crash",
     "disk_fault",
+    "partition_fault",
+)
+
+#: partition_fault variants — where the fractional-chip lifecycle breaks
+#: (docs/partitioning.md): hardware create fails mid-bind, the MP control
+#: daemon dies mid-ATTACH, or the destroy leg fails and a SIGKILL lands
+#: before anything can repair it (the recovery sweep must).
+PARTITION_FAULT_VARIANTS = (
+    "create_fail",
+    "daemon_crash_mid_attach",
+    "destroy_fail_crash",
 )
 
 #: disk_fault variants — what the misbehaving disk does (storage.FaultPlan
@@ -179,6 +200,11 @@ INV_ACK_DURABILITY = "acknowledged-mutation-durability"
 #: no disk fault is active — heal detection + the convergent compaction
 #: rewrite must bring it back.
 INV_STORAGE_DEGRADED = "storage-degraded-convergence"
+#: The fractional-chip bijection (docs/partitioning.md): no live partition
+#: without a checkpoint explanation (Live record or completed claim
+#: grant), and no Live-phase record without its live partition — aged by
+#: the leak grace so in-flight create/destroy windows never false-fire.
+INV_PARTITION_LEAK = "partition-leak"
 INVARIANTS = (
     INV_CLAIM_STUCK,
     INV_CDI_LEAK,
@@ -192,6 +218,7 @@ INVARIANTS = (
     INV_GRANT_HEALTH,
     INV_ACK_DURABILITY,
     INV_STORAGE_DEGRADED,
+    INV_PARTITION_LEAK,
 )
 
 
@@ -361,10 +388,25 @@ class ChaosSoak:
                 ),
             )
             lockwitness.reset_for_tests()
+        # The soak runs with the fractional-chip gates ON (partition_fault
+        # needs dynamic partitions + multi-process sharing) over a
+        # partitionable generation — the gates COMPOSE by design
+        # (featuregates.validate, docs/partitioning.md).  Process-global:
+        # `make soak` is its own process; the in-process unit tests reset
+        # gates per test (conftest's autouse fixture).
+        from tpudra import featuregates
+
+        featuregates.feature_gates().set_from_map(
+            {
+                featuregates.DYNAMIC_PARTITIONING: True,
+                featuregates.MULTI_PROCESS_SHARING: True,
+            }
+        )
         self.sim = ClusterScaleSim(
             ClusterScaleConfig(
                 nodes=config.nodes,
                 chips_per_node=config.chips_per_node,
+                generation="v5p",  # partitionable (v5e's fused core is not)
                 seed=config.seed,
                 workers=max(4, config.churn_workers * 2),
                 compute_domains=2,
@@ -397,6 +439,10 @@ class ChaosSoak:
         }
         self._stuck_ager = MonotonicAger()
         self._leak_ager = MonotonicAger()
+        # Partition-leak aging is separate from the file-leak ager: the
+        # two checks prune independently, and a shared table would drop
+        # each other's keys every pass (resetting every age to zero).
+        self._partition_ager = MonotonicAger()
         # First pass through the kinds is a seeded shuffle of ALL of them:
         # a short run must still exercise every enabled injector at least
         # once (soak_report asserts it), and a plain choice() leaves that
@@ -738,6 +784,12 @@ class ChaosSoak:
                 params = {
                     "target": self._rng.choice(["slicewatchd", "coordproxy"])
                 }
+            elif kind == "partition_fault":
+                params = {
+                    "variant": self._rng.choice(
+                        list(PARTITION_FAULT_VARIANTS)
+                    )
+                }
             elif kind == "disk_fault":
                 variant = self._rng.choice(list(DISK_FAULT_VARIANTS))
                 params = {
@@ -780,6 +832,8 @@ class ChaosSoak:
             self._inject_daemon_crash(params)
         elif kind == "disk_fault":
             self._inject_disk_fault(node, params)
+        elif kind == "partition_fault":
+            self._inject_partition_fault(node, params)
         else:
             self._anomaly(f"unknown fault kind {kind!r}")
 
@@ -1691,6 +1745,251 @@ class ChaosSoak:
 
     # ----------------------------------------------------------- chip fault
 
+    # ------------------------------------------------------ partition_fault
+
+    @staticmethod
+    def _partition_claim(uid: str, node_name: str, sharing: bool) -> dict:
+        """An allocated claim for TWO fractional partitions of the
+        reserved chip 0 (the fault injectors' slot), with the opaque
+        TpuPartitionConfig — MultiProcess-shared for the daemon variant."""
+        claim = make_claim(
+            uid, node_name,
+            ["tpu-0-part-1c.4hbm-0-0", "tpu-0-part-1c.4hbm-1-4"],
+            name=uid,
+        )
+        params: dict = {
+            "apiVersion": "resource.tpu.google.com/v1beta1",
+            "kind": "TpuPartitionConfig",
+        }
+        if sharing:
+            params["sharing"] = {
+                "strategy": "MultiProcess",
+                "multiProcessConfig": {},
+            }
+        claim["status"]["allocation"]["devices"]["config"] = [
+            {
+                "source": "FromClaim",
+                "requests": [],
+                "opaque": {
+                    "driver": TPU_DRIVER_NAME,
+                    "parameters": params,
+                },
+            }
+        ]
+        return claim
+
+    def _node_partition_state(self, node: int) -> tuple[set, dict]:
+        """(live partition uuids, partition records) for one node —
+        checkpoint truth read through the real recovery view."""
+        from tpudra.plugin import partitions as partrec_mod
+
+        live = {p.uuid for p in self.sim._libs[node].list_partitions()}
+        records = partrec_mod.records_in(
+            self.sim.drivers[node].state._cp.read_view()
+        )
+        return live, records
+
+    def _inject_partition_fault(self, node: int, params: dict) -> None:
+        """Break the fractional-chip lifecycle on one node
+        (docs/partitioning.md) and hold it to convergence:
+
+        - ``create_fail``: ``create_partition`` fails once mid-bind — the
+          claim must come back with a RETRYABLE error, the retry must
+          bind, and no partition/record may leak at any point;
+        - ``daemon_crash_mid_attach``: the claim's MP control daemon (a
+          REAL process via LocalDaemonRunner) is SIGKILLed while a client
+          is ATTACHed — release must still converge to zero partitions
+          and a dead daemon;
+        - ``destroy_fail_crash``: ``delete_partition`` fails during
+          unprepare AND the plugin is crash/restarted — the recovery
+          sweep must destroy the orphan from checkpoint truth alone.
+        """
+        from tpudra.devicelib import DeviceLibError
+
+        variant = params.get("variant") or "create_fail"
+        record = FaultRecord(
+            kind="partition_fault", t_sim_start=self._now(), node=node,
+            params=dict(params),
+        )
+        self._record_fault(record)
+        self._quarantine_node(node)
+        t0_sim = self._now()
+        n_fault = self._fault_counter
+        uid = f"soak-part-{n_fault}"
+        node_name = self.sim.node_names[node]
+        converged = False
+        live, recs = set(), {}
+        try:
+            driver = self.sim.drivers[node]
+            lib = self.sim._libs[node]
+            claim = self._partition_claim(
+                uid, node_name, sharing=variant == "daemon_crash_mid_attach"
+            )
+            with api_deadline(5.0):
+                self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+
+            if variant == "create_fail":
+                real_create = lib.create_partition
+                armed = {"on": True}
+
+                def flaky_create(spec):
+                    if armed["on"]:
+                        armed["on"] = False
+                        raise DeviceLibError(
+                            f"soak partition_fault #{n_fault}: injected "
+                            "create failure"
+                        )
+                    return real_create(spec)
+
+                lib.create_partition = flaky_create
+                try:
+                    with api_deadline(5.0):
+                        resp = driver.prepare_resource_claims([claim])
+                    entry = resp["claims"][uid]
+                    self._check_or_interrupted(
+                        INV_FAULT_RECOVERY,
+                        "error" in entry and not entry.get("permanent"),
+                        key=("partition_create_fail", n_fault),
+                        detail=(
+                            "failed partition create must yield a "
+                            f"retryable error, got {entry!r:.120}"
+                        ),
+                        what=f"partition_fault create leg on node {node}",
+                    )
+                finally:
+                    lib.create_partition = real_create
+                bound = self._retry_prepare(
+                    node, claim, self.budget.recovery_sim_s / 2
+                )
+                live, recs = self._node_partition_state(node)
+                self._check_or_interrupted(
+                    INV_FAULT_RECOVERY,
+                    bound and len(live) == 2,
+                    key=("partition_retry_bind", n_fault),
+                    detail="retry after injected create failure never bound",
+                    what=f"partition_fault retry on node {node}",
+                )
+            elif variant == "daemon_crash_mid_attach":
+                self._ensure_mp_stack(node)
+                bound = self._retry_prepare(
+                    node, claim, self.budget.recovery_sim_s / 2
+                )
+                if bound:
+                    from tpudra import mpdaemon
+
+                    pipe_dir = os.path.join(
+                        self.sim._base, f"mp{node}", uid
+                    )
+                    attached = False
+                    try:
+                        resp = mpdaemon.query(pipe_dir, f"ATTACH soak-{n_fault}")
+                        attached = resp.startswith("OK ")
+                    except OSError:
+                        ...
+                    self._check_or_interrupted(
+                        INV_FAULT_RECOVERY,
+                        attached,
+                        key=("partition_mp_attach", n_fault),
+                        detail="workload ATTACH through control.sock failed",
+                        what=f"partition_fault attach on node {node}",
+                    )
+                    # THE FAULT: SIGKILL the broker mid-attach.
+                    runner = driver.state._mp.runner
+                    pid = runner.pid(uid, pipe_dir)
+                    if pid is not None:
+                        with contextlib.suppress(OSError):
+                            os.kill(pid, 9)
+                else:
+                    self._anomaly(
+                        f"partition_fault #{n_fault}: MP bind never landed"
+                    )
+            else:  # destroy_fail_crash
+                bound = self._retry_prepare(
+                    node, claim, self.budget.recovery_sim_s / 2
+                )
+                if bound:
+                    real_delete = lib.delete_partition
+                    armed = {"on": True}
+
+                    def flaky_delete(uuid):
+                        if armed["on"]:
+                            armed["on"] = False
+                            raise DeviceLibError(
+                                f"soak partition_fault #{n_fault}: injected "
+                                "destroy failure"
+                            )
+                        return real_delete(uuid)
+
+                    lib.delete_partition = flaky_delete
+                    try:
+                        self._best_effort_unprepare(driver, uid)
+                    finally:
+                        lib.delete_partition = real_delete
+                    # Compose the SIGKILL before anything can repair: the
+                    # restarted plugin's recovery sweep is the only path
+                    # allowed to reap the orphan.
+                    self.sim.crash_node(node)
+                    self.sim.restart_node(node)
+                else:
+                    self._anomaly(
+                        f"partition_fault #{n_fault}: destroy leg never bound"
+                    )
+
+            # Convergence: release whatever is still bound, then hold the
+            # node to ZERO live partitions and ZERO partition records.
+            self._best_effort_unprepare(self.sim.drivers[node], uid)
+            deadline = time.monotonic() + self.simclock.wall_of(
+                self.budget.recovery_sim_s
+            )
+            while time.monotonic() < deadline and not self._stop.is_set():
+                try:
+                    live, recs = self._node_partition_state(node)
+                except Exception:  # noqa: BLE001 — mid-restart window
+                    live, recs = {"restarting"}, {}
+                if not live and not recs:
+                    converged = True
+                    break
+                self._best_effort_unprepare(self.sim.drivers[node], uid)
+                time.sleep(0.05)
+            self._check_or_interrupted(
+                INV_PARTITION_LEAK,
+                converged,
+                key=("partition_fault", n_fault, variant),
+                detail=(
+                    f"node {node} still holds partitions/records after "
+                    f"{variant} (live={sorted(live)}, recs={sorted(recs)})"
+                ),
+                what=f"partition_fault convergence on node {node}",
+            )
+        finally:
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+            except (NotFound, ApiError):
+                ...
+            self._unquarantine_node(node)
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+            if converged:
+                self._recovery_samples.append(record.recovered_sim_s)
+
+    def _ensure_mp_stack(self, node: int) -> None:
+        """Lazily hand one node's driver a MultiProcessManager with the
+        LocalDaemonRunner — the REAL broker process, spawned per claim
+        (fault thread only; the driver reads the reference atomically)."""
+        from tpudra.plugin.sharing import LocalDaemonRunner, MultiProcessManager
+
+        driver = self.sim.drivers[node]
+        if driver.state._mp is not None:
+            return
+        driver.state._mp = MultiProcessManager(
+            self.sim.kube,
+            self.sim._libs[node],
+            self.sim.node_names[node],
+            pipe_root=os.path.join(self.sim._base, f"mp{node}"),
+            runner=LocalDaemonRunner(),
+        )
+
     def _inject_chip_fault(self, node: int) -> None:
         """A chip dies on a node with (1) a BOUND node-local claim on the
         silicon and (2) a live gang member — the escalation + remediation
@@ -2176,6 +2475,7 @@ class ChaosSoak:
     def _monitor_once(self) -> None:
         self._check_claim_stuck()
         self._check_leaks()
+        self._check_partition_leak()
         self._check_slice_convergence()
         self._check_gang_atomicity()
         self._check_slice_health()
@@ -2523,6 +2823,71 @@ class ChaosSoak:
         self._leak_ager.prune(live_keys)
         self._pass_check(INV_CDI_LEAK)
         self._pass_check(INV_FLOCK_LEAK)
+
+    def _check_partition_leak(self) -> None:
+        """The fractional-chip bijection (docs/partitioning.md): every
+        LIVE partition on every node is explained by checkpoint truth (a
+        Live-phase partition record or a completed claim's grant), and
+        every Live-phase record points at a live partition.  Aged by the
+        leak grace so in-flight create/destroy windows (Creating/
+        Destroying phases are exempt by construction) never false-fire;
+        crashes must converge through the recovery sweep inside it."""
+        from tpudra.plugin import partitions as partrec_mod
+        from tpudra.plugin.checkpoint import PREPARE_COMPLETED
+
+        grace = self.budget.leak_grace_sim_s
+        live_keys: list = []
+        for i in range(self.config.nodes):
+            try:
+                cp = self.sim.drivers[i].state._cp.read_view()
+                live = {p.uuid for p in self.sim._libs[i].list_partitions()}
+            except Exception:  # noqa: BLE001 — mid-restart window
+                logger.info("partition scan skipped node %d", i, exc_info=True)
+                continue
+            records = partrec_mod.records_in(cp)
+            explained = {
+                rec.partition_uuid
+                for rec in records.values()
+                if rec.phase != partrec_mod.PHASE_CREATING
+                and rec.partition_uuid
+            }
+            for uid, claim in cp.prepared_claims.items():
+                if partrec_mod.is_partition_record(uid):
+                    continue
+                if claim.status != PREPARE_COMPLETED:
+                    continue
+                for dev in claim.all_devices():
+                    u = dev.attributes.get("partitionUUID")
+                    if u:
+                        explained.add(u)
+            suspects: list[tuple] = []
+            for uuid in live - explained:
+                suspects.append(("hardware", i, uuid))
+            for rec_uid, rec in records.items():
+                if (
+                    rec.phase == partrec_mod.PHASE_LIVE
+                    and rec.partition_uuid not in live
+                ):
+                    suspects.append(("record", i, rec_uid))
+            for key in suspects:
+                live_keys.append(key)
+                age_sim = (
+                    self._partition_ager.age(key, "orphan")
+                    * self.config.compression
+                )
+                kind, _, what = key
+                self._check(
+                    INV_PARTITION_LEAK,
+                    age_sim <= grace,
+                    key=key,
+                    detail=(
+                        f"{kind} {what} on node {i} unexplained for "
+                        f"{age_sim:.0f} sim-seconds (grace {grace:.0f}) — "
+                        "live partitions and checkpoint records diverged"
+                    ),
+                )
+        self._partition_ager.prune(live_keys)
+        self._pass_check(INV_PARTITION_LEAK)
 
     def _check_slice_convergence(self) -> None:
         """After every fault window (plus the convergence budget), the

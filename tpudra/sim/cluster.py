@@ -278,6 +278,16 @@ class ClusterScaleSim:
         # still hold under the soak's disk faults: the storage-heal
         # supervisor is the one production thread each node keeps.
         driver.start_storage_supervisor()
+        # Startup reconciliation, exactly as Driver.start() runs it: the
+        # partition recovery sweep (no-op unless DynamicPartitioning) —
+        # a restarted node must reap crash-orphaned partitions before
+        # serving (the soak's partition_fault destroy-then-SIGKILL leg).
+        swept = driver.state.destroy_unknown_partitions()
+        if swept:
+            logger.warning(
+                "node %s startup sweep destroyed %d partition(s)",
+                self.node_names[i], swept,
+            )
         return lib, driver
 
     # ----------------------------------------------------- fault injection
