@@ -7,7 +7,7 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition e2e-multihost soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition bench-failover e2e-multihost soak image helm-render clean
 
 all: native test
 
@@ -153,6 +153,13 @@ bench-trace:
 # convergence — the bounded-p99 acceptance arm for storage-fault PRs.
 bench-storage:
 	set -o pipefail; python bench.py --storage-degraded | tee /tmp/tpudra_bench_out.txt
+	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
+
+# Controller-failover A/B (docs/ha.md): time-to-new-leader p50/p99
+# across crash-shaped and graceful lease handoffs, plus bind p99 during
+# a 429 storm vs quiet (interleaved arms); CPU-only.
+bench-failover:
+	set -o pipefail; python bench.py --failover | tee /tmp/tpudra_bench_out.txt
 	python tools/bench_delta.py /tmp/tpudra_bench_out.txt
 
 # Fractional-chip A/B (docs/partitioning.md): interleaved

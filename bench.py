@@ -160,6 +160,19 @@ def _bench_driver(
             driver.stop()
 
 
+def _pcts_ms(samples: list[float], nd: int = 3, include_max: bool = False) -> dict:
+    """p50/p99 (+ optional max) over millisecond samples.  p99 is the
+    nearest-rank sample (== max below ~100 samples)."""
+    s = sorted(samples)
+    out = {
+        "p50_ms": round(statistics.median(s), nd),
+        "p99_ms": round(s[max(0, int(len(s) * 0.99) - 1)], nd),
+    }
+    if include_max:
+        out["max_ms"] = round(s[-1], nd)
+    return out
+
+
 def bench_bind_p50(iters: int = None, warmup: int = None) -> float:
     iters = ITERS if iters is None else iters
     warmup = WARMUP if warmup is None else warmup
@@ -616,17 +629,150 @@ def bench_storage_degraded(iters: int = None, warmup: int = None) -> dict:
             recovered = "error" not in resp["claims"]["sb-post"]
             if recovered:
                 client.unprepare([post])
-        shed_sorted = sorted(shed_ms)
+        shed = _pcts_ms(shed_ms, include_max=True)
         return {
             "iters": iters,
             "healthy_bind_p50_ms": round(statistics.median(healthy_ms), 3),
-            "shed_p50_ms": round(statistics.median(shed_ms), 3),
-            "shed_p99_ms": round(
-                shed_sorted[max(0, int(len(shed_sorted) * 0.99) - 1)], 3
-            ),
-            "shed_max_ms": round(max(shed_ms), 3),
+            "shed_p50_ms": shed["p50_ms"],
+            "shed_p99_ms": shed["p99_ms"],
+            "shed_max_ms": shed["max_ms"],
             "recovered_after_heal": recovered,
         }
+
+
+def bench_failover(iters: int = None, warmup: int = None) -> dict:
+    """Controller-failover A/B (`make bench-failover`, docs/ha.md).
+
+    Two measurements:
+
+    - **time-to-new-leader**: lease pairs on a FakeKube; the leader dies
+      (``crash()`` — SIGKILL-shaped, the standby must wait out the full
+      expiry window) or hands off gracefully (``release()``); p50/p99 of
+      the standby's acquisition latency, per arm.  Measured at a scaled
+      lease geometry (duration/renew below) — production geometries scale
+      linearly since expiry dominates the crash arm.
+
+    - **bind under a 429 storm vs quiet, truly interleaved**: the same
+      single-claim bind (per-claim-GET resolution, so every bind touches
+      the apiserver) with the storm arm's resolve refused once with
+      429-plus-Retry-After before succeeding; the measured time includes
+      the kubelet-role retry paced by the shared Backoff.  The artifact
+      is the within-run delta: what one shed round-trip costs a bind.
+    """
+    import threading as threading_mod
+
+    from tests.test_device_state import mk_claim
+    from tpudra.controller.lease import LeaseElector
+    from tpudra.kube import gvr
+    from tpudra.kube.fake import ApiErrorPlan, FakeKube
+
+    iters = ITERS if iters is None else iters
+    warmup = WARMUP if warmup is None else warmup
+    lease_iters = min(iters, 10)
+    dur_s, renew_s = 0.4, 0.08
+    out: dict = {
+        "iters": iters,
+        "lease_iters": lease_iters,
+        "lease_duration_ms": dur_s * 1000.0,
+        "renew_interval_ms": renew_s * 1000.0,
+    }
+
+    def one_failover(i: int, graceful: bool) -> float:
+        kube = FakeKube()
+        stop_a, stop_b = threading_mod.Event(), threading_mod.Event()
+        mk = lambda ident: LeaseElector(  # noqa: E731
+            kube,
+            identity=ident,
+            name="bench-controller",
+            namespace="default",
+            lease_duration_s=dur_s,
+            renew_interval_s=renew_s,
+        )
+        a, b = mk(f"a-{i}"), mk(f"b-{i}")
+        try:
+            a.start(stop_a)
+            deadline = time.monotonic() + 10
+            while not a.is_leader and time.monotonic() < deadline:
+                time.sleep(0.005)
+            b.start(stop_b)
+            time.sleep(renew_s * 3)  # b observes the live lease
+            t0 = time.perf_counter()
+            if graceful:
+                stop_a.set()  # run()'s finally releases the lease
+            else:
+                a.crash()  # lease left held: b waits out expiry
+            deadline = time.monotonic() + 10
+            while not b.is_leader and time.monotonic() < deadline:
+                time.sleep(0.002)
+            if not b.is_leader:
+                raise RuntimeError("standby never acquired the lease")
+            return (time.perf_counter() - t0) * 1000.0
+        finally:
+            stop_a.set()
+            stop_b.set()
+
+    crash_ms = [one_failover(i, graceful=False) for i in range(lease_iters)]
+    handoff_ms = [one_failover(i, graceful=True) for i in range(lease_iters)]
+
+    out["time_to_new_leader"] = {
+        "crash": _pcts_ms(crash_ms, nd=1, include_max=True),
+        "graceful": _pcts_ms(handoff_ms, nd=1, include_max=True),
+    }
+
+    # -- bind under a 429 storm vs quiet, interleaved -----------------------
+    retry_after_s = 0.02
+    out["storm_retry_after_ms"] = retry_after_s * 1000.0
+    with _bench_driver(claim_cache=False) as (kube, client, driver):
+        from tpudra.backoff import Backoff
+
+        quiet_ms: list[float] = []
+        storm_ms: list[float] = []
+
+        def one_bind(i: int, storm: bool) -> float:
+            uid = f"fo-{'s' if storm else 'q'}-{i}"
+            claim = mk_claim(uid, [f"tpu-{i % 4}"], name=uid)
+            kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+            if storm:
+                # Deterministic storm: this bind's first resolve GET is
+                # shed with 429 + Retry-After, the retry lands.
+                kube.set_error_plan(
+                    ApiErrorPlan().fail(
+                        verb="get", code=429, times=1,
+                        retry_after_s=retry_after_s,
+                    )
+                )
+            backoff = Backoff(retry_after_s, 0.5)
+            t0 = time.perf_counter()
+            try:
+                for _ in range(20):
+                    resp = client.prepare([claim])
+                    if "error" not in resp["claims"][uid]:
+                        break
+                    # The kubelet role: a retryable error re-prepares on
+                    # the shared jittered backoff (the Retry-After floor
+                    # travels typed in-process; over gRPC the hint is in
+                    # the error string and the backoff base covers it).
+                    time.sleep(max(backoff.next_delay(), retry_after_s))
+                else:
+                    raise RuntimeError(f"bind never granted: {resp}")
+                return (time.perf_counter() - t0) * 1000.0
+            finally:
+                kube.set_error_plan(None)
+                client.unprepare([claim])
+                kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+
+        for i in range(iters + warmup):
+            q = one_bind(i, storm=False)
+            s = one_bind(i, storm=True)
+            if i >= warmup:
+                quiet_ms.append(q)
+                storm_ms.append(s)
+        out["bind_quiet"] = _pcts_ms(quiet_ms, nd=1, include_max=True)
+        out["bind_429_storm"] = _pcts_ms(storm_ms, nd=1, include_max=True)
+        out["storm_overhead_p50_ms"] = round(
+            statistics.median(storm_ms) - statistics.median(quiet_ms), 1
+        )
+    return out
 
 
 def bench_partition_ab(iters: int = None, warmup: int = None) -> dict:
@@ -679,13 +825,6 @@ def bench_partition_ab(iters: int = None, warmup: int = None) -> dict:
             if i >= warmup:
                 chip_ms.append(dt_c)
                 part_ms.append(dt_p)
-
-        def stats(samples: list[float]) -> dict:
-            s = sorted(samples)
-            return {
-                "p50_ms": round(statistics.median(s), 3),
-                "p99_ms": round(s[max(0, int(len(s) * 0.99) - 1)], 3),
-            }
 
         # -- packing: saturation residency, then churn throughput --------
         def fill(mk_devices, configs, prefix: str) -> list[dict]:
@@ -746,8 +885,8 @@ def bench_partition_ab(iters: int = None, warmup: int = None) -> dict:
         per_hour = 3600.0 / window_s / chips
         return {
             "iters": iters,
-            "whole_chip": stats(chip_ms),
-            "partition": stats(part_ms),
+            "whole_chip": _pcts_ms(chip_ms),
+            "partition": _pcts_ms(part_ms),
             "bind_ratio_p50": round(
                 statistics.median(part_ms) / max(1e-9, statistics.median(chip_ms)), 2
             ),
@@ -2090,6 +2229,19 @@ def main(argv=None) -> None:
         line = {
             "metric": "storage_degraded_shed",
             **bench_storage_degraded(iters=iters, warmup=warmup),
+        }
+        print(json.dumps(line))
+        return
+
+    if "--failover" in argv:
+        # The controller-failover artifact (`make bench-failover`,
+        # docs/ha.md): time-to-new-leader p50/p99 across crash-shaped and
+        # graceful lease handoffs, plus bind p99 during a 429 storm vs
+        # quiet, interleaved; CPU-only.
+        argv.remove("--failover")
+        line = {
+            "metric": "controller_failover",
+            **bench_failover(iters=iters, warmup=warmup),
         }
         print(json.dumps(line))
         return

@@ -1,0 +1,29 @@
+"""BAD: apiserver retry loops sleeping a constant (APISERVER-RETRY).
+
+A constant retry delay synchronizes every client that hit the same flap:
+the retries land as one storm exactly when the apiserver is weakest.
+"""
+
+import time
+
+
+class ApiError(Exception):
+    pass
+
+
+def resolve_with_retry(kube, gvr, uid):
+    for _ in range(5):
+        try:
+            return kube.get(gvr, uid, "default")
+        except ApiError:
+            time.sleep(0.2)  # EXPECT: APISERVER-RETRY
+    return None
+
+
+def sweep_until_gone(sim_kube, gvr, name, stop):
+    while not stop.is_set():
+        try:
+            sim_kube.delete(gvr, name, "default")
+            return
+        except Exception:  # noqa: BLE001 — deliberately broad
+            time.sleep(1)  # EXPECT: APISERVER-RETRY

@@ -1,0 +1,39 @@
+"""GOOD: apiserver retry loops paced by the shared Backoff (plus the loop
+shapes the rule deliberately leaves alone)."""
+
+import time
+
+from tpudra.backoff import Backoff
+from tpudra.kube.errors import ApiError, retry_after_of
+
+
+def resolve_with_backoff(kube, gvr, uid):
+    backoff = Backoff(0.1, 5.0)
+    for _ in range(5):
+        try:
+            return kube.get(gvr, uid, "default")
+        except ApiError as e:
+            # Full jitter decorrelates the herd; Retry-After is a floor.
+            time.sleep(max(backoff.next_delay(), retry_after_of(e) or 0.0))
+    return None
+
+
+def poll_until_ready(kube, gvr, name, deadline):
+    # A loop-tail sleep pacing a bounded state poll is cadence, not a
+    # failure retry — the rule only looks inside the error handler.
+    while time.monotonic() < deadline:
+        obj = kube.get(gvr, name, "default")
+        if obj.get("status", {}).get("ready"):
+            return obj
+        time.sleep(0.05)
+    return None
+
+
+def non_apiserver_retry(sock):
+    # No apiserver verb in the loop: socket retries are out of scope.
+    for _ in range(3):
+        try:
+            return sock.recv(16)
+        except OSError:
+            time.sleep(0.1)
+    return b""

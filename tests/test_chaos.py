@@ -218,6 +218,51 @@ def _mini_config(tmp_path, **overrides) -> ChaosConfig:
 
 
 class TestMiniSoak:
+    def test_slo_failover_leg_uses_run_local_observation(self):
+        """The stale-leader acceptance reads the RUN-LOCAL observation —
+        the process-global metric carries residue across in-process soaks
+        and could fake the gate — and a run whose probes all skipped
+        fails with the skip named, not a counter."""
+        from tools.soak_report import REQUIRED_CHECKED, REQUIRED_KINDS
+
+        def mk_report(**fo):
+            return {
+                "slo": {},
+                "sim_hours": 2.0,
+                "faults": {
+                    "injected_total": len(REQUIRED_KINDS),
+                    "by_kind": {k: 1 for k in REQUIRED_KINDS},
+                },
+                "config": {"fault_kinds": list(REQUIRED_KINDS), "witness": False},
+                "invariants": {
+                    inv: {"checks": 1, "violations": 0}
+                    for inv in REQUIRED_CHECKED
+                },
+                "bind": {"overall": {"n": 1}},
+                "failover": fo,
+            }
+
+        residue = mk_report(
+            tpudra_gang_stale_leader_rejections_total=7.0,  # another run's
+            stale_leader_rejections_observed=0,
+            stale_probes_run=1,
+        )
+        fails = assert_slo(residue, min_sim_hours=0.0, min_faults=0)
+        assert any("probe(s) ran without a refusal" in f for f in fails), fails
+        skipped = mk_report(
+            tpudra_gang_stale_leader_rejections_total=0.0,
+            stale_leader_rejections_observed=0,
+            stale_probes_run=0,
+        )
+        fails = assert_slo(skipped, min_sim_hours=0.0, min_faults=0)
+        assert any("stale probe was skipped" in f for f in fails), fails
+        ok = mk_report(
+            tpudra_gang_stale_leader_rejections_total=0.0,
+            stale_leader_rejections_observed=1,
+            stale_probes_run=1,
+        )
+        assert assert_slo(ok, min_sim_hours=0.0, min_faults=0) == []
+
     def test_mini_soak_clean_run_passes_slo(self, tmp_path):
         """A seconds-scale soak: compound churn, every invariant checked,
         zero violations, report passes the SLO gate end to end (through
@@ -642,4 +687,141 @@ class TestPartitionFault:
             assert v["replay"]["seed"] == soak.config.seed
         finally:
             soak._stop.set()
+            soak.sim.close()
+
+
+class TestApiserverOutage:
+    """The error-storm injector: the apiserver REFUSES for a window, every
+    client layer retries through the shared backoff (Retry-After as a
+    floor), and the control plane reconverges after heal."""
+
+    def test_storm_429_refuses_then_recovers(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            soak._fault_counter = 1
+            soak._inject(
+                {
+                    "kind": "apiserver_outage", "t_sim": 0.0, "node": 0,
+                    "point": None,
+                    "params": {
+                        "variant": "storm_429",
+                        "window_sim_s": 30.0,
+                        "retry_after_sim_s": 1.0,
+                    },
+                }
+            )
+            record = soak._timeline[-1]
+            assert record.kind == "apiserver_outage"
+            assert record.params["requests_refused"] > 0
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            # Healed: the plan is gone and a plain verb succeeds.
+            from tpudra.kube import gvr as gvr_mod
+
+            soak.sim.kube.list(gvr_mod.RESOURCE_CLAIMS, "default")
+        finally:
+            soak._stop.set()
+            soak.sim.close()
+
+    def test_full_outage_closes_watches_and_reconverges(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            soak._fault_counter = 1
+            soak._inject(
+                {
+                    "kind": "apiserver_outage", "t_sim": 0.0, "node": 1,
+                    "point": None,
+                    "params": {
+                        "variant": "full_outage",
+                        "window_sim_s": 30.0,
+                        "retry_after_sim_s": 1.0,
+                    },
+                }
+            )
+            record = soak._timeline[-1]
+            assert record.params.get("streams_closed", 0) >= 1
+            assert record.params["requests_refused"] > 0
+            assert soak._checks["fault-recovery"]["violation"] == 0
+            assert record.recovered_sim_s is not None
+        finally:
+            soak._stop.set()
+            soak.sim.close()
+
+
+class TestControllerFailover:
+    """The failover injector: leader crash mid-gang-reserve, standby lease
+    acquisition with a larger term, all-or-nothing recovery under the new
+    term, and the revived stale leader fenced at the WAL."""
+
+    def test_failover_fences_stale_leader_and_converges(self, tmp_path):
+        soak = ChaosSoak(_mini_config(tmp_path))
+        soak.sim.start()
+        try:
+            soak._fault_counter = 1
+            soak._inject(
+                {
+                    "kind": "controller_failover", "t_sim": 0.0, "node": 0,
+                    "point": None, "params": {},
+                }
+            )
+            record = soak._timeline[-1]
+            assert record.kind == "controller_failover"
+            # A fresh term was started and is strictly above the old one.
+            assert record.params.get("new_term", 0) > (
+                record.params.get("old_term") or 0
+            )
+            # The stale probe hit the WAL refusal (single-writer leg).
+            assert soak._stale_rejections == 1
+            assert soak._checks["single-writer"]["violation"] == 0
+            assert soak._checks["gang-atomicity"]["violation"] == 0
+            # Converged all-or-nothing: nothing bound, no gang record.
+            assert soak._gang_mgr.gangs() == {}
+            for d in soak._cd_drivers.values():
+                assert not [
+                    u for u in d.state.prepared_claim_uids()
+                    if u.startswith("soak-fo-")
+                ]
+            # The new manager is fenced at the standby's term and the
+            # journaled history is strictly increasing.
+            high, history = soak._gang_mgr.fence_state()
+            assert high == soak._gang_term
+            assert history == sorted(set(history))
+            # The monitor's continuous audits pass over the steady state.
+            soak._check_single_writer()
+            assert soak._checks["single-writer"]["ok"] > 0
+            report = soak._report()
+            fo = report["failover"]
+            assert fo["stale_leader_rejections_observed"] == 1
+            assert fo["stale_probes_run"] == 1
+            assert fo["tpudra_gang_stale_leader_rejections_total"] >= 1
+            assert fo["time_to_new_leader_sim_s"]
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
+            soak.sim.close()
+
+    def test_leadership_liveness_ages_a_stalled_lease(self, tmp_path):
+        """Kill every elector, then run monitor passes: once the lease rv
+        sits unchanged past the recovery budget (sim time), the liveness
+        invariant must fire."""
+        soak = ChaosSoak(_mini_config(tmp_path, compression=4500.0))
+        soak.sim.start()
+        try:
+            soak._ensure_cd_stack()
+            assert soak._elector is not None and soak._elector.is_leader
+            soak._check_leadership_liveness()
+            assert soak._checks["leadership-liveness"]["violation"] == 0
+            soak._elector.crash()
+            deadline = time.monotonic() + 10
+            while (
+                soak._checks["leadership-liveness"]["violation"] == 0
+                and time.monotonic() < deadline
+            ):
+                soak._check_leadership_liveness()
+                time.sleep(0.05)
+            assert soak._checks["leadership-liveness"]["violation"] >= 1
+        finally:
+            soak._stop.set()
+            soak._close_cd_stack()
             soak.sim.close()

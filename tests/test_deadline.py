@@ -117,3 +117,36 @@ class TestResolverUnderDeadline:
             with pytest.raises(errors.Timeout):
                 resolve("default", "c1", "u1")
         assert time.monotonic() - t0 < 1.0
+
+
+class TestNestingUnderOutage:
+    def test_nesting_only_tightens_while_outage_window_open(self):
+        """An in-flight apiserver outage (error plan installed) must not
+        disturb deadline algebra: an inner scope opened DURING the outage
+        still only tightens, and the failed verbs consume none of the
+        outer budget's meaning — after heal, the outer deadline is still
+        the one in force."""
+        from tpudra.kube import errors as kerrors
+        from tpudra.kube.fake import ApiErrorPlan, FakeKube
+        from tpudra.kube.gvr import CONFIGMAPS
+
+        kube = FakeKube()
+        plan = ApiErrorPlan().outage(retry_after_s=30.0)
+        with api_deadline(5.0) as outer:
+            kube.set_error_plan(plan)
+            with pytest.raises(kerrors.ServiceUnavailable):
+                kube.list(CONFIGMAPS, "default")
+            with api_deadline(60.0) as inner:
+                # A LOOSER inner scope under an open outage window must
+                # still clamp to the outer budget.
+                assert inner == outer
+                with pytest.raises(kerrors.ServiceUnavailable):
+                    kube.list(CONFIGMAPS, "default")
+                with api_deadline(0.5) as tighter:
+                    assert tighter < outer
+            # Unwound: the outer deadline is back in force, and heal
+            # restores service inside it.
+            assert deadline.remaining() is not None
+            plan.heal()
+            kube.list(CONFIGMAPS, "default")
+        assert deadline.remaining() is None

@@ -787,3 +787,160 @@ def test_controller_gang_wiring_recovers_at_start_and_compacts_on_stop(tmp_path)
     assert (not os.path.exists(wal)) or os.path.getsize(wal) == 0
     for d in drivers.values():
         d._checkpoints.close()
+
+
+class TestGangFencing:
+    """The WAL fence (docs/ha.md): a journaled leadership term above the
+    writer's refuses the commit — split-brain cannot corrupt gang state
+    even when the lease layer misbehaves."""
+
+    def test_unfenced_manager_journals_no_term(self, cp):
+        mgr = GangReservationManager(cp, RecordingBinder())
+        members = mk_members(2)
+        mgr.reserve("g1", members, mk_claims(members))
+        assert mgr.fence_state() == (0, [])
+
+    def test_terms_advance_and_history_is_strictly_increasing(self, cp):
+        binder = RecordingBinder()
+        m1 = GangReservationManager(cp, binder, term=1)
+        members = mk_members(2)
+        m1.reserve("g1", members, mk_claims(members))
+        assert m1.fence_state() == (1, [1])
+        m1.set_term(3)  # a re-election skipped term 2 (another candidate)
+        m1.release("g1")
+        assert m1.fence_state() == (3, [1, 3])
+
+    def test_set_term_refuses_regression(self, cp):
+        mgr = GangReservationManager(cp, RecordingBinder(), term=5)
+        with pytest.raises(ValueError):
+            mgr.set_term(4)
+
+    def test_stale_leader_commit_refused_and_counted(self, cp):
+        from tpudra import metrics
+        from tpudra.controller.gang import StaleLeader
+
+        binder = RecordingBinder()
+        old = GangReservationManager(cp, binder, term=1)
+        members = mk_members(2)
+        old.reserve("g1", members, mk_claims(members))
+        # The new leader commits ANYTHING — its first fenced mutate
+        # advances the journaled high-water term past the old leader's.
+        new = GangReservationManager(cp, binder, term=2)
+        new.mark_degraded("g1", ["c0"], reason="takeover probe")
+        before = metrics.GANG_STALE_LEADER_REJECTIONS._value.get()
+        # Every mutate-shaped op of the REVIVED old leader is refused at
+        # the checkpoint layer — reserve, release, remediation marks.
+        m2 = mk_members(3)
+        with pytest.raises(StaleLeader) as ei:
+            old.reserve("g2", m2, mk_claims(m2))
+        assert ei.value.journaled_term == 2 and ei.value.my_term == 1
+        with pytest.raises(StaleLeader):
+            old.release("g1")
+        with pytest.raises(StaleLeader):
+            old.mark_degraded("g1", ["c1"])
+        assert metrics.GANG_STALE_LEADER_REJECTIONS._value.get() >= before + 3
+        # The refusals left gang state exactly as the new leader had it.
+        gangs = new.gangs()
+        assert set(gangs) == {"g1"}
+        assert gangs["g1"].phase == "degraded"
+        assert binder.bound == {"c0", "c1"}
+
+    def test_claim_store_fences_fresh_reserve_when_nothing_to_recover(self, cp):
+        """The adoption-time claim (Controller._leader_startup): when the
+        dead leader left NOTHING to converge, recovery alone never
+        advances the fence past its term — without claim_store a revived
+        stale leader's FRESH gang reserve would be accepted against its
+        own high-water mark."""
+        from tpudra.controller.gang import StaleLeader
+
+        binder = RecordingBinder()
+        old = GangReservationManager(cp, binder, term=1)
+        members = mk_members(1)
+        old.reserve("g1", members, mk_claims(members))
+        old.release("g1")  # cleanly done: the new leader has no work
+        new = GangReservationManager(cp, binder, term=2)
+        assert new.recover() == []  # recovery made no fenced commit
+        new.claim_store()
+        assert new.fence_state() == (2, [1, 2])
+        new.claim_store()  # idempotent: no duplicate history entry
+        assert new.fence_state() == (2, [1, 2])
+        m2 = mk_members(2)[1:]
+        with pytest.raises(StaleLeader):
+            old.reserve("g2", m2, mk_claims(m2))
+
+    def test_claim_store_unfenced_is_noop(self, cp):
+        mgr = GangReservationManager(cp, RecordingBinder())
+        mgr.claim_store()
+        assert mgr.fence_state() == (0, [])
+
+    def test_stale_recover_refused_but_new_term_recover_converges(self, cp):
+        from tpudra.controller.gang import StaleLeader
+
+        binder = RecordingBinder(fail_on=frozenset({"c1"}), fail_unbind=frozenset({"c1"}))
+        old = GangReservationManager(cp, binder, term=1)
+        members = mk_members(2)
+        with pytest.raises(GangRollbackIncomplete):
+            old.reserve("g1", members, mk_claims(members))
+        binder.fail_unbind = set()
+        new = GangReservationManager(cp, binder, term=2)
+        # Any fenced commit by the new leader claims the store — even a
+        # no-op mark on a not-yet-completed gang advances the fence.
+        new.mark_degraded("g1", ["c0"])
+        with pytest.raises(StaleLeader):
+            old.recover()  # the revived old leader's sweep is fenced too
+        assert new.recover() == ["g1"]  # the NEW term converges the gang
+        assert new.gangs() == {} and binder.bound == set()
+
+    def test_reserving_term_journaled_in_gang_record(self, cp):
+        mgr = GangReservationManager(cp, RecordingBinder(), term=7)
+        members = mk_members(2)
+        mgr.reserve("g1", members, mk_claims(members))
+        rec = cp.read_view().prepared_claims[GANG_UID_PREFIX + "g1"]
+        assert rec.groups[0].config_state["term"] == "7"
+
+
+def test_failover_crash_sweep_standby_recovers_and_fences_old_leader(tmp_path):
+    """The ISSUE 14 acceptance arm: SIGKILL the leading controller
+    mid-gang-reserve, the standby acquires the lease and ``recover()``
+    converges the gang all-or-nothing under the NEW term, and a revived
+    old leader's commit is refused at the checkpoint layer."""
+    from tpudra.controller.gang import StaleLeader
+
+    kube, nodes, drivers = _cd_stack(tmp_path)
+    members, claims = _gang_inputs(kube, nodes)
+    gang_dir = str(tmp_path / "gangs")
+    cp = CheckpointManager(gang_dir)
+    leader = GangReservationManager(cp, DriverGangBinder(drivers), term=1)
+    crashed = False
+    try:
+        with checkpoint_mod.armed_crash("mid-gang-reserve"):
+            leader.reserve("gfo", members, claims)
+    except SimulatedCrash:
+        crashed = True
+    assert crashed
+    cp.abandon()  # SIGKILL-shaped: no shutdown compaction
+
+    # The standby wins the lease (term 2) and recovers over the same dir.
+    cp2 = CheckpointManager(gang_dir)
+    standby = GangReservationManager(cp2, DriverGangBinder(drivers), term=2)
+    standby.recover()
+    bound = _bound_member_count(drivers, members)
+    gangs = standby.gangs()
+    assert bound in (0, len(members)), f"partial gang after failover: {bound}"
+    assert (bound == 0) == (not gangs)
+    high, history = standby.fence_state()
+    assert high == 2 and history[-1] == 2
+
+    # The old leader revives (a paused process resuming): every commit it
+    # attempts against the SAME checkpoint dir is refused at the WAL.
+    cp_revived = CheckpointManager(gang_dir)
+    revived = GangReservationManager(
+        cp_revived, DriverGangBinder(drivers), term=1
+    )
+    with pytest.raises(StaleLeader):
+        revived.reserve("gfo2", members, claims)
+    assert standby.fence_state()[0] == 2  # fence unmoved by the refusal
+    cp_revived.close()
+    cp2.close()
+    for d in drivers.values():
+        d._checkpoints.close()

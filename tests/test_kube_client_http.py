@@ -163,3 +163,78 @@ def test_watch_410_travels_the_http_transport():
         status = events[0]["object"]
         err = errors.from_status(status, int(status.get("code") or 500))
         assert isinstance(err, errors.Expired)
+
+
+class TestRetryAfter:
+    """429/503 Retry-After travels the HTTP transport onto the typed
+    error, clamped to the caller's remaining ambient deadline."""
+
+    def test_429_header_parsed_onto_typed_error(self, server, client):
+        from tpudra.kube.fake import ApiErrorPlan
+
+        plan = ApiErrorPlan().fail(verb="get", code=429, retry_after_s=3)
+        server.fake.set_error_plan(plan)
+        try:
+            with pytest.raises(errors.TooManyRequests) as ei:
+                client.get(gvr.CONFIGMAPS, "missing", "default")
+            assert ei.value.retry_after_s == 3.0
+        finally:
+            server.fake.set_error_plan(None)
+
+    def test_retry_after_clamped_to_ambient_deadline(self, server, client):
+        from tpudra.kube.deadline import api_deadline
+        from tpudra.kube.fake import ApiErrorPlan
+
+        server.fake.set_error_plan(
+            ApiErrorPlan().fail(verb="get", code=503, retry_after_s=60)
+        )
+        try:
+            with api_deadline(0.5):
+                with pytest.raises(errors.ServiceUnavailable) as ei:
+                    client.get(gvr.CONFIGMAPS, "missing", "default")
+            # Waiting 60s on a 0.5s budget is an instruction to fail, not
+            # to wait: the hint is clamped to what was left.
+            assert ei.value.retry_after_s is not None
+            assert ei.value.retry_after_s <= 0.5
+        finally:
+            server.fake.set_error_plan(None)
+
+    def test_header_parsing_forms(self):
+        assert errors.parse_retry_after("5") == 5.0
+        assert errors.parse_retry_after("0.25") == 0.25
+        assert errors.parse_retry_after(" 7 ") == 7.0
+        assert errors.parse_retry_after("") is None
+        assert errors.parse_retry_after(None) is None
+        assert errors.parse_retry_after("-3") is None
+        # HTTP-date form: too mangled to trust from our servers — no hint.
+        assert errors.parse_retry_after("Wed, 21 Oct 2015 07:28:00 GMT") is None
+        # Non-finite floats would turn every delay floor into a
+        # forever-sleep (informer relist, workqueue retry, elector wait).
+        assert errors.parse_retry_after("inf") is None
+        assert errors.parse_retry_after("Infinity") is None
+        assert errors.parse_retry_after("1e999") is None
+        assert errors.parse_retry_after("nan") is None
+
+    def test_retry_after_of_rejects_garbage(self):
+        e = errors.TooManyRequests("x", retry_after_s=None)
+        assert errors.retry_after_of(e) is None
+        assert errors.retry_after_of(RuntimeError("no attr")) is None
+        assert errors.is_retryable(errors.TooManyRequests("x"))
+        assert errors.is_retryable(errors.ServiceUnavailable("x"))
+        assert errors.is_retryable(errors.Timeout("x"))
+        assert not errors.is_retryable(errors.Conflict("x"))
+        assert not errors.is_retryable(RuntimeError("x"))
+
+    def test_untyped_error_carries_transport_code_and_is_not_retryable(self):
+        """An unmapped reason AND code (401, 413, ...) rehydrates as the
+        base ApiError — which must carry the REAL transport code: the
+        class default (500) would make is_retryable() blind-retry a
+        permanently-failing request through the whole backoff schedule."""
+        e = errors.from_status(
+            {"reason": "Unauthorized", "message": "token expired"}, 401
+        )
+        assert type(e) is errors.ApiError
+        assert e.code == 401
+        assert not errors.is_retryable(e)
+        # Mapped codes stay typed and keep their retryability.
+        assert errors.is_retryable(errors.from_status({}, 503))

@@ -274,3 +274,96 @@ def test_generation_bumps_only_on_spec_change(api):
     updated["spec"] = {"numNodes": 5}
     updated = api.update(gvr.COMPUTE_DOMAINS, updated)
     assert updated["metadata"]["generation"] == 2
+
+
+# ---------------------------------------------------------- error injection
+
+
+def test_error_plan_429_carries_retry_after(api):
+    from tpudra.kube.fake import ApiErrorPlan
+
+    plan = ApiErrorPlan().fail(
+        verb="get", gvr=gvr.CONFIGMAPS, code=429, retry_after_s=2.5
+    )
+    api.set_error_plan(plan)
+    api.create(gvr.CONFIGMAPS, {"metadata": {"name": "x"}}, "default")
+    with pytest.raises(errors.TooManyRequests) as ei:
+        api.get(gvr.CONFIGMAPS, "x", "default")
+    assert ei.value.retry_after_s == 2.5
+    assert errors.retry_after_of(ei.value) == 2.5
+    assert plan.injected == 1
+    # Other verbs are untouched by the scoped rule, and clearing the
+    # plan restores the verb it covered.
+    api.list(gvr.CONFIGMAPS, "default")
+    api.set_error_plan(None)
+    assert api.get(gvr.CONFIGMAPS, "x", "default")["metadata"]["name"] == "x"
+
+
+def test_error_plan_fail_once_then_recovers(api):
+    from tpudra.kube.fake import ApiErrorPlan
+
+    api.set_error_plan(ApiErrorPlan().fail(verb="create", code=500, times=1))
+    with pytest.raises(errors.InternalError):
+        api.create(gvr.CONFIGMAPS, {"metadata": {"name": "y"}}, "default")
+    # fail-once: the retry lands.
+    api.create(gvr.CONFIGMAPS, {"metadata": {"name": "y"}}, "default")
+
+
+def test_error_plan_outage_refuses_every_verb_until_heal(api):
+    from tpudra.kube.fake import ApiErrorPlan
+
+    api.create(gvr.CONFIGMAPS, {"metadata": {"name": "z"}}, "default")
+    plan = ApiErrorPlan().outage(retry_after_s=1.0)
+    api.set_error_plan(plan)
+    for fn in (
+        lambda: api.get(gvr.CONFIGMAPS, "z", "default"),
+        lambda: api.list(gvr.PODS, "default"),
+        lambda: api.create(gvr.CONFIGMAPS, {"metadata": {"name": "w"}}, "default"),
+        lambda: api.delete(gvr.CONFIGMAPS, "z", "default"),
+    ):
+        with pytest.raises(errors.ServiceUnavailable) as ei:
+            fn()
+        assert ei.value.retry_after_s == 1.0
+    assert plan.injected == 4
+    plan.heal()
+    assert api.get(gvr.CONFIGMAPS, "z", "default")
+
+
+def test_close_watches_scopes_to_one_gvr(api):
+    """close_watches(gvr=...) must 410 ONLY that resource's streams —
+    the narrow flap arm the chaos soak composes with resource-specific
+    storms."""
+    import queue as queue_mod
+
+    cm_events: queue_mod.Queue = queue_mod.Queue()
+    pod_events: queue_mod.Queue = queue_mod.Queue()
+    stop = threading.Event()
+
+    def consume(g, sink):
+        for ev in api.watch(g, stop=stop):
+            sink.put(ev)
+
+    threads = [
+        threading.Thread(target=consume, args=(gvr.CONFIGMAPS, cm_events), daemon=True),
+        threading.Thread(target=consume, args=(gvr.PODS, pod_events), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        deadline = 5.0
+        import time as time_mod
+
+        t0 = time_mod.monotonic()
+        while len(api._watchers) < 2 and time_mod.monotonic() - t0 < deadline:
+            time_mod.sleep(0.01)
+        closed = api.close_watches(gvr=gvr.CONFIGMAPS)
+        assert closed == 1
+        ev = cm_events.get(timeout=5)
+        assert ev["type"] == "ERROR" and ev["object"]["code"] == 410
+        # The pod stream stays live: a post-flap event still arrives.
+        api.create(gvr.PODS, {"metadata": {"name": "p1"}}, "default")
+        ev = pod_events.get(timeout=5)
+        assert ev["type"] == "ADDED"
+        assert ev["object"]["metadata"]["name"] == "p1"
+    finally:
+        stop.set()

@@ -369,3 +369,29 @@ def test_cache_filter_bounds_store_and_evicts(api):
     # filtering-handler convention), even though the wire event was MODIFIED.
     assert [t for t, n, _ in seen if n == "small"].count("ADDED") == 2
     stop.set()
+
+
+def test_relist_honors_retry_after_hint():
+    """A 429'd LIST with Retry-After must floor the relist backoff: the
+    informer's first retry may not land before the server's hint."""
+    from tpudra.kube.fake import ApiErrorPlan, FakeKube
+
+    kube = FakeKube()
+    plan = ApiErrorPlan().fail(
+        verb="list", gvr=gvr.CONFIGMAPS, code=429, times=1, retry_after_s=0.6
+    )
+    kube.set_error_plan(plan)
+    informer = Informer(kube, gvr.CONFIGMAPS)
+    stop = threading.Event()
+    t0 = time.monotonic()
+    informer.start(stop)
+    try:
+        assert informer.wait_for_sync(10)
+        took = time.monotonic() - t0
+        # First LIST 429s instantly; the jittered backoff alone would
+        # retry in well under 0.4s (base 0.2, full jitter) — only the
+        # hint explains a sync this late.
+        assert took >= 0.55, f"synced after {took:.2f}s, inside the hint"
+        assert plan.injected == 1
+    finally:
+        stop.set()

@@ -1,0 +1,447 @@
+"""Leader election (tpudra/controller/lease.py) and its controller wiring.
+
+The elector's contract, unit-level: a lone candidate acquires with term 1;
+a standby takes over after a crash only once the full expiry window has
+passed (and with a strictly larger term); a graceful release hands off
+without the expiry wait; renew failures inside the grace window keep
+leadership, past it demote; every transition drives the callbacks in
+order.  The controller wiring: a follower's informer handlers drop events
+and its work queue stays paused; winning the lease opens the gates and
+re-fences the gang manager.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from tpudra.controller.lease import LeaseElector
+from tpudra.kube import errors, gvr
+from tpudra.kube.fake import ApiErrorPlan, FakeKube
+
+
+#: Tight timings so a full acquire/expire cycle fits in well under a
+#: second of wall time; renew << duration per the elector's own check.
+DUR = 0.5
+RENEW = 0.1
+
+
+class Recorder:
+    def __init__(self):
+        self.events: list[tuple[str, int]] = []
+        self.lock = threading.Lock()
+        self.leading = threading.Event()
+        self.stopped = threading.Event()
+
+    def started(self, term: int) -> None:
+        with self.lock:
+            self.events.append(("started", term))
+        self.stopped.clear()
+        self.leading.set()
+
+    def stopped_leading(self) -> None:
+        with self.lock:
+            self.events.append(("stopped", -1))
+        self.leading.clear()
+        self.stopped.set()
+
+
+def mk_elector(kube, ident, rec=None, dur=DUR, renew=RENEW) -> LeaseElector:
+    rec = rec or Recorder()
+    e = LeaseElector(
+        kube,
+        identity=ident,
+        namespace="default",
+        lease_duration_s=dur,
+        renew_interval_s=renew,
+        on_started_leading=rec.started,
+        on_stopped_leading=rec.stopped_leading,
+    )
+    e._recorder = rec  # test-side handle
+    return e
+
+
+def wait_for(cond, timeout=5.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestLeaseElector:
+    def test_lone_candidate_acquires_term_1(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a")
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            assert e.term == 1
+            assert e._recorder.events[0] == ("started", 1)
+            lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+            assert lease["spec"]["holderIdentity"] == "a"
+            assert lease["spec"]["leaseTransitions"] == 1
+        finally:
+            stop.set()
+
+    def test_standby_defers_to_live_leader(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        a, b = mk_elector(kube, "a"), mk_elector(kube, "b")
+        a.start(stop)
+        try:
+            wait_for(lambda: a.is_leader, what="a leading")
+            b.start(stop)
+            # b must observe a live (renewing) lease and never steal it.
+            time.sleep(DUR * 2.5)
+            assert a.is_leader and not b.is_leader
+        finally:
+            stop.set()
+
+    def test_crash_failover_waits_out_expiry_and_bumps_term(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        a, b = mk_elector(kube, "a"), mk_elector(kube, "b")
+        a.start(stop)
+        try:
+            wait_for(lambda: a.is_leader, what="a leading")
+            b.start(stop)
+            time.sleep(RENEW * 3)  # let b observe the live lease
+            t0 = time.monotonic()
+            a.crash()  # SIGKILL-shaped: lease left held, no release
+            wait_for(lambda: b.is_leader, what="b taking over")
+            took = time.monotonic() - t0
+            # No early steal: b had to wait out (most of) the expiry
+            # window from its last observed change.
+            assert took > DUR * 0.5, f"stole the lease after only {took:.2f}s"
+            assert b.term == 2  # strictly above the dead leader's term
+            # The crashed leader fired NO stopped callback: it is "gone".
+            assert ("stopped", -1) not in a._recorder.events
+        finally:
+            stop.set()
+
+    def test_crash_during_inflight_acquire_never_promotes(self):
+        """crash() landing while the acquire verb is on the wire: the
+        write may still win (the lease ends up held by the dead identity
+        — a process dying right after its write, the standby pays
+        expiry), but the 'dead' incarnation must NOT promote, fire
+        callbacks, or touch the gauge.  The chaos soak's failover leg
+        relies on this to kill a stalled candidate without a ghost
+        leader appearing after the fault window drains."""
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a")
+        entered, release = threading.Event(), threading.Event()
+        orig_create = kube.create
+
+        def stalled_create(g, body, ns=None):
+            entered.set()
+            release.wait(5)
+            return orig_create(g, body, ns)
+
+        kube.create = stalled_create
+        e.start(stop)
+        try:
+            assert entered.wait(5), "acquire never reached the apiserver"
+            e.crash()  # lands while the create is in flight
+            release.set()
+
+            def lease_held_by_a() -> bool:
+                try:
+                    lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+                except errors.NotFound:
+                    return False
+                return lease["spec"]["holderIdentity"] == "a"
+
+            # The write wins: the lease IS held by the dead identity...
+            wait_for(lease_held_by_a, what="in-flight create landing")
+            time.sleep(0.1)  # room for a buggy promotion to surface
+            # ...but nothing promoted: no leader flag, no callback.
+            assert not e.is_leader
+            assert e._recorder.events == []
+        finally:
+            release.set()
+            stop.set()
+
+    def test_graceful_release_hands_off_without_expiry_wait(self):
+        kube = FakeKube()
+        stop_a, stop_b = threading.Event(), threading.Event()
+        a, b = mk_elector(kube, "a"), mk_elector(kube, "b")
+        a.start(stop_a)
+        try:
+            wait_for(lambda: a.is_leader, what="a leading")
+            b.start(stop_b)
+            time.sleep(RENEW * 2)
+            stop_a.set()  # graceful: run()'s finally releases the lease
+            wait_for(lambda: b.is_leader, what="b taking over")
+            assert a._recorder.stopped.is_set()
+            assert b.term == 2
+        finally:
+            stop_a.set()
+            stop_b.set()
+
+    def test_renew_failures_inside_grace_keep_leadership(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a", dur=1.5, renew=0.1)
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            plan = ApiErrorPlan().outage()
+            kube.set_error_plan(plan)
+            time.sleep(0.5)  # several failed renews, all inside grace
+            assert e.is_leader, "demoted during an outage inside the grace"
+            kube.set_error_plan(None)
+            time.sleep(0.4)
+            assert e.is_leader
+            assert e.term == 1  # the hold survived: same term throughout
+        finally:
+            stop.set()
+
+    def test_outage_past_grace_demotes(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a", dur=0.4, renew=0.1)
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            kube.set_error_plan(ApiErrorPlan().outage())
+            wait_for(
+                lambda: not e.is_leader, timeout=5.0, what="grace demotion"
+            )
+            assert e._recorder.stopped.is_set()
+            # Recovery: the apiserver returns, the candidate re-acquires
+            # with a FRESH term (its old journaled term must not fence the
+            # new incarnation out).
+            kube.set_error_plan(None)
+            wait_for(lambda: e.is_leader, what="re-acquisition")
+            assert e.term == 2
+        finally:
+            stop.set()
+
+    def test_renew_interval_must_undershoot_duration(self):
+        with pytest.raises(ValueError):
+            LeaseElector(FakeKube(), lease_duration_s=1.0, renew_interval_s=1.0)
+
+
+class TestControllerLeadershipGate:
+    def _mk_controller(self, kube, tmp_path, ident):
+        from tpudra.controller.controller import Controller, ManagerConfig
+
+        binder = type(
+            "B", (), {"bind": lambda *a: None, "unbind": lambda *a: None}
+        )()
+        return Controller(
+            kube,
+            ManagerConfig(
+                driver_namespace="default",
+                leader_elect=True,
+                leader_identity=ident,
+                lease_duration_s=DUR,
+                lease_renew_interval_s=RENEW,
+                gang_state_dir=str(tmp_path / f"gangs-{ident}"),
+                resync_period=3600.0,
+            ),
+            gang_binder=binder,
+        )
+
+    def test_follower_holds_dispatch_until_lease_won(self, tmp_path):
+        kube = FakeKube()
+        stop = threading.Event()
+        # Pre-seat a foreign leader so the controller starts as follower.
+        squatter = mk_elector(kube, "squatter")
+        squat_stop = threading.Event()
+        squatter.start(squat_stop)
+        wait_for(lambda: squatter.is_leader, what="squatter leading")
+
+        ctrl = self._mk_controller(kube, tmp_path, "ctrl-a")
+        assert ctrl.queue.paused
+        health_seen = []
+        ctrl._claim_health_pass = lambda uid, reason: health_seen.append(
+            (uid, reason)
+        )
+        ctrl.start(stop)
+        try:
+            wait_for(lambda: ctrl._cd_informer.has_synced, what="informer sync")
+            # Events while follower are dropped at the handler, not queued.
+            kube.create(
+                gvr.COMPUTE_DOMAINS,
+                {
+                    "apiVersion": "resource.tpu.google.com/v1beta1",
+                    "kind": "ComputeDomain",
+                    "metadata": {"name": "cd-x", "namespace": "default"},
+                    "spec": {"numNodes": 1, "channel": {
+                        "resourceClaimTemplate": {"name": "cd-x-channel"},
+                    }},
+                },
+                "default",
+            )
+            # A claim-health escalation landing while follower is dropped
+            # too — it has NO wire-level retry (the condition is a one-shot
+            # write), so the acquire-time resync must re-deliver it.
+            from tpudra import CLAIM_UNHEALTHY_CONDITION
+
+            kube.create(
+                gvr.RESOURCE_CLAIMS,
+                {
+                    "apiVersion": "resource.k8s.io/v1",
+                    "kind": "ResourceClaim",
+                    "metadata": {
+                        "name": "sick", "namespace": "default", "uid": "sick-uid",
+                    },
+                    "status": {"conditions": [{
+                        "type": CLAIM_UNHEALTHY_CONDITION,
+                        "status": "True",
+                        "reason": "HbmEccError",
+                    }]},
+                },
+                "default",
+            )
+            time.sleep(RENEW * 3)
+            assert not ctrl.is_leader
+            assert len(ctrl.queue) == 0, "follower queued dropped events"
+            assert not health_seen, "follower ran a claim-health pass"
+            # Hand over: the squatter exits gracefully; the controller must
+            # win the lease, adopt a term, re-fence gangs, and resync.
+            squat_stop.set()
+            wait_for(lambda: ctrl.is_leader, what="controller leading")
+            assert ctrl.leader_term == 2
+            assert ctrl.gangs.term == 2
+            # Resume rides the leader-startup thread (store claim +
+            # recovery first) — wait, don't race it.
+            wait_for(lambda: not ctrl.queue.paused, what="dispatch resume")
+            # The acquire-time resync picked the dropped CD up.
+            wait_for(
+                lambda: kube.get(
+                    gvr.COMPUTE_DOMAINS, "cd-x", "default"
+                ).get("metadata", {}).get("finalizers"),
+                what="reconcile of the dropped event",
+            )
+            # ... and re-delivered the dropped claim-health escalation.
+            wait_for(
+                lambda: ("sick-uid", "HbmEccError") in health_seen,
+                what="resync re-delivery of the dropped claim-health event",
+            )
+            # Adoption claimed the WAL store: the fence outranks any prior
+            # term even though recovery had nothing to converge.
+            assert ctrl.gangs.fence_state()[0] == ctrl.leader_term
+        finally:
+            stop.set()
+            squat_stop.set()
+
+    def test_lost_lease_pauses_dispatch(self, tmp_path):
+        kube = FakeKube()
+        stop = threading.Event()
+        ctrl = self._mk_controller(kube, tmp_path, "ctrl-a")
+        ctrl.start(stop)
+        try:
+            wait_for(lambda: ctrl.is_leader, what="controller leading")
+            # A rival steals the lease out-of-band (the shape a stalled
+            # leader sees after a GC pause): force-write the holder.
+            lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+            lease["spec"]["holderIdentity"] = "usurper"
+            lease["spec"]["leaseTransitions"] = 99
+            kube.update(gvr.LEASES, lease, "default")
+            wait_for(lambda: not ctrl.is_leader, what="demotion")
+            assert ctrl.queue.paused
+        finally:
+            stop.set()
+
+
+class TestRecreatedLease:
+    """`kubectl delete lease` (the operator's force-failover move) must
+    not restart the fencing sequence: minted terms floor on the highest
+    transitions count a candidate ever observed, and `advance_term`
+    repairs a cold process against a fence's journaled high-water."""
+
+    def test_recreated_lease_mints_past_observed_history(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a")
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            assert e.term == 1
+            # Simulate several elections' worth of history, observed by
+            # this candidate through its own renew reads.
+            lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+            lease["spec"]["leaseTransitions"] = 7
+            kube.update(gvr.LEASES, lease, "default")
+            time.sleep(RENEW * 3)  # a renew pass observes transitions=7
+            kube.delete(gvr.LEASES, "tpudra-controller", "default")
+            # The next acquisition recreates the lease: the minted term
+            # must land ABOVE everything observed, never back at 1.
+            wait_for(lambda: e.term >= 8, what="post-recreation term")
+            lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+            assert lease["spec"]["leaseTransitions"] >= 8
+        finally:
+            stop.set()
+
+    def test_deleted_lease_demotes_holder_promptly(self):
+        """A renew that finds the Lease GONE demotes NOW — riding the
+        grace window (it's for outages, not deletion) would leave the
+        old leader acting while a standby recreates the lease and leads:
+        a guaranteed dual-leader window on the force-failover move."""
+        kube = FakeKube()
+        stop = threading.Event()
+        rec = Recorder()
+        # A wide grace window so the two behaviors are unambiguous even
+        # on a loaded box: NotFound-demotes ≈ renew interval, riding the
+        # grace ≈ dur.  The demote→re-acquire gap is too short to poll
+        # is_leader; the on_stopped_leading callback is the witness.
+        e = mk_elector(kube, "a", rec=rec, dur=2.0, renew=0.1)
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            assert e.term == 1
+            kube.delete(gvr.LEASES, "tpudra-controller", "default")
+            t0 = time.monotonic()
+            # The next renew cycle sees NotFound and demotes — not the
+            # outage grace arithmetic (≈ 2 s would have elapsed).
+            assert rec.stopped.wait(1.0), "holder never demoted on deletion"
+            assert time.monotonic() - t0 < 1.0
+            # The candidate loop re-acquires the recreated lease under a
+            # FRESH term (leadership restarted, never silently resumed).
+            wait_for(lambda: e.is_leader and e.term >= 2, what="re-acquisition")
+        finally:
+            stop.set()
+
+    def test_advance_term_pushes_counter_past_a_fence(self):
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a")
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            # A cold process after lease recreation: term 1, but the gang
+            # WAL's journaled high-water says 5 — the controller calls
+            # advance_term(6) and fencing resumes above history.
+            assert e.advance_term(6) == 6
+            assert e.term == 6
+            lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+            assert lease["spec"]["leaseTransitions"] == 6
+            # Idempotent at-or-below: never regresses.
+            assert e.advance_term(3) == 6
+        finally:
+            stop.set()
+
+    def test_advance_term_refuses_when_lease_lost(self):
+        from tpudra.kube import errors as kerrors
+
+        kube = FakeKube()
+        stop = threading.Event()
+        e = mk_elector(kube, "a")
+        e.start(stop)
+        try:
+            wait_for(lambda: e.is_leader, what="acquisition")
+            lease = kube.get(gvr.LEASES, "tpudra-controller", "default")
+            lease["spec"]["holderIdentity"] = "usurper"
+            kube.update(gvr.LEASES, lease, "default")
+            with pytest.raises(kerrors.Conflict):
+                e.advance_term(9)
+        finally:
+            stop.set()

@@ -7,7 +7,18 @@ import os
 import numpy as np
 import pytest
 
+from tpudra.workload import jaxcompat
 from tpudra.workload.envspec import ClaimEnv, factor_devices, mesh_from_devices
+
+#: Capability probe (tpudra/workload/jaxcompat.py): tests composing a
+#: MANUAL shard_map region inside a GSPMD-partitioned program need the
+#: native jax.shard_map + lax.pcast varying-types system — on boxes with
+#: only the experimental port they skip WITH the reason, keeping tier-1
+#: signal clean instead of failing on a jax the code cannot target.
+_PARTIAL_MANUAL_GAP = jaxcompat.missing_capability("shard_map-partial-manual")
+partial_manual = pytest.mark.skipif(
+    _PARTIAL_MANUAL_GAP is not None, reason=_PARTIAL_MANUAL_GAP or ""
+)
 
 
 class TestClaimEnv:
@@ -179,7 +190,7 @@ class TestCollectives:
         import jax
         import jax.numpy as jnp
         from functools import partial
-        from jax import shard_map
+        from tpudra.workload.jaxcompat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from tpudra.workload.envspec import mesh_from_devices
@@ -263,6 +274,7 @@ class TestFlagshipModel:
         sharded = float(jax.jit(m.loss_fn, static_argnums=2)(sharded_params, sharded_tokens, cfg))
         np.testing.assert_allclose(sharded, single, rtol=2e-2)
 
+    @partial_manual
     def test_graft_entry_contract(self):
         import jax
 
@@ -377,6 +389,7 @@ class TestPipelineParallel:
         mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "dp"))
         return m, cfg, params, tokens, mesh
 
+    @partial_manual
     def test_combined_3d_ep_single_program(self):
         """dp×pp×tp in ONE program: the pipeline schedule is manual over
         pp/dp while tp stays a GSPMD-auto axis inside the stage body — the
@@ -978,6 +991,7 @@ class TestRingModelComposition:
     sequence-parallel ring attention core (sp manual, everything else
     GSPMD) must match the dense model."""
 
+    @partial_manual
     def test_loss_and_grads_match_dense(self):
         import numpy as np
 

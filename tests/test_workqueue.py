@@ -441,3 +441,60 @@ def test_priority_bookkeeping_resets_after_completion():
     q._push = spy_push
     q.enqueue_keyed("cd", lambda: None, priority=PRIORITY_LOW)
     assert q2_entry_priority == [PRIORITY_LOW]
+
+
+def test_pause_holds_dispatch_and_resume_drains():
+    """The leader-election gate: a paused queue absorbs enqueues (keyed
+    supersession included) but dispatches nothing; resume() drains what
+    accumulated."""
+    q = WorkQueue(name="pause-test")
+    q.pause()
+    ran: list[str] = []
+    stop = threading.Event()
+    worker = threading.Thread(target=q.run, args=(stop,), daemon=True)
+    worker.start()
+    try:
+        q.enqueue_keyed("k", lambda: ran.append("old"))
+        q.enqueue_keyed("k", lambda: ran.append("new"))  # supersedes
+        q.enqueue(lambda: ran.append("anon"))
+        time.sleep(0.3)
+        assert ran == [], "paused queue dispatched work"
+        assert q.paused
+        q.resume()
+        deadline = time.monotonic() + 5
+        while len(ran) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sorted(ran) == ["anon", "new"], ran
+    finally:
+        stop.set()
+        q.shutdown()
+
+
+def test_retry_after_floors_the_limiter_delay():
+    """A work item failing with a 429 carrying Retry-After must not be
+    retried before the server's hint elapses — the hint floors the
+    limiter's (much shorter) first-failure delay."""
+    from tpudra.kube.errors import TooManyRequests
+
+    q = WorkQueue(name="ra-test")
+    attempts: list[float] = []
+    done = threading.Event()
+
+    def flaky():
+        attempts.append(time.monotonic())
+        if len(attempts) == 1:
+            raise TooManyRequests("shed", retry_after_s=0.5)
+        done.set()
+
+    stop = threading.Event()
+    worker = threading.Thread(target=q.run, args=(stop,), daemon=True)
+    worker.start()
+    try:
+        q.enqueue_keyed("k", flaky)
+        assert done.wait(10), "retry never ran"
+        # Controller preset's first backoff is ~5ms; the 0.5s hint must
+        # have floored it.
+        assert attempts[1] - attempts[0] >= 0.45, attempts
+    finally:
+        stop.set()
+        q.shutdown()

@@ -93,6 +93,9 @@ def main() -> int:
     storage = current_headline(sys.argv[1], metric="storage_degraded_shed")
     if storage is not None:
         print_storage_section(storage)
+    failover = current_headline(sys.argv[1], metric="controller_failover")
+    if failover is not None:
+        print_failover_section(failover)
     trace_ab = current_headline(sys.argv[1], metric="trace_overhead")
     if trace_ab is not None:
         print_trace_section(trace_ab)
@@ -179,6 +182,33 @@ def print_storage_section(shed: dict) -> None:
         f"/ max {shed.get('shed_max_ms')} ms (typed retryable error) vs "
         f"healthy bind p50 {shed.get('healthy_bind_p50_ms')} ms; "
         f"recovered after heal: {shed.get('recovered_after_heal')}"
+    )
+
+
+def print_failover_section(fo: dict) -> None:
+    """The `--failover` artifact (make bench-failover, docs/ha.md):
+    time-to-new-leader across crash vs graceful lease handoffs, plus what
+    one 429 shed round-trip costs a bind (within-run interleaved arms)."""
+    if "error" in fo:
+        print(f"bench-delta: failover section errored: {fo['error']}")
+        return
+    ttl = fo.get("time_to_new_leader", {})
+    crash, graceful = ttl.get("crash", {}), ttl.get("graceful", {})
+    print(
+        "bench-delta: time-to-new-leader (lease "
+        f"{fo.get('lease_duration_ms'):g} ms / renew "
+        f"{fo.get('renew_interval_ms'):g} ms): crash p50 "
+        f"{crash.get('p50_ms')} ms / p99 {crash.get('p99_ms')} ms, "
+        f"graceful handoff p50 {graceful.get('p50_ms')} ms / p99 "
+        f"{graceful.get('p99_ms')} ms"
+    )
+    quiet, storm = fo.get("bind_quiet", {}), fo.get("bind_429_storm", {})
+    print(
+        "bench-delta: bind under 429 storm: p50 "
+        f"{storm.get('p50_ms')} ms / p99 {storm.get('p99_ms')} ms vs quiet "
+        f"{quiet.get('p50_ms')} / {quiet.get('p99_ms')} ms "
+        f"(+{fo.get('storm_overhead_p50_ms')} ms p50 per shed round-trip, "
+        f"Retry-After {fo.get('storm_retry_after_ms'):g} ms)"
     )
 
 

@@ -42,6 +42,8 @@ REQUIRED_CHECKED = (
     "acknowledged-mutation-durability",
     "storage-degraded-convergence",
     "partition-leak",
+    "single-writer",
+    "leadership-liveness",
 )
 
 #: Fault kinds every soak run must have injected at least once — checked
@@ -60,6 +62,8 @@ REQUIRED_KINDS = (
     "daemon_crash",
     "disk_fault",
     "partition_fault",
+    "apiserver_outage",
+    "controller_failover",
 )
 
 
@@ -103,6 +107,19 @@ def render(report: dict) -> str:
         f"recovery: {len(rec['samples_sim_s'])} fault recoveries, max "
         f"{rec['max_sim_s']:.0f} sim-s (budget {rec['budget_sim_s']:.0f})"
     )
+    fo = report.get("failover")
+    if fo:
+        ttl = fo.get("time_to_new_leader_sim_s") or []
+        lines.append(
+            f"failover: {fo.get('leader_terms_started', 0)} leader term(s), "
+            f"stale-leader rejections "
+            f"{fo.get('stale_leader_rejections_observed', fo.get('tpudra_gang_stale_leader_rejections_total', 0)):.0f}"
+            + (
+                f", time-to-new-leader max {max(ttl):.0f} sim-s"
+                if ttl
+                else ""
+            )
+        )
     if report.get("anomalies"):
         lines.append("")
         lines.append("anomalies (non-failing):")
@@ -164,6 +181,30 @@ def assert_slo(
         failures.append("witness was armed but the merge never ran")
     if report["bind"]["overall"]["n"] < 1:
         failures.append("no successful binds recorded — the churn never ran")
+    if report["faults"]["by_kind"].get("controller_failover", 0) >= 1:
+        # The failover acceptance (docs/ha.md): every run that injected a
+        # failover must have FENCED at least one revived stale leader at
+        # the checkpoint layer — a failover whose stale-commit probe never
+        # hit the WAL refusal proved nothing about split-brain.  The
+        # RUN-LOCAL observation is what counts: the process-global metric
+        # carries residue across in-process soaks and could fake the gate.
+        fo = report.get("failover", {})
+        observed = fo.get(
+            "stale_leader_rejections_observed",
+            fo.get("tpudra_gang_stale_leader_rejections_total", 0),
+        )
+        if observed < 1:
+            probes = fo.get("stale_probes_run", 0)
+            failures.append(
+                "controller_failover injected but no stale-leader commit "
+                "was fenced this run ("
+                + (
+                    f"{probes} probe(s) ran without a refusal"
+                    if probes
+                    else "every stale probe was skipped — see anomalies"
+                )
+                + ")"
+            )
     return failures
 
 
@@ -172,7 +213,7 @@ def main(argv=None) -> int:
     parser.add_argument("report", help="path to the soak's JSON report")
     parser.add_argument("--assert-slo", action="store_true")
     parser.add_argument("--min-sim-hours", type=float, default=1.0)
-    parser.add_argument("--min-faults", type=int, default=11)
+    parser.add_argument("--min-faults", type=int, default=13)
     args = parser.parse_args(argv)
     with open(args.report) as f:
         report = json.load(f)

@@ -33,6 +33,7 @@ class Rule:
 
 
 def all_rules() -> list[Rule]:
+    from tpudra.analysis.rules.apiserver_retry import ApiserverRetry
     from tpudra.analysis.rules.durable_write import DurableWrite
     from tpudra.analysis.rules.exc_swallow import ExcSwallow
     from tpudra.analysis.rules.lockgraph import (
@@ -60,6 +61,7 @@ def all_rules() -> list[Rule]:
         SpanHygiene(),
         DurableWrite(),
         PartitionPhase(),
+        ApiserverRetry(),
         LockCycle(lockgraph),
         BlockUnderLockIP(lockgraph),
         FlockInversion(lockgraph),

@@ -1,0 +1,147 @@
+"""APISERVER-RETRY: apiserver-verb retry loops pace with the shared Backoff.
+
+An apiserver failure is almost never one client's private event: a flap,
+a 429 shed window, or an outage puts EVERY client into its failure path
+within milliseconds.  A retry loop that sleeps a constant after catching
+the error marches the whole fleet back in lockstep — the synchronized
+storm lands exactly when the server is weakest, which is why every
+production retry path in this tree (informer relist, workqueue limiter,
+publisher, lease elector) runs on ``tpudra/backoff.py``'s capped
+full-jitter policy, flooring on any 429/503 ``Retry-After`` hint.
+
+This rule pins the discipline as a machine check: inside a loop that
+calls an apiserver verb, an ``except`` handler for an API-error-ish
+exception may not reach a **literal-constant** ``time.sleep`` — route the
+delay through a :class:`tpudra.backoff.Backoff` (``sleep(b.next_delay())``
+or ``stop.wait(...)``) instead.  The match is deliberately narrow:
+
+- only sleeps whose argument is a numeric literal fire (a delay computed
+  from ``next_delay()`` / ``full_jitter_delay`` is exactly the fix);
+- only sleeps INSIDE the except handler fire — a loop-tail sleep pacing a
+  bounded state poll is cadence, not failure retry, and jittering it
+  buys nothing;
+- the loop must actually touch the apiserver: a call whose attribute is a
+  KubeAPI verb on a receiver mentioning ``kube`` (``self._kube.get``,
+  ``sim.kube.create``, ...).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+
+#: KubeAPI protocol verbs (kube/client.py).
+APISERVER_VERBS = frozenset(
+    {"get", "list", "create", "update", "update_status", "patch", "delete",
+     "watch"}
+)
+
+#: Exception names that mark a handler as "the apiserver failed" — the
+#: typed errors plus the broad catches retry loops actually write.
+_API_ERRORISH = frozenset(
+    {
+        "ApiError",
+        "Timeout",
+        "TooManyRequests",
+        "ServiceUnavailable",
+        "InternalError",
+        "Expired",
+        "Conflict",
+        "Exception",
+    }
+)
+
+
+def _is_apiserver_call(node: ast.Call) -> bool:
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and func.attr in APISERVER_VERBS):
+        return False
+    try:
+        receiver = ast.unparse(func.value)
+    except Exception:  # noqa: BLE001 — unparse failure: not a finding
+        return False
+    return "kube" in receiver.lower()
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set[str]:
+    t = handler.type
+    if t is None:
+        return {"Exception"}  # bare except: at least as broad
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    out: set[str] = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            out.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            out.add(e.attr)
+    return out
+
+
+def _literal_sleeps(node: ast.AST) -> list[ast.Call]:
+    """time.sleep(<numeric literal>) calls anywhere under ``node``."""
+    out = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        func = sub.func
+        named_sleep = (
+            isinstance(func, ast.Attribute)
+            and func.attr == "sleep"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+        ) or (isinstance(func, ast.Name) and func.id == "sleep")
+        if not named_sleep or not sub.args:
+            continue
+        arg = sub.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+            out.append(sub)
+    return out
+
+
+class ApiserverRetry(Rule):
+    rule_id = "APISERVER-RETRY"
+    description = (
+        "apiserver-verb retry loops may not sleep a literal constant in "
+        "their error handler — route the delay through tpudra.backoff's "
+        "shared full-jitter Backoff (Retry-After as a floor)"
+    )
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        out: list[Finding] = []
+        # Nested retry loops (per-node outer, per-attempt inner) both
+        # match the verb predicate and would each re-report the same
+        # sleep — one finding per sleep site.
+        seen: set[tuple[int, int]] = set()
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            if not any(
+                isinstance(n, ast.Call) and _is_apiserver_call(n)
+                for n in ast.walk(loop)
+            ):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not (_handler_names(handler) & _API_ERRORISH):
+                        continue
+                    for sleep in _literal_sleeps(handler):
+                        key = (sleep.lineno, sleep.col_offset)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        out.append(
+                            self.finding(
+                                module, sleep,
+                                "constant sleep in an apiserver-verb retry "
+                                "loop's error handler: a fleet of clients "
+                                "retrying on the same constant marches "
+                                "back in lockstep — use the shared "
+                                "tpudra.backoff.Backoff (full jitter, "
+                                "Retry-After floor) for the delay",
+                            )
+                        )
+        return out

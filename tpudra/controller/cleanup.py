@@ -30,14 +30,23 @@ class CleanupManager:
         namespace: str | None,
         cd_exists: Callable[[str], bool],
         period: float = 600.0,
+        enabled: Callable[[], bool] | None = None,
     ):
         self._kube = kube
         self._target = target
         self._ns = namespace
         self._cd_exists = cd_exists
         self._period = period
+        #: Leadership gate (docs/ha.md): a follower replica must not
+        #: sweep — its informer view can lag the leader's (a processed
+        #: DELETED without the re-creation), and an ungated delete pass
+        #: over that split view would GC objects the leader just stamped.
+        #: None = always enabled (the single-replica default).
+        self._enabled = enabled
 
     def cleanup_once(self) -> int:
+        if self._enabled is not None and not self._enabled():
+            return 0
         removed = 0
         items = self._kube.list(
             self._target, self._ns, label_selector=CD_UID_LABEL

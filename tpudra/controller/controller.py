@@ -17,7 +17,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from tpudra import CLAIM_UNHEALTHY_CONDITION
+from tpudra import CLAIM_UNHEALTHY_CONDITION, lockwitness
 from tpudra.controller.cleanup import CleanupManager
 from tpudra.controller.computedomain import ComputeDomainManager, RetryLater
 from tpudra.controller.resourceclaimtemplate import CD_UID_LABEL
@@ -80,6 +80,19 @@ class ManagerConfig:
     # None disables the gang manager; a Controller built with a state dir
     # AND a gang_binder recovers in-flight gangs at run() start.
     gang_state_dir: Optional[str] = None
+    # -- leader election (controller/lease.py, docs/ha.md) ------------------
+    # False (default) keeps the single-replica behavior every existing
+    # harness relies on: the controller leads unconditionally, unfenced.
+    # True gates run() on holding the coordination.k8s.io Lease: informer
+    # handlers drop events and the work queue pauses while not leading,
+    # and every leadership term hands the gang manager a fresh fencing
+    # token (GangReservationManager.set_term).
+    leader_elect: bool = False
+    #: Candidate identity (pod name in production); "" = random.
+    leader_identity: str = ""
+    lease_name: str = "tpudra-controller"
+    lease_duration_s: float = 15.0
+    lease_renew_interval_s: float = 5.0
 
 
 class Controller:
@@ -129,6 +142,40 @@ class Controller:
             name="controller",
             fair=self._config.fair_queue,
         )
+        # -- leadership (docs/ha.md).  Without election the controller
+        # leads unconditionally from construction (the event pre-set), so
+        # every existing single-replica harness behaves identically.  With
+        # it, the event flips with the lease and everything event-driven
+        # checks it: handlers drop events while follower (the acquire-time
+        # resync rebuilds state), the queue pauses, and each term re-fences
+        # the gang manager.
+        self._leader_evt = threading.Event()
+        self._leader_term = 0
+        #: Serializes dispatch-gate transitions between the elector thread
+        #: (pause on loss) and the leader-startup thread (resume after
+        #: recovery): without it a loss racing the startup's resume could
+        #: leave the queue running while follower.
+        self._leader_gate_lock = lockwitness.make_lock(
+            "controller.leader_gate_lock"
+        )
+        self.elector = None
+        if self._config.leader_elect:
+            from tpudra.controller.lease import LeaseElector
+
+            self.elector = LeaseElector(
+                kube,
+                identity=self._config.leader_identity,
+                name=self._config.lease_name,
+                namespace=self._config.driver_namespace,
+                lease_duration_s=self._config.lease_duration_s,
+                renew_interval_s=self._config.lease_renew_interval_s,
+                on_started_leading=self._on_started_leading,
+                on_stopped_leading=self._on_stopped_leading,
+                rng=rng,
+            )
+            self.queue.pause()  # nothing dispatches until the lease is won
+        else:
+            self._leader_evt.set()
         self._cd_informer = Informer(kube, gvr.COMPUTE_DOMAINS)
         self._clique_informer = Informer(
             kube, gvr.COMPUTE_DOMAIN_CLIQUES, namespace=self._config.driver_namespace
@@ -166,7 +213,10 @@ class Controller:
         # Orphan GC sweeps every managed namespace (the driver namespace
         # plus --additional-namespaces, mnsdaemonset.go semantics).
         self._cleanups = [
-            CleanupManager(kube, gvr.DAEMONSETS, ns, self.manager.cd_exists)
+            CleanupManager(
+                kube, gvr.DAEMONSETS, ns, self.manager.cd_exists,
+                enabled=self._leader_evt.is_set,
+            )
             for ns in self.manager.daemonsets.namespaces
         ] + [
             CleanupManager(
@@ -174,8 +224,118 @@ class Controller:
                 gvr.RESOURCE_CLAIM_TEMPLATES,
                 self._config.driver_namespace,
                 self.manager.cd_exists,
+                enabled=self._leader_evt.is_set,
             ),
         ]
+
+    # -- leadership ---------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leader_evt.is_set()
+
+    @property
+    def leader_term(self) -> int:
+        """The fencing token of the current leadership term (0 while
+        follower or before the first acquisition)."""
+        return self._leader_term
+
+    def _on_started_leading(self, term: int) -> None:
+        """Elector callback (elector thread): adopt the term and re-fence
+        the gang manager, then hand the startup sequence to its own
+        thread — gang recovery must run BEFORE dispatch resumes (the same
+        recovery-first ordering the non-elected run() enforces inline: an
+        in-flight gang from the dead leader converges under OUR term
+        before any reconcile can touch its members), and running it here
+        would stall lease renewal for the length of a recovery."""
+        logger.warning(
+            "controller %s: leading at term %d",
+            self.elector.identity if self.elector else "-", term,
+        )
+        if self.gangs is not None:
+            try:
+                high, _ = self.gangs.fence_state()
+            except Exception:  # noqa: BLE001 — unreadable store: fence decides
+                high = 0
+            if term <= high and self.elector is not None:
+                # A deleted-and-recreated Lease restarted the numbering at
+                # or below the WAL's journaled high-water: push the lease
+                # counter past history (CAS as holder) so fencing resumes
+                # ABOVE it instead of refusing this leader forever.
+                try:
+                    term = self.elector.advance_term(high + 1)
+                except Exception:  # noqa: BLE001 — blip: lead anyway; the
+                    # WAL fence refuses gang commits loudly (StaleLeader,
+                    # counted) until the next acquisition repairs the term.
+                    logger.exception(
+                        "fencing-term repair failed (lease term %d <= "
+                        "journaled %d); gang mutates will be refused until "
+                        "the next term", term, high,
+                    )
+            try:
+                self.gangs.set_term(term)
+            except ValueError:
+                # A same-process regression (this manager already held a
+                # higher term): keep the higher fence — the WAL refusals
+                # protect state while the lease numbering catches up.
+                logger.exception("gang fencing term not adopted")
+        self._leader_term = term
+        self._leader_evt.set()
+        threading.Thread(
+            target=self._leader_startup,
+            args=(term,),
+            daemon=True,
+            name="leader-startup",
+        ).start()
+
+    def _leader_startup(self, term: int) -> None:
+        """Recovery-first leadership startup, off the elector thread:
+        recover gangs (inline first attempt; a failure enqueues the
+        queued retry exactly like the non-elected path), then open the
+        dispatch gate and resync.  The gate transition re-checks the term
+        under ``_leader_gate_lock`` so a loss that raced the recovery
+        cannot be un-paused by a stale startup thread."""
+        if self.gangs is not None:
+            try:
+                # Claim the store BEFORE recovery: the fence must outrank
+                # the dead leader's term even when it left nothing to
+                # converge — otherwise a revived stale incarnation's fresh
+                # gang reserve would find its own old high-water mark
+                # at-or-below and be accepted (split-brain).
+                self.gangs.claim_store()
+            except Exception:  # noqa: BLE001 — outranked or store down: the
+                # per-mutate fence still refuses stale commits loudly.
+                logger.exception("leadership store claim failed (term %d)", term)
+            self._recover_gangs()
+        with self._leader_gate_lock:
+            if self._leader_term != term or not self._leader_evt.is_set():
+                return  # lost (or re-won under a newer term) mid-startup
+            self.queue.resume()
+        # Full resync: every event that arrived while follower was
+        # dropped at the handlers; the level-triggered caches rebuild.
+        # Claim-health escalations dropped while follower (including the
+        # initial LIST) get the same treatment HERE, once per
+        # acquisition — the condition is a one-shot write with no
+        # wire-level retry.  Not in the periodic resync: a lingering
+        # condition would cost a WAL re-mark + remediation enqueue every
+        # cycle (the degraded-gang sweep already owns that backstop).
+        if self._claim_health_informer is not None:
+            for claim in self._claim_health_informer.list():
+                self._on_claim_health_event("ADDED", claim)
+        self._resync_once()
+
+    def _on_stopped_leading(self) -> None:
+        """Elector callback: stop ACTING immediately — gates closed, queue
+        paused.  Queued work survives (coalesced, newest-wins) so a
+        re-acquire resumes warm; the WAL fence covers the window where a
+        stale in-flight item outlives this callback."""
+        logger.warning(
+            "controller %s: lost leadership; suspending dispatch",
+            self.elector.identity if self.elector else "-",
+        )
+        with self._leader_gate_lock:
+            self._leader_evt.clear()
+            self.queue.pause()
 
     # -- event plumbing -----------------------------------------------------
 
@@ -208,6 +368,8 @@ class Controller:
             _RECONCILE_LATENCY.observe(time.monotonic() - t0)
 
     def _on_cd_event(self, _etype: str, obj: dict) -> None:
+        if not self._leader_evt.is_set():
+            return  # follower: the acquire-time resync rebuilds this
         meta = obj.get("metadata", {})
         # Teardown outranks routine reconciles: a terminating CD holds a
         # finalizer the user is waiting on, and behind a busy lane it
@@ -218,6 +380,8 @@ class Controller:
         self._enqueue_cd(meta.get("namespace", ""), meta.get("name", ""), priority)
 
     def _on_clique_event(self, _etype: str, obj: dict) -> None:
+        if not self._leader_evt.is_set():
+            return  # follower: the acquire-time resync rebuilds this
         cd_uid = obj.get("spec", {}).get("computeDomainUID", "")
         if not cd_uid:
             return
@@ -280,7 +444,12 @@ class Controller:
         for c in self._cleanups:
             c.start(stop)
         self.manager.nodes.start(stop)
-        if self.gangs is not None:
+        if self.elector is not None:
+            # Elected mode: recovery belongs to the TERM, not to startup —
+            # _on_started_leading re-fences the gang manager and enqueues
+            # it; dispatch stays paused until the lease is won.
+            self.elector.start(stop)
+        elif self.gangs is not None:
             # Crash recovery FIRST: an in-flight gang from the previous
             # incarnation must converge to none-bound before new waves
             # (or reconciles acting on its members) dispatch.  A rollback
@@ -337,6 +506,8 @@ class Controller:
         condition."""
         if etype == "DELETED":
             return
+        if not self._leader_evt.is_set():
+            return  # follower: the acquire-time resync sweep re-marks
         uid = obj.get("metadata", {}).get("uid", "")
         reason = next(
             (
@@ -473,6 +644,8 @@ class Controller:
             self._resync_once()
 
     def _resync_once(self) -> None:
+        if not self._leader_evt.is_set():
+            return  # a follower's sweep would only queue work the pause holds
         self._sweep_degraded_gangs()
         for cd in self._cd_informer.list():
             meta = cd.get("metadata", {})

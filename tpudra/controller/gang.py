@@ -71,6 +71,14 @@ logger = logging.getLogger(__name__)
 #: object uid, so no claim record can ever collide with a gang record.
 GANG_UID_PREFIX = "gang/"
 
+#: The leadership-fence record (docs/ha.md): ONE per gang checkpoint,
+#: outside the gang/ namespace so no gang scan ever sees it.  Its
+#: config_state carries the high-water fencing ``term`` (the largest
+#: leadership term that ever committed here) and the strictly-increasing
+#: ``termHistory`` of first-commit-per-term (the chaos soak's
+#: single-writer invariant audits it).
+GANG_META_UID = "gangmeta/term"
+
 #: config_state phases of a PrepareStarted gang record.  A completed gang
 #: (status PREPARE_COMPLETED) with no degraded mark is phase-less: all
 #: members bound.
@@ -92,10 +100,27 @@ _GANGS_RELEASED = metrics.GANG_RESERVATIONS_TOTAL.labels("released")
 _REMEDIATED = metrics.GANG_REMEDIATIONS_TOTAL.labels("remediated")
 _REMEDIATION_RELEASED = metrics.GANG_REMEDIATIONS_TOTAL.labels("released")
 _REMEDIATION_FAILED = metrics.GANG_REMEDIATIONS_TOTAL.labels("failed")
+_STALE_REJECTED = metrics.GANG_STALE_LEADER_REJECTIONS
 
 
 class GangBindError(Exception):
     """A member bind failed; the gang was rolled back to none-bound."""
+
+
+class StaleLeader(Exception):
+    """A gang mutate was REFUSED at the checkpoint layer because the
+    journaled leadership term outranks this manager's fencing token: a
+    newer leader has committed here, so this writer is a superseded
+    incarnation (crash-loop overlap, a paused-then-revived process, a
+    lease layer gone wrong).  The refusal — not the lease — is what makes
+    split-brain unable to corrupt gang state (docs/ha.md).  Counted in
+    ``tpudra_gang_stale_leader_rejections_total``; the correct response is
+    to stop acting, not to retry."""
+
+    def __init__(self, message: str, journaled_term: int = 0, my_term: int = 0):
+        super().__init__(message)
+        self.journaled_term = journaled_term
+        self.my_term = my_term
 
 
 class GangOpInProgress(Exception):
@@ -215,10 +240,17 @@ class GangReservationManager:
         checkpoints: CheckpointManager,
         binder: GangBinder,
         claim_resolver: Optional[Callable[[GangMember], Optional[dict]]] = None,
+        term: Optional[int] = None,
     ):
         self._cp = checkpoints
         self._binder = binder
         self._claim_resolver = claim_resolver
+        #: Leadership fencing token (docs/ha.md): when set, EVERY mutate
+        #: is fenced through the ``gangmeta/term`` record — a journaled
+        #: term above ours refuses the commit with :class:`StaleLeader`.
+        #: None = legacy unfenced operation (single-process harnesses,
+        #: benches, every pre-election caller).
+        self._term = term
         # Per-gang operation guard: reserve/release/remediate/recover of
         # ONE gang never interleave (two threads unbinding the same
         # member set would double-free), while distinct gangs proceed
@@ -242,6 +274,99 @@ class GangReservationManager:
             with self._ops_lock:
                 self._active_ops.discard(gang_id)
 
+    # -------------------------------------------------------------- fencing
+
+    def set_term(self, term: int) -> None:
+        """Adopt a (new) leadership term — called by the election layer's
+        ``on_started_leading``.  Terms only move forward: adopting a term
+        below the current one is a caller bug, refused loudly."""
+        if self._term is not None and term < self._term:
+            raise ValueError(
+                f"fencing term may not regress ({self._term} -> {term})"
+            )
+        self._term = term
+
+    @property
+    def term(self) -> Optional[int]:
+        return self._term
+
+    def claim_store(self) -> None:
+        """Advance the journaled fence to OUR term with a no-op fenced
+        commit — the new leader's first write, made at adoption time.
+        Recovery alone is not enough: when the dead leader left nothing
+        to converge, no fenced commit ever outranks its term, and a
+        revived stale incarnation reserving a FRESH gang would find its
+        own old high-water mark at-or-below and be accepted.  Idempotent;
+        unfenced managers have no store to claim.  Raises
+        :class:`StaleLeader` when a newer term already committed."""
+        if self._term is None:
+            return
+        self._mutate(lambda cp: None, touched=[])
+
+    def _mutate(self, fn, touched: list) -> None:
+        """Every gang mutate funnels through here.  Unfenced (term None):
+        a plain checkpoint mutate.  Fenced: the commit first consults the
+        journaled high-water term — a stored term above ours means a newer
+        leader has committed, and THIS commit is refused inside the WAL
+        transaction (typed :class:`StaleLeader`, counted) so not even a
+        torn lease layer lets a stale incarnation corrupt gang state.  A
+        term at-or-below ours is advanced to ours in the SAME commit, with
+        the first commit of each term appended to the strictly-increasing
+        ``termHistory`` the soak's single-writer invariant audits."""
+        term = self._term
+        if term is None:
+            self._cp.mutate(fn, touched=touched)
+            return
+
+        def fenced(cp: Checkpoint) -> None:
+            meta = cp.prepared_claims.get(GANG_META_UID)
+            state = meta.groups[0].config_state if meta and meta.groups else {}
+            stored = int(state.get("term", "0") or 0)
+            if stored > term:
+                raise StaleLeader(
+                    f"gang mutate refused: journaled leadership term "
+                    f"{stored} outranks this writer's term {term}",
+                    journaled_term=stored,
+                    my_term=term,
+                )
+            if meta is None or stored != term:
+                history = list(json.loads(state.get("termHistory", "[]")))
+                history.append(term)
+                cp.prepared_claims[GANG_META_UID] = PreparedClaim(
+                    uid=GANG_META_UID,
+                    namespace="",
+                    name="term",
+                    status=PREPARE_COMPLETED,
+                    groups=[
+                        PreparedDeviceGroup(
+                            devices=[],
+                            config_state={
+                                "term": str(term),
+                                "termHistory": json.dumps(history),
+                            },
+                        )
+                    ],
+                )
+            fn(cp)
+
+        try:
+            self._cp.mutate(fenced, touched=[*touched, GANG_META_UID])
+        except StaleLeader:
+            _STALE_REJECTED.inc()
+            raise
+
+    def fence_state(self) -> tuple[int, list[int]]:
+        """(journaled high-water term, first-commit term history) — what
+        the chaos soak's single-writer invariant audits: the history must
+        be strictly increasing, or a superseded term committed after its
+        successor.  (0, []) before any fenced commit."""
+        rec = self._cp.read_view().prepared_claims.get(GANG_META_UID)
+        state = rec.groups[0].config_state if rec and rec.groups else {}
+        return (
+            int(state.get("term", "0") or 0),
+            list(json.loads(state.get("termHistory", "[]"))),
+        )
+
     # -------------------------------------------------------------- helpers
 
     @staticmethod
@@ -256,6 +381,7 @@ class GangReservationManager:
         bound: list[str],
         extra: Optional[dict] = None,
         traceparent: str = "",
+        term: Optional[int] = None,
     ) -> PreparedClaim:
         return PreparedClaim(
             uid=GANG_UID_PREFIX + gang_id,
@@ -273,6 +399,10 @@ class GangReservationManager:
                         "members": json.dumps([m.to_state() for m in members]),
                         "bound": json.dumps(list(bound)),
                         **({"traceparent": traceparent} if traceparent else {}),
+                        # The reserving term, for audit: the FENCE is the
+                        # gangmeta record (every commit re-checks it); this
+                        # field answers "which leadership created this gang".
+                        **({"term": str(term)} if term is not None else {}),
                         **(extra or {}),
                     },
                 )
@@ -370,13 +500,14 @@ class GangReservationManager:
             cp.prepared_claims[guid] = self._record(
                 gang_id, members, PHASE_RESERVING, [],
                 traceparent=reserve_traceparent,
+                term=self._term,
             )
 
         with trace.start_span(
             "gang.reserve", attrs={"gang": gang_id, "members": len(members)}
         ), self._gang_op(gang_id, "reserve"):
             reserve_traceparent = trace.current_traceparent()
-            self._cp.mutate(start, touched=[guid])
+            self._mutate(start, [guid])
             if cached:
                 return cached[0]
             try:
@@ -445,7 +576,7 @@ class GangReservationManager:
                             state["bound"] = json.dumps(done)
 
                     stage = f"bind journal for claim {member.claim_uid!r}"
-                    self._cp.mutate(journal_bound, touched=[guid])
+                    self._mutate(journal_bound, [guid])
                     # Fires (when armed) after the FIRST member is durably
                     # bound and before the rest: the canonical partial-gang
                     # crash for the sweep, as long as the gang has ≥2 members.
@@ -472,7 +603,7 @@ class GangReservationManager:
             state.pop("target", None)
             state.pop("degradedReason", None)
 
-        self._cp.mutate(complete, touched=[guid])
+        self._mutate(complete, [guid])
 
     # ------------------------------------------------------------- rollback
 
@@ -499,7 +630,7 @@ class GangReservationManager:
             rec.status = PREPARE_STARTED
             rec.groups[0].config_state["phase"] = phase
 
-        self._cp.mutate(mark, touched=[guid])
+        self._mutate(mark, [guid])
         failures: list[str] = []
         first = True
         for member in reversed(members):
@@ -525,7 +656,7 @@ class GangReservationManager:
             def drop(cp: Checkpoint) -> None:
                 cp.prepared_claims.pop(guid, None)
 
-            self._cp.mutate(drop, touched=[guid])
+            self._mutate(drop, [guid])
         else:
             def clear_bound(cp: Checkpoint) -> None:
                 rec = cp.prepared_claims.get(guid)
@@ -533,7 +664,7 @@ class GangReservationManager:
                     return
                 rec.groups[0].config_state["bound"] = json.dumps([])
 
-            self._cp.mutate(clear_bound, touched=[guid])
+            self._mutate(clear_bound, [guid])
 
     def release(self, gang_id: str) -> None:
         """Tear down a bound gang (workload done): unbind every member,
@@ -582,7 +713,7 @@ class GangReservationManager:
                 state["degradedReason"] = reason
             changed.append(True)
 
-        self._cp.mutate(mark, touched=[guid])
+        self._mutate(mark, [guid])
         if changed:
             logger.warning(
                 "gang %s marked degraded (%s): unhealthy members %s",
@@ -657,7 +788,7 @@ class GangReservationManager:
                     "replaced": sorted(replacements),
                 },
             ):
-                self._cp.mutate(plan, touched=[guid])
+                self._mutate(plan, [guid])
                 if not planned:
                     raise GangBindError(
                         f"gang {gang_id!r} record vanished before the "
@@ -735,7 +866,7 @@ class GangReservationManager:
             state = rec.groups[0].config_state
             state["members"] = json.dumps([m.to_state() for m in target])
 
-        self._cp.mutate(retarget, touched=[guid])
+        self._mutate(retarget, [guid])
         self._complete(guid)
 
     # ------------------------------------------------------------- recovery

@@ -1,0 +1,488 @@
+"""Lease-based controller leader election with fencing tokens.
+
+PAPER.md's reference architecture runs the controller as a Deployment
+whose replicas coordinate through ``coordination.k8s.io`` Lease leader
+election (the controller-runtime manager every reference controller
+embeds).  This module is that discipline for our controller: one
+:class:`LeaseElector` per candidate replica, all competing for one Lease
+object through the shared :class:`~tpudra.kube.client.KubeAPI` protocol
+(FakeKube in the harnesses, the real apiserver in production).
+
+The algorithm is client-go's ``leaderelection`` package, including its one
+subtle-but-load-bearing choice: **expiry is judged by the observer's own
+monotonic clock**, never by comparing the record's timestamps against
+local wall time.  A candidate remembers *when it last saw the lease
+record change* (resourceVersion transition) and treats the lease as
+expired only after ``lease_duration_s`` of no observed change — so two
+replicas with skewed wall clocks cannot steal a live leader's lease, and
+the chaos soak's ``clock_skew`` fault cannot manufacture split-brain.
+
+**Fencing tokens.**  Every acquisition bumps the Lease's
+``leaseTransitions`` counter — on EVERY term change, not just holder
+changes — and that monotonic value is the term handed to
+``on_started_leading(term)``.  The term is the fence: the gang manager
+journals it into the checkpoint WAL and refuses commits from any term
+below the journaled high-water mark (``controller/gang.py`` StaleLeader),
+so even a lease layer gone wrong (a paused-then-revived leader that still
+*believes* it leads) cannot corrupt gang state.  Lease-based mutual
+exclusion alone is famously insufficient exactly because of that revival
+window; the fence is what makes leadership a safety property instead of a
+probabilistic one.
+
+**Outage behavior.**  Renew failures retry on the shared full-jitter
+:class:`~tpudra.backoff.Backoff` and honor any 429/503 ``Retry-After``
+hint as a floor.  Leadership is *held through the grace window*: the
+candidate keeps acting as leader until ``lease_duration_s`` has elapsed
+since its last successful renew — the instant a rival could legitimately
+take the lease — then calls ``on_stopped_leading`` and demotes itself.
+An apiserver outage shorter than the grace window therefore costs nothing
+but retries; a longer one parks the controller, and the first renew after
+recovery either re-establishes the hold or observes the new holder.
+
+Lock discipline: ``lease.state_lock`` guards only in-memory bookkeeping
+(leader flag, observation timestamps) and is never held across an
+apiserver verb — acquire/renew run lock-free and publish their outcome
+under the lock afterwards (docs/lock-order.md).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tpudra import lockwitness, metrics
+from tpudra.backoff import Backoff
+from tpudra.kube import errors, gvr
+from tpudra.kube.client import KubeAPI
+
+logger = logging.getLogger(__name__)
+
+#: Default Lease object name — one per controller deployment, the way the
+#: reference's controller-runtime manager names its election lock.
+DEFAULT_LEASE_NAME = "tpudra-controller"
+
+
+def _now_rfc3339() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+@dataclass
+class _Observation:
+    """What this candidate last saw on the lease record, and WHEN (its own
+    monotonic clock) — the only clock expiry is ever judged by."""
+
+    resource_version: str = ""
+    holder: str = ""
+    transitions: int = 0
+    seen_at: float = 0.0  # time.monotonic()
+
+
+class LeaseElector:
+    """One candidate in the controller's leader election.
+
+    ``on_started_leading(term)`` / ``on_stopped_leading()`` run on the
+    elector's own thread, in order; a candidate that re-acquires after a
+    loss gets a strictly larger ``term``.  ``start(stop)`` spawns the
+    loop; :meth:`release` hands the lease off gracefully (shutdown);
+    :meth:`crash` kills the loop WITHOUT touching the lease — the
+    SIGKILL-shaped stop the chaos soak's failover fault uses, leaving the
+    standby to wait out the full expiry window like a real crash would.
+    """
+
+    def __init__(
+        self,
+        kube: KubeAPI,
+        identity: str = "",
+        name: str = DEFAULT_LEASE_NAME,
+        namespace: str = "tpudra-system",
+        lease_duration_s: float = 15.0,
+        renew_interval_s: float = 5.0,
+        on_started_leading: Optional[Callable[[int], None]] = None,
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        rng=None,
+    ):
+        if renew_interval_s >= lease_duration_s:
+            raise ValueError(
+                "renew_interval_s must be < lease_duration_s (a candidate "
+                "that renews slower than expiry loses its own lease)"
+            )
+        self._kube = kube
+        self.identity = identity or f"tpudra-{uuid.uuid4().hex[:8]}"
+        self._name = name
+        self._namespace = namespace
+        self.lease_duration_s = lease_duration_s
+        self.renew_interval_s = renew_interval_s
+        self._on_started = on_started_leading
+        self._on_stopped = on_stopped_leading
+        # Retry pacing for failed acquire/renew attempts: full jitter on
+        # the shared policy so N candidates hammered by one apiserver flap
+        # do not march back in lockstep (tpudra/backoff.py).
+        self._backoff = Backoff(
+            max(0.05, renew_interval_s / 4.0), lease_duration_s, rng=rng
+        )
+        self._rng = rng if rng is not None else random
+        self._state_lock = lockwitness.make_lock("lease.state_lock")
+        self._is_leader = False
+        self._term = 0
+        self._last_renew = 0.0  # monotonic; last SUCCESSFUL acquire/renew
+        self._obs = _Observation()
+        #: Highest leaseTransitions this candidate has EVER observed —
+        #: survives the Lease object being deleted and recreated (the
+        #: operator's force-failover move): minted terms are floored on
+        #: it, so a recreated lease cannot restart the fencing sequence
+        #: at 1 and fence the new leader out of its own WAL.
+        self._max_seen_transitions = 0
+        self._crashed = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._gauge = metrics.LEADER_IS_LEADER.labels(self.identity)
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def is_leader(self) -> bool:
+        with self._state_lock:
+            return self._is_leader
+
+    @property
+    def term(self) -> int:
+        """The fencing token of the CURRENT term (0 before first
+        acquisition; stale once leadership is lost)."""
+        with self._state_lock:
+            return self._term
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self, stop: threading.Event) -> threading.Thread:
+        self._thread = threading.Thread(
+            target=self.run,
+            args=(stop,),
+            daemon=True,
+            name=f"lease-elector-{self.identity}",
+        )
+        self._thread.start()
+        return self._thread
+
+    def crash(self) -> None:
+        """SIGKILL-shaped stop: the loop ends as soon as it notices, the
+        lease is left EXACTLY as it stands (held, un-released), and no
+        ``on_stopped_leading`` fires — the process is 'gone'.  A standby
+        must wait out the full ``lease_duration_s`` expiry window, the
+        real crash-failover cost docs/ha.md quantifies.  The leadership
+        gauge zeroes: a dead process exports nothing, and the in-process
+        harnesses (soak, bench) run many failovers in one registry — a
+        stuck 1 per dead identity would fake concurrent leaders."""
+        self._crashed.set()
+        self._gauge.set(0)
+
+    def run(self, stop: threading.Event) -> None:
+        """The candidate loop: acquire when the lease is free or expired,
+        renew while holding, demote when the grace window closes."""
+        try:
+            while not stop.is_set() and not self._crashed.is_set():
+                if self.is_leader:
+                    self._renew_once(stop)
+                else:
+                    self._acquire_once(stop)
+        finally:
+            if (
+                self.is_leader
+                and not self._crashed.is_set()
+            ):
+                self.release()
+
+    def release(self) -> None:
+        """Graceful handoff: clear the holder so a standby acquires
+        immediately instead of waiting out expiry.  Demotes first (the
+        callback ordering contract: we stop ACTING before anyone else can
+        start)."""
+        was_leader = self._demote(reason="released")
+        if not was_leader:
+            return
+        metrics.LEADER_ELECTIONS_TOTAL.labels("released").inc()
+        try:
+            lease = self._kube.get(gvr.LEASES, self._name, self._namespace)
+            spec = lease.setdefault("spec", {})
+            if spec.get("holderIdentity") != self.identity:
+                return  # someone already took it; nothing to hand off
+            spec["holderIdentity"] = ""
+            spec["renewTime"] = _now_rfc3339()
+            self._kube.update(gvr.LEASES, lease, self._namespace)
+        except errors.ApiError as e:
+            # Expiry hands it off anyway, just slower.
+            logger.info("lease release failed (expiry will cover): %s", e)
+
+    def advance_term(self, min_term: int) -> int:
+        """Bump the HELD lease's transitions counter to ``min_term`` and
+        adopt it — the repair for a deleted-and-recreated Lease whose
+        restarted numbering minted a term at or below a fence's journaled
+        high-water (docs/ha.md): the holder pushes the counter past
+        history so fencing resumes above it.  CAS-guarded (holder must
+        still be this identity); raises ApiError on failure, Conflict
+        when the lease is no longer ours."""
+        lease = self._kube.get(gvr.LEASES, self._name, self._namespace)
+        spec = lease.setdefault("spec", {})
+        if (spec.get("holderIdentity", "") or "") != self.identity:
+            raise errors.Conflict(
+                f"lease {self._name} no longer held by {self.identity}"
+            )
+        current = int(spec.get("leaseTransitions", 0) or 0)
+        term = max(min_term, current)
+        if term > current:
+            spec["leaseTransitions"] = term
+            spec["renewTime"] = _now_rfc3339()
+            updated = self._kube.update(gvr.LEASES, lease, self._namespace)
+            self._observe(updated)
+        with self._state_lock:
+            self._term = term
+            self._max_seen_transitions = max(
+                self._max_seen_transitions, term
+            )
+        logger.warning(
+            "lease %s: %s advanced fencing term to %d (recreated-lease "
+            "repair)", self._name, self.identity, term,
+        )
+        return term
+
+    # ------------------------------------------------------------ acquire
+
+    def _observe(self, lease: dict) -> None:
+        """Record the lease state + our OWN monotonic read time; the
+        expiry judgment below only ever compares against this."""
+        rv = lease.get("metadata", {}).get("resourceVersion", "")
+        with self._state_lock:
+            transitions = int(
+                lease.get("spec", {}).get("leaseTransitions", 0) or 0
+            )
+            self._max_seen_transitions = max(
+                self._max_seen_transitions, transitions
+            )
+            if rv != self._obs.resource_version:
+                spec = lease.get("spec", {})
+                self._obs = _Observation(
+                    resource_version=rv,
+                    holder=spec.get("holderIdentity", "") or "",
+                    transitions=transitions,
+                    seen_at=time.monotonic(),
+                )
+
+    def _observed_expired(self) -> bool:
+        with self._state_lock:
+            obs = self._obs
+        if not obs.resource_version:
+            return False  # never seen it: creation path handles absence
+        if not obs.holder:
+            return True  # released: free for the taking
+        return time.monotonic() - obs.seen_at > self.lease_duration_s
+
+    def _acquire_once(self, stop: threading.Event) -> None:
+        try:
+            acquired = self._try_acquire()
+        except Exception as e:  # noqa: BLE001 — transport faults (raw URLError
+            # included: the real client only types HTTP-level failures) must
+            # not kill the candidate loop; the backoff paces the retry.
+            logger.warning("lease %s: acquire attempt failed: %s", self._name, e)
+            self._wait(stop, self._failure_delay(e))
+            return
+        self._backoff.reset()
+        if acquired:
+            return
+        # Someone else holds a live lease: poll again around the renew
+        # cadence (jittered so N standbys don't GET in lockstep).
+        self._wait(
+            stop,
+            self.renew_interval_s * (0.5 + 0.5 * self._rng.random()),
+        )
+
+    def _try_acquire(self) -> bool:
+        """One acquisition attempt.  Returns True on success; raises
+        ApiError on transport failure; False when a live holder stands."""
+        try:
+            lease = self._kube.get(gvr.LEASES, self._name, self._namespace)
+        except errors.NotFound:
+            lease = None
+        if lease is None:
+            # A deleted-and-recreated Lease must not restart the fencing
+            # sequence: mint past everything this candidate ever saw.
+            with self._state_lock:
+                minted = self._max_seen_transitions + 1
+            body = {
+                "apiVersion": gvr.LEASES.api_version,
+                "kind": gvr.LEASES.kind,
+                "metadata": {"name": self._name, "namespace": self._namespace},
+                "spec": {
+                    "holderIdentity": self.identity,
+                    "leaseDurationSeconds": int(
+                        max(1, round(self.lease_duration_s))
+                    ),
+                    "acquireTime": _now_rfc3339(),
+                    "renewTime": _now_rfc3339(),
+                    "leaseTransitions": minted,
+                },
+            }
+            try:
+                created = self._kube.create(
+                    gvr.LEASES, body, self._namespace
+                )
+            except errors.AlreadyExists:
+                return False  # lost the creation race; observe next pass
+            self._observe(created)
+            self._promote(minted)
+            return True
+        self._observe(lease)
+        spec = lease.get("spec", {})
+        holder = spec.get("holderIdentity", "") or ""
+        if holder != self.identity and not self._observed_expired():
+            return False
+        # Free, expired, or already ours (a restart re-finding its own
+        # lease takes a FRESH term: the old incarnation's journaled term
+        # must not fence the new one out).  Floored on the highest count
+        # this candidate ever observed — a recreated lease's restarted
+        # numbering never regresses a term.
+        with self._state_lock:
+            floor = self._max_seen_transitions
+        transitions = max(int(spec.get("leaseTransitions", 0) or 0), floor) + 1
+        spec.update(
+            {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": int(
+                    max(1, round(self.lease_duration_s))
+                ),
+                "acquireTime": _now_rfc3339(),
+                "renewTime": _now_rfc3339(),
+                "leaseTransitions": transitions,
+            }
+        )
+        try:
+            updated = self._kube.update(gvr.LEASES, lease, self._namespace)
+        except errors.Conflict:
+            return False  # a rival's write landed first; observe next pass
+        self._observe(updated)
+        self._promote(transitions)
+        return True
+
+    # -------------------------------------------------------------- renew
+
+    def _renew_once(self, stop: threading.Event) -> None:
+        """One renew CYCLE: wait the interval, then renew — retrying on
+        the backoff alone until it lands or the grace window closes.  The
+        retries must NOT pay the interval again (a failure already spent
+        time): stacking interval + backoff per attempt would burn a grace
+        window sized for backoff-paced retries and demote on outages the
+        grace was meant to absorb."""
+        self._wait(stop, self.renew_interval_s)
+        while not stop.is_set() and not self._crashed.is_set():
+            try:
+                lease = self._kube.get(gvr.LEASES, self._name, self._namespace)
+                spec = lease.get("spec", {})
+                if (spec.get("holderIdentity", "") or "") != self.identity:
+                    # Someone took it (our grace lapsed during an outage and
+                    # a rival acquired): demote NOW, before anything runs.
+                    self._observe(lease)
+                    self._demote(reason="lease taken by " + (
+                        spec.get("holderIdentity") or "nobody"
+                    ))
+                    metrics.LEADER_ELECTIONS_TOTAL.labels("lost").inc()
+                    return
+                spec["renewTime"] = _now_rfc3339()
+                updated = self._kube.update(gvr.LEASES, lease, self._namespace)
+                self._observe(updated)
+                with self._state_lock:
+                    self._last_renew = time.monotonic()
+                self._backoff.reset()
+                return
+            except errors.NotFound:
+                # The Lease object is GONE — the operator's force-failover
+                # move (kubectl delete lease).  A standby recreates it and
+                # leads within one poll; riding the grace window here would
+                # keep TWO actors dispatching unfenced writes for up to
+                # lease_duration_s.  Demote NOW and let the candidate loop
+                # re-acquire (the recreated-lease term floor keeps the
+                # fencing sequence monotonic either way).
+                self._demote(reason="lease deleted out from under the holder")
+                metrics.LEADER_ELECTIONS_TOTAL.labels("lost").inc()
+                return
+            except Exception as e:  # noqa: BLE001 — transport faults (raw
+                # URLError included) must not kill the loop; the grace
+                # arithmetic owns whether the failure costs leadership.
+                metrics.LEADER_ELECTIONS_TOTAL.labels("renew-failed").inc()
+                with self._state_lock:
+                    grace_left = self.lease_duration_s - (
+                        time.monotonic() - self._last_renew
+                    )
+                if grace_left <= 0:
+                    # The instant a rival could legitimately acquire: stop
+                    # acting.  (The fence catches us if we misjudge.)
+                    logger.warning(
+                        "lease %s: renew failing past the grace window (%s); "
+                        "demoting", self._name, e,
+                    )
+                    self._demote(reason=f"grace expired during outage: {e}")
+                    metrics.LEADER_ELECTIONS_TOTAL.labels("lost").inc()
+                    return
+                delay = min(self._failure_delay(e), max(0.05, grace_left / 2))
+                logger.info(
+                    "lease %s: renew failed (%s); %0.1fs grace left, "
+                    "retrying in %.2fs", self._name, e, grace_left, delay,
+                )
+                self._wait(stop, delay)
+
+    # ----------------------------------------------------------- internals
+
+    def _failure_delay(self, e: Exception) -> float:
+        delay = self._backoff.next_delay()
+        retry_after = errors.retry_after_of(e)
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        return delay
+
+    def _wait(self, stop: threading.Event, seconds: float) -> None:
+        deadline = time.monotonic() + max(0.0, seconds)
+        while not stop.is_set() and not self._crashed.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            stop.wait(min(0.05, remaining))
+
+    def _promote(self, term: int) -> None:
+        if self._crashed.is_set():
+            # crash() landed while the acquire verb was in flight and the
+            # write won the race: the lease IS held by this identity —
+            # exactly a process dying right after its write hit the wire,
+            # so the standby pays the full expiry window — but a "dead"
+            # incarnation must not start acting, fire callbacks, or raise
+            # the leadership gauge back to 1 for a gone identity.
+            return
+        with self._state_lock:
+            self._is_leader = True
+            self._term = term
+            self._last_renew = time.monotonic()
+        self._gauge.set(1)
+        metrics.LEADER_ELECTIONS_TOTAL.labels("acquired").inc()
+        logger.info(
+            "lease %s: %s acquired leadership (term %d)",
+            self._name, self.identity, term,
+        )
+        if self._on_started is not None:
+            self._on_started(term)
+
+    def _demote(self, reason: str) -> bool:
+        """Flip to follower; returns whether we WERE leader (callbacks and
+        metrics fire only on the edge)."""
+        with self._state_lock:
+            was = self._is_leader
+            self._is_leader = False
+        if not was:
+            return False
+        self._gauge.set(0)
+        logger.warning(
+            "lease %s: %s lost leadership (%s)",
+            self._name, self.identity, reason,
+        )
+        if self._on_stopped is not None:
+            self._on_stopped()
+        return True

@@ -194,7 +194,18 @@ class KubeClient:
                 status = json.loads(payload)
             except (ValueError, TypeError):
                 status = {"message": payload.decode(errors="replace")}
-            raise errors.from_status(status, e.code) from None
+            err = errors.from_status(status, e.code)
+            # A 429/503's Retry-After hint travels on the typed error so
+            # retry loops can floor their backoff on it — clamped to the
+            # caller's remaining ambient deadline: a hint that outlives
+            # the budget is an instruction to fail, not to wait.
+            retry_after = errors.parse_retry_after(e.headers.get("Retry-After"))
+            if retry_after is not None and hasattr(err, "retry_after_s"):
+                rem = deadline.remaining()
+                if rem is not None:
+                    retry_after = min(retry_after, max(0.0, rem))
+                err.retry_after_s = retry_after
+            raise err from None
         except TimeoutError as e:
             raise errors.Timeout(
                 f"{method} {path}: no response within {effective:.1f}s"

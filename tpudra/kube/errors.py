@@ -75,11 +75,51 @@ class Expired(ApiError):
     reason = "Expired"
 
 
+class TooManyRequests(ApiError):
+    """429 — the apiserver is shedding load (apimachinery's
+    StatusReasonTooManyRequests; API Priority and Fairness rejections,
+    max-inflight overflow).  Carries the server's ``Retry-After`` hint in
+    ``retry_after_s`` (None when the server sent none): retry loops must
+    wait AT LEAST that long — but still through the shared full-jitter
+    :class:`tpudra.backoff.Backoff`, so a storm of 429'd clients does not
+    march back in lockstep at exactly the hinted second."""
+
+    code = 429
+    reason = "TooManyRequests"
+
+    def __init__(self, message: str = "", retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class ServiceUnavailable(ApiError):
+    """503 — the apiserver (or what fronts it) cannot serve at all right
+    now: rolling restart, etcd quorum loss, a dead load-balancer backend.
+    The shape a full outage window presents to every client.  May carry a
+    ``Retry-After`` hint like 429."""
+
+    code = 503
+    reason = "ServiceUnavailable"
+
+    def __init__(self, message: str = "", retry_after_s: "float | None" = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class InternalError(ApiError):
+    """500 — the server blew up mid-request (apimachinery's
+    StatusReasonInternalError).  Distinct from the ApiError base only so
+    injected 500 storms and parsed statuses round-trip a stable reason."""
+
+    code = 500
+    reason = "InternalError"
+
+
 _BY_REASON = {
     cls.reason: cls
     for cls in (
         NotFound, AlreadyExists, Conflict, Invalid, BadRequest, Forbidden,
-        Expired, Timeout,
+        Expired, Timeout, TooManyRequests, ServiceUnavailable,
     )
 }
 
@@ -96,6 +136,63 @@ def from_status(status: dict, http_code: int) -> ApiError:
             400: BadRequest,
             403: Forbidden,
             410: Expired,
+            429: TooManyRequests,
+            500: InternalError,
+            503: ServiceUnavailable,
             504: Timeout,
         }.get(http_code, ApiError)
-    return cls(message)
+    err = cls(message)
+    if cls is ApiError and http_code:
+        # Untyped failure (unmapped reason AND code — 401, 413, ...):
+        # carry the REAL transport code.  The class default (500) would
+        # make is_retryable() blind-retry permanent failures through the
+        # whole backoff schedule.
+        err.code = http_code
+    return err
+
+
+#: Codes a client may retry blindly (after backoff): the request failed for
+#: server-side capacity/availability reasons, not because of anything about
+#: the request itself.  409 Conflict is deliberately absent — retrying a
+#: conflicted write without re-reading re-submits stale state.
+RETRYABLE_CODES = frozenset({429, 500, 503, 504})
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """True when ``exc`` is an ApiError a retry loop should simply retry
+    (through the shared backoff policy) rather than surface."""
+    return isinstance(exc, ApiError) and exc.code in RETRYABLE_CODES
+
+
+def retry_after_of(exc: BaseException) -> "float | None":
+    """The server's Retry-After hint carried by ``exc`` (429/503), or None.
+    Callers take ``max(backoff_delay, retry_after_of(e) or 0)`` — the hint
+    is a FLOOR under the jittered delay, never a replacement for it."""
+    ra = getattr(exc, "retry_after_s", None)
+    if ra is None:
+        return None
+    try:
+        ra = float(ra)
+    except (TypeError, ValueError):
+        return None
+    return ra if ra >= 0 else None
+
+
+def parse_retry_after(value: "str | None") -> "float | None":
+    """Parse an HTTP ``Retry-After`` header value: delta-seconds per RFC
+    9110 (the only form apiservers emit).  HTTP-date values, garbage, and
+    non-finite floats ("inf", "1e999" — which would turn every delay
+    floor into a forever-sleep) return None — a hint too mangled to trust
+    is no hint."""
+    if not value:
+        return None
+    value = value.strip()
+    try:
+        seconds = float(value)
+    except ValueError:
+        return None
+    import math
+
+    if not math.isfinite(seconds) or seconds < 0:
+        return None
+    return seconds

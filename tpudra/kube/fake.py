@@ -36,6 +36,7 @@ import queue
 import threading
 import time
 import uuid as uuidlib
+from dataclasses import dataclass
 from typing import Callable, Iterator, Optional
 
 from tpudra.kube import deadline, errors
@@ -92,6 +93,112 @@ def match_field_selector(selector: str | None, obj: dict) -> bool:
         if (cur or "") != v.strip():
             return False
     return True
+
+
+@dataclass
+class _ErrorRule:
+    """One injected-failure rule: ``verb`` ("get"/"update"/... or "*") ×
+    ``gvr_key`` (FakeKube._key form, or None for every resource) failing
+    with HTTP ``code`` (429/500/503), ``times`` more times (None =
+    sustained until the plan is cleared/healed), optionally carrying a
+    ``Retry-After`` hint."""
+
+    verb: str = "*"
+    gvr_key: Optional[str] = None
+    code: int = 503
+    times: Optional[int] = None
+    retry_after_s: Optional[float] = None
+    message: str = ""
+
+
+class ApiErrorPlan:
+    """Per-verb × per-GVR apiserver error injection for :class:`FakeKube`
+    — the refusal counterpart of ``set_latency`` (which only delays).  The
+    chaos soak's ``apiserver_outage`` fault installs one to manufacture
+    the failure mode real apiservers exhibit most: 429-with-Retry-After
+    load shedding, 500 storms, and full 503 outage windows (fail-once and
+    sustained).  Thread-safe; ``injected`` counts the failures actually
+    delivered so an injector can assert its storm landed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: list[_ErrorRule] = []
+        self._outage = False
+        self._outage_retry_after: Optional[float] = None
+        self.injected = 0
+
+    def fail(
+        self,
+        verb: str = "*",
+        gvr: Optional[GVR] = None,
+        code: int = 503,
+        times: Optional[int] = None,
+        retry_after_s: Optional[float] = None,
+        message: str = "",
+    ) -> "ApiErrorPlan":
+        """Add one rule; returns self for chaining."""
+        if code not in (429, 500, 503):
+            raise ValueError(f"unsupported injected error code {code}")
+        with self._lock:
+            self._rules.append(
+                _ErrorRule(
+                    verb=verb,
+                    gvr_key=None if gvr is None else FakeKube._key(gvr),
+                    code=code,
+                    times=times,
+                    retry_after_s=retry_after_s,
+                    message=message,
+                )
+            )
+        return self
+
+    def outage(self, retry_after_s: Optional[float] = None) -> "ApiErrorPlan":
+        """Every request verb on every resource fails 503 until
+        :meth:`heal` — the full-outage window.  (Watch streams are closed
+        separately via ``FakeKube.close_watches``: a dead apiserver drops
+        both, but they are distinct injectors so tests can exercise each
+        recovery path alone.)"""
+        with self._lock:
+            self._outage = True
+            self._outage_retry_after = retry_after_s
+        return self
+
+    def heal(self) -> None:
+        """Drop every rule and close the outage window."""
+        with self._lock:
+            self._rules.clear()
+            self._outage = False
+            self._outage_retry_after = None
+
+    def _error_for(self, verb: str, gvr_key: str) -> Optional[errors.ApiError]:
+        with self._lock:
+            if self._outage:
+                self.injected += 1
+                return errors.ServiceUnavailable(
+                    f"injected outage: {verb} refused",
+                    retry_after_s=self._outage_retry_after,
+                )
+            for rule in self._rules:
+                if rule.verb not in (verb, "*"):
+                    continue
+                if rule.gvr_key is not None and rule.gvr_key != gvr_key:
+                    continue
+                if rule.times is not None:
+                    if rule.times <= 0:
+                        continue
+                    rule.times -= 1
+                self.injected += 1
+                message = rule.message or f"injected {rule.code}: {verb} refused"
+                if rule.code == 429:
+                    return errors.TooManyRequests(
+                        message, retry_after_s=rule.retry_after_s
+                    )
+                if rule.code == 503:
+                    return errors.ServiceUnavailable(
+                        message, retry_after_s=rule.retry_after_s
+                    )
+                return errors.InternalError(message)
+        return None
 
 
 def _expired_event(message: str) -> dict:
@@ -164,6 +271,7 @@ class FakeKube:
         self._watchers: list[_Watcher] = []
         self._reactors: list[tuple[str, str, Callable]] = []  # (verb, gvr_key, fn)
         self._latency_s = 0.0
+        self._error_plan: Optional[ApiErrorPlan] = None
         self._watch_queue_depth = int(watch_queue_depth)
         self._watch_history_limit = int(watch_history_limit)
         #: rv of the newest event dropped by history compaction — resumes
@@ -213,6 +321,13 @@ class FakeKube:
                 self.watch_stats["forced_closes"] += 1
         return len(targets)
 
+    def set_error_plan(self, plan: Optional[ApiErrorPlan]) -> None:
+        """Install (or clear, with None) an error-injection plan.  Every
+        request verb consults it AFTER the latency/deadline simulation —
+        a 429 storm during a latency spike costs the RTT and then the
+        refusal, exactly like a slow-then-shedding real apiserver."""
+        self._error_plan = plan
+
     def set_latency(self, seconds: float) -> None:
         """Simulate apiserver round-trip time: every request verb (not
         watch delivery) sleeps ``seconds`` before executing, while holding
@@ -246,6 +361,11 @@ class FakeKube:
                 time.sleep(self._latency_s)
             elif rem is not None and rem <= 0:
                 raise errors.Timeout(f"{verb}: deadline already exceeded")
+            plan = self._error_plan
+            if plan is not None:
+                err = plan._error_for(verb, self._key(gvr))
+                if err is not None:
+                    raise err
         for v, key, fn in self._reactors:
             if v in (verb, "*") and key == self._key(gvr):
                 fn(verb, gvr, obj)

@@ -55,6 +55,10 @@ SERVICES = GVR("", "v1", "services", "Service")
 DAEMONSETS = GVR("apps", "v1", "daemonsets", "DaemonSet")
 DEPLOYMENTS = GVR("apps", "v1", "deployments", "Deployment")
 
+# -- coordination.k8s.io ----------------------------------------------------
+
+LEASES = GVR("coordination.k8s.io", "v1", "leases", "Lease")
+
 # -- resource.k8s.io (DRA) --------------------------------------------------
 
 RESOURCE_CLAIMS = GVR("resource.k8s.io", "v1", "resourceclaims", "ResourceClaim")
@@ -83,6 +87,7 @@ ALL_GVRS = [
     SERVICES,
     DAEMONSETS,
     DEPLOYMENTS,
+    LEASES,
     RESOURCE_CLAIMS,
     RESOURCE_CLAIM_TEMPLATES,
     RESOURCE_SLICES,

@@ -56,16 +56,27 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # silence request logging
         pass
 
-    def _send_json(self, code: int, obj: dict) -> None:
+    def _send_json(
+        self, code: int, obj: dict, extra_headers: dict | None = None
+    ) -> None:
         payload = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(payload)
 
     def _send_error(self, e: errors.ApiError) -> None:
-        self._send_json(e.code, e.to_status())
+        # 429/503 carry the server's backpressure hint the way a real
+        # apiserver does — as a Retry-After header, so KubeClient's parse
+        # path is exercised end-to-end over this frontend.
+        headers = None
+        retry_after = getattr(e, "retry_after_s", None)
+        if retry_after is not None:
+            headers = {"Retry-After": f"{float(retry_after):g}"}
+        self._send_json(e.code, e.to_status(), extra_headers=headers)
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))

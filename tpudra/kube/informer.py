@@ -229,6 +229,12 @@ class Informer:
             except Exception as e:  # noqa: BLE001 — informer must survive apiserver blips
                 self._watch_ok = False
                 delay = self._relist_backoff.next_delay()
+                # A 429/503's Retry-After hint floors the jittered delay:
+                # the server asked for AT LEAST that much quiet, and
+                # relisting into its shed window only re-feeds the storm.
+                retry_after = errors.retry_after_of(e)
+                if retry_after is not None:
+                    delay = max(delay, retry_after)
                 logger.warning(
                     "informer %s: list/watch failed: %s; re-listing in %.1fs",
                     self._gvr.resource, e, delay,

@@ -269,8 +269,9 @@ SOAK_FAULTS_INJECTED_TOTAL = Counter(
     "tpudra_soak_faults_injected_total",
     "Faults injected by the chaos soak (sim/chaos.py), by kind: "
     "apiserver_latency, watch_close, kubelet_restart, plugin_crash, "
-    "torn_wal, clock_skew, cd_wave, chip_fault, daemon_crash — the "
-    "denominator every soak SLO is asserted against",
+    "torn_wal, clock_skew, cd_wave, chip_fault, daemon_crash, "
+    "disk_fault, partition_fault, apiserver_outage, controller_failover "
+    "— the denominator every soak SLO is asserted against",
     ["kind"],
 )
 SOAK_INVARIANT_CHECKS_TOTAL = Counter(
@@ -278,8 +279,9 @@ SOAK_INVARIANT_CHECKS_TOTAL = Counter(
     "Continuous invariant evaluations by the soak's monitor thread, by "
     "invariant (claim-stuck, cdi-leak, flock-leak, slice-convergence, "
     "lock-witness, gang-atomicity, slice-health, gang-degraded, "
-    "grant-health) and result (ok / violation) — a healthy soak is all "
-    "ok with a nonzero check count per invariant",
+    "grant-health, single-writer, leadership-liveness, ...) and result "
+    "(ok / violation) — a healthy soak is all ok with a nonzero check "
+    "count per invariant",
     ["invariant", "result"],
 )
 CLAIM_HEALTH_ESCALATIONS = Counter(
@@ -315,6 +317,32 @@ GANG_RESERVATIONS_TOTAL = Counter(
     "to none-bound at controller start), released (a bound gang torn "
     "down) — controller/gang.py",
     ["outcome"],
+)
+GANG_STALE_LEADER_REJECTIONS = Counter(
+    "tpudra_gang_stale_leader_rejections_total",
+    "Gang-record mutates refused at the CHECKPOINT layer because the "
+    "journaled leadership term outranks the writer's fencing token "
+    "(controller/gang.py StaleLeader) — every count is a split-brain "
+    "write that the lease layer failed to prevent and the WAL fence "
+    "stopped from corrupting gang state",
+)
+LEADER_ELECTIONS_TOTAL = Counter(
+    "tpudra_leader_elections_total",
+    "Leader-election lifecycle transitions (controller/lease.py), by "
+    "outcome: acquired (this candidate took the lease and got a fresh "
+    "fencing term), lost (the lease expired or another holder took it "
+    "before a renew landed), released (graceful handoff at shutdown), "
+    "renew-failed (one renew attempt failed; leadership held through the "
+    "grace window)",
+    ["outcome"],
+)
+LEADER_IS_LEADER = Gauge(
+    "tpudra_leader_is_leader",
+    "1 while this candidate holds the controller lease, by candidate "
+    "identity (identity-labeled because tests and the chaos soak run "
+    "several candidates in one process; a single unlabeled gauge would "
+    "let one replica's loss mask another's hold)",
+    ["identity"],
 )
 GANG_BIND_SECONDS = Histogram(
     "tpudra_gang_bind_seconds",

@@ -57,6 +57,24 @@ implies, and this soak is its hermetic reproduction:
                        to zero live partitions and zero records, and the
                        monitor's partition-leak invariant holds the
                        record ⟷ hardware bijection in quiet windows
+  apiserver_outage     an error plan (``FakeKube.set_error_plan``) makes
+                       the apiserver REFUSE — sustained 429-with-
+                       Retry-After shedding, 500/503 storms, a fail-once
+                       blip, or a full outage window with every watch
+                       stream force-closed — composed with whatever
+                       latency/disk windows are open; recovery asserts
+                       every informer back on a live watch and a fresh
+                       bind granted, with every retry routed through the
+                       shared backoff honoring the Retry-After floor
+  controller_failover  the LEADING controller dies mid-gang-reserve
+                       (armed crash + checkpoint abandon + lease elector
+                       crash), a standby replica waits out lease expiry
+                       and acquires with a strictly larger fencing term,
+                       a fresh gang manager converges the gang
+                       all-or-nothing under the new term, and a
+                       deliberately-REVIVED stale leader's commit must be
+                       refused at the checkpoint layer (StaleLeader,
+                       counted in the report)
   disk_fault           a storage fault plan (tpudra/storage.py) is
                        installed against ONE node's checkpoint + CDI dirs
                        — ENOSPC on writes, EIO on fsync (fsyncgate),
@@ -150,7 +168,28 @@ FAULT_KINDS = (
     "daemon_crash",
     "disk_fault",
     "partition_fault",
+    "apiserver_outage",
+    "controller_failover",
 )
+
+#: apiserver_outage variants — how the apiserver REFUSES (docs/ha.md):
+#: sustained 429-with-Retry-After load shedding, 500 storms, 503 fronting
+#: failures, a fail-once 429 blip, or a full outage window (every verb
+#: 503 plus forced watch closes).
+APISERVER_OUTAGE_VARIANTS = (
+    "storm_429",
+    "storm_500",
+    "storm_503",
+    "fail_once_429",
+    "full_outage",
+)
+
+#: Failover-stack lease timings, in WALL seconds: the lease layer runs in
+#: real time (its expiry judgment is the candidates' own monotonic
+#: clocks), so these are NOT sim-scaled — at the default 60x they read
+#: as 90/18 sim-seconds, comfortably inside the recovery budget.
+FAILOVER_LEASE_WALL_S = 1.5
+FAILOVER_RENEW_WALL_S = 0.3
 
 #: partition_fault variants — where the fractional-chip lifecycle breaks
 #: (docs/partitioning.md): hardware create fails mid-bind, the MP control
@@ -205,6 +244,15 @@ INV_STORAGE_DEGRADED = "storage-degraded-convergence"
 #: grant), and no Live-phase record without its live partition — aged by
 #: the leak grace so in-flight create/destroy windows never false-fire.
 INV_PARTITION_LEAK = "partition-leak"
+#: No two leadership terms may interleave gang WAL commits: the journaled
+#: fence record's term history must be strictly increasing (a superseded
+#: term committing after its successor is split-brain the checkpoint
+#: layer failed to refuse).
+INV_SINGLE_WRITER = "single-writer"
+#: While the apiserver is up (no outage/latency window open), SOME
+#: controller must hold a live, renewing lease within the recovery budget
+#: — leader election must never deadlock the control plane.
+INV_LEADERSHIP = "leadership-liveness"
 INVARIANTS = (
     INV_CLAIM_STUCK,
     INV_CDI_LEAK,
@@ -219,6 +267,8 @@ INVARIANTS = (
     INV_ACK_DURABILITY,
     INV_STORAGE_DEGRADED,
     INV_PARTITION_LEAK,
+    INV_SINGLE_WRITER,
+    INV_LEADERSHIP,
 )
 
 
@@ -479,6 +529,19 @@ class ChaosSoak:
         self._daemon_proxy = None
         self._daemon_upstream: Optional[object] = None
         self._daemon_dir: Optional[str] = None
+        # -- controller failover stack (docs/ha.md): one lease elector per
+        # "controller replica" identity over the shared kube, the ACTIVE
+        # one supplying the gang manager's fencing term.  Built with the
+        # cd stack (fault thread only; the monitor reads the references
+        # atomically and tolerates mid-swap windows).
+        self._elector = None
+        self._elector_seq = 0
+        self._elector_stop: Optional[threading.Event] = None
+        self._gang_term: Optional[int] = None
+        self._stale_rejections = 0  # guarded by _records_lock
+        self._stale_probes_run = 0  # guarded by _records_lock
+        self._failover_samples_sim: list[float] = []  # time-to-new-leader
+        self._lease_ager = MonotonicAger()
 
     # ------------------------------------------------------------- plumbing
 
@@ -790,6 +853,22 @@ class ChaosSoak:
                         list(PARTITION_FAULT_VARIANTS)
                     )
                 }
+            elif kind == "apiserver_outage":
+                variant = self._rng.choice(list(APISERVER_OUTAGE_VARIANTS))
+                params = {
+                    "variant": variant,
+                    # Sustained storms stay open for a sim window (short
+                    # enough that a composed full outage undershoots the
+                    # failover stack's lease grace at default compression);
+                    # fail-once keeps a short window so churn can consume
+                    # the per-verb blips before heal clears them.
+                    "window_sim_s": (
+                        self._rng.uniform(10.0, 20.0)
+                        if variant == "fail_once_429"
+                        else self._rng.uniform(30.0, 60.0)
+                    ),
+                    "retry_after_sim_s": self._rng.choice([1.0, 3.0, 6.0]),
+                }
             elif kind == "disk_fault":
                 variant = self._rng.choice(list(DISK_FAULT_VARIANTS))
                 params = {
@@ -834,6 +913,10 @@ class ChaosSoak:
             self._inject_disk_fault(node, params)
         elif kind == "partition_fault":
             self._inject_partition_fault(node, params)
+        elif kind == "apiserver_outage":
+            self._inject_apiserver_outage(node, params)
+        elif kind == "controller_failover":
+            self._inject_controller_failover(params)
         else:
             self._anomaly(f"unknown fault kind {kind!r}")
 
@@ -1036,7 +1119,10 @@ class ChaosSoak:
                 # the subprocess sweep pulls via TPUDRA_JOURNAL_MAX_RECORDS
                 # (the abandoned instance never needs the old value back).
                 driver._checkpoints._journal_max_records = 1
+            from tpudra.backoff import Backoff
+
             crashed = False
+            resolve_backoff = Backoff(0.1, 1.0)
             for _ in range(5):
                 try:
                     with checkpoint_mod.armed_crash(point):
@@ -1050,7 +1136,9 @@ class ChaosSoak:
                     crashed = True
                     break
                 except ApiError:
-                    time.sleep(0.2)  # latency spike beat the resolve; retry
+                    # Latency spike beat the resolve; jittered retry
+                    # (APISERVER-RETRY: never a constant).
+                    time.sleep(resolve_backoff.next_delay())
             if not crashed:
                 self._anomaly(
                     f"crash arm at {point} on node {node} never fired"
@@ -1475,6 +1563,309 @@ class ChaosSoak:
             self._open_churn_gate()
             self._end_fault(record)
 
+    # ------------------------------------------------- apiserver error storm
+
+    def _inject_apiserver_outage(self, node: int, params: dict) -> None:
+        """The apiserver REFUSES (docs/ha.md): a per-verb error plan —
+        429-with-Retry-After shedding, 500/503 storms, a fail-once blip,
+        or a full outage window with every watch stream force-closed —
+        composed with whatever latency/disk windows are already open.
+        After heal: every informer back on a live watch and a fresh bind
+        granted within the recovery budget, with no hot-spin having
+        occurred (every retry routed through the shared backoff honoring
+        the Retry-After floor is what the client layers are FOR)."""
+        from tpudra.kube.fake import ApiErrorPlan
+
+        variant = params.get("variant") or "storm_503"
+        record = FaultRecord(
+            kind="apiserver_outage", t_sim_start=self._now(),
+            params=dict(params),
+        )
+        self._record_fault(record)
+        t0_sim = self._now()
+        retry_after_wall = self.simclock.wall_of(
+            params.get("retry_after_sim_s", 1.0)
+        )
+        plan = ApiErrorPlan()
+        if variant == "storm_429":
+            plan.fail(verb="*", code=429, retry_after_s=retry_after_wall)
+        elif variant == "storm_500":
+            plan.fail(verb="*", code=500)
+        elif variant == "storm_503":
+            plan.fail(verb="*", code=503, retry_after_s=retry_after_wall)
+        elif variant == "fail_once_429":
+            for verb in ("get", "list", "create", "update", "delete"):
+                plan.fail(
+                    verb=verb, code=429, times=1,
+                    retry_after_s=retry_after_wall,
+                )
+        else:  # full_outage
+            plan.outage(retry_after_s=retry_after_wall)
+        self.sim.kube.set_error_plan(plan)
+        try:
+            if variant == "full_outage":
+                record.params["streams_closed"] = self.sim.kube.close_watches()
+            if variant == "fail_once_429":
+                # Deterministically consume one blip: without a probe, a
+                # quiet-churn window could reach heal with every times=1
+                # rule unconsumed — a fault counted as injected that
+                # exercised nothing (the no-op the gate must not accept).
+                with contextlib.suppress(ApiError):
+                    with api_deadline(3.0):
+                        self.sim.kube.list(gvr.RESOURCE_CLAIMS, "default")
+            self._stop.wait(
+                self.simclock.wall_of(params.get("window_sim_s", 0.0))
+            )
+        finally:
+            plan.heal()
+            self.sim.kube.set_error_plan(None)
+            record.params["requests_refused"] = plan.injected
+            if plan.injected < 1:
+                self._anomaly(
+                    f"apiserver_outage({variant}) refused zero requests"
+                )
+        # Recovery: every node informer back to a live watch...
+        deadline = time.monotonic() + self.simclock.wall_of(
+            self.budget.recovery_sim_s
+        )
+        informers = [
+            d.claim_informer
+            for d in self.sim.drivers
+            if d.claim_informer is not None
+        ]
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if all(inf.watch_healthy for inf in informers):
+                break
+            time.sleep(0.05)
+        watches_ok = all(inf.watch_healthy for inf in informers)
+        # ... and a fresh bind granted on the drawn node.
+        self._quarantine_node(node)
+        try:
+            uid = f"soak-outage-{self._fault_counter}"
+            claim = make_claim(
+                uid, self.sim.node_names[node], ["tpu-0"], name=uid
+            )
+            bound = False
+            try:
+                with api_deadline(5.0):
+                    self.sim.kube.create(gvr.RESOURCE_CLAIMS, claim, "default")
+                bound = self._retry_prepare(
+                    node, claim, self.budget.recovery_sim_s / 2
+                )
+            except ApiError:
+                logger.info("outage recovery probe create failed", exc_info=True)
+            self._check_or_interrupted(
+                INV_FAULT_RECOVERY,
+                watches_ok and bound,
+                key=("apiserver_outage", self._fault_counter),
+                detail=(
+                    f"control plane did not reconverge after {variant} "
+                    f"(watches_ok={watches_ok}, bind_granted={bound})"
+                ),
+                what="apiserver_outage recovery",
+            )
+            if bound:
+                self._best_effort_unprepare(self.sim.drivers[node], uid)
+            with contextlib.suppress(NotFound, ApiError):
+                with api_deadline(5.0):
+                    self.sim.kube.delete(gvr.RESOURCE_CLAIMS, uid, "default")
+        finally:
+            self._unquarantine_node(node)
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+            # Sample only genuine recoveries (same predicate as the
+            # invariant): a run that never re-granted a bind must not
+            # feed the recovery percentiles it just violated.
+            if watches_ok and bound:
+                self._recovery_samples.append(record.recovered_sim_s)
+
+    # --------------------------------------------------- controller failover
+
+    def _inject_controller_failover(self, params: dict) -> None:
+        """The ISSUE 14 failover scenario end to end: SIGKILL-shaped crash
+        of the LEADING controller mid-gang-reserve (durable intent, first
+        member bound), a standby replica acquires the lease after expiry,
+        a fresh gang-manager incarnation under the NEW term converges the
+        gang all-or-nothing via recover(), and a deliberately-REVIVED
+        stale leader's commit is refused at the checkpoint layer
+        (single-writer's stale-leader leg + the report's
+        ``tpudra_gang_stale_leader_rejections_total``)."""
+        from tpudra.controller.gang import (
+            GangMember,
+            GangReservationManager,
+            StaleLeader,
+        )
+        from tpudra.plugin.checkpoint import CheckpointManager
+        from tpudra.sim.multihost import make_channel_claim
+
+        self._ensure_cd_stack()
+        record = FaultRecord(
+            kind="controller_failover", t_sim_start=self._now(),
+            params=dict(params),
+        )
+        self._record_fault(record)
+        t0_sim = self._now()
+        n_fault = self._fault_counter
+        gang_id = f"soak-fo-{n_fault}"
+        domain_uid = f"{gang_id}-uid"
+        idxs = list(range(min(2, self.config.nodes)))
+        nodes = [self.sim.node_names[i] for i in idxs]
+        members = [
+            GangMember(node=n, claim_uid=f"{gang_id}-m{k}")
+            for k, n in enumerate(nodes)
+        ]
+        claims = {
+            m.claim_uid: make_channel_claim(m.claim_uid, m.node, domain_uid)
+            for m in members
+        }
+        old_term = self._gang_term
+        old_elector = self._elector
+        record.params["old_term"] = old_term
+        gang_dir = os.path.join(self.sim._base, "cdw-gangs")
+        try:
+            try:
+                self._create_cd_objects(gang_id, domain_uid, nodes, claims)
+            except ApiError as e:
+                record.params["aborted"] = str(e)[:120]
+                return
+            self._await_cd_ready(gang_id)
+            # THE CRASH: the leader dies mid-gang-reserve — intent
+            # journaled, first member durably bound, rest in flight.
+            crashed = False
+            try:
+                with checkpoint_mod.armed_crash("mid-gang-reserve"):
+                    self._gang_mgr.reserve(gang_id, members, claims)
+            except SimulatedCrash:
+                crashed = True
+            except Exception as e:  # noqa: BLE001 — a fault window won the race
+                record.params["reserve_error"] = f"{type(e).__name__}: {e}"[:120]
+            if not crashed:
+                self._anomaly(
+                    f"controller_failover #{n_fault}: crash arm never fired"
+                )
+            self._gang_cp.abandon()
+            if old_elector is not None:
+                old_elector.crash()
+            # THE FAILOVER: a fresh replica identity waits out the lease
+            # expiry and acquires with a strictly larger term.
+            standby = self._start_controller_elector()
+            fenced = standby is not None
+            if fenced:
+                record.params["new_term"] = standby.term
+                self._failover_samples_sim.append(self._now() - t0_sim)
+                self._check(
+                    INV_LEADERSHIP,
+                    standby.term > (old_term or 0),
+                    key=("term-advance", n_fault),
+                    detail=(
+                        f"standby acquired with term {standby.term}, not "
+                        f"above the dead leader's {old_term}"
+                    ),
+                )
+            # THE RECOVERY: a new manager incarnation over the same dir,
+            # under the new term, converges the gang all-or-nothing.
+            new_cp = CheckpointManager(gang_dir)
+            new_mgr = GangReservationManager(
+                new_cp, self._gang_binder, term=self._gang_term
+            )
+            deadline = time.monotonic() + self.simclock.wall_of(
+                self.budget.recovery_sim_s
+            )
+            converged = False
+            # Mirror Controller._leader_startup: the new leader's first
+            # act claims the store, so the fence outranks the dead term
+            # even when the crashed reserve never journaled (a fault
+            # window winning the race leaves nothing to recover — without
+            # the claim, the stale probe below would be ACCEPTED against
+            # the old leader's own high-water mark and false-fail
+            # single-writer with a REAL split-brain bind).
+            claimed = False
+            while time.monotonic() < deadline and not self._stop.is_set():
+                try:
+                    if not claimed:
+                        new_mgr.claim_store()
+                        claimed = True
+                    gangs = new_mgr.gangs()
+                    if gang_id not in gangs:
+                        if self._bound_gang_members(members) == 0:
+                            converged = True
+                            break
+                    elif gangs[gang_id].phase == "bound":
+                        if self._bound_gang_members(members) == len(members):
+                            converged = True
+                            break
+                        new_mgr.release(gang_id)
+                    else:
+                        new_mgr.recover()
+                except Exception:  # noqa: BLE001 — retried under open fault windows
+                    logger.info("failover recovery retry", exc_info=True)
+                time.sleep(0.05)
+            self._check_or_interrupted(
+                INV_GANG_ATOMICITY,
+                converged,
+                key=("failover", n_fault),
+                detail=(
+                    f"gang {gang_id} not all-or-nothing after controller "
+                    f"failover ({self._bound_gang_members(members)}/"
+                    f"{len(members)} members bound)"
+                ),
+                what="controller_failover gang recovery",
+            )
+            # THE REVIVED STALE LEADER: an incarnation still carrying the
+            # dead term (a paused process resuming) MUST be refused at the
+            # WAL — the split-brain write the fence exists to stop.  Only
+            # probe once the new term actually claimed the store: an
+            # unclaimed store (storage faults held every commit off) makes
+            # at-or-below acceptance of the old term CORRECT, not a bug.
+            if not claimed:
+                record.params["stale_probe_skipped"] = "store never claimed"
+                self._anomaly(
+                    f"controller_failover #{n_fault}: store never claimed "
+                    "under the new term; stale-leader probe skipped"
+                )
+            if fenced and old_term is not None and claimed:
+                with self._records_lock:
+                    self._stale_probes_run += 1
+                refused = False
+                revived_cp = CheckpointManager(gang_dir)
+                try:
+                    revived = GangReservationManager(
+                        revived_cp, self._gang_binder, term=old_term
+                    )
+                    revived.reserve(
+                        f"{gang_id}-stale",
+                        [members[0]],
+                        {members[0].claim_uid: claims[members[0].claim_uid]},
+                    )
+                except StaleLeader:
+                    refused = True
+                    with self._records_lock:
+                        self._stale_rejections += 1
+                except Exception as e:  # noqa: BLE001 — wrong refusal shape = violation below
+                    record.params["stale_probe_error"] = (
+                        f"{type(e).__name__}: {e}"[:120]
+                    )
+                finally:
+                    revived_cp.abandon()
+                self._check(
+                    INV_SINGLE_WRITER,
+                    refused,
+                    key=("stale-leader", n_fault),
+                    detail=(
+                        "a revived stale leader's gang commit was NOT "
+                        "refused with StaleLeader at the checkpoint layer"
+                    ),
+                )
+            # Swap the new incarnation in for every later wave.
+            self._gang_cp = new_cp
+            self._gang_mgr = new_mgr
+            if converged:
+                self._recovery_samples.append(self._now() - t0_sim)
+        finally:
+            self._delete_cd_objects(gang_id, claims)
+            self._end_fault(record)
+            record.recovered_sim_s = record.t_sim_end - t0_sim
+
     # ------------------------------------------------------------- cd wave
 
     def _ensure_cd_stack(self) -> None:
@@ -1513,12 +1904,60 @@ class ChaosSoak:
                     inner.unbind(member)
 
         self._gang_cp = CheckpointManager(os.path.join(base, "cdw-gangs"))
-        self._gang_mgr = GangReservationManager(self._gang_cp, _DeadlineBinder())
+        self._gang_binder = _DeadlineBinder()
+        # Leadership first: the gang manager is FENCED from its first
+        # commit (controller_failover later bumps the term; single-writer
+        # audits the journaled history).  An elector that cannot acquire
+        # inside the budget (a latency window swallowing its writes) is an
+        # anomaly and the stack runs unfenced rather than wedging.
+        self._start_controller_elector()
+        self._gang_mgr = GangReservationManager(
+            self._gang_cp, self._gang_binder, term=self._gang_term
+        )
         self._cd_drivers = drivers
+
+    def _start_controller_elector(self):
+        """Start the next controller-replica elector and wait (bounded)
+        for it to lead; adopts its fencing term.  Returns the elector (or
+        None on timeout, reported as an anomaly)."""
+        from tpudra.controller.lease import LeaseElector
+
+        if self._elector_stop is None:
+            self._elector_stop = threading.Event()
+        self._elector_seq += 1
+        elector = LeaseElector(
+            self.sim.kube,
+            identity=f"soak-ctrl-{self._elector_seq}",
+            name="soak-controller",
+            namespace=self.sim.config.driver_namespace,
+            lease_duration_s=FAILOVER_LEASE_WALL_S,
+            renew_interval_s=FAILOVER_RENEW_WALL_S,
+        )
+        elector.start(self._elector_stop)
+        deadline = time.monotonic() + max(
+            self.simclock.wall_of(self.budget.recovery_sim_s / 2), 5.0
+        )
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if elector.is_leader:
+                self._elector = elector
+                self._gang_term = elector.term
+                return elector
+            time.sleep(0.02)
+        # Kill the timed-out candidate: left running it would eventually
+        # acquire as an untracked ghost and starve every later failover's
+        # standby out of its acquisition window.
+        elector.crash()
+        self._anomaly(
+            f"controller elector {elector.identity} never acquired the "
+            "lease; gang stack running unfenced"
+        )
+        return None
 
     def _close_cd_stack(self) -> None:
         from tpudra.sim.multihost import close_cd_stack
 
+        if self._elector_stop is not None:
+            self._elector_stop.set()
         close_cd_stack(self._cd_drivers)
         if self._gang_cp is not None:
             try:
@@ -2482,6 +2921,80 @@ class ChaosSoak:
         self._check_gang_degraded()
         self._check_grant_health()
         self._check_storage_degraded()
+        self._check_single_writer()
+        self._check_leadership_liveness()
+
+    def _check_single_writer(self) -> None:
+        """The journaled fence history must be strictly increasing and
+        topped by the high-water term: a superseded term appearing after
+        its successor is a split-brain commit the checkpoint layer failed
+        to refuse (docs/ha.md).  Audited CONTINUOUSLY — not just at the
+        failover fault's stale-leader probe — so any interleaving a
+        compound fault provokes is caught at the store."""
+        mgr = self._gang_mgr
+        if mgr is not None and mgr.term is not None:
+            try:
+                high, history = mgr.fence_state()
+            except Exception:  # noqa: BLE001 — mid-swap/teardown window
+                return
+            monotonic_ok = all(a < b for a, b in zip(history, history[1:]))
+            capped_ok = not history or history[-1] == high
+            if not (monotonic_ok and capped_ok):
+                self._check(
+                    INV_SINGLE_WRITER,
+                    False,
+                    key=("history", tuple(history)),
+                    detail=(
+                        f"fence term history {history} (high-water {high}) "
+                        "is not strictly increasing — two leadership terms "
+                        "interleaved gang WAL commits"
+                    ),
+                )
+        self._pass_check(INV_SINGLE_WRITER)
+
+    def _check_leadership_liveness(self) -> None:
+        """While the apiserver is up (no outage/latency window open and no
+        failover mid-flight), SOME controller must be renewing the lease:
+        the lease object's resourceVersion may not sit unchanged past the
+        recovery budget.  Monotonic-aged on the observed rv, like every
+        other liveness check."""
+        if self._elector is None:
+            self._pass_check(INV_LEADERSHIP)
+            return
+        with self._records_lock:
+            blocked = any(
+                k in self._active
+                for k in (
+                    "apiserver_outage",
+                    "apiserver_latency",
+                    "controller_failover",
+                )
+            )
+        if blocked:
+            self._lease_ager.forget("lease")
+            return
+        try:
+            with api_deadline(3.0):
+                lease = self.sim.kube.get(
+                    gvr.LEASES,
+                    "soak-controller",
+                    self.sim.config.driver_namespace,
+                )
+            rv = lease.get("metadata", {}).get("resourceVersion", "")
+        except NotFound:
+            rv = "absent"
+        except ApiError:
+            return  # can't tell: wait for a readable pass
+        age_sim = self._lease_ager.age("lease", rv) * self.config.compression
+        self._check(
+            INV_LEADERSHIP,
+            age_sim <= self.budget.recovery_sim_s,
+            key=("lease-stalled",),
+            detail=(
+                f"controller lease unrenewed for {age_sim:.0f} sim-s with "
+                f"the apiserver up (budget {self.budget.recovery_sim_s:.0f})"
+            ),
+        )
 
     def _quiet_and_settled(self) -> bool:
         """True when no fault window is open AND the convergence budget
@@ -2998,6 +3511,17 @@ class ChaosSoak:
 
     # --------------------------------------------------------------- report
 
+    @staticmethod
+    def _counter_value(counter) -> float:
+        """Current value of an unlabeled prometheus Counter via the public
+        collect() surface (no private-attr reads)."""
+        total = 0.0
+        for metric in counter.collect():
+            for sample in metric.samples:
+                if sample.name.endswith("_total"):
+                    total += sample.value
+        return total
+
     def _report(self) -> dict:
         with self._samples_lock:
             samples = list(self._bind_samples)
@@ -3099,6 +3623,21 @@ class ChaosSoak:
             },
             "anomalies": anomalies,
             "violations": violations,
+            "failover": {
+                # The acceptance counter (docs/ha.md): >0 proves at least
+                # one stale-leader commit was actually refused at the WAL
+                # this run.  Metric value + the soak's own observation so
+                # a cross-test metric residue can never fake the latter.
+                "tpudra_gang_stale_leader_rejections_total": (
+                    self._counter_value(metrics.GANG_STALE_LEADER_REJECTIONS)
+                ),
+                "stale_leader_rejections_observed": self._stale_rejections,
+                "stale_probes_run": self._stale_probes_run,
+                "leader_terms_started": self._elector_seq,
+                "time_to_new_leader_sim_s": [
+                    round(s, 1) for s in self._failover_samples_sim
+                ],
+            },
             "slo": slo,
         }
 

@@ -94,6 +94,8 @@ def _fwd_pallas(x, emb, targets2d, interpret=False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from tpudra.workload import jaxcompat
+
     Np, D = x.shape
     V = emb.shape[0]
     bn = _pick_block(Np, BLOCK_N, 8)
@@ -120,7 +122,7 @@ def _fwd_pallas(x, emb, targets2d, interpret=False):
             pltpu.VMEM((bn, 1), jnp.float32),
             pltpu.VMEM((bn, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -71,7 +71,7 @@ def _mk_operand(mesh, axis: str, elems_per_device: int):
 def bench_psum(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -99,7 +99,7 @@ def bench_psum(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 
 
 def bench_all_gather(mesh, axis: str = "data", mib_per_device: int = 64, iters: int = 10) -> BenchResult:
     import jax
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -135,7 +135,7 @@ def bench_ppermute_ring(mesh, axis: str = "data", mib_per_device: int = 64, iter
     """Every device sends its whole block to the next ring neighbor — the
     closest analog to a raw point-to-point ICI link measurement."""
     import jax
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -165,7 +165,7 @@ def bench_reduce_scatter(mesh, axis: str = "data", mib_per_device: int = 64, ite
     device ends with its 1/n shard of the sum (the gradient/optimizer
     sharding primitive)."""
     import jax
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -194,7 +194,7 @@ def bench_all_to_all(mesh, axis: str = "data", mib_per_device: int = 64, iters: 
     """Full shuffle: every device sends a distinct 1/n chunk to every other
     device — the MoE dispatch/combine wire pattern."""
     import jax
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n = mesh.shape[axis]
@@ -243,7 +243,7 @@ def verify_collectives(mesh, axis: str = "data") -> list[str]:
 
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = mesh.shape[axis]

@@ -174,7 +174,6 @@ class ClaimEnv:
             return
         import jax
 
-        _enable_cpu_collectives(jax)
         address = self.coordinator
         _, _, port = self.coordinator.rpartition(":")
         if self.host_index == 0 and port.isdigit():
@@ -221,6 +220,14 @@ class ClaimEnv:
                     "(re-prepare the claim with a current driver) or the "
                     "env was stripped"
                 )
+        # Flip the gloo knob ONLY once every validation above has passed
+        # and the distributed client is really being created: the config
+        # is process-global, and on jaxlib builds whose gloo factory
+        # requires a live distributed client, a knob set on an early-exit
+        # path (a grant that fails validation) would poison every later
+        # single-process backend init in the process — the exact failure
+        # that took out 30 tests in tests/test_workload.py.
+        _enable_cpu_collectives(jax)
         jax.distributed.initialize(
             coordinator_address=address,
             num_processes=self.num_hosts,

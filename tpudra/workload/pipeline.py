@@ -66,7 +66,7 @@ def pipelined_backbone(
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     B, S = tokens.shape

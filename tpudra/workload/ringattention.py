@@ -84,9 +84,11 @@ def ring_self_attention(q, k, v, axis_name: str, causal: bool = True,
     # pcast-to-varying: the accumulators are per-device values varying over the ring
     # axis; without the annotation the scan carry types disagree (the body's
     # outputs pick up {V:sp} from q/k/v).
-    acc0 = lax.pcast(jnp.zeros((B, H, s, D), jnp.float32), (axis_name,), to='varying')
-    m0 = lax.pcast(jnp.full((B, H, s), -jnp.inf, jnp.float32), (axis_name,), to='varying')
-    l0 = lax.pcast(jnp.zeros((B, H, s), jnp.float32), (axis_name,), to='varying')
+    from tpudra.workload.jaxcompat import pcast
+
+    acc0 = pcast(jnp.zeros((B, H, s, D), jnp.float32), (axis_name,), to='varying')
+    m0 = pcast(jnp.full((B, H, s), -jnp.inf, jnp.float32), (axis_name,), to='varying')
+    l0 = pcast(jnp.zeros((B, H, s), jnp.float32), (axis_name,), to='varying')
     (k_f, v_f, acc, m, l), _ = lax.scan(
         step, (k, v, acc0, m0, l0), jnp.arange(n)
     )
@@ -112,7 +114,7 @@ def make_sharded_ring_attention(
     dp/tp partitioning continues through the manual region); ``jit=False``
     returns the bare shard_map for embedding inside a larger program."""
     import jax
-    from jax import shard_map
+    from tpudra.workload.jaxcompat import shard_map
     from jax.sharding import PartitionSpec as P
 
     seq_dim = 1 if layout == "bshd" else 2

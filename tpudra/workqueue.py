@@ -42,6 +42,15 @@ from tpudra.backoff import capped_exponential
 logger = logging.getLogger(__name__)
 
 
+def _retry_after_of(exc: BaseException):
+    """kube/errors.retry_after_of via a late import: the workqueue is a
+    lower layer than the kube client (which imports TokenBucket from
+    here), so a module-level import would be a cycle."""
+    from tpudra.kube.errors import retry_after_of
+
+    return retry_after_of(exc)
+
+
 class ExponentialBackoff:
     """Per-item exponential backoff: base * 2^failures, capped — the
     window arithmetic comes from the shared ``tpudra/backoff.py`` policy
@@ -223,6 +232,13 @@ class WorkQueue:
         self._gens: dict[object, int] = {}
         self._active_keys: set[object] = set()
         self._shutdown = False
+        #: While True, _pop hands out nothing: enqueues still land (and
+        #: keyed supersession still applies) but no worker dispatches.
+        #: The controller's leader-election gate (docs/ha.md): a replica
+        #: that lost its lease must stop ACTING immediately, while its
+        #: queue keeps absorbing informer events so a re-acquire resumes
+        #: from coalesced state instead of a cold resync.
+        self._paused = False
         self._max_retries = max_retries
         self._inflight = 0
         self._name = name
@@ -359,6 +375,13 @@ class WorkQueue:
                     self._limiter.forget(item)
                 else:
                     delay = self._limiter.when(item)
+                    # An apiserver 429/503's Retry-After hint floors the
+                    # limiter's delay (kube/errors.retry_after_of): the
+                    # server asked for quiet, and retrying into its shed
+                    # window re-feeds the storm it is shedding.
+                    retry_after = _retry_after_of(e)
+                    if retry_after is not None:
+                        delay = max(delay, retry_after)
                     logger.debug("work item %r failed (%s); retrying in %.3fs", item, e, delay)
                     self._retries_counter.inc()
                     self._push(entry.fn, entry.key, delay, entry.gen, entry.priority)
@@ -393,6 +416,9 @@ class WorkQueue:
             while True:
                 if self._shutdown or stop.is_set():
                     return None
+                if self._paused:
+                    self._cond.wait(timeout=0.1)
+                    continue
                 now = time.monotonic()
                 if self._fair:
                     self._migrate_due(now)
@@ -416,6 +442,23 @@ class WorkQueue:
         with self._cond:
             self._shutdown = True
             self._cond.notify_all()
+
+    def pause(self) -> None:
+        """Suspend dispatch: in-flight items finish, nothing new pops.
+        Producers are unaffected.  Idempotent."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        """Lift a pause(); idempotent."""
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    @property
+    def paused(self) -> bool:
+        with self._cond:
+            return self._paused
 
     def drain(self, timeout: float = 10.0) -> bool:
         """Block until the queue is empty and no item is in flight."""
