@@ -7,13 +7,14 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition bench-failover e2e-multihost soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs effectgraph effectgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition bench-failover e2e-multihost soak image helm-render clean
 
 all: native test
 
-# Static analysis gate: tpudra-lint + tpudra-lockgraph (one stdlib AST
-# analyzer sharing one parse pass, docs/static-analysis.md) plus ruff/mypy
-# when installed.  Nonzero exit on any finding.
+# Static analysis gate: tpudra-lint + tpudra-lockgraph + tpudra-effectgraph
+# (one stdlib AST analyzer sharing one parse pass and one call graph,
+# docs/static-analysis.md) plus ruff/mypy when installed.  Nonzero exit on
+# any finding.
 lint:
 	bash hack/lint.sh
 
@@ -28,6 +29,19 @@ lockgraph:
 # (tests/test_lockgraph.py::test_lock_order_doc_is_fresh diffs it).
 lockgraph-docs:
 	python -m tpudra.analysis --emit-dot docs/lock-order.md
+
+# Just the whole-program WAL rules (WAL-INTENT-BEFORE-EFFECT,
+# WAL-RECOVERY-EXHAUSTIVE, FENCE-DOMINATES-COMMIT, STRIPE-ORDER) — the
+# quick loop while reworking the checkpoint/bind path.  Also part of
+# `make lint`/`make tier1` (hack/lint.sh runs the full analyzer), and
+# gated in-suite by tests/test_effectgraph.py::test_effectgraph_is_clean.
+effectgraph:
+	python -m tpudra.analysis --effectgraph
+
+# Regenerate the checked-in effect-graph doc from the static WAL model
+# (tests/test_effectgraph.py::test_effect_graph_doc_is_fresh diffs it).
+effectgraph-docs:
+	python -m tpudra.analysis --emit-effectgraph docs/effect-graph.md
 
 native:
 	$(MAKE) -C native

@@ -58,6 +58,10 @@ class CrashablePlugin:
         self.log_i = 0
         self.proc = None
         self.log_path = None
+        #: One append-only WAL witness log per harness, shared across every
+        #: crash/restart of the plugin process (tpudra/walwitness.py); the
+        #: sweep merges it against the static effect graph at the end.
+        self.wal_witness_log = os.path.join(tmp, "wal-witness.jsonl")
 
     # Subclass hooks -------------------------------------------------------
 
@@ -77,6 +81,12 @@ class CrashablePlugin:
             **self.extra_env(),
         )
         env.pop("KUBECONFIG", None)
+        # Arm the WAL record→effect witness in EVERY harness process: the
+        # log survives the SIGKILLs (O_APPEND, one line per event), so the
+        # sweep's merge sees exactly which effects ran under which
+        # journaled intent across the whole crash schedule.
+        env["TPUDRA_WAL_WITNESS"] = "1"
+        env["TPUDRA_WAL_WITNESS_LOG"] = self.wal_witness_log
         if crashpoint:
             env["TPUDRA_CRASHPOINT"] = crashpoint
             env["TPUDRA_TEST_HOOKS"] = "1"  # two-key arming (device_state)

@@ -1,0 +1,21 @@
+"""tpudra-lint fixture: ANNOTATION-REASON.
+
+Analyzer annotations rewrite what the whole-program models believe about
+the code (a lock's identity, a record key's family); like suppressions,
+each must carry free text saying why the claim holds.  These carry only
+keywords — and a nested ``# EXPECT`` comment is not a reason.
+"""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def touch():
+    # tpudra-lock: id=fixture.lock  # EXPECT: ANNOTATION-REASON
+    with _lock:
+        pass
+
+
+def label(cp, uid):
+    cp.prepared_claims[uid] = None  # tpudra-wal: kind=claim # EXPECT: ANNOTATION-REASON

@@ -39,6 +39,6 @@ mutexes = DeviceMutexes()
 
 
 def rebind(device):
-    # tpudra-lock: id=fixture.per-device
+    # tpudra-lock: id=fixture.per-device names the shared per-device family so both acquisition paths pair up
     with mutexes.get(device):
         time.sleep(0.1)  # EXPECT: BLOCK-UNDER-LOCK-IP

@@ -9,17 +9,17 @@ additionally runs on the group-commit leader under the cp.lock flock.
 class BadDriver:
     def prepare_under_node_lock(self, spec):
         with self._locked_pu():
-            self._lib.create_partition(spec)  # EXPECT: PARTITION-PHASE
+            self._lib.create_partition(spec)  # EXPECT: PARTITION-PHASE, WAL-INTENT-BEFORE-EFFECT
 
     def prepare_under_publish_lock(self, spec):
         with self._publish_lock:
-            live = self._lib.create_partition(spec)  # EXPECT: PARTITION-PHASE
+            live = self._lib.create_partition(spec)  # EXPECT: PARTITION-PHASE, WAL-INTENT-BEFORE-EFFECT
         return live
 
     def destroy_inside_mutator(self, uuid):
         def drop_and_destroy(cp):
             cp.prepared_claims.pop(uuid, None)
-            self._lib.delete_partition(uuid)  # EXPECT: PARTITION-PHASE, RMW-PURITY
+            self._lib.delete_partition(uuid)  # EXPECT: PARTITION-PHASE, RMW-PURITY, WAL-INTENT-BEFORE-EFFECT
 
         self._cp.mutate(drop_and_destroy, touched=[uuid])
 

@@ -11,15 +11,15 @@ class State:
 
     def prepare(self, uid, spec):
         def start(cp):
-            live = self._lib.create_partition(spec)  # EXPECT: RMW-PURITY
+            live = self._lib.create_partition(spec)  # EXPECT: RMW-PURITY, WAL-INTENT-BEFORE-EFFECT
             self._record(cp, uid, live)
 
         self._cp.mutate(start)
 
     def _record(self, cp, uid, live):
         # One call deep from the mutator: still scanned.
-        self._cdi.create_claim_spec_file(uid, {}, None)  # EXPECT: RMW-PURITY
-        cp.prepared_claims[uid] = live
+        self._cdi.create_claim_spec_file(uid, {}, None)  # EXPECT: RMW-PURITY, WAL-INTENT-BEFORE-EFFECT
+        cp.prepared_claims[uid] = live  # EXPECT: WAL-RECOVERY-EXHAUSTIVE
 
     def unprepare(self, uid):
         def drop(cp):

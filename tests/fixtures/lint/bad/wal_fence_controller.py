@@ -1,0 +1,23 @@
+"""tpudra-effectgraph fixture: FENCE-DOMINATES-COMMIT.
+
+A checkpoint commit in controller code ("controller" in the file name, as
+in tpudra/controller/) whose enclosing function never consults the
+``gangmeta/term`` fence record: a deposed leader that lost its lease can
+still land this write.  The reasoned gang sweep keeps the recovery rule
+quiet so the fence violation is isolated.
+"""
+
+
+class Reservations:
+    def __init__(self, cp):
+        self._cp = cp
+
+    def reserve(self, guid, rec):
+        def add(cp):
+            cp.prepared_claims["gang/" + guid] = rec
+
+        self._cp.mutate(add)  # EXPECT: FENCE-DOMINATES-COMMIT
+
+    # tpudra-wal: recovers=gang restart sweep rolls incomplete gang records back
+    def recover_gangs(self, cp):
+        cp.prepared_claims.pop("gang/incomplete", None)

@@ -1,0 +1,19 @@
+"""tpudra-effectgraph fixture: WAL-INTENT-BEFORE-EFFECT.
+
+A registered hardware effect (devicelib partition create) reached through
+a resolved helper call with NO checkpoint commit anywhere on the path from
+the root: a crash between the effect and any later record write leaves a
+partition nothing in the checkpoint accounts for.
+"""
+
+
+class Preparer:
+    def __init__(self, lib):
+        self._lib = lib
+
+    def prepare(self, spec):
+        # No cp.mutate journals intent before the helper runs the effect.
+        self._apply(spec)
+
+    def _apply(self, spec):
+        self._lib.create_partition(spec)  # EXPECT: WAL-INTENT-BEFORE-EFFECT

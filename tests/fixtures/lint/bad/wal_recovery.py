@@ -1,0 +1,21 @@
+"""tpudra-effectgraph fixture: WAL-RECOVERY-EXHAUSTIVE, both sides.
+
+An orphan kind — a commit writes ``gang/...`` records but no function
+declares ``recovers=gang`` — and a dead handler: a sweep declares
+``recovers=partition`` while no commit site ever writes one.
+"""
+
+
+class GangStore:
+    def __init__(self, cp):
+        self._cp = cp
+
+    def reserve(self, guid, rec):
+        def add(cp):
+            cp.prepared_claims["gang/" + guid] = rec  # EXPECT: WAL-RECOVERY-EXHAUSTIVE
+
+        self._cp.mutate(add)
+
+    # tpudra-wal: recovers=partition claims to be the partition sweep, but nothing here commits that kind
+    def sweep(self, cp):  # EXPECT: WAL-RECOVERY-EXHAUSTIVE
+        cp.prepared_claims.pop("partition/leftover", None)

@@ -1,0 +1,13 @@
+"""tpudra-effectgraph fixture: STRIPE-ORDER.
+
+A staging helper first-touches record families out of the canonical
+``gangmeta < gang < claim < partition`` order: partition records land
+before the owning claim record.  Under the striped checkpoint (ROADMAP
+item 1) that acquisition order deadlocks against a compliant mutator.
+"""
+
+
+def stage(cp, uid, rec, parts):
+    for pu in parts:
+        cp.prepared_claims["partition/" + pu] = rec
+    cp.prepared_claims[uid] = rec  # EXPECT: STRIPE-ORDER

@@ -1,0 +1,19 @@
+"""tpudra-lint fixture: reasoned annotations stay silent.
+
+Each annotation follows its keywords with free text saying why the claim
+holds — the auditable form ANNOTATION-REASON requires.
+"""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def touch():
+    # tpudra-lock: id=fixture.lock names the module singleton so the cycle detector can pair acquisitions
+    with _lock:
+        pass
+
+
+def label(cp, uid):
+    cp.prepared_claims[uid] = None  # tpudra-wal: kind=claim the uid here is always a claim uid, not a record key

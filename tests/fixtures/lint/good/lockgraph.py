@@ -94,7 +94,7 @@ def serialize(uids):
     locks = []
     try:
         for uid in sorted(uids):
-            # tpudra-lock: id=flock:claim-uid family
+            # tpudra-lock: id=flock:claim-uid family sorted acquisition of one ordered flock family, not a self-deadlock
             lock = Flock(f"/var/lock/claims/{uid}.lock")
             lock.acquire(timeout=5.0)
             locks.append(lock)

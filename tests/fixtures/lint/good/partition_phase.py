@@ -1,12 +1,28 @@
 """GOOD: the phased partition discipline (PARTITION-PHASE clean).
 
 Lifecycle calls run in the effects phase — lock-free (the per-claim-uid
-flock family is exempt by design: effects DO run under it) — and the
-checkpoint mutators only journal intent records.
+flock family is exempt by design: effects DO run under it) — the
+checkpoint mutators only journal intent records, the journaled intent
+dominates every hardware call, and a reasoned recovery sweep covers the
+committed kinds.
 """
 
 
 class GoodDriver:
+    def prepare_one(self, item):
+        self.begin_prepare(item)
+        self.run_prepare_effects(item)
+
+    def begin_prepare(self, item):
+        def journal(cp):
+            # Mutators journal INTENT, owner before leaves: the claim
+            # record, then its partition records.
+            cp.prepared_claims[item.uid] = {"status": "PrepareStarted"}
+            for spec in item.planned:
+                cp.prepared_claims["partition/" + spec.uid] = spec
+
+        self._cp.mutate(journal, touched=[item.uid])
+
     def run_prepare_effects(self, item):
         # Effects phase: no lock held; the durable PrepareStarted record
         # is what reserves the silicon.
@@ -14,6 +30,11 @@ class GoodDriver:
             item.live.append(self._lib.create_partition(spec))
 
     def prepare(self, claims):
+        def journal(cp):
+            for c in claims:
+                cp.prepared_claims["partition/" + c["uid"]] = c["spec"]
+
+        self._cp.mutate(journal)
         with self._claims_serialized([c["uid"] for c in claims]):
             # The claim-uid flock is the designed effects serialization:
             # lifecycle calls under it are the correct shape.
@@ -24,9 +45,13 @@ class GoodDriver:
         def mark_destroying(cp):
             # Mutators journal INTENT; the hardware delete happens in the
             # effects phase after the commit.
-            rec = cp.prepared_claims.get(uid)
+            rec = cp.prepared_claims.get("partition/" + uid)
             if rec is not None:
                 rec.status = "Destroying"
 
         self._cp.mutate(mark_destroying, touched=[uid])
         self._lib.delete_partition(uid)
+
+    # tpudra-wal: recovers=claim,partition restart sweep destroys hardware whose records read Destroying and re-runs half-done prepares
+    def destroy_unknown(self, cp):
+        cp.prepared_claims.pop("partition/stale", None)

@@ -1,6 +1,8 @@
 """tpudra-lint fixture: the phased-engine idiom — zero findings.  The
-mutator only moves checkpoint state; hardware and CDI effects run before
-or after the RMW (docs/bind-path.md's begin/effects/finish shape)."""
+mutator only moves checkpoint state (journaling claim AND partition
+intent), hardware and CDI effects run after the commit, and a reasoned
+recovery sweep covers both record kinds (docs/bind-path.md's
+begin/effects/finish shape)."""
 
 
 class State:
@@ -13,6 +15,7 @@ class State:
         def begin(cp):
             self._validate(cp, uid)
             cp.prepared_claims[uid] = {"status": "PrepareStarted"}
+            cp.prepared_claims["partition/" + uid] = spec
 
         self._cp.mutate(begin)
         live = self._lib.create_partition(spec)
@@ -30,3 +33,7 @@ class State:
     def unprepare(self, uid):
         self._cdi.delete_claim_spec_file(uid)
         self._cp.mutate(lambda cp: cp.prepared_claims.pop(uid, None))
+
+    # tpudra-wal: recovers=claim,partition restart sweep converges records whose effects half-ran before the crash
+    def recover(self, cp):
+        cp.prepared_claims.pop("stale", None)

@@ -1,0 +1,26 @@
+"""tpudra-effectgraph fixture: the fenced controller commit.
+
+Same commit as the bad twin, but the mutator consults the gangmeta/term
+fence record inside the WAL transaction before writing — the static form
+of the runtime StaleLeader refusal (controller/gang.py's fenced funnel).
+"""
+
+GANG_META_UID = "gangmeta/term"
+
+
+class Reservations:
+    def __init__(self, cp):
+        self._cp = cp
+
+    def reserve(self, guid, rec, term):
+        def add(cp):
+            meta = cp.prepared_claims.get(GANG_META_UID)
+            if meta is not None and meta.term != term:
+                raise RuntimeError("stale leader")
+            cp.prepared_claims["gang/" + guid] = rec
+
+        self._cp.mutate(add)
+
+    # tpudra-wal: recovers=gang restart sweep rolls incomplete gang records back
+    def recover_gangs(self, cp):
+        cp.prepared_claims.pop("gang/incomplete", None)

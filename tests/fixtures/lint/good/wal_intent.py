@@ -1,0 +1,24 @@
+"""tpudra-effectgraph fixture: the compliant intent-before-effect shape.
+
+The mutator journals a partition intent record (the commit's touched kinds
+dominate everything after the ``mutate`` call returns), THEN the hardware
+effect runs; a reasoned recovery sweep declares itself the handler for the
+kind, so both sides of WAL-RECOVERY-EXHAUSTIVE are satisfied too.
+"""
+
+
+class Preparer:
+    def __init__(self, cp, lib):
+        self._cp = cp
+        self._lib = lib
+
+    def prepare(self, uid, spec):
+        def add(cp):
+            cp.prepared_claims["partition/" + uid] = spec
+
+        self._cp.mutate(add)
+        self._lib.create_partition(spec)
+
+    # tpudra-wal: recovers=partition restart sweep pops partition records whose hardware never materialized
+    def recover(self, cp):
+        cp.prepared_claims.pop("partition/orphan", None)

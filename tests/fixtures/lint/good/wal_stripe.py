@@ -1,0 +1,12 @@
+"""tpudra-effectgraph fixture: canonical stripe order.
+
+Owner before leaves: the claim record lands before its partition records,
+matching ``gangmeta < gang < claim < partition`` — the acquisition order
+the striped checkpoint will take family locks in.
+"""
+
+
+def stage(cp, uid, rec, parts):
+    cp.prepared_claims[uid] = rec
+    for pu in parts:
+        cp.prepared_claims["partition/" + pu] = rec

@@ -41,6 +41,16 @@ LIB_PATH = os.environ.get("TPUINFO_LIBRARY_PATH", DEFAULT_LIB_PATH)
 
 API_V = "resource.tpu.google.com/v1beta1"
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def effect_graph():
+    """The static WAL effect graph, built once for the witness merges."""
+    from tpudra.analysis.effectwitness import build_graph
+
+    return build_graph(os.path.join(REPO, "tpudra"))
+
 pytestmark = pytest.mark.skipif(
     not os.path.exists(LIB_PATH),
     reason="libtpuinfo.so not built (make -C native)",
@@ -127,7 +137,9 @@ CLAIMS = {"chip": chip_claim, "partition": partition_claim}
 
 @pytest.mark.parametrize("kind", sorted(CLAIMS))
 @pytest.mark.parametrize("point", POINTS)
-def test_sigkill_at_checkpoint_boundary_converges(short_tmp, point, kind):
+def test_sigkill_at_checkpoint_boundary_converges(
+    short_tmp, point, kind, effect_graph
+):
     mk = CLAIMS[kind]
     uid = f"crash-{kind}-{point}"
     with FakeKubeServer() as server:
@@ -224,6 +236,15 @@ def test_sigkill_at_checkpoint_boundary_converges(short_tmp, point, kind):
             if kind == "partition":
                 assert not h.live_partitions()
             assert uid not in h.claim_statuses()
+
+            # -------- witness merge: the whole crash schedule's runtime
+            # record→effect trace (appended across both plugin processes)
+            # must fit the static effect graph — zero model gaps, zero
+            # intent-before-effect ordering violations.
+            from tpudra.analysis.effectwitness import merge
+
+            report = merge(effect_graph, h.wal_witness_log)
+            assert report.ok, report.render()
         finally:
             h.terminate()
 

@@ -1,0 +1,441 @@
+"""tpudra-effectgraph (tpudra/analysis/{effectmodel,effectwitness}.py +
+tpudra/walwitness.py): the whole-program WAL crash-consistency rules, the
+generated effect-graph doc, and the runtime witness-merge semantics.
+
+The fixture corpus (tests/fixtures/lint/{bad,good}/wal_*.py) rides the
+exact-(line, rule) machinery in tests/test_lint.py; this file covers
+everything beyond per-fixture precision."""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpudra import walwitness
+from tpudra.analysis.effectmodel import (
+    EFFECTS,
+    STRIPE_FAMILIES,
+    WalAnnotations,
+    analyze_effects,
+)
+from tpudra.analysis.effectwitness import build_graph, emit_markdown, merge
+from tpudra.analysis.engine import DEFAULT_ROOTS, ParsedModule, lint_modules, parse_paths
+from tpudra.analysis.rules import effectgraph_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_module(source: str, path: str = "mod_under_test.py") -> ParsedModule:
+    return ParsedModule(path=path, source=source, tree=ast.parse(source))
+
+
+def analyze(source: str, path: str = "mod_under_test.py"):
+    return analyze_effects([mk_module(source, path)])
+
+
+@pytest.fixture(scope="module")
+def graph():
+    """The static effect graph of the tpudra package, built once."""
+    return build_graph(os.path.join(REPO_ROOT, "tpudra"))
+
+
+# ------------------------------------------------------------------ CI gates
+
+
+def test_effectgraph_is_clean():
+    """The whole-program gate, mirroring test_lockgraph_is_clean: zero
+    WAL-INTENT-BEFORE-EFFECT / WAL-RECOVERY-EXHAUSTIVE /
+    FENCE-DOMINATES-COMMIT / STRIPE-ORDER findings at HEAD (every
+    deliberate exception carries a reasoned annotation)."""
+    roots = [
+        p
+        for p in (os.path.join(REPO_ROOT, r) for r in DEFAULT_ROOTS)
+        if os.path.exists(p)
+    ]
+    modules, parse_findings = parse_paths(roots)
+    findings = lint_modules(modules, parse_findings, rules=effectgraph_rules())
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_effect_graph_doc_is_fresh(graph):
+    """docs/effect-graph.md is generated; a kind, effect, or commit-site
+    change must ship a regenerated table (`make effectgraph-docs`)."""
+    doc = os.path.join(REPO_ROOT, "docs", "effect-graph.md")
+    with open(doc, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == emit_markdown(graph), (
+        "docs/effect-graph.md is stale — run `make effectgraph-docs` and "
+        "commit the result"
+    )
+
+
+# ------------------------------------------------------------- model pins
+
+
+def test_every_registered_effect_has_a_static_site(graph):
+    """Each of the registered effect ids resolves to at least one call
+    site in the tree — if one vanishes, the analyzer stopped seeing that
+    effect provider and its 'dominated' verdicts are vacuous."""
+    assert graph.effect_ids() == {spec.effect_id for spec in EFFECTS}
+
+
+def test_all_reached_effects_dominated_at_head(graph):
+    """Every modeled effect site at HEAD is either dominated by journaled
+    intent or carries a reasoned nonrecoverable annotation — the doc
+    table shows no UNCOVERED rows."""
+    for e in graph.effects:
+        assert e.journaled_ok or e.nonrecoverable or not e.reached, (
+            e.spec.effect_id,
+            e.path,
+            e.line,
+        )
+
+
+def test_controller_commits_fenced_at_head(graph):
+    """Every checkpoint commit site in controller code consults the
+    gangmeta/term fence — the static form of the StaleLeader refusal."""
+    controller = [c for c in graph.commits if c.in_controller]
+    assert controller, "the model lost sight of the controller's commits"
+    for c in controller:
+        assert c.fenced, (c.path, c.line, c.qualname)
+
+
+def test_every_kind_with_writers_has_handlers_at_head(graph):
+    for kind, info in graph.kinds.items():
+        if info.written_at:
+            assert info.handlers, f"kind {kind} committed but never recovered"
+
+
+# ----------------------------------------------------- model unit behaviors
+
+
+def test_effect_without_commit_is_flagged():
+    src = (
+        "class S:\n"
+        "    def prepare(self, spec):\n"
+        "        self._lib.create_partition(spec)\n"
+    )
+    result = analyze(src)
+    assert [f.rule_id for f in result.findings] == ["WAL-INTENT-BEFORE-EFFECT"]
+
+
+def test_commit_dominates_effect_through_helper():
+    src = (
+        "class S:\n"
+        "    def begin(self, uid, spec):\n"
+        "        def add(cp):\n"
+        "            cp.prepared_claims['partition/' + uid] = spec\n"
+        "        self._cp.mutate(add)\n"
+        "    def prepare(self, uid, spec):\n"
+        "        self.begin(uid, spec)\n"
+        "        self._lib.create_partition(spec)\n"
+        "    # tpudra-wal: recovers=partition restart sweep reaps unknown partitions\n"
+        "    def sweep(self, cp):\n"
+        "        cp.prepared_claims.pop('partition/x', None)\n"
+    )
+    result = analyze(src)
+    assert result.findings == []
+
+
+def test_callee_commit_replays_for_every_caller():
+    """Regression: the walk memo must replay a callee's journal additions
+    for the SECOND (and later) callers too — a bare visited-set would
+    leave caller two's effect looking uncovered."""
+    src = (
+        "class S:\n"
+        "    def begin(self, uid, spec):\n"
+        "        def add(cp):\n"
+        "            cp.prepared_claims['partition/' + uid] = spec\n"
+        "        self._cp.mutate(add)\n"
+        "    def one(self, uid, spec):\n"
+        "        self.begin(uid, spec)\n"
+        "        self._lib.create_partition(spec)\n"
+        "    def two(self, uid, spec):\n"
+        "        self.begin(uid, spec)\n"
+        "        self._lib.create_partition(spec)\n"
+        "    # tpudra-wal: recovers=partition restart sweep reaps unknown partitions\n"
+        "    def sweep(self, cp):\n"
+        "        cp.prepared_claims.pop('partition/x', None)\n"
+    )
+    result = analyze(src)
+    assert result.findings == []
+
+
+def test_recovers_assumption_does_not_leak_to_caller():
+    """Inside a recovers= handler its kinds ARE journaled (recovery acts
+    from checkpoint truth); after the handler returns, the caller's own
+    effects still need their own intent."""
+    src = (
+        "class S:\n"
+        "    def writer(self, uid, spec):\n"
+        "        def add(cp):\n"
+        "            cp.prepared_claims['partition/' + uid] = spec\n"
+        "        self._cp.mutate(add)\n"
+        "    def main(self, spec):\n"
+        "        self.sweep()\n"
+        "        self._lib.create_partition(spec)\n"
+        "    # tpudra-wal: recovers=partition recovery acts from checkpoint truth\n"
+        "    def sweep(self):\n"
+        "        self._lib.delete_partition('p0')\n"
+    )
+    result = analyze(src)
+    assert [(f.line, f.rule_id) for f in result.findings] == [
+        (8, "WAL-INTENT-BEFORE-EFFECT")
+    ]
+
+
+def test_nonrecoverable_def_annotation_covers_subtree():
+    src = (
+        "class S:\n"
+        "    def main(self):\n"
+        "        self.probe()\n"
+        "    # tpudra-wal: nonrecoverable probe partitions are reaped synchronously before any claim exists\n"
+        "    def probe(self):\n"
+        "        self._lib.create_partition(None)\n"
+    )
+    result = analyze(src)
+    assert result.findings == []
+
+
+def test_stripe_order_gangmeta_outranks_gang():
+    src = (
+        "def move(cp):\n"
+        "    cp.prepared_claims['gang/g1'] = 1\n"
+        "    cp.prepared_claims['gangmeta/term'] = 2\n"
+    )
+    result = analyze(src)
+    assert [(f.line, f.rule_id) for f in result.findings] == [(3, "STRIPE-ORDER")]
+
+
+def test_unknown_kind_annotation_is_flagged():
+    src = "# tpudra-wal: kind=blob the blob family does not exist\nx = 1\n"
+    result = analyze(src)
+    assert [f.rule_id for f in result.findings] == ["WAL-RECOVERY-EXHAUSTIVE"]
+    assert "blob" in result.findings[0].message
+
+
+def test_wal_annotations_parse():
+    ann = WalAnnotations(
+        "x = 1  # tpudra-wal: kind=partition because reasons\n"
+        "# tpudra-wal: recovers=gang,gangmeta the sweep\n"
+        "y = 2\n"
+        "z = 3  # tpudra-wal: nonrecoverable why it converges\n"
+    )
+    assert ann.at(1).kind == "partition"
+    assert ann.at(2).recovers == ("gang", "gangmeta")  # comment-only line
+    assert ann.at(3).recovers == ("gang", "gangmeta")  # ... covers the next
+    assert ann.at(4).nonrecoverable
+
+
+def test_record_kind_classifier():
+    assert walwitness.record_kind("gangmeta/term") == "gangmeta"
+    assert walwitness.record_kind("gang/abc") == "gang"
+    assert walwitness.record_kind("partition/chip0/p1") == "partition"
+    assert walwitness.record_kind("claim-uid-123") == "claim"
+    assert [walwitness.record_kind(k + "/x") for k in STRIPE_FAMILIES[:2]] == [
+        "gangmeta",
+        "gang",
+    ]
+
+
+# ------------------------------------------------------------ runtime witness
+
+
+@pytest.fixture
+def armed_witness(tmp_path, monkeypatch):
+    log = str(tmp_path / "wal-witness.jsonl")
+    monkeypatch.setenv(walwitness.ENV_WITNESS, "1")
+    monkeypatch.setenv(walwitness.ENV_WITNESS_LOG, log)
+    walwitness.reset_for_tests()
+    yield log
+    walwitness.reset_for_tests()
+
+
+def test_witness_round_trip(armed_witness):
+    walwitness.note_journal(["uid-1", "partition/p0"])
+    walwitness.note_effect("partition:create")
+    walwitness.note_effect("partition:create")  # deduped
+    kinds, effects = walwitness.read_log(armed_witness)
+    assert kinds == {"claim", "partition"}
+    assert effects == [("partition:create", frozenset({"claim", "partition"}))]
+
+
+def test_witness_disabled_writes_nothing(tmp_path, monkeypatch):
+    log = str(tmp_path / "off.jsonl")
+    monkeypatch.delenv(walwitness.ENV_WITNESS, raising=False)
+    monkeypatch.setenv(walwitness.ENV_WITNESS_LOG, log)
+    walwitness.reset_for_tests()
+    walwitness.note_journal(["uid-1"])
+    walwitness.note_effect("partition:create")
+    assert not os.path.exists(log)
+
+
+def test_witness_exempt_scope_suppresses_effects(armed_witness):
+    # Runtime twin of `# tpudra-wal: nonrecoverable`: the probe's
+    # journal-less create/destroy must not appear in the log at all.
+    with walwitness.exempt():
+        walwitness.note_effect("partition:create")
+        walwitness.note_effect("partition:destroy")
+    walwitness.note_effect("cdi:spec-write")  # outside: witnessed
+    _, effects = walwitness.read_log(armed_witness)
+    assert effects == [("cdi:spec-write", frozenset())]
+
+
+def test_witness_recovery_scope_assumes_kinds(armed_witness):
+    # Runtime twin of `# tpudra-wal: recovers=partition`: inside the
+    # sweep's scope the kind counts as journaled (checkpoint truth),
+    # but the assumption does not leak past the scope or into the
+    # process-global journaled set.
+    with walwitness.recovery_scope("partition"):
+        walwitness.note_effect("partition:destroy")
+    walwitness.note_effect("partition:destroy")
+    assert walwitness.journaled_kinds() == ()
+    _, effects = walwitness.read_log(armed_witness)
+    assert effects == [
+        ("partition:destroy", frozenset({"partition"})),
+        ("partition:destroy", frozenset()),
+    ]
+
+
+def test_probe_partitions_are_witness_exempt(armed_witness):
+    # The init-time probe (annotated nonrecoverable) creates and deletes
+    # a real partition with no record anywhere: driving it under an
+    # armed witness must leave the log empty, or every armed run of a
+    # partition-capable plugin would report a false violation.
+    from tpudra.devicelib.mock import MockDeviceLib
+    from tpudra.plugin.device_state import DeviceState
+
+    lib = MockDeviceLib()
+    DeviceState._probe_simulated_partitions(lib)
+    _, effects = walwitness.read_log(armed_witness)
+    assert effects == []
+    assert lib.list_partitions() == []
+
+
+def test_read_log_skips_torn_tail(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as f:
+        f.write('{"t": "record", "kind": "claim"}\n')
+        f.write('{"t": "effect", "effect": "cdi:spec-w')  # SIGKILL mid-line
+    kinds, effects = walwitness.read_log(path)
+    assert kinds == {"claim"}
+    assert effects == []
+
+
+# ----------------------------------------------------------- witness merge
+
+
+def _write_log(tmp_path, records):
+    path = str(tmp_path / "witness.jsonl")
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def test_witness_merge_clean(graph, tmp_path):
+    log = _write_log(
+        tmp_path,
+        [
+            {"t": "record", "kind": "claim"},
+            {"t": "effect", "effect": "cdi:spec-write", "journaled": ["claim"]},
+        ],
+    )
+    report = merge(graph, log)
+    assert report.ok
+    assert "cdi:spec-write" in report.covered
+    assert "gang:bind" in report.uncovered  # reported, non-failing
+
+
+def test_witness_merge_violation_fails(graph, tmp_path):
+    """An effect witnessed WITHOUT its required kind journaled is the
+    runtime form of WAL-INTENT-BEFORE-EFFECT — fail."""
+    log = _write_log(
+        tmp_path,
+        [{"t": "effect", "effect": "partition:create", "journaled": ["claim"]}],
+    )
+    report = merge(graph, log)
+    assert not report.ok
+    assert [(e, need) for e, need, _ in report.violations] == [
+        ("partition:create", "partition")
+    ]
+    assert "WITNESSED VIOLATION" in report.render()
+
+
+def test_witness_merge_model_gap_fails(graph, tmp_path):
+    """An effect id the suite exhibited but the model has no site for
+    must FAIL — every other static verdict is built on a hole."""
+    log = _write_log(
+        tmp_path,
+        [
+            {
+                "t": "effect",
+                "effect": "quota:burn",
+                "journaled": ["claim", "partition"],
+            }
+        ],
+    )
+    report = merge(graph, log)
+    assert not report.ok
+    assert report.model_gaps == ["quota:burn"]
+    assert "MODEL GAP" in report.render()
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tpudra.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=300,
+    )
+
+
+def test_cli_effectgraph_clean_at_head():
+    proc = _run_cli("--effectgraph")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tpudra-effectgraph: clean" in proc.stdout
+
+
+def test_cli_lanes_are_exclusive():
+    proc = _run_cli("--lockgraph", "--effectgraph")
+    assert proc.returncode == 2
+
+
+def test_cli_emit_effectgraph(tmp_path):
+    out = str(tmp_path / "graph.md")
+    proc = _run_cli("--emit-effectgraph", out)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        content = f.read()
+    assert "# WAL effect graph" in content
+    assert "partition:create" in content
+    assert "UNCOVERED" not in content
+
+
+def test_cli_wal_witness_missing_log_is_usage_error():
+    proc = _run_cli("--wal-witness", "no/such/log.jsonl")
+    assert proc.returncode == 2
+
+
+def test_cli_wal_witness_merge(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    with open(log, "w") as f:
+        f.write(json.dumps({"t": "record", "kind": "gang"}) + "\n")
+        f.write(
+            json.dumps(
+                {"t": "effect", "effect": "gang:bind", "journaled": ["gang"]}
+            )
+            + "\n"
+        )
+    proc = _run_cli("--wal-witness", log)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "witness merge: OK" in proc.stdout

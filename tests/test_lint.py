@@ -64,7 +64,10 @@ def test_every_rule_id_demonstrated():
     """The corpus covers the whole rule set — a rule nobody can see fire
     is a rule nobody trusts."""
     demonstrated = {rid for p in BAD for _, rid in _expected(p)}
-    want = {r.rule_id for r in all_rules()} | {"SUPPRESS-REASON"}
+    want = {r.rule_id for r in all_rules()} | {
+        "SUPPRESS-REASON",
+        "ANNOTATION-REASON",
+    }
     assert want <= demonstrated, f"rules without a bad fixture: {want - demonstrated}"
 
 
@@ -126,6 +129,44 @@ def test_unreasoned_suppression_is_flagged():
     assert [f.rule_id for f in lint_source(src)] == ["SUPPRESS-REASON"]
 
 
+# ---------------------------------------------------------------- annotations
+
+
+def test_unreasoned_lock_annotation_is_flagged():
+    src = (
+        "import threading\n"
+        "lock = threading.Lock()\n"
+        "# tpudra-lock: id=fixture.lock\n"
+        "with lock:\n"
+        "    pass\n"
+    )
+    assert [f.rule_id for f in lint_source(src)] == ["ANNOTATION-REASON"]
+
+
+def test_unreasoned_wal_annotation_is_flagged():
+    src = (
+        "def f(cp, uid):\n"
+        "    cp.prepared_claims[uid] = None  # tpudra-wal: kind=claim\n"
+    )
+    findings = lint_source(src)
+    assert [(f.line, f.rule_id) for f in findings] == [(2, "ANNOTATION-REASON")]
+
+
+def test_reasoned_annotation_is_silent():
+    src = (
+        "def f(cp, uid):\n"
+        "    cp.prepared_claims[uid] = None"
+        "  # tpudra-wal: kind=claim uid is always a claim uid here\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_annotation_inside_string_is_inert():
+    src = 's = "# tpudra-wal: kind=claim"\n'
+    sup = Suppressions(src)
+    assert not sup.unreasoned_annotations
+
+
 # ------------------------------------------------------------------------ CLI
 
 
@@ -142,8 +183,40 @@ def _run_cli(*args: str) -> subprocess.CompletedProcess:
 def test_cli_nonzero_on_bad_fixtures():
     proc = _run_cli(os.path.join(FIXTURES, "bad"))
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    for rule_id in ("LOCK-ORDER", "RMW-PURITY", "METRICS-HYGIENE"):
+    for rule_id in (
+        "LOCK-ORDER",
+        "RMW-PURITY",
+        "METRICS-HYGIENE",
+        "WAL-INTENT-BEFORE-EFFECT",
+        "STRIPE-ORDER",
+        "ANNOTATION-REASON",
+    ):
         assert rule_id in proc.stdout
+
+
+def test_cli_json_schema():
+    """The stable machine schema: a v1 envelope whose keys only ever grow
+    (documented in tpudra/analysis/__main__.py and docs/static-analysis.md)."""
+    import json
+
+    proc = _run_cli("--json", os.path.join(FIXTURES, "bad", "wal_intent.py"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["schema"] == "tpudra-analysis/v1"
+    assert doc["count"] == len(doc["findings"]) > 0
+    for f in doc["findings"]:
+        assert set(f) >= {"rule", "path", "line", "col", "message"}
+        assert isinstance(f["line"], int) and isinstance(f["col"], int)
+    assert {f["rule"] for f in doc["findings"]} == {"WAL-INTENT-BEFORE-EFFECT"}
+
+
+def test_cli_json_clean_is_zero():
+    import json
+
+    proc = _run_cli("--json", os.path.join(FIXTURES, "good", "wal_intent.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc == {"schema": "tpudra-analysis/v1", "findings": [], "count": 0}
 
 
 def test_cli_zero_on_repo_head():
@@ -158,6 +231,7 @@ def test_cli_list_rules():
     for rule in all_rules():
         assert rule.rule_id in proc.stdout
     assert "SUPPRESS-REASON" in proc.stdout
+    assert "ANNOTATION-REASON" in proc.stdout
 
 
 def test_cli_missing_path_is_usage_error():

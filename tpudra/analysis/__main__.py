@@ -1,16 +1,30 @@
-"""CLI for tpudra-lint + tpudra-lockgraph: ``python -m tpudra.analysis``.
+"""CLI for tpudra-lint + tpudra-lockgraph + tpudra-effectgraph:
+``python -m tpudra.analysis``.
 
-One shared parse pass feeds both the per-module lint rules and the
-whole-program lock analysis.  Extra modes:
+One shared parse pass (parallel across files when CPUs allow) feeds the
+per-module lint rules and both whole-program analyses.  Extra modes:
 
 - ``--lockgraph``: only the lock rules (the ``make lockgraph`` lane);
-- ``--witness LOG``: merge a runtime witness log (tpudra/lockwitness.py)
-  into the static graph — witnessed cycles and model gaps fail;
-- ``--emit-dot [PATH]``: regenerate docs/lock-order.md from the model.
+- ``--effectgraph``: only the WAL rules (the ``make effectgraph`` lane);
+- ``--witness LOG``: merge a runtime lock witness log
+  (tpudra/lockwitness.py) into the static lock graph — witnessed cycles
+  and model gaps fail;
+- ``--wal-witness LOG``: merge a runtime WAL witness log
+  (tpudra/walwitness.py) into the static effect graph — witnessed
+  ordering violations and model gaps fail;
+- ``--emit-dot [PATH]``: regenerate docs/lock-order.md from the model;
+- ``--emit-effectgraph [PATH]``: regenerate docs/effect-graph.md.
+
+``--json`` emits the stable machine schema (documented in
+docs/static-analysis.md and asserted by tests/test_lint.py)::
+
+    {"schema": "tpudra-analysis/v1",
+     "findings": [{"rule", "path", "line", "col", "message"}, ...],
+     "count": N}
 
 Exit status: 0 clean, 1 findings (or a failed witness merge), 2 usage/
 internal error — the contract ``hack/lint.sh`` and ``make lint``/`
-``make lockgraph`` build on.
+``make lockgraph``/``make effectgraph`` build on.
 """
 
 from __future__ import annotations
@@ -19,6 +33,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from tpudra.analysis.engine import DEFAULT_ROOTS, lint_modules, parse_paths
 
@@ -56,11 +71,25 @@ def main(argv: list[str] | None = None) -> int:
         "BLOCK-UNDER-LOCK-IP, FLOCK-INVERSION)",
     )
     parser.add_argument(
+        "--effectgraph",
+        action="store_true",
+        help="run only the whole-program WAL rules (WAL-INTENT-BEFORE-"
+        "EFFECT, WAL-RECOVERY-EXHAUSTIVE, FENCE-DOMINATES-COMMIT, "
+        "STRIPE-ORDER)",
+    )
+    parser.add_argument(
         "--witness",
         metavar="LOG",
         help="merge a TPUDRA_LOCK_WITNESS jsonl log into the static lock "
         "graph: witnessed cycles / model gaps fail, unwitnessed static "
         "edges are reported as coverage",
+    )
+    parser.add_argument(
+        "--wal-witness",
+        metavar="LOG",
+        help="merge a TPUDRA_WAL_WITNESS jsonl log into the static effect "
+        "graph: witnessed intent-before-effect violations / model gaps "
+        "fail, unwitnessed modeled effects are reported as coverage",
     )
     parser.add_argument(
         "--emit-dot",
@@ -69,6 +98,14 @@ def main(argv: list[str] | None = None) -> int:
         metavar="PATH",
         help="regenerate the lock-order document (default docs/lock-order.md) "
         "from the static graph and exit",
+    )
+    parser.add_argument(
+        "--emit-effectgraph",
+        nargs="?",
+        const="docs/effect-graph.md",
+        metavar="PATH",
+        help="regenerate the effect-graph document (default "
+        "docs/effect-graph.md) from the static WAL model and exit",
     )
     args = parser.parse_args(argv)
 
@@ -81,9 +118,20 @@ def main(argv: list[str] | None = None) -> int:
             "SUPPRESS-REASON: every '# tpudra-lint: disable=...' states a "
             "reason (engine-level check)"
         )
+        print(
+            "ANNOTATION-REASON: every '# tpudra-lock:'/'# tpudra-wal:' "
+            "annotation states a reason after its keywords (engine-level "
+            "check)"
+        )
         return 0
 
-    if args.witness is not None or args.emit_dot is not None:
+    graph_flags = (
+        args.witness is not None
+        or args.wal_witness is not None
+        or args.emit_dot is not None
+        or args.emit_effectgraph is not None
+    )
+    if graph_flags:
         # Graph modes operate on the tpudra package's static model; the
         # lint-mode arguments have no meaning there — reject rather than
         # silently ignore them.
@@ -92,18 +140,28 @@ def main(argv: list[str] | None = None) -> int:
             for name, present in (
                 ("--json", args.json),
                 ("--lockgraph", args.lockgraph),
+                ("--effectgraph", args.effectgraph),
                 ("paths", bool(args.paths)),
             )
             if present
         ]
         if rejected:
             print(
-                "tpudra-lockgraph: --witness/--emit-dot cannot be combined "
-                f"with {', '.join(rejected)}",
+                "tpudra-lockgraph: graph modes (--witness/--wal-witness/"
+                "--emit-dot/--emit-effectgraph) cannot be combined with "
+                f"{', '.join(rejected)}",
                 file=sys.stderr,
             )
             return 2
         return _graph_mode(args)
+
+    if args.lockgraph and args.effectgraph:
+        print(
+            "tpudra-lint: --lockgraph and --effectgraph are separate lanes; "
+            "run the full analyzer for both",
+            file=sys.stderr,
+        )
+        return 2
 
     paths = args.paths
     if not paths:
@@ -125,67 +183,118 @@ def main(argv: list[str] | None = None) -> int:
         from tpudra.analysis.rules import lockgraph_rules
 
         rules = lockgraph_rules()
+    elif args.effectgraph:
+        from tpudra.analysis.rules import effectgraph_rules
+
+        rules = effectgraph_rules()
+    started = time.monotonic()
     modules, parse_findings = parse_paths(paths)
     findings = lint_modules(modules, parse_findings, rules=rules)
+    elapsed = time.monotonic() - started
     if args.json:
+        # The stable machine schema; see the module docstring.  Keys and
+        # their meanings only ever grow — tests/test_lint.py pins them.
         print(
             json.dumps(
-                [
-                    {
-                        "path": f.path,
-                        "line": f.line,
-                        "col": f.col,
-                        "rule": f.rule_id,
-                        "message": f.message,
-                    }
-                    for f in findings
-                ],
+                {
+                    "schema": "tpudra-analysis/v1",
+                    "findings": [
+                        {
+                            "rule": f.rule_id,
+                            "path": f.path,
+                            "line": f.line,
+                            "col": f.col,
+                            "message": f.message,
+                        }
+                        for f in findings
+                    ],
+                    "count": len(findings),
+                },
                 indent=2,
             )
         )
     else:
-        name = "tpudra-lockgraph" if args.lockgraph else "tpudra-lint"
+        name = "tpudra-lint"
+        if args.lockgraph:
+            name = "tpudra-lockgraph"
+        elif args.effectgraph:
+            name = "tpudra-effectgraph"
         for f in findings:
             print(f.render())
         n = len(findings)
+        verdict = (
+            f"{n} finding{'s' if n != 1 else ''}" if n else "clean"
+        )
         print(
-            f"{name}: {n} finding{'s' if n != 1 else ''}"
-            if n
-            else f"{name}: clean"
+            f"{name}: {verdict} "
+            f"({len(modules)} modules in {elapsed:.2f}s)"
         )
     return 1 if findings else 0
 
 
 def _graph_mode(args) -> int:
-    """--witness / --emit-dot: operate on the static lock graph of the
-    tpudra package (the lockgraph's scope) rather than on lint findings."""
-    from tpudra.analysis.witness import build_graph, emit_markdown, merge
-
+    """--witness / --wal-witness / --emit-dot / --emit-effectgraph: operate
+    on the static whole-program models of the tpudra package rather than on
+    lint findings.  One shared parse pass and one shared CallGraph feed
+    whichever of the two models the flags require."""
     root = _repo_root()
-    if args.witness is not None and not os.path.exists(args.witness):
-        # Before the (multi-second) whole-program pass: a typo'd log path
-        # is a usage error, not a reason to build and maybe rewrite docs.
-        print(
-            f"tpudra-lockgraph: no witness log at {args.witness}",
-            file=sys.stderr,
-        )
-        return 2
-    result = build_graph(os.path.join(root, "tpudra"))
+    for flag, log in (("witness", args.witness), ("wal-witness", args.wal_witness)):
+        if log is not None and not os.path.exists(log):
+            # Before the (multi-second) whole-program pass: a typo'd log
+            # path is a usage error, not a reason to build and maybe
+            # rewrite docs.
+            print(
+                f"tpudra-lockgraph: no --{flag} log at {log}",
+                file=sys.stderr,
+            )
+            return 2
+
+    from tpudra.analysis.callgraph import CallGraph
+
+    modules, _ = parse_paths([os.path.join(root, "tpudra")])
+    graph = CallGraph(modules)
     rc = 0
-    if args.emit_dot is not None:
-        out_path = args.emit_dot
-        if not os.path.isabs(out_path):
-            out_path = os.path.join(root, out_path)
-        with open(out_path, "w", encoding="utf-8") as f:
-            f.write(emit_markdown(result))
-        print(
-            f"tpudra-lockgraph: wrote {out_path} "
-            f"({len(result.locks)} locks, {len(result.edges)} edges)"
-        )
-    if args.witness is not None:
-        report = merge(result, args.witness)
-        print(report.render())
-        rc = 0 if report.ok else 1
+
+    if args.emit_dot is not None or args.witness is not None:
+        from tpudra.analysis import witness
+        from tpudra.analysis.lockmodel import analyze_modules
+
+        result = analyze_modules(modules, graph)
+        if args.emit_dot is not None:
+            out_path = args.emit_dot
+            if not os.path.isabs(out_path):
+                out_path = os.path.join(root, out_path)
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write(witness.emit_markdown(result))
+            print(
+                f"tpudra-lockgraph: wrote {out_path} "
+                f"({len(result.locks)} locks, {len(result.edges)} edges)"
+            )
+        if args.witness is not None:
+            report = witness.merge(result, args.witness)
+            print(report.render())
+            rc = rc or (0 if report.ok else 1)
+
+    if args.emit_effectgraph is not None or args.wal_witness is not None:
+        from tpudra.analysis import effectwitness
+        from tpudra.analysis.effectmodel import analyze_effects
+
+        eresult = analyze_effects(modules, graph)
+        if args.emit_effectgraph is not None:
+            out_path = args.emit_effectgraph
+            if not os.path.isabs(out_path):
+                out_path = os.path.join(root, out_path)
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write(effectwitness.emit_markdown(eresult))
+            print(
+                f"tpudra-effectgraph: wrote {out_path} "
+                f"({len(eresult.kinds)} kinds, {len(eresult.effects)} effect "
+                f"sites, {len(eresult.commits)} commit sites)"
+            )
+        if args.wal_witness is not None:
+            report = effectwitness.merge(eresult, args.wal_witness)
+            print(report.render())
+            rc = rc or (0 if report.ok else 1)
     return rc
 
 

@@ -1,0 +1,921 @@
+"""tpudra-effectgraph: the whole-program WAL crash-consistency model.
+
+Where lockmodel.py proves ordering facts about *locks*, this module proves
+ordering facts about the repo's actual survival contract: every hardware /
+disk / daemon side effect on the bind path is dominated by a durable intent
+record, every record kind that can land in the checkpoint has a recovery
+handler, every controller commit goes through the leadership fence, and
+cross-family mutators touch record families in the canonical stripe order
+(the pre-flight for ROADMAP item 1's striped checkpoint).
+
+Built on the same shared parse pass and call graph as the lock analysis:
+
+1. **Record-kind classification** — every ``cp.prepared_claims[KEY]``
+   write/pop/read is classified into a record family by its key shape:
+   constant prefixes (``partition/``, ``gang/``, ``gangmeta/term``), the
+   well-known constructors (``partrec.record_uid``, ``_guid``,
+   ``make_record``), uid-ish variable names, or an explicit
+   ``# tpudra-wal: kind=NAME <why>`` annotation.  Unclassifiable keys are
+   excluded from the ordering/commit sets rather than guessed.
+
+2. **Commit-kind extraction** — a ``*.mutate(fn, ...)`` call on a
+   checkpoint-ish receiver is a *commit site*; its kinds are the
+   transitive classified touches of the resolved mutator closure
+   (nested defs, lambdas, called helpers like ``_start_one``, and
+   function-valued parameters such as the gang fence funnel's ``fn``).
+
+3. **Interprocedural effect walk** — from every call-graph root,
+   statements are walked in order carrying the running *journaled* set;
+   commits add kinds, registered effect calls check them.  The walk is
+   linear (order-sensitive, path-insensitive): a commit lexically earlier
+   on ANY branch counts, which over-approximates domination the same way
+   every static rule here errs toward silence on conditional paths — the
+   runtime witness (tpudra/walwitness.py) is the cross-check for the
+   missed-violation direction, exactly like the lock witness.
+
+Rule families (all anchored at real sites, all suppressible the standard
+way):
+
+- ``WAL-INTENT-BEFORE-EFFECT`` — a registered side effect reachable with
+  no journaled intent record of its matching kind dominating it.
+- ``WAL-RECOVERY-EXHAUSTIVE`` — two-sided: every record kind committed
+  anywhere has a ``# tpudra-wal: recovers=KIND`` handler, and every
+  declared handler matches a kind actually committed (dead handlers and
+  orphan kinds are both findings; unknown kind names too).
+- ``FENCE-DOMINATES-COMMIT`` — a checkpoint commit site in controller
+  code whose enclosing function never consults the ``gangmeta/term``
+  fence record (the static form of the runtime StaleLeader refusal).
+- ``STRIPE-ORDER`` — a mutator scope that first-touches record families
+  out of the canonical ``gangmeta < gang < claim < partition`` order.
+
+Annotations (comment on the line, or alone on the line above):
+
+    # tpudra-wal: kind=NAME <reason>          — classify this record key
+    # tpudra-wal: recovers=KIND[,KIND] <reason> — this function is the
+    #     recovery-sweep handler for KIND (its subtree treats KIND as
+    #     journaled: recovery acts from checkpoint truth)
+    # tpudra-wal: nonrecoverable <reason>     — this effect (or every
+    #     effect in this function) deliberately runs without a journaled
+    #     intent record; the reason must say why convergence still holds
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpudra.analysis import astutil
+from tpudra.analysis.callgraph import CallGraph, FunctionInfo
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.lockmodel import _rel
+from tpudra.walwitness import record_kind
+
+#: Canonical stripe order — the family-lock acquisition order the striped
+#: checkpoint (ROADMAP item 1) will enforce at runtime.  gangmeta first:
+#: the fence outranks everything it fences.  gang before claim before
+#: partition mirrors ownership: a gang spans claims, a claim spans its
+#: partitions — acquiring owners before leaves keeps cross-stripe commits
+#: deadlock-free by construction.
+STRIPE_FAMILIES = ("gangmeta", "gang", "claim", "partition")
+_STRIPE_INDEX = {k: i for i, k in enumerate(STRIPE_FAMILIES)}
+
+#: Receiver names that denote a CheckpointManager for ``.mutate`` commit
+#: detection (name-heuristic, like every classification in astutil).
+_CP_RECEIVERS = frozenset({"_cp", "cp", "cpw", "cp_mgr", "checkpoints", "checkpoint"})
+
+#: Well-known uid-constructor names (plugin/partitions.py, controller/gang.py).
+_KEY_CALL_KINDS = {"record_uid": "partition", "_guid": "gang"}
+_PREFIX_NAME_KINDS = {
+    "GANG_UID_PREFIX": "gang",
+    "GANG_META_UID": "gangmeta",
+    "PARTITION_RECORD_PREFIX": "partition",
+}
+#: RHS constructor hints: ``partrec.make_record(...)`` builds a partition
+#: record, ``self._record(...)`` a gang record (gang.py's only record ctor).
+_VALUE_CALL_KINDS = {"make_record": "partition", "_record": "gang"}
+
+_MAX_CLOSURE_DEPTH = 6
+_MAX_WALK_DEPTH = 14
+
+_WAL_ANNOTATION_RE = re.compile(r"#\s*tpudra-wal:\s*(?P<body>.+)")
+_WAL_KV_RE = re.compile(r"^(kind|recovers)=(\S+)$")
+
+
+# ---------------------------------------------------------------- annotations
+
+
+@dataclass
+class WalDirective:
+    kind: Optional[str] = None
+    recovers: tuple[str, ...] = ()
+    nonrecoverable: bool = False
+    line: int = 0
+
+
+class WalAnnotations:
+    """``# tpudra-wal: ...`` directives of one file, by line (a directive
+    alone on its line also covers the next, like lint suppressions and
+    lock annotations)."""
+
+    def __init__(self, source: str):
+        self.by_line: dict[int, WalDirective] = {}
+        try:
+            tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _WAL_ANNOTATION_RE.search(tok.string)
+                if not m:
+                    continue
+                directive = WalDirective(line=tok.start[0])
+                for word in m.group("body").split():
+                    kv = _WAL_KV_RE.match(word)
+                    if kv:
+                        if kv.group(1) == "kind":
+                            directive.kind = kv.group(2)
+                        else:
+                            directive.recovers = tuple(kv.group(2).split(","))
+                    elif word == "nonrecoverable":
+                        directive.nonrecoverable = True
+                    else:
+                        break  # free-text reason starts
+                line = tok.start[0]
+                self.by_line[line] = directive
+                if tok.line.strip().startswith("#"):
+                    self.by_line.setdefault(line + 1, directive)
+        except tokenize.TokenError:
+            pass
+
+    def at(self, line: int) -> Optional[WalDirective]:
+        return self.by_line.get(line)
+
+
+# -------------------------------------------------------------- effect specs
+
+
+@dataclass(frozen=True)
+class EffectSpec:
+    """One registered irreversible-ish side effect: the call shape that
+    identifies it and the record kind whose durable intent must dominate
+    it.  Teardown counterparts (delete_claim_spec_file, daemon.stop,
+    vfio unconfigure) are deliberately NOT registered: they are
+    convergent-by-design idempotent cleanup the recovery sweep re-runs
+    freely — only effects that *create* state the checkpoint must cover
+    need a dominating intent record."""
+
+    effect_id: str  # stable id, shared with tpudra/walwitness.py hooks
+    attr: str  # called attribute name
+    receivers: frozenset  # receiver terminal-name hints
+    requires: str  # record kind that must be journaled first
+
+
+EFFECTS: tuple[EffectSpec, ...] = (
+    EffectSpec(
+        "partition:create", "create_partition",
+        frozenset({"_lib", "lib", "devicelib"}), "partition",
+    ),
+    EffectSpec(
+        "partition:destroy", "delete_partition",
+        frozenset({"_lib", "lib", "devicelib"}), "partition",
+    ),
+    EffectSpec(
+        "cdi:spec-write", "create_claim_spec_file",
+        frozenset({"_cdi", "cdi"}), "claim",
+    ),
+    EffectSpec(
+        "daemon:start", "new_daemon", frozenset({"_mp", "mp"}), "claim",
+    ),
+    EffectSpec(
+        "timeslice:set", "set_timeslice", frozenset({"_ts", "ts"}), "claim",
+    ),
+    EffectSpec(
+        "vfio:configure", "configure", frozenset({"_vfio", "vfio"}), "claim",
+    ),
+    EffectSpec(
+        "gang:bind", "bind", frozenset({"_binder", "binder"}), "gang",
+    ),
+)
+
+_EFFECT_BY_ATTR: dict[str, list[EffectSpec]] = {}
+for _spec in EFFECTS:
+    _EFFECT_BY_ATTR.setdefault(_spec.attr, []).append(_spec)
+
+
+# ------------------------------------------------------------------- results
+
+
+@dataclass
+class WriteSite:
+    path: str
+    line: int
+    kind: Optional[str]
+    is_pop: bool = False
+    nonrecoverable: bool = False
+
+
+@dataclass
+class CommitSite:
+    path: str
+    line: int
+    qualname: str  # enclosing top-level function
+    kinds: set = field(default_factory=set)  # touched (read or written)
+    written: set = field(default_factory=set)
+    fenced: bool = False
+    in_controller: bool = False
+
+
+@dataclass
+class EffectSite:
+    spec: EffectSpec
+    path: str
+    line: int
+    chain: str = ""  # root → ... call chain of the first walk reaching it
+    journaled_ok: bool = False
+    nonrecoverable: bool = False
+    reached: bool = False
+
+
+@dataclass
+class KindInfo:
+    kind: str
+    written_at: list = field(default_factory=list)  # [(path, line)]
+    handlers: list = field(default_factory=list)  # [(path, line, qualname)]
+
+
+@dataclass
+class EffectGraphResult:
+    kinds: dict  # kind → KindInfo
+    effects: list  # [EffectSite], sorted
+    commits: list  # [CommitSite], sorted
+    findings: list  # [Finding]
+
+    def effect_ids(self) -> set:
+        """Effect ids with at least one static call site — the model's
+        universe for the witness merge (a witnessed id outside it is a
+        model gap)."""
+        return {e.spec.effect_id for e in self.effects}
+
+    def required_kind(self, effect_id: str) -> Optional[str]:
+        for spec in EFFECTS:
+            if spec.effect_id == effect_id:
+                return spec.requires
+        return None
+
+
+# ------------------------------------------------------------------ analysis
+
+
+@dataclass
+class _Callable:
+    """One walkable callable: a top-level function/method, or a nested
+    def / lambda whose ``ctx`` is the enclosing FunctionInfo (for self/
+    import resolution and finding anchors)."""
+
+    node: ast.AST  # FunctionDef | Lambda
+    ctx: FunctionInfo
+    label: str
+
+
+def _ordered_calls(node: ast.AST):
+    """Call nodes in document order, not descending into nested function /
+    class / lambda bodies (those run when called, not here)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(
+            child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        if isinstance(child, ast.Call):
+            yield child
+        yield from _ordered_calls(child)
+
+
+def _nested_defs(node: ast.AST) -> dict:
+    """name → FunctionDef for every def nested anywhere under ``node``
+    (first definition wins; shadowing nested defs would be a lint smell
+    anyway)."""
+    out: dict[str, ast.FunctionDef] = {}
+    for sub in ast.walk(node):
+        if sub is node:
+            continue
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(sub.name, sub)
+    return out
+
+
+def _short(qualname: str) -> str:
+    mod, _, rest = qualname.partition(":")
+    return rest or mod
+
+
+class EffectAnalysis:
+    def __init__(self, modules: list, graph: Optional[CallGraph] = None):
+        self.modules = modules
+        self.graph = graph or CallGraph(modules)
+        self.annotations = {m.path: WalAnnotations(m.source) for m in modules}
+        self.findings: list[Finding] = []
+        self.effect_sites: dict[tuple, EffectSite] = {}  # (path, line, id)
+        self.commit_sites: dict[tuple, CommitSite] = {}  # (path, line)
+        self.kind_writes: dict[str, list] = {}  # kind → [(path, line, nonrec)]
+        self.handlers: dict[str, list] = {}  # kind → [(path, line, qualname)]
+        self._scan_cache: dict[int, tuple] = {}
+        #: memo key → frozenset of kinds the walk ADDED to its caller's
+        #: journaled set (replayed on memo hits; a bare visited-set would
+        #: lose a callee's commits for every caller after the first).
+        self._walk_memo: dict = {}
+        self._violations: dict[tuple, Finding] = {}
+        self._walked_nested: set = set()
+
+    # -- annotation helpers -------------------------------------------------
+
+    def _ann(self, path: str, line: int) -> Optional[WalDirective]:
+        ann = self.annotations.get(path)
+        return ann.at(line) if ann is not None else None
+
+    def _check_known_kinds(self, d: WalDirective, path: str) -> None:
+        for name in ((d.kind,) if d.kind else ()) + d.recovers:
+            if name not in _STRIPE_INDEX:
+                self.findings.append(
+                    Finding(
+                        path, d.line, 0, "WAL-RECOVERY-EXHAUSTIVE",
+                        f"annotation names unknown record kind {name!r} — "
+                        f"known kinds: {', '.join(STRIPE_FAMILIES)}",
+                    )
+                )
+
+    # -- key classification -------------------------------------------------
+
+    def _classify_name(self, name: str) -> Optional[str]:
+        low = name.lower()
+        if low == "gang_meta_uid":
+            return "gangmeta"
+        if low.startswith("rec") or "record" in low:
+            return None  # record-uid locals carry any family; annotate
+        if low == "guid" or "gang" in low:
+            return "gang"
+        if low == "uid" or low.endswith("uid"):
+            return "claim"
+        return None
+
+    def _classify_expr(self, e: ast.AST) -> Optional[str]:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            return record_kind(e.value)
+        if isinstance(e, ast.Name):
+            kind = _PREFIX_NAME_KINDS.get(e.id)
+            return kind or self._classify_name(e.id)
+        if isinstance(e, ast.Attribute):
+            kind = _PREFIX_NAME_KINDS.get(e.attr)
+            return kind or self._classify_name(e.attr)
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+            left = e.left
+            if isinstance(left, ast.Constant) and isinstance(left.value, str):
+                return record_kind(left.value + "x")
+            if isinstance(left, (ast.Name, ast.Attribute)):
+                name = left.id if isinstance(left, ast.Name) else left.attr
+                if name in _PREFIX_NAME_KINDS:
+                    return _PREFIX_NAME_KINDS[name]
+        if isinstance(e, ast.Call):
+            return _KEY_CALL_KINDS.get(astutil.call_name(e))
+        return None
+
+    def _classify_write(
+        self, key: ast.AST, value: Optional[ast.AST], path: str, line: int
+    ) -> Optional[str]:
+        d = self._ann(path, line)
+        if d is not None and d.kind:
+            return d.kind
+        kind = self._classify_expr(key)
+        if kind is not None:
+            return kind
+        if isinstance(value, ast.Call):
+            kind = _VALUE_CALL_KINDS.get(astutil.call_name(value))
+            if kind is not None:
+                return kind
+            for kw in value.keywords:
+                if kw.arg == "uid":
+                    return self._classify_expr(kw.value)
+        return None
+
+    # -- scope scanning -----------------------------------------------------
+
+    @staticmethod
+    def _prepared_claims_recv(node: ast.AST) -> bool:
+        return isinstance(node, ast.Attribute) and node.attr == "prepared_claims"
+
+    def _scan_scope(self, cal: _Callable) -> tuple:
+        """(writes, touches, nested) of one callable body, shallow.
+
+        writes: WriteSite per classified-or-not assignment/pop;
+        touches: [(kind, line)] including plain reads (a ``.get(key)`` in
+        a mutator closure is a touched claim — the delta derivation emits
+        a record for it, so it journals intent exactly like an assign);
+        nested: name → FunctionDef."""
+        key = id(cal.node)
+        cached = self._scan_cache.get(key)
+        if cached is not None:
+            return cached
+        writes: list[WriteSite] = []
+        touches: list[tuple] = []
+        body = cal.node.body if isinstance(cal.node.body, list) else [cal.node.body]
+        for sub in astutil.walk_body_shallow(body):
+            key_node = value_node = None
+            is_pop = False
+            is_read = False
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Subscript)
+                and self._prepared_claims_recv(sub.targets[0].value)
+            ):
+                key_node, value_node = sub.targets[0].slice, sub.value
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("pop", "get", "setdefault")
+                and self._prepared_claims_recv(sub.func.value)
+                and sub.args
+            ):
+                key_node = sub.args[0]
+                is_pop = sub.func.attr == "pop"
+                is_read = sub.func.attr == "get"
+            elif (
+                isinstance(sub, ast.Subscript)
+                and isinstance(sub.ctx, ast.Load)
+                and self._prepared_claims_recv(sub.value)
+            ):
+                key_node, is_read = sub.slice, True
+            if key_node is None:
+                continue
+            line = sub.lineno
+            kind = self._classify_write(key_node, value_node, cal.ctx.path, line)
+            d = self._ann(cal.ctx.path, line)
+            nonrec = d.nonrecoverable if d is not None else False
+            if kind is not None:
+                touches.append((kind, line))
+            if not is_read:
+                writes.append(
+                    WriteSite(cal.ctx.path, line, kind, is_pop, nonrec)
+                )
+        result = (writes, touches, _nested_defs(cal.node))
+        self._scan_cache[key] = result
+        return result
+
+    # -- callable resolution ------------------------------------------------
+
+    def _as_callable(
+        self, expr: ast.AST, cal: _Callable, bindings: dict
+    ) -> Optional[_Callable]:
+        if isinstance(expr, ast.Lambda):
+            return _Callable(expr, cal.ctx, f"{cal.label}.<lambda>")
+        if isinstance(expr, ast.Name):
+            bound = bindings.get(expr.id)
+            if bound is not None:
+                return bound
+            _, _, nested = self._scan_scope(cal)
+            node = nested.get(expr.id)
+            if node is not None:
+                return _Callable(node, cal.ctx, f"{cal.label}.{expr.id}")
+            fn = self.graph.module_function(cal.ctx.module, expr.id)
+            if fn is not None:
+                return _Callable(fn.node, fn, _short(fn.qualname))
+            return None
+        if isinstance(expr, ast.Attribute):
+            # A method *reference* (``self.state.run_prepare_effects``):
+            # resolve by unique name, the same fallback the call resolver
+            # uses for untyped receivers.
+            fn = self.graph.unique_method(expr.attr)
+            if fn is not None:
+                return _Callable(fn.node, fn, _short(fn.qualname))
+        return None
+
+    def _bind_args(
+        self, call: ast.Call, fn: FunctionInfo, cal: _Callable, bindings: dict
+    ) -> dict:
+        """Function-valued actual args bound to the callee's parameter
+        names — how the gang fence funnel's ``fn`` and the driver's
+        effects-phase dispatch resolve."""
+        params = [a.arg for a in fn.node.args.args]
+        if fn.class_name and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        out: dict[str, _Callable] = {}
+        for i, actual in enumerate(call.args):
+            if i >= len(params):
+                break
+            c = self._as_callable(actual, cal, bindings)
+            if c is not None:
+                out[params[i]] = c
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params:
+                c = self._as_callable(kw.value, cal, bindings)
+                if c is not None:
+                    out[kw.arg] = c
+        return out
+
+    def _call_targets(
+        self, call: ast.Call, cal: _Callable, bindings: dict
+    ) -> list:
+        """[(callable, child_bindings)] a call may land on."""
+        out = []
+        func = call.func
+        if isinstance(func, ast.Name):
+            c = self._as_callable(func, cal, bindings)
+            if c is not None:
+                if isinstance(c.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # Nested defs close over the enclosing bindings (the
+                    # fence funnel's ``fenced`` calls its free ``fn``).
+                    child = dict(bindings) if c.ctx is cal.ctx else {}
+                else:
+                    child = {}
+                out.append((c, child))
+            return out
+        if not isinstance(func, ast.Attribute):
+            return out
+        fn = self.graph.resolve_call(call, cal.ctx)
+        if fn is not None:
+            out.append(
+                (
+                    _Callable(fn.node, fn, _short(fn.qualname)),
+                    self._bind_args(call, fn, cal, bindings),
+                )
+            )
+        if func.attr == "_run_effects" and len(call.args) >= 2:
+            # Driver._run_effects(items, self.state.run_X_effects, ...):
+            # the second arg is invoked per item on worker threads — the
+            # reference must be walked as a direct call or the effects
+            # phase would look unreachable (and become a journal-less
+            # root).  Mirrors lockmodel's effect-target collection.
+            c = self._as_callable(call.args[1], cal, bindings)
+            if c is not None:
+                out.append((c, {}))
+        return out
+
+    # -- commit handling ----------------------------------------------------
+
+    @staticmethod
+    def _is_commit(call: ast.Call) -> bool:
+        return (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "mutate"
+            and astutil.terminal_name(call.func.value) in _CP_RECEIVERS
+        )
+
+    def _closure_kinds(
+        self, cal: _Callable, bindings: dict, depth: int, visited: set
+    ) -> tuple:
+        """(written, touched, write_sites) of a mutator closure, following
+        nested calls and bound function parameters."""
+        key = id(cal.node)
+        if key in visited or depth > _MAX_CLOSURE_DEPTH:
+            return set(), set(), []
+        visited = visited | {key}
+        writes, touches, _ = self._scan_scope(cal)
+        written = {w.kind for w in writes if w.kind is not None}
+        touched = {k for k, _ in touches} | written
+        sites = list(writes)
+        for call in _ordered_calls(cal.node):
+            if self._is_commit(call):
+                continue  # a nested commit journals for itself
+            for target, child in self._call_targets(call, cal, bindings):
+                w, t, s = self._closure_kinds(target, child, depth + 1, visited)
+                written |= w
+                touched |= t
+                sites.extend(s)
+        return written, touched, sites
+
+    def _commit_kinds(
+        self, call: ast.Call, cal: _Callable, bindings: dict
+    ) -> tuple:
+        arg = call.args[0] if call.args else None
+        if arg is None:
+            return set(), set(), []
+        c = self._as_callable(arg, cal, bindings)
+        if c is None:
+            return set(), set(), []
+        return self._closure_kinds(c, bindings, 0, set())
+
+    def _fence_checked(self, fn: FunctionInfo) -> bool:
+        for sub in ast.walk(fn.node):
+            if isinstance(sub, ast.Name) and sub.id == "GANG_META_UID":
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr == "GANG_META_UID":
+                return True
+            if (
+                isinstance(sub, ast.Constant)
+                and isinstance(sub.value, str)
+                and sub.value == "gangmeta/term"
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _in_controller(path: str) -> bool:
+        rel = _rel(path)
+        return "controller" in rel.replace(os.sep, "/").split("/") or (
+            "controller" in os.path.basename(path)
+        )
+
+    def _note_commit(
+        self, call: ast.Call, cal: _Callable, bindings: dict
+    ) -> set:
+        written, touched, sites = self._commit_kinds(call, cal, bindings)
+        key = (cal.ctx.path, call.lineno)
+        site = self.commit_sites.get(key)
+        if site is None:
+            site = CommitSite(
+                path=cal.ctx.path,
+                line=call.lineno,
+                qualname=cal.ctx.qualname,
+                fenced=self._fence_checked(cal.ctx),
+                in_controller=self._in_controller(cal.ctx.path),
+            )
+            self.commit_sites[key] = site
+        site.kinds |= touched
+        site.written |= written
+        for w in sites:
+            if w.kind is not None and not w.is_pop:
+                self.kind_writes.setdefault(w.kind, []).append(
+                    (w.path, w.line, w.nonrecoverable)
+                )
+        return touched
+
+    # -- the interprocedural walk -------------------------------------------
+
+    def _def_directive(self, cal: _Callable) -> Optional[WalDirective]:
+        if isinstance(cal.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return self._ann(cal.ctx.path, cal.node.lineno)
+        return None
+
+    def _walk(
+        self,
+        cal: _Callable,
+        bindings: dict,
+        journaled: set,
+        stack: tuple,
+        chain: str,
+    ) -> None:
+        key = id(cal.node)
+        if key in stack or len(stack) > _MAX_WALK_DEPTH:
+            return
+        memo = (
+            key,
+            frozenset(journaled),
+            tuple(sorted((k, id(v.node)) for k, v in bindings.items())),
+        )
+        cached = self._walk_memo.get(memo)
+        if cached is not None:
+            # A callee's commits journal for its caller's later calls too —
+            # replay what the first walk from this entry state added.
+            journaled |= cached
+            return
+        self._walk_memo[memo] = frozenset()  # in-progress: cycles add nothing
+        self._walked_nested.add(key)
+        stack = stack + (key,)
+        entered = set(journaled)
+        for call in _ordered_calls(cal.node):
+            if self._is_commit(call):
+                journaled |= self._note_commit(call, cal, bindings)
+                continue
+            self._check_effect(call, cal, journaled, chain)
+            for target, child in self._call_targets(call, cal, bindings):
+                d = self._def_directive(target)
+                if d is not None and d.nonrecoverable:
+                    continue  # acknowledged journal-less subtree
+                if d is not None and d.recovers:
+                    # Recovery acts from checkpoint truth: within the
+                    # handler's subtree its kinds ARE journaled — but the
+                    # assumption must not leak back to the caller.
+                    self._walk(
+                        target, child, journaled | set(d.recovers),
+                        stack, chain + " → " + target.label,
+                    )
+                else:
+                    self._walk(
+                        target, child, journaled,
+                        stack, chain + " → " + target.label,
+                    )
+        self._walk_memo[memo] = frozenset(journaled - entered)
+
+    def _check_effect(
+        self, call: ast.Call, cal: _Callable, journaled: set, chain: str
+    ) -> None:
+        if not isinstance(call.func, ast.Attribute):
+            return
+        specs = _EFFECT_BY_ATTR.get(call.func.attr)
+        if not specs:
+            return
+        recv = astutil.terminal_name(call.func.value)
+        for spec in specs:
+            if recv not in spec.receivers:
+                continue
+            skey = (cal.ctx.path, call.lineno, spec.effect_id)
+            site = self.effect_sites.get(skey)
+            if site is None:
+                site = EffectSite(spec, cal.ctx.path, call.lineno)
+                self.effect_sites[skey] = site
+            d = self._ann(cal.ctx.path, call.lineno)
+            if d is not None and d.nonrecoverable:
+                site.nonrecoverable = True
+                site.reached = True
+                continue
+            if spec.requires in journaled:
+                site.journaled_ok = True
+                if not site.reached:
+                    site.chain = chain
+                site.reached = True
+                continue
+            site.reached = True
+            vkey = (cal.ctx.path, call.lineno, spec.effect_id)
+            if vkey not in self._violations:
+                site.chain = chain
+                self._violations[vkey] = Finding(
+                    cal.ctx.path, call.lineno, call.col_offset,
+                    "WAL-INTENT-BEFORE-EFFECT",
+                    f"effect '{spec.effect_id}' can run with no journaled "
+                    f"'{spec.requires}' intent record dominating it "
+                    f"(path: {chain}) — commit the intent (cp.mutate) "
+                    "before the side effect, or annotate the site "
+                    "'# tpudra-wal: nonrecoverable <why convergence holds>'",
+                )
+
+    # -- lexical passes -----------------------------------------------------
+
+    def _collect_handlers_and_stripe(self) -> None:
+        for m in self.modules:
+            ann = self.annotations[m.path]
+            checked: set = set()
+            for d in ann.by_line.values():
+                # A comment-only directive registers on two lines (its own
+                # and the next); validate each directive object once.
+                if id(d) not in checked:
+                    checked.add(id(d))
+                    self._check_known_kinds(d, m.path)
+            mod_fns = [
+                fn for fn in self.graph.functions.values() if fn.path == m.path
+            ]
+            seen_nodes: set = set()
+            for fn in mod_fns:
+                for node in ast.walk(fn.node):
+                    if not isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    if id(node) in seen_nodes:
+                        continue
+                    seen_nodes.add(id(node))
+                    d = ann.at(node.lineno)
+                    if d is not None and d.recovers:
+                        for kind in d.recovers:
+                            if kind in _STRIPE_INDEX:
+                                self.handlers.setdefault(kind, []).append(
+                                    (m.path, node.lineno, node.name)
+                                )
+                    self._check_stripe_order(
+                        _Callable(node, fn, node.name)
+                    )
+
+    def _check_stripe_order(self, cal: _Callable) -> None:
+        writes, _, _ = self._scan_scope(cal)
+        max_idx = -1
+        max_kind = ""
+        flagged = False
+        seen: set = set()
+        for w in sorted(writes, key=lambda w: w.line):
+            if w.kind is None or w.kind in seen:
+                continue
+            seen.add(w.kind)
+            idx = _STRIPE_INDEX[w.kind]
+            if idx < max_idx and not flagged:
+                flagged = True
+                self.findings.append(
+                    Finding(
+                        w.path, w.line, 0, "STRIPE-ORDER",
+                        f"mutator first-touches record family '{w.kind}' "
+                        f"after '{max_kind}' — cross-family mutators must "
+                        "touch stripe families in the canonical order "
+                        f"{' < '.join(STRIPE_FAMILIES)} (docs/effect-graph.md) "
+                        "so the striped checkpoint can lock families "
+                        "deadlock-free",
+                    )
+                )
+            if idx > max_idx:
+                max_idx, max_kind = idx, w.kind
+        return
+
+    # -- roots --------------------------------------------------------------
+
+    def _roots(self) -> list:
+        called: set = set()
+        for fn in self.graph.functions.values():
+            cal = _Callable(fn.node, fn, _short(fn.qualname))
+            for call in _ordered_calls(fn.node):
+                for target, _ in self._call_targets(call, cal, {}):
+                    if isinstance(
+                        target.node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ) and target.ctx is not fn:
+                        called.add(id(target.node))
+        return [
+            fn
+            for fn in self.graph.functions.values()
+            if id(fn.node) not in called
+        ]
+
+    # -- rule finalization --------------------------------------------------
+
+    def _finalize_recovery(self) -> None:
+        for kind in sorted(self.kind_writes):
+            sites = sorted(set(self.kind_writes[kind]))
+            if kind in self.handlers:
+                continue
+            live = [s for s in sites if not s[2]]  # not nonrecoverable
+            if not live:
+                continue
+            path, line, _ = live[0]
+            others = len(live) - 1
+            suffix = f" (and {others} other site(s))" if others else ""
+            self.findings.append(
+                Finding(
+                    path, line, 0, "WAL-RECOVERY-EXHAUSTIVE",
+                    f"record kind '{kind}' is committed here{suffix} but no "
+                    "recovery sweep declares '# tpudra-wal: "
+                    f"recovers={kind} <why>' — a crash after this commit "
+                    "leaves a record nothing converges",
+                )
+            )
+        for kind in sorted(self.handlers):
+            if kind in self.kind_writes:
+                continue
+            for path, line, name in sorted(self.handlers[kind]):
+                self.findings.append(
+                    Finding(
+                        path, line, 0, "WAL-RECOVERY-EXHAUSTIVE",
+                        f"dead recovery handler: {name} declares "
+                        f"recovers={kind} but no commit site ever writes a "
+                        f"'{kind}' record — drop the annotation or wire the "
+                        "writer",
+                    )
+                )
+
+    def _finalize_fence(self) -> None:
+        for site in self.commit_sites.values():
+            if site.in_controller and not site.fenced:
+                self.findings.append(
+                    Finding(
+                        site.path, site.line, 0, "FENCE-DOMINATES-COMMIT",
+                        f"checkpoint commit in controller code "
+                        f"({_short(site.qualname)}) is not dominated by a "
+                        "gangmeta/term fence check — route it through the "
+                        "fenced funnel (GangReservationManager._mutate) so "
+                        "a stale leader's write is refused inside the WAL "
+                        "transaction",
+                    )
+                )
+
+    def run(self) -> EffectGraphResult:
+        self._collect_handlers_and_stripe()
+        for fn in sorted(self._roots(), key=lambda f: f.qualname):
+            self._walk(
+                _Callable(fn.node, fn, _short(fn.qualname)),
+                {}, set(), (), _short(fn.qualname),
+            )
+        # Nested defs nobody invoked (registered callbacks, thread targets):
+        # walk each as its own journal-less root so their effects are not
+        # silently unmodeled.
+        for fn in sorted(self.graph.functions.values(), key=lambda f: f.qualname):
+            for name, node in sorted(_nested_defs(fn.node).items()):
+                if id(node) in self._walked_nested:
+                    continue
+                self._walk(
+                    _Callable(node, fn, f"{_short(fn.qualname)}.{name}"),
+                    {}, set(), (), f"{_short(fn.qualname)}.{name}",
+                )
+        self.findings.extend(self._violations.values())
+        self._finalize_recovery()
+        self._finalize_fence()
+        kinds = {}
+        for kind in STRIPE_FAMILIES:
+            info = KindInfo(kind)
+            info.written_at = sorted(
+                {(p, line) for p, line, _ in self.kind_writes.get(kind, [])}
+            )
+            info.handlers = sorted(self.handlers.get(kind, []))
+            kinds[kind] = info
+        return EffectGraphResult(
+            kinds=kinds,
+            effects=sorted(
+                self.effect_sites.values(),
+                key=lambda e: (e.spec.effect_id, e.path, e.line),
+            ),
+            commits=sorted(
+                self.commit_sites.values(), key=lambda c: (c.path, c.line)
+            ),
+            findings=sorted(self.findings),
+        )
+
+
+def analyze_effects(
+    modules: list, graph: Optional[CallGraph] = None
+) -> EffectGraphResult:
+    return EffectAnalysis(modules, graph).run()

@@ -27,6 +27,15 @@ _SUPPRESS_RE = re.compile(
     r"(?:\s+(?P<reason>[^#\s][^#]*))?"
 )
 
+#: Analyzer annotations (`# tpudra-lock:` / `# tpudra-wal:`) change what the
+#: whole-program models believe about the code; like suppressions, each must
+#: carry a free-text why after its keywords (ANNOTATION-REASON).
+_ANNOTATION_COMMENT_RE = re.compile(
+    r"#\s*(?P<prefix>tpudra-(?:lock|wal)):\s*(?P<body>.+)"
+)
+_ANNOTATION_KV_RE = re.compile(r"^(id|acquires|kind|recovers)=\S+$")
+_ANNOTATION_FLAGS = {"family", "nonblocking", "nonrecoverable"}
+
 
 @dataclass(frozen=True, order=True)
 class Finding:
@@ -65,10 +74,29 @@ class Suppressions:
     def __init__(self, source: str):
         self._by_line: dict[int, set[str]] = {}
         self.unreasoned: list[tuple[int, str]] = []
+        #: (line, prefix, keywords) of analyzer annotations with no reason.
+        self.unreasoned_annotations: list[tuple[int, str, str]] = []
         try:
             tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
             for tok in tokens:
                 if tok.type != tokenize.COMMENT:
+                    continue
+                am = _ANNOTATION_COMMENT_RE.search(tok.string)
+                if am:
+                    words = am.group("body").split()
+                    keywords = []
+                    for word in words:
+                        if _ANNOTATION_KV_RE.match(word) or word in _ANNOTATION_FLAGS:
+                            keywords.append(word)
+                        else:
+                            break  # free-text reason starts
+                    rest = words[len(keywords):]
+                    # Like _SUPPRESS_RE's reason group, a nested comment
+                    # ("... # EXPECT: ...") is not a reason.
+                    if not rest or rest[0].startswith("#"):
+                        self.unreasoned_annotations.append(
+                            (tok.start[0], am.group("prefix"), " ".join(keywords))
+                        )
                     continue
                 m = _SUPPRESS_RE.search(tok.string)
                 if not m:
@@ -149,6 +177,18 @@ def _lint_one(
                 "the rule is safe to ignore here",
             )
         )
+    # An annotation rewrites what the whole-program models believe about
+    # this code; without a reason nobody can audit whether the claim still
+    # holds after the next refactor.
+    for line, prefix, keywords in suppressed.unreasoned_annotations:
+        what = f"'# {prefix}: {keywords}'" if keywords else f"'# {prefix}:'"
+        out.append(
+            Finding(
+                module.path, line, 0, "ANNOTATION-REASON",
+                f"annotation {what} states no reason — follow the keywords "
+                "with free text saying why the claim holds",
+            )
+        )
     return out
 
 
@@ -168,26 +208,60 @@ def _apply_suppressions(
     return out
 
 
-def parse_paths(paths: Iterable[str]) -> tuple[list[ParsedModule], list[Finding]]:
+def _parse_one(filename: str):
+    """Parse worker (top level so multiprocessing can pickle it): the
+    ParsedModule, or the SYNTAX Finding when the file cannot be read."""
+    try:
+        with open(filename, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=filename)
+    except (OSError, SyntaxError, ValueError) as e:
+        line = getattr(e, "lineno", 1) or 1
+        return Finding(filename, line, 0, "SYNTAX", f"cannot analyze: {e}")
+    return ParsedModule(path=filename, source=source, tree=tree)
+
+
+def _default_jobs(n_files: int) -> int:
+    env = os.environ.get("TPUDRA_LINT_JOBS", "")
+    if env:
+        try:
+            jobs = int(env)
+        except ValueError:
+            jobs = 1
+    else:
+        jobs = os.cpu_count() or 1
+    return max(1, min(jobs, n_files))
+
+
+def parse_paths(
+    paths: Iterable[str], jobs: Optional[int] = None
+) -> tuple[list[ParsedModule], list[Finding]]:
     """One ``ast.parse`` per file, shared by every analysis that runs over
-    the tree (lint rules and the lockgraph both consume these modules —
-    the parse pass is the expensive part of a cold run and must not be
-    paid twice).  Unparseable files surface as SYNTAX findings."""
-    modules: list[ParsedModule] = []
-    findings: list[Finding] = []
-    for root in paths:
-        for filename in _iter_python_files(root):
-            try:
-                with open(filename, encoding="utf-8") as f:
-                    source = f.read()
-                tree = ast.parse(source, filename=filename)
-            except (OSError, SyntaxError, ValueError) as e:
-                line = getattr(e, "lineno", 1) or 1
-                findings.append(
-                    Finding(filename, line, 0, "SYNTAX", f"cannot analyze: {e}")
-                )
-                continue
-            modules.append(ParsedModule(path=filename, source=source, tree=tree))
+    the tree (lint rules, the lockgraph, and the effectgraph all consume
+    these modules — the parse pass is the expensive part of a cold run and
+    must not be paid twice).  Unparseable files surface as SYNTAX findings.
+
+    The per-file parses are independent, so with ``jobs >= 2`` (default:
+    ``TPUDRA_LINT_JOBS`` or the CPU count) they fan out over a process
+    pool; result order follows the sorted file walk either way, so output
+    is deterministic.  Single-CPU boxes and tiny file sets stay serial —
+    fork + pickle overhead would swamp the win."""
+    filenames = [fn for root in paths for fn in _iter_python_files(root)]
+    if jobs is None:
+        jobs = _default_jobs(len(filenames))
+    results = None
+    if jobs >= 2 and len(filenames) >= 8:
+        try:
+            import multiprocessing
+
+            with multiprocessing.Pool(jobs) as pool:
+                results = pool.map(_parse_one, filenames)
+        except (ImportError, OSError):
+            results = None  # no usable pool here (sandbox): parse serially
+    if results is None:
+        results = [_parse_one(fn) for fn in filenames]
+    modules = [r for r in results if isinstance(r, ParsedModule)]
+    findings = [r for r in results if isinstance(r, Finding)]
     return modules, findings
 
 

@@ -35,6 +35,13 @@ class Rule:
 def all_rules() -> list[Rule]:
     from tpudra.analysis.rules.apiserver_retry import ApiserverRetry
     from tpudra.analysis.rules.durable_write import DurableWrite
+    from tpudra.analysis.rules.effectgraph import (
+        EffectgraphState,
+        FenceDominatesCommit,
+        StripeOrder,
+        WalIntentBeforeEffect,
+        WalRecoveryExhaustive,
+    )
     from tpudra.analysis.rules.exc_swallow import ExcSwallow
     from tpudra.analysis.rules.lockgraph import (
         BlockUnderLockIP,
@@ -45,12 +52,16 @@ def all_rules() -> list[Rule]:
     from tpudra.analysis.rules.locks import BlockUnderLock, LockOrder
     from tpudra.analysis.rules.metrics_hygiene import MetricsHygiene
     from tpudra.analysis.rules.partition_phase import PartitionPhase
+    from tpudra.analysis.rules.program import ProgramState
     from tpudra.analysis.rules.rmw_purity import RmwPurity
     from tpudra.analysis.rules.shared_state import SharedState
     from tpudra.analysis.rules.span_hygiene import SpanHygiene
 
-    # The three lockgraph rules share ONE whole-program analysis per run.
-    lockgraph = LockgraphState()
+    # The whole-program rule families each share ONE analysis per run,
+    # and both analyses share ONE CallGraph over the same corpus.
+    program = ProgramState()
+    lockgraph = LockgraphState(program)
+    effectgraph = EffectgraphState(program)
     return [
         LockOrder(),
         BlockUnderLock(),
@@ -65,6 +76,10 @@ def all_rules() -> list[Rule]:
         LockCycle(lockgraph),
         BlockUnderLockIP(lockgraph),
         FlockInversion(lockgraph),
+        WalIntentBeforeEffect(effectgraph),
+        WalRecoveryExhaustive(effectgraph),
+        FenceDominatesCommit(effectgraph),
+        StripeOrder(effectgraph),
     ]
 
 
@@ -79,3 +94,22 @@ def lockgraph_rules() -> list[Rule]:
 
     state = LockgraphState()
     return [LockCycle(state), BlockUnderLockIP(state), FlockInversion(state)]
+
+
+def effectgraph_rules() -> list[Rule]:
+    """Just the whole-program WAL rules (the ``make effectgraph`` lane)."""
+    from tpudra.analysis.rules.effectgraph import (
+        EffectgraphState,
+        FenceDominatesCommit,
+        StripeOrder,
+        WalIntentBeforeEffect,
+        WalRecoveryExhaustive,
+    )
+
+    state = EffectgraphState()
+    return [
+        WalIntentBeforeEffect(state),
+        WalRecoveryExhaustive(state),
+        FenceDominatesCommit(state),
+        StripeOrder(state),
+    ]

@@ -1,0 +1,88 @@
+"""WAL-INTENT-BEFORE-EFFECT, WAL-RECOVERY-EXHAUSTIVE,
+FENCE-DOMINATES-COMMIT, STRIPE-ORDER: the whole-program WAL rules
+(tpudra-effectgraph).
+
+The heavy lifting lives in tpudra/analysis/effectmodel.py; these Rule
+shells adapt it to the engine's per-module + finalize protocol.  All four
+rules SHARE one analysis per run, and the analysis shares its CallGraph
+with the lockgraph through ``ProgramState`` — one parse pass, one call
+graph, two whole-program models.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpudra.analysis.effectmodel import EffectGraphResult, analyze_effects
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.rules import Rule
+from tpudra.analysis.rules.program import ProgramState
+
+
+class EffectgraphState:
+    """Accumulates the modules of one lint run; analyzes once on demand."""
+
+    def __init__(self, program: Optional[ProgramState] = None) -> None:
+        self.program = program or ProgramState()
+        self._result: Optional[EffectGraphResult] = None
+
+    def add(self, module: ParsedModule) -> None:
+        if self.program.add(module):
+            self._result = None
+
+    def result(self) -> EffectGraphResult:
+        if self._result is None:
+            self._result = analyze_effects(
+                self.program.modules, self.program.graph()
+            )
+        return self._result
+
+
+class _EffectgraphRule(Rule):
+    def __init__(self, state: Optional[EffectgraphState] = None):
+        self.state = state or EffectgraphState()
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        self.state.add(module)
+        return []
+
+    def finalize(self) -> list[Finding]:
+        return [
+            f for f in self.state.result().findings if f.rule_id == self.rule_id
+        ]
+
+
+class WalIntentBeforeEffect(_EffectgraphRule):
+    rule_id = "WAL-INTENT-BEFORE-EFFECT"
+    description = (
+        "every registered hardware/disk/daemon side effect is dominated by "
+        "a durable intent record of its matching kind (the WAL "
+        "crash-consistency contract, statically)"
+    )
+
+
+class WalRecoveryExhaustive(_EffectgraphRule):
+    rule_id = "WAL-RECOVERY-EXHAUSTIVE"
+    description = (
+        "two-sided recovery coverage: every committed record kind has a "
+        "'# tpudra-wal: recovers=' handler and every declared handler "
+        "matches a kind actually committed"
+    )
+
+
+class FenceDominatesCommit(_EffectgraphRule):
+    rule_id = "FENCE-DOMINATES-COMMIT"
+    description = (
+        "every checkpoint commit site in controller code is dominated by a "
+        "gangmeta/term fence check (the static form of the StaleLeader "
+        "runtime refusal)"
+    )
+
+
+class StripeOrder(_EffectgraphRule):
+    rule_id = "STRIPE-ORDER"
+    description = (
+        "cross-family mutators first-touch record families in the "
+        "canonical stripe order gangmeta < gang < claim < partition (the "
+        "striped-checkpoint pre-flight)"
+    )

@@ -17,25 +17,33 @@ from typing import Optional
 from tpudra.analysis.engine import Finding, ParsedModule
 from tpudra.analysis.lockmodel import LockGraphResult, analyze_modules
 from tpudra.analysis.rules import Rule
+from tpudra.analysis.rules.program import ProgramState
 
 
 class LockgraphState:
-    """Accumulates the modules of one lint run; analyzes once on demand."""
+    """Accumulates the modules of one lint run; analyzes once on demand.
 
-    def __init__(self) -> None:
-        self.modules: list[ParsedModule] = []
-        self._paths: set[str] = set()
+    The corpus and CallGraph live in a ``ProgramState`` so the effectgraph
+    (rules/effectgraph.py) can share them — pass the same instance to both
+    and the call graph is built once per run."""
+
+    def __init__(self, program: Optional[ProgramState] = None) -> None:
+        self.program = program or ProgramState()
         self._result: Optional[LockGraphResult] = None
 
+    @property
+    def modules(self) -> list[ParsedModule]:
+        return self.program.modules
+
     def add(self, module: ParsedModule) -> None:
-        if module.path not in self._paths:
-            self._paths.add(module.path)
-            self.modules.append(module)
+        if self.program.add(module):
             self._result = None
 
     def result(self) -> LockGraphResult:
         if self._result is None:
-            self._result = analyze_modules(self.modules)
+            self._result = analyze_modules(
+                self.program.modules, self.program.graph()
+            )
         return self._result
 
 

@@ -1,0 +1,37 @@
+"""Shared whole-program state for the cross-file rule families.
+
+Both whole-program analyses (tpudra-lockgraph and tpudra-effectgraph)
+resolve calls over the same corpus; building the CallGraph twice per lint
+run would double the most expensive non-parse step for no information.
+One ``ProgramState`` accumulates the engine's shared parse pass and hands
+every analysis the SAME lazily-built CallGraph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpudra.analysis.callgraph import CallGraph
+from tpudra.analysis.engine import ParsedModule
+
+
+class ProgramState:
+    def __init__(self) -> None:
+        self.modules: list[ParsedModule] = []
+        self._paths: set[str] = set()
+        self._graph: Optional[CallGraph] = None
+
+    def add(self, module: ParsedModule) -> bool:
+        """Register a module; True when it was new (consumers invalidate
+        their cached analysis on that signal)."""
+        if module.path in self._paths:
+            return False
+        self._paths.add(module.path)
+        self.modules.append(module)
+        self._graph = None
+        return True
+
+    def graph(self) -> CallGraph:
+        if self._graph is None:
+            self._graph = CallGraph(self.modules)
+        return self._graph

@@ -49,7 +49,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional, Protocol
 
-from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, trace
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, trace, walwitness
 from tpudra.kube import gvr
 from tpudra.plugin.checkpoint import (
     PREPARE_COMPLETED,
@@ -290,6 +290,7 @@ class GangReservationManager:
     def term(self) -> Optional[int]:
         return self._term
 
+    # tpudra-wal: recovers=gangmeta the fence record is recovered by supersession, not sweeping — the new leader's first fenced commit here rewrites gangmeta/term, and every stale term is refused from then on
     def claim_store(self) -> None:
         """Advance the journaled fence to OUR term with a no-op fenced
         commit — the new leader's first write, made at adoption time.
@@ -563,6 +564,7 @@ class GangReservationManager:
                     attrs={"claim": member.claim_uid, "node": member.node},
                 ):
                     stage = f"bind of claim {member.claim_uid!r}"
+                    walwitness.note_effect("gang:bind")
                     self._binder.bind(member, claims[member.claim_uid])
 
                     def journal_bound(cp: Checkpoint, uid=member.claim_uid) -> None:
@@ -871,6 +873,7 @@ class GangReservationManager:
 
     # ------------------------------------------------------------- recovery
 
+    # tpudra-wal: recovers=gang the controller-start sweep converges every in-flight gang record (rollback, resumed remediation, or release) from checkpoint truth
     def recover(self) -> list[str]:
         """Converge every in-flight gang to a consistent state — the
         crash-recovery sweep, run at controller start.  Returns the gang

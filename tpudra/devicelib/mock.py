@@ -19,6 +19,7 @@ import threading
 import uuid as uuidlib
 from typing import Iterator, Optional
 
+from tpudra import walwitness
 from tpudra.devicelib.base import (
     DeviceLib,
     DeviceLibError,
@@ -205,11 +206,13 @@ class MockDeviceLib(DeviceLib):
         return live
 
     def create_partition(self, spec: PartitionSpec) -> LivePartition:
+        walwitness.note_effect("partition:create")
         with self._lock:
             # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP the state file IS the simulated silicon — its write must be atomic with the in-memory registry, exactly like the hardware mutation it stands in for
             return self._create_unlocked(spec)
 
     def delete_partition(self, uuid: str) -> None:
+        walwitness.note_effect("partition:destroy")
         with self._lock:
             if uuid not in self._partitions:
                 raise DeviceLibError(f"no partition with uuid {uuid}")
@@ -224,6 +227,7 @@ class MockDeviceLib(DeviceLib):
     # -- sharing knobs ------------------------------------------------------
 
     def set_timeslice(self, chip_uuids: list[str], interval: str) -> None:
+        walwitness.note_effect("timeslice:set")
         with self._lock:
             for u in chip_uuids:
                 self.chip_by_uuid(u)  # existence check

@@ -19,6 +19,7 @@ import os
 import threading
 from typing import Iterator
 
+from tpudra import walwitness
 from tpudra.devicelib.base import (
     DeviceLib,
     DeviceLibError,
@@ -259,6 +260,7 @@ class NativeDeviceLib(DeviceLib):
         return out
 
     def create_partition(self, spec: PartitionSpec) -> LivePartition:
+        walwitness.note_effect("partition:create")
         p = _Partition()
         rc = self._lib.tpuinfo_create_partition(
             self._handle,
@@ -280,6 +282,7 @@ class NativeDeviceLib(DeviceLib):
         )
 
     def delete_partition(self, uuid: str) -> None:
+        walwitness.note_effect("partition:destroy")
         if self._lib.tpuinfo_delete_partition(self._handle, uuid.encode()) != 0:
             raise DeviceLibError(f"delete_partition: {self._error()}")
 
@@ -316,6 +319,7 @@ class NativeDeviceLib(DeviceLib):
     # -- sharing knobs ------------------------------------------------------
 
     def set_timeslice(self, chip_uuids: list[str], interval: str) -> None:
+        walwitness.note_effect("timeslice:set")
         with self._sharing_lock:
             for u in chip_uuids:
                 self._timeslice[u] = interval

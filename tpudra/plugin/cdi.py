@@ -35,7 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpudra import metrics, storage
+from tpudra import metrics, storage, walwitness
 
 CDI_VERSION = "0.6.0"
 
@@ -176,6 +176,7 @@ class CDIHandler:
         to every container consuming any device of the claim (claim-wide env
         like the clique ID; reference cdi.go:194-304).
         """
+        walwitness.note_effect("cdi:spec-write")
         t0 = time.monotonic()
         devices = []
         ids = []

@@ -70,7 +70,7 @@ from dataclasses import dataclass, field
 from types import MappingProxyType
 from typing import Callable, Iterable, Optional
 
-from tpudra import lockwitness, metrics, storage, trace
+from tpudra import lockwitness, metrics, storage, trace, walwitness
 from tpudra.api import serde
 from tpudra.flock import Flock, FlockTimeout
 from tpudra.plugin import journal as journal_mod
@@ -473,7 +473,7 @@ class CheckpointManager:
         except OSError:
             return False  # still broken; detail stays as first noted
         try:
-            with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock
+            with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock same per-store lock file as every commit
                 # Full reload: the incremental base was discarded at
                 # poison time; only a from-byte-zero parse may repair.
                 self._applied_state = None
@@ -592,6 +592,10 @@ class CheckpointManager:
             # must re-log and re-count until a commit repairs it.
             with self._cache_lock:
                 self._cache = (key, copy.deepcopy(cp))
+        # Recovery seeding: a record loaded from disk IS journaled intent —
+        # without this, the post-restart sweep's effects would be witnessed
+        # as journal-less and flagged as false ordering violations.
+        walwitness.note_journal(cp.prepared_claims.keys())
         return cp, degraded
 
     @staticmethod
@@ -733,6 +737,9 @@ class CheckpointManager:
         _FSYNC_SNAPSHOT.inc()
         _FSYNC_DIR.inc()
         _BYTES_SNAPSHOT.inc(len(data))
+        # Snapshot replace + dir fsync landed: every record in it is
+        # durable intent (noted before the crashpoint below).
+        walwitness.note_journal(cp.prepared_claims.keys())
         _crashpoint("mid-compaction")
         jkey = self._journal.stat_key()
         try:
@@ -843,7 +850,7 @@ class CheckpointManager:
         under contention comes from."""
         batch: list[_Mutation] = []
         try:
-            with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock
+            with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock the leader takes the store's one commit lock
                 with self._commit_cond:
                     batch = list(self._commit_queue)
                     self._commit_queue.clear()
@@ -1050,6 +1057,9 @@ class CheckpointManager:
                     self._note_storage_failure("journal append", e)
                 raise
             self._mark_storage_ok()
+            # After the fsync, before any crashpoint: the records ARE
+            # durable intent now, even if the process dies next line.
+            walwitness.note_journal(r.get("uid", "") for r in records)
             trace.record_span(
                 "checkpoint.fsync", tf_wall, time.perf_counter() - tf0,
                 attrs={"kind": "journal", "records": len(records)},
@@ -1223,7 +1233,7 @@ class CheckpointManager:
             # write happens under cp.lock, so under it no leader — not
             # even one that outlived the drain deadline — can be mid-
             # append on the fd we close.
-            with Flock(self._lock_path)(timeout=5.0):  # tpudra-lock: id=flock:cp.lock
+            with Flock(self._lock_path)(timeout=5.0):  # tpudra-lock: id=flock:cp.lock same store lock; excludes a mid-append leader
                 jkey = self._journal.stat_key()
                 if jkey is not None and jkey[1] > 0:
                     state, degraded = self._load_locked()
@@ -1255,7 +1265,7 @@ class CheckpointManager:
         with self._commit_cond:
             self._journal_enabled = False  # no further appends from here
         try:
-            with Flock(self._lock_path)(timeout=5.0):  # tpudra-lock: id=flock:cp.lock
+            with Flock(self._lock_path)(timeout=5.0):  # tpudra-lock: id=flock:cp.lock same store lock; close must not race an append
                 self._journal.close()
         except Exception:  # noqa: BLE001 — abandoning must not wedge
             logger.warning(
@@ -1275,7 +1285,7 @@ class CheckpointManager:
         # twice, but in-process callers DO overlap (the GC thread mutates
         # while RPC threads mutate) — each needs its own fd so the kernel
         # serializes them instead of a RuntimeError failing the batch.
-        with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock
+        with Flock(self._lock_path)(timeout=timeout):  # tpudra-lock: id=flock:cp.lock fresh fd, same per-store lock file
             # Bypass the read cache inside the RMW: the stat triple is not
             # collision-proof across processes (inode recycling + coarse
             # mtime), and a false cache hit here would write a stale

@@ -82,6 +82,7 @@ class CheckpointCleanupManager:
                 logger.exception("checkpoint cleanup pass failed")
             stop.wait(self._period)
 
+    # tpudra-wal: recovers=claim the periodic GC pass converges claim records whose owner died out from under us — each stale record is unprepared through the plugin's own rollback path
     def cleanup_once(self) -> int:
         """One validation pass; returns number of stale claims unprepared."""
         stale = 0

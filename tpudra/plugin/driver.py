@@ -880,7 +880,7 @@ class Driver:
         acquired twice, but kubelet issues concurrent prepare RPCs — each
         call gets its own fd and the kernel serializes across both threads
         and processes."""
-        return Flock(self._pu_lock_path)  # tpudra-lock: id=flock:pu.lock
+        return Flock(self._pu_lock_path)  # tpudra-lock: id=flock:pu.lock the node-global prepare/unprepare lock
 
     @contextlib.contextmanager
     def _locked_pu(self):

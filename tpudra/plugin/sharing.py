@@ -28,6 +28,7 @@ from typing import Optional
 
 import yaml
 
+from tpudra import walwitness
 from tpudra.api.sharing import DEFAULT_TIME_SLICE, MultiProcessConfig, TimeSlicingConfig
 from tpudra.devicelib import DeviceLib
 from tpudra.kube import gvr
@@ -366,6 +367,7 @@ class MultiProcessManager:
         tensorcore_pct: Optional[int] = None,
         exclusive: bool = True,
     ) -> MultiProcessControlDaemon:
+        walwitness.note_effect("daemon:start")
         return MultiProcessControlDaemon(
             self, claim_uid, chip_uuids, config,
             limits=limits, tensorcore_pct=tensorcore_pct, exclusive=exclusive,

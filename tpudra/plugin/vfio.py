@@ -13,6 +13,7 @@ import logging
 import os
 import threading
 
+from tpudra import walwitness
 from tpudra.devicelib import TpuChip
 from tpudra.plugin.cdi import ContainerEdits
 
@@ -107,7 +108,8 @@ class VfioManager:
         """Rebind to vfio-pci; returns the iommu group
         (reference Configure, vfio-device.go:176-178 — incl. taking the
         device's mutex around the rebind sequence)."""
-        # tpudra-lock: id=vfio.per-device
+        walwitness.note_effect("vfio:configure")
+        # tpudra-lock: id=vfio.per-device keyed per PCI address — rebinds of distinct chips never contend
         with per_device_lock.get(chip.pci_address):
             dev_dir = self._device_dir(chip.pci_address)
             if not os.path.isdir(dev_dir):
@@ -127,7 +129,7 @@ class VfioManager:
     def unconfigure(self, chip: TpuChip) -> None:
         """Return the function to the TPU driver
         (reference Unconfigure, vfio-device.go:207-209)."""
-        # tpudra-lock: id=vfio.per-device
+        # tpudra-lock: id=vfio.per-device same per-PCI-address key as configure, so the two rebind directions serialize
         with per_device_lock.get(chip.pci_address):
             dev_dir = self._device_dir(chip.pci_address)
             if not os.path.isdir(dev_dir):

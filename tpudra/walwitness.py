@@ -1,0 +1,216 @@
+"""Runtime WAL record→effect witness (the effectgraph dynamic side).
+
+tpudra-effectgraph's static model (tpudra/analysis/effectmodel.py) claims
+that every registered side effect is dominated by a durable intent record
+of a matching kind; this module is its runtime cross-check.  With
+``TPUDRA_WAL_WITNESS=1`` in the environment, the checkpoint commit path
+notes every record kind it makes durable (journal append, snapshot write,
+and the recovery read — a record loaded from disk IS journaled intent),
+the effect sites on the bind/teardown path note every effect they run, and
+each first-seen (effect, journaled-kind-set) pair is appended to a JSONL
+witness log (``TPUDRA_WAL_WITNESS_LOG``, default
+``tpudra-wal-witness.jsonl`` in the working directory).
+``python -m tpudra.analysis --wal-witness <log>`` then merges the log into
+the static effect graph: an effect the model has no site for is a model
+gap, and an effect witnessed without its required kind journaled is a
+witnessed ordering violation — both fail, exactly like the lock witness.
+
+With the variable unset (every production path), every hook is a single
+falsy env check — zero allocation, zero I/O.
+
+Conventions shared with the static model:
+
+- Kinds are record *families*, not uids (every ``partition/<name>`` record
+  is one ``partition`` node) — ``record_kind`` below is the one
+  classifier, imported by the static side so the two can never drift.
+- The journaled set is process-wide and monotone: durability has no
+  thread affinity, and a kind once fsynced stays journaled for the life
+  of the process.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+from typing import Iterable, Iterator
+
+ENV_WITNESS = "TPUDRA_WAL_WITNESS"
+ENV_WITNESS_LOG = "TPUDRA_WAL_WITNESS_LOG"
+DEFAULT_LOG = "tpudra-wal-witness.jsonl"
+
+#: Record-uid namespace prefixes → stripe family.  Everything else is a
+#: plain claim record (the default namespace).
+_KIND_PREFIXES = (
+    ("gangmeta/", "gangmeta"),
+    ("gang/", "gang"),
+    ("partition/", "partition"),
+)
+
+
+def record_kind(uid: str) -> str:
+    """The stripe family of one checkpoint record uid."""
+    for prefix, kind in _KIND_PREFIXES:
+        if uid.startswith(prefix):
+            return kind
+    return "claim"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_WITNESS, "") not in ("", "0")
+
+
+def log_path() -> str:
+    return os.environ.get(ENV_WITNESS_LOG, "") or os.path.join(
+        os.getcwd(), DEFAULT_LOG
+    )
+
+
+# ----------------------------------------------------------------- recording
+
+_sink_guard = threading.Lock()
+_sink = None  # opened lazily, OUTSIDE _sink_guard (no open-under-lock)
+_journaled: set = set()  # kinds made durable by this process (monotone)
+_written: set = set()  # emitted record keys (first-seen dedup)
+
+# Dynamic scopes mirroring the static model's two subtree directives
+# (thread-local: an exempt probe on one thread must not blind the witness
+# to a concurrent bind on another).
+_tls = threading.local()
+
+
+@contextlib.contextmanager
+def exempt() -> Iterator[None]:
+    """Runtime twin of ``# tpudra-wal: nonrecoverable``: effects inside
+    this scope deliberately run journal-less (the static walk skips the
+    annotated subtree; the witness must not report what the model
+    deliberately does not check).  Use it exactly where the annotation
+    sits — a scope without the annotation, or vice versa, is model
+    drift the merge exists to catch."""
+    _tls.exempt = getattr(_tls, "exempt", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.exempt -= 1
+
+
+@contextlib.contextmanager
+def recovery_scope(*kinds: str) -> Iterator[None]:
+    """Runtime twin of ``# tpudra-wal: recovers=KIND``: within this scope
+    the declared kinds count as journaled — a recovery sweep acts FROM
+    checkpoint truth, so its effects carry the checkpoint's own
+    authority even when the specific record is long gone (a record-less
+    stray being reaped has no uid to have journaled)."""
+    prev = getattr(_tls, "assumed", ())
+    _tls.assumed = prev + tuple(kinds)
+    try:
+        yield
+    finally:
+        _tls.assumed = prev
+
+
+def _emit(record: dict) -> None:
+    global _sink
+    if _sink is None:
+        # Open before taking the guard; a racing double-open leaves one
+        # extra O_APPEND handle to close, never a torn line.
+        fh = open(log_path(), "a", encoding="utf-8")
+        with _sink_guard:
+            if _sink is None:
+                _sink = fh
+                fh = None
+        if fh is not None:
+            fh.close()
+    line = json.dumps(record, sort_keys=True) + "\n"
+    with _sink_guard:
+        _sink.write(line)
+        _sink.flush()
+
+
+def note_journal(uids: Iterable[str]) -> None:
+    """Record that every uid's record kind is now durable.  Called by the
+    checkpoint layer AFTER the fsync (journal append, snapshot replace)
+    and on recovery read — before any crashpoint, so a crash-armed run
+    still witnesses exactly what it made durable."""
+    if not enabled():
+        return
+    new_records = []
+    with _sink_guard:
+        for uid in uids:
+            kind = record_kind(uid)
+            if kind in _journaled:
+                continue
+            _journaled.add(kind)
+            key = ("record", kind)
+            if key not in _written:
+                _written.add(key)
+                new_records.append({"t": "record", "kind": kind})
+    for record in new_records:
+        _emit(record)
+
+
+def note_effect(effect_id: str) -> None:
+    """Record that a registered side effect ran, with the kinds journaled
+    at that moment — one record per first-seen (effect, kind-set) pair."""
+    if not enabled() or getattr(_tls, "exempt", 0):
+        return
+    assumed = getattr(_tls, "assumed", ())
+    with _sink_guard:
+        journaled = tuple(sorted(_journaled.union(assumed)))
+        key = ("effect", effect_id, journaled)
+        seen = key in _written
+        if not seen:
+            _written.add(key)
+    if not seen:
+        _emit(
+            {"t": "effect", "effect": effect_id, "journaled": list(journaled)}
+        )
+
+
+def journaled_kinds() -> tuple:
+    """The process's journaled-kind set (tests)."""
+    with _sink_guard:
+        return tuple(sorted(_journaled))
+
+
+def reset_for_tests() -> None:
+    """Drop the in-process journaled/dedup/sink state so a test can
+    witness into a fresh log file."""
+    global _sink, _journaled, _written
+    with _sink_guard:
+        sink, _sink = _sink, None
+        _journaled = set()
+        _written = set()
+    if sink is not None:
+        sink.close()
+
+
+# ------------------------------------------------------------------- reading
+
+
+def read_log(path: str) -> tuple[set, list]:
+    """(journaled kinds, [(effect_id, frozenset(journaled-at-the-time))])
+    recorded in a witness log.  Malformed lines are skipped — a crashed
+    witness process may tear its final line."""
+    kinds: set = set()
+    effects: list = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("t") == "record" and rec.get("kind"):
+                    kinds.add(rec["kind"])
+                elif rec.get("t") == "effect" and rec.get("effect"):
+                    effects.append(
+                        (rec["effect"], frozenset(rec.get("journaled", ())))
+                    )
+    except FileNotFoundError:
+        pass
+    return kinds, effects
