@@ -7,14 +7,14 @@ IMAGE ?= tpudra:dev
 VERSION ?= $(shell grep -m1 '__version__' tpudra/__init__.py | cut -d'"' -f2)
 GIT_COMMIT ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo unknown)
 
-.PHONY: all native test test-fast lint lockgraph lockgraph-docs effectgraph effectgraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition bench-failover e2e-multihost soak image helm-render clean
+.PHONY: all native test test-fast lint lockgraph lockgraph-docs effectgraph effectgraph-docs racegraph racegraph-docs trace-check tier1 bats bats-real bench bench-bind bench-apiserver bench-checkpoint bench-cluster bench-gang bench-trace bench-storage bench-partition bench-failover e2e-multihost soak image helm-render clean
 
 all: native test
 
 # Static analysis gate: tpudra-lint + tpudra-lockgraph + tpudra-effectgraph
-# (one stdlib AST analyzer sharing one parse pass and one call graph,
-# docs/static-analysis.md) plus ruff/mypy when installed.  Nonzero exit on
-# any finding.
+# + tpudra-racegraph (one stdlib AST analyzer sharing one parse pass and
+# one call graph, docs/static-analysis.md) plus ruff/mypy when installed.
+# Nonzero exit on any finding.
 lint:
 	bash hack/lint.sh
 
@@ -42,6 +42,19 @@ effectgraph:
 # (tests/test_effectgraph.py::test_effect_graph_doc_is_fresh diffs it).
 effectgraph-docs:
 	python -m tpudra.analysis --emit-effectgraph docs/effect-graph.md
+
+# Just the whole-program race rules (RACE, GUARD-CONSISTENCY,
+# THREAD-CONFINED-ESCAPE) — the quick loop while reworking shared state.
+# Also part of `make lint`/`make tier1` (hack/lint.sh runs the full
+# analyzer), and gated in-suite by
+# tests/test_racegraph.py::test_racegraph_is_clean.
+racegraph:
+	python -m tpudra.analysis --racegraph
+
+# Regenerate the checked-in race-model doc from the static thread/race
+# model (tests/test_racegraph.py::test_race_model_doc_is_fresh diffs it).
+racegraph-docs:
+	python -m tpudra.analysis --emit-racegraph docs/race-model.md
 
 native:
 	$(MAKE) -C native

@@ -11,14 +11,15 @@ cd "$(dirname "$0")/.."
 
 fail=0
 
-echo "== tpudra-lint + tpudra-lockgraph + tpudra-effectgraph (python -m tpudra.analysis)"
+echo "== tpudra-lint + tpudra-lockgraph + tpudra-effectgraph + tpudra-racegraph (python -m tpudra.analysis)"
 # One invocation, one shared parse pass, one shared call graph: the
-# per-module lint rules AND both whole-program rule families — the lock
+# per-module lint rules AND all whole-program rule families — the lock
 # rules (LOCK-CYCLE / BLOCK-UNDER-LOCK-IP / FLOCK-INVERSION,
-# docs/lock-order.md) and the WAL rules (WAL-INTENT-BEFORE-EFFECT /
+# docs/lock-order.md), the WAL rules (WAL-INTENT-BEFORE-EFFECT /
 # WAL-RECOVERY-EXHAUSTIVE / FENCE-DOMINATES-COMMIT / STRIPE-ORDER,
-# docs/effect-graph.md) — run over the same parsed modules, so neither
-# graph costs a second walk of the tree.
+# docs/effect-graph.md), and the race rules (RACE / GUARD-CONSISTENCY /
+# THREAD-CONFINED-ESCAPE, docs/race-model.md) — run over the same parsed
+# modules, so no graph costs a second walk of the tree.
 python -m tpudra.analysis || fail=1
 
 if python -m ruff --version >/dev/null 2>&1; then

@@ -62,6 +62,11 @@ class CrashablePlugin:
         #: crash/restart of the plugin process (tpudra/walwitness.py); the
         #: sweep merges it against the static effect graph at the end.
         self.wal_witness_log = os.path.join(tmp, "wal-witness.jsonl")
+        #: Likewise for the vector-clock race witness (tpudra/racewitness.py)
+        #: and the lock witness riding with it — armed locks make the race
+        #: samples' held-locksets real instead of vacuously empty.
+        self.race_witness_log = os.path.join(tmp, "race-witness.jsonl")
+        self.lock_witness_log = os.path.join(tmp, "lock-witness.jsonl")
 
     # Subclass hooks -------------------------------------------------------
 
@@ -87,6 +92,13 @@ class CrashablePlugin:
         # journaled intent across the whole crash schedule.
         env["TPUDRA_WAL_WITNESS"] = "1"
         env["TPUDRA_WAL_WITNESS_LOG"] = self.wal_witness_log
+        # Arm the race witness (and the lock witness it piggybacks on for
+        # held locksets) the same way: SIGKILL-safe O_APPEND samples, merged
+        # against the static race model at the end of the sweep.
+        env["TPUDRA_RACE_WITNESS"] = "1"
+        env["TPUDRA_RACE_WITNESS_LOG"] = self.race_witness_log
+        env["TPUDRA_LOCK_WITNESS"] = "1"
+        env["TPUDRA_LOCK_WITNESS_LOG"] = self.lock_witness_log
         if crashpoint:
             env["TPUDRA_CRASHPOINT"] = crashpoint
             env["TPUDRA_TEST_HOOKS"] = "1"  # two-key arming (device_state)

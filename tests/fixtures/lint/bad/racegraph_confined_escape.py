@@ -1,0 +1,24 @@
+"""tpudra-lint fixture: THREAD-CONFINED-ESCAPE must fire on every marked
+line — a field annotated as confined to one thread role is written from
+another role."""
+
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._cursor = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="pump", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        # tpudra-race: owner=pump the cursor is the pump loop's private scan position
+        self._cursor += 1
+
+    def rewind(self):
+        self._cursor = 0  # EXPECT: THREAD-CONFINED-ESCAPE

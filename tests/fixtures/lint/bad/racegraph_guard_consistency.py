@@ -1,0 +1,25 @@
+"""tpudra-lint fixture: GUARD-CONSISTENCY must fire on every marked line —
+every write holds SOME lock, but not the SAME lock, so no single guard
+protects the field."""
+
+import threading
+
+
+class SplitBrain:
+    def __init__(self):
+        self._state = ""
+        self._read_lock = threading.Lock()
+        self._write_lock = threading.Lock()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        with self._read_lock:
+            self._state = "from-loop"  # EXPECT: GUARD-CONSISTENCY
+
+    def publish(self):
+        with self._write_lock:
+            self._state = "from-main"

@@ -1,4 +1,5 @@
-"""tpudra-lint fixture: SHARED-STATE must fire on every marked line."""
+"""tpudra-lint fixture: RACE must fire on every marked line — a field
+written from two thread roles with no common lock across the writes."""
 
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -12,7 +13,7 @@ class Tracker:
 
     def kick(self):
         def work():
-            self._count = self._count + 1  # EXPECT: SHARED-STATE
+            self._count = self._count + 1  # EXPECT: RACE
 
         self._pool.submit(work)
 
@@ -30,7 +31,7 @@ class Monitor:
         self._thread.start()
 
     def _loop(self):
-        self._status = "running"  # EXPECT: SHARED-STATE
+        self._status = "running"  # EXPECT: RACE
 
     def clear(self):
         self._status = ""

@@ -1,0 +1,91 @@
+"""tpudra-lint fixture: happens-before edges the race rules must honor —
+zero findings.  Covers init-before-start publication, write-before-spawn
+plus write-after-join in the spawner, queue put/get handoff, and
+condition wait/notify handoff."""
+
+import queue
+import threading
+
+
+class InitBeforeStart:
+    """Config written before the thread exists; the spawn is the
+    publication edge."""
+
+    def __init__(self):
+        self._config = {}
+        self._thread = None
+
+    def start(self, config):
+        self._config = config
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._config.get("poll")
+
+
+class SpawnJoin:
+    """The spawner writes before start() and again after join(): both
+    writes are ordered against the worker's by the spawn/join edges."""
+
+    def __init__(self):
+        self._result = None
+        self._thread = None
+
+    def run(self):
+        self._result = "pending"
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+        self._thread.join()
+        self._result = "collected"
+
+    def _work(self):
+        self._result = "done"
+
+
+class QueueHandoff:
+    """Items cross threads through the queue; the batch buffer is only
+    touched after a get() that the put() happens-before."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+        self._batch = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def submit(self, item):
+        self._batch = [item]
+        self._q.put(item)
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            self._batch = [item, self._batch]
+
+
+class CondHandoff:
+    """Writes on both sides of a condition wait/notify pair: the waiter
+    only proceeds after the notifier published."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._payload = None
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._consume, daemon=True)
+        self._thread.start()
+
+    def produce(self, payload):
+        with self._cond:
+            self._payload = payload
+            self._cond.notify()
+
+    def _consume(self):
+        with self._cond:
+            self._cond.wait()
+            self._payload = None

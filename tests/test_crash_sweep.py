@@ -51,6 +51,15 @@ def effect_graph():
 
     return build_graph(os.path.join(REPO, "tpudra"))
 
+
+@pytest.fixture(scope="module")
+def race_graph():
+    """The static thread/race model, built once for the race-witness
+    merges."""
+    from tpudra.analysis.racemerge import build_graph
+
+    return build_graph(os.path.join(REPO, "tpudra"))
+
 pytestmark = pytest.mark.skipif(
     not os.path.exists(LIB_PATH),
     reason="libtpuinfo.so not built (make -C native)",
@@ -138,7 +147,7 @@ CLAIMS = {"chip": chip_claim, "partition": partition_claim}
 @pytest.mark.parametrize("kind", sorted(CLAIMS))
 @pytest.mark.parametrize("point", POINTS)
 def test_sigkill_at_checkpoint_boundary_converges(
-    short_tmp, point, kind, effect_graph
+    short_tmp, point, kind, effect_graph, race_graph
 ):
     mk = CLAIMS[kind]
     uid = f"crash-{kind}-{point}"
@@ -245,6 +254,15 @@ def test_sigkill_at_checkpoint_boundary_converges(
 
             report = merge(effect_graph, h.wal_witness_log)
             assert report.ok, report.render()
+
+            # -------- race-witness merge: every sampled cross-thread
+            # access across both plugin processes (SIGKILL included) must
+            # fit the static thread/race model — zero witnessed unordered
+            # write pairs, zero model gaps.
+            from tpudra.analysis.racemerge import merge as race_merge
+
+            rreport = race_merge(race_graph, h.race_witness_log)
+            assert rreport.ok, rreport.render()
         finally:
             h.terminate()
 

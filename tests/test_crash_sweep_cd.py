@@ -38,6 +38,17 @@ API_V = "resource.tpu.google.com/v1beta1"
 CD_UID = "cd-crash-uid"
 NODE = "crash-node"
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def race_graph():
+    """The static thread/race model, built once for the race-witness
+    merges."""
+    from tpudra.analysis.racemerge import build_graph
+
+    return build_graph(os.path.join(REPO, "tpudra"))
+
 
 class CDHarness(CrashablePlugin):
     module = "tpudra.cdplugin.main"
@@ -111,7 +122,7 @@ def node_label(client):
 
 
 @pytest.mark.parametrize("point", POINTS)
-def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point):
+def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point, race_graph):
     uid = f"cd-crash-{point}"
     with FakeKubeServer() as server:
         client = KubeClient(server.url)
@@ -183,6 +194,15 @@ def test_cd_sigkill_at_checkpoint_boundary_converges(short_tmp, point):
             assert not any(uid in f for f in h.cdi_files())
             assert uid not in h.claim_statuses()
             assert node_label(client) is None
+
+            # -------- race-witness merge: both CD plugin processes'
+            # sampled cross-thread accesses (SIGKILL included) must fit the
+            # static thread/race model — zero witnessed unordered write
+            # pairs, zero model gaps.
+            from tpudra.analysis.racemerge import merge as race_merge
+
+            rreport = race_merge(race_graph, h.race_witness_log)
+            assert rreport.ok, rreport.render()
         finally:
             h.terminate()
 

@@ -1,0 +1,675 @@
+"""tpudra-racegraph (tpudra/analysis/{racemodel,racemerge}.py): the
+thread-role model, the Eraser-style lockset rules with happens-before
+refinement, the `# tpudra-race:` annotation grammar, the generated race
+model doc, the SHARED-STATE suppression alias, and the parse cache.
+
+The fixture corpus (tests/fixtures/lint/{bad,good}/racegraph*.py) rides
+the exact-(line, rule) machinery in tests/test_lint.py; this file covers
+everything beyond per-fixture precision.  The runtime witness and its
+merge live in tests/test_racewitness.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tpudra.analysis import engine
+from tpudra.analysis.engine import (
+    DEFAULT_ROOTS,
+    ParsedModule,
+    lint_modules,
+    lint_source,
+    parse_paths,
+)
+from tpudra.analysis.racemerge import build_graph, emit_markdown
+from tpudra.analysis.racemodel import analyze_races
+from tpudra.analysis.rules import racegraph_rules
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mk_module(source: str, path: str = "mod_under_test.py") -> ParsedModule:
+    return ParsedModule(path=path, source=source, tree=ast.parse(source))
+
+
+def races(source: str):
+    """Race model of one inline module: (result, findings)."""
+    result = analyze_races([mk_module(textwrap.dedent(source))])
+    return result, result.findings
+
+
+def rule_ids(findings) -> list[str]:
+    return sorted(f.rule_id for f in findings)
+
+
+@pytest.fixture(scope="module")
+def race_graph():
+    """The static race model of the tpudra package, built once."""
+    return build_graph(os.path.join(REPO_ROOT, "tpudra"))
+
+
+# ------------------------------------------------------------------ CI gates
+
+
+def test_racegraph_is_clean():
+    """The whole-program gate, mirroring test_repo_is_clean: zero
+    RACE / GUARD-CONSISTENCY / THREAD-CONFINED-ESCAPE findings at HEAD
+    (every deliberate exception carries a reasoned annotation)."""
+    roots = [
+        p
+        for p in (os.path.join(REPO_ROOT, r) for r in DEFAULT_ROOTS)
+        if os.path.exists(p)
+    ]
+    modules, parse_findings = parse_paths(roots)
+    findings = lint_modules(modules, parse_findings, rules=racegraph_rules())
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_race_model_doc_is_fresh(race_graph):
+    """docs/race-model.md is generated; a role or shared-field change must
+    ship a regenerated table (`make racegraph-docs`)."""
+    doc = os.path.join(REPO_ROOT, "docs", "race-model.md")
+    with open(doc, encoding="utf-8") as f:
+        on_disk = f.read()
+    assert on_disk == emit_markdown(race_graph), (
+        "docs/race-model.md is stale — run `make racegraph-docs` and commit "
+        "the result"
+    )
+
+
+# ----------------------------------------------------- HEAD regression pins
+
+
+def test_mock_partitions_guard_pinned(race_graph):
+    """The triage fix for this rule family: MockDeviceLib mutates
+    `_partitions` from the health loop AND from driver calls, so every
+    non-init write must hold the devicelib lock.  If the intersection
+    drops, the production fix regressed."""
+    info = race_graph.fields["MockDeviceLib._partitions"]
+    writes = [
+        a for a in info.sites if a.write and not a.init and not a.handoff
+    ]
+    assert writes, "model no longer sees MockDeviceLib._partitions writes"
+    guards = frozenset.intersection(*[a.guards for a in writes])
+    assert "devicelib.mock.MockDeviceLib._lock" in guards
+
+
+def test_controller_worker_role_resolved(race_graph):
+    """`Thread(target=self.queue.run, name="controller-worker-N")` is an
+    attribute-of-attribute entry: the model must resolve it through the
+    call graph's attr-type inference, or every runtime sample from a
+    worker thread becomes a merge-failing model gap."""
+    role = race_graph.roles["controller-worker"]
+    assert "tpudra.workqueue:WorkQueue.run" in role.entries
+    assert "controller-worker" in race_graph.fields["WorkQueue._heap"].roles()
+
+
+def test_known_production_roles_present(race_graph):
+    """The role vocabulary the runtime witness classifies against: these
+    production thread names must keep deriving from their spawn sites."""
+    for role_id in (
+        "informer",
+        "informer-resync",
+        "controller",
+        "controller-worker",
+        "device-health",
+        "lease-elector",
+    ):
+        assert role_id in race_graph.roles, role_id
+
+
+# ------------------------------------------------- role derivation (inline)
+
+
+def test_role_from_name_constant():
+    result, _ = races(
+        """
+        import threading
+
+        def loop():
+            pass
+
+        def main():
+            threading.Thread(target=loop, name="pumper").start()
+        """
+    )
+    assert "pumper" in result.roles
+    assert result.roles["pumper"].entries == ("mod_under_test:loop",)
+
+
+def test_role_from_fstring_prefix():
+    """`name=f"worker-{i}"` derives the role from the constant prefix,
+    matching the longest-prefix classification the witness merge uses."""
+    result, _ = races(
+        """
+        import threading
+
+        def loop():
+            pass
+
+        def main():
+            for i in range(4):
+                threading.Thread(target=loop, name=f"worker-{i}").start()
+        """
+    )
+    assert "worker" in result.roles
+
+
+def test_unnamed_thread_role_from_entry():
+    result, _ = races(
+        """
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                pass
+        """
+    )
+    assert "thread:loop" in result.roles
+
+
+# ------------------------------------------------------- the RACE rule
+
+
+RACY = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self.val = 0
+
+        def start(self):
+            threading.Thread(target=self._loop, name="boxer").start()
+
+        def _loop(self):
+            while True:
+                self.val += 1
+
+        def reset(self):
+            self.val = 0
+    """
+
+
+def test_unguarded_cross_role_write_is_race():
+    result, findings = races(RACY)
+    assert rule_ids(findings) == ["RACE"]
+    assert "Box.val" in findings[0].message
+    # Anchored at the spawned-thread side (the unguarded non-main write).
+    assert findings[0].line == 13
+    assert result.fields["Box.val"].roles() >= {"main", "boxer"}
+
+
+def test_common_guard_is_clean():
+    _, findings = races(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.val = 0
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                with self._lock:
+                    self.val += 1
+
+            def reset(self):
+                with self._lock:
+                    self.val = 0
+        """
+    )
+    assert findings == []
+
+
+def test_single_role_writes_are_clean():
+    """Writes all on one role never race, however unguarded."""
+    _, findings = races(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.val = 0
+
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                self.val += 1
+                self._bump()
+
+            def _bump(self):
+                self.val += 1
+        """
+    )
+    assert findings == []
+
+
+def test_interprocedural_guard_through_helper():
+    """A helper ONLY ever called with the lock held inherits it via the
+    entry-held fixpoint — the write inside is guarded."""
+    _, findings = races(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.val = 0
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self.val += 1
+
+            def reset(self):
+                with self._lock:
+                    self._bump()
+        """
+    )
+    assert findings == []
+
+
+def test_guard_consistency_on_split_locks():
+    """Every write guarded, but by DIFFERENT locks — the distinct rule so
+    review sees 'pick one guard', not 'add a guard'."""
+    _, findings = races(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.val = 0
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                with self._a:
+                    self.val += 1
+
+            def reset(self):
+                with self._b:
+                    self.val = 0
+        """
+    )
+    assert rule_ids(findings) == ["GUARD-CONSISTENCY"]
+
+
+# ------------------------------------------------- happens-before refinement
+
+
+def test_init_before_start_publication_is_clean():
+    """__init__ writes happen-before the spawn that publishes the object —
+    the classic config-then-start idiom must not count as a racing
+    write."""
+    _, findings = races(
+        """
+        import threading
+
+        class Pump:
+            def __init__(self, cfg):
+                self.cfg = dict(cfg)
+
+            def start(self):
+                threading.Thread(target=self._loop, name="pump").start()
+
+            def _loop(self):
+                self.cfg = dict(self.cfg)
+        """
+    )
+    assert findings == []
+
+
+def test_write_before_spawn_in_spawner_is_ordered():
+    _, findings = races(
+        """
+        import threading
+
+        class Pump:
+            def start(self):
+                self.state = "starting"
+                threading.Thread(target=self._loop, name="pump").start()
+
+            def _loop(self):
+                self.state = "running"
+        """
+    )
+    assert findings == []
+
+
+def test_join_orders_post_join_writes():
+    _, findings = races(
+        """
+        import threading
+
+        class Pump:
+            def run_once(self):
+                t = threading.Thread(target=self._work, name="pump")
+                t.start()
+                t.join()
+                self.total = 0
+
+            def _work(self):
+                self.total = 1
+        """
+    )
+    assert findings == []
+
+
+def test_write_after_spawn_without_join_races():
+    _, findings = races(
+        """
+        import threading
+
+        class Pump:
+            def run_once(self):
+                t = threading.Thread(target=self._work, name="pump")
+                t.start()
+                self.total = 0
+
+            def _work(self):
+                self.total = 1
+        """
+    )
+    assert rule_ids(findings) == ["RACE"]
+
+
+def test_queue_handoff_orders_writes():
+    """write → put on one side, get → write on the other: the channel
+    carries the happens-before edge."""
+    _, findings = races(
+        """
+        import queue
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self.item = None
+                self.q = queue.Queue()
+
+            def start(self):
+                threading.Thread(target=self._drain, name="pipe").start()
+
+            def submit(self, x):
+                self.item = x
+                self.q.put(x)
+
+            def _drain(self):
+                self.q.get()
+                self.item = None
+        """
+    )
+    assert findings == []
+
+
+# --------------------------------------------------- annotations + confined
+
+
+def test_owner_annotation_and_escape():
+    result, findings = races(
+        """
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._loop, name="pump").start()
+
+            def _loop(self):
+                # tpudra-race: owner=pump the cursor is loop-private
+                self.cursor = 1
+
+            def rewind(self):
+                self.cursor = 0
+        """
+    )
+    assert rule_ids(findings) == ["THREAD-CONFINED-ESCAPE"]
+    assert findings[0].line == 13  # the stray main-role write
+    assert result.fields["Pump.cursor"].owner == "pump"
+
+
+def test_guard_annotation_joins_lockset():
+    """guard=ID vouches for a lock the lexical scan cannot see (an
+    external mutex, a C-level guarantee) — annotated sites intersect."""
+    _, findings = races(
+        """
+        import threading
+
+        class Box:
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                # tpudra-race: guard=ext.mutex held by the embedding runtime
+                self.val = 1
+
+            def reset(self):
+                # tpudra-race: guard=ext.mutex held by the embedding runtime
+                self.val = 0
+        """
+    )
+    assert findings == []
+
+
+def test_handoff_annotation_excludes_site():
+    _, findings = races(
+        """
+        import threading
+
+        class Box:
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                self.val = 1
+
+            def adopt(self):
+                # tpudra-race: handoff ownership transferred before start
+                self.val = 0
+        """
+    )
+    assert findings == []
+
+
+def test_mutator_needs_container_evidence():
+    """`self.cb.append(...)` only counts as a field write once the model
+    has container evidence for the field (a literal/ctor assignment) —
+    otherwise `.append` on an opaque object is not a mutation claim."""
+    _, findings = races(
+        """
+        import threading
+
+        class Opaque:
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                self.cb.append(1)
+
+            def reset(self):
+                self.cb.append(2)
+        """
+    )
+    assert findings == []
+    _, findings = races(
+        """
+        import threading
+
+        class Evident:
+            def __init__(self):
+                self.cb = []
+
+            def start(self):
+                threading.Thread(target=self._loop, name="boxer").start()
+
+            def _loop(self):
+                self.cb.append(1)
+
+            def reset(self):
+                self.cb.append(2)
+        """
+    )
+    assert rule_ids(findings) == ["RACE"]
+
+
+# ------------------------------------------------- SHARED-STATE suppression
+
+
+def test_shared_state_suppression_aliases_to_race_rules():
+    """SHARED-STATE retired into this family: existing reasoned
+    `disable=SHARED-STATE` comments keep covering the successor ids."""
+    racy = textwrap.dedent(RACY)
+    line = "self.val += 1"
+    suppressed = racy.replace(
+        line,
+        line
+        + "  # tpudra-lint: disable=SHARED-STATE counter is best-effort",
+    )
+    findings = lint_modules([mk_module(suppressed)], rules=racegraph_rules())
+    assert findings == []
+    # ...and the unsuppressed source still fires through the same lane.
+    assert "RACE" in rule_ids(
+        lint_modules([mk_module(racy)], rules=racegraph_rules())
+    )
+
+
+def test_race_annotation_requires_reason():
+    findings = lint_source(
+        textwrap.dedent(
+            """
+            class Box:
+                def set(self):
+                    # tpudra-race: guard=ext.mutex
+                    self.val = 1
+            """
+        )
+    )
+    assert "ANNOTATION-REASON" in rule_ids(findings)
+
+
+# ------------------------------------------------------------ parse cache
+
+
+def test_cache_escape_hatch(monkeypatch):
+    monkeypatch.setenv("TPUDRA_LINT_CACHE", "0")
+    assert engine._cache_dir() is None
+    monkeypatch.delenv("TPUDRA_LINT_CACHE")
+    d = engine._cache_dir()
+    assert d is not None and d.endswith(".tpudra-analysis-cache")
+
+
+def test_cache_invalidates_on_mutation(tmp_path):
+    """The cache is keyed by content hash: mutate the file, re-run, and
+    the parse MUST see the new source — never a stale tree."""
+    mod = tmp_path / "m.py"
+    mod.write_text("X = 1\n")
+    modules, _ = parse_paths([str(mod)])
+    assert "X = 1" in modules[0].source
+    first_tree = ast.dump(modules[0].tree)
+    mod.write_text("X = 2\n")
+    modules, _ = parse_paths([str(mod)])
+    assert "X = 2" in modules[0].source
+    assert ast.dump(modules[0].tree) != first_tree
+
+
+def test_cache_round_trip_equals_fresh_parse(tmp_path, monkeypatch):
+    """Warm-hit deserialization returns the same module a cold parse
+    builds (source, path, and tree shape)."""
+    mod = tmp_path / "m.py"
+    mod.write_text("def f():\n    return 41\n")
+    warm, _ = parse_paths([str(mod)])
+    warm2, _ = parse_paths([str(mod)])
+    monkeypatch.setenv("TPUDRA_LINT_CACHE", "0")
+    cold, _ = parse_paths([str(mod)])
+    assert warm2[0].source == cold[0].source
+    assert ast.dump(warm2[0].tree) == ast.dump(cold[0].tree)
+    assert warm2[0].path == cold[0].path
+
+
+# ------------------------------------------------------------------------ CLI
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tpudra.analysis", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        timeout=300,
+    )
+
+
+def test_cli_racegraph_clean_at_head():
+    proc = _run_cli("--racegraph")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "tpudra-racegraph: clean" in proc.stdout
+
+
+def test_cli_lanes_are_exclusive():
+    proc = _run_cli("--racegraph", "--lockgraph")
+    assert proc.returncode == 2
+
+
+def test_cli_list_rules_has_race_family():
+    proc = _run_cli("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("RACE", "GUARD-CONSISTENCY", "THREAD-CONFINED-ESCAPE"):
+        assert rid in proc.stdout, rid
+
+
+def test_cli_emit_racegraph(tmp_path):
+    out = str(tmp_path / "race-model.md")
+    proc = _run_cli("--emit-racegraph", out)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        content = f.read()
+    assert "# Thread-role race model" in content
+    assert "`controller-worker`" in content
+
+
+def test_cli_race_witness_missing_log_is_usage_error():
+    proc = _run_cli("--race-witness", "no/such/log.jsonl")
+    assert proc.returncode == 2
+
+
+def test_cli_race_witness_merge(tmp_path):
+    log = str(tmp_path / "race.jsonl")
+    with open(log, "w") as f:
+        f.write(json.dumps({"t": "meta", "pid": 1, "locks_armed": True}) + "\n")
+        f.write(
+            json.dumps(
+                {
+                    "t": "access",
+                    "field": "WorkQueue._heap",
+                    "thread": "MainThread",
+                    "write": True,
+                    "locks": ["workqueue.cond"],
+                    "vc": {"MainThread": 0},
+                    "pid": 1,
+                }
+            )
+            + "\n"
+        )
+    proc = _run_cli("--race-witness", log)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "witness merge: OK" in proc.stdout

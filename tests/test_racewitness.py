@@ -1,0 +1,366 @@
+"""The runtime race witness (tpudra/racewitness.py) and its merge into
+the static race model (tpudra/analysis/racemerge.py): vector-clock epoch
+mechanics, sampling/dedup/torn-tail behavior, thread-name
+classification, the violation / model-gap / coverage verdicts, and one
+end-to-end planted race the witness must actually catch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import pytest
+
+from tpudra import lockwitness, racewitness
+from tpudra.analysis import racemerge
+from tpudra.analysis.racemodel import (
+    Access,
+    FieldInfo,
+    RaceGraphResult,
+    ThreadRole,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def armed(tmp_path, monkeypatch):
+    """Race witness armed into a fresh log, WITH the lock witness it
+    piggybacks on — unarmed-lock pids are skipped by the merge's race
+    check (their locksets are vacuously empty)."""
+    log = str(tmp_path / "race-witness.jsonl")
+    monkeypatch.setenv(racewitness.ENV_WITNESS, "1")
+    monkeypatch.setenv(racewitness.ENV_WITNESS_LOG, log)
+    monkeypatch.setenv(lockwitness.ENV_WITNESS, "1")
+    monkeypatch.setenv(
+        lockwitness.ENV_WITNESS_LOG, str(tmp_path / "lock-witness.jsonl")
+    )
+    racewitness.reset_for_tests()
+    yield log
+    racewitness.reset_for_tests()
+
+
+def in_thread(name: str, fn) -> None:
+    t = threading.Thread(target=fn, name=name)
+    t.start()
+    t.join()
+
+
+def model(fields: dict[str, dict], roles=()) -> RaceGraphResult:
+    """A hand-built static model: {display: {role, ...}} shared fields."""
+    infos = {}
+    for fid, role_set in fields.items():
+        cls, _, attr = fid.partition(".")
+        infos[fid] = FieldInfo(
+            field=(f"m:{cls}", attr),
+            display=fid,
+            sites=[
+                Access(
+                    field=(f"m:{cls}", attr),
+                    path="m.py",
+                    line=1,
+                    fn_qual=f"m:{cls}.f",
+                    write=True,
+                    init=False,
+                    guards=frozenset(),
+                    roles=frozenset({r}),
+                )
+                for r in role_set
+            ],
+        )
+    role_map = {
+        r: ThreadRole(r, "thread", "m:f", "m.py", 1, ())
+        for r in set(roles) | {r for rs in fields.values() for r in rs}
+    }
+    return RaceGraphResult(roles=role_map, fields=infos, findings=[])
+
+
+# ----------------------------------------------------- vector-clock epochs
+
+
+def test_send_ticks_own_epoch(armed):
+    racewitness.note_hb_send("chan")
+    me = threading.current_thread().name
+    assert racewitness.vector_clock()[me] == 1
+    racewitness.note_hb_send("chan")
+    assert racewitness.vector_clock()[me] == 2
+
+
+def test_recv_merges_channel_into_receiver(armed):
+    racewitness.note_hb_send("chan")
+    in_thread("rx", lambda: racewitness.note_hb_recv("chan"))
+    me = threading.current_thread().name
+    # The receiver saw the sender's pre-tick epoch (0), not the post-tick
+    # one — work after the send is NOT covered by the publication.
+    assert racewitness.vector_clock("rx") == {me: 0, "rx": 0}
+
+
+def test_recv_on_silent_channel_is_noop(armed):
+    in_thread("rx", lambda: racewitness.note_hb_recv("never-sent"))
+    assert racewitness.vector_clock("rx") == {}
+
+
+def test_ordered_before_is_epoch_domination():
+    a = racewitness.Sample("F.x", "tx", True, (), {"tx": 0}, 1)
+    b = racewitness.Sample("F.x", "rx", True, (), {"tx": 0, "rx": 0}, 1)
+    c = racewitness.Sample("F.x", "rx", True, (), {"rx": 0}, 1)
+    assert a.ordered_before(b)  # rx holds tx's epoch
+    assert not b.ordered_before(a)  # tx never saw rx
+    assert not a.ordered_before(c) and not c.ordered_before(a)  # concurrent
+
+
+def test_handoff_orders_samples_through_witness(armed):
+    """End-to-end clock plumbing: write→send in one thread, recv→write in
+    another produces samples the merge proves ordered."""
+    racewitness.note_access("Pipe.item")
+    racewitness.note_hb_send("pipe.q")
+
+    def rx():
+        racewitness.note_hb_recv("pipe.q")
+        racewitness.note_access("Pipe.item")
+
+    in_thread("rx", rx)
+    samples, _ = racewitness.read_log(armed)
+    first, second = samples
+    assert first.ordered_before(second)
+    report = racemerge.merge(model({"Pipe.item": {"main", "rx"}}), armed)
+    assert report.ok and not report.violations
+
+
+# ----------------------------------------------------- sampling + the log
+
+
+def test_disabled_mode_writes_nothing(tmp_path, monkeypatch):
+    log = str(tmp_path / "off.jsonl")
+    monkeypatch.delenv(racewitness.ENV_WITNESS, raising=False)
+    monkeypatch.setenv(racewitness.ENV_WITNESS_LOG, log)
+    racewitness.reset_for_tests()
+    racewitness.note_access("F.x")
+    racewitness.note_hb_send("chan")
+    racewitness.note_hb_recv("chan")
+    assert not os.path.exists(log)
+    assert racewitness.vector_clock() == {}
+
+
+def test_first_seen_dedup(armed):
+    for _ in range(100):
+        racewitness.note_access("F.x")
+    samples, _ = racewitness.read_log(armed)
+    assert len(samples) == 1
+
+
+def test_meta_records_lock_arming(armed):
+    racewitness.note_access("F.x")
+    _, armed_map = racewitness.read_log(armed)
+    assert armed_map == {os.getpid(): lockwitness.enabled()}
+
+
+def test_read_log_skips_torn_tail(tmp_path):
+    log = str(tmp_path / "torn.jsonl")
+    with open(log, "w") as f:
+        f.write(json.dumps({"t": "meta", "pid": 7, "locks_armed": True}) + "\n")
+        f.write(
+            json.dumps(
+                {"t": "access", "field": "F.x", "thread": "a", "write": True,
+                 "locks": [], "vc": {}, "pid": 7}
+            )
+            + "\n"
+        )
+        f.write('{"t": "access", "field": "F.y", "thr')  # SIGKILL mid-line
+    samples, armed_map = racewitness.read_log(log)
+    assert [s.field for s in samples] == ["F.x"]
+    assert armed_map == {7: True}
+
+
+def test_read_log_missing_file_is_empty():
+    samples, armed_map = racewitness.read_log("no/such/witness.jsonl")
+    assert samples == [] and armed_map == {}
+
+
+# ----------------------------------------------------------- classification
+
+
+def test_classify_thread_longest_prefix():
+    roles = ["informer", "informer-resync", "controller"]
+    assert racemerge.classify_thread("informer-resync-pods", roles) == (
+        "informer-resync"
+    )
+    assert racemerge.classify_thread("informer", roles) == "informer"
+    assert racemerge.classify_thread("MainThread", roles) == "main"
+    assert racemerge.classify_thread("Thread-3", roles) is None
+    assert racemerge.classify_thread("pytest-worker", roles) is None
+
+
+# ------------------------------------------------------------------- merge
+
+
+def sample(field, thread, locks=(), vc=None, pid=1, write=True):
+    return {
+        "t": "access", "field": field, "thread": thread, "write": write,
+        "locks": list(locks), "vc": dict(vc or {}), "pid": pid,
+    }
+
+
+def write_log(path, *records, pid=1, locks_armed=True):
+    with open(path, "w") as f:
+        f.write(
+            json.dumps({"t": "meta", "pid": pid, "locks_armed": locks_armed})
+            + "\n"
+        )
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_merge_flags_unordered_disjoint_writes(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(
+        log,
+        sample("F.x", "a", locks=["la"], vc={"a": 0}),
+        sample("F.x", "b", locks=["lb"], vc={"b": 0}),
+    )
+    report = racemerge.merge(model({"F.x": {"a", "b"}}), log)
+    assert not report.ok
+    assert report.violations == [("F.x", "a", "b", 1)]
+    assert "WITNESSED VIOLATION" in report.render()
+    assert "witness merge: FAILED" in report.render()
+
+
+def test_merge_common_lock_is_not_a_race(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(
+        log,
+        sample("F.x", "a", locks=["l", "extra"], vc={"a": 0}),
+        sample("F.x", "b", locks=["l"], vc={"b": 0}),
+    )
+    assert racemerge.merge(model({"F.x": {"a", "b"}}), log).ok
+
+
+def test_merge_vc_ordering_is_not_a_race(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(
+        log,
+        sample("F.x", "a", vc={"a": 0}),
+        sample("F.x", "b", vc={"a": 0, "b": 0}),  # b received a's epoch
+    )
+    assert racemerge.merge(model({"F.x": {"a", "b"}}), log).ok
+
+
+def test_merge_cross_pid_writes_never_race(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    with open(log, "w") as f:
+        for pid in (1, 2):
+            f.write(json.dumps(
+                {"t": "meta", "pid": pid, "locks_armed": True}) + "\n")
+            f.write(json.dumps(sample("F.x", "a" if pid == 1 else "b",
+                                      pid=pid)) + "\n")
+    assert racemerge.merge(model({"F.x": {"a", "b"}}), log).ok
+
+
+def test_merge_unarmed_pid_locksets_are_vacuous(tmp_path):
+    """A process that ran without the lock witness reports every lockset
+    empty — calling that a race would be noise, so the pid is skipped."""
+    log = str(tmp_path / "w.jsonl")
+    write_log(
+        log,
+        sample("F.x", "a", vc={"a": 0}),
+        sample("F.x", "b", vc={"b": 0}),
+        locks_armed=False,
+    )
+    assert racemerge.merge(model({"F.x": {"a", "b"}}), log).ok
+
+
+def test_merge_model_gap_unknown_field(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(log, sample("Ghost.x", "a"))
+    report = racemerge.merge(model({"F.x": {"a", "b"}}), log)
+    assert not report.ok
+    assert report.model_gaps == [("Ghost.x", None, "a")]
+    assert "no such field" in report.render()
+
+
+def test_merge_model_gap_unreached_role(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(log, sample("F.x", "c"))
+    report = racemerge.merge(
+        model({"F.x": {"a", "b"}}, roles=("c",)), log
+    )
+    assert not report.ok
+    assert report.model_gaps == [("F.x", "c", "c")]
+    assert "does not reach that field" in report.render()
+
+
+def test_merge_unknown_thread_cannot_gap(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(log, sample("F.x", "Thread-17"))
+    assert racemerge.merge(model({"F.x": {"a", "b"}}), log).ok
+
+
+def test_merge_coverage_is_informational(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(log, sample("F.x", "a"))
+    report = racemerge.merge(
+        model({"F.x": {"a", "b"}, "F.y": {"a", "b"}}), log
+    )
+    assert report.ok  # uncovered F.y reports, never fails
+    assert report.covered == {"F.x"} and report.uncovered == {"F.y"}
+    assert report.coverage() == 0.5
+    assert "never witnessed: F.y" in report.render()
+
+
+def test_merge_render_caps_uncovered_listing(tmp_path):
+    log = str(tmp_path / "w.jsonl")
+    write_log(log, sample("F0.x", "a"))
+    fields = {f"F{i}.x": {"a", "b"} for i in range(15)}
+    report = racemerge.merge(model(fields), log)
+    rendered = report.render()
+    assert rendered.count("never witnessed:") == 10
+    assert "and 4 more" in rendered
+
+
+# ----------------------------------------------------------- planted race
+
+
+def test_planted_race_is_witnessed(armed):
+    """The end-to-end guarantee: two threads hammering one field with no
+    lock and no handoff MUST surface as a witnessed violation — whatever
+    the schedule interleaved, the clocks prove no ordering."""
+
+    class Victim:
+        count = 0
+
+    def hammer():
+        Victim.count += 1
+        racewitness.note_access("Victim.count")
+
+    t1 = threading.Thread(target=hammer, name="racer-a")
+    t2 = threading.Thread(target=hammer, name="racer-b")
+    t1.start(), t2.start()
+    t1.join(), t2.join()
+    report = racemerge.merge(
+        model({"Victim.count": {"racer-a", "racer-b"}}), armed
+    )
+    assert not report.ok
+    assert report.violations == [("Victim.count", "racer-a", "racer-b",
+                                  os.getpid())]
+
+
+def test_planted_race_fixed_by_handoff(armed):
+    """The same pair, ordered by a send/recv edge, is clean — the witness
+    distinguishes a real race from sequenced cross-thread writes."""
+
+    def first():
+        racewitness.note_access("Victim.count")
+        racewitness.note_hb_send("baton")
+
+    def second():
+        racewitness.note_hb_recv("baton")
+        racewitness.note_access("Victim.count")
+
+    in_thread("racer-a", first)
+    in_thread("racer-b", second)
+    report = racemerge.merge(
+        model({"Victim.count": {"racer-a", "racer-b"}}), armed
+    )
+    assert report.ok, report.render()

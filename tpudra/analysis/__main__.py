@@ -1,19 +1,24 @@
-"""CLI for tpudra-lint + tpudra-lockgraph + tpudra-effectgraph:
-``python -m tpudra.analysis``.
+"""CLI for tpudra-lint + tpudra-lockgraph + tpudra-effectgraph +
+tpudra-racegraph: ``python -m tpudra.analysis``.
 
 One shared parse pass (parallel across files when CPUs allow) feeds the
-per-module lint rules and both whole-program analyses.  Extra modes:
+per-module lint rules and all whole-program analyses.  Extra modes:
 
 - ``--lockgraph``: only the lock rules (the ``make lockgraph`` lane);
 - ``--effectgraph``: only the WAL rules (the ``make effectgraph`` lane);
+- ``--racegraph``: only the race rules (the ``make racegraph`` lane);
 - ``--witness LOG``: merge a runtime lock witness log
   (tpudra/lockwitness.py) into the static lock graph — witnessed cycles
   and model gaps fail;
 - ``--wal-witness LOG``: merge a runtime WAL witness log
   (tpudra/walwitness.py) into the static effect graph — witnessed
   ordering violations and model gaps fail;
+- ``--race-witness LOG``: merge a runtime race witness log
+  (tpudra/racewitness.py) into the static race model — witnessed races
+  and model gaps fail;
 - ``--emit-dot [PATH]``: regenerate docs/lock-order.md from the model;
-- ``--emit-effectgraph [PATH]``: regenerate docs/effect-graph.md.
+- ``--emit-effectgraph [PATH]``: regenerate docs/effect-graph.md;
+- ``--emit-racegraph [PATH]``: regenerate docs/race-model.md.
 
 ``--json`` emits the stable machine schema (documented in
 docs/static-analysis.md and asserted by tests/test_lint.py)::
@@ -78,6 +83,12 @@ def main(argv: list[str] | None = None) -> int:
         "STRIPE-ORDER)",
     )
     parser.add_argument(
+        "--racegraph",
+        action="store_true",
+        help="run only the whole-program race rules (RACE, "
+        "GUARD-CONSISTENCY, THREAD-CONFINED-ESCAPE)",
+    )
+    parser.add_argument(
         "--witness",
         metavar="LOG",
         help="merge a TPUDRA_LOCK_WITNESS jsonl log into the static lock "
@@ -90,6 +101,13 @@ def main(argv: list[str] | None = None) -> int:
         help="merge a TPUDRA_WAL_WITNESS jsonl log into the static effect "
         "graph: witnessed intent-before-effect violations / model gaps "
         "fail, unwitnessed modeled effects are reported as coverage",
+    )
+    parser.add_argument(
+        "--race-witness",
+        metavar="LOG",
+        help="merge a TPUDRA_RACE_WITNESS jsonl log into the static race "
+        "model: witnessed unordered cross-thread writes / model gaps fail, "
+        "unwitnessed modeled shared fields are reported as coverage",
     )
     parser.add_argument(
         "--emit-dot",
@@ -107,6 +125,14 @@ def main(argv: list[str] | None = None) -> int:
         help="regenerate the effect-graph document (default "
         "docs/effect-graph.md) from the static WAL model and exit",
     )
+    parser.add_argument(
+        "--emit-racegraph",
+        nargs="?",
+        const="docs/race-model.md",
+        metavar="PATH",
+        help="regenerate the race-model document (default "
+        "docs/race-model.md) from the static race model and exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -119,17 +145,19 @@ def main(argv: list[str] | None = None) -> int:
             "reason (engine-level check)"
         )
         print(
-            "ANNOTATION-REASON: every '# tpudra-lock:'/'# tpudra-wal:' "
-            "annotation states a reason after its keywords (engine-level "
-            "check)"
+            "ANNOTATION-REASON: every '# tpudra-lock:'/'# tpudra-wal:'/"
+            "'# tpudra-race:' annotation states a reason after its keywords "
+            "(engine-level check)"
         )
         return 0
 
     graph_flags = (
         args.witness is not None
         or args.wal_witness is not None
+        or args.race_witness is not None
         or args.emit_dot is not None
         or args.emit_effectgraph is not None
+        or args.emit_racegraph is not None
     )
     if graph_flags:
         # Graph modes operate on the tpudra package's static model; the
@@ -141,6 +169,7 @@ def main(argv: list[str] | None = None) -> int:
                 ("--json", args.json),
                 ("--lockgraph", args.lockgraph),
                 ("--effectgraph", args.effectgraph),
+                ("--racegraph", args.racegraph),
                 ("paths", bool(args.paths)),
             )
             if present
@@ -148,17 +177,18 @@ def main(argv: list[str] | None = None) -> int:
         if rejected:
             print(
                 "tpudra-lockgraph: graph modes (--witness/--wal-witness/"
-                "--emit-dot/--emit-effectgraph) cannot be combined with "
+                "--race-witness/--emit-dot/--emit-effectgraph/"
+                "--emit-racegraph) cannot be combined with "
                 f"{', '.join(rejected)}",
                 file=sys.stderr,
             )
             return 2
         return _graph_mode(args)
 
-    if args.lockgraph and args.effectgraph:
+    if sum((args.lockgraph, args.effectgraph, args.racegraph)) > 1:
         print(
-            "tpudra-lint: --lockgraph and --effectgraph are separate lanes; "
-            "run the full analyzer for both",
+            "tpudra-lint: --lockgraph, --effectgraph and --racegraph are "
+            "separate lanes; run the full analyzer for all",
             file=sys.stderr,
         )
         return 2
@@ -187,6 +217,10 @@ def main(argv: list[str] | None = None) -> int:
         from tpudra.analysis.rules import effectgraph_rules
 
         rules = effectgraph_rules()
+    elif args.racegraph:
+        from tpudra.analysis.rules import racegraph_rules
+
+        rules = racegraph_rules()
     started = time.monotonic()
     modules, parse_findings = parse_paths(paths)
     findings = lint_modules(modules, parse_findings, rules=rules)
@@ -219,6 +253,8 @@ def main(argv: list[str] | None = None) -> int:
             name = "tpudra-lockgraph"
         elif args.effectgraph:
             name = "tpudra-effectgraph"
+        elif args.racegraph:
+            name = "tpudra-racegraph"
         for f in findings:
             print(f.render())
         n = len(findings)
@@ -233,12 +269,17 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _graph_mode(args) -> int:
-    """--witness / --wal-witness / --emit-dot / --emit-effectgraph: operate
-    on the static whole-program models of the tpudra package rather than on
-    lint findings.  One shared parse pass and one shared CallGraph feed
-    whichever of the two models the flags require."""
+    """--witness / --wal-witness / --race-witness / --emit-dot /
+    --emit-effectgraph / --emit-racegraph: operate on the static
+    whole-program models of the tpudra package rather than on lint
+    findings.  One shared parse pass and one shared CallGraph feed
+    whichever of the models the flags require."""
     root = _repo_root()
-    for flag, log in (("witness", args.witness), ("wal-witness", args.wal_witness)):
+    for flag, log in (
+        ("witness", args.witness),
+        ("wal-witness", args.wal_witness),
+        ("race-witness", args.race_witness),
+    ):
         if log is not None and not os.path.exists(log):
             # Before the (multi-second) whole-program pass: a typo'd log
             # path is a usage error, not a reason to build and maybe
@@ -293,6 +334,28 @@ def _graph_mode(args) -> int:
             )
         if args.wal_witness is not None:
             report = effectwitness.merge(eresult, args.wal_witness)
+            print(report.render())
+            rc = rc or (0 if report.ok else 1)
+
+    if args.emit_racegraph is not None or args.race_witness is not None:
+        from tpudra.analysis import racemerge
+        from tpudra.analysis.racemodel import analyze_races
+
+        rresult = analyze_races(modules, graph)
+        if args.emit_racegraph is not None:
+            out_path = args.emit_racegraph
+            if not os.path.isabs(out_path):
+                out_path = os.path.join(root, out_path)
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write(racemerge.emit_markdown(rresult))
+            shared = rresult.shared_fields()
+            print(
+                f"tpudra-racegraph: wrote {out_path} "
+                f"({len(rresult.roles)} roles, {len(rresult.fields)} fields, "
+                f"{len(shared)} shared)"
+            )
+        if args.race_witness is not None:
+            report = racemerge.merge(rresult, args.race_witness)
             print(report.render())
             rc = rc or (0 if report.ok else 1)
     return rc

@@ -27,14 +27,24 @@ _SUPPRESS_RE = re.compile(
     r"(?:\s+(?P<reason>[^#\s][^#]*))?"
 )
 
-#: Analyzer annotations (`# tpudra-lock:` / `# tpudra-wal:`) change what the
-#: whole-program models believe about the code; like suppressions, each must
-#: carry a free-text why after its keywords (ANNOTATION-REASON).
+#: Analyzer annotations (`# tpudra-lock:` / `# tpudra-wal:` /
+#: `# tpudra-race:`) change what the whole-program models believe about the
+#: code; like suppressions, each must carry a free-text why after its
+#: keywords (ANNOTATION-REASON).
 _ANNOTATION_COMMENT_RE = re.compile(
-    r"#\s*(?P<prefix>tpudra-(?:lock|wal)):\s*(?P<body>.+)"
+    r"#\s*(?P<prefix>tpudra-(?:lock|wal|race)):\s*(?P<body>.+)"
 )
-_ANNOTATION_KV_RE = re.compile(r"^(id|acquires|kind|recovers)=\S+$")
-_ANNOTATION_FLAGS = {"family", "nonblocking", "nonrecoverable"}
+_ANNOTATION_KV_RE = re.compile(r"^(id|acquires|kind|recovers|guard|owner)=\S+$")
+_ANNOTATION_FLAGS = {"family", "nonblocking", "nonrecoverable", "handoff"}
+
+#: Retired rule ids whose suppressions keep working: a finding from a
+#: successor rule is covered by a suppression naming the predecessor
+#: (SHARED-STATE was absorbed into tpudra-racegraph).
+_RULE_ALIASES = {
+    "RACE": ("SHARED-STATE",),
+    "GUARD-CONSISTENCY": ("SHARED-STATE",),
+    "THREAD-CONFINED-ESCAPE": ("SHARED-STATE",),
+}
 
 
 @dataclass(frozen=True, order=True)
@@ -202,23 +212,94 @@ def _apply_suppressions(
     out = []
     for f in findings:
         sup = suppressions.get(f.path)
-        if sup is not None and sup.covers(f.line, f.rule_id):
-            continue
+        if sup is not None:
+            ids = (f.rule_id,) + _RULE_ALIASES.get(f.rule_id, ())
+            if any(sup.covers(f.line, rid) for rid in ids):
+                continue
         out.append(f)
     return out
 
 
+#: Bump when ParsedModule's pickled shape changes — stale entries must
+#: miss, not deserialize into the wrong structure.
+_CACHE_FORMAT = "tpudra-parse-cache/1"
+
+
+def _cache_dir() -> Optional[str]:
+    """``.tpudra-analysis-cache/`` at the repo root (the directory holding
+    the ``tpudra`` package); ``TPUDRA_LINT_CACHE=0`` is the escape hatch."""
+    if os.environ.get("TPUDRA_LINT_CACHE", "1") == "0":
+        return None
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, ".tpudra-analysis-cache")
+
+
+def _cache_key(filename: str, source: str) -> str:
+    import hashlib
+    import sys
+
+    h = hashlib.sha256()
+    h.update(_CACHE_FORMAT.encode())
+    h.update(("%d.%d" % sys.version_info[:2]).encode())
+    h.update(filename.encode())  # same bytes at another path ≠ same module
+    h.update(b"\0")
+    h.update(source.encode())
+    return h.hexdigest()
+
+
+def _cache_get(cache_dir: str, key: str):
+    import pickle
+
+    try:
+        with open(os.path.join(cache_dir, key + ".pkl"), "rb") as f:
+            obj = pickle.load(f)
+    except Exception:  # tpudra-lint: disable=EXC-SWALLOW any unpickle failure (miss, torn write, stale format) means exactly one thing: reparse — nothing to log, nothing to narrow (pickle raises arbitrary types)
+        return None
+    return obj if isinstance(obj, ParsedModule) else None
+
+
+def _cache_put(cache_dir: str, key: str, module: ParsedModule) -> None:
+    import pickle
+
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = os.path.join(cache_dir, f".{key}.{os.getpid()}.tmp")
+        with open(tmp, "wb") as f:
+            pickle.dump(module, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, os.path.join(cache_dir, key + ".pkl"))
+    except Exception:  # tpudra-lint: disable=EXC-SWALLOW the cache is an optimization — a full disk or unwritable dir must not fail lint, and there is no logger this deep in the parse worker
+        pass
+
+
 def _parse_one(filename: str):
     """Parse worker (top level so multiprocessing can pickle it): the
-    ParsedModule, or the SYNTAX Finding when the file cannot be read."""
+    ParsedModule, or the SYNTAX Finding when the file cannot be read.
+
+    Results are memoized under ``.tpudra-analysis-cache/`` keyed by the
+    content hash (plus path, format version, and interpreter version), so
+    a warm lint run skips ``ast.parse`` for unchanged files; any edit
+    changes the hash and misses.  Parse FAILURES are never cached — the
+    error message must track the live file."""
     try:
         with open(filename, encoding="utf-8") as f:
             source = f.read()
+    except (OSError, ValueError) as e:
+        return Finding(filename, 1, 0, "SYNTAX", f"cannot analyze: {e}")
+    cache_dir = _cache_dir()
+    key = _cache_key(filename, source) if cache_dir else ""
+    if cache_dir:
+        cached = _cache_get(cache_dir, key)
+        if cached is not None:
+            return cached
+    try:
         tree = ast.parse(source, filename=filename)
-    except (OSError, SyntaxError, ValueError) as e:
+    except (SyntaxError, ValueError) as e:
         line = getattr(e, "lineno", 1) or 1
         return Finding(filename, line, 0, "SYNTAX", f"cannot analyze: {e}")
-    return ParsedModule(path=filename, source=source, tree=tree)
+    module = ParsedModule(path=filename, source=source, tree=tree)
+    if cache_dir:
+        _cache_put(cache_dir, key, module)
+    return module
 
 
 def _default_jobs(n_files: int) -> int:

@@ -503,7 +503,7 @@ class LockModel:
     def _build_registry(self) -> None:
         for module in self.modules:
             mod = _module_of(module)
-            if mod in ("tpudra.lockwitness", "tpudra.trace"):
+            if mod not in ("tpudra.lockwitness", "tpudra.racewitness", "tpudra.trace"):
                 # The witness and the tracer are the measurement apparatus:
                 # their sink/ring guards are held for an append+flush and
                 # never across another acquisition by construction;
@@ -511,17 +511,17 @@ class LockModel:
                 # acquisition (and every span close) in a phantom lock
                 # node.  (The modules stay in the CALL graph so references
                 # into them resolve instead of degrading to unique-name
-                # guesses.)
-                continue
-            for node in module.tree.body:
-                if isinstance(node, ast.Assign) and len(node.targets) == 1:
-                    target = node.targets[0]
-                    if isinstance(target, ast.Name) and isinstance(node.value, ast.Call):
-                        ref = self._lock_ctor_ref(node.value, module, "", target.id)
-                        if ref is not None:
-                            self.module_locks[(mod, target.id)] = self._register(ref)
-                elif isinstance(node, ast.ClassDef):
-                    self._register_class_locks(module, mod, node)
+                # guesses, and their function-level directives below still
+                # load — the witness emit paths declare nonblocking.)
+                for node in module.tree.body:
+                    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                        target = node.targets[0]
+                        if isinstance(target, ast.Name) and isinstance(node.value, ast.Call):
+                            ref = self._lock_ctor_ref(node.value, module, "", target.id)
+                            if ref is not None:
+                                self.module_locks[(mod, target.id)] = self._register(ref)
+                    elif isinstance(node, ast.ClassDef):
+                        self._register_class_locks(module, mod, node)
             # Function-level directives: nonblocking / acquires on the def.
             for fn in self.graph.functions.values():
                 if fn.path != module.path:
@@ -1122,8 +1122,13 @@ class LockGraphAnalysis:
     """Runs held-set propagation over every function and derives the
     acquisition graph plus the three rule finding sets."""
 
-    def __init__(self, modules: list[ParsedModule], graph: Optional[CallGraph] = None):
-        self.model = LockModel(modules, graph)
+    def __init__(
+        self,
+        modules: list[ParsedModule],
+        graph: Optional[CallGraph] = None,
+        model: Optional[LockModel] = None,
+    ):
+        self.model = model or LockModel(modules, graph)
         self.edges: dict[tuple[str, str], Edge] = {}
         self.locks: dict[str, LockRef] = {}
         self.block_findings: list[Finding] = []
@@ -1447,6 +1452,8 @@ def _find_cycles(adj: dict[str, list[str]]) -> list[list[str]]:
 
 
 def analyze_modules(
-    modules: list[ParsedModule], graph: Optional[CallGraph] = None
+    modules: list[ParsedModule],
+    graph: Optional[CallGraph] = None,
+    model: Optional[LockModel] = None,
 ) -> LockGraphResult:
-    return LockGraphAnalysis(modules, graph).run()
+    return LockGraphAnalysis(modules, graph, model).run()

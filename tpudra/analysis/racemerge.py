@@ -1,0 +1,274 @@
+"""Witness merge + race-model doc generation for tpudra-racegraph.
+
+The static race model (racemodel.py) and the runtime race witness log
+(tpudra/racewitness.py) validate each other:
+
+- two WRITE samples of one field from different threads of one process
+  with disjoint held locksets and NO vector-clock ordering is a
+  **witnessed race** the suite actually exhibited — fail;
+- a sample from a thread whose name classifies to a model role the
+  static model says cannot reach that field — or of a field the model
+  does not know at all — is a **model gap** (role derivation or call
+  resolution missed a path) — fail, because RACE/GUARD-CONSISTENCY are
+  only as good as the model;
+- a modeled shared field never witnessed is a coverage statement,
+  reported but non-failing (static analysis over-approximates by
+  design).
+
+Thread-name classification is deliberately conservative: a sample's
+thread maps to the LONGEST role id that prefixes its runtime name
+(``informer-resync-pods`` → ``informer-resync``, not ``informer``;
+``MainThread`` → ``main``), and a name no role prefixes — pytest
+workers, bare ``Thread-N`` spawns — maps to nothing and can neither gap
+nor cover.  Races, by contrast, compare raw thread names: two unnamed
+threads colliding unordered on a field is a real race whatever the
+model calls them.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpudra import racewitness
+from tpudra.analysis.engine import parse_paths
+from tpudra.analysis.lockmodel import _rel
+from tpudra.analysis.racemodel import MAIN_ROLE, RaceGraphResult, analyze_races
+
+
+def build_graph(root: str) -> RaceGraphResult:
+    """The static race model of the tree under ``root`` (normally the
+    ``tpudra`` package directory) — one shared parse pass."""
+    modules, _ = parse_paths([root])
+    return analyze_races(modules)
+
+
+def classify_thread(name: str, role_ids) -> str | None:
+    """Runtime thread name → model role id, longest-prefix; None when no
+    role claims the name (unknown threads are wildcards, not gaps)."""
+    if name == racewitness.MAIN_THREAD_NAME:
+        return MAIN_ROLE
+    best = None
+    for role_id in role_ids:
+        if name == role_id or name.startswith(role_id):
+            if best is None or len(role_id) > len(best):
+                best = role_id
+    return best
+
+
+@dataclass
+class MergeReport:
+    sample_count: int
+    thread_names: set
+    violations: list = field(default_factory=list)  # (field, t1, t2, pid)
+    model_gaps: list = field(default_factory=list)  # (field, role, thread)
+    covered: set = field(default_factory=set)  # modeled shared ∩ witnessed
+    uncovered: set = field(default_factory=set)  # modeled shared, unseen
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.model_gaps
+
+    def coverage(self) -> float:
+        total = len(self.covered) + len(self.uncovered)
+        return (len(self.covered) / total) if total else 1.0
+
+    def render(self) -> str:
+        lines = [
+            f"witnessed: {self.sample_count} access sample(s) from "
+            f"{len(self.thread_names)} thread(s)",
+        ]
+        for fld, t1, t2, pid in self.violations:
+            lines.append(
+                f"WITNESSED VIOLATION: '{fld}' written by threads "
+                f"'{t1}' and '{t2}' (pid {pid}) with disjoint locksets and "
+                "no happens-before ordering — a data race the schedule "
+                "actually exhibited"
+            )
+        for fld, role, thread in self.model_gaps:
+            if role:
+                lines.append(
+                    f"MODEL GAP: thread '{thread}' (role '{role}') accessed "
+                    f"'{fld}' but the static model does not reach that field "
+                    "from that role — teach racemodel.py the spawn/call path "
+                    "before trusting RACE verdicts"
+                )
+            else:
+                lines.append(
+                    f"MODEL GAP: runtime accessed '{fld}' but the static "
+                    "model has no such field — instrumented name and model "
+                    "display id have drifted"
+                )
+        lines.append(
+            f"static shared-field coverage: {len(self.covered)}/"
+            f"{len(self.covered) + len(self.uncovered)} "
+            f"({self.coverage():.0%}) of modeled shared fields"
+        )
+        uncovered = sorted(self.uncovered)
+        for fld in uncovered[:10]:
+            lines.append(f"  never witnessed: {fld}")
+        if len(uncovered) > 10:
+            lines.append(
+                f"  ... and {len(uncovered) - 10} more (static analysis "
+                "over-approximates sharing; coverage is informational)"
+            )
+        lines.append("witness merge: " + ("OK" if self.ok else "FAILED"))
+        return "\n".join(lines)
+
+
+def merge(result: RaceGraphResult, log_path: str) -> MergeReport:
+    samples, armed = racewitness.read_log(log_path)
+    report = MergeReport(
+        sample_count=len(samples),
+        thread_names={s.thread for s in samples},
+    )
+    field_roles = {fid: info.roles() for fid, info in result.fields.items()}
+    shared = set(result.shared_fields())
+    role_ids = list(result.roles)
+
+    # -- model gaps ---------------------------------------------------------
+    seen_gap: set = set()
+    for s in samples:
+        role = classify_thread(s.thread, role_ids)
+        roles = field_roles.get(s.field)
+        if roles is None:
+            key = (s.field, None)
+            if key not in seen_gap:
+                seen_gap.add(key)
+                report.model_gaps.append((s.field, None, s.thread))
+            continue
+        if role is not None and role not in roles:
+            key = (s.field, role)
+            if key not in seen_gap:
+                seen_gap.add(key)
+                report.model_gaps.append((s.field, role, s.thread))
+
+    # -- witnessed races ----------------------------------------------------
+    by_field: dict = {}
+    for s in samples:
+        if s.write:
+            by_field.setdefault((s.pid, s.field), []).append(s)
+    seen_race: set = set()
+    for (pid, fld), writes in sorted(by_field.items()):
+        if not armed.get(pid, True):
+            # This process ran without the lock witness: every lockset is
+            # vacuously empty, and calling that a race would be noise.
+            continue
+        for i, a in enumerate(writes):
+            for b in writes[i + 1:]:
+                if a.thread == b.thread:
+                    continue
+                if a.locks & b.locks:
+                    continue
+                if a.ordered_before(b) or b.ordered_before(a):
+                    continue
+                key = (fld, *sorted((a.thread, b.thread)))
+                if key in seen_race:
+                    continue
+                seen_race.add(key)
+                t1, t2 = sorted((a.thread, b.thread))
+                report.violations.append((fld, t1, t2, pid))
+
+    witnessed_fields = {s.field for s in samples}
+    report.covered = shared & witnessed_fields
+    report.uncovered = shared - witnessed_fields
+    report.violations.sort()
+    report.model_gaps.sort(key=lambda g: (g[0], g[1] or ""))
+    return report
+
+
+# --------------------------------------------------------------- model doc
+
+
+def _field_verdict(info) -> str:
+    if info.owner:
+        return f"owner=`{info.owner}`"
+    writes = [a for a in info.sites if a.write and not a.init and not a.handoff]
+    if not writes:
+        return "init/handoff only"
+    guards = frozenset.intersection(*[a.guards for a in writes])
+    if guards:
+        return "guarded: " + ", ".join(f"`{g}`" for g in sorted(guards))
+    return "hb-ordered / annotated"
+
+
+def emit_markdown(result: RaceGraphResult) -> str:
+    """docs/race-model.md: thread roles with their spawn sites and
+    entries, every shared field with its role set and verdict, and the
+    witness workflow — regenerated by
+    ``python -m tpudra.analysis --emit-racegraph`` (``make
+    racegraph-docs``).  Deterministic output — a freshness test diffs it
+    against the file."""
+    out = [
+        "# Thread-role race model",
+        "",
+        "**Generated** by `python -m tpudra.analysis --emit-racegraph"
+        " docs/race-model.md`",
+        "(`make racegraph-docs`) from the tpudra-racegraph static model —"
+        " do not",
+        "edit by hand.  Rules, lockset algorithm, HB edges, annotation"
+        " grammar, and",
+        "witness workflow: [static-analysis.md](static-analysis.md).",
+        "",
+        "Every field written from two or more thread roles must keep a",
+        "non-empty intersection of held locks across its writes (RACE),",
+        "under ONE consistent lock (GUARD-CONSISTENCY), unless a",
+        "happens-before edge — init-before-start, spawn/join, queue or",
+        "condition handoff — orders the writes, or a reasoned",
+        "`# tpudra-race:` annotation claims the protocol.",
+        "",
+        "## Thread roles",
+        "",
+        "`main` is implicit: every function no modeled spawn reaches is",
+        "public API assumed to run on the caller's thread.",
+        "",
+        "| role | kind | spawned at | entries |",
+        "|---|---|---|---|",
+    ]
+    for role_id, role in sorted(result.roles.items()):
+        entries = ", ".join(
+            f"`{e.partition(':')[2] or e}`" for e in role.entries
+        ) or "—"
+        out.append(
+            f"| `{role_id}` | {role.kind} | "
+            f"{_rel(role.path)}:{role.line} | {entries} |"
+        )
+    out += [
+        "",
+        "## Shared fields",
+        "",
+        "Fields the model sees written or read from two or more roles,",
+        "with the write-lockset verdict the RACE rule enforces.",
+        "",
+        "| field | roles | verdict |",
+        "|---|---|---|",
+    ]
+    for fid, info in sorted(result.fields.items()):
+        roles = info.roles()
+        if len(roles) < 2:
+            continue
+        out.append(
+            f"| `{fid}` | {', '.join(f'`{r}`' for r in sorted(roles))} | "
+            f"{_field_verdict(info)} |"
+        )
+    out += [
+        "",
+        "## Witness workflow",
+        "",
+        "Run any suite with `TPUDRA_RACE_WITNESS=1` (the chaos soak and",
+        "both crash sweeps arm it automatically, alongside the lock",
+        "witness so held stacks are real), then merge:",
+        "",
+        "```console",
+        "$ TPUDRA_RACE_WITNESS=1 TPUDRA_LOCK_WITNESS=1 \\",
+        "    TPUDRA_RACE_WITNESS_LOG=/tmp/race.jsonl \\",
+        "    python -m pytest tests/ -q",
+        "$ python -m tpudra.analysis --race-witness /tmp/race.jsonl",
+        "```",
+        "",
+        "Unordered cross-thread writes with disjoint locksets fail as",
+        "witnessed races; accesses from a role the model cannot route to",
+        "the field fail as model gaps; modeled-but-never-witnessed shared",
+        "fields are the coverage report.",
+        "",
+    ]
+    return "\n".join(out)

@@ -1,0 +1,1059 @@
+"""tpudra-racegraph static model: thread roles, Eraser-style locksets,
+happens-before refinement.
+
+Three layers, riding the same parse pass and CallGraph as the lockgraph
+and the effectgraph:
+
+1. a **thread model** — every ``threading.Thread(target=...)`` and every
+   ``pool.submit(fn, ...)`` is a *spawn site* defining a logical thread
+   role (the publisher loop, the informer watch thread, the claim-effects
+   pool, workqueue workers, ...).  Each role's *reachable set* is the
+   call-graph closure of its entry; functions nobody in the corpus calls
+   are **main-role roots** (the public-API assumption: tests and gRPC
+   invoke them from the caller's thread).  Informer handler callbacks and
+   ``Driver._run_effects`` effect callables — dispatch the call graph
+   cannot resolve — are folded in explicitly, exactly as lockmodel does.
+
+2. **lockset inference per shared attribute** — every ``self.attr``
+   write/mutation site carries the set of lock IDs *definitely held*
+   there: the lexical ``with`` nesting (resolved through
+   ``LockModel.resolve_lock``, including ``@contextmanager`` wrappers)
+   plus the interprocedural *entry-held* fixpoint
+   ``entry(f) = ∩ over call sites (entry(caller) ∪ held-at-site)``.
+   A field written from ≥ 2 distinct roles must keep a non-empty
+   intersection of held guards across all conflicting writes.  The
+   conflict criterion is **write/write** (reads stay out: single-writer
+   fields are safe under the GIL's per-bytecode atomicity, and the
+   runtime witness covers the rest); intra-role concurrency (N threads
+   sharing one role id) is likewise the witness's side of the contract.
+
+3. **happens-before refinement** — conflicts are dropped when ordered:
+   ``__init__`` writes (init-before-start publication), writes lexically
+   before the role's spawn site in the spawning function, writes after a
+   ``join()`` that follows the spawn, and channel handoff pairs
+   (``Queue.put``/``get``, ``Event.set``/``wait``,
+   ``Condition.notify``/``wait``) where the writer sends after writing
+   and the other side receives before writing.
+
+Rules:
+
+- RACE — conflicting cross-role writes, empty guard intersection, some
+  write wholly unguarded, no happens-before edge;
+- GUARD-CONSISTENCY — every conflicting write holds *a* lock, but not
+  the *same* lock (the classic split-guard refactor bug);
+- THREAD-CONFINED-ESCAPE — a field declared ``# tpudra-race: owner=ROLE``
+  is accessed (read or write) from a function another role reaches.
+
+Annotations (``# tpudra-race:``, reason mandatory — ANNOTATION-REASON):
+``guard=LOCKID`` adds a guard the resolver cannot see at the access on
+its line; ``owner=ROLE`` declares thread confinement; ``handoff`` exempts
+an access whose ordering is a protocol the model has no edge for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from tpudra.analysis import astutil
+from tpudra.analysis.callgraph import CallGraph, FunctionInfo
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.lockmodel import LockModel, _rel
+
+MAIN_ROLE = "main"
+
+#: Mutating container/set/dict method names: a call on a ``self.attr``
+#: receiver with one of these IS a write to the attribute's object — but
+#: only once the field has *container evidence* (it is assigned a
+#: dict/list/set/deque literal or constructor somewhere in the corpus).
+#: Without that gate, every domain method named ``update`` or ``remove``
+#: (kube clients, managers) would read as a container write.
+_MUTATORS = frozenset(
+    {
+        "update", "add", "append", "appendleft", "extend", "insert",
+        "remove", "discard", "clear", "pop", "popitem", "setdefault",
+    }
+)
+
+_CONTAINER_CTORS = frozenset(
+    {
+        "dict", "list", "set", "deque", "defaultdict", "OrderedDict",
+        "Counter",
+    }
+)
+
+
+def _is_container_expr(expr: Optional[ast.expr]) -> bool:
+    if isinstance(expr, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        from tpudra.analysis.astutil import terminal_name
+
+        return terminal_name(expr.func) in _CONTAINER_CTORS
+    return False
+
+#: Channel-op classification for happens-before handoff edges.  ``put``
+#: has no dict/list collision; ``get`` is ambiguous (dict.get) so it only
+#: counts as a receive on a channel some function also ``put``s to;
+#: zero-arg ``set`` is ``Event.set`` (dicts have no ``set``).
+_SEND_METHODS = frozenset({"put", "put_nowait", "notify", "notify_all"})
+_RECV_METHODS = frozenset({"wait", "wait_for", "get_nowait"})
+
+
+# ------------------------------------------------------------- annotations
+
+_RACE_ANNOTATION_RE = re.compile(r"#\s*tpudra-race:\s*(?P<body>.+)")
+_RACE_KV_RE = re.compile(r"^(?P<key>guard|owner)=(?P<value>\S+)$")
+
+
+@dataclass
+class RaceDirective:
+    line: int
+    guards: tuple[str, ...] = ()
+    owner: str = ""
+    handoff: bool = False
+
+
+class RaceAnnotations:
+    """``# tpudra-race: guard=ID / owner=ROLE / handoff <why>`` comments
+    of one file, found with ``tokenize`` so string literals are inert.  A
+    comment alone on its line covers the next line (same convention as
+    the lock/WAL annotations and suppressions)."""
+
+    def __init__(self, source: str):
+        self.by_line: dict[int, RaceDirective] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                iter(source.splitlines(True)).__next__
+            )
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _RACE_ANNOTATION_RE.search(tok.string)
+                if not m:
+                    continue
+                directive = RaceDirective(line=tok.start[0])
+                guards: list[str] = []
+                for word in m.group("body").split():
+                    kv = _RACE_KV_RE.match(word)
+                    if kv and kv.group("key") == "guard":
+                        guards.append(kv.group("value"))
+                    elif kv:
+                        directive.owner = kv.group("value")
+                    elif word == "handoff":
+                        directive.handoff = True
+                    else:
+                        break  # free-text reason starts
+                directive.guards = tuple(guards)
+                self.by_line[directive.line] = directive
+                if tok.line.strip().startswith("#"):
+                    self.by_line.setdefault(directive.line + 1, directive)
+        except tokenize.TokenError:
+            pass  # file parsed; trailing tokenize hiccups lose nothing
+
+    def at(self, *lines: int) -> Optional[RaceDirective]:
+        for line in lines:
+            d = self.by_line.get(line)
+            if d is not None:
+                return d
+        return None
+
+
+# ------------------------------------------------------------ result model
+
+
+@dataclass(frozen=True)
+class ThreadRole:
+    role_id: str
+    kind: str  # "thread" | "pool"
+    spawned_in: str  # qualname of the spawning function
+    path: str
+    line: int
+    entries: tuple[str, ...]  # entry-function qualnames
+
+
+@dataclass
+class Access:
+    field: tuple[str, str]  # (class_qual, attr)
+    path: str
+    line: int
+    fn_qual: str
+    write: bool
+    init: bool
+    guards: frozenset  # lock IDs definitely held (lexical ∪ entry ∪ guard=)
+    roles: frozenset  # role ids whose reachable set contains fn_qual
+    handoff: bool = False
+    owner: str = ""  # owner=ROLE declared on this site's line
+    #: write inferred from a _MUTATORS method call — only counts once the
+    #: field has container evidence, else it demotes to a read
+    mutate: bool = False
+
+
+@dataclass
+class FieldInfo:
+    field: tuple[str, str]
+    display: str  # "Class.attr" — the runtime witness's field id
+    sites: list[Access] = field(default_factory=list)
+    owner: str = ""
+
+    def roles(self) -> set:
+        out: set = set()
+        for s in self.sites:
+            out |= s.roles
+        return out
+
+
+@dataclass
+class RaceGraphResult:
+    roles: dict[str, ThreadRole]
+    fields: dict[str, FieldInfo]  # display id → info
+    findings: list[Finding]
+
+    def shared_fields(self) -> dict[str, set]:
+        """display id → role set, for fields reachable from ≥ 2 roles —
+        the witness merge's model-gap and coverage universe."""
+        return {
+            fid: info.roles()
+            for fid, info in self.fields.items()
+            if len(info.roles()) >= 2
+        }
+
+
+# -------------------------------------------------------------- the analysis
+
+
+@dataclass
+class _PseudoFn:
+    """A nested def handed to a spawn site: not in graph.functions, but it
+    needs its own scan (its writes belong to its role, not the enclosing
+    function's).  Mirrors the FunctionInfo surface the scanner touches."""
+
+    qualname: str
+    name: str
+    module: str
+    path: str
+    node: ast.AST
+    class_name: str = ""
+
+
+@dataclass
+class _SpawnSite:
+    role_id: str
+    kind: str
+    fn_qual: str
+    path: str
+    line: int
+    entry_qual: str  # "" when the target could not be resolved
+
+
+@dataclass
+class _FnScan:
+    fn: object  # FunctionInfo | _PseudoFn
+    accesses: list[Access] = field(default_factory=list)
+    calls: list[tuple[str, frozenset]] = field(default_factory=list)
+    spawns: list[_SpawnSite] = field(default_factory=list)
+    joins: list[int] = field(default_factory=list)
+    #: (channel key, "send"|"recv", line)
+    channels: list[tuple[tuple, str, int]] = field(default_factory=list)
+
+
+class RaceAnalysis:
+    def __init__(
+        self,
+        modules: list[ParsedModule],
+        graph: Optional[CallGraph] = None,
+        model: Optional[LockModel] = None,
+    ):
+        self.modules = modules
+        self.graph = graph or CallGraph(modules)
+        self.model = model or LockModel(modules, self.graph)
+        self.annotations = {
+            m.path: RaceAnnotations(m.source) for m in modules
+        }
+        self.scans: dict[str, _FnScan] = {}
+        self._container_fields: set = set()
+        self.roles: dict[str, ThreadRole] = {}
+        self._role_entries: dict[str, list[_SpawnSite]] = {}
+        self.findings: list[Finding] = []
+
+    # -- driver --------------------------------------------------------------
+
+    def run(self) -> RaceGraphResult:
+        for fn in list(self.graph.functions.values()):
+            self._scan_function(fn)
+        self._fold_callbacks()
+        self._build_roles()
+        role_reach = self._role_reachability()
+        main_reach = self._main_reachability()
+        entry_held = self._entry_held_fixpoint()
+        fields = self._collect_fields(role_reach, main_reach, entry_held)
+        self._finalize_rules(fields)
+        self.findings.sort()
+        return RaceGraphResult(
+            roles=self.roles, fields=fields, findings=self.findings
+        )
+
+    # -- per-function scan ---------------------------------------------------
+
+    def _scan_function(self, fn: FunctionInfo) -> None:
+        if fn.qualname in self.scans:
+            return
+        nested = self._nested_defs(fn.node)
+        spawn_names = self._spawn_target_names(fn.node)
+        called_names = {
+            c.func.id
+            for c in astutil.iter_calls(fn.node)
+            if isinstance(c.func, ast.Name)
+        }
+        # A nested def ONLY referenced as a spawn target runs on the new
+        # thread, never on this one: scan it as its own pseudo-function so
+        # its writes are attributed to the role, not the spawner.
+        spawn_only = {
+            name
+            for name in nested
+            if name in spawn_names and name not in called_names
+        }
+        scan = _FnScan(fn=fn)
+        self.scans[fn.qualname] = scan
+        body = getattr(fn.node, "body", [])
+        self._walk_stmts(scan, fn, body, held=(), skip_defs=spawn_only)
+        for name in sorted(spawn_only):
+            sub = _PseudoFn(
+                qualname=f"{fn.qualname}.{name}",
+                name=name,
+                module=fn.module,
+                path=fn.path,
+                node=nested[name],
+                class_name=fn.class_name,
+            )
+            sub_scan = _FnScan(fn=sub)
+            self.scans[sub.qualname] = sub_scan
+            self._walk_stmts(
+                sub_scan, fn, nested[name].body, held=(), skip_defs=set()
+            )
+            # Re-anchor: accesses inside the pseudo-def belong to it.
+            for acc in sub_scan.accesses:
+                acc.fn_qual = sub.qualname
+            sub_scan.calls = [c for c in sub_scan.calls]
+
+    @staticmethod
+    def _nested_defs(node: ast.AST) -> dict[str, ast.FunctionDef]:
+        out: dict[str, ast.FunctionDef] = {}
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not node
+            ):
+                out.setdefault(sub.name, sub)
+        return out
+
+    def _spawn_target_names(self, node: ast.AST) -> set:
+        out: set = set()
+        for call in astutil.iter_calls(node):
+            expr = self._spawn_entry_expr(call)
+            if isinstance(expr, ast.Name):
+                out.add(expr.id)
+        return out
+
+    @staticmethod
+    def _spawn_entry_expr(call: ast.Call) -> Optional[ast.expr]:
+        """The function expression a call hands to another thread:
+        ``Thread(target=f)`` / ``pool.submit(f, ...)``, including the
+        contextvars idiom ``pool.submit(ctx.run, f, ...)`` where the real
+        entry is the second argument."""
+        name = astutil.call_name(call)
+        if name == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    return kw.value
+            return None
+        if name == "submit" and call.args:
+            first = call.args[0]
+            if (
+                isinstance(first, ast.Attribute)
+                and first.attr == "run"
+                and len(call.args) >= 2
+            ):
+                return call.args[1]
+            return first
+        return None
+
+    def _walk_stmts(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        stmts: Iterable[ast.stmt],
+        held: tuple,
+        skip_defs: set,
+    ) -> None:
+        for stmt in stmts:
+            self._walk_stmt(scan, ctx, stmt, held, skip_defs)
+
+    def _walk_stmt(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        stmt: ast.stmt,
+        held: tuple,
+        skip_defs: set,
+    ) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if stmt.name in skip_defs:
+                return
+            # A locally-invoked nested def runs on this thread; lexical
+            # holds do NOT carry into its body (it runs when called, not
+            # where defined) — entry-held propagation owns that edge.
+            self._walk_stmts(scan, ctx, stmt.body, (), skip_defs)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            layer = list(held)
+            for item in stmt.items:
+                for lock_id in self._with_item_locks(item.context_expr, ctx):
+                    layer.append(lock_id)
+                self._scan_exprs(scan, ctx, [item.context_expr], held)
+            self._walk_stmts(scan, ctx, stmt.body, tuple(layer), skip_defs)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                stmt.targets
+                if isinstance(stmt, ast.Assign)
+                else [stmt.target]
+            )
+            value = getattr(stmt, "value", None)
+            for target in targets:
+                self._note_target_write(scan, ctx, target, held, value)
+            if value is not None:
+                self._scan_exprs(scan, ctx, [value], held)
+            return
+        if isinstance(stmt, ast.Delete):
+            for target in stmt.targets:
+                self._note_target_write(scan, ctx, target, held)
+            return
+        self._scan_exprs(
+            scan,
+            ctx,
+            [v for v in ast.iter_child_nodes(stmt) if isinstance(v, ast.expr)],
+            held,
+        )
+        for block in ("body", "orelse", "finalbody"):
+            self._walk_stmts(scan, ctx, getattr(stmt, block, []), held, skip_defs)
+        for handler in getattr(stmt, "handlers", []):
+            self._walk_stmts(scan, ctx, handler.body, held, skip_defs)
+
+    def _with_item_locks(self, expr: ast.expr, ctx: FunctionInfo) -> list:
+        ref = self.model.resolve_lock(expr, ctx)
+        if ref is not None:
+            return [ref.id]
+        if isinstance(expr, ast.Call):
+            callee = self.graph.resolve_call(expr, ctx)
+            if callee is not None and callee.is_contextmanager:
+                return [r.id for r in self.model.cm_yield(callee)]
+        return []
+
+    def _note_target_write(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        target: ast.expr,
+        held: tuple,
+        value: Optional[ast.expr] = None,
+    ) -> None:
+        node = target
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                self._note_target_write(scan, ctx, elt, held)
+            return
+        subscripted = isinstance(node, ast.Subscript)
+        if subscripted:
+            node = node.value
+        attr = astutil.self_attr_target(node)
+        if attr is not None and ctx.class_name:
+            if not subscripted and _is_container_expr(value):
+                self._container_fields.add(
+                    (f"{ctx.module}:{ctx.class_name}", attr)
+                )
+            if subscripted:
+                # self.x[k] = v mutates the container; same evidence gate
+                # as the method-mutator form.
+                self._container_fields.add(
+                    (f"{ctx.module}:{ctx.class_name}", attr)
+                )
+            self._note_access(scan, ctx, attr, node, held, write=True)
+
+    def _scan_exprs(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        exprs: Iterable[ast.expr],
+        held: tuple,
+    ) -> None:
+        mutator_receivers: set = set()
+        calls: list[ast.Call] = []
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    calls.append(node)
+        for call in calls:
+            self._note_call(scan, ctx, call, held, mutator_receivers)
+        for expr in exprs:
+            for node in ast.walk(expr):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in mutator_receivers
+                ):
+                    attr = astutil.self_attr_target(node)
+                    if attr is not None and ctx.class_name:
+                        self._note_access(
+                            scan, ctx, attr, node, held, write=False
+                        )
+
+    def _note_call(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        call: ast.Call,
+        held: tuple,
+        mutator_receivers: set,
+    ) -> None:
+        name = astutil.call_name(call)
+        func = call.func
+        # Mutating method on a self attribute is a write to that field.
+        if (
+            isinstance(func, ast.Attribute)
+            and name in _MUTATORS
+            and astutil.self_attr_target(func.value) is not None
+            and ctx.class_name
+        ):
+            mutator_receivers.add(id(func.value))
+            self._note_access(
+                scan,
+                ctx,
+                astutil.self_attr_target(func.value),
+                func.value,
+                held,
+                write=True,
+                mutate=True,
+            )
+        self._note_channel_op(scan, ctx, call, name)
+        if name == "join" and not call.args and not call.keywords:
+            scan.joins.append(call.lineno)
+        spawn_entry = self._spawn_entry_expr(call)
+        if spawn_entry is not None:
+            self._note_spawn(scan, ctx, call, spawn_entry)
+        callee = self.graph.resolve_call(call, ctx)
+        if callee is not None:
+            scan.calls.append((callee.qualname, frozenset(held)))
+
+    def _note_channel_op(
+        self, scan: _FnScan, ctx: FunctionInfo, call: ast.Call, name: str
+    ) -> None:
+        direction = ""
+        if name in _SEND_METHODS or (name == "set" and not call.args):
+            direction = "send"
+        elif name in _RECV_METHODS or name == "get":
+            direction = "recv"
+        if not direction or not isinstance(call.func, ast.Attribute):
+            return
+        recv = call.func.value
+        attr = astutil.self_attr_target(recv)
+        if attr is not None and ctx.class_name:
+            key = ("attr", f"{ctx.module}:{ctx.class_name}", attr)
+        elif isinstance(recv, ast.Name):
+            key = ("name", ctx.module, recv.id)
+        else:
+            return
+        scan.channels.append((key, direction, call.lineno))
+
+    def _note_spawn(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        call: ast.Call,
+        entry_expr: ast.expr,
+    ) -> None:
+        kind = "thread" if astutil.call_name(call) == "Thread" else "pool"
+        entry = self._resolve_entry(entry_expr, ctx)
+        role_id = self._role_id(call, entry_expr, entry, kind)
+        if role_id is None:
+            return
+        scan.spawns.append(
+            _SpawnSite(
+                role_id=role_id,
+                kind=kind,
+                fn_qual=self._scan_qual(scan),
+                path=ctx.path,
+                line=call.lineno,
+                entry_qual=entry.qualname if entry is not None else "",
+            )
+        )
+
+    @staticmethod
+    def _scan_qual(scan: _FnScan) -> str:
+        return scan.fn.qualname
+
+    def _resolve_entry(self, expr: ast.expr, ctx: FunctionInfo):
+        if isinstance(expr, ast.Name):
+            fn = self.graph.module_function(ctx.module, expr.id)
+            if fn is not None:
+                return fn
+            # A nested def in the spawning function: pseudo-scanned by
+            # _scan_function; reference it by its pseudo qualname.
+            pseudo = self.scans.get(f"{ctx.qualname}.{expr.id}")
+            if pseudo is not None:
+                return pseudo.fn
+            return _PseudoFn(
+                qualname=f"{ctx.qualname}.{expr.id}",
+                name=expr.id,
+                module=ctx.module,
+                path=ctx.path,
+                node=expr,
+                class_name=ctx.class_name,
+            )
+        attr = astutil.self_attr_target(expr)
+        if attr is not None and ctx.class_name:
+            return self.graph.method_on(f"{ctx.module}:{ctx.class_name}", attr)
+        # ``target=self.queue.run``: resolve the receiver attribute's class
+        # through the call graph's attr-type inference, then the method on
+        # that class — the controller spawns its workers this way.
+        if isinstance(expr, ast.Attribute) and ctx.class_name:
+            recv_attr = astutil.self_attr_target(expr.value)
+            if recv_attr is not None:
+                owner = self.graph.classes.get(
+                    f"{ctx.module}:{ctx.class_name}"
+                )
+                attr_cls = owner.attr_types.get(recv_attr) if owner else None
+                if attr_cls:
+                    return self.graph.method_on(attr_cls, expr.attr)
+        return None
+
+    def _role_id(
+        self, call: ast.Call, entry_expr: ast.expr, entry, kind: str
+    ) -> Optional[str]:
+        for kw in call.keywords:
+            if kw.arg != "name":
+                continue
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, str
+            ):
+                return kw.value.value
+            if isinstance(kw.value, ast.JoinedStr) and kw.value.values:
+                first = kw.value.values[0]
+                if isinstance(first, ast.Constant) and isinstance(
+                    first.value, str
+                ):
+                    prefix = first.value.rstrip("-_ ")
+                    if prefix:
+                        return prefix
+        name = ""
+        if entry is not None:
+            name = entry.name
+        elif isinstance(entry_expr, ast.Name):
+            name = entry_expr.id
+        elif isinstance(entry_expr, ast.Attribute):
+            name = entry_expr.attr
+        if not name:
+            return None
+        return f"{kind}:{name.lstrip('_')}"
+
+    # -- callback dispatch the call graph cannot resolve ---------------------
+
+    def _fold_callbacks(self) -> None:
+        """Informer handlers run on the watch thread under the dispatch
+        lock; ``_run_effects`` callables run on the claim-effects pool.
+        Both are function-valued dispatch lockmodel already resolves —
+        reuse its target lists as synthetic call edges / role entries."""
+        dispatch = self.scans.get("tpudra.kube.informer:Informer._dispatch")
+        if dispatch is not None:
+            for target in self.model._handler_targets:
+                # _dispatch invokes handlers holding its RLock (the
+                # registry id of Informer._dispatch_lock).
+                dispatch.calls.append(
+                    (target.qualname, frozenset({"informer.dispatch_lock"}))
+                )
+        run_effects = self.scans.get("tpudra.plugin.driver:Driver._run_effects")
+        if run_effects is not None:
+            for scan in list(self.scans.values()):
+                for spawn in scan.spawns:
+                    if spawn.fn_qual != run_effects.fn.qualname:
+                        continue
+                    pseudo = self.scans.get(spawn.entry_qual)
+                    if pseudo is None:
+                        continue
+                    for target in self.model._effect_targets:
+                        pseudo.calls.append((target.qualname, frozenset()))
+
+    # -- roles and reachability ----------------------------------------------
+
+    def _build_roles(self) -> None:
+        for scan in self.scans.values():
+            for spawn in scan.spawns:
+                self._role_entries.setdefault(spawn.role_id, []).append(spawn)
+        for role_id, sites in sorted(self._role_entries.items()):
+            first = min(sites, key=lambda s: (s.path, s.line))
+            entries = tuple(
+                sorted({s.entry_qual for s in sites if s.entry_qual})
+            )
+            self.roles[role_id] = ThreadRole(
+                role_id=role_id,
+                kind=first.kind,
+                spawned_in=first.fn_qual,
+                path=first.path,
+                line=first.line,
+                entries=entries,
+            )
+
+    def _adjacency(self) -> dict[str, set]:
+        adj: dict[str, set] = {}
+        for qual, scan in self.scans.items():
+            adj.setdefault(qual, set())
+            for callee, _held in scan.calls:
+                if callee in self.scans:
+                    adj[qual].add(callee)
+        return adj
+
+    def _closure(self, roots: Iterable[str], adj: dict[str, set]) -> set:
+        seen: set = set()
+        stack = [r for r in roots if r in adj]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            stack.extend(adj.get(q, ()))
+        return seen
+
+    def _role_reachability(self) -> dict[str, set]:
+        adj = self._adjacency()
+        return {
+            role_id: self._closure(role.entries, adj)
+            for role_id, role in self.roles.items()
+        }
+
+    def _main_reachability(self) -> set:
+        adj = self._adjacency()
+        indeg: dict[str, int] = {q: 0 for q in adj}
+        for callees in adj.values():
+            for c in callees:
+                indeg[c] = indeg.get(c, 0) + 1
+        entry_quals = {e for r in self.roles.values() for e in r.entries}
+        roots = [
+            q for q, d in indeg.items() if d == 0 and q not in entry_quals
+        ]
+        return self._closure(roots, adj)
+
+    def _entry_held_fixpoint(self) -> dict[str, frozenset]:
+        """``entry(f) = ∩ over call sites (entry(caller) ∪ held-at-site)``
+        — the lock set DEFINITELY held whenever ``f`` runs.  Roots (main
+        roots, role entries) start empty; the intersection only ever
+        shrinks, so the optimistic worklist terminates."""
+        entry: dict[str, Optional[frozenset]] = {q: None for q in self.scans}
+        adj = self._adjacency()
+        indeg: dict[str, int] = {q: 0 for q in adj}
+        for callees in adj.values():
+            for c in callees:
+                indeg[c] = indeg.get(c, 0) + 1
+        for role in self.roles.values():
+            for e in role.entries:
+                entry[e] = frozenset()
+        for q, d in indeg.items():
+            if d == 0:
+                entry[q] = frozenset()
+        for _ in range(len(self.scans) + 1):
+            changed = False
+            for qual, scan in self.scans.items():
+                base = entry.get(qual)
+                if base is None:
+                    continue
+                for callee, held in scan.calls:
+                    if callee not in entry:
+                        continue
+                    cand = base | held
+                    cur = entry[callee]
+                    new = cand if cur is None else (cur & cand)
+                    if new != cur:
+                        entry[callee] = new
+                        changed = True
+            if not changed:
+                break
+        return {q: (s or frozenset()) for q, s in entry.items()}
+
+    # -- access collection ---------------------------------------------------
+
+    def _note_access(
+        self,
+        scan: _FnScan,
+        ctx: FunctionInfo,
+        attr: str,
+        node: ast.AST,
+        held: tuple,
+        write: bool,
+        mutate: bool = False,
+    ) -> None:
+        directive = self.annotations.get(
+            ctx.path, RaceAnnotations("")
+        ).at(getattr(node, "lineno", 0))
+        guards = frozenset(held)
+        owner = ""
+        handoff = False
+        if directive is not None:
+            guards |= frozenset(directive.guards)
+            owner = directive.owner
+            handoff = directive.handoff
+        fn_qual = self._scan_qual(scan)
+        scan.accesses.append(
+            Access(
+                field=(f"{ctx.module}:{ctx.class_name}", attr),
+                path=ctx.path,
+                line=getattr(node, "lineno", 1),
+                fn_qual=fn_qual,
+                write=write,
+                init=scan.fn.name == "__init__",
+                guards=guards,
+                roles=frozenset(),
+                handoff=handoff,
+                owner=owner,
+                mutate=mutate,
+            )
+        )
+
+    def _collect_fields(
+        self,
+        role_reach: dict[str, set],
+        main_reach: set,
+        entry_held: dict[str, frozenset],
+    ) -> dict[str, FieldInfo]:
+        roles_of: dict[str, frozenset] = {}
+        for qual in self.scans:
+            mine = {
+                role_id
+                for role_id, reach in role_reach.items()
+                if qual in reach
+            }
+            if qual in main_reach or not mine:
+                mine.add(MAIN_ROLE)
+            roles_of[qual] = frozenset(mine)
+        fields: dict[tuple, FieldInfo] = {}
+        for qual, scan in self.scans.items():
+            for acc in scan.accesses:
+                acc.roles = roles_of[qual]
+                acc.guards = acc.guards | entry_held.get(qual, frozenset())
+                if acc.mutate and acc.field not in self._container_fields:
+                    acc.write = False  # '.update()' on a non-container: a read
+                cls = acc.field[0].partition(":")[2] or acc.field[0]
+                display = f"{cls}.{acc.field[1]}"
+                info = fields.get(acc.field)
+                if info is None:
+                    info = fields[acc.field] = FieldInfo(
+                        field=acc.field, display=display
+                    )
+                info.sites.append(acc)
+                if acc.owner:
+                    info.owner = info.owner or acc.owner
+        out: dict[str, FieldInfo] = {}
+        for info in sorted(fields.values(), key=lambda i: i.field):
+            info.sites.sort(key=lambda a: (a.path, a.line))
+            out[info.display] = info
+        return out
+
+    # -- happens-before ------------------------------------------------------
+
+    def _channel_map(self) -> dict[tuple, dict[str, tuple[list, list]]]:
+        """channel key → fn_qual → (send lines, recv lines).  Bare ``get``
+        receives only count on channels some function also ``put``s to."""
+        chans: dict[tuple, dict[str, tuple[list, list]]] = {}
+        has_put: set = set()
+        for qual, scan in self.scans.items():
+            for key, direction, line in scan.channels:
+                sends, recvs = chans.setdefault(key, {}).setdefault(
+                    qual, ([], [])
+                )
+                (sends if direction == "send" else recvs).append(line)
+                if direction == "send":
+                    has_put.add(key)
+        return {
+            key: per_fn
+            for key, per_fn in chans.items()
+            if key in has_put
+        }
+
+    def _hb_covers(self, acc: Access, role_id: str) -> bool:
+        """True when ``acc`` is ordered against the WHOLE life of the
+        role: it is init-before-start publication, runs in the spawning
+        function before the spawn, or runs there after a post-spawn
+        ``join()``."""
+        if acc.init or acc.handoff:
+            return True
+        for site in self._role_entries.get(role_id, ()):
+            if site.fn_qual != acc.fn_qual:
+                continue
+            if acc.line < site.line:
+                return True
+            scan = self.scans.get(acc.fn_qual)
+            if scan and any(
+                site.line < j <= acc.line for j in scan.joins
+            ):
+                return True
+        return False
+
+    def _channel_ordered(
+        self,
+        a: Access,
+        b: Access,
+        chans: dict[tuple, dict[str, tuple[list, list]]],
+    ) -> bool:
+        """Handoff HB: one side writes then sends on a channel, the other
+        receives on it then writes — either direction."""
+        for per_fn in chans.values():
+            a_ops = per_fn.get(a.fn_qual)
+            b_ops = per_fn.get(b.fn_qual)
+            if a_ops is None or b_ops is None:
+                continue
+            if any(line >= a.line for line in a_ops[0]) and any(
+                line <= b.line for line in b_ops[1]
+            ):
+                return True
+            if any(line >= b.line for line in b_ops[0]) and any(
+                line <= a.line for line in a_ops[1]
+            ):
+                return True
+        return False
+
+    def _pair_ordered(self, a: Access, b: Access, chans) -> bool:
+        if self._channel_ordered(a, b, chans):
+            return True
+        for r1 in a.roles:
+            for r2 in b.roles:
+                if r1 == r2:
+                    continue
+                if not (self._hb_covers(a, r2) or self._hb_covers(b, r1)):
+                    return False
+        return True
+
+    # -- rules ---------------------------------------------------------------
+
+    def _finalize_rules(self, fields: dict[str, FieldInfo]) -> None:
+        chans = self._channel_map()
+        for info in fields.values():
+            if info.owner:
+                self._check_owner(info)
+                continue
+            self._check_locksets(info, chans)
+
+    def _check_owner(self, info: FieldInfo) -> None:
+        for acc in info.sites:
+            if acc.init or acc.handoff:
+                continue
+            strays = sorted(acc.roles - {info.owner})
+            if not strays:
+                continue
+            self.findings.append(
+                Finding(
+                    path=acc.path,
+                    line=acc.line,
+                    col=0,
+                    rule_id="THREAD-CONFINED-ESCAPE",
+                    message=(
+                        f"'{info.display}' is declared owner={info.owner} "
+                        f"but this {'write' if acc.write else 'read'} runs "
+                        f"on role(s) {', '.join(strays)} "
+                        f"({acc.fn_qual.partition(':')[2] or acc.fn_qual}) — "
+                        "confine the access to the owning thread or drop "
+                        "the owner= claim"
+                    ),
+                )
+            )
+
+    def _check_locksets(self, info: FieldInfo, chans) -> None:
+        writes = [
+            a for a in info.sites if a.write and not a.init and not a.handoff
+        ]
+        if len({r for a in writes for r in a.roles}) < 2:
+            return
+        live: list[Access] = []
+        for a in writes:
+            conflicted = False
+            for b in writes:
+                cross = any(
+                    r1 != r2 for r1 in a.roles for r2 in b.roles
+                )
+                if not cross:
+                    continue
+                if a.guards & b.guards:
+                    continue
+                if self._pair_ordered(a, b, chans):
+                    continue
+                conflicted = True
+                break
+            if conflicted:
+                live.append(a)
+        if not live:
+            return
+        intersection = frozenset.intersection(*[a.guards for a in live])
+        if intersection:
+            return
+        anchor = self._anchor(live)
+        sites = ", ".join(
+            f"{_rel(a.path)}:{a.line}"
+            + (f" [{'+'.join(sorted(a.guards))}]" if a.guards else "")
+            for a in live
+        )
+        role_list = ", ".join(sorted({r for a in live for r in a.roles}))
+        if all(a.guards for a in live):
+            self.findings.append(
+                Finding(
+                    path=anchor.path,
+                    line=anchor.line,
+                    col=0,
+                    rule_id="GUARD-CONSISTENCY",
+                    message=(
+                        f"'{info.display}' is written under DIFFERENT locks "
+                        f"at different sites ({sites}; roles: {role_list}) — "
+                        "pick one guard for every write or annotate "
+                        "'# tpudra-race: guard=' with why two suffice"
+                    ),
+                )
+            )
+            return
+        self.findings.append(
+            Finding(
+                path=anchor.path,
+                line=anchor.line,
+                col=0,
+                rule_id="RACE",
+                message=(
+                    f"'{info.display}' is written from roles {role_list} "
+                    f"with no common guard and no happens-before edge "
+                    f"(writes: {sites}) — guard every write with one lock, "
+                    "order them (start/join, queue or event handoff), or "
+                    "annotate '# tpudra-race: guard=/owner=/handoff' with a "
+                    "reason"
+                ),
+            )
+        )
+
+    @staticmethod
+    def _anchor(live: list) -> "Access":
+        """Deterministic finding anchor: prefer an unguarded write on a
+        non-main role (the spawned-thread side reads best in review)."""
+        for a in live:
+            if not a.guards and a.roles != frozenset({MAIN_ROLE}):
+                return a
+        for a in live:
+            if not a.guards:
+                return a
+        return live[0]
+
+
+def analyze_races(
+    modules: list[ParsedModule],
+    graph: Optional[CallGraph] = None,
+    model: Optional[LockModel] = None,
+) -> RaceGraphResult:
+    return RaceAnalysis(modules, graph, model).run()

@@ -53,20 +53,26 @@ def all_rules() -> list[Rule]:
     from tpudra.analysis.rules.metrics_hygiene import MetricsHygiene
     from tpudra.analysis.rules.partition_phase import PartitionPhase
     from tpudra.analysis.rules.program import ProgramState
+    from tpudra.analysis.rules.racegraph import (
+        GuardConsistency,
+        Race,
+        RacegraphState,
+        ThreadConfinedEscape,
+    )
     from tpudra.analysis.rules.rmw_purity import RmwPurity
-    from tpudra.analysis.rules.shared_state import SharedState
     from tpudra.analysis.rules.span_hygiene import SpanHygiene
 
     # The whole-program rule families each share ONE analysis per run,
-    # and both analyses share ONE CallGraph over the same corpus.
+    # and all analyses share ONE CallGraph (and the lock/race pair one
+    # LockModel) over the same corpus.
     program = ProgramState()
     lockgraph = LockgraphState(program)
     effectgraph = EffectgraphState(program)
+    racegraph = RacegraphState(program)
     return [
         LockOrder(),
         BlockUnderLock(),
         RmwPurity(),
-        SharedState(),
         MetricsHygiene(),
         ExcSwallow(),
         SpanHygiene(),
@@ -80,6 +86,9 @@ def all_rules() -> list[Rule]:
         WalRecoveryExhaustive(effectgraph),
         FenceDominatesCommit(effectgraph),
         StripeOrder(effectgraph),
+        Race(racegraph),
+        GuardConsistency(racegraph),
+        ThreadConfinedEscape(racegraph),
     ]
 
 
@@ -113,3 +122,16 @@ def effectgraph_rules() -> list[Rule]:
         FenceDominatesCommit(state),
         StripeOrder(state),
     ]
+
+
+def racegraph_rules() -> list[Rule]:
+    """Just the whole-program race rules (the ``make racegraph`` lane)."""
+    from tpudra.analysis.rules.racegraph import (
+        GuardConsistency,
+        Race,
+        RacegraphState,
+        ThreadConfinedEscape,
+    )
+
+    state = RacegraphState()
+    return [Race(state), GuardConsistency(state), ThreadConfinedEscape(state)]

@@ -42,7 +42,9 @@ class LockgraphState:
     def result(self) -> LockGraphResult:
         if self._result is None:
             self._result = analyze_modules(
-                self.program.modules, self.program.graph()
+                self.program.modules,
+                self.program.graph(),
+                self.program.lockmodel(),
             )
         return self._result
 

@@ -1,10 +1,11 @@
 """Shared whole-program state for the cross-file rule families.
 
-Both whole-program analyses (tpudra-lockgraph and tpudra-effectgraph)
-resolve calls over the same corpus; building the CallGraph twice per lint
-run would double the most expensive non-parse step for no information.
-One ``ProgramState`` accumulates the engine's shared parse pass and hands
-every analysis the SAME lazily-built CallGraph.
+The whole-program analyses (tpudra-lockgraph, tpudra-effectgraph,
+tpudra-racegraph) resolve calls over the same corpus; building the
+CallGraph or the lock registry twice per lint run would double the most
+expensive non-parse steps for no information.  One ``ProgramState``
+accumulates the engine's shared parse pass and hands every analysis the
+SAME lazily-built CallGraph and LockModel.
 """
 
 from __future__ import annotations
@@ -20,6 +21,7 @@ class ProgramState:
         self.modules: list[ParsedModule] = []
         self._paths: set[str] = set()
         self._graph: Optional[CallGraph] = None
+        self._lockmodel = None
 
     def add(self, module: ParsedModule) -> bool:
         """Register a module; True when it was new (consumers invalidate
@@ -29,9 +31,19 @@ class ProgramState:
         self._paths.add(module.path)
         self.modules.append(module)
         self._graph = None
+        self._lockmodel = None
         return True
 
     def graph(self) -> CallGraph:
         if self._graph is None:
             self._graph = CallGraph(self.modules)
         return self._graph
+
+    def lockmodel(self):
+        """The shared lock registry + resolver (lockgraph and racegraph
+        both consume it; built once per corpus)."""
+        if self._lockmodel is None:
+            from tpudra.analysis.lockmodel import LockModel
+
+            self._lockmodel = LockModel(self.modules, self.graph())
+        return self._lockmodel

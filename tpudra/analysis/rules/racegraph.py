@@ -1,0 +1,87 @@
+"""RACE, GUARD-CONSISTENCY, THREAD-CONFINED-ESCAPE: the whole-program
+data-race rules (tpudra-racegraph).
+
+The heavy lifting lives in tpudra/analysis/racemodel.py; these Rule
+shells adapt it to the engine's per-module + finalize protocol.  All
+three rules SHARE one analysis per run, and the analysis shares its
+CallGraph AND LockModel with the lockgraph through ``ProgramState`` —
+one parse pass, one call graph, one lock registry, three whole-program
+models.
+
+This family supersedes the old single-module SHARED-STATE heuristic;
+``# tpudra-lint: disable=SHARED-STATE`` suppressions alias to the three
+new rule ids (engine._apply_suppressions) so they do not silently go
+stale.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpudra.analysis.engine import Finding, ParsedModule
+from tpudra.analysis.racemodel import RaceGraphResult, analyze_races
+from tpudra.analysis.rules import Rule
+from tpudra.analysis.rules.program import ProgramState
+
+
+class RacegraphState:
+    """Accumulates the modules of one lint run; analyzes once on demand."""
+
+    def __init__(self, program: Optional[ProgramState] = None) -> None:
+        self.program = program or ProgramState()
+        self._result: Optional[RaceGraphResult] = None
+
+    def add(self, module: ParsedModule) -> None:
+        if self.program.add(module):
+            self._result = None
+
+    def result(self) -> RaceGraphResult:
+        if self._result is None:
+            self._result = analyze_races(
+                self.program.modules,
+                self.program.graph(),
+                self.program.lockmodel(),
+            )
+        return self._result
+
+
+class _RacegraphRule(Rule):
+    def __init__(self, state: Optional[RacegraphState] = None):
+        self.state = state or RacegraphState()
+
+    def check_module(self, module: ParsedModule) -> list[Finding]:
+        self.state.add(module)
+        return []
+
+    def finalize(self) -> list[Finding]:
+        return [
+            f for f in self.state.result().findings if f.rule_id == self.rule_id
+        ]
+
+
+class Race(_RacegraphRule):
+    rule_id = "RACE"
+    description = (
+        "every attribute written from two or more thread roles keeps a "
+        "non-empty intersection of held locks across all conflicting "
+        "writes, after happens-before refinement (Eraser-style lockset "
+        "over the shared call graph)"
+    )
+
+
+class GuardConsistency(_RacegraphRule):
+    rule_id = "GUARD-CONSISTENCY"
+    description = (
+        "a cross-thread field is guarded by the SAME lock at every write "
+        "site — different locks at different sites is the split-guard "
+        "refactor bug, mutual exclusion in name only"
+    )
+
+
+class ThreadConfinedEscape(_RacegraphRule):
+    rule_id = "THREAD-CONFINED-ESCAPE"
+    description = (
+        "a field declared '# tpudra-race: owner=ROLE' is only accessed by "
+        "functions that role reaches — any other role touching it breaks "
+        "the confinement claim"
+    )

@@ -85,9 +85,11 @@ class Backoff:
     def next_delay(self) -> float:
         """The delay before the next retry; each call widens the window."""
         delay = full_jitter_delay(self.base, self.cap, self._attempt, self._rng)
+        # tpudra-race: handoff per-instance confinement: each retry loop owns its own Backoff (class docstring); the cross-role reach is different instances, never shared state
         self._attempt += 1
         return delay
 
     def reset(self) -> None:
         """Collapse the window after a success."""
+        # tpudra-race: handoff per-instance confinement: each retry loop owns its own Backoff (class docstring); the cross-role reach is different instances, never shared state
         self._attempt = 0

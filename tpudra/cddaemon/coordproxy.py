@@ -195,10 +195,12 @@ class CoordinatorProxy:
         return self._server.getsockname()[1] if self._server else self.port
 
     def start(self) -> None:
+        # tpudra-race: handoff restart choreography: the soak's proxy bounce calls stop() first, which shuts the socket down and joins the accept thread before start() runs again — the writes are ordered by that join, which spans two methods the model cannot connect
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((self._host, self.port))
         self._server.listen(16)
+        # tpudra-race: handoff restart choreography: same stop()-joins-before-start() ordering as _server above
         self._thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="coord-proxy"
         )

@@ -16,7 +16,7 @@ import string
 
 import yaml
 
-from tpudra import featuregates
+from tpudra import featuregates, lockwitness
 from tpudra.controller.resourceclaimtemplate import CD_UID_LABEL
 from tpudra.kube import gvr
 from tpudra.kube.client import KubeAPI
@@ -170,7 +170,12 @@ class MultiNamespaceDaemonSetManager:
         # controller always creates in the driver namespace), so once a CD's
         # home is resolved it never changes until teardown — the additional-
         # namespace probes are paid once per CD, not once per reconcile.
+        # Reconciles arrive from the informer dispatch, the resync loop,
+        # AND the leader-startup replay; the cache writes need one guard
+        # (tpudra-racegraph pins the lockset).  The namespace probes stay
+        # outside it — they hit the apiserver.
         self._home_ns: dict[str, str] = {}
+        self._home_lock = lockwitness.make_lock("daemonset.home_ns")
 
     @property
     def namespaces(self) -> list[str]:
@@ -185,11 +190,13 @@ class MultiNamespaceDaemonSetManager:
                 if ns != self._driver_ns and mgr.get(uid) is not None:
                     home = ns
                     break
-            self._home_ns[uid] = home
+            with self._home_lock:
+                home = self._home_ns.setdefault(uid, home)
         return self._managers[home].ensure(cd, daemon_rct_name)
 
     def remove(self, cd_uid: str) -> None:
-        self._home_ns.pop(cd_uid, None)
+        with self._home_lock:
+            self._home_ns.pop(cd_uid, None)
         for mgr in self._managers.values():
             mgr.remove(cd_uid)
 

@@ -90,12 +90,20 @@ class MockDeviceLib(DeviceLib):
             host_index=config.host_index,
             num_hosts=config.num_hosts,
         )
-        self._load_state()
-        for part in config.static_partitions:
-            chip_idx, profile, core_start, hbm_start = part
-            spec_ = PartitionSpec(chip_idx, profile, core_start, hbm_start)
-            if not any(p.spec == spec_ for p in self._partitions.values()):
-                self._create_unlocked(spec_, static=True)
+        # Constructor-time loads take the registry lock too: nothing races
+        # during __init__ itself, but the soak's fault injector creates and
+        # deletes partitions concurrently with harness resets, and every
+        # write to _partitions must share ONE guard for that to stay sound
+        # (tpudra-racegraph pins the lockset).
+        with self._lock:
+            # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP the state file IS the simulated silicon — load/create must be atomic with the in-memory registry, same as create_partition
+            self._load_state()
+            for part in config.static_partitions:
+                chip_idx, profile, core_start, hbm_start = part
+                spec_ = PartitionSpec(chip_idx, profile, core_start, hbm_start)
+                if not any(p.spec == spec_ for p in self._partitions.values()):
+                    # tpudra-lint: disable=BLOCK-UNDER-LOCK-IP the state file IS the simulated silicon — the static-partition create must be atomic with the registry, same as create_partition
+                    self._create_unlocked(spec_, static=True)
 
     # -- state persistence --------------------------------------------------
 

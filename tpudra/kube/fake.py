@@ -326,6 +326,7 @@ class FakeKube:
         request verb consults it AFTER the latency/deadline simulation —
         a 429 storm during a latency spike costs the RTT and then the
         refusal, exactly like a slow-then-shedding real apiserver."""
+        # tpudra-race: handoff atomic publication knob: a single reference assignment the request threads read per-verb; guarding it with the store lock would park the fault injector behind the simulated RTT sleep
         self._error_plan = plan
 
     def set_latency(self, seconds: float) -> None:
@@ -338,6 +339,7 @@ class FakeKube:
         RTT itself.  N concurrent GETs therefore cost ~N×RTT, the cost the
         watch-backed caches exist to remove (bench.py
         --apiserver-latency-ms)."""
+        # tpudra-race: handoff atomic publication knob: a single float assignment read per-request; same rationale as set_error_plan
         self._latency_s = float(seconds)
 
     # tpudra-lock: nonblocking the latency sleep is the simulated-RTT knob itself — set_latency's docstring argues why it sleeps under the store lock on purpose

@@ -22,7 +22,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from tpudra import lockwitness, metrics
+from tpudra import lockwitness, metrics, racewitness
 from tpudra.backoff import Backoff
 from tpudra.kube import errors
 from tpudra.kube.client import KubeAPI
@@ -94,6 +94,7 @@ class Informer:
     # -- configuration ------------------------------------------------------
 
     def add_handler(self, handler: Handler) -> None:
+        # tpudra-race: handoff init-before-start publication across call sites: controllers register every handler before start() spawns the watch thread, and the dispatch side only iterates — the ordering edge is the Thread.start the model cannot tie to this method
         self._handlers.append(handler)
 
     def add_index(self, name: str, fn: Callable[[dict], str | None]) -> None:
@@ -150,9 +151,14 @@ class Informer:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self, stop: threading.Event) -> None:
+        # tpudra-race: handoff lifecycle: start() runs once per informer from whichever single thread owns setup; the field is written before the watch thread exists and only read by the join in stop choreography
         self._thread = threading.Thread(
             target=self._run, args=(stop,), daemon=True, name=f"informer-{self._gvr.resource}"
         )
+        if racewitness.enabled():
+            # Publication edge: everything configured before start()
+            # happens-before the watch/resync loops' first read.
+            racewitness.note_hb_send("informer.start")
         self._thread.start()
         if self._resync_period > 0:
             threading.Thread(
@@ -171,6 +177,8 @@ class Informer:
         dispatch mutex, so a resync delivery is never an OLDER state than
         an event the watch thread already delivered (client-go gets the
         same guarantee from its single processor queue)."""
+        if racewitness.enabled():
+            racewitness.note_hb_recv("informer.start")
         while not stop.wait(self._resync_period):
             if not self._synced.is_set():
                 continue
@@ -184,7 +192,10 @@ class Informer:
                         self._dispatch("MODIFIED", obj)
 
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
-        return self._synced.wait(timeout)
+        ok = self._synced.wait(timeout)
+        if ok and racewitness.enabled():
+            racewitness.note_hb_recv("informer.synced")
+        return ok
 
     @property
     def has_synced(self) -> bool:
@@ -207,6 +218,8 @@ class Informer:
         # every informer in every binary hits this loop at once — fixed
         # short sleeps synchronize them into a relist storm at recovery
         # (client-go's reflector backs off the same way).
+        if racewitness.enabled():
+            racewitness.note_hb_recv("informer.start")
         self._relist_backoff.reset()
         while not stop.is_set():
             try:
@@ -264,6 +277,8 @@ class Informer:
             old = self._store
             self._store = fresh
             self._index_rebuild()
+            if racewitness.enabled():
+                racewitness.note_access("Informer._store")
         self._watch_ok = True
         for key, obj in fresh.items():
             if key not in old:
@@ -275,6 +290,8 @@ class Informer:
         for key, obj in old.items():
             if key not in fresh:
                 self._dispatch("DELETED", obj)
+        if racewitness.enabled():
+            racewitness.note_hb_send("informer.synced")
         self._synced.set()
 
         try:
@@ -320,6 +337,8 @@ class Informer:
                     self._index_add(key, obj)
                 else:
                     self._store.pop(key, None)
+                if racewitness.enabled():
+                    racewitness.note_access("Informer._store")
             if self._cache_filter is None:
                 self._dispatch(etype, obj)
             elif keep:

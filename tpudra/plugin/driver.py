@@ -43,6 +43,7 @@ from tpudra import (
     featuregates,
     lockwitness,
     metrics,
+    racewitness,
     storage,
     trace,
 )
@@ -307,6 +308,11 @@ class Driver:
         # transition (gauge + storage-degraded slice annotation) and
         # drives the heal probe + convergent compaction on a backoff.
         self._storage_heal_thread: Optional[threading.Thread] = None
+        # Serializes supervisor (re)starts: the sim and the soak's fault
+        # injector both call start_storage_supervisor, and an unguarded
+        # alive-check-then-spawn could double the heal loop
+        # (tpudra-racegraph pins the lockset).
+        self._storage_heal_lock = lockwitness.make_lock("driver.storage_heal_lock")
         # Side-effect fan-out pool.  Threads spawn lazily on first multi-
         # claim batch; single-claim batches run inline on the RPC thread
         # (no hop, no pool wakeup — the common kubelet case).
@@ -693,13 +699,14 @@ class Driver:
         cluster sim runs hundreds of drivers with no socket/publisher
         threads — call this directly so degraded-mode announce/heal runs
         there exactly as in production.  Idempotent."""
-        t = self._storage_heal_thread
-        if t is not None and t.is_alive():
-            return
-        self._storage_heal_thread = threading.Thread(
-            target=self._storage_heal_loop, daemon=True, name="storage-heal"
-        )
-        self._storage_heal_thread.start()
+        with self._storage_heal_lock:
+            t = self._storage_heal_thread
+            if t is not None and t.is_alive():
+                return
+            self._storage_heal_thread = threading.Thread(
+                target=self._storage_heal_loop, daemon=True, name="storage-heal"
+            )
+            self._storage_heal_thread.start()
 
     def _join_storage_supervisor(self, timeout: float = 10.0) -> None:
         """Wait the heal supervisor out (``_stop`` must already be set).
@@ -906,6 +913,9 @@ class Driver:
             return
         with self._publish_cond:
             self._publish_seq += 1
+            if racewitness.enabled():
+                racewitness.note_access("Driver._publish_seq")
+                racewitness.note_hb_send("driver.publish_cond")
             # notify_all: drain_publishes waiters share this condition, and
             # a bare notify() could wake one of them instead of the
             # publisher, stalling the publish until the 1 s poll timeout.
@@ -921,6 +931,8 @@ class Driver:
                 if remaining <= 0:
                     return False
                 self._publish_cond.wait(remaining)
+            if racewitness.enabled():
+                racewitness.note_hb_recv("driver.publish_cond")
             return True
 
     def _needs_reassert(self) -> bool:
@@ -955,6 +967,8 @@ class Driver:
                     and not self._needs_reassert()
                 ):
                     self._publish_cond.wait(1.0)
+                if racewitness.enabled():
+                    racewitness.note_hb_recv("driver.publish_cond")
             if self._stop.is_set():
                 return
             # Coalescing window — outside every lock (BLOCK-UNDER-LOCK).
@@ -975,6 +989,9 @@ class Driver:
             with self._publish_cond:
                 absorbed = target - self._publish_done - 1
                 self._publish_done = target
+                if racewitness.enabled():
+                    racewitness.note_access("Driver._publish_done")
+                    racewitness.note_hb_send("driver.publish_cond")
                 self._publish_cond.notify_all()  # wake drain_publishes waiters
             if absorbed > 0:
                 metrics.SLICE_PUBLISH_COALESCED.labels(TPU_DRIVER_NAME).inc(

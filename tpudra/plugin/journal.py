@@ -238,6 +238,7 @@ class Journal:
         storage.ftruncate(fd, size)
 
     def close(self) -> None:
+        # tpudra-race: handoff shutdown choreography: close() runs after the owning loops have stopped (the driver joins its workers and supervisors first); every live-path write holds the cp.lock flock
         fd, self._fd = self._fd, None
         if fd is not None:
             storage.close(fd)

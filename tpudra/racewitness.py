@@ -1,0 +1,264 @@
+"""Runtime race witness (the racegraph dynamic side).
+
+tpudra-racegraph's static model (tpudra/analysis/racemodel.py) claims
+every cross-thread field keeps a consistent lockset or a happens-before
+edge; this module is its runtime cross-check, the third witness after
+the lock witness (lock-order) and the WAL witness (crash-consistency).
+
+With ``TPUDRA_RACE_WITNESS=1`` armed, each instrumented shared-field
+access logs a SAMPLE: the field id (the static model's ``Class.attr``
+display name), the accessing thread, whether it wrote, the lock IDs held
+right now (piggybacked on the lock witness's per-thread held stack), and
+the thread's **vector clock**.  Instrumented happens-before points —
+thread start handoffs, queue put/get, condition notify/wait, event set —
+advance the clocks: ``note_hb_send`` merges the sender's clock into the
+channel and ticks the sender's own epoch; ``note_hb_recv`` merges the
+channel into the receiver.  Two samples are then provably ordered exactly
+when one's clock dominates the other's epoch — and a pair of WRITE
+samples from different threads with disjoint locksets and NO ordering is
+a witnessed race, whatever the schedule happened to interleave.
+
+``python -m tpudra.analysis --race-witness <log>`` merges the log into
+the static model (tpudra/analysis/racemerge.py): witnessed races fail,
+and so do MODEL GAPS — an access from a thread role the model says
+cannot reach that field.  Coverage (modeled shared fields never
+witnessed) is reported without failing.
+
+With the variable unset (every production path), every hook is a single
+falsy env check — zero allocation, zero I/O.
+
+Conventions shared with the static model:
+
+- Field ids are the model's display names (``WorkQueue._heap``); channel
+  ids are the guarding primitive's lock-witness id where one exists
+  (``workqueue.cond``) so the three witnesses tell one story.
+- Thread names are the role vocabulary: production threads are spawned
+  with ``name=`` matching the static role ids, and the merge classifies
+  a sample's thread to the longest role-id prefix (``MainThread`` →
+  ``main``; unnamed test threads match no role and cannot gap).
+- Clocks are per-process: samples from different pids never race each
+  other (each pid has its own memory), so every record carries the pid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from tpudra import lockwitness
+
+ENV_WITNESS = "TPUDRA_RACE_WITNESS"
+ENV_WITNESS_LOG = "TPUDRA_RACE_WITNESS_LOG"
+DEFAULT_LOG = "tpudra-race-witness.jsonl"
+
+MAIN_THREAD_NAME = "MainThread"
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_WITNESS, "") not in ("", "0")
+
+
+def log_path() -> str:
+    return os.environ.get(ENV_WITNESS_LOG, "") or os.path.join(
+        os.getcwd(), DEFAULT_LOG
+    )
+
+
+# ----------------------------------------------------------------- recording
+
+_guard = threading.Lock()
+_sink = None  # opened lazily, OUTSIDE _guard (no open-under-lock)
+#: thread name → {thread name → epoch}: the per-thread vector clocks.
+#: Keyed by name, not TLS — the merge compares by thread name and tests
+#: need to inspect foreign threads' clocks.
+_vcs: dict = {}
+#: channel id → merged clock of every send so far.
+_channels: dict = {}
+_written: set = set()  # emitted sample keys (first-seen dedup)
+_meta_done = False
+
+
+def _my_vc_locked(name: str) -> dict:
+    vc = _vcs.get(name)
+    if vc is None:
+        vc = _vcs[name] = {name: 0}
+    return vc
+
+
+def _merge_into_locked(dst: dict, src: dict) -> None:
+    for k, v in src.items():
+        if v > dst.get(k, -1):
+            dst[k] = v
+
+
+# tpudra-lock: nonblocking the witness is the measurement apparatus: armed only in test harnesses, and the sink append+flush must run inside the instrumented critical section so the sampled lockset is the one actually held
+def _emit(record: dict) -> None:
+    global _sink
+    if _sink is None:
+        # Open before taking the guard; a racing double-open leaves one
+        # extra O_APPEND handle to close, never a torn line.
+        fh = open(log_path(), "a", encoding="utf-8")
+        with _guard:
+            if _sink is None:
+                _sink = fh
+                fh = None
+        if fh is not None:
+            fh.close()
+    line = json.dumps(record, sort_keys=True) + "\n"
+    with _guard:
+        _sink.write(line)
+        _sink.flush()
+
+
+def _emit_meta_once() -> None:
+    """One record per process saying whether the LOCK witness is armed:
+    without it the held stacks are empty and every lockset in this pid's
+    samples is vacuous — the merge must not call those races."""
+    global _meta_done
+    with _guard:
+        if _meta_done:
+            return
+        _meta_done = True
+    _emit(
+        {
+            "t": "meta",
+            "pid": os.getpid(),
+            "locks_armed": lockwitness.enabled(),
+        }
+    )
+
+
+def note_hb_send(channel: str) -> None:
+    """A happens-before source: queue put, condition notify, event set,
+    or the pre-``start()`` handoff of a thread spawn.  Publishes the
+    caller's clock into the channel, then ticks the caller's epoch so
+    later work is NOT covered by this publication."""
+    if not enabled():
+        return
+    name = threading.current_thread().name
+    with _guard:
+        vc = _my_vc_locked(name)
+        chan = _channels.setdefault(channel, {})
+        _merge_into_locked(chan, vc)
+        vc[name] = vc.get(name, 0) + 1
+
+
+def note_hb_recv(channel: str) -> None:
+    """A happens-before sink: queue get, condition wait return, event
+    wait, or a spawned thread's loop entry.  Everything the channel has
+    seen now happens-before this thread's subsequent accesses."""
+    if not enabled():
+        return
+    name = threading.current_thread().name
+    with _guard:
+        chan = _channels.get(channel)
+        if chan:
+            _merge_into_locked(_my_vc_locked(name), chan)
+
+
+def note_access(field: str, write: bool = True) -> None:
+    """Sample one access to a modeled shared field.  First-seen dedup per
+    (field, thread, write, held-lockset): the witness samples states, it
+    does not trace — same philosophy as the lock witness's first-seen
+    edges, bounded output however hot the loop."""
+    if not enabled():
+        return
+    _emit_meta_once()
+    name = threading.current_thread().name
+    locks = tuple(lockwitness.held_by_current_thread())
+    key = (field, name, write, locks)
+    with _guard:
+        if key in _written:
+            return
+        _written.add(key)
+        vc = dict(_my_vc_locked(name))
+    _emit(
+        {
+            "t": "access",
+            "field": field,
+            "thread": name,
+            "write": write,
+            "locks": list(locks),
+            "vc": vc,
+            "pid": os.getpid(),
+        }
+    )
+
+
+def vector_clock(thread_name: str | None = None) -> dict:
+    """The (copied) clock of one thread (tests)."""
+    name = thread_name or threading.current_thread().name
+    with _guard:
+        return dict(_vcs.get(name, {}))
+
+
+def reset_for_tests() -> None:
+    """Drop clocks/channels/dedup/sink state so a test can witness into a
+    fresh log file."""
+    global _sink, _vcs, _channels, _written, _meta_done
+    with _guard:
+        sink, _sink = _sink, None
+        _vcs = {}
+        _channels = {}
+        _written = set()
+        _meta_done = False
+    if sink is not None:
+        sink.close()
+
+
+# ------------------------------------------------------------------- reading
+
+
+class Sample:
+    __slots__ = ("field", "thread", "write", "locks", "vc", "pid")
+
+    def __init__(self, field, thread, write, locks, vc, pid):
+        self.field = field
+        self.thread = thread
+        self.write = write
+        self.locks = frozenset(locks)
+        self.vc = vc
+        self.pid = pid
+
+    def ordered_before(self, other: "Sample") -> bool:
+        """True when this sample provably happens-before ``other``: the
+        other thread has (transitively) received this thread's epoch."""
+        return other.vc.get(self.thread, -1) >= self.vc.get(self.thread, 0)
+
+
+def read_log(path: str) -> tuple[list, dict]:
+    """(samples, {pid: locks_armed}) recorded in a witness log.
+    Malformed lines are skipped — a SIGKILLed witness process may tear
+    its final line."""
+    samples: list = []
+    armed: dict = {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("t") == "meta" and "pid" in rec:
+                    armed[rec["pid"]] = bool(rec.get("locks_armed"))
+                elif rec.get("t") == "access" and rec.get("field"):
+                    samples.append(
+                        Sample(
+                            field=rec["field"],
+                            thread=rec.get("thread", "?"),
+                            write=bool(rec.get("write")),
+                            locks=rec.get("locks", ()),
+                            vc={
+                                str(k): int(v)
+                                for k, v in (rec.get("vc") or {}).items()
+                            },
+                            pid=rec.get("pid", 0),
+                        )
+                    )
+    except FileNotFoundError:
+        pass
+    return samples, armed

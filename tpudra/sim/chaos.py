@@ -127,7 +127,7 @@ import time
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
-from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, storage, trace
+from tpudra import TPU_DRIVER_NAME, lockwitness, metrics, racewitness, storage, trace
 from tpudra.clock import MonotonicAger, SkewedClock
 from tpudra.kube import gvr
 from tpudra.kube.deadline import api_deadline
@@ -218,6 +218,10 @@ INV_CDI_LEAK = "cdi-leak"
 INV_FLOCK_LEAK = "flock-leak"
 INV_SLICE_CONVERGENCE = "slice-convergence"
 INV_LOCK_WITNESS = "lock-witness"
+#: Finalize-time merge of the vector-clock race witness log against the
+#: static thread/race model (tpudra-racegraph): a witnessed unordered
+#: cross-thread write pair or a model gap fails the soak.
+INV_RACE_WITNESS = "race-witness"
 INV_FAULT_RECOVERY = "fault-recovery"
 INV_GANG_ATOMICITY = "gang-atomicity"
 #: No quiet-window ResourceSlice may advertise silicon its driver holds
@@ -259,6 +263,7 @@ INVARIANTS = (
     INV_FLOCK_LEAK,
     INV_SLICE_CONVERGENCE,
     INV_LOCK_WITNESS,
+    INV_RACE_WITNESS,
     INV_FAULT_RECOVERY,
     INV_GANG_ATOMICITY,
     INV_SLICE_HEALTH,
@@ -438,6 +443,28 @@ class ChaosSoak:
                 ),
             )
             lockwitness.reset_for_tests()
+            # The race witness rides along: with the lock witness armed the
+            # sampled locksets are real, so the finalize merge can tell a
+            # guarded access from a racing one.
+            os.environ[racewitness.ENV_WITNESS] = "1"
+            os.environ.setdefault(
+                racewitness.ENV_WITNESS_LOG,
+                os.path.join(
+                    os.path.dirname(config.report_path) or ".",
+                    "soak-race-witness.jsonl",
+                ),
+            )
+            racewitness.reset_for_tests()
+            # The finalize merges assert THIS run's schedule against the
+            # model at THIS commit; the logs are O_APPEND (crash-safe for
+            # the sweeps' multi-process harnesses, which get a fresh tmp
+            # dir per test), so a leftover from a prior soak would replay
+            # stale lock ids into the gap check.  Start clean.
+            for stale in (lockwitness.log_path(), racewitness.log_path()):
+                try:
+                    os.remove(stale)
+                except FileNotFoundError:
+                    pass
         # The soak runs with the fractional-chip gates ON (partition_fault
         # needs dynamic partitions + multi-process sharing) over a
         # partitionable generation — the gates COMPOSE by design
@@ -3469,6 +3496,33 @@ class ChaosSoak:
             ),
         )
 
+    def _check_race_witness(self) -> None:
+        """Finalize-time merge of the vector-clock race witness log
+        against the static thread/race model: a witnessed unordered
+        cross-thread write pair, or an access from a role the model cannot
+        route to the field, is a race (or a model hole) the quiet-path
+        tests never provoked."""
+        if not self.config.witness:
+            return
+        log = racewitness.log_path()
+        if not os.path.exists(log):
+            self._anomaly("race witness armed but no race log was written")
+            return
+        from tpudra.analysis.racemerge import build_graph, merge
+
+        result = build_graph(os.path.dirname(os.path.dirname(__file__)))
+        report = merge(result, log)
+        self._check(
+            INV_RACE_WITNESS,
+            report.ok,
+            key="witness",
+            detail=(
+                f"violations={len(report.violations)} "
+                f"gaps={len(report.model_gaps)} "
+                f"coverage={report.coverage():.0%}"
+            ),
+        )
+
     # ------------------------------------------------------------------ run
 
     def run(self) -> dict:
@@ -3498,6 +3552,7 @@ class ChaosSoak:
         for i in range(self.config.nodes):
             self._gc_pass(i)
         self._check_lock_witness()
+        self._check_race_witness()
         report = self._report()
         self._close_cd_stack()
         self._close_daemon_stack()

@@ -35,6 +35,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from tpudra import lockwitness
 from tpudra.kube import gvr
 from tpudra.kube.errors import ApiError, Conflict, NotFound
 from tpudra.sim.sched import (
@@ -184,6 +185,10 @@ class ClusterSim:
         self._claim_users: dict[str, set[str]] = {}
         self._prepared_claims: set[str] = set()
         self._dra_clients: dict[tuple[str, str], object] = {}
+        # Pod prepare/unprepare threads share the client cache with the
+        # main loop; the get-or-create below is a classic TOCTOU without
+        # a guard (tpudra-racegraph pins the lockset).
+        self._dra_lock = lockwitness.make_lock("kubelet.dra_clients")
         self._stop = threading.Event()
 
     # ----------------------------------------------------------- plumbing
@@ -197,8 +202,12 @@ class ClusterSim:
             sock = node.drivers.get(driver)
             if not sock:
                 raise RuntimeError(f"node {node.name} has no driver {driver}")
+            # Construct outside the lock (the client may dial its socket);
+            # setdefault under it keeps one canonical client per key when
+            # two pod threads race the miss.
             cli = DRAClient(sock)
-            self._dra_clients[key] = cli
+            with self._dra_lock:
+                cli = self._dra_clients.setdefault(key, cli)
         return cli
 
     def _annotate(self, pod_run: _PodRun, annotations: dict) -> None:

@@ -36,7 +36,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from tpudra import lockwitness
+from tpudra import lockwitness, racewitness
 from tpudra.backoff import capped_exponential
 
 logger = logging.getLogger(__name__)
@@ -287,6 +287,11 @@ class WorkQueue:
             else:
                 heapq.heappush(self._heap, entry)
             self._update_depth()
+            if racewitness.enabled():
+                # The enqueue→pop handoff is the queue's happens-before
+                # edge; sampled inside the cond so the held lockset is real.
+                racewitness.note_access("WorkQueue._heap")
+                racewitness.note_hb_send("workqueue.cond")
             self._cond.notify()
 
     # -- fair-dispatch internals (every helper expects self._cond held) -----
@@ -425,9 +430,15 @@ class WorkQueue:
                     entry = self._ready_pop()
                     if entry is not None:
                         self._inflight += 1
+                        if racewitness.enabled():
+                            racewitness.note_hb_recv("workqueue.cond")
+                            racewitness.note_access("WorkQueue._heap")
                         return entry
                 elif self._heap and self._heap[0].ready_at <= now:
                     self._inflight += 1
+                    if racewitness.enabled():
+                        racewitness.note_hb_recv("workqueue.cond")
+                        racewitness.note_access("WorkQueue._heap")
                     return heapq.heappop(self._heap)
                 if self._heap:
                     self._cond.wait(
